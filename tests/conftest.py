@@ -1,0 +1,14 @@
+"""Test harness config: force an 8-device virtual CPU mesh before JAX loads.
+
+Mirrors the reference's "local mode" testing stance (SURVEY.md §4): the same
+SPMD code paths run on fake CPU devices, no TPU required.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

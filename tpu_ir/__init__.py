@@ -1,0 +1,9 @@
+"""tpu_ir — a TPU-native (JAX/XLA/pjit) information-retrieval framework with
+the capabilities of the reference MapReduce search engine
+(a-to-the-5/Simple-MapReduce-Search-Engine-Information-Retrieval-):
+TREC ingestion, tag-aware analysis, term-k-gram inverted indexing,
+char-k-gram wildcard indexing, a term dictionary, and batched top-k TF-IDF /
+BM25 ranked retrieval — built SPMD-first on jax.sharding meshes instead of
+Hadoop MapReduce."""
+
+__version__ = "0.1.0"

@@ -1,0 +1,14 @@
+from .analyzer import Analyzer, analyze
+from .porter2 import Porter2Stemmer, stem
+from .stopwords import TERRIER_STOPWORDS
+from .tag_tokenizer import TagTokenizer, tokenize
+
+__all__ = [
+    "Analyzer",
+    "analyze",
+    "Porter2Stemmer",
+    "stem",
+    "TERRIER_STOPWORDS",
+    "TagTokenizer",
+    "tokenize",
+]

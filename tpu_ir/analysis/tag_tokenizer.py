@@ -1,0 +1,269 @@
+"""HTML/XML-tag-aware tokenizer.
+
+Behavior-parity target: the Galago TagTokenizer vendored by the reference
+(org/galagosearch/core/parse/TagTokenizer.java). Semantics reproduced:
+
+- Split characters: all ASCII codepoints <= 32 plus
+  ``; " & / : ! # ? $ % ( ) @ ^ * + - , = > < [ ] { } | ` ~ _``
+  (TagTokenizer.java:73-95). Period and apostrophe are NOT split chars.
+- ``<`` opens tag handling: ``</`` end tag, ``<!`` comment, ``<?`` processing
+  instruction, otherwise begin tag (:602-620). ``<style>``/``<script>``
+  content is ignored until the matching end tag (:97-102, :388-390).
+- ``&`` starts an XML-entity skip when followed by ``[a-z0-9#]* ;`` (:644-662).
+- Token post-processing (:573-600): tokens of only ``[a-z0-9]`` pass through;
+  uppercase/apostrophes trigger a simple fix (ASCII lowercase + apostrophe
+  removal, :536-559); any other character triggers a complex fix (simple fix
+  + full lowercase, :455-460); any period triggers acronym processing
+  (:479-527) — strip edge periods, collapse true acronyms (periods at all odd
+  positions), otherwise split on periods keeping pieces of length >= 2.
+- Tokens longer than 16 chars AND >= 100 UTF-8 bytes are dropped (:439-453).
+
+This is a new implementation (regex-assisted scan), not a port of the Java
+character loop.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+
+_SPLIT_CHARS = set(';"&/:!#?$%()@^*+-,=><[]{}|`~_') | {chr(c) for c in range(33)}
+_IGNORED_TAGS = frozenset(("style", "script"))
+_MAX_TOKEN_BYTES = 100
+
+
+def _is_space_char(c: str) -> bool:
+    # Java Character.isSpaceChar == Unicode space separator categories
+    # (NOT \t/\n/\r).
+    return c == " " or unicodedata.category(c) in ("Zs", "Zl", "Zp")
+
+
+def _simple_fix(token: str) -> str:
+    out = []
+    for c in token:
+        if "A" <= c <= "Z":
+            out.append(chr(ord(c) + 32))
+        elif c == "'":
+            continue
+        else:
+            out.append(c)
+    return "".join(out)
+
+
+def _complex_fix(token: str) -> str:
+    return _simple_fix(token).lower()
+
+
+def _classify(token: str) -> int:
+    """0=clean, 1=simple fix, 2=complex fix, 3=acronym processing."""
+    status = 0
+    for c in token:
+        if "a" <= c <= "z" or "0" <= c <= "9":
+            continue
+        if c == ".":
+            return 3
+        if (("A" <= c <= "Z") or c == "'") and status == 0:
+            status = 1
+        elif not (("A" <= c <= "Z") or c == "'"):
+            status = 2
+    return status
+
+
+class TagTokenizer:
+    """Stateful single-document tokenizer; use :func:`tokenize` for one-shots."""
+
+    def __init__(self) -> None:
+        self.tokens: list[str] = []
+        self._text = ""
+        self._ignore_until: str | None = None
+
+    def tokenize(self, text: str) -> list[str]:
+        self.tokens = []
+        self._text = text
+        self._ignore_until = None
+        n = len(text)
+        pos = 0
+        last_split = -1
+
+        while 0 <= pos < n:
+            c = text[pos]
+            if c == "<":
+                if self._ignore_until is None:
+                    self._on_token(last_split + 1, pos)
+                pos = self._on_start_bracket(pos)
+                last_split = pos
+            elif self._ignore_until is not None:
+                pass
+            elif c == "&":
+                self._on_token(last_split + 1, pos)
+                last_split = pos
+                skip_to = self._entity_end(pos)
+                if skip_to is not None:
+                    pos = skip_to
+                    last_split = skip_to
+            elif ord(c) < 256 and c in _SPLIT_CHARS:
+                self._on_token(last_split + 1, pos)
+                last_split = pos
+            pos += 1
+
+        if self._ignore_until is None:
+            self._on_token(last_split + 1, n)
+        return self.tokens
+
+    # -- token emission ---------------------------------------------------
+
+    def _on_token(self, start: int, end: int) -> None:
+        if end <= start:
+            return
+        token = self._text[start:end]
+        status = _classify(token)
+        if status == 1:
+            self._add(_simple_fix(token))
+        elif status == 2:
+            self._add(_complex_fix(token))
+        elif status == 3:
+            self._acronym(token)
+        else:
+            self._add(token)
+
+    def _add(self, token: str) -> None:
+        if not token:
+            return
+        if len(token) > _MAX_TOKEN_BYTES // 6 and len(token.encode("utf-8")) >= _MAX_TOKEN_BYTES:
+            return
+        self.tokens.append(token)
+
+    def _acronym(self, token: str) -> None:
+        token = _complex_fix(token)
+        token = token.strip(".")
+        if "." in token:
+            is_acronym = len(token) > 0 and all(
+                token[i] == "." for i in range(1, len(token), 2)
+            )
+            if is_acronym:
+                self._add(token.replace(".", ""))
+            else:
+                for piece in token.split("."):
+                    if len(piece) > 1:
+                        self._add(piece)
+        else:
+            self._add(token)
+
+    # -- markup handling --------------------------------------------------
+
+    def _entity_end(self, pos: int) -> int | None:
+        """Index of the ';' ending a valid entity starting at '&', else None."""
+        text = self._text
+        for i in range(pos + 1, len(text)):
+            c = text[i]
+            if ("a" <= c <= "z") or ("0" <= c <= "9") or c == "#":
+                continue
+            if c == ";":
+                return i
+            break
+        return None
+
+    def _on_start_bracket(self, pos: int) -> int:
+        text = self._text
+        n = len(text)
+        if pos + 1 >= n:
+            return n
+        c = text[pos + 1]
+        if c == "/":
+            return self._parse_end_tag(pos)
+        if c == "!":
+            return self._skip_comment(pos)
+        if c == "?":
+            end = text.find("?>", pos + 1)
+            return n if end < 0 else end
+        return self._parse_begin_tag(pos)
+
+    def _skip_comment(self, pos: int) -> int:
+        text = self._text
+        if text.startswith("<!--", pos):
+            end = text.find("-->", pos + 1)
+            return len(text) if end < 0 else end + 2
+        end = text.find(">", pos + 1)
+        return len(text) if end < 0 else end
+
+    def _tag_name_end(self, start: int) -> int:
+        text = self._text
+        i = start
+        while i < len(text) and not (_is_space_char(text[i]) or text[i] == ">"):
+            i += 1
+        return i
+
+    def _parse_end_tag(self, pos: int) -> int:
+        text = self._text
+        i = self._tag_name_end(pos + 2)
+        name = text[pos + 2 : i].lower()
+        if self._ignore_until is not None and self._ignore_until == name:
+            self._ignore_until = None
+        while i < len(text) and text[i] != ">":
+            i += 1
+        return i
+
+    def _parse_begin_tag(self, pos: int) -> int:
+        text = self._text
+        n = len(text)
+        i = self._tag_name_end(pos + 1)
+        name = text[pos + 1 : i].lower()
+
+        # advance over attributes to the tag-closing '>' (or text end),
+        # honoring quoted attribute values; detect self-closing '/>'
+        close_it = False
+        while i < n and _is_space_char(text[i]):
+            i += 1
+        if i >= n:
+            i = n
+        elif text[i] == ">":
+            pass
+        else:
+            tag_end = text.find(">", i + 1)
+            if tag_end < 0:
+                pass  # malformed: resume scanning right after the name
+            else:
+                while i < tag_end:
+                    start = i
+                    while start < tag_end and _is_space_char(text[start]):
+                        start += 1
+                    if text[start] == ">":
+                        i = start
+                        break
+                    if text[start] == "/" and start + 1 < n and text[start + 1] == ">":
+                        i = start + 1
+                        close_it = True
+                        break
+                    end = self._attr_end(start, tag_end)
+                    if end is None:
+                        i = tag_end
+                        break
+                    i = end
+                    if i < n and text[i] in "\"'":
+                        i += 1
+
+        if name in _IGNORED_TAGS and not close_it:
+            self._ignore_until = name
+        return i
+
+    def _attr_end(self, start: int, tag_end: int) -> int | None:
+        """End index of one attribute (first unquoted space-char or '>')."""
+        text = self._text
+        in_quote = False
+        escaped = False
+        for i in range(start, tag_end + 1):
+            c = text[i]
+            if c in "\"'" and not escaped:
+                in_quote = not in_quote
+                if not in_quote:
+                    return i
+            elif not in_quote and (_is_space_char(c) or c == ">"):
+                return i
+            elif c == "\\" and not escaped:
+                escaped = True
+                continue
+            escaped = False
+        return None
+
+
+def tokenize(text: str) -> list[str]:
+    return TagTokenizer().tokenize(text)
