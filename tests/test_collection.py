@@ -1,0 +1,74 @@
+import gzip
+import io
+
+import pytest
+
+from tpu_ir.collection import DocnoMapping, Vocab, kgram_terms, read_trec_stream
+from tpu_ir.collection.trec import read_trec_file
+
+
+def make_corpus(docs: dict[str, str]) -> bytes:
+    return b"".join(
+        f"<DOC>\n<DOCNO> {docid} </DOCNO>\n<TEXT>\n{text}\n</TEXT>\n</DOC>\n".encode()
+        for docid, text in docs.items()
+    )
+
+
+def test_stream_reader_basic():
+    raw = make_corpus({"D1": "alpha beta", "D2": "gamma"})
+    docs = list(read_trec_stream(io.BufferedReader(io.BytesIO(raw))))
+    assert [d.docid for d in docs] == ["D1", "D2"]
+    assert "alpha beta" in docs[0].content
+    assert docs[0].offset == 0
+    assert docs[1].offset == raw.find(b"<DOC>", 1)
+
+
+def test_stream_reader_tiny_chunks_and_noise():
+    # record split across chunk boundaries + garbage between records
+    raw = b"junk " + make_corpus({"A": "x" * 50}) + b" mid-noise " + make_corpus({"B": "y"})
+    docs = list(read_trec_stream(io.BufferedReader(io.BytesIO(raw)), chunk_size=7))
+    assert [d.docid for d in docs] == ["A", "B"]
+
+
+def test_gzip_transparent(tmp_path):
+    raw = make_corpus({"G1": "zipped content"})
+    p = tmp_path / "corpus.gz"
+    p.write_bytes(gzip.compress(raw))
+    docs = list(read_trec_file(p))
+    assert [d.docid for d in docs] == ["G1"]
+
+
+def test_docno_mapping_roundtrip(tmp_path):
+    m = DocnoMapping.build(["WSJ-2", "AP-1", "FT-3", "AP-1"])
+    # 1-based, sorted-docid order (reference NumberTrecDocuments semantics)
+    assert len(m) == 3
+    assert m.get_docno("AP-1") == 1
+    assert m.get_docno("FT-3") == 2
+    assert m.get_docno("WSJ-2") == 3
+    assert m.get_docid(2) == "FT-3"
+    with pytest.raises(KeyError):
+        m.get_docno("NOPE")
+    p = tmp_path / "docnos.txt"
+    m.save(p)
+    m2 = DocnoMapping.load(p)
+    assert m2.docids == m.docids
+
+
+def test_vocab_roundtrip(tmp_path):
+    v = Vocab.build(["zebra", "apple", "mango", "apple"])
+    assert len(v) == 3
+    assert v.id("apple") == 0 and v.id("zebra") == 2
+    assert v.term(1) == "mango"
+    assert v.id_or("nope") == -1
+    p = tmp_path / "vocab.txt"
+    v.save(p)
+    assert Vocab.load(p).terms == v.terms
+
+
+def test_kgram_terms():
+    toks = ["a", "b", "c", "d"]
+    assert kgram_terms(toks, 1) == toks
+    assert kgram_terms(toks, 2) == ["a b", "b c", "c d"]
+    assert kgram_terms(toks, 4) == ["a b c d"]
+    # shorter than k -> nothing (reference TermKGramDocIndexer.java:144-146)
+    assert kgram_terms(["a"], 2) == []

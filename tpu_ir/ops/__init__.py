@@ -1,0 +1,26 @@
+from .chargram import (
+    CharGramIndex,
+    build_chargram_index,
+    build_chargram_index_jit,
+    code_to_gram,
+    gram_to_code,
+    pack_term_bytes,
+)
+from .postings import PAD_TERM, Postings, build_postings, build_postings_jit, pack_occurrences
+from .scoring import (
+    PAD_QTERM,
+    bm25_topk_dense,
+    dense_doc_matrix,
+    idf_weights,
+    tfidf_topk_dense,
+    tfidf_topk_sparse,
+)
+
+__all__ = [
+    "CharGramIndex", "build_chargram_index", "build_chargram_index_jit",
+    "code_to_gram", "gram_to_code", "pack_term_bytes",
+    "PAD_TERM", "Postings", "build_postings", "build_postings_jit",
+    "pack_occurrences",
+    "PAD_QTERM", "bm25_topk_dense", "dense_doc_matrix", "idf_weights",
+    "tfidf_topk_dense", "tfidf_topk_sparse",
+]

@@ -1,0 +1,140 @@
+"""Char-k-gram -> term index construction on device.
+
+Parity target: CharKGramTermIndexer (sa/edu/kaust/indexing/
+CharKGramTermIndexer.java:88-209): every vocabulary term is padded as
+"$term$", each length-k character window maps gram -> set of containing
+terms; the output lists are sorted and deduplicated (the reference reducer's
+iterative pairwise sorted-merge).
+
+TPU-first: terms become a padded uint8 matrix; sliding windows are a strided
+gather; each gram packs its k bytes into one int32 code (k <= 4); then the
+same sort + run-length machinery as the inverted index groups (gram, term)
+pairs. Because term ids are assigned in lexicographic order, the per-gram
+term-id lists come out sorted exactly like the reference's merged string
+lists. For k > 4 the host packer hashes bytes into 32 bits instead (gram
+strings themselves stay host-side either way).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .postings import PAD_TERM
+
+BOUNDARY = ord("$")  # reference pads terms as $term$ (CharKGramTermIndexer.java:99)
+PAD_BYTE = 0
+
+
+class CharGramIndex(NamedTuple):
+    """gram_codes: int32 [G] sorted unique packed grams (valid prefix
+    num_grams); indptr int32 [G+1]; term_ids int32 [C] (valid prefix
+    num_entries) sorted within each gram; counts per gram in gram_df."""
+
+    gram_codes: jax.Array
+    indptr: jax.Array
+    term_ids: jax.Array
+    gram_df: jax.Array
+    num_grams: jax.Array
+    num_entries: jax.Array
+
+
+def pack_term_bytes(terms: list[str], k: int) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: encode '$term$' per term (utf-8) as a padded uint8 matrix.
+
+    Returns (bytes_matrix [T, Lmax], lengths [T])."""
+    encoded = [b"$" + t.encode("utf-8") + b"$" for t in terms]
+    lmax = max((len(e) for e in encoded), default=k)
+    lmax = max(lmax, k)
+    out = np.zeros((len(encoded), lmax), np.uint8)
+    lens = np.zeros((len(encoded),), np.int32)
+    for i, e in enumerate(encoded):
+        out[i, : len(e)] = np.frombuffer(e, np.uint8)
+        lens[i] = len(e)
+    return out, lens
+
+
+def build_chargram_index(
+    term_bytes: jax.Array,   # uint8 [T, Lmax]
+    term_lens: jax.Array,    # int32 [T]
+    *,
+    k: int,
+) -> CharGramIndex:
+    """Build the gram -> sorted-term-id lists, fully on device."""
+    if not 1 <= k <= 4:
+        raise ValueError("device path packs k bytes into int32; need 1<=k<=4")
+    t, lmax = term_bytes.shape
+    n_windows = max(lmax - k + 1, 1)
+
+    # [T, n_windows, k] sliding windows via gather
+    win_idx = jnp.arange(n_windows)[:, None] + jnp.arange(k)[None, :]
+    windows = term_bytes[:, win_idx].astype(jnp.int32)      # [T, W, k]
+    shifts = jnp.array([(k - 1 - j) * 8 for j in range(k)], jnp.int32)
+    codes = jnp.sum(windows << shifts[None, None, :], axis=-1)  # [T, W]
+    valid = (jnp.arange(n_windows)[None, :] + k) <= term_lens[:, None]
+
+    flat_codes = jnp.where(valid, codes, PAD_TERM).ravel()
+    flat_terms = jnp.broadcast_to(
+        jnp.arange(t, dtype=jnp.int32)[:, None], codes.shape).ravel()
+    flat_terms = jnp.where(valid.ravel(), flat_terms, 0)
+
+    cap = flat_codes.shape[0]
+    order = jnp.lexsort((flat_terms, flat_codes))
+    g_sorted = flat_codes[order]
+    t_sorted = flat_terms[order]
+    v_sorted = g_sorted != PAD_TERM
+
+    prev_g = jnp.concatenate([jnp.full((1,), -1, jnp.int32), g_sorted[:-1]])
+    prev_t = jnp.concatenate([jnp.full((1,), -1, jnp.int32), t_sorted[:-1]])
+    # dedup identical (gram, term) pairs (a gram appearing twice in one term)
+    new_entry = ((g_sorted != prev_g) | (t_sorted != prev_t)) & v_sorted
+    entry_idx = jnp.cumsum(new_entry.astype(jnp.int32)) - 1
+    num_entries = entry_idx[-1] + 1
+
+    scatter = jnp.where(new_entry, entry_idx, cap)
+    entry_gram = jnp.full((cap,), PAD_TERM, jnp.int32).at[scatter].set(
+        g_sorted, mode="drop")
+    entry_term = jnp.zeros((cap,), jnp.int32).at[scatter].set(
+        t_sorted, mode="drop")
+
+    # unique grams over entries
+    prev_eg = jnp.concatenate([jnp.full((1,), -1, jnp.int32), entry_gram[:-1]])
+    entry_valid = entry_gram != PAD_TERM
+    new_gram = (entry_gram != prev_eg) & entry_valid
+    gram_idx = jnp.cumsum(new_gram.astype(jnp.int32)) - 1
+    num_grams = gram_idx[-1] + 1
+
+    gscatter = jnp.where(new_gram, gram_idx, cap)
+    gram_codes = jnp.full((cap,), PAD_TERM, jnp.int32).at[gscatter].set(
+        entry_gram, mode="drop")
+    gram_df = jnp.zeros((cap,), jnp.int32).at[
+        jnp.where(entry_valid, gram_idx, cap)].add(
+        jnp.ones((cap,), jnp.int32), mode="drop")
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(gram_df).astype(jnp.int32)])
+
+    return CharGramIndex(gram_codes, indptr, entry_term, gram_df,
+                         jnp.asarray(num_grams, jnp.int32),
+                         jnp.asarray(num_entries, jnp.int32))
+
+
+build_chargram_index_jit = jax.jit(build_chargram_index, static_argnames=("k",))
+
+
+def code_to_gram(code: int, k: int) -> str:
+    """Unpack an int32 gram code back to its k-byte string (host-side)."""
+    bs = bytes((code >> (8 * (k - 1 - j))) & 0xFF for j in range(k))
+    return bs.decode("utf-8", "replace")
+
+
+def gram_to_code(gram: str, k: int) -> int:
+    bs = gram.encode("utf-8")
+    if len(bs) != k:
+        raise ValueError(f"gram {gram!r} is not {k} bytes")
+    code = 0
+    for b in bs:
+        code = (code << 8) | b
+    return code

@@ -1,0 +1,138 @@
+"""Device-side inverted-index construction: sort-based group-by under jit.
+
+This replaces the reference's Hadoop map->shuffle->reduce pipeline
+(TermKGramDocIndexer.java:119-213): the mapper's per-occurrence emission
+becomes a flat (term_id, docno) pair array; the shuffle's sort+group becomes
+jnp.lexsort + run-length segmentation; the reducer's per-term merge (sum tf
+per doc, df = number of docs, postings re-sorted by tf desc,
+TermKGramDocIndexer.java:192-211) becomes segment sums and a second lexsort.
+
+Everything is static-shape: inputs are padded to a fixed capacity with
+PAD_TERM, outputs are fixed-size arrays with a `num_pairs` scalar marking the
+valid prefix. That is what lets XLA compile one program and reuse it for
+every input batch (SURVEY.md §7 "device-side group-by").
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Padding sentinel: sorts after every real term id.
+PAD_TERM = np.int32(np.iinfo(np.int32).max)
+
+
+class Postings(NamedTuple):
+    """Term-sharded (or single-shard) postings in compacted sorted order.
+
+    pair_term/pair_doc/pair_tf: int32 [C]; the first `num_pairs` entries are
+    valid, sorted by (term asc, tf desc, doc asc) — the reference's posting
+    order. indptr: int32 [V+1] CSR offsets per term id. df: int32 [V].
+    doc_len: int32 [D+1] total term occurrences per docno (docnos 1-based;
+    slot 0 unused) — needed by BM25, free to compute here.
+    """
+
+    pair_term: jax.Array
+    pair_doc: jax.Array
+    pair_tf: jax.Array
+    indptr: jax.Array
+    df: jax.Array
+    doc_len: jax.Array
+    num_pairs: jax.Array
+
+
+def build_postings(
+    term_ids: jax.Array,
+    doc_ids: jax.Array,
+    *,
+    vocab_size: int,
+    num_docs: int,
+) -> Postings:
+    """Group (term, doc) occurrence pairs into tf postings, fully on device.
+
+    term_ids: int32 [T] with PAD_TERM padding; doc_ids: int32 [T] 1-based
+    docnos (padding value irrelevant). T is static.
+    """
+    term_ids = term_ids.astype(jnp.int32)
+    doc_ids = doc_ids.astype(jnp.int32)
+    t_cap = term_ids.shape[0]
+    valid = term_ids != PAD_TERM
+    doc_ids = jnp.where(valid, doc_ids, 0)
+
+    # --- shuffle: sort by (term, doc) ---
+    order = jnp.lexsort((doc_ids, term_ids))
+    t_sorted = term_ids[order]
+    d_sorted = doc_ids[order]
+    v_sorted = valid[order]
+
+    # --- run-length segmentation into unique (term, doc) pairs ---
+    prev_t = jnp.concatenate([jnp.full((1,), -1, jnp.int32), t_sorted[:-1]])
+    prev_d = jnp.concatenate([jnp.full((1,), -1, jnp.int32), d_sorted[:-1]])
+    new_pair = ((t_sorted != prev_t) | (d_sorted != prev_d)) & v_sorted
+    pair_idx = jnp.cumsum(new_pair.astype(jnp.int32)) - 1  # [T], -1 before 1st
+    num_pairs = pair_idx[-1] + 1 if t_cap else jnp.int32(0)
+
+    # scatter pair attributes; invalid tokens are dropped via OOB index
+    scatter_idx = jnp.where(v_sorted, pair_idx, t_cap)
+    pair_term = jnp.full((t_cap,), PAD_TERM, jnp.int32).at[scatter_idx].set(
+        t_sorted, mode="drop")
+    pair_doc = jnp.zeros((t_cap,), jnp.int32).at[scatter_idx].set(
+        d_sorted, mode="drop")
+    pair_tf = jnp.zeros((t_cap,), jnp.int32).at[scatter_idx].add(
+        v_sorted.astype(jnp.int32), mode="drop")
+
+    # --- df: one count per unique (term, doc) pair ---
+    df_idx = jnp.where(new_pair, t_sorted, vocab_size)
+    df = jnp.zeros((vocab_size,), jnp.int32).at[df_idx].add(
+        jnp.ones((t_cap,), jnp.int32), mode="drop")
+
+    # --- doc lengths (total occurrences per doc) for BM25 ---
+    dl_idx = jnp.where(v_sorted, d_sorted, num_docs + 1)
+    doc_len = jnp.zeros((num_docs + 1,), jnp.int32).at[dl_idx].add(
+        jnp.ones((t_cap,), jnp.int32), mode="drop")
+
+    # --- reference posting order: term asc, tf desc, doc asc ---
+    order2 = jnp.lexsort((pair_doc, -pair_tf, pair_term))
+    pair_term = pair_term[order2]
+    pair_doc = pair_doc[order2]
+    pair_tf = pair_tf[order2]
+
+    indptr = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(df).astype(jnp.int32)])
+
+    return Postings(pair_term, pair_doc, pair_tf, indptr, df,
+                    doc_len, jnp.asarray(num_pairs, jnp.int32))
+
+
+build_postings_jit = jax.jit(
+    build_postings, static_argnames=("vocab_size", "num_docs"))
+
+
+def pack_occurrences(
+    doc_term_ids: list[np.ndarray],
+    docnos: np.ndarray,
+    capacity: int | None = None,
+    round_to: int = 1024,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side packer: per-doc term-id arrays -> flat padded pair arrays.
+
+    This is the map-side emission (one pair per k-gram occurrence). Capacity
+    is rounded up so repeated builds reuse the same compiled program shape.
+    """
+    total = sum(len(a) for a in doc_term_ids)
+    if capacity is None:
+        capacity = max(round_to, ((total + round_to - 1) // round_to) * round_to)
+    if total > capacity:
+        raise ValueError(f"occurrences {total} exceed capacity {capacity}")
+    term_ids = np.full(capacity, PAD_TERM, np.int32)
+    doc_ids = np.zeros(capacity, np.int32)
+    pos = 0
+    for docno, ids in zip(docnos, doc_term_ids):
+        n = len(ids)
+        term_ids[pos : pos + n] = ids
+        doc_ids[pos : pos + n] = docno
+        pos += n
+    return term_ids, doc_ids

@@ -1,0 +1,167 @@
+"""Batched ranked retrieval: TF-IDF / BM25 scoring + top-k, on device.
+
+This replaces the reference's per-query scoring loop
+(IntDocVectorsForwardIndex.java:192-223): score(d) = sum over query terms of
+(1 + ln tf) * log10(N / df), truncated to the top 10. The reference's O(P^2)
+linear-scan accumulation becomes a dense doc-axis accumulator; its
+Collections.sort becomes jax.lax.top_k; and queries are scored in batches so
+the work is a handful of fused gathers/adds per query block instead of a
+Java loop per posting.
+
+Two layouts:
+- dense: a [V, D] term-by-doc (1+ln tf) matrix; scoring a query batch is L
+  embedding-style row gathers + weighted adds (MXU/VPU friendly, best when
+  V*D fits HBM).
+- sparse: CSR postings padded per-term to a cap; scoring scatter-adds each
+  query term's postings slice. Used when the dense matrix would not fit.
+
+Quirk policy (SURVEY.md §7): the reference computes N/df with Java int
+division; `compat_int_idf=True` reproduces that for parity tests, default
+computes float idf. Documented deviation: documents whose total score is
+exactly 0 (every query term has df == N, so idf == 0) are not returned,
+whereas the reference would list them in unspecified order.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+PAD_QTERM = -1
+
+
+def idf_weights(df: jax.Array, num_docs: int, compat_int_idf: bool = False) -> jax.Array:
+    """log10(N/df) per term; df==0 terms get weight 0."""
+    dff = df.astype(jnp.float32)
+    if compat_int_idf:
+        ratio = jnp.floor_divide(
+            jnp.int32(num_docs), jnp.maximum(df, 1)).astype(jnp.float32)
+    else:
+        ratio = num_docs / jnp.maximum(dff, 1.0)
+    w = jnp.log10(jnp.maximum(ratio, 1e-30))
+    return jnp.where(df > 0, w, 0.0)
+
+
+def dense_doc_matrix(postings_pair_term, postings_pair_doc, postings_pair_tf,
+                     *, vocab_size: int, num_docs: int) -> jax.Array:
+    """[V, D+1] matrix of (1+ln tf); column 0 (docno 0) is dead padding."""
+    tf = postings_pair_tf.astype(jnp.float32)
+    w = jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
+    flat = jnp.zeros((vocab_size * (num_docs + 1),), jnp.float32)
+    idx = postings_pair_term * (num_docs + 1) + postings_pair_doc
+    idx = jnp.where(postings_pair_term < vocab_size, idx,
+                    vocab_size * (num_docs + 1))
+    flat = flat.at[idx].add(w, mode="drop")
+    return flat.reshape(vocab_size, num_docs + 1)
+
+
+@partial(jax.jit, static_argnames=("k", "compat_int_idf"))
+def tfidf_topk_dense(
+    q_terms: jax.Array,   # int32 [B, L], PAD_QTERM padding
+    doc_matrix: jax.Array,  # f32 [V, D+1]
+    df: jax.Array,          # int32 [V]
+    num_docs: jax.Array,    # int32 scalar (N)
+    *,
+    k: int = 10,
+    compat_int_idf: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Batched TF-IDF top-k. Returns (scores [B,k], docnos [B,k]);
+    docno 0 marks an empty slot (fewer than k docs matched)."""
+    vocab_size = doc_matrix.shape[0]
+    dff = df.astype(jnp.float32)
+    if compat_int_idf:
+        n = jnp.asarray(num_docs, jnp.int32)
+        ratio = (n // jnp.maximum(df, 1)).astype(jnp.float32)
+    else:
+        ratio = jnp.asarray(num_docs, jnp.float32) / jnp.maximum(dff, 1.0)
+    idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
+
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)
+    q_valid = (q_terms >= 0) & (q_terms < vocab_size)
+    q_idf = jnp.where(q_valid, idf[safe_q], 0.0)          # [B, L]
+    rows = doc_matrix[safe_q]                              # [B, L, D+1]
+    rows = rows * jnp.where(q_valid, 1.0, 0.0)[..., None]
+    scores = jnp.einsum("bld,bl->bd", rows, q_idf)         # [B, D+1]
+    scores = scores.at[:, 0].set(-jnp.inf)                 # dead column
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    matched = top_scores > 0.0
+    return (jnp.where(matched, top_scores, 0.0),
+            jnp.where(matched, top_idx, 0).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("k", "k1", "b"))
+def bm25_topk_dense(
+    q_terms: jax.Array,      # int32 [B, L]
+    tf_matrix: jax.Array,    # f32 [V, D+1] raw tf
+    df: jax.Array,           # int32 [V]
+    doc_len: jax.Array,      # int32 [D+1]
+    num_docs: jax.Array,     # int32 scalar
+    *,
+    k: int = 10,
+    k1: float = 0.9,
+    b: float = 0.4,
+) -> tuple[jax.Array, jax.Array]:
+    """Batched Okapi BM25 top-k (the scorer variant the reference never had
+    but the MS MARCO config needs; SURVEY.md §7 build order)."""
+    vocab_size = tf_matrix.shape[0]
+    n = jnp.asarray(num_docs, jnp.float32)
+    dff = df.astype(jnp.float32)
+    idf = jnp.log(1.0 + (n - dff + 0.5) / (dff + 0.5))
+    avg_dl = jnp.sum(doc_len.astype(jnp.float32)) / jnp.maximum(n, 1.0)
+    dl_norm = 1.0 - b + b * doc_len.astype(jnp.float32) / jnp.maximum(avg_dl, 1e-9)
+
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)
+    q_valid = (q_terms >= 0) & (q_terms < vocab_size)
+    q_idf = jnp.where(q_valid, idf[safe_q], 0.0)           # [B, L]
+    tf = tf_matrix[safe_q]                                  # [B, L, D+1]
+    sat = tf * (k1 + 1.0) / (tf + k1 * dl_norm[None, None, :])
+    scores = jnp.einsum("bld,bl->bd", sat, q_idf)
+    scores = scores.at[:, 0].set(-jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    matched = top_scores > 0.0
+    return (jnp.where(matched, top_scores, 0.0),
+            jnp.where(matched, top_idx, 0).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
+def tfidf_topk_sparse(
+    q_terms: jax.Array,        # int32 [B, L]
+    post_docs: jax.Array,      # int32 [V, P] padded per-term postings (docnos)
+    post_tfs: jax.Array,       # int32 [V, P] padded tfs (0 = empty slot)
+    df: jax.Array,             # int32 [V]
+    n_scalar: jax.Array,       # int32 scalar (N)
+    *,
+    num_docs: int,
+    k: int = 10,
+    compat_int_idf: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Sparse scoring: scatter each query term's postings into a doc-axis
+    accumulator. Work is B*L*P instead of B*L*D."""
+    dff = df.astype(jnp.float32)
+    if compat_int_idf:
+        n = jnp.asarray(n_scalar, jnp.int32)
+        ratio = (n // jnp.maximum(df, 1)).astype(jnp.float32)
+    else:
+        ratio = jnp.asarray(n_scalar, jnp.float32) / jnp.maximum(dff, 1.0)
+    idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
+
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)           # [B, L]
+    q_valid = q_terms >= 0
+    docs = post_docs[safe_q]                                # [B, L, P]
+    tfs = post_tfs[safe_q].astype(jnp.float32)              # [B, L, P]
+    w = jnp.where(tfs > 0, 1.0 + jnp.log(jnp.maximum(tfs, 1.0)), 0.0)
+    w = w * idf[safe_q][..., None] * q_valid[..., None]
+    slot = jnp.where((tfs > 0) & q_valid[..., None], docs, num_docs + 1)
+
+    def score_one(slots_q, w_q):
+        acc = jnp.zeros((num_docs + 1,), jnp.float32)
+        return acc.at[slots_q.ravel()].add(w_q.ravel(), mode="drop")
+
+    scores = jax.vmap(score_one)(slot, w)                   # [B, D+1]
+    scores = scores.at[:, 0].set(-jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(scores, k)
+    matched = top_scores > 0.0
+    return (jnp.where(matched, top_scores, 0.0),
+            jnp.where(matched, top_idx, 0).astype(jnp.int32))
