@@ -2,13 +2,31 @@
 
 Mirrors the reference's "local mode" testing stance (SURVEY.md §4): the same
 SPMD code paths run on fake CPU devices, no TPU required.
+
+The container may register an external TPU PJRT plugin ("axon") via
+sitecustomize whose initialization contacts a tunnel; tests must be hermetic,
+so after importing jax we drop that factory entirely — otherwise any
+jax.devices() call would try (and possibly hang) to initialize it.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+# a pytest plugin may have imported jax before this conftest ran, freezing
+# jax_platforms at the container's env value; override it in-config too
+jax.config.update("jax_platforms", "cpu")
+
+for _name in list(_xb._backend_factories):
+    if _name != "cpu":
+        _xb._backend_factories.pop(_name, None)
+
+assert len(jax.devices("cpu")) == 8, "expected 8 virtual CPU devices"

@@ -1,0 +1,181 @@
+"""End-to-end: toy TREC corpus -> index artifacts -> ranked search, asserted
+against a pure-Python oracle that follows the reference pipeline exactly
+(SURVEY.md §3.3 scoring formula, §7 minimum end-to-end slice)."""
+
+import json
+import math
+import os
+
+import numpy as np
+import pytest
+
+from tpu_ir.analysis import Analyzer
+from tpu_ir.collection import kgram_terms
+from tpu_ir.index import build_index
+from tpu_ir.index import format as fmt
+from tpu_ir.search import Scorer, WildcardLookup
+
+DOCS = {
+    "AP-0001": "The quick brown fox jumps over the lazy dog.",
+    "AP-0002": "A quick quick quick fox. The dog sleeps soundly tonight.",
+    "AP-0010": "Brown bears eat honey. Bears love rivers and salmon fishing.",
+    "FT-0003": "Stock markets fell sharply as investors fled risky assets.",
+    "FT-0004": "Investors bought brown bonds; markets recovered against assets.",
+    "WSJ-9.1": "The lazy dog sleeps while the quick fox watches the river.",
+    "WSJ-9.2": "Salmon fishing season opened; fishermen crowded the rivers.",
+    "ZF-077": "Honey prices rose as bears raided apiaries near the river.",
+}
+
+
+def corpus_file(tmp_path):
+    p = tmp_path / "corpus.trec"
+    body = "".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in DOCS.items())
+    p.write_text(body)
+    return p
+
+
+def oracle_search(query, k_gram=1, topk=10):
+    """Pure-Python reference pipeline: analyze -> postings -> tf-idf."""
+    an = Analyzer()
+    doc_terms = {d: kgram_terms(an.analyze(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>"), k_gram)
+        for d, t in DOCS.items()}
+    n = len(DOCS)
+    q_terms = kgram_terms(an.analyze(query), k_gram)
+    scores = {}
+    for qt in q_terms:
+        posting = {d: ts.count(qt) for d, ts in doc_terms.items()
+                   if qt in ts}
+        df = len(posting)
+        if df == 0:
+            continue
+        idf = math.log10(n / df)
+        for d, tf in posting.items():
+            scores[d] = scores.get(d, 0.0) + (1 + math.log(tf)) * idf
+    ranked = sorted(((d, s) for d, s in scores.items() if s > 0),
+                    key=lambda kv: (-kv[1], kv[0]))
+    return ranked[:topk]
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("e2e")
+    corpus = corpus_file(tmp)
+    out = str(tmp / "index")
+    build_index([str(corpus)], out, k=1, chargram_ks=[2, 3], num_shards=3)
+    return out
+
+
+def test_artifacts_exist(index_dir):
+    for name in [fmt.METADATA, fmt.DOCNOS, fmt.VOCAB, fmt.DOCLEN,
+                 fmt.DICTIONARY, "part-00000.npz", "part-00002.npz",
+                 "chargram-k2.npz", "chargram-k3.npz"]:
+        assert os.path.exists(os.path.join(index_dir, name)), name
+    meta = fmt.IndexMetadata.load(index_dir)
+    assert meta.num_docs == len(DOCS)
+    assert meta.num_shards == 3
+    # job reports with reference counter names
+    report = json.load(open(os.path.join(index_dir, fmt.JOBS_DIR,
+                                         "TermKGramDocIndexer.json")))
+    assert report["counters"]["Count.DOCS"] == len(DOCS)
+    assert report["counters"]["reduce_output_groups"] == meta.vocab_size
+    assert os.path.exists(os.path.join(index_dir, fmt.JOBS_DIR,
+                                       "BuildIntDocVectorsForwardIndex.json"))
+
+
+def test_dictionary_sorted_and_complete(index_dir):
+    meta = fmt.IndexMetadata.load(index_dir)
+    lines = open(os.path.join(index_dir, fmt.DICTIONARY)).read().splitlines()
+    assert len(lines) == meta.vocab_size
+    terms = [l.split("\t")[0] for l in lines]
+    assert terms == sorted(terms)
+    # every term's (shard, offset) points at a real postings slice
+    for line in lines[:50]:
+        term, shard, offset = line.split("\t")
+        z = fmt.load_shard(index_dir, int(shard))
+        local = np.searchsorted(z["indptr"], int(offset))
+        assert z["indptr"][local] == int(offset)
+
+
+@pytest.mark.parametrize("query", [
+    "quick fox", "brown", "salmon fishing", "investors assets",
+    "honey bears", "river", "nonexistentterm", "the",  # stopword-only
+])
+def test_search_matches_oracle(index_dir, query):
+    scorer = Scorer.load(index_dir)
+    got = scorer.search(query, k=10)
+    want = oracle_search(query)
+    assert [d for d, _ in got] == [d for d, _ in want], query
+    for (gd, gs), (wd, ws) in zip(got, want):
+        assert gs == pytest.approx(ws, rel=1e-4)
+
+
+def test_sparse_layout_agrees(index_dir):
+    dense = Scorer.load(index_dir, layout="dense")
+    sparse = Scorer.load(index_dir, layout="sparse")
+    for query in ["quick fox", "honey bears river"]:
+        g1, g2 = dense.search(query), sparse.search(query)
+        assert [d for d, _ in g1] == [d for d, _ in g2]
+        for (_, s1), (_, s2) in zip(g1, g2):
+            assert s1 == pytest.approx(s2, rel=1e-4)
+
+
+def test_batch_search(index_dir):
+    scorer = Scorer.load(index_dir)
+    queries = ["quick fox", "salmon fishing", "honey"]
+    batch = scorer.search_batch(queries)
+    singles = [scorer.search(q) for q in queries]
+    assert batch == singles
+
+
+def test_bm25_reasonable(index_dir):
+    scorer = Scorer.load(index_dir, layout="dense")
+    res = scorer.search("salmon fishing", scoring="bm25")
+    assert res, "bm25 returned nothing"
+    top = [d for d, _ in res[:2]]
+    assert "WSJ-9.2" in top  # the salmon-fishing doc must rank top-2
+
+
+def test_skip_if_exists(index_dir, tmp_path):
+    # second build with same dir returns existing metadata without rebuild
+    meta1 = fmt.IndexMetadata.load(index_dir)
+    meta2 = build_index(["/nonexistent/path"], index_dir)  # corpus not touched
+    assert meta2.__dict__ == meta1.__dict__
+
+
+def test_wildcard_expand(index_dir):
+    lookup = WildcardLookup.load(index_dir, 2)
+    got = set(lookup.expand("riv*"))
+    assert "river" in got
+    for t in got:
+        assert t.startswith("riv")
+    assert lookup.expand("zzz*") == []
+    lookup3 = WildcardLookup.load(index_dir, 3)
+    assert "salmon" in lookup3.expand("sal*on")
+
+
+def test_kgram2_index_and_search(tmp_path):
+    corpus = corpus_file(tmp_path)
+    out = str(tmp_path / "index2")
+    build_index([str(corpus)], out, k=2, num_shards=2,
+                compute_chargrams=False)
+    scorer = Scorer.load(out)
+    got = scorer.search("salmon fishing")
+    want = oracle_search("salmon fishing", k_gram=2)
+    assert [d for d, _ in got] == [d for d, _ in want]
+    for (gd, gs), (wd, ws) in zip(got, want):
+        assert gs == pytest.approx(ws, rel=1e-4)
+
+
+def test_compat_int_idf_quirk(index_dir):
+    """The reference's int-division idf: log10(N//df)."""
+    scorer = Scorer.load(index_dir, compat_int_idf=True)
+    got = scorer.search("brown")  # df=3, N=8 -> log10(8//3=2)
+    an = Analyzer()
+    n, df = len(DOCS), 3
+    idf = math.log10(n // df)
+    for d, s in got:
+        tf = kgram_terms(an.analyze(DOCS[d]), 1).count("brown")
+        assert s == pytest.approx((1 + math.log(tf)) * idf, rel=1e-4)

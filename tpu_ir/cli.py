@@ -1,0 +1,181 @@
+"""CLI surface: index / search / inspect / expand.
+
+Preserves the reference's command shapes (SURVEY.md §1 L5): the reference's
+`hadoop jar cloud9.jar TermKGramDocIndexer k input output mapping` becomes
+`tpu-ir index --k K CORPUS... INDEX_DIR`; the query REPL
+(IntDocVectorsForwardIndex.java:243-322) becomes `tpu-ir search INDEX_DIR`
+(interactive) or `--query/--queries-file` (batch); ReadSequenceFile's index
+dumping becomes `tpu-ir inspect`.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+
+
+def _add_backend_arg(p: argparse.ArgumentParser) -> None:
+    p.add_argument(
+        "--backend", choices=["auto", "cpu", "tpu"], default="auto",
+        help="device backend; 'cpu' is the reference's local mode equivalent")
+
+
+def _apply_backend(args) -> None:
+    if args.backend == "cpu":
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    elif args.backend == "tpu":
+        os.environ.setdefault("JAX_PLATFORMS", "tpu")
+
+
+def cmd_index(args) -> int:
+    _apply_backend(args)
+    from .index import build_index
+
+    meta = build_index(
+        args.corpus, args.index_dir, k=args.k,
+        chargram_ks=args.chargram_k, num_shards=args.shards,
+        overwrite=args.overwrite,
+        compute_chargrams=not args.no_chargrams)
+    print(json.dumps(meta.__dict__))
+    return 0
+
+
+def cmd_search(args) -> int:
+    _apply_backend(args)
+    from .search import Scorer
+
+    scorer = Scorer.load(args.index_dir, layout=args.layout,
+                         compat_int_idf=args.compat)
+    show_docids = not args.docnos
+
+    def run_batch(queries: list[str]) -> None:
+        results = scorer.search_batch(
+            queries, k=args.k, scoring=args.scoring,
+            return_docids=show_docids)
+        for q, res in zip(queries, results):
+            print(f"query: {q}")
+            if not res:
+                print("  (no matching documents)")
+            for rank, (key, score) in enumerate(res, 1):
+                print(f"  {rank:2d}. {key}\t{score:.6f}")
+
+    if args.query:
+        run_batch([args.query])
+    elif args.queries_file:
+        with open(args.queries_file) as f:
+            queries = [line.strip() for line in f if line.strip()]
+        run_batch(queries)
+    else:
+        # interactive REPL (reference main loop); 'exit' quits like the
+        # reference's exit command (IntDocVectorsForwardIndex.java:289)
+        print(f"tpu-ir: {scorer.meta.num_docs} docs, "
+              f"{scorer.meta.vocab_size} terms, k={scorer.meta.k}, "
+              f"layout={scorer.layout}. Type a query, or 'exit'.")
+        while True:
+            try:
+                line = input("query> ").strip()
+            except EOFError:
+                break
+            if not line:
+                continue
+            if line == "exit":
+                break
+            if args.compat and len(line.split()) > 2:
+                # reference guard: only 1-2 word queries (:292,297)
+                print("  (compat mode: queries are limited to 1-2 words)")
+                continue
+            run_batch([line])
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    _apply_backend(args)
+    from .collection import Vocab
+    from .index import format as fmt
+
+    meta = fmt.IndexMetadata.load(args.index_dir)
+    print(json.dumps(meta.__dict__))
+    vocab = Vocab.load(os.path.join(args.index_dir, fmt.VOCAB))
+    shown = 0
+    for s in range(meta.num_shards):
+        if shown >= args.n:
+            break
+        z = fmt.load_shard(args.index_dir, s)
+        for i, tid in enumerate(z["term_ids"]):
+            if shown >= args.n:
+                break
+            lo, hi = z["indptr"][i], z["indptr"][i + 1]
+            posts = list(zip(z["pair_doc"][lo:hi].tolist(),
+                             z["pair_tf"][lo:hi].tolist()))
+            print(f"part-{s:05d}\t{vocab.term(int(tid))}\tdf={int(z['df'][i])}"
+                  f"\t{posts[: args.postings]}")
+            shown += 1
+    return 0
+
+
+def cmd_expand(args) -> int:
+    from .search import WildcardLookup
+
+    lookup = WildcardLookup.load(args.index_dir, args.chargram_k)
+    for term in lookup.expand(args.pattern, limit=args.n):
+        print(term)
+    return 0
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(prog="tpu-ir")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    pi = sub.add_parser("index", help="build all index artifacts for a corpus")
+    pi.add_argument("corpus", nargs="+", help="TREC files or directories")
+    pi.add_argument("index_dir")
+    pi.add_argument("--k", type=int, default=1, help="term-k-gram size")
+    pi.add_argument("--chargram-k", type=int, nargs="*", default=[2, 3])
+    pi.add_argument("--shards", type=int, default=10,
+                    help="term shards (reference used 10 reducers)")
+    pi.add_argument("--overwrite", action="store_true")
+    pi.add_argument("--no-chargrams", action="store_true")
+    _add_backend_arg(pi)
+    pi.set_defaults(fn=cmd_index)
+
+    ps = sub.add_parser("search", help="query an index (REPL or batch)")
+    ps.add_argument("index_dir")
+    ps.add_argument("--query", "-q")
+    ps.add_argument("--queries-file")
+    ps.add_argument("--k", type=int, default=10, help="results per query")
+    ps.add_argument("--scoring", choices=["tfidf", "bm25"], default="tfidf")
+    ps.add_argument("--layout", choices=["auto", "dense", "sparse"],
+                    default="auto")
+    ps.add_argument("--docnos", action="store_true",
+                    help="print docnos instead of docids")
+    ps.add_argument("--compat", action="store_true",
+                    help="reproduce reference quirks (int-division idf, "
+                         "1-2 word query cap)")
+    _add_backend_arg(ps)
+    ps.set_defaults(fn=cmd_search)
+
+    pn = sub.add_parser("inspect", help="dump index records (ReadSequenceFile)")
+    pn.add_argument("index_dir")
+    pn.add_argument("-n", type=int, default=20, help="max terms to print")
+    pn.add_argument("--postings", type=int, default=10,
+                    help="max postings per term")
+    _add_backend_arg(pn)
+    pn.set_defaults(fn=cmd_inspect)
+
+    pe = sub.add_parser("expand", help="wildcard term lookup (char-k-grams)")
+    pe.add_argument("index_dir")
+    pe.add_argument("pattern", help="glob pattern, e.g. 'te*' or '*tion'")
+    pe.add_argument("--chargram-k", type=int, default=3)
+    pe.add_argument("-n", type=int, default=50)
+    pe.set_defaults(fn=cmd_expand)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,4 @@
+from . import format
+from .builder import build_chargram_artifacts, build_index
+
+__all__ = ["format", "build_chargram_artifacts", "build_index"]

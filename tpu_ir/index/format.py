@@ -1,0 +1,118 @@
+"""On-disk index format.
+
+Layout preserved from the reference (sharded part-NNNNN files + side files,
+SURVEY.md §2.5 "keep the N-way sharded index layout as the public on-disk
+format"), with Hadoop SequenceFiles replaced by npz arrays:
+
+    index_dir/
+      metadata.json     N, k, vocab size, shard count, counters
+      docnos.txt        docid list, sorted; docno = 1-based position
+      vocab.txt         term list, sorted; term id = 0-based position
+      doclen.npy        int32 [N+1] total occurrences per docno (BM25)
+      part-00000.npz .. per term-shard CSR postings
+      dictionary.tsv    term -> (shard, offset) forward index
+      chargram-k<k>.npz char-k-gram -> sorted term-id lists
+      jobs/*.json       job reports
+
+Term shard assignment: term_id % num_shards (the reference used Hadoop's
+hash partitioner over 10 reducers, TermKGramDocIndexer.java:246; modulo over
+sorted ids keeps shards balanced and is reproducible). Each part file stores
+its global term ids plus a local CSR, exactly the information the reference's
+forward index reconstructs via (fileNo, byteOffset) pairs
+(BuildIntDocVectorsForwardIndex.java:139-153).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+FORMAT_VERSION = 1
+METADATA = "metadata.json"
+DOCNOS = "docnos.txt"
+VOCAB = "vocab.txt"
+DOCLEN = "doclen.npy"
+DICTIONARY = "dictionary.tsv"
+JOBS_DIR = "jobs"
+
+
+def part_name(shard: int) -> str:
+    # reference output shards are part-00000..part-0000N (Hadoop naming)
+    return f"part-{shard:05d}.npz"
+
+
+def chargram_name(k: int) -> str:
+    return f"chargram-k{k}.npz"
+
+
+@dataclass
+class IndexMetadata:
+    num_docs: int
+    vocab_size: int
+    k: int
+    num_shards: int
+    num_pairs: int
+    chargram_ks: list[int]
+    version: int = FORMAT_VERSION
+
+    def save(self, index_dir: str) -> None:
+        with open(os.path.join(index_dir, METADATA), "w") as f:
+            json.dump(self.__dict__, f, indent=2, sort_keys=True)
+
+    @classmethod
+    def load(cls, index_dir: str) -> "IndexMetadata":
+        with open(os.path.join(index_dir, METADATA)) as f:
+            return cls(**json.load(f))
+
+
+def save_shard(index_dir: str, shard: int, *, term_ids: np.ndarray,
+               indptr: np.ndarray, pair_doc: np.ndarray,
+               pair_tf: np.ndarray, df: np.ndarray) -> None:
+    np.savez(
+        os.path.join(index_dir, part_name(shard)),
+        term_ids=term_ids.astype(np.int32),
+        indptr=indptr.astype(np.int64),
+        pair_doc=pair_doc.astype(np.int32),
+        pair_tf=pair_tf.astype(np.int32),
+        df=df.astype(np.int32),
+    )
+
+
+def load_shard(index_dir: str, shard: int) -> dict[str, np.ndarray]:
+    with np.load(os.path.join(index_dir, part_name(shard))) as z:
+        return {k: z[k] for k in z.files}
+
+
+def save_chargram(index_dir: str, k: int, *, gram_codes: np.ndarray,
+                  indptr: np.ndarray, term_ids: np.ndarray) -> None:
+    np.savez(
+        os.path.join(index_dir, chargram_name(k)),
+        gram_codes=gram_codes.astype(np.int64),
+        indptr=indptr.astype(np.int64),
+        term_ids=term_ids.astype(np.int32),
+    )
+
+
+def load_chargram(index_dir: str, k: int) -> dict[str, np.ndarray]:
+    with np.load(os.path.join(index_dir, chargram_name(k))) as z:
+        return {k_: z[k_] for k_ in z.files}
+
+
+def write_dictionary(index_dir: str, terms: list[str],
+                     shard_of: np.ndarray, offset_of: np.ndarray) -> None:
+    """Forward-index parity artifact: sorted 'term<TAB>shard<TAB>offset'
+    lines, one per term — the same information the reference packs as
+    fileNo*1e9+byteOffset into one flat writeUTF file
+    (BuildIntDocVectorsForwardIndex.java:139-153)."""
+    tmp = os.path.join(index_dir, DICTIONARY + ".tmp")
+    with open(tmp, "w", encoding="utf-8") as f:
+        for tid, term in enumerate(terms):
+            f.write(f"{term}\t{int(shard_of[tid])}\t{int(offset_of[tid])}\n")
+    os.replace(tmp, os.path.join(index_dir, DICTIONARY))
+
+
+def artifact_exists(index_dir: str, name: str) -> bool:
+    return os.path.exists(os.path.join(index_dir, name))

@@ -44,17 +44,31 @@ def idf_weights(df: jax.Array, num_docs: int, compat_int_idf: bool = False) -> j
     return jnp.where(df > 0, w, 0.0)
 
 
+def _dense_scatter(pair_term, pair_doc, values, *, vocab_size: int,
+                   num_docs: int) -> jax.Array:
+    flat = jnp.zeros((vocab_size * (num_docs + 1),), jnp.float32)
+    idx = pair_term * (num_docs + 1) + pair_doc
+    idx = jnp.where((pair_term >= 0) & (pair_term < vocab_size), idx,
+                    vocab_size * (num_docs + 1))
+    flat = flat.at[idx].add(values, mode="drop")
+    return flat.reshape(vocab_size, num_docs + 1)
+
+
 def dense_doc_matrix(postings_pair_term, postings_pair_doc, postings_pair_tf,
                      *, vocab_size: int, num_docs: int) -> jax.Array:
     """[V, D+1] matrix of (1+ln tf); column 0 (docno 0) is dead padding."""
     tf = postings_pair_tf.astype(jnp.float32)
     w = jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
-    flat = jnp.zeros((vocab_size * (num_docs + 1),), jnp.float32)
-    idx = postings_pair_term * (num_docs + 1) + postings_pair_doc
-    idx = jnp.where(postings_pair_term < vocab_size, idx,
-                    vocab_size * (num_docs + 1))
-    flat = flat.at[idx].add(w, mode="drop")
-    return flat.reshape(vocab_size, num_docs + 1)
+    return _dense_scatter(postings_pair_term, postings_pair_doc, w,
+                          vocab_size=vocab_size, num_docs=num_docs)
+
+
+def dense_tf_matrix(postings_pair_term, postings_pair_doc, postings_pair_tf,
+                    *, vocab_size: int, num_docs: int) -> jax.Array:
+    """[V, D+1] matrix of raw tf (float32), for BM25 saturation."""
+    return _dense_scatter(postings_pair_term, postings_pair_doc,
+                          postings_pair_tf.astype(jnp.float32),
+                          vocab_size=vocab_size, num_docs=num_docs)
 
 
 @partial(jax.jit, static_argnames=("k", "compat_int_idf"))
@@ -85,7 +99,7 @@ def tfidf_topk_dense(
     rows = rows * jnp.where(q_valid, 1.0, 0.0)[..., None]
     scores = jnp.einsum("bld,bl->bd", rows, q_idf)         # [B, D+1]
     scores = scores.at[:, 0].set(-jnp.inf)                 # dead column
-    top_scores, top_idx = jax.lax.top_k(scores, k)
+    top_scores, top_idx = jax.lax.top_k(scores, min(k, scores.shape[-1]))
     matched = top_scores > 0.0
     return (jnp.where(matched, top_scores, 0.0),
             jnp.where(matched, top_idx, 0).astype(jnp.int32))
@@ -119,7 +133,7 @@ def bm25_topk_dense(
     sat = tf * (k1 + 1.0) / (tf + k1 * dl_norm[None, None, :])
     scores = jnp.einsum("bld,bl->bd", sat, q_idf)
     scores = scores.at[:, 0].set(-jnp.inf)
-    top_scores, top_idx = jax.lax.top_k(scores, k)
+    top_scores, top_idx = jax.lax.top_k(scores, min(k, scores.shape[-1]))
     matched = top_scores > 0.0
     return (jnp.where(matched, top_scores, 0.0),
             jnp.where(matched, top_idx, 0).astype(jnp.int32))
@@ -161,7 +175,7 @@ def tfidf_topk_sparse(
 
     scores = jax.vmap(score_one)(slot, w)                   # [B, D+1]
     scores = scores.at[:, 0].set(-jnp.inf)
-    top_scores, top_idx = jax.lax.top_k(scores, k)
+    top_scores, top_idx = jax.lax.top_k(scores, min(k, scores.shape[-1]))
     matched = top_scores > 0.0
     return (jnp.where(matched, top_scores, 0.0),
             jnp.where(matched, top_idx, 0).astype(jnp.int32))

@@ -1,0 +1,4 @@
+from .scorer import Scorer, SearchResult
+from .wildcard import WildcardLookup
+
+__all__ = ["Scorer", "SearchResult", "WildcardLookup"]

@@ -1,0 +1,73 @@
+"""Wildcard term lookup over the char-k-gram index.
+
+The reference builds the char-k-gram -> term index "for wildcard/fuzzy term
+lookup" (SURVEY.md §0; CharKGramTermIndexer.java) but ships no query-side
+consumer for it — lookup was done by inspecting the index manually. We close
+that gap: a `te*d`-style pattern is decomposed into its $-padded k-grams,
+the per-gram sorted term-id lists are intersected, and a final literal scan
+filters false positives (the classic k-gram postfilter).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import os
+from functools import reduce
+
+import numpy as np
+
+from ..collection import Vocab
+from ..index import format as fmt
+from ..index.builder import TOKENS_VOCAB
+from ..ops import gram_to_code
+
+
+class WildcardLookup:
+    def __init__(self, vocab: Vocab, k: int, gram_codes: np.ndarray,
+                 indptr: np.ndarray, term_ids: np.ndarray):
+        self.vocab = vocab
+        self.k = k
+        self._codes = gram_codes
+        self._indptr = indptr
+        self._term_ids = term_ids
+
+    @classmethod
+    def load(cls, index_dir: str, k: int) -> "WildcardLookup":
+        z = fmt.load_chargram(index_dir, k)
+        tok_vocab_path = os.path.join(index_dir, TOKENS_VOCAB)
+        vocab = Vocab.load(
+            tok_vocab_path if os.path.exists(tok_vocab_path)
+            else os.path.join(index_dir, fmt.VOCAB))
+        return cls(vocab, k, z["gram_codes"], z["indptr"], z["term_ids"])
+
+    def _terms_for_gram(self, gram: str) -> np.ndarray:
+        code = gram_to_code(gram, self.k)
+        i = np.searchsorted(self._codes, code)
+        if i >= len(self._codes) or self._codes[i] != code:
+            return np.zeros(0, np.int32)
+        return self._term_ids[self._indptr[i] : self._indptr[i + 1]]
+
+    def pattern_grams(self, pattern: str) -> list[str]:
+        """k-grams implied by a wildcard pattern: pad with $ at fixed ends,
+        take grams of every maximal wildcard-free run."""
+        padded = "$" + pattern + "$"
+        runs = [r for r in padded.replace("?", "*").split("*") if r]
+        grams = []
+        for run in runs:
+            grams.extend(
+                run[i : i + self.k] for i in range(len(run) - self.k + 1))
+        return grams
+
+    def expand(self, pattern: str, limit: int | None = None) -> list[str]:
+        """Vocabulary terms matching a glob pattern (e.g. 'te*', '*tion')."""
+        grams = self.pattern_grams(pattern)
+        if grams:
+            lists = [self._terms_for_gram(g) for g in grams]
+            if any(len(l) == 0 for l in lists):
+                return []
+            cand_ids = reduce(np.intersect1d, lists)
+            cands = (self.vocab.term(int(t)) for t in cand_ids)
+        else:
+            cands = iter(self.vocab.terms)  # pattern like '*': scan all
+        out = [t for t in cands if fnmatch.fnmatchcase(t, pattern)]
+        return out[:limit] if limit is not None else out

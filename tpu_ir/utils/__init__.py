@@ -1,0 +1,3 @@
+from .report import JobReport
+
+__all__ = ["JobReport"]

@@ -1,0 +1,60 @@
+"""Machine-readable job reports.
+
+Replaces the reference's Hadoop counter system + JobTracker pages (SURVEY.md
+§5 metrics): each pipeline stage writes one JSON report with the same counter
+names the reference exposes (Count.DOCS, Dictionary.Size, map output records,
+reduce output groups) plus wall-clock timings per phase.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+
+@dataclass
+class JobReport:
+    job: str
+    counters: dict[str, int] = field(default_factory=dict)
+    timings_s: dict[str, float] = field(default_factory=dict)
+    config: dict = field(default_factory=dict)
+    _t0: float = field(default_factory=time.perf_counter, repr=False)
+
+    def incr(self, name: str, amount: int = 1) -> None:
+        self.counters[name] = self.counters.get(name, 0) + amount
+
+    def set_counter(self, name: str, value: int) -> None:
+        self.counters[name] = int(value)
+
+    class _Phase:
+        def __init__(self, report: "JobReport", name: str):
+            self._r, self._name = report, name
+
+        def __enter__(self):
+            self._t = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            self._r.timings_s[self._name] = self._r.timings_s.get(
+                self._name, 0.0) + time.perf_counter() - self._t
+            return False
+
+    def phase(self, name: str) -> "JobReport._Phase":
+        return JobReport._Phase(self, name)
+
+    def save(self, jobs_dir: str | os.PathLike) -> str:
+        os.makedirs(jobs_dir, exist_ok=True)
+        out = {
+            "job": self.job,
+            "wall_s": round(time.perf_counter() - self._t0, 3),
+            "counters": self.counters,
+            "timings_s": {k: round(v, 3) for k, v in self.timings_s.items()},
+            "config": self.config,
+            "finished_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        }
+        path = os.path.join(os.fspath(jobs_dir), f"{self.job}.json")
+        with open(path, "w") as f:
+            json.dump(out, f, indent=2, sort_keys=True)
+        return path
