@@ -1,0 +1,12 @@
+from .mesh import SHARD_AXIS, make_mesh
+from .sharded_build import ShardedPostings, sharded_build_postings
+from .sharded_scoring import make_doc_blocks, sharded_tfidf_topk
+
+__all__ = [
+    "SHARD_AXIS",
+    "make_mesh",
+    "ShardedPostings",
+    "sharded_build_postings",
+    "make_doc_blocks",
+    "sharded_tfidf_topk",
+]

@@ -1,0 +1,32 @@
+"""Mesh construction and backend selection.
+
+The reference's "cluster vs local mode" switch (mapred.job.tracker == "local",
+TermKGramDocIndexer.java:101-108) becomes backend selection: the same SPMD
+program runs on a TPU slice, a single chip, or N virtual CPU devices
+(XLA_FLAGS=--xla_force_host_platform_device_count=N) — SURVEY.md §2.5.
+
+One mesh axis, "shards": for the index build each device plays both mapper
+(its doc shard) and reducer (its term shard), exchanging postings over
+all_to_all — the direct analog of Hadoop's N map tasks feeding N reduce
+partitions through the shuffle, except the "shuffle" is one XLA collective
+over ICI.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+SHARD_AXIS = "shards"
+
+
+def make_mesh(num_shards: int | None = None, backend: str | None = None) -> Mesh:
+    devices = jax.devices(backend) if backend else jax.devices()
+    if num_shards is None:
+        num_shards = len(devices)
+    if num_shards > len(devices):
+        raise ValueError(
+            f"need {num_shards} devices, have {len(devices)} "
+            "(for CPU testing set XLA_FLAGS=--xla_force_host_platform_device_count=N)")
+    return Mesh(np.array(devices[:num_shards]), (SHARD_AXIS,))

@@ -1,0 +1,199 @@
+"""SPMD inverted-index build: doc-sharded map, all_to_all shuffle, term-sharded
+reduce — one jit-compiled program over a device mesh.
+
+Reference mapping (SURVEY.md §2.5 table):
+  Hadoop input splits -> mapper tasks   = doc-shard axis of the mesh
+  hash partitioner over 10 reducers     = dest = term_id % num_shards
+  sort/shuffle (HTTP)                   = jax.lax.all_to_all over ICI
+  combiner (map-side pre-aggregation)   = per-device pre-group before routing
+  MR counters / corpus size N           = jax.lax.psum
+  part-NNNNN reducer outputs            = per-device term-shard postings
+
+Static shapes: each device sends exactly `bucket_cap` (term, doc) slots to
+each destination (MoE-style capacity). Overflowed pairs are counted (psum'd)
+and surfaced so the host can retry with a bigger capacity — the moral
+equivalent of a failed-task retry, but deterministic (SURVEY.md §5 failure
+handling).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..ops.postings import PAD_TERM, build_postings
+from .mesh import SHARD_AXIS, make_mesh
+
+
+class ShardedPostings(NamedTuple):
+    """Per-term-shard postings, one leaf row per mesh shard.
+
+    All arrays carry a leading [num_shards] axis (sharded over the mesh):
+    pair_term/pair_doc/pair_tf int32 [S, C]; df int32 [S, V] (only the
+    shard's own terms nonzero); num_pairs int32 [S]; dropped int32 [S]
+    (overflow counts, all equal after psum); num_docs int32 [S] (global N,
+    all equal after psum).
+    """
+
+    pair_term: jax.Array
+    pair_doc: jax.Array
+    pair_tf: jax.Array
+    df: jax.Array
+    num_pairs: jax.Array
+    dropped: jax.Array
+    num_docs: jax.Array
+
+
+def _route_and_build(term_ids, doc_ids, local_num_docs, *, num_shards: int,
+                     vocab_size: int, bucket_cap: int, total_docs: int):
+    """Per-device body under shard_map. term_ids/doc_ids: int32 [1, C]."""
+    term_ids = term_ids.reshape(-1)
+    doc_ids = doc_ids.reshape(-1)
+    local_num_docs = local_num_docs.reshape(())
+    c = term_ids.shape[0]
+    valid = term_ids != PAD_TERM
+    dest = jnp.where(valid, term_ids % num_shards, num_shards)
+
+    # combiner: pre-group local (term, doc) pairs so each unique pair crosses
+    # the interconnect once with an aggregated tf (reference combiner=reducer,
+    # TermKGramDocIndexer.java:273)
+    local = build_postings(term_ids, doc_ids, vocab_size=vocab_size,
+                           num_docs=total_docs)
+    g_term = local.pair_term
+    g_doc = local.pair_doc
+    g_tf = local.pair_tf
+    g_valid = g_term != PAD_TERM
+    g_dest = jnp.where(g_valid, g_term % num_shards, num_shards)
+
+    # rank of each pair within its destination bucket
+    order = jnp.argsort(g_dest, stable=True)
+    d_sorted = g_dest[order]
+    ranks_sorted = jnp.arange(c, dtype=jnp.int32) - jnp.searchsorted(
+        d_sorted, d_sorted, side="left").astype(jnp.int32)
+    rank = jnp.zeros((c,), jnp.int32).at[order].set(ranks_sorted)
+
+    in_cap = g_valid & (rank < bucket_cap)
+    dropped = jnp.sum(g_valid & ~in_cap).astype(jnp.int32)
+
+    slot = jnp.where(in_cap, g_dest * bucket_cap + rank, num_shards * bucket_cap)
+    send_term = jnp.full((num_shards * bucket_cap,), PAD_TERM, jnp.int32
+                         ).at[slot].set(g_term, mode="drop")
+    send_doc = jnp.zeros((num_shards * bucket_cap,), jnp.int32
+                         ).at[slot].set(g_doc, mode="drop")
+    send_tf = jnp.ones((num_shards * bucket_cap,), jnp.int32
+                       ).at[slot].set(g_tf, mode="drop")
+
+    # the shuffle: bucket b of device s -> device b
+    recv_term = jax.lax.all_to_all(
+        send_term.reshape(num_shards, bucket_cap), SHARD_AXIS, 0, 0, tiled=False)
+    recv_doc = jax.lax.all_to_all(
+        send_doc.reshape(num_shards, bucket_cap), SHARD_AXIS, 0, 0, tiled=False)
+    recv_tf = jax.lax.all_to_all(
+        send_tf.reshape(num_shards, bucket_cap), SHARD_AXIS, 0, 0, tiled=False)
+    recv_term = recv_term.reshape(num_shards * bucket_cap)
+    recv_doc = recv_doc.reshape(num_shards * bucket_cap)
+    recv_tf = recv_tf.reshape(num_shards * bucket_cap)
+
+    # term-shard reduce: merge partial tf postings from every doc shard.
+    # build_postings sums tf per (term, doc); feeding weighted pairs needs a
+    # tf-weighted variant: replicate via segment-sum on (term,doc) keys.
+    reduced = _reduce_weighted(recv_term, recv_doc, recv_tf,
+                               vocab_size=vocab_size, total_docs=total_docs)
+    r_term, r_doc, r_tf, df, num_pairs = reduced
+
+    # global counters over the mesh (reference MR counters / sentinel term)
+    n_total = jax.lax.psum(local_num_docs, SHARD_AXIS)
+    dropped_total = jax.lax.psum(dropped, SHARD_AXIS)
+
+    return (r_term[None], r_doc[None], r_tf[None], df[None],
+            num_pairs[None], dropped_total[None], n_total[None])
+
+
+def _reduce_weighted(term, doc, tf, *, vocab_size: int, total_docs: int):
+    """Group (term, doc, tf) triples summing tf; postings ordered
+    (term asc, tf desc, doc asc); df per term. Same machinery as
+    ops.postings.build_postings but tf-weighted."""
+    c = term.shape[0]
+    valid = term != PAD_TERM
+    doc = jnp.where(valid, doc, 0)
+    tf = jnp.where(valid, tf, 0)
+
+    order = jnp.lexsort((doc, term))
+    t_s, d_s, w_s = term[order], doc[order], tf[order]
+    v_s = valid[order]
+
+    prev_t = jnp.concatenate([jnp.full((1,), -1, jnp.int32), t_s[:-1]])
+    prev_d = jnp.concatenate([jnp.full((1,), -1, jnp.int32), d_s[:-1]])
+    new = ((t_s != prev_t) | (d_s != prev_d)) & v_s
+    idx = jnp.cumsum(new.astype(jnp.int32)) - 1
+    num_pairs = idx[-1] + 1
+
+    scatter = jnp.where(v_s, idx, c)
+    p_term = jnp.full((c,), PAD_TERM, jnp.int32).at[
+        jnp.where(new, idx, c)].set(t_s, mode="drop")
+    p_doc = jnp.zeros((c,), jnp.int32).at[
+        jnp.where(new, idx, c)].set(d_s, mode="drop")
+    p_tf = jnp.zeros((c,), jnp.int32).at[scatter].add(w_s, mode="drop")
+
+    df = jnp.zeros((vocab_size,), jnp.int32).at[
+        jnp.where(new, t_s, vocab_size)].add(
+        jnp.ones((c,), jnp.int32), mode="drop")
+
+    order2 = jnp.lexsort((p_doc, -p_tf, p_term))
+    return (p_term[order2], p_doc[order2], p_tf[order2], df,
+            jnp.asarray(num_pairs, jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("num_shards", "vocab_size", "bucket_cap",
+                                   "total_docs", "mesh"))
+def _sharded_build_jit(term_ids, doc_ids, local_num_docs, *, mesh,
+                       num_shards, vocab_size, bucket_cap, total_docs):
+    fn = jax.shard_map(
+        partial(_route_and_build, num_shards=num_shards,
+                vocab_size=vocab_size, bucket_cap=bucket_cap,
+                total_docs=total_docs),
+        mesh=mesh,
+        in_specs=(P(SHARD_AXIS, None), P(SHARD_AXIS, None), P(SHARD_AXIS)),
+        out_specs=(P(SHARD_AXIS, None),) * 3 + (P(SHARD_AXIS, None),)
+        + (P(SHARD_AXIS),) * 3,
+    )
+    return fn(term_ids, doc_ids, local_num_docs)
+
+
+def sharded_build_postings(
+    term_ids: np.ndarray,     # int32 [S, C] per-doc-shard occurrences (padded)
+    doc_ids: np.ndarray,      # int32 [S, C]
+    docs_per_shard: np.ndarray,  # int32 [S]
+    *,
+    vocab_size: int,
+    total_docs: int,
+    mesh=None,
+    bucket_cap: int | None = None,
+    max_retries: int = 3,
+) -> ShardedPostings:
+    """Run the SPMD build, growing bucket capacity on overflow."""
+    s, c = term_ids.shape
+    if mesh is None:
+        mesh = make_mesh(s)
+    if bucket_cap is None:
+        # expected pairs per (device, dest) with 2x headroom, 128-aligned
+        bucket_cap = max(128, int(2 * c / s) + 127 & ~127)
+    for attempt in range(max_retries + 1):
+        out = _sharded_build_jit(
+            jnp.asarray(term_ids), jnp.asarray(doc_ids),
+            jnp.asarray(docs_per_shard),
+            mesh=mesh, num_shards=s, vocab_size=vocab_size,
+            bucket_cap=bucket_cap, total_docs=total_docs)
+        result = ShardedPostings(*out)
+        if int(np.asarray(result.dropped)[0]) == 0:
+            return result
+        bucket_cap = min(bucket_cap * 2, c)
+        if attempt == max_retries:
+            raise RuntimeError(
+                f"postings routing overflow persists at bucket_cap={bucket_cap}")
+    raise AssertionError("unreachable")

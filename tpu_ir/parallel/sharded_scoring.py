@@ -1,0 +1,115 @@
+"""SPMD batched scoring: docs sharded over the mesh, queries replicated.
+
+Each device scores its doc block (dense [V, D_shard] layout -> gathers +
+fused adds on the VPU/MXU), takes a local top-k, then the per-shard
+candidates are all_gather'd and reduced to a global top-k — all inside one
+jit. This is the standard distributed-top-k pattern: k*S candidates cross
+the interconnect instead of D scores.
+
+The reference has no distributed serving at all (its query path is a single
+JVM doing disk seeks, SURVEY.md §3.3); this is the piece that makes 10k-query
+batches over pod-scale corpora feasible.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from .mesh import SHARD_AXIS, make_mesh
+
+
+def _score_local(q_terms, q_idf, doc_matrix, doc_base, *, k: int):
+    """Body under shard_map. q_terms/q_idf [B, L] replicated;
+    doc_matrix [1, V, Dblk] this shard's block; doc_base [1] global docno of
+    the block's first column."""
+    doc_matrix = doc_matrix.reshape(doc_matrix.shape[-2:])
+    doc_base = doc_base.reshape(())
+
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)
+    rows = doc_matrix[safe_q]                          # [B, L, Dblk]
+    scores = jnp.einsum("bld,bl->bd", rows, q_idf)     # [B, Dblk]
+
+    kk = min(k, scores.shape[-1])
+    loc_scores, loc_idx = jax.lax.top_k(scores, kk)
+    if kk < k:  # pad so every shard contributes exactly k candidates
+        pad = k - kk
+        loc_scores = jnp.pad(loc_scores, ((0, 0), (0, pad)),
+                             constant_values=-jnp.inf)
+        loc_idx = jnp.pad(loc_idx, ((0, 0), (0, pad)))
+    loc_docno = loc_idx.astype(jnp.int32) + doc_base
+
+    # gather candidates from every shard and merge
+    all_scores = jax.lax.all_gather(loc_scores, SHARD_AXIS)   # [S, B, k]
+    all_docnos = jax.lax.all_gather(loc_docno, SHARD_AXIS)
+    s, b, _ = all_scores.shape
+    flat_scores = jnp.transpose(all_scores, (1, 0, 2)).reshape(b, s * k)
+    flat_docnos = jnp.transpose(all_docnos, (1, 0, 2)).reshape(b, s * k)
+    top_scores, top_pos = jax.lax.top_k(flat_scores, k)
+    top_docnos = jnp.take_along_axis(flat_docnos, top_pos, axis=1)
+    matched = top_scores > 0.0
+    return (jnp.where(matched, top_scores, 0.0),
+            jnp.where(matched, top_docnos, 0))
+
+
+@partial(jax.jit, static_argnames=("k", "mesh", "compat_int_idf"))
+def sharded_tfidf_topk(
+    q_terms: jax.Array,      # int32 [B, L]
+    doc_blocks: jax.Array,   # f32 [S, V, Dblk] (1+ln tf), doc-sharded
+    doc_bases: jax.Array,    # int32 [S] first global docno per block
+    df: jax.Array,           # int32 [V] global df (replicated)
+    num_docs,                # int32 scalar
+    *,
+    mesh,
+    k: int = 10,
+    compat_int_idf: bool = False,
+):
+    """Returns (scores [B,k], docnos [B,k]); docno 0 = empty slot."""
+    if compat_int_idf:
+        n = jnp.asarray(num_docs, jnp.int32)
+        ratio = (n // jnp.maximum(df, 1)).astype(jnp.float32)
+    else:
+        ratio = jnp.asarray(num_docs, jnp.float32) / jnp.maximum(
+            df.astype(jnp.float32), 1.0)
+    idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
+    vocab_size = doc_blocks.shape[1]
+    q_valid = (q_terms >= 0) & (q_terms < vocab_size)
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)
+    q_idf = jnp.where(q_valid, idf[safe_q], 0.0)
+
+    fn = jax.shard_map(
+        partial(_score_local, k=k),
+        mesh=mesh,
+        in_specs=(P(None, None), P(None, None),
+                  P(SHARD_AXIS, None, None), P(SHARD_AXIS)),
+        out_specs=(P(None, None), P(None, None)),
+        # outputs are replicated by construction (identical all_gather+merge
+        # on every device); the static checker cannot infer that
+        check_vma=False,
+    )
+    scores, docnos = fn(q_terms, q_idf, doc_blocks, doc_bases)
+    return scores, docnos
+
+
+def make_doc_blocks(
+    pair_term: np.ndarray, pair_doc: np.ndarray, pair_tf: np.ndarray,
+    *, vocab_size: int, num_docs: int, num_shards: int,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side: global CSR postings -> doc-sharded dense (1+ln tf) blocks.
+
+    Docnos 1..N are split into num_shards contiguous blocks of equal padded
+    width. Returns (blocks [S, V, Dblk] f32, doc_bases [S] int32)."""
+    dblk = -(-num_docs // num_shards)
+    blocks = np.zeros((num_shards, vocab_size, dblk), np.float32)
+    w = np.where(pair_tf > 0, 1.0 + np.log(np.maximum(pair_tf, 1)), 0.0)
+    shard = (pair_doc - 1) // dblk
+    col = (pair_doc - 1) % dblk
+    ok = (pair_doc >= 1) & (pair_doc <= num_docs) & (pair_term >= 0) \
+        & (pair_term < vocab_size)
+    np.add.at(blocks, (shard[ok], pair_term[ok], col[ok]), w[ok])
+    doc_bases = (np.arange(num_shards, dtype=np.int32) * dblk + 1).astype(np.int32)
+    return blocks, doc_bases
