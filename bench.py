@@ -1,0 +1,119 @@
+"""Headline benchmark: index a reference-scale synthetic TREC corpus and
+answer a 10k batched query load.
+
+Reference baseline (BASELINE.md): the PA1 inverted-index build processed
+8,761 TREC docs (23.9 MB) in 51 s on the course Hadoop cluster -> ~172 docs/s.
+Query latency was never measured there (interactive REPL only), so docs/sec
+indexed is the headline metric and batched queries/sec is reported alongside.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...extras}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+BASELINE_DOCS_PER_SEC = 8761 / 51.0  # reference PA1 job _0010
+
+# word-shape pool: mixed lengths, zipf-ish usage like English text
+VOCAB_SIZE = 30_000
+DOC_COUNT = 8_761
+TARGET_BYTES = 23_950_858
+
+
+def make_corpus(path: str, seed: int = 0) -> int:
+    rng = np.random.default_rng(seed)
+    letters = np.array(list("abcdefghijklmnopqrstuvwxyz"))
+    lengths = rng.integers(3, 11, VOCAB_SIZE)
+    words = np.array(["".join(rng.choice(letters, l)) for l in lengths])
+    zipf_p = 1.0 / np.arange(1, VOCAB_SIZE + 1)
+    zipf_p /= zipf_p.sum()
+
+    avg_doc_words = TARGET_BYTES // DOC_COUNT // 8  # ~8 bytes/word incl space
+    total = 0
+    with open(path, "w") as f:
+        for i in range(DOC_COUNT):
+            n_words = int(rng.integers(avg_doc_words // 2, avg_doc_words * 3 // 2))
+            body = " ".join(rng.choice(words, n_words, p=zipf_p))
+            rec = (f"<DOC>\n<DOCNO> SYN-{i:06d} </DOCNO>\n<TEXT>\n{body}\n"
+                   f"</TEXT>\n</DOC>\n")
+            f.write(rec)
+            total += len(rec)
+    return total
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--cpu", action="store_true",
+                    help="force CPU backend (local-mode equivalent)")
+    ap.add_argument("--queries", type=int, default=10_000)
+    args = ap.parse_args()
+
+    if args.cpu:
+        import jax
+        import jax._src.xla_bridge as xb
+
+        jax.config.update("jax_platforms", "cpu")
+        for name in list(xb._backend_factories):
+            if name != "cpu":
+                xb._backend_factories.pop(name, None)
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.devices()[0].platform
+
+    from tpu_ir.index import build_index
+    from tpu_ir.search import Scorer
+
+    with tempfile.TemporaryDirectory() as tmp:
+        corpus = os.path.join(tmp, "corpus.trec")
+        nbytes = make_corpus(corpus)
+        index_dir = os.path.join(tmp, "index")
+
+        # warm-up build on a slice to compile the device programs, then the
+        # timed full build (compile caches persist; artifact writes included)
+        t0 = time.perf_counter()
+        build_index([corpus], index_dir, k=1, chargram_ks=[2, 3],
+                    num_shards=10)
+        build_s = time.perf_counter() - t0
+        docs_per_sec = DOC_COUNT / build_s
+
+        scorer = Scorer.load(index_dir, layout="dense")
+        rng = np.random.default_rng(1)
+        v = scorer.meta.vocab_size
+        q_ids = rng.integers(0, v, size=(args.queries, 2)).astype(np.int32)
+
+        # compile once at the measured shape, then measure (topk returns
+        # host arrays, so completion is synchronous)
+        scorer.topk(q_ids, k=10)
+        t0 = time.perf_counter()
+        scorer.topk(q_ids, k=10)
+        query_s = time.perf_counter() - t0
+        queries_per_sec = args.queries / query_s
+
+    out = {
+        "metric": "docs_per_sec_indexed",
+        "value": round(docs_per_sec, 1),
+        "unit": "docs/s",
+        "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC, 2),
+        "index_wall_s": round(build_s, 2),
+        "corpus_bytes": nbytes,
+        "corpus_docs": DOC_COUNT,
+        "queries_per_sec": round(queries_per_sec, 1),
+        "query_batch": args.queries,
+        "backend": backend,
+    }
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
