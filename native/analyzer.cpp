@@ -1,0 +1,543 @@
+// tpu-ir native analysis pipeline: tag tokenizer -> stopword filter -> Porter2.
+//
+// Exact behavioral mirror of tpu_ir/analysis (tag_tokenizer.py, porter2.py,
+// stopwords.py) for ASCII documents; the Python side routes any document
+// containing a byte >= 0x80 to the pure-Python analyzer instead, so this file
+// never needs Unicode case folding. Parity is enforced by fuzz tests
+// (tests/test_native.py) comparing this against the Python implementation.
+//
+// Role in the framework: the reference engine's hot loops #2/#3 (per-char
+// TagTokenizer scan and Snowball stemming, SURVEY.md §3.1) live host-side;
+// this is their native equivalent so host tokenization keeps pace with the
+// TPU device ops.
+//
+// C API (ctypes):
+//   ir_set_stopwords(blob, len)      '\n'-separated stopword list
+//   ir_analyze(text, len, out, cap)  tokens '\n'-joined; returns bytes
+//                                    written, or -(needed) if cap too small
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- tokenizer
+
+bool split_table[256];
+bool split_table_init = false;
+
+void init_splits() {
+  if (split_table_init) return;
+  memset(split_table, 0, sizeof(split_table));
+  const char *extras = ";\"&/:!#?$%()@^*+-,=><[]{}|`~_";
+  for (const char *p = extras; *p; ++p) split_table[(uint8_t)*p] = true;
+  for (int c = 0; c <= 32; ++c) split_table[c] = true;
+  split_table_init = true;
+}
+
+inline bool is_lower(char c) { return c >= 'a' && c <= 'z'; }
+inline bool is_upper(char c) { return c >= 'A' && c <= 'Z'; }
+inline bool is_digit(char c) { return c >= '0' && c <= '9'; }
+
+// token status per reference checkTokenStatus semantics
+enum Status { CLEAN = 0, SIMPLE = 1, COMPLEX = 2, ACRONYM = 3 };
+
+Status classify(const std::string &tok) {
+  Status st = CLEAN;
+  for (char c : tok) {
+    if (is_lower(c) || is_digit(c)) continue;
+    if (c == '.') return ACRONYM;
+    if ((is_upper(c) || c == '\'') && st == CLEAN) st = SIMPLE;
+    else if (!(is_upper(c) || c == '\'')) st = COMPLEX;
+  }
+  return st;
+}
+
+std::string simple_fix(const std::string &tok) {
+  std::string out;
+  out.reserve(tok.size());
+  for (char c : tok) {
+    if (is_upper(c)) out.push_back(c + 32);
+    else if (c == '\'') continue;
+    else out.push_back(c);
+  }
+  return out;
+}
+// complex fix == simple fix for ASCII (no further lowercasing possible)
+
+struct Tokenizer {
+  const char *text;
+  int32_t n;
+  std::vector<std::string> tokens;
+  std::string ignore_until;  // empty = not ignoring
+
+  void add(const std::string &tok) {
+    if (tok.empty()) return;
+    if (tok.size() >= 100) return;  // ASCII: chars == bytes
+    tokens.push_back(tok);
+  }
+
+  void acronym(std::string tok) {
+    tok = simple_fix(tok);
+    size_t b = tok.find_first_not_of('.');
+    size_t e = tok.find_last_not_of('.');
+    tok = (b == std::string::npos) ? "" : tok.substr(b, e - b + 1);
+    if (tok.find('.') != std::string::npos) {
+      bool is_acr = !tok.empty();
+      for (size_t i = 1; i < tok.size(); i += 2)
+        if (tok[i] != '.') { is_acr = false; break; }
+      if (is_acr) {
+        std::string collapsed;
+        for (char c : tok) if (c != '.') collapsed.push_back(c);
+        add(collapsed);
+      } else {
+        size_t s = 0;
+        for (size_t i = 0; i <= tok.size(); ++i) {
+          if (i == tok.size() || tok[i] == '.') {
+            if (i - s > 1) add(tok.substr(s, i - s));
+            s = i + 1;
+          }
+        }
+      }
+    } else {
+      add(tok);
+    }
+  }
+
+  void on_token(int32_t start, int32_t end) {
+    if (end <= start) return;
+    std::string tok(text + start, text + end);
+    switch (classify(tok)) {
+      case CLEAN: add(tok); break;
+      case SIMPLE:
+      case COMPLEX: add(simple_fix(tok)); break;
+      case ACRONYM: acronym(tok); break;
+    }
+  }
+
+  // returns index of ';' ending a valid entity after '&' at pos, else -1
+  int32_t entity_end(int32_t pos) {
+    for (int32_t i = pos + 1; i < n; ++i) {
+      char c = text[i];
+      if (is_lower(c) || is_digit(c) || c == '#') continue;
+      if (c == ';') return i;
+      break;
+    }
+    return -1;
+  }
+
+  int32_t tag_name_end(int32_t start) {
+    int32_t i = start;
+    while (i < n && text[i] != ' ' && text[i] != '>') ++i;
+    return i;
+  }
+
+  int32_t skip_comment(int32_t pos) {
+    if (pos + 3 < n && memcmp(text + pos, "<!--", 4) == 0) {
+      const char *f = (const char *)memmem(text + pos + 1, n - pos - 1, "-->", 3);
+      return f ? (int32_t)(f - text) + 2 : n;
+    }
+    const char *f = (const char *)memchr(text + pos + 1, '>', n - pos - 1);
+    return f ? (int32_t)(f - text) : n;
+  }
+
+  int32_t parse_end_tag(int32_t pos) {
+    int32_t i = tag_name_end(pos + 2);
+    std::string name(text + pos + 2, text + i);
+    for (auto &ch : name) if (is_upper(ch)) ch += 32;
+    if (!ignore_until.empty() && ignore_until == name) ignore_until.clear();
+    while (i < n && text[i] != '>') ++i;
+    return i;
+  }
+
+  // end index of one attribute (first unquoted space or '>'), or -1
+  int32_t attr_end(int32_t start, int32_t tag_end) {
+    bool in_quote = false, escaped = false;
+    for (int32_t i = start; i <= tag_end; ++i) {
+      char c = text[i];
+      if ((c == '"' || c == '\'') && !escaped) {
+        in_quote = !in_quote;
+        if (!in_quote) return i;
+      } else if (!in_quote && (c == ' ' || c == '>')) {
+        return i;
+      } else if (c == '\\' && !escaped) {
+        escaped = true;
+        continue;
+      }
+      escaped = false;
+    }
+    return -1;
+  }
+
+  int32_t parse_begin_tag(int32_t pos) {
+    int32_t i = tag_name_end(pos + 1);
+    std::string name(text + pos + 1, text + i);
+    for (auto &ch : name) if (is_upper(ch)) ch += 32;
+
+    bool close_it = false;
+    while (i < n && text[i] == ' ') ++i;
+    if (i >= n) {
+      i = n;
+    } else if (text[i] == '>') {
+      // position lands on '>'
+    } else {
+      const char *f = (const char *)memchr(text + i + 1, '>', n - i - 1);
+      int32_t tag_end = f ? (int32_t)(f - text) : -1;
+      if (tag_end >= 0) {
+        while (i < tag_end) {
+          int32_t start = i;
+          while (start < tag_end && text[start] == ' ') ++start;
+          if (text[start] == '>') { i = start; break; }
+          if (text[start] == '/' && start + 1 < n && text[start + 1] == '>') {
+            i = start + 1;
+            close_it = true;
+            break;
+          }
+          int32_t end = attr_end(start, tag_end);
+          if (end < 0) { i = tag_end; break; }
+          i = end;
+          if (i < n && (text[i] == '"' || text[i] == '\'')) ++i;
+        }
+      }
+      // malformed (no '>'): resume right after the name, i unchanged
+    }
+    if ((name == "style" || name == "script") && !close_it) ignore_until = name;
+    return i;
+  }
+
+  int32_t on_start_bracket(int32_t pos) {
+    if (pos + 1 >= n) return n;
+    char c = text[pos + 1];
+    if (c == '/') return parse_end_tag(pos);
+    if (c == '!') return skip_comment(pos);
+    if (c == '?') {
+      const char *f = (const char *)memmem(text + pos + 1, n - pos - 1, "?>", 2);
+      return f ? (int32_t)(f - text) : n;
+    }
+    return parse_begin_tag(pos);
+  }
+
+  void run() {
+    init_splits();
+    int32_t pos = 0, last_split = -1;
+    while (pos >= 0 && pos < n) {
+      char c = text[pos];
+      if (c == '<') {
+        if (ignore_until.empty()) on_token(last_split + 1, pos);
+        pos = on_start_bracket(pos);
+        last_split = pos;
+      } else if (!ignore_until.empty()) {
+        // skip
+      } else if (c == '&') {
+        on_token(last_split + 1, pos);
+        last_split = pos;
+        int32_t e = entity_end(pos);
+        if (e >= 0) { pos = e; last_split = e; }
+      } else if (split_table[(uint8_t)c]) {
+        on_token(last_split + 1, pos);
+        last_split = pos;
+      }
+      ++pos;
+    }
+    if (ignore_until.empty()) on_token(last_split + 1, n);
+  }
+};
+
+// ---------------------------------------------------------------- porter2
+
+inline bool p2_vowel(const std::string &w, size_t i) {
+  char c = w[i];
+  return c == 'a' || c == 'e' || c == 'i' || c == 'o' || c == 'u' || c == 'y';
+}
+
+bool contains_vowel(const std::string &w, size_t end) {
+  for (size_t i = 0; i < end && i < w.size(); ++i)
+    if (p2_vowel(w, i)) return true;
+  return false;
+}
+
+void mark_regions(const std::string &w, size_t &r1, size_t &r2) {
+  size_t n = w.size();
+  r1 = n;
+  static const char *prefixes[] = {"gener", "commun", "arsen"};
+  bool special = false;
+  for (const char *p : prefixes) {
+    size_t pl = strlen(p);
+    if (n >= pl && memcmp(w.data(), p, pl) == 0) {
+      r1 = pl;
+      special = true;
+      break;
+    }
+  }
+  if (!special) {
+    for (size_t i = 0; i + 1 < n; ++i)
+      if (p2_vowel(w, i) && !p2_vowel(w, i + 1)) { r1 = i + 2; break; }
+  }
+  r2 = n;
+  for (size_t i = r1; i + 1 < n; ++i)
+    if (p2_vowel(w, i) && !p2_vowel(w, i + 1)) { r2 = i + 2; break; }
+}
+
+bool ends_short_syllable(const std::string &w) {
+  size_t n = w.size();
+  if (n == 2) return p2_vowel(w, 0) && !p2_vowel(w, 1);
+  if (n >= 3) {
+    char last = w[n - 1];
+    return p2_vowel(w, n - 2) && !p2_vowel(w, n - 3) && !p2_vowel(w, n - 1) &&
+           last != 'w' && last != 'x' && last != 'Y';
+  }
+  return false;
+}
+
+inline bool ends_with(const std::string &w, const char *suf) {
+  size_t sl = strlen(suf);
+  return w.size() >= sl && memcmp(w.data() + w.size() - sl, suf, sl) == 0;
+}
+
+const std::unordered_map<std::string, std::string> &exception1() {
+  static const std::unordered_map<std::string, std::string> m = {
+      {"skis", "ski"},   {"skies", "sky"},  {"dying", "die"},
+      {"lying", "lie"},  {"tying", "tie"},  {"idly", "idl"},
+      {"gently", "gentl"}, {"ugly", "ugli"}, {"early", "earli"},
+      {"only", "onli"},  {"singly", "singl"}, {"sky", "sky"},
+      {"news", "news"},  {"howe", "howe"},  {"atlas", "atlas"},
+      {"cosmos", "cosmos"}, {"bias", "bias"}, {"andes", "andes"},
+  };
+  return m;
+}
+
+const std::unordered_set<std::string> &exception2() {
+  static const std::unordered_set<std::string> s = {
+      "inning", "outing", "canning", "herring", "earring",
+      "proceed", "exceed", "succeed"};
+  return s;
+}
+
+std::string porter2(std::string w) {
+  if (w.size() < 3) return w;
+  {
+    auto it = exception1().find(w);
+    if (it != exception1().end()) return it->second;
+  }
+  // prelude
+  if (w[0] == '\'') w.erase(0, 1);
+  bool y_found = false;
+  if (!w.empty() && w[0] == 'y') { w[0] = 'Y'; y_found = true; }
+  for (size_t i = 1; i < w.size(); ++i)
+    if (w[i] == 'y' && p2_vowel(w, i - 1)) { w[i] = 'Y'; y_found = true; }
+
+  size_t r1, r2;
+  mark_regions(w, r1, r2);
+
+  // step 0
+  if (ends_with(w, "'s'")) w.resize(w.size() - 3);
+  else if (ends_with(w, "'s")) w.resize(w.size() - 2);
+  else if (ends_with(w, "'")) w.resize(w.size() - 1);
+
+  // step 1a
+  if (ends_with(w, "sses")) {
+    w.resize(w.size() - 2);
+  } else if (ends_with(w, "ied") || ends_with(w, "ies")) {
+    if (w.size() > 4) { w.resize(w.size() - 3); w += "i"; }
+    else { w.resize(w.size() - 3); w += "ie"; }
+  } else if (ends_with(w, "us") || ends_with(w, "ss")) {
+    // nothing
+  } else if (ends_with(w, "s")) {
+    if (w.size() >= 2 && contains_vowel(w, w.size() - 2))
+      w.resize(w.size() - 1);
+  }
+
+  if (exception2().count(w)) return w;
+
+  // step 1b
+  {
+    const char *suf = nullptr;
+    static const char *sufs[] = {"eedly", "ingly", "edly", "eed", "ing", "ed"};
+    for (const char *s : sufs)
+      if (ends_with(w, s)) { suf = s; break; }
+    if (suf && (strcmp(suf, "eed") == 0 || strcmp(suf, "eedly") == 0)) {
+      if (w.size() - strlen(suf) >= r1) {
+        w.resize(w.size() - strlen(suf));
+        w += "ee";
+      }
+    } else if (suf) {
+      std::string stem = w.substr(0, w.size() - strlen(suf));
+      if (contains_vowel(stem, stem.size())) {
+        w = stem;
+        if (ends_with(w, "at") || ends_with(w, "bl") || ends_with(w, "iz")) {
+          w += "e";
+        } else if (ends_with(w, "bb") || ends_with(w, "dd") ||
+                   ends_with(w, "ff") || ends_with(w, "gg") ||
+                   ends_with(w, "mm") || ends_with(w, "nn") ||
+                   ends_with(w, "pp") || ends_with(w, "rr") ||
+                   ends_with(w, "tt")) {
+          w.resize(w.size() - 1);
+        } else if (r1 >= w.size() && ends_short_syllable(w)) {
+          w += "e";
+        }
+      }
+    }
+  }
+
+  // step 1c
+  if (w.size() > 2 && (w.back() == 'y' || w.back() == 'Y') &&
+      !p2_vowel(w, w.size() - 2))
+    w.back() = 'i';
+
+  // step 2 (longest-of; order matters only among overlapping suffixes)
+  {
+    struct S { const char *suf, *repl; };
+    static const S table[] = {
+        {"ational", "ate"}, {"fulness", "ful"}, {"iveness", "ive"},
+        {"ization", "ize"}, {"ousness", "ous"}, {"biliti", "ble"},
+        {"lessli", "less"}, {"tional", "tion"}, {"alism", "al"},
+        {"aliti", "al"},    {"ation", "ate"},   {"entli", "ent"},
+        {"fulli", "ful"},   {"iviti", "ive"},   {"ousli", "ous"},
+        {"abli", "able"},   {"alli", "al"},     {"anci", "ance"},
+        {"ator", "ate"},    {"enci", "ence"},   {"izer", "ize"},
+        {"bli", "ble"},
+    };
+    bool matched = false;
+    for (const S &e : table) {
+      if (ends_with(w, e.suf)) {
+        matched = true;
+        if (w.size() - strlen(e.suf) >= r1) {
+          w.resize(w.size() - strlen(e.suf));
+          w += e.repl;
+        }
+        break;
+      }
+    }
+    if (!matched) {
+      if (ends_with(w, "ogi")) {
+        if (w.size() - 3 >= r1 && w.size() >= 4 && w[w.size() - 4] == 'l')
+          w.resize(w.size() - 1);
+      } else if (ends_with(w, "li")) {
+        if (w.size() - 2 >= r1 && w.size() >= 3) {
+          char c = w[w.size() - 3];
+          if (strchr("cdeghkmnrt", c)) w.resize(w.size() - 2);
+        }
+      }
+    }
+  }
+
+  // step 3
+  {
+    struct S { const char *suf, *repl; };
+    static const S table[] = {
+        {"ational", "ate"}, {"tional", "tion"}, {"alize", "al"},
+        {"icate", "ic"},    {"iciti", "ic"},    {"ical", "ic"},
+        {"ful", ""},        {"ness", ""},
+    };
+    bool matched = false;
+    for (const S &e : table) {
+      if (ends_with(w, e.suf)) {
+        matched = true;
+        if (w.size() - strlen(e.suf) >= r1) {
+          w.resize(w.size() - strlen(e.suf));
+          w += e.repl;
+        }
+        break;
+      }
+    }
+    if (!matched && ends_with(w, "ative")) {
+      if (w.size() - 5 >= r1 && w.size() - 5 >= r2) w.resize(w.size() - 5);
+    }
+  }
+
+  // step 4
+  {
+    static const char *sufs[] = {"ement", "ance", "ence", "able", "ible",
+                                 "ment", "ant", "ent", "ism", "ate", "iti",
+                                 "ous", "ive", "ize", "al", "er", "ic"};
+    bool matched = false;
+    for (const char *s : sufs) {
+      if (ends_with(w, s)) {
+        matched = true;
+        if (w.size() - strlen(s) >= r2) w.resize(w.size() - strlen(s));
+        break;
+      }
+    }
+    if (!matched && (ends_with(w, "sion") || ends_with(w, "tion"))) {
+      if (w.size() - 3 >= r2) w.resize(w.size() - 3);
+    }
+  }
+
+  // step 5
+  if (!w.empty() && w.back() == 'e') {
+    std::string head = w.substr(0, w.size() - 1);
+    if (w.size() - 1 >= r2 ||
+        (w.size() - 1 >= r1 && !ends_short_syllable(head)))
+      w.resize(w.size() - 1);
+  } else if (!w.empty() && w.back() == 'l') {
+    if (w.size() - 1 >= r2 && w.size() >= 2 && w[w.size() - 2] == 'l')
+      w.resize(w.size() - 1);
+  }
+
+  if (y_found)
+    for (auto &c : w)
+      if (c == 'Y') c = 'y';
+  return w;
+}
+
+// ---------------------------------------------------------------- C API
+
+std::unordered_set<std::string> g_stopwords;
+
+}  // namespace
+
+extern "C" {
+
+void ir_set_stopwords(const char *blob, int32_t len) {
+  g_stopwords.clear();
+  const char *p = blob, *end = blob + len;
+  while (p < end) {
+    const char *nl = (const char *)memchr(p, '\n', end - p);
+    if (!nl) nl = end;
+    if (nl > p) g_stopwords.emplace(p, nl);
+    p = nl + 1;
+  }
+}
+
+// Analyze one ASCII document. Writes '\n'-joined analyzed tokens to out.
+// Returns bytes written (>= 0), or -(bytes needed) if out_cap is too small.
+int32_t ir_analyze(const char *text, int32_t len, char *out, int32_t out_cap) {
+  Tokenizer tk;
+  tk.text = text;
+  tk.n = len;
+  tk.run();
+
+  // stopword filter + stem, accumulating into out
+  static thread_local std::unordered_map<std::string, std::string> cache;
+  int64_t written = 0;
+  int64_t needed = 0;
+  for (const std::string &tok : tk.tokens) {
+    if (g_stopwords.count(tok)) continue;
+    std::string stemmed;
+    auto it = cache.find(tok);
+    if (it != cache.end()) {
+      stemmed = it->second;
+    } else {
+      stemmed = porter2(tok);
+      cache.emplace(tok, stemmed);
+      if (cache.size() > 50000) cache.clear();
+    }
+    int64_t need = (int64_t)stemmed.size() + 1;
+    if (written + need <= out_cap) {
+      memcpy(out + written, stemmed.data(), stemmed.size());
+      out[written + stemmed.size()] = '\n';
+      written += need;
+    }
+    needed += need;
+  }
+  if (needed > out_cap) return (int32_t)-needed;
+  return (int32_t)written;
+}
+
+const char *ir_version() { return "tpu-ir-native-1"; }
+
+}  // extern "C"

@@ -1,0 +1,83 @@
+"""Native (C++) analyzer parity: must match the Python pipeline exactly on
+ASCII documents, and route non-ASCII documents to the Python pipeline."""
+
+import random
+import string
+
+import pytest
+
+from tpu_ir.analysis import Analyzer
+from tpu_ir.analysis.native import NativeAnalyzer, load_native
+
+pytestmark = pytest.mark.skipif(
+    load_native() is None, reason="native analyzer unavailable (no g++?)")
+
+
+def both():
+    return Analyzer(), NativeAnalyzer()
+
+
+def test_native_loads():
+    assert NativeAnalyzer().is_native
+
+
+GOLDEN_DOCS = [
+    " this is a the <test> for the teokenizer 101 546 "
+    "345-543543545436-4656765865865 rgger <xml> ergtre 456435klj345lj34590",
+    "<DOC>\n<DOCNO> WSJ870324-0001 </DOCNO>\n<TEXT>\nJohn Blair &amp; Co. is "
+    "close to an agreement to sell its U.S.A. T.V. station advertising unit "
+    "to Ph.D. students at umass.edu; don't they know I.B.M.?\n</TEXT>\n</DOC>",
+    "a <script>var x = 1 < 2;</script> b <style>p{x}</style> c <script/> d",
+    "U.S.A. ...dots... a.b.c.d ph.d. O'Neill's CAN'T won't",
+    "<!-- comment --> visible <?php hidden ?> also <!DOCTYPE x> end",
+    "fish &amp; chips AT&T x&#160;y &unterminated rest",
+    "running dogs quickly jumping nations communities generations",
+    "<a href=\"http://x.com/page>weird\">link text</a>",
+    "" , "   ", "<", "&", "<unclosed tag here", "a" * 99, "a" * 101,
+]
+
+
+@pytest.mark.parametrize("i", range(len(GOLDEN_DOCS)))
+def test_parity_golden(i):
+    py, nat = both()
+    doc = GOLDEN_DOCS[i]
+    assert nat.analyze(doc) == py.analyze(doc), doc
+
+
+def test_parity_fuzz():
+    py, nat = both()
+    rng = random.Random(42)
+    alphabet = (string.ascii_letters + string.digits +
+                " \t\n.<>&/;'\"-_=!?#()[]{}austeding")
+    for trial in range(300):
+        n = rng.randint(0, 400)
+        doc = "".join(rng.choice(alphabet) for _ in range(n))
+        assert nat.analyze(doc) == py.analyze(doc), repr(doc)
+
+
+def test_parity_wordlike_fuzz():
+    py, nat = both()
+    rng = random.Random(7)
+    suffixes = ["", "s", "es", "ed", "ing", "ly", "ness", "ful", "ation",
+                "ization", "ity", "ies", "ied", "ement", "ous", "ive", "al"]
+    for trial in range(200):
+        words = []
+        for _ in range(rng.randint(1, 40)):
+            base = "".join(rng.choice("abcdefghijklmnopqrstuvwxy")
+                           for _ in range(rng.randint(1, 9)))
+            words.append(base + rng.choice(suffixes))
+        doc = f"<DOC><TEXT>{' '.join(words)}</TEXT></DOC>"
+        assert nat.analyze(doc) == py.analyze(doc), doc
+
+
+def test_non_ascii_falls_back_to_python():
+    py, nat = both()
+    doc = "Müller's résumé <TEXT>naïve café</TEXT> 中文 test"
+    assert nat.analyze(doc) == py.analyze(doc)
+
+
+def test_long_token_cap_parity():
+    py, nat = both()
+    for n in [15, 16, 17, 98, 99, 100, 101, 150]:
+        doc = "x" * n
+        assert nat.analyze(doc) == py.analyze(doc), n

@@ -1,0 +1,106 @@
+"""ctypes loader for the native (C++) analysis pipeline.
+
+Builds native/analyzer.cpp with g++ on first use (cached as a .so next to the
+source), exposes `NativeAnalyzer` with the exact semantics of the Python
+`Analyzer` for ASCII documents, and transparently falls back:
+- per document, to the Python pipeline when the text contains non-ASCII bytes
+  (the C++ path is byte-wise and skips Unicode case folding on purpose);
+- globally, to the Python pipeline when no compiler/.so is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from .analyzer import Analyzer
+from .stopwords import TERRIER_STOPWORDS
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "..", "..", "native")
+_SRC = os.path.abspath(os.path.join(_NATIVE_DIR, "analyzer.cpp"))
+_SO = os.path.abspath(os.path.join(_NATIVE_DIR, "analyzer.so"))
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_lib_failed = False
+
+
+def _build_so() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
+             "-o", _SO, _SRC],
+            check=True, capture_output=True, timeout=120)
+        return True
+    except (OSError, subprocess.SubprocessError):
+        return False
+
+
+def load_native() -> ctypes.CDLL | None:
+    """Load (building if needed) the native analyzer; None if unavailable."""
+    global _lib, _lib_failed
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if _lib_failed:
+            return None
+        if not os.path.exists(_SO) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
+        ):
+            if not os.path.exists(_SRC) or not _build_so():
+                _lib_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_SO)
+        except OSError:
+            _lib_failed = True
+            return None
+        lib.ir_analyze.restype = ctypes.c_int32
+        lib.ir_analyze.argtypes = [ctypes.c_char_p, ctypes.c_int32,
+                                   ctypes.c_char_p, ctypes.c_int32]
+        lib.ir_set_stopwords.argtypes = [ctypes.c_char_p, ctypes.c_int32]
+        blob = "\n".join(sorted(TERRIER_STOPWORDS)).encode()
+        lib.ir_set_stopwords(blob, len(blob))
+        _lib = lib
+        return lib
+
+
+class NativeAnalyzer:
+    """Drop-in Analyzer using the C++ pipeline when possible."""
+
+    def __init__(self, out_cap: int = 1 << 20):
+        self._lib = load_native()
+        self._py = Analyzer()
+        self._buf = ctypes.create_string_buffer(out_cap)
+
+    @property
+    def is_native(self) -> bool:
+        return self._lib is not None
+
+    def analyze(self, text: str) -> list[str]:
+        if self._lib is None or not text.isascii():
+            return self._py.analyze(text)
+        raw = text.encode("ascii")
+        n = self._lib.ir_analyze(raw, len(raw), self._buf,
+                                 len(self._buf) - 1)
+        if n < 0:  # grow and retry once
+            self._buf = ctypes.create_string_buffer(2 * -n)
+            n = self._lib.ir_analyze(raw, len(raw), self._buf,
+                                     len(self._buf) - 1)
+            if n < 0:
+                return self._py.analyze(text)
+        if n == 0:
+            return []
+        return self._buf.raw[: n - 1].decode("ascii").split("\n") if n > 1 else []
+
+
+def make_analyzer(native: bool = True):
+    """Factory: NativeAnalyzer when requested and available, else Analyzer."""
+    if native:
+        a = NativeAnalyzer()
+        if a.is_native:
+            return a
+    return Analyzer()

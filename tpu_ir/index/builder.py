@@ -21,7 +21,7 @@ from typing import Iterable, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis import Analyzer
+from ..analysis.native import make_analyzer
 from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
 from ..ops import (
     build_chargram_index_jit,
@@ -39,7 +39,7 @@ def _analyze_corpus(
     corpus_paths: Sequence[str], k: int, report: JobReport
 ) -> tuple[list[str], list[list[str]]]:
     """Stream + analyze every document. Returns (docids, per-doc token lists)."""
-    analyzer = Analyzer()
+    analyzer = make_analyzer()
     docids: list[str] = []
     doc_tokens: list[list[str]] = []
     with report.phase("tokenize"):

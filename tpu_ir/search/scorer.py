@@ -20,7 +20,7 @@ from typing import Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis import Analyzer
+from ..analysis.native import make_analyzer
 from ..collection import DocnoMapping, Vocab, kgram_terms
 from ..index import format as fmt
 from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense, tfidf_topk_sparse
@@ -53,7 +53,7 @@ class Scorer:
         self.mapping = mapping
         self.meta = meta
         self.compat_int_idf = compat_int_idf
-        self._analyzer = Analyzer()
+        self._analyzer = make_analyzer()
         v, d = meta.vocab_size, meta.num_docs
         self.df = jnp.asarray(df)
         self.doc_len = jnp.asarray(doc_len)
