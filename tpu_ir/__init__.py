@@ -7,3 +7,19 @@ BM25 ranked retrieval — built SPMD-first on jax.sharding meshes instead of
 Hadoop MapReduce."""
 
 __version__ = "0.1.0"
+
+
+def enable_compilation_cache(path: str | None = None) -> None:
+    """Persist XLA executables across processes (big win for repeat builds:
+    the device group-by/scoring programs compile once per shape ever).
+    Called automatically by the index builder and scorer."""
+    import os
+
+    import jax
+
+    path = path or os.path.join(
+        os.path.expanduser("~"), ".cache", "tpu_ir", "jax_cache")
+    os.makedirs(path, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", path)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)

@@ -24,9 +24,9 @@ import numpy as np
 from ..analysis.native import make_analyzer
 from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
 from ..ops import (
+    PAD_TERM,
     build_chargram_index_jit,
     build_postings_jit,
-    pack_occurrences,
     pack_term_bytes,
 )
 from ..utils import JobReport
@@ -75,6 +75,10 @@ def build_index(
     if fmt.artifact_exists(index_dir, fmt.METADATA) and not overwrite:
         return fmt.IndexMetadata.load(index_dir)
 
+    from .. import enable_compilation_cache
+
+    enable_compilation_cache()
+
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "num_shards": num_shards, "chargram_ks": chargram_ks})
 
@@ -91,23 +95,33 @@ def build_index(
         mapping.save(os.path.join(index_dir, fmt.DOCNOS))
         docnos = np.array([mapping.get_docno(d) for d in docids], np.int32)
 
-    # --- vocab over k-gram terms ---
+    # --- vocab over k-gram terms (np.unique = one C-speed sort doubles as
+    # both the vocab build and the term-id assignment) ---
     with report.phase("vocab"):
-        doc_kgrams = [kgram_terms(toks, k) for toks in doc_tokens]
-        vocab = Vocab.build(t for grams in doc_kgrams for t in grams)
+        doc_kgrams = (doc_tokens if k == 1 else
+                      [kgram_terms(toks, k) for toks in doc_tokens])
+        lengths = np.fromiter((len(g) for g in doc_kgrams), np.int64,
+                              len(doc_kgrams))
+        flat_terms = np.array(
+            [t for grams in doc_kgrams for t in grams], dtype=np.str_)
+        uniques, inverse = np.unique(flat_terms, return_inverse=True)
+        vocab = Vocab(uniques.tolist())
         vocab.save(os.path.join(index_dir, fmt.VOCAB))
         v = len(vocab)
-        term_id_arrays = [
-            np.fromiter((vocab.id(t) for t in grams), np.int32, len(grams))
-            for grams in doc_kgrams
-        ]
-        occurrences = int(sum(len(a) for a in term_id_arrays))
+        occurrences = int(len(flat_terms))
         report.set_counter("map_output_records", occurrences)
         report.set_counter("reduce_output_groups", v)
 
     # --- postings build on device (the map/shuffle/reduce) ---
     with report.phase("postings_device"):
-        term_ids, doc_ids = pack_occurrences(term_id_arrays, docnos)
+        # round capacity to 256k granularity: padded waste stays < 10% while
+        # repeat builds of the same corpus reuse the compiled program shape
+        granule = 1 << 18
+        cap = max(granule, (occurrences + granule - 1) // granule * granule)
+        term_ids = np.full(cap, PAD_TERM, np.int32)
+        doc_ids = np.zeros(cap, np.int32)
+        term_ids[:occurrences] = inverse.astype(np.int32)
+        doc_ids[:occurrences] = np.repeat(docnos, lengths)
         p = build_postings_jit(
             jnp.asarray(term_ids), jnp.asarray(doc_ids),
             vocab_size=v, num_docs=num_docs)
