@@ -179,3 +179,35 @@ def test_compat_int_idf_quirk(index_dir):
     for d, s in got:
         tf = kgram_terms(an.analyze(DOCS[d]), 1).count("brown")
         assert s == pytest.approx((1 + math.log(tf)) * idf, rel=1e-4)
+
+
+def test_spmd_build_equals_single_device(tmp_path):
+    """build_index(spmd_devices=8) must produce byte-identical artifacts to
+    the single-device build (modulo shard count)."""
+    corpus = corpus_file(tmp_path)
+    out1 = str(tmp_path / "idx_single")
+    out8 = str(tmp_path / "idx_spmd")
+    build_index([str(corpus)], out1, k=1, num_shards=8,
+                compute_chargrams=False)
+    build_index([str(corpus)], out8, k=1, compute_chargrams=False,
+                spmd_devices=8)
+
+    m1 = fmt.IndexMetadata.load(out1)
+    m8 = fmt.IndexMetadata.load(out8)
+    assert m8.num_shards == 8
+    assert m8.num_pairs == m1.num_pairs
+    assert m8.vocab_size == m1.vocab_size
+    for s in range(8):
+        z1 = fmt.load_shard(out1, s)
+        z8 = fmt.load_shard(out8, s)
+        for key in ["term_ids", "indptr", "pair_doc", "pair_tf", "df"]:
+            np.testing.assert_array_equal(z1[key], z8[key], err_msg=f"{s}/{key}")
+    np.testing.assert_array_equal(
+        np.load(os.path.join(out1, fmt.DOCLEN)),
+        np.load(os.path.join(out8, fmt.DOCLEN)))
+
+    # search results identical
+    s1 = Scorer.load(out1)
+    s8 = Scorer.load(out8)
+    for q in ["quick fox", "salmon fishing", "honey bears river"]:
+        assert s1.search(q) == s8.search(q)

@@ -39,7 +39,8 @@ def cmd_index(args) -> int:
         args.corpus, args.index_dir, k=args.k,
         chargram_ks=args.chargram_k, num_shards=args.shards,
         overwrite=args.overwrite,
-        compute_chargrams=not args.no_chargrams)
+        compute_chargrams=not args.no_chargrams,
+        spmd_devices=args.spmd_devices)
     print(json.dumps(meta.__dict__))
     return 0
 
@@ -139,6 +140,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="term shards (reference used 10 reducers)")
     pi.add_argument("--overwrite", action="store_true")
     pi.add_argument("--no-chargrams", action="store_true")
+    pi.add_argument("--spmd-devices", type=int, default=None,
+                    help="build over an N-device mesh (doc-sharded map, "
+                         "all_to_all shuffle, term-sharded reduce); implies "
+                         "N index shards")
     _add_backend_arg(pi)
     pi.set_defaults(fn=cmd_index)
 

@@ -59,6 +59,7 @@ def build_index(
     num_shards: int = 10,
     overwrite: bool = False,
     compute_chargrams: bool = True,
+    spmd_devices: int | None = None,
 ) -> fmt.IndexMetadata:
     """Build every index artifact for a TREC corpus. Idempotent per artifact."""
     if isinstance(corpus_paths, (str, os.PathLike)):
@@ -112,49 +113,77 @@ def build_index(
         report.set_counter("map_output_records", occurrences)
         report.set_counter("reduce_output_groups", v)
 
-    # --- postings build on device (the map/shuffle/reduce) ---
-    with report.phase("postings_device"):
-        # round capacity to 256k granularity: padded waste stays < 10% while
-        # repeat builds of the same corpus reuse the compiled program shape
-        granule = 1 << 18
-        cap = max(granule, (occurrences + granule - 1) // granule * granule)
-        term_ids = np.full(cap, PAD_TERM, np.int32)
-        doc_ids = np.zeros(cap, np.int32)
-        term_ids[:occurrences] = inverse.astype(np.int32)
-        doc_ids[:occurrences] = np.repeat(docnos, lengths)
-        p = build_postings_jit(
-            jnp.asarray(term_ids), jnp.asarray(doc_ids),
-            vocab_size=v, num_docs=num_docs)
-        num_pairs = int(p.num_pairs)
-        pair_term = np.asarray(p.pair_term)[:num_pairs]
-        pair_doc = np.asarray(p.pair_doc)[:num_pairs]
-        pair_tf = np.asarray(p.pair_tf)[:num_pairs]
-        df = np.asarray(p.df)
-        doc_len = np.asarray(p.doc_len)
-        report.set_counter("num_pairs", num_pairs)
+    flat_term_ids = inverse.astype(np.int32)
+    flat_doc_ids = np.repeat(docnos, lengths).astype(np.int32)
 
-    # --- shard + persist (part-NNNNN layout) ---
-    with report.phase("write_shards"):
-        np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
-        indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
-        shard_of = np.arange(v, dtype=np.int32) % num_shards
-        offset_of = np.zeros(v, np.int64)
-        for s in range(num_shards):
-            tids = np.nonzero(shard_of == s)[0].astype(np.int32)
-            lens = df[tids].astype(np.int64)
-            local_indptr = np.concatenate([[0], np.cumsum(lens)])
-            sel = np.concatenate(
-                [np.arange(indptr[t], indptr[t + 1]) for t in tids]
-            ) if len(tids) else np.zeros(0, np.int64)
-            offset_of[tids] = local_indptr[:-1]
-            fmt.save_shard(
-                index_dir, s,
-                term_ids=tids,
-                indptr=local_indptr,
-                pair_doc=pair_doc[sel],
-                pair_tf=pair_tf[sel],
-                df=df[tids],
-            )
+    if spmd_devices:
+        # --- SPMD path: doc-sharded map + all_to_all shuffle + term-sharded
+        # reduce; each device's output IS its part-NNNNN file (the Hadoop
+        # reducer-output layout, with the shuffle on ICI) ---
+        num_shards = spmd_devices
+        with report.phase("postings_device"):
+            shard_pairs, df, doc_len = _spmd_postings(
+                flat_term_ids, flat_doc_ids, docnos,
+                vocab_size=v, num_docs=num_docs, num_devices=spmd_devices)
+            num_pairs = int(sum(len(sp[0]) for sp in shard_pairs))
+            report.set_counter("num_pairs", num_pairs)
+        with report.phase("write_shards"):
+            np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
+            shard_of = np.arange(v, dtype=np.int32) % num_shards
+            offset_of = np.zeros(v, np.int64)
+            for s, (s_term, s_doc, s_tf) in enumerate(shard_pairs):
+                tids = np.nonzero(shard_of == s)[0].astype(np.int32)
+                lens = df[tids].astype(np.int64)
+                local_indptr = np.concatenate([[0], np.cumsum(lens)])
+                offset_of[tids] = local_indptr[:-1]
+                fmt.save_shard(index_dir, s, term_ids=tids,
+                               indptr=local_indptr, pair_doc=s_doc,
+                               pair_tf=s_tf, df=df[tids])
+    else:
+        # --- single-device path ---
+        with report.phase("postings_device"):
+            # round capacity to 256k granularity: padded waste stays < 10%
+            # while repeat builds reuse the compiled program shape
+            granule = 1 << 18
+            cap = max(granule,
+                      (occurrences + granule - 1) // granule * granule)
+            term_ids = np.full(cap, PAD_TERM, np.int32)
+            doc_ids = np.zeros(cap, np.int32)
+            term_ids[:occurrences] = flat_term_ids
+            doc_ids[:occurrences] = flat_doc_ids
+            p = build_postings_jit(
+                jnp.asarray(term_ids), jnp.asarray(doc_ids),
+                vocab_size=v, num_docs=num_docs)
+            num_pairs = int(p.num_pairs)
+            pair_term = np.asarray(p.pair_term)[:num_pairs]
+            pair_doc = np.asarray(p.pair_doc)[:num_pairs]
+            pair_tf = np.asarray(p.pair_tf)[:num_pairs]
+            df = np.asarray(p.df)
+            doc_len = np.asarray(p.doc_len)
+            report.set_counter("num_pairs", num_pairs)
+
+        # --- shard + persist (part-NNNNN layout) ---
+        with report.phase("write_shards"):
+            np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
+            indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
+            shard_of = np.arange(v, dtype=np.int32) % num_shards
+            offset_of = np.zeros(v, np.int64)
+            for s in range(num_shards):
+                tids = np.nonzero(shard_of == s)[0].astype(np.int32)
+                lens = df[tids].astype(np.int64)
+                local_indptr = np.concatenate([[0], np.cumsum(lens)])
+                sel = np.concatenate(
+                    [np.arange(indptr[t], indptr[t + 1]) for t in tids]
+                ) if len(tids) else np.zeros(0, np.int64)
+                offset_of[tids] = local_indptr[:-1]
+                fmt.save_shard(
+                    index_dir, s,
+                    term_ids=tids,
+                    indptr=local_indptr,
+                    pair_doc=pair_doc[sel],
+                    pair_tf=pair_tf[sel],
+                    df=df[tids],
+                )
 
     # --- dictionary / forward index (BuildIntDocVectorsForwardIndex) ---
     with report.phase("dictionary"):
@@ -181,6 +210,49 @@ def build_index(
     meta.save(index_dir)
     report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     return meta
+
+
+def _spmd_postings(flat_term_ids, flat_doc_ids, docnos, *, vocab_size,
+                   num_docs, num_devices):
+    """Run the mesh build; returns ([(term, doc, tf)] per shard, df, doc_len).
+
+    Documents are dealt to doc shards by (docno-1) % num_devices; terms land
+    on term shard term_id % num_devices via the all_to_all routing."""
+    from ..parallel import make_mesh, sharded_build_postings
+
+    s = num_devices
+    doc_shard = (flat_doc_ids - 1) % s
+    granule = 1 << 14
+    max_fill = int(np.bincount(doc_shard, minlength=s).max()) if len(
+        flat_term_ids) else 1
+    cap = max(granule, (max_fill + granule - 1) // granule * granule)
+    term_ids = np.full((s, cap), PAD_TERM, np.int32)
+    doc_ids = np.zeros((s, cap), np.int32)
+    for sh in range(s):
+        sel = doc_shard == sh
+        n = int(sel.sum())
+        term_ids[sh, :n] = flat_term_ids[sel]
+        doc_ids[sh, :n] = flat_doc_ids[sel]
+    docs_per_shard = np.bincount((docnos - 1) % s, minlength=s).astype(np.int32)
+
+    mesh = make_mesh(s)
+    out = sharded_build_postings(
+        term_ids, doc_ids, docs_per_shard,
+        vocab_size=vocab_size, total_docs=num_docs, mesh=mesh)
+
+    shard_pairs = []
+    df = np.zeros(vocab_size, np.int32)
+    for sh in range(s):
+        npairs = int(np.asarray(out.num_pairs)[sh])
+        shard_pairs.append((
+            np.asarray(out.pair_term)[sh][:npairs],
+            np.asarray(out.pair_doc)[sh][:npairs],
+            np.asarray(out.pair_tf)[sh][:npairs],
+        ))
+        df += np.asarray(out.df)[sh]
+    doc_len = np.bincount(flat_doc_ids, minlength=num_docs + 1
+                          ).astype(np.int32)[: num_docs + 1]
+    return shard_pairs, df, doc_len
 
 
 def build_chargram_artifacts(
