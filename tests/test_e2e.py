@@ -211,3 +211,35 @@ def test_spmd_build_equals_single_device(tmp_path):
     s8 = Scorer.load(out8)
     for q in ["quick fox", "salmon fishing", "honey bears river"]:
         assert s1.search(q) == s8.search(q)
+
+
+def test_streaming_build_equals_in_memory(tmp_path):
+    """Streaming (spill/merge) build must produce identical artifacts to the
+    in-memory build, even with tiny 3-doc batches."""
+    from tpu_ir.index.streaming import build_index_streaming
+
+    corpus = corpus_file(tmp_path)
+    out1 = str(tmp_path / "idx_mem")
+    out2 = str(tmp_path / "idx_stream")
+    build_index([str(corpus)], out1, k=1, num_shards=4,
+                compute_chargrams=False)
+    build_index_streaming([str(corpus)], out2, k=1, num_shards=4,
+                          batch_docs=3, compute_chargrams=False)
+
+    m1 = fmt.IndexMetadata.load(out1)
+    m2 = fmt.IndexMetadata.load(out2)
+    assert m2.num_pairs == m1.num_pairs
+    assert m2.vocab_size == m1.vocab_size
+    for s in range(4):
+        z1 = fmt.load_shard(out1, s)
+        z2 = fmt.load_shard(out2, s)
+        for key in ["term_ids", "indptr", "pair_doc", "pair_tf", "df"]:
+            np.testing.assert_array_equal(z1[key], z2[key],
+                                          err_msg=f"{s}/{key}")
+    np.testing.assert_array_equal(
+        np.load(os.path.join(out1, fmt.DOCLEN)),
+        np.load(os.path.join(out2, fmt.DOCLEN)))
+    assert not os.path.exists(os.path.join(out2, "_spill"))
+    s1, s2 = Scorer.load(out1), Scorer.load(out2)
+    for q in ["quick fox", "salmon fishing"]:
+        assert s1.search(q) == s2.search(q)

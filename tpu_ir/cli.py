@@ -33,14 +33,23 @@ def _apply_backend(args) -> None:
 
 def cmd_index(args) -> int:
     _apply_backend(args)
-    from .index import build_index
+    if args.streaming:
+        from .index.streaming import build_index_streaming
 
-    meta = build_index(
-        args.corpus, args.index_dir, k=args.k,
-        chargram_ks=args.chargram_k, num_shards=args.shards,
-        overwrite=args.overwrite,
-        compute_chargrams=not args.no_chargrams,
-        spmd_devices=args.spmd_devices)
+        meta = build_index_streaming(
+            args.corpus, args.index_dir, k=args.k,
+            chargram_ks=args.chargram_k, num_shards=args.shards,
+            batch_docs=args.batch_docs,
+            compute_chargrams=not args.no_chargrams)
+    else:
+        from .index import build_index
+
+        meta = build_index(
+            args.corpus, args.index_dir, k=args.k,
+            chargram_ks=args.chargram_k, num_shards=args.shards,
+            overwrite=args.overwrite,
+            compute_chargrams=not args.no_chargrams,
+            spmd_devices=args.spmd_devices)
     print(json.dumps(meta.__dict__))
     return 0
 
@@ -140,6 +149,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="term shards (reference used 10 reducers)")
     pi.add_argument("--overwrite", action="store_true")
     pi.add_argument("--no-chargrams", action="store_true")
+    pi.add_argument("--streaming", action="store_true",
+                    help="out-of-core spill/merge build for corpora larger "
+                         "than memory")
+    pi.add_argument("--batch-docs", type=int, default=20000,
+                    help="streaming: documents per tokenize batch")
     pi.add_argument("--spmd-devices", type=int, default=None,
                     help="build over an N-device mesh (doc-sharded map, "
                          "all_to_all shuffle, term-sharded reduce); implies "

@@ -1,0 +1,220 @@
+"""Streaming (out-of-core) index build for corpora that don't fit in memory.
+
+Architecture mirrors Hadoop's spill-and-merge (the reference's substrate)
+with the merge as a device op:
+
+  pass 1 (map): stream the corpus in document batches; tokenize each batch
+    (native analyzer), cache its tokens + docids to a spill directory, and
+    keep only the batch's unique terms (memory = global vocab, not corpus).
+  between passes: docno mapping (sorted docids) + vocab (merge of per-batch
+    uniques) — vectorized via np.unique/searchsorted.
+  pass 2 (combine + spill): re-read each token batch, map terms to ids with
+    np.searchsorted, pre-aggregate (term, doc, tf) on device (the combiner),
+    and spill each batch's pairs partitioned by term shard (term_id % S).
+  pass 3 (reduce): per term shard, concatenate its spills and run one
+    device reduce (reduce_weighted_postings) -> part-NNNNN file. Peak memory
+    is one shard's pairs, never the whole index.
+
+This is the scaling path for the Wikipedia-1M / MS MARCO configs
+(BASELINE.json); the in-memory builder (builder.py) stays the fast path for
+reference-scale corpora.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Iterable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..analysis.native import make_analyzer
+from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
+from ..ops import PAD_TERM, build_postings_jit
+from ..ops.postings import reduce_weighted_postings_jit
+from ..utils import JobReport
+from . import format as fmt
+from .builder import build_chargram_artifacts
+
+
+def _round_cap(n: int, granule: int = 1 << 18) -> int:
+    return max(granule, (n + granule - 1) // granule * granule)
+
+
+def build_index_streaming(
+    corpus_paths: Sequence[str] | str,
+    index_dir: str,
+    *,
+    k: int = 1,
+    chargram_ks: Iterable[int] = (2, 3),
+    num_shards: int = 10,
+    batch_docs: int = 20_000,
+    compute_chargrams: bool = True,
+    keep_spills: bool = False,
+) -> fmt.IndexMetadata:
+    if isinstance(corpus_paths, (str, os.PathLike)):
+        corpus_paths = [corpus_paths]
+    chargram_ks = list(chargram_ks)
+    os.makedirs(index_dir, exist_ok=True)
+    if fmt.artifact_exists(index_dir, fmt.METADATA):
+        return fmt.IndexMetadata.load(index_dir)
+
+    from .. import enable_compilation_cache
+
+    enable_compilation_cache()
+
+    spill_dir = os.path.join(index_dir, "_spill")
+    os.makedirs(spill_dir, exist_ok=True)
+    report = JobReport("TermKGramDocIndexer", config={
+        "k": k, "num_shards": num_shards, "streaming": True,
+        "batch_docs": batch_docs})
+    analyzer = make_analyzer()
+
+    # ---- pass 1: tokenize + spill token batches, accumulate vocab ----
+    vocab_terms: np.ndarray | None = None  # sorted unique terms so far
+    all_docids: list[str] = []
+    n_batches = 0
+    with report.phase("pass1_tokenize"):
+        batch_tokens: list[list[str]] = []
+        batch_docids: list[str] = []
+
+        def flush():
+            nonlocal vocab_terms, n_batches
+            if not batch_docids:
+                return
+            flat = np.array(
+                [t for toks in batch_tokens for t in toks], dtype=np.str_)
+            lengths = np.fromiter((len(t) for t in batch_tokens), np.int64,
+                                  len(batch_tokens))
+            uniq = np.unique(flat)
+            vocab_terms = uniq if vocab_terms is None else np.union1d(
+                vocab_terms, uniq)
+            np.savez(os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
+                     flat=flat, lengths=lengths,
+                     docids=np.array(batch_docids, dtype=np.str_))
+            n_batches += 1
+            batch_tokens.clear()
+            batch_docids.clear()
+
+        for doc in read_trec_corpus(corpus_paths):
+            report.incr("Count.DOCS")
+            toks = analyzer.analyze(doc.content)
+            batch_docids.append(doc.docid)
+            all_docids.append(doc.docid)
+            batch_tokens.append(kgram_terms(toks, k) if k > 1 else toks)
+            if len(batch_docids) >= batch_docs:
+                flush()
+        flush()
+
+    num_docs = len(all_docids)
+    if num_docs == 0:
+        raise ValueError(f"no <DOC> records found in {corpus_paths}")
+    assert vocab_terms is not None
+
+    # ---- between passes: docno mapping + vocab ----
+    with report.phase("docno_mapping"):
+        mapping = DocnoMapping.build(all_docids)
+        if len(mapping) != num_docs:
+            raise ValueError("duplicate docids in corpus")
+        mapping.save(os.path.join(index_dir, fmt.DOCNOS))
+        sorted_docids = np.array(mapping.docids, dtype=np.str_)
+    with report.phase("vocab"):
+        vocab = Vocab(vocab_terms.tolist())
+        vocab.save(os.path.join(index_dir, fmt.VOCAB))
+        v = len(vocab)
+        report.set_counter("reduce_output_groups", v)
+
+    # ---- pass 2: combine per batch, spill pairs per term shard ----
+    doc_len = np.zeros(num_docs + 1, np.int64)
+    occurrences = 0
+    with report.phase("pass2_combine"):
+        for b in range(n_batches):
+            with np.load(os.path.join(spill_dir, f"tokens-{b:05d}.npz")) as z:
+                flat, lengths, docids = z["flat"], z["lengths"], z["docids"]
+            occurrences += len(flat)
+            term_ids = np.searchsorted(vocab_terms, flat).astype(np.int32)
+            docnos = (np.searchsorted(sorted_docids, docids) + 1).astype(
+                np.int32)
+            doc_ids = np.repeat(docnos, lengths)
+            np.add.at(doc_len, doc_ids, 1)
+
+            cap = _round_cap(len(flat))
+            t_pad = np.full(cap, PAD_TERM, np.int32)
+            d_pad = np.zeros(cap, np.int32)
+            t_pad[: len(flat)] = term_ids
+            d_pad[: len(flat)] = doc_ids
+            p = build_postings_jit(jnp.asarray(t_pad), jnp.asarray(d_pad),
+                                   vocab_size=v, num_docs=num_docs)
+            npairs = int(p.num_pairs)
+            pt = np.asarray(p.pair_term)[:npairs]
+            pd = np.asarray(p.pair_doc)[:npairs]
+            ptf = np.asarray(p.pair_tf)[:npairs]
+            shard = pt % num_shards
+            for s in range(num_shards):
+                sel = shard == s
+                np.savez(os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"),
+                         term=pt[sel], doc=pd[sel], tf=ptf[sel])
+    report.set_counter("map_output_records", occurrences)
+
+    # ---- pass 3: per-shard reduce -> part files ----
+    df = np.zeros(v, np.int32)
+    num_pairs_total = 0
+    shard_of = np.arange(v, dtype=np.int32) % num_shards
+    offset_of = np.zeros(v, np.int64)
+    with report.phase("pass3_reduce"):
+        for s in range(num_shards):
+            terms, docs, tfs = [], [], []
+            for b in range(n_batches):
+                path = os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz")
+                with np.load(path) as z:
+                    terms.append(z["term"])
+                    docs.append(z["doc"])
+                    tfs.append(z["tf"])
+            t = np.concatenate(terms) if terms else np.zeros(0, np.int32)
+            d = np.concatenate(docs) if docs else np.zeros(0, np.int32)
+            w = np.concatenate(tfs) if tfs else np.zeros(0, np.int32)
+            cap = _round_cap(max(len(t), 1), 1 << 16)
+            t_pad = np.full(cap, PAD_TERM, np.int32)
+            d_pad = np.zeros(cap, np.int32)
+            w_pad = np.zeros(cap, np.int32)
+            t_pad[: len(t)] = t
+            d_pad[: len(d)] = d
+            w_pad[: len(w)] = w
+            rt, rd, rtf, rdf, rnp = reduce_weighted_postings_jit(
+                jnp.asarray(t_pad), jnp.asarray(d_pad), jnp.asarray(w_pad),
+                vocab_size=v)
+            npairs = int(rnp)
+            num_pairs_total += npairs
+            rdf = np.asarray(rdf)
+            df += rdf
+            tids = np.nonzero(shard_of == s)[0].astype(np.int32)
+            lens = rdf[tids].astype(np.int64)
+            local_indptr = np.concatenate([[0], np.cumsum(lens)])
+            offset_of[tids] = local_indptr[:-1]
+            fmt.save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
+                           pair_doc=np.asarray(rd)[:npairs],
+                           pair_tf=np.asarray(rtf)[:npairs], df=rdf[tids])
+    report.set_counter("num_pairs", num_pairs_total)
+
+    with report.phase("dictionary"):
+        np.save(os.path.join(index_dir, fmt.DOCLEN),
+                doc_len.astype(np.int32))
+        fmt.write_dictionary(index_dir, vocab.terms, shard_of, offset_of)
+        dict_report = JobReport("BuildIntDocVectorsForwardIndex")
+        dict_report.set_counter("Dictionary.Size", v)
+        dict_report.save(os.path.join(index_dir, fmt.JOBS_DIR))
+
+    if compute_chargrams and chargram_ks and k == 1:
+        with report.phase("chargrams"):
+            build_chargram_artifacts(index_dir, vocab.terms, chargram_ks)
+
+    if not keep_spills:
+        shutil.rmtree(spill_dir, ignore_errors=True)
+
+    meta = fmt.IndexMetadata(
+        num_docs=num_docs, vocab_size=v, k=k, num_shards=num_shards,
+        num_pairs=num_pairs_total, chargram_ks=chargram_ks if k == 1 else [])
+    meta.save(index_dir)
+    report.save(os.path.join(index_dir, fmt.JOBS_DIR))
+    return meta

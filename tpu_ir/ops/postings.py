@@ -111,6 +111,48 @@ build_postings_jit = jax.jit(
     build_postings, static_argnames=("vocab_size", "num_docs"))
 
 
+def reduce_weighted_postings(term, doc, tf, *, vocab_size: int):
+    """Merge pre-aggregated (term, doc, tf) triples: sum tf over duplicate
+    (term, doc) keys, order postings (term asc, tf desc, doc asc), df per
+    term. The reducer-side half of build_postings, reusable on partial
+    results (chunk spills, all_to_all buckets). Padding: term == PAD_TERM.
+
+    Returns (pair_term, pair_doc, pair_tf, df, num_pairs)."""
+    c = term.shape[0]
+    valid = term != PAD_TERM
+    doc = jnp.where(valid, doc, 0)
+    tf = jnp.where(valid, tf, 0)
+
+    order = jnp.lexsort((doc, term))
+    t_s, d_s, w_s = term[order], doc[order], tf[order]
+    v_s = valid[order]
+
+    prev_t = jnp.concatenate([jnp.full((1,), -1, jnp.int32), t_s[:-1]])
+    prev_d = jnp.concatenate([jnp.full((1,), -1, jnp.int32), d_s[:-1]])
+    new = ((t_s != prev_t) | (d_s != prev_d)) & v_s
+    idx = jnp.cumsum(new.astype(jnp.int32)) - 1
+    num_pairs = idx[-1] + 1
+
+    scatter = jnp.where(v_s, idx, c)
+    p_term = jnp.full((c,), PAD_TERM, jnp.int32).at[
+        jnp.where(new, idx, c)].set(t_s, mode="drop")
+    p_doc = jnp.zeros((c,), jnp.int32).at[
+        jnp.where(new, idx, c)].set(d_s, mode="drop")
+    p_tf = jnp.zeros((c,), jnp.int32).at[scatter].add(w_s, mode="drop")
+
+    df = jnp.zeros((vocab_size,), jnp.int32).at[
+        jnp.where(new, t_s, vocab_size)].add(
+        jnp.ones((c,), jnp.int32), mode="drop")
+
+    order2 = jnp.lexsort((p_doc, -p_tf, p_term))
+    return (p_term[order2], p_doc[order2], p_tf[order2], df,
+            jnp.asarray(num_pairs, jnp.int32))
+
+
+reduce_weighted_postings_jit = jax.jit(
+    reduce_weighted_postings, static_argnames=("vocab_size",))
+
+
 def pack_occurrences(
     doc_term_ids: list[np.ndarray],
     docnos: np.ndarray,

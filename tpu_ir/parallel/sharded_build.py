@@ -26,7 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..ops.postings import PAD_TERM, build_postings
+from ..ops.postings import PAD_TERM, build_postings, reduce_weighted_postings
 from .mesh import SHARD_AXIS, make_mesh
 
 
@@ -99,12 +99,9 @@ def _route_and_build(term_ids, doc_ids, local_num_docs, *, num_shards: int,
     recv_doc = recv_doc.reshape(num_shards * bucket_cap)
     recv_tf = recv_tf.reshape(num_shards * bucket_cap)
 
-    # term-shard reduce: merge partial tf postings from every doc shard.
-    # build_postings sums tf per (term, doc); feeding weighted pairs needs a
-    # tf-weighted variant: replicate via segment-sum on (term,doc) keys.
-    reduced = _reduce_weighted(recv_term, recv_doc, recv_tf,
-                               vocab_size=vocab_size, total_docs=total_docs)
-    r_term, r_doc, r_tf, df, num_pairs = reduced
+    # term-shard reduce: merge partial tf postings from every doc shard
+    r_term, r_doc, r_tf, df, num_pairs = reduce_weighted_postings(
+        recv_term, recv_doc, recv_tf, vocab_size=vocab_size)
 
     # global counters over the mesh (reference MR counters / sentinel term)
     n_total = jax.lax.psum(local_num_docs, SHARD_AXIS)
@@ -112,41 +109,6 @@ def _route_and_build(term_ids, doc_ids, local_num_docs, *, num_shards: int,
 
     return (r_term[None], r_doc[None], r_tf[None], df[None],
             num_pairs[None], dropped_total[None], n_total[None])
-
-
-def _reduce_weighted(term, doc, tf, *, vocab_size: int, total_docs: int):
-    """Group (term, doc, tf) triples summing tf; postings ordered
-    (term asc, tf desc, doc asc); df per term. Same machinery as
-    ops.postings.build_postings but tf-weighted."""
-    c = term.shape[0]
-    valid = term != PAD_TERM
-    doc = jnp.where(valid, doc, 0)
-    tf = jnp.where(valid, tf, 0)
-
-    order = jnp.lexsort((doc, term))
-    t_s, d_s, w_s = term[order], doc[order], tf[order]
-    v_s = valid[order]
-
-    prev_t = jnp.concatenate([jnp.full((1,), -1, jnp.int32), t_s[:-1]])
-    prev_d = jnp.concatenate([jnp.full((1,), -1, jnp.int32), d_s[:-1]])
-    new = ((t_s != prev_t) | (d_s != prev_d)) & v_s
-    idx = jnp.cumsum(new.astype(jnp.int32)) - 1
-    num_pairs = idx[-1] + 1
-
-    scatter = jnp.where(v_s, idx, c)
-    p_term = jnp.full((c,), PAD_TERM, jnp.int32).at[
-        jnp.where(new, idx, c)].set(t_s, mode="drop")
-    p_doc = jnp.zeros((c,), jnp.int32).at[
-        jnp.where(new, idx, c)].set(d_s, mode="drop")
-    p_tf = jnp.zeros((c,), jnp.int32).at[scatter].add(w_s, mode="drop")
-
-    df = jnp.zeros((vocab_size,), jnp.int32).at[
-        jnp.where(new, t_s, vocab_size)].add(
-        jnp.ones((c,), jnp.int32), mode="drop")
-
-    order2 = jnp.lexsort((p_doc, -p_tf, p_term))
-    return (p_term[order2], p_doc[order2], p_tf[order2], df,
-            jnp.asarray(num_pairs, jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("num_shards", "vocab_size", "bucket_cap",
