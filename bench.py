@@ -50,6 +50,32 @@ def make_corpus(path: str, seed: int = 0) -> int:
     return total
 
 
+def _recall_at_10(scorer, q_ids: np.ndarray, got_docnos: np.ndarray) -> float:
+    """Exhaustive host-side TF-IDF oracle over the CSR postings."""
+    pt, pd, ptf = scorer._pairs
+    n = scorer.meta.num_docs
+    df = np.asarray(scorer.df)
+    hits = total = 0
+    for qi in range(q_ids.shape[0]):
+        scores = np.zeros(n + 1)
+        for tid in q_ids[qi]:
+            if tid < 0 or df[tid] == 0:
+                continue
+            sel = pt == tid
+            idf = np.log10(n / df[tid])
+            scores[pd[sel]] += (1.0 + np.log(ptf[sel])) * idf
+        pos = np.nonzero(scores > 0)[0]
+        if len(pos) == 0:
+            continue
+        expect = min(10, len(pos))
+        thr = np.sort(scores[pos])[::-1][expect - 1]
+        got = [int(d) for d in got_docnos[qi] if d > 0]
+        # tie-tolerant: any doc scoring >= the oracle's 10th-best counts
+        hits += sum(1 for d in got if scores[d] >= thr - 1e-9)
+        total += expect
+    return round(hits / total, 4) if total else 1.0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
@@ -95,8 +121,12 @@ def main() -> int:
         # host arrays, so completion is synchronous)
         scorer.topk(q_ids, k=10)
         t0 = time.perf_counter()
-        scorer.topk(q_ids, k=10)
+        scores, docnos = scorer.topk(q_ids, k=10)
         query_s = time.perf_counter() - t0
+
+        # recall@10 vs an exhaustive numpy oracle on a query sample
+        # (BASELINE.json: "recall@10 vs CPU reference")
+        recall = _recall_at_10(scorer, q_ids[:64], docnos[:64])
         queries_per_sec = args.queries / query_s
 
     out = {
@@ -109,6 +139,7 @@ def main() -> int:
         "corpus_docs": DOC_COUNT,
         "queries_per_sec": round(queries_per_sec, 1),
         "query_batch": args.queries,
+        "recall_at_10": recall,
         "backend": backend,
     }
     print(json.dumps(out))

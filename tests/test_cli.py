@@ -1,0 +1,100 @@
+"""CLI surface tests: index / search / inspect / verify / pack / expand."""
+
+import json
+import os
+
+import pytest
+
+from tpu_ir.cli import main
+
+DOCS = {
+    "D-01": "alpha bravo charlie delta",
+    "D-02": "alpha alpha echo foxtrot",
+    "D-03": "charlie golf hotel india bravo",
+}
+
+
+@pytest.fixture(scope="module")
+def setup(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cli")
+    corpus = tmp / "corpus.trec"
+    corpus.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in DOCS.items()))
+    index_dir = str(tmp / "index")
+    rc = main(["index", str(corpus), index_dir, "--shards", "2"])
+    assert rc == 0
+    return str(corpus), index_dir, tmp
+
+
+def test_index_and_verify(setup, capsys):
+    _, index_dir, _ = setup
+    assert main(["verify", index_dir]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["num_docs"] == 3
+
+
+def test_search_query(setup, capsys):
+    _, index_dir, _ = setup
+    assert main(["search", index_dir, "-q", "alpha"]) == 0
+    out = capsys.readouterr().out
+    assert "D-02" in out and "D-01" in out
+    # D-02 has tf=2 for alpha -> ranks first
+    assert out.index("D-02") < out.index("D-01")
+
+
+def test_search_batch_file(setup, capsys, tmp_path):
+    _, index_dir, _ = setup
+    qf = tmp_path / "queries.txt"
+    qf.write_text("alpha\ncharlie bravo\n")
+    assert main(["search", index_dir, "--queries-file", str(qf)]) == 0
+    out = capsys.readouterr().out
+    assert out.count("query:") == 2
+
+
+def test_inspect(setup, capsys):
+    _, index_dir, _ = setup
+    assert main(["inspect", index_dir, "-n", "5"]) == 0
+    out = capsys.readouterr().out
+    assert "part-0000" in out and "df=" in out
+
+
+def test_expand(setup, capsys):
+    _, index_dir, _ = setup
+    assert main(["expand", index_dir, "al*", "--chargram-k", "2"]) == 0
+    out = capsys.readouterr().out.split()
+    assert "alpha" in out
+
+
+def test_pack_roundtrip(setup, capsys, tmp_path):
+    txt = tmp_path / "lines.txt"
+    txt.write_text("first document line\nsecond line here\n")
+    trec = tmp_path / "packed.trec"
+    assert main(["pack", str(txt), str(trec), "--prefix", "L"]) == 0
+    idx = str(tmp_path / "packed_index")
+    assert main(["index", str(trec), idx, "--no-chargrams"]) == 0
+    assert main(["verify", idx]) == 0
+    out = capsys.readouterr().out
+    meta = json.loads(out.strip().splitlines()[-1])
+    assert meta["num_docs"] == 2
+
+
+def test_verify_catches_corruption(setup, tmp_path):
+    import numpy as np
+
+    from tpu_ir.index import build_index
+    from tpu_ir.index import format as fmt
+    from tpu_ir.index.verify import verify_index
+
+    corpus, _, _ = setup
+    idx = str(tmp_path / "corrupt")
+    build_index([corpus], idx, num_shards=2, compute_chargrams=False)
+    z = fmt.load_shard(idx, 0)
+    z["pair_tf"] = z["pair_tf"].copy()
+    if len(z["pair_tf"]):
+        z["pair_tf"][0] = 0  # invalid tf
+        fmt.save_shard(idx, 0, **{k: z[k] for k in
+                                  ["term_ids", "indptr", "pair_doc",
+                                   "pair_tf", "df"]})
+        with pytest.raises(AssertionError):
+            verify_index(idx)

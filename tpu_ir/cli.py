@@ -127,6 +127,29 @@ def cmd_inspect(args) -> int:
     return 0
 
 
+def cmd_verify(args) -> int:
+    from .index.verify import verify_index
+
+    print(json.dumps(verify_index(args.index_dir)))
+    return 0
+
+
+def cmd_pack(args) -> int:
+    """PackTextFile equivalent: each line of a plain text file becomes one
+    TREC <DOC> with docid PREFIX-NNNNNNN (reference
+    edu/umd/cloud9/io/PackTextFile.java packs lines into SequenceFiles)."""
+    with open(args.text_file, encoding="utf-8") as fin, \
+            open(args.output, "w", encoding="utf-8") as fout:
+        n = 0
+        for i, line in enumerate(fin):
+            line = line.rstrip("\n")
+            fout.write(f"<DOC>\n<DOCNO> {args.prefix}-{i:07d} </DOCNO>\n"
+                       f"<TEXT>\n{line}\n</TEXT>\n</DOC>\n")
+            n += 1
+    print(json.dumps({"docs_packed": n, "output": args.output}))
+    return 0
+
+
 def cmd_expand(args) -> int:
     from .search import WildcardLookup
 
@@ -184,6 +207,17 @@ def main(argv: list[str] | None = None) -> int:
                     help="max postings per term")
     _add_backend_arg(pn)
     pn.set_defaults(fn=cmd_inspect)
+
+    pv = sub.add_parser("verify", help="validate index structural invariants")
+    pv.add_argument("index_dir")
+    pv.set_defaults(fn=cmd_verify)
+
+    pp = sub.add_parser("pack", help="pack plain text into TREC format "
+                                     "(one <DOC> per input line)")
+    pp.add_argument("text_file")
+    pp.add_argument("output", help="TREC file to write")
+    pp.add_argument("--prefix", default="LINE", help="docid prefix")
+    pp.set_defaults(fn=cmd_pack)
 
     pe = sub.add_parser("expand", help="wildcard term lookup (char-k-grams)")
     pe.add_argument("index_dir")

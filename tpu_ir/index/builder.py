@@ -193,7 +193,8 @@ def build_index(
         dict_report.save(os.path.join(index_dir, fmt.JOBS_DIR))
 
     # --- char-k-gram indexes (CharKGramTermIndexer) ---
-    if compute_chargrams and chargram_ks:
+    built_chargrams = bool(compute_chargrams and chargram_ks)
+    if built_chargrams:
         with report.phase("chargrams"):
             if k == 1:
                 token_vocab = vocab
@@ -206,7 +207,8 @@ def build_index(
 
     meta = fmt.IndexMetadata(
         num_docs=num_docs, vocab_size=v, k=k, num_shards=num_shards,
-        num_pairs=num_pairs, chargram_ks=chargram_ks)
+        num_pairs=num_pairs,
+        chargram_ks=chargram_ks if built_chargrams else [])
     meta.save(index_dir)
     report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     return meta

