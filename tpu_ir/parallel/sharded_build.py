@@ -152,7 +152,11 @@ def sharded_build_postings(
             mesh=mesh, num_shards=s, vocab_size=vocab_size,
             bucket_cap=bucket_cap, total_docs=total_docs)
         result = ShardedPostings(*out)
-        if int(np.asarray(result.dropped)[0]) == 0:
+        # dropped is psum'd (identical on every shard); read an addressable
+        # shard so this also works on a multi-host mesh
+        dropped = int(np.asarray(
+            result.dropped.addressable_shards[0].data).ravel()[0])
+        if dropped == 0:
             return result
         bucket_cap = min(bucket_cap * 2, c)
         if attempt == max_retries:
