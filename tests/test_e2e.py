@@ -243,3 +243,16 @@ def test_streaming_build_equals_in_memory(tmp_path):
     s1, s2 = Scorer.load(out1), Scorer.load(out2)
     for q in ["quick fox", "salmon fishing"]:
         assert s1.search(q) == s2.search(q)
+
+
+def test_sharded_scorer_layout(index_dir):
+    """layout='sharded' (doc blocks over the 8-device mesh + global top-k
+    merge) must agree with the dense single-device layout."""
+    dense = Scorer.load(index_dir, layout="dense")
+    sharded = Scorer.load(index_dir, layout="sharded")
+    for q in ["quick fox", "salmon fishing", "honey bears river",
+              "nonexistentterm"]:
+        g1, g2 = dense.search(q), sharded.search(q)
+        assert {d for d, _ in g1} == {d for d, _ in g2}, q
+        for (_, s1), (_, s2) in zip(g1, g2):
+            assert s1 == pytest.approx(s2, rel=1e-4)

@@ -1,0 +1,90 @@
+"""End-to-end multi-host index build: 2 processes x 2 CPU devices build one
+index into a shared directory; artifacts must match a single-process build
+and produce identical search results."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+DOCS = {
+    "A-1": "alpha bravo charlie alpha",
+    "A-2": "delta echo foxtrot bravo",
+    "B-1": "alpha golf hotel india",
+    "B-2": "charlie juliet kilo lima bravo",
+    "C-1": "echo mike november oscar",
+    "C-2": "papa quebec romeo alpha charlie",
+}
+
+WORKER = r"""
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as xb
+for n in list(xb._backend_factories):
+    if n != "cpu":
+        xb._backend_factories.pop(n, None)
+
+coordinator, pid, corpus_dir, index_dir = (
+    sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4])
+from tpu_ir.parallel.multihost import init_distributed, build_index_multihost
+
+init_distributed(coordinator, num_processes=2, process_id=pid)
+meta = build_index_multihost([corpus_dir], index_dir, k=1,
+                             compute_chargrams=False)
+print(json.dumps({"pid": pid, "num_docs": meta.num_docs,
+                  "num_shards": meta.num_shards,
+                  "vocab_size": meta.vocab_size}))
+"""
+
+
+def test_multihost_build(tmp_path):
+    corpus_dir = tmp_path / "corpus"
+    corpus_dir.mkdir()
+    # several files so the round-robin slice gives each process some
+    for name in ["A", "B", "C"]:
+        (corpus_dir / f"{name}.trec").write_text("".join(
+            f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+            for d, t in DOCS.items() if d.startswith(name)))
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    index_dir = str(tmp_path / "mh_index")
+
+    env = {**os.environ, "PYTHONPATH": os.getcwd()}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), f"127.0.0.1:{port}", str(pid),
+             str(corpus_dir), index_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            cwd=os.getcwd(), text=True)
+        for pid in range(2)
+    ]
+    for p in procs:
+        out, err = p.communicate(timeout=240)
+        assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
+
+    # validate in THIS (single) process
+    from tpu_ir.index import build_index
+    from tpu_ir.index import format as fmt
+    from tpu_ir.index.verify import verify_index
+    from tpu_ir.search import Scorer
+
+    summary = verify_index(index_dir)
+    assert summary["ok"] and summary["num_docs"] == len(DOCS)
+    assert fmt.IndexMetadata.load(index_dir).num_shards == 4
+
+    ref_dir = str(tmp_path / "ref_index")
+    build_index([str(corpus_dir)], ref_dir, k=1, num_shards=4,
+                compute_chargrams=False)
+    s_mh = Scorer.load(index_dir)
+    s_ref = Scorer.load(ref_dir)
+    for q in ["alpha", "charlie bravo", "echo", "zulu"]:
+        assert s_mh.search(q) == s_ref.search(q), q

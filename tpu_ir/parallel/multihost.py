@@ -63,6 +63,177 @@ def process_file_slice(paths: Sequence[str],
     return [f for i, f in enumerate(expanded) if i % pc == pi]
 
 
+def build_index_multihost(
+    corpus_paths: Sequence[str] | str,
+    index_dir: str,
+    *,
+    k: int = 1,
+    chargram_ks: Sequence[int] = (2, 3),
+    compute_chargrams: bool = True,
+) -> "object":
+    """End-to-end multi-host index build over the global device mesh.
+
+    Every process: streams + tokenizes ITS slice of the corpus files, agrees
+    on the global docno/vocab tables host-side, feeds its devices' rows of
+    the global occurrence array, runs the shared all_to_all build program,
+    and writes the part files for its addressable term shards. Process 0
+    writes the shared side artifacts. `index_dir` must be a filesystem all
+    processes can write (the HDFS-equivalent assumption).
+
+    Single-process, this degenerates to the SPMD build over local devices.
+    """
+    import jax
+    from jax.experimental import multihost_utils
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..analysis.native import make_analyzer
+    from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
+    from ..index import format as fmt
+    from ..index.builder import build_chargram_artifacts
+    from ..ops.postings import PAD_TERM
+    from ..utils import JobReport
+    from .mesh import SHARD_AXIS, make_mesh
+    from .sharded_build import sharded_build_postings
+
+    if isinstance(corpus_paths, (str, os.PathLike)):
+        corpus_paths = [corpus_paths]
+    pi, pc = jax.process_index(), jax.process_count()
+    os.makedirs(index_dir, exist_ok=True)
+    report = JobReport("TermKGramDocIndexer", config={
+        "k": k, "multihost": True, "process": pi, "process_count": pc})
+
+    # --- map: tokenize my slice ---
+    analyzer = make_analyzer()
+    my_files = process_file_slice(corpus_paths, pi, pc)
+    my_docids: list[str] = []
+    my_doc_terms: list[list[str]] = []
+    with report.phase("tokenize"):
+        for doc in read_trec_corpus(my_files):
+            report.incr("Count.DOCS")
+            my_docids.append(doc.docid)
+            toks = analyzer.analyze(doc.content)
+            my_doc_terms.append(kgram_terms(toks, k) if k > 1 else toks)
+
+    # --- agree on global tables ---
+    with report.phase("global_tables"):
+        global_docids = allgather_strings(my_docids)
+        local_uniques = sorted({t for ts in my_doc_terms for t in ts})
+        global_terms = allgather_strings(local_uniques)
+        mapping = DocnoMapping(global_docids)
+        vocab = Vocab(global_terms)
+        num_docs = len(mapping)
+        v = len(vocab)
+        sorted_terms = np.array(global_terms, dtype=np.str_)
+        sorted_docids = np.array(global_docids, dtype=np.str_)
+
+    # --- pack my devices' rows of the global [S, C] occurrence array ---
+    n_local = jax.local_device_count()
+    s = pc * n_local
+    mesh = make_mesh(s)
+    with report.phase("pack"):
+        per_dev_terms: list[np.ndarray] = []
+        per_dev_docs: list[np.ndarray] = []
+        per_dev_ndocs = np.zeros(n_local, np.int32)
+        buckets: list[list[int]] = [[] for _ in range(n_local)]
+        for i in range(len(my_docids)):
+            buckets[i % n_local].append(i)
+        for dev, idxs in enumerate(buckets):
+            terms = [t for i in idxs for t in my_doc_terms[i]]
+            tid = np.searchsorted(sorted_terms, np.array(terms, np.str_)
+                                  ) if terms else np.zeros(0, np.int64)
+            dno = np.concatenate([
+                np.full(len(my_doc_terms[i]),
+                        np.searchsorted(sorted_docids, my_docids[i]) + 1,
+                        np.int32)
+                for i in idxs]) if idxs else np.zeros(0, np.int32)
+            per_dev_terms.append(tid.astype(np.int32))
+            per_dev_docs.append(dno)
+            per_dev_ndocs[dev] = len(idxs)
+        local_max = max((len(a) for a in per_dev_terms), default=1)
+        cap = int(multihost_utils.process_allgather(
+            np.int64(local_max)).max())
+        granule = 1 << 12
+        cap = max(granule, (cap + granule - 1) // granule * granule)
+        local_t = np.full((n_local, cap), PAD_TERM, np.int32)
+        local_d = np.zeros((n_local, cap), np.int32)
+        for dev in range(n_local):
+            n = len(per_dev_terms[dev])
+            local_t[dev, :n] = per_dev_terms[dev]
+            local_d[dev, :n] = per_dev_docs[dev]
+
+        sh2 = NamedSharding(mesh, P(SHARD_AXIS, None))
+        sh1 = NamedSharding(mesh, P(SHARD_AXIS))
+        g_t = jax.make_array_from_process_local_data(sh2, local_t, (s, cap))
+        g_d = jax.make_array_from_process_local_data(sh2, local_d, (s, cap))
+        g_n = jax.make_array_from_process_local_data(
+            sh1, per_dev_ndocs, (s,))
+
+    # --- the shared SPMD build ---
+    with report.phase("postings_device"):
+        out = sharded_build_postings(
+            g_t, g_d, g_n, vocab_size=v, total_docs=num_docs, mesh=mesh)
+
+    # --- write my shards; gather df/doc_len host-side for side artifacts ---
+    with report.phase("write_shards"):
+        local_df = np.zeros(v, np.int64)
+        for sd in out.df.addressable_shards:
+            local_df += np.asarray(sd.data).reshape(-1, v).sum(axis=0)
+        df = np.asarray(multihost_utils.process_allgather(local_df))
+        df = df.reshape(-1, v).sum(axis=0).astype(np.int32)
+
+        local_dl = np.zeros(num_docs + 1, np.int64)
+        for dev in range(n_local):
+            np.add.at(local_dl, per_dev_docs[dev], 1)
+        doc_len = np.asarray(multihost_utils.process_allgather(local_dl))
+        doc_len = doc_len.reshape(-1, num_docs + 1).sum(axis=0).astype(np.int32)
+
+        shard_of = np.arange(v, dtype=np.int32) % s
+        num_pairs_rows = {}
+        for sd in out.num_pairs.addressable_shards:
+            num_pairs_rows[sd.index[0].start] = int(
+                np.asarray(sd.data).ravel()[0])
+        doc_rows = {sd.index[0].start: np.asarray(sd.data).reshape(-1)
+                    for sd in out.pair_doc.addressable_shards}
+        tf_rows = {sd.index[0].start: np.asarray(sd.data).reshape(-1)
+                   for sd in out.pair_tf.addressable_shards}
+        offset_of = np.zeros(v, np.int64)
+        for row, npairs in num_pairs_rows.items():
+            tids = np.nonzero(shard_of == row)[0].astype(np.int32)
+            lens = df[tids].astype(np.int64)
+            local_indptr = np.concatenate([[0], np.cumsum(lens)])
+            offset_of[tids] = local_indptr[:-1]
+            fmt.save_shard(index_dir, row, term_ids=tids,
+                           indptr=local_indptr,
+                           pair_doc=doc_rows[row][:npairs],
+                           pair_tf=tf_rows[row][:npairs],
+                           df=df[tids])
+
+    # --- process 0 writes shared side artifacts ---
+    if pi == 0:
+        mapping.save(os.path.join(index_dir, fmt.DOCNOS))
+        vocab.save(os.path.join(index_dir, fmt.VOCAB))
+        np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
+        # offsets are derivable on every process (df is global): recompute all
+        all_offsets = np.zeros(v, np.int64)
+        for row in range(s):
+            tids = np.nonzero(shard_of == row)[0]
+            all_offsets[tids] = np.concatenate(
+                [[0], np.cumsum(df[tids].astype(np.int64))])[:-1]
+        fmt.write_dictionary(index_dir, vocab.terms, shard_of, all_offsets)
+        built_chargrams = bool(compute_chargrams and chargram_ks and k == 1)
+        if built_chargrams:
+            build_chargram_artifacts(index_dir, vocab.terms,
+                                     list(chargram_ks))
+        meta = fmt.IndexMetadata(
+            num_docs=num_docs, vocab_size=v, k=k, num_shards=s,
+            num_pairs=int(df.sum()),
+            chargram_ks=list(chargram_ks) if built_chargrams else [])
+        meta.save(index_dir)
+        report.save(os.path.join(index_dir, fmt.JOBS_DIR))
+    multihost_utils.sync_global_devices("tpu_ir_index_built")
+    return fmt.IndexMetadata.load(index_dir)
+
+
 def allgather_strings(local: Sequence[str]) -> list[str]:
     """Union of string sets across processes (sorted). Uses host-side
     broadcast through the jax coordination service; single-process = sorted

@@ -67,6 +67,23 @@ class Scorer:
             self.doc_matrix = dense_doc_matrix(
                 jnp.asarray(pair_term), jnp.asarray(pair_doc),
                 jnp.asarray(pair_tf), vocab_size=v, num_docs=d)
+        elif layout == "sharded":
+            # distributed serving: doc-sharded dense blocks over the mesh,
+            # per-shard top-k + global merge (parallel/sharded_scoring.py)
+            import jax
+
+            from ..parallel import make_doc_blocks, make_mesh
+
+            n_dev = len(jax.devices())
+            self._mesh = make_mesh(n_dev)
+            blocks, bases = make_doc_blocks(
+                pair_term, pair_doc, pair_tf,
+                vocab_size=v, num_docs=d, num_shards=n_dev)
+            self.doc_blocks = jax.device_put(
+                jnp.asarray(blocks),
+                jax.sharding.NamedSharding(
+                    self._mesh, jax.sharding.PartitionSpec("shards")))
+            self.doc_bases = jnp.asarray(bases)
         else:
             indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
             pcap = max(int(df.max()) if len(df) else 1, 1)
@@ -150,6 +167,12 @@ class Scorer:
                     num_docs=self.meta.num_docs)
             s, d = bm25_topk_dense(q, self._tf_matrix, self.df, self.doc_len,
                                    n, k=k)
+        elif self.layout == "sharded":
+            from ..parallel import sharded_tfidf_topk
+
+            s, d = sharded_tfidf_topk(
+                q, self.doc_blocks, self.doc_bases, self.df, n,
+                mesh=self._mesh, k=k, compat_int_idf=self.compat_int_idf)
         elif self.layout == "dense":
             s, d = tfidf_topk_dense(q, self.doc_matrix, self.df, n, k=k,
                                     compat_int_idf=self.compat_int_idf)
