@@ -1,0 +1,82 @@
+"""Compat-oracle tests: the quirk-exact reference engine vs the TPU engine in
+compat mode — documents where they match and where the engine deviates."""
+
+import math
+
+import pytest
+
+from tpu_ir.compat import DOC_COUNTER_TERM, CompatIndex
+from tpu_ir.index import build_index
+from tpu_ir.search import Scorer
+
+DOCS = {
+    "AP-1": "gold silver gold copper",
+    "AP-2": "silver iron copper tin gold",
+    "AP-3": "tin zinc lead iron",
+    "AP-4": "gold gold gold mercury",
+    "AP-5": "platinum mercury zinc silver",
+}
+
+
+@pytest.fixture(scope="module")
+def engines(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("compat")
+    corpus = tmp / "c.trec"
+    corpus.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in DOCS.items()))
+    idx = str(tmp / "idx")
+    build_index([str(corpus)], idx, compute_chargrams=False, num_shards=2)
+    return CompatIndex(DOCS), Scorer.load(idx, compat_int_idf=True)
+
+
+def test_sentinel_doc_counter(engines):
+    oracle, _ = engines
+    # the " " sentinel term's df is the corpus size (reference N channel)
+    assert oracle.df(DOC_COUNTER_TERM) == len(DOCS)
+
+
+def test_word_cap_guard(engines):
+    oracle, _ = engines
+    assert oracle.rank("gold silver copper") is None  # 3 words rejected
+    assert oracle.rank("") is None
+    assert oracle.rank("gold") is not None
+
+
+def test_int_division_idf_matches_engine(engines):
+    oracle, scorer = engines
+    for q in ["gold", "silver", "zinc mercury", "iron tin"]:
+        want = oracle.rank(q)
+        got = scorer.search(q)
+        # engine drops zero-score docs; oracle keeps them — compare the
+        # positive-score prefix
+        want_pos = [(d, s) for d, s in want if s > 0]
+        got_d = dict(got)
+        assert set(got_d) == {d for d, _ in want_pos}, q
+        for d, s in want_pos:
+            assert got_d[d] == pytest.approx(s, rel=1e-4), (q, d)
+
+
+def test_idf_zero_when_df_equals_n():
+    docs = {f"D-{i}": "common word here" for i in range(4)}
+    oracle = CompatIndex(docs)
+    ranked = oracle.rank("common")
+    # int division: N//df = 1 -> log10(1) = 0; reference still lists docs
+    assert ranked is not None and len(ranked) == 4
+    assert all(s == 0.0 for _, s in ranked)
+
+
+def test_ceil_comparator_tie_behavior():
+    """Scores within 1.0 of each other compare 'equal' under the reference
+    comparator, so insertion order survives — a documented reference quirk."""
+    oracle = CompatIndex({
+        "X-1": "apple apple banana",
+        "X-2": "apple cherry",
+        "X-3": "banana cherry",
+    })
+    ranked = oracle.rank("apple")
+    assert ranked is not None
+    scores = [s for _, s in ranked]
+    # all scores positive and within 1.0 -> order is postings (tf-desc) order
+    assert scores == sorted(scores, reverse=True) or (
+        max(scores) - min(scores) < 1.0)

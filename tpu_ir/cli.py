@@ -22,6 +22,9 @@ def _add_backend_arg(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--backend", choices=["auto", "cpu", "tpu"], default="auto",
         help="device backend; 'cpu' is the reference's local mode equivalent")
+    p.add_argument(
+        "--profile", metavar="DIR", default=None,
+        help="write a jax.profiler trace (view with tensorboard/xprof)")
 
 
 def _apply_backend(args) -> None:
@@ -31,8 +34,35 @@ def _apply_backend(args) -> None:
         os.environ.setdefault("JAX_PLATFORMS", "tpu")
 
 
+class _MaybeProfile:
+    """jax.profiler.trace wrapper (SURVEY.md §5: the JobTracker-page
+    observability niche, filled with real device traces)."""
+
+    def __init__(self, trace_dir: str | None):
+        self._dir = trace_dir
+        self._cm = None
+
+    def __enter__(self):
+        if self._dir:
+            import jax
+
+            self._cm = jax.profiler.trace(self._dir)
+            self._cm.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        if self._cm:
+            self._cm.__exit__(*exc)
+        return False
+
+
 def cmd_index(args) -> int:
     _apply_backend(args)
+    with _MaybeProfile(args.profile):
+        return _run_index(args)
+
+
+def _run_index(args) -> int:
     if args.streaming:
         from .index.streaming import build_index_streaming
 
@@ -56,6 +86,11 @@ def cmd_index(args) -> int:
 
 def cmd_search(args) -> int:
     _apply_backend(args)
+    with _MaybeProfile(args.profile):
+        return _run_search(args)
+
+
+def _run_search(args) -> int:
     from .search import Scorer
 
     scorer = Scorer.load(args.index_dir, layout=args.layout,

@@ -1,0 +1,104 @@
+"""Reference-exact oracle engine (pure Python, quirks and all).
+
+SURVEY.md §7 quirk policy: "fix in the engine, reproduce in a --compat oracle
+mode used by tests". This module is that oracle: a direct, slow, in-memory
+implementation of the reference's exact semantics, including the behaviors
+the main engine deliberately fixes:
+
+- the `" "` sentinel doc-counter term carrying N in its df
+  (TermKGramDocIndexer.java:84,126,174-183);
+- integer-division idf `log10(N / df)` with Java int semantics
+  (IntDocVectorsForwardIndex.java:211);
+- the ceil-based DocScore comparator whose ties are order-dependent
+  (DocScore.compareTo, IntDocVectorsForwardIndex.java:362-365) — reproduced
+  via Java Collections.sort's stable merge over insertion order;
+- the 1-2-word query guard (IntDocVectorsForwardIndex.java:292,297);
+- top-10 truncation.
+
+Tests compare the TPU engine against this oracle to document precisely where
+behavior matches and where it (intentionally) deviates.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Sequence
+
+from .analysis import Analyzer
+from .collection import kgram_terms
+
+DOC_COUNTER_TERM = " "
+
+
+class CompatIndex:
+    """In-memory index following the reference reducer exactly."""
+
+    def __init__(self, docs: dict[str, str], k: int = 1):
+        self._analyzer = Analyzer()
+        self.k = k
+        # docno mapping: 1-based, sorted docids
+        self.docids = sorted(docs)
+        self.docno = {d: i + 1 for i, d in enumerate(self.docids)}
+        # postings: term -> list[(docno, tf)] sorted tf desc then docno asc
+        # (stable Java sort on docno-ordered input)
+        postings: dict[str, dict[int, int]] = {}
+        for docid, text in docs.items():
+            dn = self.docno[docid]
+            toks = self._analyzer.analyze(text)
+            for term in kgram_terms(toks, k):
+                postings.setdefault(term, {}).setdefault(dn, 0)
+                postings[term][dn] += 1
+        self.postings = {
+            t: sorted(by_doc.items(), key=lambda p: (-p[1], p[0]))
+            for t, by_doc in postings.items()
+        }
+        # sentinel: df of the " " term is the corpus size
+        self.postings[DOC_COUNTER_TERM] = []
+        self.num_docs = len(docs)
+
+    def df(self, term: str) -> int:
+        if term == DOC_COUNTER_TERM:
+            return self.num_docs
+        return len(self.postings.get(term, []))
+
+    def rank(self, query: str, enforce_word_cap: bool = True
+             ) -> list[tuple[str, float]] | None:
+        """Reference rank(): returns top-10 (docid, score), or None when the
+        query fails the 1-2 word guard."""
+        q_tokens = self._analyzer.analyze(query)
+        if enforce_word_cap and not 1 <= len(q_tokens) <= 2:
+            return None
+        q_terms = kgram_terms(q_tokens, self.k)
+
+        # reference accumulation: a list of DocScore searched linearly; we
+        # keep insertion order to reproduce the stable-sort tie behavior
+        order: list[int] = []
+        scores: dict[int, float] = {}
+        for term in q_terms:
+            posts = self.postings.get(term)
+            if not posts:
+                continue
+            dfv = len(posts)
+            idf_ratio = self.num_docs // dfv  # Java int division
+            idf = math.log10(idf_ratio) if idf_ratio > 0 else float("-inf")
+            for dn, tf in posts:
+                if dn not in scores:
+                    scores[dn] = 0.0
+                    order.append(dn)
+                scores[dn] += (1.0 + math.log(tf)) * idf
+
+        # DocScore.compareTo: (int) Math.ceil(other.score - this.score) --
+        # desc by score but any pair within (-1, 0] of each other compares
+        # "equal", so Java's stable sort preserves insertion order for them.
+        import functools
+
+        def cmp(a: int, b: int) -> int:
+            return int(math.ceil(scores[b] - scores[a]))
+
+        ranked = sorted(order, key=functools.cmp_to_key(cmp))
+        return [(self.docids[dn - 1], scores[dn]) for dn in ranked[:10]]
+
+
+def compat_search(docs: dict[str, str], query: str, k: int = 1
+                  ) -> list[tuple[str, float]] | None:
+    return CompatIndex(docs, k=k).rank(query)
