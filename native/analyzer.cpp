@@ -541,3 +541,174 @@ int32_t ir_analyze(const char *text, int32_t len, char *out, int32_t out_cap) {
 const char *ir_version() { return "tpu-ir-native-1"; }
 
 }  // extern "C"
+
+// ------------------------------------------------------------ corpus API
+//
+// Whole-corpus ingestion: TREC <DOC> record splitting, docid extraction,
+// analysis, and incremental vocab construction in one pass, so Python never
+// materializes per-token strings. Temp term ids are insertion-ordered; the
+// Python side remaps them to sorted-vocab ids with one vectorized pass.
+// Non-ASCII documents are recorded as (start, end) byte ranges for the
+// Python analyzer to handle (same fallback contract as ir_analyze).
+
+#include <cstdio>
+
+namespace {
+
+struct Corpus {
+  std::vector<std::string> docids;
+  std::vector<int64_t> doc_token_counts;
+  std::vector<int32_t> token_ids;
+  std::unordered_map<std::string, int32_t> vocab;
+  std::vector<std::string> vocab_list;
+  std::unordered_map<std::string, std::string> stem_cache;
+  // per skipped doc: (file_index, start, end) byte range
+  std::vector<int64_t> nonascii;
+  std::vector<std::string> files;
+
+  int32_t term_id(const std::string &stemmed) {
+    auto it = vocab.find(stemmed);
+    if (it != vocab.end()) return it->second;
+    int32_t id = (int32_t)vocab_list.size();
+    vocab.emplace(stemmed, id);
+    vocab_list.push_back(stemmed);
+    return id;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void *ir_corpus_new() { return new Corpus(); }
+
+void ir_corpus_free(void *h) { delete (Corpus *)h; }
+
+// Returns docs added, or -1 on IO error. Gzip files are NOT handled here
+// (the Python wrapper routes them to the pure-Python reader).
+int64_t ir_corpus_add_file(void *h, const char *path) {
+  Corpus *c = (Corpus *)h;
+  FILE *f = fopen(path, "rb");
+  if (!f) return -1;
+  fseek(f, 0, SEEK_END);
+  long fsize = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  std::string data(fsize, '\0');
+  if (fsize && fread(&data[0], 1, fsize, f) != (size_t)fsize) {
+    fclose(f);
+    return -1;
+  }
+  fclose(f);
+  int64_t file_idx = (int64_t)c->files.size();
+  c->files.emplace_back(path);
+
+  int64_t added = 0;
+  size_t pos = 0;
+  while (true) {
+    const char *start = (const char *)memmem(data.data() + pos,
+                                             data.size() - pos, "<DOC>", 5);
+    if (!start) break;
+    size_t s_off = start - data.data();
+    const char *end = (const char *)memmem(data.data() + s_off + 5,
+                                           data.size() - s_off - 5,
+                                           "</DOC>", 6);
+    if (!end) break;
+    size_t e_off = end - data.data() + 6;
+
+    // docid between <DOCNO> and </DOCNO>, trimmed
+    const char *dn = (const char *)memmem(data.data() + s_off, e_off - s_off,
+                                          "<DOCNO>", 7);
+    std::string docid;
+    if (dn) {
+      const char *dne = (const char *)memmem(dn + 7,
+                                             data.data() + e_off - dn - 7,
+                                             "</DOCNO>", 8);
+      if (dne) {
+        const char *b = dn + 7;
+        const char *e2 = dne;
+        while (b < e2 && (unsigned char)*b <= ' ') ++b;
+        while (e2 > b && (unsigned char)e2[-1] <= ' ') --e2;
+        docid.assign(b, e2);
+      }
+    }
+
+    bool ascii = true;
+    for (size_t i = s_off; i < e_off; ++i)
+      if ((unsigned char)data[i] >= 0x80) { ascii = false; break; }
+
+    if (!ascii || docid.empty()) {
+      c->nonascii.push_back(file_idx);
+      c->nonascii.push_back((int64_t)s_off);
+      c->nonascii.push_back((int64_t)e_off);
+    } else {
+      Tokenizer tk;
+      tk.text = data.data() + s_off;
+      tk.n = (int32_t)(e_off - s_off);
+      tk.run();
+      int64_t count = 0;
+      for (const std::string &tok : tk.tokens) {
+        if (g_stopwords.count(tok)) continue;
+        std::string stemmed;
+        auto it = c->stem_cache.find(tok);
+        if (it != c->stem_cache.end()) {
+          stemmed = it->second;
+        } else {
+          stemmed = porter2(tok);
+          c->stem_cache.emplace(tok, stemmed);
+          if (c->stem_cache.size() > 50000) c->stem_cache.clear();
+        }
+        c->token_ids.push_back(c->term_id(stemmed));
+        ++count;
+      }
+      c->docids.push_back(docid);
+      c->doc_token_counts.push_back(count);
+      ++added;
+    }
+    pos = e_off;
+  }
+  return added;
+}
+
+// out8: n_docs, n_tokens, vocab_size, docids_blob_bytes, vocab_blob_bytes,
+//       n_nonascii_triples, 0, 0
+void ir_corpus_stats(void *h, int64_t *out8) {
+  Corpus *c = (Corpus *)h;
+  int64_t docid_bytes = 0, vocab_bytes = 0;
+  for (auto &s : c->docids) docid_bytes += (int64_t)s.size() + 1;
+  for (auto &s : c->vocab_list) vocab_bytes += (int64_t)s.size() + 1;
+  out8[0] = (int64_t)c->docids.size();
+  out8[1] = (int64_t)c->token_ids.size();
+  out8[2] = (int64_t)c->vocab_list.size();
+  out8[3] = docid_bytes;
+  out8[4] = vocab_bytes;
+  out8[5] = (int64_t)(c->nonascii.size() / 3);
+  out8[6] = 0;
+  out8[7] = 0;
+}
+
+// Caller allocates per ir_corpus_stats sizes. Blobs are '\n'-joined.
+void ir_corpus_export(void *h, int32_t *ids, int64_t *doc_lens,
+                      char *docids_blob, char *vocab_blob,
+                      int64_t *nonascii_out) {
+  Corpus *c = (Corpus *)h;
+  memcpy(ids, c->token_ids.data(), c->token_ids.size() * sizeof(int32_t));
+  memcpy(doc_lens, c->doc_token_counts.data(),
+         c->doc_token_counts.size() * sizeof(int64_t));
+  char *p = docids_blob;
+  for (auto &s : c->docids) {
+    memcpy(p, s.data(), s.size());
+    p += s.size();
+    *p++ = '\n';
+  }
+  p = vocab_blob;
+  for (auto &s : c->vocab_list) {
+    memcpy(p, s.data(), s.size());
+    p += s.size();
+    *p++ = '\n';
+  }
+  if (!c->nonascii.empty())
+    memcpy(nonascii_out, c->nonascii.data(),
+           c->nonascii.size() * sizeof(int64_t));
+}
+
+}  // extern "C"

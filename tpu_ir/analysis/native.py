@@ -97,6 +97,102 @@ class NativeAnalyzer:
         return self._buf.raw[: n - 1].decode("ascii").split("\n") if n > 1 else []
 
 
+def tokenize_corpus_native(paths):
+    """Whole-corpus ingestion through the C++ pipeline.
+
+    Returns (docids, flat_temp_ids int32, doc_lens int64, vocab_list) where
+    temp ids are insertion-ordered (caller remaps to sorted ids), or None if
+    the native library is unavailable. Gzip files and non-ASCII/malformed
+    documents are routed through the Python pipeline and merged in.
+    """
+    import numpy as np
+
+    lib = load_native()
+    if lib is None:
+        return None
+    if not hasattr(lib, "ir_corpus_new"):
+        return None
+    lib.ir_corpus_new.restype = ctypes.c_void_p
+    lib.ir_corpus_add_file.restype = ctypes.c_int64
+    lib.ir_corpus_add_file.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+    lib.ir_corpus_stats.argtypes = [ctypes.c_void_p,
+                                    ctypes.POINTER(ctypes.c_int64)]
+    lib.ir_corpus_free.argtypes = [ctypes.c_void_p]
+
+    # expand dirs; split gz files out for the python reader
+    files: list[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            files.extend(os.path.join(p, n) for n in sorted(os.listdir(p))
+                         if os.path.isfile(os.path.join(p, n)))
+        else:
+            files.append(p)
+    native_files, py_files = [], []
+    for f in files:
+        with open(f, "rb") as fh:
+            magic = fh.read(2)
+        (py_files if magic == b"\x1f\x8b" else native_files).append(f)
+
+    h = lib.ir_corpus_new()
+    try:
+        for f in native_files:
+            if lib.ir_corpus_add_file(h, f.encode()) < 0:
+                raise OSError(f"native reader failed on {f}")
+        stats = (ctypes.c_int64 * 8)()
+        lib.ir_corpus_stats(h, stats)
+        n_docs, n_tokens, v, docid_b, vocab_b, n_skip = stats[:6]
+
+        ids = np.empty(n_tokens, np.int32)
+        doc_lens = np.empty(n_docs, np.int64)
+        docid_buf = ctypes.create_string_buffer(max(int(docid_b), 1))
+        vocab_buf = ctypes.create_string_buffer(max(int(vocab_b), 1))
+        skip_buf = (ctypes.c_int64 * max(int(n_skip) * 3, 1))()
+        lib.ir_corpus_export(
+            ctypes.c_void_p(h),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            doc_lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            docid_buf, vocab_buf, skip_buf)
+        docids = (docid_buf.raw[: int(docid_b)].decode("utf-8")
+                  .split("\n")[:-1] if docid_b else [])
+        vocab_list = (vocab_buf.raw[: int(vocab_b)].decode("utf-8")
+                      .split("\n")[:-1] if vocab_b else [])
+
+        # python fallback for skipped (non-ascii/no-docid) records + gz files
+        extra_docs: list[tuple[str, list[str]]] = []
+        py = Analyzer()
+        from ..collection.trec import TrecDocument, read_trec_file
+
+        for i in range(int(n_skip)):
+            fi, lo, hi = skip_buf[3 * i: 3 * i + 3]
+            with open(native_files[fi], "rb") as fh:
+                fh.seek(lo)
+                raw = fh.read(hi - lo).decode("utf-8", "replace")
+            doc = TrecDocument(lo, raw)
+            extra_docs.append((doc.docid, py.analyze(doc.content)))
+        for f in py_files:
+            for doc in read_trec_file(f):
+                extra_docs.append((doc.docid, py.analyze(doc.content)))
+
+        if extra_docs:
+            vocab_index = {t: i for i, t in enumerate(vocab_list)}
+            extra_ids: list[int] = []
+            for docid, toks in extra_docs:
+                docids.append(docid)
+                for t in toks:
+                    tid = vocab_index.get(t)
+                    if tid is None:
+                        tid = len(vocab_list)
+                        vocab_index[t] = tid
+                        vocab_list.append(t)
+                    extra_ids.append(tid)
+                doc_lens = np.append(doc_lens, np.int64(len(toks)))
+            ids = np.concatenate([ids, np.array(extra_ids, np.int32)])
+        return docids, ids, doc_lens, vocab_list
+    finally:
+        lib.ir_corpus_free(ctypes.c_void_p(h))
+
+
 def make_analyzer(native: bool = True):
     """Factory: NativeAnalyzer when requested and available, else Analyzer."""
     if native:

@@ -83,10 +83,52 @@ def build_index(
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "num_shards": num_shards, "chargram_ks": chargram_ks})
 
-    docids, doc_tokens = _analyze_corpus(corpus_paths, k, report)
-    num_docs = len(docids)
-    if num_docs == 0:
-        raise ValueError(f"no <DOC> records found in {corpus_paths}")
+    # --- tokenize + vocab + term-id assignment ---
+    # fast path (k == 1): the whole corpus pass — TREC splitting, analysis,
+    # incremental vocab — runs in C++; Python only remaps temp ids to
+    # sorted-vocab ids with two vectorized passes.
+    native_corpus = None
+    if k == 1:
+        with report.phase("tokenize"):
+            from ..analysis.native import tokenize_corpus_native
+
+            native_corpus = tokenize_corpus_native(corpus_paths)
+    doc_tokens: list[list[str]] = []
+    if native_corpus is not None:
+        docids, temp_ids, lengths, vocab_list = native_corpus
+        report.set_counter("Count.DOCS", len(docids))
+        num_docs = len(docids)
+        if num_docs == 0:
+            raise ValueError(f"no <DOC> records found in {corpus_paths}")
+        with report.phase("vocab"):
+            vocab_arr = np.array(vocab_list, dtype=np.str_)
+            order = np.argsort(vocab_arr)
+            rank = np.empty(len(order), np.int64)
+            rank[order] = np.arange(len(order))
+            vocab = Vocab(vocab_arr[order].tolist())
+            inverse = rank[temp_ids]
+    else:
+        docids, doc_tokens = _analyze_corpus(corpus_paths, k, report)
+        num_docs = len(docids)
+        if num_docs == 0:
+            raise ValueError(f"no <DOC> records found in {corpus_paths}")
+        # np.unique = one C-speed sort doubles as both the vocab build and
+        # the term-id assignment
+        with report.phase("vocab"):
+            doc_kgrams = (doc_tokens if k == 1 else
+                          [kgram_terms(toks, k) for toks in doc_tokens])
+            lengths = np.fromiter((len(g) for g in doc_kgrams), np.int64,
+                                  len(doc_kgrams))
+            flat_terms = np.array(
+                [t for grams in doc_kgrams for t in grams], dtype=np.str_)
+            uniques, inverse = np.unique(flat_terms, return_inverse=True)
+            vocab = Vocab(uniques.tolist())
+
+    vocab.save(os.path.join(index_dir, fmt.VOCAB))
+    v = len(vocab)
+    occurrences = int(len(inverse))
+    report.set_counter("map_output_records", occurrences)
+    report.set_counter("reduce_output_groups", v)
 
     # --- docno mapping (NumberTrecDocuments equivalent) ---
     with report.phase("docno_mapping"):
@@ -94,24 +136,10 @@ def build_index(
         if len(mapping) != num_docs:
             raise ValueError("duplicate docids in corpus")
         mapping.save(os.path.join(index_dir, fmt.DOCNOS))
-        docnos = np.array([mapping.get_docno(d) for d in docids], np.int32)
-
-    # --- vocab over k-gram terms (np.unique = one C-speed sort doubles as
-    # both the vocab build and the term-id assignment) ---
-    with report.phase("vocab"):
-        doc_kgrams = (doc_tokens if k == 1 else
-                      [kgram_terms(toks, k) for toks in doc_tokens])
-        lengths = np.fromiter((len(g) for g in doc_kgrams), np.int64,
-                              len(doc_kgrams))
-        flat_terms = np.array(
-            [t for grams in doc_kgrams for t in grams], dtype=np.str_)
-        uniques, inverse = np.unique(flat_terms, return_inverse=True)
-        vocab = Vocab(uniques.tolist())
-        vocab.save(os.path.join(index_dir, fmt.VOCAB))
-        v = len(vocab)
-        occurrences = int(len(flat_terms))
-        report.set_counter("map_output_records", occurrences)
-        report.set_counter("reduce_output_groups", v)
+        sorted_docids = np.array(mapping.docids, dtype=np.str_)
+        docnos = (np.searchsorted(sorted_docids,
+                                  np.array(docids, dtype=np.str_))
+                  + 1).astype(np.int32)
 
     flat_term_ids = inverse.astype(np.int32)
     flat_doc_ids = np.repeat(docnos, lengths).astype(np.int32)
