@@ -98,3 +98,11 @@ def test_verify_catches_corruption(setup, tmp_path):
                                    "pair_tf", "df"]})
         with pytest.raises(AssertionError):
             verify_index(idx)
+
+
+def test_count(setup, capsys):
+    corpus, _, _ = setup
+    assert main(["count", corpus]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["Count.DOCS"] == 3
+    assert out["min_docid"] == "D-01" and out["max_docid"] == "D-03"

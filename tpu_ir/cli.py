@@ -185,6 +185,24 @@ def cmd_pack(args) -> int:
     return 0
 
 
+def cmd_count(args) -> int:
+    """DemoCountTrecDocuments equivalent: stream a corpus, count docs, report
+    docid range (reference sa/edu/kaust/indexing/DemoCountTrecDocuments.java
+    maps (docid, docno) and keeps the max)."""
+    from .collection import read_trec_corpus
+
+    n = 0
+    first = last = None
+    for doc in read_trec_corpus(args.corpus):
+        d = doc.docid
+        first = d if first is None or d < first else first
+        last = d if last is None or d > last else last
+        n += 1
+    print(json.dumps({"Count.DOCS": n, "min_docid": first,
+                      "max_docid": last}))
+    return 0
+
+
 def cmd_expand(args) -> int:
     from .search import WildcardLookup
 
@@ -256,6 +274,10 @@ def main(argv: list[str] | None = None) -> int:
     pp.add_argument("output", help="TREC file to write")
     pp.add_argument("--prefix", default="LINE", help="docid prefix")
     pp.set_defaults(fn=cmd_pack)
+
+    pc = sub.add_parser("count", help="count documents in a corpus")
+    pc.add_argument("corpus", nargs="+")
+    pc.set_defaults(fn=cmd_count)
 
     pe = sub.add_parser("expand", help="wildcard term lookup (char-k-grams)")
     pe.add_argument("index_dir")
