@@ -81,7 +81,16 @@ def main() -> int:
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU backend (local-mode equivalent)")
     ap.add_argument("--queries", type=int, default=10_000)
+    ap.add_argument("--config", choices=["ref", "wiki100k"], default="ref",
+                    help="ref = reference-scale corpus (8,761 docs / 23 MB); "
+                         "wiki100k = 100k docs / ~270 MB, streaming build")
     args = ap.parse_args()
+
+    global DOC_COUNT, TARGET_BYTES, VOCAB_SIZE
+    streaming = False
+    if args.config == "wiki100k":
+        DOC_COUNT, TARGET_BYTES, VOCAB_SIZE = 100_000, 270_000_000, 200_000
+        streaming = True
 
     if args.cpu:
         import jax
@@ -107,12 +116,18 @@ def main() -> int:
         # warm-up build on a slice to compile the device programs, then the
         # timed full build (compile caches persist; artifact writes included)
         t0 = time.perf_counter()
-        build_index([corpus], index_dir, k=1, chargram_ks=[2, 3],
-                    num_shards=10)
+        if streaming:
+            from tpu_ir.index.streaming import build_index_streaming
+
+            build_index_streaming([corpus], index_dir, k=1,
+                                  chargram_ks=[2, 3], num_shards=10)
+        else:
+            build_index([corpus], index_dir, k=1, chargram_ks=[2, 3],
+                        num_shards=10)
         build_s = time.perf_counter() - t0
         docs_per_sec = DOC_COUNT / build_s
 
-        scorer = Scorer.load(index_dir, layout="dense")
+        scorer = Scorer.load(index_dir, layout="auto")
         rng = np.random.default_rng(1)
         v = scorer.meta.vocab_size
         q_ids = rng.integers(0, v, size=(args.queries, 2)).astype(np.int32)
@@ -126,7 +141,8 @@ def main() -> int:
 
         # recall@10 vs an exhaustive numpy oracle on a query sample
         # (BASELINE.json: "recall@10 vs CPU reference")
-        recall = _recall_at_10(scorer, q_ids[:64], docnos[:64])
+        sample = 64 if args.config == "ref" else 8
+        recall = _recall_at_10(scorer, q_ids[:sample], docnos[:sample])
         queries_per_sec = args.queries / query_s
 
     out = {
@@ -141,6 +157,7 @@ def main() -> int:
         "query_batch": args.queries,
         "recall_at_10": recall,
         "backend": backend,
+        "config": args.config,
     }
     print(json.dumps(out))
     return 0

@@ -256,3 +256,15 @@ def test_sharded_scorer_layout(index_dir):
         assert {d for d, _ in g1} == {d for d, _ in g2}, q
         for (_, s1), (_, s2) in zip(g1, g2):
             assert s1 == pytest.approx(s2, rel=1e-4)
+
+
+def test_query_blocking_matches_unblocked(index_dir):
+    """Blocked query dispatch (tiny SCORE_BUDGET) must equal one-shot."""
+    s1 = Scorer.load(index_dir)
+    s2 = Scorer.load(index_dir)
+    s2.SCORE_BUDGET = 30  # forces block size ~3 for the 8-doc corpus
+    queries = ["quick fox", "brown", "salmon fishing", "river", "honey",
+               "investors assets", "lazy dog"]
+    r1 = s1.search_batch(queries)
+    r2 = s2.search_batch(queries)
+    assert r1 == r2

@@ -150,10 +150,29 @@ class Scorer:
             out[i, : min(len(r), cap)] = r[:cap]
         return out
 
+    # max elements of the [B_block, D+1] score accumulator per dispatch
+    SCORE_BUDGET = 250_000_000
+
     def topk(
         self, q_terms: np.ndarray, k: int = 10, scoring: str = "tfidf"
     ) -> tuple[np.ndarray, np.ndarray]:
-        """Score an id batch. Returns (scores [B,k], docnos [B,k], 0=empty)."""
+        """Score an id batch. Returns (scores [B,k], docnos [B,k], 0=empty).
+
+        Large batches are scored in query blocks so the per-dispatch score
+        accumulator stays within SCORE_BUDGET elements regardless of corpus
+        size (the reference had no batching at all; SURVEY.md §3.3)."""
+        b = q_terms.shape[0]
+        block = max(1, self.SCORE_BUDGET // (self.meta.num_docs + 1))
+        if b > block:
+            # pad to a whole number of blocks so every dispatch reuses one
+            # compiled shape; padding rows are all-PAD queries
+            padded = (b + block - 1) // block * block
+            qp = np.full((padded, q_terms.shape[1]), -1, np.int32)
+            qp[:b] = q_terms
+            parts = [self.topk(qp[i : i + block], k=k, scoring=scoring)
+                     for i in range(0, padded, block)]
+            return (np.concatenate([p[0] for p in parts])[:b],
+                    np.concatenate([p[1] for p in parts])[:b])
         q = jnp.asarray(q_terms)
         n = jnp.int32(self.meta.num_docs)
         if scoring == "bm25":
