@@ -38,11 +38,16 @@ def make_corpus(path: str, seed: int = 0) -> int:
     zipf_p /= zipf_p.sum()
 
     avg_doc_words = TARGET_BYTES // DOC_COUNT // 8  # ~8 bytes/word incl space
+    n_words_per_doc = rng.integers(avg_doc_words // 2,
+                                   avg_doc_words * 3 // 2, DOC_COUNT)
+    all_ids = rng.choice(VOCAB_SIZE, int(n_words_per_doc.sum()), p=zipf_p)
     total = 0
+    pos = 0
     with open(path, "w") as f:
         for i in range(DOC_COUNT):
-            n_words = int(rng.integers(avg_doc_words // 2, avg_doc_words * 3 // 2))
-            body = " ".join(rng.choice(words, n_words, p=zipf_p))
+            n = int(n_words_per_doc[i])
+            body = " ".join(words[all_ids[pos : pos + n]])
+            pos += n
             rec = (f"<DOC>\n<DOCNO> SYN-{i:06d} </DOCNO>\n<TEXT>\n{body}\n"
                    f"</TEXT>\n</DOC>\n")
             f.write(rec)

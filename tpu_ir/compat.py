@@ -22,7 +22,7 @@ behavior matches and where it (intentionally) deviates.
 from __future__ import annotations
 
 import math
-from typing import Sequence
+
 
 from .analysis import Analyzer
 from .collection import kgram_terms

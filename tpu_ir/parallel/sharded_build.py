@@ -24,7 +24,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..ops.postings import PAD_TERM, build_postings, reduce_weighted_postings
 from .mesh import SHARD_AXIS, make_mesh
@@ -56,8 +56,6 @@ def _route_and_build(term_ids, doc_ids, local_num_docs, *, num_shards: int,
     doc_ids = doc_ids.reshape(-1)
     local_num_docs = local_num_docs.reshape(())
     c = term_ids.shape[0]
-    valid = term_ids != PAD_TERM
-    dest = jnp.where(valid, term_ids % num_shards, num_shards)
 
     # combiner: pre-group local (term, doc) pairs so each unique pair crosses
     # the interconnect once with an aggregated tf (reference combiner=reducer,

@@ -20,7 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from .mesh import SHARD_AXIS, make_mesh
+from .mesh import SHARD_AXIS
 
 
 def _score_local(q_terms, q_idf, doc_matrix, doc_base, *, k: int):
