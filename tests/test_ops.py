@@ -217,3 +217,45 @@ def test_compat_int_idf():
     got = [float(x) for x in np.asarray(s)[0] if x > 0]
     for g, (w, _) in zip(got, want):
         assert g == pytest.approx(w, rel=1e-4)
+
+
+def test_tfidf_hybrid_matches_dense():
+    """Hot/cold split layout must equal the dense path regardless of where
+    the df threshold lands."""
+    from tpu_ir.ops.scoring import tfidf_topk_hybrid
+
+    p, oracle, vocab, ndocs = _small_index()
+    mat = dense_doc_matrix(p.pair_term, p.pair_doc, p.pair_tf,
+                           vocab_size=vocab, num_docs=ndocs)
+    indptr = np.asarray(p.indptr)
+    df = np.asarray(p.df)
+    pd_, pt_ = np.asarray(p.pair_doc), np.asarray(p.pair_tf)
+
+    for threshold in [0, 3, 10**9]:  # all-hot, mixed, all-cold
+        hot_tids = np.nonzero(df > threshold)[0]
+        hot_rank = np.full(vocab, -1, np.int32)
+        hot_rank[hot_tids] = np.arange(len(hot_tids), dtype=np.int32)
+        hot_rows = np.zeros((max(len(hot_tids), 1), ndocs + 1), np.float32)
+        for r, tid in enumerate(hot_tids):
+            lo, hi = indptr[tid], indptr[tid + 1]
+            hot_rows[r, pd_[lo:hi]] = 1.0 + np.log(pt_[lo:hi])
+        pcap = max(int(df[hot_rank < 0].max()) if (hot_rank < 0).any() else 1, 1)
+        post_docs = np.zeros((vocab, pcap), np.int32)
+        post_tfs = np.zeros((vocab, pcap), np.int32)
+        for tid in range(vocab):
+            if hot_rank[tid] >= 0:
+                continue
+            lo, hi = indptr[tid], indptr[tid + 1]
+            post_docs[tid, : hi - lo] = pd_[lo:hi]
+            post_tfs[tid, : hi - lo] = pt_[lo:hi]
+
+        queries = np.array([[0, 5, 199], [3, -1, -1], [11, 2, 7]], np.int32)
+        s1, d1 = tfidf_topk_dense(jnp.asarray(queries), mat, p.df,
+                                  jnp.int32(ndocs), k=5)
+        s2, d2 = tfidf_topk_hybrid(
+            jnp.asarray(queries), jnp.asarray(hot_rank),
+            jnp.asarray(hot_rows), jnp.asarray(post_docs),
+            jnp.asarray(post_tfs), p.df, jnp.int32(ndocs),
+            num_docs=ndocs, k=5)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                                   rtol=1e-4, err_msg=str(threshold))

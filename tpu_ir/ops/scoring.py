@@ -140,6 +140,64 @@ def bm25_topk_dense(
 
 
 @partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
+def tfidf_topk_hybrid(
+    q_terms: jax.Array,        # int32 [B, L]
+    hot_rank: jax.Array,       # int32 [V]: row in hot_rows, or -1 (cold)
+    hot_rows: jax.Array,       # f32 [H, D+1] dense (1+ln tf) rows, hot terms
+    post_docs: jax.Array,      # int32 [V, P] cold-term padded postings
+    post_tfs: jax.Array,       # int32 [V, P] (all-zero rows for hot terms)
+    df: jax.Array,             # int32 [V]
+    n_scalar: jax.Array,       # int32 scalar (N)
+    *,
+    num_docs: int,
+    k: int = 10,
+    compat_int_idf: bool = False,
+) -> tuple[jax.Array, jax.Array]:
+    """Sparse scoring with a dense strip for high-df terms.
+
+    The pure padded layout pays V*P_max memory where P_max is the LARGEST
+    df; here terms with df > P_cap live as dense doc-axis rows (bounded by
+    H*(D+1)) and the padded layout only covers the cold tail — the classic
+    hot/cold split, so one stop-word-like term cannot inflate every row."""
+    dff = df.astype(jnp.float32)
+    if compat_int_idf:
+        n = jnp.asarray(n_scalar, jnp.int32)
+        ratio = (n // jnp.maximum(df, 1)).astype(jnp.float32)
+    else:
+        ratio = jnp.asarray(n_scalar, jnp.float32) / jnp.maximum(dff, 1.0)
+    idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
+
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)            # [B, L]
+    q_valid = q_terms >= 0
+    q_idf = idf[safe_q] * q_valid                            # [B, L]
+    rank = hot_rank[safe_q]                                  # [B, L]
+    is_hot = (rank >= 0) & q_valid
+
+    # hot contribution: dense row gather + weighted sum
+    hot_gather = hot_rows[jnp.where(is_hot, rank, 0)]        # [B, L, D+1]
+    scores = jnp.einsum("bld,bl->bd", hot_gather,
+                        jnp.where(is_hot, q_idf, 0.0))       # [B, D+1]
+
+    # cold contribution: scatter-add the padded postings
+    docs = post_docs[safe_q]                                 # [B, L, P]
+    tfs = post_tfs[safe_q].astype(jnp.float32)
+    w = jnp.where(tfs > 0, 1.0 + jnp.log(jnp.maximum(tfs, 1.0)), 0.0)
+    cold_mask = (q_valid & ~is_hot)[..., None]
+    w = w * q_idf[..., None] * cold_mask
+    slot = jnp.where((tfs > 0) & cold_mask, docs, num_docs + 1)
+
+    def add_cold(acc_q, slots_q, w_q):
+        return acc_q.at[slots_q.ravel()].add(w_q.ravel(), mode="drop")
+
+    scores = jax.vmap(add_cold)(scores, slot, w)
+    scores = scores.at[:, 0].set(-jnp.inf)
+    top_scores, top_idx = jax.lax.top_k(scores, min(k, scores.shape[-1]))
+    matched = top_scores > 0.0
+    return (jnp.where(matched, top_scores, 0.0),
+            jnp.where(matched, top_idx, 0).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
 def tfidf_topk_sparse(
     q_terms: jax.Array,        # int32 [B, L]
     post_docs: jax.Array,      # int32 [V, P] padded per-term postings (docnos)

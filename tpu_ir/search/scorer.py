@@ -85,14 +85,30 @@ class Scorer:
                     self._mesh, jax.sharding.PartitionSpec("shards")))
             self.doc_bases = jnp.asarray(bases)
         else:
+            # hybrid sparse: terms with df above the 99th percentile become
+            # dense doc-axis rows; the padded layout covers the cold tail
             indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
-            pcap = max(int(df.max()) if len(df) else 1, 1)
+            nonzero_df = df[df > 0]
+            pcap = max(int(np.percentile(nonzero_df, 99))
+                       if len(nonzero_df) else 1, 1)
+            hot_tids = np.nonzero(df > pcap)[0]
+            hot_rank = np.full(v, -1, np.int32)
+            hot_rank[hot_tids] = np.arange(len(hot_tids), dtype=np.int32)
+            hot_rows = np.zeros((max(len(hot_tids), 1), d + 1), np.float32)
+            for r, tid in enumerate(hot_tids):
+                lo, hi = indptr[tid], indptr[tid + 1]
+                hot_rows[r, pair_doc[lo:hi]] = \
+                    1.0 + np.log(pair_tf[lo:hi])
             post_docs = np.zeros((v, pcap), np.int32)
             post_tfs = np.zeros((v, pcap), np.int32)
             for tid in range(v):
+                if hot_rank[tid] >= 0:
+                    continue
                 lo, hi = indptr[tid], indptr[tid + 1]
                 post_docs[tid, : hi - lo] = pair_doc[lo:hi]
                 post_tfs[tid, : hi - lo] = pair_tf[lo:hi]
+            self.hot_rank = jnp.asarray(hot_rank)
+            self.hot_rows = jnp.asarray(hot_rows)
             self.post_docs = jnp.asarray(post_docs)
             self.post_tfs = jnp.asarray(post_tfs)
 
@@ -196,9 +212,12 @@ class Scorer:
             s, d = tfidf_topk_dense(q, self.doc_matrix, self.df, n, k=k,
                                     compat_int_idf=self.compat_int_idf)
         else:
-            s, d = tfidf_topk_sparse(q, self.post_docs, self.post_tfs,
-                                     self.df, n, num_docs=self.meta.num_docs,
-                                     k=k, compat_int_idf=self.compat_int_idf)
+            from ..ops.scoring import tfidf_topk_hybrid
+
+            s, d = tfidf_topk_hybrid(
+                q, self.hot_rank, self.hot_rows, self.post_docs,
+                self.post_tfs, self.df, n, num_docs=self.meta.num_docs,
+                k=k, compat_int_idf=self.compat_int_idf)
         return np.asarray(s), np.asarray(d)
 
     def search_batch(
