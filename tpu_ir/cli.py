@@ -28,10 +28,28 @@ def _add_backend_arg(p: argparse.ArgumentParser) -> None:
 
 
 def _apply_backend(args) -> None:
-    if args.backend == "cpu":
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    elif args.backend == "tpu":
-        os.environ.setdefault("JAX_PLATFORMS", "tpu")
+    if args.backend == "auto":
+        return
+    # hard-pin: the environment may pre-set JAX_PLATFORMS (and a PJRT plugin
+    # may have force-updated jax.config at interpreter start), so setting the
+    # env var alone is not enough. Factories stay registered (the pin is the
+    # platform list, so a later in-process call can still pick another
+    # backend); already-initialized backends are cleared so the pin takes.
+    import jax
+    import jax._src.xla_bridge as xb
+
+    target = args.backend
+    if target == "tpu" and "tpu" not in xb._backend_factories:
+        # the TPU may be exposed under a plugin name (e.g. "axon")
+        others = [n for n in xb._backend_factories if n != "cpu"]
+        if others:
+            target = others[0]
+    os.environ["JAX_PLATFORMS"] = target
+    jax.config.update("jax_platforms", target)
+    if xb.backends_are_initialized():
+        from jax.extend.backend import clear_backends
+
+        clear_backends()
 
 
 class _MaybeProfile:
@@ -138,7 +156,7 @@ def _run_search(args) -> int:
 
 
 def cmd_inspect(args) -> int:
-    _apply_backend(args)
+    # artifact reading only — no jax backend needed
     from .collection import Vocab
     from .index import format as fmt
 
