@@ -116,11 +116,20 @@ def _run_search(args) -> int:
     show_docids = not args.docnos
 
     def run_batch(queries: list[str]) -> None:
-        results = scorer.search_batch(
-            queries, k=args.k, scoring=args.scoring,
-            return_docids=show_docids)
-        for q, res in zip(queries, results):
+        # reference guard: only 1-2 word queries
+        # (IntDocVectorsForwardIndex.java:292,297)
+        skipped = ({q for q in queries if len(q.split()) > 2}
+                   if args.compat else set())
+        kept = [q for q in queries if q not in skipped]
+        results = iter(scorer.search_batch(
+            kept, k=args.k, scoring=args.scoring,
+            return_docids=show_docids) if kept else [])
+        for q in queries:
             print(f"query: {q}")
+            if q in skipped:
+                print("  (compat mode: queries are limited to 1-2 words)")
+                continue
+            res = next(results)
             if not res:
                 print("  (no matching documents)")
             for rank, (key, score) in enumerate(res, 1):
@@ -147,10 +156,6 @@ def _run_search(args) -> int:
                 continue
             if line == "exit":
                 break
-            if args.compat and len(line.split()) > 2:
-                # reference guard: only 1-2 word queries (:292,297)
-                print("  (compat mode: queries are limited to 1-2 words)")
-                continue
             run_batch([line])
     return 0
 

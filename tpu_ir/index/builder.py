@@ -29,7 +29,8 @@ from ..ops import (
     build_postings_jit,
     pack_term_bytes,
 )
-from ..utils import JobReport
+from ..ops.postings import pair_term_from_df
+from ..utils import JobReport, fetch_to_host
 from . import format as fmt
 
 TOKENS_VOCAB = "tokens.txt"  # single-token vocab for char-gram lookups (k>1)
@@ -144,6 +145,7 @@ def build_index(
     flat_term_ids = inverse.astype(np.int32)
     flat_doc_ids = np.repeat(docnos, lengths).astype(np.int32)
 
+    deferred = None  # single-device: big pair arrays still in flight to host
     if spmd_devices:
         # --- SPMD path: doc-sharded map + all_to_all shuffle + term-sharded
         # reduce; each device's output IS its part-NNNNN file (the Hadoop
@@ -155,18 +157,6 @@ def build_index(
                 vocab_size=v, num_docs=num_docs, num_devices=spmd_devices)
             num_pairs = int(sum(len(sp[0]) for sp in shard_pairs))
             report.set_counter("num_pairs", num_pairs)
-        with report.phase("write_shards"):
-            np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
-            shard_of = np.arange(v, dtype=np.int32) % num_shards
-            offset_of = np.zeros(v, np.int64)
-            for s, (s_term, s_doc, s_tf) in enumerate(shard_pairs):
-                tids = np.nonzero(shard_of == s)[0].astype(np.int32)
-                lens = df[tids].astype(np.int64)
-                local_indptr = np.concatenate([[0], np.cumsum(lens)])
-                offset_of[tids] = local_indptr[:-1]
-                fmt.save_shard(index_dir, s, term_ids=tids,
-                               indptr=local_indptr, pair_doc=s_doc,
-                               pair_tf=s_tf, df=df[tids])
     else:
         # --- single-device path ---
         with report.phase("postings_device"):
@@ -182,45 +172,17 @@ def build_index(
             p = build_postings_jit(
                 jnp.asarray(term_ids), jnp.asarray(doc_ids),
                 vocab_size=v, num_docs=num_docs)
-            num_pairs = int(p.num_pairs)
-            pair_term = np.asarray(p.pair_term)[:num_pairs]
-            pair_doc = np.asarray(p.pair_doc)[:num_pairs]
-            pair_tf = np.asarray(p.pair_tf)[:num_pairs]
-            df = np.asarray(p.df)
-            doc_len = np.asarray(p.doc_len)
-            report.set_counter("num_pairs", num_pairs)
+            # no blocking here: start every result copy in the background
+            # (num_pairs = df.sum() and pair_term = term-major repeat of df
+            # are recovered on host, so nothing needs a device sync) and let
+            # the char-gram programs below keep the device busy while the
+            # copies stream back
+            deferred = (p.df, p.doc_len, p.pair_doc, p.pair_tf)
+            for a in deferred:
+                a.copy_to_host_async()
 
-        # --- shard + persist (part-NNNNN layout) ---
-        with report.phase("write_shards"):
-            np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
-            indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
-            shard_of = np.arange(v, dtype=np.int32) % num_shards
-            offset_of = np.zeros(v, np.int64)
-            for s in range(num_shards):
-                tids = np.nonzero(shard_of == s)[0].astype(np.int32)
-                lens = df[tids].astype(np.int64)
-                local_indptr = np.concatenate([[0], np.cumsum(lens)])
-                sel = np.concatenate(
-                    [np.arange(indptr[t], indptr[t + 1]) for t in tids]
-                ) if len(tids) else np.zeros(0, np.int64)
-                offset_of[tids] = local_indptr[:-1]
-                fmt.save_shard(
-                    index_dir, s,
-                    term_ids=tids,
-                    indptr=local_indptr,
-                    pair_doc=pair_doc[sel],
-                    pair_tf=pair_tf[sel],
-                    df=df[tids],
-                )
-
-    # --- dictionary / forward index (BuildIntDocVectorsForwardIndex) ---
-    with report.phase("dictionary"):
-        fmt.write_dictionary(index_dir, vocab.terms, shard_of, offset_of)
-        dict_report = JobReport("BuildIntDocVectorsForwardIndex")
-        dict_report.set_counter("Dictionary.Size", v)
-        dict_report.save(os.path.join(index_dir, fmt.JOBS_DIR))
-
-    # --- char-k-gram indexes (CharKGramTermIndexer) ---
+    # --- char-k-gram indexes (CharKGramTermIndexer); runs while the
+    # postings arrays stream back to host ---
     built_chargrams = bool(compute_chargrams and chargram_ks)
     if built_chargrams:
         with report.phase("chargrams"):
@@ -232,6 +194,49 @@ def build_index(
                 token_vocab.save(os.path.join(index_dir, TOKENS_VOCAB))
             build_chargram_artifacts(
                 index_dir, token_vocab.terms, chargram_ks)
+
+    # --- shard + persist (part-NNNNN layout) ---
+    with report.phase("write_shards"):
+        shard_of = np.arange(v, dtype=np.int32) % num_shards
+        offset_of = np.zeros(v, np.int64)
+        if deferred is not None:
+            df, doc_len, pair_doc, pair_tf = fetch_to_host(*deferred)
+            num_pairs = int(df.sum())
+            report.set_counter("num_pairs", num_pairs)
+            np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
+            # selection per shard is one boolean mask over the pairs' terms
+            pair_shard = shard_of[pair_term_from_df(df)]
+            for s in range(num_shards):
+                tids = np.nonzero(shard_of == s)[0].astype(np.int32)
+                lens = df[tids].astype(np.int64)
+                local_indptr = np.concatenate([[0], np.cumsum(lens)])
+                sel = pair_shard == s
+                offset_of[tids] = local_indptr[:-1]
+                fmt.save_shard(
+                    index_dir, s,
+                    term_ids=tids,
+                    indptr=local_indptr,
+                    pair_doc=pair_doc[:num_pairs][sel],
+                    pair_tf=pair_tf[:num_pairs][sel],
+                    df=df[tids],
+                )
+        else:
+            np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
+            for s, (s_term, s_doc, s_tf) in enumerate(shard_pairs):
+                tids = np.nonzero(shard_of == s)[0].astype(np.int32)
+                lens = df[tids].astype(np.int64)
+                local_indptr = np.concatenate([[0], np.cumsum(lens)])
+                offset_of[tids] = local_indptr[:-1]
+                fmt.save_shard(index_dir, s, term_ids=tids,
+                               indptr=local_indptr, pair_doc=s_doc,
+                               pair_tf=s_tf, df=df[tids])
+
+    # --- dictionary / forward index (BuildIntDocVectorsForwardIndex) ---
+    with report.phase("dictionary"):
+        fmt.write_dictionary(index_dir, vocab.terms, shard_of, offset_of)
+        dict_report = JobReport("BuildIntDocVectorsForwardIndex")
+        dict_report.set_counter("Dictionary.Size", v)
+        dict_report.save(os.path.join(index_dir, fmt.JOBS_DIR))
 
     meta = fmt.IndexMetadata(
         num_docs=num_docs, vocab_size=v, k=k, num_shards=num_shards,
@@ -270,16 +275,15 @@ def _spmd_postings(flat_term_ids, flat_doc_ids, docnos, *, vocab_size,
         term_ids, doc_ids, docs_per_shard,
         vocab_size=vocab_size, total_docs=num_docs, mesh=mesh)
 
+    num_pairs_h, pt_h, pd_h, ptf_h, df_h = fetch_to_host(
+        out.num_pairs, out.pair_term, out.pair_doc, out.pair_tf, out.df)
     shard_pairs = []
     df = np.zeros(vocab_size, np.int32)
     for sh in range(s):
-        npairs = int(np.asarray(out.num_pairs)[sh])
-        shard_pairs.append((
-            np.asarray(out.pair_term)[sh][:npairs],
-            np.asarray(out.pair_doc)[sh][:npairs],
-            np.asarray(out.pair_tf)[sh][:npairs],
-        ))
-        df += np.asarray(out.df)[sh]
+        npairs = int(num_pairs_h[sh])
+        shard_pairs.append(
+            (pt_h[sh][:npairs], pd_h[sh][:npairs], ptf_h[sh][:npairs]))
+        df += df_h[sh]
     doc_len = np.bincount(flat_doc_ids, minlength=num_docs + 1
                           ).astype(np.int32)[: num_docs + 1]
     return shard_pairs, df, doc_len
@@ -288,19 +292,34 @@ def _spmd_postings(flat_term_ids, flat_doc_ids, docnos, *, vocab_size,
 def build_chargram_artifacts(
     index_dir: str, terms: list[str], ks: Iterable[int]
 ) -> None:
-    for ck in ks:
-        if fmt.artifact_exists(index_dir, fmt.chargram_name(ck)):
-            continue
+    ks = [ck for ck in ks
+          if not fmt.artifact_exists(index_dir, fmt.chargram_name(ck))]
+    if not ks:
+        return
+    # one byte matrix serves every k (padding differs only if k > max term
+    # length + 2), so it is packed and uploaded once
+    tb_np, tl_np = pack_term_bytes(terms, max(ks))
+    tb, tl = jnp.asarray(tb_np), jnp.asarray(tl_np)
+    # dispatch every k's program before collecting any result so the device
+    # programs and the D2H copies pipeline
+    pending = [(ck, build_chargram_index_jit(tb, tl, k=ck)) for ck in ks]
+    for _, idx in pending:
+        for a in (idx.gram_codes, idx.indptr, idx.term_ids):
+            a.copy_to_host_async()
+    for ck, idx in pending:
+        # batched fetch, no device scalar syncs: the valid-prefix lengths
+        # are recovered on host (gram_codes is PAD_TERM-padded and sorted;
+        # indptr[ng] is the entry count)
         report = JobReport("CharKGramTermIndexer", config={"k": ck})
-        tb, tl = pack_term_bytes(terms, ck)
-        idx = build_chargram_index_jit(jnp.asarray(tb), jnp.asarray(tl), k=ck)
-        ng = int(idx.num_grams)
-        ne = int(idx.num_entries)
+        gram_codes, indptr, term_ids = fetch_to_host(
+            idx.gram_codes, idx.indptr, idx.term_ids)
+        ng = int(np.searchsorted(gram_codes, PAD_TERM))
+        ne = int(indptr[ng])
         fmt.save_chargram(
             index_dir, ck,
-            gram_codes=np.asarray(idx.gram_codes)[:ng],
-            indptr=np.asarray(idx.indptr)[: ng + 1],
-            term_ids=np.asarray(idx.term_ids)[:ne],
+            gram_codes=gram_codes[:ng],
+            indptr=indptr[: ng + 1],
+            term_ids=term_ids[:ne],
         )
         report.set_counter("map_output_records", ne)
         report.set_counter("reduce_output_groups", ng)

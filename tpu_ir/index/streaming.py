@@ -32,8 +32,8 @@ import numpy as np
 from ..analysis.native import make_analyzer
 from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
 from ..ops import PAD_TERM, build_postings_jit
-from ..ops.postings import reduce_weighted_postings_jit
-from ..utils import JobReport
+from ..ops.postings import pair_term_from_df, reduce_weighted_postings_jit
+from ..utils import JobReport, fetch_to_host
 from . import format as fmt
 from .builder import build_chargram_artifacts
 
@@ -146,10 +146,14 @@ def build_index_streaming(
             d_pad[: len(flat)] = doc_ids
             p = build_postings_jit(jnp.asarray(t_pad), jnp.asarray(d_pad),
                                    vocab_size=v, num_docs=num_docs)
-            npairs = int(p.num_pairs)
-            pt = np.asarray(p.pair_term)[:npairs]
-            pd = np.asarray(p.pair_doc)[:npairs]
-            ptf = np.asarray(p.pair_tf)[:npairs]
+            # batched fetch (tunnel D2H latency is per-fetch); num_pairs and
+            # pair_term are both implied by df since pairs are term-major
+            df_b, pd_full, ptf_full = fetch_to_host(p.df, p.pair_doc,
+                                                    p.pair_tf)
+            npairs = int(df_b.sum())
+            pt = pair_term_from_df(df_b)
+            pd = pd_full[:npairs]
+            ptf = ptf_full[:npairs]
             shard = pt % num_shards
             for s in range(num_shards):
                 sel = shard == s
@@ -181,20 +185,20 @@ def build_index_streaming(
             t_pad[: len(t)] = t
             d_pad[: len(d)] = d
             w_pad[: len(w)] = w
-            rt, rd, rtf, rdf, rnp = reduce_weighted_postings_jit(
+            _, rd, rtf, rdf, _ = reduce_weighted_postings_jit(
                 jnp.asarray(t_pad), jnp.asarray(d_pad), jnp.asarray(w_pad),
                 vocab_size=v)
-            npairs = int(rnp)
+            rdf, rd, rtf = fetch_to_host(rdf, rd, rtf)
+            npairs = int(rdf.sum())
             num_pairs_total += npairs
-            rdf = np.asarray(rdf)
             df += rdf
             tids = np.nonzero(shard_of == s)[0].astype(np.int32)
             lens = rdf[tids].astype(np.int64)
             local_indptr = np.concatenate([[0], np.cumsum(lens)])
             offset_of[tids] = local_indptr[:-1]
             fmt.save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
-                           pair_doc=np.asarray(rd)[:npairs],
-                           pair_tf=np.asarray(rtf)[:npairs], df=rdf[tids])
+                           pair_doc=rd[:npairs],
+                           pair_tf=rtf[:npairs], df=rdf[tids])
     report.set_counter("num_pairs", num_pairs_total)
 
     with report.phase("dictionary"):

@@ -153,6 +153,17 @@ reduce_weighted_postings_jit = jax.jit(
     reduce_weighted_postings, static_argnames=("vocab_size",))
 
 
+def pair_term_from_df(df: np.ndarray) -> np.ndarray:
+    """Recover the valid-prefix pair_term column on host from df alone.
+
+    Both build_postings and reduce_weighted_postings emit their valid pairs
+    term-major (final order: term asc, tf desc, doc asc — the lexsort above),
+    so pair i's term is the df-run it falls in and there is no need to
+    download the pair_term array from device.
+    """
+    return np.repeat(np.arange(len(df), dtype=np.int32), df)
+
+
 def pack_occurrences(
     doc_term_ids: list[np.ndarray],
     docnos: np.ndarray,

@@ -1,3 +1,4 @@
 from .report import JobReport
+from .transfer import fetch_to_host
 
-__all__ = ["JobReport"]
+__all__ = ["JobReport", "fetch_to_host"]

@@ -1,0 +1,26 @@
+"""Batched device->host transfers.
+
+The TPU-tunnel PJRT transport has a large fixed latency per device->host
+fetch (hundreds of ms regardless of size, measured on the axon tunnel), so
+sequential `np.asarray` calls on several result arrays serialize that
+latency. `fetch_to_host` issues `copy_to_host_async` on every array first so
+the copies are in flight together, then materializes them; measured ~2x
+faster than sequential fetches for the index-build result set.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def fetch_to_host(*arrays) -> list[np.ndarray]:
+    """Fetch any number of jax Arrays to host numpy, overlapping the copies.
+
+    Plain numpy arrays pass through unchanged, so callers can mix host and
+    device values.
+    """
+    for a in arrays:
+        f = getattr(a, "copy_to_host_async", None)
+        if f is not None:
+            f()
+    return [np.asarray(a) for a in arrays]
