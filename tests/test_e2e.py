@@ -156,6 +156,39 @@ def test_wildcard_expand(index_dir):
     assert "salmon" in lookup3.expand("sal*on")
 
 
+def test_wildcard_search(index_dir):
+    """Glob tokens in a query expand (OR) over the char-k-gram index."""
+    scorer = Scorer.load(index_dir)
+    got = {d for d, _ in scorer.search("riv*")}
+    assert {"AP-0010", "WSJ-9.1", "WSJ-9.2", "ZF-077"} <= got
+    # expansion of riv* is exactly the stemmed term 'river' here
+    assert got == {d for d, _ in scorer.search("river")}
+    # mixed literal + wildcard query
+    assert "WSJ-9.2" in {d for d, _ in scorer.search("salmon fish*")}
+    # pattern matching nothing scores nothing
+    assert scorer.search("zzzq*") == []
+    # a trailing '?' is punctuation, not a glob: same results as 'river'
+    assert {d for d, _ in scorer.search("river?")} == got
+    # overlap between a literal term and its own expansion is not scored
+    # twice: 'river riv*' == plain 'river' scores exactly
+    assert scorer.search("river riv*") == scorer.search("river")
+    # a pattern too short for every chargram k is skipped, not scanned
+    assert scorer.analyze_queries(["*"]).tolist() == [[-1]]
+    # surrounding punctuation on a glob token is stripped, not matched
+    assert scorer.search("salmon (fish*),") == scorer.search("salmon fish*")
+
+
+def test_wildcard_search_without_chargrams(tmp_path):
+    """On an index without char-gram artifacts the glob token falls back to
+    literal analysis (the metacharacters are split chars)."""
+    corpus = corpus_file(tmp_path)
+    out = str(tmp_path / "idx-nogram")
+    build_index([str(corpus)], out, k=1, num_shards=2,
+                compute_chargrams=False)
+    scorer = Scorer.load(out)
+    assert scorer.search("fish*") == scorer.search("fish")
+
+
 def test_kgram2_index_and_search(tmp_path):
     corpus = corpus_file(tmp_path)
     out = str(tmp_path / "index2")

@@ -260,7 +260,10 @@ def main(argv: list[str] | None = None) -> int:
     _add_backend_arg(pi)
     pi.set_defaults(fn=cmd_index)
 
-    ps = sub.add_parser("search", help="query an index (REPL or batch)")
+    ps = sub.add_parser(
+        "search",
+        help="query an index (REPL or batch); glob tokens like te* expand "
+             "over the char-k-gram index (OR of up to 64 matching terms)")
     ps.add_argument("index_dir")
     ps.add_argument("--query", "-q")
     ps.add_argument("--queries-file")

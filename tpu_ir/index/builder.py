@@ -300,17 +300,14 @@ def build_chargram_artifacts(
     # length + 2), so it is packed and uploaded once
     tb_np, tl_np = pack_term_bytes(terms, max(ks))
     tb, tl = jnp.asarray(tb_np), jnp.asarray(tl_np)
-    # dispatch every k's program before collecting any result so the device
-    # programs and the D2H copies pipeline
-    pending = [(ck, build_chargram_index_jit(tb, tl, k=ck)) for ck in ks]
-    for _, idx in pending:
-        for a in (idx.gram_codes, idx.indptr, idx.term_ids):
-            a.copy_to_host_async()
-    for ck, idx in pending:
+    # depth-1 pipeline: the next k's program is dispatched before the
+    # previous k's results are collected, so compute and D2H copies overlap
+    # while at most two result sets are live on device at once
+
+    def collect(ck, idx, report):
         # batched fetch, no device scalar syncs: the valid-prefix lengths
         # are recovered on host (gram_codes is PAD_TERM-padded and sorted;
         # indptr[ng] is the entry count)
-        report = JobReport("CharKGramTermIndexer", config={"k": ck})
         gram_codes, indptr, term_ids = fetch_to_host(
             idx.gram_codes, idx.indptr, idx.term_ids)
         ng = int(np.searchsorted(gram_codes, PAD_TERM))
@@ -324,3 +321,17 @@ def build_chargram_artifacts(
         report.set_counter("map_output_records", ne)
         report.set_counter("reduce_output_groups", ng)
         report.save(os.path.join(index_dir, fmt.JOBS_DIR))
+
+    prev = None
+    for ck in ks:
+        # report opens at dispatch so wall_s covers the device program, not
+        # just the fetch+write in collect()
+        report = JobReport("CharKGramTermIndexer", config={"k": ck})
+        idx = build_chargram_index_jit(tb, tl, k=ck)
+        for a in (idx.gram_codes, idx.indptr, idx.term_ids):
+            a.copy_to_host_async()
+        if prev is not None:
+            collect(*prev)
+        prev = (ck, idx, report)
+    if prev is not None:
+        collect(*prev)

@@ -14,7 +14,9 @@ the index was built with k > 1.
 
 from __future__ import annotations
 
+import logging
 import os
+import re
 from typing import Sequence
 
 import jax.numpy as jnp
@@ -28,6 +30,16 @@ from ..ops.scoring import dense_tf_matrix
 
 # dense [V, D+1] matrix budget in elements (f32); above this use sparse CSR
 DENSE_BUDGET = 500_000_000
+
+# a whitespace-delimited query token containing a glob metacharacter
+_WILDCARD_RE = re.compile(r"\S*[*?]\S*")
+
+# punctuation the analyzer would strip from a literal token; removed from
+# glob-token edges too so 'fish*,' or '(fish*)' means the pattern 'fish*'
+_EDGE_PUNCT = "".join(c for c in
+                      r"""!"#$%&'()+,-./:;<=>@[\]^_`{|}~""" if c not in "*?")
+
+logger = logging.getLogger(__name__)
 
 
 class SearchResult(list):
@@ -54,6 +66,9 @@ class Scorer:
         self.meta = meta
         self.compat_int_idf = compat_int_idf
         self._analyzer = make_analyzer()
+        self._index_dir: str | None = None  # set by load(); enables wildcards
+        self._wildcard = None
+        self._wildcard_tried = False
         v, d = meta.vocab_size, meta.num_docs
         self.df = jnp.asarray(df)
         self.doc_len = jnp.asarray(doc_len)
@@ -137,13 +152,75 @@ class Scorer:
         # stable sort by term restores global CSR order while preserving each
         # term's tf-desc/doc-asc posting order from the shard files
         order = np.argsort(pair_term, kind="stable")
-        return cls(
+        scorer = cls(
             vocab=vocab, mapping=mapping,
             pair_term=pair_term[order], pair_doc=pair_doc[order],
             pair_tf=pair_tf[order], df=df, doc_len=doc_len, meta=meta,
             layout=layout, compat_int_idf=compat_int_idf)
+        scorer._index_dir = index_dir
+        return scorer
 
     # -- query pipeline ----------------------------------------------------
+
+    # max vocabulary terms a single wildcard pattern may expand to
+    WILDCARD_LIMIT = 64
+
+    def _wildcard_lookups(self):
+        """Lazy WildcardLookups (largest chargram k first), or [] when the
+        index has no char-gram artifacts / wasn't loaded from a directory.
+        Wildcard search is only meaningful at k=1, where the index vocabulary
+        is the token vocabulary the char-gram index covers."""
+        if not self._wildcard_tried:
+            self._wildcard_tried = True
+            if (self._index_dir and self.meta.k == 1
+                    and self.meta.chargram_ks):
+                from .wildcard import WildcardLookup
+
+                self._wildcard = [
+                    WildcardLookup.load(self._index_dir, ck,
+                                        vocab=self.vocab)
+                    for ck in sorted(self.meta.chargram_ks, reverse=True)]
+        return self._wildcard or []
+
+    def _expand_wildcards(self, text: str) -> tuple[str, list[int]]:
+        """Pull glob tokens ('te*', 'ho?se') out of a query; return the text
+        with them removed plus the term-ids of their vocabulary expansions
+        (an OR over expansions — the wildcard query semantics the reference's
+        char-k-gram index was built for but never wired into search;
+        SURVEY.md §0 pipeline 2)."""
+        extra: list[int] = []
+
+        def repl(m: re.Match) -> str:
+            # a trailing '?' is question punctuation, not a glob: 'river?'
+            # means the literal term 'river'
+            token = m.group(0).strip(_EDGE_PUNCT).rstrip("?")
+            if "*" not in token and "?" not in token:
+                return token
+            # with no char-gram index to expand against, leave the token to
+            # the literal analyzer (which splits on the metacharacters)
+            if not self._wildcard_lookups():
+                return token
+            # use the largest chargram k whose grams cover the pattern; a
+            # pattern too short for every k (e.g. '*') is skipped rather than
+            # falling back to a full-vocabulary scan in the query hot path
+            pattern = token.lower()
+            for lookup in self._wildcard_lookups():
+                if lookup.pattern_grams(pattern):
+                    terms = lookup.expand(pattern,
+                                          limit=self.WILDCARD_LIMIT + 1)
+                    if len(terms) > self.WILDCARD_LIMIT:
+                        logger.warning(
+                            "pattern %r matches more than %d terms; "
+                            "expansion truncated", token, self.WILDCARD_LIMIT)
+                        terms = terms[: self.WILDCARD_LIMIT]
+                    for t in terms:
+                        tid = self.vocab.id_or(t)
+                        if tid >= 0:
+                            extra.append(tid)
+                    break
+            return " "
+
+        return _WILDCARD_RE.sub(repl, text), extra
 
     def analyze_queries(
         self, texts: Sequence[str], max_terms: int | None = None
@@ -152,15 +229,29 @@ class Scorer:
 
         Unknown terms (not in the vocabulary) are dropped, like the
         reference's dictionary miss path (IntDocVectorsForwardIndex.java:
-        150-153 returns null -> term skipped)."""
+        150-153 returns null -> term skipped). Glob tokens expand to an OR
+        over matching vocabulary terms via the char-k-gram index."""
         rows = []
         for text in texts:
+            extra: list[int] = []
+            if "*" in text or "?" in text:
+                text, extra = self._expand_wildcards(text)
             toks = self._analyzer.analyze(text)
             grams = kgram_terms(toks, self.meta.k)
             ids = [self.vocab.id_or(g) for g in grams]
-            rows.append([i for i in ids if i >= 0])
+            row = [i for i in ids if i >= 0]
+            # expansions are an OR: drop ids already contributed by literal
+            # terms (or another pattern) so nothing is scored twice
+            seen = set(row)
+            row += [i for i in dict.fromkeys(extra) if i not in seen]
+            rows.append(row)
         cap = max_terms or max((len(r) for r in rows), default=1)
         cap = max(cap, 1)
+        if max_terms is None:
+            # bucket the width to a power of two so the set of compiled
+            # programs stays small (wildcard expansion would otherwise mint
+            # a fresh width — and a fresh XLA compile — per query shape)
+            cap = 1 << (cap - 1).bit_length()
         out = np.full((len(rows), cap), -1, np.int32)
         for i, r in enumerate(rows):
             out[i, : min(len(r), cap)] = r[:cap]

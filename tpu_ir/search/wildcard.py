@@ -32,12 +32,17 @@ class WildcardLookup:
         self._term_ids = term_ids
 
     @classmethod
-    def load(cls, index_dir: str, k: int) -> "WildcardLookup":
+    def load(cls, index_dir: str, k: int,
+             vocab: Vocab | None = None) -> "WildcardLookup":
+        """`vocab` lets a caller that already holds the token vocabulary
+        (e.g. a k=1 Scorer, whose index vocab IS the token vocab) share it
+        instead of re-reading it from disk."""
         z = fmt.load_chargram(index_dir, k)
-        tok_vocab_path = os.path.join(index_dir, TOKENS_VOCAB)
-        vocab = Vocab.load(
-            tok_vocab_path if os.path.exists(tok_vocab_path)
-            else os.path.join(index_dir, fmt.VOCAB))
+        if vocab is None:
+            tok_vocab_path = os.path.join(index_dir, TOKENS_VOCAB)
+            vocab = Vocab.load(
+                tok_vocab_path if os.path.exists(tok_vocab_path)
+                else os.path.join(index_dir, fmt.VOCAB))
         return cls(vocab, k, z["gram_codes"], z["indptr"], z["term_ids"])
 
     def _terms_for_gram(self, gram: str) -> np.ndarray:
