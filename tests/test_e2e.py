@@ -176,6 +176,22 @@ def test_wildcard_search(index_dir):
     assert scorer.analyze_queries(["*"]).tolist() == [[-1]]
     # surrounding punctuation on a glob token is stripped, not matched
     assert scorer.search("salmon (fish*),") == scorer.search("salmon fish*")
+    # interior punctuation splits like the analyzer: the literal part
+    # survives instead of being swallowed by the glob token
+    assert scorer.search("salmon,fish*") == scorer.search("salmon fish*")
+
+
+def test_wildcard_non_ascii_pattern(index_dir):
+    """A glob token with a multi-byte character must not crash the query
+    path: grams are UTF-8 byte windows (matching pack_term_bytes), so the
+    pattern decomposes into byte grams and simply matches nothing here."""
+    scorer = Scorer.load(index_dir)
+    assert scorer.search("naïve*") == []
+    lookup = WildcardLookup.load(index_dir, 2)
+    assert lookup.expand("naïve*") == []
+    # byte-gram decomposition: 'ï' (2 bytes) spans two 2-byte grams
+    grams = lookup.pattern_grams("naïve*")
+    assert b"a\xc3" in grams and b"\xc3\xaf" in grams
 
 
 def test_wildcard_search_without_chargrams(tmp_path):

@@ -130,8 +130,8 @@ def code_to_gram(code: int, k: int) -> str:
     return bs.decode("utf-8", "replace")
 
 
-def gram_to_code(gram: str, k: int) -> int:
-    bs = gram.encode("utf-8")
+def gram_to_code(gram: str | bytes, k: int) -> int:
+    bs = gram if isinstance(gram, bytes) else gram.encode("utf-8")
     if len(bs) != k:
         raise ValueError(f"gram {gram!r} is not {k} bytes")
     code = 0

@@ -39,6 +39,12 @@ _WILDCARD_RE = re.compile(r"\S*[*?]\S*")
 _EDGE_PUNCT = "".join(c for c in
                       r"""!"#$%&'()+,-./:;<=>@[\]^_`{|}~""" if c not in "*?")
 
+# interior punctuation splits a glob token the way the analyzer splits a
+# literal one ('salmon,fish*' = literal 'salmon' + pattern 'fish*'); '.' and
+# "'" are kept inside parts to preserve acronym/apostrophe analysis
+_GLOB_SPLIT_RE = re.compile(
+    "[" + re.escape("".join(c for c in _EDGE_PUNCT if c not in ".'")) + "]+")
+
 logger = logging.getLogger(__name__)
 
 
@@ -190,20 +196,11 @@ class Scorer:
         SURVEY.md §0 pipeline 2)."""
         extra: list[int] = []
 
-        def repl(m: re.Match) -> str:
-            # a trailing '?' is question punctuation, not a glob: 'river?'
-            # means the literal term 'river'
-            token = m.group(0).strip(_EDGE_PUNCT).rstrip("?")
-            if "*" not in token and "?" not in token:
-                return token
-            # with no char-gram index to expand against, leave the token to
-            # the literal analyzer (which splits on the metacharacters)
-            if not self._wildcard_lookups():
-                return token
+        def expand_part(part: str) -> None:
             # use the largest chargram k whose grams cover the pattern; a
             # pattern too short for every k (e.g. '*') is skipped rather than
             # falling back to a full-vocabulary scan in the query hot path
-            pattern = token.lower()
+            pattern = part.lower()
             for lookup in self._wildcard_lookups():
                 if lookup.pattern_grams(pattern):
                     terms = lookup.expand(pattern,
@@ -211,14 +208,31 @@ class Scorer:
                     if len(terms) > self.WILDCARD_LIMIT:
                         logger.warning(
                             "pattern %r matches more than %d terms; "
-                            "expansion truncated", token, self.WILDCARD_LIMIT)
+                            "expansion truncated", part, self.WILDCARD_LIMIT)
                         terms = terms[: self.WILDCARD_LIMIT]
                     for t in terms:
                         tid = self.vocab.id_or(t)
                         if tid >= 0:
                             extra.append(tid)
                     break
-            return " "
+
+        def repl(m: re.Match) -> str:
+            token = m.group(0).strip(_EDGE_PUNCT)
+            literals = []
+            for part in _GLOB_SPLIT_RE.split(token):
+                # a trailing '?' is question punctuation, not a glob:
+                # 'river?' means the literal term 'river'
+                part = part.rstrip("?")
+                if not part:
+                    continue
+                if ("*" not in part and "?" not in part
+                        # with no char-gram index, leave the part to the
+                        # literal analyzer (which splits on metacharacters)
+                        or not self._wildcard_lookups()):
+                    literals.append(part)
+                else:
+                    expand_part(part)
+            return " ".join(literals) if literals else " "
 
         return _WILDCARD_RE.sub(repl, text), extra
 

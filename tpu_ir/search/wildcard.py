@@ -11,6 +11,7 @@ filters false positives (the classic k-gram postfilter).
 from __future__ import annotations
 
 import fnmatch
+import itertools
 import os
 from functools import reduce
 
@@ -30,33 +31,47 @@ class WildcardLookup:
         self._codes = gram_codes
         self._indptr = indptr
         self._term_ids = term_ids
+        self._lazy_dir: str | None = None
 
     @classmethod
     def load(cls, index_dir: str, k: int,
              vocab: Vocab | None = None) -> "WildcardLookup":
         """`vocab` lets a caller that already holds the token vocabulary
         (e.g. a k=1 Scorer, whose index vocab IS the token vocab) share it
-        instead of re-reading it from disk."""
-        z = fmt.load_chargram(index_dir, k)
+        instead of re-reading it from disk. The gram arrays themselves load
+        lazily on first expansion — a Scorer holds one lookup per chargram
+        k but a typical pattern only ever consults the largest k."""
         if vocab is None:
             tok_vocab_path = os.path.join(index_dir, TOKENS_VOCAB)
             vocab = Vocab.load(
                 tok_vocab_path if os.path.exists(tok_vocab_path)
                 else os.path.join(index_dir, fmt.VOCAB))
-        return cls(vocab, k, z["gram_codes"], z["indptr"], z["term_ids"])
+        out = cls(vocab, k, None, None, None)
+        out._lazy_dir = index_dir
+        return out
 
-    def _terms_for_gram(self, gram: str) -> np.ndarray:
+    def _ensure_loaded(self) -> None:
+        if self._codes is None:
+            z = fmt.load_chargram(self._lazy_dir, self.k)
+            self._codes = z["gram_codes"]
+            self._indptr = z["indptr"]
+            self._term_ids = z["term_ids"]
+
+    def _terms_for_gram(self, gram: bytes) -> np.ndarray:
         code = gram_to_code(gram, self.k)
         i = np.searchsorted(self._codes, code)
         if i >= len(self._codes) or self._codes[i] != code:
             return np.zeros(0, np.int32)
         return self._term_ids[self._indptr[i] : self._indptr[i + 1]]
 
-    def pattern_grams(self, pattern: str) -> list[str]:
+    def pattern_grams(self, pattern: str) -> list[bytes]:
         """k-grams implied by a wildcard pattern: pad with $ at fixed ends,
-        take grams of every maximal wildcard-free run."""
+        take grams of every maximal wildcard-free run. Grams are UTF-8
+        *byte* windows, matching how the index packs terms (a multi-byte
+        character spans several byte grams, same as in `pack_term_bytes`)."""
         padded = "$" + pattern + "$"
-        runs = [r for r in padded.replace("?", "*").split("*") if r]
+        runs = [r.encode("utf-8")
+                for r in padded.replace("?", "*").split("*") if r]
         grams = []
         for run in runs:
             grams.extend(
@@ -66,6 +81,7 @@ class WildcardLookup:
     def expand(self, pattern: str, limit: int | None = None) -> list[str]:
         """Vocabulary terms matching a glob pattern (e.g. 'te*', '*tion')."""
         grams = self.pattern_grams(pattern)
+        self._ensure_loaded()
         if grams:
             lists = [self._terms_for_gram(g) for g in grams]
             if any(len(l) == 0 for l in lists):
@@ -74,5 +90,11 @@ class WildcardLookup:
             cands = (self.vocab.term(int(t)) for t in cand_ids)
         else:
             cands = iter(self.vocab.terms)  # pattern like '*': scan all
-        out = [t for t in cands if fnmatch.fnmatchcase(t, pattern)]
-        return out[:limit] if limit is not None else out
+        matches = (t for t in cands if fnmatch.fnmatchcase(t, pattern))
+        # early exit: candidates arrive in sorted-term order either way, so
+        # stopping at `limit` returns the same prefix a full scan would
+        # (matters for single-gram patterns like 'a*' whose candidate set is
+        # a vocabulary-scale slice)
+        if limit is not None:
+            return list(itertools.islice(matches, limit))
+        return list(matches)
