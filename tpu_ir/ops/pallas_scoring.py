@@ -27,15 +27,18 @@ from jax.experimental.pallas import tpu as pltpu
 
 
 def _score_kernel(q_ref, idf_ref, row_ref, out_ref):
-    """Grid (B, L). row_ref: the [1, D] doc-matrix row for term q[b, l]
-    (selected by the index_map); out_ref: score row [1, D] for query b."""
+    """Grid (B, L). row_ref: the [1, 1, D] doc-matrix row for term q[b, l]
+    (selected by the index_map); idf_ref: the full [B, L] idf table in SMEM
+    (scalar-prefetched — a (1,1) VMEM block would violate the TPU's 8x128
+    tile minimum); out_ref: score row [1, 1, D] for query b."""
+    b = pl.program_id(0)
     l = pl.program_id(1)
 
     @pl.when(l == 0)
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    w = idf_ref[0, 0]
+    w = idf_ref[b, l]
     out_ref[:] = out_ref[:] + w * row_ref[:]
 
 
@@ -61,22 +64,26 @@ def pallas_tfidf_scores(
     q_idf = jnp.where(q_valid, idf[safe_q], 0.0)  # [B, L]
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,  # safe_q drives the row DMA schedule
+        # safe_q drives the row DMA schedule; q_idf rides along in SMEM so
+        # the kernel reads its (b, l) weight without a sub-tile VMEM block.
+        num_scalar_prefetch=2,
         grid=(b, l),
         in_specs=[
-            # idf weight for (b, l): one scalar block
-            pl.BlockSpec((1, 1), lambda i, j, q: (i, j)),
-            # doc-matrix row for term q[b, l]
-            pl.BlockSpec((1, d), lambda i, j, q: (q[i, j], 0)),
+            # doc-matrix row for term q[b, l]. The singleton middle dim keeps
+            # the block's trailing two dims equal to the array's (the Mosaic
+            # lowering rejects a (1, D) block of a [V, D] array: 1 is neither
+            # a multiple of 8 sublanes nor the full first dim).
+            pl.BlockSpec((1, 1, d), lambda i, j, q, w: (q[i, j], 0, 0)),
         ],
-        out_specs=pl.BlockSpec((1, d), lambda i, j, q: (i, 0)),
+        out_specs=pl.BlockSpec((1, 1, d), lambda i, j, q, w: (i, 0, 0)),
     )
-    return pl.pallas_call(
+    out = pl.pallas_call(
         _score_kernel,
-        out_shape=jax.ShapeDtypeStruct((b, d), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((b, 1, d), jnp.float32),
         grid_spec=grid_spec,
         interpret=interpret,
-    )(safe_q, q_idf, doc_matrix)
+    )(safe_q, q_idf, doc_matrix.reshape(v, 1, d))
+    return out.reshape(b, d)
 
 
 def pallas_tfidf_topk(q_terms, doc_matrix, df, num_docs, *, k: int = 10,

@@ -27,6 +27,7 @@ from ..collection import DocnoMapping, Vocab, kgram_terms
 from ..index import format as fmt
 from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense, tfidf_topk_sparse
 from ..ops.scoring import dense_tf_matrix
+from ..utils.transfer import fetch_to_host
 
 # dense [V, D+1] matrix budget in elements (f32); above this use sparse CSR
 DENSE_BUDGET = 500_000_000
@@ -281,7 +282,11 @@ class Scorer:
 
         Large batches are scored in query blocks so the per-dispatch score
         accumulator stays within SCORE_BUDGET elements regardless of corpus
-        size (the reference had no batching at all; SURVEY.md §3.3)."""
+        size (the reference had no batching at all; SURVEY.md §3.3). All
+        blocks are dispatched before any result is fetched, and the score /
+        docno copies run concurrently — the device transport has a large
+        fixed per-fetch latency, so overlapping transfers is worth more than
+        any compute tuning here."""
         b = q_terms.shape[0]
         block = max(1, self.SCORE_BUDGET // (self.meta.num_docs + 1))
         if b > block:
@@ -290,10 +295,19 @@ class Scorer:
             padded = (b + block - 1) // block * block
             qp = np.full((padded, q_terms.shape[1]), -1, np.int32)
             qp[:b] = q_terms
-            parts = [self.topk(qp[i : i + block], k=k, scoring=scoring)
-                     for i in range(0, padded, block)]
-            return (np.concatenate([p[0] for p in parts])[:b],
-                    np.concatenate([p[1] for p in parts])[:b])
+            outs = [self._topk_device(qp[i : i + block], k, scoring)
+                    for i in range(0, padded, block)]
+        else:
+            outs = [self._topk_device(q_terms, k, scoring)]
+        flat = fetch_to_host(*[a for pair in outs for a in pair])
+        parts = [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
+        if len(parts) == 1:
+            return parts[0]
+        return (np.concatenate([p[0] for p in parts])[:b],
+                np.concatenate([p[1] for p in parts])[:b])
+
+    def _topk_device(self, q_terms: np.ndarray, k: int, scoring: str):
+        """Dispatch one query block; returns device arrays without waiting."""
         q = jnp.asarray(q_terms)
         n = jnp.int32(self.meta.num_docs)
         if scoring == "bm25":
@@ -323,7 +337,7 @@ class Scorer:
                 q, self.hot_rank, self.hot_rows, self.post_docs,
                 self.post_tfs, self.df, n, num_docs=self.meta.num_docs,
                 k=k, compat_int_idf=self.compat_int_idf)
-        return np.asarray(s), np.asarray(d)
+        return s, d
 
     def search_batch(
         self, texts: Sequence[str], k: int = 10, scoring: str = "tfidf",
