@@ -138,6 +138,19 @@ def test_bm25_reasonable(index_dir):
     assert "WSJ-9.2" in top  # the salmon-fishing doc must rank top-2
 
 
+def test_bm25_sparse_layout_agrees(index_dir):
+    """BM25 on the hybrid sparse layout (the large-corpus path) must match
+    the dense path end-to-end."""
+    dense = Scorer.load(index_dir, layout="dense")
+    sparse = Scorer.load(index_dir, layout="sparse")
+    for query in ["quick fox", "salmon fishing", "honey bears river"]:
+        g1 = dense.search(query, scoring="bm25")
+        g2 = sparse.search(query, scoring="bm25")
+        assert [d for d, _ in g1] == [d for d, _ in g2], query
+        for (_, s1), (_, s2) in zip(g1, g2):
+            assert s1 == pytest.approx(s2, rel=1e-4)
+
+
 def test_skip_if_exists(index_dir, tmp_path):
     # second build with same dir returns existing metadata without rebuild
     meta1 = fmt.IndexMetadata.load(index_dir)

@@ -219,43 +219,120 @@ def test_compat_int_idf():
         assert g == pytest.approx(w, rel=1e-4)
 
 
-def test_tfidf_hybrid_matches_dense():
-    """Hot/cold split layout must equal the dense path regardless of where
-    the df threshold lands."""
-    from tpu_ir.ops.scoring import tfidf_topk_hybrid
+def _tier_regimes(vocab, ndocs):
+    """Layout parameter sets spanning: everything-hot, hot-strip starved by
+    the budget (forces multi-tier cold coverage of high-df terms), and
+    single-tier-dominant (large base cap)."""
+    return [
+        dict(hot_budget=10**12, base_cap=2, growth=4),   # p99 split, roomy
+        dict(hot_budget=1, base_cap=2, growth=2),        # 1 hot row max
+        dict(hot_budget=(ndocs + 1) * 2, base_cap=1, growth=4),  # 2 hot rows
+        dict(hot_budget=1, base_cap=4096, growth=4),     # one big tier
+    ]
+
+
+def test_tfidf_tiered_matches_dense():
+    """The tiered sparse layout must equal the dense path under every
+    hot-budget / tier-capacity regime."""
+    from tpu_ir.ops.scoring import tfidf_topk_tiered
+    from tpu_ir.search.layout import build_tiered_layout
 
     p, oracle, vocab, ndocs = _small_index()
     mat = dense_doc_matrix(p.pair_term, p.pair_doc, p.pair_tf,
                            vocab_size=vocab, num_docs=ndocs)
-    indptr = np.asarray(p.indptr)
     df = np.asarray(p.df)
     pd_, pt_ = np.asarray(p.pair_doc), np.asarray(p.pair_tf)
 
-    for threshold in [0, 3, 10**9]:  # all-hot, mixed, all-cold
-        hot_tids = np.nonzero(df > threshold)[0]
-        hot_rank = np.full(vocab, -1, np.int32)
-        hot_rank[hot_tids] = np.arange(len(hot_tids), dtype=np.int32)
-        hot_rows = np.zeros((max(len(hot_tids), 1), ndocs + 1), np.float32)
-        for r, tid in enumerate(hot_tids):
-            lo, hi = indptr[tid], indptr[tid + 1]
-            hot_rows[r, pd_[lo:hi]] = 1.0 + np.log(pt_[lo:hi])
-        pcap = max(int(df[hot_rank < 0].max()) if (hot_rank < 0).any() else 1, 1)
-        post_docs = np.zeros((vocab, pcap), np.int32)
-        post_tfs = np.zeros((vocab, pcap), np.int32)
-        for tid in range(vocab):
-            if hot_rank[tid] >= 0:
-                continue
-            lo, hi = indptr[tid], indptr[tid + 1]
-            post_docs[tid, : hi - lo] = pd_[lo:hi]
-            post_tfs[tid, : hi - lo] = pt_[lo:hi]
-
-        queries = np.array([[0, 5, 199], [3, -1, -1], [11, 2, 7]], np.int32)
-        s1, d1 = tfidf_topk_dense(jnp.asarray(queries), mat, p.df,
-                                  jnp.int32(ndocs), k=5)
-        s2, d2 = tfidf_topk_hybrid(
-            jnp.asarray(queries), jnp.asarray(hot_rank),
-            jnp.asarray(hot_rows), jnp.asarray(post_docs),
-            jnp.asarray(post_tfs), p.df, jnp.int32(ndocs),
-            num_docs=ndocs, k=5)
+    queries = np.array([[0, 5, 199], [3, -1, -1], [11, 2, 7]], np.int32)
+    s1, d1 = tfidf_topk_dense(jnp.asarray(queries), mat, p.df,
+                              jnp.int32(ndocs), k=5)
+    for kw in _tier_regimes(vocab, ndocs):
+        t = build_tiered_layout(pd_, pt_, df, num_docs=ndocs, **kw)
+        s2, d2 = tfidf_topk_tiered(
+            jnp.asarray(queries), jnp.asarray(t.hot_rank),
+            jnp.asarray(t.hot_tfs), jnp.asarray(t.tier_of),
+            jnp.asarray(t.row_of),
+            tuple(jnp.asarray(a) for a in t.tier_docs),
+            tuple(jnp.asarray(a) for a in t.tier_tfs),
+            p.df, jnp.int32(ndocs), num_docs=ndocs, k=5)
         np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
-                                   rtol=1e-4, err_msg=str(threshold))
+                                   rtol=1e-4, err_msg=str(kw))
+
+
+def test_bm25_tiered_matches_dense():
+    """BM25 on the tiered layout must equal bm25_topk_dense under every
+    layout regime (the path that unlocks BM25 past the dense budget)."""
+    from tpu_ir.ops.scoring import (bm25_topk_dense, bm25_topk_tiered,
+                                    dense_tf_matrix)
+    from tpu_ir.search.layout import build_tiered_layout
+
+    p, oracle, vocab, ndocs = _small_index()
+    tf_mat = dense_tf_matrix(p.pair_term, p.pair_doc, p.pair_tf,
+                             vocab_size=vocab, num_docs=ndocs)
+    df = np.asarray(p.df)
+    pd_, pt_ = np.asarray(p.pair_doc), np.asarray(p.pair_tf)
+    rng = np.random.default_rng(7)
+    doc_len = np.zeros(ndocs + 1, np.int32)
+    doc_len[1:] = rng.integers(5, 50, ndocs)
+
+    queries = np.array([[0, 5, 199], [3, -1, -1], [11, 2, 7]], np.int32)
+    s1, d1 = bm25_topk_dense(jnp.asarray(queries), tf_mat, p.df,
+                             jnp.asarray(doc_len), jnp.int32(ndocs), k=5)
+    for kw in _tier_regimes(vocab, ndocs):
+        t = build_tiered_layout(pd_, pt_, df, num_docs=ndocs, **kw)
+        s2, d2 = bm25_topk_tiered(
+            jnp.asarray(queries), jnp.asarray(t.hot_rank),
+            jnp.asarray(t.hot_tfs), jnp.asarray(t.tier_of),
+            jnp.asarray(t.row_of),
+            tuple(jnp.asarray(a) for a in t.tier_docs),
+            tuple(jnp.asarray(a) for a in t.tier_tfs),
+            p.df, jnp.asarray(doc_len), jnp.int32(ndocs),
+            num_docs=ndocs, k=5)
+        # scores only: ulp-level accumulation-order differences between the
+        # einsum and per-tier scatter paths may reorder tied docnos
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                                   rtol=1e-4, err_msg=str(kw))
+
+
+def test_tiered_ignores_df0_and_out_of_range_terms():
+    """Regression: a df=0 vocab term must contribute nothing under tiered
+    BM25 (its idf is nonzero, and an unmasked tier_of=0 default would alias
+    it onto tier 0 row 0's postings); ditto ids past the vocabulary."""
+    from tpu_ir.ops.scoring import (bm25_topk_dense, bm25_topk_tiered,
+                                    dense_tf_matrix, tfidf_topk_tiered)
+    from tpu_ir.search.layout import build_tiered_layout
+
+    rng = np.random.default_rng(3)
+    vocab, ndocs = 210, 17  # ids 200..209 never occur -> df = 0
+    t = rng.integers(0, 200, 1500).astype(np.int32)
+    d = rng.integers(1, ndocs + 1, 1500).astype(np.int32)
+    term_ids = np.full(4096, PAD_TERM, np.int32)
+    doc_ids = np.zeros(4096, np.int32)
+    term_ids[:1500] = t
+    doc_ids[:1500] = d
+    p = build_postings_jit(jnp.asarray(term_ids), jnp.asarray(doc_ids),
+                           vocab_size=vocab, num_docs=ndocs)
+    df = np.asarray(p.df)
+    assert df[205] == 0
+    lay = build_tiered_layout(np.asarray(p.pair_doc), np.asarray(p.pair_tf),
+                              df, num_docs=ndocs)
+    args = (jnp.asarray(lay.hot_rank), jnp.asarray(lay.hot_tfs),
+            jnp.asarray(lay.tier_of), jnp.asarray(lay.row_of),
+            tuple(jnp.asarray(a) for a in lay.tier_docs),
+            tuple(jnp.asarray(a) for a in lay.tier_tfs))
+    doc_len = np.zeros(ndocs + 1, np.int32)
+    doc_len[1:] = rng.integers(5, 50, ndocs)
+
+    queries = jnp.asarray(np.array([[205, -1], [300, -1]], np.int32))
+    s, dn = bm25_topk_tiered(queries, *args, p.df, jnp.asarray(doc_len),
+                             jnp.int32(ndocs), num_docs=ndocs, k=5)
+    assert (np.asarray(s) == 0).all() and (np.asarray(dn) == 0).all()
+    s, dn = tfidf_topk_tiered(queries, *args, p.df, jnp.int32(ndocs),
+                              num_docs=ndocs, k=5)
+    assert (np.asarray(s) == 0).all() and (np.asarray(dn) == 0).all()
+
+    tf_mat = dense_tf_matrix(p.pair_term, p.pair_doc, p.pair_tf,
+                             vocab_size=vocab, num_docs=ndocs)
+    s, dn = bm25_topk_dense(queries, tf_mat, p.df, jnp.asarray(doc_len),
+                            jnp.int32(ndocs), k=5)
+    assert (np.asarray(s) == 0).all() and (np.asarray(dn) == 0).all()

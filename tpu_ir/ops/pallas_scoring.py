@@ -89,10 +89,8 @@ def pallas_tfidf_scores(
 def pallas_tfidf_topk(q_terms, doc_matrix, df, num_docs, *, k: int = 10,
                       interpret: bool = False):
     """Drop-in for tfidf_topk_dense using the Pallas scoring kernel."""
+    from .scoring import _topk_from_scores
+
     scores = pallas_tfidf_scores(q_terms, doc_matrix, df, num_docs,
                                  interpret=interpret)
-    scores = scores.at[:, 0].set(-jnp.inf)
-    top_scores, top_idx = jax.lax.top_k(scores, min(k, scores.shape[-1]))
-    matched = top_scores > 0.0
-    return (jnp.where(matched, top_scores, 0.0),
-            jnp.where(matched, top_idx, 0).astype(jnp.int32))
+    return _topk_from_scores(scores, k)

@@ -98,11 +98,7 @@ def tfidf_topk_dense(
     rows = doc_matrix[safe_q]                              # [B, L, D+1]
     rows = rows * jnp.where(q_valid, 1.0, 0.0)[..., None]
     scores = jnp.einsum("bld,bl->bd", rows, q_idf)         # [B, D+1]
-    scores = scores.at[:, 0].set(-jnp.inf)                 # dead column
-    top_scores, top_idx = jax.lax.top_k(scores, min(k, scores.shape[-1]))
-    matched = top_scores > 0.0
-    return (jnp.where(matched, top_scores, 0.0),
-            jnp.where(matched, top_idx, 0).astype(jnp.int32))
+    return _topk_from_scores(scores, k)
 
 
 @partial(jax.jit, static_argnames=("k", "k1", "b"))
@@ -132,20 +128,66 @@ def bm25_topk_dense(
     tf = tf_matrix[safe_q]                                  # [B, L, D+1]
     sat = tf * (k1 + 1.0) / (tf + k1 * dl_norm[None, None, :])
     scores = jnp.einsum("bld,bl->bd", sat, q_idf)
-    scores = scores.at[:, 0].set(-jnp.inf)
+    return _topk_from_scores(scores, k)
+
+
+def _topk_from_scores(scores: jax.Array, k: int):
+    scores = scores.at[:, 0].set(-jnp.inf)                   # dead column
     top_scores, top_idx = jax.lax.top_k(scores, min(k, scores.shape[-1]))
     matched = top_scores > 0.0
     return (jnp.where(matched, top_scores, 0.0),
             jnp.where(matched, top_idx, 0).astype(jnp.int32))
 
 
+def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
+                   tier_tfs, q_weight, *, num_docs, hot_weight_fn,
+                   cold_weight_fn):
+    """Shared tiered accumulation: hot-strip einsum + one masked
+    gather/scatter-add per df tier (see search/layout.py for the layout).
+
+    `hot_weight_fn(tf_rows)` / `cold_weight_fn(tfs, docs)` map raw tf to the
+    per-posting score contribution before the q_weight multiply — the only
+    difference between TF-IDF ((1+ln tf)) and BM25 (saturation with the
+    doc-length norm gathered at each posting's docno)."""
+    vocab_size = hot_rank.shape[0]
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)            # [B, L]
+    q_valid = (q_terms >= 0) & (q_terms < vocab_size)
+    q_w = q_weight[safe_q] * q_valid                         # [B, L]
+    rank = hot_rank[safe_q]                                  # [B, L]
+    is_hot = (rank >= 0) & q_valid
+
+    hot_tf = hot_tfs[jnp.where(is_hot, rank, 0)]             # [B, L, D+1]
+    scores = jnp.einsum("bld,bl->bd", hot_weight_fn(hot_tf),
+                        jnp.where(is_hot, q_w, 0.0))         # [B, D+1]
+
+    tof = tier_of[safe_q]                                    # [B, L]
+    row = row_of[safe_q]
+
+    def add_cold(acc_q, slots_q, w_q):
+        return acc_q.at[slots_q.ravel()].add(w_q.ravel(), mode="drop")
+
+    for i, (tdocs, ttfs) in enumerate(zip(tier_docs, tier_tfs)):
+        in_tier = (tof == i) & q_valid & ~is_hot             # [B, L]
+        r = jnp.where(in_tier, row, 0)
+        docs = tdocs[r]                                      # [B, L, P_t]
+        tfs = ttfs[r].astype(jnp.float32)
+        w = cold_weight_fn(tfs, docs)
+        mask = in_tier[..., None]
+        w = jnp.where(tfs > 0, w, 0.0) * q_w[..., None] * mask
+        slot = jnp.where((tfs > 0) & mask, docs, num_docs + 1)
+        scores = jax.vmap(add_cold)(scores, slot, w)
+    return scores
+
+
 @partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
-def tfidf_topk_hybrid(
+def tfidf_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
-    hot_rank: jax.Array,       # int32 [V]: row in hot_rows, or -1 (cold)
-    hot_rows: jax.Array,       # f32 [H, D+1] dense (1+ln tf) rows, hot terms
-    post_docs: jax.Array,      # int32 [V, P] cold-term padded postings
-    post_tfs: jax.Array,       # int32 [V, P] (all-zero rows for hot terms)
+    hot_rank: jax.Array,       # int32 [V]: row in hot_tfs, or -1 (cold)
+    hot_tfs: jax.Array,        # f32 [H, D+1] dense raw-tf rows, hot terms
+    tier_of: jax.Array,        # int32 [V] tier index for cold terms
+    row_of: jax.Array,         # int32 [V] row within the tier
+    tier_docs: tuple,          # of int32 [V_t, P_t]
+    tier_tfs: tuple,           # of int32 [V_t, P_t]
     df: jax.Array,             # int32 [V]
     n_scalar: jax.Array,       # int32 scalar (N)
     *,
@@ -153,12 +195,9 @@ def tfidf_topk_hybrid(
     k: int = 10,
     compat_int_idf: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
-    """Sparse scoring with a dense strip for high-df terms.
-
-    The pure padded layout pays V*P_max memory where P_max is the LARGEST
-    df; here terms with df > P_cap live as dense doc-axis rows (bounded by
-    H*(D+1)) and the padded layout only covers the cold tail — the classic
-    hot/cold split, so one stop-word-like term cannot inflate every row."""
+    """TF-IDF top-k on the tiered sparse layout (search/layout.py): the
+    budget-capped hot strip bounds dense memory, geometric tier capacities
+    bound padding waste, and every shape stays static under jit."""
     dff = df.astype(jnp.float32)
     if compat_int_idf:
         n = jnp.asarray(n_scalar, jnp.int32)
@@ -167,34 +206,57 @@ def tfidf_topk_hybrid(
         ratio = jnp.asarray(n_scalar, jnp.float32) / jnp.maximum(dff, 1.0)
     idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
 
-    safe_q = jnp.where(q_terms >= 0, q_terms, 0)            # [B, L]
-    q_valid = q_terms >= 0
-    q_idf = idf[safe_q] * q_valid                            # [B, L]
-    rank = hot_rank[safe_q]                                  # [B, L]
-    is_hot = (rank >= 0) & q_valid
+    def lntf(tf):
+        return jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
 
-    # hot contribution: dense row gather + weighted sum
-    hot_gather = hot_rows[jnp.where(is_hot, rank, 0)]        # [B, L, D+1]
-    scores = jnp.einsum("bld,bl->bd", hot_gather,
-                        jnp.where(is_hot, q_idf, 0.0))       # [B, D+1]
+    scores = _tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        idf, num_docs=num_docs, hot_weight_fn=lntf,
+        cold_weight_fn=lambda tfs, docs: lntf(tfs))
+    return _topk_from_scores(scores, k)
 
-    # cold contribution: scatter-add the padded postings
-    docs = post_docs[safe_q]                                 # [B, L, P]
-    tfs = post_tfs[safe_q].astype(jnp.float32)
-    w = jnp.where(tfs > 0, 1.0 + jnp.log(jnp.maximum(tfs, 1.0)), 0.0)
-    cold_mask = (q_valid & ~is_hot)[..., None]
-    w = w * q_idf[..., None] * cold_mask
-    slot = jnp.where((tfs > 0) & cold_mask, docs, num_docs + 1)
 
-    def add_cold(acc_q, slots_q, w_q):
-        return acc_q.at[slots_q.ravel()].add(w_q.ravel(), mode="drop")
+@partial(jax.jit, static_argnames=("k", "num_docs", "k1", "b"))
+def bm25_topk_tiered(
+    q_terms: jax.Array,        # int32 [B, L]
+    hot_rank: jax.Array,       # int32 [V]
+    hot_tfs: jax.Array,        # f32 [H, D+1] raw tf
+    tier_of: jax.Array,        # int32 [V]
+    row_of: jax.Array,         # int32 [V]
+    tier_docs: tuple,          # of int32 [V_t, P_t]
+    tier_tfs: tuple,           # of int32 [V_t, P_t]
+    df: jax.Array,             # int32 [V]
+    doc_len: jax.Array,        # int32 [D+1] (slot 0 dead)
+    n_scalar: jax.Array,       # int32 scalar (N)
+    *,
+    num_docs: int,
+    k: int = 10,
+    k1: float = 0.9,
+    b: float = 0.4,
+) -> tuple[jax.Array, jax.Array]:
+    """Okapi BM25 on the tiered sparse layout — the scorer variant that
+    makes BM25 usable past the dense-matrix budget (MS MARCO-scale corpora).
+    Hot terms: saturation over dense raw-tf rows with the [D+1] length norm
+    broadcast. Cold terms: per-posting saturation with the length norm
+    gathered at each posting's docno."""
+    n = jnp.asarray(n_scalar, jnp.float32)
+    dff = df.astype(jnp.float32)
+    # df == 0 terms contribute nothing (parity with the dense path, where
+    # their tf-matrix row is all zeros); BM25's idf alone is nonzero there
+    idf = jnp.where(df > 0,
+                    jnp.log(1.0 + (n - dff + 0.5) / (dff + 0.5)), 0.0)
+    dlf = doc_len.astype(jnp.float32)
+    avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
+    dl_norm = 1.0 - b + b * dlf / jnp.maximum(avg_dl, 1e-9)  # [D+1]
 
-    scores = jax.vmap(add_cold)(scores, slot, w)
-    scores = scores.at[:, 0].set(-jnp.inf)
-    top_scores, top_idx = jax.lax.top_k(scores, min(k, scores.shape[-1]))
-    matched = top_scores > 0.0
-    return (jnp.where(matched, top_scores, 0.0),
-            jnp.where(matched, top_idx, 0).astype(jnp.int32))
+    scores = _tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        idf, num_docs=num_docs,
+        hot_weight_fn=lambda tf: tf * (k1 + 1.0)
+        / (tf + k1 * dl_norm[None, None, :]),
+        cold_weight_fn=lambda tfs, docs: tfs * (k1 + 1.0)
+        / (tfs + k1 * dl_norm[docs]))
+    return _topk_from_scores(scores, k)
 
 
 @partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
@@ -232,8 +294,4 @@ def tfidf_topk_sparse(
         return acc.at[slots_q.ravel()].add(w_q.ravel(), mode="drop")
 
     scores = jax.vmap(score_one)(slot, w)                   # [B, D+1]
-    scores = scores.at[:, 0].set(-jnp.inf)
-    top_scores, top_idx = jax.lax.top_k(scores, min(k, scores.shape[-1]))
-    matched = top_scores > 0.0
-    return (jnp.where(matched, top_scores, 0.0),
-            jnp.where(matched, top_idx, 0).astype(jnp.int32))
+    return _topk_from_scores(scores, k)

@@ -28,6 +28,7 @@ from ..index import format as fmt
 from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense, tfidf_topk_sparse
 from ..ops.scoring import dense_tf_matrix
 from ..utils.transfer import fetch_to_host
+from .layout import build_tiered_layout
 
 # dense [V, D+1] matrix budget in elements (f32); above this use sparse CSR
 DENSE_BUDGET = 500_000_000
@@ -107,32 +108,17 @@ class Scorer:
                     self._mesh, jax.sharding.PartitionSpec("shards")))
             self.doc_bases = jnp.asarray(bases)
         else:
-            # hybrid sparse: terms with df above the 99th percentile become
-            # dense doc-axis rows; the padded layout covers the cold tail
-            indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
-            nonzero_df = df[df > 0]
-            pcap = max(int(np.percentile(nonzero_df, 99))
-                       if len(nonzero_df) else 1, 1)
-            hot_tids = np.nonzero(df > pcap)[0]
-            hot_rank = np.full(v, -1, np.int32)
-            hot_rank[hot_tids] = np.arange(len(hot_tids), dtype=np.int32)
-            hot_rows = np.zeros((max(len(hot_tids), 1), d + 1), np.float32)
-            for r, tid in enumerate(hot_tids):
-                lo, hi = indptr[tid], indptr[tid + 1]
-                hot_rows[r, pair_doc[lo:hi]] = \
-                    1.0 + np.log(pair_tf[lo:hi])
-            post_docs = np.zeros((v, pcap), np.int32)
-            post_tfs = np.zeros((v, pcap), np.int32)
-            for tid in range(v):
-                if hot_rank[tid] >= 0:
-                    continue
-                lo, hi = indptr[tid], indptr[tid + 1]
-                post_docs[tid, : hi - lo] = pair_doc[lo:hi]
-                post_tfs[tid, : hi - lo] = pair_tf[lo:hi]
-            self.hot_rank = jnp.asarray(hot_rank)
-            self.hot_rows = jnp.asarray(hot_rows)
-            self.post_docs = jnp.asarray(post_docs)
-            self.post_tfs = jnp.asarray(post_tfs)
+            # tiered sparse: budget-capped dense strip for the hottest
+            # terms + geometric-capacity padded tiers for the rest
+            # (search/layout.py) — raw tf everywhere so the same arrays
+            # serve TF-IDF and BM25
+            tiers = build_tiered_layout(pair_doc, pair_tf, df, num_docs=d)
+            self.hot_rank = jnp.asarray(tiers.hot_rank)
+            self.hot_tfs = jnp.asarray(tiers.hot_tfs)
+            self.tier_of = jnp.asarray(tiers.tier_of)
+            self.row_of = jnp.asarray(tiers.row_of)
+            self.tier_docs = tuple(jnp.asarray(a) for a in tiers.tier_docs)
+            self.tier_tfs = tuple(jnp.asarray(a) for a in tiers.tier_tfs)
 
     # -- loading -----------------------------------------------------------
 
@@ -311,16 +297,25 @@ class Scorer:
         q = jnp.asarray(q_terms)
         n = jnp.int32(self.meta.num_docs)
         if scoring == "bm25":
-            if self.layout != "dense":
-                raise NotImplementedError("bm25 requires dense layout for now")
-            if self._tf_matrix is None:
-                pt, pd, ptf = self._pairs
-                self._tf_matrix = dense_tf_matrix(
-                    jnp.asarray(pt), jnp.asarray(pd), jnp.asarray(ptf),
-                    vocab_size=self.meta.vocab_size,
-                    num_docs=self.meta.num_docs)
-            s, d = bm25_topk_dense(q, self._tf_matrix, self.df, self.doc_len,
-                                   n, k=k)
+            if self.layout == "dense":
+                if self._tf_matrix is None:
+                    pt, pd, ptf = self._pairs
+                    self._tf_matrix = dense_tf_matrix(
+                        jnp.asarray(pt), jnp.asarray(pd), jnp.asarray(ptf),
+                        vocab_size=self.meta.vocab_size,
+                        num_docs=self.meta.num_docs)
+                s, d = bm25_topk_dense(q, self._tf_matrix, self.df,
+                                       self.doc_len, n, k=k)
+            elif self.layout == "sparse":
+                from ..ops.scoring import bm25_topk_tiered
+
+                s, d = bm25_topk_tiered(
+                    q, self.hot_rank, self.hot_tfs, self.tier_of,
+                    self.row_of, self.tier_docs, self.tier_tfs, self.df,
+                    self.doc_len, n, num_docs=self.meta.num_docs, k=k)
+            else:
+                raise NotImplementedError(
+                    "bm25 is not implemented for the sharded layout")
         elif self.layout == "sharded":
             from ..parallel import sharded_tfidf_topk
 
@@ -331,12 +326,13 @@ class Scorer:
             s, d = tfidf_topk_dense(q, self.doc_matrix, self.df, n, k=k,
                                     compat_int_idf=self.compat_int_idf)
         else:
-            from ..ops.scoring import tfidf_topk_hybrid
+            from ..ops.scoring import tfidf_topk_tiered
 
-            s, d = tfidf_topk_hybrid(
-                q, self.hot_rank, self.hot_rows, self.post_docs,
-                self.post_tfs, self.df, n, num_docs=self.meta.num_docs,
-                k=k, compat_int_idf=self.compat_int_idf)
+            s, d = tfidf_topk_tiered(
+                q, self.hot_rank, self.hot_tfs, self.tier_of, self.row_of,
+                self.tier_docs, self.tier_tfs, self.df, n,
+                num_docs=self.meta.num_docs, k=k,
+                compat_int_idf=self.compat_int_idf)
         return s, d
 
     def search_batch(
