@@ -25,12 +25,14 @@ from ..analysis.native import make_analyzer
 from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
 from ..ops import (
     PAD_TERM,
+    PAD_TERM_U16,
     build_chargram_index_jit,
-    build_postings_jit,
+    build_postings_packed_jit,
     pack_term_bytes,
 )
 from ..ops.postings import pair_term_from_df
 from ..utils import JobReport, fetch_to_host
+from ..utils.transfer import narrow_uint, shrink_for_fetch, shrink_pairs
 from . import format as fmt
 
 TOKENS_VOCAB = "tokens.txt"  # single-token vocab for char-gram lookups (k>1)
@@ -143,10 +145,10 @@ def build_index(
                   + 1).astype(np.int32)
 
     flat_term_ids = inverse.astype(np.int32)
-    flat_doc_ids = np.repeat(docnos, lengths).astype(np.int32)
 
     deferred = None  # single-device: big pair arrays still in flight to host
     if spmd_devices:
+        flat_doc_ids = np.repeat(docnos, lengths).astype(np.int32)
         # --- SPMD path: doc-sharded map + all_to_all shuffle + term-sharded
         # reduce; each device's output IS its part-NNNNN file (the Hadoop
         # reducer-output layout, with the shuffle on ICI) ---
@@ -165,21 +167,33 @@ def build_index(
             granule = 1 << 18
             cap = max(granule,
                       (occurrences + granule - 1) // granule * granule)
-            term_ids = np.full(cap, PAD_TERM, np.int32)
-            doc_ids = np.zeros(cap, np.int32)
+            # slim upload: term ids as uint16 when the vocab fits; the doc
+            # column is reconstructed on device from (docno, length) per doc
+            use16 = v < int(PAD_TERM_U16)
+            term_ids = np.full(
+                cap, PAD_TERM_U16 if use16 else PAD_TERM,
+                np.uint16 if use16 else np.int32)
             term_ids[:occurrences] = flat_term_ids
-            doc_ids[:occurrences] = flat_doc_ids
-            p = build_postings_jit(
-                jnp.asarray(term_ids), jnp.asarray(doc_ids),
+            p = build_postings_packed_jit(
+                jnp.asarray(term_ids), jnp.asarray(docnos),
+                jnp.asarray(lengths.astype(np.int32)),
                 vocab_size=v, num_docs=num_docs)
-            # no blocking here: start every result copy in the background
-            # (num_pairs = df.sum() and pair_term = term-major repeat of df
-            # are recovered on host, so nothing needs a device sync) and let
-            # the char-gram programs below keep the device busy while the
-            # copies stream back
-            deferred = (p.df, p.doc_len, p.pair_doc, p.pair_tf)
-            for a in deferred:
+            # one small blocking fetch (df et al.) tells the host the valid
+            # pair count and tf range, then the capacity-padded pair columns
+            # are sliced + narrowed ON DEVICE before their D2H copy — the
+            # tunnel's ~25 MB/s D2H link is the build's critical path, and
+            # this cuts the big transfer ~3x. Copies then stream back while
+            # the char-gram programs below keep the device busy.
+            df, doc_len, tf_max = fetch_to_host(
+                p.df, p.doc_len, jnp.max(p.pair_tf))
+            num_pairs = int(df.sum())
+            report.set_counter("num_pairs", num_pairs)
+            pair_doc_d, pair_tf_d = shrink_pairs(
+                p.pair_doc, p.pair_tf, num_pairs, num_docs=num_docs,
+                tf_max=int(tf_max), granule=granule)
+            for a in (pair_doc_d, pair_tf_d):
                 a.copy_to_host_async()
+            deferred = (df, doc_len, pair_doc_d, pair_tf_d)
 
     # --- char-k-gram indexes (CharKGramTermIndexer); runs while the
     # postings arrays stream back to host ---
@@ -201,8 +215,6 @@ def build_index(
         offset_of = np.zeros(v, np.int64)
         if deferred is not None:
             df, doc_len, pair_doc, pair_tf = fetch_to_host(*deferred)
-            num_pairs = int(df.sum())
-            report.set_counter("num_pairs", num_pairs)
             np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
             # selection per shard is one boolean mask over the pairs' terms
             pair_shard = shard_of[pair_term_from_df(df)]
@@ -304,14 +316,23 @@ def build_chargram_artifacts(
     # previous k's results are collected, so compute and D2H copies overlap
     # while at most two result sets are live on device at once
 
+    num_terms = len(terms)
+
     def collect(ck, idx, report):
-        # batched fetch, no device scalar syncs: the valid-prefix lengths
-        # are recovered on host (gram_codes is PAD_TERM-padded and sorted;
-        # indptr[ng] is the entry count)
-        gram_codes, indptr, term_ids = fetch_to_host(
-            idx.gram_codes, idx.indptr, idx.term_ids)
-        ng = int(np.searchsorted(gram_codes, PAD_TERM))
-        ne = int(indptr[ng])
+        # the count scalars (already async in flight) tell the host the
+        # valid prefixes; the capacity-padded result arrays are then sliced
+        # + narrowed on device so only real entries cross the tunnel
+        # (~4x fewer D2H bytes than fetching the padded arrays)
+        ng, ne = (int(x) for x in
+                  fetch_to_host(idx.num_grams, idx.num_entries))
+        shrunk = (
+            shrink_for_fetch(idx.gram_codes, ng,
+                             dtype=np.uint16 if ck <= 2 else np.int32),
+            shrink_for_fetch(idx.indptr, ng + 1),
+            shrink_for_fetch(idx.term_ids, ne,
+                             dtype=narrow_uint(num_terms - 1)),
+        )
+        gram_codes, indptr, term_ids = fetch_to_host(*shrunk)
         fmt.save_chargram(
             index_dir, ck,
             gram_codes=gram_codes[:ng],
@@ -326,9 +347,10 @@ def build_chargram_artifacts(
     for ck in ks:
         # report opens at dispatch so wall_s covers the device program, not
         # just the fetch+write in collect()
-        report = JobReport("CharKGramTermIndexer", config={"k": ck})
+        report = JobReport("CharKGramTermIndexer", config={"k": ck},
+                           suffix=f"-k{ck}")
         idx = build_chargram_index_jit(tb, tl, k=ck)
-        for a in (idx.gram_codes, idx.indptr, idx.term_ids):
+        for a in (idx.num_grams, idx.num_entries):
             a.copy_to_host_async()
         if prev is not None:
             collect(*prev)

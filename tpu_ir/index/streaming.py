@@ -31,9 +31,10 @@ import numpy as np
 
 from ..analysis.native import make_analyzer
 from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
-from ..ops import PAD_TERM, build_postings_jit
+from ..ops import PAD_TERM, PAD_TERM_U16, build_postings_packed_jit
 from ..ops.postings import pair_term_from_df, reduce_weighted_postings_jit
 from ..utils import JobReport, fetch_to_host
+from ..utils.transfer import narrow_uint, shrink_for_fetch, shrink_pairs
 from . import format as fmt
 from .builder import build_chargram_artifacts
 
@@ -126,39 +127,56 @@ def build_index_streaming(
         report.set_counter("reduce_output_groups", v)
 
     # ---- pass 2: combine per batch, spill pairs per term shard ----
+    # depth-1 dispatch/collect pipeline: batch b+1's host prep + device
+    # program overlap batch b's D2H copies; the pair columns are sliced +
+    # narrowed on device before the copy (see builder.py — the tunnel's
+    # D2H bandwidth is the critical path)
     doc_len = np.zeros(num_docs + 1, np.int64)
     occurrences = 0
+    use16 = v < int(PAD_TERM_U16)
+
+    def collect_batch(b, p, tf_max):
+        df_b, tfm = fetch_to_host(p.df, tf_max)
+        npairs = int(df_b.sum())
+        pd, ptf = fetch_to_host(*shrink_pairs(
+            p.pair_doc, p.pair_tf, npairs, num_docs=num_docs,
+            tf_max=int(tfm)))
+        pt = pair_term_from_df(df_b)
+        pd = pd[:npairs]
+        ptf = ptf[:npairs]
+        shard = pt % num_shards
+        for s in range(num_shards):
+            sel = shard == s
+            np.savez(os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"),
+                     term=pt[sel], doc=pd[sel], tf=ptf[sel])
+
     with report.phase("pass2_combine"):
+        pending = None
         for b in range(n_batches):
             with np.load(os.path.join(spill_dir, f"tokens-{b:05d}.npz")) as z:
                 flat, lengths, docids = z["flat"], z["lengths"], z["docids"]
             occurrences += len(flat)
-            term_ids = np.searchsorted(vocab_terms, flat).astype(np.int32)
+            term_ids = np.searchsorted(vocab_terms, flat)
             docnos = (np.searchsorted(sorted_docids, docids) + 1).astype(
                 np.int32)
-            doc_ids = np.repeat(docnos, lengths)
-            np.add.at(doc_len, doc_ids, 1)
+            np.add.at(doc_len, np.repeat(docnos, lengths), 1)
 
             cap = _round_cap(len(flat))
-            t_pad = np.full(cap, PAD_TERM, np.int32)
-            d_pad = np.zeros(cap, np.int32)
+            t_pad = np.full(cap, PAD_TERM_U16 if use16 else PAD_TERM,
+                            np.uint16 if use16 else np.int32)
             t_pad[: len(flat)] = term_ids
-            d_pad[: len(flat)] = doc_ids
-            p = build_postings_jit(jnp.asarray(t_pad), jnp.asarray(d_pad),
-                                   vocab_size=v, num_docs=num_docs)
-            # batched fetch (tunnel D2H latency is per-fetch); num_pairs and
-            # pair_term are both implied by df since pairs are term-major
-            df_b, pd_full, ptf_full = fetch_to_host(p.df, p.pair_doc,
-                                                    p.pair_tf)
-            npairs = int(df_b.sum())
-            pt = pair_term_from_df(df_b)
-            pd = pd_full[:npairs]
-            ptf = ptf_full[:npairs]
-            shard = pt % num_shards
-            for s in range(num_shards):
-                sel = shard == s
-                np.savez(os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"),
-                         term=pt[sel], doc=pd[sel], tf=ptf[sel])
+            p = build_postings_packed_jit(
+                jnp.asarray(t_pad), jnp.asarray(docnos),
+                jnp.asarray(lengths.astype(np.int32)),
+                vocab_size=v, num_docs=num_docs)
+            tf_max = jnp.max(p.pair_tf)
+            for a in (p.df, tf_max):
+                a.copy_to_host_async()
+            if pending is not None:
+                collect_batch(*pending)
+            pending = (b, p, tf_max)
+        if pending is not None:
+            collect_batch(*pending)
     report.set_counter("map_output_records", occurrences)
 
     # ---- pass 3: per-shard reduce -> part files ----
@@ -180,16 +198,23 @@ def build_index_streaming(
             w = np.concatenate(tfs) if tfs else np.zeros(0, np.int32)
             cap = _round_cap(max(len(t), 1), 1 << 16)
             t_pad = np.full(cap, PAD_TERM, np.int32)
-            d_pad = np.zeros(cap, np.int32)
-            w_pad = np.zeros(cap, np.int32)
+            d_pad = np.zeros(cap, d.dtype)
+            w_pad = np.zeros(cap, w.dtype)
             t_pad[: len(t)] = t
             d_pad[: len(d)] = d
             w_pad[: len(w)] = w
-            _, rd, rtf, rdf, _ = reduce_weighted_postings_jit(
+            _, rd_d, rtf_d, rdf_d, _ = reduce_weighted_postings_jit(
                 jnp.asarray(t_pad), jnp.asarray(d_pad), jnp.asarray(w_pad),
                 vocab_size=v)
-            rdf, rd, rtf = fetch_to_host(rdf, rd, rtf)
+            rdf = fetch_to_host(rdf_d)[0]
             npairs = int(rdf.sum())
+            # tf sums can't outgrow the spilled dtype: each (term, doc)
+            # pair lives in exactly one batch, so no cross-batch summation
+            rd, rtf = fetch_to_host(
+                shrink_for_fetch(rd_d, npairs, dtype=narrow_uint(num_docs),
+                                 granule=1 << 16),
+                shrink_for_fetch(rtf_d, npairs, dtype=w_pad.dtype,
+                                 granule=1 << 16))
             num_pairs_total += npairs
             df += rdf
             tids = np.nonzero(shard_of == s)[0].astype(np.int32)

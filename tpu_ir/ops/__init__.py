@@ -6,7 +6,16 @@ from .chargram import (
     gram_to_code,
     pack_term_bytes,
 )
-from .postings import PAD_TERM, Postings, build_postings, build_postings_jit, pack_occurrences
+from .postings import (
+    PAD_TERM,
+    PAD_TERM_U16,
+    Postings,
+    build_postings,
+    build_postings_jit,
+    build_postings_packed,
+    build_postings_packed_jit,
+    pack_occurrences,
+)
 from .scoring import (
     PAD_QTERM,
     bm25_topk_dense,
@@ -19,7 +28,8 @@ from .scoring import (
 __all__ = [
     "CharGramIndex", "build_chargram_index", "build_chargram_index_jit",
     "code_to_gram", "gram_to_code", "pack_term_bytes",
-    "PAD_TERM", "Postings", "build_postings", "build_postings_jit",
+    "PAD_TERM", "PAD_TERM_U16", "Postings", "build_postings",
+    "build_postings_jit", "build_postings_packed", "build_postings_packed_jit",
     "pack_occurrences",
     "PAD_QTERM", "bm25_topk_dense", "dense_doc_matrix", "idf_weights",
     "tfidf_topk_dense", "tfidf_topk_sparse",

@@ -20,6 +20,7 @@ class JobReport:
     counters: dict[str, int] = field(default_factory=dict)
     timings_s: dict[str, float] = field(default_factory=dict)
     config: dict = field(default_factory=dict)
+    suffix: str = ""  # distinguishes report files of repeated jobs (per-k)
     _t0: float = field(default_factory=time.perf_counter, repr=False)
 
     def incr(self, name: str, amount: int = 1) -> None:
@@ -54,7 +55,8 @@ class JobReport:
             "config": self.config,
             "finished_at": time.strftime("%Y-%m-%dT%H:%M:%S"),
         }
-        path = os.path.join(os.fspath(jobs_dir), f"{self.job}.json")
+        path = os.path.join(os.fspath(jobs_dir),
+                            f"{self.job}{self.suffix}.json")
         with open(path, "w") as f:
             json.dump(out, f, indent=2, sort_keys=True)
         return path

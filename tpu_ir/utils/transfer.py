@@ -10,6 +10,8 @@ faster than sequential fetches for the index-build result set.
 
 from __future__ import annotations
 
+from functools import partial
+
 import numpy as np
 
 
@@ -24,3 +26,61 @@ def fetch_to_host(*arrays) -> list[np.ndarray]:
         if f is not None:
             f()
     return [np.asarray(a) for a in arrays]
+
+
+_SLICE_CAST = None
+
+
+def _slice_cast(a, *, n: int, dtype):
+    # the jitted callable is created once so its compilation cache persists
+    # across calls (a fresh jax.jit per call would recompile every time)
+    global _SLICE_CAST
+    if _SLICE_CAST is None:
+        import jax
+
+        @partial(jax.jit, static_argnames=("n", "dtype"))
+        def run(x, *, n, dtype):
+            return jax.lax.slice(x, (0,), (n,)).astype(dtype)
+
+        _SLICE_CAST = run
+    return _SLICE_CAST(a, n=n, dtype=np.dtype(dtype))
+
+
+def shrink_for_fetch(a, valid: int, *, dtype=None, granule: int = 1 << 14):
+    """Cut a capacity-padded device array down before its D2H copy.
+
+    Device result arrays are padded to a static capacity, but only a
+    `valid`-length prefix carries data; fetching the full array wastes
+    tunnel bandwidth (the dominant index-build cost on this transport).
+    This dispatches a tiny on-device slice-and-cast so only the valid
+    prefix — in the narrowest safe dtype — crosses the wire. The slice
+    length is bucketed to `granule` so repeat builds reuse one compiled
+    program per bucket. Returns the input unchanged when nothing shrinks.
+    """
+    cap = a.shape[0]
+    n = min(cap, max(granule, -(-valid // granule) * granule))
+    dt = np.dtype(dtype) if dtype is not None else np.dtype(a.dtype)
+    if n == cap and dt == np.dtype(a.dtype):
+        return a
+    return _slice_cast(a, n=n, dtype=dt)
+
+
+def narrow_uint(max_value: int):
+    """Smallest of uint16/int32 that exactly holds values in [0, max_value]."""
+    return np.uint16 if max_value < (1 << 16) else np.int32
+
+
+def shrink_pairs(pair_doc, pair_tf, num_pairs: int, *, num_docs: int,
+                 tf_max: int, granule: int = 1 << 18):
+    """Shrink the two capacity-padded posting pair columns for fetch.
+
+    Returns the (pair_doc, pair_tf) device arrays sliced to the valid-pair
+    bucket and narrowed to the smallest dtypes that hold a docno / tf.
+    Callers either async-copy them (deferred fetch) or fetch immediately.
+    """
+    return (
+        shrink_for_fetch(pair_doc, num_pairs, dtype=narrow_uint(num_docs),
+                         granule=granule),
+        shrink_for_fetch(pair_tf, num_pairs, dtype=narrow_uint(tf_max),
+                         granule=granule),
+    )
