@@ -561,7 +561,12 @@ struct Corpus {
   std::vector<int32_t> token_ids;
   std::unordered_map<std::string, int32_t> vocab;
   std::vector<std::string> vocab_list;
-  std::unordered_map<std::string, std::string> stem_cache;
+  // raw token -> final term id (-1 = stopword): folds the stopword probe,
+  // stem-cache probe, and vocab probe of the hot loop into ONE hash lookup
+  // after a token's first sighting; porter2 is pure, so memoizing the whole
+  // mapping is semantically identical to the 3-step path. Bounded by the
+  // number of distinct raw tokens (~vocab size).
+  std::unordered_map<std::string, int32_t> tok2id;
   // per skipped doc: (file_index, start, end) byte range
   std::vector<int64_t> nonascii;
   std::vector<std::string> files;
@@ -647,17 +652,16 @@ int64_t ir_corpus_add_file(void *h, const char *path) {
       tk.run();
       int64_t count = 0;
       for (const std::string &tok : tk.tokens) {
-        if (g_stopwords.count(tok)) continue;
-        std::string stemmed;
-        auto it = c->stem_cache.find(tok);
-        if (it != c->stem_cache.end()) {
-          stemmed = it->second;
+        int32_t id;
+        auto it = c->tok2id.find(tok);
+        if (it != c->tok2id.end()) {
+          id = it->second;
         } else {
-          stemmed = porter2(tok);
-          c->stem_cache.emplace(tok, stemmed);
-          if (c->stem_cache.size() > 50000) c->stem_cache.clear();
+          id = g_stopwords.count(tok) ? -1 : c->term_id(porter2(tok));
+          c->tok2id.emplace(tok, id);
         }
-        c->token_ids.push_back(c->term_id(stemmed));
+        if (id < 0) continue;
+        c->token_ids.push_back(id);
         ++count;
       }
       c->docids.push_back(docid);

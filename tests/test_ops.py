@@ -336,3 +336,87 @@ def test_tiered_ignores_df0_and_out_of_range_terms():
     s, dn = bm25_topk_dense(queries, tf_mat, p.df, jnp.asarray(doc_len),
                             jnp.int32(ndocs), k=5)
     assert (np.asarray(s) == 0).all() and (np.asarray(dn) == 0).all()
+
+
+def test_build_postings_packed_matches_unpacked():
+    """The slim-upload front end (uint16 term ids + on-device doc-column
+    reconstruction from (docno, length)) must agree with build_postings."""
+    from tpu_ir.ops import PAD_TERM_U16, build_postings_packed_jit
+
+    rng = np.random.default_rng(3)
+    vocab, ndocs, cap = 37, 23, 4096
+    lengths = rng.integers(0, 40, ndocs).astype(np.int32)  # incl zero-len doc
+    docnos = rng.permutation(ndocs).astype(np.int32) + 1
+    n_tok = int(lengths.sum())
+    t = rng.integers(0, vocab, n_tok).astype(np.int32)
+    d = np.repeat(docnos, lengths)
+
+    ref_t = np.full(cap, PAD_TERM, np.int32)
+    ref_d = np.zeros(cap, np.int32)
+    ref_t[:n_tok] = t
+    ref_d[:n_tok] = d
+    ref = build_postings_jit(jnp.asarray(ref_t), jnp.asarray(ref_d),
+                             vocab_size=vocab, num_docs=ndocs)
+
+    for use16 in (True, False):
+        packed = np.full(cap, PAD_TERM_U16 if use16 else PAD_TERM,
+                         np.uint16 if use16 else np.int32)
+        packed[:n_tok] = t
+        got = build_postings_packed_jit(
+            jnp.asarray(packed), jnp.asarray(docnos), jnp.asarray(lengths),
+            vocab_size=vocab, num_docs=ndocs)
+        assert int(got.num_pairs) == int(ref.num_pairs)
+        np.testing.assert_array_equal(np.asarray(got.df), np.asarray(ref.df))
+        np.testing.assert_array_equal(np.asarray(got.doc_len),
+                                      np.asarray(ref.doc_len))
+        n = int(ref.num_pairs)
+        for name in ("pair_term", "pair_doc", "pair_tf"):
+            np.testing.assert_array_equal(
+                np.asarray(getattr(got, name))[:n],
+                np.asarray(getattr(ref, name))[:n], err_msg=name)
+
+
+def test_build_postings_packed_u16_boundary_ids():
+    """Term ids right at the uint16 edge (65533/65534) survive the 0xFFFF
+    sentinel remap; the sentinel itself is reserved for padding."""
+    from tpu_ir.ops import PAD_TERM_U16, build_postings_packed_jit
+
+    vocab = 65535 - 1  # the builder's use16 cutoff: v < 65535
+    packed = np.full(256, PAD_TERM_U16, np.uint16)
+    packed[:3] = [65533, 0, 65533]
+    docnos = np.array([7, 9], np.int32)
+    lengths = np.array([2, 1], np.int32)
+    p = build_postings_packed_jit(jnp.asarray(packed), jnp.asarray(docnos),
+                                  jnp.asarray(lengths),
+                                  vocab_size=vocab, num_docs=9)
+    assert int(p.num_pairs) == 3
+    df = np.asarray(p.df)
+    assert df[65533] == 2 and df[0] == 1 and df.sum() == 3
+
+
+def test_narrow_uint_boundary():
+    from tpu_ir.utils.transfer import narrow_uint
+
+    assert narrow_uint(0) == np.uint16
+    assert narrow_uint(65535) == np.uint16   # exact fit
+    assert narrow_uint(65536) == np.int32
+    assert np.array(65535, narrow_uint(65535)) == 65535  # no wraparound
+
+
+def test_shrink_for_fetch_and_pairs():
+    from tpu_ir.utils.transfer import shrink_for_fetch, shrink_pairs
+
+    a = jnp.arange(1 << 16, dtype=jnp.int32)
+    out = shrink_for_fetch(a, 100, dtype=np.uint16, granule=64)
+    assert out.shape[0] == 128 and out.dtype == np.uint16
+    np.testing.assert_array_equal(np.asarray(out)[:100], np.arange(100))
+    # no-op path returns the same array
+    assert shrink_for_fetch(a, 1 << 16, granule=64) is a
+
+    pd = jnp.full((1 << 10,), 70000, jnp.int32)
+    ptf = jnp.full((1 << 10,), 3, jnp.int32)
+    spd, stf = shrink_pairs(pd, ptf, 10, num_docs=100_000, tf_max=3,
+                            granule=32)
+    assert spd.dtype == np.int32     # docnos don't fit uint16
+    assert stf.dtype == np.uint16
+    assert int(np.asarray(spd)[0]) == 70000

@@ -327,7 +327,7 @@ def build_chargram_artifacts(
                   fetch_to_host(idx.num_grams, idx.num_entries))
         shrunk = (
             shrink_for_fetch(idx.gram_codes, ng,
-                             dtype=np.uint16 if ck <= 2 else np.int32),
+                             dtype=narrow_uint((1 << (8 * ck)) - 1)),
             shrink_for_fetch(idx.indptr, ng + 1),
             shrink_for_fetch(idx.term_ids, ne,
                              dtype=narrow_uint(num_terms - 1)),

@@ -165,9 +165,15 @@ def build_index_streaming(
             t_pad = np.full(cap, PAD_TERM_U16 if use16 else PAD_TERM,
                             np.uint16 if use16 else np.int32)
             t_pad[: len(flat)] = term_ids
+            # docnos/lengths are padded to the fixed batch_docs shape
+            # (zero-length repeats are no-ops) so the final partial batch
+            # reuses the same compiled program instead of adding a shape
+            d_pad = np.zeros(batch_docs, np.int32)
+            l_pad = np.zeros(batch_docs, np.int32)
+            d_pad[: len(docnos)] = docnos
+            l_pad[: len(docnos)] = lengths
             p = build_postings_packed_jit(
-                jnp.asarray(t_pad), jnp.asarray(docnos),
-                jnp.asarray(lengths.astype(np.int32)),
+                jnp.asarray(t_pad), jnp.asarray(d_pad), jnp.asarray(l_pad),
                 vocab_size=v, num_docs=num_docs)
             tf_max = jnp.max(p.pair_tf)
             for a in (p.df, tf_max):
