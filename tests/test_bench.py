@@ -1,0 +1,44 @@
+"""The bench configs' eval machinery, at toy scale on CPU.
+
+Guards the planted-relevance corpus generator and the MRR computation that
+back `bench.py --config msmarco` (BASELINE.json's quality metric), and that
+BM25 actually ranks the two-term relevant passage above the single-term
+high-tf distractors it plants.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def test_msmarco_planted_relevance_mrr(tmp_path):
+    import bench
+    from tpu_ir.index import build_index
+    from tpu_ir.search import Scorer
+
+    corpus = str(tmp_path / "c.trec")
+    queries, rel = bench.make_msmarco_corpus(corpus, n_docs=300,
+                                             n_queries=20)
+    assert len(queries) == 20 and rel.min() >= 1 and rel.max() <= 300
+    idx = str(tmp_path / "idx")
+    build_index([corpus], idx, k=1, chargram_ks=[], num_shards=3,
+                compute_chargrams=False)
+    scorer = Scorer.load(idx, layout="dense")
+    q = scorer.analyze_queries(queries, max_terms=4)
+    _, docnos = scorer.topk(q, k=10, scoring="bm25")
+    assert bench._mrr_at_k(rel, docnos) == 1.0
+
+    # tf-idf with raw tf (no saturation) must still find the doc in top-10
+    _, d2 = scorer.topk(q, k=10, scoring="tfidf")
+    assert bench._mrr_at_k(rel, d2) > 0.5
+
+
+def test_mrr_at_k():
+    import bench
+
+    rel = np.array([5, 7, 9])
+    got = np.array([[5, 1, 2], [1, 7, 3], [0, 0, 0]])
+    assert bench._mrr_at_k(rel, got) == round((1.0 + 0.5 + 0.0) / 3, 4)
