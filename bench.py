@@ -202,10 +202,14 @@ def main() -> int:
                     help="force CPU backend (local-mode equivalent)")
     ap.add_argument("--queries", type=int, default=None,
                     help="query-batch size (default: 10000; msmarco: 2000)")
-    ap.add_argument("--config", choices=["ref", "wiki100k", "msmarco"],
+    ap.add_argument("--config",
+                    choices=["ref", "wiki100k", "wiki1m", "msmarco"],
                     default="ref",
                     help="ref = reference-scale corpus (8,761 docs / 23 MB); "
                          "wiki100k = 100k docs / ~270 MB, streaming build; "
+                         "wiki1m = 1M docs / ~2.7 GB, streaming build (no "
+                         "warm-up run — relies on the persistent compile "
+                         "cache, so the first-ever run includes compiles); "
                          "msmarco = 50k passages + 2k planted-relevance "
                          "queries, BM25 MRR@10 + top-1000 candidates")
     args = ap.parse_args()
@@ -216,6 +220,10 @@ def main() -> int:
     streaming = False
     if args.config == "wiki100k":
         DOC_COUNT, TARGET_BYTES, VOCAB_SIZE = 100_000, 270_000_000, 200_000
+        streaming = True
+    elif args.config == "wiki1m":
+        DOC_COUNT, TARGET_BYTES, VOCAB_SIZE = (
+            1_000_000, 2_700_000_000, 500_000)
         streaming = True
 
     if args.cpu:
@@ -263,9 +271,10 @@ def main() -> int:
                 build_index([corpus], out, k=1, chargram_ks=[2, 3],
                             num_shards=10)
 
-        warm_dir = os.path.join(tmp, "index-warmup")
-        one_build(warm_dir)
-        shutil.rmtree(warm_dir)
+        if args.config != "wiki1m":  # 1M-doc warm-up would double a long run
+            warm_dir = os.path.join(tmp, "index-warmup")
+            one_build(warm_dir)
+            shutil.rmtree(warm_dir)
         runs = []
         n_runs = 1 if streaming else 3
         for r in range(n_runs):
@@ -293,7 +302,7 @@ def main() -> int:
 
         # recall@10 vs an exhaustive numpy oracle on a query sample
         # (BASELINE.json: "recall@10 vs CPU reference")
-        sample = 64 if args.config == "ref" else 8
+        sample = {"ref": 64, "wiki1m": 4}.get(args.config, 8)
         recall = _recall_at_10(scorer, q_ids[:sample], docnos[:sample])
         queries_per_sec = args.queries / query_s
 

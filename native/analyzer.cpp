@@ -571,6 +571,11 @@ struct Corpus {
   std::vector<int64_t> nonascii;
   std::vector<std::string> files;
 
+  // chunked-ingestion state: skip ranges relative to the buffer of the
+  // most recent ir_corpus_add_bytes call (take_delta clears the token/doc
+  // vectors, so a delta is always everything currently accumulated)
+  std::vector<int64_t> delta_skips;  // (start, end) pairs
+
   int32_t term_id(const std::string &stemmed) {
     auto it = vocab.find(stemmed);
     if (it != vocab.end()) return it->second;
@@ -579,7 +584,78 @@ struct Corpus {
     vocab_list.push_back(stemmed);
     return id;
   }
+
+  int32_t intern_token(const std::string &tok) {
+    auto it = tok2id.find(tok);
+    if (it != tok2id.end()) return it->second;
+    int32_t id = g_stopwords.count(tok) ? -1 : term_id(porter2(tok));
+    tok2id.emplace(tok, id);
+    return id;
+  }
 };
+
+// Scan every complete <DOC>..</DOC> record in data[0..len) and ingest it.
+// Skipped records (non-ASCII or missing docid) are appended to `skips` as
+// (file_idx, start, end) triples when file_idx >= 0, else as (start, end)
+// pairs (chunk mode). Returns docs ingested.
+int64_t process_records(Corpus *c, const char *data, size_t len,
+                        int64_t file_idx, std::vector<int64_t> *skips) {
+  int64_t added = 0;
+  size_t pos = 0;
+  while (true) {
+    const char *start =
+        (const char *)memmem(data + pos, len - pos, "<DOC>", 5);
+    if (!start) break;
+    size_t s_off = start - data;
+    const char *end = (const char *)memmem(data + s_off + 5,
+                                           len - s_off - 5, "</DOC>", 6);
+    if (!end) break;
+    size_t e_off = end - data + 6;
+
+    // docid between <DOCNO> and </DOCNO>, trimmed
+    const char *dn =
+        (const char *)memmem(data + s_off, e_off - s_off, "<DOCNO>", 7);
+    std::string docid;
+    if (dn) {
+      const char *dne = (const char *)memmem(dn + 7, data + e_off - dn - 7,
+                                             "</DOCNO>", 8);
+      if (dne) {
+        const char *b = dn + 7;
+        const char *e2 = dne;
+        while (b < e2 && (unsigned char)*b <= ' ') ++b;
+        while (e2 > b && (unsigned char)e2[-1] <= ' ') --e2;
+        docid.assign(b, e2);
+      }
+    }
+
+    bool ascii = true;
+    for (size_t i = s_off; i < e_off; ++i)
+      if ((unsigned char)data[i] >= 0x80) { ascii = false; break; }
+
+    if (!ascii || docid.empty()) {
+      if (file_idx >= 0) skips->push_back(file_idx);
+      skips->push_back((int64_t)s_off);
+      skips->push_back((int64_t)e_off);
+    } else {
+      Tokenizer tk;
+      tk.text = data + s_off;
+      tk.n = (int32_t)(e_off - s_off);
+      tk.run();
+      int64_t count = 0;
+      for (const std::string &tok : tk.tokens) {
+        int32_t id = c->intern_token(tok);
+        if (id < 0) continue;
+        c->token_ids.push_back(id);
+        ++count;
+      }
+      c->docids.push_back(docid);
+      c->doc_token_counts.push_back(count);
+      ++added;
+    }
+    pos = e_off;
+  }
+  return added;
+}
 
 }  // namespace
 
@@ -606,71 +682,85 @@ int64_t ir_corpus_add_file(void *h, const char *path) {
   fclose(f);
   int64_t file_idx = (int64_t)c->files.size();
   c->files.emplace_back(path);
+  return process_records(c, data.data(), data.size(), file_idx,
+                         &c->nonascii);
+}
 
-  int64_t added = 0;
-  size_t pos = 0;
-  while (true) {
-    const char *start = (const char *)memmem(data.data() + pos,
-                                             data.size() - pos, "<DOC>", 5);
-    if (!start) break;
-    size_t s_off = start - data.data();
-    const char *end = (const char *)memmem(data.data() + s_off + 5,
-                                           data.size() - s_off - 5,
-                                           "</DOC>", 6);
-    if (!end) break;
-    size_t e_off = end - data.data() + 6;
+// ---- chunked ingestion (streaming builds) ----
+//
+// The caller feeds byte buffers whose records are complete (split the
+// stream at a </DOC> boundary), then drains each delta: token ids + doc
+// lens + docids added since the previous take. Skipped (non-ASCII /
+// docid-less) records are returned as (start, end) offsets into the buffer
+// of THIS add_bytes call, so the caller must take the delta before feeding
+// the next chunk. The incremental vocab spans the whole corpus; ids in
+// deltas are stable temp ids remapped to sorted order by the caller at the
+// end (ir_corpus_stats/ir_corpus_export semantics unchanged).
 
-    // docid between <DOCNO> and </DOCNO>, trimmed
-    const char *dn = (const char *)memmem(data.data() + s_off, e_off - s_off,
-                                          "<DOCNO>", 7);
-    std::string docid;
-    if (dn) {
-      const char *dne = (const char *)memmem(dn + 7,
-                                             data.data() + e_off - dn - 7,
-                                             "</DOCNO>", 8);
-      if (dne) {
-        const char *b = dn + 7;
-        const char *e2 = dne;
-        while (b < e2 && (unsigned char)*b <= ' ') ++b;
-        while (e2 > b && (unsigned char)e2[-1] <= ' ') --e2;
-        docid.assign(b, e2);
-      }
-    }
+int64_t ir_corpus_add_bytes(void *h, const char *data, int64_t len) {
+  Corpus *c = (Corpus *)h;
+  return process_records(c, data, (size_t)len, -1, &c->delta_skips);
+}
 
-    bool ascii = true;
-    for (size_t i = s_off; i < e_off; ++i)
-      if ((unsigned char)data[i] >= 0x80) { ascii = false; break; }
+// out4: n_docs, n_tokens, docids_blob_bytes, n_skip_pairs (delta only)
+void ir_corpus_delta_stats(void *h, int64_t *out4) {
+  Corpus *c = (Corpus *)h;
+  int64_t docid_bytes = 0;
+  for (auto &s : c->docids) docid_bytes += (int64_t)s.size() + 1;
+  out4[0] = (int64_t)c->docids.size();
+  out4[1] = (int64_t)c->token_ids.size();
+  out4[2] = docid_bytes;
+  out4[3] = (int64_t)(c->delta_skips.size() / 2);
+}
 
-    if (!ascii || docid.empty()) {
-      c->nonascii.push_back(file_idx);
-      c->nonascii.push_back((int64_t)s_off);
-      c->nonascii.push_back((int64_t)e_off);
-    } else {
-      Tokenizer tk;
-      tk.text = data.data() + s_off;
-      tk.n = (int32_t)(e_off - s_off);
-      tk.run();
-      int64_t count = 0;
-      for (const std::string &tok : tk.tokens) {
-        int32_t id;
-        auto it = c->tok2id.find(tok);
-        if (it != c->tok2id.end()) {
-          id = it->second;
-        } else {
-          id = g_stopwords.count(tok) ? -1 : c->term_id(porter2(tok));
-          c->tok2id.emplace(tok, id);
-        }
-        if (id < 0) continue;
-        c->token_ids.push_back(id);
-        ++count;
-      }
-      c->docids.push_back(docid);
-      c->doc_token_counts.push_back(count);
-      ++added;
-    }
-    pos = e_off;
+// Export the delta and release its token/doc storage (vocab is kept).
+void ir_corpus_take_delta(void *h, int32_t *ids, int64_t *doc_lens,
+                          char *docids_blob, int64_t *skips_out) {
+  Corpus *c = (Corpus *)h;
+  memcpy(ids, c->token_ids.data(), c->token_ids.size() * sizeof(int32_t));
+  memcpy(doc_lens, c->doc_token_counts.data(),
+         c->doc_token_counts.size() * sizeof(int64_t));
+  char *p = docids_blob;
+  for (auto &s : c->docids) {
+    memcpy(p, s.data(), s.size());
+    p += s.size();
+    *p++ = '\n';
   }
-  return added;
+  if (!c->delta_skips.empty())
+    memcpy(skips_out, c->delta_skips.data(),
+           c->delta_skips.size() * sizeof(int64_t));
+  c->delta_skips.clear();
+  // bounded memory: drop the exported tokens/docids, keep only the vocab
+  c->token_ids.clear();
+  c->doc_token_counts.clear();
+  c->docids.clear();
+}
+
+// Intern one ALREADY-ANALYZED term (vocab insert only — no stopword filter
+// or stemming, which the Python fallback analyzer has already applied) into
+// the corpus-wide vocab; for the rare fallback docs in chunk mode.
+int32_t ir_corpus_intern_term(void *h, const char *term, int32_t len) {
+  Corpus *c = (Corpus *)h;
+  return c->term_id(std::string(term, (size_t)len));
+}
+
+// vocab blob size alone (chunk mode drains docs/tokens via deltas, so
+// ir_corpus_stats' other fields are not meaningful there)
+int64_t ir_corpus_vocab_bytes(void *h) {
+  Corpus *c = (Corpus *)h;
+  int64_t vocab_bytes = 0;
+  for (auto &s : c->vocab_list) vocab_bytes += (int64_t)s.size() + 1;
+  return vocab_bytes;
+}
+
+void ir_corpus_vocab_export(void *h, char *vocab_blob) {
+  Corpus *c = (Corpus *)h;
+  char *p = vocab_blob;
+  for (auto &s : c->vocab_list) {
+    memcpy(p, s.data(), s.size());
+    p += s.size();
+    *p++ = '\n';
+  }
 }
 
 // out8: n_docs, n_tokens, vocab_size, docids_blob_bytes, vocab_blob_bytes,

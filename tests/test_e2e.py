@@ -330,3 +330,58 @@ def test_query_blocking_matches_unblocked(index_dir):
     r1 = s1.search_batch(queries)
     r2 = s2.search_batch(queries)
     assert r1 == r2
+
+
+def test_streaming_chunked_native_multichunk(tmp_path, monkeypatch):
+    """The native chunked reader with chunk boundaries mid-corpus, plus
+    unicode docs (C++ skip -> Python fallback with shared vocab) and a gzip
+    file, must match the in-memory build exactly."""
+    import gzip
+
+    from tpu_ir.analysis import native as native_mod
+    from tpu_ir.index.streaming import build_index_streaming
+
+    def rec(d, t):
+        return f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+
+    plain = tmp_path / "a.trec"
+    texts = [
+        ("P-000", "salmon fishing boats catch silver salmon"),
+        ("P-001", "the café métro fishing club"),      # unicode
+        ("P-002", "quantum computing with fishing nets and boats"),
+        ("P-003", "bears eat honey near the river bank"),
+        ("P-004", "riverbank honey bears fishing expedition"),
+    ]
+    plain.write_text("".join(rec(d, t) for d, t in texts))
+    gz = tmp_path / "b.trec.gz"
+    with gzip.open(gz, "wt") as f:
+        f.write(rec("G-000", "gzip fishing record with salmon"))
+
+    out_mem = str(tmp_path / "mem")
+    out_str = str(tmp_path / "stream")
+    build_index([str(plain), str(gz)], out_mem, k=1, num_shards=3,
+                compute_chargrams=False)
+
+    # force several chunks: tiny chunk budget splits the plain file
+    orig = native_mod.make_chunked_tokenizer
+    monkeypatch.setattr(
+        native_mod, "make_chunked_tokenizer",
+        lambda paths, k=1, chunk_bytes=0: orig(paths, k=k, chunk_bytes=128))
+    import tpu_ir.index.streaming as streaming_mod
+
+    monkeypatch.setattr(streaming_mod, "make_chunked_tokenizer",
+                        native_mod.make_chunked_tokenizer)
+    build_index_streaming([str(plain), str(gz)], out_str, k=1, num_shards=3,
+                          batch_docs=2, compute_chargrams=False)
+
+    m1 = fmt.IndexMetadata.load(out_mem)
+    m2 = fmt.IndexMetadata.load(out_str)
+    assert (m2.num_pairs, m2.vocab_size) == (m1.num_pairs, m1.vocab_size)
+    for s in range(3):
+        z1, z2 = fmt.load_shard(out_mem, s), fmt.load_shard(out_str, s)
+        for key in ["term_ids", "indptr", "pair_doc", "pair_tf", "df"]:
+            np.testing.assert_array_equal(z1[key], z2[key],
+                                          err_msg=f"{s}/{key}")
+    s1, s2 = Scorer.load(out_mem), Scorer.load(out_str)
+    for q in ["salmon fishing", "café honey"]:
+        assert s1.search(q) == s2.search(q)

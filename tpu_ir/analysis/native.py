@@ -119,20 +119,7 @@ def tokenize_corpus_native(paths):
                                     ctypes.POINTER(ctypes.c_int64)]
     lib.ir_corpus_free.argtypes = [ctypes.c_void_p]
 
-    # expand dirs; split gz files out for the python reader
-    files: list[str] = []
-    for p in paths:
-        p = os.fspath(p)
-        if os.path.isdir(p):
-            files.extend(os.path.join(p, n) for n in sorted(os.listdir(p))
-                         if os.path.isfile(os.path.join(p, n)))
-        else:
-            files.append(p)
-    native_files, py_files = [], []
-    for f in files:
-        with open(f, "rb") as fh:
-            magic = fh.read(2)
-        (py_files if magic == b"\x1f\x8b" else native_files).append(f)
+    native_files, py_files = _split_native_py_files(paths)
 
     h = lib.ir_corpus_new()
     try:
@@ -191,6 +178,229 @@ def tokenize_corpus_native(paths):
         return docids, ids, doc_lens, vocab_list
     finally:
         lib.ir_corpus_free(ctypes.c_void_p(h))
+
+
+def _split_native_py_files(paths):
+    """Expand dirs to sorted regular files and route by gzip magic bytes:
+    (native_files, py_files). Shared by the in-memory and chunked readers so
+    the routing policy cannot diverge."""
+    files: list[str] = []
+    for p in paths:
+        p = os.fspath(p)
+        if os.path.isdir(p):
+            files.extend(os.path.join(p, n) for n in sorted(os.listdir(p))
+                         if os.path.isfile(os.path.join(p, n)))
+        else:
+            files.append(p)
+    native_files, py_files = [], []
+    for f in files:
+        with open(f, "rb") as fh:
+            magic = fh.read(2)
+        (py_files if magic == b"\x1f\x8b" else native_files).append(f)
+    return native_files, py_files
+
+
+def _iter_record_chunks(path: str, chunk_bytes: int):
+    """Yield byte buffers cut at </DOC> boundaries (records stay whole)."""
+    rem = b""
+    with open(path, "rb") as f:
+        while True:
+            buf = f.read(chunk_bytes)
+            if not buf:
+                if rem:
+                    yield rem  # trailing bytes; an incomplete record is
+                break          # ignored by the record scanner
+            buf = rem + buf
+            cut = buf.rfind(b"</DOC>")
+            if cut < 0:
+                rem = buf
+                continue
+            cut += 6
+            yield buf[:cut]
+            rem = buf[cut:]
+
+
+class NativeChunkedTokenizer:
+    """Streaming whole-corpus ingestion in bounded memory (C++ chunk scan).
+
+    Feed order: for each non-gzip file, ~chunk_bytes buffers split at record
+    boundaries go through the C++ scanner (incremental corpus-wide vocab);
+    each chunk's delta — docids, temp term ids, per-doc lengths — is drained
+    immediately, so C++ holds only the vocab between chunks. Non-ASCII /
+    docid-less records and gzip files take the Python analyzer path, with
+    terms interned into the same C++ vocab. Temp ids are insertion-ordered;
+    call vocab() after the last delta and remap (argsort) like the
+    in-memory builder does.
+    """
+
+    #: docs per delta yielded by the Python-analyzer (gzip) file path, so a
+    #: multi-GB gzip corpus still streams in bounded memory
+    PY_BATCH_DOCS = 5_000
+
+    def __init__(self, paths, chunk_bytes: int = 8 << 20):
+        import numpy as np
+
+        self._np = np
+        self._chunk_bytes = chunk_bytes
+        lib = load_native()
+        if lib is None or not hasattr(lib, "ir_corpus_add_bytes"):
+            raise RuntimeError("native chunked ingestion unavailable")
+        # classify input files BEFORE allocating the C++ handle: a missing
+        # corpus path must surface as its real FileNotFoundError, not leak
+        # the handle and get masked by the factory's fallback
+        self._native_files, self._py_files = _split_native_py_files(paths)
+        lib.ir_corpus_new.restype = ctypes.c_void_p
+        lib.ir_corpus_add_bytes.restype = ctypes.c_int64
+        lib.ir_corpus_add_bytes.argtypes = [ctypes.c_void_p, ctypes.c_char_p,
+                                            ctypes.c_int64]
+        lib.ir_corpus_delta_stats.argtypes = [ctypes.c_void_p,
+                                              ctypes.POINTER(ctypes.c_int64)]
+        lib.ir_corpus_intern_term.restype = ctypes.c_int32
+        lib.ir_corpus_intern_term.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_char_p, ctypes.c_int32]
+        lib.ir_corpus_vocab_bytes.restype = ctypes.c_int64
+        lib.ir_corpus_vocab_bytes.argtypes = [ctypes.c_void_p]
+        lib.ir_corpus_vocab_export.argtypes = [ctypes.c_void_p,
+                                               ctypes.c_char_p]
+        lib.ir_corpus_free.argtypes = [ctypes.c_void_p]
+        self._lib = lib
+        self._h = lib.ir_corpus_new()
+        self._py = Analyzer()
+
+    def _intern_terms(self, terms):
+        lib, h = self._lib, self._h
+        out = []
+        for t in terms:
+            raw = t.encode("utf-8")
+            out.append(lib.ir_corpus_intern_term(h, raw, len(raw)))
+        return out
+
+    def _take_delta(self, chunk: bytes | None):
+        np = self._np
+        stats = (ctypes.c_int64 * 4)()
+        self._lib.ir_corpus_delta_stats(self._h, stats)
+        n_doc, n_tok, docid_b, n_skip = (int(x) for x in stats)
+        ids = np.empty(n_tok, np.int32)
+        lens = np.empty(n_doc, np.int64)
+        docid_buf = ctypes.create_string_buffer(max(docid_b, 1))
+        skips = (ctypes.c_int64 * max(n_skip * 2, 1))()
+        self._lib.ir_corpus_take_delta(
+            ctypes.c_void_p(self._h),
+            ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            lens.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            docid_buf, skips)
+        docids = (docid_buf.raw[:docid_b].decode("utf-8").split("\n")[:-1]
+                  if docid_b else [])
+        if n_skip:
+            from ..collection.trec import TrecDocument
+
+            extra_ids: list[int] = []
+            for i in range(n_skip):
+                lo, hi = skips[2 * i], skips[2 * i + 1]
+                doc = TrecDocument(lo, chunk[lo:hi].decode("utf-8", "replace"))
+                toks = [t for t in self._intern_terms(
+                    self._py.analyze(doc.content)) if t >= 0]
+                docids.append(doc.docid)
+                extra_ids.extend(toks)
+                lens = np.append(lens, np.int64(len(toks)))
+            ids = np.concatenate([ids, np.array(extra_ids, np.int32)])
+        return docids, ids, lens
+
+    def deltas(self):
+        """Yield (docids, temp_ids int32, doc_lens int64) per chunk."""
+        from ..collection.trec import read_trec_file
+
+        np = self._np
+        for f in self._native_files:
+            for chunk in _iter_record_chunks(f, self._chunk_bytes):
+                if self._lib.ir_corpus_add_bytes(
+                        ctypes.c_void_p(self._h), chunk, len(chunk)) < 0:
+                    raise OSError(f"native chunk scan failed in {f}")
+                yield self._take_delta(chunk)
+        for f in self._py_files:
+            docids, flat, lens = [], [], []
+            for doc in read_trec_file(f):
+                toks = [t for t in self._intern_terms(
+                    self._py.analyze(doc.content)) if t >= 0]
+                docids.append(doc.docid)
+                flat.extend(toks)
+                lens.append(len(toks))
+                if len(docids) >= self.PY_BATCH_DOCS:
+                    yield (docids, np.array(flat, np.int32),
+                           np.array(lens, np.int64))
+                    docids, flat, lens = [], [], []
+            if docids:
+                yield docids, np.array(flat, np.int32), np.array(
+                    lens, np.int64)
+
+    def vocab(self) -> list[str]:
+        nbytes = int(self._lib.ir_corpus_vocab_bytes(ctypes.c_void_p(self._h)))
+        buf = ctypes.create_string_buffer(max(nbytes, 1))
+        self._lib.ir_corpus_vocab_export(ctypes.c_void_p(self._h), buf)
+        return buf.raw[:nbytes].decode("utf-8").split("\n")[:-1] if nbytes \
+            else []
+
+    def close(self):
+        if self._h is not None:
+            self._lib.ir_corpus_free(ctypes.c_void_p(self._h))
+            self._h = None
+
+
+class PyChunkedTokenizer:
+    """Pure-Python fallback with the NativeChunkedTokenizer interface;
+    also the k>1 path (k-gram composition happens on analyzed tokens)."""
+
+    def __init__(self, paths, k: int = 1, batch_docs: int = 5_000):
+        self._paths = paths
+        self._k = k
+        self._batch = batch_docs
+        self._an = make_analyzer()
+        self._vocab: dict[str, int] = {}
+
+    def _intern(self, term: str) -> int:
+        tid = self._vocab.get(term)
+        if tid is None:
+            tid = len(self._vocab)
+            self._vocab[term] = tid
+        return tid
+
+    def deltas(self):
+        import numpy as np
+
+        from ..collection import kgram_terms, read_trec_corpus
+
+        docids, flat, lens = [], [], []
+        for doc in read_trec_corpus(self._paths):
+            toks = self._an.analyze(doc.content)
+            grams = kgram_terms(toks, self._k) if self._k > 1 else toks
+            docids.append(doc.docid)
+            flat.extend(self._intern(g) for g in grams)
+            lens.append(len(grams))
+            if len(docids) >= self._batch:
+                yield (docids, np.array(flat, np.int32),
+                       np.array(lens, np.int64))
+                docids, flat, lens = [], [], []
+        if docids:
+            yield docids, np.array(flat, np.int32), np.array(lens, np.int64)
+
+    def vocab(self) -> list[str]:
+        return list(self._vocab)
+
+    def close(self):
+        pass
+
+
+def make_chunked_tokenizer(paths, k: int = 1, chunk_bytes: int = 8 << 20):
+    """Native chunked ingestion when possible (k == 1, library present),
+    else the Python fallback. Both yield insertion-ordered temp ids."""
+    if k == 1:
+        try:
+            return NativeChunkedTokenizer(paths, chunk_bytes=chunk_bytes)
+        except RuntimeError:
+            # library unavailable only — real I/O errors (missing corpus
+            # file etc.) propagate instead of masquerading as a fallback
+            pass
+    return PyChunkedTokenizer(paths, k=k)
 
 
 def make_analyzer(native: bool = True):

@@ -3,14 +3,17 @@
 Architecture mirrors Hadoop's spill-and-merge (the reference's substrate)
 with the merge as a device op:
 
-  pass 1 (map): stream the corpus in document batches; tokenize each batch
-    (native analyzer), cache its tokens + docids to a spill directory, and
-    keep only the batch's unique terms (memory = global vocab, not corpus).
-  between passes: docno mapping (sorted docids) + vocab (merge of per-batch
-    uniques) — vectorized via np.unique/searchsorted.
-  pass 2 (combine + spill): re-read each token batch, map terms to ids with
-    np.searchsorted, pre-aggregate (term, doc, tf) on device (the combiner),
-    and spill each batch's pairs partitioned by term shard (term_id % S).
+  pass 1 (map): stream the corpus in byte chunks through the native (C++)
+    scanner — record split, analysis, and an incremental corpus-wide vocab
+    all happen in C++; each chunk's delta (temp term ids + doc lens) is
+    drained immediately and spilled as int arrays. Python never touches a
+    token string (the pure-Python fallback tokenizer keeps the same
+    temp-id interface). Memory = the vocab + one chunk.
+  between passes: docno mapping (sorted docids) + vocab argsort; a rank
+    array remaps temp ids -> sorted ids with one vectorized gather.
+  pass 2 (combine + spill): re-read each id batch, remap via rank,
+    pre-aggregate (term, doc, tf) on device (the combiner), and spill each
+    batch's pairs partitioned by term shard (term_id % S).
   pass 3 (reduce): per term shard, concatenate its spills and run one
     device reduce (reduce_weighted_postings) -> part-NNNNN file. Peak memory
     is one shard's pairs, never the whole index.
@@ -29,8 +32,8 @@ from typing import Iterable, Sequence
 import jax.numpy as jnp
 import numpy as np
 
-from ..analysis.native import make_analyzer
-from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
+from ..analysis.native import make_chunked_tokenizer
+from ..collection import DocnoMapping, Vocab
 from ..ops import PAD_TERM, PAD_TERM_U16, build_postings_packed_jit
 from ..ops.postings import pair_term_from_df, reduce_weighted_postings_jit
 from ..utils import JobReport, fetch_to_host
@@ -70,50 +73,49 @@ def build_index_streaming(
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "num_shards": num_shards, "streaming": True,
         "batch_docs": batch_docs})
-    analyzer = make_analyzer()
 
-    # ---- pass 1: tokenize + spill token batches, accumulate vocab ----
-    vocab_terms: np.ndarray | None = None  # sorted unique terms so far
+    # ---- pass 1: chunked tokenize -> spill temp-id batches ----
+    # (each spill batch covers a contiguous docid range; pass 2 walks the
+    # same order, so batch b's docids are all_docids[ofs : ofs + len(lens)])
     all_docids: list[str] = []
     n_batches = 0
+    tok = make_chunked_tokenizer(corpus_paths, k=k)
     with report.phase("pass1_tokenize"):
-        batch_tokens: list[list[str]] = []
-        batch_docids: list[str] = []
+        acc_ids: list[np.ndarray] = []
+        acc_lens: list[np.ndarray] = []
+        acc_docs = 0
 
         def flush():
-            nonlocal vocab_terms, n_batches
-            if not batch_docids:
+            nonlocal n_batches, acc_docs
+            if not acc_docs:
                 return
-            flat = np.array(
-                [t for toks in batch_tokens for t in toks], dtype=np.str_)
-            lengths = np.fromiter((len(t) for t in batch_tokens), np.int64,
-                                  len(batch_tokens))
-            uniq = np.unique(flat)
-            vocab_terms = uniq if vocab_terms is None else np.union1d(
-                vocab_terms, uniq)
             np.savez(os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
-                     flat=flat, lengths=lengths,
-                     docids=np.array(batch_docids, dtype=np.str_))
+                     ids=np.concatenate(acc_ids),
+                     lengths=np.concatenate(acc_lens))
             n_batches += 1
-            batch_tokens.clear()
-            batch_docids.clear()
+            acc_ids.clear()
+            acc_lens.clear()
+            acc_docs = 0
 
-        for doc in read_trec_corpus(corpus_paths):
-            report.incr("Count.DOCS")
-            toks = analyzer.analyze(doc.content)
-            batch_docids.append(doc.docid)
-            all_docids.append(doc.docid)
-            batch_tokens.append(kgram_terms(toks, k) if k > 1 else toks)
-            if len(batch_docids) >= batch_docs:
-                flush()
-        flush()
+        try:
+            for docids_d, ids_d, lens_d in tok.deltas():
+                report.incr("Count.DOCS", len(docids_d))
+                all_docids.extend(docids_d)
+                acc_ids.append(ids_d)
+                acc_lens.append(lens_d)
+                acc_docs += len(docids_d)
+                if acc_docs >= batch_docs:
+                    flush()
+            flush()
+            vocab_list = tok.vocab()
+        finally:
+            tok.close()
 
     num_docs = len(all_docids)
     if num_docs == 0:
         raise ValueError(f"no <DOC> records found in {corpus_paths}")
-    assert vocab_terms is not None
 
-    # ---- between passes: docno mapping + vocab ----
+    # ---- between passes: docno mapping + vocab (temp -> sorted rank) ----
     with report.phase("docno_mapping"):
         mapping = DocnoMapping.build(all_docids)
         if len(mapping) != num_docs:
@@ -121,7 +123,11 @@ def build_index_streaming(
         mapping.save(os.path.join(index_dir, fmt.DOCNOS))
         sorted_docids = np.array(mapping.docids, dtype=np.str_)
     with report.phase("vocab"):
-        vocab = Vocab(vocab_terms.tolist())
+        vocab_arr = np.array(vocab_list, dtype=np.str_)
+        order = np.argsort(vocab_arr)
+        rank = np.empty(len(order), np.int32)
+        rank[order] = np.arange(len(order), dtype=np.int32)
+        vocab = Vocab(vocab_arr[order].tolist())
         vocab.save(os.path.join(index_dir, fmt.VOCAB))
         v = len(vocab)
         report.set_counter("reduce_output_groups", v)
@@ -152,24 +158,31 @@ def build_index_streaming(
 
     with report.phase("pass2_combine"):
         pending = None
+        ofs = 0
         for b in range(n_batches):
             with np.load(os.path.join(spill_dir, f"tokens-{b:05d}.npz")) as z:
-                flat, lengths, docids = z["flat"], z["lengths"], z["docids"]
+                flat, lengths = z["ids"], z["lengths"]
             occurrences += len(flat)
-            term_ids = np.searchsorted(vocab_terms, flat)
+            term_ids = rank[flat]
+            docids = np.array(all_docids[ofs : ofs + len(lengths)],
+                              dtype=np.str_)
+            ofs += len(lengths)
             docnos = (np.searchsorted(sorted_docids, docids) + 1).astype(
                 np.int32)
-            np.add.at(doc_len, np.repeat(docnos, lengths), 1)
+            # a doc's length IS its post-analysis occurrence count
+            doc_len[docnos] = lengths
 
             cap = _round_cap(len(flat))
             t_pad = np.full(cap, PAD_TERM_U16 if use16 else PAD_TERM,
                             np.uint16 if use16 else np.int32)
             t_pad[: len(flat)] = term_ids
-            # docnos/lengths are padded to the fixed batch_docs shape
-            # (zero-length repeats are no-ops) so the final partial batch
-            # reuses the same compiled program instead of adding a shape
-            d_pad = np.zeros(batch_docs, np.int32)
-            l_pad = np.zeros(batch_docs, np.int32)
+            # docnos/lengths are padded to a bucketed doc capacity
+            # (zero-length repeats are no-ops) so batches of similar size
+            # share one compiled program shape; batches can overshoot
+            # batch_docs by up to one tokenizer chunk
+            doc_cap = _round_cap(len(lengths), 1 << 14)
+            d_pad = np.zeros(doc_cap, np.int32)
+            l_pad = np.zeros(doc_cap, np.int32)
             d_pad[: len(docnos)] = docnos
             l_pad[: len(docnos)] = lengths
             p = build_postings_packed_jit(
@@ -190,7 +203,30 @@ def build_index_streaming(
     num_pairs_total = 0
     shard_of = np.arange(v, dtype=np.int32) % num_shards
     offset_of = np.zeros(v, np.int64)
+    def collect_shard(s, rd_d, rtf_d, rdf, w_dtype):
+        nonlocal num_pairs_total
+        npairs = int(rdf.sum())
+        # tf sums can't outgrow the spilled dtype: each (term, doc)
+        # pair lives in exactly one batch, so no cross-batch summation
+        rd, rtf = fetch_to_host(
+            shrink_for_fetch(rd_d, npairs, dtype=narrow_uint(num_docs),
+                             granule=1 << 16),
+            shrink_for_fetch(rtf_d, npairs, dtype=w_dtype,
+                             granule=1 << 16))
+        num_pairs_total += npairs
+        df[:] += rdf
+        tids = np.nonzero(shard_of == s)[0].astype(np.int32)
+        lens = rdf[tids].astype(np.int64)
+        local_indptr = np.concatenate([[0], np.cumsum(lens)])
+        offset_of[tids] = local_indptr[:-1]
+        fmt.save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
+                       pair_doc=rd[:npairs],
+                       pair_tf=rtf[:npairs], df=rdf[tids])
+
+    # depth-1 dispatch/collect pipeline across shards, like pass 2: shard
+    # s+1's spill load + host concat + upload overlap shard s's D2H copies
     with report.phase("pass3_reduce"):
+        pending = None
         for s in range(num_shards):
             terms, docs, tfs = [], [], []
             for b in range(n_batches):
@@ -212,24 +248,12 @@ def build_index_streaming(
             _, rd_d, rtf_d, rdf_d, _ = reduce_weighted_postings_jit(
                 jnp.asarray(t_pad), jnp.asarray(d_pad), jnp.asarray(w_pad),
                 vocab_size=v)
-            rdf = fetch_to_host(rdf_d)[0]
-            npairs = int(rdf.sum())
-            # tf sums can't outgrow the spilled dtype: each (term, doc)
-            # pair lives in exactly one batch, so no cross-batch summation
-            rd, rtf = fetch_to_host(
-                shrink_for_fetch(rd_d, npairs, dtype=narrow_uint(num_docs),
-                                 granule=1 << 16),
-                shrink_for_fetch(rtf_d, npairs, dtype=w_pad.dtype,
-                                 granule=1 << 16))
-            num_pairs_total += npairs
-            df += rdf
-            tids = np.nonzero(shard_of == s)[0].astype(np.int32)
-            lens = rdf[tids].astype(np.int64)
-            local_indptr = np.concatenate([[0], np.cumsum(lens)])
-            offset_of[tids] = local_indptr[:-1]
-            fmt.save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
-                           pair_doc=rd[:npairs],
-                           pair_tf=rtf[:npairs], df=rdf[tids])
+            rdf_d.copy_to_host_async()
+            if pending is not None:
+                collect_shard(*pending)
+            pending = (s, rd_d, rtf_d, fetch_to_host(rdf_d)[0], w_pad.dtype)
+        if pending is not None:
+            collect_shard(*pending)
     report.set_counter("num_pairs", num_pairs_total)
 
     with report.phase("dictionary"):
