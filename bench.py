@@ -300,6 +300,18 @@ def main() -> int:
         scores, docnos = scorer.topk(q_ids, k=10)
         query_s = time.perf_counter() - t0
 
+        # single-query latency (REPL-shaped load): one [1, L] query per
+        # topk call, p50/p99 over 50 calls (the reference REPL's per-query
+        # cost was dict lookup + disk seek per term; never measured)
+        lat = []
+        scorer.topk(q_ids[:1], k=10)  # compile the B=1 shape
+        for i in range(50):
+            row = q_ids[i % len(q_ids)][None, :]
+            t0 = time.perf_counter()
+            scorer.topk(row, k=10)
+            lat.append(time.perf_counter() - t0)
+        lat_ms = np.sort(np.array(lat)) * 1e3
+
         # recall@10 vs an exhaustive numpy oracle on a query sample
         # (BASELINE.json: "recall@10 vs CPU reference")
         sample = {"ref": 64, "wiki1m": 4}.get(args.config, 8)
@@ -317,6 +329,8 @@ def main() -> int:
         "corpus_docs": DOC_COUNT,
         "queries_per_sec": round(queries_per_sec, 1),
         "query_batch": args.queries,
+        "query_p50_ms": round(float(lat_ms[len(lat_ms) // 2]), 2),
+        "query_p99_ms": round(float(lat_ms[-1]), 2),
         "recall_at_10": recall,
         "backend": backend,
         "config": args.config,

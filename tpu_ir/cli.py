@@ -270,10 +270,11 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--k", type=int, default=10, help="results per query")
     ps.add_argument("--scoring", choices=["tfidf", "bm25"], default="tfidf")
     ps.add_argument("--layout",
-                    choices=["auto", "dense", "sparse", "sharded"],
+                    choices=["auto", "dense", "sparse", "sharded", "pallas"],
                     default="auto",
                     help="'sharded' distributes doc blocks over all devices "
-                         "with a global top-k merge")
+                         "with a global top-k merge; 'pallas' scores the "
+                         "dense layout with the fused TPU kernel")
     ps.add_argument("--docnos", action="store_true",
                     help="print docnos instead of docids")
     ps.add_argument("--compat", action="store_true",

@@ -89,7 +89,11 @@ def build_index(
     # --- tokenize + vocab + term-id assignment ---
     # fast path (k == 1): the whole corpus pass — TREC splitting, analysis,
     # incremental vocab — runs in C++; Python only remaps temp ids to
-    # sorted-vocab ids with two vectorized passes.
+    # sorted-vocab ids with two vectorized passes. (A chunked variant that
+    # overlapped per-chunk H2D uploads with the scan was tried and lost:
+    # this transport's uploads block the host thread, and chunk padding
+    # inflates the device sort ~25% — the chunked tokenizer pays off in the
+    # streaming builder, not here.)
     native_corpus = None
     if k == 1:
         with report.phase("tokenize"):
@@ -168,7 +172,7 @@ def build_index(
             cap = max(granule,
                       (occurrences + granule - 1) // granule * granule)
             # slim upload: term ids as uint16 when the vocab fits; the doc
-            # column is reconstructed on device from (docno, length) per doc
+            # column is reconstructed on device from per-doc (docno, length)
             use16 = v < int(PAD_TERM_U16)
             term_ids = np.full(
                 cap, PAD_TERM_U16 if use16 else PAD_TERM,

@@ -86,7 +86,14 @@ class Scorer:
         self.layout = layout
         self._pairs = (pair_term, pair_doc, pair_tf)
         self._tf_matrix = None  # built lazily on first BM25 call
-        if layout == "dense":
+        if layout == "pallas":
+            # same dense doc matrix, scored by the fused Pallas kernel
+            # (ops/pallas_scoring.py); interpret mode off-TPU so the
+            # hermetic CPU suite exercises the identical path
+            import jax
+
+            self._pallas_interpret = jax.devices()[0].platform != "tpu"
+        if layout in ("dense", "pallas"):
             self.doc_matrix = dense_doc_matrix(
                 jnp.asarray(pair_term), jnp.asarray(pair_doc),
                 jnp.asarray(pair_tf), vocab_size=v, num_docs=d)
@@ -260,6 +267,9 @@ class Scorer:
 
     # max elements of the [B_block, D+1] score accumulator per dispatch
     SCORE_BUDGET = 250_000_000
+    # pallas layout: the kernel scalar-prefetches its [B, L] id/idf tables
+    # into SMEM (~1 MB per core), so query blocks must stay small
+    PALLAS_BLOCK = 256
 
     def topk(
         self, q_terms: np.ndarray, k: int = 10, scoring: str = "tfidf"
@@ -275,6 +285,9 @@ class Scorer:
         any compute tuning here."""
         b = q_terms.shape[0]
         block = max(1, self.SCORE_BUDGET // (self.meta.num_docs + 1))
+        if self.layout == "pallas" and scoring == "tfidf" \
+                and not self.compat_int_idf:
+            block = min(block, self.PALLAS_BLOCK)
         if b > block:
             # pad to a whole number of blocks so every dispatch reuses one
             # compiled shape; padding rows are all-PAD queries
@@ -297,7 +310,7 @@ class Scorer:
         q = jnp.asarray(q_terms)
         n = jnp.int32(self.meta.num_docs)
         if scoring == "bm25":
-            if self.layout == "dense":
+            if self.layout in ("dense", "pallas"):  # kernel is tf-idf only
                 if self._tf_matrix is None:
                     pt, pd, ptf = self._pairs
                     self._tf_matrix = dense_tf_matrix(
@@ -322,7 +335,13 @@ class Scorer:
             s, d = sharded_tfidf_topk(
                 q, self.doc_blocks, self.doc_bases, self.df, n,
                 mesh=self._mesh, k=k, compat_int_idf=self.compat_int_idf)
-        elif self.layout == "dense":
+        elif self.layout == "pallas" and not self.compat_int_idf:
+            from ..ops.pallas_scoring import pallas_tfidf_topk
+
+            s, d = pallas_tfidf_topk(q, self.doc_matrix, self.df, n, k=k,
+                                     interpret=self._pallas_interpret)
+        elif self.layout in ("dense", "pallas"):
+            # compat int-idf isn't implemented in the kernel; use XLA dense
             s, d = tfidf_topk_dense(q, self.doc_matrix, self.df, n, k=k,
                                     compat_int_idf=self.compat_int_idf)
         else:
