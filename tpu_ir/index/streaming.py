@@ -1,7 +1,7 @@
 """Streaming (out-of-core) index build for corpora that don't fit in memory.
 
-Architecture mirrors Hadoop's spill-and-merge (the reference's substrate)
-with the merge as a device op:
+Architecture mirrors Hadoop's spill-and-merge (the reference's substrate),
+with the per-batch combine as a device op:
 
   pass 1 (map): stream the corpus in byte chunks through the native (C++)
     scanner — record split, analysis, and an incremental corpus-wide vocab
@@ -14,9 +14,11 @@ with the merge as a device op:
   pass 2 (combine + spill): re-read each id batch, remap via rank,
     pre-aggregate (term, doc, tf) on device (the combiner), and spill each
     batch's pairs partitioned by term shard (term_id % S).
-  pass 3 (reduce): per term shard, concatenate its spills and run one
-    device reduce (reduce_weighted_postings) -> part-NNNNN file. Peak memory
-    is one shard's pairs, never the whole index.
+  pass 3 (order + write): per term shard, concatenate its spills and
+    lexsort into the reference posting order -> part-NNNNN file. A host
+    sort, deliberately: batches partition documents so there is nothing to
+    merge, and the spills start and end on host disk. Peak memory is one
+    shard's pairs, never the whole index.
 
 This is the scaling path for the Wikipedia-1M / MS MARCO configs
 (BASELINE.json); the in-memory builder (builder.py) stays the fast path for
@@ -35,9 +37,9 @@ import numpy as np
 from ..analysis.native import make_chunked_tokenizer
 from ..collection import DocnoMapping, Vocab
 from ..ops import PAD_TERM, PAD_TERM_U16, build_postings_packed_jit
-from ..ops.postings import pair_term_from_df, reduce_weighted_postings_jit
+from ..ops.postings import pair_term_from_df
 from ..utils import JobReport, fetch_to_host
-from ..utils.transfer import narrow_uint, shrink_for_fetch, shrink_pairs
+from ..utils.transfer import shrink_pairs
 from . import format as fmt
 from .builder import build_chargram_artifacts
 
@@ -203,30 +205,13 @@ def build_index_streaming(
     num_pairs_total = 0
     shard_of = np.arange(v, dtype=np.int32) % num_shards
     offset_of = np.zeros(v, np.int64)
-    def collect_shard(s, rd_d, rtf_d, rdf, w_dtype):
-        nonlocal num_pairs_total
-        npairs = int(rdf.sum())
-        # tf sums can't outgrow the spilled dtype: each (term, doc)
-        # pair lives in exactly one batch, so no cross-batch summation
-        rd, rtf = fetch_to_host(
-            shrink_for_fetch(rd_d, npairs, dtype=narrow_uint(num_docs),
-                             granule=1 << 16),
-            shrink_for_fetch(rtf_d, npairs, dtype=w_dtype,
-                             granule=1 << 16))
-        num_pairs_total += npairs
-        df[:] += rdf
-        tids = np.nonzero(shard_of == s)[0].astype(np.int32)
-        lens = rdf[tids].astype(np.int64)
-        local_indptr = np.concatenate([[0], np.cumsum(lens)])
-        offset_of[tids] = local_indptr[:-1]
-        fmt.save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
-                       pair_doc=rd[:npairs],
-                       pair_tf=rtf[:npairs], df=rdf[tids])
-
-    # depth-1 dispatch/collect pipeline across shards, like pass 2: shard
-    # s+1's spill load + host concat + upload overlap shard s's D2H copies
+    # pass 3 is a pure sort, NOT a merge: batches partition whole documents,
+    # so a (term, doc) pair exists in exactly one batch and per-batch
+    # combining (pass 2's device group-by) already produced final tfs. The
+    # spills start and end on host disk, so a host lexsort beats shipping
+    # hundreds of MB through the device and back on any backend — the
+    # device keeps the role it wins at: the per-batch shuffle+reduce.
     with report.phase("pass3_reduce"):
-        pending = None
         for s in range(num_shards):
             terms, docs, tfs = [], [], []
             for b in range(n_batches):
@@ -238,22 +223,19 @@ def build_index_streaming(
             t = np.concatenate(terms) if terms else np.zeros(0, np.int32)
             d = np.concatenate(docs) if docs else np.zeros(0, np.int32)
             w = np.concatenate(tfs) if tfs else np.zeros(0, np.int32)
-            cap = _round_cap(max(len(t), 1), 1 << 16)
-            t_pad = np.full(cap, PAD_TERM, np.int32)
-            d_pad = np.zeros(cap, d.dtype)
-            w_pad = np.zeros(cap, w.dtype)
-            t_pad[: len(t)] = t
-            d_pad[: len(d)] = d
-            w_pad[: len(w)] = w
-            _, rd_d, rtf_d, rdf_d, _ = reduce_weighted_postings_jit(
-                jnp.asarray(t_pad), jnp.asarray(d_pad), jnp.asarray(w_pad),
-                vocab_size=v)
-            rdf_d.copy_to_host_async()
-            if pending is not None:
-                collect_shard(*pending)
-            pending = (s, rd_d, rtf_d, fetch_to_host(rdf_d)[0], w_pad.dtype)
-        if pending is not None:
-            collect_shard(*pending)
+            # reference posting order: term asc, tf desc, doc asc
+            # (tf negated as int64: spills may ride as uint16)
+            order = np.lexsort((d, -w.astype(np.int64), t))
+            t, d, w = t[order], d[order], w[order]
+            rdf = np.bincount(t, minlength=v).astype(np.int32)
+            num_pairs_total += len(t)
+            df[:] += rdf
+            tids = np.nonzero(shard_of == s)[0].astype(np.int32)
+            lens = rdf[tids].astype(np.int64)
+            local_indptr = np.concatenate([[0], np.cumsum(lens)])
+            offset_of[tids] = local_indptr[:-1]
+            fmt.save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
+                           pair_doc=d, pair_tf=w, df=rdf[tids])
     report.set_counter("num_pairs", num_pairs_total)
 
     with report.phase("dictionary"):
