@@ -123,7 +123,7 @@ def _run_search(args) -> int:
         kept = [q for q in queries if q not in skipped]
         results = iter(scorer.search_batch(
             kept, k=args.k, scoring=args.scoring,
-            return_docids=show_docids) if kept else [])
+            return_docids=show_docids, rerank=args.rerank) if kept else [])
         for q in queries:
             print(f"query: {q}")
             if q in skipped:
@@ -269,6 +269,9 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--queries-file")
     ps.add_argument("--k", type=int, default=10, help="results per query")
     ps.add_argument("--scoring", choices=["tfidf", "bm25"], default="tfidf")
+    ps.add_argument("--rerank", type=int, default=None, metavar="N",
+                    help="two-stage retrieval: BM25 top-N candidates, then "
+                         "cosine TF-IDF rerank")
     ps.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded", "pallas"],
                     default="auto",

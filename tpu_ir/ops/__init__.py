@@ -19,6 +19,8 @@ from .postings import (
 from .scoring import (
     PAD_QTERM,
     bm25_topk_dense,
+    cosine_rerank_dense,
+    cosine_rerank_tiered,
     dense_doc_matrix,
     idf_weights,
     tfidf_topk_dense,
@@ -31,6 +33,7 @@ __all__ = [
     "PAD_TERM", "PAD_TERM_U16", "Postings", "build_postings",
     "build_postings_jit", "build_postings_packed", "build_postings_packed_jit",
     "pack_occurrences",
-    "PAD_QTERM", "bm25_topk_dense", "dense_doc_matrix", "idf_weights",
+    "PAD_QTERM", "bm25_topk_dense", "cosine_rerank_dense",
+    "cosine_rerank_tiered", "dense_doc_matrix", "idf_weights",
     "tfidf_topk_dense", "tfidf_topk_sparse",
 ]

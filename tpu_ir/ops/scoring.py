@@ -259,6 +259,67 @@ def bm25_topk_tiered(
     return _topk_from_scores(scores, k)
 
 
+def _topk_over_candidates(cand_scores, cand_docnos, k):
+    """Top-k over per-candidate scores [B, C]; docno 0 marks empty slots."""
+    cand = jnp.where(cand_docnos > 0, cand_scores, -jnp.inf)
+    top_scores, idx = jax.lax.top_k(cand, min(k, cand.shape[-1]))
+    docnos = jnp.take_along_axis(cand_docnos, idx, axis=1)
+    matched = top_scores > 0.0
+    return (jnp.where(matched, top_scores, 0.0),
+            jnp.where(matched, docnos, 0).astype(jnp.int32))
+
+
+@partial(jax.jit, static_argnames=("k",))
+def cosine_rerank_dense(
+    q_terms: jax.Array,     # int32 [B, L]
+    doc_matrix: jax.Array,  # f32 [V, D+1] (1+ln tf)
+    df: jax.Array,          # int32 [V]
+    doc_norm: jax.Array,    # f32 [D+1] ||d|| under (1+ln tf)*idf weights
+    cand_docnos: jax.Array,  # int32 [B, C] stage-1 candidates (0 = empty)
+    num_docs: jax.Array,    # int32 scalar
+    *,
+    k: int = 10,
+) -> tuple[jax.Array, jax.Array]:
+    """Stage-2 reranker: cosine-normalized TF-IDF over stage-1 candidates
+    (the classic SMART lnc.ltc second stage; the reference has no rerank —
+    this is the MS MARCO-shaped candidates->rerank composition). Work is
+    B*L*C, not B*L*D: only the candidates' matrix cells are gathered."""
+    vocab_size = doc_matrix.shape[0]
+    idf = idf_weights(df, num_docs)
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)
+    q_valid = (q_terms >= 0) & (q_terms < vocab_size)
+    q_idf = jnp.where(q_valid, idf[safe_q], 0.0)             # [B, L]
+    # one fused gather of exactly the candidate columns: [B, L, C]
+    cand_tf = doc_matrix[safe_q[:, :, None],
+                         cand_docnos.astype(jnp.int32)[:, None, :]]
+    scores = jnp.einsum("blc,bl->bc", cand_tf, q_idf * q_idf)
+    scores = scores / jnp.maximum(doc_norm[cand_docnos], 1e-30)
+    return _topk_over_candidates(scores, cand_docnos, k)
+
+
+@partial(jax.jit, static_argnames=("k", "num_docs"))
+def cosine_rerank_tiered(
+    q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+    df, doc_norm, n_scalar, cand_docnos, *, num_docs: int, k: int = 10,
+):
+    """cosine_rerank_dense on the tiered sparse layout (large corpora).
+    The tiered accumulation is doc-axis-wide by construction, so this path
+    scores [B, D+1] and then gathers the candidates."""
+    idf = idf_weights(df, n_scalar)
+
+    def lntf(tf):
+        return jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
+
+    scores = _tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        idf * idf, num_docs=num_docs, hot_weight_fn=lntf,
+        cold_weight_fn=lambda tfs, docs: lntf(tfs))
+    scores = scores / jnp.maximum(doc_norm, 1e-30)[None, :]
+    cand_scores = jnp.take_along_axis(
+        scores, cand_docnos.astype(jnp.int32), axis=1)
+    return _topk_over_candidates(cand_scores, cand_docnos, k)
+
+
 @partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
 def tfidf_topk_sparse(
     q_terms: jax.Array,        # int32 [B, L]

@@ -271,6 +271,36 @@ class Scorer:
     # into SMEM (~1 MB per core), so query blocks must stay small
     PALLAS_BLOCK = 256
 
+    def _blocked_dispatch(self, block: int, dispatch, *arrays_pads):
+        """Run a per-block device dispatch over padded query-row blocks.
+
+        `arrays_pads` are (array [B, W], pad_value) pairs sliced in lockstep;
+        batches larger than `block` are padded to whole blocks so every
+        dispatch reuses one compiled shape. All blocks are dispatched before
+        any result is fetched, and the score / docno copies run concurrently
+        — the device transport has a large fixed per-fetch latency, so
+        overlapping transfers is worth more than any compute tuning here."""
+        b = arrays_pads[0][0].shape[0]
+        if b == 0:
+            return np.zeros((0, 0), np.float32), np.zeros((0, 0), np.int32)
+        if b > block:
+            padded = (b + block - 1) // block * block
+            padded_arrays = []
+            for a, pad_value in arrays_pads:
+                ap = np.full((padded, a.shape[1]), pad_value, a.dtype)
+                ap[:b] = a
+                padded_arrays.append(ap)
+            outs = [dispatch(*(ap[i : i + block] for ap in padded_arrays))
+                    for i in range(0, padded, block)]
+        else:
+            outs = [dispatch(*(a for a, _ in arrays_pads))]
+        flat = fetch_to_host(*[a for pair in outs for a in pair])
+        parts = [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
+        if len(parts) == 1:
+            return parts[0]
+        return (np.concatenate([p[0] for p in parts])[:b],
+                np.concatenate([p[1] for p in parts])[:b])
+
     def topk(
         self, q_terms: np.ndarray, k: int = 10, scoring: str = "tfidf"
     ) -> tuple[np.ndarray, np.ndarray]:
@@ -278,32 +308,14 @@ class Scorer:
 
         Large batches are scored in query blocks so the per-dispatch score
         accumulator stays within SCORE_BUDGET elements regardless of corpus
-        size (the reference had no batching at all; SURVEY.md §3.3). All
-        blocks are dispatched before any result is fetched, and the score /
-        docno copies run concurrently — the device transport has a large
-        fixed per-fetch latency, so overlapping transfers is worth more than
-        any compute tuning here."""
-        b = q_terms.shape[0]
+        size (the reference had no batching at all; SURVEY.md §3.3)."""
         block = max(1, self.SCORE_BUDGET // (self.meta.num_docs + 1))
         if self.layout == "pallas" and scoring == "tfidf" \
                 and not self.compat_int_idf:
             block = min(block, self.PALLAS_BLOCK)
-        if b > block:
-            # pad to a whole number of blocks so every dispatch reuses one
-            # compiled shape; padding rows are all-PAD queries
-            padded = (b + block - 1) // block * block
-            qp = np.full((padded, q_terms.shape[1]), -1, np.int32)
-            qp[:b] = q_terms
-            outs = [self._topk_device(qp[i : i + block], k, scoring)
-                    for i in range(0, padded, block)]
-        else:
-            outs = [self._topk_device(q_terms, k, scoring)]
-        flat = fetch_to_host(*[a for pair in outs for a in pair])
-        parts = [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
-        if len(parts) == 1:
-            return parts[0]
-        return (np.concatenate([p[0] for p in parts])[:b],
-                np.concatenate([p[1] for p in parts])[:b])
+        return self._blocked_dispatch(
+            block, lambda q: self._topk_device(q, k, scoring),
+            (np.asarray(q_terms, np.int32), -1))
 
     def _topk_device(self, q_terms: np.ndarray, k: int, scoring: str):
         """Dispatch one query block; returns device arrays without waiting."""
@@ -354,12 +366,76 @@ class Scorer:
                 compat_int_idf=self.compat_int_idf)
         return s, d
 
+    def _doc_norms(self):
+        """f32 [D+1] doc-vector norms under (1+ln tf)*idf weighting, for
+        the cosine rerank stage. Built lazily from the host CSR columns."""
+        if getattr(self, "_norms", None) is None:
+            pt, pd, ptf = self._pairs
+            idf = np.asarray(
+                np.log10(np.maximum(
+                    self.meta.num_docs
+                    / np.maximum(np.asarray(self.df, np.float64), 1.0),
+                    1e-30)))
+            idf = np.where(np.asarray(self.df) > 0, idf, 0.0)
+            w = (1.0 + np.log(np.maximum(ptf, 1))) * idf[pt]
+            sq = np.bincount(pd, weights=w * w,
+                             minlength=self.meta.num_docs + 1)
+            self._norms = jnp.asarray(
+                np.sqrt(sq[: self.meta.num_docs + 1]), jnp.float32)
+        return self._norms
+
+    def rerank_topk(
+        self, q_terms: np.ndarray, k: int = 10, candidates: int = 1000,
+    ) -> tuple[np.ndarray, np.ndarray]:
+        """Two-stage retrieval: BM25 top-`candidates`, then cosine TF-IDF
+        (SMART lnc.ltc shape) restricted to those candidates. The reference
+        has no second stage; this is the MS MARCO-style composition on the
+        same resident index."""
+        from ..ops import cosine_rerank_dense
+        from ..ops.scoring import cosine_rerank_tiered
+
+        if self.layout == "sharded":
+            raise NotImplementedError(
+                "rerank is not implemented for the sharded layout")
+        _, cand = self.topk(q_terms, k=candidates, scoring="bm25")
+        if q_terms.shape[0] == 0:
+            return cand.astype(np.float32), cand
+        n = jnp.int32(self.meta.num_docs)
+        norms = self._doc_norms()
+
+        if self.layout in ("dense", "pallas"):
+            # dense rerank work is B*L*C (candidate-gathered)
+            per_q = max(q_terms.shape[1] * cand.shape[1], 1)
+
+            def dispatch(q, c):
+                return cosine_rerank_dense(
+                    jnp.asarray(q), self.doc_matrix, self.df, norms,
+                    jnp.asarray(c), n, k=k)
+        else:
+            # tiered rerank scores the whole doc axis before the gather
+            per_q = self.meta.num_docs + 1
+
+            def dispatch(q, c):
+                return cosine_rerank_tiered(
+                    jnp.asarray(q), self.hot_rank, self.hot_tfs,
+                    self.tier_of, self.row_of, self.tier_docs,
+                    self.tier_tfs, self.df, norms, n, jnp.asarray(c),
+                    num_docs=self.meta.num_docs, k=k)
+        return self._blocked_dispatch(
+            max(1, self.SCORE_BUDGET // per_q), dispatch,
+            (np.asarray(q_terms, np.int32), -1), (cand, 0))
+
     def search_batch(
         self, texts: Sequence[str], k: int = 10, scoring: str = "tfidf",
-        return_docids: bool = True,
+        return_docids: bool = True, rerank: int | None = None,
     ) -> list[SearchResult]:
+        """Ranked retrieval for query texts. `rerank=N` switches to the
+        two-stage pipeline: BM25 top-N candidates, cosine TF-IDF rerank."""
         q = self.analyze_queries(texts)
-        scores, docnos = self.topk(q, k=k, scoring=scoring)
+        if rerank:
+            scores, docnos = self.rerank_topk(q, k=k, candidates=rerank)
+        else:
+            scores, docnos = self.topk(q, k=k, scoring=scoring)
         out = []
         for qi in range(len(texts)):
             res = SearchResult()
