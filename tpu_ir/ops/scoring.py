@@ -32,6 +32,11 @@ import jax.numpy as jnp
 PAD_QTERM = -1
 
 
+def _lntf(tf):
+    """The (1 + ln tf) weight curve; 0 for empty slots."""
+    return jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
+
+
 def idf_weights(df: jax.Array, num_docs: int, compat_int_idf: bool = False) -> jax.Array:
     """log10(N/df) per term; df==0 terms get weight 0."""
     dff = df.astype(jnp.float32)
@@ -57,8 +62,7 @@ def _dense_scatter(pair_term, pair_doc, values, *, vocab_size: int,
 def dense_doc_matrix(postings_pair_term, postings_pair_doc, postings_pair_tf,
                      *, vocab_size: int, num_docs: int) -> jax.Array:
     """[V, D+1] matrix of (1+ln tf); column 0 (docno 0) is dead padding."""
-    tf = postings_pair_tf.astype(jnp.float32)
-    w = jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
+    w = _lntf(postings_pair_tf.astype(jnp.float32))
     return _dense_scatter(postings_pair_term, postings_pair_doc, w,
                           vocab_size=vocab_size, num_docs=num_docs)
 
@@ -145,20 +149,31 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
     """Shared tiered accumulation: hot-strip einsum + one masked
     gather/scatter-add per df tier (see search/layout.py for the layout).
 
-    `hot_weight_fn(tf_rows)` / `cold_weight_fn(tfs, docs)` map raw tf to the
-    per-posting score contribution before the q_weight multiply — the only
-    difference between TF-IDF ((1+ln tf)) and BM25 (saturation with the
-    doc-length norm gathered at each posting's docno)."""
+    `hot_weight_fn(strip)` maps the raw-tf hot strip [H, D+1] (doc axis
+    last) to per-cell score contributions; `cold_weight_fn(tfs, docs)` does
+    the same per padded posting. They are the only difference between
+    TF-IDF ((1+ln tf)) and BM25 (saturation with the doc-length norm —
+    broadcast over the strip's doc axis / gathered at each posting's
+    docno)."""
     vocab_size = hot_rank.shape[0]
+    b = q_terms.shape[0]
     safe_q = jnp.where(q_terms >= 0, q_terms, 0)            # [B, L]
     q_valid = (q_terms >= 0) & (q_terms < vocab_size)
     q_w = q_weight[safe_q] * q_valid                         # [B, L]
     rank = hot_rank[safe_q]                                  # [B, L]
     is_hot = (rank >= 0) & q_valid
 
-    hot_tf = hot_tfs[jnp.where(is_hot, rank, 0)]             # [B, L, D+1]
-    scores = jnp.einsum("bld,bl->bd", hot_weight_fn(hot_tf),
-                        jnp.where(is_hot, q_w, 0.0))         # [B, D+1]
+    # hot strip as an MXU matmul: scatter each query's term weights into a
+    # [B, H] row (duplicate terms sum), then one [B, H] @ [H, D+1] matmul
+    # against the element-wise-weighted strip. The per-(query, term) row
+    # gather it replaces materializes [B, L, D+1] — at 1M docs that is GBs
+    # of HBM traffic per dispatch for the same math.
+    h = hot_tfs.shape[0]
+    w_hot = jnp.zeros((b, h), jnp.float32).at[
+        jnp.broadcast_to(jnp.arange(b)[:, None], rank.shape),
+        jnp.where(is_hot, rank, h),
+    ].add(jnp.where(is_hot, q_w, 0.0), mode="drop")          # [B, H]
+    scores = w_hot @ hot_weight_fn(hot_tfs)                  # [B, D+1]
 
     tof = tier_of[safe_q]                                    # [B, L]
     row = row_of[safe_q]
@@ -206,13 +221,10 @@ def tfidf_topk_tiered(
         ratio = jnp.asarray(n_scalar, jnp.float32) / jnp.maximum(dff, 1.0)
     idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
 
-    def lntf(tf):
-        return jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
-
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
-        idf, num_docs=num_docs, hot_weight_fn=lntf,
-        cold_weight_fn=lambda tfs, docs: lntf(tfs))
+        idf, num_docs=num_docs, hot_weight_fn=_lntf,
+        cold_weight_fn=lambda tfs, docs: _lntf(tfs))
     return _topk_from_scores(scores, k)
 
 
@@ -252,8 +264,9 @@ def bm25_topk_tiered(
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
         idf, num_docs=num_docs,
+        # hot_weight_fn sees the whole [H, D+1] strip (doc axis last)
         hot_weight_fn=lambda tf: tf * (k1 + 1.0)
-        / (tf + k1 * dl_norm[None, None, :]),
+        / (tf + k1 * dl_norm[None, :]),
         cold_weight_fn=lambda tfs, docs: tfs * (k1 + 1.0)
         / (tfs + k1 * dl_norm[docs]))
     return _topk_from_scores(scores, k)
@@ -280,9 +293,14 @@ def cosine_rerank_dense(
     *,
     k: int = 10,
 ) -> tuple[jax.Array, jax.Array]:
-    """Stage-2 reranker: cosine-normalized TF-IDF over stage-1 candidates
-    (the classic SMART lnc.ltc second stage; the reference has no rerank —
-    this is the MS MARCO-shaped candidates->rerank composition). Work is
+    """Stage-2 reranker: cosine-normalized TF-IDF over stage-1 candidates.
+
+    score(q, d) = sum over query-term slots of idf(t)^2 * (1 + ln tf(t, d)),
+    divided by ||d|| under (1 + ln tf) * idf doc weights. Duplicate query
+    terms contribute once per slot — deliberately matching the first-stage
+    scorers and the reference's per-slot accumulation
+    (IntDocVectorsForwardIndex.java:192-223). The reference has no rerank;
+    this is the MS MARCO-shaped candidates->rerank composition. Work is
     B*L*C, not B*L*D: only the candidates' matrix cells are gathered."""
     vocab_size = doc_matrix.shape[0]
     idf = idf_weights(df, num_docs)
@@ -306,14 +324,10 @@ def cosine_rerank_tiered(
     The tiered accumulation is doc-axis-wide by construction, so this path
     scores [B, D+1] and then gathers the candidates."""
     idf = idf_weights(df, n_scalar)
-
-    def lntf(tf):
-        return jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
-
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
-        idf * idf, num_docs=num_docs, hot_weight_fn=lntf,
-        cold_weight_fn=lambda tfs, docs: lntf(tfs))
+        idf * idf, num_docs=num_docs, hot_weight_fn=_lntf,
+        cold_weight_fn=lambda tfs, docs: _lntf(tfs))
     scores = scores / jnp.maximum(doc_norm, 1e-30)[None, :]
     cand_scores = jnp.take_along_axis(
         scores, cand_docnos.astype(jnp.int32), axis=1)
@@ -346,8 +360,7 @@ def tfidf_topk_sparse(
     q_valid = q_terms >= 0
     docs = post_docs[safe_q]                                # [B, L, P]
     tfs = post_tfs[safe_q].astype(jnp.float32)              # [B, L, P]
-    w = jnp.where(tfs > 0, 1.0 + jnp.log(jnp.maximum(tfs, 1.0)), 0.0)
-    w = w * idf[safe_q][..., None] * q_valid[..., None]
+    w = _lntf(tfs) * idf[safe_q][..., None] * q_valid[..., None]
     slot = jnp.where((tfs > 0) & q_valid[..., None], docs, num_docs + 1)
 
     def score_one(slots_q, w_q):

@@ -370,13 +370,12 @@ class Scorer:
         """f32 [D+1] doc-vector norms under (1+ln tf)*idf weighting, for
         the cosine rerank stage. Built lazily from the host CSR columns."""
         if getattr(self, "_norms", None) is None:
+            from ..ops import idf_weights
+
             pt, pd, ptf = self._pairs
-            idf = np.asarray(
-                np.log10(np.maximum(
-                    self.meta.num_docs
-                    / np.maximum(np.asarray(self.df, np.float64), 1.0),
-                    1e-30)))
-            idf = np.where(np.asarray(self.df) > 0, idf, 0.0)
+            # the same idf the rerank kernels use (single source of truth);
+            # the rerank model is float idf regardless of compat mode
+            idf = np.asarray(idf_weights(self.df, self.meta.num_docs))
             w = (1.0 + np.log(np.maximum(ptf, 1))) * idf[pt]
             sq = np.bincount(pd, weights=w * w,
                              minlength=self.meta.num_docs + 1)
@@ -397,33 +396,28 @@ class Scorer:
         if self.layout == "sharded":
             raise NotImplementedError(
                 "rerank is not implemented for the sharded layout")
-        _, cand = self.topk(q_terms, k=candidates, scoring="bm25")
-        if q_terms.shape[0] == 0:
-            return cand.astype(np.float32), cand
         n = jnp.int32(self.meta.num_docs)
         norms = self._doc_norms()
 
-        if self.layout in ("dense", "pallas"):
-            # dense rerank work is B*L*C (candidate-gathered)
-            per_q = max(q_terms.shape[1] * cand.shape[1], 1)
-
-            def dispatch(q, c):
+        # both stages run inside one block so the candidate matrix never
+        # round-trips through the host (at B=10k, C=1000 that would be
+        # 2 x 40 MB over the transport whose bandwidth is the critical
+        # path). Stage 1 (BM25) always scores the full doc axis, so its
+        # budget dominates the block size.
+        def dispatch(q):
+            qd = jnp.asarray(q)
+            _, cand_d = self._topk_device(qd, candidates, "bm25")
+            if self.layout in ("dense", "pallas"):
                 return cosine_rerank_dense(
-                    jnp.asarray(q), self.doc_matrix, self.df, norms,
-                    jnp.asarray(c), n, k=k)
-        else:
-            # tiered rerank scores the whole doc axis before the gather
-            per_q = self.meta.num_docs + 1
+                    qd, self.doc_matrix, self.df, norms, cand_d, n, k=k)
+            return cosine_rerank_tiered(
+                qd, self.hot_rank, self.hot_tfs, self.tier_of, self.row_of,
+                self.tier_docs, self.tier_tfs, self.df, norms, n, cand_d,
+                num_docs=self.meta.num_docs, k=k)
 
-            def dispatch(q, c):
-                return cosine_rerank_tiered(
-                    jnp.asarray(q), self.hot_rank, self.hot_tfs,
-                    self.tier_of, self.row_of, self.tier_docs,
-                    self.tier_tfs, self.df, norms, n, jnp.asarray(c),
-                    num_docs=self.meta.num_docs, k=k)
         return self._blocked_dispatch(
-            max(1, self.SCORE_BUDGET // per_q), dispatch,
-            (np.asarray(q_terms, np.int32), -1), (cand, 0))
+            max(1, self.SCORE_BUDGET // (self.meta.num_docs + 1)), dispatch,
+            (np.asarray(q_terms, np.int32), -1))
 
     def search_batch(
         self, texts: Sequence[str], k: int = 10, scoring: str = "tfidf",
