@@ -183,14 +183,27 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
 
     for i, (tdocs, ttfs) in enumerate(zip(tier_docs, tier_tfs)):
         in_tier = (tof == i) & q_valid & ~is_hot             # [B, L]
-        r = jnp.where(in_tier, row, 0)
-        docs = tdocs[r]                                      # [B, L, P_t]
-        tfs = ttfs[r].astype(jnp.float32)
-        w = cold_weight_fn(tfs, docs)
-        mask = in_tier[..., None]
-        w = jnp.where(tfs > 0, w, 0.0) * q_w[..., None] * mask
-        slot = jnp.where((tfs > 0) & mask, docs, num_docs + 1)
-        scores = jax.vmap(add_cold)(scores, slot, w)
+
+        def do_tier(s, in_tier=in_tier, tdocs=tdocs, ttfs=ttfs):
+            r = jnp.where(in_tier, row, 0)
+            docs = tdocs[r]                                  # [B, L, P_t]
+            tfs = ttfs[r].astype(jnp.float32)
+            w = cold_weight_fn(tfs, docs)
+            mask = in_tier[..., None]
+            w = jnp.where(tfs > 0, w, 0.0) * q_w[..., None] * mask
+            slot = jnp.where((tfs > 0) & mask, docs, num_docs + 1)
+            return jax.vmap(add_cold)(s, slot, w)
+
+        # a tier's gather/scatter costs B*L*P_t even when nothing lands in
+        # it. For the BIG tiers (which dominate that sum and hold few terms,
+        # so a block often misses them entirely) the stage runs under a
+        # whole-block any() predicate; small tiers are nearly always hit
+        # and the cond would only add sync overhead.
+        if tdocs.shape[1] >= 4096:
+            scores = jax.lax.cond(jnp.any(in_tier), do_tier, lambda s: s,
+                                  scores)
+        else:
+            scores = do_tier(scores)
     return scores
 
 
