@@ -420,3 +420,53 @@ def test_shrink_for_fetch_and_pairs():
     assert spd.dtype == np.int32     # docnos don't fit uint16
     assert stf.dtype == np.uint16
     assert int(np.asarray(spd)[0]) == 70000
+
+
+def test_tiered_big_tier_cond_path():
+    """Terms in tiers with cap >= 4096 (the lax.cond-gated stages) must
+    score identically to the dense path — including blocks where no query
+    term lands in the big tier (the skip branch)."""
+    from tpu_ir.ops.scoring import tfidf_topk_tiered
+    from tpu_ir.search.layout import build_tiered_layout
+
+    rng = np.random.default_rng(9)
+    ndocs, vocab = 9000, 50
+    # term 0: df 5000 -> tier cap 8192 (cond-gated); term 1: df 6000 but
+    # hot (hot strip takes the top-df terms); the rest small
+    dfs = [5000, 6000] + [int(x) for x in rng.integers(1, 50, vocab - 2)]
+    pt, pd, ptf = [], [], []
+    for tid, df_t in enumerate(dfs):
+        docs = rng.choice(ndocs, df_t, replace=False) + 1
+        tfs = rng.integers(1, 9, df_t)
+        order = np.lexsort((docs, -tfs))
+        pt.extend([tid] * df_t)
+        pd.extend(docs[order].tolist())
+        ptf.extend(tfs[order].tolist())
+    pt = np.array(pt, np.int32)
+    pd = np.array(pd, np.int32)
+    ptf = np.array(ptf, np.int32)
+    df = np.bincount(pt, minlength=vocab).astype(np.int32)
+
+    tiers = build_tiered_layout(pd, ptf, df, num_docs=ndocs,
+                                hot_budget=2 * (ndocs + 1))  # 2 hot rows
+    assert max(a.shape[1] for a in tiers.tier_docs) >= 4096
+
+    mat = dense_doc_matrix(jnp.asarray(pt), jnp.asarray(pd),
+                           jnp.asarray(ptf), vocab_size=vocab,
+                           num_docs=ndocs)
+    # queries hitting the big tier, the hot strip, small tiers, and one
+    # block-wide big-tier miss (terms 2.. only)
+    qs = np.array([[0, 5], [1, 7], [3, 9], [2, 4]], np.int32)
+    for q in (qs, qs[2:]):  # second batch: nothing in the big tier
+        s1, d1 = tfidf_topk_dense(jnp.asarray(q), mat, jnp.asarray(df),
+                                  jnp.int32(ndocs), k=10)
+        s2, d2 = tfidf_topk_tiered(
+            jnp.asarray(q), jnp.asarray(tiers.hot_rank),
+            jnp.asarray(tiers.hot_tfs), jnp.asarray(tiers.tier_of),
+            jnp.asarray(tiers.row_of),
+            tuple(jnp.asarray(a) for a in tiers.tier_docs),
+            tuple(jnp.asarray(a) for a in tiers.tier_tfs),
+            jnp.asarray(df), jnp.int32(ndocs), num_docs=ndocs, k=10)
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
