@@ -205,6 +205,26 @@ def _recall_at_10(scorer, q_ids: np.ndarray, got_docnos: np.ndarray) -> float:
     return round(hits / total, 4) if total else 1.0
 
 
+def _tpu_probe_ok(timeout_s: int = 120) -> bool:
+    """True if the accelerator backend initializes within the timeout.
+
+    The TPU tunnel in this environment can wedge so that jax.devices()
+    blocks forever (NOTES.md); probing in a subprocess keeps the bench from
+    hanging and lets it fall back to the CPU backend with a number instead
+    of no output at all."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "raise SystemExit(0 if d else 1)"],
+            timeout=timeout_s, capture_output=True)
+        return r.returncode == 0
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
@@ -235,6 +255,10 @@ def main() -> int:
             1_000_000, 2_700_000_000, 500_000)
         streaming = True
 
+    if not args.cpu and not _tpu_probe_ok():
+        print("bench: TPU backend probe failed/timed out; falling back "
+              "to CPU", file=sys.stderr)
+        args.cpu = True
     if args.cpu:
         import jax
         import jax._src.xla_bridge as xb
