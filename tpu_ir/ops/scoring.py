@@ -31,6 +31,11 @@ import jax.numpy as jnp
 
 PAD_QTERM = -1
 
+# cold tiers at least this wide run under a whole-block lax.cond skip (the
+# stage costs B*L*P_t even when no query term lands in it); narrower tiers
+# are nearly always hit, where the cond only adds sync overhead
+COND_TIER_MIN_CAP = 4096
+
 
 def _lntf(tf):
     """The (1 + ln tf) weight curve; 0 for empty slots."""
@@ -88,13 +93,7 @@ def tfidf_topk_dense(
     """Batched TF-IDF top-k. Returns (scores [B,k], docnos [B,k]);
     docno 0 marks an empty slot (fewer than k docs matched)."""
     vocab_size = doc_matrix.shape[0]
-    dff = df.astype(jnp.float32)
-    if compat_int_idf:
-        n = jnp.asarray(num_docs, jnp.int32)
-        ratio = (n // jnp.maximum(df, 1)).astype(jnp.float32)
-    else:
-        ratio = jnp.asarray(num_docs, jnp.float32) / jnp.maximum(dff, 1.0)
-    idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
+    idf = idf_weights(df, num_docs, compat_int_idf)
 
     safe_q = jnp.where(q_terms >= 0, q_terms, 0)
     q_valid = (q_terms >= 0) & (q_terms < vocab_size)
@@ -199,7 +198,7 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
         # so a block often misses them entirely) the stage runs under a
         # whole-block any() predicate; small tiers are nearly always hit
         # and the cond would only add sync overhead.
-        if tdocs.shape[1] >= 4096:
+        if tdocs.shape[1] >= COND_TIER_MIN_CAP:
             scores = jax.lax.cond(jnp.any(in_tier), do_tier, lambda s: s,
                                   scores)
         else:
@@ -226,13 +225,7 @@ def tfidf_topk_tiered(
     """TF-IDF top-k on the tiered sparse layout (search/layout.py): the
     budget-capped hot strip bounds dense memory, geometric tier capacities
     bound padding waste, and every shape stays static under jit."""
-    dff = df.astype(jnp.float32)
-    if compat_int_idf:
-        n = jnp.asarray(n_scalar, jnp.int32)
-        ratio = (n // jnp.maximum(df, 1)).astype(jnp.float32)
-    else:
-        ratio = jnp.asarray(n_scalar, jnp.float32) / jnp.maximum(dff, 1.0)
-    idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
+    idf = idf_weights(df, n_scalar, compat_int_idf)
 
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
@@ -361,13 +354,7 @@ def tfidf_topk_sparse(
 ) -> tuple[jax.Array, jax.Array]:
     """Sparse scoring: scatter each query term's postings into a doc-axis
     accumulator. Work is B*L*P instead of B*L*D."""
-    dff = df.astype(jnp.float32)
-    if compat_int_idf:
-        n = jnp.asarray(n_scalar, jnp.int32)
-        ratio = (n // jnp.maximum(df, 1)).astype(jnp.float32)
-    else:
-        ratio = jnp.asarray(n_scalar, jnp.float32) / jnp.maximum(dff, 1.0)
-    idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
+    idf = idf_weights(df, n_scalar, compat_int_idf)
 
     safe_q = jnp.where(q_terms >= 0, q_terms, 0)           # [B, L]
     q_valid = q_terms >= 0

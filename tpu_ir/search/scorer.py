@@ -400,7 +400,8 @@ class Scorer:
         self, q_terms: np.ndarray, k: int = 10, candidates: int = 1000,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Two-stage retrieval: BM25 top-`candidates`, then cosine TF-IDF
-        (SMART lnc.ltc shape) restricted to those candidates. The reference
+        (see ops/scoring.py::cosine_rerank_dense for the exact model)
+        restricted to those candidates. The reference
         has no second stage; this is the MS MARCO-style composition on the
         same resident index."""
         from ..ops import cosine_rerank_dense
