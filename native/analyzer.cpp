@@ -74,11 +74,16 @@ struct Tokenizer {
   int32_t n;
   std::vector<std::string> tokens;
   std::string ignore_until;  // empty = not ignoring
+  // optional sink: when set, add() forwards each final token instead of
+  // storing it (corpus mode interns directly — no per-doc string vector)
+  void (*sink)(void *, const std::string &) = nullptr;
+  void *sink_ctx = nullptr;
 
   void add(const std::string &tok) {
     if (tok.empty()) return;
     if (tok.size() >= 100) return;  // ASCII: chars == bytes
-    tokens.push_back(tok);
+    if (sink) sink(sink_ctx, tok);
+    else tokens.push_back(tok);
   }
 
   void acronym(std::string tok) {
@@ -637,19 +642,24 @@ int64_t process_records(Corpus *c, const char *data, size_t len,
       skips->push_back((int64_t)s_off);
       skips->push_back((int64_t)e_off);
     } else {
+      struct Sink {
+        Corpus *c;
+        int64_t count;
+      } st{c, 0};
       Tokenizer tk;
       tk.text = data + s_off;
       tk.n = (int32_t)(e_off - s_off);
+      tk.sink_ctx = &st;
+      tk.sink = [](void *p, const std::string &tok) {
+        Sink *s = (Sink *)p;
+        int32_t id = s->c->intern_token(tok);
+        if (id < 0) return;
+        s->c->token_ids.push_back(id);
+        ++s->count;
+      };
       tk.run();
-      int64_t count = 0;
-      for (const std::string &tok : tk.tokens) {
-        int32_t id = c->intern_token(tok);
-        if (id < 0) continue;
-        c->token_ids.push_back(id);
-        ++count;
-      }
       c->docids.push_back(docid);
-      c->doc_token_counts.push_back(count);
+      c->doc_token_counts.push_back(st.count);
       ++added;
     }
     pos = e_off;
