@@ -106,3 +106,42 @@ def test_count(setup, capsys):
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["Count.DOCS"] == 3
     assert out["min_docid"] == "D-01" and out["max_docid"] == "D-03"
+
+
+def test_verify_catches_chargram_and_doclen_corruption(setup, tmp_path):
+    """Other artifact families: a shuffled char-gram term list and a
+    wrong-length doclen must both fail verification."""
+    import numpy as np
+
+    from tpu_ir.index import build_index
+    from tpu_ir.index import format as fmt
+    from tpu_ir.index.verify import verify_index
+
+    corpus, _, _ = setup
+    idx = str(tmp_path / "corrupt2")
+    build_index([corpus], idx, num_shards=2, chargram_ks=[2])
+    assert verify_index(idx)["ok"]
+
+    # chargram: reverse one gram's term list (must be sorted-unique)
+    z = fmt.load_chargram(idx, 2)
+    tids = z["term_ids"].copy()
+    lo, hi = None, None
+    for g in range(len(z["gram_codes"])):
+        if z["indptr"][g + 1] - z["indptr"][g] >= 2:
+            lo, hi = int(z["indptr"][g]), int(z["indptr"][g + 1])
+            break
+    assert lo is not None, "need a gram with >= 2 terms"
+    tids[lo:hi] = tids[lo:hi][::-1]
+    fmt.save_chargram(idx, 2, gram_codes=z["gram_codes"],
+                      indptr=z["indptr"], term_ids=tids)
+    with pytest.raises(AssertionError):
+        verify_index(idx)
+    fmt.save_chargram(idx, 2, **{k: z[k] for k in z})  # restore
+
+    # doclen: truncate
+    import os
+
+    dl = np.load(os.path.join(idx, fmt.DOCLEN))
+    np.save(os.path.join(idx, fmt.DOCLEN), dl[:-1])
+    with pytest.raises(AssertionError):
+        verify_index(idx)
