@@ -145,3 +145,22 @@ def test_verify_catches_chargram_and_doclen_corruption(setup, tmp_path):
     np.save(os.path.join(idx, fmt.DOCLEN), dl[:-1])
     with pytest.raises(AssertionError):
         verify_index(idx)
+
+
+def test_docno_cli(setup, capsys):
+    """TrecDocnoMapping CLI parity: list / getDocno / getDocid
+    (TrecDocnoMapping.java:164-200)."""
+    from tpu_ir.cli import main
+
+    _, idx, _ = setup
+    assert main(["docno", idx, "list"]) == 0
+    lines = capsys.readouterr().out.strip().splitlines()
+    assert lines and all("\t" in l for l in lines)
+    docid, docno = lines[0].split("\t")
+
+    assert main(["docno", idx, "getDocno", docid]) == 0
+    assert capsys.readouterr().out.strip() == docno
+    assert main(["docno", idx, "getDocid", docno]) == 0
+    assert capsys.readouterr().out.strip() == docid
+    assert main(["docno", idx, "getDocno", "NO-SUCH-DOC"]) == 1
+    assert main(["docno", idx, "getDocid", "999999"]) == 1

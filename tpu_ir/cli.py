@@ -226,6 +226,33 @@ def cmd_count(args) -> int:
     return 0
 
 
+def cmd_docno(args) -> int:
+    """Docno-mapping inspection (reference TrecDocnoMapping.main:
+    `list | getDocno docid | getDocid docno`,
+    edu/umd/cloud9/collection/trec/TrecDocnoMapping.java:164-200)."""
+    from .collection import DocnoMapping
+    from .index import format as fmt
+
+    mapping = DocnoMapping.load(os.path.join(args.index_dir, fmt.DOCNOS))
+    if args.op == "list":
+        for docno in range(1, len(mapping) + 1):
+            print(f"{mapping.get_docid(docno)}\t{docno}")
+    elif args.op == "getDocno":
+        try:
+            print(mapping.get_docno(args.arg))
+        except KeyError:
+            print(f"docid {args.arg!r} not found", file=sys.stderr)
+            return 1
+    else:  # getDocid
+        docno = int(args.arg)
+        if not 1 <= docno <= len(mapping):
+            print(f"docno {docno} out of range 1..{len(mapping)}",
+                  file=sys.stderr)
+            return 1
+        print(mapping.get_docid(docno))
+    return 0
+
+
 def cmd_expand(args) -> int:
     from .search import WildcardLookup
 
@@ -308,6 +335,14 @@ def main(argv: list[str] | None = None) -> int:
     pc = sub.add_parser("count", help="count documents in a corpus")
     pc.add_argument("corpus", nargs="+")
     pc.set_defaults(fn=cmd_count)
+
+    pd = sub.add_parser(
+        "docno", help="docid <-> docno mapping (TrecDocnoMapping CLI)")
+    pd.add_argument("index_dir")
+    pd.add_argument("op", choices=["list", "getDocno", "getDocid"])
+    pd.add_argument("arg", nargs="?", default=None,
+                    help="docid for getDocno, docno for getDocid")
+    pd.set_defaults(fn=cmd_docno)
 
     pe = sub.add_parser("expand", help="wildcard term lookup (char-k-grams)")
     pe.add_argument("index_dir")
