@@ -448,3 +448,44 @@ def test_rerank_two_stage(index_dir):
     got_docs = {int(x) for x in d1[0] if x > 0}
     assert got_docs <= {int(x) for x in np.asarray(
         dense.topk(q, k=10, scoring="bm25")[1][0]) if x > 0}
+
+
+def test_serving_layout_cache(tmp_path):
+    """The tiered layout disk cache: second load hits the cache with
+    identical scoring; a changed index invalidates it."""
+    from tpu_ir.index import build_index as bi
+
+    corpus = corpus_file(tmp_path)
+    idx = str(tmp_path / "idx")
+    bi([str(corpus)], idx, k=1, num_shards=3, compute_chargrams=False)
+
+    s1 = Scorer.load(idx, layout="sparse")
+    r1 = s1.search("salmon fishing")
+    assert os.path.isdir(os.path.join(idx, "serving-tiered"))
+
+    # cache hit: the second load must actually read the cached arrays —
+    # poison one on disk and expect the poisoned values to surface
+    import numpy as np
+
+    cache = os.path.join(idx, "serving-tiered")
+    tier0 = np.load(os.path.join(cache, "tier_tfs_0.npy"))
+    np.save(os.path.join(cache, "tier_tfs_0.npy"), tier0 * 0)
+    s2 = Scorer.load(idx, layout="sparse")
+    assert s2.search("salmon fishing") != r1  # poisoned cache was used
+    np.save(os.path.join(cache, "tier_tfs_0.npy"), tier0)  # restore
+    assert Scorer.load(idx, layout="sparse").search("salmon fishing") == r1
+
+    # in-place rebuild over a DIFFERENT corpus with overwrite=True (which
+    # deletes files but keeps the cache dir): the content CRCs must miss
+    # and the layout must reflect the new index, not the stale cache
+    small = tmp_path / "small.trec"
+    small.write_text(
+        "<DOC>\n<DOCNO> X-1 </DOCNO>\n<TEXT>\nsalmon salmon trout\n"
+        "</TEXT>\n</DOC>\n"
+        "<DOC>\n<DOCNO> X-2 </DOCNO>\n<TEXT>\ntrout river\n</TEXT>\n</DOC>\n")
+    bi([str(small)], idx, k=1, num_shards=3, compute_chargrams=False,
+       overwrite=True)
+    assert os.path.isdir(cache)  # stale cache dir survived the overwrite
+    s3 = Scorer.load(idx, layout="sparse")
+    got = {d for d, _ in s3.search("salmon")}
+    assert got == {"X-1"}

@@ -68,13 +68,15 @@ class Scorer:
         meta: fmt.IndexMetadata,
         layout: str = "auto",
         compat_int_idf: bool = False,
+        index_dir: str | None = None,
     ):
         self.vocab = vocab
         self.mapping = mapping
         self.meta = meta
         self.compat_int_idf = compat_int_idf
         self._analyzer = make_analyzer()
-        self._index_dir: str | None = None  # set by load(); enables wildcards
+        # enables wildcards + the serving-layout disk cache
+        self._index_dir: str | None = index_dir
         self._wildcard = None
         self._wildcard_tried = False
         v, d = meta.vocab_size, meta.num_docs
@@ -118,8 +120,16 @@ class Scorer:
             # tiered sparse: budget-capped dense strip for the hottest
             # terms + geometric-capacity padded tiers for the rest
             # (search/layout.py) — raw tf everywhere so the same arrays
-            # serve TF-IDF and BM25
-            tiers = build_tiered_layout(pair_doc, pair_tf, df, num_docs=d)
+            # serve TF-IDF and BM25. With an index dir, the built layout is
+            # cached on disk (a 1M-doc build costs ~1 min per load without)
+            if index_dir is not None:
+                from .layout import load_or_build_tiered_layout
+
+                tiers = load_or_build_tiered_layout(
+                    index_dir, pair_doc, pair_tf, df, meta=meta)
+            else:
+                tiers = build_tiered_layout(pair_doc, pair_tf, df,
+                                            num_docs=d)
             self.hot_rank = jnp.asarray(tiers.hot_rank)
             self.hot_tfs = jnp.asarray(tiers.hot_tfs)
             self.tier_of = jnp.asarray(tiers.tier_of)
@@ -165,13 +175,12 @@ class Scorer:
             pair_doc[dest] = z["pair_doc"]
             pair_tf[dest] = z["pair_tf"]
         pair_term = np.repeat(np.arange(v, dtype=np.int32), df)
-        scorer = cls(
+        return cls(
             vocab=vocab, mapping=mapping,
             pair_term=pair_term, pair_doc=pair_doc,
             pair_tf=pair_tf, df=df, doc_len=doc_len, meta=meta,
-            layout=layout, compat_int_idf=compat_int_idf)
-        scorer._index_dir = index_dir
-        return scorer
+            layout=layout, compat_int_idf=compat_int_idf,
+            index_dir=index_dir)
 
     # -- query pipeline ----------------------------------------------------
 
