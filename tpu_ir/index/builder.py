@@ -150,6 +150,27 @@ def build_index(
 
     flat_term_ids = inverse.astype(np.int32)
 
+    # char-k-gram builds (CharKGramTermIndexer) are dispatched at the first
+    # opportunity the device would otherwise idle, collected near the end;
+    # the closure memoizes so both call sites below are safe
+    built_chargrams = bool(compute_chargrams and chargram_ks)
+    chargram_state = {"handle": None, "dispatched": False}
+
+    def _dispatch_chargrams():
+        if not built_chargrams or chargram_state["dispatched"]:
+            return chargram_state["handle"]
+        chargram_state["dispatched"] = True
+        with report.phase("chargrams"):
+            if k == 1:
+                token_vocab = vocab
+            else:
+                token_vocab = Vocab.build(
+                    t for toks in doc_tokens for t in toks)
+                token_vocab.save(os.path.join(index_dir, TOKENS_VOCAB))
+            chargram_state["handle"] = dispatch_chargram_builds(
+                index_dir, token_vocab.terms, chargram_ks)
+        return chargram_state["handle"]
+
     deferred = None  # single-device: big pair arrays still in flight to host
     if spmd_devices:
         flat_doc_ids = np.repeat(docnos, lengths).astype(np.int32)
@@ -182,14 +203,23 @@ def build_index(
                 jnp.asarray(term_ids), jnp.asarray(docnos),
                 jnp.asarray(lengths.astype(np.int32)),
                 vocab_size=v, num_docs=num_docs)
+            tf_max_d = jnp.max(p.pair_tf)
+            for a in (p.df, p.doc_len, tf_max_d):
+                a.copy_to_host_async()
+        # queue the char-gram programs NOW: the device works through them
+        # while the small postings fetch below blocks the host (measured
+        # net win at reference scale; the pair shrink+copy queues behind
+        # the in-flight chargram compute, but its transfer then overlaps
+        # the chargram fetches instead)
+        _dispatch_chargrams()
+        with report.phase("postings_device"):
             # one small blocking fetch (df et al.) tells the host the valid
             # pair count and tf range, then the capacity-padded pair columns
             # are sliced + narrowed ON DEVICE before their D2H copy — the
             # tunnel's ~25 MB/s D2H link is the build's critical path, and
             # this cuts the big transfer ~3x. Copies then stream back while
-            # the char-gram programs below keep the device busy.
-            df, doc_len, tf_max = fetch_to_host(
-                p.df, p.doc_len, jnp.max(p.pair_tf))
+            # the char-gram collection below proceeds.
+            df, doc_len, tf_max = fetch_to_host(p.df, p.doc_len, tf_max_d)
             num_pairs = int(df.sum())
             report.set_counter("num_pairs", num_pairs)
             pair_doc_d, pair_tf_d = shrink_pairs(
@@ -199,19 +229,12 @@ def build_index(
                 a.copy_to_host_async()
             deferred = (df, doc_len, pair_doc_d, pair_tf_d)
 
-    # --- char-k-gram indexes (CharKGramTermIndexer); runs while the
-    # postings arrays stream back to host ---
-    built_chargrams = bool(compute_chargrams and chargram_ks)
+    # --- char-k-gram collection; copies stream back alongside the postings
+    # pair columns ---
+    chargram_handle = _dispatch_chargrams()  # no-op if already dispatched
     if built_chargrams:
         with report.phase("chargrams"):
-            if k == 1:
-                token_vocab = vocab
-            else:
-                token_vocab = Vocab.build(
-                    t for toks in doc_tokens for t in toks)
-                token_vocab.save(os.path.join(index_dir, TOKENS_VOCAB))
-            build_chargram_artifacts(
-                index_dir, token_vocab.terms, chargram_ks)
+            collect_chargram_builds(index_dir, chargram_handle)
 
     # --- shard + persist (part-NNNNN layout) ---
     with report.phase("write_shards"):
@@ -305,24 +328,51 @@ def _spmd_postings(flat_term_ids, flat_doc_ids, docnos, *, vocab_size,
     return shard_pairs, df, doc_len
 
 
-def build_chargram_artifacts(
-    index_dir: str, terms: list[str], ks: Iterable[int]
-) -> None:
+def dispatch_chargram_builds(
+    index_dir: str, terms: list[str], ks: Iterable[int],
+    max_inflight: int = 2,
+):
+    """Queue the first char-gram device programs; returns the pending
+    handle for collect_chargram_builds (None when every artifact already
+    exists). Split from collection so the builder can slot other host work
+    — e.g. its blocking postings fetch — between dispatch and collect. At
+    most `max_inflight` capacity-padded result sets are live on device at
+    once; further ks are dispatched as earlier ones are collected."""
     ks = [ck for ck in ks
           if not fmt.artifact_exists(index_dir, fmt.chargram_name(ck))]
     if not ks:
-        return
+        return None
     # one byte matrix serves every k (padding differs only if k > max term
     # length + 2), so it is packed and uploaded once
     tb_np, tl_np = pack_term_bytes(terms, max(ks))
     tb, tl = jnp.asarray(tb_np), jnp.asarray(tl_np)
-    # depth-1 pipeline: the next k's program is dispatched before the
-    # previous k's results are collected, so compute and D2H copies overlap
-    # while at most two result sets are live on device at once
 
-    num_terms = len(terms)
+    def dispatch_one(ck):
+        # report opens at dispatch so wall_s covers the device program, not
+        # just the fetch+write in collect
+        report = JobReport("CharKGramTermIndexer", config={"k": ck},
+                           suffix=f"-k{ck}")
+        idx = build_chargram_index_jit(tb, tl, k=ck)
+        for a in (idx.num_grams, idx.num_entries):
+            a.copy_to_host_async()
+        return ck, idx, report
 
-    def collect(ck, idx, report):
+    pending = [dispatch_one(ck) for ck in ks[:max_inflight]]
+    return len(terms), pending, ks[max_inflight:], dispatch_one
+
+
+def collect_chargram_builds(index_dir: str, handle) -> None:
+    """Fetch + persist the char-gram results queued by
+    dispatch_chargram_builds, rolling further dispatches in depth-1 so
+    copies overlap the next k's compute."""
+    if handle is None:
+        return
+    num_terms, pending, todo, dispatch_one = handle
+    todo = list(todo)
+    while pending:
+        ck, idx, report = pending.pop(0)
+        if todo:
+            pending.append(dispatch_one(todo.pop(0)))
         # the count scalars (already async in flight) tell the host the
         # valid prefixes; the capacity-padded result arrays are then sliced
         # + narrowed on device so only real entries cross the tunnel
@@ -347,17 +397,9 @@ def build_chargram_artifacts(
         report.set_counter("reduce_output_groups", ng)
         report.save(os.path.join(index_dir, fmt.JOBS_DIR))
 
-    prev = None
-    for ck in ks:
-        # report opens at dispatch so wall_s covers the device program, not
-        # just the fetch+write in collect()
-        report = JobReport("CharKGramTermIndexer", config={"k": ck},
-                           suffix=f"-k{ck}")
-        idx = build_chargram_index_jit(tb, tl, k=ck)
-        for a in (idx.num_grams, idx.num_entries):
-            a.copy_to_host_async()
-        if prev is not None:
-            collect(*prev)
-        prev = (ck, idx, report)
-    if prev is not None:
-        collect(*prev)
+
+def build_chargram_artifacts(
+    index_dir: str, terms: list[str], ks: Iterable[int]
+) -> None:
+    collect_chargram_builds(
+        index_dir, dispatch_chargram_builds(index_dir, terms, ks))
