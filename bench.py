@@ -362,8 +362,8 @@ def main() -> int:
         "corpus_docs": DOC_COUNT,
         "queries_per_sec": round(queries_per_sec, 1),
         "query_batch": args.queries,
-        "query_p50_ms": round(float(lat_ms[len(lat_ms) // 2]), 2),
-        "query_p99_ms": round(float(lat_ms[-1]), 2),
+        "query_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
+        "query_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
         "recall_at_10": recall,
         "backend": backend,
         "config": args.config,

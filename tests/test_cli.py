@@ -156,7 +156,9 @@ def test_docno_cli(setup, capsys):
     assert main(["docno", idx, "list"]) == 0
     lines = capsys.readouterr().out.strip().splitlines()
     assert lines and all("\t" in l for l in lines)
-    docid, docno = lines[0].split("\t")
+    # reference column order: docno first ("i + \"\\t\" + mDocids[i]")
+    docno, docid = lines[0].split("\t")
+    assert docno == "1"
 
     assert main(["docno", idx, "getDocno", docid]) == 0
     assert capsys.readouterr().out.strip() == docno
@@ -164,3 +166,60 @@ def test_docno_cli(setup, capsys):
     assert capsys.readouterr().out.strip() == docid
     assert main(["docno", idx, "getDocno", "NO-SUCH-DOC"]) == 1
     assert main(["docno", idx, "getDocid", "999999"]) == 1
+    assert main(["docno", idx, "getDocid", "not-a-number"]) == 1
+    # missing positional arg is a usage error, not a crash
+    assert main(["docno", idx, "getDocno"]) == 1
+    assert main(["docno", idx, "getDocid"]) == 1
+
+def test_inspect_term(setup, capsys):
+    """Per-term random access through dictionary.tsv — the reference
+    getValue seek path (IntDocVectorsForwardIndex.java:148-184) finally has
+    a consumer."""
+    _, index_dir, _ = setup
+    assert main(["inspect", index_dir, "--term", "alpha"]) == 0
+    out = capsys.readouterr().out
+    assert "df=2" in out and "alpha" in out
+    # input is analyzed like a query (case folding, punctuation)
+    assert main(["inspect", index_dir, "--term", "Alpha,"]) == 0
+    assert "df=2" in capsys.readouterr().out
+    assert main(["inspect", index_dir, "--term", "zzznope"]) == 1
+
+
+def test_dictionary_access(setup):
+    from tpu_ir.index.dictionary import Dictionary, verify_dictionary_access
+
+    _, index_dir, _ = setup
+    d = Dictionary(index_dir)
+    tp = d.get_value("alpha")
+    assert tp is not None and tp.df == 2
+    # postings in reference order: tf desc (D-02 has tf=2), doc asc
+    assert tp.postings[0, 1] == 2
+    assert d.get_value("no-such-term") is None  # miss -> None (ref null)
+    assert verify_dictionary_access(index_dir) > 0
+
+
+def test_dictionary_detects_tamper(setup, tmp_path):
+    """The post-seek term-match check (reference :175-179): a dictionary
+    line pointing at the wrong offset must raise, not silently return the
+    wrong postings."""
+    import shutil
+
+    from tpu_ir.index import format as fmt
+    from tpu_ir.index.dictionary import Dictionary
+
+    _, index_dir, _ = setup
+    bad = tmp_path / "bad-index"
+    shutil.copytree(index_dir, bad)
+    path = os.path.join(bad, fmt.DICTIONARY)
+    lines = open(path).read().splitlines()
+    # swap the offsets of two same-shard terms
+    t0, s0, o0 = lines[0].rsplit("\t", 2)
+    swap = next(i for i, l in enumerate(lines[1:], 1)
+                if l.rsplit("\t", 2)[1] == s0
+                and l.rsplit("\t", 2)[2] != o0)
+    ts, ss, os_ = lines[swap].rsplit("\t", 2)
+    lines[0] = f"{t0}\t{s0}\t{os_}"
+    lines[swap] = f"{ts}\t{ss}\t{o0}"
+    open(path, "w").write("\n".join(lines) + "\n")
+    with pytest.raises(AssertionError):
+        Dictionary(str(bad)).get_value(t0)

@@ -41,6 +41,12 @@ def test_word_cap_guard(engines):
     assert oracle.rank("gold silver copper") is None  # 3 words rejected
     assert oracle.rank("") is None
     assert oracle.rank("gold") is not None
+    # the guard counts RAW whitespace words (term.split("\\s+"),
+    # IntDocVectorsForwardIndex.java:292,297), not analyzed tokens: "the of"
+    # analyzes to zero tokens but is 2 raw words -> allowed (empty result),
+    # while "gold, silver. copper!" is 3 raw words -> rejected
+    assert oracle.rank("the of") == []
+    assert oracle.rank("gold, silver. copper!") is None
 
 
 def test_int_division_idf_matches_engine(engines):

@@ -470,3 +470,53 @@ def test_tiered_big_tier_cond_path():
         np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
                                    rtol=1e-5)
         np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+class TestChargramHostFallback:
+    """4 < k <= 8 grams pack into int64 on host (ops/chargram.py); the
+    semantics must match the device path's: '$term$' byte windows, per-gram
+    sorted-unique term lists."""
+
+    def test_matches_python_oracle(self):
+        from tpu_ir.ops.chargram import (
+            build_chargram_index_host, gram_to_code, pack_term_bytes)
+
+        terms = sorted(["alpha", "alphabet", "beta", "albania", "a"])
+        tb, tl = pack_term_bytes(terms, 5)
+        codes, indptr, tids = build_chargram_index_host(tb, tl, k=5)
+
+        oracle: dict[bytes, set] = {}
+        for i, t in enumerate(terms):
+            s = b"$" + t.encode() + b"$"
+            for j in range(len(s) - 4):
+                oracle.setdefault(s[j : j + 5], set()).add(i)
+        assert len(codes) == len(oracle)
+        for gram, want in oracle.items():
+            gi = int(np.searchsorted(codes, gram_to_code(gram, 5)))
+            got = tids[indptr[gi] : indptr[gi + 1]].tolist()
+            assert got == sorted(want), gram
+
+    def test_k_gt_8_rejected(self):
+        from tpu_ir.ops.chargram import (
+            build_chargram_index_host, pack_term_bytes)
+
+        tb, tl = pack_term_bytes(["word"], 9)
+        with pytest.raises(ValueError):
+            build_chargram_index_host(tb, tl, k=9)
+
+    def test_builder_integration_and_expand(self, tmp_path):
+        """chargram_ks mixing device (<=4) and host (>4) ks builds both
+        artifacts, and wildcard expansion works over the k=5 index."""
+        from tpu_ir.index import build_index
+        from tpu_ir.search.wildcard import WildcardLookup
+
+        corpus = tmp_path / "c.trec"
+        corpus.write_text(
+            "<DOC>\n<DOCNO> W-1 </DOCNO>\n<TEXT>\nfishing fisher walked"
+            "\n</TEXT>\n</DOC>\n")
+        idx = str(tmp_path / "idx")
+        meta = build_index([str(corpus)], idx, chargram_ks=[2, 5],
+                           num_shards=2)
+        assert meta.chargram_ks == [2, 5]
+        lookup = WildcardLookup.load(idx, 5)
+        got = lookup.expand("fish*")
+        assert "fisher" in got and "fish" in got  # 'fishing' stems to fish

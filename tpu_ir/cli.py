@@ -165,6 +165,20 @@ def cmd_inspect(args) -> int:
     from .collection import Vocab
     from .index import format as fmt
 
+    if args.term is not None:
+        # per-term random access through dictionary.tsv (the reference
+        # getValue seek path, IntDocVectorsForwardIndex.java:148-184)
+        from .index.dictionary import lookup_term
+
+        tp = lookup_term(args.index_dir, args.term)
+        if tp is None:
+            print(f"term {args.term!r} not in dictionary", file=sys.stderr)
+            return 1
+        posts = [tuple(p) for p in tp.postings[: args.postings].tolist()]
+        print(f"part-{tp.shard:05d}@{tp.offset}\t{tp.term}\tdf={tp.df}"
+              f"\t{posts}")
+        return 0
+
     meta = fmt.IndexMetadata.load(args.index_dir)
     print(json.dumps(meta.__dict__))
     vocab = Vocab.load(os.path.join(args.index_dir, fmt.VOCAB))
@@ -234,9 +248,16 @@ def cmd_docno(args) -> int:
     from .index import format as fmt
 
     mapping = DocnoMapping.load(os.path.join(args.index_dir, fmt.DOCNOS))
+    if args.op != "list" and args.arg is None:
+        print(f"usage: tpu-ir docno INDEX_DIR {args.op} "
+              f"{'DOCID' if args.op == 'getDocno' else 'DOCNO'}",
+              file=sys.stderr)
+        return 1
     if args.op == "list":
+        # reference column order: docno first
+        # (TrecDocnoMapping.java list branch prints i + "\t" + mDocids[i])
         for docno in range(1, len(mapping) + 1):
-            print(f"{mapping.get_docid(docno)}\t{docno}")
+            print(f"{docno}\t{mapping.get_docid(docno)}")
     elif args.op == "getDocno":
         try:
             print(mapping.get_docno(args.arg))
@@ -244,7 +265,11 @@ def cmd_docno(args) -> int:
             print(f"docid {args.arg!r} not found", file=sys.stderr)
             return 1
     else:  # getDocid
-        docno = int(args.arg)
+        try:
+            docno = int(args.arg)
+        except ValueError:
+            print(f"invalid docno {args.arg!r}", file=sys.stderr)
+            return 1
         if not 1 <= docno <= len(mapping):
             print(f"docno {docno} out of range 1..{len(mapping)}",
                   file=sys.stderr)
@@ -318,6 +343,10 @@ def main(argv: list[str] | None = None) -> int:
     pn.add_argument("-n", type=int, default=20, help="max terms to print")
     pn.add_argument("--postings", type=int, default=10,
                     help="max postings per term")
+    pn.add_argument("--term", default=None,
+                    help="print one term's postings via the dictionary "
+                         "(the reference getValue seek); input is analyzed "
+                         "like a query")
     _add_backend_arg(pn)
     pn.set_defaults(fn=cmd_inspect)
 
@@ -352,7 +381,14 @@ def main(argv: list[str] | None = None) -> int:
     pe.set_defaults(fn=cmd_expand)
 
     args = p.parse_args(argv)
-    return args.fn(args)
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # downstream pipe (e.g. `| head`) closed early — standard unix exit;
+        # handled here (not just under __main__) so the installed console
+        # script gets the same behavior
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        return 141
 
 
 if __name__ == "__main__":

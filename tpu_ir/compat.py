@@ -64,10 +64,17 @@ class CompatIndex:
     def rank(self, query: str, enforce_word_cap: bool = True
              ) -> list[tuple[str, float]] | None:
         """Reference rank(): returns top-10 (docid, score), or None when the
-        query fails the 1-2 word guard."""
-        q_tokens = self._analyzer.analyze(query)
-        if enforce_word_cap and not 1 <= len(q_tokens) <= 2:
+        query fails the 1-2 word guard. The guard counts RAW whitespace-split
+        words, not analyzed tokens ("origQ = term.split(\"\\\\s+\")",
+        IntDocVectorsForwardIndex.java:292,297 — the comment there says the
+        tokenizer may drop some), so punctuated queries like "gold, or!"
+        count 2 words even if analysis yields a different token count.
+        The reference trims the line BEFORE splitting (:284), so Python's
+        argless split() — which ignores edge whitespace — is the exact
+        trim+split("\\s+") word count."""
+        if enforce_word_cap and not 1 <= len(query.split()) <= 2:
             return None
+        q_tokens = self._analyzer.analyze(query)
         q_terms = kgram_terms(q_tokens, self.k)
 
         # reference accumulation: a list of DocScore searched linearly; we

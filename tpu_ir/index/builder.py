@@ -238,11 +238,10 @@ def build_index(
 
     # --- shard + persist (part-NNNNN layout) ---
     with report.phase("write_shards"):
-        shard_of = np.arange(v, dtype=np.int32) % num_shards
-        offset_of = np.zeros(v, np.int64)
         if deferred is not None:
             df, doc_len, pair_doc, pair_tf = fetch_to_host(*deferred)
             np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
+            shard_of, offset_of = fmt.shard_local_offsets(df, num_shards)
             # selection per shard is one boolean mask over the pairs' terms
             pair_shard = shard_of[pair_term_from_df(df)]
             for s in range(num_shards):
@@ -250,7 +249,6 @@ def build_index(
                 lens = df[tids].astype(np.int64)
                 local_indptr = np.concatenate([[0], np.cumsum(lens)])
                 sel = pair_shard == s
-                offset_of[tids] = local_indptr[:-1]
                 fmt.save_shard(
                     index_dir, s,
                     term_ids=tids,
@@ -261,11 +259,11 @@ def build_index(
                 )
         else:
             np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
+            shard_of, offset_of = fmt.shard_local_offsets(df, num_shards)
             for s, (s_term, s_doc, s_tf) in enumerate(shard_pairs):
                 tids = np.nonzero(shard_of == s)[0].astype(np.int32)
                 lens = df[tids].astype(np.int64)
                 local_indptr = np.concatenate([[0], np.cumsum(lens)])
-                offset_of[tids] = local_indptr[:-1]
                 fmt.save_shard(index_dir, s, term_ids=tids,
                                indptr=local_indptr, pair_doc=s_doc,
                                pair_tf=s_tf, df=df[tids])
@@ -352,6 +350,15 @@ def dispatch_chargram_builds(
         # just the fetch+write in collect
         report = JobReport("CharKGramTermIndexer", config={"k": ck},
                            suffix=f"-k{ck}")
+        if ck > 4:
+            # int64 gram codes don't fit the x32 device sort; defer the
+            # numpy twin to collect time as a thunk so dispatch stays
+            # non-blocking (the builder slots its postings fetch between
+            # dispatch and collect — host work here would serialize that)
+            from ..ops.chargram import build_chargram_index_host
+
+            return ck, ("host", lambda: build_chargram_index_host(
+                tb_np, tl_np, k=ck)), report
         idx = build_chargram_index_jit(tb, tl, k=ck)
         for a in (idx.num_grams, idx.num_entries):
             a.copy_to_host_async()
@@ -373,6 +380,14 @@ def collect_chargram_builds(index_dir: str, handle) -> None:
         ck, idx, report = pending.pop(0)
         if todo:
             pending.append(dispatch_one(todo.pop(0)))
+        if isinstance(idx, tuple) and idx[0] == "host":
+            gram_codes, indptr, term_ids = idx[1]()
+            fmt.save_chargram(index_dir, ck, gram_codes=gram_codes,
+                              indptr=indptr, term_ids=term_ids)
+            report.set_counter("map_output_records", len(term_ids))
+            report.set_counter("reduce_output_groups", len(gram_codes))
+            report.save(os.path.join(index_dir, fmt.JOBS_DIR))
+            continue
         # the count scalars (already async in flight) tell the host the
         # valid prefixes; the capacity-padded result arrays are then sliced
         # + narrowed on device so only real entries cross the tunnel

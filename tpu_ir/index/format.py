@@ -101,6 +101,23 @@ def load_chargram(index_dir: str, k: int) -> dict[str, np.ndarray]:
         return {k_: z[k_] for k_ in z.files}
 
 
+def shard_local_offsets(df: np.ndarray, num_shards: int
+                        ) -> tuple[np.ndarray, np.ndarray]:
+    """(shard_of [V], offset_of [V]): each term's shard (term_id % shards)
+    and its postings start within that shard's pair columns (cumsum of the
+    shard's dfs). The single source of truth shared by every writer
+    (builder, streaming, multihost) and the verifier — the offsets are what
+    dictionary.tsv records and Dictionary.get_value seeks by."""
+    v = len(df)
+    shard_of = np.arange(v, dtype=np.int32) % num_shards
+    offset_of = np.zeros(v, np.int64)
+    for s in range(num_shards):
+        tids = np.nonzero(shard_of == s)[0]
+        offset_of[tids] = np.concatenate(
+            [[0], np.cumsum(df[tids], dtype=np.int64)])[:-1]
+    return shard_of, offset_of
+
+
 def write_dictionary(index_dir: str, terms: list[str],
                      shard_of: np.ndarray, offset_of: np.ndarray) -> None:
     """Forward-index parity artifact: sorted 'term<TAB>shard<TAB>offset'

@@ -204,7 +204,6 @@ def build_index_streaming(
     df = np.zeros(v, np.int32)
     num_pairs_total = 0
     shard_of = np.arange(v, dtype=np.int32) % num_shards
-    offset_of = np.zeros(v, np.int64)
     # pass 3 is a pure sort, NOT a merge: batches partition whole documents,
     # so a (term, doc) pair exists in exactly one batch and per-batch
     # combining (pass 2's device group-by) already produced final tfs. The
@@ -233,7 +232,6 @@ def build_index_streaming(
             tids = np.nonzero(shard_of == s)[0].astype(np.int32)
             lens = rdf[tids].astype(np.int64)
             local_indptr = np.concatenate([[0], np.cumsum(lens)])
-            offset_of[tids] = local_indptr[:-1]
             fmt.save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
                            pair_doc=d, pair_tf=w, df=rdf[tids])
     report.set_counter("num_pairs", num_pairs_total)
@@ -241,6 +239,7 @@ def build_index_streaming(
     with report.phase("dictionary"):
         np.save(os.path.join(index_dir, fmt.DOCLEN),
                 doc_len.astype(np.int32))
+        _, offset_of = fmt.shard_local_offsets(df, num_shards)
         fmt.write_dictionary(index_dir, vocab.terms, shard_of, offset_of)
         dict_report = JobReport("BuildIntDocVectorsForwardIndex")
         dict_report.set_counter("Dictionary.Size", v)

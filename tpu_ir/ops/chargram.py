@@ -11,8 +11,10 @@ gather; each gram packs its k bytes into one int32 code (k <= 4); then the
 same sort + run-length machinery as the inverted index groups (gram, term)
 pairs. Because term ids are assigned in lexicographic order, the per-gram
 term-id lists come out sorted exactly like the reference's merged string
-lists. For k > 4 the host packer hashes bytes into 32 bits instead (gram
-strings themselves stay host-side either way).
+lists. For 4 < k <= 8 a host (numpy) twin packs grams into int64 instead —
+the default x32 jax config has no int64 sort, and k that large is far off
+the reference's k=2,3 hot path, so it does not earn a device program. k > 8
+is rejected (a gram must pack into one sortable integer code).
 """
 
 from __future__ import annotations
@@ -122,6 +124,44 @@ def build_chargram_index(
 
 
 build_chargram_index_jit = jax.jit(build_chargram_index, static_argnames=("k",))
+
+
+def build_chargram_index_host(
+    term_bytes: np.ndarray,  # uint8 [T, Lmax]
+    term_lens: np.ndarray,   # int32 [T]
+    *,
+    k: int,
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Host twin of build_chargram_index for 4 < k <= 8 (int64 gram codes).
+
+    Same semantics — sliding byte windows of '$term$', (gram, term) dedup,
+    per-gram sorted-unique term lists — with numpy doing the lexsort the
+    device program can't at 64-bit codes under x32. Returns
+    (gram_codes int64 [G], indptr int64 [G+1], term_ids int32 [C])."""
+    if not 1 <= k <= 8:
+        raise ValueError("gram codes pack into one int64; need 1<=k<=8")
+    t, lmax = term_bytes.shape
+    n_windows = max(lmax - k + 1, 1)
+    # fold the k axis with shifted adds — peak memory stays one [T, W]
+    # int64 array instead of a [T, W, k] window tensor (~k*8x the byte
+    # matrix, GBs at 1M-term vocabularies)
+    codes = np.zeros((t, n_windows), np.int64)
+    for j in range(k):
+        codes = (codes << 8) | term_bytes[:, j : j + n_windows].astype(
+            np.int64)
+    valid = (np.arange(n_windows)[None, :] + k) <= term_lens[:, None]
+
+    flat_codes = codes[valid]
+    flat_terms = np.broadcast_to(
+        np.arange(t, dtype=np.int32)[:, None], codes.shape)[valid]
+    order = np.lexsort((flat_terms, flat_codes))
+    g, tm = flat_codes[order], flat_terms[order]
+    keep = np.ones(len(g), bool)
+    keep[1:] = (np.diff(g) != 0) | (np.diff(tm) != 0)
+    g, tm = g[keep], tm[keep]
+    gram_codes, counts = np.unique(g, return_counts=True)
+    indptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
+    return gram_codes.astype(np.int64), indptr, tm.astype(np.int32)
 
 
 def code_to_gram(code: int, k: int) -> str:

@@ -187,7 +187,7 @@ def build_index_multihost(
         doc_len = np.asarray(multihost_utils.process_allgather(local_dl))
         doc_len = doc_len.reshape(-1, num_docs + 1).sum(axis=0).astype(np.int32)
 
-        shard_of = np.arange(v, dtype=np.int32) % s
+        shard_of, offset_of = fmt.shard_local_offsets(df, s)
         num_pairs_rows = {}
         for sd in out.num_pairs.addressable_shards:
             num_pairs_rows[sd.index[0].start] = int(
@@ -196,12 +196,10 @@ def build_index_multihost(
                     for sd in out.pair_doc.addressable_shards}
         tf_rows = {sd.index[0].start: np.asarray(sd.data).reshape(-1)
                    for sd in out.pair_tf.addressable_shards}
-        offset_of = np.zeros(v, np.int64)
         for row, npairs in num_pairs_rows.items():
             tids = np.nonzero(shard_of == row)[0].astype(np.int32)
             lens = df[tids].astype(np.int64)
             local_indptr = np.concatenate([[0], np.cumsum(lens)])
-            offset_of[tids] = local_indptr[:-1]
             fmt.save_shard(index_dir, row, term_ids=tids,
                            indptr=local_indptr,
                            pair_doc=doc_rows[row][:npairs],
@@ -213,13 +211,8 @@ def build_index_multihost(
         mapping.save(os.path.join(index_dir, fmt.DOCNOS))
         vocab.save(os.path.join(index_dir, fmt.VOCAB))
         np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
-        # offsets are derivable on every process (df is global): recompute all
-        all_offsets = np.zeros(v, np.int64)
-        for row in range(s):
-            tids = np.nonzero(shard_of == row)[0]
-            all_offsets[tids] = np.concatenate(
-                [[0], np.cumsum(df[tids].astype(np.int64))])[:-1]
-        fmt.write_dictionary(index_dir, vocab.terms, shard_of, all_offsets)
+        # offsets were derived from the global df, so process 0 holds them all
+        fmt.write_dictionary(index_dir, vocab.terms, shard_of, offset_of)
         built_chargrams = bool(compute_chargrams and chargram_ks and k == 1)
         if built_chargrams:
             build_chargram_artifacts(index_dir, vocab.terms,
