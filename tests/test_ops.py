@@ -495,13 +495,31 @@ class TestChargramHostFallback:
             got = tids[indptr[gi] : indptr[gi + 1]].tolist()
             assert got == sorted(want), gram
 
-    def test_k_gt_8_rejected(self):
+    def test_k_gt_7_rejected(self):
+        """k=8 would let grams with a >=0x80 leading byte (any non-ASCII)
+        overflow int64's sign bit and silently break lookups."""
         from tpu_ir.ops.chargram import (
             build_chargram_index_host, pack_term_bytes)
 
-        tb, tl = pack_term_bytes(["word"], 9)
+        tb, tl = pack_term_bytes(["word"], 8)
         with pytest.raises(ValueError):
-            build_chargram_index_host(tb, tl, k=9)
+            build_chargram_index_host(tb, tl, k=8)
+
+    def test_non_ascii_grams_roundtrip(self):
+        """Multi-byte UTF-8 grams (leading byte >= 0x80) must stay
+        positive and matchable at the max host k."""
+        from tpu_ir.ops.chargram import (
+            build_chargram_index_host, gram_to_code, pack_term_bytes)
+
+        terms = sorted(["caféterm", "naïveword"])
+        tb, tl = pack_term_bytes(terms, 7)
+        codes, indptr, tids = build_chargram_index_host(tb, tl, k=7)
+        assert (codes >= 0).all()
+        s = b"$" + terms[0].encode("utf-8") + b"$"
+        gram = s[1:8]  # window containing the 2-byte é sequence
+        gi = int(np.searchsorted(codes, gram_to_code(gram, 7)))
+        assert codes[gi] == gram_to_code(gram, 7)
+        assert 0 in tids[indptr[gi] : indptr[gi + 1]]
 
     def test_builder_integration_and_expand(self, tmp_path):
         """chargram_ks mixing device (<=4) and host (>4) ks builds both

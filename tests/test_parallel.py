@@ -9,11 +9,15 @@ import jax
 import jax.numpy as jnp
 
 from tpu_ir.ops import PAD_TERM, build_postings_jit, dense_doc_matrix, tfidf_topk_dense
+from tpu_ir.ops.scoring import bm25_topk_dense, cosine_rerank_dense, dense_tf_matrix, idf_weights
 from tpu_ir.parallel import (
-    make_doc_blocks,
     make_mesh,
+    make_sharded_tiered,
+    put_sharded,
+    shard_slices,
     sharded_build_postings,
-    sharded_tfidf_topk,
+    sharded_tiered_rerank,
+    sharded_tiered_topk,
 )
 
 S = 8
@@ -95,7 +99,10 @@ def test_sharded_build_overflow_retry():
     assert int(np.asarray(out.dropped)[0]) == 0
 
 
-def test_sharded_scoring_equals_single_device():
+@pytest.fixture(scope="module")
+def _scoring_fixture():
+    """Postings + the sharded tiered layout on the 8-device mesh, with a
+    small hot budget so both the hot strip AND the cold tiers carry data."""
     t, d, term_ids, doc_ids, dps, vocab, ndocs = _synth(seed=1)
     flat_cap = 8192
     ft = np.full(flat_cap, PAD_TERM, np.int32)
@@ -108,23 +115,86 @@ def test_sharded_scoring_equals_single_device():
     pt = np.asarray(ref.pair_term)[:npairs]
     pd = np.asarray(ref.pair_doc)[:npairs]
     ptf = np.asarray(ref.pair_tf)[:npairs]
+    df = np.asarray(ref.df)
+    doc_len = np.asarray(ref.doc_len)
 
-    mat = dense_doc_matrix(jnp.asarray(pt), jnp.asarray(pd), jnp.asarray(ptf),
-                           vocab_size=vocab, num_docs=ndocs)
+    mesh = make_mesh(S)
+    lay = make_sharded_tiered(pt, pd, ptf, df, doc_len,
+                              num_docs=ndocs, num_shards=S,
+                              hot_budget=S * 9 * 4)
+    lay = put_sharded(lay, mesh)
+    assert np.asarray(lay.hot_rank).max() >= 0          # hot strip in use
+    assert any(np.asarray(td).any() for td in lay.tier_docs)  # tiers in use
     queries = np.array([[0, 5, -1], [17, 3, 9], [149, -1, -1], [2, 2, 2]],
                        np.int32)
+    return ref, pt, pd, ptf, vocab, ndocs, mesh, lay, queries
+
+
+def test_sharded_tiered_tfidf_equals_single_device(_scoring_fixture):
+    ref, pt, pd, ptf, vocab, ndocs, mesh, lay, queries = _scoring_fixture
+    mat = dense_doc_matrix(jnp.asarray(pt), jnp.asarray(pd), jnp.asarray(ptf),
+                           vocab_size=vocab, num_docs=ndocs)
     s_ref, d_ref = tfidf_topk_dense(jnp.asarray(queries), mat, ref.df,
                                     jnp.int32(ndocs), k=10)
-
-    blocks, bases = make_doc_blocks(pt, pd, ptf, vocab_size=vocab,
-                                    num_docs=ndocs, num_shards=S)
-    mesh = make_mesh(S)
-    s_got, d_got = sharded_tfidf_topk(
-        jnp.asarray(queries), jnp.asarray(blocks), jnp.asarray(bases),
-        ref.df, jnp.int32(ndocs), mesh=mesh, k=10)
-
+    s_got, d_got = sharded_tiered_topk(
+        jnp.asarray(queries), lay, ref.df, jnp.int32(ndocs), mesh=mesh, k=10)
     np.testing.assert_allclose(np.asarray(s_got), np.asarray(s_ref), rtol=1e-5)
     # doc ids equal wherever scores are distinct; compare sets per query
+    for qi in range(queries.shape[0]):
+        assert set(np.asarray(d_got)[qi].tolist()) == \
+            set(np.asarray(d_ref)[qi].tolist())
+    # compat int-idf flows through the sharded path too
+    s_c, _ = sharded_tiered_topk(
+        jnp.asarray(queries), lay, ref.df, jnp.int32(ndocs), mesh=mesh,
+        k=10, compat_int_idf=True)
+    s_cr, _ = tfidf_topk_dense(jnp.asarray(queries), mat, ref.df,
+                               jnp.int32(ndocs), k=10, compat_int_idf=True)
+    np.testing.assert_allclose(np.asarray(s_c), np.asarray(s_cr), rtol=1e-5)
+
+
+def test_sharded_tiered_bm25_equals_single_device(_scoring_fixture):
+    ref, pt, pd, ptf, vocab, ndocs, mesh, lay, queries = _scoring_fixture
+    tf_mat = dense_tf_matrix(jnp.asarray(pt), jnp.asarray(pd),
+                             jnp.asarray(ptf), vocab_size=vocab,
+                             num_docs=ndocs)
+    s_ref, d_ref = bm25_topk_dense(jnp.asarray(queries), tf_mat, ref.df,
+                                   ref.doc_len, jnp.int32(ndocs), k=10)
+    s_got, d_got = sharded_tiered_topk(
+        jnp.asarray(queries), lay, ref.df, jnp.int32(ndocs), mesh=mesh,
+        k=10, scoring="bm25")
+    np.testing.assert_allclose(np.asarray(s_got), np.asarray(s_ref),
+                               rtol=1e-5)
+    for qi in range(queries.shape[0]):
+        assert set(np.asarray(d_got)[qi].tolist()) == \
+            set(np.asarray(d_ref)[qi].tolist())
+
+
+def test_sharded_tiered_rerank_equals_single_device(_scoring_fixture):
+    ref, pt, pd, ptf, vocab, ndocs, mesh, lay, queries = _scoring_fixture
+    df = np.asarray(ref.df)
+    idf = np.asarray(idf_weights(ref.df, ndocs))
+    w = (1.0 + np.log(np.maximum(ptf, 1))) * idf[pt]
+    sq = np.bincount(pd, weights=w * w, minlength=ndocs + 1)
+    norms = np.sqrt(sq[: ndocs + 1]).astype(np.float32)
+
+    # single-device two-stage pipeline
+    tf_mat = dense_tf_matrix(jnp.asarray(pt), jnp.asarray(pd),
+                             jnp.asarray(ptf), vocab_size=vocab,
+                             num_docs=ndocs)
+    _, cand = bm25_topk_dense(jnp.asarray(queries), tf_mat, ref.df,
+                              ref.doc_len, jnp.int32(ndocs), k=16)
+    mat = dense_doc_matrix(jnp.asarray(pt), jnp.asarray(pd), jnp.asarray(ptf),
+                           vocab_size=vocab, num_docs=ndocs)
+    s_ref, d_ref = cosine_rerank_dense(
+        jnp.asarray(queries), mat, ref.df, jnp.asarray(norms), cand,
+        jnp.int32(ndocs), k=5)
+
+    s_got, d_got = sharded_tiered_rerank(
+        jnp.asarray(queries), lay, ref.df, jnp.int32(ndocs),
+        jnp.asarray(shard_slices(norms, num_docs=ndocs, num_shards=S)),
+        mesh=mesh, k=5, candidates=16)
+    np.testing.assert_allclose(np.asarray(s_got), np.asarray(s_ref),
+                               rtol=1e-5)
     for qi in range(queries.shape[0]):
         assert set(np.asarray(d_got)[qi].tolist()) == \
             set(np.asarray(d_ref)[qi].tolist())

@@ -170,13 +170,14 @@ def cmd_inspect(args) -> int:
         # getValue seek path, IntDocVectorsForwardIndex.java:148-184)
         from .index.dictionary import lookup_term
 
-        tp = lookup_term(args.index_dir, args.term)
-        if tp is None:
+        hits = lookup_term(args.index_dir, args.term)
+        if not hits:
             print(f"term {args.term!r} not in dictionary", file=sys.stderr)
             return 1
-        posts = [tuple(p) for p in tp.postings[: args.postings].tolist()]
-        print(f"part-{tp.shard:05d}@{tp.offset}\t{tp.term}\tdf={tp.df}"
-              f"\t{posts}")
+        for tp in hits:
+            posts = [tuple(p) for p in tp.postings[: args.postings].tolist()]
+            print(f"part-{tp.shard:05d}@{tp.offset}\t{tp.term}\tdf={tp.df}"
+                  f"\t{posts}")
         return 0
 
     meta = fmt.IndexMetadata.load(args.index_dir)
@@ -327,9 +328,10 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded", "pallas"],
                     default="auto",
-                    help="'sharded' distributes doc blocks over all devices "
-                         "with a global top-k merge; 'pallas' scores the "
-                         "dense layout with the fused TPU kernel")
+                    help="'sharded' distributes the tiered layout's doc "
+                         "axis over all devices (TF-IDF/BM25/rerank) with "
+                         "a global top-k merge; 'pallas' scores the dense "
+                         "layout with the fused TPU kernel")
     ps.add_argument("--docnos", action="store_true",
                     help="print docnos instead of docids")
     ps.add_argument("--compat", action="store_true",

@@ -42,15 +42,31 @@ class Dictionary:
     (IntDocVectorsForwardIndex.java:93-122); term ids fall out of line
     order because the dictionary is written in sorted-term order."""
 
-    def __init__(self, index_dir: str):
+    def __init__(self, index_dir: str, *, text: str | None = None):
+        """`text` lets a caller that already read dictionary.tsv (e.g. the
+        verifier, which compares the raw bytes) share it instead of a
+        second disk read."""
         self._dir = index_dir
         self._entries: dict[str, tuple[int, int, int]] = {}
-        with open(os.path.join(index_dir, fmt.DICTIONARY),
-                  encoding="utf-8") as f:
-            for tid, line in enumerate(f):
-                term, shard, offset = line.rstrip("\n").rsplit("\t", 2)
-                self._entries[term] = (tid, int(shard), int(offset))
+        if text is None:
+            with open(os.path.join(index_dir, fmt.DICTIONARY),
+                      encoding="utf-8") as f:
+                text = f.read()
+        for tid, line in enumerate(text.splitlines()):
+            term, shard, offset = line.rsplit("\t", 2)
+            self._entries[term] = (tid, int(shard), int(offset))
+        # shards load lazily and stay cached; a cooperating caller may
+        # also consume the cache via pop_shard to avoid re-reads
         self._shard_cache: dict[int, dict[str, np.ndarray]] = {}
+
+    def pop_shard(self, shard: int) -> dict[str, np.ndarray]:
+        """Hand over (and forget) a shard's arrays — loading it if never
+        touched — so a caller walking every shard after a spot-check pays
+        one read total and memory is released as it goes."""
+        z = self._shard_cache.pop(shard, None)
+        if z is None:
+            z = fmt.load_shard(self._dir, shard)
+        return z
 
     def __len__(self) -> int:
         return len(self._entries)
@@ -91,31 +107,36 @@ class Dictionary:
 
 
 def lookup_term(index_dir: str, term: str, *,
-                analyze: bool = True) -> TermPostings | None:
-    """One-shot per-term lookup; `analyze=True` runs the term through the
+                analyze: bool = True) -> list[TermPostings]:
+    """One-shot per-term lookup; `analyze=True` runs the input through the
     same analyzer as indexing first (reference parity: query terms are
     analyzed before the dictionary lookup, IntDocVectorsForwardIndex.java:
-    276,295). Multi-token input composes the index's k-grams."""
-    query = term
+    276,295). Multi-token input composes the index's k-grams and EVERY
+    composed gram is resolved (one TermPostings per dictionary hit; misses
+    are skipped like the reference's null path)."""
+    queries = [term]
     if analyze:
         from ..analysis.native import make_analyzer
         from ..collection import kgram_terms
 
         meta = fmt.IndexMetadata.load(index_dir)
         toks = make_analyzer().analyze(term)
-        grams = kgram_terms(toks, meta.k)
-        if not grams:
-            return None
-        query = grams[0]
-    return Dictionary(index_dir).get_value(query)
+        queries = kgram_terms(toks, meta.k)
+    d = Dictionary(index_dir)
+    hits = (d.get_value(q) for q in dict.fromkeys(queries))
+    return [h for h in hits if h is not None]
 
 
-def verify_dictionary_access(index_dir: str, sample: int = 64) -> int:
+def verify_dictionary_access(index_dir: str, sample: int = 64, *,
+                             dictionary: Dictionary | None = None,
+                             vocab: Vocab | None = None) -> int:
     """Spot-check the dictionary against the vocab: resolve `sample` evenly
     spaced terms through get_value and confirm df parity. Returns the number
-    of terms checked (used by tests and `tpu-ir verify`)."""
-    vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
-    d = Dictionary(index_dir)
+    of terms checked (used by tests and `tpu-ir verify`). Pass `dictionary`
+    / `vocab` to reuse already-loaded state (the verifier does)."""
+    if vocab is None:
+        vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
+    d = dictionary if dictionary is not None else Dictionary(index_dir)
     n = len(vocab)
     step = max(1, n // max(sample, 1))
     checked = 0

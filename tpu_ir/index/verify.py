@@ -29,12 +29,25 @@ def verify_index(index_dir: str) -> dict:
     assert doc_len.shape[0] == meta.num_docs + 1, "doclen length"
     assert doc_len[0] == 0, "doclen slot 0 must be unused"
 
+    # dictionary access path first (the reference's post-seek term-match
+    # check, exercised end to end): the Dictionary shares this function's
+    # reads — it is handed the raw tsv text, and the shards its spot-check
+    # pulled in are consumed (pop_shard) by the structural loop below, so
+    # the whole verification reads each artifact exactly once
+    from .dictionary import Dictionary, verify_dictionary_access
+
+    dict_text = open(os.path.join(index_dir, fmt.DICTIONARY),
+                     encoding="utf-8").read()
+    dictionary = Dictionary(index_dir, text=dict_text)
+    dict_checked = verify_dictionary_access(
+        index_dir, dictionary=dictionary, vocab=vocab)
+
     seen_terms = np.zeros(meta.vocab_size, bool)
     df_global = np.zeros(meta.vocab_size, np.int64)
     total_pairs = 0
     total_tf = 0
     for s in range(meta.num_shards):
-        z = fmt.load_shard(index_dir, s)
+        z = dictionary.pop_shard(s)
         tids, indptr = z["term_ids"], z["indptr"]
         pd, ptf, df = z["pair_doc"], z["pair_tf"], z["df"]
         assert ((tids % meta.num_shards) == s).all(), f"shard {s}: foreign term"
@@ -85,9 +98,7 @@ def verify_index(index_dir: str) -> dict:
     expected = "".join(
         f"{term}\t{shard_of[tid]}\t{offset_of[tid]}\n"
         for tid, term in enumerate(vocab.terms))
-    actual = open(os.path.join(index_dir, fmt.DICTIONARY),
-                  encoding="utf-8").read()
-    assert actual == expected, "dictionary content mismatch"
+    assert dict_text == expected, "dictionary content mismatch"
     terms_arr = np.array(vocab.terms, dtype=np.str_)
     assert (terms_arr[:-1] < terms_arr[1:]).all(), "vocab not sorted-unique"
 
@@ -104,12 +115,6 @@ def verify_index(index_dir: str) -> dict:
             within[starts[(starts > 0) & (starts < len(tids))] - 1] = False
             assert (np.diff(tids)[within] > 0).all(), \
                 f"chargram k={ck}: term lists not sorted-unique"
-
-    # dictionary access path: resolve a term sample through get_value (the
-    # reference's post-seek term-match check, exercised end to end)
-    from .dictionary import verify_dictionary_access
-
-    dict_checked = verify_dictionary_access(index_dir)
 
     return {
         "dictionary_terms_checked": dict_checked,

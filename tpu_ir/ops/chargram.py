@@ -11,10 +11,13 @@ gather; each gram packs its k bytes into one int32 code (k <= 4); then the
 same sort + run-length machinery as the inverted index groups (gram, term)
 pairs. Because term ids are assigned in lexicographic order, the per-gram
 term-id lists come out sorted exactly like the reference's merged string
-lists. For 4 < k <= 8 a host (numpy) twin packs grams into int64 instead —
+lists. For 4 < k <= 7 a host (numpy) twin packs grams into int64 instead —
 the default x32 jax config has no int64 sort, and k that large is far off
-the reference's k=2,3 hot path, so it does not earn a device program. k > 8
-is rejected (a gram must pack into one sortable integer code).
+the reference's k=2,3 hot path, so it does not earn a device program. k > 7
+is rejected: a gram must pack into one sortable integer code, and an 8-byte
+gram whose leading byte is >= 0x80 would overflow int64's sign bit (the
+stored code would go negative while gram_to_code's Python int stays
+unsigned, silently breaking lookups for non-ASCII grams).
 """
 
 from __future__ import annotations
@@ -132,14 +135,17 @@ def build_chargram_index_host(
     *,
     k: int,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Host twin of build_chargram_index for 4 < k <= 8 (int64 gram codes).
+    """Host twin of build_chargram_index for 4 < k <= 7 (int64 gram codes).
 
     Same semantics — sliding byte windows of '$term$', (gram, term) dedup,
     per-gram sorted-unique term lists — with numpy doing the lexsort the
-    device program can't at 64-bit codes under x32. Returns
+    device program can't at 64-bit codes under x32. k <= 7 keeps codes in
+    56 bits, clear of int64's sign bit (see module docstring). Returns
     (gram_codes int64 [G], indptr int64 [G+1], term_ids int32 [C])."""
-    if not 1 <= k <= 8:
-        raise ValueError("gram codes pack into one int64; need 1<=k<=8")
+    if not 1 <= k <= 7:
+        raise ValueError(
+            "gram codes must stay within int64's positive range; need "
+            "1<=k<=7 (56-bit codes)")
     t, lmax = term_bytes.shape
     n_windows = max(lmax - k + 1, 1)
     # fold the k axis with shifted adds — peak memory stays one [T, W]

@@ -1,12 +1,23 @@
 from .mesh import SHARD_AXIS, make_mesh
 from .sharded_build import ShardedPostings, sharded_build_postings
-from .sharded_scoring import make_doc_blocks, sharded_tfidf_topk
+from .sharded_tiered import (
+    ShardedTieredLayout,
+    make_sharded_tiered,
+    put_sharded,
+    shard_slices,
+    sharded_tiered_rerank,
+    sharded_tiered_topk,
+)
 
 __all__ = [
     "SHARD_AXIS",
     "make_mesh",
     "ShardedPostings",
     "sharded_build_postings",
-    "make_doc_blocks",
-    "sharded_tfidf_topk",
+    "ShardedTieredLayout",
+    "make_sharded_tiered",
+    "put_sharded",
+    "shard_slices",
+    "sharded_tiered_rerank",
+    "sharded_tiered_topk",
 ]

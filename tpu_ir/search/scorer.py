@@ -23,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..analysis.native import make_analyzer
-from ..collection import DocnoMapping, Vocab, kgram_terms
+from ..collection import KGRAM_SEP, DocnoMapping, Vocab, kgram_terms
 from ..index import format as fmt
 from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense, tfidf_topk_sparse
 from ..ops.scoring import dense_tf_matrix
@@ -100,22 +100,22 @@ class Scorer:
                 jnp.asarray(pair_term), jnp.asarray(pair_doc),
                 jnp.asarray(pair_tf), vocab_size=v, num_docs=d)
         elif layout == "sharded":
-            # distributed serving: doc-sharded dense blocks over the mesh,
-            # per-shard top-k + global merge (parallel/sharded_scoring.py)
+            # distributed serving: the tiered layout's doc axis sharded
+            # over the mesh (parallel/sharded_tiered.py) — total memory is
+            # the single-device tiered layout spread across devices, so the
+            # corpora that need distribution actually fit; TF-IDF, BM25 and
+            # rerank all run on it
             import jax
 
-            from ..parallel import make_doc_blocks, make_mesh
+            from ..parallel import make_mesh, make_sharded_tiered, put_sharded
 
             n_dev = len(jax.devices())
             self._mesh = make_mesh(n_dev)
-            blocks, bases = make_doc_blocks(
-                pair_term, pair_doc, pair_tf,
-                vocab_size=v, num_docs=d, num_shards=n_dev)
-            self.doc_blocks = jax.device_put(
-                jnp.asarray(blocks),
-                jax.sharding.NamedSharding(
-                    self._mesh, jax.sharding.PartitionSpec("shards")))
-            self.doc_bases = jnp.asarray(bases)
+            lay = make_sharded_tiered(
+                pair_term, pair_doc, pair_tf, np.asarray(df),
+                np.asarray(doc_len), num_docs=d, num_shards=n_dev)
+            self._sharded = put_sharded(lay, self._mesh)
+            self._sharded_norm = None  # built lazily for rerank
         else:
             # tiered sparse: budget-capped dense strip for the hottest
             # terms + geometric-capacity padded tiers for the rest
@@ -190,19 +190,35 @@ class Scorer:
     def _wildcard_lookups(self):
         """Lazy WildcardLookups (largest chargram k first), or [] when the
         index has no char-gram artifacts / wasn't loaded from a directory.
-        Wildcard search is only meaningful at k=1, where the index vocabulary
-        is the token vocabulary the char-gram index covers."""
+        The char-gram index always covers the TOKEN vocabulary: for k=1
+        that is the index vocabulary itself (shared), for k>1 the builder's
+        tokens.txt sidecar — expansions then compose into k-gram terms
+        (see _analyze_wildcard_kgram)."""
         if not self._wildcard_tried:
             self._wildcard_tried = True
-            if (self._index_dir and self.meta.k == 1
-                    and self.meta.chargram_ks):
+            if self._index_dir and self.meta.chargram_ks:
                 from .wildcard import WildcardLookup
 
+                shared = self.vocab if self.meta.k == 1 else None
                 self._wildcard = [
-                    WildcardLookup.load(self._index_dir, ck,
-                                        vocab=self.vocab)
+                    WildcardLookup.load(self._index_dir, ck, vocab=shared)
                     for ck in sorted(self.meta.chargram_ks, reverse=True)]
         return self._wildcard or []
+
+    def _pattern_tokens(self, pattern: str) -> list[str] | None:
+        """Token-vocabulary expansions of one glob pattern via the largest
+        chargram k whose grams cover it; None when no lookup covers the
+        pattern (too short for every k, e.g. bare '*')."""
+        for lookup in self._wildcard_lookups():
+            if lookup.pattern_grams(pattern):
+                terms = lookup.expand(pattern, limit=self.WILDCARD_LIMIT + 1)
+                if len(terms) > self.WILDCARD_LIMIT:
+                    logger.warning(
+                        "pattern %r matches more than %d terms; "
+                        "expansion truncated", pattern, self.WILDCARD_LIMIT)
+                    terms = terms[: self.WILDCARD_LIMIT]
+                return terms
+        return None
 
     def _expand_wildcards(self, text: str) -> tuple[str, list[int]]:
         """Pull glob tokens ('te*', 'ho?se') out of a query; return the text
@@ -216,21 +232,11 @@ class Scorer:
             # use the largest chargram k whose grams cover the pattern; a
             # pattern too short for every k (e.g. '*') is skipped rather than
             # falling back to a full-vocabulary scan in the query hot path
-            pattern = part.lower()
-            for lookup in self._wildcard_lookups():
-                if lookup.pattern_grams(pattern):
-                    terms = lookup.expand(pattern,
-                                          limit=self.WILDCARD_LIMIT + 1)
-                    if len(terms) > self.WILDCARD_LIMIT:
-                        logger.warning(
-                            "pattern %r matches more than %d terms; "
-                            "expansion truncated", part, self.WILDCARD_LIMIT)
-                        terms = terms[: self.WILDCARD_LIMIT]
-                    for t in terms:
-                        tid = self.vocab.id_or(t)
-                        if tid >= 0:
-                            extra.append(tid)
-                    break
+            terms = self._pattern_tokens(part.lower())
+            for t in terms or []:
+                tid = self.vocab.id_or(t)
+                if tid >= 0:
+                    extra.append(tid)
 
         def repl(m: re.Match) -> str:
             token = m.group(0).strip(_EDGE_PUNCT)
@@ -252,6 +258,62 @@ class Scorer:
 
         return _WILDCARD_RE.sub(repl, text), extra
 
+    def _analyze_wildcard_kgram(self, text: str) -> list[int]:
+        """k>1 wildcard semantics: expand each glob token over the TOKEN
+        vocabulary (tokens.txt), then compose candidate k-gram index terms
+        from every k-slot window — the cartesian product over the window's
+        expansion sets, capped at WILDCARD_LIMIT candidates per window.
+        Each window is an OR over its candidates (same semantics as the
+        k=1 expansion); unknown composed grams are dropped like any
+        dictionary miss."""
+        import itertools
+
+        slots: list[list[str]] = []
+        for raw in text.split():
+            if "*" in raw or "?" in raw:
+                token = raw.strip(_EDGE_PUNCT)
+                for part in _GLOB_SPLIT_RE.split(token):
+                    part = part.rstrip("?")
+                    if not part:
+                        continue
+                    if "*" not in part and "?" not in part:
+                        for t in self._analyzer.analyze(part):
+                            slots.append([t])
+                    else:
+                        # no expansion = a slot no window matches through
+                        slots.append(self._pattern_tokens(part.lower())
+                                     or [])
+            else:
+                # literal tokens go through the standard analyzer (may
+                # yield 0..n tokens, e.g. stopwords vanish)
+                for t in self._analyzer.analyze(raw):
+                    slots.append([t])
+        k = self.meta.k
+        row: list[int] = []
+        seen: set[int] = set()
+        for i in range(max(len(slots) - k + 1, 0)):
+            window = slots[i : i + k]
+            if any(not s for s in window):
+                continue
+            # cap the window's cartesian product at WILDCARD_LIMIT combos
+            # by budgeting each multi-candidate slot the same share —
+            # itertools.product varies the LAST slot fastest, so a plain
+            # islice would exhaust the limit on the first expansion of a
+            # leading glob and silently drop every other one
+            n_multi = sum(1 for s in window if len(s) > 1)
+            if n_multi:
+                per_slot = max(
+                    int(self.WILDCARD_LIMIT ** (1.0 / n_multi)), 1)
+                window = [s[:per_slot] if len(s) > 1 else s
+                          for s in window]
+            for combo in itertools.islice(
+                    itertools.product(*window), self.WILDCARD_LIMIT):
+                tid = self.vocab.id_or(KGRAM_SEP.join(combo))
+                if tid >= 0 and tid not in seen:
+                    seen.add(tid)
+                    row.append(tid)
+        return row
+
     def analyze_queries(
         self, texts: Sequence[str], max_terms: int | None = None
     ) -> np.ndarray:
@@ -264,7 +326,11 @@ class Scorer:
         rows = []
         for text in texts:
             extra: list[int] = []
-            if "*" in text or "?" in text:
+            has_glob = "*" in text or "?" in text
+            if has_glob and self.meta.k > 1 and self._wildcard_lookups():
+                rows.append(self._analyze_wildcard_kgram(text))
+                continue
+            if has_glob:
                 text, extra = self._expand_wildcards(text)
             toks = self._analyzer.analyze(text)
             grams = kgram_terms(toks, self.meta.k)
@@ -331,7 +397,7 @@ class Scorer:
         Large batches are scored in query blocks so the per-dispatch score
         accumulator stays within SCORE_BUDGET elements regardless of corpus
         size (the reference had no batching at all; SURVEY.md §3.3)."""
-        block = max(1, self.SCORE_BUDGET // (self.meta.num_docs + 1))
+        block = max(1, self.SCORE_BUDGET // (self._doc_axis_width()))
         if self.layout == "pallas" and scoring == "tfidf" \
                 and not self.compat_int_idf:
             block = min(block, self.PALLAS_BLOCK)
@@ -339,11 +405,24 @@ class Scorer:
             block, lambda q: self._topk_device(q, k, scoring),
             (np.asarray(q_terms, np.int32), -1))
 
+    def _doc_axis_width(self) -> int:
+        """Per-device score-accumulator width: the full doc axis, or one
+        doc block on the sharded layout (each device only holds dblk+1)."""
+        if self.layout == "sharded":
+            return self._sharded.dblk + 1
+        return self.meta.num_docs + 1
+
     def _topk_device(self, q_terms: np.ndarray, k: int, scoring: str):
         """Dispatch one query block; returns device arrays without waiting."""
         q = jnp.asarray(q_terms)
         n = jnp.int32(self.meta.num_docs)
-        if scoring == "bm25":
+        if self.layout == "sharded":
+            from ..parallel import sharded_tiered_topk
+
+            s, d = sharded_tiered_topk(
+                q, self._sharded, self.df, n, mesh=self._mesh, k=k,
+                scoring=scoring, compat_int_idf=self.compat_int_idf)
+        elif scoring == "bm25":
             if self.layout in ("dense", "pallas"):  # kernel is tf-idf only
                 if self._tf_matrix is None:
                     pt, pd, ptf = self._pairs
@@ -353,22 +432,13 @@ class Scorer:
                         num_docs=self.meta.num_docs)
                 s, d = bm25_topk_dense(q, self._tf_matrix, self.df,
                                        self.doc_len, n, k=k)
-            elif self.layout == "sparse":
+            else:
                 from ..ops.scoring import bm25_topk_tiered
 
                 s, d = bm25_topk_tiered(
                     q, self.hot_rank, self.hot_tfs, self.tier_of,
                     self.row_of, self.tier_docs, self.tier_tfs, self.df,
                     self.doc_len, n, num_docs=self.meta.num_docs, k=k)
-            else:
-                raise NotImplementedError(
-                    "bm25 is not implemented for the sharded layout")
-        elif self.layout == "sharded":
-            from ..parallel import sharded_tfidf_topk
-
-            s, d = sharded_tfidf_topk(
-                q, self.doc_blocks, self.doc_bases, self.df, n,
-                mesh=self._mesh, k=k, compat_int_idf=self.compat_int_idf)
         elif self.layout == "pallas" and not self.compat_int_idf:
             from ..ops.pallas_scoring import pallas_tfidf_topk
 
@@ -416,10 +486,31 @@ class Scorer:
         from ..ops import cosine_rerank_dense
         from ..ops.scoring import cosine_rerank_tiered
 
-        if self.layout == "sharded":
-            raise NotImplementedError(
-                "rerank is not implemented for the sharded layout")
         n = jnp.int32(self.meta.num_docs)
+        if self.layout == "sharded":
+            # both stages run inside one SPMD program; the global doc norms
+            # ride to the mesh in sharded [S, dblk+1] form (built once)
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel import SHARD_AXIS, shard_slices, sharded_tiered_rerank
+
+            if self._sharded_norm is None:
+                norms_np = np.asarray(self._doc_norms())
+                self._sharded_norm = jax.device_put(
+                    shard_slices(norms_np, num_docs=self.meta.num_docs,
+                                 num_shards=self._mesh.devices.size),
+                    NamedSharding(self._mesh, P(SHARD_AXIS, None)))
+
+            def dispatch(q):
+                return sharded_tiered_rerank(
+                    jnp.asarray(q), self._sharded, self.df, n,
+                    self._sharded_norm, mesh=self._mesh, k=k,
+                    candidates=candidates)
+
+            return self._blocked_dispatch(
+                max(1, self.SCORE_BUDGET // self._doc_axis_width()),
+                dispatch, (np.asarray(q_terms, np.int32), -1))
         norms = self._doc_norms()
 
         # both stages run inside one block so the candidate matrix never
@@ -439,7 +530,7 @@ class Scorer:
                 num_docs=self.meta.num_docs, k=k)
 
         return self._blocked_dispatch(
-            max(1, self.SCORE_BUDGET // (self.meta.num_docs + 1)), dispatch,
+            max(1, self.SCORE_BUDGET // self._doc_axis_width()), dispatch,
             (np.asarray(q_terms, np.int32), -1))
 
     def search_batch(
