@@ -1,6 +1,9 @@
-"""End-to-end multi-host index build: 2 processes x 2 CPU devices build one
-index into a shared directory; artifacts must match a single-process build
-and produce identical search results."""
+"""End-to-end STREAMING multi-host index build: 2 processes x 2 CPU devices
+build one index into a shared directory through the chunked scanner +
+per-batch SPMD shuffle (batch_docs=2 forces several lockstep steps per
+process, proving no process ever holds its slice in memory); artifacts must
+be byte-identical to the single-process streaming build at the same shard
+count and produce identical search results."""
 
 import os
 import socket
@@ -35,7 +38,7 @@ from tpu_ir.parallel.multihost import init_distributed, build_index_multihost
 
 init_distributed(coordinator, num_processes=2, process_id=pid)
 meta = build_index_multihost([corpus_dir], index_dir, k=1,
-                             compute_chargrams=False)
+                             compute_chargrams=False, batch_docs=2)
 print(json.dumps({"pid": pid, "num_docs": meta.num_docs,
                   "num_shards": meta.num_shards,
                   "vocab_size": meta.vocab_size}))
@@ -72,18 +75,35 @@ def test_multihost_build(tmp_path):
         assert p.returncode == 0, f"worker failed:\n{err[-4000:]}"
 
     # validate in THIS (single) process
-    from tpu_ir.index import build_index
+    import numpy as np
+
     from tpu_ir.index import format as fmt
+    from tpu_ir.index.streaming import build_index_streaming
     from tpu_ir.index.verify import verify_index
     from tpu_ir.search import Scorer
 
     summary = verify_index(index_dir)
     assert summary["ok"] and summary["num_docs"] == len(DOCS)
     assert fmt.IndexMetadata.load(index_dir).num_shards == 4
+    # local spills cleaned up from the shared dir
+    assert not [n for n in os.listdir(index_dir) if n.startswith("_spill")]
 
+    # byte-identical to the single-process streaming build at 4 shards
     ref_dir = str(tmp_path / "ref_index")
-    build_index([str(corpus_dir)], ref_dir, k=1, num_shards=4,
-                compute_chargrams=False)
+    build_index_streaming([str(corpus_dir)], ref_dir, k=1, num_shards=4,
+                          batch_docs=2, compute_chargrams=False)
+    for s in range(4):
+        z1, z2 = fmt.load_shard(ref_dir, s), fmt.load_shard(index_dir, s)
+        for key in ["term_ids", "indptr", "pair_doc", "pair_tf", "df"]:
+            np.testing.assert_array_equal(z1[key], z2[key],
+                                          err_msg=f"{s}/{key}")
+    for name in [fmt.DICTIONARY, fmt.DOCNOS, fmt.VOCAB]:
+        assert (open(os.path.join(ref_dir, name), "rb").read()
+                == open(os.path.join(index_dir, name), "rb").read()), name
+    np.testing.assert_array_equal(
+        np.load(os.path.join(ref_dir, fmt.DOCLEN)),
+        np.load(os.path.join(index_dir, fmt.DOCLEN)))
+
     s_mh = Scorer.load(index_dir)
     s_ref = Scorer.load(ref_dir)
     for q in ["alpha", "charlie bravo", "echo", "zulu"]:

@@ -205,3 +205,38 @@ def test_mesh_helper():
     assert mesh.devices.size == S
     with pytest.raises(ValueError):
         make_mesh(9999)
+
+def test_shard_slices_more_shards_than_blocks():
+    """Shards whose doc range starts past num_docs must stay empty, not
+    crash (10 docs over 8 shards leaves shards 5..7 with no docs)."""
+    row = np.arange(11)
+    out = shard_slices(row, num_docs=10, num_shards=8)
+    assert out.shape == (8, 3)
+    np.testing.assert_array_equal(out[0], [0, 1, 2])
+    np.testing.assert_array_equal(out[4], [0, 9, 10])
+    assert (out[5:] == 0).all()
+
+
+def test_sharded_scorer_small_corpus(tmp_path):
+    """Scorer.load(layout='sharded') on a corpus smaller than mesh*2 docs
+    (empty trailing shards) must agree with the dense layout for all
+    scorers."""
+    from tpu_ir.index import build_index
+    from tpu_ir.search import Scorer
+
+    docs = {f"T-{i:02d}": f"alpha w{i} w{i % 3} beta" for i in range(10)}
+    corpus = tmp_path / "c.trec"
+    corpus.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in docs.items()))
+    idx = str(tmp_path / "idx")
+    build_index([str(corpus)], idx, num_shards=2, compute_chargrams=False)
+    dense = Scorer.load(idx, layout="dense")
+    sharded = Scorer.load(idx, layout="sharded")
+    for q, kwargs in [("alpha w1", {}), ("beta", {"scoring": "bm25"})]:
+        g1 = dense.search_batch([q], **kwargs)[0]
+        g2 = sharded.search_batch([q], **kwargs)[0]
+        assert {d for d, _ in g1} == {d for d, _ in g2}, q
+    r1 = dense.search_batch(["alpha beta"], rerank=4)[0]
+    r2 = sharded.search_batch(["alpha beta"], rerank=4)[0]
+    assert {d for d, _ in r1} == {d for d, _ in r2}

@@ -88,7 +88,8 @@ def _run_index(args) -> int:
             args.corpus, args.index_dir, k=args.k,
             chargram_ks=args.chargram_k, num_shards=args.shards,
             batch_docs=args.batch_docs,
-            compute_chargrams=not args.no_chargrams)
+            compute_chargrams=not args.no_chargrams,
+            spmd_devices=args.spmd_devices)
     else:
         from .index import build_index
 
@@ -309,7 +310,8 @@ def main(argv: list[str] | None = None) -> int:
     pi.add_argument("--spmd-devices", type=int, default=None,
                     help="build over an N-device mesh (doc-sharded map, "
                          "all_to_all shuffle, term-sharded reduce); implies "
-                         "N index shards")
+                         "N index shards; composes with --streaming for "
+                         "out-of-core corpora")
     _add_backend_arg(pi)
     pi.set_defaults(fn=cmd_index)
 

@@ -20,6 +20,16 @@ with the per-batch combine as a device op:
     merge, and the spills start and end on host disk. Peak memory is one
     shard's pairs, never the whole index.
 
+With `spmd_devices=N`, pass 2 runs as the mesh program instead: each
+batch's occurrences are doc-dealt across the N devices, the combiner +
+all_to_all shuffle + term-shard reduce run inside one jit
+(parallel/sharded_build.py — the splits -> shuffle -> reducers pipeline of
+TermKGramDocIndexer.java:227-283, with the corpus streamed from disk), and
+every device's reduced output spills directly as its term shard's pairs.
+Pass 1 and pass 3 are unchanged, so scale (out-of-core) and distribution
+(mesh) compose: the artifacts are byte-identical to the single-device
+streaming build at the same shard count.
+
 This is the scaling path for the Wikipedia-1M / MS MARCO configs
 (BASELINE.json); the in-memory builder (builder.py) stays the fast path for
 reference-scale corpora.
@@ -48,6 +58,42 @@ def _round_cap(n: int, granule: int = 1 << 18) -> int:
     return max(granule, (n + granule - 1) // granule * granule)
 
 
+def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
+                        n_batches: int, vocab_size: int,
+                        shard_of: np.ndarray) -> tuple[np.ndarray, int]:
+    """Pass 3 for ONE term shard: concatenate its pair spills, lexsort into
+    the reference posting order (term asc, tf desc, doc asc), write the
+    part file. Returns (rdf int32 [V], num_pairs). Shared by the
+    single-process streaming build and the multi-host build so the
+    byte-identical-artifacts guarantee rests on one implementation.
+
+    A pure sort, NOT a merge: batches partition whole documents, so a
+    (term, doc) pair exists in exactly one batch and per-batch combining
+    already produced final tfs. The spills start and end on host disk, so
+    a host lexsort beats shipping hundreds of MB through the device and
+    back on any backend."""
+    terms, docs, tfs = [], [], []
+    for b in range(n_batches):
+        path = os.path.join(spill_dir, f"pairs-{row:03d}-{b:05d}.npz")
+        with np.load(path) as z:
+            terms.append(z["term"])
+            docs.append(z["doc"])
+            tfs.append(z["tf"])
+    t = np.concatenate(terms) if terms else np.zeros(0, np.int32)
+    d = np.concatenate(docs) if docs else np.zeros(0, np.int32)
+    w = np.concatenate(tfs) if tfs else np.zeros(0, np.int32)
+    # tf negated as int64: spills may ride as uint16
+    order = np.lexsort((d, -w.astype(np.int64), t))
+    t, d, w = t[order], d[order], w[order]
+    rdf = np.bincount(t, minlength=vocab_size).astype(np.int32)
+    tids = np.nonzero(shard_of == row)[0].astype(np.int32)
+    lens = rdf[tids].astype(np.int64)
+    local_indptr = np.concatenate([[0], np.cumsum(lens)])
+    fmt.save_shard(index_dir, row, term_ids=tids, indptr=local_indptr,
+                   pair_doc=d, pair_tf=w, df=rdf[tids])
+    return rdf, len(t)
+
+
 def build_index_streaming(
     corpus_paths: Sequence[str] | str,
     index_dir: str,
@@ -58,10 +104,15 @@ def build_index_streaming(
     batch_docs: int = 20_000,
     compute_chargrams: bool = True,
     keep_spills: bool = False,
+    spmd_devices: int | None = None,
 ) -> fmt.IndexMetadata:
     if isinstance(corpus_paths, (str, os.PathLike)):
         corpus_paths = [corpus_paths]
     chargram_ks = list(chargram_ks)
+    if spmd_devices:
+        # each device's reduce output IS one term shard (Hadoop's
+        # reducer-count = partition-count identity)
+        num_shards = spmd_devices
     os.makedirs(index_dir, exist_ok=True)
     if fmt.artifact_exists(index_dir, fmt.METADATA):
         return fmt.IndexMetadata.load(index_dir)
@@ -74,7 +125,7 @@ def build_index_streaming(
     os.makedirs(spill_dir, exist_ok=True)
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "num_shards": num_shards, "streaming": True,
-        "batch_docs": batch_docs})
+        "batch_docs": batch_docs, "spmd_devices": spmd_devices})
 
     # ---- pass 1: chunked tokenize -> spill temp-id batches ----
     # (each spill batch covers a contiguous docid range; pass 2 walks the
@@ -135,34 +186,17 @@ def build_index_streaming(
         report.set_counter("reduce_output_groups", v)
 
     # ---- pass 2: combine per batch, spill pairs per term shard ----
-    # depth-1 dispatch/collect pipeline: batch b+1's host prep + device
-    # program overlap batch b's D2H copies; the pair columns are sliced +
-    # narrowed on device before the copy (see builder.py — the tunnel's
-    # D2H bandwidth is the critical path)
     doc_len = np.zeros(num_docs + 1, np.int64)
     occurrences = 0
-    use16 = v < int(PAD_TERM_U16)
 
-    def collect_batch(b, p, tf_max):
-        df_b, tfm = fetch_to_host(p.df, tf_max)
-        npairs = int(df_b.sum())
-        pd, ptf = fetch_to_host(*shrink_pairs(
-            p.pair_doc, p.pair_tf, npairs, num_docs=num_docs,
-            tf_max=int(tfm)))
-        pt = pair_term_from_df(df_b)
-        pd = pd[:npairs]
-        ptf = ptf[:npairs]
-        shard = pt % num_shards
-        for s in range(num_shards):
-            sel = shard == s
-            np.savez(os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"),
-                     term=pt[sel], doc=pd[sel], tf=ptf[sel])
-
-    with report.phase("pass2_combine"):
-        pending = None
+    def iter_batches():
+        """Yield (b, term_ids, docnos, lengths) per spill batch; maintains
+        doc_len and the occurrence counter as it goes."""
+        nonlocal occurrences
         ofs = 0
         for b in range(n_batches):
-            with np.load(os.path.join(spill_dir, f"tokens-{b:05d}.npz")) as z:
+            with np.load(os.path.join(spill_dir,
+                                      f"tokens-{b:05d}.npz")) as z:
                 flat, lengths = z["ids"], z["lengths"]
             occurrences += len(flat)
             term_ids = rank[flat]
@@ -173,11 +207,37 @@ def build_index_streaming(
                 np.int32)
             # a doc's length IS its post-analysis occurrence count
             doc_len[docnos] = lengths
+            yield b, term_ids, docnos, lengths
 
-            cap = _round_cap(len(flat))
+    def pass2_single_device():
+        # depth-1 dispatch/collect pipeline: batch b+1's host prep + device
+        # program overlap batch b's D2H copies; the pair columns are sliced
+        # + narrowed on device before the copy (see builder.py — the
+        # tunnel's D2H bandwidth is the critical path)
+        use16 = v < int(PAD_TERM_U16)
+
+        def collect_batch(b, p, tf_max):
+            df_b, tfm = fetch_to_host(p.df, tf_max)
+            npairs = int(df_b.sum())
+            pd, ptf = fetch_to_host(*shrink_pairs(
+                p.pair_doc, p.pair_tf, npairs, num_docs=num_docs,
+                tf_max=int(tfm)))
+            pt = pair_term_from_df(df_b)
+            pd = pd[:npairs]
+            ptf = ptf[:npairs]
+            shard = pt % num_shards
+            for s in range(num_shards):
+                sel = shard == s
+                np.savez(
+                    os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"),
+                    term=pt[sel], doc=pd[sel], tf=ptf[sel])
+
+        pending = None
+        for b, term_ids, docnos, lengths in iter_batches():
+            cap = _round_cap(len(term_ids))
             t_pad = np.full(cap, PAD_TERM_U16 if use16 else PAD_TERM,
                             np.uint16 if use16 else np.int32)
-            t_pad[: len(flat)] = term_ids
+            t_pad[: len(term_ids)] = term_ids
             # docnos/lengths are padded to a bucketed doc capacity
             # (zero-length repeats are no-ops) so batches of similar size
             # share one compiled program shape; batches can overshoot
@@ -198,42 +258,65 @@ def build_index_streaming(
             pending = (b, p, tf_max)
         if pending is not None:
             collect_batch(*pending)
+
+    def pass2_spmd():
+        # the Hadoop pipeline proper: doc-dealt map shards, combiner +
+        # all_to_all shuffle + term-shard reduce in one jit per batch
+        # (parallel/sharded_build.py), each device's reduced output
+        # spilling straight to its term shard's file. Streamed input +
+        # mesh shuffle is how scale and distribution compose.
+        from ..parallel import make_mesh, sharded_build_postings
+
+        s = spmd_devices
+        mesh = make_mesh(s)
+        granule = 1 << 14
+        for b, term_ids, docnos, lengths in iter_batches():
+            flat_doc = np.repeat(docnos, lengths.astype(np.int64)).astype(
+                np.int32)
+            doc_shard = (flat_doc - 1) % s
+            counts = np.bincount(doc_shard, minlength=s)
+            fill = int(counts.max()) if len(counts) else 1
+            cap = max(granule, (fill + granule - 1) // granule * granule)
+            t_arr = np.full((s, cap), PAD_TERM, np.int32)
+            d_arr = np.zeros((s, cap), np.int32)
+            for sh in range(s):
+                sel = doc_shard == sh
+                n = int(sel.sum())
+                t_arr[sh, :n] = term_ids[sel]
+                d_arr[sh, :n] = flat_doc[sel]
+            dps = np.bincount((docnos - 1) % s, minlength=s).astype(
+                np.int32)
+            out = sharded_build_postings(
+                t_arr, d_arr, dps, vocab_size=v, total_docs=num_docs,
+                mesh=mesh)
+            npairs, pt, pd, ptf = fetch_to_host(
+                out.num_pairs, out.pair_term, out.pair_doc, out.pair_tf)
+            for sh in range(s):
+                n_sh = int(npairs[sh])
+                np.savez(
+                    os.path.join(spill_dir, f"pairs-{sh:03d}-{b:05d}.npz"),
+                    term=pt[sh][:n_sh], doc=pd[sh][:n_sh],
+                    tf=ptf[sh][:n_sh])
+
+    with report.phase("pass2_combine"):
+        if spmd_devices:
+            pass2_spmd()
+        else:
+            pass2_single_device()
     report.set_counter("map_output_records", occurrences)
 
     # ---- pass 3: per-shard reduce -> part files ----
+    # (reduce_shard_spills: pure host sort per shard; the device keeps the
+    # role it wins at — the per-batch shuffle+reduce)
     df = np.zeros(v, np.int32)
     num_pairs_total = 0
     shard_of = np.arange(v, dtype=np.int32) % num_shards
-    # pass 3 is a pure sort, NOT a merge: batches partition whole documents,
-    # so a (term, doc) pair exists in exactly one batch and per-batch
-    # combining (pass 2's device group-by) already produced final tfs. The
-    # spills start and end on host disk, so a host lexsort beats shipping
-    # hundreds of MB through the device and back on any backend — the
-    # device keeps the role it wins at: the per-batch shuffle+reduce.
     with report.phase("pass3_reduce"):
         for s in range(num_shards):
-            terms, docs, tfs = [], [], []
-            for b in range(n_batches):
-                path = os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz")
-                with np.load(path) as z:
-                    terms.append(z["term"])
-                    docs.append(z["doc"])
-                    tfs.append(z["tf"])
-            t = np.concatenate(terms) if terms else np.zeros(0, np.int32)
-            d = np.concatenate(docs) if docs else np.zeros(0, np.int32)
-            w = np.concatenate(tfs) if tfs else np.zeros(0, np.int32)
-            # reference posting order: term asc, tf desc, doc asc
-            # (tf negated as int64: spills may ride as uint16)
-            order = np.lexsort((d, -w.astype(np.int64), t))
-            t, d, w = t[order], d[order], w[order]
-            rdf = np.bincount(t, minlength=v).astype(np.int32)
-            num_pairs_total += len(t)
+            rdf, npairs = reduce_shard_spills(
+                spill_dir, index_dir, s, n_batches, v, shard_of)
+            num_pairs_total += npairs
             df[:] += rdf
-            tids = np.nonzero(shard_of == s)[0].astype(np.int32)
-            lens = rdf[tids].astype(np.int64)
-            local_indptr = np.concatenate([[0], np.cumsum(lens)])
-            fmt.save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
-                           pair_doc=d, pair_tf=w, df=rdf[tids])
     report.set_counter("num_pairs", num_pairs_total)
 
     with report.phase("dictionary"):

@@ -14,7 +14,11 @@ Responsibilities handled here:
 - global docno/vocab agreement: each host tokenizes its slice, then the
   docid and term sets are exchanged host-side (allgather over the process
   group via jax.experimental.multihost_utils) so every process holds the
-  same sorted global tables before the device build runs.
+  same sorted global tables before the device build runs;
+- the streaming multi-host build itself (`build_index_multihost`): chunked
+  native ingestion + local spills + lockstep per-batch SPMD shuffle steps,
+  so no process ever holds its slice's tokens in memory — the composition
+  of index/streaming.py's out-of-core passes with the mesh program.
 
 Single-process calls are no-ops/identities, so the same driver script runs
 everywhere.
@@ -70,24 +74,39 @@ def build_index_multihost(
     k: int = 1,
     chargram_ks: Sequence[int] = (2, 3),
     compute_chargrams: bool = True,
+    batch_docs: int = 20_000,
+    keep_spills: bool = False,
 ) -> "object":
-    """End-to-end multi-host index build over the global device mesh.
+    """End-to-end STREAMING multi-host index build over the global mesh.
 
-    Every process: streams + tokenizes ITS slice of the corpus files, agrees
-    on the global docno/vocab tables host-side, feeds its devices' rows of
-    the global occurrence array, runs the shared all_to_all build program,
-    and writes the part files for its addressable term shards. Process 0
-    writes the shared side artifacts. `index_dir` must be a filesystem all
-    processes can write (the HDFS-equivalent assumption).
+    Every process: streams ITS slice of the corpus files through the
+    chunked native scanner (C++ record split + analysis + incremental
+    vocab — never holding the slice's tokens in RAM), spills temp-id
+    batches to its local disk, agrees on the global docno/vocab tables
+    host-side, then replays its batches as lockstep SPMD steps: each step
+    deals the batch's occurrences over the process's device rows and runs
+    the combiner + all_to_all shuffle + term-shard reduce program
+    (sharded_build.py); each device's reduced output spills straight to
+    its term shard. A final per-shard host sort (the same pass 3 as
+    index/streaming.py) writes each process's addressable part files, so
+    artifacts are byte-identical to the single-process streaming build at
+    the same shard count. Process 0 writes the shared side artifacts.
+    `index_dir` must be a filesystem all processes can write (the
+    HDFS-equivalent assumption); token/pair spills stay on process-local
+    disk. Memory per process = the vocab + one batch, like the
+    single-device streaming build — a slice larger than RAM streams fine.
 
-    Single-process, this degenerates to the SPMD build over local devices.
+    Single-process, this degenerates to the SPMD streaming build over
+    local devices.
     """
+    import shutil
+
     import jax
     from jax.experimental import multihost_utils
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    from ..analysis.native import make_analyzer
-    from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
+    from ..analysis.native import make_chunked_tokenizer
+    from ..collection import DocnoMapping, Vocab
     from ..index import format as fmt
     from ..index.builder import build_chargram_artifacts
     from ..ops.postings import PAD_TERM
@@ -99,112 +118,181 @@ def build_index_multihost(
         corpus_paths = [corpus_paths]
     pi, pc = jax.process_index(), jax.process_count()
     os.makedirs(index_dir, exist_ok=True)
+    spill_dir = os.path.join(index_dir, f"_spill-p{pi:03d}")
+    os.makedirs(spill_dir, exist_ok=True)
     report = JobReport("TermKGramDocIndexer", config={
-        "k": k, "multihost": True, "process": pi, "process_count": pc})
+        "k": k, "multihost": True, "process": pi, "process_count": pc,
+        "batch_docs": batch_docs})
 
-    # --- map: tokenize my slice ---
-    analyzer = make_analyzer()
+    # --- pass 1: chunked tokenize my slice -> local temp-id spills ---
+    n_local = jax.local_device_count()
     my_files = process_file_slice(corpus_paths, pi, pc)
     my_docids: list[str] = []
-    my_doc_terms: list[list[str]] = []
-    with report.phase("tokenize"):
-        for doc in read_trec_corpus(my_files):
-            report.incr("Count.DOCS")
-            my_docids.append(doc.docid)
-            toks = analyzer.analyze(doc.content)
-            my_doc_terms.append(kgram_terms(toks, k) if k > 1 else toks)
+    n_batches = 0
+    batch_dev_caps: list[int] = []  # max per-device occupancy per batch
+    tok = make_chunked_tokenizer(my_files, k=k)
+    with report.phase("pass1_tokenize"):
+        acc_ids: list[np.ndarray] = []
+        acc_lens: list[np.ndarray] = []
+        acc_docs = 0
 
-    # --- agree on global tables ---
+        def flush():
+            nonlocal n_batches, acc_docs
+            if not acc_docs:
+                return
+            lengths = np.concatenate(acc_lens)
+            np.savez(os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
+                     ids=np.concatenate(acc_ids), lengths=lengths)
+            # record the batch's per-device occupancy now — pass 2
+            # negotiates one global capacity from these, with no second
+            # read of the spills
+            occ = np.bincount(np.arange(len(lengths)) % n_local,
+                              weights=lengths, minlength=n_local)
+            batch_dev_caps.append(int(occ.max()))
+            n_batches += 1
+            acc_ids.clear()
+            acc_lens.clear()
+            acc_docs = 0
+
+        try:
+            for docids_d, ids_d, lens_d in tok.deltas():
+                report.incr("Count.DOCS", len(docids_d))
+                my_docids.extend(docids_d)
+                acc_ids.append(ids_d)
+                acc_lens.append(lens_d)
+                acc_docs += len(docids_d)
+                if acc_docs >= batch_docs:
+                    flush()
+            flush()
+            local_vocab = tok.vocab()
+        finally:
+            tok.close()
+
+    # --- agree on global tables (host-side allgather) ---
     with report.phase("global_tables"):
         global_docids = allgather_strings(my_docids)
-        local_uniques = sorted({t for ts in my_doc_terms for t in ts})
-        global_terms = allgather_strings(local_uniques)
+        global_terms = allgather_strings(local_vocab)
+        total_seen = int(multihost_utils.process_allgather(
+            np.int64(len(my_docids))).sum())
+        if total_seen != len(global_docids):
+            raise ValueError("duplicate docids across the corpus")
         mapping = DocnoMapping(global_docids)
         vocab = Vocab(global_terms)
         num_docs = len(mapping)
         v = len(vocab)
-        sorted_terms = np.array(global_terms, dtype=np.str_)
         sorted_docids = np.array(global_docids, dtype=np.str_)
+        # local temp id -> global sorted id
+        rank = (np.searchsorted(np.array(global_terms, dtype=np.str_),
+                                np.array(local_vocab, dtype=np.str_))
+                .astype(np.int32) if local_vocab
+                else np.zeros(0, np.int32))
 
-    # --- pack my devices' rows of the global [S, C] occurrence array ---
-    n_local = jax.local_device_count()
+    # --- pass 2: lockstep per-batch SPMD shuffle over the global mesh ---
     s = pc * n_local
     mesh = make_mesh(s)
-    with report.phase("pack"):
-        per_dev_terms: list[np.ndarray] = []
-        per_dev_docs: list[np.ndarray] = []
-        per_dev_ndocs = np.zeros(n_local, np.int32)
-        buckets: list[list[int]] = [[] for _ in range(n_local)]
-        for i in range(len(my_docids)):
-            buckets[i % n_local].append(i)
-        for dev, idxs in enumerate(buckets):
-            terms = [t for i in idxs for t in my_doc_terms[i]]
-            tid = np.searchsorted(sorted_terms, np.array(terms, np.str_)
-                                  ) if terms else np.zeros(0, np.int64)
-            dno = np.concatenate([
-                np.full(len(my_doc_terms[i]),
-                        np.searchsorted(sorted_docids, my_docids[i]) + 1,
-                        np.int32)
-                for i in idxs]) if idxs else np.zeros(0, np.int32)
-            per_dev_terms.append(tid.astype(np.int32))
-            per_dev_docs.append(dno)
-            per_dev_ndocs[dev] = len(idxs)
-        local_max = max((len(a) for a in per_dev_terms), default=1)
-        cap = int(multihost_utils.process_allgather(
-            np.int64(local_max)).max())
+    doc_len = np.zeros(num_docs + 1, np.int64)
+    df_local = np.zeros(v, np.int64)       # my term shards' dfs
+    num_pairs_by_shard: dict[int, int] = {}
+    occurrences = 0
+    with report.phase("pass2_combine"):
+        # one shared batch shape for the whole job: the max per-device
+        # occupancy was recorded at flush time, so the global capacity is
+        # negotiated from in-memory integers — all steps reuse one
+        # compiled program
+        local_cap = max(batch_dev_caps, default=1)
+        dims = multihost_utils.process_allgather(
+            np.array([n_batches, local_cap], np.int64))
+        b_global = int(np.asarray(dims)[:, 0].max())
+        cap = int(np.asarray(dims)[:, 1].max())
         granule = 1 << 12
         cap = max(granule, (cap + granule - 1) // granule * granule)
-        local_t = np.full((n_local, cap), PAD_TERM, np.int32)
-        local_d = np.zeros((n_local, cap), np.int32)
-        for dev in range(n_local):
-            n = len(per_dev_terms[dev])
-            local_t[dev, :n] = per_dev_terms[dev]
-            local_d[dev, :n] = per_dev_docs[dev]
-
         sh2 = NamedSharding(mesh, P(SHARD_AXIS, None))
         sh1 = NamedSharding(mesh, P(SHARD_AXIS))
-        g_t = jax.make_array_from_process_local_data(sh2, local_t, (s, cap))
-        g_d = jax.make_array_from_process_local_data(sh2, local_d, (s, cap))
-        g_n = jax.make_array_from_process_local_data(
-            sh1, per_dev_ndocs, (s,))
 
-    # --- the shared SPMD build ---
-    with report.phase("postings_device"):
-        out = sharded_build_postings(
-            g_t, g_d, g_n, vocab_size=v, total_docs=num_docs, mesh=mesh)
+        ofs = 0
+        for b in range(b_global):
+            local_t = np.full((n_local, cap), PAD_TERM, np.int32)
+            local_d = np.zeros((n_local, cap), np.int32)
+            local_n = np.zeros(n_local, np.int32)
+            if b < n_batches:  # processes out of batches step with padding
+                with np.load(os.path.join(spill_dir,
+                                          f"tokens-{b:05d}.npz")) as z:
+                    flat, lengths = z["ids"], z["lengths"]
+                occurrences += len(flat)
+                term_ids = rank[flat]
+                docids = np.array(my_docids[ofs : ofs + len(lengths)],
+                                  dtype=np.str_)
+                ofs += len(lengths)
+                docnos = (np.searchsorted(sorted_docids, docids) + 1
+                          ).astype(np.int32)
+                doc_len[docnos] = lengths
+                dev_of_doc = (np.arange(len(lengths)) % n_local).astype(
+                    np.int32)
+                flat_dev = np.repeat(dev_of_doc, lengths)
+                flat_doc = np.repeat(docnos, lengths)
+                for dev in range(n_local):
+                    sel = flat_dev == dev
+                    n_occ = int(sel.sum())
+                    local_t[dev, :n_occ] = term_ids[sel]
+                    local_d[dev, :n_occ] = flat_doc[sel]
+                    local_n[dev] = int((dev_of_doc == dev).sum())
+            g_t = jax.make_array_from_process_local_data(
+                sh2, local_t, (s, cap))
+            g_d = jax.make_array_from_process_local_data(
+                sh2, local_d, (s, cap))
+            g_n = jax.make_array_from_process_local_data(
+                sh1, local_n, (s,))
+            out = sharded_build_postings(
+                g_t, g_d, g_n, vocab_size=v, total_docs=num_docs, mesh=mesh)
 
-    # --- write my shards; gather df/doc_len host-side for side artifacts ---
-    with report.phase("write_shards"):
-        local_df = np.zeros(v, np.int64)
-        for sd in out.df.addressable_shards:
-            local_df += np.asarray(sd.data).reshape(-1, v).sum(axis=0)
-        df = np.asarray(multihost_utils.process_allgather(local_df))
+            # spill my devices' reduced outputs as their term shards' pairs
+            np_rows = {sd.index[0].start: int(np.asarray(sd.data).ravel()[0])
+                       for sd in out.num_pairs.addressable_shards}
+            rows = {}
+            for col in ("pair_term", "pair_doc", "pair_tf"):
+                rows[col] = {sd.index[0].start: np.asarray(sd.data)
+                             .reshape(-1)
+                             for sd in getattr(out, col).addressable_shards}
+            for row, npair in np_rows.items():
+                np.savez(
+                    os.path.join(spill_dir, f"pairs-{row:03d}-{b:05d}.npz"),
+                    term=rows["pair_term"][row][:npair],
+                    doc=rows["pair_doc"][row][:npair],
+                    tf=rows["pair_tf"][row][:npair])
+                num_pairs_by_shard[row] = (num_pairs_by_shard.get(row, 0)
+                                           + npair)
+            for sd in out.df.addressable_shards:
+                df_local += np.asarray(sd.data).reshape(-1, v).sum(axis=0)
+    report.set_counter("map_output_records", occurrences)
+    report.set_counter("reduce_output_groups", v)
+
+    # --- global side data (df / doc_len assembled across processes) ---
+    with report.phase("reduce_side"):
+        df = np.asarray(multihost_utils.process_allgather(df_local))
         df = df.reshape(-1, v).sum(axis=0).astype(np.int32)
+        doc_len = np.asarray(multihost_utils.process_allgather(doc_len))
+        doc_len = doc_len.reshape(-1, num_docs + 1).sum(axis=0).astype(
+            np.int32)
 
-        local_dl = np.zeros(num_docs + 1, np.int64)
-        for dev in range(n_local):
-            np.add.at(local_dl, per_dev_docs[dev], 1)
-        doc_len = np.asarray(multihost_utils.process_allgather(local_dl))
-        doc_len = doc_len.reshape(-1, num_docs + 1).sum(axis=0).astype(np.int32)
+    # --- pass 3: per-shard host sort for MY term shards (the same
+    # reduce_shard_spills the single-process streaming build runs, so the
+    # byte-identical-artifacts guarantee rests on one implementation) ---
+    from ..index.streaming import reduce_shard_spills
 
+    with report.phase("pass3_reduce"):
         shard_of, offset_of = fmt.shard_local_offsets(df, s)
-        num_pairs_rows = {}
-        for sd in out.num_pairs.addressable_shards:
-            num_pairs_rows[sd.index[0].start] = int(
-                np.asarray(sd.data).ravel()[0])
-        doc_rows = {sd.index[0].start: np.asarray(sd.data).reshape(-1)
-                    for sd in out.pair_doc.addressable_shards}
-        tf_rows = {sd.index[0].start: np.asarray(sd.data).reshape(-1)
-                   for sd in out.pair_tf.addressable_shards}
-        for row, npairs in num_pairs_rows.items():
-            tids = np.nonzero(shard_of == row)[0].astype(np.int32)
-            lens = df[tids].astype(np.int64)
-            local_indptr = np.concatenate([[0], np.cumsum(lens)])
-            fmt.save_shard(index_dir, row, term_ids=tids,
-                           indptr=local_indptr,
-                           pair_doc=doc_rows[row][:npairs],
-                           pair_tf=tf_rows[row][:npairs],
-                           df=df[tids])
+        for row in (pi * n_local + dev for dev in range(n_local)):
+            _, npairs = reduce_shard_spills(
+                spill_dir, index_dir, row, b_global, v, shard_of)
+            # cross-check: the sorted pair count must equal what pass 2's
+            # device programs reported for this shard
+            if npairs != num_pairs_by_shard.get(row, 0):
+                raise AssertionError(
+                    f"shard {row}: pass 3 saw {npairs} pairs but pass 2 "
+                    f"reported {num_pairs_by_shard.get(row, 0)}")
+
+    if not keep_spills:
+        shutil.rmtree(spill_dir, ignore_errors=True)
 
     # --- process 0 writes shared side artifacts ---
     if pi == 0:

@@ -66,6 +66,8 @@ def shard_slices(global_row: np.ndarray, *, num_docs: int, num_shards: int,
     out = np.full((num_shards, dblk + 1), fill, global_row.dtype)
     for s in range(num_shards):
         lo, hi = s * dblk + 1, min((s + 1) * dblk, num_docs)
+        if hi < lo:  # trailing shards past num_docs hold no docs at all
+            continue
         out[s, 1 : hi - lo + 2] = global_row[lo : hi + 1]
     return out
 
