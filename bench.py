@@ -56,16 +56,40 @@ def make_corpus(path: str, seed: int = 0) -> int:
     return total
 
 
-def make_msmarco_corpus(path: str, n_docs: int, n_queries: int,
+def make_quality_corpus(path: str, n_docs: int, n_queries: int,
                         seed: int = 7):
-    """Passage-style corpus with planted relevance (MS MARCO-shaped eval).
+    """Passage corpus with GRADED planted relevance that splits the scorers.
 
-    Each query i is two entity terms unique to it. One designated relevant
-    passage contains BOTH terms (tf 3 each); two hard distractors contain
-    only ONE of the terms but at higher tf (5) — a scorer without
-    saturating, multi-term-aware ranking (BM25) puts a distractor first.
-    Returns (queries, rel_docno per query). Docids are zero-padded in
-    generation order, so docno == doc index + 1 after sorted numbering.
+    Each query i is two entity terms unique to it, with a relevant passage
+    (grade 2) and distractors (grade 1) built so the three scorers come
+    apart — the round-1 generator saturated at MRR 1.0 for everything and
+    could not detect a regression. Query types cycle:
+
+    - type 0 (verbose doc): relevant has both terms at tf 2 in a normal
+      passage; a distractor has both at tf 3 buried in a ~460-word doc.
+      TF-IDF has NO length normalization, so the verbose doc's higher tf
+      wins; BM25's length norm and the cosine stage's doc norm both
+      punish it. => splits TF-IDF below BM25 (and the rerank).
+    - type 1 (norm tie): a distractor with the SAME query-term tfs and
+      the SAME length as the relevant doc, but its padding is 40 distinct
+      rare fillers where the relevant doc repeats one filler. TF-IDF and
+      BM25 tie exactly (winner = lower docno, random); the cosine stage's
+      doc-norm breaks the tie toward the lighter (relevant) vector.
+      => splits BM25/TF-IDF below the rerank.
+    - type 2 (legit stronger doc): a grade-1 distractor with both terms at
+      tf 3 in a shorter doc beats the relevant doc under EVERY scorer,
+      capping all metrics strictly below 1.
+    - type 3 (idf canary): query = rare entity + a planted COMMON topic
+      word (appears tf 1 in ~4% of the corpus). The relevant doc has the
+      rare term once; distractors carry only the common word at higher tf.
+      Only df-aware weighting ranks the relevant doc first — flatten idf
+      and the common word drowns the query, collapsing TF-IDF and the
+      rerank while BM25 (its own idf) stands, which breaks the gate's
+      ordering. This is what makes a broken idf FAIL the bench.
+
+    Returns (queries, rel_docnos, grades) — grades[qi] maps docno->grade
+    for NDCG. Docids are zero-padded in generation order, so docno ==
+    doc index + 1 after sorted numbering.
     """
     rng = np.random.default_rng(seed)
     letters = np.array(list("abcdefghijklmnopqrstuvwxyz"))
@@ -78,17 +102,44 @@ def make_msmarco_corpus(path: str, n_docs: int, n_queries: int,
     def entity(i, which):  # unique, analyzer-stable
         return f"xx{which}{i:05d}ent"
 
+    COMMON = "qqcommontopic"  # planted into ~4% of unplanted docs below
+
     doc_words: dict[int, list[str]] = {}
-    queries, rel_docnos = [], []
+    no_bg: set[int] = set()   # docs whose token lists must match exactly
+    queries, rel_docnos, grades = [], [], []
     slots = rng.choice(n_docs, n_queries * 3, replace=False)
     for qi in range(n_queries):
         e1, e2 = entity(qi, "a"), entity(qi, "b")
         rel, d1, d2 = (int(s) for s in slots[3 * qi : 3 * qi + 3])
-        doc_words[rel] = [e1] * 3 + [e2] * 3
-        doc_words[d1] = [e1] * 5
-        doc_words[d2] = [e2] * 5
+        kind = qi % 4
+        if kind == 0:    # verbose doc: 2*(1+ln 3) > 2*(1+ln 2), length ignored
+            doc_words[rel] = [e1] * 2 + [e2] * 2
+            doc_words[d1] = ([e1] * 3 + [e2] * 3
+                             + list(bg_words[rng.integers(0, bg_vocab, 400)]))
+            doc_words[d2] = [e2] * 1
+        elif kind == 1:  # exact tie broken only by the cosine doc norm
+            filler = f"zz{qi:05d}fil"
+            doc_words[rel] = ([e1] * 2 + [e2] * 2 + [filler] * 40)
+            doc_words[d1] = ([e1] * 2 + [e2] * 2
+                             + [f"zz{qi:05d}d{j:02d}" for j in range(40)])
+            doc_words[d2] = [e1] * 1  # weak single-term doc
+            no_bg.update((rel, d1))
+        elif kind == 2:  # legitimately stronger grade-1 distractor
+            doc_words[rel] = [e1] * 2 + [e2] * 2
+            doc_words[d1] = [e1] * 3 + [e2] * 3
+            doc_words[d2] = [e2] * 1
+            no_bg.add(d1)
+        else:            # idf canary: rare entity vs planted common word
+            doc_words[rel] = [e1] * 1
+            doc_words[d1] = [COMMON] * 3
+            doc_words[d2] = [COMMON] * 2
+            queries.append(f"{e1} {COMMON}")
+            rel_docnos.append(rel + 1)
+            grades.append({rel + 1: 2, d1 + 1: 1, d2 + 1: 1})
+            continue
         queries.append(f"{e1} {e2}")
         rel_docnos.append(rel + 1)
+        grades.append({rel + 1: 2, d1 + 1: 1, d2 + 1: 1})
 
     # one vectorized zipf draw for every document's background words
     # (per-doc rng.choice with a 40k-entry p vector is seconds of waste)
@@ -97,16 +148,21 @@ def make_msmarco_corpus(path: str, n_docs: int, n_queries: int,
     offsets = np.concatenate([[0], np.cumsum(n_bg_per_doc)])
     with open(path, "w") as f:
         for i in range(n_docs):
-            words = list(bg_words[all_bg[offsets[i] : offsets[i + 1]]])
             planted = doc_words.get(i)
-            if planted:
-                pos = rng.integers(0, len(words) + 1, len(planted))
-                for p, w in zip(sorted(pos, reverse=True), planted):
-                    words.insert(int(p), w)
+            if i in no_bg:
+                words = list(planted)
+            else:
+                words = list(bg_words[all_bg[offsets[i] : offsets[i + 1]]])
+                if planted:
+                    pos = rng.integers(0, len(words) + 1, len(planted))
+                    for p, w in zip(sorted(pos, reverse=True), planted):
+                        words.insert(int(p), w)
+                elif i % 25 == 7:  # make COMMON genuinely common (df ~ 4%)
+                    words.append(COMMON)
             body = " ".join(words)
             f.write(f"<DOC>\n<DOCNO> MSM-{i:06d} </DOCNO>\n<TEXT>\n{body}\n"
                     f"</TEXT>\n</DOC>\n")
-    return queries, np.array(rel_docnos, np.int64)
+    return queries, np.array(rel_docnos, np.int64), grades
 
 
 def _mrr_at_k(rel_docnos: np.ndarray, got_docnos: np.ndarray) -> float:
@@ -118,9 +174,44 @@ def _mrr_at_k(rel_docnos: np.ndarray, got_docnos: np.ndarray) -> float:
     return round(rr / len(rel_docnos), 4)
 
 
+def _ndcg_at_k(grades: list, got_docnos: np.ndarray, k: int = 10) -> float:
+    """Graded NDCG@k with gains 2^g - 1 (the standard web-search form)."""
+    total = 0.0
+    for qi, g in enumerate(grades):
+        dcg = sum((2.0 ** g.get(int(d), 0) - 1) / np.log2(r + 2)
+                  for r, d in enumerate(got_docnos[qi][:k]))
+        ideal = sorted(g.values(), reverse=True)[:k]
+        idcg = sum((2.0 ** gv - 1) / np.log2(r + 2)
+                   for r, gv in enumerate(ideal))
+        total += dcg / idcg if idcg > 0 else 0.0
+    return round(total / len(grades), 4)
+
+
+def quality_gate(m: dict) -> list[str]:
+    """The discriminative-power contract: every metric strictly inside
+    (0, 1) and rerank > BM25 > TF-IDF with real margins. A scoring
+    regression (e.g. broken idf) collapses the ordering and fails here."""
+    bad = []
+    for key in ("tfidf_mrr_at_10", "bm25_mrr_at_10", "rerank_mrr_at_10",
+                "tfidf_ndcg_at_10", "bm25_ndcg_at_10", "rerank_ndcg_at_10"):
+        if not 0.0 < m[key] < 1.0:
+            bad.append(f"{key}={m[key]} outside (0, 1)")
+    if not m["tfidf_mrr_at_10"] + 0.05 < m["bm25_mrr_at_10"]:
+        bad.append("bm25 does not beat tfidf by >= 0.05 MRR")
+    if not m["bm25_mrr_at_10"] + 0.03 < m["rerank_mrr_at_10"]:
+        bad.append("rerank does not beat bm25 by >= 0.03 MRR")
+    if not m["tfidf_ndcg_at_10"] < m["bm25_ndcg_at_10"] \
+            < m["rerank_ndcg_at_10"]:
+        bad.append("NDCG ordering tfidf < bm25 < rerank violated")
+    return bad
+
+
 def run_msmarco(args) -> dict:
-    """BM25 retrieval-quality config: build, retrieve top-10 (MRR@10) and
-    top-1000 (candidate generation for a rerank stage)."""
+    """Retrieval-quality config: graded planted relevance scored by all
+    three scorers (TF-IDF / BM25 / two-stage rerank), MRR@10 + NDCG@10
+    each, plus top-1000 candidate recall. The quality_gate asserts the
+    discriminative ordering rerank > BM25 > TF-IDF with every value
+    strictly inside (0, 1) — a scoring regression fails the gate."""
     from tpu_ir.index import build_index
     from tpu_ir.search import Scorer
 
@@ -128,7 +219,8 @@ def run_msmarco(args) -> dict:
     n_queries = min(args.queries or 2_000, n_docs // 3)  # 3 planted docs/query
     with tempfile.TemporaryDirectory() as tmp:
         corpus = os.path.join(tmp, "corpus.trec")
-        queries, rel_docnos = make_msmarco_corpus(corpus, n_docs, n_queries)
+        queries, rel_docnos, grades = make_quality_corpus(
+            corpus, n_docs, n_queries)
         index_dir = os.path.join(tmp, "index")
         t0 = time.perf_counter()
         build_index([corpus], index_dir, k=1, chargram_ks=[],
@@ -138,11 +230,16 @@ def run_msmarco(args) -> dict:
         scorer = Scorer.load(index_dir, layout="auto")
         q_ids = scorer.analyze_queries(queries, max_terms=4)
 
-        scorer.topk(q_ids, k=10, scoring="bm25")  # compile
-        t0 = time.perf_counter()
-        _, docnos10 = scorer.topk(q_ids, k=10, scoring="bm25")
-        bm25_s = time.perf_counter() - t0
-        mrr = _mrr_at_k(rel_docnos, docnos10)
+        metrics: dict[str, float] = {}
+        speeds: dict[str, float] = {}
+        for scoring in ("tfidf", "bm25"):
+            scorer.topk(q_ids, k=10, scoring=scoring)  # compile
+            t0 = time.perf_counter()
+            _, docnos10 = scorer.topk(q_ids, k=10, scoring=scoring)
+            dt = time.perf_counter() - t0
+            metrics[f"{scoring}_mrr_at_10"] = _mrr_at_k(rel_docnos, docnos10)
+            metrics[f"{scoring}_ndcg_at_10"] = _ndcg_at_k(grades, docnos10)
+            speeds[f"{scoring}_queries_per_sec"] = round(n_queries / dt, 1)
 
         m = min(256, n_queries)
         scorer.topk(q_ids[:m], k=1000, scoring="bm25")  # compile
@@ -153,27 +250,41 @@ def run_msmarco(args) -> dict:
             rel_docnos[qi] in docnos1k[qi] for qi in range(m)]))
 
         # stage 2: cosine TF-IDF rerank over BM25 top-1000 candidates
-        scorer.rerank_topk(q_ids[:m], k=10, candidates=1000)  # compile
+        # (scored over the SAME query set as the single-stage scorers so
+        # the MRR/NDCG comparison is apples to apples)
+        scorer.rerank_topk(q_ids, k=10, candidates=1000)  # compile
         t0 = time.perf_counter()
-        _, rr_docnos = scorer.rerank_topk(q_ids[:m], k=10, candidates=1000)
+        _, rr_docnos = scorer.rerank_topk(q_ids, k=10, candidates=1000)
         rerank_s = time.perf_counter() - t0
-        mrr_rerank = _mrr_at_k(rel_docnos[:m], rr_docnos)
+        metrics["rerank_mrr_at_10"] = _mrr_at_k(rel_docnos, rr_docnos)
+        metrics["rerank_ndcg_at_10"] = _ndcg_at_k(grades, rr_docnos)
+        speeds["rerank_queries_per_sec"] = round(n_queries / rerank_s, 1)
+
+        # the gate's ordering margins assume all four query types are
+        # present in balance; tiny --queries runs would trip the strict
+        # (0, 1) bounds spuriously (e.g. 2 queries resolved perfectly)
+        gate = (quality_gate(metrics) if n_queries >= 16
+                else ["skipped: needs >= 16 queries"])
 
     return {
-        "metric": "bm25_mrr_at_10",
-        "value": mrr,
-        "unit": "mrr",
-        "vs_baseline": mrr,  # ideal planted-relevance MRR is 1.0
+        "metric": "rerank_ndcg_at_10",
+        "value": metrics["rerank_ndcg_at_10"],
+        "unit": "ndcg",
+        # vs the reference's own scoring formula (TF-IDF is all it had) on
+        # the same corpus: the quality win of the full two-stage pipeline
+        "vs_baseline": round(metrics["rerank_ndcg_at_10"]
+                             / max(metrics["tfidf_ndcg_at_10"], 1e-9), 3),
         "corpus_docs": n_docs,
         "queries": n_queries,
         # cold build: includes first-time XLA compiles for this config's
         # shapes (the ref config's warmed docs/s is the throughput headline)
         "index_wall_s_cold": round(build_s, 2),
-        "bm25_queries_per_sec": round(n_queries / bm25_s, 1),
+        **metrics,
+        **speeds,
         "top1000_queries_per_sec": round(m / cand_s, 1),
         "top1000_recall": round(recall1k, 4),
-        "rerank_mrr_at_10": mrr_rerank,
-        "rerank_queries_per_sec": round(m / rerank_s, 1),
+        "quality_gate": "ok" if not gate else "; ".join(gate),
+        "quality_gate_enforced": n_queries >= 16,
         "layout": scorer.layout,
         "config": "msmarco",
     }
@@ -276,6 +387,8 @@ def main() -> int:
         out = run_msmarco(args)
         out["backend"] = backend
         print(json.dumps(out))
+        if out["quality_gate_enforced"] and out["quality_gate"] != "ok":
+            return 1
         return 0
 
     from tpu_ir.index import build_index

@@ -1,39 +1,94 @@
 """The bench configs' eval machinery, at toy scale on CPU.
 
-Guards the planted-relevance corpus generator and the MRR computation that
-back `bench.py --config msmarco` (BASELINE.json's quality metric), and that
-BM25 actually ranks the two-term relevant passage above the single-term
-high-tf distractors it plants.
+Guards the graded planted-relevance generator, MRR/NDCG computation and the
+quality_gate that back `bench.py --config msmarco`: the corpus must SPLIT
+the scorers (rerank > BM25 > TF-IDF, all strictly inside (0, 1)) — the
+round-1 generator saturated every scorer at MRR 1.0 and could not detect a
+regression — and a deliberately broken idf must fail the gate.
 """
 
 import os
 import sys
 
 import numpy as np
+import pytest
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
-def test_msmarco_planted_relevance_mrr(tmp_path):
+@pytest.fixture(scope="module")
+def quality_setup(tmp_path_factory):
     import bench
     from tpu_ir.index import build_index
     from tpu_ir.search import Scorer
 
-    corpus = str(tmp_path / "c.trec")
-    queries, rel = bench.make_msmarco_corpus(corpus, n_docs=300,
-                                             n_queries=20)
-    assert len(queries) == 20 and rel.min() >= 1 and rel.max() <= 300
-    idx = str(tmp_path / "idx")
+    tmp = tmp_path_factory.mktemp("bench")
+    corpus = str(tmp / "c.trec")
+    # n_queries divisible by 4 so every query TYPE (qi % 4) is equally
+    # represented — the gate's margins assume the balanced mix
+    queries, rel, grades = bench.make_quality_corpus(corpus, n_docs=600,
+                                                     n_queries=60)
+    assert len(queries) == 60 and rel.min() >= 1 and rel.max() <= 600
+    idx = str(tmp / "idx")
     build_index([corpus], idx, k=1, chargram_ks=[], num_shards=3,
                 compute_chargrams=False)
     scorer = Scorer.load(idx, layout="dense")
     q = scorer.analyze_queries(queries, max_terms=4)
-    _, docnos = scorer.topk(q, k=10, scoring="bm25")
-    assert bench._mrr_at_k(rel, docnos) == 1.0
+    return bench, scorer, q, rel, grades
 
-    # tf-idf with raw tf (no saturation) must still find the doc in top-10
-    _, d2 = scorer.topk(q, k=10, scoring="tfidf")
-    assert bench._mrr_at_k(rel, d2) > 0.5
+
+def _metrics(bench, scorer, q, rel, grades):
+    out = {}
+    for scoring in ("tfidf", "bm25"):
+        _, d = scorer.topk(q, k=10, scoring=scoring)
+        out[f"{scoring}_mrr_at_10"] = bench._mrr_at_k(rel, d)
+        out[f"{scoring}_ndcg_at_10"] = bench._ndcg_at_k(grades, d)
+    _, d = scorer.rerank_topk(q, k=10, candidates=50)
+    out["rerank_mrr_at_10"] = bench._mrr_at_k(rel, d)
+    out["rerank_ndcg_at_10"] = bench._ndcg_at_k(grades, d)
+    return out
+
+
+def test_quality_corpus_splits_the_scorers(quality_setup):
+    bench, scorer, q, rel, grades = quality_setup
+    m = _metrics(bench, scorer, q, rel, grades)
+    assert bench.quality_gate(m) == [], m
+    # the intended mechanism, not just the ordering: verbose docs fool
+    # length-blind TF-IDF, ties cost BM25, type-2 caps everyone < 1
+    assert m["tfidf_mrr_at_10"] < 0.75
+    assert m["rerank_mrr_at_10"] < 1.0
+
+
+def test_broken_idf_fails_the_gate(quality_setup, monkeypatch):
+    """A scoring regression must be DETECTED: flatten idf to a constant
+    (df ignored) and the gate has to report violations (the idf-canary
+    queries collapse TF-IDF and the rerank while BM25, which computes its
+    own idf, stands — breaking the required ordering)."""
+    import jax.numpy as jnp
+
+    import tpu_ir.ops
+    import tpu_ir.ops.scoring as scoring_mod
+    from tpu_ir.search import Scorer
+
+    bench, scorer, q, rel, grades = quality_setup
+
+    def flat_idf(df, n, compat_int_idf=False):
+        return jnp.ones(df.shape, jnp.float32)
+
+    monkeypatch.setattr(scoring_mod, "idf_weights", flat_idf)
+    monkeypatch.setattr(tpu_ir.ops, "idf_weights", flat_idf)
+    # the jitted scorers captured the healthy idf_weights at trace time and
+    # their caches key on shapes — drop them so the patch actually traces
+    scoring_mod.tfidf_topk_dense.clear_cache()
+    scoring_mod.cosine_rerank_dense.clear_cache()
+    try:
+        broken = Scorer.load(scorer._index_dir, layout="dense")
+        m = _metrics(bench, broken, q, rel, grades)
+        assert bench.quality_gate(m) != [], m
+    finally:
+        monkeypatch.undo()
+        scoring_mod.tfidf_topk_dense.clear_cache()
+        scoring_mod.cosine_rerank_dense.clear_cache()
 
 
 def test_mrr_at_k():
@@ -42,3 +97,13 @@ def test_mrr_at_k():
     rel = np.array([5, 7, 9])
     got = np.array([[5, 1, 2], [1, 7, 3], [0, 0, 0]])
     assert bench._mrr_at_k(rel, got) == round((1.0 + 0.5 + 0.0) / 3, 4)
+
+
+def test_ndcg_at_k():
+    import bench
+
+    grades = [{1: 2, 2: 1}, {3: 2}]
+    got = np.array([[2, 1, 0], [9, 8, 7]])
+    # query 1: dcg = 1/log2(2) + 3/log2(3); idcg = 3/log2(2) + 1/log2(3)
+    q1 = (1.0 + 3 / np.log2(3)) / (3.0 + 1 / np.log2(3))
+    assert bench._ndcg_at_k(grades, got) == round((q1 + 0.0) / 2, 4)
