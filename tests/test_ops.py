@@ -250,7 +250,7 @@ def test_tfidf_tiered_matches_dense():
         t = build_tiered_layout(pd_, pt_, df, num_docs=ndocs, **kw)
         s2, d2 = tfidf_topk_tiered(
             jnp.asarray(queries), jnp.asarray(t.hot_rank),
-            jnp.asarray(t.hot_tfs), jnp.asarray(t.tier_of),
+            t.hot_device(), jnp.asarray(t.tier_of),
             jnp.asarray(t.row_of),
             tuple(jnp.asarray(a) for a in t.tier_docs),
             tuple(jnp.asarray(a) for a in t.tier_tfs),
@@ -282,7 +282,7 @@ def test_bm25_tiered_matches_dense():
         t = build_tiered_layout(pd_, pt_, df, num_docs=ndocs, **kw)
         s2, d2 = bm25_topk_tiered(
             jnp.asarray(queries), jnp.asarray(t.hot_rank),
-            jnp.asarray(t.hot_tfs), jnp.asarray(t.tier_of),
+            t.hot_device(), jnp.asarray(t.tier_of),
             jnp.asarray(t.row_of),
             tuple(jnp.asarray(a) for a in t.tier_docs),
             tuple(jnp.asarray(a) for a in t.tier_tfs),
@@ -292,6 +292,35 @@ def test_bm25_tiered_matches_dense():
         # einsum and per-tier scatter paths may reorder tied docnos
         np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
                                    rtol=1e-4, err_msg=str(kw))
+
+
+def test_hot_strip_coo_densify():
+    """The hot strip is carried as COO postings (the serving cold-start
+    fix: COO crosses the H2D link, the dense strip is scattered on device).
+    hot_device() must equal the host densification, every hot term's full
+    postings list must land in its strip row, and the COO columns must be
+    slim (uint16) when the corpus allows."""
+    from tpu_ir.search.layout import build_tiered_layout
+
+    p, oracle, vocab, ndocs = _small_index()
+    df = np.asarray(p.df)
+    pd_, pt_ = np.asarray(p.pair_doc), np.asarray(p.pair_tf)
+    for kw in _tier_regimes(vocab, ndocs):
+        t = build_tiered_layout(pd_, pt_, df, num_docs=ndocs, **kw)
+        dense = t.hot_dense()
+        assert dense.shape == (t.num_hot, ndocs + 1)
+        np.testing.assert_array_equal(np.asarray(t.hot_device()), dense)
+        # every hot term's raw tfs, straight from the CSR columns
+        indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
+        for tid in np.nonzero(t.hot_rank >= 0)[0]:
+            row = dense[t.hot_rank[tid]]
+            sl = slice(indptr[tid], indptr[tid + 1])
+            np.testing.assert_array_equal(row[pd_[sl]], pt_[sl])
+            assert np.count_nonzero(row) == df[tid]
+    # this corpus is small: every column must have taken the uint16 path
+    t = build_tiered_layout(pd_, pt_, df, num_docs=ndocs, hot_budget=10**12)
+    assert (t.hot_rows.dtype == t.hot_docs.dtype == t.hot_vals.dtype
+            == np.uint16)
 
 
 def test_tiered_ignores_df0_and_out_of_range_terms():
@@ -316,7 +345,7 @@ def test_tiered_ignores_df0_and_out_of_range_terms():
     assert df[205] == 0
     lay = build_tiered_layout(np.asarray(p.pair_doc), np.asarray(p.pair_tf),
                               df, num_docs=ndocs)
-    args = (jnp.asarray(lay.hot_rank), jnp.asarray(lay.hot_tfs),
+    args = (jnp.asarray(lay.hot_rank), lay.hot_device(),
             jnp.asarray(lay.tier_of), jnp.asarray(lay.row_of),
             tuple(jnp.asarray(a) for a in lay.tier_docs),
             tuple(jnp.asarray(a) for a in lay.tier_tfs))
@@ -462,7 +491,7 @@ def test_tiered_big_tier_cond_path():
                                   jnp.int32(ndocs), k=10)
         s2, d2 = tfidf_topk_tiered(
             jnp.asarray(q), jnp.asarray(tiers.hot_rank),
-            jnp.asarray(tiers.hot_tfs), jnp.asarray(tiers.tier_of),
+            tiers.hot_device(), jnp.asarray(tiers.tier_of),
             jnp.asarray(tiers.row_of),
             tuple(jnp.asarray(a) for a in tiers.tier_docs),
             tuple(jnp.asarray(a) for a in tiers.tier_tfs),

@@ -108,12 +108,14 @@ def make_sharded_tiered(
             hot_budget=max(hot_budget // num_shards, dblk + 1),
             base_cap=base_cap, growth=growth))
 
-    # hot strip: pad rows to the max across shards
-    h_max = max(t.hot_tfs.shape[0] for t in per)
+    # hot strip: pad rows to the max across shards (densified per shard on
+    # host — each shard's strip is 1/S of the global one, and put_sharded
+    # uploads only each device's own slice)
+    h_max = max(t.num_hot for t in per)
     hot_tfs = np.zeros((num_shards, h_max, dblk + 1), np.float32)
     hot_rank = np.stack([t.hot_rank for t in per])
     for s, t in enumerate(per):
-        hot_tfs[s, : t.hot_tfs.shape[0]] = t.hot_tfs
+        hot_tfs[s, : t.num_hot] = t.hot_dense()
 
     # tiers: align to the union capacity ladder, pad rows per rung
     u_caps = sorted({td.shape[1] for t in per for td in t.tier_docs})

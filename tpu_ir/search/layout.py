@@ -26,8 +26,11 @@ gather/scatter-add per tier.
 
 from __future__ import annotations
 
+from functools import partial
 from typing import NamedTuple
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 
 # dense hot-strip budget in f32 elements (~2 GB)
@@ -38,14 +41,57 @@ GROWTH = 4
 
 
 class TieredPostings(NamedTuple):
-    """Host (numpy) arrays; the Scorer moves them to device."""
+    """Host (numpy) arrays; the Scorer moves them to device.
 
-    hot_rank: np.ndarray   # int32 [V]: row in hot_tfs, or -1
-    hot_tfs: np.ndarray    # f32 [H, D+1] raw tf, dense doc axis
+    The hot strip is carried as COO postings (hot_rows/hot_docs/hot_vals),
+    NOT as the dense [H, D+1] matrix: at 1M docs the dense strip is ~2 GB
+    while the postings behind it are a few hundred MB, and the H2D link is
+    the serving cold-start bottleneck — so the dense strip is materialized
+    ON DEVICE by a jitted scatter (`hot_device`), and only the COO columns
+    ever cross the transport (or sit in the serving cache)."""
+
+    hot_rank: np.ndarray   # int32 [V]: row in the hot strip, or -1
+    hot_rows: np.ndarray   # [nnz] strip row per hot posting (uint16/int32)
+    hot_docs: np.ndarray   # [nnz] docno per hot posting (uint16/int32)
+    hot_vals: np.ndarray   # [nnz] raw tf per hot posting (uint16/int32)
+    num_hot: int           # H >= 1 (one all-zero row when nothing is hot)
+    hot_width: int         # D + 1
     tier_of: np.ndarray    # int32 [V]: tier index (-1 for hot/df=0 terms)
     row_of: np.ndarray     # int32 [V]: row within the tier (0 likewise)
     tier_docs: tuple       # each int32 [V_t, P_t], docnos, 0 = empty slot
     tier_tfs: tuple        # each int32 [V_t, P_t], tfs, 0 = empty slot
+
+    def hot_dense(self) -> np.ndarray:
+        """Densify the hot strip on HOST — for the sharded stacker and
+        tests; the serving path uses `hot_device` instead."""
+        out = np.zeros((self.num_hot, self.hot_width), np.float32)
+        out[np.asarray(self.hot_rows, np.int64),
+            np.asarray(self.hot_docs, np.int64)] = self.hot_vals
+        return out
+
+    def hot_device(self):
+        """Densify the hot strip ON DEVICE: upload the COO columns (the
+        postings, not the strip) and scatter under jit."""
+        return _densify_hot(
+            jnp.asarray(np.ascontiguousarray(self.hot_rows)),
+            jnp.asarray(np.ascontiguousarray(self.hot_docs)),
+            jnp.asarray(np.ascontiguousarray(self.hot_vals)),
+            num_hot=self.num_hot, width=self.hot_width)
+
+
+@partial(jax.jit, static_argnames=("num_hot", "width"))
+def _densify_hot(rows, docs, vals, *, num_hot: int, width: int):
+    """jit scatter: COO hot postings -> dense f32 [H, D+1] raw-tf strip.
+    Each (term, doc) pair appears at most once, so set == add semantics."""
+    strip = jnp.zeros((num_hot, width), jnp.float32)
+    return strip.at[rows.astype(jnp.int32), docs.astype(jnp.int32)].set(
+        vals.astype(jnp.float32))
+
+
+def _slim(a: np.ndarray, hi: int) -> np.ndarray:
+    """uint16 when every value fits, else int32 — halves transport bytes
+    for the common case (strip rows, tfs, small-corpus docnos)."""
+    return a.astype(np.uint16 if hi < 65536 else np.int32)
 
 
 def _scatter_rows(tids: np.ndarray, indptr: np.ndarray, counts: np.ndarray,
@@ -93,11 +139,17 @@ def build_tiered_layout(
     hot_rank = np.full(v, -1, np.int32)
     hot_rank[hot_tids] = np.arange(len(hot_tids), dtype=np.int32)
 
-    hot_tfs = np.zeros((max(len(hot_tids), 1), d + 1), np.float32)
+    num_hot = max(len(hot_tids), 1)
     if len(hot_tids):
         rows, _, src = _scatter_rows(hot_tids, indptr, df[hot_tids],
                                      pair_doc, pair_tf)
-        hot_tfs[rows, pair_doc[src]] = pair_tf[src]
+        hot_rows = _slim(rows, num_hot)
+        hot_docs = _slim(pair_doc[src], d + 1)
+        hot_vals = _slim(pair_tf[src], int(pair_tf[src].max(initial=0)) + 1)
+    else:
+        hot_rows = np.zeros(0, np.uint16)
+        hot_docs = np.zeros(0, np.uint16)
+        hot_vals = np.zeros(0, np.uint16)
 
     # cold tiers: capacity = df rounded up to base_cap * growth^i.
     # tier_of = -1 for terms with no postings (df == 0) and for hot terms:
@@ -132,12 +184,14 @@ def build_tiered_layout(
         tier_docs.append(np.zeros((1, 1), np.int32))
         tier_tfs.append(np.zeros((1, 1), np.int32))
 
-    return TieredPostings(hot_rank, hot_tfs, tier_of, row_of,
+    return TieredPostings(hot_rank, hot_rows, hot_docs, hot_vals,
+                          num_hot, d + 1, tier_of, row_of,
                           tuple(tier_docs), tuple(tier_tfs))
 
 
 # serving-cache format version; bump when the layout semantics change
-_CACHE_VERSION = 1
+# (v2: hot strip cached as COO postings instead of the dense matrix)
+_CACHE_VERSION = 2
 
 
 def _cache_key(meta, pair_doc, pair_tf, df, hot_budget, base_cap,
@@ -204,8 +258,9 @@ def load_or_build_tiered_layout(
                     return np.load(os.path.join(cache_dir, name + ".npy"),
                                    mmap_mode="r")
                 return TieredPostings(
-                    arr("hot_rank"), arr("hot_tfs"), arr("tier_of"),
-                    arr("row_of"),
+                    arr("hot_rank"), arr("hot_rows"), arr("hot_docs"),
+                    arr("hot_vals"), m["num_hot"], m["hot_width"],
+                    arr("tier_of"), arr("row_of"),
                     tuple(arr(f"tier_docs_{i}")
                           for i in range(m["num_tiers"])),
                     tuple(arr(f"tier_tfs_{i}")
@@ -220,14 +275,18 @@ def load_or_build_tiered_layout(
     try:
         tmp = tempfile.mkdtemp(dir=index_dir, prefix=".serving-tiered-")
         np.save(os.path.join(tmp, "hot_rank.npy"), tiers.hot_rank)
-        np.save(os.path.join(tmp, "hot_tfs.npy"), tiers.hot_tfs)
+        np.save(os.path.join(tmp, "hot_rows.npy"), tiers.hot_rows)
+        np.save(os.path.join(tmp, "hot_docs.npy"), tiers.hot_docs)
+        np.save(os.path.join(tmp, "hot_vals.npy"), tiers.hot_vals)
         np.save(os.path.join(tmp, "tier_of.npy"), tiers.tier_of)
         np.save(os.path.join(tmp, "row_of.npy"), tiers.row_of)
         for i, (d, t) in enumerate(zip(tiers.tier_docs, tiers.tier_tfs)):
             np.save(os.path.join(tmp, f"tier_docs_{i}.npy"), d)
             np.save(os.path.join(tmp, f"tier_tfs_{i}.npy"), t)
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump({"key": key, "num_tiers": len(tiers.tier_docs)}, f)
+            json.dump({"key": key, "num_tiers": len(tiers.tier_docs),
+                       "num_hot": tiers.num_hot,
+                       "hot_width": tiers.hot_width}, f)
         shutil.rmtree(cache_dir, ignore_errors=True)
         os.replace(tmp, cache_dir)
     except OSError:

@@ -131,7 +131,11 @@ class Scorer:
                 tiers = build_tiered_layout(pair_doc, pair_tf, df,
                                             num_docs=d)
             self.hot_rank = jnp.asarray(tiers.hot_rank)
-            self.hot_tfs = jnp.asarray(tiers.hot_tfs)
+            # the dense strip is materialized ON DEVICE from the COO hot
+            # postings — at 1M docs that uploads a few hundred MB instead
+            # of the ~2 GB dense matrix over the H2D link (the serving
+            # cold-start bottleneck; search/layout.py::hot_device)
+            self.hot_tfs = tiers.hot_device()
             self.tier_of = jnp.asarray(tiers.tier_of)
             self.row_of = jnp.asarray(tiers.row_of)
             self.tier_docs = tuple(jnp.asarray(a) for a in tiers.tier_docs)
