@@ -1,0 +1,153 @@
+"""Microbenchmark: XLA gather+scatter vs a fused Pallas kernel for the
+cold-tier stage of tiered scoring, at 1M-doc shapes (VERDICT r1 item 7).
+
+The XLA path (ops/scoring.py::_tiered_scores `do_tier`) materializes the
+gathered [B, L, P_t] tier rows in HBM, then vmap-scatter-adds them into the
+[B, D+1] accumulator. The Pallas candidate streams each (query, term)'s tier
+row HBM->VMEM via a scalar-prefetched index map and scatters inside VMEM —
+no [B, L, P_t] intermediate. The open question is whether Mosaic's dynamic
+stores beat XLA's scatter lowering; this prints measured ms + q/s for both.
+
+Run on the real chip:  python experiments/cold_tier_bench.py
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def xla_cold_tier(q_rows, in_tier, q_w, tdocs, ttfs, *, num_docs):
+    """The production XLA path, lifted verbatim in shape/semantics from
+    ops/scoring.py::_tiered_scores (tfidf weight curve)."""
+    b = q_rows.shape[0]
+    scores = jnp.zeros((b, num_docs + 1), jnp.float32)
+    r = jnp.where(in_tier, q_rows, 0)
+    docs = tdocs[r]                                  # [B, L, P_t]
+    tfs = ttfs[r].astype(jnp.float32)
+    w = jnp.where(tfs > 0, 1.0 + jnp.log(jnp.maximum(tfs, 1.0)), 0.0)
+    w = w * q_w[..., None] * in_tier[..., None]
+    slot = jnp.where((tfs > 0) & in_tier[..., None], docs, num_docs + 1)
+
+    def add_cold(acc_q, slots_q, w_q):
+        return acc_q.at[slots_q.ravel()].add(w_q.ravel(), mode="drop")
+
+    return jax.vmap(add_cold)(scores, slot, w)
+
+
+def pallas_cold_tier(q_rows, in_tier, q_w, tdocs, ttfs, *, num_docs,
+                     interpret=False):
+    """Fused: grid (B, L); the scalar-prefetched row index schedules each
+    tier row's DMA; the kernel scatters into the query's [D+1] VMEM row."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    b, l = q_rows.shape
+    v_t, p_t = tdocs.shape
+    d1 = num_docs + 1
+
+    safe_r = jnp.where(in_tier, q_rows, 0).astype(jnp.int32)
+    w_eff = jnp.where(in_tier, q_w, 0.0)             # [B, L]
+
+    def kernel(r_ref, w_ref, docs_ref, tfs_ref, out_ref):
+        bb = pl.program_id(0)
+        ll = pl.program_id(1)
+
+        @pl.when(ll == 0)
+        def _():
+            out_ref[:] = jnp.zeros_like(out_ref)
+
+        w_q = w_ref[bb, ll]
+
+        @pl.when(w_q != 0.0)
+        def _():
+            tfs = tfs_ref[0, 0, :].astype(jnp.float32)
+            wv = jnp.where(tfs > 0,
+                           1.0 + jnp.log(jnp.maximum(tfs, 1.0)), 0.0) * w_q
+
+            def body(p, _):
+                d = docs_ref[0, 0, p]
+                out_ref[0, 0, d] = out_ref[0, 0, d] + wv[p]
+                return 0
+
+            jax.lax.fori_loop(0, p_t, body, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,                       # safe_r, w_eff
+        grid=(b, l),
+        in_specs=[
+            pl.BlockSpec((1, 1, p_t), lambda i, j, r, w: (r[i, j], 0, 0)),
+            pl.BlockSpec((1, 1, p_t), lambda i, j, r, w: (r[i, j], 0, 0)),
+        ],
+        # singleton middle dim so the block's trailing two dims equal the
+        # array's (same Mosaic constraint dodge as ops/pallas_scoring.py)
+        out_specs=pl.BlockSpec((1, 1, d1), lambda i, j, r, w: (i, 0, 0)),
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 1, d1), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(safe_r, w_eff, tdocs.reshape(v_t, 1, p_t), ttfs.reshape(v_t, 1, p_t))
+    return out.reshape(b, d1)
+
+
+def bench(fn, *args, warmup=1, iters=3, **kw):
+    out = None
+    for _ in range(warmup):
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args, **kw)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main():
+    print("devices:", jax.devices())
+    rng = np.random.default_rng(0)
+
+    # representative wiki1m cold tier: cap 65536, a few dozen terms; a
+    # query block of 250 with L=8 slots, ~1 slot in 4 landing in the tier
+    for num_docs, v_t, p_t, b, l in [
+        (1_000_000, 32, 65_536, 64, 8),
+        (1_000_000, 32, 8_192, 64, 8),
+        (100_000, 64, 8_192, 64, 8),
+    ]:
+        tdocs = np.zeros((v_t, p_t), np.int32)
+        ttfs = np.zeros((v_t, p_t), np.int32)
+        for r in range(v_t):
+            n = rng.integers(p_t // 2, p_t)
+            tdocs[r, :n] = np.sort(
+                rng.choice(num_docs, size=n, replace=False) + 1)
+            ttfs[r, :n] = rng.integers(1, 30, n)
+        q_rows = rng.integers(0, v_t, (b, l)).astype(np.int32)
+        in_tier = rng.random((b, l)) < 0.25
+        q_w = rng.random((b, l)).astype(np.float32) + 0.1
+
+        args = (jnp.asarray(q_rows), jnp.asarray(in_tier), jnp.asarray(q_w),
+                jnp.asarray(tdocs), jnp.asarray(ttfs))
+        xla_jit = jax.jit(partial(xla_cold_tier, num_docs=num_docs))
+        t_x, out_x = bench(xla_jit, *args)
+        print(f"D={num_docs} Vt={v_t} Pt={p_t} B={b} L={l}  "
+              f"XLA: {t_x*1e3:8.2f} ms  ({b/t_x:8.1f} q/s)")
+        try:
+            pal_jit = jax.jit(partial(
+                pallas_cold_tier, num_docs=num_docs,
+                interpret=jax.devices()[0].platform != "tpu"))
+            t_p, out_p = bench(pal_jit, *args)
+            ok = np.allclose(np.asarray(out_x), np.asarray(out_p),
+                             rtol=1e-4, atol=1e-4)
+            print(f"{'':38s}Pallas: {t_p*1e3:8.2f} ms  ({b/t_p:8.1f} q/s)"
+                  f"  match={ok}  speedup={t_x/t_p:.2f}x")
+        except Exception as e:  # noqa: BLE001 - report and continue
+            print(f"{'':38s}Pallas FAILED: {type(e).__name__}: {e}")
+
+
+if __name__ == "__main__":
+    main()

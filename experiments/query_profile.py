@@ -1,0 +1,101 @@
+"""Where does a 1M-doc query block actually spend its time on the chip?
+
+Breaks tiered scoring into its stages at wiki1m-like shapes (B=250 block,
+H=500 hot rows, ~10 tiers, top-k over [B, 1M]) and times each in isolation.
+Run on the real chip: python experiments/query_profile.py
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timeit(fn, *args, iters=5):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    print("devices:", jax.devices())
+    rng = np.random.default_rng(0)
+    d1 = 1_000_001
+    b, h = 250, 500
+
+    strip = jnp.asarray(rng.random((h, d1), np.float32))
+    w_hot = jnp.asarray(rng.random((b, h), np.float32))
+    scores = jnp.asarray(rng.random((b, d1), np.float32))
+
+    t = timeit(jax.jit(lambda a, m: a @ m), w_hot, strip)
+    print(f"hot matmul [B,{h}]@[{h},D]   : {t*1e3:8.2f} ms"
+          f"  ({b/t:9.1f} q/s)")
+
+    t = timeit(jax.jit(lambda m: jnp.where(m > 0, 1.0 + jnp.log(
+        jnp.maximum(m, 1.0)), 0.0)), strip)
+    print(f"strip weight_fn [H,D]        : {t*1e3:8.2f} ms")
+
+    t = timeit(jax.jit(lambda s: jax.lax.top_k(s, 10)), scores)
+    print(f"top_k k=10 [B,D]             : {t*1e3:8.2f} ms"
+          f"  ({b/t:9.1f} q/s)")
+
+    t = timeit(jax.jit(lambda s: jax.lax.top_k(s, 1000)), scores)
+    print(f"top_k k=1000 [B,D]           : {t*1e3:8.2f} ms")
+
+    # D2H fetch of one block's results (the tunnel's fixed latency)
+    sc = jnp.asarray(rng.random((b, 10), np.float32))
+    dn = jnp.asarray(rng.integers(0, d1, (b, 10)).astype(np.int32))
+    jax.block_until_ready((sc, dn))
+    t0 = time.perf_counter()
+    for _ in range(5):
+        np.asarray(sc), np.asarray(dn)
+    print(f"D2H fetch [B,10] x2          : {(time.perf_counter()-t0)/5*1e3:8.2f} ms")
+
+    # a full tiered tfidf dispatch at synthetic 1M shapes
+    from tpu_ir.ops.scoring import tfidf_topk_tiered
+    from tpu_ir.search.layout import build_tiered_layout
+
+    v, npairs = 200_000, 3_000_000
+    df = rng.integers(1, 30, v).astype(np.int64)
+    hot_ids = rng.choice(v, 400, replace=False)
+    df[hot_ids] = rng.integers(10_000, 120_000, len(hot_ids))
+    df = (df * (npairs / df.sum())).astype(np.int64)
+    df = np.maximum(df, 1)
+    indptr = np.concatenate([[0], np.cumsum(df)])
+    total = int(indptr[-1])
+    pair_doc = np.empty(total, np.int32)
+    for tid in range(v):  # ascending docs per term
+        n = df[tid]
+        pair_doc[indptr[tid]:indptr[tid+1]] = np.sort(
+            rng.choice(d1 - 1, n, replace=False) + 1) if n < 200_000 else \
+            np.sort(rng.integers(1, d1, n))
+    pair_tf = rng.integers(1, 20, total).astype(np.int32)
+    lay = build_tiered_layout(pair_doc, pair_tf, df.astype(np.int32),
+                              num_docs=d1 - 1)
+    print("tiers:", [(td.shape) for td in lay.tier_docs])
+    targs = (jnp.asarray(lay.hot_rank), lay.hot_device(),
+             jnp.asarray(lay.tier_of), jnp.asarray(lay.row_of),
+             tuple(jnp.asarray(a) for a in lay.tier_docs),
+             tuple(jnp.asarray(a) for a in lay.tier_tfs),
+             jnp.asarray(df.astype(np.int32)), jnp.int32(d1 - 1))
+    q = jnp.asarray(rng.integers(0, v, (b, 8)).astype(np.int32))
+    t = timeit(lambda q: tfidf_topk_tiered(q, *targs, num_docs=d1 - 1,
+                                           k=10), q, iters=3)
+    print(f"full tiered tfidf dispatch   : {t*1e3:8.2f} ms"
+          f"  ({b/t:9.1f} q/s)")
+
+
+if __name__ == "__main__":
+    main()

@@ -493,6 +493,19 @@ def test_rerank_two_stage(index_dir):
         dense.topk(q, k=10, scoring="bm25")[1][0]) if x > 0}
 
 
+def test_unknown_layout_rejected(tmp_path):
+    """A typo'd or retired layout value (round-1 'pallas') must raise, not
+    silently fall through to the tiered path."""
+    from tpu_ir.index import build_index as bi
+
+    corpus = corpus_file(tmp_path)
+    idx = str(tmp_path / "idx")
+    bi([str(corpus)], idx, k=1, num_shards=3, compute_chargrams=False)
+    for bad in ("pallas", "desne"):
+        with pytest.raises(ValueError, match="unknown layout"):
+            Scorer.load(idx, layout=bad)
+
+
 def test_serving_layout_cache(tmp_path):
     """The tiered layout disk cache: second load hits the cache with
     identical scoring; a changed index invalidates it."""

@@ -62,35 +62,10 @@ def test_pallas_duplicate_terms(index_data):
     np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-5)
 
 
-def test_scorer_pallas_layout_matches_dense(tmp_path):
-    """layout='pallas' on the Scorer (interpret off-TPU) must rank exactly
-    like layout='dense'; bm25 on the pallas layout falls back to XLA."""
-    from tpu_ir.index import build_index
-    from tpu_ir.search import Scorer
-
-    rng = np.random.default_rng(11)
-    words = ["w%03d" % i for i in range(60)]
-    corpus = tmp_path / "c.trec"
-    with open(corpus, "w") as f:
-        for i in range(40):
-            body = " ".join(rng.choice(words, 30))
-            f.write(f"<DOC>\n<DOCNO> D-{i:03d} </DOCNO>\n<TEXT>\n{body}\n"
-                    f"</TEXT>\n</DOC>\n")
-    idx = str(tmp_path / "idx")
-    build_index([str(corpus)], idx, k=1, chargram_ks=[],
-                compute_chargrams=False)
-
-    dense = Scorer.load(idx, layout="dense")
-    pall = Scorer.load(idx, layout="pallas")
-    assert pall.layout == "pallas"
-    queries = ["w001 w005", "w010", "w020 w030 w040"]
-    for scoring in ("tfidf", "bm25"):
-        r1 = dense.search_batch(queries, k=5, scoring=scoring)
-        r2 = pall.search_batch(queries, k=5, scoring=scoring)
-        # like the kernel tests above: docno sets + approx scores (ties may
-        # reorder under 1-ulp accumulation differences kernel vs einsum)
-        for q1, q2 in zip(r1, r2):
-            assert {d for d, _ in q1} == {d for d, _ in q2}, scoring
-            np.testing.assert_allclose(
-                sorted(s for _, s in q1), sorted(s for _, s in q2),
-                rtol=1e-5, err_msg=scoring)
+# NOTE: the Scorer's `--layout pallas` serving option was retired in round 2
+# after hardware measurement (NOTES.md "Pallas verdict"): the kernel is 2x
+# slower than XLA's einsum at ref scale and the cold-tier scatter it might
+# have fused runs at memory bandwidth under XLA already (0.06 ms per
+# 64-query block at 1M docs). The kernel itself stays, exercised by the
+# parity tests above — the scalar-prefetch row-DMA pattern is the reusable
+# piece, not the layout flag.

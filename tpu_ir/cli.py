@@ -328,12 +328,11 @@ def main(argv: list[str] | None = None) -> int:
                     help="two-stage retrieval: BM25 top-N candidates, then "
                          "cosine TF-IDF rerank")
     ps.add_argument("--layout",
-                    choices=["auto", "dense", "sparse", "sharded", "pallas"],
+                    choices=["auto", "dense", "sparse", "sharded"],
                     default="auto",
                     help="'sharded' distributes the tiered layout's doc "
                          "axis over all devices (TF-IDF/BM25/rerank) with "
-                         "a global top-k merge; 'pallas' scores the dense "
-                         "layout with the fused TPU kernel")
+                         "a global top-k merge")
     ps.add_argument("--docnos", action="store_true",
                     help="print docnos instead of docids")
     ps.add_argument("--compat", action="store_true",

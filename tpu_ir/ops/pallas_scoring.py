@@ -12,8 +12,14 @@ term) and one [B, D] result write — no [B, L, D] intermediate.
 Top-k stays in XLA (lax.top_k); sort-free selection inside a kernel buys
 nothing at D ~ thousands.
 
-Used when `layout="pallas"` is requested on the Scorer; falls back to
-interpret mode off-TPU so the hermetic CPU suite exercises the same code.
+STATUS (round 2): retired from the serving surface after hardware
+measurement — the XLA einsum is 2x faster at ref scale (34.8k vs 16.7k
+q/s, NOTES.md), and the tiered layout's cold-tier scatter (the one place a
+fused kernel might have paid at 1M docs) already runs at memory bandwidth
+under XLA (0.06 ms per 64-query block; a Mosaic scatter kernel is not even
+expressible — no dynamic-index vector stores, experiments/cold_tier_bench
+.py). Kept as the canonical scalar-prefetch gather pattern, exercised by
+tests/test_pallas.py in interpret mode off-TPU and compiled on real TPU.
 """
 
 from __future__ import annotations

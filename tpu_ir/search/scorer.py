@@ -85,17 +85,16 @@ class Scorer:
 
         if layout == "auto":
             layout = "dense" if v * (d + 1) <= DENSE_BUDGET else "sparse"
+        if layout not in ("dense", "sparse", "sharded"):
+            # explicit rejection so a typo (or the round-1 "pallas" layout,
+            # retired after hardware measurement — NOTES.md "Pallas
+            # verdict") cannot silently fall through to the tiered path
+            raise ValueError(f"unknown layout {layout!r}; expected "
+                             "'auto', 'dense', 'sparse' or 'sharded'")
         self.layout = layout
         self._pairs = (pair_term, pair_doc, pair_tf)
         self._tf_matrix = None  # built lazily on first BM25 call
-        if layout == "pallas":
-            # same dense doc matrix, scored by the fused Pallas kernel
-            # (ops/pallas_scoring.py); interpret mode off-TPU so the
-            # hermetic CPU suite exercises the identical path
-            import jax
-
-            self._pallas_interpret = jax.devices()[0].platform != "tpu"
-        if layout in ("dense", "pallas"):
+        if layout == "dense":
             self.doc_matrix = dense_doc_matrix(
                 jnp.asarray(pair_term), jnp.asarray(pair_doc),
                 jnp.asarray(pair_tf), vocab_size=v, num_docs=d)
@@ -359,9 +358,6 @@ class Scorer:
 
     # max elements of the [B_block, D+1] score accumulator per dispatch
     SCORE_BUDGET = 250_000_000
-    # pallas layout: the kernel scalar-prefetches its [B, L] id/idf tables
-    # into SMEM (~1 MB per core), so query blocks must stay small
-    PALLAS_BLOCK = 256
 
     def _blocked_dispatch(self, block: int, dispatch, *arrays_pads):
         """Run a per-block device dispatch over padded query-row blocks.
@@ -402,9 +398,6 @@ class Scorer:
         accumulator stays within SCORE_BUDGET elements regardless of corpus
         size (the reference had no batching at all; SURVEY.md §3.3)."""
         block = max(1, self.SCORE_BUDGET // (self._doc_axis_width()))
-        if self.layout == "pallas" and scoring == "tfidf" \
-                and not self.compat_int_idf:
-            block = min(block, self.PALLAS_BLOCK)
         return self._blocked_dispatch(
             block, lambda q: self._topk_device(q, k, scoring),
             (np.asarray(q_terms, np.int32), -1))
@@ -427,7 +420,7 @@ class Scorer:
                 q, self._sharded, self.df, n, mesh=self._mesh, k=k,
                 scoring=scoring, compat_int_idf=self.compat_int_idf)
         elif scoring == "bm25":
-            if self.layout in ("dense", "pallas"):  # kernel is tf-idf only
+            if self.layout == "dense":
                 if self._tf_matrix is None:
                     pt, pd, ptf = self._pairs
                     self._tf_matrix = dense_tf_matrix(
@@ -443,13 +436,7 @@ class Scorer:
                     q, self.hot_rank, self.hot_tfs, self.tier_of,
                     self.row_of, self.tier_docs, self.tier_tfs, self.df,
                     self.doc_len, n, num_docs=self.meta.num_docs, k=k)
-        elif self.layout == "pallas" and not self.compat_int_idf:
-            from ..ops.pallas_scoring import pallas_tfidf_topk
-
-            s, d = pallas_tfidf_topk(q, self.doc_matrix, self.df, n, k=k,
-                                     interpret=self._pallas_interpret)
-        elif self.layout in ("dense", "pallas"):
-            # compat int-idf isn't implemented in the kernel; use XLA dense
+        elif self.layout == "dense":
             s, d = tfidf_topk_dense(q, self.doc_matrix, self.df, n, k=k,
                                     compat_int_idf=self.compat_int_idf)
         else:
@@ -525,7 +512,7 @@ class Scorer:
         def dispatch(q):
             qd = jnp.asarray(q)
             _, cand_d = self._topk_device(qd, candidates, "bm25")
-            if self.layout in ("dense", "pallas"):
+            if self.layout == "dense":
                 return cosine_rerank_dense(
                     qd, self.doc_matrix, self.df, norms, cand_d, n, k=k)
             return cosine_rerank_tiered(
