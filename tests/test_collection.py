@@ -72,3 +72,126 @@ def test_kgram_terms():
     assert kgram_terms(toks, 4) == ["a b c d"]
     # shorter than k -> nothing (reference TermKGramDocIndexer.java:144-146)
     assert kgram_terms(["a"], 2) == []
+
+
+# -- stream parsers + parsed Document model (collection/parsers.py) --------
+
+TRECTEXT = """\
+junk preamble
+<DOC>
+<DOCNO> AP-900101-0001 </DOCNO>
+<FILEID>AP-NR-01-01-90</FILEID>
+<HEAD>
+Fish Stocks Rebound
+</HEAD>
+<IGNORED>not indexed</IGNORED>
+<TEXT>
+Salmon runs returned to the river.
+Second line.
+</TEXT>
+</DOC>
+<DOC>
+<DOCNO>
+ AP-2 </DOCNO>
+<TEXT>
+short
+</TEXT>
+</DOC>
+"""
+
+
+def test_trectext_parser_sections_and_multiline_docno():
+    from tpu_ir.collection import TrecTextParser
+
+    docs = list(TrecTextParser(TRECTEXT))
+    assert [d.identifier for d in docs] == ["AP-900101-0001", "AP-2"]
+    # only the known section tags' content is kept, tag lines included;
+    # FILEID/IGNORED lines are dropped (TrecTextParser.java:58-63)
+    assert "Fish Stocks Rebound" in docs[0].text
+    assert "Salmon runs" in docs[0].text and "Second line." in docs[0].text
+    # dropped: FILEID is no known section, IGNORED sits between sections.
+    # (Like the reference, sections are line-oriented: a one-line
+    # <HEAD>x</HEAD> would never close — TrecTextParser.java:66-89.)
+    assert "FILEID" not in docs[0].text and "not indexed" not in docs[0].text
+    assert docs[1].text == "<TEXT>\nshort\n</TEXT>\n"
+
+
+TRECWEB = """\
+<DOC>
+<DOCNO> WT01-B01-1 </DOCNO>
+<DOCHDR>
+HTTP://Example.COM:80/Path/# 199.0.0.1 19970101
+Content-type: text/html
+</DOCHDR>
+<html><head><title>Example Page</title></head>
+<body>web content here</body></html>
+</DOC>
+"""
+
+
+def test_trecweb_parser_url_scrub_and_metadata():
+    from tpu_ir.collection import TrecWebParser
+
+    docs = list(TrecWebParser(TRECWEB))
+    assert len(docs) == 1
+    d = docs[0]
+    assert d.identifier == "WT01-B01-1"
+    # scrubbed: lowercase, no :80, no trailing '#', no trailing slashes
+    # (TrecWebParser.java:37-53)
+    assert d.metadata["url"] == "http://example.com/path"
+    assert d.metadata["identifier"] == d.identifier
+    assert "web content here" in d.text
+    assert "Content-type" not in d.text  # header stays out of the content
+
+
+def test_parse_document_terms_and_tags():
+    from tpu_ir.collection import Document, parse_document
+
+    doc = parse_document(Document(
+        "X-1", '<title>Big News</title> hello <b>bold words</b>'))
+    assert doc.terms == ["big", "news", "hello", "bold", "words"]
+    assert [(t.name, t.begin, t.end) for t in doc.tags] == \
+        [("title", 0, 2), ("b", 3, 5)]
+
+
+def test_pack_roundtrip_into_index(tmp_path):
+    """trecweb corpus -> pack --format trecweb -> canonical TREC that the
+    native ingestion path indexes and retrieves."""
+    from tpu_ir.collection import TrecWebParser, read_trec_file, to_trec
+
+    out = tmp_path / "packed.trec"
+    with open(out, "w") as f:
+        for doc in TrecWebParser(TRECWEB):
+            f.write(to_trec(doc))
+    got = list(read_trec_file(str(out)))
+    assert [d.docid for d in got] == ["WT01-B01-1"]
+    assert "web content here" in got[0].content
+
+
+def test_tag_spans_recorded():
+    """Opt-in tag-span recording: token coordinates, (begin asc, end desc)
+    order, nesting, attributes, self-closing tags, 256-byte name cap
+    (Tag.java:8-77, TagTokenizer.java:626-642)."""
+    from tpu_ir.analysis.tag_tokenizer import TagTokenizer
+
+    t = TagTokenizer(record_tags=True)
+    toks = t.tokenize('<doc id="7"><title>Big News</title> hello '
+                      '<b>bold words</b> tail <br/> end</doc>')
+    assert toks == ["big", "news", "hello", "bold", "words", "tail", "end"]
+    spans = [(g.name, g.begin, g.end) for g in t.tags]
+    # doc encloses everything; title/b are inner spans; br is empty
+    assert spans == [("doc", 0, 7), ("title", 0, 2), ("b", 3, 5),
+                     ("br", 6, 6)]
+    assert t.tags[0].attributes == {"id": "7"}
+    assert str(t.tags[0]) == '<doc id="7">'
+
+    # default tokenizer records nothing (no cost on the indexing hot path)
+    t2 = TagTokenizer()
+    t2.tokenize("<a>x</a>")
+    assert t2.tags == []
+
+    # unmatched end tags are dropped; name capped below 256 UTF-8 bytes
+    t3 = TagTokenizer(record_tags=True)
+    t3.tokenize("</nope>w<" + "x" * 300 + ">y</" + "x" * 300 + ">")
+    assert [g.name[:2] for g in t3.tags] == ["xx"]
+    assert len(t3.tags[0].name.encode("utf-8")) < 256

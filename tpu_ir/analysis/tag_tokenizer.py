@@ -17,6 +17,12 @@ Behavior-parity target: the Galago TagTokenizer vendored by the reference
   (:479-527) — strip edge periods, collapse true acronyms (periods at all odd
   positions), otherwise split on periods keeping pieces of length >= 2.
 - Tokens longer than 16 chars AND >= 100 UTF-8 bytes are dropped (:439-453).
+- Opt-in tag-span recording (``TagTokenizer(record_tags=True)``): begin tags
+  push (name, attributes, token position); a matching end tag closes the most
+  recent open tag into a :class:`Tag` span whose begin/end are TOKEN
+  coordinates (begin=5 means the open tag sits between tokens 5 and 6 —
+  Tag.java:8-29); spans sort by (begin asc, end desc) (:626-642, Tag.java:
+  64-77); names are truncated below 256 UTF-8 bytes (Tag.java:41-62).
 
 This is a new implementation (regex-assisted scan), not a port of the Java
 character loop.
@@ -25,6 +31,7 @@ character loop.
 from __future__ import annotations
 
 import unicodedata
+from dataclasses import dataclass, field
 
 _SPLIT_CHARS = set(';"&/:!#?$%()@^*+-,=><[]{}|`~_') | {chr(c) for c in range(33)}
 _IGNORED_TAGS = frozenset(("style", "script"))
@@ -68,16 +75,72 @@ def _classify(token: str) -> int:
     return status
 
 
-class TagTokenizer:
-    """Stateful single-document tokenizer; use :func:`tokenize` for one-shots."""
+def _parse_attr(raw: str) -> tuple[str, str] | None:
+    """One raw attribute chunk -> (lowercased name, unquoted value); bare
+    attributes get an empty value; a bare quote run yields None."""
+    raw = raw.strip()
+    if not raw:
+        return None
+    key, eq, value = raw.partition("=")
+    key = key.strip().lower()
+    if not key or key[0] in "\"'":  # bare quote run is not an attribute
+        return None
+    value = value.strip()
+    if len(value) >= 2 and value[0] in "\"'" and value[-1] == value[0]:
+        value = value[1:-1]
+    return key, value
 
-    def __init__(self) -> None:
+
+def _truncate_tag_name(name: str) -> str:
+    """Keep the name under 256 UTF-8 bytes (Tag.java:41-62)."""
+    if len(name) > 32:
+        while len(name.encode("utf-8")) >= 256:
+            name = name[:256] if len(name) > 256 else name[:-1]
+    return name
+
+
+@dataclass
+class Tag:
+    """A markup span in TOKEN coordinates: begin=5 means the open tag sits
+    between tokens 5 and 6 (Tag.java:8-29). Ordered by (begin asc, end
+    desc) — an enclosing tag sorts before the tags it contains."""
+
+    name: str
+    attributes: dict = field(default_factory=dict)
+    begin: int = 0
+    end: int = 0
+
+    def __post_init__(self) -> None:
+        self.name = _truncate_tag_name(self.name)
+
+    def sort_key(self):
+        return (self.begin, -self.end)
+
+    def __str__(self) -> str:
+        attrs = "".join(f' {k}="{v}"' for k, v in self.attributes.items())
+        return f"<{self.name}{attrs}>"
+
+
+class TagTokenizer:
+    """Stateful single-document tokenizer; use :func:`tokenize` for one-shots.
+
+    With ``record_tags=True``, ``self.tags`` holds the document's markup
+    structure as sorted :class:`Tag` spans after :meth:`tokenize` (the
+    reference engine never consumes them — SURVEY.md §2.3 — but the parsed
+    Document model carries them; collection/parsers.py)."""
+
+    def __init__(self, record_tags: bool = False) -> None:
         self.tokens: list[str] = []
+        self.tags: list[Tag] = []
+        self._record_tags = record_tags
         self._text = ""
         self._ignore_until: str | None = None
+        self._open_tags: list[tuple[str, dict, int]] = []
 
     def tokenize(self, text: str) -> list[str]:
         self.tokens = []
+        self.tags = []
+        self._open_tags = []
         self._text = text
         self._ignore_until = None
         n = len(text)
@@ -107,6 +170,8 @@ class TagTokenizer:
 
         if self._ignore_until is None:
             self._on_token(last_split + 1, n)
+        if self._record_tags:
+            self.tags.sort(key=Tag.sort_key)
         return self.tokens
 
     # -- token emission ---------------------------------------------------
@@ -198,9 +263,21 @@ class TagTokenizer:
         name = text[pos + 2 : i].lower()
         if self._ignore_until is not None and self._ignore_until == name:
             self._ignore_until = None
+        if self._record_tags and self._ignore_until is None:
+            self._close_tag(name)
         while i < len(text) and text[i] != ">":
             i += 1
         return i
+
+    def _close_tag(self, name: str) -> None:
+        """Close the MOST RECENT matching open tag into a token-coordinate
+        span (unmatched end tags are dropped, like the reference's stack
+        scan, TagTokenizer.java:179-202)."""
+        for j in range(len(self._open_tags) - 1, -1, -1):
+            if self._open_tags[j][0] == name:
+                _, attrs, begin = self._open_tags.pop(j)
+                self.tags.append(Tag(name, attrs, begin, len(self.tokens)))
+                return
 
     def _parse_begin_tag(self, pos: int) -> int:
         text = self._text
@@ -211,6 +288,10 @@ class TagTokenizer:
         # advance over attributes to the tag-closing '>' (or text end),
         # honoring quoted attribute values; detect self-closing '/>'
         close_it = False
+        if name.endswith("/"):  # attribute-less self-close: <br/>
+            name = name[:-1]
+            close_it = True
+        attrs: dict = {}
         while i < n and _is_space_char(text[i]):
             i += 1
         if i >= n:
@@ -240,6 +321,18 @@ class TagTokenizer:
                     i = end
                     if i < n and text[i] in "\"'":
                         i += 1
+                    if self._record_tags:
+                        kv = _parse_attr(text[start:i])
+                        if kv is not None:
+                            attrs[kv[0]] = kv[1]
+
+        if self._record_tags and self._ignore_until is None:
+            if close_it:
+                # self-closing tag: an empty span at the current position
+                self.tags.append(Tag(name, attrs,
+                                     len(self.tokens), len(self.tokens)))
+            else:
+                self._open_tags.append((name, attrs, len(self.tokens)))
 
         if name in _IGNORED_TAGS and not close_it:
             self._ignore_until = name

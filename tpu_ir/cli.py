@@ -211,16 +211,29 @@ def cmd_verify(args) -> int:
 def cmd_pack(args) -> int:
     """PackTextFile equivalent: each line of a plain text file becomes one
     TREC <DOC> with docid PREFIX-NNNNNNN (reference
-    edu/umd/cloud9/io/PackTextFile.java packs lines into SequenceFiles)."""
+    edu/umd/cloud9/io/PackTextFile.java packs lines into SequenceFiles).
+
+    --format trectext/trecweb instead re-parses the input with the
+    matching stream parser (collection/parsers.py — live versions of the
+    reference's dead TrecTextParser/TrecWebParser) and canonicalizes each
+    parsed document into the indexers' native TREC shape."""
+    from .collection.parsers import Document, TrecTextParser, TrecWebParser, to_trec
+
     with open(args.text_file, encoding="utf-8") as fin, \
             open(args.output, "w", encoding="utf-8") as fout:
+        if args.format == "lines":
+            docs = (Document(f"{args.prefix}-{i:07d}", line.rstrip("\n"))
+                    for i, line in enumerate(fin))
+        else:
+            cls = TrecTextParser if args.format == "trectext" \
+                else TrecWebParser
+            docs = iter(cls(fin))
         n = 0
-        for i, line in enumerate(fin):
-            line = line.rstrip("\n")
-            fout.write(f"<DOC>\n<DOCNO> {args.prefix}-{i:07d} </DOCNO>\n"
-                       f"<TEXT>\n{line}\n</TEXT>\n</DOC>\n")
+        for doc in docs:
+            fout.write(to_trec(doc))
             n += 1
-    print(json.dumps({"docs_packed": n, "output": args.output}))
+    print(json.dumps({"docs_packed": n, "output": args.output,
+                      "format": args.format}))
     return 0
 
 
@@ -358,10 +371,16 @@ def main(argv: list[str] | None = None) -> int:
     pv.set_defaults(fn=cmd_verify)
 
     pp = sub.add_parser("pack", help="pack plain text into TREC format "
-                                     "(one <DOC> per input line)")
+                                     "(one <DOC> per input line), or "
+                                     "canonicalize trectext/trecweb corpora")
     pp.add_argument("text_file")
     pp.add_argument("output", help="TREC file to write")
     pp.add_argument("--prefix", default="LINE", help="docid prefix")
+    pp.add_argument("--format", choices=["lines", "trectext", "trecweb"],
+                    default="lines",
+                    help="'trectext' keeps only the known section tags' "
+                         "content; 'trecweb' parses <DOCHDR> records and "
+                         "scrubs the URL")
     pp.set_defaults(fn=cmd_pack)
 
     pc = sub.add_parser("count", help="count documents in a corpus")

@@ -1,9 +1,23 @@
 from .docno import DocnoMapping
+from .parsers import (
+    Document,
+    DocumentStreamParser,
+    TrecTextParser,
+    TrecWebParser,
+    parse_document,
+    to_trec,
+)
 from .trec import TrecDocument, read_trec_corpus, read_trec_file, read_trec_stream
 from .vocab import KGRAM_SEP, Vocab, kgram_terms
 
 __all__ = [
     "DocnoMapping",
+    "Document",
+    "DocumentStreamParser",
+    "TrecTextParser",
+    "TrecWebParser",
+    "parse_document",
+    "to_trec",
     "TrecDocument",
     "read_trec_corpus",
     "read_trec_file",

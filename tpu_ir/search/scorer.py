@@ -145,6 +145,11 @@ class Scorer:
     @classmethod
     def load(cls, index_dir: str, *, layout: str = "auto",
              compat_int_idf: bool = False) -> "Scorer":
+        if layout not in ("auto", "dense", "sparse", "sharded"):
+            # fail before any IO — a typo'd layout should not cost the
+            # minutes-long shard read + CSR assembly of a large index
+            raise ValueError(f"unknown layout {layout!r}; expected "
+                             "'auto', 'dense', 'sparse' or 'sharded'")
         meta = fmt.IndexMetadata.load(index_dir)
         vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
         mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
