@@ -218,6 +218,12 @@ struct Tokenizer {
     if (pos + 1 >= n) return n;
     char c = text[pos + 1];
     if (c == '/') return parse_end_tag(pos);
+    if (!ignore_until.empty()) {
+      // inside <style>/<script> only the matching end tag can change
+      // state (twin of tag_tokenizer.py::_on_start_bracket)
+      const char *f = (const char *)memchr(text + pos + 1, '>', n - pos - 1);
+      return f ? (int32_t)(f - text) : n;
+    }
     if (c == '!') return skip_comment(pos);
     if (c == '?') {
       const char *f = (const char *)memmem(text + pos + 1, n - pos - 1, "?>", 2);

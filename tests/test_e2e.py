@@ -546,6 +546,45 @@ def test_serving_layout_cache(tmp_path):
     got = {d for d, _ in s3.search("salmon")}
     assert got == {"X-1"}
 
+def test_serving_cache_fast_path_skips_shards(tmp_path, monkeypatch):
+    """A warm load (cache hit) must not read any shard or assemble the CSR
+    columns: tiers + df + rerank norms all ride in the serving cache (the
+    1M-doc warm-load fix — shard IO was the dominant cost). Every scorer
+    (tfidf, bm25, rerank) must match the cold load's results."""
+    from tpu_ir.index import build_index as bi
+    from tpu_ir.index import format as fmt
+
+    corpus = corpus_file(tmp_path)
+    idx = str(tmp_path / "idx")
+    bi([str(corpus)], idx, k=1, num_shards=3, compute_chargrams=False)
+
+    cold = Scorer.load(idx, layout="sparse")
+    queries = ["salmon fishing", "river trout"]
+    want = {
+        ("tfidf", None): cold.search_batch(queries, scoring="tfidf"),
+        ("bm25", None): cold.search_batch(queries, scoring="bm25"),
+        ("bm25", 5): cold.search_batch(queries, rerank=5),
+    }
+
+    def boom(*a, **k):
+        raise AssertionError("cache hit must not touch shard files")
+
+    monkeypatch.setattr(fmt, "load_shard", boom)
+    warm = Scorer.load(idx, layout="sparse")
+    assert warm._pairs_cols is None  # nothing forced the CSR assembly
+    for (scoring, rr), expect in want.items():
+        got = warm.search_batch(queries, scoring=scoring, rerank=rr)
+        for g, e in zip(got, expect):
+            assert [d for d, _ in g] == [d for d, _ in e], (scoring, rr)
+            np.testing.assert_allclose([s for _, s in g],
+                                       [s for _, s in e], rtol=1e-5)
+    assert warm._pairs_cols is None  # rerank used the cached norms
+    # the columns are still reachable lazily (oracles need them) — but
+    # only by explicit request, which does read shards
+    monkeypatch.undo()
+    assert len(warm._pairs[0]) == warm.meta.num_pairs
+
+
 def test_wildcard_search_kgram_index(tmp_path_factory):
     """k=2 index: glob tokens expand over the TOKEN vocab (tokens.txt) and
     compose into k-gram index terms — the OR-over-expansions semantics of

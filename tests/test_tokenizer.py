@@ -105,3 +105,18 @@ def test_trec_doc_end_to_end():
     assert analyze(doc) == [
         "ft911", "3", "contamin", "water", "suppli", "affect", "thousand",
         "refuge"]
+
+
+def test_script_content_cannot_rearm_ignore():
+    """Markup-looking text INSIDE an ignored <script>/<style> region must
+    not change tokenizer state: document.write("<style>") used to overwrite
+    ignore_until so the real </script> never matched and the rest of the
+    document vanished (round-2 review finding)."""
+    from tpu_ir.analysis.tag_tokenizer import tokenize
+
+    assert tokenize('<script> document.write("<style>"); </script> '
+                    'visible text here') == ["visible", "text", "here"]
+    # comments/PIs inside the ignored region must not swallow the end tag
+    assert tokenize("<script><!-- </lost --></script> shown") == ["shown"]
+    assert tokenize("<style>a <?pi </style> b?> ignored</style> ok") \
+        == ["ok"]

@@ -235,6 +235,14 @@ class TagTokenizer:
         c = text[pos + 1]
         if c == "/":
             return self._parse_end_tag(pos)
+        if self._ignore_until is not None:
+            # inside <style>/<script> only the matching end tag can change
+            # state: markup-looking content (document.write("<style>"))
+            # must not re-arm the ignore or start a comment/PI skip, else
+            # the real end tag never matches and the rest of the document
+            # silently drops
+            end = text.find(">", pos + 1)
+            return n if end < 0 else end
         if c == "!":
             return self._skip_comment(pos)
         if c == "?":

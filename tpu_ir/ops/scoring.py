@@ -185,7 +185,9 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
 
         def do_tier(s, in_tier=in_tier, tdocs=tdocs, ttfs=ttfs):
             r = jnp.where(in_tier, row, 0)
-            docs = tdocs[r]                                  # [B, L, P_t]
+            # tier arrays may arrive in slim (uint16) transport dtypes;
+            # cast once on device so index arithmetic is plain int32
+            docs = tdocs[r].astype(jnp.int32)                # [B, L, P_t]
             tfs = ttfs[r].astype(jnp.float32)
             w = cold_weight_fn(tfs, docs)
             mask = in_tier[..., None]

@@ -160,6 +160,7 @@ def build_tiered_layout(
     cold = np.nonzero((hot_rank < 0) & (df > 0))[0]
     tier_docs: list[np.ndarray] = []
     tier_tfs: list[np.ndarray] = []
+    max_tf = int(pair_tf.max(initial=0))
     if len(cold):
         caps = [base_cap]
         while caps[-1] < int(df[cold].max()):
@@ -178,8 +179,11 @@ def build_tiered_layout(
             tfs[rows, within] = pair_tf[src]
             tier_of[tids] = len(tier_docs)
             row_of[tids] = np.arange(len(tids), dtype=np.int32)
-            tier_docs.append(docs)
-            tier_tfs.append(tfs)
+            # slim dtypes cross the H2D link and sit in the serving cache;
+            # the jit programs cast/gather from any int dtype (the scatter
+            # sentinel num_docs+1 still fits: uint16 only when d+1 < 65536)
+            tier_docs.append(_slim(docs, d + 1))
+            tier_tfs.append(_slim(tfs, max_tf + 1))
     if not tier_docs:  # every term hot (or empty): keep one dummy tier
         tier_docs.append(np.zeros((1, 1), np.int32))
         tier_tfs.append(np.zeros((1, 1), np.int32))
@@ -190,89 +194,109 @@ def build_tiered_layout(
 
 
 # serving-cache format version; bump when the layout semantics change
-# (v2: hot strip cached as COO postings instead of the dense matrix)
-_CACHE_VERSION = 2
+# (v2: hot strip cached as COO postings instead of the dense matrix;
+#  v3: keyed by part-file CRCs — a cache HIT needs no shard read or CSR
+#  assembly at all — and df + rerank doc-norms ride in the cache)
+_CACHE_VERSION = 3
 
 
-def _cache_key(meta, pair_doc, pair_tf, df, hot_budget, base_cap,
-               growth) -> dict:
-    """Content-addressed key: CRCs over the actual postings columns, so an
-    in-place rebuild that changes tfs or doc assignments — even with every
-    df unchanged — misses the cache. ~1 s per GB, vs ~1 min to rebuild."""
+def _serving_cache_key(index_dir: str, meta, hot_budget, base_cap,
+                       growth) -> dict:
+    """Content-addressed key over the part FILES (streamed CRC32, ~1 s/GB
+    from page cache), so an in-place rebuild misses even when every df is
+    unchanged — without paying the shard-load + CSR assembly the old
+    column-CRC key required (~minutes at 250M pairs, the dominant warm-load
+    cost the cache exists to remove)."""
+    import os
     import zlib
 
-    def crc(a):
-        return zlib.crc32(np.ascontiguousarray(a).tobytes())
+    from ..index import format as fmt
 
+    files = []
+    for s in range(meta.num_shards):
+        path = os.path.join(index_dir, fmt.part_name(s))
+        crc = 0
+        with open(path, "rb") as f:
+            while chunk := f.read(1 << 22):
+                crc = zlib.crc32(chunk, crc)
+        files.append([fmt.part_name(s), os.path.getsize(path), crc])
     return {
         "version": _CACHE_VERSION,
         "num_docs": meta.num_docs,
         "vocab_size": meta.vocab_size,
         "num_pairs": meta.num_pairs,
-        "df_crc": crc(df),
-        "pair_doc_crc": crc(pair_doc),
-        "pair_tf_crc": crc(pair_tf),
+        "part_files": files,
         "hot_budget": hot_budget,
         "base_cap": base_cap,
         "growth": growth,
     }
 
 
-def load_or_build_tiered_layout(
+def load_serving_cache(
     index_dir: str,
-    pair_doc: np.ndarray,
-    pair_tf: np.ndarray,
-    df: np.ndarray,
     *,
     meta,
     hot_budget: int = HOT_BUDGET,
     base_cap: int = BASE_CAP,
     growth: int = GROWTH,
-) -> TieredPostings:
-    """Tiered layout with an on-disk serving cache.
+):
+    """Serving-cache hit: (TieredPostings, df, doc_norms) — every array
+    memory-mapped, NO shard IO — or None on any miss/corruption."""
+    import json
+    import os
 
-    Building the layout from the CSR columns costs ~1 min per 250M pairs on
-    one core, every process start. The built arrays are pure functions of
-    the postings + the layout constants, so they are persisted as .npy
-    files (one per array — memory-mapped on load, so a cache hit costs no
-    host RAM copies) under `index_dir/serving-tiered/`, keyed by CRCs of
-    the postings content. Cache writes are atomic (tmp dir + rename); a
-    failed write degrades to building in memory.
-    """
+    cache_dir = os.path.join(index_dir, "serving-tiered")
+    manifest = os.path.join(cache_dir, "manifest.json")
+    if not os.path.exists(manifest):
+        return None
+    try:
+        with open(manifest) as f:
+            m = json.load(f)
+        if m["key"] != _serving_cache_key(index_dir, meta, hot_budget,
+                                          base_cap, growth):
+            return None
+
+        def arr(name):
+            return np.load(os.path.join(cache_dir, name + ".npy"),
+                           mmap_mode="r")
+
+        tiers = TieredPostings(
+            arr("hot_rank"), arr("hot_rows"), arr("hot_docs"),
+            arr("hot_vals"), m["num_hot"], m["hot_width"],
+            arr("tier_of"), arr("row_of"),
+            tuple(arr(f"tier_docs_{i}") for i in range(m["num_tiers"])),
+            tuple(arr(f"tier_tfs_{i}") for i in range(m["num_tiers"])))
+        return tiers, arr("df"), arr("doc_norms")
+    except (OSError, KeyError, ValueError):
+        return None  # unreadable/stale cache: caller rebuilds
+
+
+def save_serving_cache(
+    index_dir: str,
+    tiers: TieredPostings,
+    df: np.ndarray,
+    doc_norms: np.ndarray,
+    *,
+    meta,
+    hot_budget: int = HOT_BUDGET,
+    base_cap: int = BASE_CAP,
+    growth: int = GROWTH,
+) -> None:
+    """Persist the serving arrays as .npy files under
+    `index_dir/serving-tiered/` (atomic tmp-dir + rename; a failed write
+    just leaves the in-memory build in charge)."""
     import json
     import os
     import shutil
     import tempfile
 
     cache_dir = os.path.join(index_dir, "serving-tiered")
-    manifest = os.path.join(cache_dir, "manifest.json")
-    key = _cache_key(meta, pair_doc, pair_tf, df, hot_budget, base_cap,
-                     growth)
-
-    if os.path.exists(manifest):
-        try:
-            with open(manifest) as f:
-                m = json.load(f)
-            if m["key"] == key:
-                def arr(name):
-                    return np.load(os.path.join(cache_dir, name + ".npy"),
-                                   mmap_mode="r")
-                return TieredPostings(
-                    arr("hot_rank"), arr("hot_rows"), arr("hot_docs"),
-                    arr("hot_vals"), m["num_hot"], m["hot_width"],
-                    arr("tier_of"), arr("row_of"),
-                    tuple(arr(f"tier_docs_{i}")
-                          for i in range(m["num_tiers"])),
-                    tuple(arr(f"tier_tfs_{i}")
-                          for i in range(m["num_tiers"])))
-        except (OSError, KeyError, ValueError):
-            pass  # unreadable/stale cache: rebuild below
-
-    tiers = build_tiered_layout(pair_doc, pair_tf, df, num_docs=meta.num_docs,
-                                hot_budget=hot_budget, base_cap=base_cap,
-                                growth=growth)
     tmp = None
     try:
+        # key computation reads every part file; a vanished/unreadable one
+        # must degrade like any other failed write, not crash the caller
+        key = _serving_cache_key(index_dir, meta, hot_budget, base_cap,
+                                 growth)
         tmp = tempfile.mkdtemp(dir=index_dir, prefix=".serving-tiered-")
         np.save(os.path.join(tmp, "hot_rank.npy"), tiers.hot_rank)
         np.save(os.path.join(tmp, "hot_rows.npy"), tiers.hot_rows)
@@ -280,6 +304,9 @@ def load_or_build_tiered_layout(
         np.save(os.path.join(tmp, "hot_vals.npy"), tiers.hot_vals)
         np.save(os.path.join(tmp, "tier_of.npy"), tiers.tier_of)
         np.save(os.path.join(tmp, "row_of.npy"), tiers.row_of)
+        np.save(os.path.join(tmp, "df.npy"), np.asarray(df, np.int32))
+        np.save(os.path.join(tmp, "doc_norms.npy"),
+                np.asarray(doc_norms, np.float32))
         for i, (d, t) in enumerate(zip(tiers.tier_docs, tiers.tier_tfs)):
             np.save(os.path.join(tmp, f"tier_docs_{i}.npy"), d)
             np.save(os.path.join(tmp, f"tier_tfs_{i}.npy"), t)
@@ -292,4 +319,3 @@ def load_or_build_tiered_layout(
     except OSError:
         if tmp is not None:
             shutil.rmtree(tmp, ignore_errors=True)
-    return tiers
