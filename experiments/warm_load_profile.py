@@ -1,0 +1,68 @@
+"""Phase-level timing of the serving-cache warm load (fresh process).
+
+Usage: python experiments/warm_load_profile.py INDEX_DIR
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def main(index_dir: str) -> None:
+    t0 = time.perf_counter()
+
+    def mark(label):
+        nonlocal t0
+        t = time.perf_counter()
+        print(f"{label:28s} {t - t0:8.2f}s", flush=True)
+        t0 = t
+
+    import jax
+
+    print("devices:", jax.devices(), flush=True)
+    mark("jax init")
+
+    from tpu_ir.collection import DocnoMapping, Vocab
+    from tpu_ir.index import format as fmt
+    from tpu_ir.search.layout import load_serving_cache
+    from tpu_ir.search.scorer import Scorer
+
+    mark("imports")
+
+    meta = fmt.IndexMetadata.load(index_dir)
+    mark("metadata")
+    vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
+    mark("vocab")
+    mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
+    mark("docnos")
+    doc_len = np.load(os.path.join(index_dir, fmt.DOCLEN))
+    mark("doclen")
+
+    cached = load_serving_cache(index_dir, meta=meta)
+    assert cached is not None, "no cache hit!"
+    tiers, df, norms = cached
+    mark("cache key + mmap")
+
+    s = Scorer(vocab=vocab, mapping=mapping, df=np.asarray(df),
+               doc_len=doc_len, meta=meta, layout="sparse",
+               index_dir=index_dir, tiers=tiers,
+               doc_norms=np.asarray(norms))
+    mark("Scorer.__init__ (dispatch)")
+    jax.block_until_ready([s.df, s.doc_len, s.hot_rank, s.hot_tfs,
+                           s.tier_of, s.row_of, s.tier_docs, s.tier_tfs])
+    mark("device uploads complete")
+
+    # end-to-end sanity: Scorer.load in-process (second call re-CRCs)
+    t0 = time.perf_counter()
+    s2 = Scorer.load(index_dir, layout="sparse")
+    jax.block_until_ready([s2.hot_tfs, s2.tier_docs])
+    mark("full Scorer.load (again)")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1])
