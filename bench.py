@@ -480,6 +480,14 @@ def main() -> int:
         build_s = min(runs)
         docs_per_sec = DOC_COUNT / build_s
 
+        # post-build verification gate (VERDICT r1 item 5): the vectorized
+        # structural check must hold — and stay fast — at every bench scale
+        from tpu_ir.index.verify import verify_index
+
+        t0 = time.perf_counter()
+        verify_index(index_dir)  # AssertionError fails the bench loudly
+        verify_s = time.perf_counter() - t0
+
         # cold load: builds the serving-tiered disk cache (tiered corpora);
         # warm load: a REAL process restart against the populated cache —
         # the steady-state serving cold start (VERDICT r1 item 3's metric),
@@ -541,6 +549,7 @@ def main() -> int:
         "query_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
         "scorer_load_cold_s": round(load_cold_s, 2),
         "scorer_load_warm_s": round(load_warm_s, 2),
+        "verify_s": round(verify_s, 2),
         "recall_at_10": recall,
         "backend": backend,
         "config": args.config,
