@@ -223,3 +223,20 @@ def test_dictionary_detects_tamper(setup, tmp_path):
     open(path, "w").write("\n".join(lines) + "\n")
     with pytest.raises(AssertionError):
         Dictionary(str(bad)).get_value(t0)
+
+
+def test_warm_prebuilds_serving_cache(setup, capsys, tmp_path):
+    """tpu-ir warm: one deploy-time load persists the serving cache; the
+    second load inside the command must already take the fast path."""
+    corpus = tmp_path / "c.trec"
+    corpus.write_text(
+        "<DOC>\n<DOCNO> A-1 </DOCNO>\n<TEXT>\nsalmon river fishing\n"
+        "</TEXT>\n</DOC>\n"
+        "<DOC>\n<DOCNO> A-2 </DOCNO>\n<TEXT>\ntrout river\n</TEXT>\n</DOC>\n")
+    idx = str(tmp_path / "idx")
+    assert main(["index", str(corpus), idx, "--no-chargrams"]) == 0
+    assert main(["warm", idx]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["cache_written"] is True
+    assert out["warm_skips_shards"] is True
+    assert os.path.isdir(os.path.join(idx, "serving-tiered"))

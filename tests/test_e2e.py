@@ -607,3 +607,21 @@ def test_wildcard_search_kgram_index(tmp_path_factory):
 
     # no-match pattern composes no grams -> no results
     assert scorer.search("zzz* fishing") == []
+
+
+def test_truncated_cache_array_recovers(tmp_path):
+    """A truncated serving-cache .npy (torn write, disk-full) must degrade
+    to a rebuild, not crash the load."""
+    from tpu_ir.index import build_index as bi
+
+    corpus = corpus_file(tmp_path)
+    idx = str(tmp_path / "idx")
+    bi([str(corpus)], idx, k=1, num_shards=3, compute_chargrams=False)
+    want = Scorer.load(idx, layout="sparse").search("salmon fishing")
+
+    cache = os.path.join(idx, "serving-tiered")
+    path = os.path.join(cache, "tier_tfs_0.npy")
+    with open(path, "r+b") as f:
+        f.truncate(16)  # inside the npy header
+    got = Scorer.load(idx, layout="sparse").search("salmon fishing")
+    assert got == want  # rebuilt from shards, identical results
