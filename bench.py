@@ -468,7 +468,10 @@ def main() -> int:
             one_build(warm_dir)
             shutil.rmtree(warm_dir)
         runs = []
-        n_runs = 1 if streaming else 3
+        # best-of-N: the tunnel's noise floor moves by whole seconds day to
+        # day; five ref-scale builds cost ~20 s total and give the minimum
+        # a fair shot at the steady-state number
+        n_runs = 1 if streaming else 5
         for r in range(n_runs):
             out = index_dir if r == n_runs - 1 else os.path.join(
                 tmp, f"index-run{r}")

@@ -625,3 +625,25 @@ def test_truncated_cache_array_recovers(tmp_path):
         f.truncate(16)  # inside the npy header
     got = Scorer.load(idx, layout="sparse").search("salmon fishing")
     assert got == want  # rebuilt from shards, identical results
+
+
+def test_readonly_index_dir_serves_without_cache(tmp_path, monkeypatch):
+    """On an unwritable index dir (serving_cache_writable False — chmod
+    can't simulate it under root, so the probe is patched) the load must
+    skip the eager norms pass and the cache write — not silently repay
+    them every restart — and still serve all scorers, rerank included
+    (lazy norms)."""
+    import tpu_ir.search.scorer as scorer_mod
+
+    from tpu_ir.index import build_index as bi
+
+    corpus = corpus_file(tmp_path)
+    idx = str(tmp_path / "idx")
+    bi([str(corpus)], idx, k=1, num_shards=3, compute_chargrams=False)
+    monkeypatch.setattr("tpu_ir.search.layout.serving_cache_writable",
+                        lambda d: False)
+    s = scorer_mod.Scorer.load(idx, layout="sparse")
+    assert s._norms_np is None  # eager pass skipped
+    assert not os.path.isdir(os.path.join(idx, "serving-tiered"))
+    assert s.search("salmon fishing")
+    assert s.search_batch(["salmon fishing"], rerank=5)[0]

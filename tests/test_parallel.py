@@ -240,3 +240,49 @@ def test_sharded_scorer_small_corpus(tmp_path):
     r1 = dense.search_batch(["alpha beta"], rerank=4)[0]
     r2 = sharded.search_batch(["alpha beta"], rerank=4)[0]
     assert {d for d, _ in r1} == {d for d, _ in r2}
+
+
+def test_sharded_serving_cache_fast_path(tmp_path, monkeypatch):
+    """Distributed serving gets the same zero-shard-IO warm load as the
+    single-device tiered layout: a sharded cache hit must serve TF-IDF,
+    BM25 and rerank identically with load_shard forbidden."""
+    import os
+
+    from tpu_ir.index import build_index
+    from tpu_ir.index import format as fmt
+    from tpu_ir.search import Scorer
+
+    rng = np.random.default_rng(3)
+    words = ["w%03d" % i for i in range(80)]
+    corpus = tmp_path / "c.trec"
+    with open(corpus, "w") as f:
+        for i in range(60):
+            body = " ".join(rng.choice(words, 25))
+            f.write(f"<DOC>\n<DOCNO> D-{i:03d} </DOCNO>\n<TEXT>\n{body}\n"
+                    f"</TEXT>\n</DOC>\n")
+    idx = str(tmp_path / "idx")
+    build_index([str(corpus)], idx, k=1, chargram_ks=[],
+                compute_chargrams=False)
+
+    cold = Scorer.load(idx, layout="sharded")
+    queries = ["w001 w005", "w010 w020"]
+    want = {
+        ("tfidf", None): cold.search_batch(queries, scoring="tfidf"),
+        ("bm25", None): cold.search_batch(queries, scoring="bm25"),
+        ("bm25", 7): cold.search_batch(queries, rerank=7),
+    }
+    assert os.path.isdir(os.path.join(
+        idx, f"serving-sharded-{len(jax.devices())}"))
+
+    def boom(*a, **k):
+        raise AssertionError("sharded cache hit must not touch shards")
+
+    monkeypatch.setattr(fmt, "load_shard", boom)
+    warm = Scorer.load(idx, layout="sharded")
+    assert warm._pairs_cols is None
+    for (scoring, rr), expect in want.items():
+        got = warm.search_batch(queries, scoring=scoring, rerank=rr)
+        for g, e in zip(got, expect):
+            assert [d for d, _ in g] == [d for d, _ in e], (scoring, rr)
+            np.testing.assert_allclose([s for _, s in g],
+                                       [s for _, s in e], rtol=1e-5)

@@ -220,16 +220,27 @@ def cmd_warm(args) -> int:
     _apply_backend(args)
     from .search import Scorer
 
+    start_wall = time.time()
     t0 = time.perf_counter()
     scorer = Scorer.load(args.index_dir, layout=args.layout)
     build_s = time.perf_counter() - t0
-    cached = os.path.isdir(os.path.join(args.index_dir, "serving-tiered"))
+    if scorer.layout == "sharded":
+        import jax
+
+        cache_name = f"serving-sharded-{len(jax.devices())}"
+    else:
+        cache_name = "serving-tiered"  # dense layouts have no cache
+    cache_dir = os.path.join(args.index_dir, cache_name)
+    mtime = os.path.getmtime(cache_dir) if os.path.isdir(cache_dir) else 0
     t0 = time.perf_counter()
     warm = Scorer.load(args.index_dir, layout=args.layout)
     warm_s = time.perf_counter() - t0
     print(json.dumps({
         "layout": scorer.layout,
-        "cache_written": cached and scorer.layout == "sparse",
+        "cache_dir": cache_name,
+        # written BY THIS RUN (dir mtime after this command started), not
+        # merely present from an earlier warm
+        "cache_written": mtime >= start_wall - 1.0,
         "cold_load_s": round(build_s, 2),
         "warm_load_s": round(warm_s, 2),
         "warm_skips_shards": warm._pairs_cols is None,
@@ -403,7 +414,7 @@ def main(argv: list[str] | None = None) -> int:
                                      "layout + df + rerank norms) so later "
                                      "process starts take the fast path")
     pw.add_argument("index_dir")
-    pw.add_argument("--layout", choices=["auto", "dense", "sparse"],
+    pw.add_argument("--layout", choices=["auto", "dense", "sparse", "sharded"],
                     default="sparse")
     _add_backend_arg(pw)
     pw.set_defaults(fn=cmd_warm)

@@ -151,6 +151,75 @@ def make_sharded_tiered(
         tuple(tier_docs), tuple(tier_tfs), dl, doc_base, dblk)
 
 
+def _sharded_cache_key(index_dir: str, meta, num_shards: int) -> dict:
+    from ..search.layout import _serving_cache_key
+
+    return dict(_serving_cache_key(index_dir, meta,
+                                   HOT_BUDGET, BASE_CAP, GROWTH),
+                kind="sharded", num_shards=num_shards)
+
+
+def load_sharded_serving_cache(index_dir: str, *, meta, num_shards: int):
+    """Sharded-serving-cache hit: (ShardedTieredLayout, df, doc_norms) with
+    NO shard IO — or None on any miss. Per shard count
+    (`serving-sharded-N/`): a different mesh size needs different doc
+    blocks. The stacked hot strip is stored as COO (a dense [S, H, dblk+1]
+    f32 strip is ~2 GB of mostly zeros at 1M docs) and densified here on
+    host — the same bytes-on-disk reasoning as the single-device cache's
+    v2 format (search/layout.py)."""
+    from ..search.layout import read_cache_manifest
+
+    try:
+        hit = read_cache_manifest(
+            index_dir, f"serving-sharded-{num_shards}",
+            _sharded_cache_key(index_dir, meta, num_shards))
+        if hit is None:
+            return None
+        m, arr = hit
+        hot_tfs = np.zeros(tuple(m["hot_shape"]), np.float32)
+        hot_tfs.reshape(-1)[np.asarray(arr("hot_flat_idx"))] = \
+            arr("hot_vals")
+        lay = ShardedTieredLayout(
+            arr("hot_rank"), hot_tfs, arr("tier_of"), arr("row_of"),
+            tuple(arr(f"tier_docs_{i}") for i in range(m["num_tiers"])),
+            tuple(arr(f"tier_tfs_{i}") for i in range(m["num_tiers"])),
+            arr("doc_len"), arr("doc_base"), m["dblk"])
+        return lay, arr("df"), arr("doc_norms")
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def save_sharded_serving_cache(index_dir: str, lay: ShardedTieredLayout,
+                               df: np.ndarray, doc_norms: np.ndarray, *,
+                               meta, num_shards: int) -> None:
+    """Persist via the shared atomic cache protocol
+    (search/layout.py::write_cache_atomic); any failure leaves the
+    in-memory layout in charge."""
+    from ..search.layout import _slim, write_cache_atomic
+
+    hot = np.asarray(lay.hot_tfs)
+    flat_idx = np.flatnonzero(hot.reshape(-1))
+    arrays = {
+        "hot_rank": lay.hot_rank,
+        "hot_flat_idx": flat_idx,
+        "hot_vals": _slim(hot.reshape(-1)[flat_idx].astype(np.int64),
+                          int(hot.max(initial=0)) + 1),
+        "tier_of": lay.tier_of, "row_of": lay.row_of,
+        "doc_len": lay.doc_len, "doc_base": lay.doc_base,
+        "df": np.asarray(df, np.int32),
+        "doc_norms": np.asarray(doc_norms, np.float32),
+    }
+    for i, (d, t) in enumerate(zip(lay.tier_docs, lay.tier_tfs)):
+        arrays[f"tier_docs_{i}"] = d
+        arrays[f"tier_tfs_{i}"] = t
+    write_cache_atomic(
+        index_dir, f"serving-sharded-{num_shards}", arrays,
+        lambda: {"key": _sharded_cache_key(index_dir, meta, num_shards),
+                 "num_tiers": len(lay.tier_docs),
+                 "hot_shape": list(np.asarray(lay.hot_tfs).shape),
+                 "dblk": lay.dblk})
+
+
 def put_sharded(layout: ShardedTieredLayout, mesh) -> ShardedTieredLayout:
     """Move a host layout to the mesh: every array sharded on its leading
     axis (one shard slice per device)."""

@@ -232,6 +232,68 @@ def _serving_cache_key(index_dir: str, meta, hot_budget, base_cap,
     }
 
 
+def serving_cache_writable(index_dir: str) -> bool:
+    """Whether a serving-cache save can possibly succeed — callers skip
+    eager cache-only work (the norms pass) on read-only index dirs, where
+    every process restart would otherwise repay it for a save that
+    silently fails."""
+    import os
+
+    return os.access(index_dir, os.W_OK)
+
+
+def read_cache_manifest(index_dir: str, cache_name: str, key: dict):
+    """(manifest dict, arr loader) on a key match, else None. The shared
+    half of the cache protocol: both the tiered and the sharded serving
+    caches (parallel/sharded_tiered.py) speak exactly this format, so
+    version/manifest changes live in one place."""
+    import json
+    import os
+
+    cache_dir = os.path.join(index_dir, cache_name)
+    manifest = os.path.join(cache_dir, "manifest.json")
+    if not os.path.exists(manifest):
+        return None
+    with open(manifest) as f:
+        m = json.load(f)
+    if m["key"] != key:
+        return None
+
+    def arr(name):
+        return np.load(os.path.join(cache_dir, name + ".npy"),
+                       mmap_mode="r")
+
+    return m, arr
+
+
+def write_cache_atomic(index_dir: str, cache_name: str,
+                       arrays: dict, manifest: dict) -> None:
+    """Atomic cache persist (tmp dir + rename): write every array as .npy
+    plus manifest.json, then swap the directory in. Any OSError — from key
+    computation IO included if the caller defers it into `manifest` via a
+    callable — degrades to no cache, never an exception."""
+    import json
+    import os
+    import shutil
+    import tempfile
+
+    cache_dir = os.path.join(index_dir, cache_name)
+    tmp = None
+    try:
+        if callable(manifest):
+            manifest = manifest()
+        tmp = tempfile.mkdtemp(dir=index_dir, prefix=f".{cache_name}-")
+        for name, a in arrays.items():
+            np.save(os.path.join(tmp, name + ".npy"), np.asarray(a))
+        with open(os.path.join(tmp, "manifest.json"), "w") as f:
+            json.dump(manifest, f)
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        os.replace(tmp, cache_dir)
+    except OSError:
+        if tmp is not None:
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
 def load_serving_cache(
     index_dir: str,
     *,
@@ -242,24 +304,14 @@ def load_serving_cache(
 ):
     """Serving-cache hit: (TieredPostings, df, doc_norms) — every array
     memory-mapped, NO shard IO — or None on any miss/corruption."""
-    import json
-    import os
-
-    cache_dir = os.path.join(index_dir, "serving-tiered")
-    manifest = os.path.join(cache_dir, "manifest.json")
-    if not os.path.exists(manifest):
-        return None
     try:
-        with open(manifest) as f:
-            m = json.load(f)
-        if m["key"] != _serving_cache_key(index_dir, meta, hot_budget,
-                                          base_cap, growth):
+        hit = read_cache_manifest(
+            index_dir, "serving-tiered",
+            _serving_cache_key(index_dir, meta, hot_budget, base_cap,
+                               growth))
+        if hit is None:
             return None
-
-        def arr(name):
-            return np.load(os.path.join(cache_dir, name + ".npy"),
-                           mmap_mode="r")
-
+        m, arr = hit
         tiers = TieredPostings(
             arr("hot_rank"), arr("hot_rows"), arr("hot_docs"),
             arr("hot_vals"), m["num_hot"], m["hot_width"],
@@ -282,40 +334,23 @@ def save_serving_cache(
     base_cap: int = BASE_CAP,
     growth: int = GROWTH,
 ) -> None:
-    """Persist the serving arrays as .npy files under
-    `index_dir/serving-tiered/` (atomic tmp-dir + rename; a failed write
-    just leaves the in-memory build in charge)."""
-    import json
-    import os
-    import shutil
-    import tempfile
-
-    cache_dir = os.path.join(index_dir, "serving-tiered")
-    tmp = None
-    try:
-        # key computation reads every part file; a vanished/unreadable one
-        # must degrade like any other failed write, not crash the caller
-        key = _serving_cache_key(index_dir, meta, hot_budget, base_cap,
-                                 growth)
-        tmp = tempfile.mkdtemp(dir=index_dir, prefix=".serving-tiered-")
-        np.save(os.path.join(tmp, "hot_rank.npy"), tiers.hot_rank)
-        np.save(os.path.join(tmp, "hot_rows.npy"), tiers.hot_rows)
-        np.save(os.path.join(tmp, "hot_docs.npy"), tiers.hot_docs)
-        np.save(os.path.join(tmp, "hot_vals.npy"), tiers.hot_vals)
-        np.save(os.path.join(tmp, "tier_of.npy"), tiers.tier_of)
-        np.save(os.path.join(tmp, "row_of.npy"), tiers.row_of)
-        np.save(os.path.join(tmp, "df.npy"), np.asarray(df, np.int32))
-        np.save(os.path.join(tmp, "doc_norms.npy"),
-                np.asarray(doc_norms, np.float32))
-        for i, (d, t) in enumerate(zip(tiers.tier_docs, tiers.tier_tfs)):
-            np.save(os.path.join(tmp, f"tier_docs_{i}.npy"), d)
-            np.save(os.path.join(tmp, f"tier_tfs_{i}.npy"), t)
-        with open(os.path.join(tmp, "manifest.json"), "w") as f:
-            json.dump({"key": key, "num_tiers": len(tiers.tier_docs),
-                       "num_hot": tiers.num_hot,
-                       "hot_width": tiers.hot_width}, f)
-        shutil.rmtree(cache_dir, ignore_errors=True)
-        os.replace(tmp, cache_dir)
-    except OSError:
-        if tmp is not None:
-            shutil.rmtree(tmp, ignore_errors=True)
+    """Persist the serving arrays under `index_dir/serving-tiered/`."""
+    arrays = {
+        "hot_rank": tiers.hot_rank, "hot_rows": tiers.hot_rows,
+        "hot_docs": tiers.hot_docs, "hot_vals": tiers.hot_vals,
+        "tier_of": tiers.tier_of, "row_of": tiers.row_of,
+        "df": np.asarray(df, np.int32),
+        "doc_norms": np.asarray(doc_norms, np.float32),
+    }
+    for i, (d, t) in enumerate(zip(tiers.tier_docs, tiers.tier_tfs)):
+        arrays[f"tier_docs_{i}"] = d
+        arrays[f"tier_tfs_{i}"] = t
+    # key computation reads every part file; a vanished/unreadable one
+    # must degrade like any other failed write (deferred via callable)
+    write_cache_atomic(
+        index_dir, "serving-tiered", arrays,
+        lambda: {"key": _serving_cache_key(index_dir, meta, hot_budget,
+                                           base_cap, growth),
+                 "num_tiers": len(tiers.tier_docs),
+                 "num_hot": tiers.num_hot,
+                 "hot_width": tiers.hot_width})

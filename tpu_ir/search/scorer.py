@@ -95,6 +95,7 @@ class Scorer:
         tiers=None,
         doc_norms: np.ndarray | None = None,
         pairs_loader=None,
+        sharded_layout=None,
     ):
         """`pair_*` may be omitted on the tiered path when prebuilt `tiers`
         (+ cached `doc_norms`) are supplied — the serving-cache fast path;
@@ -127,10 +128,11 @@ class Scorer:
                              "'auto', 'dense', 'sparse' or 'sharded'")
         self.layout = layout
         self._tf_matrix = None  # built lazily on first BM25 call
-        if layout in ("dense", "sharded") and self._pairs_cols is None:
+        if self._pairs_cols is None and (
+                layout == "dense"
+                or (layout == "sharded" and sharded_layout is None)):
             raise ValueError(f"layout {layout!r} needs the postings "
-                             "columns; only the tiered path can run from "
-                             "prebuilt serving arrays")
+                             "columns or a prebuilt serving layout")
         if layout == "dense":
             self.doc_matrix = dense_doc_matrix(
                 jnp.asarray(pair_term), jnp.asarray(pair_doc),
@@ -147,9 +149,11 @@ class Scorer:
 
             n_dev = len(jax.devices())
             self._mesh = make_mesh(n_dev)
-            lay = make_sharded_tiered(
-                pair_term, pair_doc, pair_tf, np.asarray(df),
-                np.asarray(doc_len), num_docs=d, num_shards=n_dev)
+            lay = sharded_layout
+            if lay is None:
+                lay = make_sharded_tiered(
+                    pair_term, pair_doc, pair_tf, np.asarray(df),
+                    np.asarray(doc_len), num_docs=d, num_shards=n_dev)
             self._sharded = put_sharded(lay, self._mesh)
             self._sharded_norm = None  # built lazily for rerank
         else:
@@ -212,28 +216,76 @@ class Scorer:
                     doc_norms=np.asarray(norms),
                     pairs_loader=lambda: cls._assemble_csr(
                         index_dir, meta)[1])
+        elif resolved == "sharded":
+            # same fast path for distributed serving, per mesh size
+            import jax
+
+            from ..parallel.sharded_tiered import load_sharded_serving_cache
+
+            n_dev = len(jax.devices())
+            cached = load_sharded_serving_cache(index_dir, meta=meta,
+                                                num_shards=n_dev)
+            if cached is not None:
+                lay, df, norms = cached
+                return cls(
+                    vocab=vocab, mapping=mapping,
+                    df=np.asarray(df), doc_len=doc_len, meta=meta,
+                    layout="sharded", compat_int_idf=compat_int_idf,
+                    index_dir=index_dir, sharded_layout=lay,
+                    doc_norms=np.asarray(norms),
+                    pairs_loader=lambda: cls._assemble_csr(
+                        index_dir, meta)[1])
 
         df, (pair_term, pair_doc, pair_tf) = cls._assemble_csr(
             index_dir, meta)
         tiers = norms = None
-        if resolved == "sparse":
-            # cache miss: build + persist here in load(), where the arrays
-            # provably came from the index files the cache key CRCs — a
-            # direct-constructed Scorer (caller-supplied arrays) never
-            # writes the cache, so it cannot poison later loads
+        sharded_layout = None
+        # cache miss: build + persist here in load(), where the arrays
+        # provably came from the index files the cache key CRCs — a
+        # direct-constructed Scorer (caller-supplied arrays) never writes
+        # the cache, so it cannot poison later loads. The norms pass (a
+        # full sweep over the postings) is eager ONLY for the cache write;
+        # on a read-only index dir both are skipped and norms stay lazy
+        # (rerank-only), instead of repaying the pass every restart for a
+        # save that silently fails.
+        from .layout import serving_cache_writable
+
+        save_cache = serving_cache_writable(index_dir)
+        if resolved == "sharded":
+            import jax
+
+            from ..parallel.sharded_tiered import (
+                make_sharded_tiered,
+                save_sharded_serving_cache,
+            )
+
+            n_dev = len(jax.devices())
+            sharded_layout = make_sharded_tiered(
+                pair_term, pair_doc, pair_tf, np.asarray(df),
+                np.asarray(doc_len), num_docs=meta.num_docs,
+                num_shards=n_dev)
+            if save_cache:
+                norms = compute_doc_norms(pair_term, pair_doc, pair_tf,
+                                          df, meta.num_docs)
+                save_sharded_serving_cache(index_dir, sharded_layout, df,
+                                           norms, meta=meta,
+                                           num_shards=n_dev)
+        elif resolved == "sparse":
             from .layout import save_serving_cache
 
             tiers = build_tiered_layout(pair_doc, pair_tf, df,
                                         num_docs=meta.num_docs)
-            norms = compute_doc_norms(pair_term, pair_doc, pair_tf, df,
-                                      meta.num_docs)
-            save_serving_cache(index_dir, tiers, df, norms, meta=meta)
+            if save_cache:
+                norms = compute_doc_norms(pair_term, pair_doc, pair_tf,
+                                          df, meta.num_docs)
+                save_serving_cache(index_dir, tiers, df, norms, meta=meta)
         return cls(
             vocab=vocab, mapping=mapping,
             pair_term=pair_term, pair_doc=pair_doc,
             pair_tf=pair_tf, df=df, doc_len=doc_len, meta=meta,
             layout=layout, compat_int_idf=compat_int_idf,
-            index_dir=index_dir, tiers=tiers, doc_norms=norms)
+            index_dir=index_dir, tiers=tiers, doc_norms=norms,
+            sharded_layout=sharded_layout)
 
     @staticmethod
     def _assemble_csr(index_dir: str, meta):
