@@ -195,3 +195,31 @@ def test_tag_spans_recorded():
     t3.tokenize("</nope>w<" + "x" * 300 + ">y</" + "x" * 300 + ">")
     assert [g.name[:2] for g in t3.tags] == ["xx"]
     assert len(t3.tags[0].name.encode("utf-8")) < 256
+
+
+def test_stream_parsers_malformed_input():
+    """Truncated/malformed streams must end cleanly (None / partial), like
+    the reference's readLine-until-EOF loops, never raise."""
+    from tpu_ir.collection import TrecTextParser, TrecWebParser
+
+    # empty and garbage streams -> no documents
+    assert list(TrecTextParser("")) == []
+    assert list(TrecWebParser("no trec here\njust text\n")) == []
+    # truncated mid-record: TrecText yields the partial doc (reference
+    # breaks out of the section loop at EOF and returns the buffer)
+    docs = list(TrecTextParser(
+        "<DOC>\n<DOCNO> X-1 </DOCNO>\n<TEXT>\ncut off"))
+    assert [d.identifier for d in docs] == ["X-1"]
+    assert "cut off" in docs[0].text
+    # web record missing its DOCHDR -> stream ends with no document
+    assert list(TrecWebParser("<DOC>\n<DOCNO> X-2 </DOCNO>\nbody\n</DOC>\n")) == []
+    # DOCNO line split across lines (never closed) -> identifier is the
+    # accumulated text up to EOF, no crash
+    docs = list(TrecTextParser("<DOC>\n<DOCNO>\nX-3\n"))
+    assert len(docs) == 1 and "X-3" in docs[0].identifier
+    # bare '#' URL must not crash scrub_url (the reference's charAt(-1)
+    # style would); empty URL line is tolerated
+    assert TrecWebParser.scrub_url("#") == ""
+    docs = list(TrecWebParser(
+        "<DOC>\n<DOCNO> X-4 </DOCNO>\n<DOCHDR>\n\n</DOCHDR>\nb\n</DOC>\n"))
+    assert docs[0].metadata["url"] == ""
