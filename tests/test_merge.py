@@ -1,0 +1,134 @@
+"""Index merge: N indexes -> the union index, byte-identical to one build
+over the concatenated corpus (the determinism contract of the format:
+docnos = sorted-docid ranks, term ids = sorted-vocab ranks, postings in
+(term asc, tf desc, doc asc))."""
+
+import filecmp
+import os
+
+import numpy as np
+import pytest
+
+from tpu_ir.index import build_index
+from tpu_ir.index import format as fmt
+from tpu_ir.index.merge import merge_indexes
+from tpu_ir.index.verify import verify_index
+from tpu_ir.search import Scorer
+
+DOCS_A = {
+    "AP-0001": "The quick brown fox jumps over the lazy dog.",
+    "AP-0002": "A quick quick quick fox. The dog sleeps soundly.",
+    "ZF-077": "Honey prices rose as bears raided apiaries near the river.",
+}
+DOCS_B = {
+    "FT-0003": "Stock markets fell sharply as investors fled risky assets.",
+    "WSJ-9.2": "Salmon fishing season opened; fishermen crowded the rivers.",
+    "AP-0010": "Brown bears eat honey. Bears love rivers and salmon fishing.",
+}
+
+
+def write_corpus(path, docs):
+    path.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in docs.items()))
+    return str(path)
+
+
+def artifact_names(index_dir):
+    return sorted(
+        n for n in os.listdir(index_dir)
+        if not n.startswith(".") and n != fmt.JOBS_DIR
+        and not n.startswith("serving-"))
+
+
+@pytest.mark.parametrize("k,chargrams", [(1, [2, 3]), (2, [2])])
+def test_merge_equals_direct_build(tmp_path, k, chargrams):
+    ca = write_corpus(tmp_path / "a.trec", DOCS_A)
+    cb = write_corpus(tmp_path / "b.trec", DOCS_B)
+    cboth = write_corpus(tmp_path / "both.trec", {**DOCS_A, **DOCS_B})
+
+    ia, ib = str(tmp_path / "ia"), str(tmp_path / "ib")
+    build_index([ca], ia, k=k, chargram_ks=chargrams, num_shards=3)
+    build_index([cb], ib, k=k, chargram_ks=chargrams, num_shards=3)
+    direct = str(tmp_path / "direct")
+    build_index([cboth], direct, k=k, chargram_ks=chargrams, num_shards=4)
+
+    merged = str(tmp_path / "merged")
+    meta = merge_indexes([ia, ib], merged, num_shards=4)
+    assert meta.num_docs == len(DOCS_A) + len(DOCS_B)
+    assert verify_index(merged)["ok"]
+
+    # every artifact byte-identical to the one-shot build
+    names = artifact_names(direct)
+    assert artifact_names(merged) == names
+    for n in names:
+        assert filecmp.cmp(os.path.join(direct, n),
+                           os.path.join(merged, n), shallow=False), n
+
+    # and searching the merged index equals searching the direct one
+    s1, s2 = Scorer.load(direct), Scorer.load(merged)
+    for q in ["quick fox", "salmon fishing", "honey bears river"]:
+        assert s1.search(q) == s2.search(q), q
+
+
+def test_merge_rejects_bad_inputs(tmp_path):
+    ca = write_corpus(tmp_path / "a.trec", DOCS_A)
+    ia = str(tmp_path / "ia")
+    build_index([ca], ia, k=1, num_shards=2, compute_chargrams=False)
+
+    # overlapping docids
+    with pytest.raises(ValueError, match="share docids"):
+        merge_indexes([ia, ia], str(tmp_path / "dup"))
+
+    # k mismatch
+    ib = str(tmp_path / "ib2")
+    cb = write_corpus(tmp_path / "b.trec", DOCS_B)
+    build_index([cb], ib, k=2, num_shards=2, compute_chargrams=False)
+    with pytest.raises(ValueError, match="different k"):
+        merge_indexes([ia, ib], str(tmp_path / "mixk"))
+
+
+def test_merge_single_source_resharding(tmp_path):
+    """Merging one index is a reshard: same corpus, new shard count,
+    same retrieval results."""
+    ca = write_corpus(tmp_path / "a.trec", DOCS_A)
+    ia = str(tmp_path / "ia")
+    build_index([ca], ia, k=1, num_shards=5, compute_chargrams=False)
+    out = str(tmp_path / "resharded")
+    meta = merge_indexes([ia], out, num_shards=2,
+                         compute_chargrams=False)
+    assert meta.num_shards == 2
+    assert verify_index(out)["ok"]
+    s1, s2 = Scorer.load(ia), Scorer.load(out)
+    assert s1.search("quick fox") == s2.search("quick fox")
+
+
+def test_merge_guards(tmp_path):
+    """Stale-output, source-as-output and missing-tokens.txt guards."""
+    ca = write_corpus(tmp_path / "a.trec", DOCS_A)
+    cb = write_corpus(tmp_path / "b.trec", DOCS_B)
+    ia, ib = str(tmp_path / "ia"), str(tmp_path / "ib")
+    build_index([ca], ia, k=1, num_shards=2, compute_chargrams=False)
+    build_index([cb], ib, k=1, num_shards=2, compute_chargrams=False)
+
+    out = str(tmp_path / "out")
+    m1 = merge_indexes([ia], out, num_shards=2, compute_chargrams=False)
+    # stale early-return without overwrite; real re-merge with it
+    assert merge_indexes([ia, ib], out, num_shards=2,
+                         compute_chargrams=False).num_docs == m1.num_docs
+    m2 = merge_indexes([ia, ib], out, num_shards=2,
+                       compute_chargrams=False, overwrite=True)
+    assert m2.num_docs == len(DOCS_A) + len(DOCS_B)
+
+    with pytest.raises(ValueError, match="must not be one of the sources"):
+        merge_indexes([ia, out], out)
+
+    # k>1 chargram merge requires every source's tokens.txt sidecar
+    ja, jb = str(tmp_path / "ja"), str(tmp_path / "jb")
+    build_index([ca], ja, k=2, chargram_ks=[2], num_shards=2)
+    build_index([cb], jb, k=2, num_shards=2, compute_chargrams=False)
+    with pytest.raises(ValueError, match="tokens.txt"):
+        merge_indexes([ja, jb], str(tmp_path / "jm"))
+    # explicit no-chargrams merge of the same pair is fine
+    assert merge_indexes([ja, jb], str(tmp_path / "jm2"),
+                         compute_chargrams=False).chargram_ks == []

@@ -248,6 +248,21 @@ def cmd_warm(args) -> int:
     return 0
 
 
+def cmd_merge(args) -> int:
+    """Merge built indexes into one (incremental corpus growth: index new
+    batches separately, merge). Byte-identical to a single build over the
+    concatenated corpus (index/merge.py)."""
+    _apply_backend(args)
+    from .index.merge import merge_indexes
+
+    meta = merge_indexes(args.sources, args.out_dir,
+                         num_shards=args.shards,
+                         compute_chargrams=not args.no_chargrams,
+                         overwrite=args.overwrite)
+    print(json.dumps(meta.__dict__))
+    return 0
+
+
 def cmd_pack(args) -> int:
     """PackTextFile equivalent: each line of a plain text file becomes one
     TREC <DOC> with docid PREFIX-NNNNNNN (reference
@@ -418,6 +433,18 @@ def main(argv: list[str] | None = None) -> int:
                     default="sparse")
     _add_backend_arg(pw)
     pw.set_defaults(fn=cmd_warm)
+
+    pm = sub.add_parser("merge", help="merge built indexes into one "
+                                      "(same artifacts as one build over "
+                                      "the concatenated corpus)")
+    pm.add_argument("sources", nargs="+", help="source index dirs")
+    pm.add_argument("out_dir", help="output index dir")
+    pm.add_argument("--shards", type=int, default=10)
+    pm.add_argument("--no-chargrams", action="store_true")
+    pm.add_argument("--overwrite", action="store_true",
+                    help="delete an existing output index first")
+    _add_backend_arg(pm)
+    pm.set_defaults(fn=cmd_merge)
 
     pp = sub.add_parser("pack", help="pack plain text into TREC format "
                                      "(one <DOC> per input line), or "

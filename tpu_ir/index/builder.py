@@ -241,22 +241,10 @@ def build_index(
         if deferred is not None:
             df, doc_len, pair_doc, pair_tf = fetch_to_host(*deferred)
             np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
-            shard_of, offset_of = fmt.shard_local_offsets(df, num_shards)
-            # selection per shard is one boolean mask over the pairs' terms
-            pair_shard = shard_of[pair_term_from_df(df)]
-            for s in range(num_shards):
-                tids = np.nonzero(shard_of == s)[0].astype(np.int32)
-                lens = df[tids].astype(np.int64)
-                local_indptr = np.concatenate([[0], np.cumsum(lens)])
-                sel = pair_shard == s
-                fmt.save_shard(
-                    index_dir, s,
-                    term_ids=tids,
-                    indptr=local_indptr,
-                    pair_doc=pair_doc[:num_pairs][sel],
-                    pair_tf=pair_tf[:num_pairs][sel],
-                    df=df[tids],
-                )
+            # shard layout shared with the index merger (byte-identity)
+            shard_of, offset_of = fmt.write_pair_shards(
+                index_dir, df, pair_doc[:num_pairs], pair_tf[:num_pairs],
+                num_shards)
         else:
             np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
             shard_of, offset_of = fmt.shard_local_offsets(df, num_shards)

@@ -81,6 +81,26 @@ def save_shard(index_dir: str, shard: int, *, term_ids: np.ndarray,
     )
 
 
+def write_pair_shards(index_dir: str, df: np.ndarray, pair_doc: np.ndarray,
+                      pair_tf: np.ndarray, num_shards: int):
+    """Write term-sharded part files from CSR-ordered pair columns (sorted
+    by term id with per-term runs of length df). Returns (shard_of,
+    offset_of) for the dictionary. Single source of truth for the shard
+    layout: the builder and the index merger both call this, and the
+    merge's byte-identical-artifacts contract rides on them agreeing."""
+    shard_of, offset_of = shard_local_offsets(df, num_shards)
+    pair_shard = np.repeat(shard_of, df.astype(np.int64))
+    for s in range(num_shards):
+        tids = np.nonzero(shard_of == s)[0].astype(np.int32)
+        lens = df[tids].astype(np.int64)
+        local_indptr = np.concatenate([[0], np.cumsum(lens)])
+        sel = pair_shard == s
+        save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
+                   pair_doc=pair_doc[sel], pair_tf=pair_tf[sel],
+                   df=df[tids])
+    return shard_of, offset_of
+
+
 def load_shard(index_dir: str, shard: int) -> dict[str, np.ndarray]:
     with np.load(os.path.join(index_dir, part_name(shard))) as z:
         return {k: z[k] for k in z.files}

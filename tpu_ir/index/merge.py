@@ -1,0 +1,175 @@
+"""Index merging: N built indexes -> one index over the union corpus.
+
+The reference had no merge (every change re-ran the full MapReduce job,
+TermKGramDocIndexer.java:227-283); this is the incremental-ops capability
+an engine actually needs: index new document batches separately (fast,
+parallel), then merge. The contract is strict — merging must produce
+artifacts BYTE-IDENTICAL to indexing the concatenated corpus in one job
+(tests/test_merge.py) — which falls out of the format's determinism:
+docnos are ranks in sorted-docid order, term ids are ranks in
+sorted-vocab order, postings order is (term asc, tf desc, doc asc).
+
+All host-side numpy (remap = searchsorted, regroup = one lexsort over the
+union pairs); the char-gram artifacts rebuild on device through the same
+builder path (`dispatch_chargram_builds`), since they depend only on the
+merged vocabulary.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Sequence
+
+import numpy as np
+
+from ..collection import DocnoMapping, Vocab
+from ..utils.report import JobReport
+from . import format as fmt
+from .builder import TOKENS_VOCAB, collect_chargram_builds, dispatch_chargram_builds
+
+
+def merge_indexes(
+    sources: Sequence[str],
+    out_dir: str,
+    *,
+    num_shards: int = 10,
+    compute_chargrams: bool = True,
+    overwrite: bool = False,
+) -> fmt.IndexMetadata:
+    """Merge built indexes into `out_dir`. Sources must share the same k
+    and have disjoint docid sets; chargram ks are the union of sources'.
+    Like build_index, an existing output is returned as-is unless
+    `overwrite=True` (which deletes it up front) — re-running a merge
+    with MORE sources against a stale out_dir needs the flag."""
+    if len(sources) < 1:
+        raise ValueError("need at least one source index")
+    out_abs = os.path.abspath(out_dir)
+    if any(os.path.abspath(s) == out_abs for s in sources):
+        raise ValueError("out_dir must not be one of the sources")
+    metas = [fmt.IndexMetadata.load(s) for s in sources]
+    k = metas[0].k
+    if any(m.k != k for m in metas):
+        raise ValueError(
+            f"cannot merge indexes with different k: "
+            f"{[m.k for m in metas]}")
+    chargram_ks = sorted({ck for m in metas for ck in m.chargram_ks})
+    if compute_chargrams and chargram_ks and k > 1:
+        # the token vocab rides in each source's tokens.txt sidecar; a
+        # source without it would silently vanish from wildcard coverage
+        missing = [s for s in sources
+                   if not os.path.exists(os.path.join(s, TOKENS_VOCAB))]
+        if missing:
+            raise ValueError(
+                "chargram merge needs every source's token vocabulary "
+                f"(tokens.txt); missing from {missing} — rebuild those "
+                "sources with chargrams, or pass compute_chargrams=False")
+
+    os.makedirs(out_dir, exist_ok=True)
+    if overwrite:
+        for name in os.listdir(out_dir):
+            if name != fmt.JOBS_DIR:
+                p = os.path.join(out_dir, name)
+                if os.path.isfile(p):
+                    os.unlink(p)
+    if fmt.artifact_exists(out_dir, fmt.METADATA):
+        return fmt.IndexMetadata.load(out_dir)
+    report = JobReport("MergeIndexes", config={
+        "sources": list(sources), "num_shards": num_shards, "k": k})
+
+    # ---- docno space: union of docids, renumbered by sorted rank ----
+    with report.phase("docnos"):
+        mappings = [DocnoMapping.load(os.path.join(s, fmt.DOCNOS))
+                    for s in sources]
+        all_docids = np.concatenate(
+            [np.asarray(m.docids, dtype=object) for m in mappings])
+        if len(np.unique(all_docids)) != len(all_docids):
+            raise ValueError("sources share docids; corpora must be "
+                             "disjoint to merge")
+        merged_map = DocnoMapping.build(list(all_docids))
+        merged_map.save(os.path.join(out_dir, fmt.DOCNOS))
+        merged_docids = np.asarray(merged_map.docids, dtype=object)
+        # per source: old docno (1-based) -> new docno, as a lookup row
+        docno_lut = []
+        for m in mappings:
+            old = np.asarray(m.docids, dtype=object)
+            lut = np.zeros(len(old) + 1, np.int32)
+            lut[1:] = np.searchsorted(merged_docids, old) + 1
+            docno_lut.append(lut)
+        num_docs = len(merged_map)
+        report.set_counter("Count.DOCS", num_docs)
+
+    # ---- vocabulary: sorted union; per-source id remap rows ----
+    with report.phase("vocab"):
+        vocabs = [Vocab.load(os.path.join(s, fmt.VOCAB)) for s in sources]
+        merged_terms = sorted(set().union(*[set(v.terms) for v in vocabs]))
+        term_lut = [np.searchsorted(merged_terms, np.asarray(v.terms))
+                    .astype(np.int32) for v in vocabs]
+        Vocab(merged_terms).save(os.path.join(out_dir, fmt.VOCAB))
+        v_size = len(merged_terms)
+        report.set_counter("Dictionary.Size", v_size)
+
+    # ---- doc lengths ----
+    with report.phase("doc_len"):
+        # int32 like the builder's device-fetched array (byte-identity)
+        doc_len = np.zeros(num_docs + 1, np.int32)
+        for i, s in enumerate(sources):
+            dl = np.load(os.path.join(s, fmt.DOCLEN))
+            doc_len[docno_lut[i][1:]] = dl[1:]
+        np.save(os.path.join(out_dir, fmt.DOCLEN), doc_len)
+
+    # ---- postings: remap ids, one union lexsort, reshard ----
+    with report.phase("merge_postings"):
+        terms_l, docs_l, tfs_l = [], [], []
+        for i, s in enumerate(sources):
+            for sh in range(metas[i].num_shards):
+                z = fmt.load_shard(s, sh)
+                t = np.repeat(term_lut[i][z["term_ids"]],
+                              np.diff(z["indptr"]).astype(np.int64))
+                terms_l.append(t.astype(np.int32))
+                docs_l.append(docno_lut[i][z["pair_doc"]])
+                tfs_l.append(z["pair_tf"].astype(np.int32))
+        pt = np.concatenate(terms_l) if terms_l else np.zeros(0, np.int32)
+        pd = np.concatenate(docs_l) if docs_l else np.zeros(0, np.int32)
+        ptf = np.concatenate(tfs_l) if tfs_l else np.zeros(0, np.int32)
+        order = np.lexsort((pd, -ptf.astype(np.int64), pt))
+        pt, pd, ptf = pt[order], pd[order], ptf[order]
+        df = np.bincount(pt, minlength=v_size).astype(np.int32)
+        report.set_counter("num_pairs", len(pt))
+
+    with report.phase("write_shards"):
+        shard_of, offset_of = fmt.write_pair_shards(out_dir, df, pd, ptf,
+                                                    num_shards)
+
+    with report.phase("dictionary"):
+        fmt.write_dictionary(out_dir, merged_terms, shard_of, offset_of)
+
+    # ---- char-gram artifacts: rebuilt over the merged TOKEN vocab ----
+    built_chargrams = bool(compute_chargrams and chargram_ks)
+    if built_chargrams:
+        with report.phase("chargrams"):
+            if k == 1:
+                token_terms = merged_terms
+            else:
+                # k>1: union the tokens.txt sidecars (their presence was
+                # validated up front — a silently missing one would drop
+                # that source from wildcard coverage)
+                token_terms = sorted(set().union(*[
+                    set(Vocab.load(os.path.join(s, TOKENS_VOCAB)).terms)
+                    for s in sources]))
+                if token_terms:
+                    Vocab(token_terms).save(
+                        os.path.join(out_dir, TOKENS_VOCAB))
+            if token_terms:
+                handle = dispatch_chargram_builds(out_dir, token_terms,
+                                                  chargram_ks)
+                collect_chargram_builds(out_dir, handle)
+            else:
+                built_chargrams = False
+
+    meta = fmt.IndexMetadata(
+        num_docs=num_docs, vocab_size=v_size, k=k, num_shards=num_shards,
+        num_pairs=int(len(pt)),
+        chargram_ks=chargram_ks if built_chargrams else [])
+    meta.save(out_dir)
+    report.save(os.path.join(out_dir, fmt.JOBS_DIR))
+    return meta
