@@ -240,3 +240,28 @@ def test_warm_prebuilds_serving_cache(setup, capsys, tmp_path):
     assert out["cache_written"] is True
     assert out["warm_skips_shards"] is True
     assert os.path.isdir(os.path.join(idx, "serving-tiered"))
+
+
+def test_trec_run_output(setup, capsys, tmp_path):
+    """--trec-run emits standard trec_eval lines: qid Q0 docid rank score
+    tag, 1-based qids in query-file order."""
+    corpus = tmp_path / "c.trec"
+    corpus.write_text(
+        "<DOC>\n<DOCNO> A-1 </DOCNO>\n<TEXT>\nsalmon river\n</TEXT>\n</DOC>\n"
+        "<DOC>\n<DOCNO> A-2 </DOCNO>\n<TEXT>\ntrout river\n</TEXT>\n</DOC>\n")
+    idx = str(tmp_path / "idx")
+    assert main(["index", str(corpus), idx, "--no-chargrams"]) == 0
+    qf = tmp_path / "q.txt"
+    # note: 'river' would return nothing (df == N -> idf 0, the documented
+    # zero-score deviation) — use discriminative terms
+    qf.write_text("salmon\nsalmon trout\n")
+    capsys.readouterr()
+    assert main(["search", idx, "--queries-file", str(qf),
+                 "--trec-run", "run1"]) == 0
+    lines = [l.split() for l in capsys.readouterr().out.strip().splitlines()]
+    assert all(len(l) == 6 and l[1] == "Q0" and l[5] == "run1"
+               for l in lines)
+    assert lines[0][:3] == ["1", "Q0", "A-1"]      # qid 1 = 'salmon'
+    q2 = [l for l in lines if l[0] == "2"]          # hits both docs
+    assert {l[2] for l in q2} == {"A-1", "A-2"}
+    assert [l[3] for l in q2] == ["1", "2"]         # ranks ascend

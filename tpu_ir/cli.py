@@ -116,7 +116,7 @@ def _run_search(args) -> int:
                          compat_int_idf=args.compat)
     show_docids = not args.docnos
 
-    def run_batch(queries: list[str]) -> None:
+    def run_batch(queries: list[str], qid0: int = 1) -> None:
         # reference guard: only 1-2 word queries
         # (IntDocVectorsForwardIndex.java:292,297)
         skipped = ({q for q in queries if len(q.split()) > 2}
@@ -125,12 +125,22 @@ def _run_search(args) -> int:
         results = iter(scorer.search_batch(
             kept, k=args.k, scoring=args.scoring,
             return_docids=show_docids, rerank=args.rerank) if kept else [])
-        for q in queries:
-            print(f"query: {q}")
+        for qid, q in enumerate(queries, qid0):
+            if args.trec_run is None:
+                print(f"query: {q}")
             if q in skipped:
-                print("  (compat mode: queries are limited to 1-2 words)")
+                if args.trec_run is None:
+                    print("  (compat mode: queries are limited to 1-2 "
+                          "words)")
                 continue
             res = next(results)
+            if args.trec_run is not None:
+                # standard trec_eval run format:
+                # qid Q0 docid rank score run-tag
+                for rank, (key, score) in enumerate(res, 1):
+                    print(f"{qid} Q0 {key} {rank} {score:.6f} "
+                          f"{args.trec_run}")
+                continue
             if not res:
                 print("  (no matching documents)")
             for rank, (key, score) in enumerate(res, 1):
@@ -406,6 +416,11 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--compat", action="store_true",
                     help="reproduce reference quirks (int-division idf, "
                          "1-2 word query cap)")
+    ps.add_argument("--trec-run", metavar="TAG", default=None,
+                    help="emit standard trec_eval run lines "
+                         "('qid Q0 docid rank score TAG'; qids are "
+                         "1-based query positions) instead of the "
+                         "human-readable listing")
     _add_backend_arg(ps)
     ps.set_defaults(fn=cmd_search)
 
