@@ -265,3 +265,25 @@ def test_trec_run_output(setup, capsys, tmp_path):
     q2 = [l for l in lines if l[0] == "2"]          # hits both docs
     assert {l[2] for l in q2} == {"A-1", "A-2"}
     assert [l[3] for l in q2] == ["1", "2"]         # ranks ascend
+
+
+def test_topics_file_with_trec_run(setup, capsys, tmp_path):
+    """TREC topics input: <num>/<title> records drive the batch, topic
+    numbers become the run qids (classic multi-line and one-line shapes)."""
+    corpus = tmp_path / "c.trec"
+    corpus.write_text(
+        "<DOC>\n<DOCNO> A-1 </DOCNO>\n<TEXT>\nsalmon river\n</TEXT>\n</DOC>\n"
+        "<DOC>\n<DOCNO> A-2 </DOCNO>\n<TEXT>\ntrout stream\n</TEXT>\n</DOC>\n")
+    idx = str(tmp_path / "idx")
+    assert main(["index", str(corpus), idx, "--no-chargrams"]) == 0
+    topics = tmp_path / "topics.txt"
+    topics.write_text(
+        "<top>\n<num> Number: 301\n<title> salmon\n\n<desc> Description:\n"
+        "x\n</top>\n"
+        "<top>\n<num> Number: 302\n<title>trout</title>\n</top>\n")
+    capsys.readouterr()
+    assert main(["search", idx, "--topics", str(topics),
+                 "--trec-run", "r"]) == 0
+    lines = [l.split() for l in capsys.readouterr().out.strip().splitlines()]
+    assert [l[0] for l in lines] == ["301", "302"]
+    assert [l[2] for l in lines] == ["A-1", "A-2"]

@@ -116,7 +116,7 @@ def _run_search(args) -> int:
                          compat_int_idf=args.compat)
     show_docids = not args.docnos
 
-    def run_batch(queries: list[str], qid0: int = 1) -> None:
+    def run_batch(queries: list[str], qids: list | None = None) -> None:
         # reference guard: only 1-2 word queries
         # (IntDocVectorsForwardIndex.java:292,297)
         skipped = ({q for q in queries if len(q.split()) > 2}
@@ -125,7 +125,9 @@ def _run_search(args) -> int:
         results = iter(scorer.search_batch(
             kept, k=args.k, scoring=args.scoring,
             return_docids=show_docids, rerank=args.rerank) if kept else [])
-        for qid, q in enumerate(queries, qid0):
+        if qids is None:
+            qids = list(range(1, len(queries) + 1))
+        for qid, q in zip(qids, queries):
             if args.trec_run is None:
                 print(f"query: {q}")
             if q in skipped:
@@ -148,6 +150,9 @@ def _run_search(args) -> int:
 
     if args.query:
         run_batch([args.query])
+    elif args.topics:
+        qids, queries = _read_trec_topics(args.topics)
+        run_batch(queries, qids=qids)
     elif args.queries_file:
         with open(args.queries_file) as f:
             queries = [line.strip() for line in f if line.strip()]
@@ -169,6 +174,30 @@ def _run_search(args) -> int:
                 break
             run_batch([line])
     return 0
+
+
+def _read_trec_topics(path: str) -> tuple[list[str], list[str]]:
+    """Parse a TREC topics file: <top> records with <num> Number: NNN and
+    <title> lines; returns (qids, title queries). Tolerates both the
+    classic SGML shape (title text on the following lines until the next
+    tag) and single-line <title>text</title>."""
+    import re
+
+    with open(path, encoding="utf-8", errors="replace") as f:
+        text = f.read()
+    qids: list[str] = []
+    queries: list[str] = []
+    for top in re.split(r"(?i)<top>", text)[1:]:
+        num = re.search(r"(?i)<num>\s*(?:Number:)?\s*([^<\s][^<\n]*)", top)
+        title = re.search(
+            r"(?i)<title>\s*(?:Topic:)?\s*(.*?)\s*(?=<|\Z)", top, re.S)
+        if not num or not title:
+            continue
+        q = " ".join(title.group(1).split())
+        if q:
+            qids.append(num.group(1).strip())
+            queries.append(q)
+    return qids, queries
 
 
 def cmd_inspect(args) -> int:
@@ -400,6 +429,10 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("index_dir")
     ps.add_argument("--query", "-q")
     ps.add_argument("--queries-file")
+    ps.add_argument("--topics", metavar="FILE", default=None,
+                    help="TREC topics file (<top>/<num>/<title> records); "
+                         "titles become the queries, topic numbers the "
+                         "qids for --trec-run")
     ps.add_argument("--k", type=int, default=10, help="results per query")
     ps.add_argument("--scoring", choices=["tfidf", "bm25"], default="tfidf")
     ps.add_argument("--rerank", type=int, default=None, metavar="N",
