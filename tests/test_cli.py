@@ -287,3 +287,38 @@ def test_topics_file_with_trec_run(setup, capsys, tmp_path):
     lines = [l.split() for l in capsys.readouterr().out.strip().splitlines()]
     assert [l[0] for l in lines] == ["301", "302"]
     assert [l[2] for l in lines] == ["A-1", "A-2"]
+
+
+def test_eval_run_against_qrels(setup, capsys, tmp_path):
+    """End-to-end eval loop: topics -> --trec-run run file -> tpu-ir eval
+    against qrels, metrics hand-checked."""
+    run = tmp_path / "run.txt"
+    # q1: relevant doc at rank 2; q2: relevant at rank 1 (of 2 relevant,
+    # one never retrieved); q3 unjudged (excluded per trec_eval convention)
+    run.write_text(
+        "1 Q0 D-9 1 3.0 t\n1 Q0 D-1 2 2.0 t\n"
+        "2 Q0 D-2 1 2.5 t\n2 Q0 D-8 2 1.0 t\n"
+        "3 Q0 D-5 1 1.0 t\n")
+    qrels = tmp_path / "qrels.txt"
+    qrels.write_text(
+        "1 0 D-1 1\n1 0 D-7 0\n"
+        "2 0 D-2 2\n2 0 D-3 1\n")
+    assert main(["eval", str(run), str(qrels)]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["queries"] == 2
+    # q1: AP = (1/2)/1 = 0.5, RR = 0.5; q2: AP = (1/1)/2 = 0.5, RR = 1.0
+    assert out["map"] == pytest.approx(0.5)
+    assert out["mrr"] == pytest.approx(0.75)
+    # q1 NDCG@10: rel grade 1 at rank 2 -> (1/log2(3)) / ideal(1/log2(2))
+    import math
+    q1 = (1 / math.log2(3)) / 1.0
+    # q2: grade-2 doc at rank 1; ideal = 2/log2(2) + 1/log2(3)
+    q2 = 2.0 / (2.0 + 1 / math.log2(3))
+    assert out["ndcg_at_10"] == pytest.approx(round((q1 + q2) / 2, 4), abs=1e-4)
+    assert out["p_at_5"] == pytest.approx(0.2)       # 1/5 each query
+    assert out["recall_at_100"] == pytest.approx(0.75)  # 1.0 and 0.5
+
+    # empty intersection -> exit 1
+    bad = tmp_path / "bad.txt"
+    bad.write_text("9 0 D-1 1\n")
+    assert main(["eval", str(run), str(bad)]) == 1

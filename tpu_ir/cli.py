@@ -287,6 +287,16 @@ def cmd_warm(args) -> int:
     return 0
 
 
+def cmd_eval(args) -> int:
+    """Score a trec_eval-format run against qrels (search/evaluate.py):
+    MAP / MRR / NDCG@10 / P@5 / P@10 / recall@100, no external tooling."""
+    from .search.evaluate import evaluate_run, read_qrels, read_run
+
+    out = evaluate_run(read_run(args.run), read_qrels(args.qrels))
+    print(json.dumps(out))
+    return 0 if out.get("queries") else 1
+
+
 def cmd_merge(args) -> int:
     """Merge built indexes into one (incremental corpus growth: index new
     batches separately, merge). Byte-identical to a single build over the
@@ -493,6 +503,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="delete an existing output index first")
     _add_backend_arg(pm)
     pm.set_defaults(fn=cmd_merge)
+
+    pe = sub.add_parser("eval", help="score a trec_eval-format run file "
+                                     "against qrels (MAP/MRR/NDCG@10/...)")
+    pe.add_argument("run", help="run file (qid Q0 docid rank score tag)")
+    pe.add_argument("qrels", help="qrels file (qid 0 docid rel)")
+    pe.set_defaults(fn=cmd_eval)
 
     pp = sub.add_parser("pack", help="pack plain text into TREC format "
                                      "(one <DOC> per input line), or "

@@ -1,0 +1,101 @@
+"""Run-file evaluation: standard IR metrics from a trec_eval-format run
+plus qrels.
+
+Closes the loop the reference left to external tooling (its only quality
+check was eyeballing REPL output, IntDocVectorsForwardIndex.java:243-322):
+`tpu-ir search --topics T --trec-run tag > run.txt` then
+`tpu-ir eval run.txt qrels.txt` gives MAP / MRR / NDCG@10 / P@5 / P@10 /
+recall@100 with no trec_eval install.
+
+Formats:
+- run:   `qid Q0 docid rank score tag` (rank-ordered per qid)
+- qrels: `qid 0 docid rel` (rel > 0 = relevant; graded rels feed NDCG)
+"""
+
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+
+
+def read_run(path: str) -> dict[str, list[str]]:
+    """qid -> docids in rank order. Lines that don't parse are skipped;
+    ties/order follow the file (rank column is trusted for sorting)."""
+    per: dict[str, list[tuple[int, str]]] = defaultdict(list)
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) < 6:
+                continue
+            qid, _, docid, rank = parts[0], parts[1], parts[2], parts[3]
+            try:
+                per[qid].append((int(rank), docid))
+            except ValueError:
+                continue
+    return {q: [d for _, d in sorted(rows)] for q, rows in per.items()}
+
+
+def read_qrels(path: str) -> dict[str, dict[str, int]]:
+    """qid -> {docid: graded relevance}. Zero/negative grades are kept
+    (explicitly judged nonrelevant) but count as not relevant."""
+    per: dict[str, dict[str, int]] = defaultdict(dict)
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) < 4:
+                continue
+            try:
+                per[parts[0]][parts[2]] = int(parts[3])
+            except ValueError:
+                continue
+    return dict(per)
+
+
+def evaluate_run(run: dict[str, list[str]],
+                 qrels: dict[str, dict[str, int]]) -> dict:
+    """Mean metrics over the qids present in BOTH run and qrels (trec_eval
+    convention: unjudged queries are excluded, empty-result queries score
+    zero)."""
+    qids = sorted(set(run) & set(qrels))
+    if not qids:
+        return {"queries": 0}
+    ap_l, rr_l, ndcg_l, p5_l, p10_l, r100_l = [], [], [], [], [], []
+    for qid in qids:
+        ranked = run.get(qid, [])
+        grades = qrels[qid]
+        rel = {d for d, g in grades.items() if g > 0}
+        n_rel = len(rel)
+        hits = 0
+        ap = 0.0
+        rr = 0.0
+        for i, d in enumerate(ranked, 1):
+            if d in rel:
+                hits += 1
+                ap += hits / i
+                if rr == 0.0:
+                    rr = 1.0 / i
+        ap_l.append(ap / n_rel if n_rel else 0.0)
+        rr_l.append(rr)
+        dcg = sum(max(grades.get(d, 0), 0) / math.log2(i + 1)
+                  for i, d in enumerate(ranked[:10], 1))
+        ideal = sorted((g for g in grades.values() if g > 0), reverse=True)
+        idcg = sum(g / math.log2(i + 1)
+                   for i, g in enumerate(ideal[:10], 1))
+        ndcg_l.append(dcg / idcg if idcg > 0 else 0.0)
+        p5_l.append(sum(1 for d in ranked[:5] if d in rel) / 5.0)
+        p10_l.append(sum(1 for d in ranked[:10] if d in rel) / 10.0)
+        r100_l.append(sum(1 for d in ranked[:100] if d in rel)
+                      / n_rel if n_rel else 0.0)
+
+    def mean(xs):
+        return round(sum(xs) / len(xs), 4)
+
+    return {
+        "queries": len(qids),
+        "map": mean(ap_l),
+        "mrr": mean(rr_l),
+        "ndcg_at_10": mean(ndcg_l),
+        "p_at_5": mean(p5_l),
+        "p_at_10": mean(p10_l),
+        "recall_at_100": mean(r100_l),
+    }
