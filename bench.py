@@ -425,7 +425,6 @@ def main() -> int:
             if name != "cpu":
                 xb._backend_factories.pop(name, None)
     import jax
-    import jax.numpy as jnp
 
     backend = jax.devices()[0].platform
 

@@ -15,7 +15,6 @@ import json
 import os
 import sys
 
-import numpy as np
 
 
 def _add_backend_arg(p: argparse.ArgumentParser) -> None:
