@@ -132,3 +132,25 @@ def test_merge_guards(tmp_path):
     # explicit no-chargrams merge of the same pair is fine
     assert merge_indexes([ja, jb], str(tmp_path / "jm2"),
                          compute_chargrams=False).chargram_ks == []
+
+
+def test_merge_mixed_builders(tmp_path):
+    """A streaming-built and an in-memory-built index merge to the same
+    bytes as one in-memory build over the concatenated corpus (the two
+    builders share one artifact format — SURVEY §3's invariant)."""
+    from tpu_ir.index.streaming import build_index_streaming
+
+    ca = write_corpus(tmp_path / "a.trec", DOCS_A)
+    cb = write_corpus(tmp_path / "b.trec", DOCS_B)
+    cboth = write_corpus(tmp_path / "both.trec", {**DOCS_A, **DOCS_B})
+    ia, ib = str(tmp_path / "ia"), str(tmp_path / "ib")
+    build_index_streaming([ca], ia, k=1, chargram_ks=[2], num_shards=3,
+                          batch_docs=2)
+    build_index([cb], ib, k=1, chargram_ks=[2], num_shards=2)
+    direct = str(tmp_path / "direct")
+    build_index([cboth], direct, k=1, chargram_ks=[2], num_shards=3)
+    merged = str(tmp_path / "merged")
+    merge_indexes([ia, ib], merged, num_shards=3)
+    for n in artifact_names(direct):
+        assert filecmp.cmp(os.path.join(direct, n),
+                           os.path.join(merged, n), shallow=False), n
