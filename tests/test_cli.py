@@ -322,3 +322,17 @@ def test_eval_run_against_qrels(setup, capsys, tmp_path):
     bad = tmp_path / "bad.txt"
     bad.write_text("9 0 D-1 1\n")
     assert main(["eval", str(run), str(bad)]) == 1
+
+
+def test_eval_skips_malformed_lines(tmp_path, capsys):
+    """Run/qrels readers tolerate malformed lines (short rows, non-numeric
+    ranks/grades) by skipping them, like trec_eval."""
+    run = tmp_path / "run.txt"
+    run.write_text("garbage\n1 Q0 D-1 notanint 1.0 t\n"
+                   "1 Q0 D-1 1 2.0 t\nshort row\n")
+    qrels = tmp_path / "qrels.txt"
+    qrels.write_text("1 0 D-1 one\n1 0 D-1 1\nbad\n")
+    from tpu_ir.cli import main
+    assert main(["eval", str(run), str(qrels)]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["queries"] == 1 and out["map"] == 1.0

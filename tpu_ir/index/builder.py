@@ -30,7 +30,6 @@ from ..ops import (
     build_postings_packed_jit,
     pack_term_bytes,
 )
-from ..ops.postings import pair_term_from_df
 from ..utils import JobReport, fetch_to_host
 from ..utils.transfer import narrow_uint, shrink_for_fetch, shrink_pairs
 from . import format as fmt
