@@ -336,3 +336,36 @@ def test_eval_skips_malformed_lines(tmp_path, capsys):
     assert main(["eval", str(run), str(qrels)]) == 0
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
     assert out["queries"] == 1 and out["map"] == 1.0
+
+
+def test_eval_complete_scores_missing_qids_zero(tmp_path, capsys):
+    """--complete (trec_eval -c): average over EVERY qrels qid; a judged
+    query absent from the run scores zero instead of being excluded."""
+    run = tmp_path / "run.txt"
+    run.write_text("1 Q0 D-1 1 2.0 t\n")   # q2 judged but never retrieved
+    qrels = tmp_path / "qrels.txt"
+    # q3 is judged but has NO relevant docs: trec_eval skips num_rel==0
+    # topics even under -c, so it must not drag the -c average down
+    qrels.write_text("1 0 D-1 1\n2 0 D-2 1\n3 0 D-9 0\n")
+    assert main(["eval", str(run), str(qrels)]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["queries"] == 1 and out["map"] == 1.0  # default: q2 excluded
+    assert main(["eval", str(run), str(qrels), "--complete"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["queries"] == 2
+    assert out["map"] == pytest.approx(0.5)  # q2 contributes 0, not nothing
+    assert out["mrr"] == pytest.approx(0.5)
+
+
+def test_repl_trec_run_qids_advance(setup, capsys, monkeypatch):
+    """Interactive stdin search with --trec-run must number queries with a
+    running qid — not reset to 1 per line (which would merge every query
+    into one qid downstream in eval)."""
+    _, index_dir, _ = setup
+    lines = iter(["alpha", "charlie", "exit"])
+    monkeypatch.setattr("builtins.input", lambda *_: next(lines))
+    assert main(["search", index_dir, "--trec-run", "repl"]) == 0
+    out = capsys.readouterr().out
+    qids = {ln.split()[0] for ln in out.splitlines()
+            if ln.endswith(" repl")}
+    assert qids == {"1", "2"}

@@ -223,3 +223,19 @@ def test_stream_parsers_malformed_input():
     docs = list(TrecWebParser(
         "<DOC>\n<DOCNO> X-4 </DOCNO>\n<DOCHDR>\n\n</DOCHDR>\nb\n</DOC>\n"))
     assert docs[0].metadata["url"] == ""
+
+
+def test_scrub_url_strips_all_port80_occurrences():
+    """TrecWebParser.java:44-48 parity: ':80/' always collapses to '/';
+    when the URL *ends* with ':80' the reference replaces ALL remaining
+    ':80' occurrences, not just the trailing one."""
+    from tpu_ir.collection import TrecWebParser
+
+    s = TrecWebParser.scrub_url
+    assert s("HTTP://Host:80/Path/") == "http://host/path"
+    assert s("http://host:80") == "http://host"
+    # ':80' mid-string not followed by '/', plus trailing ':80' ->
+    # the endswith branch removes BOTH
+    assert s("http://a:80b/c:80") == "http://ab/c"
+    # no trailing ':80' -> the mid-string ':80' (not before '/') survives
+    assert s("http://a:80b/c") == "http://a:80b/c"

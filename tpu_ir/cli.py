@@ -161,17 +161,24 @@ def _run_search(args) -> int:
         # reference's exit command (IntDocVectorsForwardIndex.java:289)
         print(f"tpu-ir: {scorer.meta.num_docs} docs, "
               f"{scorer.meta.vocab_size} terms, k={scorer.meta.k}, "
-              f"layout={scorer.layout}. Type a query, or 'exit'.")
+              f"layout={scorer.layout}. Type a query, or 'exit'.",
+              file=sys.stderr if args.trec_run is not None else sys.stdout)
+        next_qid = 1  # running qid so --trec-run lines stay distinct
+        # input()'s prompt goes to stdout, so it would corrupt piped
+        # output (run files, `| head`); only prompt at a real terminal
+        prompt = ("query> " if sys.stdin.isatty() and sys.stdout.isatty()
+                  and args.trec_run is None else "")
         while True:
             try:
-                line = input("query> ").strip()
+                line = input(prompt).strip()
             except EOFError:
                 break
             if not line:
                 continue
             if line == "exit":
                 break
-            run_batch([line])
+            run_batch([line], qids=[next_qid])
+            next_qid += 1
     return 0
 
 
@@ -291,7 +298,8 @@ def cmd_eval(args) -> int:
     MAP / MRR / NDCG@10 / P@5 / P@10 / recall@100, no external tooling."""
     from .search.evaluate import evaluate_run, read_qrels, read_run
 
-    out = evaluate_run(read_run(args.run), read_qrels(args.qrels))
+    out = evaluate_run(read_run(args.run), read_qrels(args.qrels),
+                       complete=args.complete)
     print(json.dumps(out))
     return 0 if out.get("queries") else 1
 
@@ -507,6 +515,9 @@ def main(argv: list[str] | None = None) -> int:
                                      "against qrels (MAP/MRR/NDCG@10/...)")
     pe.add_argument("run", help="run file (qid Q0 docid rank score tag)")
     pe.add_argument("qrels", help="qrels file (qid 0 docid rel)")
+    pe.add_argument("--complete", action="store_true",
+                    help="average over every qrels qid, scoring qids "
+                         "missing from the run as zero (trec_eval -c)")
     pe.set_defaults(fn=cmd_eval)
 
     pp = sub.add_parser("pack", help="pack plain text into TREC format "

@@ -141,7 +141,9 @@ class TrecWebParser(_LineParser):
         url = url.lower()
         url = url.replace(":80/", "/")
         if url.endswith(":80"):
-            url = url[:-3]
+            # the reference strips ALL ':80' occurrences in this branch,
+            # not just the trailing one (TrecWebParser.java:46-48)
+            url = url.replace(":80", "")
         return url.rstrip("/")
 
     def next_document(self) -> Document | None:

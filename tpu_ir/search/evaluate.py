@@ -52,11 +52,21 @@ def read_qrels(path: str) -> dict[str, dict[str, int]]:
 
 
 def evaluate_run(run: dict[str, list[str]],
-                 qrels: dict[str, dict[str, int]]) -> dict:
-    """Mean metrics over the qids present in BOTH run and qrels (trec_eval
-    convention: unjudged queries are excluded, empty-result queries score
-    zero)."""
-    qids = sorted(set(run) & set(qrels))
+                 qrels: dict[str, dict[str, int]],
+                 complete: bool = False) -> dict:
+    """Mean metrics over judged queries.
+
+    Default (trec_eval convention): averages over qids present in BOTH
+    run and qrels — a judged query that produced no results emits no run
+    lines, so it is EXCLUDED from the mean, not scored zero. Pass
+    ``complete=True`` (trec_eval ``-c``) to average over every qrels qid
+    that has at least one relevant document (trec_eval skips num_rel==0
+    topics even under -c), scoring qids missing from the run as zero."""
+    if complete:
+        qids = sorted(q for q, grades in qrels.items()
+                      if any(g > 0 for g in grades.values()))
+    else:
+        qids = sorted(set(run) & set(qrels))
     if not qids:
         return {"queries": 0}
     ap_l, rr_l, ndcg_l, p5_l, p10_l, r100_l = [], [], [], [], [], []
