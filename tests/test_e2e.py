@@ -647,3 +647,48 @@ def test_readonly_index_dir_serves_without_cache(tmp_path, monkeypatch):
     assert not os.path.isdir(os.path.join(idx, "serving-tiered"))
     assert s.search("salmon fishing")
     assert s.search_batch(["salmon fishing"], rerank=5)[0]
+
+
+def test_wildcard_truncation_pinned(tmp_path):
+    """Over-limit wildcard expansion is DETERMINISTIC and pinned
+    (VERDICT r2 weak #6): at k=1 the survivors are the WILDCARD_LIMIT
+    highest-df matches (ties: ascending term id), returned df-desc; at
+    k>1 (token sidecar carries no df) the survivors are the
+    lexicographically-first WILDCARD_LIMIT matches."""
+    # 100 stem-stable terms matching 'qq*'; the 10 lexicographically LAST
+    # get df=3 (so df-ranking provably beats a lexicographic prefix)
+    cons = "bcdfgjklmnpqrtvwxz"
+    terms = sorted("qq" + a + b for a in cons for b in cons)[:100]
+    hi = terms[-10:]
+    docs = {}
+    for i, t in enumerate(terms):
+        docs[f"D-{i:03d}"] = t
+    for r in range(2):  # two extra docs per high-df term
+        for j, t in enumerate(hi):
+            docs[f"H-{r}{j}"] = t
+    p = tmp_path / "c.trec"
+    p.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in docs.items()))
+
+    out = str(tmp_path / "idx1")
+    build_index([str(p)], out, k=1, num_shards=2)
+    scorer = Scorer.load(out)
+    got = scorer._pattern_tokens("qq*")
+    assert len(got) == scorer.WILDCARD_LIMIT
+    # the ten df=3 terms lead (ascending id within the df tie), then the
+    # lexicographically-first df=1 terms fill the remaining 54 slots
+    assert got[:10] == hi
+    assert got[10:] == terms[:scorer.WILDCARD_LIMIT - 10]
+    # stable across a rebuild into a different layout
+    out_b = str(tmp_path / "idx1b")
+    build_index([str(p)], out_b, k=1, num_shards=5)
+    assert Scorer.load(out_b)._pattern_tokens("qq*") == got
+
+    # k=2 index: expansion runs over the token sidecar (no df) ->
+    # lexicographic prefix, also pinned
+    out2 = str(tmp_path / "idx2")
+    build_index([str(p)], out2, k=2, num_shards=2)
+    scorer2 = Scorer.load(out2)
+    got2 = scorer2._pattern_tokens("qq*")
+    assert got2 == terms[:scorer2.WILDCARD_LIMIT]

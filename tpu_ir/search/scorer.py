@@ -349,14 +349,39 @@ class Scorer:
         pattern (too short for every k, e.g. bare '*')."""
         for lookup in self._wildcard_lookups():
             if lookup.pattern_grams(pattern):
-                terms = lookup.expand(pattern, limit=self.WILDCARD_LIMIT + 1)
+                terms = lookup.expand(pattern)
                 if len(terms) > self.WILDCARD_LIMIT:
-                    logger.warning(
-                        "pattern %r matches more than %d terms; "
-                        "expansion truncated", pattern, self.WILDCARD_LIMIT)
-                    terms = terms[: self.WILDCARD_LIMIT]
+                    terms = self._truncate_expansion(pattern, terms)
                 return terms
         return None
+
+    def _truncate_expansion(self, pattern: str, terms: list[str]) -> list[str]:
+        """Pinned truncation semantics for over-limit expansions.
+
+        k=1 (the chargram index covers the INDEX vocabulary, so df is on
+        hand): keep the WILDCARD_LIMIT highest-df matches — the terms that
+        contribute most documents to the OR — with ties broken by
+        ascending term id, and return them in that (df desc, id asc)
+        order. k>1 (expansions live in the token sidecar vocabulary,
+        which carries no df): keep the lexicographically-first
+        WILDCARD_LIMIT matches (`WildcardLookup.expand` returns sorted
+        term order). Both rules are deterministic under index rebuilds;
+        tests pin them so a layout change cannot silently reorder
+        wildcard results."""
+        logger.warning(
+            "pattern %r matches %d terms; expansion truncated to %d",
+            pattern, len(terms), self.WILDCARD_LIMIT)
+        if self.meta.k != 1:
+            return terms[: self.WILDCARD_LIMIT]
+        df = self._df_host()
+        ids = np.array([self.vocab.id_or(t) for t in terms])
+        order = np.lexsort((ids, -df[ids]))[: self.WILDCARD_LIMIT]
+        return [terms[i] for i in order.tolist()]
+
+    def _df_host(self) -> np.ndarray:
+        if not hasattr(self, "_df_host_cache"):
+            self._df_host_cache = np.asarray(self.df)
+        return self._df_host_cache
 
     def _expand_wildcards(self, text: str) -> tuple[str, list[int]]:
         """Pull glob tokens ('te*', 'ho?se') out of a query; return the text
