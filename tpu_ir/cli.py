@@ -88,7 +88,8 @@ def _run_index(args) -> int:
             chargram_ks=args.chargram_k, num_shards=args.shards,
             batch_docs=args.batch_docs,
             compute_chargrams=not args.no_chargrams,
-            spmd_devices=args.spmd_devices)
+            spmd_devices=args.spmd_devices,
+            overwrite=args.overwrite)
     else:
         from .index import build_index
 

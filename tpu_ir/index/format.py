@@ -68,10 +68,19 @@ class IndexMetadata:
             return cls(**json.load(f))
 
 
+def savez_atomic(path: str, **arrays) -> None:
+    """np.savez through a same-directory temp file + rename, so a file's
+    EXISTENCE implies it is complete — the invariant the streaming build's
+    crash-resume (streaming.py) trusts for spills and part files."""
+    tmp = path + ".tmp.npz"
+    np.savez(tmp, **arrays)
+    os.replace(tmp, path)
+
+
 def save_shard(index_dir: str, shard: int, *, term_ids: np.ndarray,
                indptr: np.ndarray, pair_doc: np.ndarray,
                pair_tf: np.ndarray, df: np.ndarray) -> None:
-    np.savez(
+    savez_atomic(
         os.path.join(index_dir, part_name(shard)),
         term_ids=term_ids.astype(np.int32),
         indptr=indptr.astype(np.int64),
@@ -108,7 +117,8 @@ def load_shard(index_dir: str, shard: int) -> dict[str, np.ndarray]:
 
 def save_chargram(index_dir: str, k: int, *, gram_codes: np.ndarray,
                   indptr: np.ndarray, term_ids: np.ndarray) -> None:
-    np.savez(
+    # atomic: chargram artifacts are skip-if-exists on rebuild/resume
+    savez_atomic(
         os.path.join(index_dir, chargram_name(k)),
         gram_codes=gram_codes.astype(np.int64),
         indptr=indptr.astype(np.int64),

@@ -30,6 +30,16 @@ Pass 1 and pass 3 are unchanged, so scale (out-of-core) and distribution
 (mesh) compose: the artifacts are byte-identical to the single-device
 streaming build at the same shard count.
 
+Crash resume: every spill and part file is written atomically (temp +
+rename), pass 1 ends by writing a manifest (docids, native vocab,
+per-batch occurrence counts, config signature), and a restart resumes from
+the last complete artifact — token spills are never re-tokenized, complete
+pass-2 batches are never recombined, complete pass-3 shards are never
+re-sorted. Spills from a different config (corpus bytes, k, shards, spmd)
+are discarded. This generalizes the reference's resume-by-artifact
+(BuildIntDocVectorsForwardIndex.java:186-194) to the pass DAG *within* one
+job, per SURVEY §5; `overwrite=True` restores delete-up-front.
+
 This is the scaling path for the Wikipedia-1M / MS MARCO configs
 (BASELINE.json); the in-memory builder (builder.py) stays the fast path for
 reference-scale corpora.
@@ -56,6 +66,54 @@ from .builder import build_chargram_artifacts
 
 def _round_cap(n: int, granule: int = 1 << 18) -> int:
     return max(granule, (n + granule - 1) // granule * granule)
+
+
+PASS1_MANIFEST = "pass1.npz"
+
+
+def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
+                spmd_devices: int | None) -> np.ndarray:
+    """Build-config signature stored in the pass-1 manifest: a resume is
+    only valid against spills produced by the SAME corpus files and build
+    shape (the reference's resume-by-artifact skips outputs the same way,
+    BuildIntDocVectorsForwardIndex.java:186-194 — generalized here to the
+    pass DAG within one job per SURVEY §5)."""
+    parts = [f"k={k}", f"shards={num_shards}", f"spmd={spmd_devices or 0}"]
+    for p in corpus_paths:
+        ap = os.path.abspath(p)
+        size = os.path.getsize(ap) if os.path.exists(ap) else -1
+        parts.append(f"{ap}:{size}")
+    return np.array(parts, dtype=np.str_)
+
+
+def _load_resume_state(spill_dir: str, sig: np.ndarray):
+    """Returns (all_docids, vocab_list, n_batches, batch_occ) when the
+    spill dir holds a complete pass-1 state for this exact config, else
+    None. Manifest + spills are written atomically, so existence implies
+    completeness."""
+    path = os.path.join(spill_dir, PASS1_MANIFEST)
+    if not os.path.exists(path):
+        return None
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if (len(z["sig"]) != len(sig)
+                    or not (z["sig"] == sig).all()):
+                return None
+            n_batches = int(z["n_batches"])
+            for b in range(n_batches):
+                if not os.path.exists(
+                        os.path.join(spill_dir, f"tokens-{b:05d}.npz")):
+                    return None
+            return (z["docids"].tolist(), z["vocab"].tolist(), n_batches,
+                    z["batch_occ"])
+    except (OSError, KeyError, ValueError):
+        return None
+
+
+def _batch_pairs_done(spill_dir: str, b: int, num_shards: int) -> bool:
+    return all(
+        os.path.exists(os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"))
+        for s in range(num_shards))
 
 
 def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
@@ -105,6 +163,7 @@ def build_index_streaming(
     compute_chargrams: bool = True,
     keep_spills: bool = False,
     spmd_devices: int | None = None,
+    overwrite: bool = False,
 ) -> fmt.IndexMetadata:
     if isinstance(corpus_paths, (str, os.PathLike)):
         corpus_paths = [corpus_paths]
@@ -114,6 +173,14 @@ def build_index_streaming(
         # reducer-count = partition-count identity)
         num_shards = spmd_devices
     os.makedirs(index_dir, exist_ok=True)
+    if overwrite:
+        for name in os.listdir(index_dir):
+            if name != fmt.JOBS_DIR:
+                p = os.path.join(index_dir, name)
+                if os.path.isfile(p):
+                    os.unlink(p)
+                elif name == "_spill":
+                    shutil.rmtree(p, ignore_errors=True)
     if fmt.artifact_exists(index_dir, fmt.METADATA):
         return fmt.IndexMetadata.load(index_dir)
 
@@ -121,48 +188,82 @@ def build_index_streaming(
 
     enable_compilation_cache()
 
+    # ---- crash resume: a leftover spill dir from an interrupted build is
+    # reusable when its pass-1 manifest matches this exact config; stale or
+    # mismatched state (and any half-written artifacts) is discarded ----
     spill_dir = os.path.join(index_dir, "_spill")
+    sig = _config_sig(corpus_paths, k, num_shards, spmd_devices)
+    resume_state = _load_resume_state(spill_dir, sig)
+    if resume_state is None and os.path.isdir(spill_dir):
+        shutil.rmtree(spill_dir, ignore_errors=True)
+    if resume_state is None:
+        # no trustworthy spills -> any part/side files are from a crashed
+        # or differently-configured run; clear them so pass 3 cannot
+        # mistake them for its own completed output
+        for name in os.listdir(index_dir):
+            if name != fmt.JOBS_DIR:
+                p = os.path.join(index_dir, name)
+                if os.path.isfile(p):
+                    os.unlink(p)
     os.makedirs(spill_dir, exist_ok=True)
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "num_shards": num_shards, "streaming": True,
-        "batch_docs": batch_docs, "spmd_devices": spmd_devices})
+        "batch_docs": batch_docs, "spmd_devices": spmd_devices,
+        "resumed": resume_state is not None})
 
     # ---- pass 1: chunked tokenize -> spill temp-id batches ----
     # (each spill batch covers a contiguous docid range; pass 2 walks the
     # same order, so batch b's docids are all_docids[ofs : ofs + len(lens)])
-    all_docids: list[str] = []
-    n_batches = 0
-    tok = make_chunked_tokenizer(corpus_paths, k=k)
-    with report.phase("pass1_tokenize"):
-        acc_ids: list[np.ndarray] = []
-        acc_lens: list[np.ndarray] = []
-        acc_docs = 0
-
-        def flush():
-            nonlocal n_batches, acc_docs
-            if not acc_docs:
-                return
-            np.savez(os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
-                     ids=np.concatenate(acc_ids),
-                     lengths=np.concatenate(acc_lens))
-            n_batches += 1
-            acc_ids.clear()
-            acc_lens.clear()
+    if resume_state is not None:
+        all_docids, vocab_list, n_batches, batch_occ = resume_state
+        report.incr("Count.DOCS", len(all_docids))
+        report.set_counter("pass1_resumed_batches", n_batches)
+    else:
+        all_docids = []
+        n_batches = 0
+        occ_per_batch: list[int] = []
+        tok = make_chunked_tokenizer(corpus_paths, k=k)
+        with report.phase("pass1_tokenize"):
+            acc_ids: list[np.ndarray] = []
+            acc_lens: list[np.ndarray] = []
             acc_docs = 0
 
-        try:
-            for docids_d, ids_d, lens_d in tok.deltas():
-                report.incr("Count.DOCS", len(docids_d))
-                all_docids.extend(docids_d)
-                acc_ids.append(ids_d)
-                acc_lens.append(lens_d)
-                acc_docs += len(docids_d)
-                if acc_docs >= batch_docs:
-                    flush()
-            flush()
-            vocab_list = tok.vocab()
-        finally:
-            tok.close()
+            def flush():
+                nonlocal n_batches, acc_docs
+                if not acc_docs:
+                    return
+                ids = np.concatenate(acc_ids)
+                fmt.savez_atomic(
+                    os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
+                    ids=ids, lengths=np.concatenate(acc_lens))
+                occ_per_batch.append(len(ids))
+                n_batches += 1
+                acc_ids.clear()
+                acc_lens.clear()
+                acc_docs = 0
+
+            try:
+                for docids_d, ids_d, lens_d in tok.deltas():
+                    report.incr("Count.DOCS", len(docids_d))
+                    all_docids.extend(docids_d)
+                    acc_ids.append(ids_d)
+                    acc_lens.append(lens_d)
+                    acc_docs += len(docids_d)
+                    if acc_docs >= batch_docs:
+                        flush()
+                flush()
+                vocab_list = tok.vocab()
+            finally:
+                tok.close()
+        batch_occ = np.array(occ_per_batch, dtype=np.int64)
+        # manifest LAST: its existence certifies pass 1 (docids in corpus
+        # order, the native vocab in temp-id order, per-batch occurrence
+        # counts) so a restart never re-tokenizes
+        fmt.savez_atomic(
+            os.path.join(spill_dir, PASS1_MANIFEST), sig=sig,
+            docids=np.array(all_docids, dtype=np.str_),
+            vocab=np.array(vocab_list, dtype=np.str_),
+            n_batches=np.int64(n_batches), batch_occ=batch_occ)
 
     num_docs = len(all_docids)
     if num_docs == 0:
@@ -187,19 +288,23 @@ def build_index_streaming(
 
     # ---- pass 2: combine per batch, spill pairs per term shard ----
     doc_len = np.zeros(num_docs + 1, np.int64)
-    occurrences = 0
+    occurrences = int(batch_occ.sum())
+    resuming = resume_state is not None
 
     def iter_batches():
-        """Yield (b, term_ids, docnos, lengths) per spill batch; maintains
-        doc_len and the occurrence counter as it goes."""
-        nonlocal occurrences
+        """Yield (b, term_ids, docnos, lengths) per spill batch that still
+        needs its pair spills; maintains doc_len as it walks. On resume, a
+        batch whose per-shard pair spills all exist is complete (they are
+        written atomically) and is skipped without reading its token ids —
+        only `lengths` loads, to rebuild doc_len."""
         ofs = 0
         for b in range(n_batches):
             with np.load(os.path.join(spill_dir,
                                       f"tokens-{b:05d}.npz")) as z:
-                flat, lengths = z["ids"], z["lengths"]
-            occurrences += len(flat)
-            term_ids = rank[flat]
+                lengths = z["lengths"]
+                done = resuming and _batch_pairs_done(
+                    spill_dir, b, num_shards)
+                flat = None if done else z["ids"]
             docids = np.array(all_docids[ofs : ofs + len(lengths)],
                               dtype=np.str_)
             ofs += len(lengths)
@@ -207,7 +312,10 @@ def build_index_streaming(
                 np.int32)
             # a doc's length IS its post-analysis occurrence count
             doc_len[docnos] = lengths
-            yield b, term_ids, docnos, lengths
+            if done:
+                report.incr("pass2_resumed_batches", 1)
+                continue
+            yield b, rank[flat], docnos, lengths
 
     def pass2_single_device():
         # depth-1 dispatch/collect pipeline: batch b+1's host prep + device
@@ -228,7 +336,7 @@ def build_index_streaming(
             shard = pt % num_shards
             for s in range(num_shards):
                 sel = shard == s
-                np.savez(
+                fmt.savez_atomic(
                     os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"),
                     term=pt[sel], doc=pd[sel], tf=ptf[sel])
 
@@ -293,7 +401,7 @@ def build_index_streaming(
                 out.num_pairs, out.pair_term, out.pair_doc, out.pair_tf)
             for sh in range(s):
                 n_sh = int(npairs[sh])
-                np.savez(
+                fmt.savez_atomic(
                     os.path.join(spill_dir, f"pairs-{sh:03d}-{b:05d}.npz"),
                     term=pt[sh][:n_sh], doc=pd[sh][:n_sh],
                     tf=ptf[sh][:n_sh])
@@ -313,8 +421,20 @@ def build_index_streaming(
     shard_of = np.arange(v, dtype=np.int32) % num_shards
     with report.phase("pass3_reduce"):
         for s in range(num_shards):
-            rdf, npairs = reduce_shard_spills(
-                spill_dir, index_dir, s, n_batches, v, shard_of)
+            part = os.path.join(index_dir, fmt.part_name(s))
+            if resuming and os.path.exists(part):
+                # parts are written atomically and only after every pass-2
+                # spill exists, so an existing part IS this shard's final
+                # output; recover its df/pair contributions without
+                # re-sorting
+                z = fmt.load_shard(index_dir, s)
+                rdf = np.zeros(v, np.int32)
+                rdf[z["term_ids"]] = z["df"]
+                npairs = len(z["pair_doc"])
+                report.incr("pass3_resumed_shards", 1)
+            else:
+                rdf, npairs = reduce_shard_spills(
+                    spill_dir, index_dir, s, n_batches, v, shard_of)
             num_pairs_total += npairs
             df[:] += rdf
     report.set_counter("num_pairs", num_pairs_total)
