@@ -267,9 +267,12 @@ class Scorer:
             if save_cache:
                 norms = compute_doc_norms(pair_term, pair_doc, pair_tf,
                                           df, meta.num_docs)
-                save_sharded_serving_cache(index_dir, sharded_layout, df,
-                                           norms, meta=meta,
-                                           num_shards=n_dev)
+                # one writer on a shared index dir: every process builds
+                # the same layout, process 0 persists it
+                if jax.process_index() == 0:
+                    save_sharded_serving_cache(index_dir, sharded_layout,
+                                               df, norms, meta=meta,
+                                               num_shards=n_dev)
         elif resolved == "sparse":
             from .layout import save_serving_cache
 
@@ -576,8 +579,12 @@ class Scorer:
         if self.layout == "sharded":
             from ..parallel import sharded_tiered_topk
 
+            # num_docs rides as the python int: the sharded path wraps it
+            # into a (possibly multi-process) global scalar itself, and a
+            # jnp scalar would cost a host sync per block there
             s, d = sharded_tiered_topk(
-                q, self._sharded, self.df, n, mesh=self._mesh, k=k,
+                q, self._sharded, self.df, self.meta.num_docs,
+                mesh=self._mesh, k=k,
                 scoring=scoring, compat_int_idf=self.compat_int_idf)
         elif scoring == "bm25":
             if self.layout == "dense":
@@ -649,23 +656,21 @@ class Scorer:
         if self.layout == "sharded":
             # both stages run inside one SPMD program; the global doc norms
             # ride to the mesh in sharded [S, dblk+1] form (built once)
-            import jax
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from ..parallel import SHARD_AXIS, shard_slices, sharded_tiered_rerank
+            from ..parallel import shard_slices, sharded_tiered_rerank
+            from ..parallel.sharded_tiered import put_doc_sharded
 
             if self._sharded_norm is None:
                 norms_np = np.asarray(self._doc_norms())
-                self._sharded_norm = jax.device_put(
+                self._sharded_norm = put_doc_sharded(
                     shard_slices(norms_np, num_docs=self.meta.num_docs,
                                  num_shards=self._mesh.devices.size),
-                    NamedSharding(self._mesh, P(SHARD_AXIS, None)))
+                    self._mesh)
 
             def dispatch(q):
                 return sharded_tiered_rerank(
-                    jnp.asarray(q), self._sharded, self.df, n,
-                    self._sharded_norm, mesh=self._mesh, k=k,
-                    candidates=candidates)
+                    jnp.asarray(q), self._sharded, self.df,
+                    self.meta.num_docs, self._sharded_norm,
+                    mesh=self._mesh, k=k, candidates=candidates)
 
             return self._blocked_dispatch(
                 max(1, self.SCORE_BUDGET // self._doc_axis_width()),
