@@ -33,6 +33,13 @@ mine = process_file_slice(files, pi, pc)
 terms = ["apple", "zebra"] if pid == 0 else ["mango", "apple"]
 union = allgather_strings(terms)
 
+# chunked rounds across real processes: asymmetric set sizes, tiny chunks
+# (forces many rounds + mid-line chunk splits), exact union required
+many0 = [f"shared-term-{i:04d}" for i in range(200)]
+many1 = many0[::2] + [f"only-p1-{i:04d}" for i in range(75)]
+u2 = allgather_strings(many0 if pid == 0 else many1, chunk_bytes=64)
+chunked_ok = u2 == sorted(set(many0) | set(many1))
+
 import jax.numpy as jnp
 total = int(jax.experimental.multihost_utils.process_allgather(
     jnp.int32(pid + 1)).sum())
@@ -80,7 +87,7 @@ n_docs_out = int(np.asarray(out.num_docs.addressable_shards[0].data).ravel()[0])
 mesh_ok = mesh_ok and n_docs_out == NDOCS
 
 print(json.dumps({"pid": pid, "mine": mine, "union": union, "total": total,
-                  "mesh_ok": mesh_ok}))
+                  "mesh_ok": mesh_ok, "chunked_ok": chunked_ok}))
 """
 
 
@@ -121,3 +128,52 @@ def test_two_process_distributed(tmp_path):
     assert results[0]["total"] == results[1]["total"] == 3
     # the SPMD index build ran over the global 2-host mesh correctly
     assert results[0]["mesh_ok"] and results[1]["mesh_ok"]
+    # chunked string exchange (64-byte rounds) reassembled exactly
+    assert results[0]["chunked_ok"] and results[1]["chunked_ok"]
+
+
+def test_allgather_strings_bounded_exchange(monkeypatch):
+    """Simulated 8-process collective over a large vocab: the stub stands
+    in for multihost_utils.process_allgather (replaying what every process
+    would contribute at each lockstep round, since the call sequence is
+    deterministic) and RECORDS each round's exchange size. The union must
+    be exact and no single round may materialize more than P * chunk_bytes
+    — the padded-matrix implementation this replaces allocated
+    P * rows * max_width up front (multiple GB at 1M terms)."""
+    import numpy as np
+
+    import tpu_ir.parallel.multihost as mh
+
+    P_ = 8
+    chunk = 1 << 16
+    vocabs = [[f"term-{(i * 7 + p) % 200_000:06d}-suffix"
+               for i in range(120_000)] for p in range(P_)]
+    blobs = [b"\n".join(s.encode() for s in sorted(set(v))) for v in vocabs]
+    sizes = np.array([len(b) for b in blobs], np.int64)
+    state = {"round": 0, "max_gathered": 0}
+
+    def fake_allgather(x):
+        x = np.asarray(x)
+        if x.ndim == 0:                       # the size negotiation
+            return sizes.copy()
+        ofs = state["round"] * chunk
+        state["round"] += 1
+        width = x.shape[0]
+        out = np.zeros((P_, width), np.uint8)
+        for p in range(P_):
+            piece = blobs[p][ofs : ofs + width]
+            out[p, : len(piece)] = np.frombuffer(piece, np.uint8)
+        # caller's process-0 chunk must equal what the stub replays
+        np.testing.assert_array_equal(x, out[0])
+        state["max_gathered"] = max(state["max_gathered"], out.nbytes)
+        return out
+
+    monkeypatch.setattr(mh.jax, "process_count", lambda: P_)
+    monkeypatch.setattr("jax.experimental.multihost_utils.process_allgather",
+                        fake_allgather)
+    got = mh.allgather_strings(vocabs[0], chunk_bytes=chunk)
+
+    want = sorted(set().union(*vocabs))
+    assert got == want and len(got) == 200_000
+    assert state["round"] == -(-int(sizes.max()) // chunk)  # lockstep rounds
+    assert state["max_gathered"] <= P_ * chunk  # bounded exchange memory
