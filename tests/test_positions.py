@@ -1,0 +1,380 @@
+"""Format v2 positions: per-posting position runs aligned with the part
+files (VERDICT r2 item 4). The reference format carries only (docno, tf)
+(PostingWritable.java:9-65); v2 keeps the token coordinates the analyzer
+already computes, enabling phrase and proximity retrieval."""
+
+import os
+
+import numpy as np
+import pytest
+
+from tpu_ir.analysis import Analyzer
+from tpu_ir.index import build_index
+from tpu_ir.index import format as fmt
+from tpu_ir.index.positions import PositionsReader, positions_name
+
+DOCS = {
+    "P-01": "salmon fishing in the river salmon fishing again",
+    "P-02": "fishing salmon is not salmon fishing",
+    "P-03": "the quick brown fox jumps over the lazy dog",
+    "P-04": "river fishing river fishing river fishing",
+    "P-05": "salmon salmon salmon fishing",
+}
+
+
+def corpus_file(tmp_path):
+    p = tmp_path / "corpus.trec"
+    p.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in DOCS.items()))
+    return str(p)
+
+
+def oracle_positions():
+    """docid -> term -> ascending post-analysis token positions."""
+    an = Analyzer()
+    out = {}
+    for d, t in DOCS.items():
+        toks = an.analyze(
+            f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>")
+        per = {}
+        for i, tok in enumerate(toks):
+            per.setdefault(tok, []).append(i)
+        out[d] = per
+    return out
+
+
+@pytest.mark.parametrize("spmd", [None, 8])
+def test_positions_match_oracle(tmp_path, spmd):
+    """Every pair row's decoded position run equals the analyzer's token
+    coordinates for that (term, doc) — on the single-device and the SPMD
+    build — and run lengths equal the pair's tf."""
+    out = str(tmp_path / f"idx-{spmd}")
+    meta = build_index([corpus_file(tmp_path)], out, k=1,
+                       num_shards=3 if spmd is None else spmd,
+                       compute_chargrams=False, positions=True,
+                       spmd_devices=spmd)
+    assert meta.has_positions and meta.version == 2
+
+    from tpu_ir.collection import DocnoMapping, Vocab
+
+    vocab = Vocab.load(os.path.join(out, fmt.VOCAB))
+    mapping = DocnoMapping.load(os.path.join(out, fmt.DOCNOS))
+    want = oracle_positions()
+    reader = PositionsReader(out)
+    assert reader.available()
+
+    n_checked = 0
+    for s in range(meta.num_shards):
+        z = fmt.load_shard(out, s)
+        runs = reader.runs_for_rows(s, 0, len(z["pair_doc"]))
+        row = 0
+        for i, tid in enumerate(z["term_ids"]):
+            term = vocab.terms[int(tid)]
+            for r in range(int(z["indptr"][i]), int(z["indptr"][i + 1])):
+                docno = int(z["pair_doc"][r])
+                tf = int(z["pair_tf"][r])
+                docid = mapping.get_docid(docno)
+                got = runs[r].tolist()
+                assert len(got) == tf, (term, docid)
+                assert got == want[docid][term], (term, docid)
+                n_checked += 1
+            row += 1
+    assert n_checked == meta.num_pairs
+
+
+def test_v1_index_loads_without_positions(tmp_path):
+    out = str(tmp_path / "idx")
+    meta = build_index([corpus_file(tmp_path)], out, k=1, num_shards=2,
+                       compute_chargrams=False)
+    assert not meta.has_positions and meta.version == 1
+    assert not os.path.exists(os.path.join(out, positions_name(0)))
+    assert not PositionsReader(out).available()
+    # and an old metadata.json without the key still loads
+    import json
+    mp = os.path.join(out, fmt.METADATA)
+    with open(mp) as f:
+        m = json.load(f)
+    del m["has_positions"]
+    with open(mp, "w") as f:
+        json.dump(m, f)
+    assert fmt.IndexMetadata.load(out).has_positions is False
+
+
+def test_positions_kgram_index(tmp_path):
+    """k=2 index: a gram's position is its window start, so adjacency
+    carries through composed terms too."""
+    out = str(tmp_path / "idx2")
+    meta = build_index([corpus_file(tmp_path)], out, k=2, num_shards=2,
+                       compute_chargrams=False, positions=True)
+    assert meta.has_positions
+
+    from tpu_ir.collection import Vocab, kgram_terms
+    from tpu_ir.index.dictionary import lookup_term
+
+    an = Analyzer()
+    record = (f"<DOC>\n<DOCNO> P-04 </DOCNO>\n<TEXT>\n{DOCS['P-04']}\n"
+              f"</TEXT>\n</DOC>")
+    grams = kgram_terms(an.analyze(record), 2)
+    target = next(g for g in grams
+                  if g.startswith("river") and "fish" in g)  # 'river fish'
+    want_pos = [i for i, g in enumerate(grams) if g == target]
+    assert len(want_pos) == 3  # river-fishing repeats three times
+    vocab = Vocab.load(os.path.join(out, fmt.VOCAB))
+    tid = vocab.terms.index(target)
+    shard = tid % meta.num_shards
+    z = fmt.load_shard(out, shard)
+    i = int(np.searchsorted(z["term_ids"], tid))
+    reader = PositionsReader(out)
+    rows = reader.runs_for_rows(shard, int(z["indptr"][i]),
+                                int(z["indptr"][i + 1]))
+    by_doc = {int(z["pair_doc"][r]): rows[j] for j, r in enumerate(
+        range(int(z["indptr"][i]), int(z["indptr"][i + 1])))}
+    # P-04 is docno of "P-04"
+    from tpu_ir.collection import DocnoMapping
+    mapping = DocnoMapping.load(os.path.join(out, fmt.DOCNOS))
+    docno = mapping.get_docno("P-04")
+    assert by_doc[docno].tolist() == want_pos
+
+
+PHRASE_DOCS = {
+    # 'salmon fishing' adjacent
+    "F-01": "salmon fishing is fun and salmon are tasty",
+    # both words, NOT adjacent, wrong order nearby
+    "F-02": "fishing for trout while salmon swim upstream",
+    # adjacent but reversed
+    "F-03": "fishing salmon is a different phrase entirely",
+    # adjacent twice (higher tf)
+    "F-04": "salmon fishing and more salmon fishing all day",
+    # one-word gap: matches only at slop >= 1
+    "F-05": "salmon net fishing with a big net",
+    # neither word adjacent, scattered far apart
+    "F-06": "salmon swim far away from any fishing boats here today",
+    # fillers WITHOUT the phrase terms, so their idf stays positive
+    "F-07": "quick brown fox jumps over lazy dog tonight",
+    "F-08": "stock markets fell sharply as investors fled",
+}
+
+
+@pytest.fixture(scope="module")
+def phrase_index(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("phrase")
+    p = tmp / "corpus.trec"
+    p.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in PHRASE_DOCS.items()))
+    out = str(tmp / "idx")
+    build_index([str(p)], out, k=1, num_shards=3, compute_chargrams=False,
+                positions=True)
+    return out
+
+
+def test_phrase_query_exact_adjacency(phrase_index):
+    """A quoted phrase returns ONLY true ordered-adjacency matches: not
+    reversed pairs, not co-occurrence, not gapped spans."""
+    from tpu_ir.search import Scorer
+
+    scorer = Scorer.load(phrase_index)
+    got = {d for d, _ in scorer.search('"salmon fishing"')}
+    assert got == {"F-01", "F-04"}
+    # reversed phrase: F-03 has it literally; F-04 gains it because
+    # positions are POST-analysis coordinates — the stopwords in
+    # "fishing and more salmon" vanish at analysis, making fish/salmon
+    # adjacent (standard for positional indexes built after analysis)
+    got_rev = {d for d, _ in scorer.search('"fishing salmon"')}
+    assert got_rev == {"F-03", "F-04"}
+    # slop=1 admits the one-gap doc too
+    got_slop = {d for d, _ in scorer.search('"salmon fishing"',
+                                            phrase_slop=1)}
+    assert got_slop == {"F-01", "F-04", "F-05"}
+    # no match -> empty, not a crash
+    assert scorer.search('"tasty trout"') == []
+    # phrase + free terms: phrase filters, all terms score
+    got_mixed = {d for d, _ in scorer.search('"salmon fishing" fun')}
+    assert got_mixed == {"F-01", "F-04"}
+    # ranking holds: doc with the phrase twice + 'fun' absent vs doc with
+    # phrase once + 'fun' present — just assert both rank and scores > 0
+    res = scorer.search('"salmon fishing"', scoring="bm25")
+    assert {d for d, _ in res} == {"F-01", "F-04"}
+    assert all(s > 0 for _, s in res)
+
+
+def test_phrase_query_batch_mixed(phrase_index):
+    """search_batch interleaves phrase and plain queries preserving
+    order; plain queries still ride the device batch path."""
+    from tpu_ir.search import Scorer
+
+    scorer = Scorer.load(phrase_index)
+    res = scorer.search_batch(
+        ['salmon', '"salmon fishing"', 'fishing boats', '"fishing salmon"'])
+    assert {d for d, _ in res[1]} == {"F-01", "F-04"}
+    assert {d for d, _ in res[3]} == {"F-03", "F-04"}
+    # plain queries equal their individually-searched selves
+    assert res[0] == scorer.search("salmon")
+    assert res[2] == scorer.search("fishing boats")
+
+
+def test_phrase_requires_positions(tmp_path):
+    """v1 index (no positions): quoted query raises the documented error
+    instead of silently degrading."""
+    from tpu_ir.search import Scorer
+
+    p = tmp_path / "c.trec"
+    p.write_text("<DOC>\n<DOCNO> X </DOCNO>\n<TEXT>\nsalmon fishing\n"
+                 "</TEXT>\n</DOC>\n")
+    out = str(tmp_path / "idx")
+    build_index([str(p)], out, k=1, num_shards=2, compute_chargrams=False)
+    scorer = Scorer.load(out)
+    with pytest.raises(ValueError, match="position"):
+        scorer.search('"salmon fishing"')
+
+
+def test_proximity_rerank_prefers_adjacent(phrase_index):
+    """--prox: same bag of words, but the doc where the query terms sit
+    adjacent outranks the doc where they are scattered."""
+    from tpu_ir.search import Scorer
+
+    scorer = Scorer.load(phrase_index)
+    base = scorer.search("salmon fishing", rerank=6)
+    boosted = scorer.search("salmon fishing", rerank=6, prox=True)
+    assert {d for d, _ in base} == {d for d, _ in boosted}
+    rank_b = {d: i for i, (d, _) in enumerate(boosted)}
+    # adjacent docs must beat the scattered one after the boost
+    assert rank_b["F-01"] < rank_b["F-06"]
+    assert rank_b["F-04"] < rank_b["F-06"]
+    # the boost is multiplicative and positive
+    s_base = dict(base)
+    s_boost = dict(boosted)
+    assert s_boost["F-01"] > s_base["F-01"]
+    # a doc with no co-occurrence proximity keeps its score
+    from tpu_ir.search.phrase import PROX_ALPHA, PhraseIndex
+
+    pidx = PhraseIndex(phrase_index)
+    docno_f06 = scorer.mapping.get_docno("F-06")
+    bonus = pidx.proximity_bonus(
+        scorer._query_term_sequence("salmon fishing"), docno_f06)
+    assert s_boost["F-06"] == pytest.approx(
+        s_base["F-06"] * (1 + PROX_ALPHA * bonus), rel=1e-5)
+
+
+def test_phrase_kgram_index(tmp_path):
+    """Phrase matching composes through a k=2 gram index: consecutive
+    gram positions differ by 1."""
+    from tpu_ir.search import Scorer
+
+    p = tmp_path / "c.trec"
+    p.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in {
+            "G-1": "big salmon fishing trip today",
+            "G-2": "salmon trip and fishing big today",
+        }.items()))
+    out = str(tmp_path / "idx2")
+    build_index([str(p)], out, k=2, num_shards=2, compute_chargrams=False,
+                positions=True)
+    scorer = Scorer.load(out)
+    got = {d for d, _ in scorer.search('"salmon fishing trip"')}
+    assert got == {"G-1"}
+
+
+def test_verify_checks_positions(phrase_index, tmp_path):
+    """tpu-ir verify validates position runs (length == tf, ascending,
+    inside the doc) and fails loudly on tampered artifacts."""
+    import shutil
+
+    from tpu_ir.index.verify import verify_index
+
+    out = verify_index(phrase_index)
+    assert out["ok"] and out["has_positions"]
+
+    tampered = str(tmp_path / "tampered")
+    shutil.copytree(phrase_index, tampered)
+    name = positions_name(0)
+    with np.load(os.path.join(tampered, name)) as z:
+        indptr, delta = z["pos_indptr"].copy(), z["pos_delta"].copy()
+    delta[0] = 10_000  # position way past any doc length
+    np.savez(os.path.join(tampered, name), pos_indptr=indptr,
+             pos_delta=delta)
+    with pytest.raises(AssertionError, match="position"):
+        verify_index(tampered)
+
+    # missing file also fails
+    os.unlink(os.path.join(tampered, name))
+    with pytest.raises(AssertionError, match="missing"):
+        verify_index(tampered)
+
+
+def test_stray_quote_falls_back_to_plain(tmp_path):
+    """An unbalanced or empty quote is punctuation, not a phrase: the
+    query runs plain — on a v1 index too, where a phrase would error."""
+    from tpu_ir.search import Scorer
+
+    p = tmp_path / "c.trec"
+    p.write_text("<DOC>\n<DOCNO> X </DOCNO>\n<TEXT>\nrack mount server\n"
+                 "</TEXT>\n</DOC>\n")
+    out = str(tmp_path / "idx")
+    build_index([str(p)], out, k=1, num_shards=2, compute_chargrams=False)
+    scorer = Scorer.load(out)  # v1: no positions
+    assert scorer.search('19" rack mount') == scorer.search("19 rack mount")
+    assert scorer.search('rack ""') == scorer.search("rack")
+
+
+def test_prox_requires_rerank(phrase_index):
+    from tpu_ir.search import Scorer
+
+    scorer = Scorer.load(phrase_index)
+    with pytest.raises(ValueError, match="rerank"):
+        scorer.search("salmon fishing", prox=True)
+
+
+def test_merge_preserves_positions(tmp_path):
+    """Merging position-built indexes keeps positions, byte-identical to
+    a one-shot positions build over the concatenated corpus; a mixed
+    v1+v2 merge is rejected loudly."""
+    import filecmp
+
+    from tpu_ir.index.merge import merge_indexes
+    from tpu_ir.index.verify import verify_index
+    from tpu_ir.search import Scorer
+
+    docs_a = {k: v for i, (k, v) in enumerate(PHRASE_DOCS.items())
+              if i % 2 == 0}
+    docs_b = {k: v for i, (k, v) in enumerate(PHRASE_DOCS.items())
+              if i % 2 == 1}
+
+    def write(name, docs):
+        p = tmp_path / name
+        p.write_text("".join(
+            f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+            for d, t in docs.items()))
+        return str(p)
+
+    ia, ib = str(tmp_path / "ia"), str(tmp_path / "ib")
+    build_index([write("a.trec", docs_a)], ia, k=1, num_shards=2,
+                compute_chargrams=False, positions=True)
+    build_index([write("b.trec", docs_b)], ib, k=1, num_shards=3,
+                compute_chargrams=False, positions=True)
+    direct = str(tmp_path / "direct")
+    build_index([write("both.trec", PHRASE_DOCS)], direct, k=1,
+                num_shards=4, compute_chargrams=False, positions=True)
+
+    merged = str(tmp_path / "merged")
+    meta = merge_indexes([ia, ib], merged, num_shards=4,
+                         compute_chargrams=False)
+    assert meta.has_positions and meta.version == 2
+    assert verify_index(merged)["ok"]
+    for s in range(4):
+        assert filecmp.cmp(os.path.join(direct, positions_name(s)),
+                           os.path.join(merged, positions_name(s)),
+                           shallow=False), s
+    # phrase queries work on the merged index
+    got = {d for d, _ in Scorer.load(merged).search('"salmon fishing"')}
+    assert got == {"F-01", "F-04"}
+
+    # mixed merge: one v1 source -> loud error
+    iv1 = str(tmp_path / "iv1")
+    build_index([write("c.trec", {"V1-1": "totally new words"})], iv1,
+                k=1, num_shards=2, compute_chargrams=False)
+    with pytest.raises(ValueError, match="positions"):
+        merge_indexes([ia, iv1], str(tmp_path / "bad"), num_shards=2,
+                      compute_chargrams=False)

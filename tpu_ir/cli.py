@@ -80,6 +80,11 @@ def cmd_index(args) -> int:
 
 
 def _run_index(args) -> int:
+    if args.streaming and args.positions:
+        print("error: --positions is not supported with --streaming yet; "
+              "build in-memory, or merge in-memory position-built indexes",
+              file=sys.stderr)
+        return 1
     if args.streaming:
         from .index.streaming import build_index_streaming
 
@@ -98,7 +103,7 @@ def _run_index(args) -> int:
             chargram_ks=args.chargram_k, num_shards=args.shards,
             overwrite=args.overwrite,
             compute_chargrams=not args.no_chargrams,
-            spmd_devices=args.spmd_devices)
+            spmd_devices=args.spmd_devices, positions=args.positions)
     print(json.dumps(meta.__dict__))
     return 0
 
@@ -124,7 +129,8 @@ def _run_search(args) -> int:
         kept = [q for q in queries if q not in skipped]
         results = iter(scorer.search_batch(
             kept, k=args.k, scoring=args.scoring,
-            return_docids=show_docids, rerank=args.rerank) if kept else [])
+            return_docids=show_docids, rerank=args.rerank,
+            prox=args.prox, phrase_slop=args.slop) if kept else [])
         if qids is None:
             qids = list(range(1, len(queries) + 1))
         for qid, q in zip(qids, queries):
@@ -437,6 +443,9 @@ def main(argv: list[str] | None = None) -> int:
                          "all_to_all shuffle, term-sharded reduce); implies "
                          "N index shards; composes with --streaming for "
                          "out-of-core corpora")
+    pi.add_argument("--positions", action="store_true",
+                    help="format v2: also write per-posting position runs "
+                         "(enables \"quoted phrase\" and --prox queries)")
     _add_backend_arg(pi)
     pi.set_defaults(fn=cmd_index)
 
@@ -456,6 +465,12 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--rerank", type=int, default=None, metavar="N",
                     help="two-stage retrieval: BM25 top-N candidates, then "
                          "cosine TF-IDF rerank")
+    ps.add_argument("--prox", action="store_true",
+                    help="add the positions-based proximity boost to the "
+                         "rerank (needs an index built with --positions)")
+    ps.add_argument("--slop", type=int, default=0, metavar="S",
+                    help="\"quoted phrase\" matching tolerates S extra "
+                         "token gaps (0 = exact adjacency)")
     ps.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded"],
                     default="auto",
@@ -556,6 +571,11 @@ def main(argv: list[str] | None = None) -> int:
     args = p.parse_args(argv)
     try:
         return args.fn(args)
+    except ValueError as e:
+        # user-facing capability/usage errors (unknown layout, phrase query
+        # on a v1 index, ...) print a clean message, not a traceback
+        print(f"error: {e}", file=sys.stderr)
+        return 1
     except BrokenPipeError:
         # downstream pipe (e.g. `| head`) closed early — standard unix exit;
         # handled here (not just under __main__) so the installed console

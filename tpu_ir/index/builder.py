@@ -62,8 +62,12 @@ def build_index(
     overwrite: bool = False,
     compute_chargrams: bool = True,
     spmd_devices: int | None = None,
+    positions: bool = False,
 ) -> fmt.IndexMetadata:
-    """Build every index artifact for a TREC corpus. Idempotent per artifact."""
+    """Build every index artifact for a TREC corpus. Idempotent per artifact.
+
+    `positions=True` additionally writes format-v2 per-posting position
+    runs (index/positions.py) enabling phrase/proximity queries."""
     if isinstance(corpus_paths, (str, os.PathLike)):
         corpus_paths = [corpus_paths]
     chargram_ks = list(chargram_ks)
@@ -255,6 +259,14 @@ def build_index(
                                indptr=local_indptr, pair_doc=s_doc,
                                pair_tf=s_tf, df=df[tids])
 
+    # --- format v2: per-posting position runs (optional) ---
+    if positions:
+        with report.phase("positions"):
+            from .positions import build_and_write_positions
+
+            build_and_write_positions(index_dir, flat_term_ids, docnos,
+                                      lengths, num_shards)
+
     # --- dictionary / forward index (BuildIntDocVectorsForwardIndex) ---
     with report.phase("dictionary"):
         fmt.write_dictionary(index_dir, vocab.terms, shard_of, offset_of)
@@ -265,7 +277,9 @@ def build_index(
     meta = fmt.IndexMetadata(
         num_docs=num_docs, vocab_size=v, k=k, num_shards=num_shards,
         num_pairs=num_pairs,
-        chargram_ks=chargram_ks if built_chargrams else [])
+        chargram_ks=chargram_ks if built_chargrams else [],
+        version=2 if positions else fmt.FORMAT_VERSION,
+        has_positions=bool(positions))
     meta.save(index_dir)
     report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     return meta

@@ -57,6 +57,9 @@ class IndexMetadata:
     num_pairs: int
     chargram_ks: list[int]
     version: int = FORMAT_VERSION
+    # format v2: optional per-posting position runs (positions-NNNNN.npz,
+    # index/positions.py); v1 metadata lacks the key and defaults False
+    has_positions: bool = False
 
     def save(self, index_dir: str) -> None:
         with open(os.path.join(index_dir, METADATA), "w") as f:

@@ -117,9 +117,21 @@ def merge_indexes(
             doc_len[docno_lut[i][1:]] = dl[1:]
         np.save(os.path.join(out_dir, fmt.DOCLEN), doc_len)
 
+    # ---- positions policy: merged output carries them iff every source
+    # does (a mixed merge would silently produce a phrase-incapable index
+    # for docs that paid the position build) ----
+    has_positions = all(m.has_positions for m in metas)
+    if any(m.has_positions for m in metas) and not has_positions:
+        raise ValueError(
+            "cannot merge: some sources carry positions and some do not "
+            f"({[(s, m.has_positions) for s, m in zip(sources, metas)]}); "
+            "rebuild the v1 sources with positions=True, or drop the "
+            "positions by rebuilding the v2 sources without them")
+
     # ---- postings: remap ids, one union lexsort, reshard ----
     with report.phase("merge_postings"):
         terms_l, docs_l, tfs_l = [], [], []
+        delta_l, rlen_l = [], []
         for i, s in enumerate(sources):
             for sh in range(metas[i].num_shards):
                 z = fmt.load_shard(s, sh)
@@ -128,6 +140,13 @@ def merge_indexes(
                 terms_l.append(t.astype(np.int32))
                 docs_l.append(docno_lut[i][z["pair_doc"]])
                 tfs_l.append(z["pair_tf"].astype(np.int32))
+                if has_positions:
+                    from .positions import positions_name
+
+                    with np.load(os.path.join(
+                            s, positions_name(sh))) as pz:
+                        delta_l.append(pz["pos_delta"])
+                        rlen_l.append(np.diff(pz["pos_indptr"]))
         pt = np.concatenate(terms_l) if terms_l else np.zeros(0, np.int32)
         pd = np.concatenate(docs_l) if docs_l else np.zeros(0, np.int32)
         ptf = np.concatenate(tfs_l) if tfs_l else np.zeros(0, np.int32)
@@ -139,6 +158,28 @@ def merge_indexes(
     with report.phase("write_shards"):
         shard_of, offset_of = fmt.write_pair_shards(out_dir, df, pd, ptf,
                                                     num_shards)
+
+    if has_positions:
+        # runs follow their pairs through the union sort: gather each
+        # run's delta block into the new pair order (deltas are per-run
+        # local, so reordering runs never re-encodes), then reshard with
+        # the same order-preserving term_id % S split as the pairs —
+        # byte-identical to a one-shot positions build by construction
+        with report.phase("merge_positions"):
+            from .positions import write_position_shards
+
+            all_delta = (np.concatenate(delta_l) if delta_l
+                         else np.zeros(0, np.int32))
+            all_len = (np.concatenate(rlen_l).astype(np.int64) if rlen_l
+                       else np.zeros(0, np.int64))
+            starts = np.concatenate([[0], np.cumsum(all_len)])[:-1]
+            new_len = all_len[order]
+            out_indptr = np.concatenate([[0], np.cumsum(new_len)])
+            gather = (np.repeat(starts[order], new_len)
+                      + np.arange(int(new_len.sum()))
+                      - np.repeat(out_indptr[:-1], new_len))
+            write_position_shards(out_dir, pt, out_indptr,
+                                  all_delta[gather], num_shards)
 
     with report.phase("dictionary"):
         fmt.write_dictionary(out_dir, merged_terms, shard_of, offset_of)
@@ -169,7 +210,9 @@ def merge_indexes(
     meta = fmt.IndexMetadata(
         num_docs=num_docs, vocab_size=v_size, k=k, num_shards=num_shards,
         num_pairs=int(len(pt)),
-        chargram_ks=chargram_ks if built_chargrams else [])
+        chargram_ks=chargram_ks if built_chargrams else [],
+        version=2 if has_positions else fmt.FORMAT_VERSION,
+        has_positions=has_positions)
     meta.save(out_dir)
     report.save(os.path.join(out_dir, fmt.JOBS_DIR))
     return meta

@@ -85,6 +85,30 @@ def verify_index(index_dir: str) -> dict:
             key.sort()
             assert not (np.diff(key) == 0).any(), \
                 f"shard {s}: duplicate docno"
+        # format v2: each pair row's position run must exist, be exactly
+        # tf long, strictly ascend, and stay inside the doc's token count
+        if meta.has_positions:
+            from .positions import positions_name
+
+            ppath = os.path.join(index_dir, positions_name(s))
+            assert os.path.exists(ppath), f"shard {s}: positions file missing"
+            with np.load(ppath) as pz:
+                p_indptr, p_delta = pz["pos_indptr"], pz["pos_delta"]
+            assert len(p_indptr) == len(pd) + 1, \
+                f"shard {s}: positions indptr length"
+            assert (np.diff(p_indptr) == ptf).all(), \
+                f"shard {s}: position run length != tf"
+            if len(p_delta):
+                firsts = p_indptr[:-1].astype(np.int64)
+                mask = np.ones(len(p_delta), bool)
+                mask[firsts] = False   # first delta is the absolute position
+                assert (p_delta[firsts] >= 0).all(), \
+                    f"shard {s}: negative position"
+                assert (p_delta[mask] >= 1).all(), \
+                    f"shard {s}: positions not strictly ascending"
+                last_pos = np.add.reduceat(p_delta.astype(np.int64), firsts)
+                assert (last_pos < doc_len[pd]).all(), \
+                    f"shard {s}: position beyond document length"
         df_global[tids] = df
         total_pairs += int(indptr[-1])
         total_tf += int(ptf.sum())
@@ -121,6 +145,7 @@ def verify_index(index_dir: str) -> dict:
 
     return {
         "dictionary_terms_checked": dict_checked,
+        "has_positions": meta.has_positions,
         "num_docs": meta.num_docs,
         "vocab_size": meta.vocab_size,
         "num_pairs": total_pairs,

@@ -110,6 +110,7 @@ class Scorer:
         self._index_dir: str | None = index_dir
         self._wildcard = None
         self._wildcard_tried = False
+        self._phrase = None  # lazy PhraseIndex (format-v2 positions)
         self._pairs_cols = (None if pair_term is None
                             else (pair_term, pair_doc, pair_tf))
         self._pairs_loader = pairs_loader
@@ -700,12 +701,41 @@ class Scorer:
     def search_batch(
         self, texts: Sequence[str], k: int = 10, scoring: str = "tfidf",
         return_docids: bool = True, rerank: int | None = None,
+        prox: bool = False, phrase_slop: int = 0,
     ) -> list[SearchResult]:
         """Ranked retrieval for query texts. `rerank=N` switches to the
-        two-stage pipeline: BM25 top-N candidates, cosine TF-IDF rerank."""
+        two-stage pipeline: BM25 top-N candidates, cosine TF-IDF rerank;
+        `prox=True` adds the positions-based proximity boost to the rerank
+        (search/phrase.py). Queries containing double-quoted spans run as
+        phrase queries (ordered window, `phrase_slop` extra token gaps) —
+        both need a format-v2 index built with positions."""
+        if prox and not rerank:
+            raise ValueError("the proximity boost is stage 3 of the "
+                             "two-stage rerank; pass rerank=N (--rerank) "
+                             "together with prox (--prox)")
+        texts = list(texts)
+        plain = [t for t in texts if '"' not in t]
+        plain_iter = iter(self._search_batch_plain(
+            plain, k=k, scoring=scoring, return_docids=return_docids,
+            rerank=rerank, prox=prox) if plain else [])
+        return [self._search_phrase(t, k=k, scoring=scoring,
+                                    slop=phrase_slop,
+                                    return_docids=return_docids)
+                if '"' in t else next(plain_iter) for t in texts]
+
+    def _search_batch_plain(
+        self, texts: Sequence[str], *, k: int, scoring: str,
+        return_docids: bool, rerank: int | None, prox: bool,
+    ) -> list[SearchResult]:
         q = self.analyze_queries(texts)
         if rerank:
-            scores, docnos = self.rerank_topk(q, k=k, candidates=rerank)
+            from .phrase import PROX_DEPTH
+
+            kk = max(k, min(PROX_DEPTH, rerank)) if prox else k
+            scores, docnos = self.rerank_topk(q, k=kk, candidates=rerank)
+            if prox:
+                scores, docnos = self._apply_proximity(
+                    texts, np.asarray(scores), np.asarray(docnos), k)
         else:
             scores, docnos = self.topk(q, k=k, scoring=scoring)
         out = []
@@ -719,7 +749,92 @@ class Scorer:
             out.append(res)
         return out
 
+    # -- positions-backed retrieval (format v2) ---------------------------
+
+    def _phrase_index(self):
+        if self._phrase is None:
+            if self._index_dir is None:
+                raise ValueError("phrase/proximity queries need an index "
+                                 "directory (Scorer built from arrays)")
+            from .phrase import PhraseIndex
+
+            self._phrase = PhraseIndex(self._index_dir, meta=self.meta)
+        return self._phrase
+
+    def _query_term_sequence(self, text: str) -> list[str]:
+        """The query's analyzed index-term sequence (k-grams composed) —
+        the coordinate system position runs are stored in."""
+        return kgram_terms(self._analyzer.analyze(text), self.meta.k)
+
+    def _search_phrase(self, text: str, *, k: int, scoring: str, slop: int,
+                       return_docids: bool) -> SearchResult:
+        """One phrase query: every quoted span must match as an ordered
+        window; matching docs are ranked by the standard scoring model
+        over ALL query terms (host — a phrase-filtered candidate set is
+        KB-scale and cannot amortize a device dispatch)."""
+        from .phrase import score_docs_host, split_phrases
+
+        # extract phrases BEFORE touching the position artifacts: a stray
+        # or empty quote ('19" rack') is a plain query on any index, v1
+        # included — only a real phrase needs format v2
+        _, phrases = split_phrases(text)
+        analyzed = [(p, self._query_term_sequence(p)) for p in phrases]
+        analyzed = [(p, toks) for p, toks in analyzed if toks]
+        if not analyzed:
+            return self._search_batch_plain(
+                [text.replace('"', ' ')], k=k, scoring=scoring,
+                return_docids=return_docids, rerank=None, prox=False)[0]
+        pidx = self._phrase_index()
+        matched: set[int] | None = None
+        for _, toks in analyzed:
+            docs = set(pidx.match_window(toks, slop=slop))
+            matched = docs if matched is None else matched & docs
+            if not matched:
+                return SearchResult()
+        all_terms = self._query_term_sequence(text.replace('"', ' '))
+        docnos, scores = score_docs_host(
+            all_terms, sorted(matched), dictionary=pidx._dict,
+            num_docs=self.meta.num_docs,
+            doc_len=np.asarray(self.doc_len),
+            scoring=scoring, compat_int_idf=self.compat_int_idf)
+        order = np.lexsort((docnos, -scores))[:k]
+        res = SearchResult()
+        for i in order:
+            if scores[i] <= 0:
+                continue
+            dn = int(docnos[i])
+            key = self.mapping.get_docid(dn) if return_docids else dn
+            res.append((key, float(scores[i])))
+        return res
+
+    def _apply_proximity(self, texts, scores, docnos, k: int):
+        """Stage 3 of the rerank: boost each candidate by the query's
+        positional proximity in it — score * (1 + PROX_ALPHA * bonus),
+        bonus = sum over adjacent query-term pairs of 1/(1+min_gap)
+        (search/phrase.py). Host work bounded by PROX_DEPTH candidates."""
+        from .phrase import PROX_ALPHA
+
+        pidx = self._phrase_index()
+        b, kk = scores.shape
+        out_s = np.zeros((b, k), np.float32)
+        out_d = np.zeros((b, k), np.int32)
+        for qi, text in enumerate(texts):
+            terms = self._query_term_sequence(text)
+            row_s = scores[qi].astype(np.float64).copy()
+            for j in range(kk):
+                dn = int(docnos[qi, j])
+                if dn > 0 and row_s[j] > 0 and len(terms) > 1:
+                    row_s[j] *= 1.0 + PROX_ALPHA * pidx.proximity_bonus(
+                        terms, dn)
+            order = np.lexsort((docnos[qi], -row_s))[:k]
+            valid = row_s[order] > 0
+            out_s[qi, : valid.sum()] = row_s[order][valid]
+            out_d[qi, : valid.sum()] = docnos[qi][order][valid]
+        return out_s, out_d
+
     def search(self, text: str, k: int = 10, scoring: str = "tfidf",
-               return_docids: bool = True) -> SearchResult:
+               return_docids: bool = True, rerank: int | None = None,
+               prox: bool = False, phrase_slop: int = 0) -> SearchResult:
         return self.search_batch([text], k=k, scoring=scoring,
-                                 return_docids=return_docids)[0]
+                                 return_docids=return_docids, rerank=rerank,
+                                 prox=prox, phrase_slop=phrase_slop)[0]
