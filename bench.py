@@ -187,6 +187,72 @@ def _ndcg_at_k(grades: list, got_docnos: np.ndarray, k: int = 10) -> float:
     return round(total / len(grades), 4)
 
 
+def _mrr_binary(grades: list, got_docnos: np.ndarray) -> float:
+    """MRR under trec_eval's binary-relevance convention: the first
+    ranked doc with ANY positive grade counts (unlike _mrr_at_k, which
+    tracks only the planted grade-2 doc)."""
+    rr = 0.0
+    for qi, g in enumerate(grades):
+        for r, d in enumerate(got_docnos[qi]):
+            if g.get(int(d), 0) > 0:
+                rr += 1.0 / (r + 1)
+                break
+    return round(rr / len(grades), 4)
+
+
+def _eval_loop_roundtrip(tmp: str, index_dir: str, queries, grades,
+                         bm25_docnos10,
+                         m_eval_cap: int = 300) -> dict:
+    """topics -> `tpu-ir search --topics --trec-run` -> run file ->
+    evaluate_run(qrels). Returns the loop's metrics plus an "eval_loop"
+    verdict that must be "ok": the run-file MRR@10 and (exp-gain) NDCG@10
+    must equal the in-process BM25 numbers on the same query subset."""
+    import contextlib
+    import io
+
+    from tpu_ir.cli import main as cli_main
+    from tpu_ir.search.evaluate import evaluate_run, read_qrels, read_run
+
+    m_eval = min(m_eval_cap, len(queries))
+    topics = os.path.join(tmp, "topics.trec")
+    with open(topics, "w") as f:
+        for qi in range(m_eval):
+            f.write(f"<top>\n<num> Number: {qi + 1}\n"
+                    f"<title> {queries[qi]}\n</top>\n")
+    qrels_path = os.path.join(tmp, "qrels.txt")
+    with open(qrels_path, "w") as f:
+        for qi in range(m_eval):
+            for docno, grade in grades[qi].items():
+                f.write(f"{qi + 1} 0 MSM-{docno - 1:06d} {grade}\n")
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main(["search", index_dir, "--topics", topics,
+                       "--scoring", "bm25", "--k", "10",
+                       "--trec-run", "bench"])
+    run_path = os.path.join(tmp, "run.txt")
+    with open(run_path, "w") as f:
+        f.write(buf.getvalue())
+    if rc != 0:
+        return {"eval_loop": f"search exited {rc}"}
+    ev = evaluate_run(read_run(run_path), read_qrels(qrels_path),
+                      complete=True, exp_gains=True)
+    want_mrr = _mrr_binary(grades[:m_eval], bm25_docnos10[:m_eval])
+    want_ndcg = _ndcg_at_k(grades[:m_eval], bm25_docnos10[:m_eval])
+    ok = (ev.get("queries") == m_eval
+          and abs(ev["mrr"] - want_mrr) < 1e-3
+          and abs(ev["ndcg_at_10"] - want_ndcg) < 1e-3)
+    return {
+        "eval_loop": "ok" if ok else (
+            f"mismatch: run mrr={ev.get('mrr')} vs {want_mrr}, "
+            f"ndcg={ev.get('ndcg_at_10')} vs {want_ndcg}, "
+            f"queries={ev.get('queries')} vs {m_eval}"),
+        "eval_loop_queries": m_eval,
+        "eval_loop_mrr": ev.get("mrr", -1.0),
+        "eval_loop_ndcg_at_10": ev.get("ndcg_at_10", -1.0),
+        "eval_loop_map": ev.get("map", -1.0),
+    }
+
+
 # minimum msmarco query count for the gate's margins to be meaningful
 _GATE_MIN_QUERIES = 200
 
@@ -236,14 +302,25 @@ def run_msmarco(args) -> dict:
 
         metrics: dict[str, float] = {}
         speeds: dict[str, float] = {}
+        bm25_docnos10 = None
         for scoring in ("tfidf", "bm25"):
             scorer.topk(q_ids, k=10, scoring=scoring)  # compile
             t0 = time.perf_counter()
             _, docnos10 = scorer.topk(q_ids, k=10, scoring=scoring)
             dt = time.perf_counter() - t0
+            if scoring == "bm25":
+                bm25_docnos10 = docnos10
             metrics[f"{scoring}_mrr_at_10"] = _mrr_at_k(rel_docnos, docnos10)
             metrics[f"{scoring}_ndcg_at_10"] = _ndcg_at_k(grades, docnos10)
             speeds[f"{scoring}_queries_per_sec"] = round(n_queries / dt, 1)
+
+        # full standard eval loop (VERDICT r2 next #7): TREC topics file
+        # -> CLI --trec-run run file -> evaluate_run against qrels. The
+        # loop must REPRODUCE the in-process BM25 MRR@10/NDCG@10 on the
+        # same query subset exactly — it exercises topics parsing, batch
+        # search, run emission, and both eval readers end to end.
+        eval_out = _eval_loop_roundtrip(
+            tmp, index_dir, queries, grades, bm25_docnos10)
 
         m = min(256, n_queries)
         scorer.topk(q_ids[:m], k=1000, scoring="bm25")  # compile
@@ -292,6 +369,7 @@ def run_msmarco(args) -> dict:
         "top1000_recall": round(recall1k, 4),
         "quality_gate": "ok" if not gate else "; ".join(gate),
         "quality_gate_enforced": n_queries >= _GATE_MIN_QUERIES,
+        **eval_out,
         "layout": scorer.layout,
         "config": "msmarco",
     }
@@ -334,32 +412,175 @@ if {cpu!r}:
         if name != "cpu":
             xb._backend_factories.pop(name, None)
 import jax
-from tpu_ir.search import Scorer
+jax.devices()  # force backend/tunnel init so it lands in INIT_S, not load
+from tpu_ir.search import Scorer  # library imports are process cost too
+init_s = time.perf_counter() - t0
+t1 = time.perf_counter()
 s = Scorer.load({index_dir!r}, layout="auto")
 arrays = [s.df, s.doc_len] + [getattr(s, n, None) for n in (
     "hot_tfs", "doc_matrix", "hot_rank", "tier_of", "row_of",
     "tier_docs", "tier_tfs")]
 jax.block_until_ready([a for a in arrays if a is not None])
 print("WARM_LOAD_S=" + str(time.perf_counter() - t0))
+print("WARM_INIT_S=" + str(init_s))
+print("WARM_INDEX_S=" + str(time.perf_counter() - t1))
 """
 
 
-def _warm_load_subprocess(index_dir: str, cpu: bool) -> float:
-    """Time Scorer.load in a fresh interpreter (true process restart,
-    jax init included). Returns -1.0 if the child fails."""
+def _warm_load_subprocess(index_dir: str, cpu: bool) -> dict:
+    """Time Scorer.load in a fresh interpreter (true process restart).
+    Splits the PROCESS-fixed cost (python + jax import + backend/tunnel
+    init — paid by any jax program, index or not) from the index-load
+    cost proper, so a large fixed cost cannot masquerade as a slow load
+    (VERDICT r2 weak #2). Values are -1.0 if the child fails."""
     import subprocess
 
+    out = {"scorer_load_warm_s": -1.0, "warm_process_fixed_s": -1.0,
+           "warm_index_load_s": -1.0}
     try:
         r = subprocess.run(
             [sys.executable, "-c",
              _WARM_LOAD_CODE.format(cpu=cpu, index_dir=index_dir)],
             capture_output=True, text=True, timeout=3600)
         for line in r.stdout.splitlines():
-            if line.startswith("WARM_LOAD_S="):
-                return round(float(line.split("=", 1)[1]), 2)
+            for key, tag in (("scorer_load_warm_s", "WARM_LOAD_S="),
+                             ("warm_process_fixed_s", "WARM_INIT_S="),
+                             ("warm_index_load_s", "WARM_INDEX_S=")):
+                if line.startswith(tag):
+                    out[key] = round(float(line.split("=", 1)[1]), 2)
     except (subprocess.SubprocessError, OSError, ValueError):
         pass
-    return -1.0
+    return out
+
+
+def transport_probe() -> dict:
+    """Transport fingerprint: H2D / D2H bandwidth on a 32 MB buffer plus
+    the scalar-fetch round trip (p50 of 20). These are the numbers that
+    move when the tunnel has a bad day — recording them in the bench JSON
+    makes a throughput swing attributable from the artifact alone
+    (VERDICT r2 weak #1: the round-2 record halved with no way to tell a
+    tunnel day from a code regression)."""
+    import jax
+    import jax.numpy as jnp
+
+    mb = 32
+    buf = np.random.default_rng(0).integers(
+        0, 255, mb << 20, dtype=np.uint8)
+    d = jax.device_put(buf)          # warm allocator + any lazy init
+    jax.block_until_ready(d)
+    t0 = time.perf_counter()
+    d = jax.device_put(buf)
+    jax.block_until_ready(d)
+    h2d_s = time.perf_counter() - t0
+    np.asarray(d[: 1 << 20])         # warm the fetch path
+    t0 = time.perf_counter()
+    np.asarray(d)
+    d2h_s = time.perf_counter() - t0
+    x = jnp.zeros(())
+    jax.block_until_ready(x)
+    rtts = []
+    for _ in range(20):
+        t0 = time.perf_counter()
+        np.asarray(x)
+        rtts.append(time.perf_counter() - t0)
+    return {
+        "h2d_mbps": round(mb / h2d_s, 1),
+        "d2h_mbps": round(mb / d2h_s, 1),
+        "device_rtt_ms": round(float(np.percentile(rtts, 50)) * 1e3, 2),
+    }
+
+
+def device_build_control(corpus: str, reps: int = 3) -> dict:
+    """Transport-INDEPENDENT build control: the exact device program the
+    builder runs (same prep, same shapes, same data), timed with
+    block_until_ready and NO result fetch — pure dispatch + device
+    compute. If docs/s drops across rounds while this number holds, the
+    loss is transport/host, not the device pipeline; if this moves, the
+    code regressed. Also reports the host tokenize time separately."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_ir.analysis.native import tokenize_corpus_native
+    from tpu_ir.ops import PAD_TERM, PAD_TERM_U16, build_postings_packed_jit
+
+    t0 = time.perf_counter()
+    docids, temp_ids, lengths, vocab_list = tokenize_corpus_native([corpus])
+    tokenize_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    vocab_arr = np.array(vocab_list, dtype=np.str_)
+    order = np.argsort(vocab_arr)
+    rank = np.empty(len(order), np.int64)
+    rank[order] = np.arange(len(order))
+    flat_term_ids = rank[temp_ids].astype(np.int32)
+    docnos = (np.argsort(np.argsort(np.array(docids, dtype=np.str_)))
+              + 1).astype(np.int32)
+    v = len(vocab_list)
+    occurrences = len(flat_term_ids)
+    granule = 1 << 18
+    cap = max(granule, (occurrences + granule - 1) // granule * granule)
+    use16 = v < int(PAD_TERM_U16)
+    term_ids = np.full(cap, PAD_TERM_U16 if use16 else PAD_TERM,
+                       np.uint16 if use16 else np.int32)
+    term_ids[:occurrences] = flat_term_ids
+    host_prep_s = time.perf_counter() - t0
+
+    t_dev, l_dev = jnp.asarray(term_ids), jnp.asarray(
+        lengths.astype(np.int32))
+    d_dev = jnp.asarray(docnos)
+    times = []
+    for _ in range(reps + 1):  # first rep includes compile; dropped
+        t0 = time.perf_counter()
+        p = build_postings_packed_jit(t_dev, d_dev, l_dev, vocab_size=v,
+                                      num_docs=len(docids))
+        jax.block_until_ready((p.pair_doc, p.pair_tf, p.df))
+        times.append(time.perf_counter() - t0)
+    return {
+        "control_tokenize_s": round(tokenize_s, 3),
+        "control_host_prep_s": round(host_prep_s, 3),
+        "control_device_build_s": round(min(times[1:]), 3),
+        "control_device_build_runs": [round(t, 3) for t in times[1:]],
+    }
+
+
+def _build_phase_timings(index_dir: str) -> dict:
+    """Surface the builder's own JobReport phase timings into the bench
+    JSON (they were always recorded, never published — VERDICT r2 next #1)."""
+    import glob
+
+    for path in glob.glob(os.path.join(index_dir, "jobs",
+                                       "TermKGramDocIndexer*.json")):
+        with open(path) as f:
+            rep = json.load(f)
+        return {f"phase_{k}_s": v for k, v in sorted(
+            rep.get("timings_s", {}).items())}
+    return {}
+
+
+def _cpu_control_subprocess(timeout_s: int = 900) -> dict:
+    """Run the build-only bench on the CPU backend in a subprocess: a
+    transport-free, device-free control of the SAME code path. Stable
+    across tunnel days; moves only when the code does."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--cpu",
+             "--build-only"],
+            capture_output=True, text=True, timeout=timeout_s,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        for line in reversed(r.stdout.splitlines()):
+            if line.startswith("{"):
+                child = json.loads(line)
+                return {
+                    "cpu_control_docs_per_sec": child.get("value", -1.0),
+                    "cpu_control_index_wall_s": child.get(
+                        "index_wall_s", -1.0),
+                }
+    except (subprocess.SubprocessError, OSError, ValueError):
+        pass
+    return {"cpu_control_docs_per_sec": -1.0,
+            "cpu_control_index_wall_s": -1.0}
 
 
 def _tpu_probe_ok(timeout_s: int = 120) -> bool:
@@ -388,6 +609,13 @@ def main() -> int:
                     help="force CPU backend (local-mode equivalent)")
     ap.add_argument("--queries", type=int, default=None,
                     help="query-batch size (default: 10000; msmarco: 2000)")
+    ap.add_argument("--build-only", action="store_true",
+                    help="corpus + warmup + timed builds only (used as the "
+                         "CPU control subprocess; skips serving/query/"
+                         "control measurements)")
+    ap.add_argument("--no-controls", action="store_true",
+                    help="skip the transport probe, device-only build "
+                         "control, and CPU control subprocess")
     ap.add_argument("--config",
                     choices=["ref", "wiki100k", "wiki1m", "msmarco"],
                     default="ref",
@@ -433,6 +661,10 @@ def main() -> int:
         out["backend"] = backend
         print(json.dumps(out))
         if out["quality_gate_enforced"] and out["quality_gate"] != "ok":
+            return 1
+        # the eval loop is a deterministic correctness assertion (same
+        # index, same queries, same scorer) — any mismatch fails
+        if out.get("eval_loop") != "ok":
             return 1
         return 0
 
@@ -481,6 +713,34 @@ def main() -> int:
                 shutil.rmtree(out)
         build_s = min(runs)
         docs_per_sec = DOC_COUNT / build_s
+        phases = _build_phase_timings(index_dir)
+
+        if args.build_only:
+            print(json.dumps({
+                "metric": "docs_per_sec_indexed",
+                "value": round(docs_per_sec, 1),
+                "unit": "docs/s",
+                "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC,
+                                     2),
+                "index_wall_s": round(build_s, 2),
+                "index_wall_s_runs": [round(r, 2) for r in runs],
+                "backend": backend,
+                "config": args.config,
+                "build_only": True,
+                **phases,
+            }))
+            return 0
+
+        # self-attribution controls (VERDICT r2 next #1): transport
+        # fingerprint + transport-independent device-only build + a
+        # CPU-backend build of the same code — together they say whether
+        # a cross-round throughput swing is tunnel weather or a regression
+        controls: dict = {}
+        if not args.no_controls:
+            controls.update(transport_probe())
+            controls.update(device_build_control(corpus))
+            if not args.cpu and args.config == "ref":
+                controls.update(_cpu_control_subprocess())
 
         # post-build verification gate (VERDICT r1 item 5): the vectorized
         # structural check must hold — and stay fast — at every bench scale
@@ -506,7 +766,7 @@ def main() -> int:
         scorer = Scorer.load(index_dir, layout="auto")
         _await_device(scorer)
         load_cold_s = time.perf_counter() - t0
-        load_warm_s = _warm_load_subprocess(index_dir, cpu=args.cpu)
+        warm = _warm_load_subprocess(index_dir, cpu=args.cpu)
         rng = np.random.default_rng(1)
         v = scorer.meta.vocab_size
         q_ids = rng.integers(0, v, size=(args.queries, 2)).astype(np.int32)
@@ -550,11 +810,15 @@ def main() -> int:
         "query_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
         "query_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
         "scorer_load_cold_s": round(load_cold_s, 2),
-        "scorer_load_warm_s": round(load_warm_s, 2),
+        # warm load split: total = process-fixed (python+jax+tunnel init,
+        # paid by ANY jax program) + the index load proper
+        **warm,
         "verify_s": round(verify_s, 2),
         "recall_at_10": recall,
         "backend": backend,
         "config": args.config,
+        **phases,
+        **controls,
     }
     print(json.dumps(out))
     return 0

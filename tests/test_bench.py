@@ -107,3 +107,32 @@ def test_ndcg_at_k():
     # query 1: dcg = 1/log2(2) + 3/log2(3); idcg = 3/log2(2) + 1/log2(3)
     q1 = (1.0 + 3 / np.log2(3)) / (3.0 + 1 / np.log2(3))
     assert bench._ndcg_at_k(grades, got) == round((q1 + 0.0) / 2, 4)
+
+
+def test_eval_loop_roundtrip(tmp_path):
+    """The bench's topics -> CLI --trec-run -> evaluate_run loop must
+    reproduce in-process BM25 metrics exactly, and flag any divergence."""
+    import bench
+    from tpu_ir.index import build_index
+    from tpu_ir.search import Scorer
+
+    corpus = str(tmp_path / "c.trec")
+    queries, rel, grades = bench.make_quality_corpus(
+        corpus, n_docs=400, n_queries=24)
+    idx = str(tmp_path / "idx")
+    build_index([corpus], idx, k=1, chargram_ks=[], num_shards=3,
+                compute_chargrams=False)
+    scorer = Scorer.load(idx, layout="dense")
+    q = scorer.analyze_queries(queries, max_terms=4)
+    _, d10 = scorer.topk(q, k=10, scoring="bm25")
+
+    out = bench._eval_loop_roundtrip(str(tmp_path), idx, queries, grades,
+                                     d10)
+    assert out["eval_loop"] == "ok", out
+    assert out["eval_loop_queries"] == 24
+    assert 0 < out["eval_loop_mrr"] <= 1
+
+    # a diverging in-process ranking must be flagged, not silently passed
+    bad = bench._eval_loop_roundtrip(str(tmp_path), idx, queries, grades,
+                                     np.zeros_like(d10))
+    assert bad["eval_loop"].startswith("mismatch")

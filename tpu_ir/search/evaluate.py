@@ -53,7 +53,8 @@ def read_qrels(path: str) -> dict[str, dict[str, int]]:
 
 def evaluate_run(run: dict[str, list[str]],
                  qrels: dict[str, dict[str, int]],
-                 complete: bool = False) -> dict:
+                 complete: bool = False,
+                 exp_gains: bool = False) -> dict:
     """Mean metrics over judged queries.
 
     Default (trec_eval convention): averages over qids present in BOTH
@@ -86,10 +87,13 @@ def evaluate_run(run: dict[str, list[str]],
                     rr = 1.0 / i
         ap_l.append(ap / n_rel if n_rel else 0.0)
         rr_l.append(rr)
-        dcg = sum(max(grades.get(d, 0), 0) / math.log2(i + 1)
+        # gains: linear (trec_eval ndcg) or 2^g - 1 (web-search form,
+        # exp_gains=True) — the latter matches bench.py::_ndcg_at_k
+        gain = (lambda g: 2.0 ** g - 1) if exp_gains else (lambda g: g)
+        dcg = sum(gain(max(grades.get(d, 0), 0)) / math.log2(i + 1)
                   for i, d in enumerate(ranked[:10], 1))
         ideal = sorted((g for g in grades.values() if g > 0), reverse=True)
-        idcg = sum(g / math.log2(i + 1)
+        idcg = sum(gain(g) / math.log2(i + 1)
                    for i, g in enumerate(ideal[:10], 1))
         ndcg_l.append(dcg / idcg if idcg > 0 else 0.0)
         p5_l.append(sum(1 for d in ranked[:5] if d in rel) / 5.0)
