@@ -436,7 +436,7 @@ def main(argv: list[str] | None = None) -> int:
     pi.add_argument("--streaming", action="store_true",
                     help="out-of-core spill/merge build for corpora larger "
                          "than memory")
-    pi.add_argument("--batch-docs", type=int, default=20000,
+    pi.add_argument("--batch-docs", type=int, default=50000,
                     help="streaming: documents per tokenize batch")
     pi.add_argument("--spmd-devices", type=int, default=None,
                     help="build over an N-device mesh (doc-sharded map, "

@@ -74,7 +74,7 @@ def build_index_multihost(
     k: int = 1,
     chargram_ks: Sequence[int] = (2, 3),
     compute_chargrams: bool = True,
-    batch_docs: int = 20_000,
+    batch_docs: int = 50_000,  # see streaming.py: fewer lockstep steps
     keep_spills: bool = False,
 ) -> "object":
     """End-to-end STREAMING multi-host index build over the global mesh.
