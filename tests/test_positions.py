@@ -378,3 +378,98 @@ def test_merge_preserves_positions(tmp_path):
     with pytest.raises(ValueError, match="positions"):
         merge_indexes([ia, iv1], str(tmp_path / "bad"), num_shards=2,
                       compute_chargrams=False)
+
+
+def test_streaming_positions_equal_in_memory(tmp_path):
+    """Streaming builds (single-device AND SPMD pass 2) with positions
+    produce part AND positions files byte-identical to the in-memory
+    positions build at the same shard count, and phrase queries work."""
+    import filecmp
+
+    from tpu_ir.index.streaming import build_index_streaming
+    from tpu_ir.index.verify import verify_index
+    from tpu_ir.search import Scorer
+
+    p = tmp_path / "c.trec"
+    p.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in PHRASE_DOCS.items()))
+
+    mem = str(tmp_path / "mem")
+    build_index([str(p)], mem, k=1, num_shards=8, compute_chargrams=False,
+                positions=True)
+
+    stream = str(tmp_path / "stream")
+    meta = build_index_streaming([str(p)], stream, k=1, num_shards=8,
+                                 batch_docs=3, compute_chargrams=False,
+                                 positions=True)
+    assert meta.has_positions and meta.version == 2
+    assert verify_index(stream)["ok"]
+
+    spmd = str(tmp_path / "spmd")
+    build_index_streaming([str(p)], spmd, k=1, batch_docs=3,
+                          compute_chargrams=False, positions=True,
+                          spmd_devices=8)
+    assert verify_index(spmd)["ok"]
+
+    for s in range(8):
+        for name in (fmt.part_name(s), positions_name(s)):
+            assert filecmp.cmp(os.path.join(mem, name),
+                               os.path.join(stream, name),
+                               shallow=False), ("stream", name)
+            assert filecmp.cmp(os.path.join(mem, name),
+                               os.path.join(spmd, name),
+                               shallow=False), ("spmd", name)
+
+    got = {d for d, _ in Scorer.load(stream).search('"salmon fishing"')}
+    assert got == {"F-01", "F-04"}
+
+
+def test_streaming_positions_resume(tmp_path, monkeypatch):
+    """Crash-resume with positions: restart after a mid-pass-2 crash
+    without re-tokenizing; positions files byte-identical to a clean
+    streaming build."""
+    import filecmp
+
+    import tpu_ir.index.streaming as streaming
+    from tpu_ir.index.streaming import build_index_streaming
+    from tpu_ir.index.verify import verify_index
+
+    p = tmp_path / "c.trec"
+    p.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in PHRASE_DOCS.items()))
+    kw = dict(k=1, num_shards=3, batch_docs=2, compute_chargrams=False,
+              positions=True)
+
+    ref_dir = str(tmp_path / "ref")
+    real_tok = streaming.make_chunked_tokenizer
+    monkeypatch.setattr(
+        streaming, "make_chunked_tokenizer",
+        lambda paths, k=1: real_tok(paths, k=k, chunk_bytes=120))
+    build_index_streaming([str(p)], ref_dir, **kw)
+
+    out = str(tmp_path / "idx")
+    real_post = streaming.build_postings_packed_jit
+    calls = {"n": 0}
+
+    def crashing(*a, **kws):
+        calls["n"] += 1
+        if calls["n"] == 2:
+            raise RuntimeError("injected")
+        return real_post(*a, **kws)
+
+    monkeypatch.setattr(streaming, "build_postings_packed_jit", crashing)
+    with pytest.raises(RuntimeError, match="injected"):
+        build_index_streaming([str(p)], out, **kw)
+    monkeypatch.setattr(streaming, "build_postings_packed_jit", real_post)
+    monkeypatch.setattr(
+        streaming, "make_chunked_tokenizer",
+        lambda *a, **kws: (_ for _ in ()).throw(
+            AssertionError("resume must not re-tokenize")))
+    build_index_streaming([str(p)], out, **kw)
+    assert verify_index(out)["ok"]
+    for s in range(3):
+        for name in (fmt.part_name(s), positions_name(s)):
+            assert filecmp.cmp(os.path.join(ref_dir, name),
+                               os.path.join(out, name), shallow=False), name

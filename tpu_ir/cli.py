@@ -80,11 +80,6 @@ def cmd_index(args) -> int:
 
 
 def _run_index(args) -> int:
-    if args.streaming and args.positions:
-        print("error: --positions is not supported with --streaming yet; "
-              "build in-memory, or merge in-memory position-built indexes",
-              file=sys.stderr)
-        return 1
     if args.streaming:
         from .index.streaming import build_index_streaming
 
@@ -94,7 +89,7 @@ def _run_index(args) -> int:
             batch_docs=args.batch_docs,
             compute_chargrams=not args.no_chargrams,
             spmd_devices=args.spmd_devices,
-            overwrite=args.overwrite)
+            overwrite=args.overwrite, positions=args.positions)
     else:
         from .index import build_index
 

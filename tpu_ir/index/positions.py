@@ -96,12 +96,12 @@ def flat_positions_from_lengths(lengths: np.ndarray) -> np.ndarray:
     return np.arange(n) - np.repeat(starts, lengths)
 
 
-def write_position_shards(index_dir: str, run_term: np.ndarray,
-                          pos_indptr: np.ndarray, pos_delta: np.ndarray,
-                          num_shards: int) -> None:
-    """Split globally-ordered position runs into per-shard files aligned
-    with the part files' pair rows (same term_id % S assignment and the
-    same order-preserving filter as fmt.write_pair_shards)."""
+def split_runs_by_shard(run_term: np.ndarray, pos_indptr: np.ndarray,
+                        pos_delta: np.ndarray, num_shards: int):
+    """Yield (shard, indptr, delta) splitting ordered runs by
+    term_id % S with the same order-preserving filter as
+    fmt.write_pair_shards — so each shard's run rows align with its pair
+    rows."""
     run_shard = run_term.astype(np.int64) % num_shards
     run_len = np.diff(pos_indptr)
     for s in range(num_shards):
@@ -112,10 +112,32 @@ def write_position_shards(index_dir: str, run_term: np.ndarray,
         gather = (np.repeat(starts, lens)
                   + np.arange(int(lens.sum()))
                   - np.repeat(indptr[:-1], lens))
+        yield s, indptr.astype(np.int64), pos_delta[gather].astype(np.int32)
+
+
+def write_position_shards(index_dir: str, run_term: np.ndarray,
+                          pos_indptr: np.ndarray, pos_delta: np.ndarray,
+                          num_shards: int) -> None:
+    """Split globally-ordered position runs into per-shard files aligned
+    with the part files' pair rows."""
+    for s, indptr, delta in split_runs_by_shard(
+            run_term, pos_indptr, pos_delta, num_shards):
         fmt.savez_atomic(
             os.path.join(index_dir, positions_name(s)),
-            pos_indptr=indptr.astype(np.int64),
-            pos_delta=pos_delta[gather].astype(np.int32))
+            pos_indptr=indptr, pos_delta=delta)
+
+
+def batch_position_runs(flat_term: np.ndarray, docnos: np.ndarray,
+                        lengths: np.ndarray):
+    """One batch's occurrence stream -> ordered runs (streaming pass-2
+    helper): returns (run_term, pos_indptr, pos_delta) in the device
+    program's pair order for the batch."""
+    flat_doc = np.repeat(np.asarray(docnos, np.int64),
+                         np.asarray(lengths, np.int64))
+    flat_pos = flat_positions_from_lengths(lengths)
+    run_term, _, _, pos_indptr, pos_delta = build_position_runs(
+        flat_term, flat_doc, flat_pos)
+    return run_term, pos_indptr, pos_delta
 
 
 def build_and_write_positions(index_dir: str, flat_term: np.ndarray,

@@ -72,13 +72,15 @@ PASS1_MANIFEST = "pass1.npz"
 
 
 def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
-                spmd_devices: int | None) -> np.ndarray:
+                spmd_devices: int | None,
+                positions: bool = False) -> np.ndarray:
     """Build-config signature stored in the pass-1 manifest: a resume is
     only valid against spills produced by the SAME corpus files and build
     shape (the reference's resume-by-artifact skips outputs the same way,
     BuildIntDocVectorsForwardIndex.java:186-194 — generalized here to the
     pass DAG within one job per SURVEY §5)."""
-    parts = [f"k={k}", f"shards={num_shards}", f"spmd={spmd_devices or 0}"]
+    parts = [f"k={k}", f"shards={num_shards}", f"spmd={spmd_devices or 0}",
+             f"pos={int(positions)}"]
     for p in corpus_paths:
         ap = os.path.abspath(p)
         size = os.path.getsize(ap) if os.path.exists(ap) else -1
@@ -110,15 +112,19 @@ def _load_resume_state(spill_dir: str, sig: np.ndarray):
         return None
 
 
-def _batch_pairs_done(spill_dir: str, b: int, num_shards: int) -> bool:
+def _batch_pairs_done(spill_dir: str, b: int, num_shards: int,
+                      positions: bool = False) -> bool:
     return all(
         os.path.exists(os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"))
+        and (not positions or os.path.exists(
+            os.path.join(spill_dir, f"pos-{s:03d}-{b:05d}.npz")))
         for s in range(num_shards))
 
 
 def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
                         n_batches: int, vocab_size: int,
-                        shard_of: np.ndarray) -> tuple[np.ndarray, int]:
+                        shard_of: np.ndarray,
+                        positions: bool = False) -> tuple[np.ndarray, int]:
     """Pass 3 for ONE term shard: concatenate its pair spills, lexsort into
     the reference posting order (term asc, tf desc, doc asc), write the
     part file. Returns (rdf int32 [V], num_pairs). Shared by the
@@ -129,14 +135,25 @@ def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
     (term, doc) pair exists in exactly one batch and per-batch combining
     already produced final tfs. The spills start and end on host disk, so
     a host lexsort beats shipping hundreds of MB through the device and
-    back on any backend."""
+    back on any backend.
+
+    With `positions`, each batch's pos-RRR-BBBBB.npz spill (runs aligned
+    with that batch's pair spill rows) rides the same permutation, and
+    the shard's positions file is written BEFORE the part file — part
+    existence is the resume marker, so positions must never trail it."""
     terms, docs, tfs = [], [], []
+    deltas, rlens = [], []
     for b in range(n_batches):
         path = os.path.join(spill_dir, f"pairs-{row:03d}-{b:05d}.npz")
         with np.load(path) as z:
             terms.append(z["term"])
             docs.append(z["doc"])
             tfs.append(z["tf"])
+        if positions:
+            with np.load(os.path.join(
+                    spill_dir, f"pos-{row:03d}-{b:05d}.npz")) as pz:
+                deltas.append(pz["pos_delta"])
+                rlens.append(np.diff(pz["pos_indptr"]))
     t = np.concatenate(terms) if terms else np.zeros(0, np.int32)
     d = np.concatenate(docs) if docs else np.zeros(0, np.int32)
     w = np.concatenate(tfs) if tfs else np.zeros(0, np.int32)
@@ -147,6 +164,23 @@ def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
     tids = np.nonzero(shard_of == row)[0].astype(np.int32)
     lens = rdf[tids].astype(np.int64)
     local_indptr = np.concatenate([[0], np.cumsum(lens)])
+    if positions:
+        from .positions import positions_name
+
+        all_delta = (np.concatenate(deltas) if deltas
+                     else np.zeros(0, np.int32))
+        all_len = (np.concatenate(rlens).astype(np.int64) if rlens
+                   else np.zeros(0, np.int64))
+        starts = np.concatenate([[0], np.cumsum(all_len)])[:-1]
+        new_len = all_len[order]
+        out_indptr = np.concatenate([[0], np.cumsum(new_len)])
+        gather = (np.repeat(starts[order], new_len)
+                  + np.arange(int(new_len.sum()))
+                  - np.repeat(out_indptr[:-1], new_len))
+        fmt.savez_atomic(
+            os.path.join(index_dir, positions_name(row)),
+            pos_indptr=out_indptr.astype(np.int64),
+            pos_delta=all_delta[gather].astype(np.int32))
     fmt.save_shard(index_dir, row, term_ids=tids, indptr=local_indptr,
                    pair_doc=d, pair_tf=w, df=rdf[tids])
     return rdf, len(t)
@@ -168,6 +202,7 @@ def build_index_streaming(
     keep_spills: bool = False,
     spmd_devices: int | None = None,
     overwrite: bool = False,
+    positions: bool = False,
 ) -> fmt.IndexMetadata:
     if isinstance(corpus_paths, (str, os.PathLike)):
         corpus_paths = [corpus_paths]
@@ -196,7 +231,7 @@ def build_index_streaming(
     # reusable when its pass-1 manifest matches this exact config; stale or
     # mismatched state (and any half-written artifacts) is discarded ----
     spill_dir = os.path.join(index_dir, "_spill")
-    sig = _config_sig(corpus_paths, k, num_shards, spmd_devices)
+    sig = _config_sig(corpus_paths, k, num_shards, spmd_devices, positions)
     resume_state = _load_resume_state(spill_dir, sig)
     if resume_state is None and os.path.isdir(spill_dir):
         shutil.rmtree(spill_dir, ignore_errors=True)
@@ -307,7 +342,7 @@ def build_index_streaming(
                                       f"tokens-{b:05d}.npz")) as z:
                 lengths = z["lengths"]
                 done = resuming and _batch_pairs_done(
-                    spill_dir, b, num_shards)
+                    spill_dir, b, num_shards, positions)
                 flat = None if done else z["ids"]
             docids = np.array(all_docids[ofs : ofs + len(lengths)],
                               dtype=np.str_)
@@ -319,7 +354,23 @@ def build_index_streaming(
             if done:
                 report.incr("pass2_resumed_batches", 1)
                 continue
-            yield b, rank[flat], docnos, lengths
+            term_ids = rank[flat]
+            if positions:
+                # position runs depend only on host data — spill them at
+                # dispatch time, overlapping the device program. The run
+                # rows align with this batch's pair spill rows (same
+                # (term asc, tf desc, doc asc) order on both sides).
+                from .positions import batch_position_runs, split_runs_by_shard
+
+                rt, pi_, pd_ = batch_position_runs(term_ids, docnos,
+                                                   lengths)
+                for s_, indptr_, delta_ in split_runs_by_shard(
+                        rt, pi_, pd_, num_shards):
+                    fmt.savez_atomic(
+                        os.path.join(spill_dir,
+                                     f"pos-{s_:03d}-{b:05d}.npz"),
+                        pos_indptr=indptr_, pos_delta=delta_)
+            yield b, term_ids, docnos, lengths
 
     def pass2_single_device():
         # depth-1 dispatch/collect pipeline: batch b+1's host prep + device
@@ -426,7 +477,16 @@ def build_index_streaming(
     with report.phase("pass3_reduce"):
         for s in range(num_shards):
             part = os.path.join(index_dir, fmt.part_name(s))
-            if resuming and os.path.exists(part):
+            if positions:
+                # positions are written before the part, so an existing
+                # part implies its positions file too; a missing one
+                # (defensive) forces recompute of both
+                from .positions import positions_name
+
+                if not os.path.exists(
+                        os.path.join(index_dir, positions_name(s))):
+                    part = ""  # treat as absent
+            if resuming and part and os.path.exists(part):
                 # parts are written atomically and only after every pass-2
                 # spill exists, so an existing part IS this shard's final
                 # output; recover its df/pair contributions without
@@ -438,7 +498,8 @@ def build_index_streaming(
                 report.incr("pass3_resumed_shards", 1)
             else:
                 rdf, npairs = reduce_shard_spills(
-                    spill_dir, index_dir, s, n_batches, v, shard_of)
+                    spill_dir, index_dir, s, n_batches, v, shard_of,
+                    positions=positions)
             num_pairs_total += npairs
             df[:] += rdf
     report.set_counter("num_pairs", num_pairs_total)
@@ -463,7 +524,9 @@ def build_index_streaming(
     meta = fmt.IndexMetadata(
         num_docs=num_docs, vocab_size=v, k=k, num_shards=num_shards,
         num_pairs=num_pairs_total,
-        chargram_ks=chargram_ks if built_chargrams else [])
+        chargram_ks=chargram_ks if built_chargrams else [],
+        version=2 if positions else fmt.FORMAT_VERSION,
+        has_positions=bool(positions))
     meta.save(index_dir)
     report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     return meta
