@@ -136,3 +136,51 @@ def test_eval_loop_roundtrip(tmp_path):
     bad = bench._eval_loop_roundtrip(str(tmp_path), idx, queries, grades,
                                      np.zeros_like(d10))
     assert bad["eval_loop"].startswith("mismatch")
+
+
+def test_prox_tie_pairs_need_the_boost(tmp_path):
+    """The prox-tie pairs tie every bag-of-words scorer exactly (tie
+    rigged toward the distractor); the positions-based boost flips them
+    to the relevant doc — the measured lift the msmarco bench asserts."""
+    import bench
+    from tpu_ir.index import build_index
+    from tpu_ir.search import Scorer
+
+    corpus = str(tmp_path / "c.trec")
+    out = bench.make_quality_corpus(corpus, n_docs=500, n_queries=24,
+                                    with_prox=True)
+    queries, rel, grades, (prox_q, prox_rel) = out
+    assert len(prox_q) == 6
+    idx = str(tmp_path / "idx")
+    build_index([corpus], idx, k=1, chargram_ks=[], num_shards=3,
+                compute_chargrams=False, positions=True)
+    scorer = Scorer.load(idx, layout="dense")
+
+    def subset_mrr(results):
+        got = np.array(
+            [[dn for dn, _ in r[:10]] + [0] * (10 - min(len(r), 10))
+             for r in results], np.int64)
+        return bench._mrr_at_k(prox_rel, got)
+
+    base = subset_mrr(scorer.search_batch(prox_q, k=10, rerank=50,
+                                          return_docids=False))
+    boosted = subset_mrr(scorer.search_batch(prox_q, k=10, rerank=50,
+                                             prox=True,
+                                             return_docids=False))
+    assert base == pytest.approx(0.5)   # exact ties, distractor first
+    assert boosted == pytest.approx(1.0)
+    m = {"rerank_mrr_prox_subset": base,
+         "prox_rerank_mrr_prox_subset": boosted}
+    # the gate clause fires on a broken boost
+    m_bad = dict(m, prox_rerank_mrr_prox_subset=base)
+    assert any("proximity" in b for b in _prox_gate(m_bad))
+    assert not _prox_gate(m)
+
+
+def _prox_gate(m):
+    """Just the prox clause of bench.quality_gate."""
+    full = {"tfidf_mrr_at_10": 0.5, "bm25_mrr_at_10": 0.6,
+            "rerank_mrr_at_10": 0.7, "tfidf_ndcg_at_10": 0.5,
+            "bm25_ndcg_at_10": 0.6, "rerank_ndcg_at_10": 0.7, **m}
+    import bench
+    return bench.quality_gate(full)
