@@ -38,10 +38,12 @@ from tpu_ir.parallel.multihost import init_distributed, build_index_multihost
 
 init_distributed(coordinator, num_processes=2, process_id=pid)
 meta = build_index_multihost([corpus_dir], index_dir, k=1,
-                             compute_chargrams=False, batch_docs=2)
+                             compute_chargrams=False, batch_docs=2,
+                             positions=True)
 print(json.dumps({"pid": pid, "num_docs": meta.num_docs,
                   "num_shards": meta.num_shards,
-                  "vocab_size": meta.vocab_size}))
+                  "vocab_size": meta.vocab_size,
+                  "has_positions": meta.has_positions}))
 """
 
 
@@ -89,14 +91,25 @@ def test_multihost_build(tmp_path):
     assert not [n for n in os.listdir(index_dir) if n.startswith("_spill")]
 
     # byte-identical to the single-process streaming build at 4 shards
+    # (positions included: each process only held ITS docs' token
+    # streams, so identical position files prove the shared-spill
+    # re-alignment)
+    import filecmp
+
+    from tpu_ir.index.positions import positions_name
+
     ref_dir = str(tmp_path / "ref_index")
     build_index_streaming([str(corpus_dir)], ref_dir, k=1, num_shards=4,
-                          batch_docs=2, compute_chargrams=False)
+                          batch_docs=2, compute_chargrams=False,
+                          positions=True)
     for s in range(4):
         z1, z2 = fmt.load_shard(ref_dir, s), fmt.load_shard(index_dir, s)
         for key in ["term_ids", "indptr", "pair_doc", "pair_tf", "df"]:
             np.testing.assert_array_equal(z1[key], z2[key],
                                           err_msg=f"{s}/{key}")
+        assert filecmp.cmp(os.path.join(ref_dir, positions_name(s)),
+                           os.path.join(index_dir, positions_name(s)),
+                           shallow=False), s
     for name in [fmt.DICTIONARY, fmt.DOCNOS, fmt.VOCAB]:
         assert (open(os.path.join(ref_dir, name), "rb").read()
                 == open(os.path.join(index_dir, name), "rb").read()), name

@@ -76,6 +76,7 @@ def build_index_multihost(
     compute_chargrams: bool = True,
     batch_docs: int = 50_000,  # see streaming.py: fewer lockstep steps
     keep_spills: bool = False,
+    positions: bool = False,
 ) -> "object":
     """End-to-end STREAMING multi-host index build over the global mesh.
 
@@ -91,6 +92,14 @@ def build_index_multihost(
     index/streaming.py) writes each process's addressable part files, so
     artifacts are byte-identical to the single-process streaming build at
     the same shard count. Process 0 writes the shared side artifacts.
+
+    `positions=True` (format v2): a term shard's pairs combine documents
+    from EVERY process, but each document's token stream lives on exactly
+    one process — so each process writes its batches' position runs
+    (keyed (term, doc, tf) + delta block) into a SHARED spill area, and
+    the shard's pass-3 owner re-aligns the union by the part order
+    (term asc, tf desc, doc asc), asserting exact agreement with the
+    pair columns it just wrote.
     `index_dir` must be a filesystem all processes can write (the
     HDFS-equivalent assumption); token/pair spills stay on process-local
     disk. Memory per process = the vocab + one batch, like the
@@ -120,6 +129,9 @@ def build_index_multihost(
     os.makedirs(index_dir, exist_ok=True)
     spill_dir = os.path.join(index_dir, f"_spill-p{pi:03d}")
     os.makedirs(spill_dir, exist_ok=True)
+    pos_dir = os.path.join(index_dir, "_spill-pos")  # SHARED (see above)
+    if positions:
+        os.makedirs(pos_dir, exist_ok=True)
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "multihost": True, "process": pi, "process_count": pc,
         "batch_docs": batch_docs})
@@ -226,6 +238,9 @@ def build_index_multihost(
                 docnos = (np.searchsorted(sorted_docids, docids) + 1
                           ).astype(np.int32)
                 doc_len[docnos] = lengths
+                if positions:
+                    _spill_position_runs(pos_dir, term_ids, docnos,
+                                         lengths, s, b, pi)
                 dev_of_doc = (np.arange(len(lengths)) % n_local).astype(
                     np.int32)
                 flat_dev = np.repeat(dev_of_doc, lengths)
@@ -279,6 +294,10 @@ def build_index_multihost(
     # byte-identical-artifacts guarantee rests on one implementation) ---
     from ..index.streaming import reduce_shard_spills
 
+    if positions:
+        # a shard's position runs come from EVERY process's shared
+        # spills; all writers must be done before any pass-3 reader
+        multihost_utils.sync_global_devices("tpu_ir_pos_spills_done")
     with report.phase("pass3_reduce"):
         shard_of, offset_of = fmt.shard_local_offsets(df, s)
         for row in (pi * n_local + dev for dev in range(n_local)):
@@ -290,6 +309,8 @@ def build_index_multihost(
                 raise AssertionError(
                     f"shard {row}: pass 3 saw {npairs} pairs but pass 2 "
                     f"reported {num_pairs_by_shard.get(row, 0)}")
+            if positions:
+                _reduce_position_spills(pos_dir, index_dir, row)
 
     if not keep_spills:
         shutil.rmtree(spill_dir, ignore_errors=True)
@@ -308,11 +329,91 @@ def build_index_multihost(
         meta = fmt.IndexMetadata(
             num_docs=num_docs, vocab_size=v, k=k, num_shards=s,
             num_pairs=int(df.sum()),
-            chargram_ks=list(chargram_ks) if built_chargrams else [])
+            chargram_ks=list(chargram_ks) if built_chargrams else [],
+            version=2 if positions else fmt.FORMAT_VERSION,
+            has_positions=bool(positions))
         meta.save(index_dir)
         report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     multihost_utils.sync_global_devices("tpu_ir_index_built")
+    if positions and pi == 0 and not keep_spills:
+        shutil.rmtree(pos_dir, ignore_errors=True)
     return fmt.IndexMetadata.load(index_dir)
+
+
+def _spill_position_runs(pos_dir: str, term_ids: np.ndarray,
+                         docnos: np.ndarray, lengths: np.ndarray,
+                         num_shards: int, b: int, pi: int) -> None:
+    """One batch's position runs -> shared per-term-shard spill files
+    carrying their (term, doc, tf) run keys, so the pass-3 shard owner
+    can re-align the union from every process by the part order."""
+    from ..index import format as fmt2
+    from ..index.positions import build_position_runs, flat_positions_from_lengths
+
+    flat_doc = np.repeat(np.asarray(docnos, np.int64),
+                         np.asarray(lengths, np.int64))
+    flat_pos = flat_positions_from_lengths(lengths)
+    rt, rd, rtf, idp, delta = build_position_runs(term_ids, flat_doc,
+                                                  flat_pos)
+    run_len = np.diff(idp)
+    shard = rt.astype(np.int64) % num_shards
+    for row in range(num_shards):
+        sel = shard == row
+        lens = run_len[sel]
+        indptr = np.concatenate([[0], np.cumsum(lens)])
+        starts = idp[:-1][sel]
+        gather = (np.repeat(starts, lens)
+                  + np.arange(int(lens.sum()))
+                  - np.repeat(indptr[:-1], lens))
+        fmt2.savez_atomic(
+            os.path.join(pos_dir, f"pos-{row:03d}-b{b:05d}-p{pi:03d}.npz"),
+            term=rt[sel], doc=rd[sel], tf=rtf[sel],
+            pos_indptr=indptr.astype(np.int64),
+            pos_delta=delta[gather].astype(np.int32))
+
+
+def _reduce_position_spills(pos_dir: str, index_dir: str, row: int) -> None:
+    """Pass 3 for ONE shard's positions: union every process's run spills
+    for the shard, lexsort runs into the part order (term asc, tf desc,
+    doc asc), assert EXACT agreement with the freshly-written part file's
+    pair columns, write positions-NNNNN.npz."""
+    import glob
+
+    from ..index import format as fmt2
+    from ..index.positions import positions_name
+
+    terms, docs, tfs, deltas, rlens = [], [], [], [], []
+    for path in sorted(glob.glob(
+            os.path.join(pos_dir, f"pos-{row:03d}-b*-p*.npz"))):
+        with np.load(path) as z:
+            terms.append(z["term"])
+            docs.append(z["doc"])
+            tfs.append(z["tf"])
+            deltas.append(z["pos_delta"])
+            rlens.append(np.diff(z["pos_indptr"]))
+    rt = np.concatenate(terms) if terms else np.zeros(0, np.int32)
+    rd = np.concatenate(docs) if docs else np.zeros(0, np.int32)
+    rtf = np.concatenate(tfs) if tfs else np.zeros(0, np.int32)
+    delta = (np.concatenate(deltas) if deltas else np.zeros(0, np.int32))
+    rlen = (np.concatenate(rlens).astype(np.int64) if rlens
+            else np.zeros(0, np.int64))
+    order = np.lexsort((rd, -rtf.astype(np.int64), rt))
+    starts = np.concatenate([[0], np.cumsum(rlen)])[:-1]
+    new_len = rlen[order]
+    out_indptr = np.concatenate([[0], np.cumsum(new_len)])
+    gather = (np.repeat(starts[order], new_len)
+              + np.arange(int(new_len.sum()))
+              - np.repeat(out_indptr[:-1], new_len))
+    # alignment proof against the part file this process just wrote
+    z = fmt2.load_shard(index_dir, row)
+    if not (np.array_equal(rd[order], z["pair_doc"])
+            and np.array_equal(rtf[order], z["pair_tf"])
+            and np.array_equal(new_len, z["pair_tf"])):
+        raise AssertionError(
+            f"shard {row}: position runs do not align with pair columns")
+    fmt2.savez_atomic(
+        os.path.join(index_dir, positions_name(row)),
+        pos_indptr=out_indptr.astype(np.int64),
+        pos_delta=delta[gather].astype(np.int32))
 
 
 ALLGATHER_CHUNK_BYTES = 4 << 20
