@@ -473,3 +473,28 @@ def test_streaming_positions_resume(tmp_path, monkeypatch):
         for name in (fmt.part_name(s), positions_name(s)):
             assert filecmp.cmp(os.path.join(ref_dir, name),
                                os.path.join(out, name), shallow=False), name
+
+
+def test_phrase_and_prox_layout_independent(phrase_index):
+    """Phrase matching is host-side and the prox boost post-processes the
+    rerank, so results must be identical across serving layouts —
+    including the 8-virtual-device sharded mesh."""
+    from tpu_ir.search import Scorer
+
+    dense = Scorer.load(phrase_index, layout="dense")
+    sparse = Scorer.load(phrase_index, layout="sparse")
+    sharded = Scorer.load(phrase_index, layout="sharded")
+
+    for q in ['"salmon fishing"', '"salmon fishing" fun']:
+        want = dense.search(q)
+        for s in (sparse, sharded):
+            got = s.search(q)
+            assert [(d, round(sc, 4)) for d, sc in got] == \
+                   [(d, round(sc, 4)) for d, sc in want], (q, s.layout)
+
+    want = dense.search("salmon fishing", rerank=6, prox=True)
+    for s in (sparse, sharded):
+        got = s.search("salmon fishing", rerank=6, prox=True)
+        assert [d for d, _ in got] == [d for d, _ in want], s.layout
+        for (_, a), (_, b) in zip(got, want):
+            assert a == pytest.approx(b, rel=1e-5), s.layout
