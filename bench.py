@@ -808,10 +808,19 @@ def main() -> int:
         # a cross-round throughput swing is tunnel weather or a regression
         controls: dict = {}
         if not args.no_controls:
-            controls.update(transport_probe())
-            controls.update(device_build_control(corpus))
-            if not args.cpu and args.config == "ref":
-                controls.update(_cpu_control_subprocess())
+            try:
+                controls.update(transport_probe())
+                # the whole-corpus single-program control only matches the
+                # in-memory builder's real shape at ref scale; at wiki
+                # scale it would dispatch one ~200M-element program the
+                # streaming builder never runs (and big enough to wedge
+                # the tunnel — observed UNAVAILABLE at 1M docs)
+                if args.config == "ref":
+                    controls.update(device_build_control(corpus))
+                    if not args.cpu:
+                        controls.update(_cpu_control_subprocess())
+            except Exception as e:  # noqa: BLE001 — controls are evidence,
+                controls["controls_error"] = str(e)[:300]  # not the metric
 
         # post-build verification gate (VERDICT r1 item 5): the vectorized
         # structural check must hold — and stay fast — at every bench scale
