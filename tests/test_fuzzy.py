@@ -1,0 +1,133 @@
+"""Fuzzy term lookup over the char-k-gram index — the OTHER half of its
+stated purpose ("wildcard/fuzzy term lookup", SURVEY.md §0;
+CharKGramTermIndexer.java) that the reference never shipped a consumer
+for. k-gram count filter + banded Levenshtein postfilter; query syntax
+'token~' / 'token~2' expands as an OR like wildcards."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tpu_ir.cli import main
+from tpu_ir.index import build_index
+from tpu_ir.search import Scorer, WildcardLookup
+from tpu_ir.search.wildcard import _levenshtein_capped
+
+DOCS = {
+    "Z-01": "salmon fishing in deep rivers",
+    "Z-02": "simon goes sailing on lakes",
+    "Z-03": "salmons and salomon brands",   # stems: salmon? check below
+    "Z-04": "quick brown foxes jumping high",
+    "Z-05": "the almon tree blossoms early",
+}
+
+
+@pytest.fixture(scope="module")
+def idx(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("fuzzy")
+    p = tmp / "c.trec"
+    p.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in DOCS.items()))
+    out = str(tmp / "idx")
+    build_index([str(p)], out, k=1, num_shards=2)
+    return out
+
+
+def test_levenshtein_banded():
+    assert _levenshtein_capped("kitten", "sitting", 3) == 3
+    assert _levenshtein_capped("kitten", "sitting", 2) is None
+    assert _levenshtein_capped("abc", "abc", 0) == 0
+    assert _levenshtein_capped("ab", "ba", 2) == 2
+    assert _levenshtein_capped("", "xy", 2) == 2
+    assert _levenshtein_capped("xy", "", 1) is None
+
+
+def test_fuzzy_lookup(idx):
+    lookup = WildcardLookup.load(idx, 3)
+    got = lookup.fuzzy("salmon", max_edits=1)
+    terms = [t for t, _ in got]
+    # exact match at distance 0 leads; 1-edit neighbors follow sorted
+    assert got[0] == ("salmon", 0)
+    assert "almon" in terms          # deletion
+    assert "salomon" in terms        # insertion
+    assert "simon" not in terms      # distance 2
+    got2 = dict(lookup.fuzzy("salmon", max_edits=2))
+    assert got2["simon"] == 2 and got2["salmon"] == 0
+    # no match -> empty, not crash
+    assert lookup.fuzzy("zzzzzz", max_edits=1) == []
+    # multibyte query must not crash (byte grams vs char distance)
+    assert isinstance(lookup.fuzzy("café", max_edits=1), list)
+
+
+def test_fuzzy_query_expansion(idx):
+    scorer = Scorer.load(idx)
+    # 'salmn~' (typo) matches docs containing 'salmon'
+    got = {d for d, _ in scorer.search("salmn~")}
+    assert "Z-01" in got and "Z-03" in got
+    # distance-2 syntax pulls in 'simon' docs too
+    got2 = {d for d, _ in scorer.search("salmon~2")}
+    assert "Z-02" in got2
+    # fuzzy is an OR: literal terms still score alongside
+    got3 = {d for d, _ in scorer.search("salmn~ fox")}
+    assert "Z-04" in got3 and "Z-01" in got3
+    # '~' that isn't a fuzzy token is just punctuation
+    assert scorer.search("~5 salmon") == scorer.search("5 salmon")
+    # on an index without chargrams the token degrades to literal
+    assert scorer.analyze_queries(["salmn~"]).shape[0] == 1
+
+
+def test_fuzzy_cli_expand(idx, capsys):
+    assert main(["expand", idx, "salmon~", "--chargram-k", "3"]) == 0
+    out = capsys.readouterr().out
+    lines = dict(ln.split("\t") for ln in out.strip().splitlines())
+    assert lines["salmon"] == "0" and lines["almon"] == "1"
+    assert main(["expand", idx, "salmon~2", "--chargram-k", "3"]) == 0
+    assert "simon\t2" in capsys.readouterr().out
+    # glob expand still works
+    assert main(["expand", idx, "sal*", "--chargram-k", "2"]) == 0
+    assert "salmon" in capsys.readouterr().out
+
+
+def test_fuzzy_short_terms_pick_smaller_k(tmp_path):
+    """'cat~' must find 'cut': at k=3 they share NO gram, so the scorer
+    consults the largest k whose count bound stays positive (k=2 here)."""
+    p = tmp_path / "c.trec"
+    p.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in {"S-1": "cat naps daily", "S-2": "cut wood today",
+                     "S-3": "cap worn proudly"}.items()))
+    out = str(tmp_path / "idx")
+    build_index([str(p)], out, k=1, num_shards=2)
+    scorer = Scorer.load(out)
+    got = {d for d, _ in scorer.search("cat~")}
+    assert got >= {"S-1", "S-2", "S-3"}  # cut and cap are 1 edit away
+    # the k=3 lookup alone would have missed them
+    assert "cut" not in [t for t, _ in
+                         WildcardLookup.load(out, 3).fuzzy("cat", 1)]
+    assert "cut" in [t for t, _ in
+                     WildcardLookup.load(out, 2).fuzzy("cat", 1)]
+
+
+def test_fuzzy_syntax_edges(idx):
+    scorer = Scorer.load(idx)
+    # '5~10': NOT a fuzzy token (distance is one digit) — both literals
+    # survive; equivalent to the analyzer's punctuation split
+    assert scorer.analyze_queries(["5~10"]).tolist() == \
+        scorer.analyze_queries(["5 10"]).tolist()
+    # '~0' is an exact vocabulary probe on both surfaces
+    got = {d for d, _ in Scorer.load(idx).search("salmon~0")}
+    assert got == {d for d, _ in scorer.search("salmon")}
+    lookup = WildcardLookup.load(idx, 3)
+    assert lookup.fuzzy("salmon", 0) == [("salmon", 0)]
+    assert lookup.fuzzy("salmn", 0) == []
+
+
+def test_fuzzy_cli_clamps_distance(idx, capsys):
+    # 'salmon~0' prints the exact term; absurd distances clamp to 2
+    assert main(["expand", idx, "salmon~0", "--chargram-k", "3"]) == 0
+    assert capsys.readouterr().out.strip() == "salmon\t0"
+    assert main(["expand", idx, "salmon~9", "--chargram-k", "3"]) == 0
+    out = capsys.readouterr().out
+    assert "simon\t2" in out  # behaves as ~2, not a vocab-wide scan

@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 
@@ -410,6 +411,15 @@ def cmd_expand(args) -> int:
     from .search import WildcardLookup
 
     lookup = WildcardLookup.load(args.index_dir, args.chargram_k)
+    m = re.fullmatch(r"(.+?)~(\d?)", args.pattern)
+    if m:  # fuzzy: 'term~' (1 edit), 'term~0' (exact), 'term~2'
+        from .search.wildcard import MAX_FUZZY_EDITS
+
+        d = min(int(m.group(2)) if m.group(2) else 1, MAX_FUZZY_EDITS)
+        for term, dist in lookup.fuzzy(m.group(1), max_edits=d,
+                                       limit=args.n):
+            print(f"{term}\t{dist}")
+        return 0
     for term in lookup.expand(args.pattern, limit=args.n):
         print(term)
     return 0

@@ -36,6 +36,12 @@ DENSE_BUDGET = 500_000_000
 # a whitespace-delimited query token containing a glob metacharacter
 _WILDCARD_RE = re.compile(r"\S*[*?]\S*")
 
+# fuzzy tokens: 'salmn~' (1 edit) or 'color~2'; the '~' must FOLLOW a
+# token (a leading '~5' is just text). The distance is a SINGLE digit
+# (Lucene-style 0-2): with \d* a query like '5~10' would swallow the
+# literal term '10' as a distance
+_FUZZY_RE = re.compile(r"(\S+?)~(\d?)(?=[\s.,;:!)\]}]|$)")
+
 # punctuation the analyzer would strip from a literal token; removed from
 # glob-token edges too so 'fish*,' or '(fish*)' means the pattern 'fish*'
 _EDGE_PUNCT = "".join(c for c in
@@ -387,6 +393,59 @@ class Scorer:
             self._df_host_cache = np.asarray(self.df)
         return self._df_host_cache
 
+    def _fuzzy_terms(self, token: str, max_edits: int) -> list[str]:
+        """Pinned fuzzy expansion of one token over the index vocabulary:
+        matches within `max_edits` Levenshtein edits, keeping at most
+        WILDCARD_LIMIT ordered (distance asc, df desc, term id asc) — the
+        same truncation contract as wildcards, with distance outranking
+        df so a 1-edit rarity never loses its slot to a 2-edit stopword-
+        grade term."""
+        # largest k whose count bound stays positive: big k = fewest
+        # candidates, but past len(q)+3-k-edits*k < 1 the filter floors
+        # at 1 shared gram and short terms lose 1-edit neighbors that
+        # share NO k-gram ('cat'/'cut' at k=3) — then a smaller k is the
+        # correct index to consult
+        lookups = self._wildcard_lookups()
+        lookup = next(
+            (lk for lk in lookups
+             if len(token) + 3 - lk.k - max_edits * lk.k >= 1),
+            lookups[-1])
+        matches = lookup.fuzzy(token, max_edits=max_edits)
+        if not matches:
+            return []
+        ids = np.array([self.vocab.id_or(t) for t, _ in matches])
+        dist = np.array([d for _, d in matches])
+        df = self._df_host()
+        order = np.lexsort((ids, -df[ids], dist))[: self.WILDCARD_LIMIT]
+        if len(matches) > self.WILDCARD_LIMIT:
+            logger.warning(
+                "fuzzy token %r~%d matches %d terms; expansion truncated "
+                "to %d", token, max_edits, len(matches),
+                self.WILDCARD_LIMIT)
+        return [matches[i][0] for i in order.tolist()]
+
+    def _expand_fuzzy(self, text: str) -> tuple[str, list[int]]:
+        """Pull 'token~[d]' fuzzy tokens out of a query; returns the text
+        with them removed plus the term ids of their expansions (an OR,
+        same semantics as wildcard expansion)."""
+        extra: list[int] = []
+
+        def repl(m: re.Match) -> str:
+            from .wildcard import MAX_FUZZY_EDITS
+
+            tok = m.group(1).strip(_EDGE_PUNCT).lower()
+            if not tok or "*" in tok or "?" in tok:
+                return m.group(0)  # mixed glob+fuzzy: leave to the glob path
+            # '~0' = exact vocabulary probe (Lucene), '~' alone = 1 edit
+            d = min(int(m.group(2)) if m.group(2) else 1, MAX_FUZZY_EDITS)
+            for t in self._fuzzy_terms(tok, d):
+                tid = self.vocab.id_or(t)
+                if tid >= 0:
+                    extra.append(tid)
+            return " "
+
+        return _FUZZY_RE.sub(repl, text), extra
+
     def _expand_wildcards(self, text: str) -> tuple[str, list[int]]:
         """Pull glob tokens ('te*', 'ho?se') out of a query; return the text
         with them removed plus the term-ids of their vocabulary expansions
@@ -493,12 +552,21 @@ class Scorer:
         rows = []
         for text in texts:
             extra: list[int] = []
+            if ("~" in text and self.meta.k == 1
+                    and self._wildcard_lookups()):
+                # fuzzy tokens ('salmn~', 'color~2') expand to an OR over
+                # near-miss vocabulary terms; k>1 leaves '~' to the
+                # analyzer's punctuation handling (composing fuzzy slots
+                # into k-gram windows is wildcard territory, not worth a
+                # second cartesian machinery)
+                text, extra = self._expand_fuzzy(text)
             has_glob = "*" in text or "?" in text
             if has_glob and self.meta.k > 1 and self._wildcard_lookups():
                 rows.append(self._analyze_wildcard_kgram(text))
                 continue
             if has_glob:
-                text, extra = self._expand_wildcards(text)
+                text, wc_extra = self._expand_wildcards(text)
+                extra += wc_extra
             toks = self._analyzer.analyze(text)
             grams = kgram_terms(toks, self.meta.k)
             ids = [self.vocab.id_or(g) for g in grams]

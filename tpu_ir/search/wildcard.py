@@ -22,6 +22,11 @@ from ..index import format as fmt
 from ..index.builder import TOKENS_VOCAB
 from ..ops import gram_to_code
 
+# fuzzy cost ceiling shared by every surface (query tokens, CLI expand):
+# the k-gram count filter weakens fast past 2 edits, degenerating toward
+# a vocabulary-wide Levenshtein scan
+MAX_FUZZY_EDITS = 2
+
 
 class WildcardLookup:
     def __init__(self, vocab: Vocab, k: int, gram_codes: np.ndarray,
@@ -78,6 +83,50 @@ class WildcardLookup:
                 run[i : i + self.k] for i in range(len(run) - self.k + 1))
         return grams
 
+    def fuzzy(self, term: str, max_edits: int = 1,
+              limit: int | None = None) -> list[tuple[str, int]]:
+        """Vocabulary terms within `max_edits` Levenshtein edits of
+        `term`, as (term, distance) sorted by (distance, term).
+
+        The other half of the char-k-gram index's stated purpose
+        (SURVEY.md §0: built "for wildcard/fuzzy term lookup"; the
+        reference shipped neither consumer). Classic k-gram filtering:
+        one edit disturbs at most k of the $-padded byte grams, so a
+        match shares >= n_grams - max_edits*k grams with the query —
+        candidates come from one bincount over the per-gram term lists,
+        then a banded edit-distance postfilter (characters, not bytes)
+        confirms. When the bound collapses (short terms vs large k:
+        len(grams) - max_edits*k < 1) the threshold floors at 1 shared
+        gram — a RECALL loss for terms shorter than ~k+edits, since a
+        1-edit neighbor can share zero k-grams ('cat'/'cut' at k=3);
+        callers with several chargram ks should pick one that keeps the
+        bound positive (Scorer._fuzzy_terms does). Multi-byte text also
+        relaxes the threshold to 1 (one character edit can disturb up to
+        4*k byte grams). `max_edits=0` is an exact vocabulary probe."""
+        self._ensure_loaded()
+        q = term
+        if max_edits < 1:  # Lucene's ~0: exact match only
+            return [(q, 0)] if q in self.vocab else []
+        qb = ("$" + q + "$").encode("utf-8")
+        grams = list(dict.fromkeys(          # distinct grams: the count
+            qb[i : i + self.k]               # filter is per shared gram
+            for i in range(len(qb) - self.k + 1)))
+        if not grams:
+            return []
+        ascii_q = len(qb) == len(q) + 2
+        thr = (max(len(grams) - max_edits * self.k, 1) if ascii_q else 1)
+        counts = np.zeros(len(self.vocab.terms), np.int32)
+        for g in grams:
+            counts[self._terms_for_gram(g)] += 1
+        out = []
+        for tid in np.nonzero(counts >= thr)[0]:
+            t = self.vocab.term(int(tid))
+            d = _levenshtein_capped(q, t, max_edits)
+            if d is not None:
+                out.append((t, d))
+        out.sort(key=lambda td: (td[1], td[0]))
+        return out[:limit] if limit is not None else out
+
     def expand(self, pattern: str, limit: int | None = None) -> list[str]:
         """Vocabulary terms matching a glob pattern (e.g. 'te*', '*tion')."""
         grams = self.pattern_grams(pattern)
@@ -98,3 +147,32 @@ class WildcardLookup:
         if limit is not None:
             return list(itertools.islice(matches, limit))
         return list(matches)
+
+
+def _levenshtein_capped(a: str, b: str, cap: int) -> int | None:
+    """Levenshtein distance if <= cap, else None. Banded DP: only the
+    diagonal band of width 2*cap+1 is computed, with an early abort when
+    a full row exceeds the cap — O(cap * max(len)) per candidate."""
+    if a == b:
+        return 0
+    la, lb = len(a), len(b)
+    if abs(la - lb) > cap:
+        return None
+    if la > lb:  # keep the inner loop over the shorter string's band
+        a, b, la, lb = b, a, lb, la
+    big = cap + 1
+    prev = list(range(la + 1))
+    for j in range(1, lb + 1):
+        cur = [big] * (la + 1)
+        cur[0] = j if j <= cap else big
+        lo = max(1, j - cap)
+        hi = min(la, j + cap)
+        for i in range(lo, hi + 1):
+            cost = 0 if a[i - 1] == b[j - 1] else 1
+            cur[i] = min(prev[i] + 1,        # delete
+                         cur[i - 1] + 1,     # insert
+                         prev[i - 1] + cost)  # substitute
+        if min(cur) > cap:
+            return None
+        prev = cur
+    return prev[la] if prev[la] <= cap else None
