@@ -498,3 +498,32 @@ def test_phrase_and_prox_layout_independent(phrase_index):
         assert [d for d, _ in got] == [d for d, _ in want], s.layout
         for (_, a), (_, b) in zip(got, want):
             assert a == pytest.approx(b, rel=1e-5), s.layout
+
+
+def test_show_matches_cli(phrase_index, capsys):
+    """--show-matches prints each hit's query-term token positions from
+    the v2 runs; a v1 index gets the documented error."""
+    from tpu_ir.cli import main
+
+    assert main(["search", phrase_index, "--backend", "cpu",
+                 "-q", "salmon fishing", "--show-matches"]) == 0
+    out = capsys.readouterr().out
+    assert "salmon@" in out and "fish@" in out
+    # F-01 analyzes to [01, salmon, fish, fun, salmon, tasti]
+    # (DOCNO digits tokenize; stopwords vanish) => salmon@1,4 fish@2
+    assert "salmon@1,4 fish@2" in out
+
+
+def test_show_matches_requires_positions(tmp_path, capsys):
+    from tpu_ir.cli import main
+    from tpu_ir.index import build_index
+
+    p = tmp_path / "c.trec"
+    p.write_text("<DOC>\n<DOCNO> X </DOCNO>\n<TEXT>\nsalmon\n</TEXT>\n"
+                 "</DOC>\n<DOC>\n<DOCNO> Y </DOCNO>\n<TEXT>\ntrout\n"
+                 "</TEXT>\n</DOC>\n")
+    out = str(tmp_path / "idx")
+    build_index([str(p)], out, k=1, num_shards=2, compute_chargrams=False)
+    assert main(["search", out, "--backend", "cpu", "-q", "salmon",
+                 "--show-matches"]) == 1
+    assert "position" in capsys.readouterr().err

@@ -149,6 +149,8 @@ def _run_search(args) -> int:
                 print("  (no matching documents)")
             for rank, (key, score) in enumerate(res, 1):
                 print(f"  {rank:2d}. {key}\t{score:.6f}")
+                if args.show_matches:
+                    print(f"      {_format_matches(scorer, q, key, show_docids)}")
 
     if args.query:
         run_batch([args.query])
@@ -183,6 +185,22 @@ def _run_search(args) -> int:
             run_batch([line], qids=[next_qid])
             next_qid += 1
     return 0
+
+
+def _format_matches(scorer, query: str, key, key_is_docid: bool) -> str:
+    """Per-hit match coordinates from the format-v2 position runs: each
+    analyzed query term with its token positions in the document (the
+    closest thing to snippets an index without stored text can offer —
+    the coordinates address the analyzed token stream)."""
+    docno = (scorer.mapping.get_docno(key) if key_is_docid else int(key))
+    pidx = scorer._phrase_index()
+    parts = []
+    for t in dict.fromkeys(
+            scorer._query_term_sequence(query.replace('"', ' '))):
+        pos = pidx.positions(t, docno)
+        if pos is not None:
+            parts.append(f"{t}@{','.join(str(int(p)) for p in pos)}")
+    return " ".join(parts) if parts else "(no positional matches)"
 
 
 def _read_trec_topics(path: str) -> tuple[list[str], list[str]]:
@@ -476,6 +494,9 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--slop", type=int, default=0, metavar="S",
                     help="\"quoted phrase\" matching tolerates S extra "
                          "token gaps (0 = exact adjacency)")
+    ps.add_argument("--show-matches", action="store_true",
+                    help="print each hit's query-term token positions "
+                         "(needs an index built with --positions)")
     ps.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded"],
                     default="auto",
