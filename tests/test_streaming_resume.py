@@ -186,6 +186,43 @@ def test_stale_state_discarded(tmp_path, monkeypatch, ref):
     assert_identical(out, ref_dir)
 
 
+def test_regenerated_same_size_corpus_not_resumed(tmp_path, monkeypatch):
+    """A corpus regenerated with identical byte size (easy with fixed-
+    width synthetic docs) must invalidate the resume state: the config
+    signature carries mtime, so stale token spills never resume over new
+    content (ADVICE r3)."""
+    corpus = tmp_path / "corpus.trec"
+
+    def write(word):
+        # the word lands in only half the docs (df < N, so idf > 0)
+        corpus.write_text("".join(
+            f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+            f"{word if i % 2 else 'forest'} river\n"
+            f"</TEXT>\n</DOC>\n" for i in range(40)))
+
+    write("salmon")
+    out = str(tmp_path / "idx")
+    monkeypatch.setattr(streaming, "reduce_shard_spills",
+                        lambda *a, **kw: (_ for _ in ()).throw(
+                            RuntimeError("injected")))
+    with pytest.raises(RuntimeError):
+        build_index_streaming([str(corpus)], out, **BUILD_KW)
+    monkeypatch.undo()
+
+    st = corpus.stat()
+    write("market")  # same byte size, different content
+    assert corpus.stat().st_size == st.st_size
+    if corpus.stat().st_mtime_ns == st.st_mtime_ns:
+        # coarse-timestamp filesystems: force the mtime tick the rewrite
+        # is standing in for, so the test exercises the signature (not
+        # the filesystem's clock granularity)
+        os.utime(corpus, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+    build_index_streaming([str(corpus)], out, **BUILD_KW)
+    s = Scorer.load(out)
+    assert s.search("market")
+    assert not s.search("salmon")
+
+
 def test_overwrite_discards_valid_spills(tmp_path, monkeypatch, ref):
     """--overwrite restores build-from-scratch even when a valid resume
     state exists (delete-output-up-front, reference JobConf semantics)."""

@@ -83,8 +83,15 @@ def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
              f"pos={int(positions)}"]
     for p in corpus_paths:
         ap = os.path.abspath(p)
-        size = os.path.getsize(ap) if os.path.exists(ap) else -1
-        parts.append(f"{ap}:{size}")
+        if os.path.exists(ap):
+            st = os.stat(ap)
+            size, mtime = st.st_size, st.st_mtime_ns
+        else:
+            size, mtime = -1, -1
+        # mtime guards against a REGENERATED corpus of identical size
+        # (fixed-width synthetic docs make that collision easy): stale
+        # token spills must not resume over new content
+        parts.append(f"{ap}:{size}:{mtime}")
     return np.array(parts, dtype=np.str_)
 
 

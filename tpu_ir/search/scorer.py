@@ -359,7 +359,14 @@ class Scorer:
         pattern (too short for every k, e.g. bare '*')."""
         for lookup in self._wildcard_lookups():
             if lookup.pattern_grams(pattern):
-                terms = lookup.expand(pattern)
+                # k>1 truncation keeps the lexicographically-first LIMIT
+                # matches — exactly the prefix a limited expand returns —
+                # so a vocabulary-scale pattern ('a*' over 1M terms) never
+                # materializes its full match list; k=1 needs every match
+                # for the df-ranked truncation
+                limit = (None if self.meta.k == 1
+                         else self.WILDCARD_LIMIT + 1)
+                terms = lookup.expand(pattern, limit=limit)
                 if len(terms) > self.WILDCARD_LIMIT:
                     terms = self._truncate_expansion(pattern, terms)
                 return terms
@@ -378,11 +385,17 @@ class Scorer:
         term order). Both rules are deterministic under index rebuilds;
         tests pin them so a layout change cannot silently reorder
         wildcard results."""
+        if self.meta.k != 1:
+            # the limited expand hands us LIMIT+1 terms — enough to know
+            # the expansion overflowed, not how far
+            logger.warning(
+                "pattern %r matches more than %d terms; expansion "
+                "truncated to the lexicographically-first %d",
+                pattern, self.WILDCARD_LIMIT, self.WILDCARD_LIMIT)
+            return terms[: self.WILDCARD_LIMIT]
         logger.warning(
             "pattern %r matches %d terms; expansion truncated to %d",
             pattern, len(terms), self.WILDCARD_LIMIT)
-        if self.meta.k != 1:
-            return terms[: self.WILDCARD_LIMIT]
         df = self._df_host()
         ids = np.array([self.vocab.id_or(t) for t in terms])
         order = np.lexsort((ids, -df[ids]))[: self.WILDCARD_LIMIT]
