@@ -497,18 +497,54 @@ class Scorer:
 
         return _WILDCARD_RE.sub(repl, text), extra
 
-    def _analyze_wildcard_kgram(self, text: str) -> list[int]:
-        """k>1 wildcard semantics: expand each glob token over the TOKEN
-        vocabulary (tokens.txt), then compose candidate k-gram index terms
-        from every k-slot window — the cartesian product over the window's
-        expansion sets, capped at WILDCARD_LIMIT candidates per window.
-        Each window is an OR over its candidates (same semantics as the
-        k=1 expansion); unknown composed grams are dropped like any
-        dictionary miss."""
+    def _fuzzy_tokens(self, token: str, max_edits: int) -> list[str]:
+        """Token-vocabulary fuzzy expansions for the k>1 composition
+        path. The chargram sidecar there covers tokens.txt, which carries
+        no df, so the truncation rule is (distance asc, term asc) — the
+        deterministic fuzzy analogue of the k>1 wildcard rule (and
+        WildcardLookup.fuzzy's native order, so a limited scan
+        suffices)."""
+        lookups = self._wildcard_lookups()
+        lookup = next(
+            (lk for lk in lookups
+             if len(token) + 3 - lk.k - max_edits * lk.k >= 1),
+            lookups[-1])
+        matches = lookup.fuzzy(token, max_edits=max_edits,
+                               limit=self.WILDCARD_LIMIT + 1)
+        if len(matches) > self.WILDCARD_LIMIT:
+            logger.warning(
+                "fuzzy token %r~%d matches more than %d terms; expansion "
+                "truncated", token, max_edits, self.WILDCARD_LIMIT)
+            matches = matches[: self.WILDCARD_LIMIT]
+        return [t for t, _ in matches]
+
+    def _analyze_expansion_kgram(self, text: str) -> list[int]:
+        """k>1 wildcard/fuzzy semantics: expand each glob or fuzzy token
+        over the TOKEN vocabulary (tokens.txt), then compose candidate
+        k-gram index terms from every k-slot window — the cartesian
+        product over the window's expansion sets, capped at
+        WILDCARD_LIMIT candidates per window. Each window is an OR over
+        its candidates (same semantics as the k=1 expansion); unknown
+        composed grams are dropped like any dictionary miss."""
         import itertools
+
+        from .wildcard import MAX_FUZZY_EDITS
 
         slots: list[list[str]] = []
         for raw in text.split():
+            fm = (None if "*" in raw or "?" in raw
+                  else _FUZZY_RE.search(raw))
+            if fm is not None:
+                # fuzzy token -> one expansion slot (mirrors the k=1
+                # _expand_fuzzy extraction rules: edge punct stripped,
+                # '~0' = exact vocabulary probe, distance capped)
+                tok = fm.group(1).strip(_EDGE_PUNCT).lower()
+                if tok:
+                    d = min(int(fm.group(2)) if fm.group(2) else 1,
+                            MAX_FUZZY_EDITS)
+                    slots.append(self._fuzzy_tokens(tok, d))
+                    continue
+                # empty after punct strip: literal analysis, like k=1
             if "*" in raw or "?" in raw:
                 token = raw.strip(_EDGE_PUNCT)
                 for part in _GLOB_SPLIT_RE.split(token):
@@ -565,17 +601,27 @@ class Scorer:
         rows = []
         for text in texts:
             extra: list[int] = []
-            if ("~" in text and self.meta.k == 1
-                    and self._wildcard_lookups()):
+            has_fuzzy = "~" in text and _FUZZY_RE.search(text) is not None
+            if has_fuzzy and not self._wildcard_lookups():
+                # loud, not silent: without char-gram artifacts the '~'
+                # falls to the analyzer's punctuation handling and the
+                # user would otherwise never learn why 'salmn~' found
+                # nothing
+                logger.warning(
+                    "query %r contains a fuzzy token but the index has "
+                    "no char-gram artifacts; '~' is treated as "
+                    "punctuation (rebuild with chargrams for fuzzy)",
+                    text)
+            if has_fuzzy and self.meta.k == 1 and self._wildcard_lookups():
                 # fuzzy tokens ('salmn~', 'color~2') expand to an OR over
-                # near-miss vocabulary terms; k>1 leaves '~' to the
-                # analyzer's punctuation handling (composing fuzzy slots
-                # into k-gram windows is wildcard territory, not worth a
-                # second cartesian machinery)
+                # near-miss vocabulary terms
                 text, extra = self._expand_fuzzy(text)
             has_glob = "*" in text or "?" in text
-            if has_glob and self.meta.k > 1 and self._wildcard_lookups():
-                rows.append(self._analyze_wildcard_kgram(text))
+            if ((has_glob or has_fuzzy) and self.meta.k > 1
+                    and self._wildcard_lookups()):
+                # k>1: glob AND fuzzy tokens expand over the token
+                # sidecar vocabulary and compose into k-gram windows
+                rows.append(self._analyze_expansion_kgram(text))
                 continue
             if has_glob:
                 text, wc_extra = self._expand_wildcards(text)
@@ -711,16 +757,22 @@ class Scorer:
             self._pairs_cols = self._pairs_loader()
         return self._pairs_cols
 
+    def _doc_norms_host(self) -> np.ndarray:
+        """Host rerank norms; from the serving cache when present, else
+        computed from the (lazily assembled) CSR columns. The phrase
+        pipeline stops here — its host cosine never needs the device
+        copy, which at 10M docs would be a ~40 MB upload for nothing."""
+        if self._norms_np is None:
+            pt, pd, ptf = self._pairs
+            self._norms_np = compute_doc_norms(
+                pt, pd, ptf, np.asarray(self.df), self.meta.num_docs)
+        return self._norms_np
+
     def _doc_norms(self):
-        """Device copy of the rerank norms; from the serving cache when
-        present, else computed from the (lazily assembled) CSR columns."""
+        """Device copy of the rerank norms (the batch rerank kernels)."""
         if getattr(self, "_norms", None) is None:
-            if self._norms_np is None:
-                pt, pd, ptf = self._pairs
-                self._norms_np = compute_doc_norms(
-                    pt, pd, ptf, np.asarray(self.df), self.meta.num_docs)
             self._norms = jnp.asarray(
-                np.ascontiguousarray(self._norms_np), jnp.float32)
+                np.ascontiguousarray(self._doc_norms_host()), jnp.float32)
         return self._norms
 
     def rerank_topk(
@@ -801,7 +853,8 @@ class Scorer:
             rerank=rerank, prox=prox) if plain else [])
         return [self._search_phrase(t, k=k, scoring=scoring,
                                     slop=phrase_slop,
-                                    return_docids=return_docids)
+                                    return_docids=return_docids,
+                                    rerank=rerank, prox=prox)
                 if '"' in t else next(plain_iter) for t in texts]
 
     def _search_batch_plain(
@@ -848,12 +901,23 @@ class Scorer:
         return kgram_terms(self._analyzer.analyze(text), self.meta.k)
 
     def _search_phrase(self, text: str, *, k: int, scoring: str, slop: int,
-                       return_docids: bool) -> SearchResult:
+                       return_docids: bool, rerank: int | None = None,
+                       prox: bool = False) -> SearchResult:
         """One phrase query: every quoted span must match as an ordered
         window; matching docs are ranked by the standard scoring model
         over ALL query terms (host — a phrase-filtered candidate set is
-        KB-scale and cannot amortize a device dispatch)."""
-        from .phrase import score_docs_host, split_phrases
+        KB-scale and cannot amortize a device dispatch). `rerank`/`prox`
+        compose exactly as on the plain path: BM25 selects the top-N
+        matched docs, cosine TF-IDF rescores them, proximity boosts the
+        top of that — so a batch mixing quoted and plain queries runs ONE
+        pipeline, not two."""
+        from .phrase import (
+            PROX_ALPHA,
+            PROX_DEPTH,
+            cosine_score_host,
+            score_docs_host,
+            split_phrases,
+        )
 
         # extract phrases BEFORE touching the position artifacts: a stray
         # or empty quote ('19" rack') is a plain query on any index, v1
@@ -864,7 +928,7 @@ class Scorer:
         if not analyzed:
             return self._search_batch_plain(
                 [text.replace('"', ' ')], k=k, scoring=scoring,
-                return_docids=return_docids, rerank=None, prox=False)[0]
+                return_docids=return_docids, rerank=rerank, prox=prox)[0]
         pidx = self._phrase_index()
         matched: set[int] | None = None
         for _, toks in analyzed:
@@ -873,11 +937,35 @@ class Scorer:
             if not matched:
                 return SearchResult()
         all_terms = self._query_term_sequence(text.replace('"', ' '))
-        docnos, scores = score_docs_host(
-            all_terms, sorted(matched), dictionary=pidx._dict,
-            num_docs=self.meta.num_docs,
-            doc_len=np.asarray(self.doc_len),
-            scoring=scoring, compat_int_idf=self.compat_int_idf)
+        if rerank:
+            # stage 1: BM25 over the matched docs, keep top-`rerank`
+            docnos, scores = score_docs_host(
+                all_terms, sorted(matched), dictionary=pidx._dict,
+                num_docs=self.meta.num_docs,
+                doc_len=np.asarray(self.doc_len), scoring="bm25",
+                term_lookup=pidx._term)
+            keep = np.lexsort((docnos, -scores))[:rerank]
+            # stage 2: cosine TF-IDF rescoring of the candidates
+            docnos, scores = cosine_score_host(
+                all_terms, docnos[keep], dictionary=pidx._dict,
+                num_docs=self.meta.num_docs,
+                doc_norms=self._doc_norms_host(),
+                term_lookup=pidx._term)
+            if prox and len(all_terms) > 1:
+                # stage 3: positional proximity boost, bounded like the
+                # plain path (top PROX_DEPTH candidates by stage-2 score)
+                scores = scores.astype(np.float64)
+                for i in np.lexsort((docnos, -scores))[:PROX_DEPTH]:
+                    if scores[i] > 0:
+                        scores[i] *= 1.0 + PROX_ALPHA * pidx.proximity_bonus(
+                            all_terms, int(docnos[i]))
+        else:
+            docnos, scores = score_docs_host(
+                all_terms, sorted(matched), dictionary=pidx._dict,
+                num_docs=self.meta.num_docs,
+                doc_len=np.asarray(self.doc_len),
+                scoring=scoring, compat_int_idf=self.compat_int_idf,
+                term_lookup=pidx._term)
         order = np.lexsort((docnos, -scores))[:k]
         res = SearchResult()
         for i in order:
