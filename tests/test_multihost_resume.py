@@ -1,0 +1,274 @@
+"""Multi-host build crash resume (VERDICT r3 item 1): the streaming
+build's pass-DAG resume generalized across processes. A 2-process build
+killed mid-pass-2 must restart WITHOUT re-tokenizing, skip the completed
+lockstep batches on every process together, and produce artifacts
+byte-identical to the single-process streaming build. A process that
+LOST its local spills must force everyone's pass-2 state to be discarded
+(the allgather'd agreement) while the surviving process still resumes
+its own pass-1 spills."""
+
+import filecmp
+import json
+import os
+import shutil
+import socket
+import subprocess
+import sys
+
+import numpy as np
+
+# 6 files, round-robin to 2 processes -> 3 files each; the chunked
+# tokenizer yields one delta per (small) file and batch_docs=2 flushes
+# each delta as one spill batch, so every process runs 3 lockstep steps
+DOCS = {
+    "A-1": "alpha bravo charlie alpha", "A-2": "delta echo foxtrot bravo",
+    "B-1": "charlie juliet kilo lima", "B-2": "echo mike november oscar",
+    "C-1": "sierra tango uniform bravo", "C-2": "victor whiskey xray charlie",
+    "D-1": "bravo charlie delta echo", "D-2": "foxtrot golf alpha india",
+    "E-1": "golf hotel india alpha", "E-2": "papa quebec romeo alpha",
+    "F-1": "yankee zulu alpha delta", "F-2": "hotel kilo mike zulu",
+}
+FILES = ["A", "B", "C", "D", "E", "F"]
+
+# worker: 2 CPU devices per process; crash / forbid-tokenize injection via
+# env so the SAME script runs the crashing pass and the resuming pass
+WORKER = r"""
+import os, sys, json
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as xb
+for n in list(xb._backend_factories):
+    if n != "cpu":
+        xb._backend_factories.pop(n, None)
+
+coordinator, pid, corpus_dir, index_dir = (
+    sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4])
+crash_step = int(os.environ.get("TEST_CRASH_STEP", "0"))
+crash_pid = int(os.environ.get("TEST_CRASH_PID", "-1"))
+forbid_tok = os.environ.get("TEST_FORBID_TOKENIZE", "").split(",")
+
+import tpu_ir.parallel.sharded_build as sb
+import tpu_ir.analysis.native as native
+
+real_build = sb.sharded_build_postings
+steps = {"n": 0}
+
+def counting(*a, **kw):
+    steps["n"] += 1
+    if pid == crash_pid and crash_step and steps["n"] == crash_step:
+        raise RuntimeError("injected pass-2 crash")
+    return real_build(*a, **kw)
+
+sb.sharded_build_postings = counting
+if str(pid) in forbid_tok:
+    def boom(*a, **kw):
+        raise AssertionError("resume must not re-tokenize")
+    native.make_chunked_tokenizer = boom
+if int(os.environ.get("TEST_CRASH_PASS3_PID", "-1")) == pid:
+    import tpu_ir.index.streaming as streaming
+    def boom3(*a, **kw):
+        raise RuntimeError("injected pass-3 crash")
+    streaming.reduce_shard_spills = boom3
+
+from tpu_ir.parallel.multihost import init_distributed, build_index_multihost
+
+init_distributed(coordinator, num_processes=2, process_id=pid)
+try:
+    meta = build_index_multihost([corpus_dir], index_dir, k=1,
+                                 compute_chargrams=False, batch_docs=2,
+                                 positions=True)
+except Exception as e:
+    # hard exit: a crashed worker must DIE like a killed process, not
+    # hang in jax.distributed's atexit barrier (which also swallows
+    # SIGTERM via the preemption notifier)
+    print("CRASHED: %s" % e, file=sys.stderr)
+    sys.stderr.flush()
+    os._exit(17)
+print(json.dumps({"pid": pid, "steps": steps["n"],
+                  "num_docs": meta.num_docs}))
+"""
+
+
+def write_corpus(tmp_path):
+    corpus_dir = tmp_path / "corpus"
+    corpus_dir.mkdir()
+    for name in FILES:
+        (corpus_dir / f"{name}.trec").write_text("".join(
+            f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+            for d, t in DOCS.items() if d.startswith(name)))
+    return corpus_dir
+
+
+def spill_batches(index_dir, pid):
+    """(n_batches from the pass-1 manifest, list of complete pair-spill
+    batches) for one process's local spill dir."""
+    spill = os.path.join(index_dir, f"_spill-p{pid:03d}")
+    with np.load(os.path.join(spill, "pass1.npz"), allow_pickle=False) as z:
+        n_batches = int(z["n_batches"])
+    rows = [pid * 2, pid * 2 + 1]
+    done = [b for b in range(n_batches)
+            if all(os.path.exists(os.path.join(
+                spill, f"pairs-{r:03d}-{b:05d}.npz")) for r in rows)]
+    return n_batches, done
+
+
+def run_workers(tmp_path, corpus_dir, index_dir, *, env_extra,
+                expect_fail_pid=None, timeout=240):
+    """Launch 2 worker processes; returns {pid: parsed stdout JSON} for
+    the ones expected to succeed. When `expect_fail_pid` is set, that
+    worker must exit nonzero and its partner (blocked in the next
+    collective) is killed."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER)
+    env = {**os.environ, "PYTHONPATH": os.getcwd(), **env_extra}
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script), f"127.0.0.1:{port}", str(pid),
+             str(corpus_dir), index_dir],
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+            cwd=os.getcwd(), text=True)
+        for pid in range(2)
+    ]
+    out = {}
+    if expect_fail_pid is not None:
+        crashed = procs[expect_fail_pid]
+        _, err = crashed.communicate(timeout=timeout)
+        assert crashed.returncode == 17, err[-2000:]
+        assert "injected pass-" in err
+        other = procs[1 - expect_fail_pid]
+        other.kill()  # partner is lockstep-blocked in a collective
+        other.communicate(timeout=timeout)
+        return out
+    for pid, p in enumerate(procs):
+        stdout, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"worker {pid} failed:\n{err[-4000:]}"
+        out[pid] = json.loads(stdout.strip().splitlines()[-1])
+    return out
+
+
+def build_reference(tmp_path, corpus_dir):
+    from tpu_ir.index.streaming import build_index_streaming
+
+    ref_dir = str(tmp_path / "ref_index")
+    build_index_streaming([str(corpus_dir)], ref_dir, k=1, num_shards=4,
+                          batch_docs=2, compute_chargrams=False,
+                          positions=True)
+    return ref_dir
+
+
+def assert_identical_to_reference(index_dir, ref_dir):
+    from tpu_ir.index import format as fmt
+    from tpu_ir.index.positions import positions_name
+    from tpu_ir.index.verify import verify_index
+    from tpu_ir.search import Scorer
+
+    assert verify_index(index_dir)["ok"]
+    for s in range(4):
+        z1, z2 = fmt.load_shard(ref_dir, s), fmt.load_shard(index_dir, s)
+        for key in ["term_ids", "indptr", "pair_doc", "pair_tf", "df"]:
+            np.testing.assert_array_equal(z1[key], z2[key],
+                                          err_msg=f"{s}/{key}")
+        assert filecmp.cmp(os.path.join(ref_dir, positions_name(s)),
+                           os.path.join(index_dir, positions_name(s)),
+                           shallow=False), s
+    for name in [fmt.DICTIONARY, fmt.DOCNOS, fmt.VOCAB]:
+        assert (open(os.path.join(ref_dir, name), "rb").read()
+                == open(os.path.join(index_dir, name), "rb").read()), name
+    s_mh, s_ref = Scorer.load(index_dir), Scorer.load(ref_dir)
+    for q in ["alpha", "charlie bravo", '"charlie delta"', "zulu"]:
+        assert s_mh.search(q) == s_ref.search(q), q
+
+
+def test_multihost_resume_after_pass2_crash(tmp_path):
+    corpus_dir = write_corpus(tmp_path)
+    index_dir = str(tmp_path / "mh_index")
+
+    # run 1: process 1 dies on its SECOND device step (batch b=1); batch 0
+    # finished on both processes, later batches did not
+    run_workers(tmp_path, corpus_dir, index_dir,
+                env_extra={"TEST_CRASH_STEP": "2", "TEST_CRASH_PID": "1"},
+                expect_fail_pid=1)
+    # process 1 died before its b=1 device step: exactly batch 0 complete
+    # on it; process 0 (killed in the next collective) also holds batch 0
+    n0, done0 = spill_batches(index_dir, 0)
+    n1, done1 = spill_batches(index_dir, 1)
+    assert n0 == 3 and n1 == 3, (n0, n1)
+    assert done1 == [0], done1
+    assert 0 in done0 and len(done0) < n0, done0
+
+    # run 2: restart both. Tokenizing is FORBIDDEN for both processes;
+    # the globally-complete batches are skipped in lockstep, the rest run
+    expect_steps = 3 - len(set(done0) & set(done1))
+    out = run_workers(
+        tmp_path, corpus_dir, index_dir,
+        env_extra={"TEST_FORBID_TOKENIZE": "0,1"})
+    assert out[0]["num_docs"] == len(DOCS)
+    assert out[0]["steps"] == expect_steps, (out, done0, done1)
+    assert out[1]["steps"] == expect_steps, (out, done0, done1)
+    assert expect_steps == 2, (done0, done1)
+
+    assert_identical_to_reference(index_dir,
+                                  build_reference(tmp_path, corpus_dir))
+    # spills cleaned up after the successful finish
+    assert not [n for n in os.listdir(index_dir) if n.startswith("_spill")]
+
+
+def test_multihost_pass3_crash_writes_no_premature_metadata(tmp_path):
+    """Metadata must only appear after EVERY process finished pass 3 (it
+    is the skip-if-exists gate): process 1 dying in pass 3 while process
+    0 has already written its parts must leave NO metadata.json, and the
+    restart completes with ZERO device steps (all pass-2 spills valid)
+    and resumed pass-3 parts."""
+    corpus_dir = write_corpus(tmp_path)
+    index_dir = str(tmp_path / "mh_index")
+
+    run_workers(tmp_path, corpus_dir, index_dir,
+                env_extra={"TEST_CRASH_PASS3_PID": "1"},
+                expect_fail_pid=1)
+    from tpu_ir.index import format as fmt
+
+    # the barrier kept process 0 from certifying a half-finished index
+    assert not os.path.exists(os.path.join(index_dir, fmt.METADATA))
+    assert not os.path.exists(os.path.join(index_dir, fmt.part_name(2)))
+
+    out = run_workers(tmp_path, corpus_dir, index_dir,
+                      env_extra={"TEST_FORBID_TOKENIZE": "0,1"})
+    assert out[0]["steps"] == 0 and out[1]["steps"] == 0, out
+    assert_identical_to_reference(index_dir,
+                                  build_reference(tmp_path, corpus_dir))
+
+
+def test_multihost_lost_spills_forces_clean_pass2(tmp_path):
+    """One process losing its local spill dir (disk wipe) invalidates
+    EVERYONE's pass-2 state via the agreement allgather — the survivor
+    still resumes its own pass-1 spills (no re-tokenize), but every batch
+    recomputes and stale pass-3 outputs are discarded."""
+    corpus_dir = write_corpus(tmp_path)
+    index_dir = str(tmp_path / "mh_index")
+
+    run_workers(tmp_path, corpus_dir, index_dir,
+                env_extra={"TEST_CRASH_STEP": "2", "TEST_CRASH_PID": "1"},
+                expect_fail_pid=1)
+    # process 1 loses its spill dir; a stale garbage part for one of its
+    # rows lingers in the shared dir and must be wiped, not trusted
+    shutil.rmtree(os.path.join(index_dir, "_spill-p001"))
+    from tpu_ir.index import format as fmt
+    from tpu_ir.index.positions import positions_name
+
+    with open(os.path.join(index_dir, fmt.part_name(2)), "wb") as f:
+        f.write(b"garbage")
+    with open(os.path.join(index_dir, positions_name(2)), "wb") as f:
+        f.write(b"garbage")
+
+    # restart: only process 0 may skip tokenizing; NO batch skips (the
+    # agreement fails), so all lockstep device steps run on both
+    out = run_workers(tmp_path, corpus_dir, index_dir,
+                      env_extra={"TEST_FORBID_TOKENIZE": "0"})
+    assert out[0]["steps"] == 3 and out[1]["steps"] == 3, out
+    assert_identical_to_reference(index_dir,
+                                  build_reference(tmp_path, corpus_dir))

@@ -73,14 +73,17 @@ PASS1_MANIFEST = "pass1.npz"
 
 def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
                 spmd_devices: int | None,
-                positions: bool = False) -> np.ndarray:
+                positions: bool = False,
+                extra: Sequence[str] = ()) -> np.ndarray:
     """Build-config signature stored in the pass-1 manifest: a resume is
     only valid against spills produced by the SAME corpus files and build
     shape (the reference's resume-by-artifact skips outputs the same way,
     BuildIntDocVectorsForwardIndex.java:186-194 — generalized here to the
-    pass DAG within one job per SURVEY §5)."""
+    pass DAG within one job per SURVEY §5). `extra` carries additional
+    shape facts (the multi-host build pins process index/count and batch
+    size, which all change the spill layout)."""
     parts = [f"k={k}", f"shards={num_shards}", f"spmd={spmd_devices or 0}",
-             f"pos={int(positions)}"]
+             f"pos={int(positions)}", *extra]
     for p in corpus_paths:
         ap = os.path.abspath(p)
         if os.path.exists(ap):

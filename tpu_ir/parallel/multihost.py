@@ -105,6 +105,20 @@ def build_index_multihost(
     disk. Memory per process = the vocab + one batch, like the
     single-device streaming build — a slice larger than RAM streams fine.
 
+    Crash resume (the streaming build's pass-DAG resume,
+    index/streaming.py, generalized to many processes): each process
+    keeps a pass-1 manifest in ITS spill dir keyed by a config signature
+    that pins its corpus slice (size+mtime), k, batch_docs, process
+    index/count and device count. On restart every process first resumes
+    its OWN pass-1 state (valid per-process: token spills are slice-local
+    temp ids). Pass-2 and pass-3 artifacts depend on the GLOBAL tables,
+    so they are only trusted when an allgather confirms EVERY process
+    resumed — one fresh pass-1 anywhere can shift the global vocab/docno
+    ids and silently mis-key every pair spill. When all agree, completed
+    pass-2 batches are replayed host-side (doc_len/df/pair counts
+    recovered from the atomic spills) while the device step is skipped in
+    LOCKSTEP — the collective sequence stays identical across processes.
+
     Single-process, this degenerates to the SPMD streaming build over
     local devices.
     """
@@ -118,6 +132,8 @@ def build_index_multihost(
     from ..collection import DocnoMapping, Vocab
     from ..index import format as fmt
     from ..index.builder import build_chargram_artifacts
+    from ..index.positions import positions_name
+    from ..index.streaming import PASS1_MANIFEST, _config_sig, _load_resume_state
     from ..ops.postings import PAD_TERM
     from ..utils import JobReport
     from .mesh import SHARD_AXIS, make_mesh
@@ -126,59 +142,90 @@ def build_index_multihost(
     if isinstance(corpus_paths, (str, os.PathLike)):
         corpus_paths = [corpus_paths]
     pi, pc = jax.process_index(), jax.process_count()
+    n_local = jax.local_device_count()
+    s = pc * n_local
     os.makedirs(index_dir, exist_ok=True)
+    if fmt.artifact_exists(index_dir, fmt.METADATA):
+        # skip-if-exists, like the streaming build (reference JobConf
+        # semantics): a completed index is never rebuilt in place
+        return fmt.IndexMetadata.load(index_dir)
     spill_dir = os.path.join(index_dir, f"_spill-p{pi:03d}")
-    os.makedirs(spill_dir, exist_ok=True)
     pos_dir = os.path.join(index_dir, "_spill-pos")  # SHARED (see above)
+
+    # --- pass-1 resume: per-process manifest against this exact config ---
+    my_files = process_file_slice(corpus_paths, pi, pc)
+    sig = _config_sig(
+        my_files, k, s, s, positions,
+        extra=(f"mh-pi={pi}", f"pc={pc}", f"nlocal={n_local}",
+               f"batch={batch_docs}"))
+    resume_state = _load_resume_state(spill_dir, sig)
+    if resume_state is None:
+        shutil.rmtree(spill_dir, ignore_errors=True)
+    os.makedirs(spill_dir, exist_ok=True)
     if positions:
         os.makedirs(pos_dir, exist_ok=True)
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "multihost": True, "process": pi, "process_count": pc,
-        "batch_docs": batch_docs})
+        "batch_docs": batch_docs, "resumed": resume_state is not None})
 
     # --- pass 1: chunked tokenize my slice -> local temp-id spills ---
-    n_local = jax.local_device_count()
-    my_files = process_file_slice(corpus_paths, pi, pc)
     my_docids: list[str] = []
     n_batches = 0
     batch_dev_caps: list[int] = []  # max per-device occupancy per batch
-    tok = make_chunked_tokenizer(my_files, k=k)
-    with report.phase("pass1_tokenize"):
-        acc_ids: list[np.ndarray] = []
-        acc_lens: list[np.ndarray] = []
-        acc_docs = 0
-
-        def flush():
-            nonlocal n_batches, acc_docs
-            if not acc_docs:
-                return
-            lengths = np.concatenate(acc_lens)
-            np.savez(os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
-                     ids=np.concatenate(acc_ids), lengths=lengths)
-            # record the batch's per-device occupancy now — pass 2
-            # negotiates one global capacity from these, with no second
-            # read of the spills
-            occ = np.bincount(np.arange(len(lengths)) % n_local,
-                              weights=lengths, minlength=n_local)
-            batch_dev_caps.append(int(occ.max()))
-            n_batches += 1
-            acc_ids.clear()
-            acc_lens.clear()
+    if resume_state is not None:
+        my_docids, local_vocab, n_batches, caps = resume_state
+        batch_dev_caps = [int(c) for c in caps]
+        report.incr("Count.DOCS", len(my_docids))
+        report.set_counter("pass1_resumed_batches", n_batches)
+    else:
+        tok = make_chunked_tokenizer(my_files, k=k)
+        with report.phase("pass1_tokenize"):
+            acc_ids: list[np.ndarray] = []
+            acc_lens: list[np.ndarray] = []
             acc_docs = 0
 
-        try:
-            for docids_d, ids_d, lens_d in tok.deltas():
-                report.incr("Count.DOCS", len(docids_d))
-                my_docids.extend(docids_d)
-                acc_ids.append(ids_d)
-                acc_lens.append(lens_d)
-                acc_docs += len(docids_d)
-                if acc_docs >= batch_docs:
-                    flush()
-            flush()
-            local_vocab = tok.vocab()
-        finally:
-            tok.close()
+            def flush():
+                nonlocal n_batches, acc_docs
+                if not acc_docs:
+                    return
+                lengths = np.concatenate(acc_lens)
+                fmt.savez_atomic(
+                    os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
+                    ids=np.concatenate(acc_ids), lengths=lengths)
+                # record the batch's per-device occupancy now — pass 2
+                # negotiates one global capacity from these, with no second
+                # read of the spills
+                occ = np.bincount(np.arange(len(lengths)) % n_local,
+                                  weights=lengths, minlength=n_local)
+                batch_dev_caps.append(int(occ.max()))
+                n_batches += 1
+                acc_ids.clear()
+                acc_lens.clear()
+                acc_docs = 0
+
+            try:
+                for docids_d, ids_d, lens_d in tok.deltas():
+                    report.incr("Count.DOCS", len(docids_d))
+                    my_docids.extend(docids_d)
+                    acc_ids.append(ids_d)
+                    acc_lens.append(lens_d)
+                    acc_docs += len(docids_d)
+                    if acc_docs >= batch_docs:
+                        flush()
+                flush()
+                local_vocab = tok.vocab()
+            finally:
+                tok.close()
+        # manifest LAST (atomic): its existence certifies pass 1, exactly
+        # like the single-process streaming build; batch_occ holds the
+        # per-batch PER-DEVICE occupancy caps here (the quantity pass 2's
+        # capacity negotiation needs)
+        fmt.savez_atomic(
+            os.path.join(spill_dir, PASS1_MANIFEST), sig=sig,
+            docids=np.array(my_docids, dtype=np.str_),
+            vocab=np.array(local_vocab, dtype=np.str_),
+            n_batches=np.int64(n_batches),
+            batch_occ=np.array(batch_dev_caps, dtype=np.int64))
 
     # --- agree on global tables (host-side allgather) ---
     with report.phase("global_tables"):
@@ -200,29 +247,102 @@ def build_index_multihost(
                 else np.zeros(0, np.int32))
 
     # --- pass 2: lockstep per-batch SPMD shuffle over the global mesh ---
-    s = pc * n_local
     mesh = make_mesh(s)
     doc_len = np.zeros(num_docs + 1, np.int64)
     df_local = np.zeros(v, np.int64)       # my term shards' dfs
     num_pairs_by_shard: dict[int, int] = {}
+    my_rows = [pi * n_local + dev for dev in range(n_local)]
     occurrences = 0
     with report.phase("pass2_combine"):
         # one shared batch shape for the whole job: the max per-device
         # occupancy was recorded at flush time, so the global capacity is
         # negotiated from in-memory integers — all steps reuse one
-        # compiled program
+        # compiled program. The same allgather carries the resume flag:
+        # pass-2 artifacts are only trusted when EVERY process resumed
+        # pass 1 (see the docstring's agreement argument).
         local_cap = max(batch_dev_caps, default=1)
-        dims = multihost_utils.process_allgather(
-            np.array([n_batches, local_cap], np.int64))
-        b_global = int(np.asarray(dims)[:, 0].max())
-        cap = int(np.asarray(dims)[:, 1].max())
+        dims = multihost_utils.process_allgather(np.array(
+            [n_batches, local_cap, int(resume_state is not None)],
+            np.int64))
+        dims = np.asarray(dims).reshape(pc, 3)
+        b_global = int(dims[:, 0].max())
+        cap = int(dims[:, 1].max())
+        all_resumed = bool(dims[:, 2].all())
         granule = 1 << 12
         cap = max(granule, (cap + granule - 1) // granule * granule)
         sh2 = NamedSharding(mesh, P(SHARD_AXIS, None))
         sh1 = NamedSharding(mesh, P(SHARD_AXIS))
 
+        if not all_resumed:
+            # a fresh pass-1 anywhere invalidates ALL pass-2/3 artifacts
+            # (global ids may have shifted): drop my pair spills + my
+            # rows' outputs; process 0 clears the shared position spills,
+            # with a barrier so no step writes before the wipe lands
+            for name in os.listdir(spill_dir):
+                if name.startswith("pairs-"):
+                    os.unlink(os.path.join(spill_dir, name))
+            for row in my_rows:
+                for path in (os.path.join(index_dir, fmt.part_name(row)),
+                             os.path.join(index_dir, positions_name(row))):
+                    if os.path.exists(path):
+                        os.unlink(path)
+            if positions:
+                if pi == 0:
+                    for name in os.listdir(pos_dir):
+                        os.unlink(os.path.join(pos_dir, name))
+                multihost_utils.sync_global_devices("tpu_ir_pos_wiped")
+
+        def my_batch_done(b: int) -> bool:
+            """Did MY contribution to batch b land completely (atomic
+            files, so existence implies completeness)? Padding steps
+            (b >= n_batches) still write empty pair spills, so the same
+            check covers them; position spills exist only for real
+            batches."""
+            if not all(os.path.exists(os.path.join(
+                    spill_dir, f"pairs-{row:03d}-{b:05d}.npz"))
+                    for row in my_rows):
+                return False
+            if positions and b < n_batches:
+                return all(os.path.exists(os.path.join(
+                    pos_dir, f"pos-{row:03d}-b{b:05d}-p{pi:03d}.npz"))
+                    for row in range(s))
+            return True
+
+        done_local = np.array(
+            [all_resumed and my_batch_done(b) for b in range(b_global)],
+            np.int64)
+        done_global = np.asarray(multihost_utils.process_allgather(
+            done_local)).reshape(pc, b_global).all(axis=0)
+
         ofs = 0
         for b in range(b_global):
+            if done_global[b]:
+                # LOCKSTEP skip: every process skips this batch's device
+                # step together (the collective sequence stays identical).
+                # Host-side replay recovers what the step would have
+                # produced: doc_len from the token spill's lengths, df and
+                # pair counts from the pair spills (each spilled pair is
+                # one (term, doc) -> df contribution of exactly 1).
+                if b < n_batches:
+                    with np.load(os.path.join(
+                            spill_dir, f"tokens-{b:05d}.npz")) as z:
+                        lengths = z["lengths"]
+                    occurrences += int(lengths.sum())
+                    docids = np.array(my_docids[ofs : ofs + len(lengths)],
+                                      dtype=np.str_)
+                    ofs += len(lengths)
+                    docnos = (np.searchsorted(sorted_docids, docids) + 1
+                              ).astype(np.int32)
+                    doc_len[docnos] = lengths
+                for row in my_rows:
+                    with np.load(os.path.join(
+                            spill_dir, f"pairs-{row:03d}-{b:05d}.npz")) as z:
+                        t_sp = z["term"]
+                        num_pairs_by_shard[row] = (
+                            num_pairs_by_shard.get(row, 0) + len(t_sp))
+                        df_local += np.bincount(t_sp, minlength=v)
+                report.incr("pass2_resumed_batches", 1)
+                continue
             local_t = np.full((n_local, cap), PAD_TERM, np.int32)
             local_d = np.zeros((n_local, cap), np.int32)
             local_n = np.zeros(n_local, np.int32)
@@ -269,7 +389,7 @@ def build_index_multihost(
                              .reshape(-1)
                              for sd in getattr(out, col).addressable_shards}
             for row, npair in np_rows.items():
-                np.savez(
+                fmt.savez_atomic(
                     os.path.join(spill_dir, f"pairs-{row:03d}-{b:05d}.npz"),
                     term=rows["pair_term"][row][:npair],
                     doc=rows["pair_doc"][row][:npair],
@@ -300,22 +420,37 @@ def build_index_multihost(
         multihost_utils.sync_global_devices("tpu_ir_pos_spills_done")
     with report.phase("pass3_reduce"):
         shard_of, offset_of = fmt.shard_local_offsets(df, s)
-        for row in (pi * n_local + dev for dev in range(n_local)):
-            _, npairs = reduce_shard_spills(
-                spill_dir, index_dir, row, b_global, v, shard_of)
+        for row in my_rows:
+            part = os.path.join(index_dir, fmt.part_name(row))
+            # resume: an existing part (plus its positions file — written
+            # AFTER the part here, so the pair must be checked together)
+            # is this shard's final output from the crashed run
+            if (all_resumed and os.path.exists(part)
+                    and (not positions or os.path.exists(
+                        os.path.join(index_dir, positions_name(row))))):
+                npairs = len(fmt.load_shard(index_dir, row)["pair_doc"])
+                report.incr("pass3_resumed_shards", 1)
+            else:
+                _, npairs = reduce_shard_spills(
+                    spill_dir, index_dir, row, b_global, v, shard_of)
+                if positions:
+                    _reduce_position_spills(pos_dir, index_dir, row)
             # cross-check: the sorted pair count must equal what pass 2's
             # device programs reported for this shard
             if npairs != num_pairs_by_shard.get(row, 0):
                 raise AssertionError(
                     f"shard {row}: pass 3 saw {npairs} pairs but pass 2 "
                     f"reported {num_pairs_by_shard.get(row, 0)}")
-            if positions:
-                _reduce_position_spills(pos_dir, index_dir, row)
 
     if not keep_spills:
         shutil.rmtree(spill_dir, ignore_errors=True)
 
     # --- process 0 writes shared side artifacts ---
+    # barrier FIRST: metadata certifies the whole index, and its existence
+    # is the skip-if-exists/resume gate — it must never be written while
+    # another process still owes part files (a crash there would otherwise
+    # leave a "complete" index missing shards forever)
+    multihost_utils.sync_global_devices("tpu_ir_pass3_done")
     if pi == 0:
         mapping.save(os.path.join(index_dir, fmt.DOCNOS))
         vocab.save(os.path.join(index_dir, fmt.VOCAB))

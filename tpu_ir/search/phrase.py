@@ -39,6 +39,19 @@ K1, B = 0.9, 0.4  # the BM25 constants every scoring path shares
 _MISS = object()
 
 
+def _term_view(dictionary: Dictionary, term: str):
+    """One term's postings as (TermPostings|None, doc column sorted,
+    argsort rows) — the sorted view every host phrase/scoring path probes
+    candidates against. Single definition; PhraseIndex._term caches it
+    with an LRU, make_term_lookup with a plain memo."""
+    tp = dictionary.get_value(term)
+    if tp is None:
+        return (None, None, None)
+    docs = tp.postings[:, 0].astype(np.int64)
+    by_doc = np.argsort(docs)
+    return (tp, docs[by_doc], by_doc)
+
+
 def _lru_get(cache: dict, key):
     """Fetch + move-to-end (dicts iterate in insertion order, so popping
     and re-inserting makes the FIRST key the least recently used)."""
@@ -84,13 +97,7 @@ class PhraseIndex:
     def _term(self, term: str):
         hit = _lru_get(self._term_cache, term)
         if hit is _MISS:
-            tp = self._dict.get_value(term)
-            if tp is None:
-                hit = (None, None, None)
-            else:
-                docs = tp.postings[:, 0].astype(np.int64)
-                by_doc = np.argsort(docs)
-                hit = (tp, docs[by_doc], by_doc)
+            hit = _term_view(self._dict, term)
             _lru_put(self._term_cache, term, hit, self.TERM_CACHE_CAP)
         return hit
 
@@ -248,21 +255,15 @@ def _tf_for_candidates(tp, docs_sorted, by_doc,
 
 
 def make_term_lookup(dictionary: Dictionary):
-    """Memoized term -> (TermPostings|None, doc column sorted, argsort
-    rows) — the same shape PhraseIndex._term serves from its LRU, so the
-    host scorers below take either interchangeably and a phrase pipeline
-    sorts each term's postings ONCE across match + both rerank stages."""
+    """Memoized _term_view — the same shape PhraseIndex._term serves from
+    its LRU, so the host scorers below take either interchangeably and a
+    phrase pipeline sorts each term's postings ONCE across match + both
+    rerank stages."""
     cache: dict = {}
 
     def get(term: str):
         if term not in cache:
-            tp = dictionary.get_value(term)
-            if tp is None:
-                cache[term] = (None, None, None)
-            else:
-                docs = tp.postings[:, 0].astype(np.int64)
-                by_doc = np.argsort(docs)
-                cache[term] = (tp, docs[by_doc], by_doc)
+            cache[term] = _term_view(dictionary, term)
         return cache[term]
 
     return get
@@ -272,7 +273,7 @@ def score_docs_host(q_terms: list[str], docnos: list[int], *,
                     dictionary: Dictionary, num_docs: int,
                     doc_len: np.ndarray, scoring: str = "tfidf",
                     compat_int_idf: bool = False,
-                    term_lookup=None) -> np.ndarray:
+                    term_lookup=None) -> tuple[np.ndarray, np.ndarray]:
     """The standard scoring formulas over an explicit candidate doc set,
     on host — numerically the same model as ops/scoring.py ((1+ln tf) *
     log10(N/df) TF-IDF; the k1=0.9/b=0.4 BM25), used where a device

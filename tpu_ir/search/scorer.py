@@ -406,6 +406,20 @@ class Scorer:
             self._df_host_cache = np.asarray(self.df)
         return self._df_host_cache
 
+    def _fuzzy_lookup_for(self, token: str, max_edits: int):
+        """The chargram lookup fuzzy expansion should consult: the
+        largest k whose count bound stays positive. Big k = fewest
+        candidates, but past len(q)+3-k-edits*k < 1 the filter floors
+        at 1 shared gram and short terms lose 1-edit neighbors that
+        share NO k-gram ('cat'/'cut' at k=3) — then a smaller k is the
+        correct index. One definition for BOTH the k=1 and the k>1
+        composition paths, so their recall can never drift apart."""
+        lookups = self._wildcard_lookups()
+        return next(
+            (lk for lk in lookups
+             if len(token) + 3 - lk.k - max_edits * lk.k >= 1),
+            lookups[-1])
+
     def _fuzzy_terms(self, token: str, max_edits: int) -> list[str]:
         """Pinned fuzzy expansion of one token over the index vocabulary:
         matches within `max_edits` Levenshtein edits, keeping at most
@@ -413,16 +427,7 @@ class Scorer:
         same truncation contract as wildcards, with distance outranking
         df so a 1-edit rarity never loses its slot to a 2-edit stopword-
         grade term."""
-        # largest k whose count bound stays positive: big k = fewest
-        # candidates, but past len(q)+3-k-edits*k < 1 the filter floors
-        # at 1 shared gram and short terms lose 1-edit neighbors that
-        # share NO k-gram ('cat'/'cut' at k=3) — then a smaller k is the
-        # correct index to consult
-        lookups = self._wildcard_lookups()
-        lookup = next(
-            (lk for lk in lookups
-             if len(token) + 3 - lk.k - max_edits * lk.k >= 1),
-            lookups[-1])
+        lookup = self._fuzzy_lookup_for(token, max_edits)
         matches = lookup.fuzzy(token, max_edits=max_edits)
         if not matches:
             return []
@@ -504,11 +509,7 @@ class Scorer:
         deterministic fuzzy analogue of the k>1 wildcard rule (and
         WildcardLookup.fuzzy's native order, so a limited scan
         suffices)."""
-        lookups = self._wildcard_lookups()
-        lookup = next(
-            (lk for lk in lookups
-             if len(token) + 3 - lk.k - max_edits * lk.k >= 1),
-            lookups[-1])
+        lookup = self._fuzzy_lookup_for(token, max_edits)
         matches = lookup.fuzzy(token, max_edits=max_edits,
                                limit=self.WILDCARD_LIMIT + 1)
         if len(matches) > self.WILDCARD_LIMIT:
