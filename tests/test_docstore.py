@@ -1,0 +1,126 @@
+"""Document store + snippets (VERDICT r3 item 6): the raw content the
+reference discards at index time (Indexable.getContent,
+edu/umd/cloud9/collection/Indexable.java:24-44) survives as a compressed
+sidecar, and search renders query-highlighted text windows from it."""
+
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from tpu_ir.cli import main
+from tpu_ir.index import build_index
+from tpu_ir.index.docstore import BLOCK_DOCS, DocStore, build_docstore
+from tpu_ir.search import Scorer
+
+DOCS = {
+    "S-01": "salmon fishing is fun and salmon are tasty",
+    "S-02": "fishing for trout while salmon swim upstream near the river "
+            "bend where the water runs cold and clear all year round",
+    "S-03": "quick brown fox jumps over the lazy dog",
+    "S-04": "the market closed sharply lower on tuesday",
+}
+
+
+def write_corpus(tmp_path, docs=DOCS):
+    p = tmp_path / "c.trec"
+    p.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in docs.items()))
+    return str(p)
+
+
+@pytest.fixture(scope="module")
+def idx(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("docstore")
+    corpus = write_corpus(tmp)
+    out = str(tmp / "idx")
+    build_index([corpus], out, k=1, num_shards=2, compute_chargrams=False)
+    stats = build_docstore([corpus], out)
+    return out, stats
+
+
+def test_docstore_roundtrip(idx):
+    out, stats = idx
+    assert stats["docs"] == len(DOCS)
+    assert 0 < stats["stored_bytes"] < stats["raw_bytes"]  # compressed
+    store = DocStore(out)
+    scorer = Scorer.load(out)
+    for docid, text in DOCS.items():
+        content = store.get(scorer.mapping.get_docno(docid))
+        assert text in content and docid in content
+    with pytest.raises(KeyError):
+        store.get(999)
+    store.close()
+
+
+def test_docstore_many_blocks(tmp_path):
+    """Docs spanning several compression blocks round-trip regardless of
+    arrival-vs-docno order (perm indirection)."""
+    docs = {f"Z-{i:04d}": f"document number {i} mentions token{i % 7}"
+            for i in range(3 * 5 + 2)}
+    corpus = write_corpus(tmp_path, docs)
+    out = str(tmp_path / "idx")
+    build_index([corpus], out, k=1, num_shards=2, compute_chargrams=False)
+    build_docstore([corpus], out, block_docs=5)
+    store = DocStore(out)
+    scorer = Scorer.load(out)
+    for docid, text in docs.items():
+        assert text in store.get(scorer.mapping.get_docno(docid))
+
+
+def test_docstore_corpus_mismatch(tmp_path):
+    """A store built from a different corpus than the index must fail
+    loudly, not silently mis-key snippets."""
+    corpus = write_corpus(tmp_path)
+    out = str(tmp_path / "idx")
+    build_index([corpus], out, k=1, num_shards=2, compute_chargrams=False)
+    other = tmp_path / "other.trec"
+    other.write_text("<DOC>\n<DOCNO> X-1 </DOCNO>\n<TEXT>\nhi\n</TEXT>\n"
+                     "</DOC>\n")
+    with pytest.raises(ValueError, match="docno mapping"):
+        build_docstore([str(other)], out)
+    # and a partial corpus (fewer docs than the index) fails the count
+    sub = tmp_path / "sub"
+    sub.mkdir()
+    partial = write_corpus(sub, dict(list(DOCS.items())[:2]))
+    with pytest.raises(ValueError, match="corpus pass saw"):
+        build_docstore([partial], out)
+
+
+def test_snippet_highlights_and_windows(idx):
+    out, _ = idx
+    scorer = Scorer.load(out)
+    # analyzed matching: 'fishing' stems to the query's 'fish'
+    snip = scorer.snippet("fish", "S-01")
+    assert "**fishing**" in snip and "S-01" not in snip
+    # long doc: the window centers on the match cluster, with ellipses
+    snip = scorer.snippet("water cold", "S-02")
+    assert "**water**" in snip and "**cold**" in snip
+    assert snip.startswith("... ") or snip.endswith(" ...")
+    # no match: leading window, no marks
+    snip = scorer.snippet("zebra", "S-03")
+    assert "**" not in snip and snip.startswith("quick brown fox")
+    # quoted queries highlight their component words
+    snip = scorer.snippet('"salmon fishing"', "S-01")
+    assert "**salmon**" in snip and "**fishing**" in snip
+
+
+def test_snippets_without_store_errors(tmp_path):
+    corpus = write_corpus(tmp_path)
+    out = str(tmp_path / "idx")
+    build_index([corpus], out, k=1, num_shards=2, compute_chargrams=False)
+    with pytest.raises(ValueError, match="--store"):
+        Scorer.load(out).snippet("salmon", "S-01")
+
+
+def test_store_cli_end_to_end(tmp_path, capsys):
+    corpus = write_corpus(tmp_path)
+    out = str(tmp_path / "idx")
+    assert main(["index", str(tmp_path), out, "--backend", "cpu",
+                 "--shards", "2", "--no-chargrams", "--store"]) == 0
+    assert '"docstore"' in capsys.readouterr().out
+    assert main(["search", out, "--backend", "cpu", "-q", "salmon",
+                 "--snippets", "--k", "2"]) == 0
+    assert "**salmon**" in capsys.readouterr().out

@@ -100,7 +100,12 @@ def _run_index(args) -> int:
             overwrite=args.overwrite,
             compute_chargrams=not args.no_chargrams,
             spmd_devices=args.spmd_devices, positions=args.positions)
-    print(json.dumps(meta.__dict__))
+    out = dict(meta.__dict__)
+    if args.store:
+        from .index.docstore import build_docstore
+
+        out["docstore"] = build_docstore(args.corpus, args.index_dir)
+    print(json.dumps(out))
     return 0
 
 
@@ -151,6 +156,8 @@ def _run_search(args) -> int:
                 print(f"  {rank:2d}. {key}\t{score:.6f}")
                 if args.show_matches:
                     print(f"      {_format_matches(scorer, q, key, show_docids)}")
+                if args.snippets:
+                    print(f"      {scorer.snippet(q, key, is_docid=show_docids)}")
 
     if args.query:
         run_batch([args.query])
@@ -231,6 +238,21 @@ def cmd_inspect(args) -> int:
     # artifact reading only — no jax backend needed
     from .collection import Vocab
     from .index import format as fmt
+
+    # generic artifact dump (ReadSequenceFile generality): any FILE the
+    # framework writes, a serving-cache dir, or a spill dir — everything
+    # that is not a built index dir (index/artifacts.py)
+    if not (os.path.isdir(args.index_dir)
+            and fmt.artifact_exists(args.index_dir, fmt.METADATA)):
+        from .index.artifacts import inspect_path
+
+        try:
+            for line in inspect_path(args.index_dir, n=args.n):
+                print(line)
+        except FileNotFoundError:
+            print(f"no such artifact: {args.index_dir}", file=sys.stderr)
+            return 1
+        return 0
 
     if args.term is not None:
         # per-term random access through dictionary.tsv (the reference
@@ -469,6 +491,10 @@ def main(argv: list[str] | None = None) -> int:
     pi.add_argument("--positions", action="store_true",
                     help="format v2: also write per-posting position runs "
                          "(enables \"quoted phrase\" and --prox queries)")
+    pi.add_argument("--store", action="store_true",
+                    help="also build the compressed document-text store "
+                         "(one extra corpus pass; enables search "
+                         "--snippets)")
     _add_backend_arg(pi)
     pi.set_defaults(fn=cmd_index)
 
@@ -497,6 +523,9 @@ def main(argv: list[str] | None = None) -> int:
     ps.add_argument("--show-matches", action="store_true",
                     help="print each hit's query-term token positions "
                          "(needs an index built with --positions)")
+    ps.add_argument("--snippets", action="store_true",
+                    help="print a query-highlighted text window per hit "
+                         "(needs an index built with --store)")
     ps.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded"],
                     default="auto",
@@ -516,9 +545,15 @@ def main(argv: list[str] | None = None) -> int:
     _add_backend_arg(ps)
     ps.set_defaults(fn=cmd_search)
 
-    pn = sub.add_parser("inspect", help="dump index records (ReadSequenceFile)")
-    pn.add_argument("index_dir")
-    pn.add_argument("-n", type=int, default=20, help="max terms to print")
+    pn = sub.add_parser(
+        "inspect",
+        help="dump index records, or ANY framework artifact — part/"
+             "positions shards, build spills, pass-1 manifests, serving "
+             "caches, npy/tsv side files (ReadSequenceFile generality)")
+    pn.add_argument("index_dir", metavar="path",
+                    help="index dir, artifact file, or artifact dir")
+    pn.add_argument("-n", type=int, default=20,
+                    help="max terms / records to print")
     pn.add_argument("--postings", type=int, default=10,
                     help="max postings per term")
     pn.add_argument("--term", default=None,

@@ -1,0 +1,156 @@
+"""Compressed document-text sidecar (the "document store").
+
+The reference pipes every document's raw content through indexing
+(Indexable.getContent, edu/umd/cloud9/collection/Indexable.java:24-44)
+and then throws it away — retrieval can only ever answer with docids.
+The store keeps that content next to the index so search can render
+highlighted text snippets (`tpu-ir search --snippets`).
+
+Layout (both files written atomically):
+    docstore.bin        zlib blocks, BLOCK_DOCS docs each, concatenated
+    docstore-idx.npz    block_starts int64 [nblocks+1]  byte offsets
+                        lengths      int64 [ndocs]      per-doc raw bytes
+                        perm         int64 [ndocs+1]    docno -> arrival row
+
+Docs are stored in ARRIVAL (corpus) order and addressed through `perm`,
+so the writer streams with O(block) memory at any corpus size — no
+re-sort of gigabytes of text into docno order, just one int per doc.
+Building is a separate corpus pass independent of the index build path
+(in-memory, streaming, SPMD, or multi-host), keyed off the docno mapping
+the build already wrote; `tpu-ir index --store` runs it after the build.
+"""
+
+from __future__ import annotations
+
+import os
+import zlib
+
+import numpy as np
+
+from ..collection import DocnoMapping
+from ..collection.trec import read_trec_corpus
+from . import format as fmt
+
+STORE_BIN = "docstore.bin"
+STORE_IDX = "docstore-idx.npz"
+BLOCK_DOCS = 256
+
+
+def available(index_dir: str) -> bool:
+    return (os.path.exists(os.path.join(index_dir, STORE_BIN))
+            and os.path.exists(os.path.join(index_dir, STORE_IDX)))
+
+
+def build_docstore(corpus_paths, index_dir: str, *,
+                   block_docs: int = BLOCK_DOCS) -> dict:
+    """One streaming corpus pass -> compressed store. Returns size stats
+    (the bench records the overhead). Every doc in the corpus must be in
+    the index's docno mapping — the store and the index must come from
+    the same corpus."""
+    if isinstance(corpus_paths, (str, os.PathLike)):
+        corpus_paths = [corpus_paths]
+    mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
+    n = len(mapping)
+    perm = np.zeros(n + 1, np.int64)
+    lengths = np.zeros(n, np.int64)
+    block_starts = [0]
+    raw_bytes = 0
+    row = 0
+    tmp_bin = os.path.join(index_dir, STORE_BIN + ".tmp")
+    try:
+        with open(tmp_bin, "wb") as out:
+            block: list[bytes] = []
+
+            def flush():
+                if not block:
+                    return
+                out.write(zlib.compress(b"".join(block), 6))
+                block_starts.append(out.tell())
+                block.clear()
+
+            for doc in read_trec_corpus([str(p) for p in corpus_paths]):
+                try:
+                    docno = mapping.get_docno(doc.docid)
+                except KeyError:
+                    raise ValueError(
+                        f"docid {doc.docid!r} not in the index's docno "
+                        "mapping; the store must be built from the same "
+                        "corpus as the index") from None
+                data = doc.content.encode("utf-8")
+                perm[docno] = row
+                lengths[row] = len(data)
+                raw_bytes += len(data)
+                block.append(data)
+                row += 1
+                if len(block) >= block_docs:
+                    flush()
+            flush()
+        if row != n:
+            raise ValueError(f"corpus pass saw {row} docs but the index "
+                             f"maps {n}")
+        os.replace(tmp_bin, os.path.join(index_dir, STORE_BIN))
+    finally:
+        if os.path.exists(tmp_bin):
+            os.unlink(tmp_bin)
+    fmt.savez_atomic(
+        os.path.join(index_dir, STORE_IDX),
+        block_starts=np.asarray(block_starts, np.int64),
+        lengths=lengths, perm=perm,
+        block_docs=np.int64(block_docs))
+    return {"docs": n, "raw_bytes": raw_bytes,
+            "stored_bytes": int(block_starts[-1])}
+
+
+class DocStore:
+    """Random access to stored document text by docno. Decompresses one
+    block per miss; a small LRU keeps recently-touched blocks hot (result
+    pages cluster arrivals, so snippet rendering for one query usually
+    costs a handful of block decompressions)."""
+
+    CACHE_BLOCKS = 8
+
+    def __init__(self, index_dir: str):
+        if not available(index_dir):
+            raise ValueError(
+                "index has no document store; build one with "
+                "`tpu-ir index --store` (or tpu_ir.index.docstore."
+                "build_docstore) to render snippets")
+        with np.load(os.path.join(index_dir, STORE_IDX),
+                     allow_pickle=False) as z:
+            self._block_starts = z["block_starts"]
+            self._lengths = z["lengths"]
+            self._perm = z["perm"]
+            self._block_docs = int(z["block_docs"])
+        # per-doc offset within its block: prefix sums reset per block
+        self._doc_ofs = np.zeros(len(self._lengths), np.int64)
+        for b0 in range(0, len(self._lengths), self._block_docs):
+            seg = self._lengths[b0 : b0 + self._block_docs]
+            self._doc_ofs[b0 : b0 + len(seg)] = (
+                np.cumsum(seg) - seg)
+        self._bin = open(os.path.join(index_dir, STORE_BIN), "rb")
+        self._cache: dict[int, bytes] = {}
+
+    def close(self) -> None:
+        self._bin.close()
+
+    def _block(self, b: int) -> bytes:
+        hit = self._cache.pop(b, None)
+        if hit is None:
+            self._bin.seek(int(self._block_starts[b]))
+            raw = self._bin.read(int(self._block_starts[b + 1]
+                                     - self._block_starts[b]))
+            hit = zlib.decompress(raw)
+        self._cache[b] = hit
+        while len(self._cache) > self.CACHE_BLOCKS:
+            self._cache.pop(next(iter(self._cache)))
+        return hit
+
+    def get(self, docno: int) -> str:
+        """The stored content of one document (raw record text)."""
+        if not 1 <= docno < len(self._perm):
+            raise KeyError(docno)
+        row = int(self._perm[docno])
+        blk = self._block(row // self._block_docs)
+        ofs = int(self._doc_ofs[row])
+        return blk[ofs : ofs + int(self._lengths[row])].decode(
+            "utf-8", errors="replace")

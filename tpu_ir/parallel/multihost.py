@@ -442,9 +442,6 @@ def build_index_multihost(
                     f"shard {row}: pass 3 saw {npairs} pairs but pass 2 "
                     f"reported {num_pairs_by_shard.get(row, 0)}")
 
-    if not keep_spills:
-        shutil.rmtree(spill_dir, ignore_errors=True)
-
     # --- process 0 writes shared side artifacts ---
     # barrier FIRST: metadata certifies the whole index, and its existence
     # is the skip-if-exists/resume gate — it must never be written while
@@ -470,8 +467,13 @@ def build_index_multihost(
         meta.save(index_dir)
         report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     multihost_utils.sync_global_devices("tpu_ir_index_built")
-    if positions and pi == 0 and not keep_spills:
-        shutil.rmtree(pos_dir, ignore_errors=True)
+    # spills only AFTER metadata certifies the index: a peer crashing in
+    # pass 3 must find every survivor's resume state intact on restart
+    # (deleting earlier made the zero-step resume a kill-timing race)
+    if not keep_spills:
+        shutil.rmtree(spill_dir, ignore_errors=True)
+        if positions and pi == 0:
+            shutil.rmtree(pos_dir, ignore_errors=True)
     return fmt.IndexMetadata.load(index_dir)
 
 

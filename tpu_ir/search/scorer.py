@@ -1002,6 +1002,31 @@ class Scorer:
             out_d[qi, : valid.sum()] = docnos[qi][order][valid]
         return out_s, out_d
 
+    # -- snippets (document store sidecar) --------------------------------
+
+    def _docstore(self):
+        if getattr(self, "_store", None) is None:
+            if self._index_dir is None:
+                raise ValueError("snippets need an index directory "
+                                 "(Scorer built from arrays)")
+            from ..index.docstore import DocStore
+
+            self._store = DocStore(self._index_dir)
+        return self._store
+
+    def snippet(self, query_text: str, key, *, is_docid: bool = True,
+                width: int | None = None) -> str:
+        """Highlighted text window for one result (search/snippets.py).
+        Matching is token-level through the indexing analyzer, so k-gram
+        and quoted queries highlight their component words."""
+        from .snippets import SNIPPET_WORDS, make_snippet
+
+        docno = self.mapping.get_docno(key) if is_docid else int(key)
+        toks = set(self._analyzer.analyze(query_text.replace('"', ' ')))
+        return make_snippet(self._docstore().get(docno), toks,
+                            self._analyzer,
+                            width=width or SNIPPET_WORDS)
+
     def search(self, text: str, k: int = 10, scoring: str = "tfidf",
                return_docids: bool = True, rerank: int | None = None,
                prox: bool = False, phrase_slop: int = 0) -> SearchResult:

@@ -1,0 +1,74 @@
+"""Query-highlighted text snippets from the document store.
+
+With `tpu-ir index --store` the raw document text survives next to the
+index (index/docstore.py); `tpu-ir search --snippets` renders, for each
+hit, a window of the ORIGINAL text centered on the densest cluster of
+query-term matches, with the matching words wrapped in ``**``.
+
+Matching reuses the indexing analyzer: a display word matches when its
+analyzed form (tag tokenizer + stopwords + Porter2) hits a query token —
+so "Fishing," highlights for the query "fish" exactly when the index
+matched it, and never on raw substring accidents. The reference has no
+equivalent (its engine returns docnos only; Indexable content is
+discarded at index time)."""
+
+from __future__ import annotations
+
+import re
+
+_TAG_RE = re.compile(r"<[^>\n]{0,256}>")
+_WS_RE = re.compile(r"\s+")
+# metadata elements whose CONTENT is not document text: the docid (the
+# caller already printed it) and trecweb's HTTP header block
+_META_RE = re.compile(r"<(DOCNO|DOCHDR)>.*?</\1>", re.S | re.I)
+
+SNIPPET_WORDS = 16   # window width in display words
+MARK = "**"
+
+
+def display_text(content: str) -> str:
+    """Raw stored record -> displayable text: metadata elements removed
+    wholesale, remaining tags dropped, whitespace collapsed."""
+    return _WS_RE.sub(
+        " ", _TAG_RE.sub(" ", _META_RE.sub(" ", content))).strip()
+
+
+def make_snippet(content: str, query_tokens: set[str], analyzer,
+                 width: int = SNIPPET_WORDS) -> str:
+    """One highlighted window. `query_tokens` are ANALYZED query tokens
+    (token-level, not k-grams — phrase/k-gram queries highlight their
+    component words)."""
+    words = display_text(content).split(" ")
+    if not words:
+        return ""
+    # memoize per call: documents repeat words heavily, and the analyzer
+    # (tokenize + stopwords + Porter2) is the scan's whole cost
+    memo: dict[str, bool] = {}
+
+    def matches(w: str) -> bool:
+        hit = memo.get(w)
+        if hit is None:
+            hit = memo[w] = any(t in query_tokens
+                                for t in analyzer.analyze(w))
+        return hit
+
+    hits = [i for i, w in enumerate(words) if matches(w)]
+    if not hits:
+        head = " ".join(words[:width])
+        return head + (" ..." if len(words) > width else "")
+    # densest cluster: the window position covering the most hits
+    # (hits is small — one pass with two pointers)
+    best_lo, best_n = hits[0], 1
+    j = 0
+    for i, h in enumerate(hits):
+        while hits[j] < h - width + 1:
+            j += 1
+        if i - j + 1 > best_n:
+            best_n, best_lo = i - j + 1, hits[j]
+    lo = max(0, best_lo - max((width - best_n) // 2, 1))
+    hi = min(len(words), lo + width)
+    hit_set = set(hits)
+    out = [(MARK + w + MARK) if i in hit_set else w
+           for i, w in enumerate(words[lo:hi], lo)]
+    return (("... " if lo > 0 else "") + " ".join(out)
+            + (" ..." if hi < len(words) else ""))
