@@ -124,3 +124,73 @@ def test_store_cli_end_to_end(tmp_path, capsys):
     assert main(["search", out, "--backend", "cpu", "-q", "salmon",
                  "--snippets", "--k", "2"]) == 0
     assert "**salmon**" in capsys.readouterr().out
+
+
+class _CountingAnalyzer:
+    """Wraps the real analyzer, counting analyze() calls — the snippet
+    scan's unit of work (tokenize + stopwords + Porter2 per word)."""
+
+    def __init__(self, analyzer):
+        self._an = analyzer
+        self.calls = 0
+
+    def analyze(self, text):
+        self.calls += 1
+        return self._an.analyze(text)
+
+
+def test_snippet_perfect_window_early_exit():
+    """A multi-MB document whose query terms co-occur early must cost a
+    handful of analyzer calls, not a full-document scan (VERDICT r4 weak
+    #3). Fillers are DISTINCT words so memoization cannot hide an
+    unbounded scan."""
+    from tpu_ir.analysis.native import make_analyzer
+    from tpu_ir.search.snippets import make_snippet
+
+    filler = " ".join(f"zq{i:07d}x" for i in range(450_000))  # ~5 MB
+    doc = f"<DOC><TEXT>salmon fishing season {filler}</TEXT></DOC>"
+    assert len(doc) > 4_000_000
+    an = _CountingAnalyzer(make_analyzer())
+    snip = make_snippet(doc, {"salmon", "fish"}, an)
+    assert "**salmon**" in snip and "**fishing**" in snip
+    assert snip.endswith(" ...")
+    # the full-coverage window is found at word 2; the scan stops at the
+    # exact-region boundary instead of crawling 450k words
+    from tpu_ir.search.snippets import SNIPPET_EXACT_WORDS
+    assert an.calls < SNIPPET_EXACT_WORDS + 50
+
+    # with a small exact region the bound is proportionally tight
+    an2 = _CountingAnalyzer(make_analyzer())
+    snip2 = make_snippet(doc, {"salmon", "fish"}, an2, exact_words=64)
+    assert "**salmon**" in snip2 and "**fishing**" in snip2
+    assert an2.calls < 120
+
+
+def test_snippet_exact_region_keeps_densest_cluster():
+    """Inside the exact region the densest-cluster selection is
+    unchanged: a single-token query must still center on the later
+    5-hit cluster, not early-exit on the first stray hit."""
+    from tpu_ir.analysis.native import make_analyzer
+    from tpu_ir.search.snippets import make_snippet
+
+    doc = ("<DOC><TEXT>salmon intro mention " + "filler " * 60
+           + "salmon feast salmon dinner salmon soup salmon roe salmon"
+           + " tail</TEXT></DOC>")
+    snip = make_snippet(doc, {"salmon"}, make_analyzer())
+    assert snip.count("**salmon**") >= 4  # the cluster, not the stray
+
+
+def test_snippet_scan_byte_cap():
+    """When the query never fully co-occurs, the scan stops at the byte
+    cap instead of crawling the whole record."""
+    from tpu_ir.analysis.native import make_analyzer
+    from tpu_ir.search.snippets import make_snippet
+
+    filler = " ".join(f"zq{i:07d}x" for i in range(450_000))  # ~5 MB
+    doc = f"<DOC><TEXT>salmon river {filler} fishing</TEXT></DOC>"
+    an = _CountingAnalyzer(make_analyzer())
+    snip = make_snippet(doc, {"salmon", "fish"}, an, scan_bytes=20_000)
+    assert "**salmon**" in snip
+    assert snip.endswith(" ...")  # truncation is visible
+    # ~20 KB / ~11 bytes per filler word, plus slack
+    assert an.calls < 4_000

@@ -1,0 +1,310 @@
+"""MaxScore pruning tests (ops/scoring.py::_hot_stage_pruned).
+
+The tiered layout IS the MaxScore partition: hot-strip terms (highest df,
+lowest idf) are the non-essential lists, cold tiers the essential ones.
+Pruning must be RANK-SAFE — identical top-k, including tie-breaks — with
+the pruned branch provably taken (tfidf_prune_diag), not just falling
+back to the full matmul. The reference scores every posting of every
+query term (IntDocVectorsForwardIndex.java:192-223); these tests pin the
+algorithmic improvement's correctness contract.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_ir.ops.scoring import (
+    MAXSCORE_CAND,
+    _prune_applicable,
+    bm25_topk_tiered,
+    dense_doc_matrix,
+    dense_tf_matrix,
+    bm25_topk_dense,
+    tfidf_prune_diag,
+    tfidf_topk_dense,
+    tfidf_topk_tiered,
+)
+from tpu_ir.search.layout import build_tiered_layout
+
+NDOCS = 2 * MAXSCORE_CAND + 500  # wide enough that pruning is applicable
+
+
+def _zipf_pairs(vocab=2500, ndocs=NDOCS, n_occ=120_000, seed=3):
+    """Synthetic CSR postings columns in term-major order with a steep
+    df distribution (a real hot/cold split)."""
+    rng = np.random.default_rng(seed)
+    p = 1.0 / np.arange(1, vocab + 1)
+    p /= p.sum()
+    t = rng.choice(vocab, n_occ, p=p).astype(np.int64)
+    d = rng.integers(1, ndocs + 1, n_occ).astype(np.int64)
+    key, tf = np.unique(t * (ndocs + 1) + d, return_counts=True)
+    pair_term = (key // (ndocs + 1)).astype(np.int32)
+    pair_doc = (key % (ndocs + 1)).astype(np.int32)
+    pair_tf = tf.astype(np.int32)
+    df = np.bincount(pair_term, minlength=vocab).astype(np.int32)
+    return pair_term, pair_doc, pair_tf, df
+
+
+@pytest.fixture(scope="module")
+def layout():
+    pair_term, pair_doc, pair_tf, df = _zipf_pairs()
+    # budget for ~24 hot rows: a real strip, far from covering the vocab
+    lay = build_tiered_layout(pair_doc, pair_tf, df, num_docs=NDOCS,
+                              hot_budget=24 * (NDOCS + 1))
+    args = (jnp.asarray(lay.hot_rank), lay.hot_device(),
+            jnp.asarray(lay.tier_of), jnp.asarray(lay.row_of),
+            tuple(jnp.asarray(a) for a in lay.tier_docs),
+            tuple(jnp.asarray(a) for a in lay.tier_tfs))
+    hot_max_tf = jnp.max(args[1], axis=1)
+    return (pair_term, pair_doc, pair_tf, df), lay, args, hot_max_tf
+
+
+def _queries(df, lay, *, safe: bool, seed=11):
+    """Query batches by construction. `safe=True`: mid-df cold terms
+    (enough postings to fill a top-k threshold, high idf -> high tau)
+    alternating with the HOTTEST hot term (max df -> near-zero idf ->
+    tiny upper bound, but a real nonzero contribution for the candidate
+    gather to reproduce). `safe=False`: hot-only queries (no cold
+    postings -> tau = 0 -> provably unsafe)."""
+    hot = np.nonzero(lay.hot_rank >= 0)[0]
+    hottest = int(hot[np.argmax(df[hot])])
+    cold_mid = np.nonzero((lay.hot_rank < 0) & (df >= 30) & (df <= 200))[0]
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(12):
+        if safe and i % 2 == 0:
+            rows.append([int(rng.choice(cold_mid)),
+                         int(rng.choice(cold_mid)), -1])
+        elif safe:
+            rows.append([hottest, int(rng.choice(cold_mid)),
+                         int(rng.choice(cold_mid))])
+        elif i % 3 == 0:
+            rows.append([int(rng.choice(hot)), int(rng.choice(hot)), -1])
+        else:
+            rows.append([int(rng.choice(hot)), int(rng.choice(cold_mid)),
+                         int(rng.choice(cold_mid))])
+    return np.array(rows, np.int32)
+
+
+def test_prune_applicability_gate():
+    assert _prune_applicable(10, NDOCS, True)
+    assert not _prune_applicable(10, NDOCS, False)
+    assert not _prune_applicable(MAXSCORE_CAND, NDOCS, True)  # k too big
+    assert not _prune_applicable(10, 1000, True)  # doc axis too narrow
+
+
+def test_tfidf_pruned_branch_engages_and_matches(layout):
+    """On an all-cold-safe batch the diag must certify every query (the
+    block takes the pruned branch) and the results must equal both the
+    unpruned kernel and the dense oracle — docnos exactly."""
+    (pt, pd, ptf, df), lay, args, hot_max_tf = layout
+    q = _queries(df, lay, safe=True)
+    safe = np.asarray(tfidf_prune_diag(
+        jnp.asarray(q), *args, jnp.asarray(df), jnp.int32(NDOCS),
+        hot_max_tf, num_docs=NDOCS, k=10))
+    assert safe.all(), "constructed-safe batch must engage the pruned branch"
+
+    s1, d1 = tfidf_topk_tiered(jnp.asarray(q), *args, jnp.asarray(df),
+                               jnp.int32(NDOCS), hot_max_tf,
+                               num_docs=NDOCS, k=10, prune=True)
+    s0, d0 = tfidf_topk_tiered(jnp.asarray(q), *args, jnp.asarray(df),
+                               jnp.int32(NDOCS), num_docs=NDOCS, k=10,
+                               prune=False)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0), rtol=1e-5)
+
+    mat = dense_doc_matrix(jnp.asarray(pt), jnp.asarray(pd),
+                           jnp.asarray(ptf), vocab_size=len(df),
+                           num_docs=NDOCS)
+    s2, d2 = tfidf_topk_dense(jnp.asarray(q), mat, jnp.asarray(df),
+                              jnp.int32(NDOCS), k=10)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+
+def test_tfidf_mixed_unsafe_batch_still_exact(layout):
+    """A batch with hot-only queries (tau = 0 -> unsafe) must fall back
+    to the full matmul and stay exact."""
+    (pt, pd, ptf, df), lay, args, hot_max_tf = layout
+    q = _queries(df, lay, safe=False)
+    safe = np.asarray(tfidf_prune_diag(
+        jnp.asarray(q), *args, jnp.asarray(df), jnp.int32(NDOCS),
+        hot_max_tf, num_docs=NDOCS, k=10))
+    assert not safe.all(), "hot-only queries must be flagged unsafe"
+
+    s1, d1 = tfidf_topk_tiered(jnp.asarray(q), *args, jnp.asarray(df),
+                               jnp.int32(NDOCS), hot_max_tf,
+                               num_docs=NDOCS, k=10, prune=True)
+    mat = dense_doc_matrix(jnp.asarray(pt), jnp.asarray(pd),
+                           jnp.asarray(ptf), vocab_size=len(df),
+                           num_docs=NDOCS)
+    s2, d2 = tfidf_topk_dense(jnp.asarray(q), mat, jnp.asarray(df),
+                              jnp.int32(NDOCS), k=10)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-4)
+
+
+def test_bm25_pruned_matches_dense(layout):
+    """BM25 pruning parity on safe and unsafe batches (its upper bound
+    uses the saturation curve at max tf and min length norm)."""
+    (pt, pd, ptf, df), lay, args, hot_max_tf = layout
+    rng = np.random.default_rng(5)
+    doc_len = np.zeros(NDOCS + 1, np.int32)
+    doc_len[1:] = rng.integers(20, 200, NDOCS)
+    tf_mat = dense_tf_matrix(jnp.asarray(pt), jnp.asarray(pd),
+                             jnp.asarray(ptf), vocab_size=len(df),
+                             num_docs=NDOCS)
+    for safe in (True, False):
+        q = _queries(df, lay, safe=safe)
+        s1, d1 = bm25_topk_tiered(jnp.asarray(q), *args, jnp.asarray(df),
+                                  jnp.asarray(doc_len), jnp.int32(NDOCS),
+                                  hot_max_tf, num_docs=NDOCS, k=10,
+                                  prune=True)
+        s2, d2 = bm25_topk_dense(jnp.asarray(q), tf_mat, jnp.asarray(df),
+                                 jnp.asarray(doc_len), jnp.int32(NDOCS),
+                                 k=10)
+        np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+        np.testing.assert_allclose(np.asarray(s1), np.asarray(s2),
+                                   rtol=1e-4)
+
+
+def test_bm25_upper_bound_is_valid(layout):
+    """The per-hot-row BM25 bound sat(max_tf, dl_min) must dominate every
+    actual per-doc saturation value — the safety proof's premise."""
+    (pt, pd, ptf, df), lay, args, hot_max_tf = layout
+    rng = np.random.default_rng(9)
+    doc_len = np.zeros(NDOCS + 1, np.float64)
+    doc_len[1:] = rng.integers(20, 200, NDOCS)
+    k1, b = 0.9, 0.4
+    avg = doc_len.sum() / NDOCS
+    dl_norm = 1.0 - b + b * doc_len / avg
+    strip = np.asarray(args[1])  # [H, D+1]
+    sat = strip * (k1 + 1.0) / (strip + k1 * dl_norm[None, :])
+    actual_max = sat[:, 1:].max(axis=1)
+    mtf = np.asarray(hot_max_tf, np.float64)
+    bound = mtf * (k1 + 1.0) / (mtf + k1 * dl_norm[1:].min())
+    # the kernel applies a 1e-4 relative safety margin on top of the bound
+    # for exactly this: f32 rounding can put the bound an ulp below the
+    # value it mathematically dominates
+    assert (bound * 1.0001 + 1e-6 >= actual_max).all()
+
+
+def test_scorer_wiring_prune_toggle(tmp_path):
+    """Scorer-level wiring: prune on/off yield identical search results
+    through the full pipeline (tiny corpus -> pruning statically gated
+    off, but the prune=True kernels and hot_max_tf plumbing run), and
+    prune_diag reports the engagement fields on the tiered layout."""
+    from tpu_ir.index import build_index
+    from tpu_ir.search import Scorer
+
+    rng = np.random.default_rng(2)
+    words = ["".join(rng.choice(list("abcdefghij"), 6)) for _ in range(300)]
+    corpus = tmp_path / "c.trec"
+    with open(corpus, "w") as f:
+        for i in range(120):
+            body = " ".join(rng.choice(words, 30))
+            f.write(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n{body}\n"
+                    f"</TEXT>\n</DOC>\n")
+    out = str(tmp_path / "idx")
+    build_index([str(corpus)], out, k=1, chargram_ks=[], num_shards=2)
+
+    s_on = Scorer.load(out, layout="sparse", prune=True)
+    s_off = Scorer.load(out, layout="sparse", prune=False)
+    # force multi-block dispatch through the prune scheduler (hot-free
+    # queries packed first, results restored to caller order)
+    s_on.SCORE_BUDGET = (121) * 3
+    texts = [" ".join(rng.choice(words, 2)) for _ in range(16)]
+    for scoring in ("tfidf", "bm25"):
+        r_on = s_on.search_batch(texts, k=5, scoring=scoring)
+        r_off = s_off.search_batch(texts, k=5, scoring=scoring)
+        assert [[d for d, _ in r] for r in r_on] \
+            == [[d for d, _ in r] for r in r_off]
+    q = s_on.analyze_queries(texts)
+    # 120 docs is far below the pruning threshold: the diag must say so
+    # rather than report engagement for a branch the kernels never take
+    assert s_on.prune_diag(q) == {"prune_applicable": False}
+
+
+def _make_scorer(layout_fixture, *, prune: bool, score_budget: int):
+    """Minimal Scorer over the module's synthetic layout (large enough
+    for _prune_applicable), bypassing index files — exactly the attrs
+    topk()/_topk_device()/prune_diag() touch."""
+    from tpu_ir.search.scorer import Scorer
+
+    (pt, pd, ptf, df), lay, args, hot_max_tf = layout_fixture
+    s = object.__new__(Scorer)
+    s.layout = "sparse"
+    s.prune = prune
+    s.compat_int_idf = False
+    s.SCORE_BUDGET = score_budget
+
+    class M:
+        num_docs = NDOCS
+        vocab_size = len(df)
+
+    s.meta = M()
+    (s.hot_rank, s.hot_tfs, s.tier_of, s.row_of,
+     s.tier_docs, s.tier_tfs) = args
+    s.hot_max_tf = hot_max_tf
+    s.df = jnp.asarray(df)
+    return s
+
+
+def test_topk_reorder_restores_caller_order(layout):
+    """Multi-block dispatch at pruning scale: the prune scheduler permutes
+    queries (hot-free first) and the results MUST come back in caller
+    order — compare against the unpruned scorer row by row on a batch
+    interleaving hot-heavy and cold queries."""
+    (pt, pd, ptf, df), lay, args, hot_max_tf = layout
+
+    s_on = _make_scorer(layout, prune=True, score_budget=(NDOCS + 1) * 4)
+    s_off = _make_scorer(layout, prune=False,
+                         score_budget=(NDOCS + 1) * 1000)
+    q_safe = _queries(df, lay, safe=True)
+    q_unsafe = _queries(df, lay, safe=False)
+    # interleave so the schedule genuinely permutes (blocks of 4)
+    q = np.empty((24, 3), np.int32)
+    q[0::2] = q_unsafe
+    q[1::2] = q_safe
+    s1, d1 = s_on.topk(q, k=10)
+    s0, d0 = s_off.topk(q, k=10)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0), rtol=1e-4)
+    # the schedule really did reorder: hot-free queries come first
+    order = s_on._prune_schedule(q)
+    assert not np.array_equal(order, np.arange(len(q)))
+
+    diag = s_on.prune_diag(q)
+    assert 0.0 < diag["prune_safe_block_fraction"] < 1.0
+
+
+def test_exact_tie_order_preserved(layout):
+    """Two docs with identical postings for the query terms must keep the
+    same (lowest-docno-first) tie order under the pruned branch — the
+    msmarco norm-tie queries depend on this."""
+    (pt, pd, ptf, df), lay, args, hot_max_tf = layout
+    # synthesize: find a mid-df cold term, take two docs that BOTH carry
+    # it at the same tf, query just that term plus another safe filler
+    cold_mid = np.nonzero((lay.hot_rank < 0) & (df >= 50) & (df <= 300))[0]
+    indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
+    pick = None
+    for tid in cold_mid:
+        run_tf = ptf[indptr[tid]:indptr[tid + 1]]
+        run_dn = pd[indptr[tid]:indptr[tid + 1]]
+        vals, counts = np.unique(run_tf, return_counts=True)
+        dup = vals[counts >= 2]
+        if len(dup):
+            docs = np.sort(run_dn[run_tf == dup[-1]])[:2]
+            pick = (int(tid), docs)
+            break
+    assert pick is not None
+    tid, docs = pick
+    q = np.array([[tid, -1, -1]], np.int32)
+    for prune in (True, False):
+        kw = dict(num_docs=NDOCS, k=int(df[tid]), prune=prune)
+        s, d = tfidf_topk_tiered(jnp.asarray(q), *args, jnp.asarray(df),
+                                 jnp.int32(NDOCS), hot_max_tf, **kw)
+        d = np.asarray(d)[0]
+        i0, i1 = (np.nonzero(d == docs[0])[0][0],
+                  np.nonzero(d == docs[1])[0][0])
+        assert i0 < i1, "tie must break toward the lower docno"

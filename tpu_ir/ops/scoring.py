@@ -36,6 +36,21 @@ PAD_QTERM = -1
 # are nearly always hit, where the cond only adds sync overhead
 COND_TIER_MIN_CAP = 4096
 
+# MaxScore candidate-set width: when the hot-strip stage is pruned, the
+# top MAXSCORE_CAND docs by cold partial score are the only ones that get
+# exact hot contributions (everything below is provably outside the top-k)
+MAXSCORE_CAND = 2048
+# pruning engages only when k is comfortably inside the candidate set and
+# the doc axis is wide enough for the skipped matmul to matter
+_PRUNE_K_FRACTION = 4
+_PRUNE_MIN_DOCS = 2 * MAXSCORE_CAND
+
+
+def _prune_applicable(k: int, num_docs: int, prune: bool) -> bool:
+    """Static decision: is MaxScore pruning structurally worthwhile?"""
+    return (prune and k * _PRUNE_K_FRACTION <= MAXSCORE_CAND
+            and num_docs + 1 >= _PRUNE_MIN_DOCS)
+
 
 def _lntf(tf):
     """The (1 + ln tf) weight curve; 0 for empty slots."""
@@ -144,7 +159,8 @@ def _topk_from_scores(scores: jax.Array, k: int):
 
 def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
                    tier_tfs, q_weight, *, num_docs, hot_weight_fn,
-                   cold_weight_fn):
+                   cold_weight_fn, hot_cell_fn=None, hot_max_w=None,
+                   prune_k=None, with_stats=False):
     """Shared tiered accumulation: hot-strip einsum + one masked
     gather/scatter-add per df tier (see search/layout.py for the layout).
 
@@ -153,7 +169,14 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
     the same per padded posting. They are the only difference between
     TF-IDF ((1+ln tf)) and BM25 (saturation with the doc-length norm —
     broadcast over the strip's doc axis / gathered at each posting's
-    docno)."""
+    docno).
+
+    When `prune_k` is set (with `hot_max_w`, the per-hot-row score upper
+    bound, and `hot_cell_fn(tfs, docs)`, the per-cell weight for gathered
+    candidates), the hot-strip stage runs under batched MaxScore pruning —
+    see `_hot_stage_pruned`. The reference scores every posting of every
+    query term (IntDocVectorsForwardIndex.java:192-223); this is the
+    rank-safe algorithmic improvement on top of the silicon one."""
     vocab_size = hot_rank.shape[0]
     b = q_terms.shape[0]
     safe_q = jnp.where(q_terms >= 0, q_terms, 0)            # [B, L]
@@ -161,18 +184,26 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
     q_w = q_weight[safe_q] * q_valid                         # [B, L]
     rank = hot_rank[safe_q]                                  # [B, L]
     is_hot = (rank >= 0) & q_valid
-
-    # hot strip as an MXU matmul: scatter each query's term weights into a
-    # [B, H] row (duplicate terms sum), then one [B, H] @ [H, D+1] matmul
-    # against the element-wise-weighted strip. The per-(query, term) row
-    # gather it replaces materializes [B, L, D+1] — at 1M docs that is GBs
-    # of HBM traffic per dispatch for the same math.
     h = hot_tfs.shape[0]
-    w_hot = jnp.zeros((b, h), jnp.float32).at[
-        jnp.broadcast_to(jnp.arange(b)[:, None], rank.shape),
-        jnp.where(is_hot, rank, h),
-    ].add(jnp.where(is_hot, q_w, 0.0), mode="drop")          # [B, H]
-    scores = w_hot @ hot_weight_fn(hot_tfs)                  # [B, D+1]
+
+    def hot_matmul(s):
+        # hot strip as an MXU matmul: scatter each query's term weights
+        # into a [B, H] row (duplicate terms sum), then one [B, H] @
+        # [H, D+1] matmul against the element-wise-weighted strip. The
+        # per-(query, term) row gather it replaces materializes
+        # [B, L, D+1] — at 1M docs that is GBs of HBM traffic per
+        # dispatch for the same math.
+        w_hot = jnp.zeros((b, h), jnp.float32).at[
+            jnp.broadcast_to(jnp.arange(b)[:, None], rank.shape),
+            jnp.where(is_hot, rank, h),
+        ].add(jnp.where(is_hot, q_w, 0.0), mode="drop")      # [B, H]
+        return s + w_hot @ hot_weight_fn(hot_tfs)            # [B, D+1]
+
+    pruning = prune_k is not None
+    # without pruning, keep the original accumulation order (hot stage
+    # first) so existing callers' float rounding is unchanged
+    scores = (jnp.zeros((b, num_docs + 1), jnp.float32) if pruning
+              else hot_matmul(jnp.zeros((b, num_docs + 1), jnp.float32)))
 
     tof = tier_of[safe_q]                                    # [B, L]
     row = row_of[safe_q]
@@ -205,10 +236,71 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
                                   scores)
         else:
             scores = do_tier(scores)
-    return scores
+
+    if not pruning:
+        return (scores, jnp.ones((b,), bool)) if with_stats else scores
+    return _hot_stage_pruned(
+        scores, hot_tfs, hot_max_w, q_w, rank, is_hot, hot_matmul,
+        hot_cell_fn, prune_k=prune_k, with_stats=with_stats)
 
 
-@partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
+def _hot_stage_pruned(partial, hot_tfs, hot_max_w, q_w, rank, is_hot,
+                      hot_matmul, hot_cell_fn, *, prune_k, with_stats):
+    """Batched rank-safe MaxScore over the hot strip.
+
+    The layout IS the MaxScore partition: hot-strip terms are the
+    highest-df (lowest score-bound) lists — the "non-essential" set — and
+    the cold tiers (already accumulated exactly into `partial`) are the
+    essential lists. Per query:
+
+      tau  = k-th largest cold partial  (lower bound on the true k-th
+             full score, since contributions are non-negative)
+      ub   = sum over the query's hot terms of q_w * max-weight
+             (an upper bound on ANY doc's hot contribution)
+      p_C  = C-th largest cold partial  (C = MAXSCORE_CAND)
+
+    If p_C + ub < tau (or ub == 0) for EVERY query in the block, then no
+    doc outside the top-C partial candidates can reach the top-k: its
+    full score <= partial + ub <= p_C + ub < tau <= true k-th score. The
+    whole [B,H]@[H,D+1] hot matmul is then replaced by an exact [B,L,C]
+    gather over the candidates — identical top-k, including tie-breaks,
+    because every doc scoring >= tau carries its exact full score into
+    the same final top-k. One unsafe query sends the block down the full
+    matmul (lax.cond), so correctness never depends on the bound being
+    tight."""
+    b, l = q_w.shape
+    # clamped for small doc axes (the diag path; the scoring kernels gate
+    # engagement on num_docs + 1 >= 2 * MAXSCORE_CAND before calling)
+    c = min(MAXSCORE_CAND, partial.shape[1])
+    ub = jnp.sum(jnp.where(is_hot, q_w * hot_max_w[
+        jnp.where(is_hot, rank, 0)], 0.0), axis=1)           # [B]
+    cand_vals, cand_idx = jax.lax.top_k(partial, c)
+    tau = cand_vals[:, min(prune_k, c) - 1]
+    p_c = cand_vals[:, -1]
+    # the relative margin keeps the bound sound under f32 rounding: the
+    # upper bound and the matmul's actual contributions are computed by
+    # different f32 expression trees, so the bound can round an ulp below
+    # the value it dominates mathematically
+    safe_q = (ub <= 0.0) | (p_c + ub * 1.0001 + 1e-6 < tau)  # [B]
+    safe = jnp.all(safe_q)
+
+    def pruned(s):
+        r_h = jnp.where(is_hot, rank, 0)
+        # exact hot contributions for the candidates only: [B, L, C]
+        # cells instead of the [H, D+1] strip sweep
+        cells = hot_tfs[r_h[:, :, None], cand_idx[:, None, :]]
+        w = hot_cell_fn(cells, cand_idx[:, None, :])
+        contrib = jnp.einsum("blc,bl->bc", w,
+                             jnp.where(is_hot, q_w, 0.0))
+        bidx = jnp.broadcast_to(jnp.arange(b)[:, None], cand_idx.shape)
+        return s.at[bidx, cand_idx].add(contrib)
+
+    scores = jax.lax.cond(safe, pruned, hot_matmul, partial)
+    return (scores, safe_q) if with_stats else scores
+
+
+@partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf",
+                                   "prune"))
 def tfidf_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
     hot_rank: jax.Array,       # int32 [V]: row in hot_tfs, or -1 (cold)
@@ -219,24 +311,36 @@ def tfidf_topk_tiered(
     tier_tfs: tuple,           # of int32 [V_t, P_t]
     df: jax.Array,             # int32 [V]
     n_scalar: jax.Array,       # int32 scalar (N)
+    hot_max_tf: jax.Array | None = None,  # f32/int [H] max tf per hot row
     *,
     num_docs: int,
     k: int = 10,
     compat_int_idf: bool = False,
+    prune: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """TF-IDF top-k on the tiered sparse layout (search/layout.py): the
     budget-capped hot strip bounds dense memory, geometric tier capacities
-    bound padding waste, and every shape stays static under jit."""
+    bound padding waste, and every shape stays static under jit.
+
+    `prune=True` (with `hot_max_tf`) enables rank-safe MaxScore pruning of
+    the hot-strip stage (`_hot_stage_pruned`)."""
     idf = idf_weights(df, n_scalar, compat_int_idf)
 
+    do_prune = _prune_applicable(k, num_docs, prune) and hot_max_tf is not None
+    # one weight model for cold postings AND pruned hot candidates: the
+    # rank-safety contract depends on the two staying identical
+    cell_fn = lambda tfs, docs: _lntf(tfs)  # noqa: E731
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
         idf, num_docs=num_docs, hot_weight_fn=_lntf,
-        cold_weight_fn=lambda tfs, docs: _lntf(tfs))
+        cold_weight_fn=cell_fn,
+        hot_cell_fn=cell_fn if do_prune else None,
+        hot_max_w=_lntf(hot_max_tf.astype(jnp.float32)) if do_prune else None,
+        prune_k=k if do_prune else None)
     return _topk_from_scores(scores, k)
 
 
-@partial(jax.jit, static_argnames=("k", "num_docs", "k1", "b"))
+@partial(jax.jit, static_argnames=("k", "num_docs", "k1", "b", "prune"))
 def bm25_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
     hot_rank: jax.Array,       # int32 [V]
@@ -248,17 +352,25 @@ def bm25_topk_tiered(
     df: jax.Array,             # int32 [V]
     doc_len: jax.Array,        # int32 [D+1] (slot 0 dead)
     n_scalar: jax.Array,       # int32 scalar (N)
+    hot_max_tf: jax.Array | None = None,  # f32/int [H] max tf per hot row
     *,
     num_docs: int,
     k: int = 10,
     k1: float = 0.9,
     b: float = 0.4,
+    prune: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Okapi BM25 on the tiered sparse layout — the scorer variant that
     makes BM25 usable past the dense-matrix budget (MS MARCO-scale corpora).
     Hot terms: saturation over dense raw-tf rows with the [D+1] length norm
     broadcast. Cold terms: per-posting saturation with the length norm
-    gathered at each posting's docno."""
+    gathered at each posting's docno.
+
+    `prune=True` (with `hot_max_tf`) enables rank-safe MaxScore pruning of
+    the hot-strip stage. The BM25 upper bound uses the saturation curve at
+    (max tf, min doc-length norm): saturation is increasing in tf and
+    decreasing in dl_norm, so sat(tf, d) <= sat(max_tf, dl_min) for every
+    posting of the row."""
     n = jnp.asarray(n_scalar, jnp.float32)
     dff = df.astype(jnp.float32)
     # df == 0 terms contribute nothing (parity with the dense path, where
@@ -269,15 +381,52 @@ def bm25_topk_tiered(
     avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
     dl_norm = 1.0 - b + b * dlf / jnp.maximum(avg_dl, 1e-9)  # [D+1]
 
+    do_prune = _prune_applicable(k, num_docs, prune) and hot_max_tf is not None
+    if do_prune:
+        # slot 0 is the dead column (doc_len 0 -> the global minimum of
+        # dl_norm); exclude it so the bound reflects real documents
+        dl_min = jnp.min(dl_norm[1:])
+        mtf = hot_max_tf.astype(jnp.float32)
+        hot_max_w = mtf * (k1 + 1.0) / jnp.maximum(mtf + k1 * dl_min, 1e-9)
+    else:
+        hot_max_w = None
+
+    # one weight model for cold postings AND pruned hot candidates: the
+    # rank-safety contract depends on the two staying identical
+    cell_fn = (lambda tfs, docs: tfs * (k1 + 1.0)
+               / (tfs + k1 * dl_norm[docs]))
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
         idf, num_docs=num_docs,
         # hot_weight_fn sees the whole [H, D+1] strip (doc axis last)
         hot_weight_fn=lambda tf: tf * (k1 + 1.0)
         / (tf + k1 * dl_norm[None, :]),
-        cold_weight_fn=lambda tfs, docs: tfs * (k1 + 1.0)
-        / (tfs + k1 * dl_norm[docs]))
+        cold_weight_fn=cell_fn,
+        hot_cell_fn=cell_fn if do_prune else None,
+        hot_max_w=hot_max_w,
+        prune_k=k if do_prune else None)
     return _topk_from_scores(scores, k)
+
+
+@partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
+def tfidf_prune_diag(
+    q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+    df, n_scalar, hot_max_tf, *, num_docs: int, k: int = 10,
+    compat_int_idf: bool = False,
+) -> jax.Array:
+    """Diagnostic: per-query MaxScore safety flags [B] for a TF-IDF block
+    (True = the query alone would permit pruning; the block prunes iff all
+    are True). Used by tests and the bench's engagement report — the
+    scoring kernels keep their (scores, docnos) signature."""
+    idf = idf_weights(df, n_scalar, compat_int_idf)
+    cell_fn = lambda tfs, docs: _lntf(tfs)  # noqa: E731
+    _, safe = _tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        idf, num_docs=num_docs, hot_weight_fn=_lntf,
+        cold_weight_fn=cell_fn, hot_cell_fn=cell_fn,
+        hot_max_w=_lntf(hot_max_tf.astype(jnp.float32)),
+        prune_k=k, with_stats=True)
+    return safe
 
 
 def _topk_over_candidates(cand_scores, cand_docnos, k):

@@ -102,6 +102,7 @@ class Scorer:
         doc_norms: np.ndarray | None = None,
         pairs_loader=None,
         sharded_layout=None,
+        prune: bool = True,
     ):
         """`pair_*` may be omitted on the tiered path when prebuilt `tiers`
         (+ cached `doc_norms`) are supplied — the serving-cache fast path;
@@ -111,6 +112,10 @@ class Scorer:
         self.mapping = mapping
         self.meta = meta
         self.compat_int_idf = compat_int_idf
+        # rank-safe MaxScore pruning of the tiered hot-strip stage
+        # (ops/scoring.py::_hot_stage_pruned); results are identical with
+        # it off — the toggle exists for the bench's device-control A/B
+        self.prune = prune
         self._analyzer = make_analyzer()
         # enables wildcards + the serving-layout disk cache
         self._index_dir: str | None = index_dir
@@ -180,6 +185,9 @@ class Scorer:
             # of the ~2 GB dense matrix over the H2D link (the serving
             # cold-start bottleneck; search/layout.py::hot_device)
             self.hot_tfs = tiers.hot_device()
+            # per-hot-row max tf: the MaxScore upper-bound input, one
+            # cheap device reduction over the strip at load time
+            self.hot_max_tf = jnp.max(self.hot_tfs, axis=1)
             self.tier_of = jnp.asarray(tiers.tier_of)
             self.row_of = jnp.asarray(tiers.row_of)
             self.tier_docs = tuple(jnp.asarray(a) for a in tiers.tier_docs)
@@ -189,7 +197,7 @@ class Scorer:
 
     @classmethod
     def load(cls, index_dir: str, *, layout: str = "auto",
-             compat_int_idf: bool = False) -> "Scorer":
+             compat_int_idf: bool = False, prune: bool = True) -> "Scorer":
         if layout not in ("auto", "dense", "sparse", "sharded"):
             # fail before any IO — a typo'd layout should not cost the
             # minutes-long shard read + CSR assembly of a large index
@@ -222,7 +230,7 @@ class Scorer:
                     index_dir=index_dir, tiers=tiers,
                     doc_norms=np.asarray(norms),
                     pairs_loader=lambda: cls._assemble_csr(
-                        index_dir, meta)[1])
+                        index_dir, meta)[1], prune=prune)
         elif resolved == "sharded":
             # same fast path for distributed serving, per mesh size
             import jax
@@ -241,7 +249,7 @@ class Scorer:
                     index_dir=index_dir, sharded_layout=lay,
                     doc_norms=np.asarray(norms),
                     pairs_loader=lambda: cls._assemble_csr(
-                        index_dir, meta)[1])
+                        index_dir, meta)[1], prune=prune)
 
         df, (pair_term, pair_doc, pair_tf) = cls._assemble_csr(
             index_dir, meta)
@@ -295,7 +303,7 @@ class Scorer:
             pair_tf=pair_tf, df=df, doc_len=doc_len, meta=meta,
             layout=layout, compat_int_idf=compat_int_idf,
             index_dir=index_dir, tiers=tiers, doc_norms=norms,
-            sharded_layout=sharded_layout)
+            sharded_layout=sharded_layout, prune=prune)
 
     @staticmethod
     def _assemble_csr(index_dir: str, meta):
@@ -688,11 +696,95 @@ class Scorer:
 
         Large batches are scored in query blocks so the per-dispatch score
         accumulator stays within SCORE_BUDGET elements regardless of corpus
-        size (the reference had no batching at all; SURVEY.md §3.3)."""
-        block = max(1, self.SCORE_BUDGET // (self._doc_axis_width()))
+        size (the reference had no batching at all; SURVEY.md §3.3).
+
+        With MaxScore pruning on, queries are stably partitioned so the
+        ones WITHOUT hot-strip terms (upper bound 0 — provably safe, known
+        host-side) fill their own blocks: one unsafe query sends a whole
+        block down the full hot matmul, so packing the guaranteed-safe
+        majority together maximizes pruned blocks. Results are returned
+        in the caller's order."""
+        from ..ops.scoring import _prune_applicable
+
+        block = self._block_size()
+        q = np.asarray(q_terms, np.int32)
+        if (self.layout == "sparse" and len(q) > block
+                and _prune_applicable(k, self.meta.num_docs, self.prune)):
+            order = self._prune_schedule(q)
+            inv = np.argsort(order, kind="stable")
+            s, d = self._blocked_dispatch(
+                block, lambda qb: self._topk_device(qb, k, scoring),
+                (q[order], -1))
+            return s[inv], d[inv]
         return self._blocked_dispatch(
-            block, lambda q: self._topk_device(q, k, scoring),
-            (np.asarray(q_terms, np.int32), -1))
+            block, lambda qb: self._topk_device(qb, k, scoring), (q, -1))
+
+    def _block_size(self) -> int:
+        """Queries per dispatch block: one [block, doc-axis] f32 score
+        accumulator stays within SCORE_BUDGET elements."""
+        return max(1, self.SCORE_BUDGET // self._doc_axis_width())
+
+    def _prune_schedule(self, q: np.ndarray) -> np.ndarray:
+        """Stable order putting hot-term-free (ub = 0) queries first."""
+        hot_rank = self._hot_rank_host()
+        # mirror the kernels' q_valid mask: out-of-vocabulary ids score
+        # zero there and must not crash the host-side gather here
+        valid = (q >= 0) & (q < len(hot_rank))
+        has_hot = ((hot_rank[np.where(valid, q, 0)] >= 0)
+                   & valid).any(axis=1)
+        return np.argsort(has_hot, kind="stable")
+
+    def _hot_rank_host(self) -> np.ndarray:
+        if not hasattr(self, "_hot_rank_host_cache"):
+            self._hot_rank_host_cache = np.asarray(self.hot_rank)
+        return self._hot_rank_host_cache
+
+    def prune_diag(self, q_terms: np.ndarray, k: int = 10) -> dict:
+        """MaxScore engagement report for a TF-IDF query batch on the
+        tiered layout: fraction of queries individually safe to prune and
+        fraction of dispatch blocks that would take the pruned branch
+        (one unsafe query sends its whole block down the full matmul)."""
+        if self.layout != "sparse":
+            return {"prune_layout": self.layout}
+        from ..ops.scoring import _prune_applicable, tfidf_prune_diag
+
+        if not _prune_applicable(k, self.meta.num_docs, self.prune):
+            # the kernels statically never prune here (small doc axis /
+            # k too large / prune off) — don't report phantom engagement
+            return {"prune_applicable": False}
+
+        q = np.asarray(q_terms, np.int32)
+        block = self._block_size()
+        # model the dispatch order topk() actually uses: guaranteed-safe
+        # (hot-free) queries are packed into their own blocks first
+        if len(q) > block:
+            q = q[self._prune_schedule(q)]
+        # dispatch block-by-block like topk: the diag's [B, D+1] partial
+        # accumulator is subject to the same SCORE_BUDGET
+        safe_parts = []
+        for i in range(0, len(q), block):
+            qb = q[i : i + block]
+            if len(qb) < block and len(q) > block:
+                # pad to the compiled block shape; pad rows are all-PAD
+                # queries (ub = 0 -> safe) and are sliced off below
+                pad = np.full((block, q.shape[1]), -1, np.int32)
+                pad[: len(qb)] = qb
+                qb = pad
+            safe_parts.append(np.asarray(tfidf_prune_diag(
+                jnp.asarray(qb), self.hot_rank, self.hot_tfs, self.tier_of,
+                self.row_of, self.tier_docs, self.tier_tfs, self.df,
+                jnp.int32(self.meta.num_docs), self.hot_max_tf,
+                num_docs=self.meta.num_docs, k=k,
+                compat_int_idf=self.compat_int_idf)))
+        safe = np.concatenate(safe_parts)[: len(q)]
+        blocks = [bool(safe[i : i + block].all())
+                  for i in range(0, len(safe), block)]
+        return {
+            "prune_safe_query_fraction": round(float(safe.mean()), 4),
+            "prune_safe_block_fraction": round(
+                float(np.mean(blocks)), 4),
+            "prune_block_queries": block,
+        }
 
     def _doc_axis_width(self) -> int:
         """Per-device score-accumulator width: the full doc axis, or one
@@ -731,7 +823,8 @@ class Scorer:
                 s, d = bm25_topk_tiered(
                     q, self.hot_rank, self.hot_tfs, self.tier_of,
                     self.row_of, self.tier_docs, self.tier_tfs, self.df,
-                    self.doc_len, n, num_docs=self.meta.num_docs, k=k)
+                    self.doc_len, n, self.hot_max_tf,
+                    num_docs=self.meta.num_docs, k=k, prune=self.prune)
         elif self.layout == "dense":
             s, d = tfidf_topk_dense(q, self.doc_matrix, self.df, n, k=k,
                                     compat_int_idf=self.compat_int_idf)
@@ -741,8 +834,8 @@ class Scorer:
             s, d = tfidf_topk_tiered(
                 q, self.hot_rank, self.hot_tfs, self.tier_of, self.row_of,
                 self.tier_docs, self.tier_tfs, self.df, n,
-                num_docs=self.meta.num_docs, k=k,
-                compat_int_idf=self.compat_int_idf)
+                self.hot_max_tf, num_docs=self.meta.num_docs, k=k,
+                compat_int_idf=self.compat_int_idf, prune=self.prune)
         return s, d
 
     @property
@@ -808,8 +901,8 @@ class Scorer:
                     mesh=self._mesh, k=k, candidates=candidates)
 
             return self._blocked_dispatch(
-                max(1, self.SCORE_BUDGET // self._doc_axis_width()),
-                dispatch, (np.asarray(q_terms, np.int32), -1))
+                self._block_size(), dispatch,
+                (np.asarray(q_terms, np.int32), -1))
         norms = self._doc_norms()
 
         # both stages run inside one block so the candidate matrix never
@@ -829,7 +922,7 @@ class Scorer:
                 num_docs=self.meta.num_docs, k=k)
 
         return self._blocked_dispatch(
-            max(1, self.SCORE_BUDGET // self._doc_axis_width()), dispatch,
+            self._block_size(), dispatch,
             (np.asarray(q_terms, np.int32), -1))
 
     def search_batch(
