@@ -30,22 +30,27 @@ DOC_COUNT = 8_761
 TARGET_BYTES = 23_950_858
 
 
-def make_corpus(path: str, seed: int = 0) -> int:
+def make_corpus(path: str, seed: int = 0, *, n_docs: int | None = None,
+                target_bytes: int | None = None,
+                vocab_size: int | None = None) -> int:
+    n_docs = DOC_COUNT if n_docs is None else n_docs
+    target_bytes = TARGET_BYTES if target_bytes is None else target_bytes
+    vocab_size = VOCAB_SIZE if vocab_size is None else vocab_size
     rng = np.random.default_rng(seed)
     letters = np.array(list("abcdefghijklmnopqrstuvwxyz"))
-    lengths = rng.integers(3, 11, VOCAB_SIZE)
+    lengths = rng.integers(3, 11, vocab_size)
     words = np.array(["".join(rng.choice(letters, l)) for l in lengths])
-    zipf_p = 1.0 / np.arange(1, VOCAB_SIZE + 1)
+    zipf_p = 1.0 / np.arange(1, vocab_size + 1)
     zipf_p /= zipf_p.sum()
 
-    avg_doc_words = TARGET_BYTES // DOC_COUNT // 8  # ~8 bytes/word incl space
+    avg_doc_words = target_bytes // n_docs // 8  # ~8 bytes/word incl space
     n_words_per_doc = rng.integers(avg_doc_words // 2,
-                                   avg_doc_words * 3 // 2, DOC_COUNT)
-    all_ids = rng.choice(VOCAB_SIZE, int(n_words_per_doc.sum()), p=zipf_p)
+                                   avg_doc_words * 3 // 2, n_docs)
+    all_ids = rng.choice(vocab_size, int(n_words_per_doc.sum()), p=zipf_p)
     total = 0
     pos = 0
     with open(path, "w") as f:
-        for i in range(DOC_COUNT):
+        for i in range(n_docs):
             n = int(n_words_per_doc[i])
             body = " ".join(words[all_ids[pos : pos + n]])
             pos += n
@@ -280,6 +285,58 @@ def _eval_loop_roundtrip(tmp: str, index_dir: str, queries, grades,
     }
 
 
+def run_stdlib_eval(tmp: str) -> dict:
+    """Real-corpus quality run (VERDICT r4 next #3): the in-repo frozen
+    collection of CPython stdlib module documentation (data/stdlib/ —
+    144 docs of third-party text, 80 hand-judged topics with graded
+    qrels) through the full standard loop: index build -> TREC topics ->
+    CLI --trec-run run files -> evaluate_run against the qrels. Unlike
+    the synthetic msmarco gate, neither the text nor the judgments were
+    generated by this framework."""
+    import contextlib
+    import io
+
+    from tpu_ir.cli import main as cli_main
+    from tpu_ir.search.evaluate import evaluate_run, read_qrels, read_run
+
+    data = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "data", "stdlib")
+    if not os.path.isdir(data):
+        return {"real_eval": "data/stdlib missing"}
+    idx = os.path.join(tmp, "stdlib-idx")
+    rc = cli_main(["index", os.path.join(data, "corpus.trec"), idx,
+                   "--backend", "cpu", "--shards", "2", "--no-chargrams"])
+    if rc != 0:
+        return {"real_eval": f"index exited {rc}"}
+    qrels = read_qrels(os.path.join(data, "qrels.txt"))
+    out: dict = {"real_eval": "ok", "real_corpus": "cpython-stdlib-docs"}
+    for tag, extra in (("bm25", []), ("rerank", ["--rerank", "100"])):
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            rc = cli_main(["search", idx, "--backend", "cpu", "--topics",
+                           os.path.join(data, "topics.trec"),
+                           "--scoring", "bm25", "--k", "10",
+                           "--trec-run", "bench"] + extra)
+        if rc != 0:
+            return {"real_eval": f"search({tag}) exited {rc}"}
+        run_path = os.path.join(tmp, f"stdlib-run-{tag}.txt")
+        with open(run_path, "w") as f:
+            f.write(buf.getvalue())
+        ev = evaluate_run(read_run(run_path), qrels, complete=True,
+                          exp_gains=True)
+        out[f"real_{tag}_mrr"] = ev["mrr"]
+        out[f"real_{tag}_ndcg_at_10"] = ev["ndcg_at_10"]
+        out[f"real_{tag}_map"] = ev["map"]
+        out["real_queries"] = ev["queries"]
+    return out
+
+
+# floors for the real-corpus eval: far below the measured values
+# (BM25 MRR 0.93 / NDCG@10 0.79 at freeze time) but far above what a
+# broken analyzer or scoring regression could reach
+_REAL_MRR_FLOOR = 0.7
+_REAL_NDCG_FLOOR = 0.6
+
 # minimum msmarco query count for the gate's margins to be meaningful
 _GATE_MIN_QUERIES = 200
 
@@ -300,6 +357,16 @@ def quality_gate(m: dict) -> list[str]:
     if not m["tfidf_ndcg_at_10"] < m["bm25_ndcg_at_10"] \
             < m["rerank_ndcg_at_10"]:
         bad.append("NDCG ordering tfidf < bm25 < rerank violated")
+    if m.get("real_eval") == "ok":
+        # the real-corpus floors: hand-judged qrels over third-party
+        # text — a collapsed analyzer or idf cannot stay above these
+        if m["real_bm25_mrr"] < _REAL_MRR_FLOOR:
+            bad.append(f"real-corpus BM25 MRR {m['real_bm25_mrr']} "
+                       f"below {_REAL_MRR_FLOOR}")
+        if m["real_bm25_ndcg_at_10"] < _REAL_NDCG_FLOOR:
+            bad.append(f"real-corpus BM25 NDCG@10 "
+                       f"{m['real_bm25_ndcg_at_10']} below "
+                       f"{_REAL_NDCG_FLOOR}")
     if "prox_rerank_mrr_prox_subset" in m:
         # the prox-tie pairs tie exactly for every bag-of-words stage and
         # break toward the distractor; a working proximity boost must
@@ -389,6 +456,13 @@ def run_msmarco(args) -> dict:
         # search, run emission, and both eval readers end to end.
         eval_out = _eval_loop_roundtrip(
             tmp, index_dir, queries, grades, bm25_docnos10)
+        # real-corpus quality run, next to the synthetic gate: in-repo
+        # CPython-docs collection + hand-judged qrels (VERDICT r4 #3)
+        real_out = run_stdlib_eval(tmp)
+        metrics.update({k: v for k, v in real_out.items()
+                        if isinstance(v, float)})
+        metrics["real_eval"] = real_out.get("real_eval", "missing")
+        eval_out.update(real_out)
 
         m = min(256, n_queries)
         scorer.topk(q_ids[:m], k=1000, scoring="bm25")  # compile
@@ -706,6 +780,7 @@ def device_query_control(scorer, q_ids: np.ndarray, reps: int = 3) -> dict:
     q = np.full((block, q_all.shape[1]), -1, np.int32)
     q[: min(block, max(n_free, 1))] = sched[: min(block, max(n_free, 1))]
     out = dict(scorer.prune_diag(q_all))
+    out["control_query_block"] = block
     out["control_query_block_hot_free"] = min(block, n_free)
     prev = scorer.prune
     try:
@@ -723,6 +798,50 @@ def device_query_control(scorer, q_ids: np.ndarray, reps: int = 3) -> dict:
     finally:
         scorer.prune = prev
     return out
+
+
+def v48_extrapolation(controls: dict, phases: dict, num_docs: int,
+                      n_queries: int = 10_000) -> dict:
+    """North-star extrapolation computed IN the artifact (VERDICT r4
+    next #4): what the <60 s / 1M-doc target looks like on a v4-8
+    (4 chips, no tunnel), from THIS run's own measurements.
+
+    - device build: the per-chip ceiling measured by the ref-scale probe
+      control (`control_device_build_s`, block_until_ready, no fetch),
+      scaled by 4 chips — the build's device program is
+      throughput-parallel over doc shards (parallel/sharded_build.py).
+    - host phases: taken AS MEASURED on this 1-core container
+      (conservative: a real v4-8 host has ~120 cores and the C++
+      scanner shards trivially by file chunk).
+    - queries: the device-only query control per block, scaled to the
+      10k batch over 4 doc-sharded chips (parallel/sharded_tiered.py).
+
+    Every input rides in the same JSON, so the estimate is recomputable
+    from the artifact alone."""
+    if "control_device_build_s" not in controls:
+        return {}
+    chip_rate = DOC_COUNT_REF / controls["control_device_build_s"]
+    dev_s = num_docs / (chip_rate * 4)
+    host_s = sum(v for k, v in phases.items()
+                 if k.startswith("phase_") and k != "phase_pass2_combine_s"
+                 and isinstance(v, (int, float)))
+    out = {
+        "v48_chip_docs_per_sec": round(chip_rate, 1),
+        "v48_device_build_s_est": round(dev_s, 1),
+        "v48_host_phases_s_measured": round(host_s, 1),
+        "v48_build_s_est": round(dev_s + host_s, 1),
+    }
+    q_s, blk = (controls.get("control_device_query_s"),
+                controls.get("control_query_block"))
+    if q_s and blk:
+        out["v48_query_10k_s_est"] = round(
+            q_s * (n_queries / blk) / 4, 2)
+        out["v48_north_star_s_est"] = round(
+            out["v48_build_s_est"] + out["v48_query_10k_s_est"], 1)
+    return out
+
+
+DOC_COUNT_REF = 8_761  # the probe-corpus size the chip ceiling is measured on
 
 
 def _build_phase_timings(index_dir: str) -> dict:
@@ -871,9 +990,13 @@ def main() -> int:
         if streaming:
             from tpu_ir.index.streaming import build_index_streaming
 
+            # store=True: the docstore rides pass 1's text spills (zero
+            # extra corpus reads — VERDICT r4 next #5); its cost shows up
+            # attributed as phase_docstore_s + the pass-1 spill overhead
             def one_build(out):
                 build_index_streaming([corpus], out, k=1,
-                                      chargram_ks=[2, 3], num_shards=10)
+                                      chargram_ks=[2, 3], num_shards=10,
+                                      store=True)
         else:
             def one_build(out):
                 build_index([corpus], out, k=1, chargram_ks=[2, 3],
@@ -899,6 +1022,21 @@ def main() -> int:
         build_s = min(runs)
         docs_per_sec = DOC_COUNT / build_s
         phases = _build_phase_timings(index_dir)
+
+        # docstore accounting (VERDICT r4 next #5): streaming configs
+        # built the store inside the timed build (phase_docstore_s above
+        # attributes it); the ref config times the standalone corpus pass
+        # the in-memory build uses
+        from tpu_ir.index import docstore as ds
+
+        if not streaming and not args.build_only:
+            t0 = time.perf_counter()
+            ds.build_docstore([corpus], index_dir)
+            phases["docstore_build_s"] = round(time.perf_counter() - t0, 3)
+        if ds.available(index_dir):
+            st = ds.stats(index_dir)
+            phases["docstore_raw_bytes"] = st["raw_bytes"]
+            phases["docstore_stored_bytes"] = st["stored_bytes"]
 
         if args.build_only:
             print(json.dumps({
@@ -933,6 +1071,16 @@ def main() -> int:
                     controls.update(device_build_control(corpus))
                     if not args.cpu:
                         controls.update(_cpu_control_subprocess())
+                elif streaming:
+                    # wiki scale: measure the per-chip device ceiling on
+                    # a ref-scale PROBE corpus (the whole-corpus single
+                    # program at 1M would wedge the tunnel — observed
+                    # UNAVAILABLE) and extrapolate from it (see
+                    # v48_extrapolation below)
+                    probe = os.path.join(tmp, "probe.trec")
+                    make_corpus(probe, n_docs=DOC_COUNT_REF,
+                                target_bytes=23_950_858, vocab_size=30_000)
+                    controls.update(device_build_control(probe))
             except Exception as e:  # noqa: BLE001 — controls are evidence,
                 controls["controls_error"] = str(e)[:300]  # not the metric
 
@@ -972,6 +1120,21 @@ def main() -> int:
             _await_device(scorer)
             load_cold_s = time.perf_counter() - t0
             warm = _warm_load_subprocess(index_dir, cpu=args.cpu)
+            # serving-cache accounting (VERDICT r4 next #7): the cold
+            # load above built + persisted the full tier layout, so a
+            # warm load's floor is uploading these bytes. Recording the
+            # cache size next to the warm child's OWN h2d probe makes
+            # "warm load ~= upload time" checkable from the artifact:
+            # warm_upload_bound_s is that floor at the measured bandwidth.
+            cache_dir = os.path.join(index_dir, "serving-tiered")
+            if os.path.isdir(cache_dir):
+                cache_bytes = sum(
+                    os.path.getsize(os.path.join(cache_dir, f))
+                    for f in os.listdir(cache_dir))
+                warm["serving_cache_bytes"] = cache_bytes
+                if warm.get("warm_h2d_mbps", -1) > 0:
+                    warm["warm_upload_bound_s"] = round(
+                        cache_bytes / (warm["warm_h2d_mbps"] * 1e6), 2)
             rng = np.random.default_rng(1)
             v = scorer.meta.vocab_size
             q_ids = rng.integers(0, v, size=(args.queries, 2)).astype(
@@ -1011,6 +1174,10 @@ def main() -> int:
                     controls.update(device_query_control(scorer, q_ids))
                 except Exception as e:  # noqa: BLE001 — evidence only
                     controls["query_control_error"] = str(e)[:300]
+                if streaming:
+                    controls.update(v48_extrapolation(
+                        controls, phases, DOC_COUNT,
+                        n_queries=args.queries))
         except AssertionError:
             raise
         except Exception as e:  # noqa: BLE001 — record, don't discard

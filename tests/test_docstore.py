@@ -194,3 +194,137 @@ def test_snippet_scan_byte_cap():
     assert snip.endswith(" ...")  # truncation is visible
     # ~20 KB / ~11 bytes per filler word, plus slack
     assert an.calls < 4_000
+
+
+def test_streaming_store_fold_no_second_corpus_read(tmp_path, monkeypatch):
+    """build_index_streaming(store=True) writes the docstore from its
+    pass-1 text spills: content matches the standalone corpus-pass store
+    doc for doc (including a non-ASCII record through the skip path),
+    and read_trec_corpus is never called after pass 1."""
+    import tpu_ir.index.docstore as ds
+    from tpu_ir.index.streaming import build_index_streaming
+
+    docs = {f"S-{i:03d}": f"salmon run number {i} in the river"
+            for i in range(40)}
+    docs["S-UNI"] = "café naïve résumé salmon"  # native-scanner skip path
+    corpus = tmp_path / "c.trec"
+    corpus.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in docs.items()))
+
+    out1 = str(tmp_path / "fold")
+    build_index_streaming([str(corpus)], out1, k=1, num_shards=2,
+                          batch_docs=16, chargram_ks=[], store=True)
+    assert ds.available(out1)
+
+    # the standalone pass over the same corpus must agree per docno
+    out2 = str(tmp_path / "twopass")
+    build_index_streaming([str(corpus)], out2, k=1, num_shards=2,
+                          batch_docs=16, chargram_ks=[])
+    ds.build_docstore([str(corpus)], out2)
+    s1, s2 = ds.DocStore(out1), ds.DocStore(out2)
+    for docno in range(1, len(docs) + 1):
+        assert s1.get(docno) == s2.get(docno)
+    assert ds.stats(out1)["docs"] == len(docs)
+
+
+def test_streaming_store_resume_after_pass2_crash(tmp_path, monkeypatch):
+    """A crash mid-pass-2 with store=True must resume WITHOUT
+    re-tokenizing (text spills survive with the token spills) and still
+    assemble a correct store."""
+    import pytest
+
+    import tpu_ir.index.streaming as streaming
+    from tpu_ir.index.streaming import build_index_streaming
+
+    corpus = write_corpus(tmp_path)
+    out = str(tmp_path / "idx")
+    real_tok = streaming.make_chunked_tokenizer
+    monkeypatch.setattr(  # tiny chunks -> several spill batches
+        streaming, "make_chunked_tokenizer",
+        lambda paths, k=1, **kw: real_tok(paths, k=k, chunk_bytes=120,
+                                          **kw))
+    real = streaming.build_postings_packed_jit
+    calls = {"n": 0}
+
+    def crashing(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] > 1:
+            raise RuntimeError("injected pass-2 crash")
+        return real(*a, **kw)
+
+    monkeypatch.setattr(streaming, "build_postings_packed_jit", crashing)
+    with pytest.raises(RuntimeError, match="injected"):
+        build_index_streaming([corpus], out, k=1, num_shards=2,
+                              batch_docs=2, chargram_ks=[], store=True)
+
+    def boom(*a, **kw):
+        raise AssertionError("resume must not re-tokenize")
+
+    monkeypatch.setattr(streaming, "make_chunked_tokenizer", boom)
+    monkeypatch.setattr(streaming, "build_postings_packed_jit", real)
+    build_index_streaming([corpus], out, k=1, num_shards=2,
+                          batch_docs=2, chargram_ks=[], store=True)
+    from tpu_ir.index.docstore import DocStore
+
+    store = DocStore(out)
+    assert "salmon" in store.get(1)
+
+
+def test_docstore_consistency_gate(idx):
+    """ADVICE r4: a bin/idx size mismatch (crash window between the two
+    writes) must fail loudly at load, not decode garbage."""
+    import shutil
+
+    import pytest
+
+    from tpu_ir.index.docstore import STORE_BIN, DocStore
+
+    out, _ = idx
+    broken = os.path.join(os.path.dirname(out), "broken-idx")
+    shutil.copytree(out, broken)
+    with open(os.path.join(broken, STORE_BIN), "ab") as f:
+        f.write(b"XX")
+    with pytest.raises(ValueError, match="inconsistent"):
+        DocStore(broken)
+
+
+def test_cli_snippets_without_store_clean_error(tmp_path, capsys):
+    """ADVICE r4: `search --snippets` on a store-less index must exit 1
+    with a rebuild hint, not traceback mid-result; `inspect` on a
+    docstore.bin missing its idx sidecar must report, not crash."""
+    corpus = write_corpus(tmp_path)
+    out = str(tmp_path / "idx")
+    build_index([corpus], out, k=1, num_shards=2, compute_chargrams=False)
+    assert main(["search", out, "--backend", "cpu", "-q", "salmon",
+                 "--snippets"]) == 1
+    err = capsys.readouterr().err
+    assert "--store" in err
+
+    # an orphaned docstore.bin (no idx) inspects cleanly
+    with open(os.path.join(out, "docstore.bin"), "wb") as f:
+        f.write(b"garbage")
+    assert main(["inspect", os.path.join(out, "docstore.bin"),
+                 "--backend", "cpu"]) == 0
+    assert "unreadable" in capsys.readouterr().out
+
+
+def test_index_store_rebuilds_inconsistent_store(tmp_path, capsys):
+    """`tpu-ir index --store` is the recovery command the DocStore
+    consistency error recommends — it must actually rebuild a broken
+    (bin/idx mismatched) store, not report its stale stats."""
+    from tpu_ir.index import docstore as ds
+
+    corpus = write_corpus(tmp_path)
+    out = str(tmp_path / "idx")
+    assert main(["index", str(tmp_path), out, "--backend", "cpu",
+                 "--shards", "2", "--no-chargrams", "--store"]) == 0
+    capsys.readouterr()
+    with open(os.path.join(out, "docstore.bin"), "ab") as f:
+        f.write(b"XX")
+    assert not ds.consistent(out)
+    assert main(["index", str(tmp_path), out, "--backend", "cpu",
+                 "--shards", "2", "--no-chargrams", "--store"]) == 0
+    capsys.readouterr()
+    assert ds.consistent(out)
+    assert "<DOC" in ds.DocStore(out).get(1)  # loads + decodes cleanly

@@ -39,7 +39,7 @@ from tpu_ir.parallel.multihost import init_distributed, build_index_multihost
 init_distributed(coordinator, num_processes=2, process_id=pid)
 meta = build_index_multihost([corpus_dir], index_dir, k=1,
                              compute_chargrams=False, batch_docs=2,
-                             positions=True)
+                             positions=True, store=True)
 print(json.dumps({"pid": pid, "num_docs": meta.num_docs,
                   "num_shards": meta.num_shards,
                   "vocab_size": meta.vocab_size,
@@ -121,3 +121,14 @@ def test_multihost_build(tmp_path):
     s_ref = Scorer.load(ref_dir)
     for q in ["alpha", "charlie bravo", "echo", "zulu"]:
         assert s_mh.search(q) == s_ref.search(q), q
+
+    # docstore folded into the multi-host pass 1 (store=True above):
+    # process 0 assembled it from the shared text spills; every doc's
+    # stored content must match, keyed by docno through the mapping
+    from tpu_ir.index.docstore import DocStore, available
+
+    assert available(index_dir)
+    store = DocStore(index_dir)
+    for docid, text in DOCS.items():
+        content = store.get(s_mh.mapping.get_docno(docid))
+        assert text in content and docid in content

@@ -1,0 +1,45 @@
+"""Real-corpus quality gate (VERDICT r4 next #3): the in-repo frozen
+CPython-docs collection (data/stdlib/ — third-party text, hand-judged
+graded qrels) must retrieve well through the FULL standard loop
+(index -> topics -> --trec-run -> evaluate_run). Unlike every other
+quality test, neither the corpus nor the judgments came from this
+framework — a collapsed analyzer, broken idf, or scoring regression
+cannot stay above these floors by construction."""
+
+import os
+
+import bench
+
+
+def test_stdlib_real_corpus_quality(tmp_path):
+    out = bench.run_stdlib_eval(str(tmp_path))
+    assert out["real_eval"] == "ok", out
+    assert out["real_queries"] == 80
+    # floors well below the freeze-time measurements (MRR 0.93 /
+    # NDCG@10 0.79) but unreachable for a degenerate ranker: with 144
+    # docs and k=10, random ranking gives MRR ~0.02
+    assert out["real_bm25_mrr"] >= bench._REAL_MRR_FLOOR
+    assert out["real_bm25_ndcg_at_10"] >= bench._REAL_NDCG_FLOOR
+    assert out["real_rerank_mrr"] >= bench._REAL_MRR_FLOOR
+    assert out["real_rerank_ndcg_at_10"] >= bench._REAL_NDCG_FLOOR
+
+
+def test_stdlib_collection_integrity():
+    """Every qrels judgment refers to a doc in the corpus; every topic
+    has at least one grade-2 judgment."""
+    import re
+
+    data = os.path.join(os.path.dirname(os.path.abspath(bench.__file__)),
+                        "data", "stdlib")
+    docs = set(re.findall(r"<DOCNO> (\S+) </DOCNO>",
+                          open(os.path.join(data, "corpus.trec")).read()))
+    assert len(docs) == 144
+    best: dict[str, int] = {}
+    for line in open(os.path.join(data, "qrels.txt")):
+        qid, _, docid, grade = line.split()
+        assert docid in docs, docid
+        best[qid] = max(best.get(qid, 0), int(grade))
+    topics = len(re.findall(r"<num>", open(
+        os.path.join(data, "topics.trec")).read()))
+    assert topics == 80 and len(best) == 80
+    assert all(g == 2 for g in best.values())

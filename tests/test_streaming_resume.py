@@ -74,7 +74,8 @@ def small_chunks(monkeypatch):
     (batch flush granularity is one tokenizer delta)."""
     monkeypatch.setattr(
         streaming, "make_chunked_tokenizer",
-        lambda paths, k=1: _REAL_TOKENIZER(paths, k=k, chunk_bytes=400))
+        lambda paths, k=1, **kw: _REAL_TOKENIZER(paths, k=k,
+                                                 chunk_bytes=400, **kw))
 
 
 def test_resume_after_pass2_crash(tmp_path, monkeypatch, ref):

@@ -200,6 +200,25 @@ def _split_native_py_files(paths):
     return native_files, py_files
 
 
+def _record_spans(chunk: bytes) -> list[tuple[int, int]]:
+    """(lo, hi) byte spans of every complete <DOC>..</DOC> record, in
+    order — the exact scan the C++ process_records() performs, so spans
+    align one-to-one with the records the scanner ingested or skipped."""
+    spans = []
+    pos = 0
+    while True:
+        lo = chunk.find(b"<DOC>", pos)
+        if lo < 0:
+            break
+        hi = chunk.find(b"</DOC>", lo + 5)
+        if hi < 0:
+            break
+        hi += 6
+        spans.append((lo, hi))
+        pos = hi
+    return spans
+
+
 def _iter_record_chunks(path: str, chunk_bytes: int):
     """Yield byte buffers cut at </DOC> boundaries (records stay whole)."""
     rem = b""
@@ -220,6 +239,14 @@ def _iter_record_chunks(path: str, chunk_bytes: int):
             rem = buf[cut:]
 
 
+def _delta_batch(with_text, docids, flat, lens, texts):
+    """Shape one tokenizer delta: (docids, ids, lens[, texts])."""
+    import numpy as np
+
+    out = (docids, np.array(flat, np.int32), np.array(lens, np.int64))
+    return out + (texts,) if with_text else out
+
+
 class NativeChunkedTokenizer:
     """Streaming whole-corpus ingestion in bounded memory (C++ chunk scan).
 
@@ -237,11 +264,17 @@ class NativeChunkedTokenizer:
     #: multi-GB gzip corpus still streams in bounded memory
     PY_BATCH_DOCS = 5_000
 
-    def __init__(self, paths, chunk_bytes: int = 8 << 20):
+    def __init__(self, paths, chunk_bytes: int = 8 << 20,
+                 with_text: bool = False):
         import numpy as np
 
         self._np = np
         self._chunk_bytes = chunk_bytes
+        # with_text: deltas() yields a 4th element — each doc's raw record
+        # bytes, in the SAME order as the delta's docids — sliced from the
+        # chunk buffer already in hand (the docstore fold pays no second
+        # corpus read; VERDICT r4 next #5)
+        self._with_text = with_text
         lib = load_native()
         if lib is None or not hasattr(lib, "ir_corpus_add_bytes"):
             raise RuntimeError("native chunked ingestion unavailable")
@@ -291,6 +324,20 @@ class NativeChunkedTokenizer:
             docid_buf, skips)
         docids = (docid_buf.raw[:docid_b].decode("utf-8").split("\n")[:-1]
                   if docid_b else [])
+        texts: list[bytes] | None = None
+        if self._with_text:
+            # C++ ingests records in order, diverting skipped ones: the
+            # native docs' spans are the chunk's record spans minus the
+            # skip spans, in order (skip texts are appended below, in the
+            # same order the skip docids are appended)
+            skip_set = {(int(skips[2 * i]), int(skips[2 * i + 1]))
+                        for i in range(n_skip)}
+            texts = [chunk[lo:hi] for lo, hi in _record_spans(chunk)
+                     if (lo, hi) not in skip_set]
+            if len(texts) != n_doc:
+                raise RuntimeError(
+                    f"record-span scan found {len(texts)} native records "
+                    f"but the scanner ingested {n_doc}")
         if n_skip:
             from ..collection.trec import TrecDocument
 
@@ -303,11 +350,17 @@ class NativeChunkedTokenizer:
                 docids.append(doc.docid)
                 extra_ids.extend(toks)
                 lens = np.append(lens, np.int64(len(toks)))
+                if texts is not None:
+                    texts.append(chunk[lo:hi])
             ids = np.concatenate([ids, np.array(extra_ids, np.int32)])
+        if self._with_text:
+            return docids, ids, lens, texts
         return docids, ids, lens
 
     def deltas(self):
-        """Yield (docids, temp_ids int32, doc_lens int64) per chunk."""
+        """Yield (docids, temp_ids int32, doc_lens int64[, texts]) per
+        chunk; `texts` (raw record bytes aligned with docids) only when
+        constructed with_text."""
         from ..collection.trec import read_trec_file
 
         np = self._np
@@ -318,20 +371,22 @@ class NativeChunkedTokenizer:
                     raise OSError(f"native chunk scan failed in {f}")
                 yield self._take_delta(chunk)
         for f in self._py_files:
-            docids, flat, lens = [], [], []
+            docids, flat, lens, texts = [], [], [], []
             for doc in read_trec_file(f):
                 toks = [t for t in self._intern_terms(
                     self._py.analyze(doc.content)) if t >= 0]
                 docids.append(doc.docid)
                 flat.extend(toks)
                 lens.append(len(toks))
+                if self._with_text:
+                    texts.append(doc.content.encode("utf-8"))
                 if len(docids) >= self.PY_BATCH_DOCS:
-                    yield (docids, np.array(flat, np.int32),
-                           np.array(lens, np.int64))
-                    docids, flat, lens = [], [], []
+                    yield _delta_batch(self._with_text, docids, flat,
+                                       lens, texts)
+                    docids, flat, lens, texts = [], [], [], []
             if docids:
-                yield docids, np.array(flat, np.int32), np.array(
-                    lens, np.int64)
+                yield _delta_batch(self._with_text, docids, flat, lens,
+                                   texts)
 
     def vocab(self) -> list[str]:
         nbytes = int(self._lib.ir_corpus_vocab_bytes(ctypes.c_void_p(self._h)))
@@ -350,12 +405,14 @@ class PyChunkedTokenizer:
     """Pure-Python fallback with the NativeChunkedTokenizer interface;
     also the k>1 path (k-gram composition happens on analyzed tokens)."""
 
-    def __init__(self, paths, k: int = 1, batch_docs: int = 5_000):
+    def __init__(self, paths, k: int = 1, batch_docs: int = 5_000,
+                 with_text: bool = False):
         self._paths = paths
         self._k = k
         self._batch = batch_docs
         self._an = make_analyzer()
         self._vocab: dict[str, int] = {}
+        self._with_text = with_text
 
     def _intern(self, term: str) -> int:
         tid = self._vocab.get(term)
@@ -369,19 +426,21 @@ class PyChunkedTokenizer:
 
         from ..collection import kgram_terms, read_trec_corpus
 
-        docids, flat, lens = [], [], []
+        docids, flat, lens, texts = [], [], [], []
         for doc in read_trec_corpus(self._paths):
             toks = self._an.analyze(doc.content)
             grams = kgram_terms(toks, self._k) if self._k > 1 else toks
             docids.append(doc.docid)
             flat.extend(self._intern(g) for g in grams)
             lens.append(len(grams))
+            if self._with_text:
+                texts.append(doc.content.encode("utf-8"))
             if len(docids) >= self._batch:
-                yield (docids, np.array(flat, np.int32),
-                       np.array(lens, np.int64))
-                docids, flat, lens = [], [], []
+                yield _delta_batch(self._with_text, docids, flat, lens,
+                                   texts)
+                docids, flat, lens, texts = [], [], [], []
         if docids:
-            yield docids, np.array(flat, np.int32), np.array(lens, np.int64)
+            yield _delta_batch(self._with_text, docids, flat, lens, texts)
 
     def vocab(self) -> list[str]:
         return list(self._vocab)
@@ -390,17 +449,20 @@ class PyChunkedTokenizer:
         pass
 
 
-def make_chunked_tokenizer(paths, k: int = 1, chunk_bytes: int = 8 << 20):
+def make_chunked_tokenizer(paths, k: int = 1, chunk_bytes: int = 8 << 20,
+                           with_text: bool = False):
     """Native chunked ingestion when possible (k == 1, library present),
-    else the Python fallback. Both yield insertion-ordered temp ids."""
+    else the Python fallback. Both yield insertion-ordered temp ids;
+    `with_text` adds each doc's raw record bytes to every delta."""
     if k == 1:
         try:
-            return NativeChunkedTokenizer(paths, chunk_bytes=chunk_bytes)
+            return NativeChunkedTokenizer(paths, chunk_bytes=chunk_bytes,
+                                          with_text=with_text)
         except RuntimeError:
             # library unavailable only — real I/O errors (missing corpus
             # file etc.) propagate instead of masquerading as a fallback
             pass
-    return PyChunkedTokenizer(paths, k=k)
+    return PyChunkedTokenizer(paths, k=k, with_text=with_text)
 
 
 def make_analyzer(native: bool = True):

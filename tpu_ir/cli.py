@@ -90,7 +90,8 @@ def _run_index(args) -> int:
             batch_docs=args.batch_docs,
             compute_chargrams=not args.no_chargrams,
             spmd_devices=args.spmd_devices,
-            overwrite=args.overwrite, positions=args.positions)
+            overwrite=args.overwrite, positions=args.positions,
+            store=args.store)
     else:
         from .index import build_index
 
@@ -102,9 +103,19 @@ def _run_index(args) -> int:
             spmd_devices=args.spmd_devices, positions=args.positions)
     out = dict(meta.__dict__)
     if args.store:
-        from .index.docstore import build_docstore
+        from .index import docstore as ds
 
-        out["docstore"] = build_docstore(args.corpus, args.index_dir)
+        # the streaming build wrote the store from its pass-1 text
+        # spills (no second corpus read); the in-memory build — and a
+        # prior index being re-run with --store, or one whose store a
+        # crash left bin/idx-inconsistent — pays the corpus pass.
+        # consistent(), not available(): this command is the recovery
+        # path the DocStore error message recommends, so it must
+        # actually rebuild a broken store.
+        out["docstore"] = (ds.stats(args.index_dir)
+                          if ds.consistent(args.index_dir)
+                          else ds.build_docstore(args.corpus,
+                                                 args.index_dir))
     print(json.dumps(out))
     return 0
 
@@ -118,6 +129,18 @@ def cmd_search(args) -> int:
 def _run_search(args) -> int:
     from .search import Scorer
 
+    if args.snippets:
+        # fail BEFORE loading/printing anything: without a usable
+        # document store (missing OR bin/idx-inconsistent from a crash
+        # window) every result row would otherwise die mid-print on the
+        # DocStore ValueError (ADVICE r4)
+        from .index import docstore as ds
+
+        if not ds.consistent(args.index_dir):
+            print("error: index has no usable document store; rebuild "
+                  "with `tpu-ir index --store` to render snippets",
+                  file=sys.stderr)
+            return 1
     scorer = Scorer.load(args.index_dir, layout=args.layout,
                          compat_int_idf=args.compat)
     show_docids = not args.docnos

@@ -142,7 +142,13 @@ def inspect_path(path: str, n: int = 10) -> Iterator[str]:
         index_dir = os.path.dirname(path) or "."
         from .docstore import DocStore
 
-        store = DocStore(index_dir)
+        try:
+            store = DocStore(index_dir)
+        except ValueError as e:
+            # missing idx sidecar / bin-idx mismatch: report, don't
+            # traceback (ADVICE r4)
+            yield f"docstore.bin: unreadable — {e}"
+            return
         ndocs = len(store._lengths)
         yield (f"docstore.bin: document store\tdocs={ndocs}"
                f"\tblocks={len(store._block_starts) - 1}"

@@ -41,16 +41,65 @@ def available(index_dir: str) -> bool:
             and os.path.exists(os.path.join(index_dir, STORE_IDX)))
 
 
-def build_docstore(corpus_paths, index_dir: str, *,
+def consistent(index_dir: str) -> bool:
+    """available() AND the bin's size matches what the idx expects — the
+    crash window between the two writes leaves a pair that available()
+    accepts but DocStore refuses; callers offering to reuse or describe
+    an existing store must gate on THIS (ADVICE r4 + review r5)."""
+    if not available(index_dir):
+        return False
+    try:
+        with np.load(os.path.join(index_dir, STORE_IDX),
+                     allow_pickle=False) as z:
+            expect = int(z["block_starts"][-1])
+        return os.path.getsize(
+            os.path.join(index_dir, STORE_BIN)) == expect
+    except (OSError, KeyError, ValueError):
+        return False
+
+
+def write_text_spill(path: str, texts, docids) -> None:
+    """One pass-1 text spill: zlib blob of the batch's raw record bytes +
+    per-doc lengths + docids. Single producer/consumer pair shared by the
+    streaming and multi-host builds (mirroring write_docstore's one-
+    producer rule for the store itself)."""
+    from . import format as fmt
+
+    fmt.savez_atomic(
+        path,
+        blob=np.frombuffer(zlib.compress(b"".join(texts), 6), np.uint8),
+        lengths=np.array([len(t) for t in texts], np.int64),
+        docids=np.array(list(docids), dtype=np.str_))
+
+
+def iter_text_spill(path: str):
+    """Yield (docid, raw_bytes) from one text spill, in arrival order."""
+    with np.load(path, allow_pickle=False) as z:
+        blob = zlib.decompress(z["blob"].tobytes())
+        lengths = z["lengths"]
+        docids = z["docids"]
+    ofs = 0
+    for docid, ln in zip(docids, lengths):
+        yield str(docid), blob[ofs : ofs + int(ln)]
+        ofs += int(ln)
+
+
+def stats(index_dir: str) -> dict:
+    """Size stats of an existing store (same shape as the build return)."""
+    with np.load(os.path.join(index_dir, STORE_IDX),
+                 allow_pickle=False) as z:
+        return {"docs": int(len(z["lengths"])),
+                "raw_bytes": int(z["lengths"].sum()),
+                "stored_bytes": int(z["block_starts"][-1])}
+
+
+def write_docstore(index_dir: str, records, n: int, *,
                    block_docs: int = BLOCK_DOCS) -> dict:
-    """One streaming corpus pass -> compressed store. Returns size stats
-    (the bench records the overhead). Every doc in the corpus must be in
-    the index's docno mapping — the store and the index must come from
-    the same corpus."""
-    if isinstance(corpus_paths, (str, os.PathLike)):
-        corpus_paths = [corpus_paths]
-    mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
-    n = len(mapping)
+    """Streaming store writer: `records` yields (docno, raw_bytes) in
+    ARRIVAL order; exactly `n` docs are expected (one per docno). Both
+    the corpus-pass builder below and the streaming build's spill
+    assembly (index/streaming.py) write through here, so the on-disk
+    format has one producer. Returns size stats."""
     perm = np.zeros(n + 1, np.int64)
     lengths = np.zeros(n, np.int64)
     block_starts = [0]
@@ -68,19 +117,12 @@ def build_docstore(corpus_paths, index_dir: str, *,
                 block_starts.append(out.tell())
                 block.clear()
 
-            for doc in read_trec_corpus([str(p) for p in corpus_paths]):
-                try:
-                    docno = mapping.get_docno(doc.docid)
-                except KeyError:
-                    raise ValueError(
-                        f"docid {doc.docid!r} not in the index's docno "
-                        "mapping; the store must be built from the same "
-                        "corpus as the index") from None
-                data = doc.content.encode("utf-8")
-                perm[docno] = row
-                lengths[row] = len(data)
-                raw_bytes += len(data)
-                block.append(data)
+            for docno, data in records:
+                if row < n:
+                    perm[docno] = row
+                    lengths[row] = len(data)
+                    raw_bytes += len(data)
+                    block.append(data)
                 row += 1
                 if len(block) >= block_docs:
                     flush()
@@ -99,6 +141,34 @@ def build_docstore(corpus_paths, index_dir: str, *,
         block_docs=np.int64(block_docs))
     return {"docs": n, "raw_bytes": raw_bytes,
             "stored_bytes": int(block_starts[-1])}
+
+
+def build_docstore(corpus_paths, index_dir: str, *,
+                   block_docs: int = BLOCK_DOCS) -> dict:
+    """One streaming corpus pass -> compressed store. Returns size stats
+    (the bench records the overhead). Every doc in the corpus must be in
+    the index's docno mapping — the store and the index must come from
+    the same corpus. The streaming builder avoids this second corpus
+    read entirely (`build_index_streaming(..., store=True)` spills text
+    during pass 1); this standalone pass covers the in-memory build and
+    after-the-fact store construction."""
+    if isinstance(corpus_paths, (str, os.PathLike)):
+        corpus_paths = [corpus_paths]
+    mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
+
+    def records():
+        for doc in read_trec_corpus([str(p) for p in corpus_paths]):
+            try:
+                docno = mapping.get_docno(doc.docid)
+            except KeyError:
+                raise ValueError(
+                    f"docid {doc.docid!r} not in the index's docno "
+                    "mapping; the store must be built from the same "
+                    "corpus as the index") from None
+            yield docno, doc.content.encode("utf-8")
+
+    return write_docstore(index_dir, records(), len(mapping),
+                          block_docs=block_docs)
 
 
 class DocStore:
@@ -121,6 +191,16 @@ class DocStore:
             self._lengths = z["lengths"]
             self._perm = z["perm"]
             self._block_docs = int(z["block_docs"])
+        # consistency gate (ADVICE r4): a crash between replacing the bin
+        # and writing the idx can pair a new bin with a stale idx, whose
+        # offsets would silently decode garbage; the sizes must agree
+        bin_size = os.path.getsize(os.path.join(index_dir, STORE_BIN))
+        if bin_size != int(self._block_starts[-1]):
+            raise ValueError(
+                f"document store is inconsistent: docstore.bin is "
+                f"{bin_size} bytes but its index expects "
+                f"{int(self._block_starts[-1])}; rebuild it with "
+                "`tpu-ir index --store`")
         # per-doc offset within its block: prefix sums reset per block
         self._doc_ofs = np.zeros(len(self._lengths), np.int64)
         for b0 in range(0, len(self._lengths), self._block_docs):

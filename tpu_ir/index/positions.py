@@ -201,8 +201,13 @@ class PositionsReader:
         d = delta[gather].astype(np.int64)
         c = np.cumsum(d)
         # positions within run r = cumsum of its deltas: subtract the
-        # running total just before the run starts
-        base = np.repeat(c[out_starts] - d[out_starts], lens)
+        # running total just before the run starts. A zero-length run at
+        # the tail would put its out_starts entry at `total` (one past
+        # the end) — clamp: its base is repeated 0 times, so any index
+        # is correct (ADVICE r4; today tf >= 1 implies every run is
+        # non-empty, but callers with arbitrary rows must not IndexError)
+        safe = np.minimum(out_starts, total - 1)
+        base = np.repeat(c[safe] - d[safe], lens)
         return lens, c - base
 
     def runs_for_rows(self, shard: int, row_lo: int, row_hi: int

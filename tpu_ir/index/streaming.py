@@ -74,6 +74,7 @@ PASS1_MANIFEST = "pass1.npz"
 def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
                 spmd_devices: int | None,
                 positions: bool = False,
+                store: bool = False,
                 extra: Sequence[str] = ()) -> np.ndarray:
     """Build-config signature stored in the pass-1 manifest: a resume is
     only valid against spills produced by the SAME corpus files and build
@@ -83,7 +84,7 @@ def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
     shape facts (the multi-host build pins process index/count and batch
     size, which all change the spill layout)."""
     parts = [f"k={k}", f"shards={num_shards}", f"spmd={spmd_devices or 0}",
-             f"pos={int(positions)}", *extra]
+             f"pos={int(positions)}", f"store={int(store)}", *extra]
     for p in corpus_paths:
         ap = os.path.abspath(p)
         if os.path.exists(ap):
@@ -213,6 +214,7 @@ def build_index_streaming(
     spmd_devices: int | None = None,
     overwrite: bool = False,
     positions: bool = False,
+    store: bool = False,
 ) -> fmt.IndexMetadata:
     if isinstance(corpus_paths, (str, os.PathLike)):
         corpus_paths = [corpus_paths]
@@ -241,7 +243,8 @@ def build_index_streaming(
     # reusable when its pass-1 manifest matches this exact config; stale or
     # mismatched state (and any half-written artifacts) is discarded ----
     spill_dir = os.path.join(index_dir, "_spill")
-    sig = _config_sig(corpus_paths, k, num_shards, spmd_devices, positions)
+    sig = _config_sig(corpus_paths, k, num_shards, spmd_devices, positions,
+                      store)
     resume_state = _load_resume_state(spill_dir, sig)
     if resume_state is None and os.path.isdir(spill_dir):
         shutil.rmtree(spill_dir, ignore_errors=True)
@@ -258,7 +261,7 @@ def build_index_streaming(
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "num_shards": num_shards, "streaming": True,
         "batch_docs": batch_docs, "spmd_devices": spmd_devices,
-        "resumed": resume_state is not None})
+        "store": store, "resumed": resume_state is not None})
 
     # ---- pass 1: chunked tokenize -> spill temp-id batches ----
     # (each spill batch covers a contiguous docid range; pass 2 walks the
@@ -271,16 +274,31 @@ def build_index_streaming(
         all_docids = []
         n_batches = 0
         occ_per_batch: list[int] = []
-        tok = make_chunked_tokenizer(corpus_paths, k=k)
+        tok = make_chunked_tokenizer(corpus_paths, k=k, with_text=store)
         with report.phase("pass1_tokenize"):
             acc_ids: list[np.ndarray] = []
             acc_lens: list[np.ndarray] = []
+            acc_texts: list[bytes] = []
+            acc_docids: list[str] = []
             acc_docs = 0
 
             def flush():
                 nonlocal n_batches, acc_docs
                 if not acc_docs:
                     return
+                if store:
+                    # text spill FIRST: a token spill's existence is the
+                    # resume marker for the whole batch, so its text twin
+                    # must never trail it (index/docstore.py assembles
+                    # these after pass 3 — zero extra corpus reads)
+                    from .docstore import write_text_spill
+
+                    write_text_spill(
+                        os.path.join(spill_dir,
+                                     f"text-{n_batches:05d}.npz"),
+                        acc_texts, acc_docids)
+                    acc_texts.clear()
+                    acc_docids.clear()
                 ids = np.concatenate(acc_ids)
                 fmt.savez_atomic(
                     os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
@@ -292,7 +310,13 @@ def build_index_streaming(
                 acc_docs = 0
 
             try:
-                for docids_d, ids_d, lens_d in tok.deltas():
+                for delta in tok.deltas():
+                    if store:
+                        docids_d, ids_d, lens_d, texts_d = delta
+                        acc_texts.extend(texts_d)
+                        acc_docids.extend(docids_d)
+                    else:
+                        docids_d, ids_d, lens_d = delta
                     report.incr("Count.DOCS", len(docids_d))
                     all_docids.extend(docids_d)
                     acc_ids.append(ids_d)
@@ -522,6 +546,27 @@ def build_index_streaming(
         dict_report = JobReport("BuildIntDocVectorsForwardIndex")
         dict_report.set_counter("Dictionary.Size", v)
         dict_report.save(os.path.join(index_dir, fmt.JOBS_DIR))
+
+    if store:
+        # assemble the document store from the pass-1 TEXT SPILLS — the
+        # corpus itself is never re-read (VERDICT r4 next #5; contrast
+        # docstore.build_docstore's standalone corpus pass). Arrival
+        # order is the pass-1 delta order; each spill carries its own
+        # docids, docnos come from the mapping.
+        from .docstore import iter_text_spill, write_docstore
+
+        with report.phase("docstore"):
+            def records():
+                for b in range(n_batches):
+                    for docid, data in iter_text_spill(os.path.join(
+                            spill_dir, f"text-{b:05d}.npz")):
+                        dn = int(np.searchsorted(sorted_docids, docid)) + 1
+                        yield dn, data
+
+            stats = write_docstore(index_dir, records(), num_docs)
+            report.set_counter("docstore_raw_bytes", stats["raw_bytes"])
+            report.set_counter("docstore_stored_bytes",
+                               stats["stored_bytes"])
 
     built_chargrams = bool(compute_chargrams and chargram_ks and k == 1)
     if built_chargrams:

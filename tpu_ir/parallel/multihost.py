@@ -77,6 +77,7 @@ def build_index_multihost(
     batch_docs: int = 50_000,  # see streaming.py: fewer lockstep steps
     keep_spills: bool = False,
     positions: bool = False,
+    store: bool = False,
 ) -> "object":
     """End-to-end STREAMING multi-host index build over the global mesh.
 
@@ -151,11 +152,17 @@ def build_index_multihost(
         return fmt.IndexMetadata.load(index_dir)
     spill_dir = os.path.join(index_dir, f"_spill-p{pi:03d}")
     pos_dir = os.path.join(index_dir, "_spill-pos")  # SHARED (see above)
+    # SHARED text spills (store=True): each process spills its batches'
+    # raw record bytes during pass 1 — the docstore fold's zero extra
+    # corpus reads (VERDICT r4 next #5) — and process 0 assembles the
+    # store after pass 3. Each spill carries its own docids, so assembly
+    # needs no cross-process token state.
+    text_dir = os.path.join(index_dir, "_spill-text")
 
     # --- pass-1 resume: per-process manifest against this exact config ---
     my_files = process_file_slice(corpus_paths, pi, pc)
     sig = _config_sig(
-        my_files, k, s, s, positions,
+        my_files, k, s, s, positions, store,
         extra=(f"mh-pi={pi}", f"pc={pc}", f"nlocal={n_local}",
                f"batch={batch_docs}"))
     resume_state = _load_resume_state(spill_dir, sig)
@@ -164,6 +171,8 @@ def build_index_multihost(
     os.makedirs(spill_dir, exist_ok=True)
     if positions:
         os.makedirs(pos_dir, exist_ok=True)
+    if store:
+        os.makedirs(text_dir, exist_ok=True)
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "multihost": True, "process": pi, "process_count": pc,
         "batch_docs": batch_docs, "resumed": resume_state is not None})
@@ -178,16 +187,29 @@ def build_index_multihost(
         report.incr("Count.DOCS", len(my_docids))
         report.set_counter("pass1_resumed_batches", n_batches)
     else:
-        tok = make_chunked_tokenizer(my_files, k=k)
+        tok = make_chunked_tokenizer(my_files, k=k, with_text=store)
         with report.phase("pass1_tokenize"):
             acc_ids: list[np.ndarray] = []
             acc_lens: list[np.ndarray] = []
+            acc_docids: list[str] = []
+            acc_texts: list[bytes] = []
             acc_docs = 0
 
             def flush():
                 nonlocal n_batches, acc_docs
                 if not acc_docs:
                     return
+                if store:
+                    # text spill FIRST: the token spill is the batch's
+                    # resume marker, so its text twin must never trail it
+                    from ..index.docstore import write_text_spill
+
+                    write_text_spill(
+                        os.path.join(
+                            text_dir, f"text-p{pi:03d}-{n_batches:05d}.npz"),
+                        acc_texts, acc_docids)
+                    acc_texts.clear()
+                    acc_docids.clear()
                 lengths = np.concatenate(acc_lens)
                 fmt.savez_atomic(
                     os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
@@ -204,7 +226,13 @@ def build_index_multihost(
                 acc_docs = 0
 
             try:
-                for docids_d, ids_d, lens_d in tok.deltas():
+                for delta in tok.deltas():
+                    if store:
+                        docids_d, ids_d, lens_d, texts_d = delta
+                        acc_texts.extend(texts_d)
+                        acc_docids.extend(docids_d)
+                    else:
+                        docids_d, ids_d, lens_d = delta
                     report.incr("Count.DOCS", len(docids_d))
                     my_docids.extend(docids_d)
                     acc_ids.append(ids_d)
@@ -449,6 +477,30 @@ def build_index_multihost(
     # leave a "complete" index missing shards forever)
     multihost_utils.sync_global_devices("tpu_ir_pass3_done")
     if pi == 0:
+        if store:
+            # assemble the document store from every process's pass-1
+            # text spills (process-major arrival order; each spill is
+            # self-describing with its docids) — the corpus is never
+            # re-read. dims[:, 0] holds each process's batch count.
+            from ..index.docstore import iter_text_spill, write_docstore
+
+            with report.phase("docstore"):
+                def records():
+                    for p in range(pc):
+                        for b in range(int(dims[p, 0])):
+                            for docid, data in iter_text_spill(
+                                    os.path.join(
+                                        text_dir,
+                                        f"text-p{p:03d}-{b:05d}.npz")):
+                                dn = int(np.searchsorted(sorted_docids,
+                                                         docid)) + 1
+                                yield dn, data
+
+                stats = write_docstore(index_dir, records(), num_docs)
+                report.set_counter("docstore_raw_bytes",
+                                   stats["raw_bytes"])
+                report.set_counter("docstore_stored_bytes",
+                                   stats["stored_bytes"])
         mapping.save(os.path.join(index_dir, fmt.DOCNOS))
         vocab.save(os.path.join(index_dir, fmt.VOCAB))
         np.save(os.path.join(index_dir, fmt.DOCLEN), doc_len)
@@ -472,8 +524,11 @@ def build_index_multihost(
     # (deleting earlier made the zero-step resume a kill-timing race)
     if not keep_spills:
         shutil.rmtree(spill_dir, ignore_errors=True)
-        if positions and pi == 0:
-            shutil.rmtree(pos_dir, ignore_errors=True)
+        if pi == 0:
+            if positions:
+                shutil.rmtree(pos_dir, ignore_errors=True)
+            if store:
+                shutil.rmtree(text_dir, ignore_errors=True)
     return fmt.IndexMetadata.load(index_dir)
 
 

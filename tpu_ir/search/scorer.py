@@ -514,9 +514,11 @@ class Scorer:
         """Token-vocabulary fuzzy expansions for the k>1 composition
         path. The chargram sidecar there covers tokens.txt, which carries
         no df, so the truncation rule is (distance asc, term asc) — the
-        deterministic fuzzy analogue of the k>1 wildcard rule (and
-        WildcardLookup.fuzzy's native order, so a limited scan
-        suffices)."""
+        deterministic fuzzy analogue of the k>1 wildcard rule, and
+        WildcardLookup.fuzzy's native order. Note the `limit` truncates
+        the ORDERED result; the candidate scan itself still filters the
+        full match set (ADVICE r4), so a high-df token pays the whole
+        bincount + Levenshtein cost either way."""
         lookup = self._fuzzy_lookup_for(token, max_edits)
         matches = lookup.fuzzy(token, max_edits=max_edits,
                                limit=self.WILDCARD_LIMIT + 1)
