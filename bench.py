@@ -425,11 +425,8 @@ def run_msmarco(args) -> dict:
         # Tie-tolerant: the two paths accumulate f32 in different orders,
         # so docno swaps are allowed only where the score vectors agree
         # within rounding (genuinely tied docs); anything else fails.
-        from tpu_ir.ops.scoring import _prune_applicable
-
         prune_info: dict = {}
-        if scorer.layout == "sparse" and _prune_applicable(
-                10, scorer.meta.num_docs, scorer.prune):
+        if scorer.layout == "sparse" and scorer.prune:
             prev_prune = scorer.prune
             mismatches = 0
             try:
@@ -751,52 +748,44 @@ def device_build_control(corpus: str, reps: int = 3) -> dict:
 def device_query_control(scorer, q_ids: np.ndarray, reps: int = 3) -> dict:
     """Transport-INDEPENDENT query control with a MaxScore A/B: one query
     block dispatched with block_until_ready and NO result fetch, timed
-    with pruning on and off (same scorer, same data — the toggle only
-    flips the lax.cond'd hot-strip stage). The delta is the measured
-    device-side value of the rank-safe pruning (VERDICT r4 next #1);
-    engagement fractions say how often blocks actually take the pruned
-    branch on this query load. Tiered (sparse) layouts only."""
+    with the static cold-only kernel (skip_hot — what the scheduler
+    dispatches for hot-free blocks) and with the full kernel. The delta
+    is the measured device-side value of the pruning (VERDICT r4 next
+    #1); engagement fractions say how many blocks of this query load
+    take the skip kernel. Tiered (sparse) layouts only."""
     if scorer.layout != "sparse":
         return {"control_query_layout": scorer.layout}
     import jax
 
-    from tpu_ir.ops.scoring import _prune_applicable
-
-    if not _prune_applicable(10, scorer.meta.num_docs, True):
-        return {"control_query_prune_applicable": False}
     block = scorer._block_size()
     q_all = np.asarray(q_ids, np.int32)
-    # measure a hot-free prefix in dispatch order (the prune schedule
-    # packs guaranteed-safe queries first): if the block also contained
-    # an unsafe query, BOTH timings would take the full matmul and the
-    # A/B would be a no-op cond. The block is padded back to `block`
-    # rows with PAD queries (ub = 0, safe) so the compiled shape matches
-    # real dispatches.
+    # measure a hot-free prefix in dispatch order: skip_hot is only
+    # exact (and only ever dispatched) for such blocks. Padded back to
+    # `block` rows with PAD queries so the compiled shape matches real
+    # dispatches.
     sched = q_all[scorer._prune_schedule(q_all)]
-    hot_rank = scorer._hot_rank_host()
-    valid = (sched >= 0) & (sched < len(hot_rank))
-    n_free = int((~((hot_rank[np.where(valid, sched, 0)] >= 0)
-                    & valid).any(axis=1)).sum())
-    q = np.full((block, q_all.shape[1]), -1, np.int32)
-    q[: min(block, max(n_free, 1))] = sched[: min(block, max(n_free, 1))]
+    n_free = int((~scorer._has_hot(sched)).sum())
     out = dict(scorer.prune_diag(q_all))
     out["control_query_block"] = block
     out["control_query_block_hot_free"] = min(block, n_free)
-    prev = scorer.prune
-    try:
-        for prune, key in ((True, "control_device_query_s"),
-                           (False, "control_device_query_noprune_s")):
-            scorer.prune = prune
-            times = []
-            for _ in range(reps + 1):  # first rep includes compile; dropped
-                t0 = time.perf_counter()
-                s, d = scorer._topk_device(q, 10, "tfidf")
-                jax.block_until_ready((s, d))
-                times.append(time.perf_counter() - t0)
-            out[key] = round(min(times[1:]), 4)
-            out[key + "_runs"] = [round(t, 4) for t in times[1:]]
-    finally:
-        scorer.prune = prev
+    if n_free == 0:
+        # no hot-free queries: topk() would never dispatch the skip
+        # kernel for this load, so an A/B here would fabricate a
+        # speedup that never materializes
+        out["control_query_skip_na"] = True
+        return out
+    q = np.full((block, q_all.shape[1]), -1, np.int32)
+    q[: min(block, n_free)] = sched[: min(block, n_free)]
+    for skip, key in ((True, "control_device_query_s"),
+                      (False, "control_device_query_noprune_s")):
+        times = []
+        for _ in range(reps + 1):  # first rep includes compile; dropped
+            t0 = time.perf_counter()
+            s, d = scorer._topk_device(q, 10, "tfidf", skip_hot=skip)
+            jax.block_until_ready((s, d))
+            times.append(time.perf_counter() - t0)
+        out[key] = round(min(times[1:]), 4)
+        out[key + "_runs"] = [round(t, 4) for t in times[1:]]
     return out
 
 

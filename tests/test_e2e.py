@@ -409,7 +409,8 @@ def test_streaming_chunked_native_multichunk(tmp_path, monkeypatch):
     orig = native_mod.make_chunked_tokenizer
     monkeypatch.setattr(
         native_mod, "make_chunked_tokenizer",
-        lambda paths, k=1, chunk_bytes=0: orig(paths, k=k, chunk_bytes=128))
+        lambda paths, k=1, chunk_bytes=0, **kw: orig(paths, k=k,
+                                                     chunk_bytes=128, **kw))
     import tpu_ir.index.streaming as streaming_mod
 
     monkeypatch.setattr(streaming_mod, "make_chunked_tokenizer",

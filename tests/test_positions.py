@@ -614,7 +614,8 @@ def test_streaming_positions_resume(tmp_path, monkeypatch):
     real_tok = streaming.make_chunked_tokenizer
     monkeypatch.setattr(
         streaming, "make_chunked_tokenizer",
-        lambda paths, k=1: real_tok(paths, k=k, chunk_bytes=120))
+        lambda paths, k=1, **kw: real_tok(paths, k=k, chunk_bytes=120,
+                                          **kw))
     build_index_streaming([str(p)], ref_dir, **kw)
 
     out = str(tmp_path / "idx")

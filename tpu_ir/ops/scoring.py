@@ -160,7 +160,7 @@ def _topk_from_scores(scores: jax.Array, k: int):
 def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
                    tier_tfs, q_weight, *, num_docs, hot_weight_fn,
                    cold_weight_fn, hot_cell_fn=None, hot_max_w=None,
-                   prune_k=None, with_stats=False):
+                   prune_k=None, with_stats=False, skip_hot=False):
     """Shared tiered accumulation: hot-strip einsum + one masked
     gather/scatter-add per df tier (see search/layout.py for the layout).
 
@@ -200,9 +200,17 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
         return s + w_hot @ hot_weight_fn(hot_tfs)            # [B, D+1]
 
     pruning = prune_k is not None
-    # without pruning, keep the original accumulation order (hot stage
-    # first) so existing callers' float rounding is unchanged
-    scores = (jnp.zeros((b, num_docs + 1), jnp.float32) if pruning
+    # `skip_hot` (static): the caller certified every query in the block
+    # is hot-term-free, so the hot stage contributes EXACTLY zero — omit
+    # it entirely (no matmul, no cond, no candidate machinery). This is
+    # the Scorer's production MaxScore specialization: measured on the
+    # runtime-cond variant, the unconditional top-C over [B, D+1] cost
+    # more than the matmul it skips on CPU backends; the host already
+    # knows which queries have ub = 0, so the skip is free.
+    # Without pruning, keep the original accumulation order (hot stage
+    # first) so existing callers' float rounding is unchanged.
+    scores = (jnp.zeros((b, num_docs + 1), jnp.float32)
+              if pruning or skip_hot
               else hot_matmul(jnp.zeros((b, num_docs + 1), jnp.float32)))
 
     tof = tier_of[safe_q]                                    # [B, L]
@@ -237,7 +245,7 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
         else:
             scores = do_tier(scores)
 
-    if not pruning:
+    if skip_hot or not pruning:
         return (scores, jnp.ones((b,), bool)) if with_stats else scores
     return _hot_stage_pruned(
         scores, hot_tfs, hot_max_w, q_w, rank, is_hot, hot_matmul,
@@ -300,7 +308,7 @@ def _hot_stage_pruned(partial, hot_tfs, hot_max_w, q_w, rank, is_hot,
 
 
 @partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf",
-                                   "prune"))
+                                   "prune", "skip_hot"))
 def tfidf_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
     hot_rank: jax.Array,       # int32 [V]: row in hot_tfs, or -1 (cold)
@@ -317,16 +325,20 @@ def tfidf_topk_tiered(
     k: int = 10,
     compat_int_idf: bool = False,
     prune: bool = False,
+    skip_hot: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """TF-IDF top-k on the tiered sparse layout (search/layout.py): the
     budget-capped hot strip bounds dense memory, geometric tier capacities
     bound padding waste, and every shape stays static under jit.
 
-    `prune=True` (with `hot_max_tf`) enables rank-safe MaxScore pruning of
-    the hot-strip stage (`_hot_stage_pruned`)."""
+    `skip_hot=True` (static) omits the hot-strip stage entirely — exact
+    when the caller certified no query term is hot (the Scorer's
+    scheduled MaxScore path). `prune=True` (with `hot_max_tf`) is the
+    runtime-bounded variant (`_hot_stage_pruned`) for mixed blocks."""
     idf = idf_weights(df, n_scalar, compat_int_idf)
 
-    do_prune = _prune_applicable(k, num_docs, prune) and hot_max_tf is not None
+    do_prune = (not skip_hot and _prune_applicable(k, num_docs, prune)
+                and hot_max_tf is not None)
     # one weight model for cold postings AND pruned hot candidates: the
     # rank-safety contract depends on the two staying identical
     cell_fn = lambda tfs, docs: _lntf(tfs)  # noqa: E731
@@ -336,11 +348,12 @@ def tfidf_topk_tiered(
         cold_weight_fn=cell_fn,
         hot_cell_fn=cell_fn if do_prune else None,
         hot_max_w=_lntf(hot_max_tf.astype(jnp.float32)) if do_prune else None,
-        prune_k=k if do_prune else None)
+        prune_k=k if do_prune else None, skip_hot=skip_hot)
     return _topk_from_scores(scores, k)
 
 
-@partial(jax.jit, static_argnames=("k", "num_docs", "k1", "b", "prune"))
+@partial(jax.jit, static_argnames=("k", "num_docs", "k1", "b", "prune",
+                                   "skip_hot"))
 def bm25_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
     hot_rank: jax.Array,       # int32 [V]
@@ -359,6 +372,7 @@ def bm25_topk_tiered(
     k1: float = 0.9,
     b: float = 0.4,
     prune: bool = False,
+    skip_hot: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Okapi BM25 on the tiered sparse layout — the scorer variant that
     makes BM25 usable past the dense-matrix budget (MS MARCO-scale corpora).
@@ -381,7 +395,8 @@ def bm25_topk_tiered(
     avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
     dl_norm = 1.0 - b + b * dlf / jnp.maximum(avg_dl, 1e-9)  # [D+1]
 
-    do_prune = _prune_applicable(k, num_docs, prune) and hot_max_tf is not None
+    do_prune = (not skip_hot and _prune_applicable(k, num_docs, prune)
+                and hot_max_tf is not None)
     if do_prune:
         # slot 0 is the dead column (doc_len 0 -> the global minimum of
         # dl_norm); exclude it so the bound reflects real documents
@@ -404,7 +419,7 @@ def bm25_topk_tiered(
         cold_weight_fn=cell_fn,
         hot_cell_fn=cell_fn if do_prune else None,
         hot_max_w=hot_max_w,
-        prune_k=k if do_prune else None)
+        prune_k=k if do_prune else None, skip_hot=skip_hot)
     return _topk_from_scores(scores, k)
 
 

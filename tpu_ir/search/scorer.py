@@ -660,6 +660,9 @@ class Scorer:
 
     # max elements of the [B_block, D+1] score accumulator per dispatch
     SCORE_BUDGET = 250_000_000
+    # minimum hot-free group size worth its own (matmul-skipping)
+    # dispatch when the batch is mixed
+    MIN_SKIP_GROUP = 32
 
     def _blocked_dispatch(self, block: int, dispatch, *arrays_pads):
         """Run a per-block device dispatch over padded query-row blocks.
@@ -700,91 +703,127 @@ class Scorer:
         accumulator stays within SCORE_BUDGET elements regardless of corpus
         size (the reference had no batching at all; SURVEY.md §3.3).
 
-        With MaxScore pruning on, queries are stably partitioned so the
-        ones WITHOUT hot-strip terms (upper bound 0 — provably safe, known
-        host-side) fill their own blocks: one unsafe query sends a whole
-        block down the full hot matmul, so packing the guaranteed-safe
-        majority together maximizes pruned blocks. Results are returned
-        in the caller's order."""
-        from ..ops.scoring import _prune_applicable
-
+        MaxScore scheduling (prune on, tiered layout): queries WITHOUT
+        hot-strip terms have a hot-stage upper bound of exactly 0 — the
+        host knows this before dispatch — so they are stably packed into
+        their own blocks and scored by the STATIC cold-only kernel
+        (skip_hot: no hot matmul, no runtime machinery, bit-identical
+        scores); only the blocks that actually contain hot query terms
+        pay the hot-strip stage. Results return in the caller's order.
+        (The runtime-bounded lax.cond variant exists in the kernels but
+        measured slower than the matmul it skips on CPU — its top-C over
+        [B, D+1] is not free — so the production path is this zero-
+        overhead static specialization.)"""
         block = self._block_size()
         q = np.asarray(q_terms, np.int32)
-        if (self.layout == "sparse" and len(q) > block
-                and _prune_applicable(k, self.meta.num_docs, self.prune)):
-            order = self._prune_schedule(q)
-            inv = np.argsort(order, kind="stable")
-            s, d = self._blocked_dispatch(
+        if self.layout != "sparse" or not self.prune:
+            return self._blocked_dispatch(
                 block, lambda qb: self._topk_device(qb, k, scoring),
-                (q[order], -1))
-            return s[inv], d[inv]
-        return self._blocked_dispatch(
-            block, lambda qb: self._topk_device(qb, k, scoring), (q, -1))
+                (q, -1))
+        has_hot, n_free, mode = self._skip_plan(q)
+        if mode == "all_skip":
+            return self._blocked_dispatch(
+                block,
+                lambda qb: self._topk_device(qb, k, scoring,
+                                             skip_hot=True), (q, -1))
+        if mode == "all_full":
+            # too few hot-free queries to pay an extra dispatch for
+            return self._blocked_dispatch(
+                block, lambda qb: self._topk_device(qb, k, scoring),
+                (q, -1))
+        order = np.argsort(has_hot, kind="stable")
+        inv = np.argsort(order, kind="stable")
+        qs = q[order]
+        s1, d1 = self._group_dispatch(qs[:n_free], block,
+                                      lambda qb: self._topk_device(
+                                          qb, k, scoring, skip_hot=True))
+        s2, d2 = self._group_dispatch(qs[n_free:], block,
+                                      lambda qb: self._topk_device(
+                                          qb, k, scoring))
+        return (np.concatenate([s1, s2])[inv],
+                np.concatenate([d1, d2])[inv])
+
+    def _skip_plan(self, q: np.ndarray):
+        """The MaxScore scheduling decision, single source for topk()
+        and prune_diag(): (has_hot [B], n_free, mode) with mode one of
+        'all_skip' (every query hot-free), 'all_full' (too few to pay an
+        extra dispatch), 'split' (grouped dispatch)."""
+        has_hot = self._has_hot(q)
+        n_free = int((~has_hot).sum())
+        if n_free == len(q):
+            mode = "all_skip"
+        elif n_free < self.MIN_SKIP_GROUP:
+            mode = "all_full"
+        else:
+            mode = "split"
+        return has_hot, n_free, mode
+
+    def _group_dispatch(self, qg: np.ndarray, block: int, dispatch):
+        """Dispatch one schedule group, padding its row count to a
+        power-of-two bucket: group sizes are CONTENT-dependent (how many
+        queries were hot-free), and an unpadded dispatch would mint a
+        fresh XLA compile per distinct size (cf. the query-width
+        bucketing in analyze_queries)."""
+        b = len(qg)
+        cap = 1 << max(b - 1, 0).bit_length()
+        if cap >= block or cap == b:
+            # whole blocks are already a fixed shape; exact-bucket sizes
+            # need no padding
+            return self._blocked_dispatch(block, dispatch, (qg, -1))
+        qp = np.full((cap, qg.shape[1]), -1, np.int32)
+        qp[:b] = qg
+        s, d = self._blocked_dispatch(block, dispatch, (qp, -1))
+        return s[:b], d[:b]
 
     def _block_size(self) -> int:
         """Queries per dispatch block: one [block, doc-axis] f32 score
         accumulator stays within SCORE_BUDGET elements."""
         return max(1, self.SCORE_BUDGET // self._doc_axis_width())
 
-    def _prune_schedule(self, q: np.ndarray) -> np.ndarray:
-        """Stable order putting hot-term-free (ub = 0) queries first."""
+    def _has_hot(self, q: np.ndarray) -> np.ndarray:
+        """Bool [B]: does the query reference any hot-strip term? (The
+        MaxScore partition, computed host-side: hot-free queries have a
+        hot-stage upper bound of exactly 0.)"""
         hot_rank = self._hot_rank_host()
         # mirror the kernels' q_valid mask: out-of-vocabulary ids score
         # zero there and must not crash the host-side gather here
         valid = (q >= 0) & (q < len(hot_rank))
-        has_hot = ((hot_rank[np.where(valid, q, 0)] >= 0)
-                   & valid).any(axis=1)
-        return np.argsort(has_hot, kind="stable")
+        return ((hot_rank[np.where(valid, q, 0)] >= 0) & valid).any(axis=1)
+
+    def _prune_schedule(self, q: np.ndarray) -> np.ndarray:
+        """Stable order putting hot-term-free (ub = 0) queries first."""
+        return np.argsort(self._has_hot(q), kind="stable")
 
     def _hot_rank_host(self) -> np.ndarray:
         if not hasattr(self, "_hot_rank_host_cache"):
             self._hot_rank_host_cache = np.asarray(self.hot_rank)
         return self._hot_rank_host_cache
 
-    def prune_diag(self, q_terms: np.ndarray, k: int = 10) -> dict:
-        """MaxScore engagement report for a TF-IDF query batch on the
-        tiered layout: fraction of queries individually safe to prune and
-        fraction of dispatch blocks that would take the pruned branch
-        (one unsafe query sends its whole block down the full matmul)."""
+    def prune_diag(self, q_terms: np.ndarray) -> dict:
+        """MaxScore engagement report for a query batch on the tiered
+        layout, matching what topk() actually dispatches (via the shared
+        _skip_plan): the fraction of queries with zero hot-stage bound
+        (hot-free) and the fraction of scheduled blocks that run the
+        static cold-only kernel."""
         if self.layout != "sparse":
             return {"prune_layout": self.layout}
-        from ..ops.scoring import _prune_applicable, tfidf_prune_diag
-
-        if not _prune_applicable(k, self.meta.num_docs, self.prune):
-            # the kernels statically never prune here (small doc axis /
-            # k too large / prune off) — don't report phantom engagement
+        if not self.prune:
             return {"prune_applicable": False}
-
         q = np.asarray(q_terms, np.int32)
         block = self._block_size()
-        # model the dispatch order topk() actually uses: guaranteed-safe
-        # (hot-free) queries are packed into their own blocks first
-        if len(q) > block:
-            q = q[self._prune_schedule(q)]
-        # dispatch block-by-block like topk: the diag's [B, D+1] partial
-        # accumulator is subject to the same SCORE_BUDGET
-        safe_parts = []
-        for i in range(0, len(q), block):
-            qb = q[i : i + block]
-            if len(qb) < block and len(q) > block:
-                # pad to the compiled block shape; pad rows are all-PAD
-                # queries (ub = 0 -> safe) and are sliced off below
-                pad = np.full((block, q.shape[1]), -1, np.int32)
-                pad[: len(qb)] = qb
-                qb = pad
-            safe_parts.append(np.asarray(tfidf_prune_diag(
-                jnp.asarray(qb), self.hot_rank, self.hot_tfs, self.tier_of,
-                self.row_of, self.tier_docs, self.tier_tfs, self.df,
-                jnp.int32(self.meta.num_docs), self.hot_max_tf,
-                num_docs=self.meta.num_docs, k=k,
-                compat_int_idf=self.compat_int_idf)))
-        safe = np.concatenate(safe_parts)[: len(q)]
-        blocks = [bool(safe[i : i + block].all())
-                  for i in range(0, len(safe), block)]
+        _, n_free, mode = self._skip_plan(q)
+        if mode == "all_skip":
+            skip_blocks, full_blocks = -(-len(q) // block), 0
+        elif mode == "all_full":
+            skip_blocks, full_blocks = 0, -(-len(q) // block)
+        else:
+            skip_blocks = -(-n_free // block)
+            full_blocks = -(-(len(q) - n_free) // block)
+        total = max(skip_blocks + full_blocks, 1)
         return {
-            "prune_safe_query_fraction": round(float(safe.mean()), 4),
-            "prune_safe_block_fraction": round(
-                float(np.mean(blocks)), 4),
+            "prune_hot_free_query_fraction": round(
+                n_free / max(len(q), 1), 4),
+            "prune_skip_block_fraction": round(skip_blocks / total, 4),
             "prune_block_queries": block,
         }
 
@@ -795,8 +834,11 @@ class Scorer:
             return self._sharded.dblk + 1
         return self.meta.num_docs + 1
 
-    def _topk_device(self, q_terms: np.ndarray, k: int, scoring: str):
-        """Dispatch one query block; returns device arrays without waiting."""
+    def _topk_device(self, q_terms: np.ndarray, k: int, scoring: str,
+                     skip_hot: bool = False):
+        """Dispatch one query block; returns device arrays without
+        waiting. `skip_hot` statically omits the tiered hot-strip stage
+        (exact only for blocks the scheduler certified hot-free)."""
         q = jnp.asarray(q_terms)
         n = jnp.int32(self.meta.num_docs)
         if self.layout == "sharded":
@@ -825,8 +867,8 @@ class Scorer:
                 s, d = bm25_topk_tiered(
                     q, self.hot_rank, self.hot_tfs, self.tier_of,
                     self.row_of, self.tier_docs, self.tier_tfs, self.df,
-                    self.doc_len, n, self.hot_max_tf,
-                    num_docs=self.meta.num_docs, k=k, prune=self.prune)
+                    self.doc_len, n, num_docs=self.meta.num_docs, k=k,
+                    skip_hot=skip_hot)
         elif self.layout == "dense":
             s, d = tfidf_topk_dense(q, self.doc_matrix, self.df, n, k=k,
                                     compat_int_idf=self.compat_int_idf)
@@ -836,8 +878,8 @@ class Scorer:
             s, d = tfidf_topk_tiered(
                 q, self.hot_rank, self.hot_tfs, self.tier_of, self.row_of,
                 self.tier_docs, self.tier_tfs, self.df, n,
-                self.hot_max_tf, num_docs=self.meta.num_docs, k=k,
-                compat_int_idf=self.compat_int_idf, prune=self.prune)
+                num_docs=self.meta.num_docs, k=k,
+                compat_int_idf=self.compat_int_idf, skip_hot=skip_hot)
         return s, d
 
     @property
