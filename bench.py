@@ -304,8 +304,12 @@ def run_stdlib_eval(tmp: str) -> dict:
     if not os.path.isdir(data):
         return {"real_eval": "data/stdlib missing"}
     idx = os.path.join(tmp, "stdlib-idx")
-    rc = cli_main(["index", os.path.join(data, "corpus.trec"), idx,
-                   "--backend", "cpu", "--shards", "2", "--no-chargrams"])
+    # redirect: the index command prints the metadata JSON, which would
+    # pollute the bench's one-JSON-line stdout contract
+    with contextlib.redirect_stdout(io.StringIO()):
+        rc = cli_main(["index", os.path.join(data, "corpus.trec"), idx,
+                       "--backend", "cpu", "--shards", "2",
+                       "--no-chargrams"])
     if rc != 0:
         return {"real_eval": f"index exited {rc}"}
     qrels = read_qrels(os.path.join(data, "qrels.txt"))
@@ -763,15 +767,16 @@ def device_query_control(scorer, q_ids: np.ndarray, reps: int = 3) -> dict:
     # exact (and only ever dispatched) for such blocks. Padded back to
     # `block` rows with PAD queries so the compiled shape matches real
     # dispatches.
-    sched = q_all[scorer._prune_schedule(q_all)]
-    n_free = int((~scorer._has_hot(sched)).sum())
+    has_hot, n_free, mode = scorer._skip_plan(q_all)
+    sched = q_all[np.argsort(has_hot, kind="stable")]
     out = dict(scorer.prune_diag(q_all))
     out["control_query_block"] = block
     out["control_query_block_hot_free"] = min(block, n_free)
-    if n_free == 0:
-        # no hot-free queries: topk() would never dispatch the skip
-        # kernel for this load, so an A/B here would fabricate a
-        # speedup that never materializes
+    if mode == "all_full":
+        # topk() never dispatches the skip kernel for this load (no
+        # hot-free queries, or fewer than MIN_SKIP_GROUP — the shared
+        # _skip_plan is the authority), so an A/B here would fabricate
+        # a speedup that never materializes
         out["control_query_skip_na"] = True
         return out
     q = np.full((block, q_all.shape[1]), -1, np.int32)
@@ -1140,12 +1145,22 @@ def main() -> int:
             # per topk call, p50/p99 over 50 calls (the reference REPL's
             # per-query cost was dict lookup + disk seek per term;
             # never measured)
+            rows = np.stack([q_ids[i % len(q_ids)] for i in range(50)])
+            scorer.topk(rows[:1], k=10)  # compile the B=1 shape
+            if scorer.layout == "sparse" and scorer.prune:
+                # topk selects a B=1 kernel variant per row CONTENT
+                # (hot-free -> static skip kernel, hot -> full); warm
+                # every class the timed rows will hit so no compile
+                # lands inside the loop
+                hh = scorer._has_hot(rows)
+                for cls in (False, True):
+                    idx = np.flatnonzero(hh == cls)
+                    if len(idx):
+                        scorer.topk(rows[idx[0]][None, :], k=10)
             lat = []
-            scorer.topk(q_ids[:1], k=10)  # compile the B=1 shape
-            for i in range(50):
-                row = q_ids[i % len(q_ids)][None, :]
+            for row in rows:
                 t0 = time.perf_counter()
-                scorer.topk(row, k=10)
+                scorer.topk(row[None, :], k=10)
                 lat.append(time.perf_counter() - t0)
             lat_ms = np.sort(np.array(lat)) * 1e3
 

@@ -288,6 +288,51 @@ def test_topk_reorder_restores_caller_order(layout):
     assert 0.0 < diag["prune_skip_block_fraction"] < 1.0
 
 
+def test_group_dispatch_shapes_are_content_independent(layout):
+    """Schedule-group sizes are CONTENT-dependent (how many queries were
+    hot-free), so _group_dispatch must only ever dispatch a closed set
+    of shapes — pow2 buckets below the block size and the block size
+    itself. A raw group-sized dispatch (e.g. 40 rows at block=48) would
+    mint a fresh XLA compile per distinct query mix."""
+    s_on = _make_scorer(layout, prune=True, score_budget=(NDOCS + 1) * 48)
+    s_off = _make_scorer(layout, prune=False,
+                         score_budget=(NDOCS + 1) * 1000)
+    block = s_on._block_size()
+    assert block == 48
+    cold_mid = np.nonzero(
+        (np.asarray(s_on.hot_rank) < 0)
+        & (np.asarray(s_on.df) >= 30) & (np.asarray(s_on.df) <= 200))[0]
+    hot = np.nonzero(np.asarray(s_on.hot_rank) >= 0)[0]
+    rng = np.random.default_rng(7)
+    # 40 hot-free + 20 hot: both groups land strictly between block/2
+    # and block (40) or at a pow2 bucket (20 -> 32)
+    q = np.empty((60, 3), np.int32)
+    for i in range(40):
+        q[i] = [int(rng.choice(cold_mid)), int(rng.choice(cold_mid)), -1]
+    for i in range(40, 60):
+        q[i] = [int(rng.choice(hot)), int(rng.choice(cold_mid)), -1]
+    q = q[rng.permutation(60)]
+
+    shapes = []
+    orig = s_on._topk_device
+
+    def spy(qb, *a, **kw):
+        shapes.append(len(qb))
+        return orig(qb, *a, **kw)
+
+    s_on._topk_device = spy
+    s1, d1 = s_on.topk(q, k=10)
+    s0, d0 = s_off.topk(q, k=10)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d0))
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s0), rtol=1e-6)
+    allowed = {block} | {1 << e for e in range(block.bit_length())
+                         if (1 << e) < block}
+    assert shapes and set(shapes) <= allowed, (shapes, allowed)
+    # the hot-free group (40 rows) was padded to the full block, not
+    # dispatched raw
+    assert 40 not in shapes
+
+
 def test_skip_hot_kernel_exact(layout):
     """The static cold-only kernel (skip_hot) must produce bit-identical
     scores to the full kernel for hot-free queries — the hot stage

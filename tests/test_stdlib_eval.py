@@ -11,9 +11,13 @@ import os
 import bench
 
 
-def test_stdlib_real_corpus_quality(tmp_path):
+def test_stdlib_real_corpus_quality(tmp_path, capsys):
     out = bench.run_stdlib_eval(str(tmp_path))
     assert out["real_eval"] == "ok", out
+    # the bench's stdout contract is ONE JSON line; the embedded eval
+    # loop must not leak the CLI's metadata/result printing (a stray
+    # metadata line broke the msmarco artifact in r5)
+    assert capsys.readouterr().out == ""
     assert out["real_queries"] == 80
     # floors well below the freeze-time measurements (MRR 0.93 /
     # NDCG@10 0.79) but unreachable for a degenerate ranker: with 144

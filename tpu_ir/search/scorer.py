@@ -766,11 +766,16 @@ class Scorer:
         bucketing in analyze_queries)."""
         b = len(qg)
         cap = 1 << max(b - 1, 0).bit_length()
-        if cap >= block or cap == b:
-            # whole blocks are already a fixed shape; exact-bucket sizes
-            # need no padding
+        if cap < block:
+            pad_to = cap          # pow2 bucket below the block size
+        else:
+            # pad to whole blocks: _blocked_dispatch sends any tail
+            # smaller than `block` at its raw shape, which for a
+            # content-dependent group size would mint a fresh compile
+            pad_to = -(-b // block) * block
+        if pad_to == b:
             return self._blocked_dispatch(block, dispatch, (qg, -1))
-        qp = np.full((cap, qg.shape[1]), -1, np.int32)
+        qp = np.full((pad_to, qg.shape[1]), -1, np.int32)
         qp[:b] = qg
         s, d = self._blocked_dispatch(block, dispatch, (qp, -1))
         return s[:b], d[:b]
