@@ -91,6 +91,46 @@ def test_pack_occurrences():
         pack_occurrences([np.zeros(9, np.int32)], np.array([1]), capacity=8)
 
 
+def test_chargram_dispatch_shapes_bucketed(monkeypatch, tmp_path):
+    """The chargram device program's input shape must NOT track the
+    exact vocab size / longest term: both are corpus-dependent, and an
+    exact shape misses the persistent compile cache on every new corpus
+    (measured ~100 s of cold compiles at 500k terms vs ~1 s warm).
+    Vocabs in the same pow2 bucket share one compiled shape, and the
+    padding must not change the artifacts."""
+    import tpu_ir.index.builder as builder
+    from tpu_ir.index import format as fmt
+    from tpu_ir.ops.chargram import build_chargram_index_host
+
+    shapes = []
+    orig = builder.build_chargram_index_jit
+
+    def spy(tb, tl, *, k):
+        shapes.append(tuple(tb.shape))
+        return orig(tb, tl, k=k)
+
+    monkeypatch.setattr(builder, "build_chargram_index_jit", spy)
+    terms_a = [f"t{i:05d}" for i in range(900)]
+    terms_b = [f"word{i:05d}x" for i in range(700)]
+    for name, terms in (("a", terms_a), ("b", terms_b)):
+        d = tmp_path / name
+        d.mkdir()
+        builder.build_chargram_artifacts(str(d), terms, [2])
+    assert len(shapes) == 2 and len(set(shapes)) == 1, shapes
+    assert shapes[0][0] >= 1024 and shapes[0][0] & (shapes[0][0] - 1) == 0
+    # padded rows/columns contribute no windows: artifacts match the
+    # unpadded host twin exactly
+    z = fmt.load_chargram(str(tmp_path / "b"), 2)
+    tb, tl = pack_term_bytes(terms_b, 2)
+    hg, hip, hti = build_chargram_index_host(tb, tl, k=2)
+    np.testing.assert_array_equal(z["gram_codes"].astype(np.int64),
+                                  np.asarray(hg, np.int64))
+    np.testing.assert_array_equal(z["indptr"].astype(np.int64),
+                                  np.asarray(hip, np.int64))
+    np.testing.assert_array_equal(z["term_ids"].astype(np.int64),
+                                  np.asarray(hti, np.int64))
+
+
 def test_chargram_index():
     terms = ["cat", "cart", "dog"]  # ids 0,1,2 assumed pre-sorted? not needed
     k = 2
