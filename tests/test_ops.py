@@ -91,6 +91,25 @@ def test_pack_occurrences():
         pack_occurrences([np.zeros(9, np.int32)], np.array([1]), capacity=8)
 
 
+def test_round_cap_buckets():
+    """Device capacities: >= n, granule-aligned at small sizes, and at
+    most 16 distinct buckets per octave at large sizes (each distinct
+    capacity is a separate XLA program)."""
+    from tpu_ir.ops import round_cap
+
+    for n in (0, 1, 100, 1 << 18, (1 << 18) + 1, 10_600_000, 1 << 30):
+        cap = round_cap(n)
+        assert cap >= max(n, 1)
+        assert cap % (1 << 18) == 0 or cap == 1 << 18
+    # one octave at ~16M: every size maps into <= 16 buckets
+    caps = {round_cap(n) for n in range(1 << 24, 1 << 25, 1 << 18)}
+    assert len(caps) <= 16, sorted(caps)
+    # padded waste bounded: granule is 1/16 of the NEXT pow2, so the
+    # tail is < n/8 + granule in the worst case (n just above a pow2)
+    for n in (10_600_000, 123_456_789, (1 << 24) + 1):
+        assert round_cap(n) <= int(n * 1.125) + (1 << 18)
+
+
 def test_chargram_dispatch_shapes_bucketed(monkeypatch, tmp_path):
     """The chargram device program's input shape must NOT track the
     exact vocab size / longest term: both are corpus-dependent, and an

@@ -29,6 +29,7 @@ from ..ops import (
     build_chargram_index_jit,
     build_postings_packed_jit,
     pack_term_bytes,
+    round_cap,
 )
 from ..utils import JobReport, fetch_to_host
 from ..utils.transfer import narrow_uint, shrink_for_fetch, shrink_pairs
@@ -190,11 +191,10 @@ def build_index(
     else:
         # --- single-device path ---
         with report.phase("postings_device"):
-            # round capacity to 256k granularity: padded waste stays < 10%
-            # while repeat builds reuse the compiled program shape
+            # bucketed capacity (<= 8 buckets per octave) so repeat
+            # builds of any corpus reuse the compiled program shape
             granule = 1 << 18
-            cap = max(granule,
-                      (occurrences + granule - 1) // granule * granule)
+            cap = round_cap(occurrences, granule)
             # slim upload: term ids as uint16 when the vocab fits; the doc
             # column is reconstructed on device from per-doc (docno, length)
             use16 = v < int(PAD_TERM_U16)
@@ -298,7 +298,7 @@ def _spmd_postings(flat_term_ids, flat_doc_ids, docnos, *, vocab_size,
     granule = 1 << 14
     max_fill = int(np.bincount(doc_shard, minlength=s).max()) if len(
         flat_term_ids) else 1
-    cap = max(granule, (max_fill + granule - 1) // granule * granule)
+    cap = round_cap(max_fill, granule)
     term_ids = np.full((s, cap), PAD_TERM, np.int32)
     doc_ids = np.zeros((s, cap), np.int32)
     for sh in range(s):

@@ -64,8 +64,7 @@ from . import format as fmt
 from .builder import build_chargram_artifacts
 
 
-def _round_cap(n: int, granule: int = 1 << 18) -> int:
-    return max(granule, (n + granule - 1) // granule * granule)
+from ..ops.postings import round_cap as _round_cap
 
 
 PASS1_MANIFEST = "pass1.npz"
@@ -473,7 +472,7 @@ def build_index_streaming(
             doc_shard = (flat_doc - 1) % s
             counts = np.bincount(doc_shard, minlength=s)
             fill = int(counts.max()) if len(counts) else 1
-            cap = max(granule, (fill + granule - 1) // granule * granule)
+            cap = _round_cap(fill, granule)
             t_arr = np.full((s, cap), PAD_TERM, np.int32)
             d_arr = np.zeros((s, cap), np.int32)
             for sh in range(s):

@@ -9,6 +9,7 @@ from .chargram import (
 from .postings import (
     PAD_TERM,
     PAD_TERM_U16,
+    round_cap,
     Postings,
     build_postings,
     build_postings_jit,
@@ -30,7 +31,7 @@ from .scoring import (
 __all__ = [
     "CharGramIndex", "build_chargram_index", "build_chargram_index_jit",
     "code_to_gram", "gram_to_code", "pack_term_bytes",
-    "PAD_TERM", "PAD_TERM_U16", "Postings", "build_postings",
+    "PAD_TERM", "PAD_TERM_U16", "Postings", "build_postings", "round_cap",
     "build_postings_jit", "build_postings_packed", "build_postings_packed_jit",
     "pack_occurrences",
     "PAD_QTERM", "bm25_topk_dense", "cosine_rerank_dense",

@@ -25,6 +25,20 @@ import numpy as np
 PAD_TERM = np.int32(np.iinfo(np.int32).max)
 
 
+def round_cap(n: int, granule: int = 1 << 18) -> int:
+    """Round a data-dependent size up to a bucketed device capacity.
+
+    The granule grows with the magnitude (1/16 of the next pow2), so
+    sizes land in at most 16 buckets per octave: every distinct
+    capacity is a separate XLA program — measured up to ~60 s of
+    compile per extra bucket at wiki1m shapes — while the padded tail
+    that recurs on every upload stays <= 6.25%. Shared by the
+    in-memory, streaming, and SPMD builders so repeat builds of ANY
+    corpus reuse the persistent compile cache."""
+    g = max(granule, 1 << max(int(n).bit_length() - 4, 0))
+    return max(g, (n + g - 1) // g * g)
+
+
 class Postings(NamedTuple):
     """Term-sharded (or single-shard) postings in compacted sorted order.
 

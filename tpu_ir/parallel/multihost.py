@@ -297,7 +297,9 @@ def build_index_multihost(
         cap = int(dims[:, 1].max())
         all_resumed = bool(dims[:, 2].all())
         granule = 1 << 12
-        cap = max(granule, (cap + granule - 1) // granule * granule)
+        from ..ops.postings import round_cap
+
+        cap = round_cap(cap, granule)
         sh2 = NamedSharding(mesh, P(SHARD_AXIS, None))
         sh1 = NamedSharding(mesh, P(SHARD_AXIS))
 
