@@ -1127,8 +1127,11 @@ def main() -> int:
                     for f in os.listdir(cache_dir))
                 warm["serving_cache_bytes"] = cache_bytes
                 if warm.get("warm_h2d_mbps", -1) > 0:
+                    # the probe reports MiB/s (32 MiB buffer / seconds),
+                    # so the floor divides by MiB too
                     warm["warm_upload_bound_s"] = round(
-                        cache_bytes / (warm["warm_h2d_mbps"] * 1e6), 2)
+                        cache_bytes / (warm["warm_h2d_mbps"] * (1 << 20)),
+                        2)
             rng = np.random.default_rng(1)
             v = scorer.meta.vocab_size
             q_ids = rng.integers(0, v, size=(args.queries, 2)).astype(

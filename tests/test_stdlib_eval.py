@@ -7,8 +7,11 @@ framework — a collapsed analyzer, broken idf, or scoring regression
 cannot stay above these floors by construction."""
 
 import os
+import sys
 
-import bench
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import bench  # noqa: E402 — repo root on sys.path first
 
 
 def test_stdlib_real_corpus_quality(tmp_path, capsys):
