@@ -335,6 +335,42 @@ def run_stdlib_eval(tmp: str) -> dict:
     return out
 
 
+_STDLIB_EVAL_CODE = """
+import json, sys, tempfile
+sys.path.insert(0, {bench_dir!r})
+import bench
+with tempfile.TemporaryDirectory() as tmp:
+    out = bench.run_stdlib_eval(tmp)
+print("STDLIB_JSON=" + json.dumps(out))
+"""
+
+
+def run_stdlib_eval_subprocess() -> dict:
+    """run_stdlib_eval in its own interpreter, CPU-pinned from the env.
+
+    The eval drives the CLI with --backend cpu, and cli._apply_backend
+    deliberately repins the WHOLE process (jax_platforms + backend
+    factories + clear_backends) — in-process it would silently migrate
+    every subsequent bench measurement off the TPU while the artifact
+    still says backend=tpu. Only the JSON crosses back."""
+    import subprocess
+
+    bench_dir = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             _STDLIB_EVAL_CODE.format(bench_dir=bench_dir)],
+            capture_output=True, text=True, timeout=1800, env=env)
+        for line in r.stdout.splitlines():
+            if line.startswith("STDLIB_JSON="):
+                return json.loads(line.split("=", 1)[1])
+        return {"real_eval": f"subprocess produced no result "
+                             f"(rc={r.returncode}): {r.stderr[-200:]}"}
+    except (subprocess.SubprocessError, OSError, ValueError) as e:
+        return {"real_eval": f"subprocess failed: {e}"[:200]}
+
+
 # floors for the real-corpus eval: far below the measured values
 # (BM25 MRR 0.93 / NDCG@10 0.79 at freeze time) but far above what a
 # broken analyzer or scoring regression could reach
@@ -458,8 +494,11 @@ def run_msmarco(args) -> dict:
         eval_out = _eval_loop_roundtrip(
             tmp, index_dir, queries, grades, bm25_docnos10)
         # real-corpus quality run, next to the synthetic gate: in-repo
-        # CPython-docs collection + hand-judged qrels (VERDICT r4 #3)
-        real_out = run_stdlib_eval(tmp)
+        # CPython-docs collection + hand-judged qrels (VERDICT r4 #3).
+        # In a SUBPROCESS: the eval pins its process to the CPU backend
+        # (the CLI's --backend is process-wide), which would silently
+        # move every later msmarco measurement off the TPU
+        real_out = run_stdlib_eval_subprocess()
         metrics.update({k: v for k, v in real_out.items()
                         if isinstance(v, float)})
         metrics["real_eval"] = real_out.get("real_eval", "missing")
@@ -768,7 +807,7 @@ def device_query_control(scorer, q_ids: np.ndarray, reps: int = 3) -> dict:
     # `block` rows with PAD queries so the compiled shape matches real
     # dispatches.
     has_hot, n_free, mode = scorer._skip_plan(q_all)
-    sched = q_all[np.argsort(has_hot, kind="stable")]
+    sched = q_all[scorer._schedule_order(has_hot)]
     out = dict(scorer.prune_diag(q_all))
     out["control_query_block"] = block
     out["control_query_block_hot_free"] = min(block, n_free)
@@ -963,6 +1002,14 @@ def main() -> int:
             return 1
         # MaxScore pruning must be rank-safe on the gate corpus
         if out.get("prune_parity", "ok") != "ok":
+            return 1
+        # the real-corpus eval must actually RUN: its floors live in
+        # quality_gate but only apply when real_eval == "ok", so an
+        # end-to-end breakage of stdlib indexing/search must fail here
+        # rather than silently skipping the gate
+        if out.get("real_eval") != "ok":
+            print(f"bench: real-corpus eval failed: "
+                  f"{out.get('real_eval')}", file=sys.stderr)
             return 1
         return 0
 

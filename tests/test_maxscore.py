@@ -248,7 +248,6 @@ def _make_scorer(layout_fixture, *, prune: bool, score_budget: int):
     s.meta = M()
     (s.hot_rank, s.hot_tfs, s.tier_of, s.row_of,
      s.tier_docs, s.tier_tfs) = args
-    s.hot_max_tf = hot_max_tf
     s.df = jnp.asarray(df)
     return s
 

@@ -191,7 +191,7 @@ def build_index(
     else:
         # --- single-device path ---
         with report.phase("postings_device"):
-            # bucketed capacity (<= 8 buckets per octave) so repeat
+            # bucketed capacity (<= 16 buckets per octave) so repeat
             # builds of any corpus reuse the compiled program shape
             granule = 1 << 18
             cap = round_cap(occurrences, granule)

@@ -28,11 +28,13 @@ PAD_TERM = np.int32(np.iinfo(np.int32).max)
 def round_cap(n: int, granule: int = 1 << 18) -> int:
     """Round a data-dependent size up to a bucketed device capacity.
 
-    The granule grows with the magnitude (1/16 of the next pow2), so
+    The granule grows with the magnitude (1/16 of the NEXT pow2), so
     sizes land in at most 16 buckets per octave: every distinct
     capacity is a separate XLA program — measured up to ~60 s of
-    compile per extra bucket at wiki1m shapes — while the padded tail
-    that recurs on every upload stays <= 6.25%. Shared by the
+    compile per extra bucket at wiki1m shapes. The padded tail that
+    recurs on every upload is < one granule: <= 6.25% when n sits in
+    the upper half of its octave, approaching 12.5% in the worst case
+    (n just above a pow2, where the granule is ~n/8). Shared by the
     in-memory, streaming, and SPMD builders so repeat builds of ANY
     corpus reuse the persistent compile cache."""
     g = max(granule, 1 << max(int(n).bit_length() - 4, 0))

@@ -185,9 +185,9 @@ class Scorer:
             # of the ~2 GB dense matrix over the H2D link (the serving
             # cold-start bottleneck; search/layout.py::hot_device)
             self.hot_tfs = tiers.hot_device()
-            # per-hot-row max tf: the MaxScore upper-bound input, one
-            # cheap device reduction over the strip at load time
-            self.hot_max_tf = jnp.max(self.hot_tfs, axis=1)
+            # (no hot_max_tf here: the runtime-bounded prune kernels
+            # that take it are not the production path — the scheduled
+            # static skip needs only hot_rank; tests compute it locally)
             self.tier_of = jnp.asarray(tiers.tier_of)
             self.row_of = jnp.asarray(tiers.row_of)
             self.tier_docs = tuple(jnp.asarray(a) for a in tiers.tier_docs)
@@ -731,7 +731,7 @@ class Scorer:
             return self._blocked_dispatch(
                 block, lambda qb: self._topk_device(qb, k, scoring),
                 (q, -1))
-        order = np.argsort(has_hot, kind="stable")
+        order = self._schedule_order(has_hot)
         inv = np.argsort(order, kind="stable")
         qs = q[order]
         s1, d1 = self._group_dispatch(qs[:n_free], block,
@@ -795,9 +795,16 @@ class Scorer:
         valid = (q >= 0) & (q < len(hot_rank))
         return ((hot_rank[np.where(valid, q, 0)] >= 0) & valid).any(axis=1)
 
+    @staticmethod
+    def _schedule_order(has_hot: np.ndarray) -> np.ndarray:
+        """THE schedule: stable order putting hot-term-free (ub = 0)
+        queries first. Single source for topk()'s grouped dispatch, the
+        bench's device query control, and the scheduling tests."""
+        return np.argsort(has_hot, kind="stable")
+
     def _prune_schedule(self, q: np.ndarray) -> np.ndarray:
-        """Stable order putting hot-term-free (ub = 0) queries first."""
-        return np.argsort(self._has_hot(q), kind="stable")
+        """Schedule order for a raw query batch (see _schedule_order)."""
+        return self._schedule_order(self._has_hot(q))
 
     def _hot_rank_host(self) -> np.ndarray:
         if not hasattr(self, "_hot_rank_host_cache"):
