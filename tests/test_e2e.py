@@ -307,7 +307,52 @@ def test_streaming_build_equals_in_memory(tmp_path):
         assert s1.search(q) == s2.search(q)
 
 
-def test_spmd_streaming_build_equals_single_device_streaming(tmp_path):
+def test_streaming_batches_share_device_shapes(tmp_path, monkeypatch):
+    """Batch token counts are data-dependent and jitter batch to batch;
+    the pass-2 dispatch capacities must collapse onto round_cap buckets
+    (each distinct capacity is a separate XLA compile — measured up to
+    ~60 s each at wiki1m scale). Documents of deliberately varying size
+    across many small batches must reuse a tiny set of shapes."""
+    import tpu_ir.index.streaming as streaming
+    from tpu_ir.ops import round_cap
+
+    rng = np.random.default_rng(5)
+    corpus = tmp_path / "vary.trec"
+    with open(corpus, "w") as f:
+        for i in range(24):
+            words = " ".join(
+                rng.choice(["alpha", "beta", "gamma", "delta", "eps"],
+                           int(rng.integers(3, 40))))
+            f.write(f"<DOC>\n<DOCNO> V-{i:03d} </DOCNO>\n<TEXT>\n{words}\n"
+                    f"</TEXT>\n</DOC>\n")
+
+    shapes = []
+    orig = streaming.build_postings_packed_jit
+
+    def spy(t, d, l, **kw):
+        shapes.append((int(t.shape[0]), int(d.shape[0])))
+        return orig(t, d, l, **kw)
+
+    monkeypatch.setattr(streaming, "build_postings_packed_jit", spy)
+    # tiny chunk budget -> many chunks -> many real batches (a small
+    # corpus otherwise arrives as one chunk and one batch)
+    from tpu_ir.analysis import native as native_mod
+
+    orig_tok = native_mod.make_chunked_tokenizer
+    monkeypatch.setattr(
+        streaming, "make_chunked_tokenizer",
+        lambda paths, k=1, chunk_bytes=0, **kw: orig_tok(
+            paths, k=k, chunk_bytes=128, **kw))
+    out = str(tmp_path / "idx")
+    streaming.build_index_streaming([str(corpus)], out, k=1,
+                                    batch_docs=3, num_shards=2,
+                                    compute_chargrams=False)
+    assert len(shapes) >= 6  # many batches actually dispatched
+    for cap, doc_cap in shapes:
+        assert cap == round_cap(cap)       # already a bucket fixpoint
+        assert doc_cap == round_cap(doc_cap, 1 << 14)
+    # jittered batch sizes collapse onto very few compiled shapes
+    assert len(set(shapes)) <= 2, shapes
     """--streaming --spmd-devices 8: the mesh shuffle (doc-dealt map +
     all_to_all + term-shard reduce per batch) must produce BYTE-IDENTICAL
     artifacts to the single-device streaming build at the same shard count
