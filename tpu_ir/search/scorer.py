@@ -203,6 +203,14 @@ class Scorer:
             # minutes-long shard read + CSR assembly of a large index
             raise ValueError(f"unknown layout {layout!r}; expected "
                              "'auto', 'dense', 'sparse' or 'sharded'")
+        from .. import enable_compilation_cache
+
+        # the serving path compiles ~20 programs (layout scatter, top-k
+        # kernels); without the persistent cache a fresh serving process
+        # pays them all again — measured 24.4 s of a 25.4 s ref-scale
+        # warm load was backend_compile_and_load (builders already
+        # enable this; the serving process must too)
+        enable_compilation_cache()
         meta = fmt.IndexMetadata.load(index_dir)
         vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
         mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
