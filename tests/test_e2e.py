@@ -353,6 +353,9 @@ def test_streaming_batches_share_device_shapes(tmp_path, monkeypatch):
         assert doc_cap == round_cap(doc_cap, 1 << 14)
     # jittered batch sizes collapse onto very few compiled shapes
     assert len(set(shapes)) <= 2, shapes
+
+
+def test_spmd_streaming_build_equals_single_device_streaming(tmp_path):
     """--streaming --spmd-devices 8: the mesh shuffle (doc-dealt map +
     all_to_all + term-shard reduce per batch) must produce BYTE-IDENTICAL
     artifacts to the single-device streaming build at the same shard count

@@ -84,6 +84,23 @@ def iter_text_spill(path: str):
         ofs += int(ln)
 
 
+def iter_text_spill_docnos(path: str, sorted_docids: np.ndarray):
+    """Yield (docno, raw_bytes) from one text spill, in arrival order —
+    the docid→docno lookup is one vectorized searchsorted over the
+    spill's docid column, not a scalar probe per document (at 1M docs
+    the per-doc numpy dispatch overhead is seconds of host time inside
+    the timed docstore phase)."""
+    with np.load(path, allow_pickle=False) as z:
+        blob = zlib.decompress(z["blob"].tobytes())
+        lengths = z["lengths"]
+        docids = z["docids"]
+    docnos = np.searchsorted(sorted_docids, docids.astype(np.str_)) + 1
+    ofs = 0
+    for dn, ln in zip(docnos, lengths):
+        yield int(dn), blob[ofs : ofs + int(ln)]
+        ofs += int(ln)
+
+
 def stats(index_dir: str) -> dict:
     """Size stats of an existing store (same shape as the build return)."""
     with np.load(os.path.join(index_dir, STORE_IDX),

@@ -552,15 +552,14 @@ def build_index_streaming(
         # docstore.build_docstore's standalone corpus pass). Arrival
         # order is the pass-1 delta order; each spill carries its own
         # docids, docnos come from the mapping.
-        from .docstore import iter_text_spill, write_docstore
+        from .docstore import iter_text_spill_docnos, write_docstore
 
         with report.phase("docstore"):
             def records():
                 for b in range(n_batches):
-                    for docid, data in iter_text_spill(os.path.join(
-                            spill_dir, f"text-{b:05d}.npz")):
-                        dn = int(np.searchsorted(sorted_docids, docid)) + 1
-                        yield dn, data
+                    yield from iter_text_spill_docnos(
+                        os.path.join(spill_dir, f"text-{b:05d}.npz"),
+                        sorted_docids)
 
             stats = write_docstore(index_dir, records(), num_docs)
             report.set_counter("docstore_raw_bytes", stats["raw_bytes"])

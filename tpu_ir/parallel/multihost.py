@@ -484,19 +484,18 @@ def build_index_multihost(
             # text spills (process-major arrival order; each spill is
             # self-describing with its docids) — the corpus is never
             # re-read. dims[:, 0] holds each process's batch count.
-            from ..index.docstore import iter_text_spill, write_docstore
+            from ..index.docstore import (iter_text_spill_docnos,
+                                          write_docstore)
 
             with report.phase("docstore"):
                 def records():
                     for p in range(pc):
                         for b in range(int(dims[p, 0])):
-                            for docid, data in iter_text_spill(
-                                    os.path.join(
-                                        text_dir,
-                                        f"text-p{p:03d}-{b:05d}.npz")):
-                                dn = int(np.searchsorted(sorted_docids,
-                                                         docid)) + 1
-                                yield dn, data
+                            yield from iter_text_spill_docnos(
+                                os.path.join(
+                                    text_dir,
+                                    f"text-p{p:03d}-{b:05d}.npz"),
+                                sorted_docids)
 
                 stats = write_docstore(index_dir, records(), num_docs)
                 report.set_counter("docstore_raw_bytes",
