@@ -560,7 +560,7 @@ def test_tiered_big_tier_cond_path():
         np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
 
 class TestChargramHostFallback:
-    """4 < k <= 8 grams pack into int64 on host (ops/chargram.py); the
+    """3 < k <= 7 grams pack into int64 on host (ops/chargram.py); the
     semantics must match the device path's: '$term$' byte windows, per-gram
     sorted-unique term lists."""
 
@@ -582,6 +582,33 @@ class TestChargramHostFallback:
             gi = int(np.searchsorted(codes, gram_to_code(gram, 5)))
             got = tids[indptr[gi] : indptr[gi + 1]].tolist()
             assert got == sorted(want), gram
+
+    def test_k4_non_ascii_routed_to_host_path(self, tmp_path):
+        """k=4 would shift a gram's leading byte by 24 bits in int32 —
+        negative codes for any non-ASCII byte >= 0x80, unfindable by
+        gram_to_code's unsigned lookup. The builder must route k=4 to the
+        int64 host twin and wildcard expansion over it must still match
+        multi-byte UTF-8 terms end-to-end."""
+        from tpu_ir.index import build_index
+        from tpu_ir.index import format as fmt
+        from tpu_ir.search.wildcard import WildcardLookup
+
+        corpus = tmp_path / "c.trec"
+        corpus.write_text(
+            "<DOC>\n<DOCNO> U-1 </DOCNO>\n<TEXT>\ncafézzz naïveté plain"
+            "\n</TEXT>\n</DOC>\n", encoding="utf-8")
+        idx = str(tmp_path / "idx")
+        meta = build_index([str(corpus)], idx, chargram_ks=[4],
+                           num_shards=2)
+        assert meta.chargram_ks == [4]
+        z = fmt.load_chargram(idx, 4)
+        assert (np.asarray(z["gram_codes"]) >= 0).all()
+        lookup = WildcardLookup.load(idx, 4)
+        assert "cafézzz" in lookup.expand("café*")
+        # and the device program refuses k=4 outright
+        tb, tl = pack_term_bytes(["café"], 4)
+        with pytest.raises(ValueError):
+            build_chargram_index_jit(jnp.asarray(tb), jnp.asarray(tl), k=4)
 
     def test_k_gt_7_rejected(self):
         """k=8 would let grams with a >=0x80 leading byte (any non-ASCII)
@@ -610,7 +637,7 @@ class TestChargramHostFallback:
         assert 0 in tids[indptr[gi] : indptr[gi + 1]]
 
     def test_builder_integration_and_expand(self, tmp_path):
-        """chargram_ks mixing device (<=4) and host (>4) ks builds both
+        """chargram_ks mixing device (<=3) and host (>3) ks builds both
         artifacts, and wildcard expansion works over the k=5 index."""
         from tpu_ir.index import build_index
         from tpu_ir.search.wildcard import WildcardLookup

@@ -164,6 +164,7 @@ def tokenize_corpus_native(paths):
         if extra_docs:
             vocab_index = {t: i for i, t in enumerate(vocab_list)}
             extra_ids: list[int] = []
+            extra_lens: list[int] = []
             for docid, toks in extra_docs:
                 docids.append(docid)
                 for t in toks:
@@ -173,7 +174,11 @@ def tokenize_corpus_native(paths):
                         vocab_index[t] = tid
                         vocab_list.append(t)
                     extra_ids.append(tid)
-                doc_lens = np.append(doc_lens, np.int64(len(toks)))
+                extra_lens.append(len(toks))
+            # one concatenate, not np.append per doc — appending copies
+            # the whole array each time, O(n^2) over many fallback docs
+            doc_lens = np.concatenate(
+                [doc_lens, np.array(extra_lens, np.int64)])
             ids = np.concatenate([ids, np.array(extra_ids, np.int32)])
         return docids, ids, doc_lens, vocab_list
     finally:

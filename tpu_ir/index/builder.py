@@ -363,11 +363,13 @@ def dispatch_chargram_builds(
         # just the fetch+write in collect
         report = JobReport("CharKGramTermIndexer", config={"k": ck},
                            suffix=f"-k{ck}")
-        if ck > 4:
-            # int64 gram codes don't fit the x32 device sort; defer the
-            # numpy twin to collect time as a thunk so dispatch stays
-            # non-blocking (the builder slots its postings fetch between
-            # dispatch and collect — host work here would serialize that)
+        if ck > 3:
+            # k=4 codes wrap int32's sign bit for non-ASCII leading bytes
+            # and k>4 needs int64 outright, which the x32 device sort
+            # can't take; defer the numpy twin to collect time as a thunk
+            # so dispatch stays non-blocking (the builder slots its
+            # postings fetch between dispatch and collect — host work
+            # here would serialize that)
             from ..ops.chargram import build_chargram_index_host
 
             return ck, ("host", lambda: build_chargram_index_host(

@@ -72,18 +72,6 @@ def write_text_spill(path: str, texts, docids) -> None:
         docids=np.array(list(docids), dtype=np.str_))
 
 
-def iter_text_spill(path: str):
-    """Yield (docid, raw_bytes) from one text spill, in arrival order."""
-    with np.load(path, allow_pickle=False) as z:
-        blob = zlib.decompress(z["blob"].tobytes())
-        lengths = z["lengths"]
-        docids = z["docids"]
-    ofs = 0
-    for docid, ln in zip(docids, lengths):
-        yield str(docid), blob[ofs : ofs + int(ln)]
-        ofs += int(ln)
-
-
 def iter_text_spill_docnos(path: str, sorted_docids: np.ndarray):
     """Yield (docno, raw_bytes) from one text spill, in arrival order —
     the docid→docno lookup is one vectorized searchsorted over the

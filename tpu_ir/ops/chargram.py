@@ -7,17 +7,20 @@ terms; the output lists are sorted and deduplicated (the reference reducer's
 iterative pairwise sorted-merge).
 
 TPU-first: terms become a padded uint8 matrix; sliding windows are a strided
-gather; each gram packs its k bytes into one int32 code (k <= 4); then the
-same sort + run-length machinery as the inverted index groups (gram, term)
-pairs. Because term ids are assigned in lexicographic order, the per-gram
-term-id lists come out sorted exactly like the reference's merged string
-lists. For 4 < k <= 7 a host (numpy) twin packs grams into int64 instead —
-the default x32 jax config has no int64 sort, and k that large is far off
-the reference's k=2,3 hot path, so it does not earn a device program. k > 7
-is rejected: a gram must pack into one sortable integer code, and an 8-byte
-gram whose leading byte is >= 0x80 would overflow int64's sign bit (the
-stored code would go negative while gram_to_code's Python int stays
-unsigned, silently breaking lookups for non-ASCII grams).
+gather; each gram packs its k bytes into one int32 code (k <= 3: max code
+0xFFFFFF, clear of both int32's sign bit and the PAD_TERM sentinel); then
+the same sort + run-length machinery as the inverted index groups
+(gram, term) pairs. Because term ids are assigned in lexicographic order,
+the per-gram term-id lists come out sorted exactly like the reference's
+merged string lists. For 3 < k <= 7 a host (numpy) twin packs grams into
+int64 instead — a k=4 code whose leading UTF-8 byte is >= 0x80 would wrap
+negative in int32 (shift by 24 bits), the default x32 jax config has no
+int64 sort, and k > 3 is off the reference's k=2,3 hot path, so it does
+not earn a device program. k > 7 is rejected: a gram must pack into one
+sortable integer code, and an 8-byte gram whose leading byte is >= 0x80
+would overflow int64's sign bit (the stored code would go negative while
+gram_to_code's Python int stays unsigned, silently breaking lookups for
+non-ASCII grams).
 """
 
 from __future__ import annotations
@@ -69,8 +72,11 @@ def build_chargram_index(
     k: int,
 ) -> CharGramIndex:
     """Build the gram -> sorted-term-id lists, fully on device."""
-    if not 1 <= k <= 4:
-        raise ValueError("device path packs k bytes into int32; need 1<=k<=4")
+    if not 1 <= k <= 3:
+        raise ValueError(
+            "device path packs k bytes into a positive int32; need 1<=k<=3 "
+            "(k=4 shifts the leading byte by 24 bits and wraps negative for "
+            "bytes >= 0x80 — use build_chargram_index_host)")
     t, lmax = term_bytes.shape
     n_windows = max(lmax - k + 1, 1)
 
@@ -135,7 +141,7 @@ def build_chargram_index_host(
     *,
     k: int,
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Host twin of build_chargram_index for 4 < k <= 7 (int64 gram codes).
+    """Host twin of build_chargram_index for 3 < k <= 7 (int64 gram codes).
 
     Same semantics — sliding byte windows of '$term$', (gram, term) dedup,
     per-gram sorted-unique term lists — with numpy doing the lexsort the

@@ -41,15 +41,18 @@ _MISS = object()
 
 def _term_view(dictionary: Dictionary, term: str):
     """One term's postings as (TermPostings|None, doc column sorted,
-    argsort rows) — the sorted view every host phrase/scoring path probes
-    candidates against. Single definition; PhraseIndex._term caches it
-    with an LRU, make_term_lookup with a plain memo."""
+    argsort rows, tf column in doc order) — the sorted view every host
+    phrase/scoring path probes candidates against. The tf column is
+    permuted here, once per term, so candidate probes stay O(candidates):
+    re-permuting the full df-length column per scoring stage is O(df)
+    work per term per stage. Single definition; PhraseIndex._term caches
+    it with an LRU, make_term_lookup with a plain memo."""
     tp = dictionary.get_value(term)
     if tp is None:
-        return (None, None, None)
+        return (None, None, None, None)
     docs = tp.postings[:, 0].astype(np.int64)
     by_doc = np.argsort(docs)
-    return (tp, docs[by_doc], by_doc)
+    return (tp, docs[by_doc], by_doc, tp.postings[:, 1][by_doc])
 
 
 def _lru_get(cache: dict, key):
@@ -88,7 +91,8 @@ class PhraseIndex:
                 "and proximity queries")
         self._dict = Dictionary(index_dir)
         self._reader = PositionsReader(index_dir)
-        # per term: (TermPostings|None, doc column sorted, argsort rows)
+        # per term: (TermPostings|None, doc column sorted, argsort rows,
+        # tf column in doc order)
         self._term_cache: dict[str, tuple] = {}
         # decoded runs, populated ONLY for (term, doc) pairs actually
         # consulted — a high-df term costs O(requested docs), never O(df)
@@ -103,7 +107,7 @@ class PhraseIndex:
 
     def doc_set(self, term: str) -> np.ndarray:
         """Sorted docnos containing the term (no position decoding)."""
-        _, docs_sorted, _ = self._term(term)
+        _, docs_sorted, _, _ = self._term(term)
         return docs_sorted if docs_sorted is not None else np.zeros(
             0, np.int64)
 
@@ -114,7 +118,7 @@ class PhraseIndex:
         hit = _lru_get(self._pos_cache, key)
         if hit is not _MISS:
             return hit
-        tp, docs_sorted, by_doc = self._term(term)
+        tp, docs_sorted, by_doc, _ = self._term(term)
         out = None
         if tp is not None:
             i = int(np.searchsorted(docs_sorted, docno))
@@ -131,7 +135,7 @@ class PhraseIndex:
         order. One vectorized row lookup + PositionsReader.runs_concat —
         the phrase path's per-candidate cost is a gather, not a Python
         loop. Docs where the term is absent contribute len 0."""
-        tp, docs_sorted, by_doc = self._term(term)
+        tp, docs_sorted, by_doc, _ = self._term(term)
         n = len(docnos)
         if tp is None or n == 0:
             return np.zeros(n, np.int64), np.zeros(0, np.int64)
@@ -242,7 +246,7 @@ def split_phrases(text: str) -> tuple[str, list[str]]:
     return rest, phrases
 
 
-def _tf_for_candidates(tp, docs_sorted, by_doc,
+def _tf_for_candidates(docs_sorted, tfs_sorted,
                        docnos_arr: np.ndarray) -> np.ndarray:
     """tf of one term in each candidate doc (0 where absent): the host
     seek-and-probe every explicit-candidate scoring model shares, over a
@@ -250,8 +254,7 @@ def _tf_for_candidates(tp, docs_sorted, by_doc,
     idx = np.searchsorted(docs_sorted, docnos_arr)
     i_c = np.minimum(idx, len(docs_sorted) - 1)
     ok = (idx < len(docs_sorted)) & (docs_sorted[i_c] == docnos_arr)
-    return np.where(ok, tp.postings[:, 1][by_doc][i_c],
-                    0).astype(np.float64)
+    return np.where(ok, tfs_sorted[i_c], 0).astype(np.float64)
 
 
 def make_term_lookup(dictionary: Dictionary):
@@ -291,10 +294,10 @@ def score_docs_host(q_terms: list[str], docnos: list[int], *,
     # dense programs sum per slot); only the term lookup is memoized
     lookup = term_lookup or make_term_lookup(dictionary)
     for t in q_terms:
-        tp, docs_sorted, by_doc = lookup(t)
+        tp, docs_sorted, _, tfs_sorted = lookup(t)
         if tp is None:
             continue
-        tf = _tf_for_candidates(tp, docs_sorted, by_doc, docnos_arr)
+        tf = _tf_for_candidates(docs_sorted, tfs_sorted, docnos_arr)
         if scoring == "bm25":
             w_q = math.log(1.0 + (num_docs - tp.df + 0.5) / (tp.df + 0.5))
             scores += np.where(
@@ -323,10 +326,10 @@ def cosine_score_host(q_terms: list[str], docnos, *,
     scores = np.zeros(len(docnos_arr), np.float64)
     lookup = term_lookup or make_term_lookup(dictionary)
     for t in q_terms:
-        tp, docs_sorted, by_doc = lookup(t)
+        tp, docs_sorted, _, tfs_sorted = lookup(t)
         if tp is None:
             continue
-        tf = _tf_for_candidates(tp, docs_sorted, by_doc, docnos_arr)
+        tf = _tf_for_candidates(docs_sorted, tfs_sorted, docnos_arr)
         idf = math.log10(num_docs / max(tp.df, 1))
         scores += np.where(tf > 0, 1.0 + np.log(np.maximum(tf, 1.0)),
                            0.0) * idf * idf

@@ -952,7 +952,9 @@ class Scorer:
             from ..parallel.sharded_tiered import put_doc_sharded
 
             if self._sharded_norm is None:
-                norms_np = np.asarray(self._doc_norms())
+                # host norms feed shard_slices directly — _doc_norms()
+                # would upload a device copy only to fetch it back
+                norms_np = np.ascontiguousarray(self._doc_norms_host())
                 self._sharded_norm = put_doc_sharded(
                     shard_slices(norms_np, num_docs=self.meta.num_docs,
                                  num_shards=self._mesh.devices.size),
