@@ -137,14 +137,18 @@ def main():
         print(f"D={num_docs} Vt={v_t} Pt={p_t} B={b} L={l}  "
               f"XLA: {t_x*1e3:8.2f} ms  ({b/t_x:8.1f} q/s)")
         try:
+            interpret = jax.devices()[0].platform != "tpu"
             pal_jit = jax.jit(partial(
-                pallas_cold_tier, num_docs=num_docs,
-                interpret=jax.devices()[0].platform != "tpu"))
+                pallas_cold_tier, num_docs=num_docs, interpret=interpret))
             t_p, out_p = bench(pal_jit, *args)
             ok = np.allclose(np.asarray(out_x), np.asarray(out_p),
                              rtol=1e-4, atol=1e-4)
+            # interpret mode runs the kernel in Python — its timing says
+            # nothing about hardware, so mark it unmistakably
+            tag = "  [INTERPRET MODE — timing not meaningful]" \
+                if interpret else f"  speedup={t_x/t_p:.2f}x"
             print(f"{'':38s}Pallas: {t_p*1e3:8.2f} ms  ({b/t_p:8.1f} q/s)"
-                  f"  match={ok}  speedup={t_x/t_p:.2f}x")
+                  f"  match={ok}{tag}")
         except Exception as e:  # noqa: BLE001 - report and continue
             print(f"{'':38s}Pallas FAILED: {type(e).__name__}: {e}")
 

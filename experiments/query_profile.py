@@ -54,12 +54,18 @@ def main():
     t = timeit(jax.jit(lambda s: jax.lax.top_k(s, 1000)), scores)
     print(f"top_k k=1000 [B,D]           : {t*1e3:8.2f} ms")
 
-    # D2H fetch of one block's results (the tunnel's fixed latency)
-    sc = jnp.asarray(rng.random((b, 10), np.float32))
-    dn = jnp.asarray(rng.integers(0, d1, (b, 10)).astype(np.int32))
-    jax.block_until_ready((sc, dn))
-    t0 = time.perf_counter()
+    # D2H fetch of one block's results (the tunnel's fixed latency).
+    # Fresh device arrays per rep: jax.Array caches its fetched numpy
+    # value, so re-fetching one array times a dict hit, not the wire
+    # (same pitfall bench.transport_probe documents)
+    pairs = []
     for _ in range(5):
+        sc = jnp.asarray(rng.random((b, 10), np.float32))
+        dn = jnp.asarray(rng.integers(0, d1, (b, 10)).astype(np.int32))
+        jax.block_until_ready((sc, dn))
+        pairs.append((sc, dn))
+    t0 = time.perf_counter()
+    for sc, dn in pairs:
         np.asarray(sc), np.asarray(dn)
     print(f"D2H fetch [B,10] x2          : {(time.perf_counter()-t0)/5*1e3:8.2f} ms")
 

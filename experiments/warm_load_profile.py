@@ -53,8 +53,9 @@ def main(index_dir: str) -> None:
                index_dir=index_dir, tiers=tiers,
                doc_norms=np.asarray(norms))
     mark("Scorer.__init__ (dispatch)")
-    jax.block_until_ready([s.df, s.doc_len, s.hot_rank, s.hot_tfs,
-                           s.tier_of, s.row_of, s.tier_docs, s.tier_tfs])
+    import bench
+
+    jax.block_until_ready(bench.serving_arrays(s))
     mark("device uploads complete")
 
     # end-to-end sanity: Scorer.load in-process (second call re-CRCs)

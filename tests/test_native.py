@@ -81,3 +81,31 @@ def test_long_token_cap_parity():
     for n in [15, 16, 17, 98, 99, 100, 101, 150]:
         doc = "x" * n
         assert nat.analyze(doc) == py.analyze(doc), n
+
+
+def test_missing_docno_raises_same_error_on_every_path(tmp_path):
+    """A record with no <DOCNO> is a corpus error, not a fallback case:
+    the C++ scanner diverts it to the skip channel, but the Python-side
+    merge must raise the SAME ValueError the pure-Python reader raises
+    (silently skipping would desync num_docs from the docno mapping).
+    Guards the skip-channel contract on both native ingestion paths."""
+    from tpu_ir.analysis.native import (NativeChunkedTokenizer,
+                                        tokenize_corpus_native)
+    from tpu_ir.collection.trec import read_trec_corpus
+
+    corpus = tmp_path / "bad.trec"
+    corpus.write_text(
+        "<DOC>\n<DOCNO> OK-1 </DOCNO>\n<TEXT>\ngood record here\n</TEXT>\n"
+        "</DOC>\n<DOC>\n<TEXT>\nno docno in this one\n</TEXT>\n</DOC>\n")
+
+    with pytest.raises(ValueError, match="no <DOCNO>"):
+        for doc in read_trec_corpus([str(corpus)]):
+            doc.docid
+    with pytest.raises(ValueError, match="no <DOCNO>"):
+        tokenize_corpus_native([str(corpus)])
+    with pytest.raises(ValueError, match="no <DOCNO>"):
+        tok = NativeChunkedTokenizer([str(corpus)])
+        try:
+            list(tok.deltas())
+        finally:
+            tok.close()

@@ -6,6 +6,13 @@ source), exposes `NativeAnalyzer` with the exact semantics of the Python
 - per document, to the Python pipeline when the text contains non-ASCII bytes
   (the C++ path is byte-wise and skips Unicode case folding on purpose);
 - globally, to the Python pipeline when no compiler/.so is available.
+
+A record with no (or an unclosed) <DOCNO> is NOT a fallback case: it is a
+corpus error, and every ingestion path — pure Python, in-memory native,
+chunked native — raises the same ValueError naming the record's byte
+offset (TrecDocument.docid). Skipping it silently would desync num_docs
+from the docno mapping; tested by test_native.py::test_missing_docno_
+raises_same_error_on_every_path.
 """
 
 from __future__ import annotations
@@ -258,9 +265,11 @@ class NativeChunkedTokenizer:
     Feed order: for each non-gzip file, ~chunk_bytes buffers split at record
     boundaries go through the C++ scanner (incremental corpus-wide vocab);
     each chunk's delta — docids, temp term ids, per-doc lengths — is drained
-    immediately, so C++ holds only the vocab between chunks. Non-ASCII /
-    docid-less records and gzip files take the Python analyzer path, with
-    terms interned into the same C++ vocab. Temp ids are insertion-ordered;
+    immediately, so C++ holds only the vocab between chunks. Non-ASCII
+    records and gzip files take the Python analyzer path, with terms
+    interned into the same C++ vocab (a record with no <DOCNO> also
+    arrives via that channel but is a hard ValueError on every path —
+    see the module docstring). Temp ids are insertion-ordered;
     call vocab() after the last delta and remap (argsort) like the
     in-memory builder does.
     """
@@ -347,6 +356,7 @@ class NativeChunkedTokenizer:
             from ..collection.trec import TrecDocument
 
             extra_ids: list[int] = []
+            extra_lens: list[int] = []
             for i in range(n_skip):
                 lo, hi = skips[2 * i], skips[2 * i + 1]
                 doc = TrecDocument(lo, chunk[lo:hi].decode("utf-8", "replace"))
@@ -354,9 +364,13 @@ class NativeChunkedTokenizer:
                     self._py.analyze(doc.content)) if t >= 0]
                 docids.append(doc.docid)
                 extra_ids.extend(toks)
-                lens = np.append(lens, np.int64(len(toks)))
+                extra_lens.append(len(toks))
                 if texts is not None:
                     texts.append(chunk[lo:hi])
+            # one concatenate, not np.append per skipped record (the
+            # in-memory merge got the same treatment — quadratic on a
+            # mostly-non-ASCII chunk otherwise)
+            lens = np.concatenate([lens, np.array(extra_lens, np.int64)])
             ids = np.concatenate([ids, np.array(extra_ids, np.int32)])
         if self._with_text:
             return docids, ids, lens, texts
