@@ -154,3 +154,60 @@ def test_merge_mixed_builders(tmp_path):
     for n in artifact_names(direct):
         assert filecmp.cmp(os.path.join(direct, n),
                            os.path.join(merged, n), shallow=False), n
+
+
+def test_merge_carries_docstore_byte_identically(tmp_path):
+    """Sources with document stores merge into a store byte-identical to
+    a one-shot --store build over the concatenated corpus (same arrival
+    order, same 256-doc zlib block cuts); a mixed merge (one source
+    stored, one not) is an error, not a silent snippet-incapable output."""
+    from tpu_ir.index import docstore as ds
+
+    ca = write_corpus(tmp_path / "a.trec", DOCS_A)
+    cb = write_corpus(tmp_path / "b.trec", DOCS_B)
+    cboth = write_corpus(tmp_path / "both.trec", {**DOCS_A, **DOCS_B})
+
+    ia, ib = str(tmp_path / "ia"), str(tmp_path / "ib")
+    build_index([ca], ia, k=1, chargram_ks=[], num_shards=3)
+    build_index([cb], ib, k=1, chargram_ks=[], num_shards=3)
+    ds.build_docstore([ca], ia)
+    ds.build_docstore([cb], ib)
+    direct = str(tmp_path / "direct")
+    build_index([cboth], direct, k=1, chargram_ks=[], num_shards=4)
+    ds.build_docstore([cboth], direct)
+
+    merged = str(tmp_path / "merged")
+    merge_indexes([ia, ib], merged, num_shards=4)
+    for name in ["docstore.bin", "docstore-idx.npz"]:
+        assert filecmp.cmp(os.path.join(merged, name),
+                           os.path.join(direct, name), shallow=False), name
+    # and the merged store serves the right text by merged docno
+    store = ds.DocStore(merged)
+    docids = {**DOCS_A, **DOCS_B}
+    from tpu_ir.collection import DocnoMapping
+
+    mapping = DocnoMapping.load(os.path.join(merged, fmt.DOCNOS))
+    for docid, text in docids.items():
+        assert text in store.get(mapping.get_docno(docid)), docid
+    store.close()
+
+    # corrupt: a crash between bin and idx writes (truncated bin) must
+    # refuse, not silently downgrade to a storeless merge
+    with open(os.path.join(ib, "docstore.bin"), "ab") as f:
+        f.write(b"x")
+    with pytest.raises(ValueError, match="inconsistent"):
+        merge_indexes([ia, ib], str(tmp_path / "mc"), num_shards=4)
+    assert not os.path.exists(str(tmp_path / "mc"))  # failed before writes
+
+    # mixed: ib loses its store -> merge must refuse
+    os.unlink(os.path.join(ib, "docstore.bin"))
+    os.unlink(os.path.join(ib, "docstore-idx.npz"))
+    with pytest.raises(ValueError, match="document store"):
+        merge_indexes([ia, ib], str(tmp_path / "m2"), num_shards=4)
+    assert not os.path.exists(str(tmp_path / "m2"))
+    # both storeless: merges fine, no store in the output
+    os.unlink(os.path.join(ia, "docstore.bin"))
+    os.unlink(os.path.join(ia, "docstore-idx.npz"))
+    m3 = str(tmp_path / "m3")
+    merge_indexes([ia, ib], m3, num_shards=4)
+    assert not ds.available(m3)

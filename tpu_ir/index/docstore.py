@@ -230,12 +230,42 @@ class DocStore:
             self._cache.pop(next(iter(self._cache)))
         return hit
 
-    def get(self, docno: int) -> str:
-        """The stored content of one document (raw record text)."""
+    def get_bytes(self, docno: int) -> bytes:
+        """The stored content of one document, exact raw bytes (the
+        lossless accessor merge re-streams through — decode-and-reencode
+        would corrupt records that were not valid UTF-8)."""
         if not 1 <= docno < len(self._perm):
             raise KeyError(docno)
         row = int(self._perm[docno])
         blk = self._block(row // self._block_docs)
         ofs = int(self._doc_ofs[row])
-        return blk[ofs : ofs + int(self._lengths[row])].decode(
-            "utf-8", errors="replace")
+        return blk[ofs : ofs + int(self._lengths[row])]
+
+    def get(self, docno: int) -> str:
+        """The stored content of one document (raw record text)."""
+        return self.get_bytes(docno).decode("utf-8", errors="replace")
+
+
+def iter_arrival(index_dir: str):
+    """Yield (docno, raw_bytes) over an existing store in ARRIVAL order —
+    the order write_docstore expects, so a store can be re-streamed into
+    another store (index merge). Walks the zlib blocks sequentially,
+    decompressing each exactly once and slicing rows off the lengths
+    column — no per-doc perm/offset scalar lookups (seconds of numpy
+    dispatch at 1M docs, same reasoning as iter_text_spill_docnos)."""
+    store = DocStore(index_dir)
+    try:
+        n = len(store._lengths)
+        inv = np.empty(n, np.int64)          # arrival row -> docno
+        inv[store._perm[1:]] = np.arange(1, n + 1)
+        bd = store._block_docs
+        for b0 in range(0, n, bd):
+            blk = store._block(b0 // bd)
+            dns = inv[b0 : b0 + bd].tolist()
+            lens = store._lengths[b0 : b0 + bd].tolist()
+            ofs = 0
+            for dn, ln in zip(dns, lens):
+                yield dn, blk[ofs : ofs + ln]
+                ofs += ln
+    finally:
+        store.close()

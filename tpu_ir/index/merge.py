@@ -12,7 +12,10 @@ sorted-vocab order, postings order is (term asc, tf desc, doc asc).
 All host-side numpy (remap = searchsorted, regroup = one lexsort over the
 union pairs); the char-gram artifacts rebuild on device through the same
 builder path (`dispatch_chargram_builds`), since they depend only on the
-merged vocabulary.
+merged vocabulary. Position runs and the document store follow the same
+all-or-nothing policy: carried through (byte-identically) iff every
+source has them, a mixed merge is an error rather than a silent
+capability downgrade.
 """
 
 from __future__ import annotations
@@ -63,6 +66,31 @@ def merge_indexes(
                 "chargram merge needs every source's token vocabulary "
                 f"(tokens.txt); missing from {missing} — rebuild those "
                 "sources with chargrams, or pass compute_chargrams=False")
+
+    # ---- docstore policy: mirrors positions — carried iff every source
+    # has a store (a mixed merge would silently produce a
+    # snippet-incapable output for docs whose text was stored). Checked
+    # up front with the other cheap validations: it needs only file
+    # stats, and failing after the docno/vocab phases would leave
+    # partial artifacts behind ----
+    from . import docstore as ds
+
+    corrupt = [s for s in sources
+               if ds.available(s) and not ds.consistent(s)]
+    if corrupt:
+        raise ValueError(
+            f"cannot merge: document store in {corrupt} is inconsistent "
+            "(crash between bin and idx writes?); rebuild it with "
+            "`tpu-ir index --store`, or delete its "
+            "docstore.bin/docstore-idx.npz to merge without one")
+    has_store = [ds.available(s) for s in sources]
+    if any(has_store) and not all(has_store):
+        raise ValueError(
+            "cannot merge: some sources carry a document store and some "
+            f"do not ({[(s, h) for s, h in zip(sources, has_store)]}); "
+            "build the missing stores with `tpu-ir index --store`, or "
+            "delete docstore.bin/docstore-idx.npz from the others to "
+            "merge without one")
 
     os.makedirs(out_dir, exist_ok=True)
     if overwrite:
@@ -128,6 +156,7 @@ def merge_indexes(
             "rebuild the v1 sources with positions=True, or drop the "
             "positions by rebuilding the v2 sources without them")
 
+
     # ---- postings: remap ids, one union lexsort, reshard ----
     with report.phase("merge_postings"):
         terms_l, docs_l, tfs_l = [], [], []
@@ -183,6 +212,23 @@ def merge_indexes(
 
     with report.phase("dictionary"):
         fmt.write_dictionary(out_dir, merged_terms, shard_of, offset_of)
+
+    if all(has_store):
+        # re-stream every source store in ITS arrival order, sources in
+        # argument order: with sources passed in corpus order this is the
+        # concatenated corpus' arrival order, so the merged store is
+        # byte-identical to a one-shot `--store` build (zlib block
+        # boundaries fall on the same 256-doc cuts)
+        with report.phase("docstore"):
+            def records():
+                for i, s in enumerate(sources):
+                    for old_dn, data in ds.iter_arrival(s):
+                        yield int(docno_lut[i][old_dn]), data
+
+            st = ds.write_docstore(out_dir, records(), num_docs)
+            report.set_counter("docstore_raw_bytes", st["raw_bytes"])
+            report.set_counter("docstore_stored_bytes",
+                               st["stored_bytes"])
 
     # ---- char-gram artifacts: rebuilt over the merged TOKEN vocab ----
     built_chargrams = bool(compute_chargrams and chargram_ks)
