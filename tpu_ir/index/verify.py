@@ -134,6 +134,10 @@ def verify_index(index_dir: str) -> dict:
     for ck in meta.chargram_ks:
         z = fmt.load_chargram(index_dir, ck)
         codes, indptr, tids = z["gram_codes"], z["indptr"], z["term_ids"]
+        # a negative code is unreachable by gram_to_code's unsigned
+        # packing — the signature of a sign-bit overflow in the build
+        # (the k=4 int32 class fixed in r5); sortedness alone passes it
+        assert (codes >= 0).all(), f"chargram k={ck}: negative gram codes"
         assert (np.diff(codes) > 0).all(), f"chargram k={ck}: codes not sorted"
         assert indptr[-1] == len(tids), f"chargram k={ck}: nnz"
         if len(tids) > 1:
