@@ -328,3 +328,17 @@ def test_index_store_rebuilds_inconsistent_store(tmp_path, capsys):
     capsys.readouterr()
     assert ds.consistent(out)
     assert "<DOC" in ds.DocStore(out).get(1)  # loads + decodes cleanly
+
+
+def test_snippet_full_window_cluster_keeps_last_hit():
+    """A matched cluster spanning the whole display window must render
+    every matched word — a forced centering shift of 1 used to cut the
+    cluster's last word off the window (review r5)."""
+    from tpu_ir.analysis.native import make_analyzer
+    from tpu_ir.search.snippets import SNIPPET_WORDS, make_snippet
+
+    lead = " ".join(f"pre{i}x" for i in range(10))
+    cluster = " ".join(["salmon", "fish"] * (SNIPPET_WORDS // 2))
+    doc = f"<DOC><TEXT>{lead} {cluster} tail words here</TEXT></DOC>"
+    snip = make_snippet(doc, {"salmon", "fish"}, make_analyzer())
+    assert snip.count("**") == 2 * SNIPPET_WORDS  # every cluster word marked

@@ -653,3 +653,29 @@ class TestChargramHostFallback:
         lookup = WildcardLookup.load(idx, 5)
         got = lookup.expand("fish*")
         assert "fisher" in got and "fish" in got  # 'fishing' stems to fish
+
+
+def test_sparse_drops_out_of_range_term_ids():
+    """tfidf_topk_sparse must ignore query ids >= V like its siblings —
+    an unmasked id would clamp its gathers to the LAST vocabulary term
+    and silently score its postings (review r5)."""
+    p, oracle, vocab, ndocs = _small_index()
+    indptr = np.asarray(p.indptr)
+    pcap = int(np.max(np.diff(indptr)))
+    post_docs = np.zeros((vocab, pcap), np.int32)
+    post_tfs = np.zeros((vocab, pcap), np.int32)
+    pd, pt = np.asarray(p.pair_doc), np.asarray(p.pair_tf)
+    for tid in range(vocab):
+        lo, hi = indptr[tid], indptr[tid + 1]
+        post_docs[tid, : hi - lo] = pd[lo:hi]
+        post_tfs[tid, : hi - lo] = pt[lo:hi]
+    q_ok = np.array([[0, 5, -1]], np.int32)
+    q_oob = np.array([[0, 5, vocab]], np.int32)  # vocab == out of range
+    s1, d1 = tfidf_topk_sparse(jnp.asarray(q_ok), jnp.asarray(post_docs),
+                               jnp.asarray(post_tfs), p.df,
+                               jnp.int32(ndocs), num_docs=ndocs, k=5)
+    s2, d2 = tfidf_topk_sparse(jnp.asarray(q_oob), jnp.asarray(post_docs),
+                               jnp.asarray(post_tfs), p.df,
+                               jnp.int32(ndocs), num_docs=ndocs, k=5)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
+    np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))

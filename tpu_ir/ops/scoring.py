@@ -522,8 +522,10 @@ def tfidf_topk_sparse(
     accumulator. Work is B*L*P instead of B*L*D."""
     idf = idf_weights(df, n_scalar, compat_int_idf)
 
-    safe_q = jnp.where(q_terms >= 0, q_terms, 0)           # [B, L]
-    q_valid = q_terms >= 0
+    # both bounds, like every sibling kernel: an id >= V would clamp all
+    # its gathers to the last vocabulary term and silently score it
+    q_valid = (q_terms >= 0) & (q_terms < post_docs.shape[0])
+    safe_q = jnp.where(q_valid, q_terms, 0)                # [B, L]
     docs = post_docs[safe_q]                                # [B, L, P]
     tfs = post_tfs[safe_q].astype(jnp.float32)              # [B, L, P]
     w = _lntf(tfs) * idf[safe_q][..., None] * q_valid[..., None]

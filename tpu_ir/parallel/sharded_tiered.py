@@ -238,8 +238,14 @@ def _put_global(a, mesh, spec):
 def replicated_global(a, mesh):
     """Replicate a host value over every device of a (possibly
     multi-process) mesh. Single-process: the value passes through
-    untouched, so the measured single-chip query path is unchanged."""
+    untouched, so the measured single-chip query path is unchanged.
+    Idempotent: an already-replicated jax.Array passes through, so
+    callers that replicate once at init (Scorer's sharded df) don't
+    re-upload per dispatched query block."""
     if jax.process_count() == 1:
+        return a
+    if (isinstance(a, jax.Array)
+            and a.sharding == NamedSharding(mesh, P())):
         return a
     return _put_global(a, mesh, P())
 

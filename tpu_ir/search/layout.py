@@ -94,10 +94,10 @@ def _slim(a: np.ndarray, hi: int) -> np.ndarray:
     return a.astype(np.uint16 if hi < 65536 else np.int32)
 
 
-def _scatter_rows(tids: np.ndarray, indptr: np.ndarray, counts: np.ndarray,
-                  pair_doc: np.ndarray, pair_tf: np.ndarray):
+def _scatter_rows(tids: np.ndarray, indptr: np.ndarray, counts: np.ndarray):
     """Vectorized source indices for packing terms' postings into rows:
-    returns (row_index, source_index) for every posting of `tids`."""
+    returns (row_index, within_row, source_index) for every posting of
+    `tids` — pure index computation, the callers gather the columns."""
     total = int(counts.sum())
     rows = np.repeat(np.arange(len(tids), dtype=np.int64), counts)
     # offset of each posting within its term's run
@@ -141,8 +141,7 @@ def build_tiered_layout(
 
     num_hot = max(len(hot_tids), 1)
     if len(hot_tids):
-        rows, _, src = _scatter_rows(hot_tids, indptr, df[hot_tids],
-                                     pair_doc, pair_tf)
+        rows, _, src = _scatter_rows(hot_tids, indptr, df[hot_tids])
         hot_rows = _slim(rows, num_hot)
         hot_docs = _slim(pair_doc[src], d + 1)
         hot_vals = _slim(pair_tf[src], int(pair_tf[src].max(initial=0)) + 1)
@@ -173,8 +172,7 @@ def build_tiered_layout(
             cap = caps[i]
             docs = np.zeros((len(tids), cap), np.int32)
             tfs = np.zeros((len(tids), cap), np.int32)
-            rows, within, src = _scatter_rows(tids, indptr, df[tids],
-                                              pair_doc, pair_tf)
+            rows, within, src = _scatter_rows(tids, indptr, df[tids])
             docs[rows, within] = pair_doc[src]
             tfs[rows, within] = pair_tf[src]
             tier_of[tids] = len(tier_docs)

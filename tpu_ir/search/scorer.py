@@ -25,7 +25,7 @@ import numpy as np
 from ..analysis.native import make_analyzer
 from ..collection import KGRAM_SEP, DocnoMapping, Vocab, kgram_terms
 from ..index import format as fmt
-from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense, tfidf_topk_sparse
+from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense
 from ..ops.scoring import dense_tf_matrix
 from ..utils.transfer import fetch_to_host
 from .layout import build_tiered_layout
@@ -142,7 +142,8 @@ class Scorer:
         self._tf_matrix = None  # built lazily on first BM25 call
         if self._pairs_cols is None and (
                 layout == "dense"
-                or (layout == "sharded" and sharded_layout is None)):
+                or (layout == "sharded" and sharded_layout is None)
+                or (layout == "sparse" and tiers is None)):
             raise ValueError(f"layout {layout!r} needs the postings "
                              "columns or a prebuilt serving layout")
         if layout == "dense":
@@ -168,6 +169,13 @@ class Scorer:
                     np.asarray(doc_len), num_docs=d, num_shards=n_dev)
             self._sharded = put_sharded(lay, self._mesh)
             self._sharded_norm = None  # built lazily for rerank
+            # df replicated over the mesh ONCE: multi-process serving
+            # would otherwise re-upload the [V] array per query block
+            # (replicated_global is idempotent and a single-process
+            # pass-through, so the dispatch calls stay unchanged)
+            from ..parallel.sharded_tiered import replicated_global
+
+            self._df_mesh = replicated_global(self.df, self._mesh)
         else:
             # tiered sparse: budget-capped dense strip for the hottest
             # terms + geometric-capacity padded tiers for the rest
@@ -361,9 +369,19 @@ class Scorer:
         if not self._wildcard_tried:
             self._wildcard_tried = True
             if self._index_dir and self.meta.chargram_ks:
+                from ..collection import Vocab
+                from ..index.builder import TOKENS_VOCAB
                 from .wildcard import WildcardLookup
 
-                shared = self.vocab if self.meta.k == 1 else None
+                if self.meta.k == 1:
+                    shared = self.vocab  # index vocab IS the token vocab
+                else:
+                    # load the tokens.txt sidecar ONCE and share it —
+                    # one lookup per chargram k would otherwise re-read
+                    # the same multi-MB file per k
+                    tok = os.path.join(self._index_dir, TOKENS_VOCAB)
+                    shared = Vocab.load(tok) if os.path.exists(tok) \
+                        else None
                 self._wildcard = [
                     WildcardLookup.load(self._index_dir, ck, vocab=shared)
                     for ck in sorted(self.meta.chargram_ks, reverse=True)]
@@ -596,8 +614,12 @@ class Scorer:
             # leading glob and silently drop every other one
             n_multi = sum(1 for s in window if len(s) > 1)
             if n_multi:
+                # exact integer root: float ** (1/n) truncates (64**(1/3)
+                # -> 3.9999... -> int 3, i.e. 27 of the budgeted 64 combos)
                 per_slot = max(
                     int(self.WILDCARD_LIMIT ** (1.0 / n_multi)), 1)
+                while (per_slot + 1) ** n_multi <= self.WILDCARD_LIMIT:
+                    per_slot += 1
                 window = [s[:per_slot] if len(s) > 1 else s
                           for s in window]
             for combo in itertools.islice(
@@ -868,7 +890,7 @@ class Scorer:
             # into a (possibly multi-process) global scalar itself, and a
             # jnp scalar would cost a host sync per block there
             s, d = sharded_tiered_topk(
-                q, self._sharded, self.df, self.meta.num_docs,
+                q, self._sharded, self._df_mesh, self.meta.num_docs,
                 mesh=self._mesh, k=k,
                 scoring=scoring, compat_int_idf=self.compat_int_idf)
         elif scoring == "bm25":
@@ -962,7 +984,7 @@ class Scorer:
 
             def dispatch(q):
                 return sharded_tiered_rerank(
-                    jnp.asarray(q), self._sharded, self.df,
+                    jnp.asarray(q), self._sharded, self._df_mesh,
                     self.meta.num_docs, self._sharded_norm,
                     mesh=self._mesh, k=k, candidates=candidates)
 

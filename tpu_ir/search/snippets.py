@@ -118,7 +118,9 @@ def make_snippet(content: str, query_tokens: set[str], analyzer,
     if not hits:
         head = " ".join(words[:width])
         return head + (" ..." if len(words) > width or truncated else "")
-    lo = max(0, best_lo - max((width - best_n) // 2, 1))
+    # center the cluster; a cluster spanning the full window gets shift 0
+    # (a forced shift of 1 would cut its last matched word off)
+    lo = max(0, best_lo - max((width - best_n) // 2, 0))
     hi = min(len(words), lo + width)
     hit_set = set(hits)
     # words past an early-exit position were never analyzed; they can
