@@ -225,6 +225,32 @@ def test_dictionary_detects_tamper(setup, tmp_path):
         Dictionary(str(bad)).get_value(t0)
 
 
+def test_dictionary_term_with_unicode_line_separator():
+    """splitlines() also breaks on U+0085/U+2028, which the analyzer
+    allows INSIDE a token — a NEL-bearing term must parse as one
+    dictionary line or every later term id shifts (review r5)."""
+    from tpu_ir.index.dictionary import Dictionary
+
+    text = "ab\x85cd\t0\t0\nzz\t1\t4\n"
+    d = Dictionary(".", text=text)
+    assert len(d) == 2
+    assert "ab\x85cd" in d and "zz" in d
+
+
+def test_eval_default_skips_zero_relevant_topics(tmp_path, capsys):
+    """A topic judged ONLY nonrelevant contributes no mean term in the
+    DEFAULT mode too — trec_eval skips num_rel==0 topics, and scoring
+    them 0 deflated every metric (review r5)."""
+    run = tmp_path / "run.txt"
+    run.write_text("1 Q0 D-1 1 2.0 t\n2 Q0 D-9 1 2.0 t\n")
+    qrels = tmp_path / "qrels.txt"
+    qrels.write_text("1 0 D-1 1\n2 0 D-9 0\n")
+    assert main(["eval", str(run), str(qrels)]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["queries"] == 1
+    assert out["map"] == 1.0 and out["mrr"] == 1.0
+
+
 def test_warm_prebuilds_serving_cache(setup, capsys, tmp_path):
     """tpu-ir warm: one deploy-time load persists the serving cache; the
     second load inside the command must already take the fast path."""

@@ -110,10 +110,44 @@ def test_trectext_parser_sections_and_multiline_docno():
     assert "Fish Stocks Rebound" in docs[0].text
     assert "Salmon runs" in docs[0].text and "Second line." in docs[0].text
     # dropped: FILEID is no known section, IGNORED sits between sections.
-    # (Like the reference, sections are line-oriented: a one-line
-    # <HEAD>x</HEAD> would never close — TrecTextParser.java:66-89.)
+    # (The reference's parser is line-oriented and a one-line <HEAD>x</HEAD>
+    # would never close — TrecTextParser.java:66-89 — leaking every later
+    # unknown-tag line into the text; this parser closes it, see
+    # test_trectext_one_line_section_closes.)
     assert "FILEID" not in docs[0].text and "not indexed" not in docs[0].text
     assert docs[1].text == "<TEXT>\nshort\n</TEXT>\n"
+
+
+def test_trectext_one_line_section_closes():
+    """<TEXT>x</TEXT> on a single line must end the section there —
+    leaving it open would index every following unknown-tag line up to
+    </DOC> (review r5; the reference's line-oriented parser has this
+    leak, TrecTextParser.java:66-89)."""
+    from tpu_ir.collection import TrecTextParser
+
+    raw = ("<DOC>\n<DOCNO> D-1 </DOCNO>\n"
+           "<TEXT>hello world</TEXT>\n"
+           "<JUNK>should be dropped</JUNK>\n</DOC>\n")
+    docs = list(TrecTextParser(raw))
+    assert len(docs) == 1
+    assert "hello world" in docs[0].text
+    assert "should be dropped" not in docs[0].text
+    # multi-line sections still span lines and keep their end tag
+    raw2 = ("<DOC>\n<DOCNO> D-2 </DOCNO>\n"
+            "<TEXT>\nline one\n</TEXT>\n<SKIPPED>x</SKIPPED>\n</DOC>\n")
+    d2 = list(TrecTextParser(raw2))[0]
+    assert "line one" in d2.text and "</TEXT>" in d2.text
+    assert "SKIPPED" not in d2.text
+
+
+def test_docno_mapping_rejects_embedded_newline():
+    """docnos.txt is one docid per line; an embedded newline (multi-line
+    <DOCNO> keeps interior whitespace after strip) would shear the file
+    and misalign every later docno on reload (review r5)."""
+    from tpu_ir.collection import DocnoMapping
+
+    with pytest.raises(ValueError, match="newline"):
+        DocnoMapping.build(["AB\nCD", "EF"])
 
 
 TRECWEB = """\

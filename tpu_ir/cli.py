@@ -38,18 +38,41 @@ def _apply_backend(args) -> None:
     import jax
     import jax._src.xla_bridge as xb
 
-    target = args.backend
-    if target == "tpu" and "tpu" not in xb._backend_factories:
-        # the TPU may be exposed under a plugin name (e.g. "axon")
-        others = [n for n in xb._backend_factories if n != "cpu"]
-        if others:
-            target = others[0]
-    os.environ["JAX_PLATFORMS"] = target
-    jax.config.update("jax_platforms", target)
-    if xb.backends_are_initialized():
-        from jax.extend.backend import clear_backends
+    def pin(name: str) -> None:
+        os.environ["JAX_PLATFORMS"] = name
+        jax.config.update("jax_platforms", name)
+        if xb.backends_are_initialized():
+            from jax.extend.backend import clear_backends
 
-        clear_backends()
+            clear_backends()
+
+    if args.backend != "tpu":
+        pin(args.backend)
+        return
+    # the chip may ride a plugin name (e.g. "axon"), and a registered
+    # "tpu" factory can still fail to initialize (libtpu present, no
+    # local device — jax raises even when the platform list has more
+    # entries). Probe the TPU-like names in order, canonical "tpu"
+    # first, and keep the first that initializes. Never fall back to an
+    # arbitrary non-cpu factory (cuda/rocm): silently running on
+    # hardware the explicit --backend tpu was meant to rule out would
+    # mask the misconfiguration.
+    tpu_like = sorted((n for n in xb._backend_factories
+                       if n not in ("cpu", "cuda", "gpu", "rocm",
+                                    "metal")),
+                      key=lambda n: n != "tpu")
+    last_err: Exception | None = None
+    for cand in tpu_like:
+        pin(cand)
+        try:
+            jax.devices()
+            return
+        except RuntimeError as e:
+            last_err = e
+    raise ValueError(
+        "--backend tpu: no TPU backend initialized (tried "
+        f"{tpu_like or 'no TPU-like factories'}; available: "
+        f"{sorted(xb._backend_factories)}; last error: {last_err})")
 
 
 class _MaybeProfile:
@@ -238,8 +261,6 @@ def _read_trec_topics(path: str) -> tuple[list[str], list[str]]:
     <title> lines; returns (qids, title queries). Tolerates both the
     classic SGML shape (title text on the following lines until the next
     tag) and single-line <title>text</title>."""
-    import re
-
     with open(path, encoding="utf-8", errors="replace") as f:
         text = f.read()
     qids: list[str] = []
@@ -659,6 +680,13 @@ def main(argv: list[str] | None = None) -> int:
         # user-facing capability/usage errors (unknown layout, phrase query
         # on a v1 index, ...) print a clean message, not a traceback
         print(f"error: {e}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as e:
+        # a missing artifact is a usage error too (expand on a
+        # --no-chargrams index, search on a non-index dir) — same clean
+        # message contract as ValueError
+        print(f"error: missing artifact: {e.filename or e}",
+              file=sys.stderr)
         return 1
     except BrokenPipeError:
         # downstream pipe (e.g. `| head`) closed early — standard unix exit;

@@ -16,12 +16,22 @@ import bisect
 import os
 from typing import Iterable, Sequence
 
+from .sidefile import load_lines, save_lines
+
 
 class DocnoMapping:
     """Sorted docid array; docno = 1-based index (reference semantics)."""
 
     def __init__(self, sorted_docids: Sequence[str]):
         self._docids = list(sorted_docids)
+        for d in self._docids:
+            # the on-disk format is one docid per line — an embedded
+            # newline (a multi-line <DOCNO> keeps interior whitespace
+            # after strip()) would shear docnos.txt and misalign every
+            # docno after it on the next load
+            if "\n" in d or "\r" in d:
+                raise ValueError(f"docid {d!r} contains a newline; "
+                                 "fix the <DOCNO> in the corpus")
         for a, b in zip(self._docids, self._docids[1:]):
             if a >= b:
                 raise ValueError(f"docids not strictly sorted: {a!r} >= {b!r}")
@@ -52,16 +62,8 @@ class DocnoMapping:
         return self._docids[docno - 1]
 
     def save(self, path: str | os.PathLike) -> None:
-        tmp = f"{os.fspath(path)}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(f"{len(self._docids)}\n")
-            for d in self._docids:
-                f.write(d + "\n")
-        os.replace(tmp, path)
+        save_lines(path, self._docids)
 
     @classmethod
     def load(cls, path: str | os.PathLike) -> "DocnoMapping":
-        with open(path, encoding="utf-8") as f:
-            n = int(f.readline())
-            docids = [f.readline().rstrip("\n") for _ in range(n)]
-        return cls(docids)
+        return cls(load_lines(path))

@@ -112,18 +112,22 @@ class TrecTextParser(_LineParser):
         while (line := self._readline()) is not None:
             if line.startswith("</DOC>"):
                 break
-            if line.startswith("<"):
-                if in_tag is not None and line.startswith(f"</{in_tag}>"):
-                    in_tag = None
-                    buf.append(line)  # the end-tag line is kept
-                    continue
-                if in_tag is None:
+            if in_tag is None:
+                if line.startswith("<"):
                     for sec in self._SECTIONS:
                         if line.startswith(f"<{sec}>"):
                             in_tag = sec
+                            buf.append(line)
+                            # open + close on ONE line ends the section
+                            # here — leaving it open would leak every
+                            # following unknown-tag line into the text
+                            if f"</{sec}>" in line:
+                                in_tag = None
                             break
-            if in_tag is not None:
-                buf.append(line)
+                continue  # outside any section: dropped
+            buf.append(line)  # the end-tag line is kept
+            if line.startswith(f"</{in_tag}>"):
+                in_tag = None
         return Document(identifier, "".join(x + "\n" for x in buf))
 
 

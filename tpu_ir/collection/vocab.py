@@ -18,6 +18,8 @@ from __future__ import annotations
 import os
 from typing import Iterable, Sequence
 
+from .sidefile import load_lines, save_lines
+
 KGRAM_SEP = " "
 
 
@@ -53,19 +55,11 @@ class Vocab:
         return self._terms[term_id]
 
     def save(self, path: str | os.PathLike) -> None:
-        tmp = f"{os.fspath(path)}.tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write(f"{len(self._terms)}\n")
-            for t in self._terms:
-                f.write(t + "\n")
-        os.replace(tmp, path)
+        save_lines(path, self._terms)
 
     @classmethod
     def load(cls, path: str | os.PathLike) -> "Vocab":
-        with open(path, encoding="utf-8") as f:
-            n = int(f.readline())
-            terms = [f.readline().rstrip("\n") for _ in range(n)]
-        return cls(terms)
+        return cls(load_lines(path))
 
 
 def kgram_terms(tokens: Sequence[str], k: int) -> list[str]:

@@ -52,7 +52,13 @@ class Dictionary:
             with open(os.path.join(index_dir, fmt.DICTIONARY),
                       encoding="utf-8") as f:
                 text = f.read()
-        for tid, line in enumerate(text.splitlines()):
+        # split on \n ONLY: splitlines() also splits on U+0085/U+2028/…,
+        # which the analyzer allows inside terms — a NEL in a term would
+        # shear its dictionary line in two and shift every later term id
+        lines = text.split("\n")
+        if lines and lines[-1] == "":
+            lines.pop()
+        for tid, line in enumerate(lines):
             term, shard, offset = line.rsplit("\t", 2)
             self._entries[term] = (tid, int(shard), int(offset))
         # shards load lazily and stay cached; a cooperating caller may

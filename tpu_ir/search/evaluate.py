@@ -61,13 +61,18 @@ def evaluate_run(run: dict[str, list[str]],
     run and qrels — a judged query that produced no results emits no run
     lines, so it is EXCLUDED from the mean, not scored zero. Pass
     ``complete=True`` (trec_eval ``-c``) to average over every qrels qid
-    that has at least one relevant document (trec_eval skips num_rel==0
-    topics even under -c), scoring qids missing from the run as zero."""
+    with at least one relevant document, scoring qids missing from the
+    run as zero. Topics judged only nonrelevant (num_rel == 0) are
+    skipped in both modes, exactly as trec_eval does."""
+    # trec_eval skips num_rel==0 topics in BOTH modes: a topic judged
+    # only nonrelevant contributes no mean term (scoring it 0 would
+    # deflate every metric relative to trec_eval)
+    has_rel = {q for q, grades in qrels.items()
+               if any(g > 0 for g in grades.values())}
     if complete:
-        qids = sorted(q for q, grades in qrels.items()
-                      if any(g > 0 for g in grades.values()))
+        qids = sorted(has_rel)
     else:
-        qids = sorted(set(run) & set(qrels))
+        qids = sorted(set(run) & has_rel)
     if not qids:
         return {"queries": 0}
     ap_l, rr_l, ndcg_l, p5_l, p10_l, r100_l = [], [], [], [], [], []
