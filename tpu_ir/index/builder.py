@@ -292,29 +292,33 @@ def _spmd_postings(flat_term_ids, flat_doc_ids, docnos, *, vocab_size,
     Documents are dealt to doc shards by (docno-1) % num_devices; terms land
     on term shard term_id % num_devices via the all_to_all routing."""
     from ..parallel import make_mesh, sharded_build_postings
+    from ..parallel.sharded_build import deal_occurrences
 
     s = num_devices
-    doc_shard = (flat_doc_ids - 1) % s
-    granule = 1 << 14
-    max_fill = int(np.bincount(doc_shard, minlength=s).max()) if len(
-        flat_term_ids) else 1
-    cap = round_cap(max_fill, granule)
-    term_ids = np.full((s, cap), PAD_TERM, np.int32)
-    doc_ids = np.zeros((s, cap), np.int32)
-    for sh in range(s):
-        sel = doc_shard == sh
-        n = int(sel.sum())
-        term_ids[sh, :n] = flat_term_ids[sel]
-        doc_ids[sh, :n] = flat_doc_ids[sel]
-    docs_per_shard = np.bincount((docnos - 1) % s, minlength=s).astype(np.int32)
+    term_ids, doc_ids, docs_per_shard = deal_occurrences(
+        flat_term_ids, flat_doc_ids, docnos, s)
 
     mesh = make_mesh(s)
     out = sharded_build_postings(
         term_ids, doc_ids, docs_per_shard,
         vocab_size=vocab_size, total_docs=num_docs, mesh=mesh)
 
-    num_pairs_h, pt_h, pd_h, ptf_h, df_h = fetch_to_host(
-        out.num_pairs, out.pair_term, out.pair_doc, out.pair_tf, out.df)
+    # shrink + narrow on device before the D2H copy (the [S, C] results
+    # are worst-case padded; only each shard's valid prefix is real —
+    # same treatment the single-device fetch gets via shrink_pairs)
+    from ..utils.transfer import narrow_uint, shrink_rows_for_fetch
+
+    num_pairs_h, tf_max = fetch_to_host(out.num_pairs,
+                                        jnp.max(out.pair_tf))
+    valid = int(num_pairs_h.max()) if len(num_pairs_h) else 1
+    pt_h, pd_h, ptf_h, df_h = fetch_to_host(
+        shrink_rows_for_fetch(out.pair_term, valid,
+                              dtype=narrow_uint(vocab_size - 1)),
+        shrink_rows_for_fetch(out.pair_doc, valid,
+                              dtype=narrow_uint(num_docs)),
+        shrink_rows_for_fetch(out.pair_tf, valid,
+                              dtype=narrow_uint(int(tf_max))),
+        out.df)
     shard_pairs = []
     df = np.zeros(vocab_size, np.int32)
     for sh in range(s):

@@ -134,6 +134,13 @@ def load_chargram(index_dir: str, k: int) -> dict[str, np.ndarray]:
         return {k_: z[k_] for k_ in z.files}
 
 
+def shard_assignment(vocab_size: int, num_shards: int) -> np.ndarray:
+    """shard_of [V] = term_id % num_shards — THE term-routing rule. One
+    definition shared by the offset writer and the streaming reducer so
+    a partitioning change cannot land in one and not the other."""
+    return np.arange(vocab_size, dtype=np.int32) % num_shards
+
+
 def shard_local_offsets(df: np.ndarray, num_shards: int
                         ) -> tuple[np.ndarray, np.ndarray]:
     """(shard_of [V], offset_of [V]): each term's shard (term_id % shards)
@@ -142,7 +149,7 @@ def shard_local_offsets(df: np.ndarray, num_shards: int
     (builder, streaming, multihost) and the verifier — the offsets are what
     dictionary.tsv records and Dictionary.get_value seeks by."""
     v = len(df)
-    shard_of = np.arange(v, dtype=np.int32) % num_shards
+    shard_of = shard_assignment(v, num_shards)
     offset_of = np.zeros(v, np.int64)
     for s in range(num_shards):
         tids = np.nonzero(shard_of == s)[0]

@@ -195,18 +195,15 @@ def merge_indexes(
         # the same order-preserving term_id % S split as the pairs —
         # byte-identical to a one-shot positions build by construction
         with report.phase("merge_positions"):
-            from .positions import write_position_shards
+            from .positions import realign_runs, write_position_shards
 
             all_delta = (np.concatenate(delta_l) if delta_l
                          else np.zeros(0, np.int32))
             all_len = (np.concatenate(rlen_l).astype(np.int64) if rlen_l
                        else np.zeros(0, np.int64))
             starts = np.concatenate([[0], np.cumsum(all_len)])[:-1]
-            new_len = all_len[order]
-            out_indptr = np.concatenate([[0], np.cumsum(new_len)])
-            gather = (np.repeat(starts[order], new_len)
-                      + np.arange(int(new_len.sum()))
-                      - np.repeat(out_indptr[:-1], new_len))
+            out_indptr, gather = realign_runs(starts[order],
+                                              all_len[order])
             write_position_shards(out_dir, pt, out_indptr,
                                   all_delta[gather], num_shards)
 

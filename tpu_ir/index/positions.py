@@ -96,6 +96,23 @@ def flat_positions_from_lengths(lengths: np.ndarray) -> np.ndarray:
     return np.arange(n) - np.repeat(starts, lengths)
 
 
+def realign_runs(old_starts: np.ndarray, new_lens: np.ndarray
+                 ) -> tuple[np.ndarray, np.ndarray]:
+    """(new_indptr, gather) re-laying concatenated variable-length runs:
+    `old_starts[i]` is where NEW row i's payload begins in the old flat
+    array and `new_lens[i]` its length; payload[gather] lists the runs
+    in the new order and new_indptr delimits them. The single CSR
+    permutation primitive behind every shard split (selection) and
+    part-order sort (permutation) of position runs — streaming,
+    multihost, and merge all route through here, so an indexing fix
+    cannot miss a copy."""
+    new_indptr = np.concatenate([[0], np.cumsum(new_lens)])
+    gather = (np.repeat(old_starts, new_lens)
+              + np.arange(int(new_lens.sum()))
+              - np.repeat(new_indptr[:-1], new_lens))
+    return new_indptr, gather
+
+
 def split_runs_by_shard(run_term: np.ndarray, pos_indptr: np.ndarray,
                         pos_delta: np.ndarray, num_shards: int):
     """Yield (shard, indptr, delta) splitting ordered runs by
@@ -106,12 +123,7 @@ def split_runs_by_shard(run_term: np.ndarray, pos_indptr: np.ndarray,
     run_len = np.diff(pos_indptr)
     for s in range(num_shards):
         sel = run_shard == s
-        lens = run_len[sel]
-        indptr = np.concatenate([[0], np.cumsum(lens)])
-        starts = pos_indptr[:-1][sel]
-        gather = (np.repeat(starts, lens)
-                  + np.arange(int(lens.sum()))
-                  - np.repeat(indptr[:-1], lens))
+        indptr, gather = realign_runs(pos_indptr[:-1][sel], run_len[sel])
         yield s, indptr.astype(np.int64), pos_delta[gather].astype(np.int32)
 
 

@@ -59,7 +59,7 @@ from ..collection import DocnoMapping, Vocab
 from ..ops import PAD_TERM, PAD_TERM_U16, build_postings_packed_jit
 from ..ops.postings import pair_term_from_df
 from ..utils import JobReport, fetch_to_host
-from ..utils.transfer import shrink_pairs
+from ..utils.transfer import narrow_uint, shrink_pairs, shrink_rows_for_fetch
 from . import format as fmt
 from .builder import build_chargram_artifacts
 
@@ -175,18 +175,14 @@ def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
     lens = rdf[tids].astype(np.int64)
     local_indptr = np.concatenate([[0], np.cumsum(lens)])
     if positions:
-        from .positions import positions_name
+        from .positions import positions_name, realign_runs
 
         all_delta = (np.concatenate(deltas) if deltas
                      else np.zeros(0, np.int32))
         all_len = (np.concatenate(rlens).astype(np.int64) if rlens
                    else np.zeros(0, np.int64))
         starts = np.concatenate([[0], np.cumsum(all_len)])[:-1]
-        new_len = all_len[order]
-        out_indptr = np.concatenate([[0], np.cumsum(new_len)])
-        gather = (np.repeat(starts[order], new_len)
-                  + np.arange(int(new_len.sum()))
-                  - np.repeat(out_indptr[:-1], new_len))
+        out_indptr, gather = realign_runs(starts[order], all_len[order])
         fmt.savez_atomic(
             os.path.join(index_dir, positions_name(row)),
             pos_indptr=out_indptr.astype(np.int64),
@@ -194,6 +190,76 @@ def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
     fmt.save_shard(index_dir, row, term_ids=tids, indptr=local_indptr,
                    pair_doc=d, pair_tf=w, df=rdf[tids])
     return rdf, len(t)
+
+
+def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
+                     report, *, text_path_fn, batch_stat):
+    """THE pass-1 spill loop (chunked tokenize -> batch -> atomic spill),
+    shared by the single-process streaming build and the multi-host build
+    so the crash-resume invariants live exactly once:
+
+    - text spill FIRST: a token spill's existence is the batch's resume
+      marker, so its text twin must never trail it (index/docstore.py
+      assembles the store from text spills after pass 3 — zero extra
+      corpus reads);
+    - the CALLER writes its manifest LAST (atomic) to certify the pass.
+
+    `text_path_fn(b)` names batch b's text spill (the two builders place
+    them differently); `batch_stat(ids, lengths)` is the per-batch int
+    recorded for pass 2 (total occurrences single-process; the
+    per-device occupancy cap multi-host). Returns
+    (docids, vocab_list, n_batches, stats)."""
+    from .docstore import write_text_spill
+
+    acc_ids: list[np.ndarray] = []
+    acc_lens: list[np.ndarray] = []
+    acc_texts: list[bytes] = []
+    acc_docids: list[str] = []
+    acc_docs = 0
+    all_docids: list[str] = []
+    stats: list[int] = []
+    n_batches = 0
+
+    def flush():
+        nonlocal n_batches, acc_docs
+        if not acc_docs:
+            return
+        if store:
+            write_text_spill(text_path_fn(n_batches), acc_texts,
+                             acc_docids)
+            acc_texts.clear()
+            acc_docids.clear()
+        ids = np.concatenate(acc_ids)
+        lengths = np.concatenate(acc_lens)
+        fmt.savez_atomic(
+            os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
+            ids=ids, lengths=lengths)
+        stats.append(int(batch_stat(ids, lengths)))
+        n_batches += 1
+        acc_ids.clear()
+        acc_lens.clear()
+        acc_docs = 0
+
+    try:
+        for delta in tok.deltas():
+            if store:
+                docids_d, ids_d, lens_d, texts_d = delta
+                acc_texts.extend(texts_d)
+                acc_docids.extend(docids_d)
+            else:
+                docids_d, ids_d, lens_d = delta
+            report.incr("Count.DOCS", len(docids_d))
+            all_docids.extend(docids_d)
+            acc_ids.append(ids_d)
+            acc_lens.append(lens_d)
+            acc_docs += len(docids_d)
+            if acc_docs >= batch_docs:
+                flush()
+        flush()
+        vocab_list = tok.vocab()
+    finally:
+        tok.close()
+    return all_docids, vocab_list, n_batches, stats
 
 
 def build_index_streaming(
@@ -270,63 +336,14 @@ def build_index_streaming(
         report.incr("Count.DOCS", len(all_docids))
         report.set_counter("pass1_resumed_batches", n_batches)
     else:
-        all_docids = []
-        n_batches = 0
-        occ_per_batch: list[int] = []
         tok = make_chunked_tokenizer(corpus_paths, k=k, with_text=store)
         with report.phase("pass1_tokenize"):
-            acc_ids: list[np.ndarray] = []
-            acc_lens: list[np.ndarray] = []
-            acc_texts: list[bytes] = []
-            acc_docids: list[str] = []
-            acc_docs = 0
-
-            def flush():
-                nonlocal n_batches, acc_docs
-                if not acc_docs:
-                    return
-                if store:
-                    # text spill FIRST: a token spill's existence is the
-                    # resume marker for the whole batch, so its text twin
-                    # must never trail it (index/docstore.py assembles
-                    # these after pass 3 — zero extra corpus reads)
-                    from .docstore import write_text_spill
-
-                    write_text_spill(
-                        os.path.join(spill_dir,
-                                     f"text-{n_batches:05d}.npz"),
-                        acc_texts, acc_docids)
-                    acc_texts.clear()
-                    acc_docids.clear()
-                ids = np.concatenate(acc_ids)
-                fmt.savez_atomic(
-                    os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
-                    ids=ids, lengths=np.concatenate(acc_lens))
-                occ_per_batch.append(len(ids))
-                n_batches += 1
-                acc_ids.clear()
-                acc_lens.clear()
-                acc_docs = 0
-
-            try:
-                for delta in tok.deltas():
-                    if store:
-                        docids_d, ids_d, lens_d, texts_d = delta
-                        acc_texts.extend(texts_d)
-                        acc_docids.extend(docids_d)
-                    else:
-                        docids_d, ids_d, lens_d = delta
-                    report.incr("Count.DOCS", len(docids_d))
-                    all_docids.extend(docids_d)
-                    acc_ids.append(ids_d)
-                    acc_lens.append(lens_d)
-                    acc_docs += len(docids_d)
-                    if acc_docs >= batch_docs:
-                        flush()
-                flush()
-                vocab_list = tok.vocab()
-            finally:
-                tok.close()
+            all_docids, vocab_list, n_batches, occ_per_batch = \
+                run_pass1_spills(
+                    tok, spill_dir, batch_docs, store, report,
+                    text_path_fn=lambda b: os.path.join(
+                        spill_dir, f"text-{b:05d}.npz"),
+                    batch_stat=lambda ids, lengths: len(ids))
         batch_occ = np.array(occ_per_batch, dtype=np.int64)
         # manifest LAST: its existence certifies pass 1 (docids in corpus
         # order, the native vocab in temp-id order, per-batch occurrence
@@ -462,31 +479,32 @@ def build_index_streaming(
         # spilling straight to its term shard's file. Streamed input +
         # mesh shuffle is how scale and distribution compose.
         from ..parallel import make_mesh, sharded_build_postings
+        from ..parallel.sharded_build import deal_occurrences
 
         s = spmd_devices
         mesh = make_mesh(s)
-        granule = 1 << 14
         for b, term_ids, docnos, lengths in iter_batches():
             flat_doc = np.repeat(docnos, lengths.astype(np.int64)).astype(
                 np.int32)
-            doc_shard = (flat_doc - 1) % s
-            counts = np.bincount(doc_shard, minlength=s)
-            fill = int(counts.max()) if len(counts) else 1
-            cap = _round_cap(fill, granule)
-            t_arr = np.full((s, cap), PAD_TERM, np.int32)
-            d_arr = np.zeros((s, cap), np.int32)
-            for sh in range(s):
-                sel = doc_shard == sh
-                n = int(sel.sum())
-                t_arr[sh, :n] = term_ids[sel]
-                d_arr[sh, :n] = flat_doc[sel]
-            dps = np.bincount((docnos - 1) % s, minlength=s).astype(
-                np.int32)
+            t_arr, d_arr, dps = deal_occurrences(term_ids, flat_doc,
+                                                 docnos, s)
             out = sharded_build_postings(
                 t_arr, d_arr, dps, vocab_size=v, total_docs=num_docs,
                 mesh=mesh)
-            npairs, pt, pd, ptf = fetch_to_host(
-                out.num_pairs, out.pair_term, out.pair_doc, out.pair_tf)
+            # shrink + narrow ON DEVICE before the D2H copy, like the
+            # single-device path: the [S, C] result arrays are padded to
+            # the worst-case capacity and fetching them whole moves ~S x
+            # the real bytes over the transport that owns this phase
+            npairs, tf_max = fetch_to_host(out.num_pairs,
+                                           jnp.max(out.pair_tf))
+            valid = int(npairs.max()) if len(npairs) else 1
+            pt, pd, ptf = fetch_to_host(
+                shrink_rows_for_fetch(out.pair_term, valid,
+                                      dtype=narrow_uint(v - 1)),
+                shrink_rows_for_fetch(out.pair_doc, valid,
+                                      dtype=narrow_uint(num_docs)),
+                shrink_rows_for_fetch(out.pair_tf, valid,
+                                      dtype=narrow_uint(int(tf_max))))
             for sh in range(s):
                 n_sh = int(npairs[sh])
                 fmt.savez_atomic(
@@ -506,7 +524,7 @@ def build_index_streaming(
     # role it wins at — the per-batch shuffle+reduce)
     df = np.zeros(v, np.int32)
     num_pairs_total = 0
-    shard_of = np.arange(v, dtype=np.int32) % num_shards
+    shard_of = fmt.shard_assignment(v, num_shards)
     with report.phase("pass3_reduce"):
         for s in range(num_shards):
             part = os.path.join(index_dir, fmt.part_name(s))

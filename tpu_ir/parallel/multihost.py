@@ -27,10 +27,14 @@ everywhere.
 from __future__ import annotations
 
 import os
+import re
 from typing import Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
+
+from ..utils.transfer import fetch_to_host, narrow_uint, shrink_rows_for_fetch
 
 
 def init_distributed(coordinator: str | None = None,
@@ -187,63 +191,21 @@ def build_index_multihost(
         report.incr("Count.DOCS", len(my_docids))
         report.set_counter("pass1_resumed_batches", n_batches)
     else:
+        from ..index.streaming import run_pass1_spills
+
         tok = make_chunked_tokenizer(my_files, k=k, with_text=store)
         with report.phase("pass1_tokenize"):
-            acc_ids: list[np.ndarray] = []
-            acc_lens: list[np.ndarray] = []
-            acc_docids: list[str] = []
-            acc_texts: list[bytes] = []
-            acc_docs = 0
-
-            def flush():
-                nonlocal n_batches, acc_docs
-                if not acc_docs:
-                    return
-                if store:
-                    # text spill FIRST: the token spill is the batch's
-                    # resume marker, so its text twin must never trail it
-                    from ..index.docstore import write_text_spill
-
-                    write_text_spill(
-                        os.path.join(
-                            text_dir, f"text-p{pi:03d}-{n_batches:05d}.npz"),
-                        acc_texts, acc_docids)
-                    acc_texts.clear()
-                    acc_docids.clear()
-                lengths = np.concatenate(acc_lens)
-                fmt.savez_atomic(
-                    os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
-                    ids=np.concatenate(acc_ids), lengths=lengths)
-                # record the batch's per-device occupancy now — pass 2
-                # negotiates one global capacity from these, with no second
-                # read of the spills
-                occ = np.bincount(np.arange(len(lengths)) % n_local,
-                                  weights=lengths, minlength=n_local)
-                batch_dev_caps.append(int(occ.max()))
-                n_batches += 1
-                acc_ids.clear()
-                acc_lens.clear()
-                acc_docs = 0
-
-            try:
-                for delta in tok.deltas():
-                    if store:
-                        docids_d, ids_d, lens_d, texts_d = delta
-                        acc_texts.extend(texts_d)
-                        acc_docids.extend(docids_d)
-                    else:
-                        docids_d, ids_d, lens_d = delta
-                    report.incr("Count.DOCS", len(docids_d))
-                    my_docids.extend(docids_d)
-                    acc_ids.append(ids_d)
-                    acc_lens.append(lens_d)
-                    acc_docs += len(docids_d)
-                    if acc_docs >= batch_docs:
-                        flush()
-                flush()
-                local_vocab = tok.vocab()
-            finally:
-                tok.close()
+            # the shared loop records the batch's max per-device
+            # occupancy — pass 2 negotiates one global capacity from
+            # these, with no second read of the spills
+            my_docids, local_vocab, n_batches, batch_dev_caps = \
+                run_pass1_spills(
+                    tok, spill_dir, batch_docs, store, report,
+                    text_path_fn=lambda b: os.path.join(
+                        text_dir, f"text-p{pi:03d}-{b:05d}.npz"),
+                    batch_stat=lambda ids, lengths: np.bincount(
+                        np.arange(len(lengths)) % n_local,
+                        weights=lengths, minlength=n_local).max())
         # manifest LAST (atomic): its existence certifies pass 1, exactly
         # like the single-process streaming build; batch_occ holds the
         # per-batch PER-DEVICE occupancy caps here (the quantity pass 2's
@@ -306,8 +268,11 @@ def build_index_multihost(
         if not all_resumed:
             # a fresh pass-1 anywhere invalidates ALL pass-2/3 artifacts
             # (global ids may have shifted): drop my pair spills + my
-            # rows' outputs; process 0 clears the shared position spills,
-            # with a barrier so no step writes before the wipe lands
+            # rows' outputs; process 0 clears the shared position spills
+            # AND any part/position rows no process owns under the
+            # current config (a crashed run with more processes leaves
+            # higher-numbered rows that would sit in the finished index
+            # forever); a barrier keeps every step after the wipe
             for name in os.listdir(spill_dir):
                 if name.startswith("pairs-"):
                     os.unlink(os.path.join(spill_dir, name))
@@ -316,11 +281,16 @@ def build_index_multihost(
                              os.path.join(index_dir, positions_name(row))):
                     if os.path.exists(path):
                         os.unlink(path)
-            if positions:
-                if pi == 0:
+            if pi == 0:
+                stale = re.compile(r"^(?:part|positions)-(\d+)\.npz$")
+                for name in os.listdir(index_dir):
+                    m = stale.match(name)
+                    if m and int(m.group(1)) >= s:
+                        os.unlink(os.path.join(index_dir, name))
+                if positions:
                     for name in os.listdir(pos_dir):
                         os.unlink(os.path.join(pos_dir, name))
-                multihost_utils.sync_global_devices("tpu_ir_pos_wiped")
+            multihost_utils.sync_global_devices("tpu_ir_stale_wiped")
 
         def my_batch_done(b: int) -> bool:
             """Did MY contribution to batch b land completely (atomic
@@ -410,14 +380,28 @@ def build_index_multihost(
             out = sharded_build_postings(
                 g_t, g_d, g_n, vocab_size=v, total_docs=num_docs, mesh=mesh)
 
-            # spill my devices' reduced outputs as their term shards' pairs
+            # spill my devices' reduced outputs as their term shards'
+            # pairs — shrunk + narrowed ON DEVICE first (the [S, C]
+            # results are worst-case padded; every process computes the
+            # same replicated global max so the sliced shapes agree)
             np_rows = {sd.index[0].start: int(np.asarray(sd.data).ravel()[0])
                        for sd in out.num_pairs.addressable_shards}
+            npmax, tfmax = fetch_to_host(jnp.max(out.num_pairs),
+                                         jnp.max(out.pair_tf))
+            shrunk = {
+                "pair_term": shrink_rows_for_fetch(
+                    out.pair_term, int(npmax), dtype=narrow_uint(v - 1)),
+                "pair_doc": shrink_rows_for_fetch(
+                    out.pair_doc, int(npmax),
+                    dtype=narrow_uint(num_docs)),
+                "pair_tf": shrink_rows_for_fetch(
+                    out.pair_tf, int(npmax), dtype=narrow_uint(int(tfmax))),
+            }
             rows = {}
             for col in ("pair_term", "pair_doc", "pair_tf"):
                 rows[col] = {sd.index[0].start: np.asarray(sd.data)
                              .reshape(-1)
-                             for sd in getattr(out, col).addressable_shards}
+                             for sd in shrunk[col].addressable_shards}
             for row, npair in np_rows.items():
                 fmt.savez_atomic(
                     os.path.join(spill_dir, f"pairs-{row:03d}-{b:05d}.npz"),
@@ -540,7 +524,9 @@ def _spill_position_runs(pos_dir: str, term_ids: np.ndarray,
     carrying their (term, doc, tf) run keys, so the pass-3 shard owner
     can re-align the union from every process by the part order."""
     from ..index import format as fmt2
-    from ..index.positions import build_position_runs, flat_positions_from_lengths
+    from ..index.positions import (build_position_runs,
+                                   flat_positions_from_lengths,
+                                   realign_runs)
 
     flat_doc = np.repeat(np.asarray(docnos, np.int64),
                          np.asarray(lengths, np.int64))
@@ -551,12 +537,7 @@ def _spill_position_runs(pos_dir: str, term_ids: np.ndarray,
     shard = rt.astype(np.int64) % num_shards
     for row in range(num_shards):
         sel = shard == row
-        lens = run_len[sel]
-        indptr = np.concatenate([[0], np.cumsum(lens)])
-        starts = idp[:-1][sel]
-        gather = (np.repeat(starts, lens)
-                  + np.arange(int(lens.sum()))
-                  - np.repeat(indptr[:-1], lens))
+        indptr, gather = realign_runs(idp[:-1][sel], run_len[sel])
         fmt2.savez_atomic(
             os.path.join(pos_dir, f"pos-{row:03d}-b{b:05d}-p{pi:03d}.npz"),
             term=rt[sel], doc=rd[sel], tf=rtf[sel],
@@ -572,7 +553,7 @@ def _reduce_position_spills(pos_dir: str, index_dir: str, row: int) -> None:
     import glob
 
     from ..index import format as fmt2
-    from ..index.positions import positions_name
+    from ..index.positions import positions_name, realign_runs
 
     terms, docs, tfs, deltas, rlens = [], [], [], [], []
     for path in sorted(glob.glob(
@@ -592,10 +573,7 @@ def _reduce_position_spills(pos_dir: str, index_dir: str, row: int) -> None:
     order = np.lexsort((rd, -rtf.astype(np.int64), rt))
     starts = np.concatenate([[0], np.cumsum(rlen)])[:-1]
     new_len = rlen[order]
-    out_indptr = np.concatenate([[0], np.cumsum(new_len)])
-    gather = (np.repeat(starts[order], new_len)
-              + np.arange(int(new_len.sum()))
-              - np.repeat(out_indptr[:-1], new_len))
+    out_indptr, gather = realign_runs(starts[order], new_len)
     # alignment proof against the part file this process just wrote
     z = fmt2.load_shard(index_dir, row)
     if not (np.array_equal(rd[order], z["pair_doc"])
@@ -633,6 +611,14 @@ def allgather_strings(local: Sequence[str],
         return sorted(set(local))
     from jax.experimental import multihost_utils
 
+    for s in local:
+        # '\n' is the wire separator: an embedded newline (the multi-line
+        # <DOCNO> case DocnoMapping rejects) would silently split into
+        # two entries here, BEFORE any validation — surface the same
+        # corpus error the single-process build raises
+        if "\n" in s or "\r" in s:
+            raise ValueError(f"string {s!r} contains a newline and cannot "
+                             "cross the allgather; fix the corpus record")
     blob = b"\n".join(s.encode("utf-8") for s in sorted(set(local)))
     n = len(blob)
     sizes = np.asarray(multihost_utils.process_allgather(

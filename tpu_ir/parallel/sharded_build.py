@@ -26,7 +26,34 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from ..ops.postings import PAD_TERM, build_postings, reduce_weighted_postings
+from ..ops.postings import (PAD_TERM, build_postings,
+                            reduce_weighted_postings, round_cap)
+
+
+def deal_occurrences(flat_term: np.ndarray, flat_doc: np.ndarray,
+                     docnos: np.ndarray, num_shards: int,
+                     granule: int = 1 << 14):
+    """Deal flat occurrence columns into the mesh program's inputs:
+    (term_ids [S, cap], doc_ids [S, cap], docs_per_shard [S]), where doc
+    (docno - 1) % S owns each occurrence and cap is the largest shard's
+    fill bucketed by `granule` (shared compiled shapes). THE dealing
+    rule — the in-memory SPMD build and the streaming SPMD pass 2 both
+    route through here, and their byte-identical-artifacts guarantee
+    depends on the rule staying single-sourced."""
+    s = num_shards
+    doc_shard = (flat_doc - 1) % s
+    fill = (int(np.bincount(doc_shard, minlength=s).max())
+            if len(flat_term) else 1)
+    cap = round_cap(fill, granule)
+    t_arr = np.full((s, cap), PAD_TERM, np.int32)
+    d_arr = np.zeros((s, cap), np.int32)
+    for sh in range(s):
+        sel = doc_shard == sh
+        n = int(sel.sum())
+        t_arr[sh, :n] = flat_term[sel]
+        d_arr[sh, :n] = flat_doc[sel]
+    dps = np.bincount((docnos - 1) % s, minlength=s).astype(np.int32)
+    return t_arr, d_arr, dps
 from .mesh import SHARD_AXIS, make_mesh
 
 
@@ -134,7 +161,6 @@ def sharded_build_postings(
     total_docs: int,
     mesh=None,
     bucket_cap: int | None = None,
-    max_retries: int = 3,
 ) -> ShardedPostings:
     """Run the SPMD build, growing bucket capacity on overflow."""
     s, c = term_ids.shape
@@ -143,7 +169,7 @@ def sharded_build_postings(
     if bucket_cap is None:
         # expected pairs per (device, dest) with 2x headroom, 128-aligned
         bucket_cap = max(128, int(2 * c / s) + 127 & ~127)
-    for attempt in range(max_retries + 1):
+    while True:
         out = _sharded_build_jit(
             jnp.asarray(term_ids), jnp.asarray(doc_ids),
             jnp.asarray(docs_per_shard),
@@ -156,8 +182,12 @@ def sharded_build_postings(
             result.dropped.addressable_shards[0].data).ravel()[0])
         if dropped == 0:
             return result
-        bucket_cap = min(bucket_cap * 2, c)
-        if attempt == max_retries:
+        if bucket_cap >= c:
+            # cap == c holds every pair a device could route to ONE dest,
+            # so overflow here means a routing bug, not skew. A fixed
+            # retry count used to stop the doubling at c/2 for meshes
+            # with s > 16, failing feasible skewed distributions.
             raise RuntimeError(
-                f"postings routing overflow persists at bucket_cap={bucket_cap}")
-    raise AssertionError("unreachable")
+                f"postings routing overflow persists at bucket_cap="
+                f"{bucket_cap} == capacity {c}; routing bug?")
+        bucket_cap = min(bucket_cap * 2, c)

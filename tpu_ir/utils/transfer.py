@@ -65,6 +65,41 @@ def shrink_for_fetch(a, valid: int, *, dtype=None, granule: int = 1 << 14):
     return _slice_cast(a, n=n, dtype=dt)
 
 
+_SLICE_CAST_ROWS = None
+
+
+def _slice_cast_rows(a, *, n: int, dtype):
+    global _SLICE_CAST_ROWS
+    if _SLICE_CAST_ROWS is None:
+        import jax
+
+        @partial(jax.jit, static_argnames=("n", "dtype"))
+        def run(x, *, n, dtype):
+            return jax.lax.slice(x, (0, 0),
+                                 (x.shape[0], n)).astype(dtype)
+
+        _SLICE_CAST_ROWS = run
+    return _SLICE_CAST_ROWS(a, n=n, dtype=np.dtype(dtype))
+
+
+def shrink_rows_for_fetch(a, valid: int, *, dtype=None,
+                          granule: int = 1 << 14):
+    """shrink_for_fetch for [S, C] per-shard result arrays: every row
+    keeps its first valid-bucket columns (the largest shard's valid
+    prefix bounds them all), cast to the narrowest safe dtype. Slicing
+    the trailing axis preserves the leading-axis sharding, so on a mesh
+    the shrink runs where each shard lives and only real data rides the
+    D2H link. Padding slots may hold values outside the narrow dtype
+    (PAD_TERM); they wrap silently and are never read — callers slice
+    each row to its own valid prefix after the fetch."""
+    cap = a.shape[1]
+    n = min(cap, max(granule, -(-valid // granule) * granule))
+    dt = np.dtype(dtype) if dtype is not None else np.dtype(a.dtype)
+    if n == cap and dt == np.dtype(a.dtype):
+        return a
+    return _slice_cast_rows(a, n=n, dtype=dt)
+
+
 def narrow_uint(max_value: int):
     """Smallest of uint16/int32 that exactly holds values in [0, max_value]."""
     return np.uint16 if max_value < (1 << 16) else np.int32
