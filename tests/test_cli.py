@@ -91,13 +91,15 @@ def test_verify_catches_corruption(setup, tmp_path):
     build_index([corpus], idx, num_shards=2, compute_chargrams=False)
     z = fmt.load_shard(idx, 0)
     z["pair_tf"] = z["pair_tf"].copy()
-    if len(z["pair_tf"]):
-        z["pair_tf"][0] = 0  # invalid tf
-        fmt.save_shard(idx, 0, **{k: z[k] for k in
-                                  ["term_ids", "indptr", "pair_doc",
-                                   "pair_tf", "df"]})
-        with pytest.raises(AssertionError):
-            verify_index(idx)
+    # precondition, not a silent skip: an empty shard 0 would make this
+    # test verify nothing (review r5)
+    assert len(z["pair_tf"])
+    z["pair_tf"][0] = 0  # invalid tf
+    fmt.save_shard(idx, 0, **{k: z[k] for k in
+                              ["term_ids", "indptr", "pair_doc",
+                               "pair_tf", "df"]})
+    with pytest.raises(AssertionError):
+        verify_index(idx)
 
 
 def test_count(setup, capsys):

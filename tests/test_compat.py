@@ -73,16 +73,23 @@ def test_idf_zero_when_df_equals_n():
 
 
 def test_ceil_comparator_tie_behavior():
-    """Scores within 1.0 of each other compare 'equal' under the reference
-    comparator, so insertion order survives — a documented reference quirk."""
+    """DocScore.compareTo is (int) ceil(other - this): a doc scoring up
+    to 1.0 HIGHER than an earlier-inserted doc compares 'equal' in the
+    direction the stable sort asks, so it never displaces it — the
+    documented reference quirk. (The old version of this test used a
+    corpus where every score was 0.0, making its disjunctive assert
+    vacuous — review r5.)"""
     oracle = CompatIndex({
-        "X-1": "apple apple banana",
-        "X-2": "apple cherry",
-        "X-3": "banana cherry",
+        "D-0": "cherry",    # filler: keeps idf positive (N=4)
+        "D-1": "apple",     # 0.301, inserted 1st (apple postings)
+        "D-4": "apple",     # 0.301, inserted 2nd
+        "D-2": "banana",    # 0.602, inserted LAST (banana postings)
     })
-    ranked = oracle.rank("apple")
+    ranked = oracle.rank("apple banana")
     assert ranked is not None
-    scores = [s for _, s in ranked]
-    # all scores positive and within 1.0 -> order is postings (tf-desc) order
-    assert scores == sorted(scores, reverse=True) or (
-        max(scores) - min(scores) < 1.0)
+    assert [d for d, _ in ranked] == ["D-1", "D-4", "D-2"]
+    scores = dict(ranked)
+    # the quirk is discriminating: D-2 scores strictly highest yet ranks
+    # last, where an exact-score sort would put it first
+    assert scores["D-2"] == max(scores.values())
+    assert scores["D-2"] - scores["D-1"] < 1.0

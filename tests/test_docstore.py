@@ -213,8 +213,21 @@ def test_streaming_store_fold_no_second_corpus_read(tmp_path, monkeypatch):
         for d, t in docs.items()))
 
     out1 = str(tmp_path / "fold")
-    build_index_streaming([str(corpus)], out1, k=1, num_shards=2,
-                          batch_docs=16, chargram_ks=[], store=True)
+    # enforce the headline claim, not just content equality: any TREC
+    # re-read during the fold build means the store did NOT come from
+    # the pass-1 text spills (review r5 — this patch was missing and the
+    # test could pass with a silent second corpus pass)
+    import tpu_ir.collection.trec as trec_mod
+
+    def _forbid(*a, **k):
+        raise AssertionError(
+            "corpus re-read: the store fold must use pass-1 text spills")
+
+    with monkeypatch.context() as m:
+        m.setattr(trec_mod, "read_trec_corpus", _forbid)
+        m.setattr(ds, "read_trec_corpus", _forbid)
+        build_index_streaming([str(corpus)], out1, k=1, num_shards=2,
+                              batch_docs=16, chargram_ks=[], store=True)
     assert ds.available(out1)
 
     # the standalone pass over the same corpus must agree per docno

@@ -104,8 +104,12 @@ def test_inspect_serving_cache(idx, tmp_path):
     assert os.path.isdir(cache)
     out = lines_for(cache)
     assert "serving cache" in out[0] and "version" in out[0]
-    assert any(line.endswith(f"head={list(np.load(os.path.join(cache, 'df.npy'))[:8])}")
-               or line.startswith("df.npy") for line in out)
+    # the df.npy line must carry the REAL head values — 'or startswith'
+    # made the value check decorative, and the endswith arm could never
+    # match (numpy-2 scalar reprs + the ' ...' suffix) (review r5)
+    head = f"head={np.load(os.path.join(cache, 'df.npy'))[:8].tolist()}"
+    df_lines = [line for line in out if line.startswith("df.npy")]
+    assert df_lines and any(head in line for line in df_lines), out
 
 
 def test_inspect_cli_dispatch(idx, capsys):

@@ -265,15 +265,22 @@ def test_compat_int_idf():
     p, oracle, vocab, ndocs = _small_index()
     mat = dense_doc_matrix(p.pair_term, p.pair_doc, p.pair_tf,
                            vocab_size=vocab, num_docs=ndocs)
-    q = np.array([[4, -1]], np.int32)
+    # term 2: df=2, so the Java int division gives ndocs//df = 8 and a
+    # POSITIVE idf (the old choice, term 4 with df=9, had 17//9 = 1 ->
+    # idf exactly 0, every score 0.0, and the comparison loop compared
+    # nothing — review r5)
+    tid = 2
+    dfv = int(np.asarray(p.df)[tid])
+    assert ndocs // dfv >= 2, "fixture drift: pick a term with idf > 0"
+    q = np.array([[tid, -1]], np.int32)
     s, dn = tfidf_topk_dense(jnp.asarray(q), mat, p.df, jnp.int32(ndocs),
                              k=3, compat_int_idf=True)
-    dfv = int(np.asarray(p.df)[4])
-    posts = oracle.get(4, [])
-    want = sorted(
-        ((1 + np.log(tf)) * np.log10(max(ndocs // dfv, 1e-30)), d)
-        for d, tf in posts)[::-1][:3]
+    posts = oracle.get(tid, [])
+    want = [pair for pair in sorted(
+        ((1 + np.log(tf)) * np.log10(ndocs // dfv), d)
+        for d, tf in posts)[::-1][:3] if pair[0] > 0]
     got = [float(x) for x in np.asarray(s)[0] if x > 0]
+    assert want and len(got) == len(want)  # zip would silently truncate
     for g, (w, _) in zip(got, want):
         assert g == pytest.approx(w, rel=1e-4)
 
