@@ -686,3 +686,49 @@ def test_sparse_drops_out_of_range_term_ids():
                                jnp.int32(ndocs), num_docs=ndocs, k=5)
     np.testing.assert_allclose(np.asarray(s1), np.asarray(s2), rtol=1e-6)
     np.testing.assert_array_equal(np.asarray(d1), np.asarray(d2))
+
+
+def test_bm25_b1_empty_doc_no_nan():
+    """At b=1.0 an empty doc has dl_norm 0, and an unguarded saturation
+    divides 0/0 — the NaN outranks every real score in lax.top_k and
+    burns top-k slots (review r5: verified scores like [0., ...] with the
+    best real doc dropped). The guarded curve must rank real docs only."""
+    from tpu_ir.ops import bm25_topk_dense
+    from tpu_ir.ops.scoring import dense_tf_matrix
+
+    # docs 1..2 real, doc 3 EMPTY (no postings, doc_len 0)
+    pair_term = jnp.asarray(np.array([0, 0, 1], np.int32))
+    pair_doc = jnp.asarray(np.array([1, 2, 1], np.int32))
+    pair_tf = jnp.asarray(np.array([2, 1, 1], np.int32))
+    tf_mat = dense_tf_matrix(pair_term, pair_doc, pair_tf,
+                             vocab_size=2, num_docs=3)
+    df = jnp.asarray(np.array([2, 1], np.int32))
+    doc_len = jnp.asarray(np.array([0, 3, 1, 0], np.int32))
+    q = jnp.asarray(np.array([[0, 1]], np.int32))
+    s, d = bm25_topk_dense(q, tf_mat, df, doc_len, jnp.int32(3),
+                           k=3, b=1.0)
+    s, d = np.asarray(s), np.asarray(d)
+    assert np.isfinite(s).all()
+    assert d[0, 0] == 1 and s[0, 0] > 0     # best real doc leads
+    assert 3 not in d[0]                     # the empty doc never ranks
+
+
+def test_reduce_weighted_postings_empty_input():
+    """A zero-length bucket must return num_pairs 0, not IndexError —
+    the guard build_postings always had (review r5)."""
+    from tpu_ir.ops.postings import reduce_weighted_postings
+
+    t = jnp.zeros((0,), jnp.int32)
+    out = reduce_weighted_postings(t, t, t, vocab_size=5)
+    assert int(out[4]) == 0
+    assert np.asarray(out[3]).sum() == 0  # df all zero
+
+
+def test_pack_occurrences_length_mismatch_is_loud():
+    """zip truncation used to silently drop whole documents' postings
+    when docnos was shorter than the per-doc id lists (review r5)."""
+    with pytest.raises(ValueError):
+        pack_occurrences(
+            [np.zeros(2, np.int32), np.ones(2, np.int32),
+             np.full(2, 2, np.int32)],
+            np.array([1, 2]), capacity=8)

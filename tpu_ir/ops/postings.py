@@ -78,43 +78,18 @@ def build_postings(
     valid = term_ids != PAD_TERM
     doc_ids = jnp.where(valid, doc_ids, 0)
 
-    # --- shuffle: sort by (term, doc) ---
-    order = jnp.lexsort((doc_ids, term_ids))
-    t_sorted = term_ids[order]
-    d_sorted = doc_ids[order]
-    v_sorted = valid[order]
-
-    # --- run-length segmentation into unique (term, doc) pairs ---
-    prev_t = jnp.concatenate([jnp.full((1,), -1, jnp.int32), t_sorted[:-1]])
-    prev_d = jnp.concatenate([jnp.full((1,), -1, jnp.int32), d_sorted[:-1]])
-    new_pair = ((t_sorted != prev_t) | (d_sorted != prev_d)) & v_sorted
-    pair_idx = jnp.cumsum(new_pair.astype(jnp.int32)) - 1  # [T], -1 before 1st
-    num_pairs = pair_idx[-1] + 1 if t_cap else jnp.int32(0)
-
-    # scatter pair attributes; invalid tokens are dropped via OOB index
-    scatter_idx = jnp.where(v_sorted, pair_idx, t_cap)
-    pair_term = jnp.full((t_cap,), PAD_TERM, jnp.int32).at[scatter_idx].set(
-        t_sorted, mode="drop")
-    pair_doc = jnp.zeros((t_cap,), jnp.int32).at[scatter_idx].set(
-        d_sorted, mode="drop")
-    pair_tf = jnp.zeros((t_cap,), jnp.int32).at[scatter_idx].add(
-        v_sorted.astype(jnp.int32), mode="drop")
-
-    # --- df: one count per unique (term, doc) pair ---
-    df_idx = jnp.where(new_pair, t_sorted, vocab_size)
-    df = jnp.zeros((vocab_size,), jnp.int32).at[df_idx].add(
-        jnp.ones((t_cap,), jnp.int32), mode="drop")
+    # an occurrence is a (term, doc, tf=1) triple: the sort/segment/
+    # scatter/df/re-sort pipeline is reduce_weighted_postings exactly
+    # (one copy of the grouping logic — the two used to be ~25
+    # near-identical lines that had already drifted on the empty guard)
+    pair_term, pair_doc, pair_tf, df, num_pairs = reduce_weighted_postings(
+        term_ids, doc_ids, jnp.ones((t_cap,), jnp.int32),
+        vocab_size=vocab_size)
 
     # --- doc lengths (total occurrences per doc) for BM25 ---
-    dl_idx = jnp.where(v_sorted, d_sorted, num_docs + 1)
+    dl_idx = jnp.where(valid, doc_ids, num_docs + 1)
     doc_len = jnp.zeros((num_docs + 1,), jnp.int32).at[dl_idx].add(
         jnp.ones((t_cap,), jnp.int32), mode="drop")
-
-    # --- reference posting order: term asc, tf desc, doc asc ---
-    order2 = jnp.lexsort((pair_doc, -pair_tf, pair_term))
-    pair_term = pair_term[order2]
-    pair_doc = pair_doc[order2]
-    pair_tf = pair_tf[order2]
 
     indptr = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum(df).astype(jnp.int32)])
@@ -188,7 +163,9 @@ def reduce_weighted_postings(term, doc, tf, *, vocab_size: int):
     prev_d = jnp.concatenate([jnp.full((1,), -1, jnp.int32), d_s[:-1]])
     new = ((t_s != prev_t) | (d_s != prev_d)) & v_s
     idx = jnp.cumsum(new.astype(jnp.int32)) - 1
-    num_pairs = idx[-1] + 1
+    # same empty guard as build_postings: a zero-length bucket must
+    # return num_pairs 0, not IndexError at trace time
+    num_pairs = idx[-1] + 1 if c else jnp.int32(0)
 
     scatter = jnp.where(v_s, idx, c)
     p_term = jnp.full((c,), PAD_TERM, jnp.int32).at[
@@ -240,7 +217,10 @@ def pack_occurrences(
     term_ids = np.full(capacity, PAD_TERM, np.int32)
     doc_ids = np.zeros(capacity, np.int32)
     pos = 0
-    for docno, ids in zip(docnos, doc_term_ids):
+    # strict: a plain zip would silently drop whole documents' postings
+    # when the lists disagree in length (total counted them, so the
+    # capacity check would still pass)
+    for docno, ids in zip(docnos, doc_term_ids, strict=True):
         n = len(ids)
         term_ids[pos : pos + n] = ids
         doc_ids[pos : pos + n] = docno

@@ -69,6 +69,24 @@ def idf_weights(df: jax.Array, num_docs: int, compat_int_idf: bool = False) -> j
     return jnp.where(df > 0, w, 0.0)
 
 
+def bm25_idf_weights(df: jax.Array, n: jax.Array) -> jax.Array:
+    """Okapi idf log(1 + (N - df + 0.5)/(df + 0.5)); df==0 terms get 0.
+    One definition — this expression used to be inlined at four sites
+    with inconsistent df==0 masking (the dense copy relied on zero
+    tf-matrix rows, a subtlety each copy had to re-reason about)."""
+    dff = df.astype(jnp.float32)
+    n_f = jnp.asarray(n, jnp.float32)
+    w = jnp.log(1.0 + (n_f - dff + 0.5) / (dff + 0.5))
+    return jnp.where(df > 0, w, 0.0)
+
+
+def bm25_saturation(tf, dl_norm, *, k1: float):
+    """tf*(k1+1)/(tf + k1*dl_norm), guarded: at b=1.0 an empty doc has
+    dl_norm 0 and a tf=0 cell would divide 0/0 — the NaN then outranks
+    every real score in lax.top_k (and poisons the hot-strip matmul)."""
+    return tf * (k1 + 1.0) / jnp.maximum(tf + k1 * dl_norm, 1e-9)
+
+
 def _dense_scatter(pair_term, pair_doc, values, *, vocab_size: int,
                    num_docs: int) -> jax.Array:
     flat = jnp.zeros((vocab_size * (num_docs + 1),), jnp.float32)
@@ -113,8 +131,10 @@ def tfidf_topk_dense(
     safe_q = jnp.where(q_terms >= 0, q_terms, 0)
     q_valid = (q_terms >= 0) & (q_terms < vocab_size)
     q_idf = jnp.where(q_valid, idf[safe_q], 0.0)          # [B, L]
+    # no separate row mask: q_idf is already 0 exactly where q_valid is
+    # False, and the clamped gather returns finite real rows — a mask
+    # here would re-multiply the [B, L, D+1] tensor for nothing
     rows = doc_matrix[safe_q]                              # [B, L, D+1]
-    rows = rows * jnp.where(q_valid, 1.0, 0.0)[..., None]
     scores = jnp.einsum("bld,bl->bd", rows, q_idf)         # [B, D+1]
     return _topk_from_scores(scores, k)
 
@@ -135,8 +155,7 @@ def bm25_topk_dense(
     but the MS MARCO config needs; SURVEY.md §7 build order)."""
     vocab_size = tf_matrix.shape[0]
     n = jnp.asarray(num_docs, jnp.float32)
-    dff = df.astype(jnp.float32)
-    idf = jnp.log(1.0 + (n - dff + 0.5) / (dff + 0.5))
+    idf = bm25_idf_weights(df, n)
     avg_dl = jnp.sum(doc_len.astype(jnp.float32)) / jnp.maximum(n, 1.0)
     dl_norm = 1.0 - b + b * doc_len.astype(jnp.float32) / jnp.maximum(avg_dl, 1e-9)
 
@@ -144,8 +163,9 @@ def bm25_topk_dense(
     q_valid = (q_terms >= 0) & (q_terms < vocab_size)
     q_idf = jnp.where(q_valid, idf[safe_q], 0.0)           # [B, L]
     tf = tf_matrix[safe_q]                                  # [B, L, D+1]
-    sat = tf * (k1 + 1.0) / (tf + k1 * dl_norm[None, None, :])
-    scores = jnp.einsum("bld,bl->bd", sat, q_idf)
+    scores = jnp.einsum("bld,bl->bd",
+                        bm25_saturation(tf, dl_norm[None, None, :], k1=k1),
+                        q_idf)
     return _topk_from_scores(scores, k)
 
 
@@ -331,6 +351,13 @@ def tfidf_topk_tiered(
     budget-capped hot strip bounds dense memory, geometric tier capacities
     bound padding waste, and every shape stays static under jit.
 
+    INVARIANT (all tiered kernels): the traced `n_scalar` and the static
+    `num_docs` must be the same N. The pair exists because the sharded
+    path's accumulator width (dblk) genuinely differs from the global N
+    its idf needs; on the single-device kernels a divergence would not
+    error — idf/avg_dl would use one N and the accumulator/prune gate
+    the other, silently mis-scaling every score.
+
     `skip_hot=True` (static) omits the hot-strip stage entirely — exact
     when the caller certified no query term is hot (the Scorer's
     scheduled MaxScore path). `prune=True` (with `hot_max_tf`) is the
@@ -386,11 +413,7 @@ def bm25_topk_tiered(
     decreasing in dl_norm, so sat(tf, d) <= sat(max_tf, dl_min) for every
     posting of the row."""
     n = jnp.asarray(n_scalar, jnp.float32)
-    dff = df.astype(jnp.float32)
-    # df == 0 terms contribute nothing (parity with the dense path, where
-    # their tf-matrix row is all zeros); BM25's idf alone is nonzero there
-    idf = jnp.where(df > 0,
-                    jnp.log(1.0 + (n - dff + 0.5) / (dff + 0.5)), 0.0)
+    idf = bm25_idf_weights(df, n)
     dlf = doc_len.astype(jnp.float32)
     avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
     dl_norm = 1.0 - b + b * dlf / jnp.maximum(avg_dl, 1e-9)  # [D+1]
@@ -401,21 +424,21 @@ def bm25_topk_tiered(
         # slot 0 is the dead column (doc_len 0 -> the global minimum of
         # dl_norm); exclude it so the bound reflects real documents
         dl_min = jnp.min(dl_norm[1:])
-        mtf = hot_max_tf.astype(jnp.float32)
-        hot_max_w = mtf * (k1 + 1.0) / jnp.maximum(mtf + k1 * dl_min, 1e-9)
+        hot_max_w = bm25_saturation(hot_max_tf.astype(jnp.float32),
+                                    dl_min, k1=k1)
     else:
         hot_max_w = None
 
     # one weight model for cold postings AND pruned hot candidates: the
     # rank-safety contract depends on the two staying identical
-    cell_fn = (lambda tfs, docs: tfs * (k1 + 1.0)
-               / (tfs + k1 * dl_norm[docs]))
+    cell_fn = (lambda tfs, docs: bm25_saturation(tfs, dl_norm[docs],
+                                                 k1=k1))
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
         idf, num_docs=num_docs,
         # hot_weight_fn sees the whole [H, D+1] strip (doc axis last)
-        hot_weight_fn=lambda tf: tf * (k1 + 1.0)
-        / (tf + k1 * dl_norm[None, :]),
+        hot_weight_fn=lambda tf: bm25_saturation(tf, dl_norm[None, :],
+                                                 k1=k1),
         cold_weight_fn=cell_fn,
         hot_cell_fn=cell_fn if do_prune else None,
         hot_max_w=hot_max_w,
@@ -500,9 +523,13 @@ def cosine_rerank_tiered(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
         idf * idf, num_docs=num_docs, hot_weight_fn=_lntf,
         cold_weight_fn=lambda tfs, docs: _lntf(tfs))
-    scores = scores / jnp.maximum(doc_norm, 1e-30)[None, :]
-    cand_scores = jnp.take_along_axis(
-        scores, cand_docnos.astype(jnp.int32), axis=1)
+    # gather the C candidates FIRST, then normalize: dividing the full
+    # [B, D+1] matrix before a [B, C] gather is ~D/C times the divides
+    # plus a full-width temporary per rerank block (elementwise divide
+    # commutes with take_along_axis, like cosine_rerank_dense)
+    cand = cand_docnos.astype(jnp.int32)
+    cand_scores = (jnp.take_along_axis(scores, cand, axis=1)
+                   / jnp.maximum(doc_norm[cand], 1e-30))
     return _topk_over_candidates(cand_scores, cand_docnos, k)
 
 

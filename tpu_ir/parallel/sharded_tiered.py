@@ -35,7 +35,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ..ops.scoring import _lntf, _tiered_scores, _topk_over_candidates, idf_weights
+from ..ops.scoring import (_lntf, _tiered_scores, _topk_over_candidates,
+                           bm25_idf_weights, bm25_saturation, idf_weights)
 from ..search.layout import BASE_CAP, GROWTH, HOT_BUDGET, build_tiered_layout
 from .mesh import SHARD_AXIS
 
@@ -278,8 +279,8 @@ def _bm25_weight_fns(doc_len, n_f, k1, b):
     total = jax.lax.psum(jnp.sum(dl), SHARD_AXIS)
     avg_dl = total / jnp.maximum(n_f, 1.0)
     dl_norm = 1.0 - b + b * dl / jnp.maximum(avg_dl, 1e-9)
-    hot = lambda tf: tf * (k1 + 1.0) / (tf + k1 * dl_norm[None, :])
-    cold = lambda tfs, docs: tfs * (k1 + 1.0) / (tfs + k1 * dl_norm[docs])
+    hot = lambda tf: bm25_saturation(tf, dl_norm[None, :], k1=k1)
+    cold = lambda tfs, docs: bm25_saturation(tfs, dl_norm[docs], k1=k1)
     return hot, cold
 
 
@@ -341,9 +342,7 @@ def _sharded_topk_jit(q_terms, df, n_scalar, hot_rank, hot_tfs, tier_of,
                       mesh, dblk, k, scoring, compat_int_idf, k1, b):
     n_f = jnp.asarray(n_scalar, jnp.float32)
     if scoring == "bm25":
-        dff = df.astype(jnp.float32)
-        q_weight = jnp.where(
-            df > 0, jnp.log(1.0 + (n_f - dff + 0.5) / (dff + 0.5)), 0.0)
+        q_weight = bm25_idf_weights(df, n_f)
     else:
         q_weight = idf_weights(df, n_scalar, compat_int_idf)
 
@@ -396,9 +395,7 @@ def _sharded_rerank_jit(q_terms, df, n_scalar, doc_norm, hot_rank, hot_tfs,
                         tier_of, row_of, doc_len, doc_base, tier_docs,
                         tier_tfs, *, mesh, dblk, k, candidates, k1, b):
     n_f = jnp.asarray(n_scalar, jnp.float32)
-    dff = df.astype(jnp.float32)
-    w_bm25 = jnp.where(
-        df > 0, jnp.log(1.0 + (n_f - dff + 0.5) / (dff + 0.5)), 0.0)
+    w_bm25 = bm25_idf_weights(df, n_f)
     idf = idf_weights(df, n_scalar)
     w_cos = idf * idf
 
