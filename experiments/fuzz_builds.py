@@ -1,0 +1,224 @@
+"""Differential build fuzz: random corpora through every build path.
+
+For each seed: generate a corpus (random sizes, duplicate tokens,
+unicode docs, empty docs, multiple files, optional gzip member), then
+
+  1. build it four ways — in-memory, streaming, SPMD(4), streaming+SPMD —
+     and require BYTE-IDENTICAL artifacts across all four;
+  2. positions+store ride along on a subset of seeds (byte-compared too);
+  3. split the corpus in half, build each, merge — byte-identical to the
+     one-shot build (the merge determinism contract);
+  4. query the built index in --compat mode and require EXACT agreement
+     with the pure-Python CompatIndex oracle on scores and order.
+
+Usage: python experiments/fuzz_builds.py [N_SEEDS] [FIRST_SEED]
+Runs hermetically on the CPU backend with an 8-virtual-device mesh.
+"""
+
+import gzip
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as xb
+
+for _n in list(xb._backend_factories):
+    if _n != "cpu":
+        xb._backend_factories.pop(_n, None)
+
+import numpy as np
+
+WORDS = ["salmon", "fish", "river", "bear", "honey", "fox", "dog", "run",
+         "the", "a", "of", "quick", "lazy", "gold", "market", "naïve",
+         "café", "x", "zz", "investor", "asset", "jump", "season"]
+
+
+def make_corpus(rng, tmp):
+    """1-3 files, 1-40 docs, some empty/unicode/dup-heavy; maybe gzip."""
+    n_docs = int(rng.integers(1, 41))
+    docids = [f"D-{rng.integers(0, 10**6):06d}-{i}" for i in range(n_docs)]
+    paths, recs = [], []
+    for i, d in enumerate(docids):
+        style = rng.integers(0, 10)
+        if style == 0:
+            body = ""                                   # empty doc
+        elif style == 1:
+            w = rng.choice(WORDS)
+            body = " ".join([w] * int(rng.integers(1, 30)))  # dup-heavy
+        else:
+            body = " ".join(rng.choice(WORDS, int(rng.integers(1, 60))))
+        recs.append(f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{body}\n"
+                    f"</TEXT>\n</DOC>\n")
+    n_files = int(rng.integers(1, 4))
+    cuts = sorted(rng.choice(len(recs) + 1, n_files - 1)) if n_files > 1 \
+        else []
+    chunks = np.split(np.array(recs, dtype=object),
+                      cuts) if recs else [np.array([], dtype=object)]
+    for fi, chunk in enumerate(chunks):
+        text = "".join(chunk)
+        if rng.integers(0, 4) == 0:                    # ~25% gzip members
+            p = os.path.join(tmp, f"c{fi}.trec.gz")
+            with gzip.open(p, "wt") as f:
+                f.write(text)
+        else:
+            p = os.path.join(tmp, f"c{fi}.trec")
+            with open(p, "w") as f:
+                f.write(text)
+        paths.append(p)
+    return paths, {d: r for d, r in zip(docids, recs)}
+
+
+def artifact_bytes(idx):
+    """name -> bytes for every non-job artifact. Serving caches are
+    excluded; so is the docstore pair — its ARRIVAL order is
+    path-dependent by design (the native scanner's fallback channel
+    appends non-ASCII records after each chunk's native docs, perm
+    resolves docno -> row), so stores are compared semantically."""
+    out = {}
+    for name in sorted(os.listdir(idx)):
+        p = os.path.join(idx, name)
+        if (os.path.isfile(p) and not name.startswith("serving-")
+                and not name.startswith("docstore")):
+            out[name] = open(p, "rb").read()
+    return out
+
+
+def require_identical(a_dir, b_dir, label):
+    a, b = artifact_bytes(a_dir), artifact_bytes(b_dir)
+    assert set(a) == set(b), (label, sorted(set(a) ^ set(b)))
+    for name in a:
+        assert a[name] == b[name], (label, name)
+    from tpu_ir.index import docstore as ds
+
+    if ds.available(a_dir) or ds.available(b_dir):
+        assert ds.available(a_dir) and ds.available(b_dir), label
+        sa, sb = ds.DocStore(a_dir), ds.DocStore(b_dir)
+        n = len(sa._lengths)
+        assert n == len(sb._lengths), label
+        for dn in range(1, n + 1):
+            assert sa.get_bytes(dn) == sb.get_bytes(dn), (label, dn)
+        sa.close()
+        sb.close()
+
+
+def one_seed(seed: int) -> None:
+    from tpu_ir.compat import CompatIndex
+    from tpu_ir.index import build_index
+    from tpu_ir.index.merge import merge_indexes
+    from tpu_ir.index.streaming import build_index_streaming
+    from tpu_ir.index.verify import verify_index
+    from tpu_ir.search import Scorer
+
+    rng = np.random.default_rng(seed)
+    tmp = tempfile.mkdtemp(prefix=f"fuzz{seed}-")
+    try:
+        paths, docs = make_corpus(rng, tmp)
+        k = 1 if rng.integers(0, 4) else 2
+        positions = bool(rng.integers(0, 2)) and k == 1
+        store = bool(rng.integers(0, 2))
+        shards = int(rng.integers(1, 6))
+        batch = int(rng.integers(1, 8))
+        common = dict(k=k, num_shards=shards, compute_chargrams=False,
+                      positions=positions)
+
+        mem = os.path.join(tmp, "mem")
+        build_index(paths, mem, chargram_ks=[], **common)
+        if store:
+            from tpu_ir.index.docstore import build_docstore
+
+            build_docstore(paths, mem)
+        stream = os.path.join(tmp, "stream")
+        build_index_streaming(paths, stream, batch_docs=batch,
+                              chargram_ks=[], store=store, **common)
+        require_identical(mem, stream, f"seed{seed}:mem-vs-stream")
+
+        # SPMD builds pin shard count = device count (4): compare against
+        # a 4-shard in-memory build (mem itself when shards == 4)
+        # (store coverage lives in the mem-vs-stream pair above; the SPMD
+        # in-memory path has no store writer, so these run storeless)
+        common4 = dict(common, num_shards=4)
+        mem4 = os.path.join(tmp, "mem4")
+        build_index(paths, mem4, chargram_ks=[], **common4)
+        spmd = os.path.join(tmp, "spmd")
+        build_index(paths, spmd, chargram_ks=[], spmd_devices=4, **common4)
+        require_identical(mem4, spmd, f"seed{seed}:mem-vs-spmd")
+
+        sstream = os.path.join(tmp, "sstream")
+        build_index_streaming(paths, sstream, batch_docs=batch,
+                              chargram_ks=[], spmd_devices=4, **common4)
+        require_identical(mem4, sstream, f"seed{seed}:mem-vs-sstream")
+
+        assert verify_index(mem)["ok"], f"seed{seed}: verify"
+
+        # merge split-halves == one-shot (docids disjoint by construction;
+        # skip when a half got only empty files — a valid corpus for the
+        # main builds above, but not a buildable merge source)
+        def half_has_docs(ps):
+            from tpu_ir.collection import read_trec_corpus
+
+            return any(True for _ in read_trec_corpus(ps))
+
+        if (len(docs) >= 2 and len(paths) >= 2
+                and half_has_docs(paths[:1]) and half_has_docs(paths[1:])):
+            ia, ib = os.path.join(tmp, "ia"), os.path.join(tmp, "ib")
+            build_index(paths[:1], ia, chargram_ks=[], **common)
+            build_index(paths[1:], ib, chargram_ks=[], **common)
+            if store:
+                from tpu_ir.index.docstore import build_docstore
+
+                build_docstore(paths[:1], ia)
+                build_docstore(paths[1:], ib)
+            merged = os.path.join(tmp, "merged")
+            merge_indexes([ia, ib], merged, num_shards=shards,
+                          compute_chargrams=False)
+            require_identical(mem, merged, f"seed{seed}:mem-vs-merged")
+
+        # compat-mode queries vs the pure-Python oracle: the engine drops
+        # zero-score docs and sorts by exact score; the oracle keeps them
+        # under the ceil comparator — compare the positive-score doc SETS
+        # and per-doc scores (the established test_compat semantics)
+        if k == 1:
+            oracle = CompatIndex({d: r for d, r in docs.items()}, k=1)
+            s = Scorer.load(mem, compat_int_idf=True)
+            for _ in range(4):
+                q = " ".join(rng.choice(WORDS, int(rng.integers(1, 3))))
+                want = oracle.rank(q)
+                if want is None:
+                    continue
+                got = dict(s.search(q, k=len(docs) + 1))
+                want_pos = {d: ws for d, ws in want if ws > 0}
+                if len(want) < 10:
+                    # untruncated: positive-score doc sets must agree
+                    assert set(got) == set(want_pos), (seed, q)
+                for d, ws in want_pos.items():
+                    # every oracle doc must appear with the exact score
+                    # (the oracle's own top-10 cut may differ from the
+                    # engine's at ceil-comparator near-ties, so only
+                    # subset+score is assertable when truncated)
+                    assert d in got, (seed, q, d)
+                    assert abs(got[d] - ws) < 1e-4 * max(1.0, abs(ws)), (
+                        seed, q, d)
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 25
+    first = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    for seed in range(first, first + n):
+        one_seed(seed)
+        print(f"seed {seed} ok", flush=True)
+    print(f"ALL OK: {n} seeds from {first}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,20 @@
+"""Differential build fuzz, suite-sized slice: 2 seeds of the
+experiments/fuzz_builds.py harness (random corpus -> four build paths
+byte-identical + merge determinism + compat-oracle agreement). The full
+sweep (100 seeds) ran clean in r5 — NOTES.md records it; this keeps the
+harness continuously exercised."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "experiments"))
+
+import pytest
+
+
+@pytest.mark.parametrize("seed", [201, 202])
+def test_fuzz_seed(seed):
+    from fuzz_builds import one_seed
+
+    one_seed(seed)
