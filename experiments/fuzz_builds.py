@@ -207,8 +207,59 @@ def one_seed(seed: int) -> None:
                     assert d in got, (seed, q, d)
                     assert abs(got[d] - ws) < 1e-4 * max(1.0, abs(ws)), (
                         seed, q, d)
+
+        # serving-layout agreement: dense vs tiered-sparse vs sharded
+        # must retrieve the same docs with ~equal scores for TF-IDF,
+        # BM25 and the two-stage rerank, on random queries
+        dense = Scorer.load(mem, layout="dense")
+        sparse = Scorer.load(mem, layout="sparse")
+        sharded = Scorer.load(mem4, layout="sharded")
+        queries = [" ".join(rng.choice(WORDS, int(rng.integers(1, 4))))
+                   for _ in range(3)]
+        for scoring in ("tfidf", "bm25"):
+            r_d = dense.search_batch(queries, scoring=scoring)
+            r_p = sparse.search_batch(queries, scoring=scoring)
+            r_s = sharded.search_batch(queries, scoring=scoring)
+            for q, gd, gp, gs in zip(queries, r_d, r_p, r_s):
+                for other, name in ((gp, "sparse"), (gs, "sharded")):
+                    assert {d for d, _ in gd} == {d for d, _ in other}, (
+                        seed, scoring, name, q)
+                    for (_, s1), (_, s2) in zip(gd, other):
+                        assert abs(s1 - s2) < 1e-3 * max(1.0, abs(s1)), (
+                            seed, scoring, name, q)
+        rr_d = dense.search_batch(queries, rerank=4)
+        rr_p = sparse.search_batch(queries, rerank=4)
+        rr_s = sharded.search_batch(queries, rerank=4)
+        for q, gd, gp, gs in zip(queries, rr_d, rr_p, rr_s):
+            for other, name in ((gp, "sparse"), (gs, "sharded")):
+                assert {d for d, _ in gd} == {d for d, _ in other}, (
+                    seed, "rerank", name, q)
+
+        # phrase matching vs a brute-force text oracle (positions builds)
+        if positions and k == 1:
+            from tpu_ir.analysis import Analyzer
+
+            an = Analyzer()
+            toks_by_doc = {d: an.analyze(r) for d, r in docs.items()}
+            sp = Scorer.load(mem)
+            for _ in range(3):
+                w1, w2 = rng.choice(WORDS, 2)
+                p1, p2 = an.analyze(w1), an.analyze(w2)
+                if len(p1) != 1 or len(p2) != 1:
+                    continue
+                t1, t2 = p1[0], p2[0]
+                want_docs = {d for d, toks in toks_by_doc.items()
+                             if any(a == t1 and b == t2
+                                    for a, b in zip(toks, toks[1:]))}
+                got = sp.search(f'"{w1} {w2}"', k=len(docs) + 1)
+                assert {d for d, _ in got} == want_docs, (
+                    seed, w1, w2, "phrase")
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
+        # every seed has fresh random shapes: without this the process
+        # accumulates hundreds of compiled executables and dies with an
+        # LLVM OOM around seed ~60
+        jax.clear_caches()
 
 
 def main():
