@@ -235,6 +235,49 @@ def one_seed(seed: int) -> None:
                 assert {d for d, _ in gd} == {d for d, _ in other}, (
                     seed, "rerank", name, q)
 
+        # wildcard + fuzzy expansion vs fnmatch / Levenshtein oracles
+        # (chargram builds on a subset of seeds; k=1 so the index vocab
+        # IS the token vocab)
+        if k == 1 and rng.integers(0, 3) == 0:
+            import fnmatch as fn
+
+            from tpu_ir.collection import Vocab
+            from tpu_ir.index import format as fmt
+            from tpu_ir.index.builder import build_chargram_artifacts
+            from tpu_ir.search.wildcard import WildcardLookup
+
+            vocab_terms = Vocab.load(os.path.join(mem, fmt.VOCAB)).terms
+            build_chargram_artifacts(mem, vocab_terms, [2, 3])
+            lookup = WildcardLookup.load(mem, 3)
+            for _ in range(4):
+                w = str(rng.choice(WORDS))
+                cut = int(rng.integers(1, max(len(w), 2)))
+                pat = w[:cut] + "*"
+                if len(pat.replace("*", "")) < 2:
+                    continue  # needs one full gram; lookup rejects
+                want = sorted(t for t in vocab_terms
+                              if fn.fnmatchcase(t, pat))
+                got = sorted(lookup.expand(pat))
+                assert got == want, (seed, pat, got, want)
+
+            def lev(a, b):
+                dp = list(range(len(b) + 1))
+                for i, ca in enumerate(a, 1):
+                    prev, dp[0] = dp[0], i
+                    for j, cb in enumerate(b, 1):
+                        prev, dp[j] = dp[j], min(
+                            dp[j] + 1, dp[j - 1] + 1,
+                            prev + (ca != cb))
+                return dp[-1]
+
+            for _ in range(2):
+                w = str(rng.choice(WORDS))
+                if len(w) < 3:
+                    continue
+                want = sorted(t for t in vocab_terms if lev(w, t) <= 1)
+                got = sorted(t for t, d in lookup.fuzzy(w, max_edits=1))
+                assert got == want, (seed, w, got, want)
+
         # phrase matching vs a brute-force text oracle (positions builds)
         if positions and k == 1:
             from tpu_ir.analysis import Analyzer
