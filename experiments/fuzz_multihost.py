@@ -1,0 +1,122 @@
+"""Differential multi-host fuzz: random corpora through a REAL 2-process
+build (jax.distributed, 2x2 virtual CPU devices) vs the single-process
+streaming build — artifacts must be byte-identical (fuzz_builds.py's
+contract, extended across process boundaries: file slicing, host-side
+allgathers, lockstep pass-2, shared position spills, process-0 store
+assembly all under random corpora and batch sizes).
+
+Usage: python experiments/fuzz_multihost.py [N_SEEDS] [FIRST_SEED]
+"""
+
+import os
+import shutil
+import socket
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=4")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as xb
+
+for _n in list(xb._backend_factories):
+    if _n != "cpu":
+        xb._backend_factories.pop(_n, None)
+
+import numpy as np
+
+WORKER = r"""
+import os, sys
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as xb
+for n in list(xb._backend_factories):
+    if n != "cpu":
+        xb._backend_factories.pop(n, None)
+
+(coordinator, pid, index_dir, batch, k, positions, store,
+ *paths) = sys.argv[1:]
+from tpu_ir.parallel.multihost import init_distributed, build_index_multihost
+
+init_distributed(coordinator, num_processes=2, process_id=int(pid))
+build_index_multihost(list(paths), index_dir, k=int(k),
+                      compute_chargrams=False, batch_docs=int(batch),
+                      positions=positions == "1", store=store == "1")
+print("worker", pid, "ok")
+"""
+
+
+def one_seed(seed: int) -> None:
+    from fuzz_builds import make_corpus, require_identical
+
+    from tpu_ir.index.streaming import build_index_streaming
+    from tpu_ir.index.verify import verify_index
+
+    rng = np.random.default_rng(10_000 + seed)
+    tmp = tempfile.mkdtemp(prefix=f"fuzzmh{seed}-")
+    try:
+        paths, docs = make_corpus(rng, tmp)
+        if not docs:
+            return
+        k = 1 if rng.integers(0, 4) else 2
+        positions = bool(rng.integers(0, 2)) and k == 1
+        store = bool(rng.integers(0, 2))
+        batch = int(rng.integers(1, 6))
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+        script = os.path.join(tmp, "worker.py")
+        with open(script, "w") as f:
+            f.write(WORKER)
+        mh = os.path.join(tmp, "mh")
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = {**os.environ, "PYTHONPATH": root}
+        procs = [
+            subprocess.Popen(
+                [sys.executable, script, f"127.0.0.1:{port}", str(pid),
+                 mh, str(batch), str(k), "1" if positions else "0",
+                 "1" if store else "0", *paths],
+                stdout=subprocess.PIPE, stderr=subprocess.PIPE, env=env,
+                cwd=root, text=True)
+            for pid in range(2)
+        ]
+        errs = []
+        for p in procs:
+            out, err = p.communicate(timeout=300)
+            if p.returncode != 0:
+                errs.append(err[-3000:])
+        assert not errs, (seed, errs)
+
+        # shard count = total device count (2 procs x 2 devices)
+        ref = os.path.join(tmp, "ref")
+        build_index_streaming(paths, ref, k=k, num_shards=4,
+                              batch_docs=batch, compute_chargrams=False,
+                              positions=positions, store=store)
+        require_identical(ref, mh, f"mh-seed{seed}")
+        assert verify_index(mh)["ok"], f"mh-seed{seed}: verify"
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+        jax.clear_caches()
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 10
+    first = int(sys.argv[2]) if len(sys.argv) > 2 else 0
+    for seed in range(first, first + n):
+        one_seed(seed)
+        print(f"mh seed {seed} ok", flush=True)
+    print(f"ALL OK: {n} multihost seeds from {first}")
+
+
+if __name__ == "__main__":
+    main()
