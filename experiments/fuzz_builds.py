@@ -230,7 +230,17 @@ def one_seed(seed: int) -> None:
         rr_d = dense.search_batch(queries, rerank=4)
         rr_p = sparse.search_batch(queries, rerank=4)
         rr_s = sharded.search_batch(queries, rerank=4)
-        for q, gd, gp, gs in zip(queries, rr_d, rr_p, rr_s):
+        # stage-1 boundary check: when the 4th and 5th BM25 scores are an
+        # fp near-tie, the layouts may legitimately pick different
+        # candidate sets (dense einsum and tiered scatter sum the same
+        # postings in different orders — seed 279 found a one-ulp tie),
+        # so the strict rerank doc-set assert only applies to queries
+        # with an unambiguous candidate cut
+        b5 = dense.search_batch(queries, scoring="bm25", k=5)
+        for q, gd, gp, gs, cand in zip(queries, rr_d, rr_p, rr_s, b5):
+            if len(cand) >= 5 and cand[3][1] - cand[4][1] < 1e-4 * max(
+                    1.0, abs(cand[3][1])):
+                continue
             for other, name in ((gp, "sparse"), (gs, "sharded")):
                 assert {d for d, _ in gd} == {d for d, _ in other}, (
                     seed, "rerank", name, q)

@@ -696,3 +696,35 @@ def test_show_matches_requires_positions(tmp_path, capsys):
     assert main(["search", out, "--backend", "cpu", "-q", "salmon",
                  "--show-matches"]) == 1
     assert "position" in capsys.readouterr().err
+
+
+def test_phrase_match_survives_zero_idf(tmp_path):
+    """A phrase whose terms appear in EVERY doc (df == N -> TF-IDF idf 0)
+    must still return its exact matches — the plain path's zero-score
+    drop does not apply to an explicit phrase constraint ("to be or not
+    to be" would otherwise return nothing). Found by the differential
+    fuzz (seed 291)."""
+    from tpu_ir.index import build_index
+    from tpu_ir.search import Scorer
+
+    docs = {
+        "Z-1": "gold quick fish",        # adjacent "gold quick"
+        "Z-2": "quick fish gold",        # both terms, not adjacent
+        "Z-3": "fish gold market quick",  # both terms, not adjacent
+        # (the separator must NOT be a stopword: positions index the
+        # post-analysis stream, so "gold then quick" IS adjacent)
+    }
+    corpus = tmp_path / "c.trec"
+    corpus.write_text("".join(
+        f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n</TEXT>\n</DOC>\n"
+        for d, t in docs.items()))
+    idx = str(tmp_path / "idx")
+    build_index([str(corpus)], idx, chargram_ks=[], num_shards=2,
+                positions=True)
+    s = Scorer.load(idx)
+    got = s.search('"gold quick"')
+    assert [d for d, _ in got] == ["Z-1"]
+    assert got[0][1] == 0.0              # idf 0: matched at score zero
+    # BM25's idf is always positive: same doc, positive score
+    got_bm = s.search('"gold quick"', scoring="bm25")
+    assert [d for d, _ in got_bm] == ["Z-1"] and got_bm[0][1] > 0

@@ -1151,8 +1151,12 @@ class Scorer:
         order = np.lexsort((docnos, -scores))[:k]
         res = SearchResult()
         for i in order:
-            if scores[i] <= 0:
-                continue
+            # unlike the plain path, zero-score docs are KEPT: every doc
+            # here satisfies the user's explicit phrase constraint, and a
+            # query whose terms all have df == N (idf 0 — "to be or not
+            # to be") must still return its exact matches. The lexsort
+            # already ranks them after positive scores, docno ascending
+            # (found by the differential fuzz, seed 291).
             dn = int(docnos[i])
             key = self.mapping.get_docid(dn) if return_docids else dn
             res.append((key, float(scores[i])))
