@@ -222,11 +222,21 @@ def one_seed(seed: int) -> None:
             r_s = sharded.search_batch(queries, scoring=scoring)
             for q, gd, gp, gs in zip(queries, r_d, r_p, r_s):
                 for other, name in ((gp, "sparse"), (gs, "sharded")):
-                    assert {d for d, _ in gd} == {d for d, _ in other}, (
-                        seed, scoring, name, q)
+                    # rank-by-rank scores must agree...
+                    assert len(gd) == len(other), (seed, scoring, name, q)
                     for (_, s1), (_, s2) in zip(gd, other):
                         assert abs(s1 - s2) < 1e-3 * max(1.0, abs(s1)), (
                             seed, scoring, name, q)
+                    # ...but doc sets only ABOVE the k-th score's tie
+                    # band: when several docs tie exactly at the cut, a
+                    # last-ulp accumulation difference between the dense
+                    # einsum and the tiered scatter legitimately flips
+                    # which of them fills the final slots (seed 492)
+                    floor = gd[-1][1] + 1e-3 * max(1.0, abs(gd[-1][1])) \
+                        if gd else 0.0
+                    top_d = {d for d, s in gd if s > floor}
+                    top_o = {d for d, s in other if s > floor}
+                    assert top_d == top_o, (seed, scoring, name, q)
         rr_d = dense.search_batch(queries, rerank=4)
         rr_p = sparse.search_batch(queries, rerank=4)
         rr_s = sharded.search_batch(queries, rerank=4)
