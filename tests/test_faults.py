@@ -1,0 +1,614 @@
+"""Fault-injection acceptance suite (the tentpole contract): every injected
+fault class — failed spill write, corrupt artifact, mid-pass process death,
+all_to_all capacity overflow, slow/hung or device-lost score dispatch — must
+end in either FULL RECOVERY with byte-identical artifacts or ONE structured
+error. Never a hang, a traceback-to-user, or a silently wrong index.
+
+Faults are driven through tpu_ir.faults' deterministic plan (the same
+machinery TPU_IR_FAULTS / --faults exposes), so what these tests prove is
+exactly what an operator can replay."""
+
+import filecmp
+import os
+import time
+
+import numpy as np
+import pytest
+
+import tpu_ir.faults as faults
+import tpu_ir.index.streaming as streaming
+from tpu_ir.index import format as fmt
+from tpu_ir.index.streaming import PASS1_MANIFEST, build_index_streaming
+from tpu_ir.index.verify import verify_index
+from tpu_ir.search import Scorer
+from tpu_ir.utils.report import recovery_counters
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+BUILD_KW = dict(k=1, num_shards=3, batch_docs=25, chargram_ks=[2])
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_state():
+    faults.clear()
+    recovery_counters().reset()
+    yield
+    faults.clear()
+    recovery_counters().reset()
+
+
+def write_corpus(path, n_docs=120):
+    body = []
+    for i in range(n_docs):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + (i % 7)))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    path.write_text("".join(body))
+    return str(path)
+
+
+def artifact_names(d):
+    return sorted(
+        n for n in os.listdir(d)
+        if not n.startswith(".") and n != fmt.JOBS_DIR
+        and not n.startswith("serving-"))
+
+
+def assert_identical(got_dir, want_dir):
+    names = artifact_names(want_dir)
+    assert artifact_names(got_dir) == names
+    for n in names:
+        assert filecmp.cmp(os.path.join(want_dir, n),
+                           os.path.join(got_dir, n), shallow=False), n
+
+
+_REAL_TOKENIZER = streaming.make_chunked_tokenizer
+
+
+def small_chunks(monkeypatch):
+    """Tiny read chunks so the corpus spans several spill batches."""
+    monkeypatch.setattr(
+        streaming, "make_chunked_tokenizer",
+        lambda paths, k=1, **kw: _REAL_TOKENIZER(paths, k=k,
+                                                 chunk_bytes=400, **kw))
+
+
+def forbid_tokenizer(monkeypatch):
+    def boom(*a, **kw):
+        raise AssertionError("resume must not re-tokenize the corpus")
+    monkeypatch.setattr(streaming, "make_chunked_tokenizer", boom)
+
+
+@pytest.fixture(scope="module")
+def ref(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("faults_ref")
+    corpus = write_corpus(tmp / "corpus.trec")
+    ref_dir = str(tmp / "ref")
+    build_index_streaming([corpus], ref_dir, **BUILD_KW)
+    return corpus, ref_dir
+
+
+def _flip_byte(path, offset=None):
+    """In-place single-byte corruption (size-preserving bit rot)."""
+    size = os.path.getsize(path)
+    offset = size // 2 if offset is None else offset
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+# ---------------------------------------------------------------------------
+# fault plan mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_plan_parsing_and_determinism():
+    plan = faults.parse_plan(
+        "spill_write@pairs-:first@2,crash.pass2:once@3,seed=7")
+    assert plan.seed == 7
+    # key matching: only keys containing the match substring count
+    assert plan.should_fire("spill_write", "tokens-00000.npz") is None
+    assert plan.should_fire("spill_write", "pairs-000-00000.npz")
+    assert plan.should_fire("spill_write", "pairs-001-00000.npz")
+    assert plan.should_fire("spill_write", "pairs-002-00000.npz") is None
+    assert plan.should_fire("crash.pass2") is None
+    assert plan.should_fire("crash.pass2") is None
+    assert plan.should_fire("crash.pass2") is not None
+    assert plan.counters() == {"spill_write": 2, "crash.pass2": 1}
+
+    # probabilistic rules replay identically under the same seed
+    seq = [faults.parse_plan("x:p=0.5,seed=3").should_fire("x") is not None
+           for _ in range(20)]
+    seq2 = []
+    p2 = faults.parse_plan("x:p=0.5,seed=3")
+    for _ in range(20):
+        seq2.append(p2.should_fire("x") is not None)
+    assert any(seq2) and not all(seq2)
+    # fresh per-call plans all see the same first draw; one plan's stream
+    # is the deterministic sequence
+    plan_a = faults.parse_plan("x:p=0.5,seed=3")
+    got_a = [plan_a.should_fire("x") is not None for _ in range(20)]
+    assert got_a == seq2
+
+
+def test_plan_parsing_sleep_modifier():
+    p = faults.parse_plan("score.hang:sleep=0.5")
+    spec = p.should_fire("score.hang")
+    assert spec is not None and spec.sleep_s == 0.5 and spec.mode == "always"
+    p2 = faults.parse_plan("score.hang:once@2:sleep=1.5")
+    assert p2.should_fire("score.hang") is None
+    spec2 = p2.should_fire("score.hang")
+    assert spec2 is not None and spec2.sleep_s == 1.5
+    with pytest.raises(ValueError):
+        faults.parse_plan("site:not-a-rule")
+
+
+def test_env_var_installs_plan(monkeypatch):
+    monkeypatch.setenv("TPU_IR_FAULTS", "some_site:once@1")
+    faults.clear()
+    assert faults.should_fire("some_site") is not None
+    assert faults.should_fire("some_site") is None
+    faults.clear()
+
+
+def test_disabled_plan_is_inert():
+    assert faults.active() is None
+    assert faults.should_fire("anything") is None
+
+
+# ---------------------------------------------------------------------------
+# fault class 1: failed spill writes -> supervised retry
+# ---------------------------------------------------------------------------
+
+
+def test_spill_write_failures_retried_to_identical_artifacts(
+        tmp_path, monkeypatch, ref):
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    small_chunks(monkeypatch)
+    # fail the first 2 pair-spill writes AND the first token-spill write:
+    # the supervised retry must absorb all of them
+    faults.install(faults.parse_plan(
+        "spill_write@pairs-:first@2,spill_write@tokens-:first@1"))
+    build_index_streaming([corpus], out, **BUILD_KW)
+    assert recovery_counters().get("retries") == 3
+    assert verify_index(out)["ok"]
+    assert_identical(out, ref_dir)
+
+
+def test_spill_write_exhaustion_is_structured_build_error(
+        tmp_path, monkeypatch, ref):
+    corpus, _ = ref
+    out = str(tmp_path / "idx")
+    small_chunks(monkeypatch)
+    faults.install(faults.parse_plan("spill_write@tokens-:first@99"))
+    with pytest.raises(faults.BuildError) as ei:
+        build_index_streaming([corpus], out, **BUILD_KW)
+    assert ei.value.stage.startswith("write:tokens-")
+    assert ei.value.attempts == faults.SPILL_RETRY.max_attempts
+    assert recovery_counters().get("retry_exhausted") == 1
+
+
+def test_part_write_failures_retried(tmp_path, ref):
+    """Part-file writes ride the same supervised retry as spills
+    (RUNBOOK §7 row 1) — the policy lives inside savez_atomic, so every
+    writer inherits it."""
+    from tpu_ir.index import build_index
+
+    corpus, _ = ref
+    out = str(tmp_path / "idx")
+    faults.install(faults.parse_plan("spill_write@part-:first@2"))
+    build_index([corpus], out, num_shards=3, chargram_ks=[2])
+    assert recovery_counters().get("retries") == 2
+    assert verify_index(out)["ok"]
+
+
+def test_truncated_token_spill_is_structured_then_recovers(
+        tmp_path, monkeypatch, ref):
+    """artifact_truncate corrupts a token spill AFTER its CRC was taken
+    (pre-rename), so the in-run read fails as ONE structured
+    IntegrityError and the restart's manifest check discards the state
+    and re-tokenizes to a byte-identical index."""
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    small_chunks(monkeypatch)
+    faults.install(faults.parse_plan("artifact_truncate@tokens-:once@2"))
+    with pytest.raises(faults.IntegrityError) as ei:
+        build_index_streaming([corpus], out, **BUILD_KW)
+    assert "tokens-00001" in ei.value.path
+    faults.clear()
+    build_index_streaming([corpus], out, **BUILD_KW)
+    assert recovery_counters().get("spill_integrity_discards") >= 1
+    assert verify_index(out)["ok"]
+    assert_identical(out, ref_dir)
+
+
+# ---------------------------------------------------------------------------
+# fault class 2: corrupt artifacts -> quarantine / integrity errors
+# ---------------------------------------------------------------------------
+
+
+def test_corrupt_part_quarantined_and_single_shard_rebuilt(
+        tmp_path, monkeypatch, ref):
+    """A corrupt part file on resume is quarantined and ONLY that shard is
+    rebuilt from its surviving spills — never the whole index."""
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    small_chunks(monkeypatch)
+    # die after pass 3 wrote shards 0 and 1
+    faults.install(faults.parse_plan("crash.pass3:once@2"))
+    with pytest.raises(faults.InjectedCrash):
+        build_index_streaming([corpus], out, **BUILD_KW)
+    faults.clear()
+    assert os.path.exists(os.path.join(out, fmt.part_name(1)))
+
+    # shard 0's part rots on disk (truncation)
+    part0 = os.path.join(out, fmt.part_name(0))
+    with open(part0, "r+b") as f:
+        f.truncate(os.path.getsize(part0) // 2)
+
+    forbid_tokenizer(monkeypatch)
+    real_reduce = streaming.reduce_shard_spills
+    rebuilt = []
+    monkeypatch.setattr(
+        streaming, "reduce_shard_spills",
+        lambda spill, idx, row, *a, **kw: (
+            rebuilt.append(row), real_reduce(spill, idx, row, *a, **kw))[1])
+    build_index_streaming([corpus], out, **BUILD_KW)
+    # shard 0 (corrupt) and shard 2 (never written) rebuilt; shard 1 reused
+    assert rebuilt == [0, 2]
+    assert recovery_counters().get("quarantined") == 1
+    assert os.path.exists(
+        os.path.join(out, fmt.QUARANTINE_DIR, fmt.part_name(0)))
+    assert verify_index(out)["ok"]
+    assert_identical(out, ref_dir)
+
+
+def test_corrupt_part_on_finished_index_is_integrity_error(tmp_path, ref):
+    """After a build certifies its checksums, byte corruption surfaces at
+    Scorer.load as ONE structured IntegrityError naming the file."""
+    corpus, _ = ref
+    out = str(tmp_path / "idx")
+    build_index_streaming([corpus], out, **BUILD_KW)
+    target = os.path.join(out, fmt.part_name(1))
+    _flip_byte(target)
+    with pytest.raises(faults.IntegrityError) as ei:
+        Scorer.load(out)
+    assert ei.value.path == target
+    # `tpu-ir verify` reports the same structured failure
+    with pytest.raises(faults.IntegrityError):
+        verify_index(out)
+
+
+def test_corrupt_side_artifact_is_integrity_error(tmp_path, ref):
+    corpus, _ = ref
+    out = str(tmp_path / "idx")
+    build_index_streaming([corpus], out, **BUILD_KW)
+    _flip_byte(os.path.join(out, fmt.DOCLEN))
+    with pytest.raises(faults.IntegrityError) as ei:
+        Scorer.load(out)
+    assert ei.value.path.endswith(fmt.DOCLEN)
+
+
+def test_corrupt_token_spill_discards_resume(tmp_path, monkeypatch, ref):
+    """A token spill failing its manifest CRC cannot be repaired without
+    re-tokenizing: the whole pass-1 state is discarded and the rebuild
+    still converges to byte-identical artifacts."""
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    small_chunks(monkeypatch)
+    faults.install(faults.parse_plan("crash.pass2:once@2"))
+    with pytest.raises(faults.InjectedCrash):
+        build_index_streaming([corpus], out, **BUILD_KW)
+    faults.clear()
+    _flip_byte(os.path.join(out, "_spill", "tokens-00001.npz"))
+
+    tokenized = {"n": 0}
+    real_tok = streaming.make_chunked_tokenizer
+
+    def counting(*a, **kw):
+        tokenized["n"] += 1
+        return real_tok(*a, **kw)
+
+    monkeypatch.setattr(streaming, "make_chunked_tokenizer", counting)
+    build_index_streaming([corpus], out, **BUILD_KW)
+    assert tokenized["n"] == 1  # resume rejected -> re-tokenized
+    assert recovery_counters().get("spill_integrity_discards") >= 1
+    assert verify_index(out)["ok"]
+    assert_identical(out, ref_dir)
+
+
+def test_corrupt_pair_spill_recomputes_only_that_batch(
+        tmp_path, monkeypatch, ref):
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    small_chunks(monkeypatch)
+    faults.install(faults.parse_plan("crash.pass3:once@1"))
+    with pytest.raises(faults.InjectedCrash):
+        build_index_streaming([corpus], out, **BUILD_KW)
+    faults.clear()
+    spill = os.path.join(out, "_spill")
+    with np.load(os.path.join(spill, PASS1_MANIFEST)) as z:
+        n_batches = int(z["n_batches"])
+    assert n_batches >= 3
+    _flip_byte(os.path.join(spill, "pairs-001-00001.npz"))
+
+    forbid_tokenizer(monkeypatch)
+    real_postings = streaming.build_postings_packed_jit
+    recomputed = {"n": 0}
+    monkeypatch.setattr(
+        streaming, "build_postings_packed_jit",
+        lambda *a, **kw: (recomputed.__setitem__("n", recomputed["n"] + 1),
+                          real_postings(*a, **kw))[1])
+    build_index_streaming([corpus], out, **BUILD_KW)
+    assert recomputed["n"] == 1  # only the corrupt batch re-ran
+    assert recovery_counters().get("spill_integrity_discards") == 1
+    assert verify_index(out)["ok"]
+    assert_identical(out, ref_dir)
+
+
+def test_corrupt_manifest_rejected_full_rebuild(tmp_path, monkeypatch, ref):
+    """Garbage where pass1.npz should be must be rejected (fresh build),
+    never trusted or tracebacked."""
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    small_chunks(monkeypatch)
+    faults.install(faults.parse_plan("crash.pass2:once@2"))
+    with pytest.raises(faults.InjectedCrash):
+        build_index_streaming([corpus], out, **BUILD_KW)
+    faults.clear()
+    manifest = os.path.join(out, "_spill", PASS1_MANIFEST)
+    with open(manifest, "wb") as f:
+        f.write(b"this is not an npz file at all")
+    build_index_streaming([corpus], out, **BUILD_KW)
+    assert verify_index(out)["ok"]
+    assert_identical(out, ref_dir)
+
+
+# ---------------------------------------------------------------------------
+# fault class 3: mid-pass process death -> resume to identical artifacts
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("site,rule", [
+    ("crash.pass1", "once@2"),
+    ("crash.pass2", "once@2"),
+    ("crash.pass3", "once@2"),
+])
+def test_mid_pass_death_recovers_byte_identical(tmp_path, monkeypatch, ref,
+                                                site, rule):
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    small_chunks(monkeypatch)
+    faults.install(faults.parse_plan(f"{site}:{rule}"))
+    with pytest.raises(faults.InjectedCrash):
+        build_index_streaming([corpus], out, **BUILD_KW)
+    faults.clear()
+    if site != "crash.pass1":
+        # pass-1 completed before the death: the restart must not
+        # re-tokenize (a pass-1 death dies before the manifest, so a
+        # fresh tokenize IS the correct recovery there)
+        forbid_tokenizer(monkeypatch)
+    else:
+        small_chunks(monkeypatch)
+    build_index_streaming([corpus], out, **BUILD_KW)
+    assert verify_index(out)["ok"]
+    assert_identical(out, ref_dir)
+
+
+def test_injected_crash_is_not_swallowed_by_retry():
+    """InjectedCrash must behave like a real SIGKILL: the retry supervisor
+    (and any `except Exception` recovery code) cannot absorb it."""
+    def dies():
+        raise faults.InjectedCrash("boom")
+    with pytest.raises(faults.InjectedCrash):
+        faults.run_with_retry(dies, stage="x",
+                              retry_on=(OSError, RuntimeError))
+    assert not isinstance(faults.InjectedCrash("x"), Exception)
+
+
+# ---------------------------------------------------------------------------
+# fault class 4: all_to_all capacity overflow -> policy retry / BuildError
+# ---------------------------------------------------------------------------
+
+
+def _synth_occurrences(n_tok=4000, n_docs=64, vocab=300, seed=0):
+    rng = np.random.default_rng(seed)
+    flat_term = rng.integers(0, vocab, n_tok).astype(np.int32)
+    flat_doc = rng.integers(1, n_docs + 1, n_tok).astype(np.int32)
+    docnos = np.arange(1, n_docs + 1, dtype=np.int32)
+    return flat_term, flat_doc, docnos, vocab, n_docs
+
+
+def test_overflow_retry_recovers():
+    from tpu_ir.parallel import make_mesh, sharded_build_postings
+    from tpu_ir.parallel.sharded_build import deal_occurrences
+
+    ft, fd, docnos, vocab, ndocs = _synth_occurrences()
+    t, d, dps = deal_occurrences(ft, fd, docnos, 8)
+    faults.install(faults.parse_plan("shuffle_overflow:first@2"))
+    out = sharded_build_postings(t, d, dps, vocab_size=vocab,
+                                 total_docs=ndocs, mesh=make_mesh(8))
+    faults.clear()
+    assert recovery_counters().get("overflow_retries") == 2
+    # the psum'd doc counter still reports the real corpus
+    assert int(np.asarray(out.num_docs)[0]) == ndocs
+    # and the recovered result matches a fault-free dispatch
+    clean = sharded_build_postings(t, d, dps, vocab_size=vocab,
+                                   total_docs=ndocs, mesh=make_mesh(8))
+    np.testing.assert_array_equal(np.asarray(out.df), np.asarray(clean.df))
+
+
+def test_overflow_exhaustion_is_structured_build_error():
+    from tpu_ir.parallel import make_mesh, sharded_build_postings
+    from tpu_ir.parallel.sharded_build import deal_occurrences
+
+    ft, fd, docnos, vocab, ndocs = _synth_occurrences()
+    t, d, dps = deal_occurrences(ft, fd, docnos, 8)
+    faults.install(faults.parse_plan("shuffle_overflow:always"))
+    with pytest.raises(faults.BuildError) as ei:
+        sharded_build_postings(t, d, dps, vocab_size=vocab,
+                               total_docs=ndocs, mesh=make_mesh(8))
+    assert ei.value.stage == "all_to_all_shuffle"
+    assert "overflow" in str(ei.value)
+
+
+# ---------------------------------------------------------------------------
+# fault class 5: slow/hung or device-lost dispatch -> degraded serving
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def served(ref):
+    corpus, ref_dir = ref
+    return Scorer.load(ref_dir)
+
+
+def test_hung_dispatch_degrades_within_deadline(served):
+    s = served
+    faults.install(faults.FaultPlan().add("score.hang", "always",
+                                          sleep_s=5.0))
+    s.deadline_s = 0.25
+    try:
+        q = s.analyze_queries(["salmon fishing", "stock market"])
+        t0 = time.perf_counter()
+        scores, docnos = s.topk(q, k=5, scoring="bm25")
+        elapsed = time.perf_counter() - t0
+    finally:
+        s.deadline_s = None
+        faults.clear()
+    assert elapsed < 3.0, "deadline did not bound the hung dispatch"
+    assert s.degraded_last
+    assert recovery_counters().get("deadline_expired") == 1
+    assert recovery_counters().get("degraded_batches") == 1
+    assert (docnos[0] > 0).any() and (docnos[1] > 0).any()
+    # degraded results are real rankings: same docs as the primary path
+    ps, pd = s.topk(q, k=5, scoring="bm25")
+    assert not s.degraded_last
+    np.testing.assert_array_equal(docnos, pd)
+    np.testing.assert_allclose(scores, ps, rtol=1e-4)
+
+
+def test_device_loss_degrades_and_tags_results(served):
+    s = served
+    faults.install(faults.parse_plan("score.device_loss:once@1"))
+    try:
+        res = s.search_batch(["salmon fishing"], k=5, scoring="tfidf")
+    finally:
+        faults.clear()
+    assert res[0].degraded
+    assert len(res[0]) > 0
+    assert recovery_counters().get("device_loss") == 1
+    # next batch is healthy again and tagged accordingly
+    res2 = s.search_batch(["salmon fishing"], k=5, scoring="tfidf")
+    assert not res2[0].degraded
+    assert [k for k, _ in res2[0]] == [k for k, _ in res[0]]
+
+
+def test_rerank_degrades_to_host_bm25(served):
+    s = served
+    faults.install(faults.parse_plan("score.device_loss:once@1"))
+    try:
+        scores, docnos = s.rerank_topk(
+            s.analyze_queries(["salmon fishing"]), k=5, candidates=50)
+    finally:
+        faults.clear()
+    assert s.degraded_last
+    assert (docnos > 0).any()
+    assert recovery_counters().get("degraded_batches") == 1
+
+
+def test_deadline_fails_fast_once_abandoned_cap_hit():
+    """A permanently hung device must not grow one blocked thread per
+    query: past _ABANDONED_CAP live abandoned dispatches, deadlined calls
+    fail fast without spawning or waiting."""
+    import threading
+
+    ev = threading.Event()
+    try:
+        for _ in range(faults._ABANDONED_CAP):
+            with pytest.raises(faults.ScoreDeadlineExceeded):
+                faults.run_with_deadline(lambda: ev.wait(30), 0.05)
+        t0 = time.perf_counter()
+        with pytest.raises(faults.ScoreDeadlineExceeded):
+            faults.run_with_deadline(lambda: ev.wait(30), 10.0)
+        assert time.perf_counter() - t0 < 1.0, "did not fail fast"
+    finally:
+        ev.set()
+        for t in faults._abandoned:
+            t.join(5)
+        faults._abandoned.clear()
+
+
+def test_cache_fast_path_lazy_pairs_verified(tmp_path, ref):
+    """The serving-cache fast path defers the shard read; when something
+    later needs the CSR columns, the parts are checksum-verified first —
+    rot since cache time surfaces as IntegrityError, not a zip traceback."""
+    corpus, _ = ref
+    out = str(tmp_path / "idx")
+    build_index_streaming([corpus], out, **BUILD_KW)
+    Scorer.load(out, layout="sparse")       # builds + persists the cache
+    s = Scorer.load(out, layout="sparse")   # cache hit: no shard read yet
+    assert s._pairs_cols is None
+    _flip_byte(os.path.join(out, fmt.part_name(0)))
+    with pytest.raises(faults.IntegrityError):
+        s._pairs
+
+
+def test_no_deadline_no_plan_takes_primary_path(served):
+    s = served
+    q = s.analyze_queries(["salmon fishing"])
+    scores, docnos = s.topk(q, k=5)
+    assert not s.degraded_last
+    assert (docnos > 0).any()
+
+
+# ---------------------------------------------------------------------------
+# end-to-end: the CLI surfaces structured errors, never tracebacks
+# ---------------------------------------------------------------------------
+
+
+def test_cli_surfaces_integrity_error_cleanly(tmp_path, ref, capsys):
+    from tpu_ir.cli import main
+
+    corpus, _ = ref
+    out = str(tmp_path / "idx")
+    build_index_streaming([corpus], out, **BUILD_KW)
+    _flip_byte(os.path.join(out, fmt.part_name(0)))
+    rc = main(["verify", out])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "integrity" in err.lower()
+    assert fmt.part_name(0) in err
+
+
+def test_cli_faults_flag_surfaces_build_error(tmp_path, ref, capsys):
+    """--faults installs the plan and retry exhaustion reaches the user as
+    ONE clean structured error line, not a traceback."""
+    from tpu_ir.cli import main
+
+    corpus, _ = ref
+    out = str(tmp_path / "idx")
+    rc = main(["index", corpus, out, "--streaming", "--shards", "2",
+               "--no-chargrams", "--faults",
+               "spill_write@tokens-:first@99"])
+    assert rc == 1
+    err = capsys.readouterr().err
+    assert "error: build stage" in err and "write:tokens-" in err
+
+
+def test_inspect_reports_corrupt_artifact_cleanly(tmp_path, ref):
+    from tpu_ir.index.artifacts import inspect_path
+
+    corpus, _ = ref
+    out = str(tmp_path / "idx")
+    build_index_streaming([corpus], out, **BUILD_KW)
+    part = os.path.join(out, fmt.part_name(0))
+    with open(part, "r+b") as f:
+        f.truncate(os.path.getsize(part) // 2)
+    lines = list(inspect_path(part))
+    assert any("CORRUPT" in ln for ln in lines)

@@ -14,6 +14,7 @@ import shutil
 import socket
 import subprocess
 import sys
+import time
 
 import numpy as np
 
@@ -47,6 +48,8 @@ coordinator, pid, corpus_dir, index_dir = (
     sys.argv[1], int(sys.argv[2]), sys.argv[3], sys.argv[4])
 crash_step = int(os.environ.get("TEST_CRASH_STEP", "0"))
 crash_pid = int(os.environ.get("TEST_CRASH_PID", "-1"))
+kill_step = int(os.environ.get("TEST_SIGKILL_STEP", "0"))
+kill_pid = int(os.environ.get("TEST_SIGKILL_PID", "-1"))
 forbid_tok = os.environ.get("TEST_FORBID_TOKENIZE", "").split(",")
 
 import tpu_ir.parallel.sharded_build as sb
@@ -59,6 +62,11 @@ def counting(*a, **kw):
     steps["n"] += 1
     if pid == crash_pid and crash_step and steps["n"] == crash_step:
         raise RuntimeError("injected pass-2 crash")
+    if pid == kill_pid and kill_step and steps["n"] == kill_step:
+        # a REAL kill: no unwinding, no atexit, no finally blocks — the
+        # closest in-process stand-in for a preempted/OOM-killed host
+        import signal
+        os.kill(os.getpid(), signal.SIGKILL)
     return real_build(*a, **kw)
 
 sb.sharded_build_postings = counting
@@ -115,7 +123,7 @@ def spill_batches(index_dir, pid):
 
 
 def run_workers(tmp_path, corpus_dir, index_dir, *, env_extra,
-                expect_fail_pid=None, timeout=240):
+                expect_fail_pid=None, expect_signal=None, timeout=240):
     """Launch 2 worker processes; returns {pid: parsed stdout JSON} for
     the ones expected to succeed. When `expect_fail_pid` is set, that
     worker must exit nonzero and its partner (blocked in the next
@@ -138,9 +146,19 @@ def run_workers(tmp_path, corpus_dir, index_dir, *, env_extra,
     if expect_fail_pid is not None:
         crashed = procs[expect_fail_pid]
         _, err = crashed.communicate(timeout=timeout)
-        assert crashed.returncode == 17, err[-2000:]
-        assert "injected pass-" in err
+        if expect_signal is not None:
+            assert crashed.returncode == -expect_signal, \
+                (crashed.returncode, err[-2000:])
+        else:
+            assert crashed.returncode == 17, err[-2000:]
+            assert "injected pass-" in err
         other = procs[1 - expect_fail_pid]
+        # grace period before killing the partner: it may still be
+        # draining its current batch's spill writes before it blocks in
+        # the next collective — killing it mid-write would race the
+        # "batch 0 complete on both processes" fixture state the resume
+        # assertions depend on
+        time.sleep(3)
         other.kill()  # partner is lockstep-blocked in a collective
         other.communicate(timeout=timeout)
         return out
@@ -267,6 +285,87 @@ def test_multihost_lost_spills_forces_clean_pass2(tmp_path):
 
     # restart: only process 0 may skip tokenizing; NO batch skips (the
     # agreement fails), so all lockstep device steps run on both
+    out = run_workers(tmp_path, corpus_dir, index_dir,
+                      env_extra={"TEST_FORBID_TOKENIZE": "0"})
+    assert out[0]["steps"] == 3 and out[1]["steps"] == 3, out
+    assert_identical_to_reference(index_dir,
+                                  build_reference(tmp_path, corpus_dir))
+
+
+def test_multihost_sigkill_and_resume(tmp_path):
+    """KILL-and-resume (not exception-and-resume): process 1 takes a real
+    SIGKILL mid-pass-2 — no unwinding, no atexit, exactly a preempted or
+    OOM-killed host. The restart must not re-tokenize on either process,
+    must skip the globally-complete batches, and must converge to
+    artifacts byte-identical to the single-process streaming build."""
+    corpus_dir = write_corpus(tmp_path)
+    index_dir = str(tmp_path / "mh_index")
+
+    run_workers(tmp_path, corpus_dir, index_dir,
+                env_extra={"TEST_SIGKILL_STEP": "2", "TEST_SIGKILL_PID": "1"},
+                expect_fail_pid=1, expect_signal=9)
+    # the kill landed before process 1's b=1 device step: its batch-0
+    # spills exist (atomic), nothing later does
+    n1, done1 = spill_batches(index_dir, 1)
+    assert n1 == 3 and done1 == [0], (n1, done1)
+
+    out = run_workers(tmp_path, corpus_dir, index_dir,
+                      env_extra={"TEST_FORBID_TOKENIZE": "0,1"})
+    assert out[0]["num_docs"] == len(DOCS)
+    # at least batch 0 was globally complete, so fewer than all 3 steps ran
+    assert out[0]["steps"] == out[1]["steps"] < 3, out
+    assert_identical_to_reference(index_dir,
+                                  build_reference(tmp_path, corpus_dir))
+    assert not [n for n in os.listdir(index_dir) if n.startswith("_spill")]
+
+
+def test_multihost_corrupt_pair_spill_recomputes_batch(tmp_path):
+    """A corrupt pair spill on one process flips that BATCH to not-done in
+    the done-flag allgather, so every process recomputes it in lockstep —
+    no raw BadZipFile, no whole-build restart."""
+    corpus_dir = write_corpus(tmp_path)
+    index_dir = str(tmp_path / "mh_index")
+
+    run_workers(tmp_path, corpus_dir, index_dir,
+                env_extra={"TEST_CRASH_STEP": "3", "TEST_CRASH_PID": "1"},
+                expect_fail_pid=1)
+    # batches 0 and 1 completed on process 1 before the crash
+    n1, done1 = spill_batches(index_dir, 1)
+    assert 0 in done1 and 1 in done1, done1
+    # batch 0's spill for one of process 1's rows rots on disk
+    victim = os.path.join(index_dir, "_spill-p001", "pairs-002-00000.npz")
+    with open(victim, "r+b") as f:
+        f.truncate(os.path.getsize(victim) // 2)
+
+    # restart: no re-tokenize anywhere; batch 0 must RE-RUN (its corrupt
+    # spill invalidated it globally) while batch 1 still skips
+    out = run_workers(tmp_path, corpus_dir, index_dir,
+                      env_extra={"TEST_FORBID_TOKENIZE": "0,1"})
+    assert out[0]["steps"] == out[1]["steps"] == 2, out
+    assert_identical_to_reference(index_dir,
+                                  build_reference(tmp_path, corpus_dir))
+
+
+def test_multihost_corrupt_manifest_rejected(tmp_path):
+    """Garbage where one process's pass-1 manifest should be must be
+    REJECTED: that process re-tokenizes its slice, the agreement
+    allgather invalidates everyone's pass-2 state (global ids may have
+    shifted), and the rebuild still converges byte-identically — never a
+    traceback, never a trusted-garbage index."""
+    corpus_dir = write_corpus(tmp_path)
+    index_dir = str(tmp_path / "mh_index")
+
+    run_workers(tmp_path, corpus_dir, index_dir,
+                env_extra={"TEST_CRASH_STEP": "2", "TEST_CRASH_PID": "1"},
+                expect_fail_pid=1)
+    manifest = os.path.join(index_dir, "_spill-p001", "pass1.npz")
+    assert os.path.exists(manifest)
+    with open(manifest, "wb") as f:
+        f.write(b"definitely not an npz manifest")
+
+    # process 0's manifest is intact: it must NOT re-tokenize; process 1
+    # must (its pass-1 state is gone). No pass-2 batch may be skipped —
+    # a fresh pass-1 anywhere voids the global agreement.
     out = run_workers(tmp_path, corpus_dir, index_dir,
                       env_extra={"TEST_FORBID_TOKENIZE": "0"})
     assert out[0]["steps"] == 3 and out[1]["steps"] == 3, out

@@ -1,0 +1,64 @@
+"""shrink_rows_for_fetch padding contract (ADVICE r5): with per-row valid
+counts, slots past each row's prefix are ZEROED on device before the
+narrowing cast — padding sentinels (PAD_TERM, far outside uint16) must
+never wrap into the narrow dtype where a buggy caller could read them as
+plausible values."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from tpu_ir.ops import PAD_TERM
+from tpu_ir.utils.transfer import narrow_uint, shrink_rows_for_fetch
+
+
+def _padded_rows():
+    # 3 shards, capacity 8: valid prefixes 3/5/0, padding = PAD_TERM
+    a = np.full((3, 8), PAD_TERM, np.int32)
+    a[0, :3] = [7, 8, 9]
+    a[1, :5] = [1, 2, 3, 4, 5]
+    valid = np.array([3, 5, 0], np.int32)
+    return a, valid
+
+
+def test_valid_rows_zeroes_padding_before_narrow_cast():
+    a, valid = _padded_rows()
+    out = np.asarray(shrink_rows_for_fetch(
+        jnp.asarray(a), 5, dtype=np.uint16, granule=4,
+        valid_rows=jnp.asarray(valid)))
+    assert out.dtype == np.uint16
+    assert out.shape[1] >= 5
+    np.testing.assert_array_equal(out[0, :3], [7, 8, 9])
+    np.testing.assert_array_equal(out[1, :5], [1, 2, 3, 4, 5])
+    # the contract: everything past each row's valid prefix reads 0,
+    # not a wrapped PAD_TERM
+    assert (out[0, 3:] == 0).all()
+    assert (out[2] == 0).all()
+
+
+def test_legacy_contract_unchanged_without_valid_rows():
+    """Without valid counts the old behavior holds: padding wraps under
+    the cast and callers must slice each row to its prefix."""
+    a, _ = _padded_rows()
+    out = np.asarray(shrink_rows_for_fetch(
+        jnp.asarray(a), 5, dtype=np.uint16, granule=4))
+    assert out.dtype == np.uint16
+    np.testing.assert_array_equal(out[1, :5], [1, 2, 3, 4, 5])
+    # wrapped sentinel — precisely the hazard valid_rows removes
+    assert out[2, 0] == (PAD_TERM & 0xFFFF)
+
+
+def test_valid_rows_zeroing_applies_even_without_narrowing():
+    """When no slice/cast is needed the masked path still zeroes padding,
+    so the caller-visible guarantee does not depend on the dtype."""
+    a, valid = _padded_rows()
+    out = np.asarray(shrink_rows_for_fetch(
+        jnp.asarray(a), 8, dtype=np.int32, granule=8,
+        valid_rows=jnp.asarray(valid)))
+    assert out.dtype == np.int32
+    assert (out[0, 3:] == 0).all()
+    np.testing.assert_array_equal(out[0, :3], [7, 8, 9])
+
+
+def test_narrow_uint():
+    assert narrow_uint(65535) == np.uint16
+    assert narrow_uint(65536) == np.int32

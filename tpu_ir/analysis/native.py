@@ -422,13 +422,22 @@ class NativeChunkedTokenizer:
 
 class PyChunkedTokenizer:
     """Pure-Python fallback with the NativeChunkedTokenizer interface;
-    also the k>1 path (k-gram composition happens on analyzed tokens)."""
+    also the k>1 path (k-gram composition happens on analyzed tokens).
+
+    Delta granularity MATCHES the native scanner's: one delta per
+    ~chunk_bytes of record text, never spanning an input path. The
+    streaming builders' crash-resume batches spills per delta, so the
+    fallback must chunk the same way or a library-less host silently
+    loses the multi-batch resume granularity (and every resume test with
+    small chunk_bytes along with it)."""
 
     def __init__(self, paths, k: int = 1, batch_docs: int = 5_000,
-                 with_text: bool = False):
-        self._paths = paths
+                 with_text: bool = False, chunk_bytes: int = 8 << 20):
+        self._paths = ([paths] if isinstance(paths, (str, bytes))
+                       else list(paths))
         self._k = k
         self._batch = batch_docs
+        self._chunk_bytes = chunk_bytes
         self._an = make_analyzer()
         self._vocab: dict[str, int] = {}
         self._with_text = with_text
@@ -441,25 +450,33 @@ class PyChunkedTokenizer:
         return tid
 
     def deltas(self):
-        import numpy as np
-
         from ..collection import kgram_terms, read_trec_corpus
 
         docids, flat, lens, texts = [], [], [], []
-        for doc in read_trec_corpus(self._paths):
-            toks = self._an.analyze(doc.content)
-            grams = kgram_terms(toks, self._k) if self._k > 1 else toks
-            docids.append(doc.docid)
-            flat.extend(self._intern(g) for g in grams)
-            lens.append(len(grams))
-            if self._with_text:
-                texts.append(doc.content.encode("utf-8"))
-            if len(docids) >= self._batch:
-                yield _delta_batch(self._with_text, docids, flat, lens,
-                                   texts)
-                docids, flat, lens, texts = [], [], [], []
-        if docids:
-            yield _delta_batch(self._with_text, docids, flat, lens, texts)
+        acc_bytes = 0
+
+        def drain():
+            nonlocal docids, flat, lens, texts, acc_bytes
+            out = _delta_batch(self._with_text, docids, flat, lens, texts)
+            docids, flat, lens, texts = [], [], [], []
+            acc_bytes = 0
+            return out
+
+        for path in self._paths:
+            for doc in read_trec_corpus([path]):
+                toks = self._an.analyze(doc.content)
+                grams = kgram_terms(toks, self._k) if self._k > 1 else toks
+                docids.append(doc.docid)
+                flat.extend(self._intern(g) for g in grams)
+                lens.append(len(grams))
+                acc_bytes += len(doc.content)
+                if self._with_text:
+                    texts.append(doc.content.encode("utf-8"))
+                if (len(docids) >= self._batch
+                        or acc_bytes >= self._chunk_bytes):
+                    yield drain()
+            if docids:  # file boundary, like the native per-file scan
+                yield drain()
 
     def vocab(self) -> list[str]:
         return list(self._vocab)
@@ -481,7 +498,8 @@ def make_chunked_tokenizer(paths, k: int = 1, chunk_bytes: int = 8 << 20,
             # library unavailable only — real I/O errors (missing corpus
             # file etc.) propagate instead of masquerading as a fallback
             pass
-    return PyChunkedTokenizer(paths, k=k, with_text=with_text)
+    return PyChunkedTokenizer(paths, k=k, with_text=with_text,
+                              chunk_bytes=chunk_bytes)
 
 
 def make_analyzer(native: bool = True):

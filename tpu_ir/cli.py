@@ -25,9 +25,30 @@ def _add_backend_arg(p: argparse.ArgumentParser) -> None:
     p.add_argument(
         "--profile", metavar="DIR", default=None,
         help="write a jax.profiler trace (view with tensorboard/xprof)")
+    p.add_argument(
+        "--faults", metavar="SPEC", default=None,
+        help="install a deterministic fault-injection plan (tpu_ir.faults "
+             "spec grammar, e.g. 'spill_write@pairs-:first@2'); equivalent "
+             "to the TPU_IR_FAULTS env var")
+
+
+# PJRT factory names known to front TPU hardware; anything else must
+# additionally LOOK like a TPU (platform/device_kind) to be accepted
+_TPU_PLUGIN_NAMES = ("tpu", "axon")
+
+
+def _devices_look_tpu(devices) -> bool:
+    d = devices[0]
+    plat = (getattr(d, "platform", "") or "").lower()
+    kind = (getattr(d, "device_kind", "") or "").lower()
+    return "tpu" in plat or "tpu" in kind
 
 
 def _apply_backend(args) -> None:
+    if getattr(args, "faults", None):
+        from . import faults
+
+        faults.install(faults.parse_plan(args.faults))
     if args.backend == "auto":
         return
     # hard-pin: the environment may pre-set JAX_PLATFORMS (and a PJRT plugin
@@ -52,26 +73,38 @@ def _apply_backend(args) -> None:
     # the chip may ride a plugin name (e.g. "axon"), and a registered
     # "tpu" factory can still fail to initialize (libtpu present, no
     # local device — jax raises even when the platform list has more
-    # entries). Probe the TPU-like names in order, canonical "tpu"
-    # first, and keep the first that initializes. Never fall back to an
-    # arbitrary non-cpu factory (cuda/rocm): silently running on
-    # hardware the explicit --backend tpu was meant to rule out would
-    # mask the misconfiguration.
-    tpu_like = sorted((n for n in xb._backend_factories
-                       if n not in ("cpu", "cuda", "gpu", "rocm",
-                                    "metal")),
-                      key=lambda n: n != "tpu")
+    # entries). Probe ALLOWLISTED TPU plugin names in order, canonical
+    # "tpu" first. Other registered factories are probed last and
+    # accepted only when their devices actually identify as TPUs
+    # (platform/device_kind) — an unknown non-TPU plugin must be
+    # REJECTED, not silently adopted as "the TPU" (ADVICE r5: the old
+    # denylist accepted any future platform name it had never heard of,
+    # the exact misconfiguration-masking this flag exists to prevent).
+    allow = sorted((n for n in xb._backend_factories
+                    if n in _TPU_PLUGIN_NAMES),
+                   key=lambda n: n != "tpu")
+    others = sorted(n for n in xb._backend_factories
+                    if n not in _TPU_PLUGIN_NAMES
+                    and n not in ("cpu", "cuda", "gpu", "rocm", "metal"))
     last_err: Exception | None = None
-    for cand in tpu_like:
+    rejected: list[str] = []
+    for cand in allow + others:
         pin(cand)
         try:
-            jax.devices()
-            return
+            devices = jax.devices()
         except RuntimeError as e:
             last_err = e
+            continue
+        if cand in _TPU_PLUGIN_NAMES or _devices_look_tpu(devices):
+            return
+        rejected.append(
+            f"{cand} (devices identify as "
+            f"{getattr(devices[0], 'platform', '?')}/"
+            f"{getattr(devices[0], 'device_kind', '?')}, not TPU)")
     raise ValueError(
         "--backend tpu: no TPU backend initialized (tried "
-        f"{tpu_like or 'no TPU-like factories'}; available: "
+        f"{(allow + others) or 'no TPU-like factories'}; "
+        f"rejected: {rejected or 'none'}; available: "
         f"{sorted(xb._backend_factories)}; last error: {last_err})")
 
 
@@ -104,6 +137,16 @@ def cmd_index(args) -> int:
 
 
 def _run_index(args) -> int:
+    # validate the user-supplied corpus paths up front: a missing corpus
+    # is a usage error with a clean message, while a FileNotFoundError
+    # raised DEEPER in the build (a temp/spill file that should exist)
+    # is a real defect and must traceback, not masquerade as usage
+    # (ADVICE r5 — cmd_index is deliberately not in _ARTIFACT_ENTRY_CMDS)
+    missing = [p for p in args.corpus if not os.path.exists(p)]
+    if missing:
+        print(f"error: corpus path(s) not found: {', '.join(missing)}",
+              file=sys.stderr)
+        return 1
     if args.streaming:
         from .index.streaming import build_index_streaming
 
@@ -509,6 +552,15 @@ def cmd_expand(args) -> int:
     return 0
 
 
+# commands whose whole job is LOADING artifacts the user named: only for
+# these does a FileNotFoundError mean "you pointed me at the wrong thing"
+# (clean message); everywhere else it keeps its traceback
+_ARTIFACT_ENTRY_CMDS = frozenset({
+    "cmd_search", "cmd_inspect", "cmd_verify", "cmd_warm", "cmd_docno",
+    "cmd_expand", "cmd_eval", "cmd_count", "cmd_pack", "cmd_merge",
+})
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(prog="tpu-ir")
     sub = p.add_subparsers(dest="cmd", required=True)
@@ -674,18 +726,28 @@ def main(argv: list[str] | None = None) -> int:
     pe.set_defaults(fn=cmd_expand)
 
     args = p.parse_args(argv)
+    from .faults import BuildError, IntegrityError
+
     try:
         return args.fn(args)
-    except ValueError as e:
+    except (ValueError, BuildError, IntegrityError) as e:
         # user-facing capability/usage errors (unknown layout, phrase query
-        # on a v1 index, ...) print a clean message, not a traceback
+        # on a v1 index, ...) and the fault layer's structured failures
+        # (retry exhaustion, corrupt artifact) print a clean one-line
+        # message, not a traceback
         print(f"error: {e}", file=sys.stderr)
         return 1
     except FileNotFoundError as e:
-        # a missing artifact is a usage error too (expand on a
-        # --no-chargrams index, search on a non-index dir) — same clean
-        # message contract as ValueError
-        print(f"error: missing artifact: {e.filename or e}",
+        # a missing artifact is a usage error ONLY for commands whose job
+        # is loading artifacts the user named (expand on a --no-chargrams
+        # index, search on a non-index dir). Builder-side commands are NOT
+        # covered: there a FileNotFoundError means a bug (e.g. a temp file
+        # that should exist) and must keep its traceback (ADVICE r5).
+        if getattr(args.fn, "__name__", "") not in _ARTIFACT_ENTRY_CMDS:
+            raise
+        path = e.filename if e.filename else str(e)
+        print(f"error: missing artifact: {path} (if this path is not an "
+              "artifact you asked for, it is a bug — please report)",
               file=sys.stderr)
         return 1
     except BrokenPipeError:

@@ -1,0 +1,394 @@
+"""Deterministic fault injection, supervised retry, and structured errors.
+
+The reference engine inherited its fault story from Hadoop for free: failed
+tasks re-run, stragglers run speculatively, finished outputs are skipped on
+restart (SURVEY §5, BuildIntDocVectorsForwardIndex.java:186-194). tpu-ir
+rebuilt the resume-by-artifact half (index/streaming.py) but had no way to
+PROVE any failure actually recovers. This module is that proof machinery plus
+the recovery primitives themselves:
+
+- **FaultPlan**: a process-wide, seeded, deterministic plan mapping named
+  injection *sites* (threaded through the build and serve paths at file /
+  batch granularity, never inner loops) to firing rules. Configured
+  programmatically, or from the `TPU_IR_FAULTS` env var / `--faults` CLI
+  flag. With no plan installed every site is one `is None` check — zero
+  overhead on the production path.
+- **RetryPolicy / run_with_retry**: supervised retry with attempt caps and
+  jittered exponential backoff (deterministically seeded), raising a
+  structured `BuildError` on exhaustion — the policy object that replaces
+  ad-hoc retry loops (e.g. the all_to_all capacity doubling in
+  parallel/sharded_build.py).
+- **Structured errors**: `BuildError` (retry exhaustion), `IntegrityError`
+  (checksum mismatch / corrupt artifact), `DeviceLoss` and
+  `ScoreDeadlineExceeded` (the degraded-serving triggers), `InjectedCrash`
+  (simulated mid-pass process death; a BaseException so recovery code that
+  catches Exception cannot accidentally swallow a "death").
+- **run_with_deadline**: bounded-latency execution of a device dispatch; on
+  expiry the call is abandoned (daemon thread) and the caller falls back to
+  a degraded path instead of hanging — "The Tail at Scale"'s
+  latency-bounding applied to the score dispatch.
+
+Spec grammar (env var / CLI): comma-separated `site[@match]:rule` entries,
+plus an optional `seed=N`. Rules:
+
+    once@K      fire exactly on the K-th hit of the site (1-based)
+    first@N     fire on the first N hits
+    p=F         fire each hit with probability F (seeded, deterministic)
+    always      fire on every hit
+    sleep=S     (modifier) sleep S seconds instead of raising, for hang sites
+
+Example: `TPU_IR_FAULTS="spill_write@pairs-:first@2,crash.pass2:once@3"`.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+
+logger = logging.getLogger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# structured errors
+# ---------------------------------------------------------------------------
+
+
+class BuildError(RuntimeError):
+    """A build stage failed permanently after supervised retry: carries the
+    stage name, the attempt count, and the final cause — the single
+    structured surface a driver/operator sees instead of a raw traceback."""
+
+    def __init__(self, stage: str, attempts: int, cause: BaseException | str):
+        self.stage = stage
+        self.attempts = attempts
+        self.cause = cause
+        super().__init__(
+            f"build stage {stage!r} failed after {attempts} attempt(s): "
+            f"{cause}")
+
+
+class IntegrityError(AssertionError):
+    """An artifact failed its integrity check (checksum mismatch, truncated
+    or unreadable file). Carries the offending path so the operator knows
+    exactly what to quarantine/rebuild. Subclasses AssertionError so it
+    honors verify_index's long-standing "raises AssertionError with a
+    specific message on violation" contract — a checksum mismatch is the
+    byte-level sibling of the structural asserts."""
+
+    def __init__(self, path: str, detail: str):
+        self.path = path
+        self.detail = detail
+        super().__init__(f"artifact integrity failure: {path}: {detail}")
+
+
+class DeviceLoss(RuntimeError):
+    """Simulated (or detected) loss of the scoring device mid-dispatch."""
+
+
+class ScoreDeadlineExceeded(RuntimeError):
+    """A score dispatch exceeded its per-batch deadline."""
+
+    def __init__(self, deadline_s: float):
+        self.deadline_s = deadline_s
+        super().__init__(f"score dispatch exceeded {deadline_s}s deadline")
+
+
+class InjectedCrash(BaseException):
+    """Simulated mid-pass process death. Deliberately NOT an Exception:
+    retry supervisors and defensive `except Exception` blocks must treat it
+    like a real SIGKILL — unswallowable — so resume correctness is tested
+    against the same propagation a dying process has."""
+
+
+def is_device_loss(exc: BaseException) -> bool:
+    """Whether an exception from a device dispatch means the DEVICE is gone
+    (degrade) rather than the program is wrong (raise). Conservative: only
+    the injected marker and XLA errors whose message names a lost/halted
+    device qualify — a compile/shape error must never silently degrade."""
+    if isinstance(exc, DeviceLoss):
+        return True
+    msg = str(exc).lower()
+    return any(tag in msg for tag in
+               ("device_lost", "device lost", "data_loss",
+                "device halted", "device unavailable"))
+
+
+# ---------------------------------------------------------------------------
+# fault plan
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class FaultSpec:
+    """Firing rule for one site (see module docstring for the grammar)."""
+
+    mode: str                 # "once" | "first" | "prob" | "always"
+    arg: float = 0.0          # K for once, N for first, F for prob
+    match: str | None = None  # substring the site key must contain
+    sleep_s: float = 0.0      # hang duration for sleep-modified sites
+    hits: int = field(default=0, compare=False)
+    fired: int = field(default=0, compare=False)
+
+    def should_fire(self, key: str | None, rng: random.Random) -> bool:
+        if self.match is not None and (key is None or self.match not in key):
+            return False
+        self.hits += 1
+        if self.mode == "once":
+            fire = self.hits == int(self.arg)
+        elif self.mode == "first":
+            fire = self.hits <= int(self.arg)
+        elif self.mode == "prob":
+            fire = rng.random() < self.arg
+        else:  # always
+            fire = True
+        if fire:
+            self.fired += 1
+        return fire
+
+
+class FaultPlan:
+    """Process-wide deterministic fault plan: site name -> [FaultSpec]."""
+
+    def __init__(self, specs: dict[str, list[FaultSpec]] | None = None,
+                 seed: int = 0):
+        self.specs: dict[str, list[FaultSpec]] = dict(specs or {})
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._lock = threading.Lock()
+
+    def add(self, site: str, rule: str = "always", *, match: str | None = None,
+            sleep_s: float = 0.0) -> "FaultPlan":
+        """Programmatic plan building: plan.add('spill_write', 'first@2')."""
+        spec = _parse_rule(rule)
+        spec.match = match
+        spec.sleep_s = sleep_s
+        self.specs.setdefault(site, []).append(spec)
+        return self
+
+    def should_fire(self, site: str, key: str | None = None) -> FaultSpec | None:
+        """The spec that fired for this hit of `site`, or None. Thread-safe
+        and deterministic: hit counters and the seeded RNG advance only for
+        sites that have specs."""
+        specs = self.specs.get(site)
+        if not specs:
+            return None
+        with self._lock:
+            for spec in specs:
+                if spec.should_fire(key, self._rng):
+                    logger.warning("fault injected at site %r (key=%r)",
+                                   site, key)
+                    return spec
+        return None
+
+    def counters(self) -> dict[str, int]:
+        return {site: sum(s.fired for s in specs)
+                for site, specs in self.specs.items() if specs}
+
+
+def _parse_rule(rule: str) -> FaultSpec:
+    rule = rule.strip()
+    if rule == "always":
+        return FaultSpec("always")
+    if rule.startswith("once@"):
+        return FaultSpec("once", float(rule[5:]))
+    if rule.startswith("first@"):
+        return FaultSpec("first", float(rule[6:]))
+    if rule.startswith("p="):
+        return FaultSpec("prob", float(rule[2:]))
+    raise ValueError(f"unknown fault rule {rule!r} "
+                     "(expected once@K / first@N / p=F / always)")
+
+
+def parse_plan(text: str) -> FaultPlan:
+    """Parse the TPU_IR_FAULTS / --faults spec string into a FaultPlan."""
+    seed = 0
+    entries = []
+    for part in filter(None, (p.strip() for p in text.split(","))):
+        if part.startswith("seed="):
+            seed = int(part[5:])
+        else:
+            entries.append(part)
+    plan = FaultPlan(seed=seed)
+    for part in entries:
+        head, _, tail = part.partition(":")
+        rule = tail or "always"
+        sleep_s = 0.0
+        if rule.startswith("sleep="):       # bare modifier: rule = always
+            sleep_s, rule = float(rule[6:]), "always"
+        elif ":sleep=" in rule:             # rule:sleep=S
+            rule, _, s = rule.partition(":sleep=")
+            sleep_s = float(s)
+        site, _, match = head.partition("@")
+        plan.add(site, rule, match=match or None, sleep_s=sleep_s)
+    return plan
+
+
+# the installed plan; None = everything disabled (the production state).
+# Injection sites read this module attribute with one `is None` test.
+_PLAN: FaultPlan | None = None
+_ENV_CHECKED = False
+
+
+def install(plan: FaultPlan | None) -> None:
+    """Install (or with None, clear) the process-wide fault plan."""
+    global _PLAN, _ENV_CHECKED
+    _PLAN = plan
+    _ENV_CHECKED = True  # explicit install overrides the env var
+
+
+def clear() -> None:
+    global _PLAN, _ENV_CHECKED
+    _PLAN = None
+    _ENV_CHECKED = False
+
+
+def active() -> FaultPlan | None:
+    """The installed plan, lazily picking up TPU_IR_FAULTS on first use."""
+    global _PLAN, _ENV_CHECKED
+    if not _ENV_CHECKED:
+        _ENV_CHECKED = True
+        spec = os.environ.get("TPU_IR_FAULTS")
+        if spec:
+            _PLAN = parse_plan(spec)
+    return _PLAN
+
+
+def should_fire(site: str, key: str | None = None) -> FaultSpec | None:
+    """Hot-path probe: one attribute read + None test when no plan is
+    installed and the env var is absent."""
+    plan = _PLAN if _ENV_CHECKED else active()
+    if plan is None:
+        return None
+    return plan.should_fire(site, key)
+
+
+def maybe_crash(site: str, key: str | None = None) -> None:
+    """Injection point for simulated mid-pass process death."""
+    if should_fire(site, key) is not None:
+        raise InjectedCrash(f"injected crash at {site}")
+
+
+def maybe_hang(site: str, key: str | None = None) -> None:
+    """Injection point for slow/hung dispatches: sleeps the spec's
+    `sleep_s` (default 30s — long enough to trip any sane deadline)."""
+    spec = should_fire(site, key)
+    if spec is not None:
+        time.sleep(spec.sleep_s or 30.0)
+
+
+# ---------------------------------------------------------------------------
+# supervised retry
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Attempt-capped jittered exponential backoff. `seed` makes the jitter
+    sequence deterministic (the whole fault story is replayable)."""
+
+    max_attempts: int = 4
+    base_delay_s: float = 0.05
+    multiplier: float = 2.0
+    jitter: float = 0.25      # +/- fraction of the delay
+    seed: int = 0
+
+    def delay_s(self, attempt: int, rng: random.Random) -> float:
+        d = self.base_delay_s * (self.multiplier ** (attempt - 1))
+        return max(0.0, d * (1.0 + self.jitter * (2 * rng.random() - 1)))
+
+
+# transient host-filesystem writes (spill / part files)
+SPILL_RETRY = RetryPolicy(max_attempts=4, base_delay_s=0.02)
+# all_to_all capacity renegotiation: supplies the backoff/jitter between
+# re-dispatches; the attempt BOUND there is the capacity ceiling C (see
+# sharded_build_postings), not max_attempts — a count below feasibility
+# would fail legitimately skewed distributions
+OVERFLOW_RETRY = RetryPolicy(max_attempts=8, base_delay_s=0.0)
+
+
+def run_with_retry(fn, *, policy: RetryPolicy = SPILL_RETRY, stage: str,
+                   retry_on: tuple = (OSError,), report=None,
+                   sleep=time.sleep):
+    """Run `fn()` under the policy; returns its value. Retries only
+    `retry_on` exceptions (InjectedCrash is a BaseException and always
+    propagates — a death is not a transient). On exhaustion raises
+    BuildError carrying the stage and final cause. Each retry increments
+    the process recovery counters (and `report`'s, when given) so every
+    recovery is observable."""
+    rng = random.Random(policy.seed)
+    last: BaseException | None = None
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            last = e
+            if attempt == policy.max_attempts:
+                break
+            from .utils.report import recovery_counters
+
+            recovery_counters().incr("retries")
+            if report is not None:
+                report.incr("Fault.RETRIES")
+            logger.warning("stage %r attempt %d/%d failed (%s); retrying",
+                           stage, attempt, policy.max_attempts, e)
+            sleep(policy.delay_s(attempt, rng))
+    from .utils.report import recovery_counters
+
+    recovery_counters().incr("retry_exhausted")
+    raise BuildError(stage, policy.max_attempts, last) from last
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+
+# abandoned dispatch threads still blocked on a hung device; bounded so a
+# dead device plus a steady query stream cannot grow threads without limit
+_ABANDONED_CAP = 4
+_abandoned: list[threading.Thread] = []
+_abandoned_lock = threading.Lock()
+
+
+def run_with_deadline(fn, deadline_s: float | None):
+    """Run `fn()` with a wall-clock deadline. None = run inline (zero
+    overhead). On expiry the worker thread is abandoned (daemon — a truly
+    hung device call cannot block process exit) and ScoreDeadlineExceeded
+    raises so the caller can fall back instead of hanging.
+
+    Abandoned threads are tracked and capped at _ABANDONED_CAP live ones:
+    once the cap is hit the device is presumed hung and further deadlined
+    calls fail fast (immediate ScoreDeadlineExceeded, no new thread, no
+    deadline wait) until an abandoned dispatch finally returns. An
+    abandoned call that completes later has its result discarded; any
+    lazy state it populated (e.g. a Scorer's cached matrices) is
+    assignment-atomic, so the cost is wasted work, not corruption."""
+    if deadline_s is None:
+        return fn()
+    with _abandoned_lock:
+        _abandoned[:] = [t for t in _abandoned if t.is_alive()]
+        if len(_abandoned) >= _ABANDONED_CAP:
+            raise ScoreDeadlineExceeded(deadline_s)
+    box: dict = {}
+
+    def run():
+        try:
+            box["r"] = fn()
+        except BaseException as e:  # delivered to the caller below
+            box["e"] = e
+
+    t = threading.Thread(target=run, daemon=True,
+                         name="tpu-ir-score-dispatch")
+    t.start()
+    t.join(deadline_s)
+    if t.is_alive():
+        with _abandoned_lock:
+            _abandoned.append(t)
+        raise ScoreDeadlineExceeded(deadline_s)
+    if "e" in box:
+        raise box["e"]
+    return box["r"]

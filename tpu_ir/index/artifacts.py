@@ -17,6 +17,8 @@ from typing import Iterator
 
 import numpy as np
 
+from .format import CORRUPT_NPZ as _CORRUPT_NPZ
+
 _HEAD = 8  # values shown per array in the fallback listing
 
 
@@ -41,6 +43,17 @@ def _decode_runs(indptr: np.ndarray, delta: np.ndarray, lo: int, hi: int):
 
 def _inspect_npz(path: str, n: int) -> Iterator[str]:
     base = os.path.basename(path)
+    try:
+        yield from _inspect_npz_inner(path, base, n)
+    except _CORRUPT_NPZ as e:
+        # a truncated/bit-rotted npz (e.g. a quarantined part file being
+        # post-mortemed) gets a clean diagnosis, not a zipfile traceback
+        yield (f"{base}: CORRUPT npz ({type(e).__name__}: {e}) — "
+               f"size={os.path.getsize(path)} bytes; if this is a part "
+               "file, re-run the build to rebuild the shard from spills")
+
+
+def _inspect_npz_inner(path: str, base: str, n: int) -> Iterator[str]:
     with np.load(path, allow_pickle=False) as z:
         names = list(z.files)
         have = set(names)

@@ -21,6 +21,7 @@ from typing import Iterable, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults
 from ..analysis.native import make_analyzer
 from ..collection import DocnoMapping, Vocab, kgram_terms, read_trec_corpus
 from ..ops import (
@@ -274,13 +275,14 @@ def build_index(
         dict_report.set_counter("Dictionary.Size", v)
         dict_report.save(os.path.join(index_dir, fmt.JOBS_DIR))
 
+    faults.maybe_crash("crash.builder", "pre-metadata")
     meta = fmt.IndexMetadata(
         num_docs=num_docs, vocab_size=v, k=k, num_shards=num_shards,
         num_pairs=num_pairs,
         chargram_ks=chargram_ks if built_chargrams else [],
         version=2 if positions else fmt.FORMAT_VERSION,
         has_positions=bool(positions))
-    meta.save(index_dir)
+    meta.save_with_checksums(index_dir)
     report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     return meta
 
@@ -313,11 +315,14 @@ def _spmd_postings(flat_term_ids, flat_doc_ids, docnos, *, vocab_size,
     valid = int(num_pairs_h.max()) if len(num_pairs_h) else 1
     pt_h, pd_h, ptf_h, df_h = fetch_to_host(
         shrink_rows_for_fetch(out.pair_term, valid,
-                              dtype=narrow_uint(vocab_size - 1)),
+                              dtype=narrow_uint(vocab_size - 1),
+                              valid_rows=out.num_pairs),
         shrink_rows_for_fetch(out.pair_doc, valid,
-                              dtype=narrow_uint(num_docs)),
+                              dtype=narrow_uint(num_docs),
+                              valid_rows=out.num_pairs),
         shrink_rows_for_fetch(out.pair_tf, valid,
-                              dtype=narrow_uint(int(tf_max))),
+                              dtype=narrow_uint(int(tf_max)),
+                              valid_rows=out.num_pairs),
         out.df)
     shard_pairs = []
     df = np.zeros(vocab_size, np.int32)

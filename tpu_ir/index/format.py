@@ -26,9 +26,21 @@ from __future__ import annotations
 
 import json
 import os
-from dataclasses import dataclass
+import zipfile
+import zlib
+from dataclasses import dataclass, field
 
 import numpy as np
+
+from .. import faults
+
+# exceptions that mean "this npz artifact is unreadable/corrupt": npz rides
+# ZIP, and zipfile CRC-checks every fully-read entry, so bit rot surfaces
+# as BadZipFile on a full read. One definition shared by every consumer
+# (resume validation, part quarantine, inspect) so the corruption taxonomy
+# cannot drift between paths.
+CORRUPT_NPZ = (OSError, ValueError, KeyError, zipfile.BadZipFile,
+               zlib.error)
 
 FORMAT_VERSION = 1
 METADATA = "metadata.json"
@@ -37,6 +49,7 @@ VOCAB = "vocab.txt"
 DOCLEN = "doclen.npy"
 DICTIONARY = "dictionary.tsv"
 JOBS_DIR = "jobs"
+QUARANTINE_DIR = ".quarantine"
 
 
 def part_name(shard: int) -> str:
@@ -60,10 +73,23 @@ class IndexMetadata:
     # format v2: optional per-posting position runs (positions-NNNNN.npz,
     # index/positions.py); v1 metadata lacks the key and defaults False
     has_positions: bool = False
+    # per-artifact-file integrity checksums ("crc32:XXXXXXXX"), recorded
+    # by every builder at metadata-save time and verified on Scorer.load
+    # / `tpu-ir verify`; pre-checksum metadata lacks the key (no checks)
+    checksums: dict[str, str] = field(default_factory=dict)
 
     def save(self, index_dir: str) -> None:
         with open(os.path.join(index_dir, METADATA), "w") as f:
             json.dump(self.__dict__, f, indent=2, sort_keys=True)
+
+    def save_with_checksums(self, index_dir: str) -> None:
+        """Checksum every integrity-covered artifact currently on disk,
+        record the digests, then save. The single finalization call every
+        builder (in-memory, streaming, multi-host, merge) ends with —
+        metadata existence certifies the index AND pins its bytes."""
+        self.checksums = {name: file_checksum(os.path.join(index_dir, name))
+                          for name in integrity_names(index_dir, self)}
+        self.save(index_dir)
 
     @classmethod
     def load(cls, index_dir: str) -> "IndexMetadata":
@@ -71,13 +97,122 @@ class IndexMetadata:
             return cls(**json.load(f))
 
 
-def savez_atomic(path: str, **arrays) -> None:
+def savez_atomic(path: str, **arrays) -> str:
     """np.savez through a same-directory temp file + rename, so a file's
     EXISTENCE implies it is complete — the invariant the streaming build's
-    crash-resume (streaming.py) trusts for spills and part files."""
+    crash-resume (streaming.py) trusts for spills and part files.
+
+    Every write runs under the supervised spill retry policy (transient
+    filesystem failures re-attempt with jittered backoff; exhaustion is a
+    structured BuildError naming the file) — one contract for token/pair
+    spills, position spills, and part files alike.
+
+    Returns the file's CRC ('crc32:XXXXXXXX'), computed from the TEMP file
+    before the rename: the digest certifies the bytes the writer intended,
+    so corruption that lands after the write (bit rot — or the
+    artifact_truncate fault below) always MISMATCHES a manifest that
+    recorded this return value."""
+    name = os.path.basename(path)
     tmp = path + ".tmp.npz"
-    np.savez(tmp, **arrays)
-    os.replace(tmp, path)
+
+    def write() -> str:
+        if faults.should_fire("spill_write", name) is not None:
+            raise OSError(f"injected spill write failure: {path}")
+        np.savez(tmp, **arrays)
+        crc = file_checksum(tmp)
+        os.replace(tmp, path)
+        return crc
+
+    crc = faults.run_with_retry(write, policy=faults.SPILL_RETRY,
+                                stage=f"write:{name}")
+    if faults.should_fire("artifact_truncate", name) is not None:
+        # simulate on-disk corruption (torn write / bit rot): chop the
+        # tail off the just-renamed file. zipfile's per-entry CRC turns
+        # any later full read into a loud failure, which is exactly what
+        # the quarantine-and-rebuild paths are tested against.
+        with open(path, "r+b") as f:
+            f.truncate(max(os.path.getsize(path) // 2, 1))
+    return crc
+
+
+def readable_npz(path: str) -> bool:
+    """Fully read every array of an npz (zipfile verifies entry CRCs on a
+    full read), so True means the artifact's bytes are intact."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            for name in z.files:
+                z[name]
+        return True
+    except CORRUPT_NPZ:
+        return False
+
+
+def file_checksum(path: str, chunk_bytes: int = 1 << 22) -> str:
+    """Streamed CRC32 of one file, as 'crc32:XXXXXXXX' (the same digest
+    the serving-cache key uses — ~1 s/GB from page cache)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while chunk := f.read(chunk_bytes):
+            crc = zlib.crc32(chunk, crc)
+    return f"crc32:{crc:08x}"
+
+
+def integrity_names(index_dir: str, meta: "IndexMetadata") -> list[str]:
+    """The artifact files covered by metadata checksums: everything the
+    index's readers load, in deterministic order, filtered to what exists
+    (e.g. a --no-chargrams build has no chargram files). The document
+    store is excluded — it may legitimately be (re)built AFTER metadata
+    (cmd_index --store on an existing index) and carries its own idx/bin
+    consistency check."""
+    names = [part_name(s) for s in range(meta.num_shards)]
+    if meta.has_positions:
+        from .positions import positions_name
+
+        names += [positions_name(s) for s in range(meta.num_shards)]
+    names += [chargram_name(ck) for ck in meta.chargram_ks]
+    names += [DOCLEN, DICTIONARY, DOCNOS, VOCAB, "tokens.txt"]
+    return [n for n in names if os.path.exists(os.path.join(index_dir, n))]
+
+
+def verify_checksums(index_dir: str, meta: "IndexMetadata",
+                     names: list[str] | None = None) -> int:
+    """Verify recorded artifact checksums; raises faults.IntegrityError
+    naming the first corrupt file (full path), returns the number of
+    files checked. Indexes built before checksums existed (empty dict)
+    verify trivially. `names` restricts the check (Scorer.load verifies
+    only what it is about to read)."""
+    if not meta.checksums:
+        return 0
+    checked = 0
+    for name, want in meta.checksums.items():
+        if names is not None and name not in names:
+            continue
+        path = os.path.join(index_dir, name)
+        if not os.path.exists(path):
+            raise faults.IntegrityError(
+                path, "file recorded in metadata checksums is missing")
+        got = file_checksum(path)
+        if got != want:
+            raise faults.IntegrityError(
+                path, f"checksum mismatch (recorded {want}, found {got}); "
+                "the artifact is corrupt — quarantine it and rebuild the "
+                "index (or restore from a good copy)")
+        checked += 1
+    return checked
+
+
+def quarantine(index_dir: str, name: str) -> str:
+    """Move a corrupt artifact into index_dir/.quarantine/ (overwriting a
+    previous quarantine of the same name) so it is out of every reader's
+    path but preserved for post-mortem. Returns the quarantine path."""
+    from ..utils.report import recovery_counters
+
+    qdir = os.path.join(index_dir, QUARANTINE_DIR)
+    os.makedirs(qdir, exist_ok=True)
+    dest = os.path.join(qdir, name)
+    os.replace(os.path.join(index_dir, name), dest)
+    recovery_counters().incr("quarantined")
+    return dest
 
 
 def save_shard(index_dir: str, shard: int, *, term_ids: np.ndarray,

@@ -256,6 +256,6 @@ def merge_indexes(
         chargram_ks=chargram_ks if built_chargrams else [],
         version=2 if has_positions else fmt.FORMAT_VERSION,
         has_positions=has_positions)
-    meta.save(out_dir)
+    meta.save_with_checksums(out_dir)
     report.save(os.path.join(out_dir, fmt.JOBS_DIR))
     return meta

@@ -47,6 +47,7 @@ reference-scale corpora.
 
 from __future__ import annotations
 
+import logging
 import os
 import shutil
 from typing import Iterable, Sequence
@@ -54,6 +55,7 @@ from typing import Iterable, Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults
 from ..analysis.native import make_chunked_tokenizer
 from ..collection import DocnoMapping, Vocab
 from ..ops import PAD_TERM, PAD_TERM_U16, build_postings_packed_jit
@@ -67,7 +69,12 @@ from .builder import build_chargram_artifacts
 from ..ops.postings import round_cap as _round_cap
 
 
+logger = logging.getLogger(__name__)
+
 PASS1_MANIFEST = "pass1.npz"
+
+_CORRUPT_NPZ = fmt.CORRUPT_NPZ
+_readable_npz = fmt.readable_npz
 
 
 def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
@@ -102,7 +109,10 @@ def _load_resume_state(spill_dir: str, sig: np.ndarray):
     """Returns (all_docids, vocab_list, n_batches, batch_occ) when the
     spill dir holds a complete pass-1 state for this exact config, else
     None. Manifest + spills are written atomically, so existence implies
-    completeness."""
+    completeness; the manifest additionally records each token spill's
+    CRC, and a mismatch (bit rot, torn disk) discards the whole pass-1
+    state — a corrupt token spill cannot be rebuilt without re-tokenizing,
+    so the only safe recovery is a fresh pass 1."""
     path = os.path.join(spill_dir, PASS1_MANIFEST)
     if not os.path.exists(path):
         return None
@@ -112,23 +122,53 @@ def _load_resume_state(spill_dir: str, sig: np.ndarray):
                     or not (z["sig"] == sig).all()):
                 return None
             n_batches = int(z["n_batches"])
+            spill_crc = (z["spill_crc"].tolist()
+                         if "spill_crc" in z.files else None)
             for b in range(n_batches):
-                if not os.path.exists(
-                        os.path.join(spill_dir, f"tokens-{b:05d}.npz")):
+                spill = os.path.join(spill_dir, f"tokens-{b:05d}.npz")
+                if not os.path.exists(spill):
+                    return None
+                if (spill_crc is not None
+                        and fmt.file_checksum(spill) != spill_crc[b]):
+                    from ..utils.report import recovery_counters
+
+                    recovery_counters().incr("spill_integrity_discards")
+                    logger.warning(
+                        "token spill %s fails its manifest checksum; "
+                        "discarding the pass-1 resume state", spill)
                     return None
             return (z["docids"].tolist(), z["vocab"].tolist(), n_batches,
                     z["batch_occ"])
-    except (OSError, KeyError, ValueError):
+    except _CORRUPT_NPZ:
         return None
 
 
 def _batch_pairs_done(spill_dir: str, b: int, num_shards: int,
-                      positions: bool = False) -> bool:
-    return all(
-        os.path.exists(os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"))
-        and (not positions or os.path.exists(
-            os.path.join(spill_dir, f"pos-{s:03d}-{b:05d}.npz")))
-        for s in range(num_shards))
+                      positions: bool = False,
+                      validate: bool = False) -> bool:
+    """Whether batch b's per-shard pair (and position) spills all exist.
+    With `validate` (the resume path), each spill is additionally read in
+    full — a corrupt spill deletes the whole batch's spills and reports
+    the batch as not done, so ONLY that batch recomputes (the smallest
+    recovery scope a pair-spill corruption allows)."""
+    paths = [os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz")
+             for s in range(num_shards)]
+    if positions:
+        paths += [os.path.join(spill_dir, f"pos-{s:03d}-{b:05d}.npz")
+                  for s in range(num_shards)]
+    if not all(os.path.exists(p) for p in paths):
+        return False
+    if validate and not all(_readable_npz(p) for p in paths):
+        from ..utils.report import recovery_counters
+
+        recovery_counters().incr("spill_integrity_discards")
+        logger.warning("batch %d has a corrupt pair/position spill; "
+                       "recomputing the batch", b)
+        for p in paths:
+            if os.path.exists(p):
+                os.unlink(p)
+        return False
+    return True
 
 
 def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
@@ -208,7 +248,8 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
     them differently); `batch_stat(ids, lengths)` is the per-batch int
     recorded for pass 2 (total occurrences single-process; the
     per-device occupancy cap multi-host). Returns
-    (docids, vocab_list, n_batches, stats)."""
+    (docids, vocab_list, n_batches, stats, spill_crcs) — the CRCs go in
+    the caller's manifest so a resume can verify the spills' bytes."""
     from .docstore import write_text_spill
 
     acc_ids: list[np.ndarray] = []
@@ -218,6 +259,7 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
     acc_docs = 0
     all_docids: list[str] = []
     stats: list[int] = []
+    spill_crcs: list[str] = []
     n_batches = 0
 
     def flush():
@@ -231,14 +273,16 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
             acc_docids.clear()
         ids = np.concatenate(acc_ids)
         lengths = np.concatenate(acc_lens)
-        fmt.savez_atomic(
-            os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz"),
-            ids=ids, lengths=lengths)
+        spill = os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz")
+        # the returned CRC is computed pre-rename, so post-write corruption
+        # of the spill can never match the manifest that records it
+        spill_crcs.append(fmt.savez_atomic(spill, ids=ids, lengths=lengths))
         stats.append(int(batch_stat(ids, lengths)))
         n_batches += 1
         acc_ids.clear()
         acc_lens.clear()
         acc_docs = 0
+        faults.maybe_crash("crash.pass1", f"b={n_batches}")
 
     try:
         for delta in tok.deltas():
@@ -259,7 +303,7 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
         vocab_list = tok.vocab()
     finally:
         tok.close()
-    return all_docids, vocab_list, n_batches, stats
+    return all_docids, vocab_list, n_batches, stats, spill_crcs
 
 
 def build_index_streaming(
@@ -338,7 +382,7 @@ def build_index_streaming(
     else:
         tok = make_chunked_tokenizer(corpus_paths, k=k, with_text=store)
         with report.phase("pass1_tokenize"):
-            all_docids, vocab_list, n_batches, occ_per_batch = \
+            all_docids, vocab_list, n_batches, occ_per_batch, spill_crcs = \
                 run_pass1_spills(
                     tok, spill_dir, batch_docs, store, report,
                     text_path_fn=lambda b: os.path.join(
@@ -347,12 +391,14 @@ def build_index_streaming(
         batch_occ = np.array(occ_per_batch, dtype=np.int64)
         # manifest LAST: its existence certifies pass 1 (docids in corpus
         # order, the native vocab in temp-id order, per-batch occurrence
-        # counts) so a restart never re-tokenizes
+        # counts, per-spill CRCs) so a restart never re-tokenizes — and
+        # never trusts a spill whose bytes rotted under it
         fmt.savez_atomic(
             os.path.join(spill_dir, PASS1_MANIFEST), sig=sig,
             docids=np.array(all_docids, dtype=np.str_),
             vocab=np.array(vocab_list, dtype=np.str_),
-            n_batches=np.int64(n_batches), batch_occ=batch_occ)
+            n_batches=np.int64(n_batches), batch_occ=batch_occ,
+            spill_crc=np.array(spill_crcs, dtype=np.str_))
 
     num_docs = len(all_docids)
     if num_docs == 0:
@@ -388,12 +434,21 @@ def build_index_streaming(
         only `lengths` loads, to rebuild doc_len."""
         ofs = 0
         for b in range(n_batches):
-            with np.load(os.path.join(spill_dir,
-                                      f"tokens-{b:05d}.npz")) as z:
-                lengths = z["lengths"]
-                done = resuming and _batch_pairs_done(
-                    spill_dir, b, num_shards, positions)
-                flat = None if done else z["ids"]
+            spill = os.path.join(spill_dir, f"tokens-{b:05d}.npz")
+            try:
+                with np.load(spill) as z:
+                    lengths = z["lengths"]
+                    done = resuming and _batch_pairs_done(
+                        spill_dir, b, num_shards, positions, validate=True)
+                    flat = None if done else z["ids"]
+            except _CORRUPT_NPZ as e:
+                # a token spill that rotted between its write and this
+                # read: surface ONE structured error (not a zip
+                # traceback); the restart's manifest-CRC check then
+                # discards the pass-1 state and re-tokenizes
+                raise faults.IntegrityError(
+                    spill, f"token spill unreadable ({e}); re-run the "
+                    "build — the restart re-tokenizes the corpus") from e
             docids = np.array(all_docids[ofs : ofs + len(lengths)],
                               dtype=np.str_)
             ofs += len(lengths)
@@ -444,6 +499,7 @@ def build_index_streaming(
                 fmt.savez_atomic(
                     os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"),
                     term=pt[sel], doc=pd[sel], tf=ptf[sel])
+            faults.maybe_crash("crash.pass2", f"b={b}")
 
         pending = None
         for b, term_ids, docnos, lengths in iter_batches():
@@ -500,17 +556,21 @@ def build_index_streaming(
             valid = int(npairs.max()) if len(npairs) else 1
             pt, pd, ptf = fetch_to_host(
                 shrink_rows_for_fetch(out.pair_term, valid,
-                                      dtype=narrow_uint(v - 1)),
+                                      dtype=narrow_uint(v - 1),
+                                      valid_rows=out.num_pairs),
                 shrink_rows_for_fetch(out.pair_doc, valid,
-                                      dtype=narrow_uint(num_docs)),
+                                      dtype=narrow_uint(num_docs),
+                                      valid_rows=out.num_pairs),
                 shrink_rows_for_fetch(out.pair_tf, valid,
-                                      dtype=narrow_uint(int(tf_max))))
+                                      dtype=narrow_uint(int(tf_max)),
+                                      valid_rows=out.num_pairs))
             for sh in range(s):
                 n_sh = int(npairs[sh])
                 fmt.savez_atomic(
                     os.path.join(spill_dir, f"pairs-{sh:03d}-{b:05d}.npz"),
                     term=pt[sh][:n_sh], doc=pd[sh][:n_sh],
                     tf=ptf[sh][:n_sh])
+            faults.maybe_crash("crash.pass2", f"b={b}")
 
     with report.phase("pass2_combine"):
         if spmd_devices:
@@ -531,18 +591,40 @@ def build_index_streaming(
             if positions:
                 # positions are written before the part, so an existing
                 # part implies its positions file too; a missing one
-                # (defensive) forces recompute of both
+                # (defensive) forces recompute of both, and an UNREADABLE
+                # one is quarantined first — resuming over it would bake
+                # its corrupt bytes into the metadata checksums and every
+                # later phrase query would die on them
                 from .positions import positions_name
 
-                if not os.path.exists(
-                        os.path.join(index_dir, positions_name(s))):
+                ppath = os.path.join(index_dir, positions_name(s))
+                if not os.path.exists(ppath):
                     part = ""  # treat as absent
+                elif not fmt.readable_npz(ppath):
+                    qpath = fmt.quarantine(index_dir, positions_name(s))
+                    logger.warning(
+                        "corrupt positions file quarantined to %s; "
+                        "rebuilding shard %d from its spills", qpath, s)
+                    report.incr("Fault.QUARANTINED_PARTS", 1)
+                    part = ""
+            z = None
             if resuming and part and os.path.exists(part):
                 # parts are written atomically and only after every pass-2
                 # spill exists, so an existing part IS this shard's final
                 # output; recover its df/pair contributions without
-                # re-sorting
-                z = fmt.load_shard(index_dir, s)
+                # re-sorting. A part that fails its full read (zipfile
+                # CRC-checks every entry) is CORRUPT: quarantine it and
+                # rebuild ONLY this shard from its surviving spills —
+                # never the whole index.
+                try:
+                    z = fmt.load_shard(index_dir, s)
+                except _CORRUPT_NPZ:
+                    qpath = fmt.quarantine(index_dir, fmt.part_name(s))
+                    logger.warning(
+                        "corrupt part file quarantined to %s; rebuilding "
+                        "shard %d from its spills", qpath, s)
+                    report.incr("Fault.QUARANTINED_PARTS", 1)
+            if z is not None:
                 rdf = np.zeros(v, np.int32)
                 rdf[z["term_ids"]] = z["df"]
                 npairs = len(z["pair_doc"])
@@ -551,6 +633,7 @@ def build_index_streaming(
                 rdf, npairs = reduce_shard_spills(
                     spill_dir, index_dir, s, n_batches, v, shard_of,
                     positions=positions)
+            faults.maybe_crash("crash.pass3", f"s={s}")
             num_pairs_total += npairs
             df[:] += rdf
     report.set_counter("num_pairs", num_pairs_total)
@@ -598,6 +681,6 @@ def build_index_streaming(
         chargram_ks=chargram_ks if built_chargrams else [],
         version=2 if positions else fmt.FORMAT_VERSION,
         has_positions=bool(positions))
-    meta.save(index_dir)
+    meta.save_with_checksums(index_dir)
     report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     return meta

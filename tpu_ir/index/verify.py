@@ -20,6 +20,10 @@ def verify_index(index_dir: str) -> dict:
     """Check every invariant of the on-disk index; raises AssertionError with
     a specific message on violation, returns a summary dict on success."""
     meta = fmt.IndexMetadata.load(index_dir)
+    # integrity first: recorded checksums must match the bytes on disk
+    # (a corrupt artifact should surface as ONE structured IntegrityError
+    # naming the file, before any structural assert trips on its content)
+    checksums_verified = fmt.verify_checksums(index_dir, meta)
     vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
     mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
     doc_len = np.load(os.path.join(index_dir, fmt.DOCLEN))
@@ -148,6 +152,7 @@ def verify_index(index_dir: str) -> dict:
                 f"chargram k={ck}: term lists not sorted-unique"
 
     return {
+        "checksums_verified": checksums_verified,
         "dictionary_terms_checked": dict_checked,
         "has_positions": meta.has_positions,
         "num_docs": meta.num_docs,

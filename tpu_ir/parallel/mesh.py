@@ -20,6 +20,21 @@ from jax.sharding import Mesh
 
 SHARD_AXIS = "shards"
 
+# jax moved shard_map out of experimental (and renamed check_rep ->
+# check_vma) late in the 0.4.x line; accept either API so the SPMD paths
+# run on whatever jax the container ships instead of dying at dispatch
+# with AttributeError
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+else:  # pragma: no cover - depends on installed jax
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
 
 def make_mesh(num_shards: int | None = None, backend: str | None = None) -> Mesh:
     devices = jax.devices(backend) if backend else jax.devices()

@@ -43,6 +43,21 @@ def init_distributed(coordinator: str | None = None,
     """Initialize jax.distributed when running multi-process; returns
     (process_index, process_count). Safe to call single-process (no-op)."""
     if coordinator or os.environ.get("JAX_COORDINATOR_ADDRESS"):
+        # CPU multi-process needs a cross-process collectives backend; the
+        # default ("none") hard-fails at the first collective with
+        # "Multiprocess computations aren't implemented on the CPU
+        # backend". Select gloo when available and nothing was chosen —
+        # non-CPU platforms ignore the flag, and jax builds without the
+        # flag/gloo keep their previous behavior.
+        try:
+            # flag-style options are not attribute-readable on every jax
+            # version; update() is the portable surface, so only flip the
+            # default, never an explicit operator choice (env var)
+            if not os.environ.get("JAX_CPU_COLLECTIVES_IMPLEMENTATION"):
+                jax.config.update("jax_cpu_collectives_implementation",
+                                  "gloo")
+        except (AttributeError, ValueError):
+            pass
         jax.distributed.initialize(
             coordinator_address=coordinator,
             num_processes=num_processes,
@@ -198,8 +213,8 @@ def build_index_multihost(
             # the shared loop records the batch's max per-device
             # occupancy — pass 2 negotiates one global capacity from
             # these, with no second read of the spills
-            my_docids, local_vocab, n_batches, batch_dev_caps = \
-                run_pass1_spills(
+            my_docids, local_vocab, n_batches, batch_dev_caps, spill_crcs \
+                = run_pass1_spills(
                     tok, spill_dir, batch_docs, store, report,
                     text_path_fn=lambda b: os.path.join(
                         text_dir, f"text-p{pi:03d}-{b:05d}.npz"),
@@ -209,13 +224,15 @@ def build_index_multihost(
         # manifest LAST (atomic): its existence certifies pass 1, exactly
         # like the single-process streaming build; batch_occ holds the
         # per-batch PER-DEVICE occupancy caps here (the quantity pass 2's
-        # capacity negotiation needs)
+        # capacity negotiation needs); spill_crc lets a restart verify
+        # the spills' bytes before trusting them
         fmt.savez_atomic(
             os.path.join(spill_dir, PASS1_MANIFEST), sig=sig,
             docids=np.array(my_docids, dtype=np.str_),
             vocab=np.array(local_vocab, dtype=np.str_),
             n_batches=np.int64(n_batches),
-            batch_occ=np.array(batch_dev_caps, dtype=np.int64))
+            batch_occ=np.array(batch_dev_caps, dtype=np.int64),
+            spill_crc=np.array(spill_crcs, dtype=np.str_))
 
     # --- agree on global tables (host-side allgather) ---
     with report.phase("global_tables"):
@@ -293,19 +310,31 @@ def build_index_multihost(
             multihost_utils.sync_global_devices("tpu_ir_stale_wiped")
 
         def my_batch_done(b: int) -> bool:
-            """Did MY contribution to batch b land completely (atomic
-            files, so existence implies completeness)? Padding steps
-            (b >= n_batches) still write empty pair spills, so the same
-            check covers them; position spills exist only for real
-            batches."""
-            if not all(os.path.exists(os.path.join(
-                    spill_dir, f"pairs-{row:03d}-{b:05d}.npz"))
-                    for row in my_rows):
-                return False
+            """Did MY contribution to batch b land completely AND intact?
+            Existence implies completeness (atomic files); a full read
+            (zip entry CRCs) additionally proves the bytes, and a corrupt
+            spill deletes the batch's local spills so ONLY that batch
+            recomputes — in lockstep, because this flag rides the same
+            allgather as everyone else's. Padding steps (b >= n_batches)
+            still write empty pair spills, so the same check covers them;
+            position spills exist only for real batches."""
+            paths = [os.path.join(
+                spill_dir, f"pairs-{row:03d}-{b:05d}.npz")
+                for row in my_rows]
             if positions and b < n_batches:
-                return all(os.path.exists(os.path.join(
-                    pos_dir, f"pos-{row:03d}-b{b:05d}-p{pi:03d}.npz"))
-                    for row in range(s))
+                paths += [os.path.join(
+                    pos_dir, f"pos-{row:03d}-b{b:05d}-p{pi:03d}.npz")
+                    for row in range(s)]
+            if not all(os.path.exists(p) for p in paths):
+                return False
+            if not all(fmt.readable_npz(p) for p in paths):
+                from ..utils.report import recovery_counters
+
+                recovery_counters().incr("spill_integrity_discards")
+                for p in paths:
+                    if os.path.exists(p):
+                        os.unlink(p)
+                return False
             return True
 
         done_local = np.array(
@@ -390,12 +419,15 @@ def build_index_multihost(
                                          jnp.max(out.pair_tf))
             shrunk = {
                 "pair_term": shrink_rows_for_fetch(
-                    out.pair_term, int(npmax), dtype=narrow_uint(v - 1)),
+                    out.pair_term, int(npmax), dtype=narrow_uint(v - 1),
+                    valid_rows=out.num_pairs),
                 "pair_doc": shrink_rows_for_fetch(
                     out.pair_doc, int(npmax),
-                    dtype=narrow_uint(num_docs)),
+                    dtype=narrow_uint(num_docs),
+                    valid_rows=out.num_pairs),
                 "pair_tf": shrink_rows_for_fetch(
-                    out.pair_tf, int(npmax), dtype=narrow_uint(int(tfmax))),
+                    out.pair_tf, int(npmax), dtype=narrow_uint(int(tfmax)),
+                    valid_rows=out.num_pairs),
             }
             rows = {}
             for col in ("pair_term", "pair_doc", "pair_tf"):
@@ -438,13 +470,30 @@ def build_index_multihost(
             part = os.path.join(index_dir, fmt.part_name(row))
             # resume: an existing part (plus its positions file — written
             # AFTER the part here, so the pair must be checked together)
-            # is this shard's final output from the crashed run
-            if (all_resumed and os.path.exists(part)
-                    and (not positions or os.path.exists(
-                        os.path.join(index_dir, positions_name(row))))):
-                npairs = len(fmt.load_shard(index_dir, row)["pair_doc"])
-                report.incr("pass3_resumed_shards", 1)
-            else:
+            # is this shard's final output from the crashed run. A part
+            # whose full read fails (zipfile CRC) is corrupt: quarantine
+            # it and rebuild only this shard from the spills, exactly
+            # like the single-process streaming pass 3.
+            npairs = None
+            pos_ok = True
+            if positions:
+                ppath = os.path.join(index_dir, positions_name(row))
+                pos_ok = os.path.exists(ppath)
+                if pos_ok and not fmt.readable_npz(ppath):
+                    # corrupt positions output: quarantine and rebuild
+                    # the shard, or its rotten bytes get checksummed as
+                    # authoritative (same rule as streaming pass 3)
+                    fmt.quarantine(index_dir, positions_name(row))
+                    report.incr("Fault.QUARANTINED_PARTS", 1)
+                    pos_ok = False
+            if all_resumed and pos_ok and os.path.exists(part):
+                try:
+                    npairs = len(fmt.load_shard(index_dir, row)["pair_doc"])
+                    report.incr("pass3_resumed_shards", 1)
+                except fmt.CORRUPT_NPZ:
+                    fmt.quarantine(index_dir, fmt.part_name(row))
+                    report.incr("Fault.QUARANTINED_PARTS", 1)
+            if npairs is None:
                 _, npairs = reduce_shard_spills(
                     spill_dir, index_dir, row, b_global, v, shard_of)
                 if positions:
@@ -501,7 +550,9 @@ def build_index_multihost(
             chargram_ks=list(chargram_ks) if built_chargrams else [],
             version=2 if positions else fmt.FORMAT_VERSION,
             has_positions=bool(positions))
-        meta.save(index_dir)
+        # after the pass-3 barrier every process's parts exist, so
+        # process 0 can checksum the whole artifact set
+        meta.save_with_checksums(index_dir)
         report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     multihost_utils.sync_global_devices("tpu_ir_index_built")
     # spills only AFTER metadata certifies the index: a peer crashing in

@@ -18,6 +18,9 @@ handling).
 
 from __future__ import annotations
 
+import logging
+import random
+import time
 from functools import partial
 from typing import NamedTuple
 
@@ -26,8 +29,14 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
+from .. import faults
+from ..utils.report import recovery_counters
+
+logger = logging.getLogger(__name__)
+
 from ..ops.postings import (PAD_TERM, build_postings,
                             reduce_weighted_postings, round_cap)
+from .mesh import SHARD_AXIS, make_mesh, shard_map
 
 
 def deal_occurrences(flat_term: np.ndarray, flat_doc: np.ndarray,
@@ -54,7 +63,6 @@ def deal_occurrences(flat_term: np.ndarray, flat_doc: np.ndarray,
         d_arr[sh, :n] = flat_doc[sel]
     dps = np.bincount((docnos - 1) % s, minlength=s).astype(np.int32)
     return t_arr, d_arr, dps
-from .mesh import SHARD_AXIS, make_mesh
 
 
 class ShardedPostings(NamedTuple):
@@ -140,7 +148,7 @@ def _route_and_build(term_ids, doc_ids, local_num_docs, *, num_shards: int,
                                    "total_docs", "mesh"))
 def _sharded_build_jit(term_ids, doc_ids, local_num_docs, *, mesh,
                        num_shards, vocab_size, bucket_cap, total_docs):
-    fn = jax.shard_map(
+    fn = shard_map(
         partial(_route_and_build, num_shards=num_shards,
                 vocab_size=vocab_size, bucket_cap=bucket_cap,
                 total_docs=total_docs),
@@ -161,15 +169,33 @@ def sharded_build_postings(
     total_docs: int,
     mesh=None,
     bucket_cap: int | None = None,
+    retry_policy: faults.RetryPolicy | None = None,
 ) -> ShardedPostings:
-    """Run the SPMD build, growing bucket capacity on overflow."""
+    """Run the SPMD build under a supervised capacity-retry policy.
+
+    Each overflow re-dispatch doubles the bucket capacity — the moral
+    equivalent of a failed-task retry, made deterministic — with the
+    policy's jittered backoff between dispatches (an overflow on real
+    hardware means re-running a collective program; hammering it
+    back-to-back starves concurrent users of the chip). The attempt
+    bound is the CAPACITY CEILING, not a fixed count: bucket_cap == C
+    holds every pair a device could route to ONE destination, so growth
+    beyond it is provably useless and exhaustion there raises a
+    structured BuildError. (A fixed attempt count once stopped the
+    doubling at c/2 on meshes with s > 16, failing feasible skewed
+    distributions — the bound must track feasibility, which the ceiling
+    does and a count does not.)"""
     s, c = term_ids.shape
     if mesh is None:
         mesh = make_mesh(s)
     if bucket_cap is None:
         # expected pairs per (device, dest) with 2x headroom, 128-aligned
         bucket_cap = max(128, int(2 * c / s) + 127 & ~127)
+    policy = retry_policy or faults.OVERFLOW_RETRY
+    rng = random.Random(policy.seed)
+    attempt = 0
     while True:
+        attempt += 1
         out = _sharded_build_jit(
             jnp.asarray(term_ids), jnp.asarray(doc_ids),
             jnp.asarray(docs_per_shard),
@@ -180,14 +206,21 @@ def sharded_build_postings(
         # shard so this also works on a multi-host mesh
         dropped = int(np.asarray(
             result.dropped.addressable_shards[0].data).ravel()[0])
+        if faults.should_fire("shuffle_overflow") is not None:
+            dropped = max(dropped, 1)
         if dropped == 0:
             return result
         if bucket_cap >= c:
-            # cap == c holds every pair a device could route to ONE dest,
-            # so overflow here means a routing bug, not skew. A fixed
-            # retry count used to stop the doubling at c/2 for meshes
-            # with s > 16, failing feasible skewed distributions.
-            raise RuntimeError(
-                f"postings routing overflow persists at bucket_cap="
-                f"{bucket_cap} == capacity {c}; routing bug?")
+            raise faults.BuildError(
+                "all_to_all_shuffle", attempt,
+                f"routing overflow persists at bucket_cap={bucket_cap} == "
+                f"capacity {c} ({dropped} pairs dropped): every pair fits "
+                "one destination bucket, so this is a routing bug, not "
+                "skew")
+        recovery_counters().incr("overflow_retries")
+        logger.warning(
+            "all_to_all overflow (%d pairs dropped) at bucket_cap=%d; "
+            "re-dispatching at %d (attempt %d)", dropped, bucket_cap,
+            min(bucket_cap * 2, c), attempt + 1)
+        time.sleep(policy.delay_s(attempt, rng))
         bucket_cap = min(bucket_cap * 2, c)

@@ -38,7 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ..ops.scoring import (_lntf, _tiered_scores, _topk_over_candidates,
                            bm25_idf_weights, bm25_saturation, idf_weights)
 from ..search.layout import BASE_CAP, GROWTH, HOT_BUDGET, build_tiered_layout
-from .mesh import SHARD_AXIS
+from .mesh import SHARD_AXIS, shard_map
 
 
 class ShardedTieredLayout(NamedTuple):
@@ -352,7 +352,7 @@ def _sharded_topk_jit(q_terms, df, n_scalar, hot_rank, hot_tfs, tier_of,
                                n_f=n_f, k1=k1, b=b)
         return _merge_topk(scores, base, k)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, None), P(None)) + _layout_specs_flat(tier_docs),
         out_specs=(P(None, None), P(None, None)),
@@ -418,7 +418,7 @@ def _sharded_rerank_jit(q_terms, df, n_scalar, doc_norm, hot_rank, hot_tfs,
         cs = jax.lax.psum(cs, SHARD_AXIS)                 # [B, C]
         return _topk_over_candidates(cs, cand, k)
 
-    fn = jax.shard_map(
+    fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(None, None), P(None), P(None), P(SHARD_AXIS, None))
         + _layout_specs_flat(tier_docs),

@@ -194,8 +194,10 @@ def build_tiered_layout(
 # serving-cache format version; bump when the layout semantics change
 # (v2: hot strip cached as COO postings instead of the dense matrix;
 #  v3: keyed by part-file CRCs — a cache HIT needs no shard read or CSR
-#  assembly at all — and df + rerank doc-norms ride in the cache)
-_CACHE_VERSION = 3
+#  assembly at all — and df + rerank doc-norms ride in the cache;
+#  v4: key CRCs carry fmt.file_checksum's tagged string form, shared with
+#  the metadata integrity checksums)
+_CACHE_VERSION = 4
 
 
 def _serving_cache_key(index_dir: str, meta, hot_budget, base_cap,
@@ -204,20 +206,19 @@ def _serving_cache_key(index_dir: str, meta, hot_budget, base_cap,
     from page cache), so an in-place rebuild misses even when every df is
     unchanged — without paying the shard-load + CSR assembly the old
     column-CRC key required (~minutes at 250M pairs, the dominant warm-load
-    cost the cache exists to remove)."""
+    cost the cache exists to remove). The digest is fmt.file_checksum —
+    the SAME helper metadata checksums use — because Scorer.load's
+    "cache hit implies parts verified" shortcut is only sound while the
+    two stay one implementation."""
     import os
-    import zlib
 
     from ..index import format as fmt
 
     files = []
     for s in range(meta.num_shards):
         path = os.path.join(index_dir, fmt.part_name(s))
-        crc = 0
-        with open(path, "rb") as f:
-            while chunk := f.read(1 << 22):
-                crc = zlib.crc32(chunk, crc)
-        files.append([fmt.part_name(s), os.path.getsize(path), crc])
+        files.append([fmt.part_name(s), os.path.getsize(path),
+                      fmt.file_checksum(path)])
     return {
         "version": _CACHE_VERSION,
         "num_docs": meta.num_docs,

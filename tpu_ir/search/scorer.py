@@ -22,11 +22,13 @@ from typing import Sequence
 import jax.numpy as jnp
 import numpy as np
 
+from .. import faults
 from ..analysis.native import make_analyzer
 from ..collection import KGRAM_SEP, DocnoMapping, Vocab, kgram_terms
 from ..index import format as fmt
 from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense
 from ..ops.scoring import dense_tf_matrix
+from ..utils.report import recovery_counters
 from ..utils.transfer import fetch_to_host
 from .layout import build_tiered_layout
 
@@ -57,7 +59,14 @@ logger = logging.getLogger(__name__)
 
 
 class SearchResult(list):
-    """List of (docno, score) or (docid, score) tuples for one query."""
+    """List of (docno, score) or (docid, score) tuples for one query.
+
+    `degraded` is True when the results came from a fallback path (score
+    deadline expired or the device was lost mid-dispatch): still correct
+    ranking per the host scoring model, but not the primary pipeline —
+    callers surfacing results to users should tag them."""
+
+    degraded: bool = False
 
 
 def compute_doc_norms(pair_term, pair_doc, pair_tf, df,
@@ -84,6 +93,11 @@ def compute_doc_norms(pair_term, pair_doc, pair_tf, df,
 
 
 class Scorer:
+    # class-level defaults so minimal Scorers (tests build them with
+    # object.__new__ over synthetic layouts) get the no-deadline behavior
+    deadline_s: float | None = None
+    degraded_last: bool = False
+
     def __init__(
         self,
         *,
@@ -103,15 +117,24 @@ class Scorer:
         pairs_loader=None,
         sharded_layout=None,
         prune: bool = True,
+        deadline_s: float | None = None,
     ):
         """`pair_*` may be omitted on the tiered path when prebuilt `tiers`
         (+ cached `doc_norms`) are supplied — the serving-cache fast path;
         `pairs_loader` then lazily assembles the CSR columns if something
-        still needs them (the bench's exhaustive oracle does)."""
+        still needs them (the bench's exhaustive oracle does).
+
+        `deadline_s` bounds every score dispatch: a batch that has not
+        returned within the deadline (or whose device is lost) falls back
+        to the host CPU scorer and is tagged degraded, instead of hanging
+        the serving process (degraded-mode serving; "The Tail at Scale")."""
         self.vocab = vocab
         self.mapping = mapping
         self.meta = meta
         self.compat_int_idf = compat_int_idf
+        self.deadline_s = deadline_s
+        # True when the LAST topk/rerank batch was answered by a fallback
+        self.degraded_last = False
         # rank-safe MaxScore pruning of the tiered hot-strip stage
         # (ops/scoring.py::_hot_stage_pruned); results are identical with
         # it off — the toggle exists for the bench's device-control A/B
@@ -205,7 +228,9 @@ class Scorer:
 
     @classmethod
     def load(cls, index_dir: str, *, layout: str = "auto",
-             compat_int_idf: bool = False, prune: bool = True) -> "Scorer":
+             compat_int_idf: bool = False, prune: bool = True,
+             deadline_s: float | None = None,
+             verify_integrity: bool = True) -> "Scorer":
         if layout not in ("auto", "dense", "sparse", "sharded"):
             # fail before any IO — a typo'd layout should not cost the
             # minutes-long shard read + CSR assembly of a large index
@@ -220,9 +245,31 @@ class Scorer:
         # enable this; the serving process must too)
         enable_compilation_cache()
         meta = fmt.IndexMetadata.load(index_dir)
+        if verify_integrity:
+            # side artifacts are small — verify their recorded checksums on
+            # every load. Part shards are verified on the paths that read
+            # them (below before CSR assembly, and inside the lazy
+            # pairs_loader); a serving-cache HIT needs no up-front part
+            # check because its content-addressed key already CRC-matches
+            # every part file (layout.py::_serving_cache_key), so a
+            # corrupted part forces a miss into the verified path.
+            fmt.verify_checksums(
+                index_dir, meta, names=[fmt.DOCLEN, fmt.DOCNOS, fmt.VOCAB])
         vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
         mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
         doc_len = np.load(os.path.join(index_dir, fmt.DOCLEN))
+
+        def load_pairs_verified():
+            """Lazy CSR assembly for the cache fast path — parts may have
+            rotted since the cache key was computed, so verify their
+            recorded CRCs before reading them (same structured-error
+            surface as the eager path)."""
+            if verify_integrity:
+                fmt.verify_checksums(
+                    index_dir, meta,
+                    names=[fmt.part_name(s)
+                           for s in range(meta.num_shards)])
+            return cls._assemble_csr(index_dir, meta)[1]
 
         v, d = meta.vocab_size, meta.num_docs
         resolved = layout
@@ -245,8 +292,8 @@ class Scorer:
                     layout="sparse", compat_int_idf=compat_int_idf,
                     index_dir=index_dir, tiers=tiers,
                     doc_norms=np.asarray(norms),
-                    pairs_loader=lambda: cls._assemble_csr(
-                        index_dir, meta)[1], prune=prune)
+                    pairs_loader=load_pairs_verified, prune=prune,
+                    deadline_s=deadline_s)
         elif resolved == "sharded":
             # same fast path for distributed serving, per mesh size
             import jax
@@ -264,9 +311,21 @@ class Scorer:
                     layout="sharded", compat_int_idf=compat_int_idf,
                     index_dir=index_dir, sharded_layout=lay,
                     doc_norms=np.asarray(norms),
-                    pairs_loader=lambda: cls._assemble_csr(
-                        index_dir, meta)[1], prune=prune)
+                    pairs_loader=load_pairs_verified, prune=prune,
+                    deadline_s=deadline_s)
 
+        if verify_integrity:
+            # about to read every part shard: verify their recorded CRCs
+            # first so corruption surfaces as ONE structured IntegrityError
+            # naming the file, not a deep numpy/zip traceback. This is a
+            # second streamed read on top of _assemble_csr's (page-cache
+            # warm), and it is NOT redundant with zip's per-entry CRCs:
+            # those prove well-formedness, while the metadata digest pins
+            # CONTENT — a stale or swapped-in part from another build
+            # parses perfectly and would serve a silently wrong index.
+            fmt.verify_checksums(
+                index_dir, meta,
+                names=[fmt.part_name(s) for s in range(meta.num_shards)])
         df, (pair_term, pair_doc, pair_tf) = cls._assemble_csr(
             index_dir, meta)
         tiers = norms = None
@@ -319,7 +378,8 @@ class Scorer:
             pair_tf=pair_tf, df=df, doc_len=doc_len, meta=meta,
             layout=layout, compat_int_idf=compat_int_idf,
             index_dir=index_dir, tiers=tiers, doc_norms=norms,
-            sharded_layout=sharded_layout, prune=prune)
+            sharded_layout=sharded_layout, prune=prune,
+            deadline_s=deadline_s)
 
     @staticmethod
     def _assemble_csr(index_dir: str, meta):
@@ -725,13 +785,23 @@ class Scorer:
                 np.concatenate([p[1] for p in parts])[:b])
 
     def topk(
-        self, q_terms: np.ndarray, k: int = 10, scoring: str = "tfidf"
+        self, q_terms: np.ndarray, k: int = 10, scoring: str = "tfidf",
+        deadline_s: float | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Score an id batch. Returns (scores [B,k], docnos [B,k], 0=empty).
 
         Large batches are scored in query blocks so the per-dispatch score
         accumulator stays within SCORE_BUDGET elements regardless of corpus
         size (the reference had no batching at all; SURVEY.md §3.3).
+
+        Degraded-mode serving: with a per-batch deadline (`deadline_s`
+        here or on the Scorer), a dispatch that overruns it — or dies
+        with a device loss — falls back down the serving chain (resident
+        device layout -> host CPU scoring over the postings columns) and
+        the batch is flagged via `degraded_last` / SearchResult.degraded,
+        so the engine returns bounded-latency answers instead of hanging
+        ("The Tail at Scale"). A deadline of None with no fault plan
+        installed takes the primary path with zero added work.
 
         MaxScore scheduling (prune on, tiered layout): queries WITHOUT
         hot-strip terms have a hot-stage upper bound of exactly 0 — the
@@ -744,8 +814,44 @@ class Scorer:
         measured slower than the matmul it skips on CPU — its top-C over
         [B, D+1] is not free — so the production path is this zero-
         overhead static specialization.)"""
-        block = self._block_size()
         q = np.asarray(q_terms, np.int32)
+        return self._dispatch_degradable(
+            lambda: self._topk_primary(q, k, scoring),
+            lambda: self._topk_host(q, k, scoring),
+            deadline_s, "score dispatch",
+            "answering from the host CPU backend")
+
+    def _dispatch_degradable(self, primary, fallback, deadline_s,
+                             label, consequence):
+        """The degraded-serving wrapper shared by topk() and
+        rerank_topk(): run `primary` under the per-batch deadline; on
+        expiry or device loss, count + log the event, set degraded_last,
+        and answer with `fallback`. Any other exception re-raises — a
+        program/shape bug must never silently degrade. With no deadline
+        and no fault plan installed this is a plain call."""
+        deadline = self.deadline_s if deadline_s is None else deadline_s
+        self.degraded_last = False
+        if deadline is None and faults.active() is None:
+            return primary()
+        reason = None
+        try:
+            return faults.run_with_deadline(primary, deadline)
+        except faults.ScoreDeadlineExceeded as e:
+            recovery_counters().incr("deadline_expired")
+            reason = str(e)
+        except Exception as e:
+            if not faults.is_device_loss(e):
+                raise
+            recovery_counters().incr("device_loss")
+            reason = f"device loss: {e}"
+        recovery_counters().incr("degraded_batches")
+        logger.warning("%s degraded (%s); %s", label, reason, consequence)
+        self.degraded_last = True
+        return fallback()
+
+    def _topk_primary(self, q: np.ndarray, k: int, scoring: str):
+        """The device scoring path (all layouts + MaxScore scheduling)."""
+        block = self._block_size()
         if self.layout != "sparse" or not self.prune:
             return self._blocked_dispatch(
                 block, lambda qb: self._topk_device(qb, k, scoring),
@@ -772,6 +878,70 @@ class Scorer:
                                           qb, k, scoring))
         return (np.concatenate([s1, s2])[inv],
                 np.concatenate([d1, d2])[inv])
+
+    def _topk_host(self, q: np.ndarray, k: int, scoring: str):
+        """Degraded-mode terminal fallback: score the batch on the host
+        CPU from the CSR postings columns — no device, no jit, bounded
+        latency. Same scoring models (and tie-break: score desc, docno
+        asc) as the device kernels, accumulated in float32 per posting
+        slice; tiny float differences vs the fused device einsums are
+        possible, which is why results ride tagged `degraded`.
+
+        Known cost on the serving-cache fast path: the cache carries no
+        CSR columns, so the FIRST degraded batch of such a Scorer pays
+        the lazy shard-read + assembly (`_pairs`) once — slow, but finite
+        and off the lost/hung device; every later degraded batch reuses
+        the assembled columns."""
+        from .phrase import B as _b, K1 as _k1  # THE shared BM25 constants
+
+        if self._pairs_cols is None:
+            logger.warning(
+                "degraded fallback is assembling the postings columns "
+                "from the part shards (one-time; the serving cache does "
+                "not carry them)")
+        pt, pd, ptf = self._pairs
+        df = self._df_host().astype(np.int64)
+        indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
+        n = self.meta.num_docs
+        doc_len = np.asarray(self.doc_len).astype(np.float32)
+        if scoring == "bm25":
+            dff = df.astype(np.float32)
+            idf = np.where(df > 0,
+                           np.log(1.0 + (n - dff + 0.5) / (dff + 0.5)),
+                           0.0).astype(np.float32)
+            avg = float(doc_len.sum()) / max(n, 1)
+            dl_norm = 1.0 - _b + _b * doc_len / max(avg, 1e-9)
+        else:
+            if self.compat_int_idf:
+                ratio = (n // np.maximum(df, 1)).astype(np.float32)
+            else:
+                ratio = (n / np.maximum(df, 1)).astype(np.float32)
+            idf = np.where(df > 0, np.log10(np.maximum(ratio, 1e-30)),
+                           0.0).astype(np.float32)
+        out_s = np.zeros((len(q), k), np.float32)
+        out_d = np.zeros((len(q), k), np.int32)
+        scores = np.zeros(n + 1, np.float32)
+        for qi, row in enumerate(q):
+            scores[:] = 0.0
+            for tid in row:
+                if tid < 0 or tid >= len(df) or df[tid] == 0:
+                    continue
+                sl = slice(int(indptr[tid]), int(indptr[tid + 1]))
+                tf = ptf[sl].astype(np.float32)
+                if scoring == "bm25":
+                    w = idf[tid] * tf * (_k1 + 1.0) / np.maximum(
+                        tf + _k1 * dl_norm[pd[sl]], 1e-9)
+                else:
+                    w = (1.0 + np.log(np.maximum(tf, 1.0))) * idf[tid]
+                # docnos are unique within one term's postings run, so
+                # fancy-index += accumulates correctly across terms
+                scores[pd[sl]] += w
+            top = np.argsort(-scores[1:], kind="stable")[:k] + 1
+            keep = scores[top] > 0.0
+            m = int(keep.sum())  # desc order => positives are a prefix
+            out_s[qi, :m] = scores[top[:m]]
+            out_d[qi, :m] = top[:m]
+        return out_s, out_d
 
     def _skip_plan(self, q: np.ndarray):
         """The MaxScore scheduling decision, single source for topk()
@@ -881,6 +1051,9 @@ class Scorer:
         """Dispatch one query block; returns device arrays without
         waiting. `skip_hot` statically omits the tiered hot-strip stage
         (exact only for blocks the scheduler certified hot-free)."""
+        faults.maybe_hang("score.hang")
+        if faults.should_fire("score.device_loss") is not None:
+            raise faults.DeviceLoss("injected device loss")
         q = jnp.asarray(q_terms)
         n = jnp.int32(self.meta.num_docs)
         if self.layout == "sharded":
@@ -957,12 +1130,26 @@ class Scorer:
 
     def rerank_topk(
         self, q_terms: np.ndarray, k: int = 10, candidates: int = 1000,
+        deadline_s: float | None = None,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Two-stage retrieval: BM25 top-`candidates`, then cosine TF-IDF
         (see ops/scoring.py::cosine_rerank_dense for the exact model)
         restricted to those candidates. The reference
         has no second stage; this is the MS MARCO-style composition on the
-        same resident index."""
+        same resident index.
+
+        Under a deadline the whole two-stage dispatch is bounded; on
+        expiry/device loss the batch degrades to single-stage host BM25
+        (the rerank is a quality refinement — dropping it under duress is
+        the intended degradation, tagged via `degraded_last`)."""
+        q = np.asarray(q_terms, np.int32)
+        return self._dispatch_degradable(
+            lambda: self._rerank_primary(q, k, candidates),
+            lambda: self._topk_host(q, k, "bm25"),
+            deadline_s, "rerank dispatch",
+            "answering with host BM25, rerank stage dropped")
+
+    def _rerank_primary(self, q_terms: np.ndarray, k: int, candidates: int):
         from ..ops import cosine_rerank_dense
         from ..ops.scoring import cosine_rerank_tiered
 
@@ -1057,6 +1244,10 @@ class Scorer:
         out = []
         for qi in range(len(texts)):
             res = SearchResult()
+            # surface the fallback to callers: a degraded batch's results
+            # are real rankings from the host backend, but SLAs/metrics
+            # must be able to tell them apart from the primary pipeline
+            res.degraded = self.degraded_last
             for s, dn in zip(scores[qi], docnos[qi]):
                 if dn <= 0:
                     continue

@@ -1,4 +1,4 @@
-from .report import JobReport
+from .report import JobReport, recovery_counters
 from .transfer import fetch_to_host
 
-__all__ = ["JobReport", "fetch_to_host"]
+__all__ = ["JobReport", "fetch_to_host", "recovery_counters"]

@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import json
 import os
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -60,3 +61,43 @@ class JobReport:
         with open(path, "w") as f:
             json.dump(out, f, indent=2, sort_keys=True)
         return path
+
+
+class RecoveryCounters:
+    """Process-wide recovery observability: every retry, degradation,
+    quarantine and integrity event increments a named counter here, so a
+    serving process (or a test) can assert that recoveries HAPPENED rather
+    than inferring them from silence. The JobReport counters cover one
+    build job; these cover the process — the Hadoop-counters idea applied
+    to the fault layer."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {}
+
+    def incr(self, name: str, amount: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return dict(self._counters)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counters.clear()
+
+
+_RECOVERY = RecoveryCounters()
+
+
+def recovery_counters() -> RecoveryCounters:
+    """The process-wide RecoveryCounters singleton. Counter names in use:
+    retries, retry_exhausted, overflow_retries, degraded_batches,
+    deadline_expired, device_loss, integrity_failures, quarantined,
+    spill_integrity_discards."""
+    return _RECOVERY

@@ -66,6 +66,7 @@ def shrink_for_fetch(a, valid: int, *, dtype=None, granule: int = 1 << 14):
 
 
 _SLICE_CAST_ROWS = None
+_SLICE_CAST_ROWS_MASKED = None
 
 
 def _slice_cast_rows(a, *, n: int, dtype):
@@ -82,19 +83,48 @@ def _slice_cast_rows(a, *, n: int, dtype):
     return _SLICE_CAST_ROWS(a, n=n, dtype=np.dtype(dtype))
 
 
+def _slice_cast_rows_masked(a, valid_rows, *, n: int, dtype):
+    # zero every slot past each row's valid count BEFORE the narrowing
+    # cast: padding sentinels (PAD_TERM) lie outside uint16 and would
+    # otherwise wrap silently. Elementwise ops preserve the leading-axis
+    # sharding, so on a mesh this still runs where each shard lives.
+    global _SLICE_CAST_ROWS_MASKED
+    if _SLICE_CAST_ROWS_MASKED is None:
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnames=("n", "dtype"))
+        def run(x, rows, *, n, dtype):
+            y = jax.lax.slice(x, (0, 0), (x.shape[0], n))
+            col = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+            return jnp.where(col < rows.astype(jnp.int32)[:, None], y,
+                             0).astype(dtype)
+
+        _SLICE_CAST_ROWS_MASKED = run
+    return _SLICE_CAST_ROWS_MASKED(a, valid_rows, n=n, dtype=np.dtype(dtype))
+
+
 def shrink_rows_for_fetch(a, valid: int, *, dtype=None,
-                          granule: int = 1 << 14):
+                          granule: int = 1 << 14, valid_rows=None):
     """shrink_for_fetch for [S, C] per-shard result arrays: every row
     keeps its first valid-bucket columns (the largest shard's valid
     prefix bounds them all), cast to the narrowest safe dtype. Slicing
     the trailing axis preserves the leading-axis sharding, so on a mesh
     the shrink runs where each shard lives and only real data rides the
-    D2H link. Padding slots may hold values outside the narrow dtype
-    (PAD_TERM); they wrap silently and are never read — callers slice
-    each row to its own valid prefix after the fetch."""
+    D2H link.
+
+    `valid_rows` (device int [S], each row's own valid count) ENFORCES the
+    padding contract: slots past a row's count are zeroed on device before
+    the cast, so padding sentinels (PAD_TERM) can never wrap into the
+    narrow dtype — a caller that reads past a row's prefix sees zeros, not
+    corrupted values (ADVICE r5). Without it the legacy contract applies:
+    padding may hold wrapped sentinels and callers MUST slice each row to
+    its valid prefix after the fetch."""
     cap = a.shape[1]
     n = min(cap, max(granule, -(-valid // granule) * granule))
     dt = np.dtype(dtype) if dtype is not None else np.dtype(a.dtype)
+    if valid_rows is not None:
+        return _slice_cast_rows_masked(a, valid_rows, n=n, dtype=dt)
     if n == cap and dt == np.dtype(a.dtype):
         return a
     return _slice_cast_rows(a, n=n, dtype=dt)
