@@ -1,0 +1,119 @@
+"""Multi-threaded serving soak under chaos: the long-running twin of
+tests/test_serving.py's fast soak, driving mixed traffic through the
+ServingFrontend (admission control + degradation ladder + circuit
+breaker) while a deterministic fault plan injects hangs and device
+losses on the score dispatch.
+
+Reports the invariant counters as JSON and exits non-zero when any
+serving invariant breaks (deadlock, untagged mismatch, vanished
+request, unstructured error).
+
+Usage:
+  python experiments/soak_serving.py INDEX_DIR [options]
+  python experiments/soak_serving.py --synthetic 2000 [options]
+
+--synthetic N builds an N-doc corpus + index in a temp dir first (no
+index needed on disk); see --help for the traffic/chaos knobs. Runs
+hermetically on the CPU backend with an 8-virtual-device mesh, same
+harness stance as the other experiments.
+"""
+
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=8")
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import jax._src.xla_bridge as xb
+
+for _n in list(xb._backend_factories):
+    if _n != "cpu":
+        xb._backend_factories.pop(_n, None)
+
+WORDS = ("salmon fish river bear honey fox dog run market investor "
+         "asset bond stock season rain forest quick brown lazy "
+         "mountain valley storm harbor signal").split()
+
+
+def synthetic_index(n_docs: int, tmp: str) -> str:
+    from tpu_ir.index.streaming import build_index_streaming
+
+    corpus = os.path.join(tmp, "corpus.trec")
+    with open(corpus, "w") as f:
+        for i in range(n_docs):
+            text = " ".join(WORDS[(i * 7 + j * 3) % len(WORDS)]
+                            for j in range(4 + i % 9))
+            f.write(f"<DOC>\n<DOCNO> S-{i:06d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    index_dir = os.path.join(tmp, "idx")
+    build_index_streaming([corpus], index_dir, k=1, num_shards=4,
+                          batch_docs=500, chargram_ks=[])
+    return index_dir
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("index_dir", nargs="?", default=None)
+    ap.add_argument("--synthetic", type=int, default=None, metavar="DOCS",
+                    help="build a synthetic index of DOCS documents "
+                         "instead of reading one from disk")
+    ap.add_argument("--layout", default="sparse",
+                    choices=["auto", "dense", "sparse", "sharded"])
+    ap.add_argument("--threads", type=int, default=16)
+    ap.add_argument("--queries", type=int, default=2000)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--deadline", type=float, default=0.25)
+    ap.add_argument("--concurrency", type=int, default=4)
+    ap.add_argument("--queue-depth", type=int, default=8)
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="skip fault injection (pure overload soak)")
+    ap.add_argument("--faults", default=None, metavar="SPEC",
+                    help="custom fault plan spec (default: the chaos "
+                         "plan serving/soak.py ships)")
+    ap.add_argument("--timeout", type=float, default=600.0)
+    args = ap.parse_args()
+
+    from tpu_ir.search import Scorer
+    from tpu_ir.serving import DEFAULT_CHAOS_PLAN, ServingConfig, run_soak
+
+    tmp = None
+    try:
+        if args.synthetic is not None:
+            tmp = tempfile.mkdtemp(prefix="soak-serving-")
+            index_dir = synthetic_index(args.synthetic, tmp)
+        elif args.index_dir:
+            index_dir = args.index_dir
+        else:
+            ap.error("give INDEX_DIR or --synthetic N")
+        scorer = Scorer.load(index_dir, layout=args.layout)
+        spec = (None if args.no_chaos
+                else (args.faults or DEFAULT_CHAOS_PLAN))
+        report = run_soak(
+            scorer, threads=args.threads, queries=args.queries,
+            seed=args.seed, fault_spec=spec,
+            config=ServingConfig(
+                max_concurrency=args.concurrency,
+                max_queue=args.queue_depth, deadline_s=args.deadline,
+                breaker_threshold=4, breaker_cooldown_s=0.2),
+            timeout_s=args.timeout)
+    finally:
+        if tmp:
+            shutil.rmtree(tmp, ignore_errors=True)
+    print(json.dumps(report, indent=2, sort_keys=True, default=repr))
+    ok = (report["errors"] == 0 and report["deadlocked"] == 0
+          and report["untagged_mismatches"] == 0
+          and report["served"] + report["shed"] == report["submitted"])
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

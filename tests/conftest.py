@@ -30,3 +30,27 @@ for _name in list(_xb._backend_factories):
         _xb._backend_factories.pop(_name, None)
 
 assert len(jax.devices("cpu")) == 8, "expected 8 virtual CPU devices"
+
+import threading  # noqa: E402
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_nondaemon_threads():
+    """Fail any test that leaks a live non-daemon thread.
+
+    The serving/fault layers run work on threads by design (deadline
+    dispatches, soak workers, admission waiters) — but every one of them
+    must be daemon or joined by test end. A leaked non-daemon thread
+    would outlive its test, block interpreter exit, and silently defeat
+    the abandoned-dispatch cap this suite exists to enforce."""
+    before = set(threading.enumerate())
+    yield
+    leaked = [t for t in threading.enumerate()
+              if t not in before and t.is_alive() and not t.daemon]
+    for t in leaked:          # grace: threads mid-shutdown may just need
+        t.join(timeout=2.0)   # a moment to exit cleanly
+    leaked = [t for t in leaked if t.is_alive()]
+    assert not leaked, ("test leaked non-daemon thread(s): "
+                        + ", ".join(repr(t) for t in leaked))

@@ -567,6 +567,130 @@ def test_no_deadline_no_plan_takes_primary_path(served):
     assert (docnos > 0).any()
 
 
+def test_concurrent_queries_tag_exactly_one_degraded(served):
+    """The degraded_last race regression (ISSUE 2 satellite): two queries
+    running CONCURRENTLY with exactly one injected device loss must come
+    back with exactly one tagged degraded — the per-request flag rides
+    the return path (topk_tagged -> SearchResult.degraded), so one
+    thread's fallback can never mis-tag the other thread's result."""
+    import threading
+
+    s = served
+    texts = ["salmon fishing", "stock market"]
+    clean = [[k for k, _ in r]
+             for r in s.search_batch(texts, k=5, scoring="bm25")]
+    faults.install(faults.parse_plan("score.device_loss:once@1"))
+    results = [None, None]
+    barrier = threading.Barrier(2)
+
+    def go(i: int) -> None:
+        barrier.wait()
+        results[i] = s.search_batch([texts[i]], k=5, scoring="bm25")[0]
+
+    try:
+        threads = [threading.Thread(target=go, args=(i,), daemon=True)
+                   for i in range(2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(30)
+        assert all(not t.is_alive() for t in threads)
+    finally:
+        faults.clear()
+    assert all(r is not None for r in results)
+    flags = [r.degraded for r in results]
+    assert sum(flags) == 1, f"exactly one must degrade, got {flags}"
+    assert recovery_counters().get("device_loss") == 1
+    # BOTH results are the correct ranking regardless of which degraded
+    for got, want in zip(results, clean):
+        assert [k for k, _ in got] == want
+
+
+@pytest.mark.parametrize("layout", ["sparse", "sharded"])
+@pytest.mark.parametrize("op", ["topk", "rerank"])
+def test_degraded_fallback_matrix(ref, layout, op):
+    """The host-CPU degraded fallback across the tiered and sharded
+    layouts (PR 1 pinned it on the dense path only). Every (layout, op)
+    cell must: fire the injected device loss, tag the batch degraded,
+    and answer with the host model's ranking. The sharded rerank cell is
+    the one this matrix originally exposed — its dispatch bypassed
+    _topk_device, so no injection site (and no real device loss
+    detection coverage) existed on that path."""
+    _, ref_dir = ref
+    s = Scorer.load(ref_dir, layout=layout)
+    q = s.analyze_queries(["salmon fishing", "stock market"])
+
+    def run():
+        if op == "topk":
+            return s.topk_tagged(q, k=5, scoring="bm25")
+        return s.rerank_topk_tagged(q, k=5, candidates=20)
+
+    cs, cd, cdeg = run()
+    assert not cdeg and (cd > 0).any()
+    faults.install(faults.parse_plan("score.device_loss:once@1"))
+    try:
+        ds, dd, ddeg = run()
+    finally:
+        faults.clear()
+    assert ddeg, f"{layout}/{op}: injected device loss did not degrade"
+    assert recovery_counters().get("device_loss") == 1
+    assert recovery_counters().get("degraded_batches") == 1
+    assert (dd > 0).any()
+    # the degraded answer IS the host model's ranking (rerank falls back
+    # to single-stage host BM25 by contract)
+    hs, hd = s._topk_host(q, 5, "bm25")
+    np.testing.assert_array_equal(np.asarray(dd), hd)
+
+
+def test_hot_only_dispatch_is_tagged_partial(ref):
+    """The overload ladder's hot-tier-only level on a full Scorer: a
+    hot_only dispatch must never be mistaken for full service — it runs
+    the device path (not degraded) and the serving frontend tags its
+    level. Here: results are a subset of the full model's contributions
+    (scores bounded above by the full scores)."""
+    _, ref_dir = ref
+    s = Scorer.load(ref_dir, layout="sparse")
+    q = s.analyze_queries(["salmon fishing river"])
+    fs, fd, fdeg = s.topk_tagged(q, k=5, scoring="bm25")
+    hs, hd, hdeg = s.topk_tagged(q, k=5, scoring="bm25", hot_only=True)
+    assert not fdeg and not hdeg
+    # hot-only is a lower bound on the full model: its best score cannot
+    # exceed the full model's best
+    assert float(hs.max()) <= float(fs.max()) + 1e-5
+
+
+# ---------------------------------------------------------------------------
+# quarantine retention (bounded .quarantine/ growth)
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_retention_keeps_last_k(tmp_path):
+    d = str(tmp_path)
+    for i in range(7):
+        with open(os.path.join(d, f"part-{i:05d}.npz"), "wb") as f:
+            f.write(b"corrupt" + bytes([i]))
+        fmt.quarantine(d, f"part-{i:05d}.npz", keep=4)
+        time.sleep(0.002)  # distinct quarantine stamps
+    qdir = os.path.join(d, fmt.QUARANTINE_DIR)
+    kept = sorted(os.listdir(qdir))
+    # the 4 most recently quarantined survive; the 3 oldest evicted
+    assert kept == [f"part-{i:05d}.npz" for i in (3, 4, 5, 6)]
+    assert recovery_counters().get("quarantined") == 7
+    assert recovery_counters().get("quarantine_evicted") == 3
+
+
+def test_quarantine_retention_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_IR_QUARANTINE_KEEP", "2")
+    d = str(tmp_path)
+    for i in range(4):
+        with open(os.path.join(d, f"doc_len-{i}.npy"), "wb") as f:
+            f.write(b"x")
+        fmt.quarantine(d, f"doc_len-{i}.npy")
+        time.sleep(0.002)
+    assert len(os.listdir(os.path.join(d, fmt.QUARANTINE_DIR))) == 2
+    assert recovery_counters().get("quarantine_evicted") == 2
+
+
 # ---------------------------------------------------------------------------
 # end-to-end: the CLI surfaces structured errors, never tracebacks
 # ---------------------------------------------------------------------------

@@ -360,6 +360,49 @@ def test_bm25_tiered_matches_dense():
                                    rtol=1e-4, err_msg=str(kw))
 
 
+def test_hot_only_scores_exactly_the_hot_strip():
+    """hot_only=True (the overload ladder's cheapest device level) must
+    score EXACTLY the hot-strip contributions: a mixed hot+cold query
+    under hot_only equals the same query with its cold terms removed
+    under full scoring, and a cold-only query scores nothing."""
+    from tpu_ir.ops.scoring import tfidf_topk_tiered
+    from tpu_ir.search.layout import build_tiered_layout
+
+    p, oracle, vocab, ndocs = _small_index()
+    df = np.asarray(p.df)
+    pd_, pt_ = np.asarray(p.pair_doc), np.asarray(p.pair_tf)
+    t = build_tiered_layout(pd_, pt_, df, num_docs=ndocs,
+                            hot_budget=10**12, base_cap=2, growth=4)
+    hot = np.nonzero(t.hot_rank >= 0)[0]
+    cold = np.nonzero((t.hot_rank < 0) & (df > 0))[0]
+    assert len(hot) >= 1 and len(cold) >= 1, "regime must split the vocab"
+    args = (jnp.asarray(t.hot_rank), t.hot_device(),
+            jnp.asarray(t.tier_of), jnp.asarray(t.row_of),
+            tuple(jnp.asarray(a) for a in t.tier_docs),
+            tuple(jnp.asarray(a) for a in t.tier_tfs),
+            p.df, jnp.int32(ndocs))
+
+    q_mixed = np.array([[int(hot[0]), int(cold[0])]], np.int32)
+    q_hot = np.array([[int(hot[0]), -1]], np.int32)
+    s_ho, d_ho = tfidf_topk_tiered(jnp.asarray(q_mixed), *args,
+                                   num_docs=ndocs, k=5, hot_only=True)
+    s_ref, d_ref = tfidf_topk_tiered(jnp.asarray(q_hot), *args,
+                                     num_docs=ndocs, k=5)
+    np.testing.assert_allclose(np.asarray(s_ho), np.asarray(s_ref),
+                               rtol=1e-5)
+    np.testing.assert_array_equal(np.asarray(d_ho), np.asarray(d_ref))
+
+    q_cold = np.array([[int(cold[0]), -1]], np.int32)
+    s0, d0 = tfidf_topk_tiered(jnp.asarray(q_cold), *args,
+                               num_docs=ndocs, k=5, hot_only=True)
+    assert not np.asarray(d0).any(), "cold-only query must score nothing"
+
+    # skip_hot + hot_only together would score nothing at all — rejected
+    with pytest.raises(ValueError):
+        tfidf_topk_tiered(jnp.asarray(q_hot), *args, num_docs=ndocs,
+                          k=5, hot_only=True, skip_hot=True)
+
+
 def test_hot_strip_coo_densify():
     """The hot strip is carried as COO postings (the serving cold-start
     fix: COO crosses the H2D link, the dense strip is scattered on device).

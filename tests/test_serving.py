@@ -1,0 +1,575 @@
+"""Overload-serving acceptance suite: admission control, the degradation
+ladder, the circuit breaker, and the concurrent chaos soak.
+
+The contract (ISSUE 2): under concurrency + injected faults, every
+response is either correct-full (bit-identical to a serial reference),
+tagged-degraded (level / degraded flag explains the divergence), or a
+structured Overloaded rejection — and nothing deadlocks, nothing is
+silently wrong, and shed + served always equals submitted."""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import tpu_ir.faults as faults
+from tpu_ir.index.streaming import build_index_streaming
+from tpu_ir.search import Scorer
+from tpu_ir.serving import (
+    LEVEL_FULL,
+    LEVEL_NO_RERANK,
+    LEVEL_SHED,
+    AdmissionController,
+    CircuitBreaker,
+    DegradationLadder,
+    Overloaded,
+    ServingConfig,
+    ServingFrontend,
+    run_soak,
+)
+from tpu_ir.utils.report import recovery_counters, serving_counters
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    recovery_counters().reset()
+    serving_counters().reset()
+    yield
+    faults.clear()
+    faults.drain_abandoned(timeout_s=10.0)
+    recovery_counters().reset()
+    serving_counters().reset()
+
+
+def write_corpus(path, n_docs=120):
+    body = []
+    for i in range(n_docs):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + (i % 7)))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    path.write_text("".join(body))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("serving")
+    corpus = write_corpus(tmp / "corpus.trec")
+    out = str(tmp / "idx")
+    build_index_streaming([corpus], out, k=1, num_shards=3,
+                          batch_docs=40, chargram_ks=[])
+    return out
+
+
+@pytest.fixture(scope="module")
+def scorer(index_dir):
+    s = Scorer.load(index_dir, layout="sparse")
+    # warm the compile caches so per-request deadlines in these tests
+    # measure serving, not XLA compilation
+    s.search_batch(["salmon fishing"], k=5, scoring="bm25")
+    s.search_batch(["salmon fishing"], k=5, scoring="tfidf")
+    s.search_batch(["salmon fishing"], k=5, rerank=25)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+
+def test_admission_sheds_past_queue_capacity():
+    adm = AdmissionController(max_concurrency=1, max_queue=1)
+    release = threading.Event()
+    holding = threading.Event()
+    waiting = threading.Event()
+
+    def holder():
+        with adm.admit():
+            holding.set()
+            release.wait(10)
+
+    def waiter():
+        waiting.set()
+        with adm.admit(queue_timeout_s=10):
+            pass
+
+    threads = [threading.Thread(target=holder, daemon=True)]
+    threads[0].start()
+    assert holding.wait(5)
+    threads.append(threading.Thread(target=waiter, daemon=True))
+    threads[1].start()
+    assert waiting.wait(5)
+    deadline = time.monotonic() + 5
+    while adm.queue_depth() < 1 and time.monotonic() < deadline:
+        time.sleep(0.005)
+    assert adm.queue_depth() == 1 and adm.pressure() == 1.0
+    # queue full: the third request sheds IMMEDIATELY with structure
+    t0 = time.perf_counter()
+    with pytest.raises(Overloaded) as ei:
+        with adm.admit():
+            pass
+    assert time.perf_counter() - t0 < 0.5, "shed was not immediate"
+    assert ei.value.reason == "queue_full"
+    assert ei.value.queue_depth == 1
+    release.set()
+    for t in threads:
+        t.join(10)
+    assert adm.queue_depth() == 0 and adm.pressure() == 0.0
+
+
+def test_admission_zero_queue_executes_without_queuing():
+    """max_queue=0 means 'execute, never queue' — an idle controller
+    must still admit up to max_concurrency, and only a request that
+    would have to WAIT is shed."""
+    adm = AdmissionController(max_concurrency=2, max_queue=0)
+    with adm.admit():
+        assert adm.queue_depth() == 0     # executing != waiting
+        with adm.admit():
+            with pytest.raises(Overloaded) as ei:
+                with adm.admit():
+                    pass
+            assert ei.value.reason == "queue_full"
+    with adm.admit():                     # slots free again
+        pass
+
+
+def test_admission_queue_timeout_sheds():
+    adm = AdmissionController(max_concurrency=1, max_queue=4)
+    release = threading.Event()
+    holding = threading.Event()
+
+    def holder():
+        with adm.admit():
+            holding.set()
+            release.wait(10)
+
+    t = threading.Thread(target=holder, daemon=True)
+    t.start()
+    assert holding.wait(5)
+    with pytest.raises(Overloaded) as ei:
+        with adm.admit(queue_timeout_s=0.05):
+            pass
+    assert ei.value.reason == "queue_timeout"
+    release.set()
+    t.join(10)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker state machine
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_state_machine_with_probes():
+    clock = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=3, cooldown_s=1.0,
+                        clock=lambda: clock["t"])
+    assert br.state == "closed"
+    for _ in range(2):
+        assert br.allow_device() == (True, False)
+        assert not br.record_failure()
+    assert br.state == "closed"          # under threshold
+    assert br.allow_device() == (True, False)
+    assert br.record_failure()           # third consecutive: OPENS (True)
+    assert br.state == "open"
+    assert br.allow_device() == (False, False)  # cooldown not elapsed
+    clock["t"] = 0.5
+    assert br.allow_device() == (False, False)
+    clock["t"] = 1.5                     # cooldown elapsed: ONE probe
+    assert br.allow_device() == (True, True)
+    assert br.state == "half_open"
+    assert br.allow_device() == (False, False)  # probe slot is exclusive
+    assert br.record_failure(is_probe=True)  # probe failed: RE-opens
+    assert br.state == "open"            # (counted — operators see flap)
+    assert br.allow_device() == (False, False)
+    clock["t"] = 3.0
+    assert br.allow_device() == (True, True)    # second probe
+    br.record_success(is_probe=True)     # device is back
+    assert br.state == "closed"
+    assert br.allow_device() == (True, False)
+    snap = br.snapshot()
+    assert snap["opened_count"] == 2 and snap["probe_count"] == 2
+
+
+def test_breaker_stale_verdicts_cannot_move_the_state():
+    """Verdicts are attributed by the is_probe token allow_device handed
+    the request, never by re-reading shared state: a request admitted
+    BEFORE the breaker opened must not close it with a late success,
+    and its late failure must not consume (or delay) the probe slot."""
+    clock = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=2, cooldown_s=1.0,
+                        clock=lambda: clock["t"])
+    assert br.allow_device() == (True, False)   # request A, slow
+    for _ in range(2):                   # B, C fail: breaker opens
+        br.allow_device()
+        br.record_failure()
+    assert br.state == "open"
+    clock["t"] = 1.5
+    assert br.allow_device() == (True, True)    # probe P in flight
+    br.record_success(is_probe=False)    # A's STALE success arrives
+    assert br.state == "half_open", \
+        "a stale pre-open success must not close the breaker"
+    br.record_failure(is_probe=False)    # another stale failure
+    assert br.state == "half_open", \
+        "a stale failure must not consume the probe slot"
+    br.record_success(is_probe=True)     # P's real verdict
+    assert br.state == "closed"
+
+
+def test_breaker_abort_releases_probe_slot():
+    """A probe request dying WITHOUT a device verdict (bad query, program
+    bug) must release the exclusive probe slot — otherwise the breaker
+    wedges half-open and all traffic serves the fallback forever."""
+    clock = {"t": 0.0}
+    br = CircuitBreaker(failure_threshold=1, cooldown_s=1.0,
+                        clock=lambda: clock["t"])
+    br.allow_device()
+    br.record_failure()                  # opens
+    clock["t"] = 1.5
+    assert br.allow_device() == (True, True)    # the probe
+    br.abort(is_probe=True)              # probe died verdictless
+    assert br.state == "open"
+    clock["t"] = 3.0
+    assert br.allow_device() == (True, True), \
+        "a later probe must still be possible after an aborted one"
+    # abort of a non-probe request is a no-op
+    br.record_success(is_probe=True)
+    br.abort(is_probe=False)
+    assert br.state == "closed" and br.allow_device() == (True, False)
+
+
+def test_frontend_exception_releases_probe_and_surfaces(scorer, monkeypatch):
+    """A request error during the half-open probe must neither be
+    swallowed nor wedge the breaker (the probe slot is released)."""
+    from tpu_ir.search.scorer import Scorer as ScorerCls
+
+    cfg = ServingConfig(deadline_s=1.0, breaker_threshold=1,
+                        breaker_cooldown_s=0.0, fail_threshold=1000)
+    fe = ServingFrontend(scorer, cfg)
+    faults.install(faults.parse_plan("score.device_loss:once@1"))
+    try:
+        fe.search("salmon fishing", k=5)          # opens the breaker
+        assert fe.breaker.state == "open"
+    finally:
+        faults.clear()
+
+    def boom(self, *a, **kw):
+        raise RuntimeError("not a device verdict")
+
+    with monkeypatch.context() as m:
+        m.setattr(ScorerCls, "search_batch", boom)
+        with pytest.raises(RuntimeError):
+            fe.search("salmon fishing", k=5)      # the probe, dying
+    # slot released: the next request can probe for real and close
+    res = fe.search("salmon fishing", k=5)
+    assert not res.degraded and fe.breaker.state == "closed"
+
+
+# ---------------------------------------------------------------------------
+# degradation ladder
+# ---------------------------------------------------------------------------
+
+
+def test_ladder_steps_down_up_with_hysteresis():
+    clock = {"t": 0.0}
+    moves = []
+    cfg = ServingConfig(fail_threshold=2, recover_successes=3,
+                        step_down_pressure=0.75, step_up_pressure=0.25,
+                        down_cooldown_s=1.0)
+    ladder = DegradationLadder(
+        ("full", "no_rerank", "shed"), cfg,
+        lambda *m: moves.append(m), clock=lambda: clock["t"])
+    assert ladder.level() == "full"
+    ladder.observe(pressure=0.0, failed=True)
+    assert ladder.level() == "full"      # one failure is not a trend
+    ladder.observe(pressure=0.0, failed=True)
+    assert ladder.level() == "no_rerank"  # fail_threshold reached
+    # a second trigger inside the cooldown must NOT cascade to shed
+    ladder.observe(pressure=1.0, failed=False)
+    assert ladder.level() == "no_rerank"
+    clock["t"] = 2.0
+    ladder.observe(pressure=1.0, failed=False)
+    assert ladder.level() == "shed"      # cooldown elapsed: steps again
+    # recovery: calm observations, one level at a time, earned each time
+    for _ in range(3):
+        assert ladder.level() == "shed"
+        ladder.observe(pressure=0.0, failed=False)
+    assert ladder.level() == "no_rerank"
+    ladder.observe(pressure=0.5, failed=False)   # middle zone: no credit
+    for _ in range(3):
+        ladder.observe(pressure=0.0, failed=False)
+    assert ladder.level() == "full"
+    assert [m[0] for m in moves] == ["down", "down", "up", "up"]
+
+
+# ---------------------------------------------------------------------------
+# frontend behavior
+# ---------------------------------------------------------------------------
+
+
+def test_frontend_full_level_matches_scorer(scorer):
+    fe = ServingFrontend(scorer, ServingConfig(deadline_s=5.0))
+    res = fe.search("salmon fishing", k=5, scoring="bm25")
+    assert res.level == LEVEL_FULL and not res.degraded
+    direct = scorer.search_batch(["salmon fishing"], k=5,
+                                 scoring="bm25")[0]
+    assert list(res) == list(direct)
+    st = fe.stats()
+    assert st["submitted"] == 1 and st["served_full"] == 1
+
+
+def test_frontend_steps_down_and_tags_levels(scorer):
+    """Repeated dispatch failures walk the ladder down; each response is
+    tagged with the level that served it, and the rerank stage is
+    actually dropped below full."""
+    cfg = ServingConfig(deadline_s=1.0, fail_threshold=2,
+                        down_cooldown_s=0.0, breaker_threshold=1000)
+    fe = ServingFrontend(scorer, cfg)
+    faults.install(faults.parse_plan("score.device_loss:first@4"))
+    try:
+        seen = []
+        for _ in range(4):
+            res = fe.search("salmon river", k=5, scoring="bm25",
+                            rerank=25)
+            seen.append((res.level, res.degraded))
+    finally:
+        faults.clear()
+    # first two failures at full; third request served at no_rerank
+    assert seen[0] == (LEVEL_FULL, True) and seen[1] == (LEVEL_FULL, True)
+    assert seen[2][0] == LEVEL_NO_RERANK
+    assert fe.stats()["level_step_down"] >= 1
+    # ladder levels on the tiered layout include hot_only
+    assert fe.ladder.levels == ("full", "no_rerank", "hot_only", "shed")
+
+
+def test_frontend_shed_level_rejects_and_recovers(scorer):
+    cfg = ServingConfig(deadline_s=1.0, fail_threshold=1,
+                        down_cooldown_s=0.0, recover_successes=2,
+                        breaker_threshold=1000)
+    fe = ServingFrontend(scorer, cfg)
+    faults.install(faults.parse_plan("score.device_loss:first@3"))
+    try:
+        for _ in range(3):   # full -> no_rerank -> hot_only -> shed
+            fe.search("salmon fishing", k=3)
+    finally:
+        faults.clear()
+    assert fe.ladder.level() == LEVEL_SHED
+    with pytest.raises(Overloaded) as ei:
+        fe.search("salmon fishing", k=3)
+    assert ei.value.reason == "shed_level" and ei.value.level == LEVEL_SHED
+    # shed observations under calm pressure earn the way back up
+    for _ in range(20):
+        try:
+            fe.search("salmon fishing", k=3)
+        except Overloaded:
+            continue
+    assert fe.ladder.level() == LEVEL_FULL
+    st = fe.stats()
+    assert st["shed_level"] >= 1
+    assert st["level_step_up"] >= 3
+    assert st["submitted"] == st.get("shed_level", 0) + sum(
+        v for k, v in st.items()
+        if isinstance(v, int) and k.startswith("served_"))
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker saves work (the >= 10x latency criterion)
+# ---------------------------------------------------------------------------
+
+
+def test_breaker_open_is_10x_faster_than_deadline_per_request(scorer):
+    """With the device path forced down (every dispatch hangs), the
+    closed breaker pays the full deadline per request; once open, the
+    frontend serves the host fallback directly — steady-state latency
+    must be at least 10x below deadline-per-request."""
+    deadline = 0.25
+    cfg = ServingConfig(deadline_s=deadline, breaker_threshold=2,
+                        breaker_cooldown_s=300.0,  # no probes mid-test
+                        fail_threshold=1000)       # isolate the breaker
+    fe = ServingFrontend(scorer, cfg)
+    faults.install(faults.FaultPlan().add("score.hang", "always",
+                                          sleep_s=1.0))
+    try:
+        t0 = time.perf_counter()
+        r1 = fe.search("salmon fishing", k=5)
+        closed_latency = time.perf_counter() - t0
+        assert r1.degraded
+        assert closed_latency >= deadline * 0.8, \
+            "closed-state failure should pay ~the deadline"
+        fe.search("stock market", k=5)            # second failure: opens
+        assert fe.breaker.state == "open"
+
+        lat = []
+        for i in range(20):
+            t0 = time.perf_counter()
+            res = fe.search(f"salmon river {WORDS[i % len(WORDS)]}", k=5)
+            lat.append(time.perf_counter() - t0)
+            assert res.degraded, "breaker-open serving must stay tagged"
+        steady = sum(lat) / len(lat)
+    finally:
+        faults.clear()
+    assert fe.stats()["served_breaker_host"] == 20
+    assert steady * 10 <= deadline, (
+        f"open-breaker latency {steady:.4f}s not >=10x below the "
+        f"{deadline}s deadline")
+
+
+def test_breaker_probe_closes_on_recovery(scorer):
+    cfg = ServingConfig(deadline_s=1.0, breaker_threshold=1,
+                        breaker_cooldown_s=0.05, fail_threshold=1000)
+    fe = ServingFrontend(scorer, cfg)
+    faults.install(faults.parse_plan("score.device_loss:once@1"))
+    try:
+        r = fe.search("salmon fishing", k=5)
+        assert r.degraded and fe.breaker.state == "open"
+        time.sleep(0.08)                 # cooldown elapses; plan exhausted
+        r2 = fe.search("salmon fishing", k=5)   # the half-open probe
+        assert not r2.degraded and r2.level == LEVEL_FULL
+        assert fe.breaker.state == "closed"
+        assert fe.stats()["breaker_probes"] == 1
+    finally:
+        faults.clear()
+
+
+# ---------------------------------------------------------------------------
+# the concurrent chaos soak (fast tier-1 variant + long slow variant)
+# ---------------------------------------------------------------------------
+
+
+def _assert_soak_invariants(report):
+    assert report["deadlocked"] == 0, "soak deadlocked"
+    assert report["errors"] == 0, report["error_samples"]
+    assert report["untagged_mismatches"] == 0, \
+        "untagged response diverged from the serial reference"
+    assert report["served"] + report["shed"] == report["submitted"]
+    fe = report["frontend"]
+    assert fe["submitted"] == report["submitted"]
+    served_by_level = sum(v for k, v in fe.items()
+                          if isinstance(v, int) and k.startswith("served_")
+                          and k != "served_breaker_host")
+    shed_total = sum(v for k, v in fe.items()
+                     if isinstance(v, int) and k.startswith("shed_"))
+    assert served_by_level == report["served"]
+    assert shed_total == report["shed"]
+
+
+def test_soak_fast_8x200_under_chaos(scorer):
+    """The tier-1 acceptance soak: >= 8 worker threads x >= 200 mixed
+    queries with hang + device-loss sites firing, done in seconds on a
+    fixed seed. Zero deadlocks, zero untagged divergence, conservation
+    of requests — and the chaos must actually bite (degradations
+    observed), or the run proved nothing."""
+    report = run_soak(
+        scorer, threads=8, queries=220, seed=0,
+        fault_spec=("score.hang:p=0.15:sleep=0.5,"
+                    "score.device_loss:p=0.1,seed=2"),
+        config=ServingConfig(max_concurrency=3, max_queue=4,
+                             deadline_s=0.2, queue_timeout_s=0.15,
+                             breaker_threshold=4,
+                             breaker_cooldown_s=0.2),
+        timeout_s=90.0, pacing_s=0.002)
+    _assert_soak_invariants(report)
+    assert report["submitted"] == 220 and report["threads"] == 8
+    # the chaos bit: degraded responses exist and are all tagged
+    assert report["degraded"] > 0
+    assert report["full_bitidentical"] > 0, \
+        "no healthy full response was verified against the reference"
+    rec = report["recovery_delta"]
+    assert (rec.get("degraded_batches", 0)
+            + rec.get("forced_host_batches", 0)) == report["degraded"]
+
+
+def test_soak_without_faults_serves_everything_full(scorer):
+    """Control run: no fault plan, light load — everything serves at
+    full level, bit-identical, nothing degraded, nothing shed."""
+    report = run_soak(
+        scorer, threads=4, queries=60, seed=3, fault_spec=None,
+        config=ServingConfig(max_concurrency=4, max_queue=16,
+                             deadline_s=5.0),
+        timeout_s=60.0)
+    _assert_soak_invariants(report)
+    assert report["shed"] == 0 and report["degraded"] == 0
+    assert report["levels"] == {"full": 60}
+    assert report["full_bitidentical"] == 60
+
+
+@pytest.mark.slow
+def test_soak_long_sustained_chaos(scorer):
+    """The long soak: sustained mixed traffic with heavier chaos and
+    more workers; same invariants, plus the control plane must have
+    cycled (breaker opened AND recovered via probes at least once)."""
+    report = run_soak(
+        scorer, threads=16, queries=3000, seed=1,
+        fault_spec=("score.hang:p=0.1:sleep=0.4,"
+                    "score.device_loss:p=0.08,seed=5"),
+        config=ServingConfig(max_concurrency=4, max_queue=8,
+                             deadline_s=0.2, breaker_threshold=4,
+                             breaker_cooldown_s=0.15),
+        timeout_s=480.0, pacing_s=0.004)
+    _assert_soak_invariants(report)
+    assert report["degraded"] > 0
+    fe = report["frontend"]
+    assert fe.get("breaker_opened", 0) >= 1
+    assert fe.get("breaker_probes", 0) >= 1
+
+
+# ---------------------------------------------------------------------------
+# stats + serve-bench CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_stats_cli_output_shape(capsys):
+    from tpu_ir.cli import main
+
+    recovery_counters().incr("degraded_batches", 2)
+    serving_counters().incr("submitted", 5)
+    faults.install(faults.parse_plan("score.hang:once@1"))
+    faults.active().should_fire("score.hang")
+    rc = main(["stats"])
+    faults.clear()
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert set(out) == {"recovery", "serving", "fault_injection"}
+    for section in out.values():
+        assert all(isinstance(k, str) and isinstance(v, int)
+                   for k, v in section.items())
+    assert out["recovery"]["degraded_batches"] == 2
+    assert out["serving"]["submitted"] == 5
+    assert out["fault_injection"] == {"score.hang": 1}
+
+
+def test_serve_bench_cli_runs_and_reports(index_dir, capsys):
+    from tpu_ir.cli import main
+
+    rc = main(["serve-bench", index_dir, "--backend", "cpu",
+               "--layout", "sparse", "--queries", "40", "--threads", "4",
+               "--chaos", "--deadline", "0.2"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["submitted"] == 40
+    assert out["served"] + out["shed"] == 40
+    assert out["deadlocked"] == 0 and out["untagged_mismatches"] == 0
+
+
+def test_serve_bench_honors_env_var_fault_plan(index_dir, capsys,
+                                               monkeypatch):
+    """TPU_IR_FAULTS (the documented env twin of --faults) must drive
+    serve-bench's chaos phase — regression: lifting the plan off with
+    clear() used to re-arm the env var and crash run_soak's guard."""
+    from tpu_ir.cli import main
+
+    spec = "score.device_loss:p=0.3,seed=4"
+    monkeypatch.setenv("TPU_IR_FAULTS", spec)
+    faults.clear()   # let active() lazily pick the env var up
+    rc = main(["serve-bench", index_dir, "--backend", "cpu",
+               "--layout", "sparse", "--queries", "20", "--threads", "2"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["fault_spec"] == spec
+    assert out["degraded"] > 0, "the env plan never fired"

@@ -423,6 +423,62 @@ def cmd_warm(args) -> int:
     return 0
 
 
+def cmd_stats(args) -> int:
+    """Operator scrape surface: the process-wide recovery and serving
+    counters (utils/report.py) plus the installed fault plan's fire
+    counts, one JSON object. Counters are per-process — meaningful from
+    a serving process (serve-bench, a REPL, an embedding application),
+    and all-zero from a fresh CLI invocation; the output SHAPE is the
+    contract (tests pin it)."""
+    from . import faults
+    from .utils.report import recovery_counters, serving_counters
+
+    plan = faults.active()
+    print(json.dumps({
+        "recovery": recovery_counters().snapshot(),
+        "serving": serving_counters().snapshot(),
+        "fault_injection": plan.counters() if plan is not None else {},
+    }, sort_keys=True))
+    return 0
+
+
+def cmd_serve_bench(args) -> int:
+    """Drive the overload soak (serving/soak.py) against an index: N
+    worker threads of mixed seeded traffic through a ServingFrontend,
+    optionally under a chaos fault plan, reporting the invariant
+    counters as JSON. The operational twin of tests/test_serving.py's
+    soak — what the tests assert, an operator can reproduce."""
+    _apply_backend(args)
+    from .search import Scorer
+    from .serving import DEFAULT_CHAOS_PLAN, ServingConfig, run_soak
+
+    scorer = Scorer.load(args.index_dir, layout=args.layout)
+    spec = DEFAULT_CHAOS_PLAN if args.chaos else None
+    # --faults / TPU_IR_FAULTS install a plan process-wide; run_soak
+    # wants to own installation (the serial reference phase must stay
+    # clean), so lift the spec off and uninstall. install(None), NOT
+    # clear(): clear() forgets the env var was consumed and run_soak's
+    # guard would re-read TPU_IR_FAULTS and refuse to run
+    from . import faults
+
+    if faults.active() is not None:
+        spec = args.faults or os.environ.get("TPU_IR_FAULTS") or spec
+        faults.install(None)
+    report = run_soak(
+        scorer, threads=args.threads, queries=args.queries,
+        seed=args.seed, fault_spec=spec,
+        config=ServingConfig(
+            max_concurrency=args.concurrency, max_queue=args.queue_depth,
+            deadline_s=args.deadline,
+            breaker_threshold=args.breaker_threshold),
+        timeout_s=args.timeout)
+    print(json.dumps(report, sort_keys=True, default=repr))
+    ok = (report["errors"] == 0 and report["deadlocked"] == 0
+          and report["untagged_mismatches"] == 0
+          and report["served"] + report["shed"] == report["submitted"])
+    return 0 if ok else 1
+
+
 def cmd_eval(args) -> int:
     """Score a trec_eval-format run against qrels (search/evaluate.py):
     MAP / MRR / NDCG@10 / P@5 / P@10 / recall@100, no external tooling."""
@@ -558,6 +614,7 @@ def cmd_expand(args) -> int:
 _ARTIFACT_ENTRY_CMDS = frozenset({
     "cmd_search", "cmd_inspect", "cmd_verify", "cmd_warm", "cmd_docno",
     "cmd_expand", "cmd_eval", "cmd_count", "cmd_pack", "cmd_merge",
+    "cmd_serve_bench",
 })
 
 
@@ -683,6 +740,46 @@ def main(argv: list[str] | None = None) -> int:
                     help="delete an existing output index first")
     _add_backend_arg(pm)
     pm.set_defaults(fn=cmd_merge)
+
+    pst = sub.add_parser(
+        "stats", help="dump the process-wide recovery + serving counters "
+                      "and fault-plan fire counts as JSON")
+    pst.set_defaults(fn=cmd_stats)
+
+    pb = sub.add_parser(
+        "serve-bench",
+        help="overload soak: mixed multi-threaded traffic through the "
+             "serving frontend (admission control + degradation ladder + "
+             "circuit breaker), optionally under an injected chaos plan")
+    pb.add_argument("index_dir")
+    pb.add_argument("--threads", type=int, default=8,
+                    help="concurrent client worker threads")
+    pb.add_argument("--queries", type=int, default=240,
+                    help="total mixed queries across all workers")
+    pb.add_argument("--seed", type=int, default=0,
+                    help="workload + chaos seed (runs are replayable)")
+    pb.add_argument("--concurrency", type=int, default=4,
+                    help="admission: requests executing at once")
+    pb.add_argument("--queue-depth", type=int, default=8,
+                    help="admission: max requests waiting for a slot "
+                         "(past this, requests shed immediately)")
+    pb.add_argument("--deadline", type=float, default=0.25,
+                    help="per-request device dispatch deadline (s)")
+    pb.add_argument("--breaker-threshold", type=int, default=4,
+                    help="consecutive device failures that open the "
+                         "circuit breaker")
+    pb.add_argument("--timeout", type=float, default=300.0,
+                    help="whole-soak wall-clock bound (s); requests still "
+                         "pending past it count as deadlocked")
+    pb.add_argument("--chaos", action="store_true",
+                    help="inject the default chaos plan (hangs + device "
+                         "losses on the score dispatch); --faults SPEC "
+                         "overrides with a custom plan")
+    pb.add_argument("--layout",
+                    choices=["auto", "dense", "sparse", "sharded"],
+                    default="auto")
+    _add_backend_arg(pb)
+    pb.set_defaults(fn=cmd_serve_bench)
 
     pe = sub.add_parser("eval", help="score a trec_eval-format run file "
                                      "against qrels (MAP/MRR/NDCG@10/...)")

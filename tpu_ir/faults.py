@@ -392,3 +392,20 @@ def run_with_deadline(fn, deadline_s: float | None):
     if "e" in box:
         raise box["e"]
     return box["r"]
+
+
+def drain_abandoned(timeout_s: float = 5.0) -> int:
+    """Best-effort bounded join of abandoned dispatch threads; returns
+    how many are STILL alive. Soak drivers and test harnesses call this
+    before process exit: the threads are daemon (they cannot block
+    exit), but one still inside a device dispatch during interpreter
+    teardown can abort the XLA runtime — draining first makes shutdown
+    quiet."""
+    deadline = time.monotonic() + timeout_s
+    with _abandoned_lock:
+        threads = list(_abandoned)
+    for t in threads:
+        t.join(max(deadline - time.monotonic(), 0.0))
+    with _abandoned_lock:
+        _abandoned[:] = [t for t in _abandoned if t.is_alive()]
+        return len(_abandoned)

@@ -201,17 +201,43 @@ def verify_checksums(index_dir: str, meta: "IndexMetadata",
     return checked
 
 
-def quarantine(index_dir: str, name: str) -> str:
+# quarantined artifacts kept per index dir (newest win); override with
+# the TPU_IR_QUARANTINE_KEEP env var or the `keep` parameter. Without a
+# bound, a flaky disk feeding the quarantine-and-rebuild loop would grow
+# .quarantine/ by one part-file-sized corpse per incident, forever.
+QUARANTINE_KEEP = 8
+
+
+def quarantine(index_dir: str, name: str, *, keep: int | None = None) -> str:
     """Move a corrupt artifact into index_dir/.quarantine/ (overwriting a
     previous quarantine of the same name) so it is out of every reader's
-    path but preserved for post-mortem. Returns the quarantine path."""
+    path but preserved for post-mortem. Returns the quarantine path.
+
+    Retention: only the `keep` most recently quarantined artifacts are
+    preserved (default QUARANTINE_KEEP / $TPU_IR_QUARANTINE_KEEP);
+    older ones are deleted and counted as `quarantine_evicted`."""
     from ..utils.report import recovery_counters
 
+    if keep is None:
+        keep = int(os.environ.get("TPU_IR_QUARANTINE_KEEP",
+                                  QUARANTINE_KEEP))
     qdir = os.path.join(index_dir, QUARANTINE_DIR)
     os.makedirs(qdir, exist_ok=True)
     dest = os.path.join(qdir, name)
     os.replace(os.path.join(index_dir, name), dest)
+    # stamp QUARANTINE time: os.replace preserves the artifact's original
+    # mtime (build time), which would make retention order meaningless
+    os.utime(dest)
     recovery_counters().incr("quarantined")
+    entries = sorted(
+        (e for e in os.scandir(qdir) if e.is_file()),
+        key=lambda e: e.stat().st_mtime, reverse=True)
+    for stale in entries[max(keep, 1):]:
+        try:
+            os.remove(stale.path)
+        except OSError:
+            continue  # lost a race with another evictor; nothing to count
+        recovery_counters().incr("quarantine_evicted")
     return dest
 
 

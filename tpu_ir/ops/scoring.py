@@ -180,7 +180,8 @@ def _topk_from_scores(scores: jax.Array, k: int):
 def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
                    tier_tfs, q_weight, *, num_docs, hot_weight_fn,
                    cold_weight_fn, hot_cell_fn=None, hot_max_w=None,
-                   prune_k=None, with_stats=False, skip_hot=False):
+                   prune_k=None, with_stats=False, skip_hot=False,
+                   skip_cold=False):
     """Shared tiered accumulation: hot-strip einsum + one masked
     gather/scatter-add per df tier (see search/layout.py for the layout).
 
@@ -219,7 +220,15 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
         ].add(jnp.where(is_hot, q_w, 0.0), mode="drop")      # [B, H]
         return s + w_hot @ hot_weight_fn(hot_tfs)            # [B, D+1]
 
-    pruning = prune_k is not None
+    # `skip_cold` (static): the hot-tier-only degraded service level — the
+    # overloaded frontend serves just the hot-strip stage (one matmul) and
+    # omits every cold-tier gather/scatter, which is where the per-query
+    # work grows with corpus size. Scores are a LOWER BOUND on the full
+    # model (cold-term contributions are simply absent), so results ride
+    # tagged with their service level, never as full answers.
+    if skip_cold and skip_hot:
+        raise ValueError("skip_cold and skip_hot together score nothing")
+    pruning = prune_k is not None and not skip_cold
     # `skip_hot` (static): the caller certified every query in the block
     # is hot-term-free, so the hot stage contributes EXACTLY zero — omit
     # it entirely (no matmul, no cond, no candidate machinery). This is
@@ -239,7 +248,8 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
     def add_cold(acc_q, slots_q, w_q):
         return acc_q.at[slots_q.ravel()].add(w_q.ravel(), mode="drop")
 
-    for i, (tdocs, ttfs) in enumerate(zip(tier_docs, tier_tfs)):
+    for i, (tdocs, ttfs) in enumerate(
+            () if skip_cold else zip(tier_docs, tier_tfs)):
         in_tier = (tof == i) & q_valid & ~is_hot             # [B, L]
 
         def do_tier(s, in_tier=in_tier, tdocs=tdocs, ttfs=ttfs):
@@ -328,7 +338,7 @@ def _hot_stage_pruned(partial, hot_tfs, hot_max_w, q_w, rank, is_hot,
 
 
 @partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf",
-                                   "prune", "skip_hot"))
+                                   "prune", "skip_hot", "hot_only"))
 def tfidf_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
     hot_rank: jax.Array,       # int32 [V]: row in hot_tfs, or -1 (cold)
@@ -346,6 +356,7 @@ def tfidf_topk_tiered(
     compat_int_idf: bool = False,
     prune: bool = False,
     skip_hot: bool = False,
+    hot_only: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """TF-IDF top-k on the tiered sparse layout (search/layout.py): the
     budget-capped hot strip bounds dense memory, geometric tier capacities
@@ -361,10 +372,14 @@ def tfidf_topk_tiered(
     `skip_hot=True` (static) omits the hot-strip stage entirely — exact
     when the caller certified no query term is hot (the Scorer's
     scheduled MaxScore path). `prune=True` (with `hot_max_tf`) is the
-    runtime-bounded variant (`_hot_stage_pruned`) for mixed blocks."""
+    runtime-bounded variant (`_hot_stage_pruned`) for mixed blocks.
+    `hot_only=True` (static) is the opposite degradation: score ONLY the
+    hot strip (the overload ladder's cheapest device level; results are
+    partial and must be tagged by the caller)."""
     idf = idf_weights(df, n_scalar, compat_int_idf)
 
-    do_prune = (not skip_hot and _prune_applicable(k, num_docs, prune)
+    do_prune = (not skip_hot and not hot_only
+                and _prune_applicable(k, num_docs, prune)
                 and hot_max_tf is not None)
     # one weight model for cold postings AND pruned hot candidates: the
     # rank-safety contract depends on the two staying identical
@@ -375,12 +390,13 @@ def tfidf_topk_tiered(
         cold_weight_fn=cell_fn,
         hot_cell_fn=cell_fn if do_prune else None,
         hot_max_w=_lntf(hot_max_tf.astype(jnp.float32)) if do_prune else None,
-        prune_k=k if do_prune else None, skip_hot=skip_hot)
+        prune_k=k if do_prune else None, skip_hot=skip_hot,
+        skip_cold=hot_only)
     return _topk_from_scores(scores, k)
 
 
 @partial(jax.jit, static_argnames=("k", "num_docs", "k1", "b", "prune",
-                                   "skip_hot"))
+                                   "skip_hot", "hot_only"))
 def bm25_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
     hot_rank: jax.Array,       # int32 [V]
@@ -400,6 +416,7 @@ def bm25_topk_tiered(
     b: float = 0.4,
     prune: bool = False,
     skip_hot: bool = False,
+    hot_only: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Okapi BM25 on the tiered sparse layout — the scorer variant that
     makes BM25 usable past the dense-matrix budget (MS MARCO-scale corpora).
@@ -418,7 +435,8 @@ def bm25_topk_tiered(
     avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
     dl_norm = 1.0 - b + b * dlf / jnp.maximum(avg_dl, 1e-9)  # [D+1]
 
-    do_prune = (not skip_hot and _prune_applicable(k, num_docs, prune)
+    do_prune = (not skip_hot and not hot_only
+                and _prune_applicable(k, num_docs, prune)
                 and hot_max_tf is not None)
     if do_prune:
         # slot 0 is the dead column (doc_len 0 -> the global minimum of
@@ -442,7 +460,8 @@ def bm25_topk_tiered(
         cold_weight_fn=cell_fn,
         hot_cell_fn=cell_fn if do_prune else None,
         hot_max_w=hot_max_w,
-        prune_k=k if do_prune else None, skip_hot=skip_hot)
+        prune_k=k if do_prune else None, skip_hot=skip_hot,
+        skip_cold=hot_only)
     return _topk_from_scores(scores, k)
 
 

@@ -285,8 +285,10 @@ def _bm25_weight_fns(doc_len, n_f, k1, b):
 
 
 def _local_scores(q_terms, q_weight, lay_local, *, dblk, scoring, n_f,
-                  k1, b):
-    """[B, dblk+1] tiered scores for this shard (column 0 dead)."""
+                  k1, b, hot_only=False):
+    """[B, dblk+1] tiered scores for this shard (column 0 dead).
+    `hot_only` (static) skips the cold-tier stages — the overload ladder's
+    hot-tier-only service level, distributed form."""
     (hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
      doc_len) = lay_local
     if scoring == "bm25":
@@ -296,7 +298,8 @@ def _local_scores(q_terms, q_weight, lay_local, *, dblk, scoring, n_f,
         cold_fn = lambda tfs, docs: _lntf(tfs)
     return _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
-        q_weight, num_docs=dblk, hot_weight_fn=hot_fn, cold_weight_fn=cold_fn)
+        q_weight, num_docs=dblk, hot_weight_fn=hot_fn, cold_weight_fn=cold_fn,
+        skip_cold=hot_only)
 
 
 def _merge_topk(scores, doc_base, k):
@@ -336,10 +339,11 @@ def _unpack_local(hot_rank, hot_tfs, tier_of, row_of, doc_len, doc_base,
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "scoring", "compat_int_idf",
-                                  "k1", "b", "dblk"))
+                                  "k1", "b", "dblk", "hot_only"))
 def _sharded_topk_jit(q_terms, df, n_scalar, hot_rank, hot_tfs, tier_of,
                       row_of, doc_len, doc_base, tier_docs, tier_tfs, *,
-                      mesh, dblk, k, scoring, compat_int_idf, k1, b):
+                      mesh, dblk, k, scoring, compat_int_idf, k1, b,
+                      hot_only=False):
     n_f = jnp.asarray(n_scalar, jnp.float32)
     if scoring == "bm25":
         q_weight = bm25_idf_weights(df, n_f)
@@ -349,7 +353,7 @@ def _sharded_topk_jit(q_terms, df, n_scalar, hot_rank, hot_tfs, tier_of,
     def body(q, qw, *leaves):
         lay, base = _unpack_local(*leaves)
         scores = _local_scores(q, qw, lay, dblk=dblk, scoring=scoring,
-                               n_f=n_f, k1=k1, b=b)
+                               n_f=n_f, k1=k1, b=b, hot_only=hot_only)
         return _merge_topk(scores, base, k)
 
     fn = shard_map(
@@ -374,11 +378,14 @@ def _layout_specs_flat(tier_docs):
 def sharded_tiered_topk(q_terms, layout: ShardedTieredLayout, df, num_docs,
                         *, mesh, k: int = 10, scoring: str = "tfidf",
                         compat_int_idf: bool = False,
-                        k1: float = 0.9, b: float = 0.4):
+                        k1: float = 0.9, b: float = 0.4,
+                        hot_only: bool = False):
     """Batched distributed top-k over the sharded tiered layout.
     Returns (scores [B, k], docnos [B, k]); docno 0 marks an empty slot.
     Multi-process: per-call inputs are replicated over the global mesh
-    (outputs come back replicated, so every process can read them)."""
+    (outputs come back replicated, so every process can read them).
+    `hot_only` scores just the per-shard hot strips (the overload
+    ladder's cheapest device level; partial scores, caller tags them)."""
     q_terms = replicated_global(q_terms, mesh)
     df = replicated_global(df, mesh)
     num_docs = replicated_global(np.int32(num_docs), mesh)
@@ -386,7 +393,8 @@ def sharded_tiered_topk(q_terms, layout: ShardedTieredLayout, df, num_docs,
         q_terms, df, num_docs, layout.hot_rank, layout.hot_tfs,
         layout.tier_of, layout.row_of, layout.doc_len, layout.doc_base,
         layout.tier_docs, layout.tier_tfs, mesh=mesh, dblk=layout.dblk,
-        k=k, scoring=scoring, compat_int_idf=compat_int_idf, k1=k1, b=b)
+        k=k, scoring=scoring, compat_int_idf=compat_int_idf, k1=k1, b=b,
+        hot_only=hot_only)
 
 
 @partial(jax.jit, static_argnames=("mesh", "k", "candidates", "k1", "b",

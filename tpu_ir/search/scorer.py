@@ -17,6 +17,7 @@ from __future__ import annotations
 import logging
 import os
 import re
+import threading
 from typing import Sequence
 
 import jax.numpy as jnp
@@ -62,11 +63,19 @@ class SearchResult(list):
     """List of (docno, score) or (docid, score) tuples for one query.
 
     `degraded` is True when the results came from a fallback path (score
-    deadline expired or the device was lost mid-dispatch): still correct
-    ranking per the host scoring model, but not the primary pipeline —
-    callers surfacing results to users should tag them."""
+    deadline expired, the device was lost mid-dispatch, or the serving
+    frontend's circuit breaker bypassed the device entirely): still
+    correct ranking per the host scoring model, but not the primary
+    pipeline — callers surfacing results to users should tag them.
+
+    `level` is the service level the request was answered at ("full"
+    unless a serving frontend stepped its degradation ladder down:
+    "no_rerank" dropped the rerank/snippet stages, "hot_only" scored only
+    the hot tier). Set per-request by tpu_ir.serving.ServingFrontend;
+    plain Scorer calls always serve "full"."""
 
     degraded: bool = False
+    level: str = "full"
 
 
 def compute_doc_norms(pair_term, pair_doc, pair_tf, df,
@@ -96,7 +105,19 @@ class Scorer:
     # class-level defaults so minimal Scorers (tests build them with
     # object.__new__ over synthetic layouts) get the no-deadline behavior
     deadline_s: float | None = None
+    # DEPRECATED single-threaded alias: True when the last tagged dispatch
+    # THIS scorer ran was answered by a fallback. Racy the moment two
+    # queries run concurrently — concurrent callers must use the
+    # per-request flag (topk_tagged / rerank_topk_tagged return it;
+    # search_batch tags each SearchResult.degraded from it).
     degraded_last: bool = False
+    # guards lazy expensive state (_pairs assembly, rerank norms, the
+    # dense tf matrix, wildcard lookups) under concurrent serving; an
+    # RLock because the norms path re-enters _pairs. __init__ gives each
+    # instance its own (two co-hosted indexes must not serialize each
+    # other's multi-second lazy loads); the class-level fallback covers
+    # minimal object.__new__ Scorers in tests.
+    _lazy_lock = threading.RLock()
 
     def __init__(
         self,
@@ -133,6 +154,7 @@ class Scorer:
         self.meta = meta
         self.compat_int_idf = compat_int_idf
         self.deadline_s = deadline_s
+        self._lazy_lock = threading.RLock()
         # True when the LAST topk/rerank batch was answered by a fallback
         self.degraded_last = False
         # rank-safe MaxScore pruning of the tiered hot-strip stage
@@ -427,7 +449,16 @@ class Scorer:
         tokens.txt sidecar — expansions then compose into k-gram terms
         (see _analyze_wildcard_kgram)."""
         if not self._wildcard_tried:
-            self._wildcard_tried = True
+            with self._lazy_lock:
+                if not self._wildcard_tried:
+                    self._load_wildcard_lookups()
+        return self._wildcard or []
+
+    def _load_wildcard_lookups(self) -> None:
+        """One-time wildcard-lookup load (call under _lazy_lock); sets
+        _wildcard_tried LAST so a concurrent reader can never observe
+        tried=True with the lookups still unloaded."""
+        try:
             if self._index_dir and self.meta.chargram_ks:
                 from ..collection import Vocab
                 from ..index.builder import TOKENS_VOCAB
@@ -445,7 +476,8 @@ class Scorer:
                 self._wildcard = [
                     WildcardLookup.load(self._index_dir, ck, vocab=shared)
                     for ck in sorted(self.meta.chargram_ks, reverse=True)]
-        return self._wildcard or []
+        finally:
+            self._wildcard_tried = True
 
     def _pattern_tokens(self, pattern: str) -> list[str] | None:
         """Token-vocabulary expansions of one glob pattern via the largest
@@ -786,7 +818,8 @@ class Scorer:
 
     def topk(
         self, q_terms: np.ndarray, k: int = 10, scoring: str = "tfidf",
-        deadline_s: float | None = None,
+        deadline_s: float | None = None, *, hot_only: bool = False,
+        force_host: bool = False,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Score an id batch. Returns (scores [B,k], docnos [B,k], 0=empty).
 
@@ -813,29 +846,63 @@ class Scorer:
         (The runtime-bounded lax.cond variant exists in the kernels but
         measured slower than the matmul it skips on CPU — its top-C over
         [B, D+1] is not free — so the production path is this zero-
-        overhead static specialization.)"""
+        overhead static specialization.)
+
+        `hot_only=True` scores just the hot strip on the tiered/sharded
+        layouts (the overload ladder's cheapest device level; partial
+        scores — tag the results). `force_host=True` answers from the
+        host CPU backend directly with NO device dispatch and no deadline
+        thread — the circuit-breaker-open serving path."""
+        s, d, _ = self.topk_tagged(q_terms, k=k, scoring=scoring,
+                                   deadline_s=deadline_s,
+                                   hot_only=hot_only,
+                                   force_host=force_host)
+        return s, d
+
+    def topk_tagged(
+        self, q_terms: np.ndarray, k: int = 10, scoring: str = "tfidf",
+        deadline_s: float | None = None, *, hot_only: bool = False,
+        force_host: bool = False,
+    ) -> tuple[np.ndarray, np.ndarray, bool]:
+        """topk() with the per-request degraded flag threaded through the
+        return value: (scores, docnos, degraded). This is the
+        thread-safe surface — `degraded_last` is only a deprecated alias
+        for single-threaded callers (two concurrent queries reading it
+        observe each other's outcome)."""
         q = np.asarray(q_terms, np.int32)
         return self._dispatch_degradable(
-            lambda: self._topk_primary(q, k, scoring),
+            lambda: self._topk_primary(q, k, scoring, hot_only=hot_only),
             lambda: self._topk_host(q, k, scoring),
             deadline_s, "score dispatch",
-            "answering from the host CPU backend")
+            "answering from the host CPU backend", force_host=force_host)
 
     def _dispatch_degradable(self, primary, fallback, deadline_s,
-                             label, consequence):
+                             label, consequence, force_host=False):
         """The degraded-serving wrapper shared by topk() and
         rerank_topk(): run `primary` under the per-batch deadline; on
-        expiry or device loss, count + log the event, set degraded_last,
-        and answer with `fallback`. Any other exception re-raises — a
-        program/shape bug must never silently degrade. With no deadline
-        and no fault plan installed this is a plain call."""
+        expiry or device loss, count + log the event and answer with
+        `fallback`. Any other exception re-raises — a program/shape bug
+        must never silently degrade. With no deadline and no fault plan
+        installed this is a plain call.
+
+        Returns (result..., degraded): the per-request degraded flag is
+        appended to the primary/fallback (scores, docnos) tuple, and also
+        mirrored into the deprecated `degraded_last` alias.
+
+        `force_host=True` skips the device path entirely — the serving
+        frontend's open circuit breaker routes here so a known-down
+        device costs host-fallback latency, not a deadline per request."""
+        if force_host:
+            recovery_counters().incr("forced_host_batches")
+            self.degraded_last = True
+            return fallback() + (True,)
         deadline = self.deadline_s if deadline_s is None else deadline_s
         self.degraded_last = False
         if deadline is None and faults.active() is None:
-            return primary()
+            return primary() + (False,)
         reason = None
         try:
-            return faults.run_with_deadline(primary, deadline)
+            return faults.run_with_deadline(primary, deadline) + (False,)
         except faults.ScoreDeadlineExceeded as e:
             recovery_counters().incr("deadline_expired")
             reason = str(e)
@@ -847,14 +914,18 @@ class Scorer:
         recovery_counters().incr("degraded_batches")
         logger.warning("%s degraded (%s); %s", label, reason, consequence)
         self.degraded_last = True
-        return fallback()
+        return fallback() + (True,)
 
-    def _topk_primary(self, q: np.ndarray, k: int, scoring: str):
+    def _topk_primary(self, q: np.ndarray, k: int, scoring: str,
+                      hot_only: bool = False):
         """The device scoring path (all layouts + MaxScore scheduling)."""
         block = self._block_size()
-        if self.layout != "sparse" or not self.prune:
+        if hot_only or self.layout != "sparse" or not self.prune:
+            # hot_only: no MaxScore scheduling — the cold stages it
+            # schedules around are statically absent
             return self._blocked_dispatch(
-                block, lambda qb: self._topk_device(qb, k, scoring),
+                block, lambda qb: self._topk_device(qb, k, scoring,
+                                                    hot_only=hot_only),
                 (q, -1))
         has_hot, n_free, mode = self._skip_plan(q)
         if mode == "all_skip":
@@ -1047,10 +1118,14 @@ class Scorer:
         return self.meta.num_docs + 1
 
     def _topk_device(self, q_terms: np.ndarray, k: int, scoring: str,
-                     skip_hot: bool = False):
+                     skip_hot: bool = False, hot_only: bool = False):
         """Dispatch one query block; returns device arrays without
         waiting. `skip_hot` statically omits the tiered hot-strip stage
-        (exact only for blocks the scheduler certified hot-free)."""
+        (exact only for blocks the scheduler certified hot-free);
+        `hot_only` statically omits the cold tiers instead (the overload
+        ladder's cheapest level — partial scores, results must be
+        tagged). On the dense layout hot_only is a no-op: there is no
+        cheaper stage to keep, so it serves the full matrix."""
         faults.maybe_hang("score.hang")
         if faults.should_fire("score.device_loss") is not None:
             raise faults.DeviceLoss("injected device loss")
@@ -1065,15 +1140,19 @@ class Scorer:
             s, d = sharded_tiered_topk(
                 q, self._sharded, self._df_mesh, self.meta.num_docs,
                 mesh=self._mesh, k=k,
-                scoring=scoring, compat_int_idf=self.compat_int_idf)
+                scoring=scoring, compat_int_idf=self.compat_int_idf,
+                hot_only=hot_only)
         elif scoring == "bm25":
             if self.layout == "dense":
                 if self._tf_matrix is None:
-                    pt, pd, ptf = self._pairs
-                    self._tf_matrix = dense_tf_matrix(
-                        jnp.asarray(pt), jnp.asarray(pd), jnp.asarray(ptf),
-                        vocab_size=self.meta.vocab_size,
-                        num_docs=self.meta.num_docs)
+                    with self._lazy_lock:
+                        if self._tf_matrix is None:
+                            pt, pd, ptf = self._pairs
+                            self._tf_matrix = dense_tf_matrix(
+                                jnp.asarray(pt), jnp.asarray(pd),
+                                jnp.asarray(ptf),
+                                vocab_size=self.meta.vocab_size,
+                                num_docs=self.meta.num_docs)
                 s, d = bm25_topk_dense(q, self._tf_matrix, self.df,
                                        self.doc_len, n, k=k)
             else:
@@ -1083,7 +1162,7 @@ class Scorer:
                     q, self.hot_rank, self.hot_tfs, self.tier_of,
                     self.row_of, self.tier_docs, self.tier_tfs, self.df,
                     self.doc_len, n, num_docs=self.meta.num_docs, k=k,
-                    skip_hot=skip_hot)
+                    skip_hot=skip_hot, hot_only=hot_only)
         elif self.layout == "dense":
             s, d = tfidf_topk_dense(q, self.doc_matrix, self.df, n, k=k,
                                     compat_int_idf=self.compat_int_idf)
@@ -1094,7 +1173,8 @@ class Scorer:
                 q, self.hot_rank, self.hot_tfs, self.tier_of, self.row_of,
                 self.tier_docs, self.tier_tfs, self.df, n,
                 num_docs=self.meta.num_docs, k=k,
-                compat_int_idf=self.compat_int_idf, skip_hot=skip_hot)
+                compat_int_idf=self.compat_int_idf, skip_hot=skip_hot,
+                hot_only=hot_only)
         return s, d
 
     @property
@@ -1102,12 +1182,17 @@ class Scorer:
         """Host CSR columns (pair_term, pair_doc, pair_tf) — assembled
         lazily on the serving-cache fast path, where nothing on the query
         path needs them (norms ride in the cache; only the dense layouts
-        and exhaustive oracles do)."""
+        and exhaustive oracles do). Double-checked under the lazy lock:
+        two concurrent degraded batches must not both pay (or interleave)
+        the shard read."""
         if self._pairs_cols is None:
-            if self._pairs_loader is None:
-                raise RuntimeError("postings columns unavailable: Scorer "
-                                   "was built from serving arrays only")
-            self._pairs_cols = self._pairs_loader()
+            with self._lazy_lock:
+                if self._pairs_cols is None:
+                    if self._pairs_loader is None:
+                        raise RuntimeError(
+                            "postings columns unavailable: Scorer was "
+                            "built from serving arrays only")
+                    self._pairs_cols = self._pairs_loader()
         return self._pairs_cols
 
     def _doc_norms_host(self) -> np.ndarray:
@@ -1116,21 +1201,27 @@ class Scorer:
         pipeline stops here — its host cosine never needs the device
         copy, which at 10M docs would be a ~40 MB upload for nothing."""
         if self._norms_np is None:
-            pt, pd, ptf = self._pairs
-            self._norms_np = compute_doc_norms(
-                pt, pd, ptf, np.asarray(self.df), self.meta.num_docs)
+            with self._lazy_lock:
+                if self._norms_np is None:
+                    pt, pd, ptf = self._pairs
+                    self._norms_np = compute_doc_norms(
+                        pt, pd, ptf, np.asarray(self.df),
+                        self.meta.num_docs)
         return self._norms_np
 
     def _doc_norms(self):
         """Device copy of the rerank norms (the batch rerank kernels)."""
         if getattr(self, "_norms", None) is None:
-            self._norms = jnp.asarray(
-                np.ascontiguousarray(self._doc_norms_host()), jnp.float32)
+            with self._lazy_lock:
+                if getattr(self, "_norms", None) is None:
+                    self._norms = jnp.asarray(
+                        np.ascontiguousarray(self._doc_norms_host()),
+                        jnp.float32)
         return self._norms
 
     def rerank_topk(
         self, q_terms: np.ndarray, k: int = 10, candidates: int = 1000,
-        deadline_s: float | None = None,
+        deadline_s: float | None = None, *, force_host: bool = False,
     ) -> tuple[np.ndarray, np.ndarray]:
         """Two-stage retrieval: BM25 top-`candidates`, then cosine TF-IDF
         (see ops/scoring.py::cosine_rerank_dense for the exact model)
@@ -1142,12 +1233,25 @@ class Scorer:
         expiry/device loss the batch degrades to single-stage host BM25
         (the rerank is a quality refinement — dropping it under duress is
         the intended degradation, tagged via `degraded_last`)."""
+        s, d, _ = self.rerank_topk_tagged(q_terms, k=k,
+                                          candidates=candidates,
+                                          deadline_s=deadline_s,
+                                          force_host=force_host)
+        return s, d
+
+    def rerank_topk_tagged(
+        self, q_terms: np.ndarray, k: int = 10, candidates: int = 1000,
+        deadline_s: float | None = None, *, force_host: bool = False,
+    ) -> tuple[np.ndarray, np.ndarray, bool]:
+        """rerank_topk() with the per-request degraded flag threaded
+        through the return value (see topk_tagged)."""
         q = np.asarray(q_terms, np.int32)
         return self._dispatch_degradable(
             lambda: self._rerank_primary(q, k, candidates),
             lambda: self._topk_host(q, k, "bm25"),
             deadline_s, "rerank dispatch",
-            "answering with host BM25, rerank stage dropped")
+            "answering with host BM25, rerank stage dropped",
+            force_host=force_host)
 
     def _rerank_primary(self, q_terms: np.ndarray, k: int, candidates: int):
         from ..ops import cosine_rerank_dense
@@ -1161,15 +1265,28 @@ class Scorer:
             from ..parallel.sharded_tiered import put_doc_sharded
 
             if self._sharded_norm is None:
-                # host norms feed shard_slices directly — _doc_norms()
-                # would upload a device copy only to fetch it back
-                norms_np = np.ascontiguousarray(self._doc_norms_host())
-                self._sharded_norm = put_doc_sharded(
-                    shard_slices(norms_np, num_docs=self.meta.num_docs,
-                                 num_shards=self._mesh.devices.size),
-                    self._mesh)
+                with self._lazy_lock:
+                    if self._sharded_norm is None:
+                        # host norms feed shard_slices directly —
+                        # _doc_norms() would upload a device copy only to
+                        # fetch it back
+                        norms_np = np.ascontiguousarray(
+                            self._doc_norms_host())
+                        self._sharded_norm = put_doc_sharded(
+                            shard_slices(norms_np,
+                                         num_docs=self.meta.num_docs,
+                                         num_shards=self._mesh.devices.size),
+                            self._mesh)
 
             def dispatch(q):
+                # same per-block injection sites as _topk_device: the
+                # sharded rerank is the one dispatch that never routes
+                # through it, and an uninjectable path is an untestable
+                # degradation (the tiered/sharded fallback matrix caught
+                # exactly this gap)
+                faults.maybe_hang("score.hang")
+                if faults.should_fire("score.device_loss") is not None:
+                    raise faults.DeviceLoss("injected device loss")
                 return sharded_tiered_rerank(
                     jnp.asarray(q), self._sharded, self._df_mesh,
                     self.meta.num_docs, self._sharded_norm,
@@ -1203,14 +1320,25 @@ class Scorer:
     def search_batch(
         self, texts: Sequence[str], k: int = 10, scoring: str = "tfidf",
         return_docids: bool = True, rerank: int | None = None,
-        prox: bool = False, phrase_slop: int = 0,
+        prox: bool = False, phrase_slop: int = 0, *,
+        deadline_s: float | None = None, force_host: bool = False,
+        hot_only: bool = False,
     ) -> list[SearchResult]:
         """Ranked retrieval for query texts. `rerank=N` switches to the
         two-stage pipeline: BM25 top-N candidates, cosine TF-IDF rerank;
         `prox=True` adds the positions-based proximity boost to the rerank
         (search/phrase.py). Queries containing double-quoted spans run as
         phrase queries (ordered window, `phrase_slop` extra token gaps) —
-        both need a format-v2 index built with positions."""
+        both need a format-v2 index built with positions.
+
+        Serving knobs (tpu_ir.serving.ServingFrontend is the intended
+        caller): `deadline_s` bounds this batch's device dispatch,
+        `force_host` answers from the host backend with no device
+        dispatch (circuit breaker open), `hot_only` scores only the hot
+        tier on tiered/sharded layouts. Each SearchResult's `degraded`
+        flag is tagged from THIS request's outcome (thread-safe), not the
+        racy `degraded_last` alias. Phrase queries already run on the
+        host and ignore the device knobs."""
         if prox and not rerank:
             raise ValueError("the proximity boost is stage 3 of the "
                              "two-stage rerank; pass rerank=N (--rerank) "
@@ -1219,7 +1347,8 @@ class Scorer:
         plain = [t for t in texts if '"' not in t]
         plain_iter = iter(self._search_batch_plain(
             plain, k=k, scoring=scoring, return_docids=return_docids,
-            rerank=rerank, prox=prox) if plain else [])
+            rerank=rerank, prox=prox, deadline_s=deadline_s,
+            force_host=force_host, hot_only=hot_only) if plain else [])
         return [self._search_phrase(t, k=k, scoring=scoring,
                                     slop=phrase_slop,
                                     return_docids=return_docids,
@@ -1229,25 +1358,34 @@ class Scorer:
     def _search_batch_plain(
         self, texts: Sequence[str], *, k: int, scoring: str,
         return_docids: bool, rerank: int | None, prox: bool,
+        deadline_s: float | None = None, force_host: bool = False,
+        hot_only: bool = False,
     ) -> list[SearchResult]:
         q = self.analyze_queries(texts)
         if rerank:
             from .phrase import PROX_DEPTH
 
             kk = max(k, min(PROX_DEPTH, rerank)) if prox else k
-            scores, docnos = self.rerank_topk(q, k=kk, candidates=rerank)
+            scores, docnos, degraded = self.rerank_topk_tagged(
+                q, k=kk, candidates=rerank, deadline_s=deadline_s,
+                force_host=force_host)
             if prox:
                 scores, docnos = self._apply_proximity(
                     texts, np.asarray(scores), np.asarray(docnos), k)
         else:
-            scores, docnos = self.topk(q, k=k, scoring=scoring)
+            scores, docnos, degraded = self.topk_tagged(
+                q, k=k, scoring=scoring, deadline_s=deadline_s,
+                hot_only=hot_only, force_host=force_host)
         out = []
         for qi in range(len(texts)):
             res = SearchResult()
             # surface the fallback to callers: a degraded batch's results
             # are real rankings from the host backend, but SLAs/metrics
-            # must be able to tell them apart from the primary pipeline
-            res.degraded = self.degraded_last
+            # must be able to tell them apart from the primary pipeline.
+            # Tagged from the per-request flag the tagged dispatch
+            # returned — NOT degraded_last, which another thread's batch
+            # may have overwritten in the meantime.
+            res.degraded = degraded
             for s, dn in zip(scores[qi], docnos[qi]):
                 if dn <= 0:
                     continue

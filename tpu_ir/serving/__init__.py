@@ -1,0 +1,24 @@
+"""Overload-resilient serving frontend (admission control, degradation
+ladder, circuit breaker) for a loaded Scorer — see frontend.py for the
+architecture and RUNBOOK "Serving under overload" for operations."""
+
+from .admission import AdmissionController, Overloaded
+from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
+from .frontend import (
+    LEVEL_FULL,
+    LEVEL_HOT_ONLY,
+    LEVEL_NO_RERANK,
+    LEVEL_SHED,
+    DegradationLadder,
+    ServingConfig,
+    ServingFrontend,
+)
+from .soak import DEFAULT_CHAOS_PLAN, make_queries, run_soak
+
+__all__ = [
+    "AdmissionController", "Overloaded",
+    "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
+    "ServingFrontend", "ServingConfig", "DegradationLadder",
+    "LEVEL_FULL", "LEVEL_NO_RERANK", "LEVEL_HOT_ONLY", "LEVEL_SHED",
+    "run_soak", "make_queries", "DEFAULT_CHAOS_PLAN",
+]

@@ -1,0 +1,269 @@
+"""The overload-resilient serving frontend: admission control, a
+degradation ladder, and a circuit breaker wrapped around one Scorer.
+
+PR 1 gave a single request a bounded-latency story (per-batch deadline →
+host fallback, tagged degraded). This module is the story for a POPULATION
+of requests — the overload axis:
+
+    request ──► admission control ──► degradation ladder ──► breaker
+                (bounded queue,        (what work this         (device or
+                 shed past it)          level still does)       host path)
+
+- **Admission** (admission.py): `max_concurrency` running, `max_queue`
+  waiting, everything else shed instantly with a structured `Overloaded`.
+- **Ladder**: under queue pressure or repeated dispatch failures the
+  frontend steps down through explicit service levels — full →
+  no_rerank (drop the rerank + snippet stages) → hot_only (score only
+  the tiered hot strip; skipped on the dense layout, which has no
+  cheaper stage) → shed (admission rejects everything). Each response is
+  tagged with the level that produced it (SearchResult.level). Stepping
+  UP requires `recover_successes` consecutive calm observations
+  (hysteresis — one good request must not flap the ladder).
+- **Breaker** (breaker.py): N consecutive device failures open it; open
+  means requests go straight to the host-CPU fallback with no device
+  dispatch and NO deadline wait (the ≥10× latency save when the device
+  is plain gone), with half-open probes to detect recovery.
+
+Everything here is thread-safe: the intended caller is one frontend
+shared by many request threads. Correctness under concurrency rides on
+Scorer's per-request tagged dispatch (topk_tagged), not the deprecated
+`degraded_last` alias.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+
+from ..search.scorer import Scorer, SearchResult
+from ..utils.report import RecoveryCounters, serving_counters
+from .admission import AdmissionController, Overloaded
+from .breaker import CircuitBreaker
+
+logger = logging.getLogger(__name__)
+
+# the full ladder, cheapest-first never — order is strictly decreasing
+# work per request; "shed" must stay last (admission consults it)
+LEVEL_FULL = "full"
+LEVEL_NO_RERANK = "no_rerank"
+LEVEL_HOT_ONLY = "hot_only"
+LEVEL_SHED = "shed"
+
+
+@dataclass(frozen=True)
+class ServingConfig:
+    """Tuning knobs (RUNBOOK "Serving under overload" documents how to
+    pick them). Defaults suit a small box driving CI-scale traffic."""
+
+    max_concurrency: int = 4       # requests executing at once
+    max_queue: int = 16            # requests allowed to WAIT for a slot
+    deadline_s: float | None = None   # per-request device dispatch bound
+    queue_timeout_s: float | None = None  # max slot wait (None: deadline_s)
+    breaker_threshold: int = 5     # consecutive device failures to open
+    breaker_cooldown_s: float = 1.0   # open time before a half-open probe
+    step_down_pressure: float = 0.75  # queue occupancy that steps down
+    step_up_pressure: float = 0.25    # calm threshold for recovery credit
+    fail_threshold: int = 3        # consecutive failures that step down
+    recover_successes: int = 16    # calm observations to step up one level
+    down_cooldown_s: float = 0.05  # min time between two down-steps
+
+
+class DegradationLadder:
+    """Thread-safe service-level state machine with hysteresis.
+
+    Down-transitions are fast (pressure at/above `step_down_pressure`,
+    or `fail_threshold` consecutive dispatch failures) but rate-limited
+    to one per `down_cooldown_s`: overload must be answered now, yet one
+    burst arriving in the same millisecond must not teleport the ladder
+    from full to shed before the cheaper levels got a chance to absorb
+    it. Up-transitions need `recover_successes` consecutive observations
+    in the calm zone (pressure at/below `step_up_pressure`, no failures)
+    and move ONE level at a time — recovery is earned, so the ladder
+    cannot flap."""
+
+    def __init__(self, levels: tuple, cfg: ServingConfig, on_transition,
+                 clock=time.monotonic):
+        self._levels = tuple(levels)
+        self._cfg = cfg
+        self._on_transition = on_transition  # (direction, from, to)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._idx = 0
+        self._fails = 0
+        self._successes = 0
+        self._last_down = -float("inf")
+
+    @property
+    def levels(self) -> tuple:
+        return self._levels
+
+    def level(self) -> str:
+        with self._lock:
+            return self._levels[self._idx]
+
+    def observe(self, *, pressure: float, failed: bool) -> None:
+        """Feed one completed (or shed) request's signals: the queue
+        pressure seen around it, and whether its device dispatch failed
+        (deadline expiry / device loss — sheds and breaker-open host
+        serves are NOT dispatch failures)."""
+        cfg = self._cfg
+        moved = None
+        with self._lock:
+            if failed:
+                self._fails += 1
+                self._successes = 0
+            else:
+                self._fails = 0
+            if (pressure >= cfg.step_down_pressure
+                    or self._fails >= cfg.fail_threshold):
+                self._successes = 0
+                now = self._clock()
+                if (self._idx + 1 < len(self._levels)
+                        and now - self._last_down >= cfg.down_cooldown_s):
+                    moved = ("down", self._levels[self._idx],
+                             self._levels[self._idx + 1])
+                    self._idx += 1
+                    self._fails = 0
+                    self._last_down = now
+            elif not failed and pressure <= cfg.step_up_pressure:
+                self._successes += 1
+                if (self._successes >= cfg.recover_successes
+                        and self._idx > 0):
+                    moved = ("up", self._levels[self._idx],
+                             self._levels[self._idx - 1])
+                    self._idx -= 1
+                    self._successes = 0
+        if moved is not None:
+            self._on_transition(*moved)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"level": self._levels[self._idx],
+                    "consecutive_failures": self._fails,
+                    "recovery_credit": self._successes}
+
+
+class ServingFrontend:
+    """Thread-safe serving wrapper around one loaded Scorer (any layout:
+    dense, tiered sparse, or sharded). Callers' threads run their own
+    requests — the frontend owns no worker pool, so there is nothing to
+    shut down and nothing to leak; concurrency is bounded by admission,
+    not by thread ownership."""
+
+    def __init__(self, scorer: Scorer, config: ServingConfig | None = None):
+        self.scorer = scorer
+        self.config = cfg = config or ServingConfig()
+        self.admission = AdmissionController(cfg.max_concurrency,
+                                             cfg.max_queue)
+        self.breaker = CircuitBreaker(cfg.breaker_threshold,
+                                      cfg.breaker_cooldown_s)
+        # dense layouts have no hot tier — no cheaper device stage exists,
+        # so the ladder goes straight from no_rerank to shed
+        levels = ((LEVEL_FULL, LEVEL_NO_RERANK, LEVEL_HOT_ONLY, LEVEL_SHED)
+                  if scorer.layout in ("sparse", "sharded")
+                  else (LEVEL_FULL, LEVEL_NO_RERANK, LEVEL_SHED))
+        self.ladder = DegradationLadder(levels, cfg, self._on_transition)
+        self._counters = RecoveryCounters()
+
+    # -- accounting --------------------------------------------------------
+
+    def _count(self, name: str, amount: int = 1) -> None:
+        """Every event lands in BOTH ledgers: this frontend's own counters
+        (the soak harness asserts shed + served == submitted per
+        instance) and the process-wide serving_counters() that
+        `tpu-ir stats` scrapes."""
+        self._counters.incr(name, amount)
+        serving_counters().incr(name, amount)
+
+    def _on_transition(self, direction: str, frm: str, to: str) -> None:
+        self._count(f"level_step_{direction}")
+        logger.warning("degradation ladder stepped %s: %s -> %s",
+                       direction, frm, to)
+
+    def stats(self) -> dict:
+        """This frontend's counters + control-plane state, one dict."""
+        out = dict(self._counters.snapshot())
+        out["ladder"] = self.ladder.snapshot()
+        out["breaker"] = self.breaker.snapshot()
+        out["queue_depth"] = self.admission.queue_depth()
+        return out
+
+    # -- the request path --------------------------------------------------
+
+    def search(self, text: str, *, k: int = 10, scoring: str = "tfidf",
+               rerank: int | None = None,
+               snippets: bool = False) -> SearchResult:
+        """Serve one query. Returns a SearchResult tagged with the
+        service level (`level`) and fallback flag (`degraded`) that
+        produced it, or raises Overloaded (a structured shed — the
+        request was NOT executed). `rerank`/`snippets` are what the
+        caller WANTS; the ladder decides what it gets."""
+        self._count("submitted")
+        level = self.ladder.level()
+        if level == LEVEL_SHED:
+            self._count("shed_level")
+            pressure = self.admission.pressure()
+            # sheds are instant, so pressure falls while shedding: these
+            # observations are how the ladder earns its way back up
+            self.ladder.observe(pressure=pressure, failed=False)
+            raise Overloaded("shed_level",
+                             queue_depth=self.admission.queue_depth(),
+                             level=level)
+        timeout = (self.config.queue_timeout_s
+                   if self.config.queue_timeout_s is not None
+                   else self.config.deadline_s)
+        try:
+            with self.admission.admit(queue_timeout_s=timeout):
+                return self._serve(text, k=k, scoring=scoring,
+                                   rerank=rerank, snippets=snippets,
+                                   level=level)
+        except Overloaded as e:
+            # only admission sheds reach here (queue_full / queue_timeout)
+            self._count(f"shed_{e.reason}")
+            # a full queue is the strongest pressure signal there is
+            self.ladder.observe(pressure=1.0, failed=False)
+            raise
+
+    def _serve(self, text: str, *, k: int, scoring: str,
+               rerank: int | None, snippets: bool,
+               level: str) -> SearchResult:
+        allowed, is_probe = self.breaker.allow_device()
+        force_host = not allowed
+        if is_probe:
+            self._count("breaker_probes")
+        use_rerank = rerank if level == LEVEL_FULL else None
+        try:
+            res = self.scorer.search_batch(
+                [text], k=k, scoring=scoring, rerank=use_rerank,
+                deadline_s=self.config.deadline_s, force_host=force_host,
+                hot_only=(level == LEVEL_HOT_ONLY))[0]
+        except BaseException:
+            # not a device verdict (bad query, program bug): release any
+            # probe slot this request held so the breaker cannot wedge
+            # half-open forever, and let the error surface structurally
+            if not force_host:
+                self.breaker.abort(is_probe=is_probe)
+            raise
+        res.level = level
+        dispatch_failed = False
+        if force_host:
+            self._count("served_breaker_host")
+        else:
+            # res.degraded is THIS request's tagged outcome: a device
+            # dispatch that expired its deadline or lost the device
+            dispatch_failed = res.degraded
+            if dispatch_failed:
+                if self.breaker.record_failure(is_probe=is_probe):
+                    self._count("breaker_opened")
+            else:
+                self.breaker.record_success(is_probe=is_probe)
+        if res.degraded:
+            self._count("degraded")
+        self._count(f"served_{level}")
+        if snippets and level == LEVEL_FULL and not res.degraded:
+            res.snippets = [self.scorer.snippet(text, key) for key, _ in res]
+        self.ladder.observe(pressure=self.admission.pressure(),
+                            failed=dispatch_failed)
+        return res

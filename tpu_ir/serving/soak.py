@@ -1,0 +1,211 @@
+"""Concurrent chaos soak: mixed query traffic through the ServingFrontend
+while a fault plan injects hangs and device losses.
+
+This is the proof harness for the overload story, the concurrency
+sibling of tests/test_faults.py's per-fault-class suite. One run drives
+`threads` worker threads over a deterministic (seeded) mixed query set
+and checks the serving invariants that single-request tests cannot:
+
+- **no deadlock**: every request completes (or is shed) within the
+  soak's wall-clock bound;
+- **no cross-request corruption**: every response served at full level
+  without degradation is BIT-IDENTICAL to a serial reference run of the
+  same query (same docids, same float scores);
+- **no silent degradation**: any response that differs from the
+  reference carries a tag explaining why (degraded flag or a
+  non-full service level);
+- **conservation**: shed + served (+ errors, expected 0) equals
+  submitted — no request vanishes.
+
+Used by tests/test_serving.py (fast + slow variants), the
+`tpu-ir serve-bench` CLI, and experiments/soak_serving.py.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
+
+from .. import faults
+from ..utils.report import recovery_counters
+from .admission import Overloaded
+from .frontend import ServingConfig, ServingFrontend
+
+# default fault plan for chaos runs: occasional hangs long enough to trip
+# any sane deadline, plus sporadic device losses — both sites fire on the
+# per-block score dispatch, so concurrent requests race into them
+DEFAULT_CHAOS_PLAN = ("score.hang:p=0.12:sleep=0.6,"
+                      "score.device_loss:p=0.08,seed=1")
+
+
+def make_queries(scorer, n: int, seed: int = 0) -> list[dict]:
+    """A deterministic mixed workload over the index's own vocabulary:
+    1-3 term queries, tfidf/bm25 split, ~25% requesting the two-stage
+    rerank. Seeded so a soak run is replayable."""
+    rng = random.Random(seed)
+    terms = list(scorer.vocab.terms)
+    if not terms:
+        raise ValueError("scorer has an empty vocabulary")
+    reqs = []
+    for _ in range(n):
+        text = " ".join(rng.choice(terms)
+                        for _ in range(rng.randint(1, 3)))
+        reqs.append({
+            "text": text,
+            "scoring": rng.choice(["tfidf", "bm25"]),
+            "rerank": rng.choice([None, None, None, 25]),
+            "k": 10,
+        })
+    return reqs
+
+
+def _req_key(r: dict) -> tuple:
+    return (r["text"], r["scoring"], r["rerank"], r["k"])
+
+
+def _serial_reference(scorer, reqs: list[dict]) -> dict:
+    """Full-level serial results per distinct request, computed BEFORE
+    any fault plan installs (also warms every compile cache, so the
+    concurrent phase measures serving, not compilation)."""
+    ref = {}
+    for r in reqs:
+        key = _req_key(r)
+        if key in ref:
+            continue
+        res = scorer.search_batch([r["text"]], k=r["k"],
+                                  scoring=r["scoring"],
+                                  rerank=r["rerank"])[0]
+        if res.degraded:
+            raise RuntimeError("reference run degraded — clear the fault "
+                               "plan before calling run_soak")
+        ref[key] = list(res)
+    return ref
+
+
+def run_soak(scorer, *, threads: int = 8, queries: int = 240,
+             seed: int = 0, fault_spec: str | None = DEFAULT_CHAOS_PLAN,
+             config: ServingConfig | None = None,
+             timeout_s: float = 120.0, pacing_s: float = 0.004) -> dict:
+    """Run the soak; returns the invariant report (no asserts here — the
+    callers decide what is fatal; tests assert on the report fields).
+
+    The scorer must be loaded and fault-plan-free on entry; the given
+    `fault_spec` (None = no chaos) is installed only around the
+    concurrent phase and cleared after."""
+    if faults.active() is not None:
+        raise RuntimeError("a fault plan is already installed")
+    reqs = make_queries(scorer, queries, seed=seed)
+    reference = _serial_reference(scorer, reqs)
+
+    cfg = config or ServingConfig(max_concurrency=4, max_queue=8,
+                                  deadline_s=0.25, breaker_threshold=4,
+                                  breaker_cooldown_s=0.2)
+    frontend = ServingFrontend(scorer, cfg)
+    recovery_before = recovery_counters().snapshot()
+    results: list = [None] * len(reqs)
+
+    def worker(i: int, r: dict) -> None:
+        if pacing_s:
+            # spread arrivals (seeded jitter): back-to-back submission of
+            # the whole workload is a thundering herd, which the ladder
+            # answers by shedding everything — pacing keeps the soak
+            # exercising RECOVERY too, not just collapse
+            time.sleep(random.Random(seed * 1_000_003 + i).random()
+                       * pacing_s * threads)
+        try:
+            results[i] = ("ok", frontend.search(
+                r["text"], k=r["k"], scoring=r["scoring"],
+                rerank=r["rerank"]))
+        except Overloaded as e:
+            results[i] = ("shed", e)
+        except BaseException as e:  # invariant: structured or nothing
+            results[i] = ("error", e)
+
+    if fault_spec:
+        faults.install(faults.parse_plan(fault_spec))
+    t0 = time.perf_counter()
+    wall_s = 0.0
+    deadlocked = 0
+    pool = ThreadPoolExecutor(max_workers=threads,
+                              thread_name_prefix="soak-worker")
+    try:
+        futs = [pool.submit(worker, i, r) for i, r in enumerate(reqs)]
+        done, not_done = wait(futs, timeout=timeout_s,
+                              return_when=FIRST_EXCEPTION)
+        wall_s = time.perf_counter() - t0
+        deadlocked = len(not_done)  # governs teardown mode only
+        for f in not_done:
+            f.cancel()
+    finally:
+        # wait=False: a genuinely hung worker must surface as the
+        # `deadlocked` count (and the test harness's thread-leak guard),
+        # not hang the soak's own teardown
+        pool.shutdown(wait=deadlocked == 0, cancel_futures=True)
+        faults.clear()
+        # abandoned deadline dispatches may still be sleeping in an
+        # injected hang; drain them so nothing races process teardown
+        faults.drain_abandoned(timeout_s=10.0)
+
+    # -- invariant evaluation ---------------------------------------------
+    # snapshot the outcome list ONCE: cancelled-but-running workers
+    # (shutdown(wait=False) on deadlock) may still be writing. An entry
+    # still None at snapshot time IS the deadlock count — it must not
+    # also masquerade as an unstructured error
+    outcomes = list(results)
+    deadlocked = sum(1 for o in outcomes if o is None)
+    served = shed = errors = degraded = 0
+    levels: dict[str, int] = {}
+    full_bitident = tagged_divergent = untagged_mismatches = 0
+    error_reprs: list[str] = []
+    for out, r in zip(outcomes, reqs):
+        if out is None:
+            continue
+        state, payload = out
+        if state == "shed":
+            shed += 1
+            continue
+        if state == "error":
+            errors += 1
+            if len(error_reprs) < 5:
+                error_reprs.append(repr(payload))
+            continue
+        served += 1
+        res = payload
+        levels[res.level] = levels.get(res.level, 0) + 1
+        degraded += bool(res.degraded)
+        matches = list(res) == reference[_req_key(r)]
+        if res.level == "full" and not res.degraded:
+            if matches:
+                full_bitident += 1
+            else:
+                # an untagged response that differs from the serial
+                # reference is the cross-request corruption this soak
+                # exists to catch
+                untagged_mismatches += 1
+        elif not matches:
+            tagged_divergent += 1
+
+    fe_stats = frontend.stats()
+    recovery_delta = {
+        k: v - recovery_before.get(k, 0)
+        for k, v in recovery_counters().snapshot().items()
+        if v != recovery_before.get(k, 0)}
+    return {
+        "submitted": len(reqs),
+        "threads": threads,
+        "served": served,
+        "shed": shed,
+        "errors": errors,
+        "error_samples": error_reprs,
+        "deadlocked": deadlocked,
+        "degraded": degraded,
+        "levels": levels,
+        "full_bitidentical": full_bitident,
+        "tagged_divergent": tagged_divergent,
+        "untagged_mismatches": untagged_mismatches,
+        "wall_s": round(wall_s, 3),
+        "fault_spec": fault_spec,
+        "frontend": fe_stats,
+        "recovery_delta": recovery_delta,
+    }

@@ -98,6 +98,22 @@ _RECOVERY = RecoveryCounters()
 def recovery_counters() -> RecoveryCounters:
     """The process-wide RecoveryCounters singleton. Counter names in use:
     retries, retry_exhausted, overflow_retries, degraded_batches,
-    deadline_expired, device_loss, integrity_failures, quarantined,
+    deadline_expired, device_loss, forced_host_batches,
+    integrity_failures, quarantined, quarantine_evicted,
     spill_integrity_discards."""
     return _RECOVERY
+
+
+_SERVING = RecoveryCounters()
+
+
+def serving_counters() -> RecoveryCounters:
+    """The process-wide serving-frontend counters (same locked-counter
+    machinery as recovery_counters, different ledger: these count
+    REQUESTS and control-plane transitions, not fault recoveries).
+    Incremented by tpu_ir.serving.ServingFrontend; scraped by
+    `tpu-ir stats`. Names in use: submitted, served_full,
+    served_no_rerank, served_hot_only, served_breaker_host, degraded,
+    shed_queue_full, shed_queue_timeout, shed_level, breaker_opened,
+    breaker_probes, level_step_down, level_step_up."""
+    return _SERVING
