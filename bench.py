@@ -1256,9 +1256,23 @@ def main() -> int:
             print(f"bench: serving/query phase failed after a successful "
                   f"build: {serving_error}", file=sys.stderr)
 
+    # per-stage latency breakdown from the unified telemetry layer:
+    # span-derived histograms recorded during this process's build and
+    # query phases (build.* per pipeline phase, kernel/dispatch per
+    # query block) — BENCH_*.json finally carries WHERE time went, not
+    # just the headline throughput
+    from tpu_ir.obs import get_registry
+
+    stage_latency = {
+        name: {k: s[k] for k in ("count", "p50_ms", "p95_ms", "p99_ms")}
+        for name, s in sorted(
+            get_registry().snapshot()["histograms"].items())
+        if s["count"]}
+
     out = {
         "metric": "docs_per_sec_indexed",
         "value": round(docs_per_sec, 1),
+        "stage_latency": stage_latency,
         "unit": "docs/s",
         "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC, 2),
         "index_wall_s": round(build_s, 2),

@@ -37,6 +37,22 @@ import pytest  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
+def _fresh_telemetry():
+    """Reset the process-wide telemetry (registry counters + histograms,
+    trace ring, flight-recorder rate limiter) around every test.
+
+    Before ISSUE 3 the counters were per-module singletons with no
+    between-run reset, so `tpu-ir stats` / serve-bench assertions
+    silently depended on which tests ran first — this fixture is the
+    bleed-through fix: every test starts from zero and leaves zero."""
+    from tpu_ir import obs
+
+    obs.reset_all()
+    yield
+    obs.reset_all()
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_nondaemon_threads():
     """Fail any test that leaks a live non-daemon thread.
 

@@ -535,13 +535,19 @@ def test_stats_cli_output_shape(capsys):
     faults.clear()
     assert rc == 0
     out = json.loads(capsys.readouterr().out)
-    assert set(out) == {"recovery", "serving", "fault_injection"}
-    for section in out.values():
+    # the ISSUE 3 contract: a strict SUPERSET of the PR 2 shape — the
+    # three counter sections keep their exact form, histograms ride along
+    assert set(out) >= {"recovery", "serving", "fault_injection",
+                        "histograms"}
+    for section in ("recovery", "serving", "fault_injection"):
         assert all(isinstance(k, str) and isinstance(v, int)
-                   for k, v in section.items())
+                   for k, v in out[section].items())
     assert out["recovery"]["degraded_batches"] == 2
     assert out["serving"]["submitted"] == 5
     assert out["fault_injection"] == {"score.hang": 1}
+    # fault fires ALSO land in the unified registry's fault.* namespace
+    assert out["recovery"] != out["histograms"]  # distinct sections
+    assert "dispatch" in out["histograms"]
 
 
 def test_serve_bench_cli_runs_and_reports(index_dir, capsys):
@@ -555,6 +561,12 @@ def test_serve_bench_cli_runs_and_reports(index_dir, capsys):
     assert out["submitted"] == 40
     assert out["served"] + out["shed"] == 40
     assert out["deadlocked"] == 0 and out["untagged_mismatches"] == 0
+    # the per-stage latency breakdown (ISSUE 3 acceptance): p50/p95/p99
+    # for every serving stage, always present in the serve-bench JSON
+    for stage in ("admission_wait", "dispatch", "kernel", "fallback"):
+        assert {"count", "p50_ms", "p95_ms", "p99_ms"} <= \
+            set(out["latency"][stage])
+    assert out["latency"]["dispatch"]["count"] > 0
 
 
 def test_serve_bench_honors_env_var_fault_plan(index_dir, capsys,

@@ -425,20 +425,76 @@ def cmd_warm(args) -> int:
 
 def cmd_stats(args) -> int:
     """Operator scrape surface: the process-wide recovery and serving
-    counters (utils/report.py) plus the installed fault plan's fire
-    counts, one JSON object. Counters are per-process — meaningful from
-    a serving process (serve-bench, a REPL, an embedding application),
-    and all-zero from a fresh CLI invocation; the output SHAPE is the
-    contract (tests pin it)."""
-    from . import faults
-    from .utils.report import recovery_counters, serving_counters
+    counters, the fault-injection fire counts (the registry's fault.*
+    ledger: sites that fired, regardless of which plan was installed)
+    and the latency histogram summaries, one JSON object. Counters are
+    per-process — meaningful from a serving process (serve-bench, a
+    REPL, an embedding application), and all-zero from a fresh CLI
+    invocation; the output SHAPE is the contract (a strict superset of
+    the PR 2 shape; tests pin it). `--reset` reads-and-zeroes
+    atomically, so repeated scrapes in one process report per-interval
+    numbers with no event lost between read and reset."""
+    from . import obs
 
-    plan = faults.active()
+    # ONE atomic snapshot feeds every section (with --reset, the
+    # registry's read-and-zero guarantees an event lands in exactly one
+    # interval): the recovery/serving sections are the counter prefixes
+    # the deprecated aliases view, and fault_injection is the registry's
+    # fault.* ledger (sites that actually fired — so it resets in step
+    # with everything else, instead of the installed plan's lifetime
+    # counts drifting against a per-interval scrape)
+    snap = obs.get_registry().snapshot(reset=args.reset)
+
+    def section(prefix: str) -> dict:
+        n = len(prefix)
+        return {k[n:]: v for k, v in snap["counters"].items()
+                if k.startswith(prefix)}
+
     print(json.dumps({
-        "recovery": recovery_counters().snapshot(),
-        "serving": serving_counters().snapshot(),
-        "fault_injection": plan.counters() if plan is not None else {},
+        "recovery": section("recovery."),
+        "serving": section("serving."),
+        "fault_injection": {k: v for k, v in section("fault.").items()
+                            if v},
+        "histograms": snap["histograms"],
     }, sort_keys=True))
+    return 0
+
+
+def cmd_metrics(args) -> int:
+    """The unified telemetry scrape: the whole TelemetryRegistry —
+    every counter namespace (recovery.*, serving.*, fault.*) and every
+    latency histogram — as one JSON object, or with `--prom` as
+    Prometheus text exposition (counters as a labeled family,
+    histograms in native cumulative-bucket form) for direct scraping.
+    `--reset` zeroes the registry after reading."""
+    from . import obs
+
+    reg = obs.get_registry()
+    if args.prom:
+        sys.stdout.write(reg.prometheus_text(reset=args.reset))
+    else:
+        print(json.dumps(reg.snapshot(reset=args.reset), sort_keys=True))
+    return 0
+
+
+def cmd_trace_dump(args) -> int:
+    """Dump the flight-recorder state on demand: the recent-trace ring
+    (per-request / per-build span trees) plus a registry snapshot, as
+    JSONL to stdout or `--out FILE` — the exact artifact shape an
+    invariant breach writes automatically (header line included, via
+    the shared recorder serializer), produced by an operator instead of
+    a failure."""
+    from .obs.recorder import artifact_lines
+
+    lines = artifact_lines("manual_trace_dump")
+    text = "\n".join(lines) + "\n"
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(text)
+        # lines minus the header and telemetry records = trace count
+        print(json.dumps({"traces": len(lines) - 2, "out": args.out}))
+    else:
+        sys.stdout.write(text)
     return 0
 
 
@@ -471,7 +527,7 @@ def cmd_serve_bench(args) -> int:
             max_concurrency=args.concurrency, max_queue=args.queue_depth,
             deadline_s=args.deadline,
             breaker_threshold=args.breaker_threshold),
-        timeout_s=args.timeout)
+        timeout_s=args.timeout, flight_dir=args.flight_dir)
     print(json.dumps(report, sort_keys=True, default=repr))
     ok = (report["errors"] == 0 and report["deadlocked"] == 0
           and report["untagged_mismatches"] == 0
@@ -742,9 +798,31 @@ def main(argv: list[str] | None = None) -> int:
     pm.set_defaults(fn=cmd_merge)
 
     pst = sub.add_parser(
-        "stats", help="dump the process-wide recovery + serving counters "
-                      "and fault-plan fire counts as JSON")
+        "stats", help="dump the process-wide recovery + serving counters, "
+                      "fault-plan fire counts and latency histograms as "
+                      "JSON")
+    pst.add_argument("--reset", action="store_true",
+                     help="zero the telemetry registry after reading "
+                          "(per-interval scrapes instead of lifetime "
+                          "counts)")
     pst.set_defaults(fn=cmd_stats)
+
+    pmx = sub.add_parser(
+        "metrics", help="dump the unified TelemetryRegistry (counters + "
+                        "latency histograms) as JSON, or Prometheus text "
+                        "with --prom")
+    pmx.add_argument("--prom", action="store_true",
+                     help="Prometheus text exposition format")
+    pmx.add_argument("--reset", action="store_true",
+                     help="zero the telemetry registry after reading")
+    pmx.set_defaults(fn=cmd_metrics)
+
+    ptd = sub.add_parser(
+        "trace-dump", help="dump the flight-recorder ring (recent span "
+                           "trees) + a telemetry snapshot as JSONL")
+    ptd.add_argument("--out", default=None,
+                     help="write the JSONL here instead of stdout")
+    ptd.set_defaults(fn=cmd_trace_dump)
 
     pb = sub.add_parser(
         "serve-bench",
@@ -778,6 +856,10 @@ def main(argv: list[str] | None = None) -> int:
     pb.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded"],
                     default="auto")
+    pb.add_argument("--flight-dir", default=None,
+                    help="where an invariant breach writes its "
+                         "flight-recorder JSONL (default: "
+                         "TPU_IR_FLIGHT_DIR or the system temp dir)")
     _add_backend_arg(pb)
     pb.set_defaults(fn=cmd_serve_bench)
 

@@ -181,6 +181,12 @@ class FaultPlan:
                 if spec.should_fire(key, self._rng):
                     logger.warning("fault injected at site %r (key=%r)",
                                    site, key)
+                    # mirror the fire into the unified registry: plan
+                    # counters die with the plan object, the registry's
+                    # fault.<site> ledger survives for stats/metrics
+                    from .obs.registry import get_registry
+
+                    get_registry().incr(f"fault.{site}")
                     return spec
         return None
 
@@ -336,9 +342,15 @@ def run_with_retry(fn, *, policy: RetryPolicy = SPILL_RETRY, stage: str,
             logger.warning("stage %r attempt %d/%d failed (%s); retrying",
                            stage, attempt, policy.max_attempts, e)
             sleep(policy.delay_s(attempt, rng))
+    from .obs.recorder import flight_dump
     from .utils.report import recovery_counters
 
     recovery_counters().incr("retry_exhausted")
+    # a structured error is a flight-recorder trigger: freeze the recent
+    # traces + telemetry for the post-mortem (rate-limited, never raises)
+    flight_dump("build_error", extra={
+        "stage": stage, "attempts": policy.max_attempts,
+        "cause": repr(last)})
     raise BuildError(stage, policy.max_attempts, last) from last
 
 
@@ -374,10 +386,18 @@ def run_with_deadline(fn, deadline_s: float | None):
         if len(_abandoned) >= _ABANDONED_CAP:
             raise ScoreDeadlineExceeded(deadline_s)
     box: dict = {}
+    # re-parent the worker onto the caller's open span so the kernel
+    # spans inside fn() land in the request's trace tree instead of
+    # surfacing as orphan roots on the dispatch thread
+    from .obs import attach as obs_attach
+    from .obs import current_span as obs_current_span
+
+    parent_span = obs_current_span()
 
     def run():
         try:
-            box["r"] = fn()
+            with obs_attach(parent_span):
+                box["r"] = fn()
         except BaseException as e:  # delivered to the caller below
             box["e"] = e
 

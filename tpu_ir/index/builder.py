@@ -43,14 +43,21 @@ def _analyze_corpus(
     corpus_paths: Sequence[str], k: int, report: JobReport
 ) -> tuple[list[str], list[list[str]]]:
     """Stream + analyze every document. Returns (docids, per-doc token lists)."""
+    from ..obs import trace as obs_trace
+
     analyzer = make_analyzer()
     docids: list[str] = []
     doc_tokens: list[list[str]] = []
     with report.phase("tokenize"):
-        for doc in read_trec_corpus(corpus_paths):
-            report.incr("Count.DOCS")
-            docids.append(doc.docid)
-            doc_tokens.append(analyzer.analyze(doc.content))
+        # one parse span per corpus file (batch altitude — a span per
+        # document would be hot-loop overhead for no operator value)
+        for path in ([corpus_paths] if isinstance(corpus_paths, str)
+                     else corpus_paths):
+            with obs_trace("build.parse", path=os.path.basename(path)):
+                for doc in read_trec_corpus([path]):
+                    report.incr("Count.DOCS")
+                    docids.append(doc.docid)
+                    doc_tokens.append(analyzer.analyze(doc.content))
     return docids, doc_tokens
 
 

@@ -58,6 +58,7 @@ import numpy as np
 from .. import faults
 from ..analysis.native import make_chunked_tokenizer
 from ..collection import DocnoMapping, Vocab
+from ..obs import trace as obs_trace
 from ..ops import PAD_TERM, PAD_TERM_U16, build_postings_packed_jit
 from ..ops.postings import pair_term_from_df
 from ..utils import JobReport, fetch_to_host
@@ -191,6 +192,13 @@ def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
     with that batch's pair spill rows) rides the same permutation, and
     the shard's positions file is written BEFORE the part file — part
     existence is the resume marker, so positions must never trail it."""
+    with obs_trace("build.spill_reduce", shard=row, batches=n_batches):
+        return _reduce_shard_spills(spill_dir, index_dir, row, n_batches,
+                                    vocab_size, shard_of, positions)
+
+
+def _reduce_shard_spills(spill_dir, index_dir, row, n_batches, vocab_size,
+                         shard_of, positions):
     terms, docs, tfs = [], [], []
     deltas, rlens = [], []
     for b in range(n_batches):
@@ -266,17 +274,20 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
         nonlocal n_batches, acc_docs
         if not acc_docs:
             return
-        if store:
-            write_text_spill(text_path_fn(n_batches), acc_texts,
-                             acc_docids)
-            acc_texts.clear()
-            acc_docids.clear()
-        ids = np.concatenate(acc_ids)
-        lengths = np.concatenate(acc_lens)
-        spill = os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz")
-        # the returned CRC is computed pre-rename, so post-write corruption
-        # of the spill can never match the manifest that records it
-        spill_crcs.append(fmt.savez_atomic(spill, ids=ids, lengths=lengths))
+        with obs_trace("build.spill", batch=n_batches, docs=acc_docs):
+            if store:
+                write_text_spill(text_path_fn(n_batches), acc_texts,
+                                 acc_docids)
+                acc_texts.clear()
+                acc_docids.clear()
+            ids = np.concatenate(acc_ids)
+            lengths = np.concatenate(acc_lens)
+            spill = os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz")
+            # the returned CRC is computed pre-rename, so post-write
+            # corruption of the spill can never match the manifest that
+            # records it
+            spill_crcs.append(fmt.savez_atomic(spill, ids=ids,
+                                               lengths=lengths))
         stats.append(int(batch_stat(ids, lengths)))
         n_batches += 1
         acc_ids.clear()

@@ -1,0 +1,65 @@
+"""tpu_ir.obs — the unified telemetry layer (ISSUE 3).
+
+One subsystem, three instruments, zero new dependencies:
+
+- **Spans** (trace.py): `trace(name)` context managers building
+  per-request / per-build span trees, held in a bounded ring of recent
+  traces. `TPU_IR_TRACE=0` disables everything at one flag test.
+- **Histograms** (histogram.py) + **registry** (registry.py): fixed
+  log-bucket latency histograms and all process-wide counters
+  (`recovery.*`, `serving.*`, `fault.*`) behind one
+  `TelemetryRegistry.snapshot(reset=...)`.
+- **Flight recorder** (recorder.py): on a soak invariant breach, breaker
+  open, or structured build error, the last-N traces + a registry
+  snapshot are dumped to a JSONL artifact — the JobTracker failure
+  page, reborn.
+
+Scrape surfaces: `tpu-ir metrics` (JSON / Prometheus text),
+`tpu-ir trace-dump`, `tpu-ir stats` (superset of the PR 2 shape), and
+the latency sections of `tpu-ir serve-bench` / `bench.py`. RUNBOOK
+"Reading the telemetry" is the operator's guide.
+"""
+
+from .histogram import LatencyHistogram, bucket_index
+from .recorder import flight_dir, flight_dump, reset_rate_limit
+from .registry import (
+    DECLARED_HISTOGRAMS,
+    FAULT_SITES,
+    REQUEST_STAGES,
+    SERVICE_LEVELS,
+    TelemetryRegistry,
+    get_registry,
+)
+from .trace import (
+    Span,
+    attach,
+    clear_traces,
+    configure,
+    current_span,
+    enabled,
+    kernel_annotation,
+    recent_traces,
+    trace,
+)
+
+
+def reset_all() -> None:
+    """Full telemetry reset: registry counters + histograms, the trace
+    ring, and the flight recorder's rate limiter. The test-isolation
+    hook (tests/conftest.py autouse fixture) — one process-wide
+    telemetry state must not leak between tests or between runs."""
+    get_registry().reset()
+    clear_traces()
+    reset_rate_limit()
+
+
+__all__ = [
+    "LatencyHistogram", "bucket_index",
+    "flight_dir", "flight_dump", "reset_rate_limit",
+    "TelemetryRegistry", "get_registry",
+    "FAULT_SITES", "REQUEST_STAGES", "SERVICE_LEVELS",
+    "DECLARED_HISTOGRAMS",
+    "Span", "trace", "attach", "current_span", "recent_traces",
+    "clear_traces", "configure", "enabled", "kernel_annotation",
+    "reset_all",
+]

@@ -1,0 +1,106 @@
+"""The flight recorder: dump recent traces + telemetry on failure.
+
+The Hadoop JobTracker's one genuinely great artifact was the failure
+page: when a job died, the counters and task history at the moment of
+death were frozen in place for the post-mortem. This module is that
+page, reborn for the serving era: on a soak invariant breach, a circuit
+breaker opening, or a structured build error, `flight_dump()` writes one
+JSONL artifact holding
+
+  1. a header line (reason, wall time, pid, caller-supplied context),
+  2. one line per recent trace — the last-N request/build span trees
+     from the trace ring (obs/trace.py), offending request included,
+  3. a full TelemetryRegistry snapshot (counters + histograms).
+
+Dumps are rate-limited per reason (TPU_IR_FLIGHT_INTERVAL seconds,
+default 30) so a flapping breaker under chaos cannot fill a disk;
+invariant breaches pass force=True — a correctness breach is never
+dropped. Artifacts land in TPU_IR_FLIGHT_DIR (default: a `tpu_ir_flight`
+directory under the system temp dir) unless the caller names a
+directory. Read them with `jq`, or just less — one JSON object per line.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import threading
+import time
+
+from .registry import get_registry
+from .trace import recent_traces
+
+_lock = threading.Lock()
+_last_dump: dict[str, float] = {}
+_seq = 0
+
+
+def _min_interval_s() -> float:
+    return float(os.environ.get("TPU_IR_FLIGHT_INTERVAL", "30") or 30)
+
+
+def flight_dir() -> str:
+    return (os.environ.get("TPU_IR_FLIGHT_DIR")
+            or os.path.join(tempfile.gettempdir(), "tpu_ir_flight"))
+
+
+def reset_rate_limit() -> None:
+    """Forget dump timestamps (test isolation)."""
+    with _lock:
+        _last_dump.clear()
+
+
+def artifact_lines(reason: str, extra: dict | None = None) -> list[str]:
+    """THE flight-recorder artifact shape, one JSON string per line:
+    header (reason, wall time, pid, extra context), then one trace
+    record per ring entry, then a full registry snapshot. Shared by
+    flight_dump and `tpu-ir trace-dump` so an operator dump and a
+    breach dump are byte-shape-identical and cannot drift."""
+    header = {
+        "record": "header",
+        "reason": reason,
+        "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
+        "pid": os.getpid(),
+    }
+    if extra:
+        header["extra"] = extra
+    lines = [json.dumps(header, default=repr)]
+    for span in recent_traces():
+        lines.append(json.dumps({"record": "trace",
+                                 "trace": span.to_dict()}, default=repr))
+    lines.append(json.dumps({"record": "telemetry",
+                             "telemetry": get_registry().snapshot()},
+                            default=repr))
+    return lines
+
+
+def flight_dump(reason: str, extra: dict | None = None,
+                out_dir: str | None = None, force: bool = False,
+                ) -> str | None:
+    """Write one flight-recorder artifact; returns its path, or None
+    when rate-limited (same `reason` dumped within the interval and not
+    forced). Never raises: the recorder runs inside failure paths, and a
+    full disk must not convert a degraded request into a crashed one."""
+    global _seq
+    now = time.monotonic()
+    with _lock:
+        if not force and now - _last_dump.get(
+                reason, -1e18) < _min_interval_s():
+            return None
+        _last_dump[reason] = now
+        _seq += 1
+        seq = _seq
+    try:
+        d = out_dir or flight_dir()
+        os.makedirs(d, exist_ok=True)
+        safe = "".join(c if c.isalnum() or c in "._-" else "_"
+                       for c in reason)
+        path = os.path.join(
+            d, f"flight-{time.strftime('%Y%m%dT%H%M%S')}-"
+               f"{os.getpid()}-{seq:03d}-{safe}.jsonl")
+        with open(path, "w") as f:
+            f.write("\n".join(artifact_lines(reason, extra)) + "\n")
+        return path
+    except Exception:  # noqa: BLE001 — see docstring
+        return None

@@ -1,0 +1,233 @@
+"""TelemetryRegistry: the one process-wide home for counters + histograms.
+
+PR 1–2 grew three separate counter surfaces — `recovery_counters()`,
+`serving_counters()` (both utils/report.py) and the fault plan's fire
+counts — each with its own snapshot/reset story, none with any latency
+distribution. This registry unifies them: every process-wide counter
+lives here under a dotted namespace (`recovery.*`, `serving.*`,
+`fault.*`), every latency histogram lives here under its span/stage name,
+and one `snapshot(reset=...)` is the single scrape surface for
+`tpu-ir stats` / `tpu-ir metrics` / the flight recorder. The old
+functions survive as thin prefix views (utils/report.py), so existing
+callers and the `tpu-ir stats` JSON shape keep working.
+
+Declared names: the registry pre-registers a `fault.<site>` counter for
+every fault-injection site threaded through the stack and a latency
+histogram for every serving stage and service level, so a failure path
+or ladder level with NO telemetry is structurally impossible —
+tests/test_obs.py introspects the source for injection sites and the
+frontend for levels and asserts both land in the declared sets.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from .histogram import LatencyHistogram, summary_from_counts
+
+# Every fault-injection site name threaded through the build and serve
+# paths (faults.should_fire / maybe_crash / maybe_hang call sites). A new
+# site MUST be added here — the registry pre-registers its fire counter,
+# and the static-analysis test fails any site found in source but not
+# declared (no silently untelemetered failure path).
+FAULT_SITES = (
+    "spill_write",         # index/format.py: transient spill/part write
+    "artifact_truncate",   # index/format.py: torn artifact write
+    "crash.builder",       # index/builder.py: death before metadata
+    "crash.pass1",         # index/streaming.py: death mid-tokenize
+    "crash.pass2",         # index/streaming.py: death mid-postings
+    "crash.pass3",         # index/streaming.py: death mid-reduce
+    "shuffle_overflow",    # parallel/sharded_build.py: all_to_all drop
+    "score.hang",          # search/scorer.py: hung device dispatch
+    "score.device_loss",   # search/scorer.py: device lost mid-dispatch
+)
+
+# Serving-stage span names (the per-request span tree) — each gets a
+# declared latency histogram so `tpu-ir serve-bench` always reports the
+# full stage breakdown, observed or not.
+REQUEST_STAGES = (
+    "admission_wait",  # time from arrival to holding an execution slot
+    "ladder",          # service-level decision
+    "breaker",         # circuit-breaker consultation
+    "dispatch",        # whole device dispatch (deadline window included)
+    "kernel",          # one jit'd scoring call (per query block)
+    "fallback",        # host-CPU degraded scoring
+)
+
+# Service levels the degradation ladder can emit; each gets a
+# `request.<level>` end-to-end latency histogram (shed = time-to-shed).
+SERVICE_LEVELS = ("full", "no_rerank", "hot_only", "shed")
+
+DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES)
+# "request" (the root span, all levels pooled) rides alongside the
+# per-level request.<level> histograms — same observations, two cuts
+DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + tuple(
+    f"request.{lv}" for lv in SERVICE_LEVELS)
+
+
+def _prom_name(name: str) -> str:
+    return name.replace(".", "_").replace("-", "_")
+
+
+class TelemetryRegistry:
+    """Process-wide counters + latency histograms, one snapshot/reset
+    API. All methods are thread-safe; the hot-path cost of an increment
+    or observation is one dict lookup plus one locked add (the existing
+    counter lock discipline — no new locking model)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: dict[str, int] = {n: 0 for n in DECLARED_COUNTERS}
+        self._hists: dict[str, LatencyHistogram] = {
+            n: LatencyHistogram() for n in DECLARED_HISTOGRAMS}
+
+    # -- counters ----------------------------------------------------------
+
+    def incr(self, name: str, amount: int = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def get(self, name: str) -> int:
+        with self._lock:
+            return self._counters.get(name, 0)
+
+    def counter_names(self) -> tuple:
+        with self._lock:
+            return tuple(self._counters)
+
+    def counters(self, prefix: str = "") -> dict[str, int]:
+        """Counter snapshot; with a prefix, only matching counters, the
+        prefix stripped (the RecoveryCounters-alias view)."""
+        with self._lock:
+            if not prefix:
+                return dict(self._counters)
+            n = len(prefix)
+            return {k[n:]: v for k, v in self._counters.items()
+                    if k.startswith(prefix)}
+
+    def reset_counters(self, prefix: str = "") -> None:
+        """Zero counters under `prefix` ('' = all). Declared counters are
+        kept at 0 (presence is the contract), undeclared ones dropped."""
+        with self._lock:
+            for k in list(self._counters):
+                if k.startswith(prefix):
+                    if k in DECLARED_COUNTERS:
+                        self._counters[k] = 0
+                    else:
+                        del self._counters[k]
+
+    # -- histograms --------------------------------------------------------
+
+    def histogram(self, name: str) -> LatencyHistogram:
+        h = self._hists.get(name)
+        if h is None:
+            with self._lock:
+                h = self._hists.setdefault(name, LatencyHistogram())
+        return h
+
+    def observe(self, name: str, seconds: float) -> None:
+        self.histogram(name).observe(seconds)
+
+    def histogram_names(self) -> tuple:
+        with self._lock:
+            return tuple(self._hists)
+
+    def hist_state(self) -> dict[str, tuple[list[int], float]]:
+        """{name: (bucket counts, total seconds)} — the before-image for
+        delta summaries (serve-bench reports per-run percentiles without
+        resetting process-wide state)."""
+        with self._lock:
+            hists = dict(self._hists)
+        return {n: h.state() for n, h in hists.items()}
+
+    def delta_summary(self, before: dict, always: tuple = ()) -> dict:
+        """Per-histogram summaries of observations made SINCE `before`
+        (a hist_state() snapshot). Names in `always` are reported even
+        with zero new observations — the serve-bench stage contract."""
+        out = {}
+        for name, (counts, sum_s) in self.hist_state().items():
+            b_counts, b_sum = before.get(name, ([0] * len(counts), 0.0))
+            d = [a - b for a, b in zip(counts, b_counts)]
+            if sum(d) > 0 or name in always:
+                out[name] = summary_from_counts(d, sum_s - b_sum)
+        return out
+
+    # -- the scrape surface ------------------------------------------------
+
+    def _collect(self, reset: bool):
+        """One read of everything — counters under a single lock hold
+        (read-and-zero when resetting), histograms via state()/drain().
+        The shared core of snapshot() and prometheus_text(): every
+        scrape surface gets the same atomicity, so with reset=True a
+        concurrent increment or observation lands in exactly one
+        interval, never in none."""
+        with self._lock:
+            counters = dict(self._counters)
+            if reset:
+                for k in list(self._counters):
+                    if k in DECLARED_COUNTERS:
+                        self._counters[k] = 0
+                    else:
+                        del self._counters[k]
+            hists = dict(self._hists)
+        states = {n: (h.drain() if reset else h.state())
+                  for n, h in hists.items()}
+        return counters, states
+
+    def snapshot(self, reset: bool = False) -> dict:
+        """Everything, one dict: {"counters": {...}, "histograms":
+        {name: summary}}. `reset=True` is the per-interval scrape —
+        the explicit between-runs reset `tpu-ir stats`/serve-bench
+        lacked (see _collect for the no-lost-update guarantee)."""
+        counters, states = self._collect(reset)
+        return {"counters": counters,
+                "histograms": {n: summary_from_counts(c, s)
+                               for n, (c, s) in states.items()}}
+
+    def reset(self) -> None:
+        self.reset_counters()
+        with self._lock:
+            hists = dict(self._hists)
+        # histograms are zeroed IN PLACE and never deleted: histogram()
+        # hands out long-lived references (span exits hold them), and an
+        # observe racing a reset must land in the live object — counted
+        # in the next interval — not in a dropped orphan
+        for h in hists.values():
+            h.reset()
+
+    def prometheus_text(self, reset: bool = False) -> str:
+        """Prometheus text exposition: counters as one labeled family,
+        histograms in the native cumulative-bucket format. `reset=True`
+        drains atomically, same as snapshot(reset=True)."""
+        from .histogram import BOUNDS
+
+        counters, states = self._collect(reset)
+        lines = ["# TYPE tpu_ir_events_total counter"]
+        for name, v in sorted(counters.items()):
+            lines.append(f'tpu_ir_events_total{{name="{name}"}} {v}')
+        lines.append("# TYPE tpu_ir_stage_latency_seconds histogram")
+        for name in sorted(states):
+            counts, sum_s = states[name]
+            stage = _prom_name(name)
+            cum = 0
+            for i, c in enumerate(counts):
+                cum += c
+                le = repr(BOUNDS[i]) if i < len(BOUNDS) else "+Inf"
+                lines.append(
+                    f'tpu_ir_stage_latency_seconds_bucket'
+                    f'{{stage="{stage}",le="{le}"}} {cum}')
+            lines.append(
+                f'tpu_ir_stage_latency_seconds_sum{{stage="{stage}"}} '
+                f'{sum_s!r}')
+            lines.append(
+                f'tpu_ir_stage_latency_seconds_count{{stage="{stage}"}} '
+                f'{cum}')
+        return "\n".join(lines) + "\n"
+
+
+_REGISTRY = TelemetryRegistry()
+
+
+def get_registry() -> TelemetryRegistry:
+    """The process-wide TelemetryRegistry singleton."""
+    return _REGISTRY

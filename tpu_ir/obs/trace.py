@@ -1,0 +1,234 @@
+"""Flight-recorder tracing: per-request span trees in a bounded ring.
+
+The reference engine's observability was the Hadoop JobTracker page — a
+frozen table of counters per job. Counters say WHAT happened; they never
+say where one request spent its time or what the last requests before a
+breach looked like. This module is the missing half:
+
+- `trace(name, **attrs)` — a context manager recording one span:
+  monotonic start, duration, thread id, free-form attrs, the exception
+  (if one escaped), and child spans. Nesting via a thread-local stack
+  builds the tree; the serving path's tree is
+  request -> (ladder, admission_wait, breaker, dispatch -> kernel*,
+  fallback), the build path's is build.<phase> per JobReport phase.
+- Every span's duration also lands in the TelemetryRegistry histogram of
+  the same name — spans and latency distributions are one instrument.
+- Completed ROOT spans go into a process-wide bounded ring buffer
+  (`recent_traces()`), the flight recorder's source: on an invariant
+  breach the last N request trees are right there, no log scraping.
+
+Overhead discipline: `TPU_IR_TRACE=0` turns `trace()` into a single
+flag test returning a shared no-op (pinned near-free by a tight-loop
+test); enabled, a span costs two perf_counter_ns calls, one small object
+and one locked histogram increment. `TPU_IR_TRACE_SAMPLE=N` keeps every
+N-th root trace in the ring (histograms always record — sampling bounds
+ring churn, not measurement).
+
+Cross-thread spans: faults.run_with_deadline re-parents its worker
+thread onto the caller's current span via `attach()`, so a deadlined
+dispatch's kernel spans stay inside the request tree instead of
+surfacing as orphan roots.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from contextlib import nullcontext
+
+from .registry import get_registry
+
+_tls = threading.local()
+_ring_lock = threading.Lock()
+
+_ENABLED = os.environ.get("TPU_IR_TRACE", "1") != "0"
+_SAMPLE_N = max(1, int(os.environ.get("TPU_IR_TRACE_SAMPLE", "1") or 1))
+_RING = collections.deque(
+    maxlen=max(1, int(os.environ.get("TPU_IR_TRACE_RING", "64") or 64)))
+_JAX_ANNOTATE = os.environ.get("TPU_IR_JAX_TRACE", "0") != "0"
+_root_seq = 0
+
+
+def configure(enabled: bool | None = None, sample: int | None = None,
+              ring_capacity: int | None = None,
+              jax_annotations: bool | None = None) -> None:
+    """Runtime overrides of the TPU_IR_TRACE* env knobs (tests, REPLs)."""
+    global _ENABLED, _SAMPLE_N, _RING, _JAX_ANNOTATE
+    if enabled is not None:
+        _ENABLED = enabled
+    if sample is not None:
+        _SAMPLE_N = max(1, sample)
+    if ring_capacity is not None:
+        with _ring_lock:
+            _RING = collections.deque(_RING, maxlen=max(1, ring_capacity))
+    if jax_annotations is not None:
+        _JAX_ANNOTATE = jax_annotations
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+class Span:
+    """One timed region; also the context manager that records it."""
+
+    __slots__ = ("name", "attrs", "start_ns", "dur_ns", "thread_id",
+                 "thread_name", "wall_time", "children", "error",
+                 "_is_root")
+
+    def __init__(self, name: str, attrs: dict):
+        self.name = name
+        self.attrs = attrs
+        self.start_ns = 0
+        self.dur_ns = 0
+        t = threading.current_thread()
+        self.thread_id = t.ident or 0
+        self.thread_name = t.name
+        self.wall_time = 0.0
+        self.children: list[Span] = []
+        self.error: str | None = None
+        self._is_root = False
+
+    def set(self, key: str, value) -> None:
+        """Annotate the span (service level, breaker state, ...)."""
+        self.attrs[key] = value
+
+    def __enter__(self) -> "Span":
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self._is_root = not stack
+        if self._is_root:
+            self.wall_time = time.time()
+        stack.append(self)
+        self.start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.dur_ns = time.perf_counter_ns() - self.start_ns
+        if exc is not None:
+            self.error = repr(exc)
+        stack = getattr(_tls, "stack", None)
+        if stack and stack[-1] is self:
+            stack.pop()
+        parent = stack[-1] if stack else None
+        if parent is not None:
+            parent.children.append(self)
+        get_registry().observe(self.name, self.dur_ns / 1e9)
+        if self._is_root:
+            _push_root(self)
+        return False
+
+    def to_dict(self) -> dict:
+        """JSON-ready tree. Copies child/attr containers first: an
+        abandoned deadline thread may still be appending to a parent
+        that is already being serialized."""
+        out = {
+            "name": self.name,
+            "start_ns": self.start_ns,
+            "dur_us": round(self.dur_ns / 1e3, 3),
+            "thread_id": self.thread_id,
+            "thread": self.thread_name,
+        }
+        attrs = dict(self.attrs)
+        if attrs:
+            out["attrs"] = attrs
+        if self.error is not None:
+            out["error"] = self.error
+        if self.wall_time:
+            out["time"] = time.strftime(
+                "%Y-%m-%dT%H:%M:%S", time.localtime(self.wall_time))
+        children = tuple(self.children)
+        if children:
+            out["children"] = [c.to_dict() for c in children]
+        return out
+
+
+class _NullSpan:
+    """The disabled-tracing singleton: enter/exit/set are no-ops."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, key, value):
+        pass
+
+
+_NULL = _NullSpan()
+
+
+def trace(name: str, **attrs):
+    """Open a span (context manager). With tracing disabled this is one
+    flag test and a shared no-op object — safe on any hot path."""
+    if not _ENABLED:
+        return _NULL
+    return Span(name, attrs)
+
+
+def current_span() -> Span | None:
+    """This thread's innermost open span (None outside any trace), the
+    handle `attach()` re-parents worker threads onto."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1] if stack else None
+
+
+class _Attach:
+    __slots__ = ("_parent", "_saved")
+
+    def __init__(self, parent):
+        self._parent = parent
+        self._saved = None
+
+    def __enter__(self):
+        self._saved = getattr(_tls, "stack", None)
+        _tls.stack = [self._parent] if self._parent is not None else []
+        return self
+
+    def __exit__(self, *exc):
+        _tls.stack = self._saved if self._saved is not None else []
+        return False
+
+
+def attach(parent: Span | None):
+    """Context manager making `parent` (a span from ANOTHER thread) the
+    current span on this thread — spans opened inside become its
+    children instead of orphan roots. attach(None) just isolates."""
+    return _Attach(parent)
+
+
+def _push_root(span: Span) -> None:
+    global _root_seq
+    with _ring_lock:
+        _root_seq += 1
+        if _root_seq % _SAMPLE_N == 0:
+            _RING.append(span)
+
+
+def recent_traces() -> list[Span]:
+    """The ring's current contents, oldest first."""
+    with _ring_lock:
+        return list(_RING)
+
+
+def clear_traces() -> None:
+    with _ring_lock:
+        _RING.clear()
+
+
+def kernel_annotation(name: str):
+    """Opt-in jax.profiler named region around a kernel dispatch: with
+    TPU_IR_JAX_TRACE=1 (or configure(jax_annotations=True)) the scoring
+    dispatches show up as named spans in an xprof/tensorboard capture
+    (`--profile DIR`); otherwise a free nullcontext."""
+    if not _JAX_ANNOTATE:
+        return nullcontext()
+    import jax
+
+    return jax.profiler.TraceAnnotation(name)

@@ -30,6 +30,8 @@ import numpy as np
 from jax.sharding import PartitionSpec as P
 
 from .. import faults
+from ..obs import flight_dump
+from ..obs import trace as obs_trace
 from ..utils.report import recovery_counters
 
 logger = logging.getLogger(__name__)
@@ -196,11 +198,13 @@ def sharded_build_postings(
     attempt = 0
     while True:
         attempt += 1
-        out = _sharded_build_jit(
-            jnp.asarray(term_ids), jnp.asarray(doc_ids),
-            jnp.asarray(docs_per_shard),
-            mesh=mesh, num_shards=s, vocab_size=vocab_size,
-            bucket_cap=bucket_cap, total_docs=total_docs)
+        with obs_trace("build.shuffle", attempt=attempt,
+                       bucket_cap=bucket_cap, shards=s):
+            out = _sharded_build_jit(
+                jnp.asarray(term_ids), jnp.asarray(doc_ids),
+                jnp.asarray(docs_per_shard),
+                mesh=mesh, num_shards=s, vocab_size=vocab_size,
+                bucket_cap=bucket_cap, total_docs=total_docs)
         result = ShardedPostings(*out)
         # dropped is psum'd (identical on every shard); read an addressable
         # shard so this also works on a multi-host mesh
@@ -211,6 +215,9 @@ def sharded_build_postings(
         if dropped == 0:
             return result
         if bucket_cap >= c:
+            flight_dump("build_error", extra={
+                "stage": "all_to_all_shuffle", "attempt": attempt,
+                "bucket_cap": bucket_cap, "dropped": dropped})
             raise faults.BuildError(
                 "all_to_all_shuffle", attempt,
                 f"routing overflow persists at bucket_cap={bucket_cap} == "

@@ -25,6 +25,8 @@ import numpy as np
 
 from .. import faults
 from ..analysis.native import make_analyzer
+from ..obs import kernel_annotation
+from ..obs import trace as obs_trace
 from ..collection import KGRAM_SEP, DocnoMapping, Vocab, kgram_terms
 from ..index import format as fmt
 from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense
@@ -895,14 +897,21 @@ class Scorer:
         if force_host:
             recovery_counters().incr("forced_host_batches")
             self.degraded_last = True
-            return fallback() + (True,)
+            with obs_trace("fallback", label=label, forced=True):
+                return fallback() + (True,)
         deadline = self.deadline_s if deadline_s is None else deadline_s
         self.degraded_last = False
         if deadline is None and faults.active() is None:
-            return primary() + (False,)
+            with obs_trace("dispatch", label=label):
+                return primary() + (False,)
         reason = None
         try:
-            return faults.run_with_deadline(primary, deadline) + (False,)
+            # the dispatch span covers the whole deadline window; an
+            # expiry/device-loss escapes THROUGH it (error recorded on
+            # the span) before the except arms classify it below
+            with obs_trace("dispatch", label=label, deadline_s=deadline):
+                return (faults.run_with_deadline(primary, deadline)
+                        + (False,))
         except faults.ScoreDeadlineExceeded as e:
             recovery_counters().incr("deadline_expired")
             reason = str(e)
@@ -914,7 +923,8 @@ class Scorer:
         recovery_counters().incr("degraded_batches")
         logger.warning("%s degraded (%s); %s", label, reason, consequence)
         self.degraded_last = True
-        return fallback() + (True,)
+        with obs_trace("fallback", label=label, reason=reason):
+            return fallback() + (True,)
 
     def _topk_primary(self, q: np.ndarray, k: int, scoring: str,
                       hot_only: bool = False):
@@ -1125,7 +1135,22 @@ class Scorer:
         `hot_only` statically omits the cold tiers instead (the overload
         ladder's cheapest level — partial scores, results must be
         tagged). On the dense layout hot_only is a no-op: there is no
-        cheaper stage to keep, so it serves the full matrix."""
+        cheaper stage to keep, so it serves the full matrix.
+
+        The "kernel" span times the jit call + injected hangs for THIS
+        block (the dispatch is async on real hardware — completion cost
+        lands in the parent dispatch span's fetch); with TPU_IR_JAX_TRACE
+        the block also rides as a named region in jax.profiler captures."""
+        with obs_trace("kernel", layout=self.layout, scoring=scoring,
+                       rows=int(len(q_terms))), \
+                kernel_annotation(
+                    f"tpu_ir.topk.{self.layout}.{scoring}"):
+            return self._topk_device_raw(q_terms, k, scoring,
+                                         skip_hot=skip_hot,
+                                         hot_only=hot_only)
+
+    def _topk_device_raw(self, q_terms: np.ndarray, k: int, scoring: str,
+                         skip_hot: bool = False, hot_only: bool = False):
         faults.maybe_hang("score.hang")
         if faults.should_fire("score.device_loss") is not None:
             raise faults.DeviceLoss("injected device loss")
@@ -1284,13 +1309,17 @@ class Scorer:
                 # through it, and an uninjectable path is an untestable
                 # degradation (the tiered/sharded fallback matrix caught
                 # exactly this gap)
-                faults.maybe_hang("score.hang")
-                if faults.should_fire("score.device_loss") is not None:
-                    raise faults.DeviceLoss("injected device loss")
-                return sharded_tiered_rerank(
-                    jnp.asarray(q), self._sharded, self._df_mesh,
-                    self.meta.num_docs, self._sharded_norm,
-                    mesh=self._mesh, k=k, candidates=candidates)
+                with obs_trace("kernel", layout="sharded",
+                               scoring="rerank", rows=int(len(q))), \
+                        kernel_annotation("tpu_ir.rerank.sharded"):
+                    faults.maybe_hang("score.hang")
+                    if faults.should_fire(
+                            "score.device_loss") is not None:
+                        raise faults.DeviceLoss("injected device loss")
+                    return sharded_tiered_rerank(
+                        jnp.asarray(q), self._sharded, self._df_mesh,
+                        self.meta.num_docs, self._sharded_norm,
+                        mesh=self._mesh, k=k, candidates=candidates)
 
             return self._blocked_dispatch(
                 self._block_size(), dispatch,

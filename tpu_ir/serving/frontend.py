@@ -37,6 +37,8 @@ import threading
 import time
 from dataclasses import dataclass
 
+from .. import obs
+from ..obs import trace as obs_trace
 from ..search.scorer import Scorer, SearchResult
 from ..utils.report import RecoveryCounters, serving_counters
 from .admission import AdmissionController, Overloaded
@@ -182,6 +184,15 @@ class ServingFrontend:
         logger.warning("degradation ladder stepped %s: %s -> %s",
                        direction, frm, to)
 
+    @staticmethod
+    def _observe_latency(name: str, t0: float) -> None:
+        """Record one end-to-end request latency. Gated on the tracing
+        flag so TPU_IR_TRACE=0 disables EVERY latency histogram, not
+        just the span-derived ones (the documented contract: counters
+        stay on, latency instrumentation goes dark)."""
+        if obs.enabled():
+            obs.get_registry().observe(name, time.perf_counter() - t0)
+
     def stats(self) -> dict:
         """This frontend's counters + control-plane state, one dict."""
         out = dict(self._counters.snapshot())
@@ -199,37 +210,66 @@ class ServingFrontend:
         service level (`level`) and fallback flag (`degraded`) that
         produced it, or raises Overloaded (a structured shed — the
         request was NOT executed). `rerank`/`snippets` are what the
-        caller WANTS; the ladder decides what it gets."""
+        caller WANTS; the ladder decides what it gets.
+
+        Telemetry: the whole call is one "request" span tree (ladder →
+        admission_wait → breaker → dispatch/kernel → fallback) and its
+        end-to-end latency lands in the `request.<level>` histogram —
+        sheds included (`request.shed` is the time-to-reject, the
+        number that proves shedding is cheap)."""
+        t0 = time.perf_counter()
         self._count("submitted")
-        level = self.ladder.level()
-        if level == LEVEL_SHED:
-            self._count("shed_level")
-            pressure = self.admission.pressure()
-            # sheds are instant, so pressure falls while shedding: these
-            # observations are how the ladder earns its way back up
-            self.ladder.observe(pressure=pressure, failed=False)
-            raise Overloaded("shed_level",
-                             queue_depth=self.admission.queue_depth(),
-                             level=level)
-        timeout = (self.config.queue_timeout_s
-                   if self.config.queue_timeout_s is not None
-                   else self.config.deadline_s)
-        try:
-            with self.admission.admit(queue_timeout_s=timeout):
-                return self._serve(text, k=k, scoring=scoring,
-                                   rerank=rerank, snippets=snippets,
-                                   level=level)
-        except Overloaded as e:
-            # only admission sheds reach here (queue_full / queue_timeout)
-            self._count(f"shed_{e.reason}")
-            # a full queue is the strongest pressure signal there is
-            self.ladder.observe(pressure=1.0, failed=False)
-            raise
+        with obs_trace("request", scoring=scoring) as root:
+            with obs_trace("ladder") as lsp:
+                level = self.ladder.level()
+                lsp.set("level", level)
+            root.set("level", level)
+            if level == LEVEL_SHED:
+                self._count("shed_level")
+                pressure = self.admission.pressure()
+                # sheds are instant, so pressure falls while shedding:
+                # these observations are how the ladder earns its way
+                # back up
+                self.ladder.observe(pressure=pressure, failed=False)
+                self._observe_latency("request.shed", t0)
+                raise Overloaded("shed_level",
+                                 queue_depth=self.admission.queue_depth(),
+                                 level=level)
+            timeout = (self.config.queue_timeout_s
+                       if self.config.queue_timeout_s is not None
+                       else self.config.deadline_s)
+            try:
+                # the admit context is entered by hand so the
+                # admission_wait span measures ONLY the slot wait (the
+                # queue_full/queue_timeout sheds raise through it and
+                # ride the span as its recorded error), not the serve
+                admit_cm = self.admission.admit(queue_timeout_s=timeout)
+                with obs_trace("admission_wait"):
+                    admit_cm.__enter__()
+                try:
+                    res = self._serve(text, k=k, scoring=scoring,
+                                      rerank=rerank, snippets=snippets,
+                                      level=level)
+                finally:
+                    admit_cm.__exit__(None, None, None)
+                self._observe_latency(f"request.{level}", t0)
+                return res
+            except Overloaded as e:
+                # only admission sheds reach here (queue_full /
+                # queue_timeout)
+                self._count(f"shed_{e.reason}")
+                # a full queue is the strongest pressure signal there is
+                self.ladder.observe(pressure=1.0, failed=False)
+                self._observe_latency("request.shed", t0)
+                raise
 
     def _serve(self, text: str, *, k: int, scoring: str,
                rerank: int | None, snippets: bool,
                level: str) -> SearchResult:
-        allowed, is_probe = self.breaker.allow_device()
+        with obs_trace("breaker") as bsp:
+            allowed, is_probe = self.breaker.allow_device()
+            bsp.set("allowed", allowed)
+            bsp.set("probe", is_probe)
         force_host = not allowed
         if is_probe:
             self._count("breaker_probes")
@@ -257,6 +297,12 @@ class ServingFrontend:
             if dispatch_failed:
                 if self.breaker.record_failure(is_probe=is_probe):
                     self._count("breaker_opened")
+                    # an opening breaker is an incident boundary: freeze
+                    # the recent traces + telemetry (rate-limited — a
+                    # flapping breaker under chaos cannot fill a disk)
+                    obs.flight_dump("breaker_open", extra={
+                        "breaker": self.breaker.snapshot(),
+                        "ladder": self.ladder.snapshot()})
             else:
                 self.breaker.record_success(is_probe=is_probe)
         if res.degraded:

@@ -27,7 +27,7 @@ import random
 import time
 from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
 
-from .. import faults
+from .. import faults, obs
 from ..utils.report import recovery_counters
 from .admission import Overloaded
 from .frontend import ServingConfig, ServingFrontend
@@ -86,9 +86,15 @@ def _serial_reference(scorer, reqs: list[dict]) -> dict:
 def run_soak(scorer, *, threads: int = 8, queries: int = 240,
              seed: int = 0, fault_spec: str | None = DEFAULT_CHAOS_PLAN,
              config: ServingConfig | None = None,
-             timeout_s: float = 120.0, pacing_s: float = 0.004) -> dict:
+             timeout_s: float = 120.0, pacing_s: float = 0.004,
+             flight_dir: str | None = None) -> dict:
     """Run the soak; returns the invariant report (no asserts here — the
     callers decide what is fatal; tests assert on the report fields).
+    The report's `latency` section holds per-stage p50/p95/p99 for the
+    CONCURRENT phase only (delta against the telemetry registry, so
+    repeated runs in one process don't bleed into each other); on an
+    invariant breach the flight recorder dumps the last traces +
+    telemetry to `flight_dir` and the report carries the path.
 
     The scorer must be loaded and fault-plan-free on entry; the given
     `fault_spec` (None = no chaos) is installed only around the
@@ -103,6 +109,7 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
                                   breaker_cooldown_s=0.2)
     frontend = ServingFrontend(scorer, cfg)
     recovery_before = recovery_counters().snapshot()
+    hist_before = obs.get_registry().hist_state()
     results: list = [None] * len(reqs)
 
     def worker(i: int, r: dict) -> None:
@@ -191,7 +198,7 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
         k: v - recovery_before.get(k, 0)
         for k, v in recovery_counters().snapshot().items()
         if v != recovery_before.get(k, 0)}
-    return {
+    report = {
         "submitted": len(reqs),
         "threads": threads,
         "served": served,
@@ -208,4 +215,21 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
         "fault_spec": fault_spec,
         "frontend": fe_stats,
         "recovery_delta": recovery_delta,
+        # per-stage latency percentiles for THIS run (registry delta);
+        # the four acceptance stages always appear, observed or not
+        "latency": obs.get_registry().delta_summary(
+            hist_before, always=("admission_wait", "dispatch", "kernel",
+                                 "fallback")),
     }
+    if errors or deadlocked or untagged_mismatches:
+        # invariant breach: this is exactly the moment the flight
+        # recorder exists for — the offending requests' span trees are
+        # still in the ring. force=True: a breach is never rate-limited
+        report["flight_record"] = obs.flight_dump(
+            "soak_invariant_breach",
+            extra={k: report[k] for k in
+                   ("submitted", "served", "shed", "errors",
+                    "deadlocked", "untagged_mismatches",
+                    "error_samples")},
+            out_dir=flight_dir, force=True)
+    return report

@@ -14,6 +14,9 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+from ..obs.registry import get_registry
+from ..obs.trace import trace as _obs_trace
+
 
 @dataclass
 class JobReport:
@@ -35,12 +38,18 @@ class JobReport:
             self._r, self._name = report, name
 
         def __enter__(self):
+            # every build phase is also a telemetry span: the build-side
+            # trace tree + a build.<phase> latency histogram come free
+            # for every existing report.phase() call site
+            self._span = _obs_trace(f"build.{self._name}")
+            self._span.__enter__()
             self._t = time.perf_counter()
             return self
 
         def __exit__(self, *exc):
             self._r.timings_s[self._name] = self._r.timings_s.get(
                 self._name, 0.0) + time.perf_counter() - self._t
+            self._span.__exit__(*exc)
             return False
 
     def phase(self, name: str) -> "JobReport._Phase":
@@ -64,12 +73,13 @@ class JobReport:
 
 
 class RecoveryCounters:
-    """Process-wide recovery observability: every retry, degradation,
-    quarantine and integrity event increments a named counter here, so a
-    serving process (or a test) can assert that recoveries HAPPENED rather
-    than inferring them from silence. The JobReport counters cover one
-    build job; these cover the process — the Hadoop-counters idea applied
-    to the fault layer."""
+    """A named-counter ledger: every retry, degradation, quarantine and
+    integrity event increments a counter, so a serving process (or a
+    test) can assert that recoveries HAPPENED rather than inferring them
+    from silence. Standalone instances remain the per-frontend ledgers
+    (tpu_ir.serving.ServingFrontend); the PROCESS-WIDE singletons below
+    are now prefix views over the unified TelemetryRegistry
+    (tpu_ir.obs) — same surface, one scrape point."""
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -92,24 +102,48 @@ class RecoveryCounters:
             self._counters.clear()
 
 
-_RECOVERY = RecoveryCounters()
+class _RegistryCounters(RecoveryCounters):
+    """RecoveryCounters-compatible view over one TelemetryRegistry
+    namespace: `incr("retries")` on the "recovery." view is the
+    registry's "recovery.retries". The deprecated-alias half of the
+    ISSUE 3 unification — recovery_counters()/serving_counters() keep
+    their exact shape while the registry becomes the single home."""
+
+    def __init__(self, prefix: str):
+        self._prefix = prefix
+
+    def incr(self, name: str, amount: int = 1) -> None:
+        get_registry().incr(self._prefix + name, amount)
+
+    def get(self, name: str) -> int:
+        return get_registry().get(self._prefix + name)
+
+    def snapshot(self) -> dict[str, int]:
+        return get_registry().counters(self._prefix)
+
+    def reset(self) -> None:
+        get_registry().reset_counters(self._prefix)
+
+
+_RECOVERY = _RegistryCounters("recovery.")
 
 
 def recovery_counters() -> RecoveryCounters:
-    """The process-wide RecoveryCounters singleton. Counter names in use:
-    retries, retry_exhausted, overflow_retries, degraded_batches,
-    deadline_expired, device_loss, forced_host_batches,
-    integrity_failures, quarantined, quarantine_evicted,
-    spill_integrity_discards."""
+    """The process-wide recovery counters — a deprecated thin alias for
+    the TelemetryRegistry's "recovery." namespace (tpu_ir.obs is the
+    primary surface). Counter names in use: retries, retry_exhausted,
+    overflow_retries, degraded_batches, deadline_expired, device_loss,
+    forced_host_batches, integrity_failures, quarantined,
+    quarantine_evicted, spill_integrity_discards."""
     return _RECOVERY
 
 
-_SERVING = RecoveryCounters()
+_SERVING = _RegistryCounters("serving.")
 
 
 def serving_counters() -> RecoveryCounters:
-    """The process-wide serving-frontend counters (same locked-counter
-    machinery as recovery_counters, different ledger: these count
+    """The process-wide serving-frontend counters — a deprecated thin
+    alias for the TelemetryRegistry's "serving." namespace (these count
     REQUESTS and control-plane transitions, not fault recoveries).
     Incremented by tpu_ir.serving.ServingFrontend; scraped by
     `tpu-ir stats`. Names in use: submitted, served_full,
