@@ -892,6 +892,30 @@ def v48_extrapolation(controls: dict, phases: dict, num_docs: int,
 DOC_COUNT_REF = 8_761  # the probe-corpus size the chip ceiling is measured on
 
 
+def _append_history(out: dict) -> None:
+    """Append this run's summary row to the cumulative
+    BENCH_HISTORY.jsonl next to this script (timestamp- and
+    commit-sha-stamped), so the perf trajectory across PRs is one
+    machine-readable file instead of scattered BENCH_*.json snapshots.
+    Best-effort: a read-only checkout must not fail the bench."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"], cwd=here,
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except (subprocess.SubprocessError, OSError):
+        commit = ""
+    row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+           "commit": commit or None, **out}
+    try:
+        with open(os.path.join(here, "BENCH_HISTORY.jsonl"), "a") as f:
+            f.write(json.dumps(row, default=repr) + "\n")
+    except OSError:
+        pass
+
+
 def _build_phase_timings(index_dir: str) -> dict:
     """Surface the builder's own JobReport phase timings into the bench
     JSON (they were always recorded, never published — VERDICT r2 next #1)."""
@@ -1008,6 +1032,7 @@ def main() -> int:
     if args.config == "msmarco":
         out = run_msmarco(args)
         out["backend"] = backend
+        _append_history(out)
         print(json.dumps(out))
         if out["quality_gate_enforced"] and out["quality_gate"] != "ok":
             return 1
@@ -1101,7 +1126,7 @@ def main() -> int:
             phases["docstore_stored_bytes"] = st["stored_bytes"]
 
         if args.build_only:
-            print(json.dumps({
+            out = {
                 "metric": "docs_per_sec_indexed",
                 "value": round(docs_per_sec, 1),
                 "unit": "docs/s",
@@ -1113,7 +1138,9 @@ def main() -> int:
                 "config": args.config,
                 "build_only": True,
                 **phases,
-            }))
+            }
+            _append_history(out)
+            print(json.dumps(out))
             return 0
 
         # self-attribution controls (VERDICT r2 next #1): transport
@@ -1296,6 +1323,7 @@ def main() -> int:
     }
     if serving_error is not None:
         out["serving_error"] = serving_error[:300]
+    _append_history(out)
     print(json.dumps(out))
     return 0
 

@@ -70,3 +70,18 @@ def _no_leaked_nondaemon_threads():
     leaked = [t for t in leaked if t.is_alive()]
     assert not leaked, ("test leaked non-daemon thread(s): "
                         + ", ".join(repr(t) for t in leaked))
+    # telemetry infrastructure threads (the embedded metrics HTTP server
+    # and the spool writer, tpu_ir/obs/server.py + aggregate.py) are
+    # DAEMONS by design — daemonhood is the crash backstop, not a
+    # license to leak. They carry the "tpu-ir-obs" name prefix exactly
+    # so this guard can hold tests to the orderly-stop contract
+    # (MetricsServer.stop() / SpoolWriter.stop()).
+    obs_leaked = [t for t in threading.enumerate()
+                  if t not in before and t.is_alive()
+                  and t.name.startswith("tpu-ir-obs")]
+    for t in obs_leaked:
+        t.join(timeout=2.0)
+    obs_leaked = [t for t in obs_leaked if t.is_alive()]
+    assert not obs_leaked, (
+        "test left telemetry server/spool thread(s) running (call "
+        ".stop()): " + ", ".join(repr(t) for t in obs_leaked))

@@ -37,9 +37,24 @@ coordinator, pid, corpus_dir, index_dir = (
 from tpu_ir.parallel.multihost import init_distributed, build_index_multihost
 
 init_distributed(coordinator, num_processes=2, process_id=pid)
+from tpu_ir import obs
+from tpu_ir.obs import aggregate
+
+# a process-distinct marker so the cluster-total assertion cannot pass
+# vacuously on all-zero counters
+obs.get_registry().incr("test.proc_marker", 100 + pid)
 meta = build_index_multihost([corpus_dir], index_dir, k=1,
                              compute_chargrams=False, batch_docs=2,
                              positions=True, store=True)
+# cluster telemetry: my local snapshot, then the LIVE allgathered merge
+# (a collective — both processes call it together after their builds)
+local = aggregate.local_snapshot()
+cluster = aggregate.gather_cluster()
+telemetry_out = os.environ["TPU_IR_TEST_TELEMETRY_OUT"]
+with open(os.path.join(telemetry_out, f"local-{pid}.json"), "w") as f:
+    json.dump(local, f)
+with open(os.path.join(telemetry_out, f"cluster-{pid}.json"), "w") as f:
+    json.dump(cluster, f)
 print(json.dumps({"pid": pid, "num_docs": meta.num_docs,
                   "num_shards": meta.num_shards,
                   "vocab_size": meta.vocab_size,
@@ -63,7 +78,16 @@ def test_multihost_build(tmp_path):
     script.write_text(WORKER)
     index_dir = str(tmp_path / "mh_index")
 
-    env = {**os.environ, "PYTHONPATH": os.getcwd()}
+    spool_dir = tmp_path / "spool"
+    telemetry_out = tmp_path / "telemetry"
+    spool_dir.mkdir()
+    telemetry_out.mkdir()
+    env = {**os.environ, "PYTHONPATH": os.getcwd(),
+           # each worker spools its final registry snapshot here (the
+           # post-mortem aggregation path) and dumps its local + live
+           # allgathered cluster views into telemetry_out
+           "TPU_IR_TELEMETRY_DIR": str(spool_dir),
+           "TPU_IR_TEST_TELEMETRY_OUT": str(telemetry_out)}
     procs = [
         subprocess.Popen(
             [sys.executable, str(script), f"127.0.0.1:{port}", str(pid),
@@ -132,3 +156,36 @@ def test_multihost_build(tmp_path):
     for docid, text in DOCS.items():
         content = store.get(s_mh.mapping.get_docno(docid))
         assert text in content and docid in content
+
+    # --- cluster telemetry (ISSUE 4 acceptance): the allgathered
+    # cluster snapshot's counter totals equal the sum of the two
+    # per-process snapshots, both processes hold the same merged view,
+    # and the file-spool post-mortem merge agrees with the live one ---
+    import json
+
+    from tpu_ir.obs import aggregate
+
+    locals_ = [json.load(open(telemetry_out / f"local-{p}.json"))
+               for p in range(2)]
+    clusters = [json.load(open(telemetry_out / f"cluster-{p}.json"))
+                for p in range(2)]
+    assert clusters[0]["counters"] == clusters[1]["counters"]
+    assert clusters[0]["histograms"] == clusters[1]["histograms"]
+    cluster = clusters[0]
+    assert cluster["processes"] == 2
+    for key in {k for l in locals_ for k in l["counters"]}:
+        assert cluster["counters"][key] == sum(
+            l["counters"].get(key, 0) for l in locals_), key
+    assert cluster["counters"]["test.proc_marker"] == 100 + 101
+    # the build phases really were observed on both processes and the
+    # cluster histogram counts are the per-process sums
+    for name in ("build.spill", "build.spill_reduce"):
+        want = sum(sum(l["histograms"][name]["counts"]) for l in locals_)
+        assert want > 0
+        assert cluster["histograms"][name]["count"] == want, name
+    # post-mortem path: each worker spooled its snapshot on build exit
+    spooled = aggregate.read_spool(str(spool_dir))
+    assert len(spooled) == 2
+    merged = aggregate.merge_snapshots(spooled)
+    assert merged["counters"] == cluster["counters"]
+    assert merged["histograms"] == cluster["histograms"]

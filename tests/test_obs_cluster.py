@@ -1,0 +1,609 @@
+"""Acceptance suite for the cluster observability layer (ISSUE 4).
+
+Pins the four contracts of the JobTracker top layer:
+
+- **job/progress model** (obs/progress.py): phase counters, bounded
+  history, and the monotone percent-complete contract `/jobs` pollers
+  rely on;
+- **aggregation math** (obs/aggregate.py): merging N process snapshots
+  equals counter sums, histogram merge is associative/commutative, and
+  the file spool dedupes cumulative generations per process;
+- **snapshot seq/resets stamps** (registry): seq strictly monotonic,
+  resets detectable by concurrent scrapers, and — the narrow-fix
+  contract — read-and-zero racing scrapes lose no event and double
+  none;
+- **HTTP endpoints** (obs/server.py): a live server on an ephemeral
+  port serves parseable Prometheus text, a /healthz with
+  breaker/ladder/queue fields, /jobs progress that only moves forward
+  mid-soak, and /flight incident headers — and stops cleanly (the
+  conftest leak guard watches the tpu-ir-obs thread names).
+"""
+
+import json
+import random
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_ir import obs
+from tpu_ir.index.streaming import build_index_streaming
+from tpu_ir.obs import aggregate
+from tpu_ir.obs.histogram import NUM_BUCKETS, LatencyHistogram
+from tpu_ir.obs.progress import start_job, report_progress, tracked
+from tpu_ir.obs.registry import SNAPSHOT_SCHEMA, TelemetryRegistry
+from tpu_ir.obs.server import MetricsServer
+from tpu_ir.search import Scorer
+from tpu_ir.serving import ServingConfig, ServingFrontend, run_soak
+
+WORDS = ("granite basalt quartz mica shale slate marble gneiss "
+         "delta river canyon mesa butte ridge summit valley".split())
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("obs_cluster")
+    body = []
+    for i in range(100):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + (i % 6)))
+        body.append(f"<DOC>\n<DOCNO> R-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    corpus = tmp / "corpus.trec"
+    corpus.write_text("".join(body))
+    out = str(tmp / "idx")
+    build_index_streaming([str(corpus)], out, k=1, num_shards=3,
+                          batch_docs=40, chargram_ks=[])
+    return out
+
+
+@pytest.fixture(scope="module")
+def scorer(index_dir):
+    s = Scorer.load(index_dir, layout="sparse")
+    s.search_batch(["granite river"], k=5, scoring="bm25")
+    s.search_batch(["granite river"], k=5, scoring="tfidf")
+    s.search_batch(["granite river"], k=5, rerank=25)
+    return s
+
+
+def _get(url: str, timeout: float = 10.0) -> tuple[int, bytes]:
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _get_json(url: str):
+    code, body = _get(url)
+    assert code == 200, (code, body[:300])
+    return json.loads(body)
+
+
+# ---------------------------------------------------------------------------
+# the job/progress model
+# ---------------------------------------------------------------------------
+
+
+def test_job_phases_counters_and_percent():
+    job = start_job("build", "unit", phases=("map", "reduce"),
+                    config={"k": 1})
+    job.report("map", advance=3, total=10, docs_parsed=120)
+    d = job.to_dict()
+    assert d["state"] == "running" and d["current_phase"] == "map"
+    assert d["phases"][0]["done"] == 3 and d["phases"][0]["total"] == 10
+    assert d["phases"][0]["counters"]["docs_parsed"] == 120
+    assert d["percent"] == pytest.approx(100 * 0.3 / 2, abs=0.01)
+    # entering the later phase closes "map" for the percent computation
+    job.report("reduce", total=4)
+    assert job.to_dict()["percent"] >= 50.0
+    job.report("reduce", advance=4)
+    job.finish()
+    d = job.to_dict()
+    assert d["state"] == "succeeded" and d["percent"] == 100.0
+    assert "eta_s" not in d
+
+
+def test_job_percent_is_monotone_even_when_totals_move():
+    job = start_job("build", "moving-total", phases=("p",))
+    job.report("p", advance=8, total=10)
+    p1 = job.to_dict()["percent"]
+    # a resume revising the total UP must not walk the needle backwards
+    job.report("p", total=100)
+    assert job.to_dict()["percent"] >= p1
+
+
+def test_job_eta_from_throughput():
+    job = start_job("soak", "eta", phases=("serve",))
+    job.report("serve", total=100)
+    job._phases["serve"]["started"] = time.time() - 10.0  # 10s elapsed
+    job.report("serve", advance=50)                       # -> 5/s
+    eta = job.to_dict()["eta_s"]
+    assert 7.0 < eta < 13.0  # ~10s remaining at the observed rate
+
+
+def test_report_progress_targets_newest_running_job_or_noops():
+    report_progress("anywhere", advance=1)      # no job: a silent no-op
+    with tracked("build", "outer", phases=("a",)) as job:
+        report_progress("a", advance=2)
+        assert job.to_dict()["phases"][0]["done"] == 2
+    # finished: report_progress no longer targets it
+    report_progress("a", advance=5)
+    assert job.to_dict()["phases"][0]["done"] == 2
+    assert job.to_dict()["state"] == "succeeded"
+
+
+def test_tracked_marks_failures_and_history_is_bounded():
+    with pytest.raises(ValueError):
+        with tracked("build", "doomed"):
+            raise ValueError("boom")
+    failed = [j for j in obs.progress.jobs() if j.name == "doomed"]
+    assert failed and failed[0].state == "failed"
+    assert "boom" in failed[0].to_dict()["error"]
+    for i in range(40):          # history cap (default 16) holds
+        start_job("build", f"spam-{i}").finish()
+    assert len(obs.progress.jobs()) <= 16
+
+
+# ---------------------------------------------------------------------------
+# aggregation math (satellite: property tests)
+# ---------------------------------------------------------------------------
+
+
+def _random_registry(rng: random.Random) -> TelemetryRegistry:
+    reg = TelemetryRegistry()
+    for _ in range(rng.randint(5, 30)):
+        reg.incr(rng.choice(["serving.submitted", "recovery.retries",
+                             "fault.score.hang", "test.other"]),
+                 rng.randint(1, 7))
+    for _ in range(rng.randint(10, 60)):
+        reg.observe(rng.choice(["dispatch", "request.full", "build.spill"]),
+                    rng.lognormvariate(-6.0, 2.0))
+    return reg
+
+
+def test_merge_of_n_process_snapshots_equals_counter_sums():
+    rng = random.Random(11)
+    regs = [_random_registry(rng) for _ in range(5)]
+    snaps = [r.collect_state() for r in regs]
+    merged = aggregate.merge_snapshots(snaps)
+    assert merged["processes"] == 5
+    keys = {k for s in snaps for k in s["counters"]}
+    for k in keys:
+        assert merged["counters"][k] == sum(
+            s["counters"].get(k, 0) for s in snaps), k
+    # histogram totals: cluster count == sum of per-process counts, and
+    # the merged summary equals one registry fed the union bucket-wise
+    for name in {n for s in snaps for n in s["histograms"]}:
+        want = sum(sum(s["histograms"][name]["counts"])
+                   for s in snaps if name in s["histograms"])
+        assert merged["histograms"][name]["count"] == want, name
+
+
+def test_merge_is_permutation_invariant_and_histogram_merge_assoc():
+    rng = random.Random(23)
+    snaps = [_random_registry(rng).collect_state() for _ in range(4)]
+    a = aggregate.merge_snapshots(snaps)
+    b = aggregate.merge_snapshots(list(reversed(snaps)))
+    assert a["counters"] == b["counters"]
+    assert a["histograms"] == b["histograms"]
+    # LatencyHistogram.merge: associative and commutative on raw buckets
+    def fill(seed):
+        h = LatencyHistogram()
+        r = random.Random(seed)
+        for _ in range(300):
+            h.observe(r.expovariate(50.0))
+        return h
+    def merged(*hs):
+        out = LatencyHistogram()
+        for h in hs:
+            out.merge(h)
+        return out.state()
+    ha, hb, hc = fill(1), fill(2), fill(3)
+    ab = merged(ha, hb)
+    ab_c = merged(ha, hb, hc)
+    # commutative
+    assert ab == merged(hb, ha)
+    # associative: (a+b)+c == a+(b+c)
+    left = LatencyHistogram()
+    left.merge(ha); left.merge(hb); left.merge(hc)
+    right_bc = LatencyHistogram()
+    right_bc.merge(hb); right_bc.merge(hc)
+    right = LatencyHistogram()
+    right.merge(ha); right.merge(right_bc)
+    assert left.state() == right.state() == ab_c
+
+
+def test_merge_rejects_future_schema_and_foreign_buckets():
+    good = TelemetryRegistry().collect_state()
+    with pytest.raises(ValueError, match="newer"):
+        aggregate.merge_snapshots([good, {**good, "schema": 99}])
+    bad = json.loads(json.dumps(good))
+    bad["histograms"]["dispatch"] = {"counts": [0] * (NUM_BUCKETS - 1),
+                                     "sum_s": 0.0}
+    with pytest.raises(ValueError, match="buckets"):
+        aggregate.merge_snapshots([bad])
+
+
+def test_spool_roundtrip_dedupes_generations(tmp_path, monkeypatch):
+    d = str(tmp_path / "spool")
+    monkeypatch.setenv("TPU_IR_TELEMETRY_DIR", d)
+    reg = obs.get_registry()
+    reg.incr("serving.submitted", 3)
+    assert aggregate.spool_write() is not None
+    reg.incr("serving.submitted", 4)     # newer cumulative generation
+    assert aggregate.spool_write() is not None
+    snaps = aggregate.read_spool(d)
+    assert len(snaps) == 1               # one live file per run_id
+    assert snaps[0]["counters"]["serving.submitted"] == 7
+    # a second "process": a foreign run_id spooled by hand
+    other = json.loads(json.dumps(snaps[0]))
+    other["run_id"] = "deadbeef"
+    other["pid"] = 999999
+    (tmp_path / "spool" / "telemetry-otherhost-999999-000001.json"
+     ).write_text(json.dumps(other))
+    merged = aggregate.merge_snapshots(aggregate.read_spool(d))
+    assert merged["processes"] == 2
+    assert merged["counters"]["serving.submitted"] == 14
+
+
+def test_merge_spool_counts_the_spooling_process_once(tmp_path,
+                                                      monkeypatch):
+    """A serving process that both spools and answers /cluster must not
+    double-count itself: its live snapshot displaces its own spooled
+    generation (same run_id), it does not add to it."""
+    d = str(tmp_path / "spool")
+    monkeypatch.setenv("TPU_IR_TELEMETRY_DIR", d)
+    reg = obs.get_registry()
+    reg.incr("serving.submitted", 8)
+    assert aggregate.spool_write() is not None
+    merged = aggregate.merge_spool(include_local=True)
+    assert merged["processes"] == 1
+    assert merged["counters"]["serving.submitted"] == 8
+    # a foreign process in the spool still counts separately
+    other = TelemetryRegistry()
+    other.incr("serving.submitted", 5)
+    s = other.collect_state()
+    s["host"], s["pid"] = "h", 424242
+    (tmp_path / "spool" / "telemetry-h-424242-000001.json").write_text(
+        json.dumps(s))
+    merged = aggregate.merge_spool(include_local=True)
+    assert merged["processes"] == 2
+    assert merged["counters"]["serving.submitted"] == 13
+
+
+def test_cluster_cli_merges_the_spool(tmp_path, capsys):
+    from tpu_ir.cli import main
+
+    d = tmp_path / "spool"
+    d.mkdir()
+    for i, n in enumerate((5, 11)):
+        snap = TelemetryRegistry()
+        snap.incr("serving.submitted", n)
+        snap.incr("recovery.retries", i)
+        s = snap.collect_state()
+        s["host"], s["pid"] = "h", 1000 + i
+        (d / f"telemetry-h-{1000 + i}-000001.json").write_text(
+            json.dumps(s))
+    assert main(["metrics", "--cluster", "--telemetry-dir", str(d)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["processes"] == 2
+    assert out["counters"]["serving.submitted"] == 16
+    assert out["counters"]["recovery.retries"] == 1
+    assert main(["stats", "--cluster", "--telemetry-dir", str(d)]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["serving"]["submitted"] == 16
+    assert out["processes"] == 2
+    # no spool -> clean usage error, not a traceback
+    assert main(["metrics", "--cluster", "--telemetry-dir",
+                 str(tmp_path / "nope")]) == 1
+
+
+# ---------------------------------------------------------------------------
+# snapshot stamps: schema / seq / resets (+ the --reset narrow fix)
+# ---------------------------------------------------------------------------
+
+
+def test_snapshots_carry_monotonic_seq_and_reset_count():
+    reg = obs.get_registry()
+    s1 = reg.snapshot()
+    s2 = reg.snapshot(reset=True)
+    s3 = reg.snapshot()
+    assert s1["schema"] == s2["schema"] == SNAPSHOT_SCHEMA
+    assert s1["seq"] < s2["seq"] < s3["seq"]
+    assert s2["resets"] == s1["resets"] + 1 == s3["resets"]
+    assert s1["run_id"] == s3["run_id"]
+    # a full reset() also announces itself; seq stays monotonic through
+    reg.reset()
+    s4 = reg.snapshot()
+    assert s4["resets"] == s3["resets"] + 1
+    assert s4["seq"] > s3["seq"]
+
+
+def test_flight_header_carries_schema_and_seq(tmp_path):
+    p1 = obs.flight_dump("unit_reason", out_dir=str(tmp_path), force=True)
+    p2 = obs.flight_dump("unit_reason", out_dir=str(tmp_path), force=True)
+    h1 = json.loads(open(p1).readline())
+    h2 = json.loads(open(p2).readline())
+    assert h1["record"] == "header" and h1["schema"] == 1
+    assert h2["seq"] > h1["seq"]
+
+
+def test_concurrent_reset_scrapes_lose_nothing_double_nothing():
+    """The narrow fix pinned: producers increment while two drainers
+    scrape with reset=True — every increment lands in exactly one
+    drained interval (or the final sweep), and the seq/resets stamps
+    order the intervals."""
+    reg = obs.get_registry()
+    N_PRODUCERS, PER = 4, 500
+    drained = []
+    stop = threading.Event()
+
+    def produce():
+        for _ in range(PER):
+            reg.incr("serving.submitted")
+
+    def drain():
+        while not stop.is_set():
+            drained.append(reg.snapshot(reset=True))
+
+    producers = [threading.Thread(target=produce) for _ in range(N_PRODUCERS)]
+    drainers = [threading.Thread(target=drain) for _ in range(2)]
+    for t in drainers + producers:
+        t.start()
+    for t in producers:
+        t.join()
+    stop.set()
+    for t in drainers:
+        t.join()
+    drained.append(reg.snapshot(reset=True))   # the final sweep
+    total = sum(s["counters"].get("serving.submitted", 0) for s in drained)
+    assert total == N_PRODUCERS * PER
+    seqs = [s["seq"] for s in drained]
+    assert len(set(seqs)) == len(seqs)         # every scrape distinct
+    # within one thread's drain sequence, seq and resets only grow
+    assert all(s["resets"] >= 1 for s in drained)
+
+
+# ---------------------------------------------------------------------------
+# HTTP endpoints (ephemeral port, urllib)
+# ---------------------------------------------------------------------------
+
+_PROM_LINE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? [0-9eE+.\-inf]+$')
+
+
+def _assert_prometheus_parses(text: str) -> int:
+    """Every non-comment line is `name{labels} value`; cumulative bucket
+    counts are non-decreasing per stage and +Inf equals _count."""
+    lines = [ln for ln in text.splitlines() if ln]
+    assert lines, "empty exposition"
+    n = 0
+    cum: dict[str, list] = {}
+    for ln in lines:
+        if ln.startswith("#"):
+            continue
+        assert _PROM_LINE.match(ln), f"unparseable line: {ln!r}"
+        n += 1
+        m = re.match(r'.*\{stage="([^"]+)",le="([^"]+)"\} (\d+)$', ln)
+        if m:
+            cum.setdefault(m.group(1), []).append(int(m.group(3)))
+    for stage, counts in cum.items():
+        assert counts == sorted(counts), f"{stage} buckets not cumulative"
+    return n
+
+
+def test_server_endpoints_metrics_jobs_flight(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path / "flight"))
+    reg = obs.get_registry()
+    reg.incr("serving.submitted", 9)
+    reg.observe("dispatch", 0.004)
+    job = start_job("build", "endpoint-unit", phases=("map", "reduce"))
+    job.report("map", advance=2, total=4, docs_parsed=37)
+    obs.flight_dump("unit_incident", force=True)
+    srv = MetricsServer(port=0)
+    srv.start()
+    try:
+        # /metrics: parseable Prometheus text; read-only (reset refused)
+        code, body = _get(f"{srv.url}/metrics")
+        assert code == 200
+        text = body.decode()
+        assert 'tpu_ir_events_total{name="serving.submitted"} 9' in text
+        assert _assert_prometheus_parses(text) > 10
+        code, _ = _get(f"{srv.url}/metrics?reset=1")
+        assert code == 403
+        assert reg.get("serving.submitted") == 9     # nothing drained
+        # /metrics.json carries the stamps
+        mj = _get_json(f"{srv.url}/metrics.json")
+        assert mj["schema"] == SNAPSHOT_SCHEMA and mj["seq"] > 0
+        # /jobs + /jobs/<id>, JSON and the JobTracker HTML echo
+        jobs = _get_json(f"{srv.url}/jobs")["jobs"]
+        mine = [j for j in jobs if j["name"] == "endpoint-unit"][0]
+        assert mine["phases"][0]["counters"]["docs_parsed"] == 37
+        one = _get_json(f"{srv.url}/jobs/{mine['job_id']}")
+        assert one["percent"] == mine["percent"]
+        code, html_body = _get(
+            f"{srv.url}/jobs/{mine['job_id']}?format=html")
+        assert code == 200
+        page = html_body.decode()
+        assert "<table>" in page and "endpoint-unit" in page
+        assert "docs_parsed=37" in page
+        code, _ = _get(f"{srv.url}/jobs/999999")
+        assert code == 404
+        # /flight: the incident header index
+        fl = _get_json(f"{srv.url}/flight")["flight_records"]
+        assert any(h["reason"] == "unit_incident" and "schema" in h
+                   for h in fl)
+        # /healthz exists even with no frontend registered
+        hz = _get_json(f"{srv.url}/healthz")
+        assert hz["status"] == "ok"
+        assert "breaker" in hz and "ladder" in hz and "queue_depth" in hz
+    finally:
+        srv.stop()
+    # after stop(): the port actually closed
+    with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+        urllib.request.urlopen(f"{srv.url}/healthz", timeout=2)
+
+
+def test_healthz_reports_frontend_control_plane(scorer):
+    frontend = ServingFrontend(scorer, ServingConfig(max_concurrency=2))
+    frontend.search("granite river", k=5)
+    with MetricsServer(port=0) as srv:
+        hz = _get_json(f"{srv.url}/healthz")
+        assert hz["breaker"]["state"] == "closed"
+        assert hz["ladder"]["level"] == "full"
+        assert hz["queue_depth"] == 0
+        # [-1]: a frontend from an earlier test may still be alive (the
+        # weakref registry keeps every live one); ours is the newest,
+        # and it is the one the top-level breaker/ladder fields lift
+        assert hz["frontends"][-1]["submitted"] == 1
+
+
+def _poll_until(pred, timeout_s=30.0, interval_s=0.02):
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        v = pred()
+        if v:
+            return v
+        time.sleep(interval_s)
+    raise AssertionError("condition not reached in time")
+
+
+def _soak_with_server(scorer, queries, threads, fault_spec=None):
+    """Drive run_soak on a worker thread with a live server; scrape
+    /jobs, /metrics and /healthz mid-run; return (report, percents)."""
+    report_box = {}
+    with MetricsServer(port=0) as srv:
+        t = threading.Thread(
+            target=lambda: report_box.update(r=run_soak(
+                scorer, threads=threads, queries=queries, seed=5,
+                fault_spec=fault_spec,
+                config=ServingConfig(max_concurrency=4, max_queue=16,
+                                     deadline_s=5.0),
+                timeout_s=120.0)),
+            name="soak-driver")
+        t.start()
+        try:
+            # the soak job appears and progresses while requests fly
+            def soak_job():
+                js = _get_json(f"{srv.url}/jobs")["jobs"]
+                mine = [j for j in js if j["kind"] == "soak"]
+                return mine[0] if mine else None
+
+            job = _poll_until(soak_job)
+            job_id = job["job_id"]
+            percents = []
+            while t.is_alive():
+                d = _get_json(f"{srv.url}/jobs/{job_id}")
+                percents.append(d["percent"])
+                code, body = _get(f"{srv.url}/metrics")
+                assert code == 200
+                _assert_prometheus_parses(body.decode())
+                hz = _get_json(f"{srv.url}/healthz")
+                assert ("breaker" in hz and "ladder" in hz
+                        and "queue_depth" in hz)
+                time.sleep(0.02)
+            percents.append(_get_json(f"{srv.url}/jobs/{job_id}")["percent"])
+        finally:
+            t.join(timeout=120.0)
+    assert not t.is_alive()
+    return report_box["r"], percents
+
+
+def test_soak_failure_after_reference_marks_job_failed(scorer):
+    """An escape AFTER the reference phase (here: a malformed fault
+    spec) must still mark the soak job failed — never a ghost job stuck
+    'running' in /jobs and /healthz's jobs_running."""
+    with pytest.raises(ValueError):
+        run_soak(scorer, threads=2, queries=4, seed=1,
+                 fault_spec="seed=bogus")
+    soaks = [j for j in obs.progress.jobs() if j.kind == "soak"]
+    assert soaks and soaks[-1].state == "failed"
+    assert "bogus" in soaks[-1].error
+
+
+def test_mid_soak_scrapes_metrics_healthz_and_monotone_jobs(scorer):
+    """THE acceptance criterion: during a soak with a live metrics
+    server, mid-run scrapes return parseable /metrics Prometheus text,
+    a /healthz with breaker/ladder/queue fields, and /jobs progress
+    that only moves forward."""
+    report, percents = _soak_with_server(scorer, queries=80, threads=4)
+    assert report["errors"] == 0 and report["deadlocked"] == 0
+    assert len(percents) >= 3, "soak finished before any mid-run scrape"
+    assert all(b >= a for a, b in zip(percents, percents[1:])), percents
+    assert percents[-1] == 100.0
+
+
+@pytest.mark.slow
+def test_long_chaos_soak_with_server_slow(scorer):
+    """The long variant: a chaos soak under the live server — progress
+    stays monotone and the scrapes stay parseable while hangs and
+    device losses fire."""
+    from tpu_ir.serving.soak import DEFAULT_CHAOS_PLAN
+
+    report, percents = _soak_with_server(
+        scorer, queries=600, threads=8, fault_spec=DEFAULT_CHAOS_PLAN)
+    assert report["errors"] == 0
+    assert all(b >= a for a, b in zip(percents, percents[1:]))
+
+
+# ---------------------------------------------------------------------------
+# build jobs: the builders actually feed the tracker
+# ---------------------------------------------------------------------------
+
+
+def test_streaming_build_registers_a_tracked_job(tmp_path):
+    body = "".join(
+        f"<DOC>\n<DOCNO> J-{i:03d} </DOCNO>\n<TEXT>\nalpha beta g{i}\n"
+        f"</TEXT>\n</DOC>\n" for i in range(30))
+    corpus = tmp_path / "c.trec"
+    corpus.write_text(body)
+    build_index_streaming([str(corpus)], str(tmp_path / "idx"), k=1,
+                          num_shards=2, batch_docs=10, chargram_ks=[])
+    job = [j for j in obs.progress.jobs() if j.kind == "build"][-1]
+    d = job.to_dict()
+    assert d["state"] == "succeeded" and d["percent"] == 100.0
+    by_phase = {p["phase"]: p for p in d["phases"]}
+    assert by_phase["pass1_tokenize"]["counters"]["docs_parsed"] == 30
+    # batch count tracks the tokenizer's chunking (one delta per corpus
+    # chunk), so pin consistency, not a count: every pass-1 spill batch
+    # became exactly one completed pass-2 step
+    n_batches = by_phase["pass1_tokenize"]["done"]
+    assert n_batches >= 1
+    assert by_phase["pass1_tokenize"]["counters"]["spills_written"] == \
+        n_batches
+    assert by_phase["pass2_combine"]["done"] == n_batches
+    assert by_phase["pass2_combine"]["total"] == n_batches
+    assert by_phase["pass3_reduce"]["done"] == 2
+    assert by_phase["pass3_reduce"]["counters"]["shards_reduced"] == 2
+
+
+def test_failed_build_marks_its_job_failed(tmp_path):
+    empty = tmp_path / "empty.trec"
+    empty.write_text("no trec records here\n")
+    with pytest.raises(ValueError):
+        build_index_streaming([str(empty)], str(tmp_path / "idx2"),
+                              k=1, num_shards=2)
+    job = [j for j in obs.progress.jobs() if j.kind == "build"][-1]
+    assert job.state == "failed"
+
+
+def test_index_cli_track_serves_and_stops(tmp_path, capsys):
+    """--track PORT: the build runs under a live server (URL announced
+    on stderr) and the server is gone when the command returns (the
+    conftest tpu-ir-obs leak guard enforces the 'gone')."""
+    from tpu_ir.cli import main
+
+    body = "".join(
+        f"<DOC>\n<DOCNO> T-{i:03d} </DOCNO>\n<TEXT>\ngamma delta t{i}\n"
+        f"</TEXT>\n</DOC>\n" for i in range(12))
+    corpus = tmp_path / "c.trec"
+    corpus.write_text(body)
+    rc = main(["index", str(corpus), str(tmp_path / "idx"),
+               "--no-chargrams", "--shards", "2", "--track", "0"])
+    assert rc == 0
+    err = capsys.readouterr().err
+    assert "serving live telemetry on http://127.0.0.1:" in err

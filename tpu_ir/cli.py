@@ -130,9 +130,36 @@ class _MaybeProfile:
         return False
 
 
+class _MaybeTrack:
+    """Run the command under an embedded metrics/jobs HTTP server
+    (`--track PORT` / serve-bench `--metrics-port PORT`): /jobs shows
+    the live JobTracker-style progress of the build or soak, /metrics
+    is scrapeable mid-run, and the server shuts down cleanly with the
+    command (obs/server.py). Port 0 binds an ephemeral port; the chosen
+    URL is announced on stderr either way."""
+
+    def __init__(self, port: int | None):
+        self._port = port
+        self.server = None
+
+    def __enter__(self):
+        if self._port is not None:
+            from .obs.server import start_server
+
+            self.server = start_server(port=self._port)
+            print(f"tpu-ir: serving live telemetry on {self.server.url} "
+                  "(/metrics /healthz /jobs /flight)", file=sys.stderr)
+        return self
+
+    def __exit__(self, *exc):
+        if self.server is not None:
+            self.server.stop()
+        return False
+
+
 def cmd_index(args) -> int:
     _apply_backend(args)
-    with _MaybeProfile(args.profile):
+    with _MaybeProfile(args.profile), _MaybeTrack(args.track):
         return _run_index(args)
 
 
@@ -443,7 +470,24 @@ def cmd_stats(args) -> int:
     # fault.* ledger (sites that actually fired — so it resets in step
     # with everything else, instead of the installed plan's lifetime
     # counts drifting against a per-interval scrape)
-    snap = obs.get_registry().snapshot(reset=args.reset)
+    if args.cluster:
+        # same sections, cluster totals: the spooled per-process
+        # snapshots merged (see cmd_metrics --cluster)
+        from .obs import aggregate
+
+        d = getattr(args, "telemetry_dir", None) or aggregate.spool_dir()
+        snaps = aggregate.read_spool(d) if d else []
+        if not snaps:
+            print("error: --cluster needs spooled telemetry "
+                  "(TPU_IR_TELEMETRY_DIR / --telemetry-dir)",
+                  file=sys.stderr)
+            return 1
+        snap = aggregate.merge_snapshots(snaps)
+        extra = {"processes": snap["processes"],
+                 "per_process": snap["per_process"]}
+    else:
+        snap = obs.get_registry().snapshot(reset=args.reset)
+        extra = {}
 
     def section(prefix: str) -> dict:
         n = len(prefix)
@@ -456,6 +500,7 @@ def cmd_stats(args) -> int:
         "fault_injection": {k: v for k, v in section("fault.").items()
                             if v},
         "histograms": snap["histograms"],
+        **extra,
     }, sort_keys=True))
     return 0
 
@@ -469,6 +514,26 @@ def cmd_metrics(args) -> int:
     `--reset` zeroes the registry after reading."""
     from . import obs
 
+    if args.cluster:
+        # cluster view: every spooled process snapshot (newest per
+        # run_id, TPU_IR_TELEMETRY_DIR / --telemetry-dir) merged —
+        # counter totals are sums, histogram buckets add exactly.
+        # A fresh CLI process's own (empty) registry is NOT folded in.
+        from .obs import aggregate
+
+        d = args.telemetry_dir or aggregate.spool_dir()
+        if not d:
+            print("error: --cluster needs TPU_IR_TELEMETRY_DIR or "
+                  "--telemetry-dir", file=sys.stderr)
+            return 1
+        snaps = aggregate.read_spool(d)
+        if not snaps:
+            print(f"error: no spooled telemetry under {d}",
+                  file=sys.stderr)
+            return 1
+        print(json.dumps(aggregate.merge_snapshots(snaps),
+                         sort_keys=True))
+        return 0
     reg = obs.get_registry()
     if args.prom:
         sys.stdout.write(reg.prometheus_text(reset=args.reset))
@@ -520,14 +585,18 @@ def cmd_serve_bench(args) -> int:
     if faults.active() is not None:
         spec = args.faults or os.environ.get("TPU_IR_FAULTS") or spec
         faults.install(None)
-    report = run_soak(
-        scorer, threads=args.threads, queries=args.queries,
-        seed=args.seed, fault_spec=spec,
-        config=ServingConfig(
-            max_concurrency=args.concurrency, max_queue=args.queue_depth,
-            deadline_s=args.deadline,
-            breaker_threshold=args.breaker_threshold),
-        timeout_s=args.timeout, flight_dir=args.flight_dir)
+    with _MaybeTrack(args.metrics_port) as track:
+        report = run_soak(
+            scorer, threads=args.threads, queries=args.queries,
+            seed=args.seed, fault_spec=spec,
+            config=ServingConfig(
+                max_concurrency=args.concurrency,
+                max_queue=args.queue_depth,
+                deadline_s=args.deadline,
+                breaker_threshold=args.breaker_threshold),
+            timeout_s=args.timeout, flight_dir=args.flight_dir)
+        if track.server is not None:
+            report["metrics_url"] = track.server.url
     print(json.dumps(report, sort_keys=True, default=repr))
     ok = (report["errors"] == 0 and report["deadlocked"] == 0
           and report["untagged_mismatches"] == 0
@@ -704,6 +773,10 @@ def main(argv: list[str] | None = None) -> int:
                     help="also build the compressed document-text store "
                          "(one extra corpus pass; enables search "
                          "--snippets)")
+    pi.add_argument("--track", type=int, default=None, metavar="PORT",
+                    help="serve live build progress over HTTP for the "
+                         "duration of the build (/jobs /metrics /healthz; "
+                         "0 = ephemeral port, announced on stderr)")
     _add_backend_arg(pi)
     pi.set_defaults(fn=cmd_index)
 
@@ -805,6 +878,13 @@ def main(argv: list[str] | None = None) -> int:
                      help="zero the telemetry registry after reading "
                           "(per-interval scrapes instead of lifetime "
                           "counts)")
+    pst.add_argument("--cluster", action="store_true",
+                     help="merge the spooled per-process snapshots "
+                          "(TPU_IR_TELEMETRY_DIR) into cluster totals "
+                          "instead of reading this process's registry")
+    pst.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                     help="spool directory for --cluster (default: "
+                          "TPU_IR_TELEMETRY_DIR)")
     pst.set_defaults(fn=cmd_stats)
 
     pmx = sub.add_parser(
@@ -815,6 +895,13 @@ def main(argv: list[str] | None = None) -> int:
                      help="Prometheus text exposition format")
     pmx.add_argument("--reset", action="store_true",
                      help="zero the telemetry registry after reading")
+    pmx.add_argument("--cluster", action="store_true",
+                     help="merge the spooled per-process snapshots "
+                          "(TPU_IR_TELEMETRY_DIR) into cluster totals "
+                          "instead of reading this process's registry")
+    pmx.add_argument("--telemetry-dir", default=None, metavar="DIR",
+                     help="spool directory for --cluster (default: "
+                          "TPU_IR_TELEMETRY_DIR)")
     pmx.set_defaults(fn=cmd_metrics)
 
     ptd = sub.add_parser(
@@ -860,6 +947,12 @@ def main(argv: list[str] | None = None) -> int:
                     help="where an invariant breach writes its "
                          "flight-recorder JSONL (default: "
                          "TPU_IR_FLIGHT_DIR or the system temp dir)")
+    pb.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="serve live telemetry over HTTP for the "
+                         "duration of the soak (/metrics /healthz /jobs "
+                         "/flight; 0 = ephemeral port, announced on "
+                         "stderr)")
     _add_backend_arg(pb)
     pb.set_defaults(fn=cmd_serve_bench)
 

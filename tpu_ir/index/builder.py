@@ -32,6 +32,7 @@ from ..ops import (
     pack_term_bytes,
     round_cap,
 )
+from ..obs.progress import report_progress, tracked
 from ..utils import JobReport, fetch_to_host
 from ..utils.transfer import narrow_uint, shrink_for_fetch, shrink_pairs
 from . import format as fmt
@@ -53,15 +54,37 @@ def _analyze_corpus(
         # document would be hot-loop overhead for no operator value)
         for path in ([corpus_paths] if isinstance(corpus_paths, str)
                      else corpus_paths):
+            n_before = len(docids)
             with obs_trace("build.parse", path=os.path.basename(path)):
                 for doc in read_trec_corpus([path]):
                     report.incr("Count.DOCS")
                     docids.append(doc.docid)
                     doc_tokens.append(analyzer.analyze(doc.content))
+            report_progress("tokenize", advance=1,
+                            docs_parsed=len(docids) - n_before)
     return docids, doc_tokens
 
 
-def build_index(
+def build_index(corpus_paths, index_dir, **kwargs) -> fmt.IndexMetadata:
+    """Build every index artifact for a TREC corpus (idempotent per
+    artifact; parameters are keyword-only, see the implementation
+    below). Runs as a tracked job: /jobs (and the `--track` server)
+    shows phase progress + the JobTracker counters live, and a build
+    that dies marks its job failed instead of leaving a ghost.
+
+    `positions=True` additionally writes format-v2 per-posting position
+    runs (index/positions.py) enabling phrase/proximity queries."""
+    name = os.path.basename(os.path.normpath(os.fspath(index_dir)))
+    with tracked("build", f"index:{name}",
+                 phases=("tokenize", "docno_mapping", "postings",
+                         "write_shards", "dictionary"),
+                 config={"k": kwargs.get("k", 1),
+                         "num_shards": kwargs.get("num_shards"),
+                         "spmd_devices": kwargs.get("spmd_devices")}):
+        return _build_index(corpus_paths, index_dir, **kwargs)
+
+
+def _build_index(
     corpus_paths: Sequence[str] | str,
     index_dir: str,
     *,
@@ -73,10 +96,6 @@ def build_index(
     spmd_devices: int | None = None,
     positions: bool = False,
 ) -> fmt.IndexMetadata:
-    """Build every index artifact for a TREC corpus. Idempotent per artifact.
-
-    `positions=True` additionally writes format-v2 per-posting position
-    runs (index/positions.py) enabling phrase/proximity queries."""
     if isinstance(corpus_paths, (str, os.PathLike)):
         corpus_paths = [corpus_paths]
     chargram_ks = list(chargram_ks)
@@ -116,6 +135,9 @@ def build_index(
     if native_corpus is not None:
         docids, temp_ids, lengths, vocab_list = native_corpus
         report.set_counter("Count.DOCS", len(docids))
+        report_progress("tokenize", advance=1, total=1,
+                        docs_parsed=len(docids),
+                        occurrences=len(temp_ids))
         num_docs = len(docids)
         if num_docs == 0:
             raise ValueError(f"no <DOC> records found in {corpus_paths}")
@@ -150,6 +172,7 @@ def build_index(
     report.set_counter("reduce_output_groups", v)
 
     # --- docno mapping (NumberTrecDocuments equivalent) ---
+    report_progress("docno_mapping", docs=num_docs)
     with report.phase("docno_mapping"):
         mapping = DocnoMapping.build(docids)
         if len(mapping) != num_docs:
@@ -184,6 +207,7 @@ def build_index(
         return chargram_state["handle"]
 
     deferred = None  # single-device: big pair arrays still in flight to host
+    report_progress("postings", occurrences=occurrences)
     if spmd_devices:
         flat_doc_ids = np.repeat(docnos, lengths).astype(np.int32)
         # --- SPMD path: doc-sharded map + all_to_all shuffle + term-sharded
@@ -248,6 +272,7 @@ def build_index(
             collect_chargram_builds(index_dir, chargram_handle)
 
     # --- shard + persist (part-NNNNN layout) ---
+    report_progress("write_shards", pairs=num_pairs)
     with report.phase("write_shards"):
         if deferred is not None:
             df, doc_len, pair_doc, pair_tf = fetch_to_host(*deferred)
@@ -276,6 +301,7 @@ def build_index(
                                       lengths, num_shards)
 
     # --- dictionary / forward index (BuildIntDocVectorsForwardIndex) ---
+    report_progress("dictionary", terms=v)
     with report.phase("dictionary"):
         fmt.write_dictionary(index_dir, vocab.terms, shard_of, offset_of)
         dict_report = JobReport("BuildIntDocVectorsForwardIndex")

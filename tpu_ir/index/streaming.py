@@ -59,6 +59,7 @@ from .. import faults
 from ..analysis.native import make_chunked_tokenizer
 from ..collection import DocnoMapping, Vocab
 from ..obs import trace as obs_trace
+from ..obs.progress import report_progress, tracked
 from ..ops import PAD_TERM, PAD_TERM_U16, build_postings_packed_jit
 from ..ops.postings import pair_term_from_df
 from ..utils import JobReport, fetch_to_host
@@ -193,8 +194,14 @@ def reduce_shard_spills(spill_dir: str, index_dir: str, row: int,
     the shard's positions file is written BEFORE the part file — part
     existence is the resume marker, so positions must never trail it."""
     with obs_trace("build.spill_reduce", shard=row, batches=n_batches):
-        return _reduce_shard_spills(spill_dir, index_dir, row, n_batches,
-                                    vocab_size, shard_of, positions)
+        rdf, npairs = _reduce_shard_spills(spill_dir, index_dir, row,
+                                           n_batches, vocab_size, shard_of,
+                                           positions)
+    # JobTracker progress: one reduce "task" done (the caller declared
+    # the phase total = its shard count)
+    report_progress("pass3_reduce", advance=1, shards_reduced=1,
+                    pairs=npairs)
+    return rdf, npairs
 
 
 def _reduce_shard_spills(spill_dir, index_dir, row, n_batches, vocab_size,
@@ -288,6 +295,9 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
             # records it
             spill_crcs.append(fmt.savez_atomic(spill, ids=ids,
                                                lengths=lengths))
+        report_progress("pass1_tokenize", advance=1, docs_parsed=acc_docs,
+                        spills_written=1 + int(store),
+                        occurrences=len(ids))
         stats.append(int(batch_stat(ids, lengths)))
         n_batches += 1
         acc_ids.clear()
@@ -317,7 +327,26 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
     return all_docids, vocab_list, n_batches, stats, spill_crcs
 
 
-def build_index_streaming(
+def build_index_streaming(corpus_paths, index_dir,
+                          **kwargs) -> fmt.IndexMetadata:
+    """The public streaming build, run as a tracked job: /jobs (and the
+    `--track` server) shows pass-1/2/3 progress live with the JobTracker
+    counters (docs parsed, spills written, shards reduced), and a build
+    that dies marks its job failed instead of leaving a ghost. All
+    parameters pass through to the implementation below (they are
+    keyword-only there)."""
+    name = os.path.basename(os.path.normpath(os.fspath(index_dir)))
+    with tracked("build", f"streaming:{name}",
+                 phases=("pass1_tokenize", "pass2_combine",
+                         "pass3_reduce", "finalize"),
+                 config={"k": kwargs.get("k", 1),
+                         "spmd_devices": kwargs.get("spmd_devices"),
+                         "num_shards": kwargs.get("num_shards"),
+                         "streaming": True}):
+        return _build_index_streaming(corpus_paths, index_dir, **kwargs)
+
+
+def _build_index_streaming(
     corpus_paths: Sequence[str] | str,
     index_dir: str,
     *,
@@ -390,6 +419,9 @@ def build_index_streaming(
         all_docids, vocab_list, n_batches, batch_occ = resume_state
         report.incr("Count.DOCS", len(all_docids))
         report.set_counter("pass1_resumed_batches", n_batches)
+        report_progress("pass1_tokenize", advance=n_batches,
+                        total=n_batches, docs_parsed=len(all_docids),
+                        resumed_batches=n_batches)
     else:
         tok = make_chunked_tokenizer(corpus_paths, k=k, with_text=store)
         with report.phase("pass1_tokenize"):
@@ -469,6 +501,8 @@ def build_index_streaming(
             doc_len[docnos] = lengths
             if done:
                 report.incr("pass2_resumed_batches", 1)
+                report_progress("pass2_combine", advance=1,
+                                resumed_batches=1)
                 continue
             term_ids = rank[flat]
             if positions:
@@ -510,6 +544,8 @@ def build_index_streaming(
                 fmt.savez_atomic(
                     os.path.join(spill_dir, f"pairs-{s:03d}-{b:05d}.npz"),
                     term=pt[sel], doc=pd[sel], tf=ptf[sel])
+            report_progress("pass2_combine", advance=1,
+                            spills_written=num_shards, pairs=npairs)
             faults.maybe_crash("crash.pass2", f"b={b}")
 
         pending = None
@@ -581,8 +617,11 @@ def build_index_streaming(
                     os.path.join(spill_dir, f"pairs-{sh:03d}-{b:05d}.npz"),
                     term=pt[sh][:n_sh], doc=pd[sh][:n_sh],
                     tf=ptf[sh][:n_sh])
+            report_progress("pass2_combine", advance=1, spills_written=s,
+                            pairs=int(npairs.sum()))
             faults.maybe_crash("crash.pass2", f"b={b}")
 
+    report_progress("pass2_combine", total=n_batches)
     with report.phase("pass2_combine"):
         if spmd_devices:
             pass2_spmd()
@@ -596,6 +635,7 @@ def build_index_streaming(
     df = np.zeros(v, np.int32)
     num_pairs_total = 0
     shard_of = fmt.shard_assignment(v, num_shards)
+    report_progress("pass3_reduce", total=num_shards)
     with report.phase("pass3_reduce"):
         for s in range(num_shards):
             part = os.path.join(index_dir, fmt.part_name(s))
@@ -640,6 +680,8 @@ def build_index_streaming(
                 rdf[z["term_ids"]] = z["df"]
                 npairs = len(z["pair_doc"])
                 report.incr("pass3_resumed_shards", 1)
+                report_progress("pass3_reduce", advance=1,
+                                resumed_shards=1)
             else:
                 rdf, npairs = reduce_shard_spills(
                     spill_dir, index_dir, s, n_batches, v, shard_of,
@@ -649,6 +691,7 @@ def build_index_streaming(
             df[:] += rdf
     report.set_counter("num_pairs", num_pairs_total)
 
+    report_progress("finalize")
     with report.phase("dictionary"):
         np.save(os.path.join(index_dir, fmt.DOCLEN),
                 doc_len.astype(np.int32))

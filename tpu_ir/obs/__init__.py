@@ -14,19 +14,35 @@ One subsystem, three instruments, zero new dependencies:
   snapshot are dumped to a JSONL artifact — the JobTracker failure
   page, reborn.
 
-Scrape surfaces: `tpu-ir metrics` (JSON / Prometheus text),
-`tpu-ir trace-dump`, `tpu-ir stats` (superset of the PR 2 shape), and
-the latency sections of `tpu-ir serve-bench` / `bench.py`. RUNBOOK
-"Reading the telemetry" is the operator's guide.
+ISSUE 4 adds the cluster-scope top layer:
+
+- **Jobs** (progress.py): JobTracker-style job/phase progress tracking
+  (`start_job` / `report_progress`), a bounded last-K job history.
+- **Aggregation** (aggregate.py): serializable registry snapshots
+  merged across processes — live via multihost collectives, post-mortem
+  via the `TPU_IR_TELEMETRY_DIR` file spool.
+- **HTTP server** (server.py): `/metrics`, `/healthz`, `/jobs`,
+  `/flight` on a stdlib ThreadingHTTPServer
+  (`tpu-ir serve-bench --metrics-port`, build `--track PORT`).
+
+Scrape surfaces: `tpu-ir metrics` (JSON / Prometheus text; `--cluster`
+for the spool-merged view), `tpu-ir trace-dump`, `tpu-ir stats`
+(superset of the PR 2 shape), the latency sections of
+`tpu-ir serve-bench` / `bench.py`, and the HTTP endpoints above.
+RUNBOOK "Reading the telemetry" / "Live monitoring" are the operator's
+guides.
 """
 
+from . import progress
 from .histogram import LatencyHistogram, bucket_index
+from .progress import current_job, report_progress, start_job
 from .recorder import flight_dir, flight_dump, reset_rate_limit
 from .registry import (
     DECLARED_HISTOGRAMS,
     FAULT_SITES,
     REQUEST_STAGES,
     SERVICE_LEVELS,
+    SNAPSHOT_SCHEMA,
     TelemetryRegistry,
     get_registry,
 )
@@ -45,20 +61,24 @@ from .trace import (
 
 def reset_all() -> None:
     """Full telemetry reset: registry counters + histograms, the trace
-    ring, and the flight recorder's rate limiter. The test-isolation
-    hook (tests/conftest.py autouse fixture) — one process-wide
-    telemetry state must not leak between tests or between runs."""
+    ring, the job history, and the flight recorder's rate limiter. The
+    test-isolation hook (tests/conftest.py autouse fixture) — one
+    process-wide telemetry state must not leak between tests or between
+    runs. (The registry's seq/resets stamps stay monotonic through
+    this — that IS their contract.)"""
     get_registry().reset()
     clear_traces()
+    progress.clear_jobs()
     reset_rate_limit()
 
 
 __all__ = [
     "LatencyHistogram", "bucket_index",
     "flight_dir", "flight_dump", "reset_rate_limit",
-    "TelemetryRegistry", "get_registry",
+    "TelemetryRegistry", "get_registry", "SNAPSHOT_SCHEMA",
     "FAULT_SITES", "REQUEST_STAGES", "SERVICE_LEVELS",
     "DECLARED_HISTOGRAMS",
+    "progress", "start_job", "report_progress", "current_job",
     "Span", "trace", "attach", "current_span", "recent_traces",
     "clear_traces", "configure", "enabled", "kernel_annotation",
     "reset_all",

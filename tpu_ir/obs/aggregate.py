@@ -1,0 +1,263 @@
+"""Cross-process telemetry aggregation: N registries -> one cluster view.
+
+A multi-host build runs N processes, each with its own TelemetryRegistry
+— N disjoint counter/histogram sets nobody merged. Following the Dapper
+split of cheap always-on collection from separate aggregation, this
+module adds the aggregation half on top of the registry's serializable
+raw snapshots (`TelemetryRegistry.collect_state()`: counters + raw
+histogram bucket counts, stamped schema/seq/resets/run_id):
+
+- `merge_snapshots(snaps)`: counters sum; histograms merge bucket-wise
+  (the shared fixed bucket layout makes the merge exact, associative
+  and commutative — property-pinned in tests). Yields the cluster-total
+  view plus a per-process index.
+- `gather_cluster(...)`: LIVE aggregation over the jax coordination
+  service (`multihost_utils.process_allgather` of the JSON blob, the
+  allgather_strings transport) when a distributed job is initialized —
+  every process receives the same merged cluster snapshot.
+- file spool (`TPU_IR_TELEMETRY_DIR`): POST-MORTEM aggregation — each
+  process writes `telemetry-<host>-<pid>-<seq>.json` atomically;
+  `read_spool()` keeps only the newest snapshot per run_id (snapshots
+  are cumulative; merging two generations of one process would double
+  count) and `merge_spool()` folds them. `SpoolWriter` is an optional
+  background thread refreshing the spool on an interval, so a crashed
+  process leaves a near-final record behind.
+
+Scrape surfaces: `tpu-ir metrics --cluster` / `tpu-ir stats --cluster`
+(spool merge from a fresh CLI process), and the multi-host build spools
+its final snapshot when TPU_IR_TELEMETRY_DIR is set.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+
+import numpy as np
+
+from .histogram import NUM_BUCKETS, summary_from_counts
+from .registry import SNAPSHOT_SCHEMA, get_registry
+
+
+def local_snapshot(reset: bool = False) -> dict:
+    """This process's serializable raw snapshot, stamped with identity
+    (host, pid, and — when a distributed job is live — process index)."""
+    snap = get_registry().collect_state(reset)
+    snap["host"] = socket.gethostname()
+    snap["pid"] = os.getpid()
+    snap["time"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    try:  # only meaningful (and only cheap) once jax.distributed is up
+        import jax
+
+        snap["process_index"] = jax.process_index()
+    except Exception:  # noqa: BLE001 — identity is best-effort garnish
+        snap["process_index"] = 0
+    return snap
+
+
+def merge_snapshots(snaps: list) -> dict:
+    """Fold N raw snapshots into the cluster view: counter totals are
+    sums, histogram buckets add element-wise (exact — the fixed shared
+    bucket layout is what makes distributed percentiles honest), and
+    the per-process identities ride along. Snapshots from an unknown
+    future schema are rejected loudly rather than mis-summed."""
+    for s in snaps:
+        if s.get("schema", 0) > SNAPSHOT_SCHEMA:
+            raise ValueError(
+                f"snapshot schema {s.get('schema')} is newer than this "
+                f"build understands ({SNAPSHOT_SCHEMA}); upgrade tpu-ir")
+    counters: dict[str, int] = {}
+    hist_counts: dict[str, list] = {}
+    hist_sums: dict[str, float] = {}
+    for s in snaps:
+        for k, v in s.get("counters", {}).items():
+            counters[k] = counters.get(k, 0) + int(v)
+        for name, h in s.get("histograms", {}).items():
+            c = list(h["counts"])
+            if len(c) != NUM_BUCKETS:
+                raise ValueError(
+                    f"histogram {name!r} has {len(c)} buckets, expected "
+                    f"{NUM_BUCKETS} — mixed-version snapshots?")
+            if name in hist_counts:
+                hist_counts[name] = [a + b
+                                     for a, b in zip(hist_counts[name], c)]
+                hist_sums[name] += float(h["sum_s"])
+            else:
+                hist_counts[name] = c
+                hist_sums[name] = float(h["sum_s"])
+    return {
+        "schema": SNAPSHOT_SCHEMA,
+        "processes": len(snaps),
+        "counters": counters,
+        "histograms": {n: summary_from_counts(c, hist_sums[n])
+                       for n, c in sorted(hist_counts.items())},
+        "per_process": [
+            {"host": s.get("host"), "pid": s.get("pid"),
+             "process_index": s.get("process_index"),
+             "run_id": s.get("run_id"), "seq": s.get("seq"),
+             "time": s.get("time"),
+             "events": sum(s.get("counters", {}).values())}
+            for s in snaps],
+    }
+
+
+# -- live aggregation (collectives) ----------------------------------------
+
+ALLGATHER_CHUNK_BYTES = 4 << 20
+
+
+def gather_cluster(reset: bool = False) -> dict:
+    """Merge every process's live snapshot across the distributed job.
+
+    Single-process: merge_snapshots([local]). Multi-process: each
+    process serializes its snapshot as a JSON blob and the blobs cross
+    via `multihost_utils.process_allgather` in fixed-size uint8 rounds
+    (the allgather_strings transport — snapshots are KBs, so this is
+    one round in practice), after which EVERY process holds the same
+    cluster view. All processes must call this together (it is a
+    collective); `reset=True` drains every registry in the same
+    exchange, so a per-interval cluster scrape loses nothing."""
+    import jax
+
+    local = local_snapshot(reset)
+    if jax.process_count() == 1:
+        return merge_snapshots([local])
+    from jax.experimental import multihost_utils
+
+    blob = json.dumps(local, default=repr).encode("utf-8")
+    n = len(blob)
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.int64(n))).reshape(-1)
+    max_n = int(sizes.max())
+    bufs = [b""] * len(sizes)
+    for ofs in range(0, max_n, ALLGATHER_CHUNK_BYTES):
+        width = min(ALLGATHER_CHUNK_BYTES, max_n - ofs)
+        chunk = np.zeros(width, np.uint8)
+        if ofs < n:
+            piece = blob[ofs : ofs + width]
+            chunk[: len(piece)] = np.frombuffer(piece, np.uint8)
+        gathered = np.asarray(multihost_utils.process_allgather(chunk))
+        for p in range(len(sizes)):
+            valid = max(0, min(int(sizes[p]) - ofs, width))
+            if valid:
+                bufs[p] += bytes(gathered[p, :valid])
+    return merge_snapshots(
+        [json.loads(b.decode("utf-8")) for b in bufs])
+
+
+# -- the file spool (post-mortem aggregation) ------------------------------
+
+
+def spool_dir() -> str | None:
+    """The telemetry spool directory, or None when spooling is off."""
+    return os.environ.get("TPU_IR_TELEMETRY_DIR") or None
+
+
+def spool_write(out_dir: str | None = None) -> str | None:
+    """Write this process's snapshot into the spool (atomic: temp +
+    rename, so a reader never sees a torn file). Returns the path, or
+    None when no spool dir is configured. Never raises — spooling is
+    telemetry, and a full disk must not fail the build it observes."""
+    d = out_dir or spool_dir()
+    if not d:
+        return None
+    try:
+        os.makedirs(d, exist_ok=True)
+        snap = local_snapshot()
+        path = os.path.join(
+            d, f"telemetry-{snap['host']}-{snap['pid']}-"
+               f"{snap['seq']:06d}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(snap, f, default=repr)
+        os.replace(tmp, path)
+        # one live file per process lifetime: drop this run's older
+        # generations so the spool stays bounded under a SpoolWriter
+        prefix = f"telemetry-{snap['host']}-{snap['pid']}-"
+        for name in os.listdir(d):
+            if (name.startswith(prefix) and name.endswith(".json")
+                    and os.path.join(d, name) != path):
+                try:
+                    os.unlink(os.path.join(d, name))
+                except OSError:
+                    pass
+        return path
+    except Exception:  # noqa: BLE001 — see docstring
+        return None
+
+
+def read_spool(out_dir: str | None = None) -> list:
+    """Parse every spooled snapshot, keeping only the NEWEST (highest
+    seq) per run_id — snapshots are cumulative, so merging two
+    generations of one process would double count its events."""
+    d = out_dir or spool_dir()
+    if not d or not os.path.isdir(d):
+        return []
+    best: dict[str, dict] = {}
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith("telemetry-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):
+            continue
+        key = snap.get("run_id") or name
+        if key not in best or snap.get("seq", 0) > best[key].get("seq", 0):
+            best[key] = snap
+    return list(best.values())
+
+
+def merge_spool(out_dir: str | None = None,
+                include_local: bool = False) -> dict:
+    """The post-mortem cluster view: fold the spool (optionally folding
+    this process's live registry in too — for a process that is itself
+    part of the cluster rather than a fresh CLI scraper). The local
+    snapshot DISPLACES this process's own spooled generation (same
+    run_id, dedup by highest seq): a serving process that both spools
+    and answers /cluster must count itself exactly once."""
+    snaps = read_spool(out_dir)
+    if include_local or not snaps:
+        local = local_snapshot()
+        snaps = [s for s in snaps
+                 if s.get("run_id") != local["run_id"]] + [local]
+    return merge_snapshots(snaps)
+
+
+class SpoolWriter:
+    """Background thread refreshing this process's spool file on an
+    interval, so a crash leaves a near-final record for the post-mortem
+    merge. The thread is named under the 'tpu-ir-obs' prefix the test
+    harness's leak guard watches — stop() is mandatory, daemonhood is
+    only the crash backstop."""
+
+    def __init__(self, out_dir: str | None = None,
+                 interval_s: float | None = None):
+        self._dir = out_dir or spool_dir()
+        self._interval = (interval_s if interval_s is not None else float(
+            os.environ.get("TPU_IR_SPOOL_INTERVAL", "5") or 5))
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> "SpoolWriter":
+        if self._dir and self._thread is None:
+            self._thread = threading.Thread(
+                target=self._run, name="tpu-ir-obs-spool", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            spool_write(self._dir)
+
+    def stop(self) -> None:
+        """Stop the thread and write one final snapshot (the authoritative
+        end-of-run record)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        spool_write(self._dir)

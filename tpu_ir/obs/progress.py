@@ -1,0 +1,252 @@
+"""JobTracker-style job/progress tracking.
+
+The reference repo's entire perf record is 8 saved Hadoop JobTracker
+HTML pages: per-job tables of map/reduce task counters with a
+percent-complete column, frozen at job end. PR 3 rebuilt the data side
+(spans, histograms, one registry); this module rebuilds the JOB side —
+a live, process-wide model of what each long-running operation (an
+index build, a serving soak) is currently doing:
+
+- `start_job(kind, name, phases=...)` registers a Job with an ordered
+  phase list (the JobTracker's map/shuffle/reduce rows). Each phase
+  holds `done`/`total` task counts plus free-form counters (docs
+  parsed, spills written, shuffle bytes, shards reduced, requests
+  served).
+- `report_progress(phase, advance=..., total=..., **counters)` is the
+  hook threaded through the builders and the soak: it targets the
+  newest unfinished job and is a cheap no-op when none is running, so
+  library code calls it unconditionally.
+- Percent-complete is derived per phase (done/total) and overall
+  (completed phases count 1.0; the mean over declared phases), and is
+  CONTRACTUALLY non-decreasing over a job's lifetime — `/jobs` pollers
+  plot it without smoothing. The ETA comes from the current phase's
+  observed throughput (done/elapsed), like the JobTracker's.
+- Finished jobs stay in a bounded last-K history (`TPU_IR_JOB_HISTORY`,
+  default 16) — the in-memory equivalent of the 8 saved pages.
+
+Serving surface: `tpu_ir/obs/server.py` renders `jobs()` as `/jobs` and
+`/jobs/<id>` (JSON + a minimal HTML page echoing the JobTracker
+layout). Everything here is thread-safe; soak worker threads report
+completions into the same job their driver registered.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import itertools
+import os
+import threading
+import time
+
+# Version of Job.to_dict()'s shape (the /jobs payload); bump on any
+# change a poller could trip over.
+JOB_SCHEMA = 1
+
+_lock = threading.Lock()
+_jobs: collections.deque = collections.deque(
+    maxlen=max(1, int(os.environ.get("TPU_IR_JOB_HISTORY", "16") or 16)))
+_ids = itertools.count(1)
+
+
+class Job:
+    """One tracked operation: an ordered set of phases, each with
+    done/total task counts and free-form counters. All mutation goes
+    through report()/finish() under the job's lock; `seq` bumps on
+    every mutation so a poller can cheaply detect change."""
+
+    def __init__(self, kind: str, name: str, phases=(), config=None):
+        self.job_id = next(_ids)
+        self.kind = kind
+        self.name = name
+        self.config = dict(config or {})
+        self.state = "running"
+        self.error: str | None = None
+        self.started = time.time()
+        self.finished_at: float | None = None
+        self.seq = 0
+        self._lock = threading.Lock()
+        self._phases: dict[str, dict] = {
+            p: {"done": 0, "total": None, "counters": {},
+                "started": None} for p in phases}
+        self._current: str | None = None
+        self._max_percent = 0.0
+
+    # -- mutation ----------------------------------------------------------
+
+    def report(self, phase: str | None, advance: int = 0,
+               total: int | None = None, **counters) -> None:
+        """Record progress against `phase` (created on first mention and
+        made the current phase; None targets whatever phase is current —
+        the shape shared helpers like the SPMD shuffle use, since they
+        run under different phases in different builds): `advance` bumps
+        its done count, `total` (re)declares its task count, and keyword
+        counters add into its free-form counter table. Safe from any
+        thread."""
+        with self._lock:
+            if phase is None:
+                phase = self._current or "main"
+            st = self._phases.get(phase)
+            if st is None:
+                st = self._phases[phase] = {
+                    "done": 0, "total": None, "counters": {},
+                    "started": None}
+            if st["started"] is None:
+                st["started"] = time.time()
+            if self._current != phase:
+                # entering a later phase closes the earlier ones for the
+                # percent computation (phases run in declaration order)
+                self._current = phase
+            if total is not None:
+                st["total"] = int(total)
+            if advance:
+                st["done"] += int(advance)
+            for k, v in counters.items():
+                st["counters"][k] = st["counters"].get(k, 0) + v
+            self.seq += 1
+
+    def finish(self, error: str | None = None) -> None:
+        with self._lock:
+            if self.state != "running":
+                return
+            self.state = "failed" if error else "succeeded"
+            self.error = error
+            self.finished_at = time.time()
+            self.seq += 1
+
+    # -- derived views -----------------------------------------------------
+
+    def _percent_locked(self) -> float:
+        if self.state == "succeeded":
+            return 100.0
+        names = list(self._phases)
+        if not names:
+            return 0.0
+        cur = (names.index(self._current)
+               if self._current in names else -1)
+        frac = 0.0
+        for i, n in enumerate(names):
+            st = self._phases[n]
+            if i < cur:
+                frac += 1.0  # an entered later phase closes earlier ones
+            elif i == cur:
+                if st["total"]:
+                    frac += min(st["done"] / st["total"], 1.0)
+        pct = 100.0 * frac / len(names)
+        # the monotonicity contract: a late total revision (e.g. a resume
+        # discovering more batches) must never walk the needle backwards
+        self._max_percent = max(self._max_percent, pct)
+        return round(self._max_percent, 2)
+
+    def _eta_locked(self) -> float | None:
+        """Seconds to current-phase completion at observed throughput."""
+        if self.state != "running" or self._current is None:
+            return None
+        st = self._phases.get(self._current)
+        if not st or not st["total"] or not st["done"]:
+            return None
+        elapsed = time.time() - (st["started"] or self.started)
+        if elapsed <= 0:
+            return None
+        rate = st["done"] / elapsed
+        remaining = max(st["total"] - st["done"], 0)
+        return round(remaining / rate, 1) if rate > 0 else None
+
+    def to_dict(self) -> dict:
+        with self._lock:
+            phases = []
+            for name, st in self._phases.items():
+                row = {"phase": name, "done": st["done"],
+                       "total": st["total"],
+                       "counters": dict(st["counters"])}
+                if st["total"]:
+                    row["percent"] = round(
+                        100.0 * min(st["done"] / st["total"], 1.0), 2)
+                phases.append(row)
+            out = {
+                "schema": JOB_SCHEMA,
+                "job_id": self.job_id,
+                "kind": self.kind,
+                "name": self.name,
+                "state": self.state,
+                "seq": self.seq,
+                "started": time.strftime(
+                    "%Y-%m-%dT%H:%M:%S", time.localtime(self.started)),
+                "elapsed_s": round(
+                    (self.finished_at or time.time()) - self.started, 3),
+                "percent": self._percent_locked(),
+                "current_phase": self._current,
+                "phases": phases,
+                "config": dict(self.config),
+            }
+            eta = self._eta_locked()
+            if eta is not None:
+                out["eta_s"] = eta
+            if self.error:
+                out["error"] = self.error
+            return out
+
+
+def start_job(kind: str, name: str, *, phases=(), config=None) -> Job:
+    """Register a new running Job (it becomes the report_progress
+    target). The caller owns finishing it — wrap the operation in
+    try/finally and call job.finish(error=...) on the failure path."""
+    job = Job(kind, name, phases=phases, config=config)
+    with _lock:
+        _jobs.append(job)
+    return job
+
+
+def current_job() -> Job | None:
+    """The newest still-running job (None when idle)."""
+    with _lock:
+        for job in reversed(_jobs):
+            if job.state == "running":
+                return job
+    return None
+
+
+def report_progress(phase: str | None, advance: int = 0,
+                    total: int | None = None, **counters) -> None:
+    """THE hook the builders/soak call: forward to the current job, or
+    do nothing when no job is registered (a bare library call — e.g. a
+    test driving build_index directly — must pay one lock + deque scan,
+    nothing more)."""
+    job = current_job()
+    if job is not None:
+        job.report(phase, advance=advance, total=total, **counters)
+
+
+@contextlib.contextmanager
+def tracked(kind: str, name: str, *, phases=(), config=None):
+    """Register a job for the duration of a `with` block: finished
+    `succeeded` on clean exit, `failed` (with the exception repr) when
+    one escapes. The builders' one-line wrapping."""
+    job = start_job(kind, name, phases=phases, config=config)
+    try:
+        yield job
+    except BaseException as e:
+        job.finish(error=repr(e))
+        raise
+    else:
+        job.finish()
+
+
+def jobs() -> list:
+    """The bounded job history, oldest first (running jobs included)."""
+    with _lock:
+        return list(_jobs)
+
+
+def get_job(job_id: int) -> Job | None:
+    with _lock:
+        for job in _jobs:
+            if job.job_id == job_id:
+                return job
+    return None
+
+
+def clear_jobs() -> None:
+    """Forget all jobs (test isolation — obs.reset_all calls this)."""
+    with _lock:
+        _jobs.clear()

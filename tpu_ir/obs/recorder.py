@@ -31,9 +31,24 @@ import time
 from .registry import get_registry
 from .trace import recent_traces
 
+# Version of the artifact's header/record shape; bump on any change a
+# downstream parser (jq pipelines, the /flight endpoint) could trip over.
+FLIGHT_SCHEMA = 1
+
 _lock = threading.Lock()
 _last_dump: dict[str, float] = {}
 _seq = 0
+
+
+def _next_seq() -> int:
+    """Process-monotonic artifact sequence number: stamped into the
+    header AND the filename, so concurrent dumps (operator trace-dump
+    racing a breach dump) order unambiguously even within one wall-clock
+    second."""
+    global _seq
+    with _lock:
+        _seq += 1
+        return _seq
 
 
 def _min_interval_s() -> float:
@@ -51,14 +66,17 @@ def reset_rate_limit() -> None:
         _last_dump.clear()
 
 
-def artifact_lines(reason: str, extra: dict | None = None) -> list[str]:
+def artifact_lines(reason: str, extra: dict | None = None,
+                   seq: int | None = None) -> list[str]:
     """THE flight-recorder artifact shape, one JSON string per line:
-    header (reason, wall time, pid, extra context), then one trace
-    record per ring entry, then a full registry snapshot. Shared by
-    flight_dump and `tpu-ir trace-dump` so an operator dump and a
-    breach dump are byte-shape-identical and cannot drift."""
+    header (schema, seq, reason, wall time, pid, extra context), then
+    one trace record per ring entry, then a full registry snapshot.
+    Shared by flight_dump and `tpu-ir trace-dump` so an operator dump
+    and a breach dump are byte-shape-identical and cannot drift."""
     header = {
         "record": "header",
+        "schema": FLIGHT_SCHEMA,
+        "seq": _next_seq() if seq is None else seq,
         "reason": reason,
         "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "pid": os.getpid(),
@@ -100,7 +118,39 @@ def flight_dump(reason: str, extra: dict | None = None,
             d, f"flight-{time.strftime('%Y%m%dT%H%M%S')}-"
                f"{os.getpid()}-{seq:03d}-{safe}.jsonl")
         with open(path, "w") as f:
-            f.write("\n".join(artifact_lines(reason, extra)) + "\n")
+            f.write("\n".join(artifact_lines(reason, extra, seq=seq))
+                    + "\n")
         return path
     except Exception:  # noqa: BLE001 — see docstring
         return None
+
+
+def recent_headers(out_dir: str | None = None, limit: int = 32) -> list:
+    """Header lines of the newest flight artifacts in `out_dir` (default:
+    flight_dir()), newest first, each with its file path attached — the
+    `/flight` endpoint's index of recent incidents. Unreadable or
+    foreign files are skipped, never raised: this runs inside a scrape."""
+    d = out_dir or flight_dir()
+    try:
+        names = [n for n in os.listdir(d)
+                 if n.startswith("flight-") and n.endswith(".jsonl")]
+    except OSError:
+        return []
+    def _mtime(p: str) -> float:
+        try:
+            return os.path.getmtime(p)
+        except OSError:  # deleted between listdir and stat — skippable,
+            return 0.0   # not raisable: this runs inside a scrape
+    paths = sorted((os.path.join(d, n) for n in names),
+                   key=_mtime, reverse=True)
+    out = []
+    for path in paths[:limit]:
+        try:
+            with open(path) as f:
+                header = json.loads(f.readline())
+        except (OSError, ValueError):
+            continue
+        if isinstance(header, dict) and header.get("record") == "header":
+            header["path"] = path
+            out.append(header)
+    return out

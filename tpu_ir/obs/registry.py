@@ -22,8 +22,15 @@ frontend for levels and asserts both land in the declared sets.
 from __future__ import annotations
 
 import threading
+import uuid
 
 from .histogram import LatencyHistogram, summary_from_counts
+
+# Version of the snapshot dict shape (and of the spooled/allgathered
+# serializable form in obs/aggregate.py). Bump on any change a downstream
+# parser could trip over; scrapers reject snapshots from a future schema
+# instead of mis-parsing them.
+SNAPSHOT_SCHEMA = 1
 
 # Every fault-injection site name threaded through the build and serve
 # paths (faults.should_fire / maybe_crash / maybe_hang call sites). A new
@@ -80,6 +87,25 @@ class TelemetryRegistry:
         self._counters: dict[str, int] = {n: 0 for n in DECLARED_COUNTERS}
         self._hists: dict[str, LatencyHistogram] = {
             n: LatencyHistogram() for n in DECLARED_HISTOGRAMS}
+        # seq: strictly monotonic per scrape/reset, NEVER zeroed — two
+        # snapshots with the same run_id order by seq, so a concurrent
+        # scraper can tell "newer scrape" from "state was reset" without
+        # heuristics on counter values. resets counts every zeroing event
+        # (snapshot(reset=True) and reset()): a scraper seeing it change
+        # between two of its own scrapes knows a third party drained the
+        # interval it thought it owned. run_id identifies this process
+        # lifetime (spool dedup across restarts/pid reuse).
+        self._seq = 0
+        self._resets = 0
+        self.run_id = uuid.uuid4().hex
+
+    @property
+    def seq(self) -> int:
+        """The last-issued scrape/reset sequence number — a read, NOT a
+        scrape: it neither bumps seq nor copies any state (liveness
+        probes poll this; a full snapshot per /healthz would be waste)."""
+        with self._lock:
+            return self._seq
 
     # -- counters ----------------------------------------------------------
 
@@ -107,7 +133,9 @@ class TelemetryRegistry:
 
     def reset_counters(self, prefix: str = "") -> None:
         """Zero counters under `prefix` ('' = all). Declared counters are
-        kept at 0 (presence is the contract), undeclared ones dropped."""
+        kept at 0 (presence is the contract), undeclared ones dropped.
+        A zeroing event like any other: bumps seq/resets in the same
+        lock hold, so scrapers detect even partial (prefix) drains."""
         with self._lock:
             for k in list(self._counters):
                 if k.startswith(prefix):
@@ -115,6 +143,8 @@ class TelemetryRegistry:
                         self._counters[k] = 0
                     else:
                         del self._counters[k]
+            self._seq += 1
+            self._resets += 1
 
     # -- histograms --------------------------------------------------------
 
@@ -160,8 +190,16 @@ class TelemetryRegistry:
         The shared core of snapshot() and prometheus_text(): every
         scrape surface gets the same atomicity, so with reset=True a
         concurrent increment or observation lands in exactly one
-        interval, never in none."""
+        interval, never in none. Returns (counters, hist states, meta):
+        meta carries the schema version, this scrape's seq, the reset
+        count and the process run_id — assigned under the same lock
+        hold as the counter read, so seq order IS counter-state order."""
         with self._lock:
+            self._seq += 1
+            if reset:
+                self._resets += 1
+            meta = {"schema": SNAPSHOT_SCHEMA, "seq": self._seq,
+                    "resets": self._resets, "run_id": self.run_id}
             counters = dict(self._counters)
             if reset:
                 for k in list(self._counters):
@@ -172,21 +210,45 @@ class TelemetryRegistry:
             hists = dict(self._hists)
         states = {n: (h.drain() if reset else h.state())
                   for n, h in hists.items()}
-        return counters, states
+        return counters, states, meta
+
+    def collect_state(self, reset: bool = False) -> dict:
+        """The SERIALIZABLE raw snapshot: counters plus raw histogram
+        bucket counts (not percentile summaries), stamped with schema /
+        seq / resets / run_id. This is the cross-process exchange unit —
+        obs/aggregate.py spools it, allgathers it, and merges N of them
+        bucket-wise; summaries don't merge, bucket counts do."""
+        counters, states, meta = self._collect(reset)
+        return {**meta,
+                "counters": counters,
+                "histograms": {n: {"counts": list(c), "sum_s": s}
+                               for n, (c, s) in states.items()}}
 
     def snapshot(self, reset: bool = False) -> dict:
-        """Everything, one dict: {"counters": {...}, "histograms":
-        {name: summary}}. `reset=True` is the per-interval scrape —
-        the explicit between-runs reset `tpu-ir stats`/serve-bench
-        lacked (see _collect for the no-lost-update guarantee)."""
-        counters, states = self._collect(reset)
-        return {"counters": counters,
+        """Everything, one dict: {"schema": ..., "seq": ..., "resets":
+        ..., "counters": {...}, "histograms": {name: summary}}.
+        `reset=True` is the per-interval scrape — the explicit
+        between-runs reset `tpu-ir stats`/serve-bench lacked (see
+        _collect for the no-lost-update guarantee)."""
+        counters, states, meta = self._collect(reset)
+        return {**meta,
+                "counters": counters,
                 "histograms": {n: summary_from_counts(c, s)
                                for n, (c, s) in states.items()}}
 
     def reset(self) -> None:
-        self.reset_counters()
         with self._lock:
+            # counter zeroing and the seq/resets bump in ONE lock hold:
+            # a concurrent scrape must never observe drained counters
+            # with an unchanged resets stamp (that window is exactly the
+            # undetected third-party reset `resets` exists to expose)
+            for k in list(self._counters):
+                if k in DECLARED_COUNTERS:
+                    self._counters[k] = 0
+                else:
+                    del self._counters[k]
+            self._seq += 1
+            self._resets += 1
             hists = dict(self._hists)
         # histograms are zeroed IN PLACE and never deleted: histogram()
         # hands out long-lived references (span exits hold them), and an
@@ -201,7 +263,7 @@ class TelemetryRegistry:
         drains atomically, same as snapshot(reset=True)."""
         from .histogram import BOUNDS
 
-        counters, states = self._collect(reset)
+        counters, states, _ = self._collect(reset)
         lines = ["# TYPE tpu_ir_events_total counter"]
         for name, v in sorted(counters.items()):
             lines.append(f'tpu_ir_events_total{{name="{name}"}} {v}')

@@ -1,0 +1,290 @@
+"""The embedded metrics/jobs HTTP server — the JobTracker web UI, reborn.
+
+One stdlib `ThreadingHTTPServer` (no dependencies, daemon request
+threads, ephemeral-port friendly) exposes the process's telemetry while
+it runs:
+
+  GET /metrics          Prometheus text exposition (the registry's
+                        prometheus_text — scrape it with anything).
+                        Read-only BY CONSTRUCTION: a `?reset=1` query is
+                        rejected 403 — a scraper must never drain the
+                        intervals the process's own delta consumers
+                        (serve-bench's latency section) are measuring.
+  GET /metrics.json     The registry snapshot as JSON (schema/seq/resets
+                        stamped, so pollers detect third-party resets).
+  GET /healthz          JSON liveness + serving control-plane state:
+                        breaker state, ladder level, admission queue
+                        depth (from the live ServingFrontends that
+                        registered themselves), plus running-job count.
+  GET /jobs             The JobTracker job table (obs/progress.py) as
+                        JSON; `?format=html` renders the minimal HTML
+                        table echoing the reference's saved pages.
+  GET /jobs/<id>        One job, JSON or `?format=html`.
+  GET /flight           Recent flight-recorder artifact headers
+                        (reason/time/seq/path), newest first.
+  GET /cluster          The spool-merged cluster view (this process's
+                        live registry folded in) when
+                        TPU_IR_TELEMETRY_DIR is configured.
+
+`MetricsServer.start(port)` binds (port 0 = ephemeral, `.port` tells
+you what you got), serves on a named daemon thread, and optionally runs
+a SpoolWriter when a telemetry spool dir is configured; `.stop()` joins
+both — the tests' thread-leak guard fails anything that forgets.
+Wired in via `tpu-ir serve-bench --metrics-port` and the build
+commands' `--track PORT`.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import logging
+import threading
+import weakref
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from . import progress
+from .recorder import recent_headers
+from .registry import get_registry
+
+logger = logging.getLogger(__name__)
+
+# -- health sources ---------------------------------------------------------
+
+_health_lock = threading.Lock()
+_frontends: list = []  # weakrefs to live ServingFrontends, oldest first
+
+
+def register_health_source(frontend) -> None:
+    """Called by ServingFrontend.__init__: /healthz reports the breaker /
+    ladder / queue state of every frontend still alive. Weakrefs — the
+    server must never keep a dead frontend's scorer resident."""
+    with _health_lock:
+        _frontends.append(weakref.ref(frontend))
+
+
+def _live_frontends() -> list:
+    with _health_lock:
+        alive = [(r, r()) for r in _frontends]
+        _frontends[:] = [r for r, f in alive if f is not None]
+        return [f for _, f in alive if f is not None]
+
+
+def health_snapshot() -> dict:
+    """The /healthz payload. The newest live frontend's control-plane
+    state is lifted to the top-level `breaker`/`ladder`/`queue_depth`
+    keys (the fields an alerting rule matches on); every live frontend
+    appears under `frontends`."""
+    fes = _live_frontends()
+    running = [j for j in progress.jobs() if j.state == "running"]
+    out = {
+        "status": "ok",
+        "breaker": None,
+        "ladder": None,
+        "queue_depth": None,
+        "frontends": [],
+        "jobs_running": len(running),
+        "registry_seq": get_registry().seq,
+    }
+    for fe in fes:
+        try:
+            st = fe.stats()
+        except Exception as e:  # noqa: BLE001 — health must not 500
+            st = {"error": repr(e)}
+        out["frontends"].append(st)
+    if out["frontends"]:
+        latest = out["frontends"][-1]
+        out["breaker"] = latest.get("breaker")
+        out["ladder"] = latest.get("ladder")
+        out["queue_depth"] = latest.get("queue_depth")
+    return out
+
+
+# -- the JobTracker HTML echo ----------------------------------------------
+
+
+def _jobs_html(job_dicts: list, title: str) -> str:
+    """A minimal single-page echo of the reference's saved JobTracker
+    pages: one table per job — name/state/percent header row, then one
+    row per phase with its task counts and counters."""
+    parts = [
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>",
+        f"<title>{html.escape(title)}</title>",
+        "<style>body{font-family:sans-serif;margin:1em}"
+        "table{border-collapse:collapse;margin:0 0 1.5em}"
+        "td,th{border:1px solid #999;padding:2px 8px;text-align:left}"
+        "th{background:#ddd}.pct{font-weight:bold}</style>",
+        f"</head><body><h1>{html.escape(title)}</h1>",
+    ]
+    for d in job_dicts:
+        eta = f" &middot; ETA {d['eta_s']}s" if "eta_s" in d else ""
+        parts.append(
+            f"<h2><a href='/jobs/{d['job_id']}?format=html'>"
+            f"job_{d['job_id']:04d}</a> {html.escape(d['name'])} "
+            f"({html.escape(d['kind'])})</h2>"
+            f"<p>state: <b>{html.escape(d['state'])}</b> &middot; "
+            f"<span class='pct'>{d['percent']}% complete</span> &middot; "
+            f"{d['elapsed_s']}s elapsed{eta}</p>")
+        parts.append("<table><tr><th>phase</th><th>done</th><th>total</th>"
+                     "<th>%</th><th>counters</th></tr>")
+        for ph in d["phases"]:
+            counters = ", ".join(f"{k}={v}"
+                                 for k, v in sorted(ph["counters"].items()))
+            pct = f"{ph['percent']}%" if "percent" in ph else ""
+            parts.append(
+                f"<tr><td>{html.escape(ph['phase'])}</td>"
+                f"<td>{ph['done']}</td>"
+                f"<td>{'' if ph['total'] is None else ph['total']}</td>"
+                f"<td>{pct}</td><td>{html.escape(counters)}</td></tr>")
+        parts.append("</table>")
+    if not job_dicts:
+        parts.append("<p>(no jobs recorded)</p>")
+    parts.append("</body></html>")
+    return "".join(parts)
+
+
+# -- the server -------------------------------------------------------------
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # stdlib default prints to stderr
+        logger.debug("metrics-http: " + fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _json(self, obj, code: int = 200) -> None:
+        self._send(code, json.dumps(obj, default=repr).encode("utf-8"),
+                   "application/json")
+
+    def do_GET(self) -> None:  # noqa: N802 — stdlib handler contract
+        try:
+            url = urlparse(self.path)
+            q = parse_qs(url.query)
+            route = url.path.rstrip("/") or "/"
+            if route == "/metrics":
+                if q.get("reset"):
+                    self._json({"error": "scrapes are read-only; reset "
+                                "via the owning process's CLI "
+                                "(tpu-ir metrics --reset)"}, code=403)
+                    return
+                self._send(200,
+                           get_registry().prometheus_text().encode("utf-8"),
+                           "text/plain; version=0.0.4")
+            elif route == "/metrics.json":
+                self._json(get_registry().snapshot())
+            elif route == "/healthz":
+                self._json(health_snapshot())
+            elif route == "/jobs":
+                dicts = [j.to_dict() for j in reversed(progress.jobs())]
+                if q.get("format", [""])[0] == "html":
+                    self._send(200, _jobs_html(
+                        dicts, "tpu-ir jobs").encode("utf-8"),
+                        "text/html; charset=utf-8")
+                else:
+                    self._json({"jobs": dicts})
+            elif route.startswith("/jobs/"):
+                try:
+                    job = progress.get_job(int(route.split("/", 2)[2]))
+                except ValueError:
+                    job = None
+                if job is None:
+                    self._json({"error": "no such job"}, code=404)
+                    return
+                d = job.to_dict()
+                if q.get("format", [""])[0] == "html":
+                    self._send(200, _jobs_html(
+                        [d], f"tpu-ir job_{d['job_id']:04d}")
+                        .encode("utf-8"), "text/html; charset=utf-8")
+                else:
+                    self._json(d)
+            elif route == "/flight":
+                self._json({"flight_records": recent_headers()})
+            elif route == "/cluster":
+                from . import aggregate
+
+                if not aggregate.spool_dir():
+                    self._json({"error": "TPU_IR_TELEMETRY_DIR not set"},
+                               code=404)
+                    return
+                self._json(aggregate.merge_spool(include_local=True))
+            elif route == "/":
+                self._json({"endpoints": ["/metrics", "/metrics.json",
+                                          "/healthz", "/jobs",
+                                          "/jobs/<id>", "/flight",
+                                          "/cluster"]})
+            else:
+                self._json({"error": "unknown endpoint"}, code=404)
+        except BrokenPipeError:
+            pass  # scraper hung up mid-response; its problem
+        except Exception as e:  # noqa: BLE001 — a scrape must never kill
+            try:                # the serving process it observes
+                self._json({"error": repr(e)}, code=500)
+            except Exception:  # noqa: BLE001
+                pass
+
+
+class MetricsServer:
+    """The embedded observability server: bind, serve on a named daemon
+    thread, stop cleanly. Request threads are daemons too (a stuck
+    scraper cannot block process exit), but stop() shuts the listener
+    down and joins the serve thread — the orderly path every CLI wiring
+    uses (try/finally)."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 spool: bool | None = None):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = self._httpd.server_address[1]
+        self._thread: threading.Thread | None = None
+        from . import aggregate
+
+        want_spool = (spool if spool is not None
+                      else aggregate.spool_dir() is not None)
+        self._spool = aggregate.SpoolWriter() if want_spool else None
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def start(self) -> "MetricsServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever,
+                kwargs={"poll_interval": 0.1},
+                name=f"tpu-ir-obs-http-{self.port}", daemon=True)
+            self._thread.start()
+            if self._spool is not None:
+                self._spool.start()
+        return self
+
+    def stop(self) -> None:
+        """Clean shutdown: stop accepting, close the socket, join the
+        serve thread, flush + stop the spool writer. Idempotent."""
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self._httpd.server_close()
+        if self._spool is not None:
+            self._spool.stop()
+            self._spool = None
+
+    def __enter__(self) -> "MetricsServer":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def start_server(port: int = 0, host: str = "127.0.0.1") -> MetricsServer:
+    """Convenience: construct + start in one call (the CLI wiring)."""
+    return MetricsServer(port=port, host=host).start()

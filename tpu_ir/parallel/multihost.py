@@ -86,7 +86,30 @@ def process_file_slice(paths: Sequence[str],
     return [f for i, f in enumerate(expanded) if i % pc == pi]
 
 
-def build_index_multihost(
+def build_index_multihost(corpus_paths, index_dir, **kwargs) -> "object":
+    """The public multi-host build, run as a tracked job (each process
+    tracks its OWN slice's progress; /jobs on any process shows that
+    process's passes — the cluster view is the aggregate module's job).
+    On completion, the process spools its telemetry snapshot when
+    TPU_IR_TELEMETRY_DIR is set, so the N per-process registries can be
+    merged post-mortem (`tpu-ir metrics --cluster`). Parameters pass
+    through to the implementation below (keyword-only there)."""
+    from ..obs import aggregate
+    from ..obs.progress import tracked
+
+    name = os.path.basename(os.path.normpath(os.fspath(index_dir)))
+    with tracked("build", f"multihost:{name}",
+                 phases=("pass1_tokenize", "global_tables",
+                         "pass2_combine", "pass3_reduce", "finalize"),
+                 config={"k": kwargs.get("k", 1),
+                         "process": jax.process_index(),
+                         "process_count": jax.process_count()}):
+        meta = _build_index_multihost(corpus_paths, index_dir, **kwargs)
+    aggregate.spool_write()
+    return meta
+
+
+def _build_index_multihost(
     corpus_paths: Sequence[str] | str,
     index_dir: str,
     *,
@@ -154,6 +177,7 @@ def build_index_multihost(
     from ..index.builder import build_chargram_artifacts
     from ..index.positions import positions_name
     from ..index.streaming import PASS1_MANIFEST, _config_sig, _load_resume_state
+    from ..obs.progress import report_progress
     from ..ops.postings import PAD_TERM
     from ..utils import JobReport
     from .mesh import SHARD_AXIS, make_mesh
@@ -205,6 +229,9 @@ def build_index_multihost(
         batch_dev_caps = [int(c) for c in caps]
         report.incr("Count.DOCS", len(my_docids))
         report.set_counter("pass1_resumed_batches", n_batches)
+        report_progress("pass1_tokenize", advance=n_batches,
+                        total=n_batches, docs_parsed=len(my_docids),
+                        resumed_batches=n_batches)
     else:
         from ..index.streaming import run_pass1_spills
 
@@ -235,6 +262,7 @@ def build_index_multihost(
             spill_crc=np.array(spill_crcs, dtype=np.str_))
 
     # --- agree on global tables (host-side allgather) ---
+    report_progress("global_tables")
     with report.phase("global_tables"):
         global_docids = allgather_strings(my_docids)
         global_terms = allgather_strings(local_vocab)
@@ -275,6 +303,7 @@ def build_index_multihost(
         b_global = int(dims[:, 0].max())
         cap = int(dims[:, 1].max())
         all_resumed = bool(dims[:, 2].all())
+        report_progress("pass2_combine", total=b_global)
         granule = 1 << 12
         from ..ops.postings import round_cap
 
@@ -371,6 +400,8 @@ def build_index_multihost(
                             num_pairs_by_shard.get(row, 0) + len(t_sp))
                         df_local += np.bincount(t_sp, minlength=v)
                 report.incr("pass2_resumed_batches", 1)
+                report_progress("pass2_combine", advance=1,
+                                resumed_batches=1)
                 continue
             local_t = np.full((n_local, cap), PAD_TERM, np.int32)
             local_d = np.zeros((n_local, cap), np.int32)
@@ -444,6 +475,9 @@ def build_index_multihost(
                                            + npair)
             for sd in out.df.addressable_shards:
                 df_local += np.asarray(sd.data).reshape(-1, v).sum(axis=0)
+            report_progress("pass2_combine", advance=1,
+                            spills_written=len(np_rows),
+                            pairs=sum(np_rows.values()))
     report.set_counter("map_output_records", occurrences)
     report.set_counter("reduce_output_groups", v)
 
@@ -464,6 +498,7 @@ def build_index_multihost(
         # a shard's position runs come from EVERY process's shared
         # spills; all writers must be done before any pass-3 reader
         multihost_utils.sync_global_devices("tpu_ir_pos_spills_done")
+    report_progress("pass3_reduce", total=len(my_rows))
     with report.phase("pass3_reduce"):
         shard_of, offset_of = fmt.shard_local_offsets(df, s)
         for row in my_rows:
@@ -490,6 +525,8 @@ def build_index_multihost(
                 try:
                     npairs = len(fmt.load_shard(index_dir, row)["pair_doc"])
                     report.incr("pass3_resumed_shards", 1)
+                    report_progress("pass3_reduce", advance=1,
+                                    resumed_shards=1)
                 except fmt.CORRUPT_NPZ:
                     fmt.quarantine(index_dir, fmt.part_name(row))
                     report.incr("Fault.QUARANTINED_PARTS", 1)
@@ -511,6 +548,7 @@ def build_index_multihost(
     # another process still owes part files (a crash there would otherwise
     # leave a "complete" index missing shards forever)
     multihost_utils.sync_global_devices("tpu_ir_pass3_done")
+    report_progress("finalize")
     if pi == 0:
         if store:
             # assemble the document store from every process's pass-1

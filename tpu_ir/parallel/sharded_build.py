@@ -32,6 +32,7 @@ from jax.sharding import PartitionSpec as P
 from .. import faults
 from ..obs import flight_dump
 from ..obs import trace as obs_trace
+from ..obs.progress import report_progress
 from ..utils.report import recovery_counters
 
 logger = logging.getLogger(__name__)
@@ -205,6 +206,14 @@ def sharded_build_postings(
                 jnp.asarray(docs_per_shard),
                 mesh=mesh, num_shards=s, vocab_size=vocab_size,
                 bucket_cap=bucket_cap, total_docs=total_docs)
+        # JobTracker counter: bytes the all_to_all moved this dispatch
+        # (3 int32 columns x S senders x S*cap slots each — the "shuffle
+        # bytes" column of the reference pages). Reported to whatever
+        # phase is current: this helper runs under "postings" in the
+        # in-memory build and "pass2_combine" in the streaming/
+        # multi-host builds.
+        report_progress(None, shuffle_bytes=3 * 4 * s * s * bucket_cap,
+                        shuffle_dispatches=1)
         result = ShardedPostings(*out)
         # dropped is psum'd (identical on every shard); read an addressable
         # shard so this also works on a multi-host mesh

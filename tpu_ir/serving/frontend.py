@@ -168,6 +168,12 @@ class ServingFrontend:
                   else (LEVEL_FULL, LEVEL_NO_RERANK, LEVEL_SHED))
         self.ladder = DegradationLadder(levels, cfg, self._on_transition)
         self._counters = RecoveryCounters()
+        # the embedded metrics server's /healthz reports this frontend's
+        # breaker/ladder/queue state for as long as it is alive (weakref
+        # — registering must not extend the scorer's lifetime)
+        from ..obs.server import register_health_source
+
+        register_health_source(self)
 
     # -- accounting --------------------------------------------------------
 

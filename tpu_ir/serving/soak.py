@@ -80,6 +80,7 @@ def _serial_reference(scorer, reqs: list[dict]) -> dict:
             raise RuntimeError("reference run degraded — clear the fault "
                                "plan before calling run_soak")
         ref[key] = list(res)
+        obs.report_progress("reference", advance=1)
     return ref
 
 
@@ -102,134 +103,162 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
     if faults.active() is not None:
         raise RuntimeError("a fault plan is already installed")
     reqs = make_queries(scorer, queries, seed=seed)
-    reference = _serial_reference(scorer, reqs)
-
-    cfg = config or ServingConfig(max_concurrency=4, max_queue=8,
-                                  deadline_s=0.25, breaker_threshold=4,
-                                  breaker_cooldown_s=0.2)
-    frontend = ServingFrontend(scorer, cfg)
-    recovery_before = recovery_counters().snapshot()
-    hist_before = obs.get_registry().hist_state()
-    results: list = [None] * len(reqs)
-
-    def worker(i: int, r: dict) -> None:
-        if pacing_s:
-            # spread arrivals (seeded jitter): back-to-back submission of
-            # the whole workload is a thundering herd, which the ladder
-            # answers by shedding everything — pacing keeps the soak
-            # exercising RECOVERY too, not just collapse
-            time.sleep(random.Random(seed * 1_000_003 + i).random()
-                       * pacing_s * threads)
-        try:
-            results[i] = ("ok", frontend.search(
-                r["text"], k=r["k"], scoring=r["scoring"],
-                rerank=r["rerank"]))
-        except Overloaded as e:
-            results[i] = ("shed", e)
-        except BaseException as e:  # invariant: structured or nothing
-            results[i] = ("error", e)
-
-    if fault_spec:
-        faults.install(faults.parse_plan(fault_spec))
-    t0 = time.perf_counter()
-    wall_s = 0.0
-    deadlocked = 0
-    pool = ThreadPoolExecutor(max_workers=threads,
-                              thread_name_prefix="soak-worker")
+    # JobTracker-style progress: /jobs shows the soak's reference and
+    # concurrent phases live, with percent-complete over the request
+    # count (obs/progress.py; the `tpu-ir serve-bench --metrics-port`
+    # surface)
+    job = obs.start_job(
+        "soak", f"soak-{queries}q-{threads}t", phases=("reference",
+                                                       "serve"),
+        config={"threads": threads, "queries": queries, "seed": seed,
+                "fault_spec": fault_spec})
     try:
-        futs = [pool.submit(worker, i, r) for i, r in enumerate(reqs)]
-        done, not_done = wait(futs, timeout=timeout_s,
-                              return_when=FIRST_EXCEPTION)
-        wall_s = time.perf_counter() - t0
-        deadlocked = len(not_done)  # governs teardown mode only
-        for f in not_done:
-            f.cancel()
-    finally:
-        # wait=False: a genuinely hung worker must surface as the
-        # `deadlocked` count (and the test harness's thread-leak guard),
-        # not hang the soak's own teardown
-        pool.shutdown(wait=deadlocked == 0, cancel_futures=True)
-        faults.clear()
-        # abandoned deadline dispatches may still be sleeping in an
-        # injected hang; drain them so nothing races process teardown
-        faults.drain_abandoned(timeout_s=10.0)
+        obs.report_progress("reference",
+                            total=len({_req_key(r) for r in reqs}))
+        reference = _serial_reference(scorer, reqs)
+        obs.report_progress("serve", total=len(reqs))
 
-    # -- invariant evaluation ---------------------------------------------
-    # snapshot the outcome list ONCE: cancelled-but-running workers
-    # (shutdown(wait=False) on deadlock) may still be writing. An entry
-    # still None at snapshot time IS the deadlock count — it must not
-    # also masquerade as an unstructured error
-    outcomes = list(results)
-    deadlocked = sum(1 for o in outcomes if o is None)
-    served = shed = errors = degraded = 0
-    levels: dict[str, int] = {}
-    full_bitident = tagged_divergent = untagged_mismatches = 0
-    error_reprs: list[str] = []
-    for out, r in zip(outcomes, reqs):
-        if out is None:
-            continue
-        state, payload = out
-        if state == "shed":
-            shed += 1
-            continue
-        if state == "error":
-            errors += 1
-            if len(error_reprs) < 5:
-                error_reprs.append(repr(payload))
-            continue
-        served += 1
-        res = payload
-        levels[res.level] = levels.get(res.level, 0) + 1
-        degraded += bool(res.degraded)
-        matches = list(res) == reference[_req_key(r)]
-        if res.level == "full" and not res.degraded:
-            if matches:
-                full_bitident += 1
-            else:
-                # an untagged response that differs from the serial
-                # reference is the cross-request corruption this soak
-                # exists to catch
-                untagged_mismatches += 1
-        elif not matches:
-            tagged_divergent += 1
+        cfg = config or ServingConfig(max_concurrency=4, max_queue=8,
+                                      deadline_s=0.25, breaker_threshold=4,
+                                      breaker_cooldown_s=0.2)
+        frontend = ServingFrontend(scorer, cfg)
+        recovery_before = recovery_counters().snapshot()
+        hist_before = obs.get_registry().hist_state()
+        results: list = [None] * len(reqs)
 
-    fe_stats = frontend.stats()
-    recovery_delta = {
-        k: v - recovery_before.get(k, 0)
-        for k, v in recovery_counters().snapshot().items()
-        if v != recovery_before.get(k, 0)}
-    report = {
-        "submitted": len(reqs),
-        "threads": threads,
-        "served": served,
-        "shed": shed,
-        "errors": errors,
-        "error_samples": error_reprs,
-        "deadlocked": deadlocked,
-        "degraded": degraded,
-        "levels": levels,
-        "full_bitidentical": full_bitident,
-        "tagged_divergent": tagged_divergent,
-        "untagged_mismatches": untagged_mismatches,
-        "wall_s": round(wall_s, 3),
-        "fault_spec": fault_spec,
-        "frontend": fe_stats,
-        "recovery_delta": recovery_delta,
-        # per-stage latency percentiles for THIS run (registry delta);
-        # the four acceptance stages always appear, observed or not
-        "latency": obs.get_registry().delta_summary(
-            hist_before, always=("admission_wait", "dispatch", "kernel",
-                                 "fallback")),
-    }
-    if errors or deadlocked or untagged_mismatches:
-        # invariant breach: this is exactly the moment the flight
-        # recorder exists for — the offending requests' span trees are
-        # still in the ring. force=True: a breach is never rate-limited
-        report["flight_record"] = obs.flight_dump(
-            "soak_invariant_breach",
-            extra={k: report[k] for k in
-                   ("submitted", "served", "shed", "errors",
-                    "deadlocked", "untagged_mismatches",
-                    "error_samples")},
-            out_dir=flight_dir, force=True)
-    return report
+        def worker(i: int, r: dict) -> None:
+            if pacing_s:
+                # spread arrivals (seeded jitter): back-to-back submission of
+                # the whole workload is a thundering herd, which the ladder
+                # answers by shedding everything — pacing keeps the soak
+                # exercising RECOVERY too, not just collapse
+                time.sleep(random.Random(seed * 1_000_003 + i).random()
+                           * pacing_s * threads)
+            try:
+                results[i] = ("ok", frontend.search(
+                    r["text"], k=r["k"], scoring=r["scoring"],
+                    rerank=r["rerank"]))
+                job.report("serve", advance=1, served=1)
+            except Overloaded as e:
+                results[i] = ("shed", e)
+                job.report("serve", advance=1, shed=1)
+            except BaseException as e:  # invariant: structured or nothing
+                results[i] = ("error", e)
+                job.report("serve", advance=1, errors=1)
+
+        if fault_spec:
+            faults.install(faults.parse_plan(fault_spec))
+        t0 = time.perf_counter()
+        wall_s = 0.0
+        deadlocked = 0
+        pool = ThreadPoolExecutor(max_workers=threads,
+                                  thread_name_prefix="soak-worker")
+        try:
+            futs = [pool.submit(worker, i, r) for i, r in enumerate(reqs)]
+            done, not_done = wait(futs, timeout=timeout_s,
+                                  return_when=FIRST_EXCEPTION)
+            wall_s = time.perf_counter() - t0
+            deadlocked = len(not_done)  # governs teardown mode only
+            for f in not_done:
+                f.cancel()
+        finally:
+            # wait=False: a genuinely hung worker must surface as the
+            # `deadlocked` count (and the test harness's thread-leak guard),
+            # not hang the soak's own teardown
+            pool.shutdown(wait=deadlocked == 0, cancel_futures=True)
+            faults.clear()
+            # abandoned deadline dispatches may still be sleeping in an
+            # injected hang; drain them so nothing races process teardown
+            faults.drain_abandoned(timeout_s=10.0)
+
+        # -- invariant evaluation ---------------------------------------------
+        # snapshot the outcome list ONCE: cancelled-but-running workers
+        # (shutdown(wait=False) on deadlock) may still be writing. An entry
+        # still None at snapshot time IS the deadlock count — it must not
+        # also masquerade as an unstructured error
+        outcomes = list(results)
+        deadlocked = sum(1 for o in outcomes if o is None)
+        served = shed = errors = degraded = 0
+        levels: dict[str, int] = {}
+        full_bitident = tagged_divergent = untagged_mismatches = 0
+        error_reprs: list[str] = []
+        for out, r in zip(outcomes, reqs):
+            if out is None:
+                continue
+            state, payload = out
+            if state == "shed":
+                shed += 1
+                continue
+            if state == "error":
+                errors += 1
+                if len(error_reprs) < 5:
+                    error_reprs.append(repr(payload))
+                continue
+            served += 1
+            res = payload
+            levels[res.level] = levels.get(res.level, 0) + 1
+            degraded += bool(res.degraded)
+            matches = list(res) == reference[_req_key(r)]
+            if res.level == "full" and not res.degraded:
+                if matches:
+                    full_bitident += 1
+                else:
+                    # an untagged response that differs from the serial
+                    # reference is the cross-request corruption this soak
+                    # exists to catch
+                    untagged_mismatches += 1
+            elif not matches:
+                tagged_divergent += 1
+
+        fe_stats = frontend.stats()
+        recovery_delta = {
+            k: v - recovery_before.get(k, 0)
+            for k, v in recovery_counters().snapshot().items()
+            if v != recovery_before.get(k, 0)}
+        report = {
+            "submitted": len(reqs),
+            "threads": threads,
+            "served": served,
+            "shed": shed,
+            "errors": errors,
+            "error_samples": error_reprs,
+            "deadlocked": deadlocked,
+            "degraded": degraded,
+            "levels": levels,
+            "full_bitidentical": full_bitident,
+            "tagged_divergent": tagged_divergent,
+            "untagged_mismatches": untagged_mismatches,
+            "wall_s": round(wall_s, 3),
+            "fault_spec": fault_spec,
+            "frontend": fe_stats,
+            "recovery_delta": recovery_delta,
+            # per-stage latency percentiles for THIS run (registry delta);
+            # the four acceptance stages always appear, observed or not
+            "latency": obs.get_registry().delta_summary(
+                hist_before, always=("admission_wait", "dispatch", "kernel",
+                                     "fallback")),
+        }
+        if errors or deadlocked or untagged_mismatches:
+            # invariant breach: this is exactly the moment the flight
+            # recorder exists for — the offending requests' span trees are
+            # still in the ring. force=True: a breach is never rate-limited
+            report["flight_record"] = obs.flight_dump(
+                "soak_invariant_breach",
+                extra={k: report[k] for k in
+                       ("submitted", "served", "shed", "errors",
+                        "deadlocked", "untagged_mismatches",
+                        "error_samples")},
+                out_dir=flight_dir, force=True)
+            job.finish(error=f"invariant breach: errors={errors} "
+                             f"deadlocked={deadlocked} "
+                             f"untagged={untagged_mismatches}")
+        else:
+            job.finish()
+        return report
+    except BaseException as e:
+        # idempotent finish: the breach/success finishes above win if
+        # they already ran; anything escaping earlier (malformed fault
+        # spec, frontend init, report assembly) marks the job failed
+        # instead of leaving a ghost "running" soak
+        job.finish(error=repr(e))
+        raise
