@@ -648,9 +648,34 @@ print("WARM_JSON=" + json.dumps({{
     "load_s": round(init_s + index_s, 2),
     "init_s": round(init_s, 2),
     "index_s": round(index_s, 2),
+    **bench.load_stage_breakdown(),
     **probe,
 }}))
 """
+
+
+def load_stage_breakdown() -> dict:
+    """The load.* stage seconds (verify / read / assemble / h2d) plus
+    effective H2D bandwidth from this process's telemetry registry —
+    recorded in every BENCH row and BENCH_HISTORY.jsonl so the
+    cold-start trajectory is tracked like throughput (ISSUE 5). Stages
+    that never fired report 0.0; keys are flat (load_verify_s, ...,
+    load_h2d_mbps) so history rows stay grep/jq-friendly."""
+    from tpu_ir.obs import LOAD_STAGES, get_registry
+
+    snap = get_registry().snapshot()
+    hists = snap.get("histograms", {})
+    out = {}
+    for stage in LOAD_STAGES:
+        s = hists.get(stage, {})
+        out[stage.replace(".", "_") + "_s"] = round(
+            s.get("sum_ms", 0.0) / 1e3, 3)
+    h2d_bytes = snap.get("counters", {}).get("load.h2d_bytes", 0)
+    out["load_h2d_bytes"] = int(h2d_bytes)
+    h2d_s = out["load_h2d_s"]
+    out["load_h2d_mbps"] = (round(h2d_bytes / (1 << 20) / h2d_s, 1)
+                            if h2d_s > 0 and h2d_bytes else -1.0)
+    return out
 
 
 def _warm_load_subprocess(index_dir: str, cpu: bool,
@@ -694,6 +719,14 @@ def _warm_load_subprocess(index_dir: str, cpu: bool,
         "warm_index_load_s": best["index_s"],
         "warm_h2d_mbps": best.get("h2d_mbps", -1.0),
         "warm_device_rtt_ms": best.get("device_rtt_ms", -1.0),
+        # the child's own load.* stage split, warm_-prefixed so the row
+        # carries both cold (parent) and warm (child) breakdowns; the
+        # child's total load_s is excluded — it already lands above as
+        # scorer_load_warm_s, and a warm_load_s twin would double-count
+        # the total into the warm_load_* stage keys for any consumer
+        # summing them
+        **{f"warm_{k}": v for k, v in best.items()
+           if k.startswith("load_") and k != "load_s"},
         "warm_runs": runs,
     }
 
@@ -1194,6 +1227,7 @@ def main() -> int:
         # discard the build record — the timed build is the headline.
         # AssertionError stays fatal (verify/recall correctness gates).
         load_cold_s = query_s = -1.0
+        cold_breakdown = {}
         warm = {}
         lat_ms = np.array([-1.0])
         recall = -1.0
@@ -1204,6 +1238,10 @@ def main() -> int:
             scorer = Scorer.load(index_dir, layout="auto")
             _await_device(scorer)
             load_cold_s = time.perf_counter() - t0
+            # the cold load's own stage split (verify/read/assemble/h2d),
+            # snapshotted before anything else can observe load.* —
+            # nothing earlier in this process runs a Scorer.load
+            cold_breakdown = load_stage_breakdown()
             warm = _warm_load_subprocess(index_dir, cpu=args.cpu)
             # serving-cache accounting (VERDICT r4 next #7): the cold
             # load above built + persisted the full tier layout, so a
@@ -1311,6 +1349,10 @@ def main() -> int:
         "query_p50_ms": round(float(np.percentile(lat_ms, 50)), 2),
         "query_p99_ms": round(float(np.percentile(lat_ms, 99)), 2),
         "scorer_load_cold_s": round(load_cold_s, 2),
+        # cold-load stage split (load.* histograms: verify/read/assemble/
+        # h2d seconds + effective h2d MB/s) — the cold-start trajectory
+        # is tracked in BENCH_HISTORY like throughput (ISSUE 5)
+        **cold_breakdown,
         # warm load split: total = process-fixed (python+jax+tunnel init,
         # paid by ANY jax program) + the index load proper
         **warm,

@@ -1,0 +1,520 @@
+"""Artifact format v2 (page-aligned zero-copy arenas) acceptance suite
+(ISSUE 5): v1<->v2 round-trip parity at the scorer-result level, the
+migrate-index CLI, verify-while-read (exactly ONE streamed pass over
+part bytes on the verified load path), corruption faults against the v2
+writer, mmap loads on a read-only index dir, load-thread-count
+equivalence, and the chunked host-to-device streamer."""
+
+import json
+import os
+import stat as stat_mod
+
+import numpy as np
+import pytest
+
+import tpu_ir.faults as faults
+from tpu_ir.cli import main
+from tpu_ir.index import build_index
+from tpu_ir.index import format as fmt
+from tpu_ir.index.migrate import migrate_index
+from tpu_ir.index.verify import verify_index
+from tpu_ir.search import Scorer
+from tpu_ir.utils.report import recovery_counters
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+QUERIES = ("salmon fishing", "honey bears river", "stock market asset",
+           "quick brown fox", "rain")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    recovery_counters().reset()
+    fmt.reset_read_bytes()
+    yield
+    faults.clear()
+    recovery_counters().reset()
+    # disarm: the ledger must not stay on (per-chunk lock + growing
+    # path dict) for every later test in the pytest process
+    fmt.reset_read_bytes(arm=False)
+
+
+def write_corpus(path, n_docs=90):
+    body = []
+    for i in range(n_docs):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + (i % 7)))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    path.write_text("".join(body))
+    return str(path)
+
+
+def build(corpus, out, fv=None, monkeypatch=None):
+    if fv is not None:
+        assert monkeypatch is not None
+        monkeypatch.setenv("TPU_IR_FORMAT_VERSION", str(fv))
+    build_index([corpus], out, k=1, num_shards=3, compute_chargrams=False)
+    if monkeypatch is not None:
+        monkeypatch.delenv("TPU_IR_FORMAT_VERSION", raising=False)
+
+
+def results(idx, layout="sparse"):
+    s = Scorer.load(idx, layout=layout)
+    return [s.search(q, k=10) for q in QUERIES]
+
+
+# ---------------------------------------------------------------------------
+# arena reader/writer unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_arena_roundtrip_eager_and_mmap(tmp_path):
+    arrays = {
+        "a": np.arange(1000, dtype=np.int32),
+        "b": np.linspace(0, 1, 7)[None, :].astype(np.float32),
+        "empty": np.zeros(0, np.int64),
+        "scalarish": np.array([[5]], np.uint16),
+    }
+    path = str(tmp_path / "t.arena")
+    fmt.write_arena(path, arrays)
+    for mmap in (False, True):
+        got = fmt.load_arena(path, mmap=mmap)
+        assert list(got) == list(arrays)
+        for k, a in arrays.items():
+            assert got[k].dtype == a.dtype and got[k].shape == a.shape
+            np.testing.assert_array_equal(np.asarray(got[k]), a)
+    # every section starts page-aligned — the property that makes any
+    # dtype memmap-able zero-copy
+    header, data_start = fmt.read_arena_header(path)
+    assert data_start % fmt.ARENA_ALIGN == 0
+    for sec in header["sections"]:
+        assert sec["offset"] % fmt.ARENA_ALIGN == 0
+
+
+def test_arena_bitrot_raises_corrupt_taxonomy(tmp_path):
+    path = str(tmp_path / "t.arena")
+    fmt.write_arena(path, {"a": np.arange(4096, dtype=np.int32)})
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.seek(size - 100)
+        byte = f.read(1)
+        f.seek(size - 100)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(fmt.CORRUPT_NPZ) as ei:
+        fmt.load_arena(path)  # eager read verifies section CRCs
+    assert "CRC mismatch" in str(ei.value)
+    # truncation (torn write) surfaces too, as a section-past-EOF error
+    with open(path, "r+b") as f:
+        f.truncate(size // 2)
+    with pytest.raises(fmt.CORRUPT_NPZ):
+        fmt.load_arena(path)
+
+
+def test_write_arena_atomic_shares_fault_sites(tmp_path):
+    """The v2 writer rides the SAME spill_write retry + artifact_truncate
+    sites as savez_atomic — PR-1 integrity semantics, new format."""
+    path = str(tmp_path / "part-00000.arena")
+    faults.install(faults.parse_plan("spill_write@part-:first@2"))
+    crc = fmt.write_arena_atomic(path, a=np.arange(10, dtype=np.int32))
+    assert recovery_counters().get("retries") == 2
+    assert fmt.file_checksum(path) == crc  # CRC certifies renamed bytes
+    faults.install(faults.parse_plan("artifact_truncate@part-:once@1"))
+    crc2 = fmt.write_arena_atomic(path, a=np.arange(10, dtype=np.int32))
+    assert fmt.file_checksum(path) != crc2  # post-rename corruption
+    with pytest.raises(fmt.CORRUPT_NPZ):
+        fmt.load_arena(path)
+
+
+# ---------------------------------------------------------------------------
+# v1 <-> v2 parity and migration
+# ---------------------------------------------------------------------------
+
+
+def test_v1_v2_scorer_parity(tmp_path, monkeypatch):
+    """The SAME corpus built as npz (pinned v1) and as arenas (default)
+    must produce byte-identical scorer results in every layout."""
+    corpus = write_corpus(tmp_path / "c.trec")
+    v1, v2 = str(tmp_path / "v1"), str(tmp_path / "v2")
+    build(corpus, v1, fv=1, monkeypatch=monkeypatch)
+    build(corpus, v2)
+    assert fmt.IndexMetadata.load(v1).format_version == 1
+    assert fmt.IndexMetadata.load(v2).format_version == 2
+    assert os.path.exists(os.path.join(v1, "part-00000.npz"))
+    assert os.path.exists(os.path.join(v2, "part-00000.arena"))
+    assert verify_index(v1)["ok"] and verify_index(v2)["ok"]
+    for layout in ("sparse", "dense"):
+        assert results(v1, layout) == results(v2, layout), layout
+
+
+def test_migrate_index_cli_roundtrip(tmp_path, monkeypatch, capsys):
+    """v1 -> v2 migration in place: parts become arenas, checksums are
+    re-recorded, results are identical; --to 1 rolls back; re-running is
+    an idempotent no-op."""
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx, fv=1, monkeypatch=monkeypatch)
+    want = results(idx)
+
+    assert main(["migrate-index", idx]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["ok"] and out["migrated"] == 3 and out["skipped"] == 0
+    meta = fmt.IndexMetadata.load(idx)
+    assert meta.format_version == 2
+    for s in range(3):
+        assert os.path.exists(os.path.join(idx, f"part-{s:05d}.arena"))
+        assert not os.path.exists(os.path.join(idx, f"part-{s:05d}.npz"))
+        assert f"part-{s:05d}.arena" in meta.checksums
+        assert f"part-{s:05d}.npz" not in meta.checksums
+    assert verify_index(idx)["ok"]
+    assert results(idx) == want
+
+    # idempotent: a second run skips every shard
+    assert main(["migrate-index", idx]) == 0
+    assert json.loads(capsys.readouterr().out)["skipped"] == 3
+
+    # rollback: --to 1 re-serializes to npz and re-pins the metadata
+    assert main(["migrate-index", idx, "--to", "1"]) == 0
+    assert json.loads(capsys.readouterr().out)["migrated"] == 3
+    meta = fmt.IndexMetadata.load(idx)
+    assert meta.format_version == 1
+    assert os.path.exists(os.path.join(idx, "part-00000.npz"))
+    assert verify_index(idx)["ok"]
+    assert results(idx) == want
+
+
+def test_migrate_refuses_corrupt_source(tmp_path, monkeypatch):
+    """Migration must never launder rotten bytes into freshly
+    re-checksummed artifacts — a corrupt source part is ONE structured
+    IntegrityError, and the index is left un-migrated past it."""
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx, fv=1, monkeypatch=monkeypatch)
+    part = os.path.join(idx, "part-00001.npz")
+    size = os.path.getsize(part)
+    with open(part, "r+b") as f:
+        f.seek(size // 2)
+        byte = f.read(1)
+        f.seek(size // 2)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(faults.IntegrityError) as ei:
+        migrate_index(idx)
+    assert "part-00001" in ei.value.path
+    # metadata still pins v1: readers keep working off the old stamp
+    assert fmt.IndexMetadata.load(idx).format_version == 1
+
+
+def test_verify_passes_on_interrupted_migration(tmp_path, monkeypatch):
+    """A migration killed mid-way leaves the converted shard's source
+    unlinked while metadata checksums still name it. `tpu-ir verify`
+    must pass on that dir (the twin is verified by its own internal
+    CRCs), and re-running the migration completes it — the RUNBOOK §12
+    contract. A genuinely missing shard (no twin) still fails."""
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx, fv=1, monkeypatch=monkeypatch)
+    want = results(idx)
+    meta = fmt.IndexMetadata.load(idx)
+
+    # replay the migration's per-shard step for shard 0 only: arena
+    # written + npz unlinked, metadata (checksums + stamp) NOT rewritten
+    z = fmt.load_shard_verified(idx, 0, meta)
+    fmt.save_shard(idx, 0, term_ids=z["term_ids"], indptr=z["indptr"],
+                   pair_doc=z["pair_doc"], pair_tf=z["pair_tf"],
+                   df=z["df"], format_version=2)
+    assert os.path.exists(os.path.join(idx, "part-00000.arena"))
+    assert not os.path.exists(os.path.join(idx, "part-00000.npz"))
+    assert "part-00000.npz" in fmt.IndexMetadata.load(idx).checksums
+
+    assert verify_index(idx)["ok"]  # twin self-verified, not "corrupt"
+    # a bit-rotted twin is still caught by that self-verification: flip
+    # a byte INSIDE a section (between-section alignment padding is not
+    # CRC-covered)
+    arena = os.path.join(idx, "part-00000.arena")
+    header, data_start = fmt.read_arena_header(arena)
+    sec = next(s for s in header["sections"] if s["nbytes"] > 0)
+    pos = data_start + sec["offset"]
+    with open(arena, "r+b") as f:
+        f.seek(pos)
+        byte = f.read(1)
+        f.seek(pos)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    with pytest.raises(faults.IntegrityError):
+        verify_index(idx)
+    fmt.save_shard(idx, 0, term_ids=z["term_ids"], indptr=z["indptr"],
+                   pair_doc=z["pair_doc"], pair_tf=z["pair_tf"],
+                   df=z["df"], format_version=2)  # restore good twin
+
+    # re-running the migration finishes the job and results are intact
+    out = migrate_index(idx)
+    assert out["ok"] and out["migrated"] == 2 and out["skipped"] == 1
+    assert verify_index(idx)["ok"]
+    assert results(idx) == want
+
+    # with the twin gone too, the missing-file error still surfaces
+    os.remove(fmt.part_path(idx, 1))
+    with pytest.raises(faults.IntegrityError) as ei:
+        verify_index(idx)
+    assert "missing" in str(ei.value)
+
+
+def test_migrate_rerun_drops_stale_twin(tmp_path, monkeypatch):
+    """A crash BETWEEN save_shard's rename and its twin-unlink leaves
+    both formats' copies of one shard. Re-running the migration must
+    drop the stale source twin (after self-verifying the kept target),
+    not carry it in the checksum manifest forever."""
+    import shutil
+
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx, fv=1, monkeypatch=monkeypatch)
+    want = results(idx)
+    meta = fmt.IndexMetadata.load(idx)
+
+    npz = os.path.join(idx, "part-00000.npz")
+    shutil.copyfile(npz, str(tmp_path / "keep.npz"))
+    z = fmt.load_shard_verified(idx, 0, meta)
+    fmt.save_shard(idx, 0, term_ids=z["term_ids"], indptr=z["indptr"],
+                   pair_doc=z["pair_doc"], pair_tf=z["pair_tf"],
+                   df=z["df"], format_version=2)  # unlinks the npz...
+    shutil.copyfile(str(tmp_path / "keep.npz"), npz)  # ...resurrect it
+
+    out = migrate_index(idx)
+    assert out["ok"] and out["migrated"] == 2 and out["skipped"] == 1
+    assert not os.path.exists(npz)
+    assert os.path.exists(os.path.join(idx, "part-00000.arena"))
+    meta2 = fmt.IndexMetadata.load(idx)
+    assert "part-00000.npz" not in meta2.checksums
+    assert "part-00000.arena" in meta2.checksums
+    assert verify_index(idx)["ok"]
+    assert results(idx) == want
+
+
+# ---------------------------------------------------------------------------
+# verify-while-read: exactly ONE streamed pass over part bytes
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("fv", [1, 2])
+def test_verified_load_single_streamed_pass(tmp_path, monkeypatch, fv):
+    """The pin behind the tentpole: a verified cold Scorer.load streams
+    each part file's bytes EXACTLY once (CRC fold and array parse share
+    one read), for v1 npz and v2 arenas alike — the verify-then-read
+    double scan is gone. The warm (cache-hit) load streams ZERO part
+    bytes: it is mmap + upload only."""
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx, fv=fv, monkeypatch=monkeypatch)
+    meta = fmt.IndexMetadata.load(idx)
+
+    fmt.reset_read_bytes()
+    cold = results(idx)  # cold: verified shard read + cache persist
+    for s in range(meta.num_shards):
+        path = fmt.part_path(idx, s)
+        assert fmt.read_bytes_streamed(path) == os.path.getsize(path), \
+            f"shard {s}: part bytes streamed more than once"
+
+    fmt.reset_read_bytes()
+    assert results(idx) == cold  # warm: serving-cache hit
+    for s in range(meta.num_shards):
+        assert fmt.read_bytes_streamed(fmt.part_path(idx, s)) == 0, \
+            f"shard {s}: warm load touched part bytes"
+
+
+def test_load_threads_equivalence(tmp_path, monkeypatch):
+    """TPU_IR_LOAD_THREADS=1 and =8 must assemble identical CSR columns
+    and serve identical results (the pool changes scheduling, never
+    content)."""
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx)
+    meta = fmt.IndexMetadata.load(idx)
+
+    monkeypatch.setenv("TPU_IR_LOAD_THREADS", "1")
+    df1, (pd1, ptf1) = Scorer._assemble_csr(idx, meta, verify=True)
+    r1 = results(idx)
+    monkeypatch.setenv("TPU_IR_LOAD_THREADS", "8")
+    df8, (pd8, ptf8) = Scorer._assemble_csr(idx, meta, verify=True)
+    np.testing.assert_array_equal(df1, df8)
+    np.testing.assert_array_equal(pd1, pd8)
+    np.testing.assert_array_equal(ptf1, ptf8)
+    assert results(idx) == r1
+
+
+# ---------------------------------------------------------------------------
+# read-only serving + lazy pair_term
+# ---------------------------------------------------------------------------
+
+
+def test_mmap_load_on_readonly_index_dir(tmp_path, monkeypatch):
+    """A deployed (read-only) index dir must serve: arena sections mmap
+    with mode='r', the cache write is skipped, results are identical to
+    a writable dir's."""
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx)
+    want = results(idx)  # also persists the serving cache
+
+    for root, _dirs, files in os.walk(idx):
+        for f in files:
+            os.chmod(os.path.join(root, f),
+                     stat_mod.S_IRUSR | stat_mod.S_IRGRP)
+    monkeypatch.setattr("tpu_ir.search.layout.serving_cache_writable",
+                        lambda d: False)
+    try:
+        assert results(idx) == want  # warm: mmap'd cache hit
+        # and the no-cache path too: a fresh verified shard load off the
+        # same read-only files
+        meta = fmt.IndexMetadata.load(idx)
+        z = fmt.load_shard(idx, 0, mmap=True)
+        assert not z["pair_doc"].flags.writeable
+        df, _cols = Scorer._assemble_csr(idx, meta, verify=True)
+        assert int(df.sum()) > 0
+    finally:
+        for root, _dirs, files in os.walk(idx):
+            for f in files:
+                os.chmod(os.path.join(root, f), 0o644)
+
+
+def test_pair_term_stays_lazy_on_load(tmp_path):
+    """The eager load must NOT materialize pair_term (~1 GB at 250M
+    pairs); oracles that need it derive it on demand from df, and the
+    derived column equals the np.repeat ground truth."""
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx)
+    s = Scorer.load(idx, layout="sparse")
+    assert s._pairs_cols is None or s._pairs_cols[0] is None
+    pt, pd, ptf = s._pairs
+    df = s._df_host()
+    np.testing.assert_array_equal(
+        pt, np.repeat(np.arange(len(df), dtype=np.int32), df))
+    # doc/tf-only consumers never trigger the materialization
+    s2 = Scorer.load(idx, layout="sparse")
+    cols = s2._pairs_doc_tf
+    assert len(cols) == 2 and s2._pairs_cols[0] is None
+
+
+# ---------------------------------------------------------------------------
+# serving-cache revalidation (stat-first, CRC fallback, param drift)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_revalidation_stat_and_params(tmp_path):
+    from tpu_ir.search.layout import load_serving_cache
+
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx)
+    results(idx)  # persist the cache
+    meta = fmt.IndexMetadata.load(idx)
+    assert load_serving_cache(idx, meta=meta) is not None
+
+    # mtime drift with identical content: the stat check misses but the
+    # CRC fallback revalidates by content — still a hit
+    part = fmt.part_path(idx, 0)
+    os.utime(part, ns=(1, 1))
+    assert load_serving_cache(idx, meta=meta) is not None
+
+    # parameter drift must MISS even when file stats match (the key's
+    # non-file fields are compared on the stat fast path too)
+    assert load_serving_cache(idx, meta=meta, hot_budget=1) is None
+
+
+def test_cache_revalidate_crc_catches_stat_preserving_rot(
+        tmp_path, monkeypatch):
+    """TPU_IR_CACHE_REVALIDATE=crc closes the one hole stat-first
+    revalidation accepts by design: media bit-rot that preserves a
+    part's size and mtime_ns rides a default-mode hit (a hit reads no
+    part bytes at all), while crc mode re-streams every part's digest —
+    the rotted part misses the cache into the eager verified path,
+    which raises the structured integrity error."""
+    from tpu_ir.search.layout import load_serving_cache
+
+    corpus = write_corpus(tmp_path / "c.trec")
+    idx = str(tmp_path / "idx")
+    build(corpus, idx)
+    results(idx)  # persist the cache
+    meta = fmt.IndexMetadata.load(idx)
+
+    # flip one byte mid-part, then restore mtime_ns: size + mtime now
+    # match the manifest's part_stat exactly — invisible to a stat check
+    part = fmt.part_path(idx, 0)
+    st = os.stat(part)
+    with open(part, "r+b") as f:
+        f.seek(st.st_size - 100)
+        byte = f.read(1)
+        f.seek(st.st_size - 100)
+        f.write(bytes([byte[0] ^ 0xFF]))
+    os.utime(part, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert os.stat(part).st_mtime_ns == st.st_mtime_ns
+
+    # default stat-first mode: still a hit — the documented tradeoff
+    # that buys the zero-part-IO warm load
+    assert load_serving_cache(idx, meta=meta) is not None
+
+    monkeypatch.setenv("TPU_IR_CACHE_REVALIDATE", "crc")
+    assert load_serving_cache(idx, meta=meta) is None
+    with pytest.raises(faults.IntegrityError):
+        results(idx)
+
+    # case/whitespace variants of the knob still count as crc; a bogus
+    # value must raise, not silently fall back to the weaker stat mode
+    monkeypatch.setenv("TPU_IR_CACHE_REVALIDATE", " CRC ")
+    assert load_serving_cache(idx, meta=meta) is None
+    monkeypatch.setenv("TPU_IR_CACHE_REVALIDATE", "full")
+    with pytest.raises(ValueError, match="TPU_IR_CACHE_REVALIDATE"):
+        load_serving_cache(idx, meta=meta)
+
+
+# ---------------------------------------------------------------------------
+# chunked host-to-device streaming
+# ---------------------------------------------------------------------------
+
+
+def test_stream_to_device_chunked_equivalence():
+    import jax.numpy as jnp
+
+    from tpu_ir.utils.transfer import stream_to_device
+
+    rng = np.random.default_rng(7)
+    for shape, dtype in (((1 << 15,), np.int32), ((257, 129), np.float32),
+                         ((5,), np.uint16), ((0,), np.int32)):
+        a = rng.integers(0, 100, size=shape).astype(dtype)
+        got = stream_to_device(a, chunk_bytes=1 << 12)  # force chunking
+        assert got.shape == a.shape and got.dtype == a.dtype
+        np.testing.assert_array_equal(np.asarray(got), a)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(jnp.asarray(a)))
+
+
+def test_stream_to_device_verifies_crc():
+    import zlib
+
+    from tpu_ir.utils.transfer import stream_to_device
+
+    a = np.arange(1 << 14, dtype=np.int32)
+    good = f"crc32:{zlib.crc32(a.tobytes()):08x}"
+    np.testing.assert_array_equal(
+        np.asarray(stream_to_device(a, chunk_bytes=1 << 12,
+                                    expected_crc=good)), a)
+    with pytest.raises(faults.IntegrityError):
+        stream_to_device(a, chunk_bytes=1 << 12,
+                         expected_crc="crc32:00000000", label="t")
+
+
+def test_h2d_telemetry_lands_in_registry():
+    """Every stream_to_device call is a load.h2d span + h2d_bytes count,
+    so effective bandwidth is readable from `tpu-ir metrics`."""
+    from tpu_ir.obs import get_registry
+    from tpu_ir.utils.transfer import stream_to_device
+
+    reg = get_registry()
+    reg.snapshot(reset=True)
+    a = np.arange(1 << 13, dtype=np.int32)
+    stream_to_device(a, chunk_bytes=1 << 12)
+    snap = reg.snapshot()
+    assert snap["counters"].get("load.h2d_bytes") == a.nbytes
+    assert snap["histograms"]["load.h2d"]["count"] == 1

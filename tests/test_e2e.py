@@ -70,7 +70,7 @@ def index_dir(tmp_path_factory):
 
 def test_artifacts_exist(index_dir):
     for name in [fmt.METADATA, fmt.DOCNOS, fmt.VOCAB, fmt.DOCLEN,
-                 fmt.DICTIONARY, "part-00000.npz", "part-00002.npz",
+                 fmt.DICTIONARY, fmt.part_name(0), fmt.part_name(2),
                  "chargram-k2.npz", "chargram-k3.npz"]:
         assert os.path.exists(os.path.join(index_dir, name)), name
     meta = fmt.IndexMetadata.load(index_dir)
@@ -569,15 +569,21 @@ def test_serving_layout_cache(tmp_path):
     assert os.path.isdir(os.path.join(idx, "serving-tiered"))
 
     # cache hit: the second load must actually read the cached arrays —
-    # poison one on disk and expect the poisoned values to surface
+    # poison one on disk (rewrite the cache arena with a zeroed section;
+    # CRCs are recomputed on write, so the reader accepts the bytes) and
+    # expect the poisoned values to surface
     import numpy as np
 
     cache = os.path.join(idx, "serving-tiered")
-    tier0 = np.load(os.path.join(cache, "tier_tfs_0.npy"))
-    np.save(os.path.join(cache, "tier_tfs_0.npy"), tier0 * 0)
+    arena = os.path.join(cache, "cache.arena")
+    sections = {k: np.array(v) for k, v in fmt.load_arena(arena).items()}
+    tier0 = sections["tier_tfs_0"].copy()
+    sections["tier_tfs_0"] = tier0 * 0
+    fmt.write_arena(arena, sections)
     s2 = Scorer.load(idx, layout="sparse")
     assert s2.search("salmon fishing") != r1  # poisoned cache was used
-    np.save(os.path.join(cache, "tier_tfs_0.npy"), tier0)  # restore
+    sections["tier_tfs_0"] = tier0  # restore
+    fmt.write_arena(arena, sections)
     assert Scorer.load(idx, layout="sparse").search("salmon fishing") == r1
 
     # in-place rebuild over a DIFFERENT corpus with overwrite=True (which
@@ -659,8 +665,8 @@ def test_wildcard_search_kgram_index(tmp_path_factory):
 
 
 def test_truncated_cache_array_recovers(tmp_path):
-    """A truncated serving-cache .npy (torn write, disk-full) must degrade
-    to a rebuild, not crash the load."""
+    """A truncated serving-cache arena (torn write, disk-full) must
+    degrade to a rebuild, not crash the load."""
     from tpu_ir.index import build_index as bi
 
     corpus = corpus_file(tmp_path)
@@ -669,9 +675,9 @@ def test_truncated_cache_array_recovers(tmp_path):
     want = Scorer.load(idx, layout="sparse").search("salmon fishing")
 
     cache = os.path.join(idx, "serving-tiered")
-    path = os.path.join(cache, "tier_tfs_0.npy")
+    path = os.path.join(cache, "cache.arena")
     with open(path, "r+b") as f:
-        f.truncate(16)  # inside the npy header
+        f.truncate(os.path.getsize(path) // 2)  # sections past EOF
     got = Scorer.load(idx, layout="sparse").search("salmon fishing")
     assert got == want  # rebuilt from shards, identical results
 

@@ -104,11 +104,17 @@ def test_inspect_serving_cache(idx, tmp_path):
     assert os.path.isdir(cache)
     out = lines_for(cache)
     assert "serving cache" in out[0] and "version" in out[0]
-    # the df.npy line must carry the REAL head values — 'or startswith'
+    # the df line must carry the REAL head values — 'or startswith'
     # made the value check decorative, and the endswith arm could never
-    # match (numpy-2 scalar reprs + the ' ...' suffix) (review r5)
-    head = f"head={np.load(os.path.join(cache, 'df.npy'))[:8].tolist()}"
-    df_lines = [line for line in out if line.startswith("df.npy")]
+    # match (numpy-2 scalar reprs + the ' ...' suffix) (review r5).
+    # Cache v5 packs every array into one arena; sections render as
+    # cache.arena/<name> lines.
+    from tpu_ir.index import format as fmt
+
+    df = fmt.load_arena(os.path.join(cache, "cache.arena"))["df"]
+    head = f"head={np.asarray(df[:8]).tolist()}"
+    df_lines = [line for line in out
+                if line.startswith("cache.arena/df\t")]
     assert df_lines and any(head in line for line in df_lines), out
 
 

@@ -410,6 +410,18 @@ def cmd_verify(args) -> int:
     return 0
 
 
+def cmd_migrate_index(args) -> int:
+    """Convert a built index's part shards between artifact formats in
+    place (v1 npz <-> v2 arenas; index/migrate.py): verify-while-read
+    from the old copies, atomic rename per shard, checksums re-recorded,
+    metadata.format_version stamped last. Idempotent — re-running
+    finishes an interrupted migration."""
+    from .index.migrate import migrate_index
+
+    print(json.dumps(migrate_index(args.index_dir, to_version=args.to)))
+    return 0
+
+
 def cmd_warm(args) -> int:
     """Prebuild the serving cache at deploy time instead of on the first
     query: one cold Scorer.load builds + persists the tiered layout, df
@@ -739,7 +751,7 @@ def cmd_expand(args) -> int:
 _ARTIFACT_ENTRY_CMDS = frozenset({
     "cmd_search", "cmd_inspect", "cmd_verify", "cmd_warm", "cmd_docno",
     "cmd_expand", "cmd_eval", "cmd_count", "cmd_pack", "cmd_merge",
-    "cmd_serve_bench",
+    "cmd_serve_bench", "cmd_migrate_index",
 })
 
 
@@ -848,6 +860,17 @@ def main(argv: list[str] | None = None) -> int:
     pv = sub.add_parser("verify", help="validate index structural invariants")
     pv.add_argument("index_dir")
     pv.set_defaults(fn=cmd_verify)
+
+    pmi = sub.add_parser(
+        "migrate-index",
+        help="convert part shards between artifact formats in place "
+             "(npz v1 <-> arena v2; atomic per shard, checksums "
+             "re-recorded, idempotent)")
+    pmi.add_argument("index_dir")
+    pmi.add_argument("--to", type=int, choices=[1, 2], default=2,
+                     help="target format_version (2 = zero-copy arenas, "
+                          "1 = npz rollback)")
+    pmi.set_defaults(fn=cmd_migrate_index)
 
     pw = sub.add_parser("warm", help="prebuild the serving cache (tiered "
                                      "layout + df + rerank norms) so later "

@@ -18,6 +18,7 @@ from typing import Iterator
 import numpy as np
 
 from .format import CORRUPT_NPZ as _CORRUPT_NPZ
+from .format import ARENA_SUFFIX, load_arena
 
 _HEAD = 8  # values shown per array in the fallback listing
 
@@ -53,76 +54,116 @@ def _inspect_npz(path: str, n: int) -> Iterator[str]:
                "file, re-run the build to rebuild the shard from spills")
 
 
+class _ArenaView:
+    """The minimal np.load-result surface (.files + mapping access) over
+    an arena's sections, so the shape-specialized dumps below serve both
+    formats through one code path."""
+
+    def __init__(self, sections: dict[str, np.ndarray]):
+        self._sections = sections
+        self.files = list(sections)
+
+    def __getitem__(self, name: str) -> np.ndarray:
+        return self._sections[name]
+
+
+def _inspect_arena(path: str, n: int) -> Iterator[str]:
+    base = os.path.basename(path)
+    try:
+        # eager verified read: per-section CRCs checked, same
+        # read-fully-implies-intact contract the inspect dump certifies
+        # for npz; the shape-specialized renderings below are shared, so
+        # a part shard dumps identically whichever format holds it
+        z = _ArenaView(load_arena(path))
+        yield from _dump_known_shapes(z, base, n)
+    except _CORRUPT_NPZ as e:
+        yield (f"{base}: CORRUPT arena ({type(e).__name__}: {e}) — "
+               f"size={os.path.getsize(path)} bytes; if this is a part "
+               "file, re-run the build (or restore/migrate) to rebuild "
+               "the shard")
+
+
 def _inspect_npz_inner(path: str, base: str, n: int) -> Iterator[str]:
     with np.load(path, allow_pickle=False) as z:
-        names = list(z.files)
-        have = set(names)
+        yield from _dump_known_shapes(z, base, n)
 
-        if {"pos_indptr", "pos_delta"} <= have:
-            # positions-NNNNN.npz shard, pos-SSS-BBBBB.npz streaming
-            # spill, or pos-RRR-bBBBBB-pPPP.npz multi-host shared spill
-            indptr, delta = z["pos_indptr"], z["pos_delta"]
-            nruns = len(indptr) - 1
-            yield (f"{base}: position runs\truns={nruns}"
-                   f"\tpositions={len(delta)}")
-            keyed = {"term", "doc", "tf"} <= have
-            for r, pos in _decode_runs(indptr, delta, 0, n):
-                key = (f"term={int(z['term'][r])}\tdoc={int(z['doc'][r])}"
-                       f"\ttf={int(z['tf'][r])}\t" if keyed else "")
-                yield f"run {r}\t{key}{pos}"
-            return
 
-        if {"term", "doc", "tf"} <= have:
-            # pairs-SSS-BBBBB.npz build spill (one term shard, one batch)
-            yield (f"{base}: pair spill\tpairs={len(z['term'])}")
-            triples = list(zip(z["term"][:n].tolist(),
-                               z["doc"][:n].tolist(),
-                               z["tf"][:n].tolist()))
-            for t, d, w in triples:
-                yield f"term={t}\tdoc={d}\ttf={w}"
-            return
+def _dump_known_shapes(z, base: str, n: int) -> Iterator[str]:
+    names = list(z.files)
+    have = set(names)
 
-        if {"ids", "lengths"} <= have:
-            # tokens-NNNNN.npz pass-1 spill (temp-id occurrence stream)
-            lengths = z["lengths"]
-            yield (f"{base}: token spill\tdocs={len(lengths)}"
-                   f"\toccurrences={len(z['ids'])}")
-            yield f"lengths\thead={_head(lengths, n)}"
-            yield f"ids\thead={_head(z['ids'], n)}"
-            return
+    if {"pos_indptr", "pos_delta"} <= have:
+        # positions-NNNNN.npz shard, pos-SSS-BBBBB.npz streaming
+        # spill, or pos-RRR-bBBBBB-pPPP.npz multi-host shared spill
+        indptr, delta = z["pos_indptr"], z["pos_delta"]
+        nruns = len(indptr) - 1
+        yield (f"{base}: position runs\truns={nruns}"
+               f"\tpositions={len(delta)}")
+        keyed = {"term", "doc", "tf"} <= have
+        for r, pos in _decode_runs(indptr, delta, 0, n):
+            key = (f"term={int(z['term'][r])}\tdoc={int(z['doc'][r])}"
+                   f"\ttf={int(z['tf'][r])}\t" if keyed else "")
+            yield f"run {r}\t{key}{pos}"
+        return
 
-        if {"sig", "docids", "n_batches"} <= have:
-            # pass1.npz crash-resume manifest (streaming / multi-host)
-            yield (f"{base}: pass-1 manifest\tdocs={len(z['docids'])}"
-                   f"\tvocab={len(z['vocab'])}"
-                   f"\tn_batches={int(z['n_batches'])}")
-            yield f"batch_occ\thead={_head(z['batch_occ'], n)}"
-            for part in z["sig"].tolist():
-                yield f"sig\t{part}"
-            return
+    if {"term", "doc", "tf"} <= have:
+        # pairs-SSS-BBBBB.npz build spill (one term shard, one batch)
+        yield (f"{base}: pair spill\tpairs={len(z['term'])}")
+        triples = list(zip(z["term"][:n].tolist(),
+                           z["doc"][:n].tolist(),
+                           z["tf"][:n].tolist()))
+        for t, d, w in triples:
+            yield f"term={t}\tdoc={d}\ttf={w}"
+        return
 
-        if {"term_ids", "indptr", "pair_doc", "pair_tf", "df"} <= have:
-            # part-NNNNN.npz shard outside an index dir (no vocab at
-            # hand, so terms print as ids)
-            tids = z["term_ids"]
-            yield f"{base}: postings shard\tterms={len(tids)}" \
-                  f"\tpairs={len(z['pair_doc'])}"
-            for i, tid in enumerate(tids[:n].tolist()):
-                lo, hi = int(z["indptr"][i]), int(z["indptr"][i + 1])
-                posts = list(zip(z["pair_doc"][lo:hi][:n].tolist(),
-                                 z["pair_tf"][lo:hi][:n].tolist()))
-                yield f"term_id={tid}\tdf={int(z['df'][i])}\t{posts}"
-            return
+    if {"ids", "lengths"} <= have:
+        # tokens-NNNNN.npz pass-1 spill (temp-id occurrence stream)
+        lengths = z["lengths"]
+        yield (f"{base}: token spill\tdocs={len(lengths)}"
+               f"\toccurrences={len(z['ids'])}")
+        yield f"lengths\thead={_head(lengths, n)}"
+        yield f"ids\thead={_head(z['ids'], n)}"
+        return
 
-        # anything else: named-array listing (the generic dump)
-        yield f"{base}: npz\tarrays={len(names)}"
-        yield from _array_lines(z, names, n)
+    if {"sig", "docids", "n_batches"} <= have:
+        # pass1.npz crash-resume manifest (streaming / multi-host)
+        yield (f"{base}: pass-1 manifest\tdocs={len(z['docids'])}"
+               f"\tvocab={len(z['vocab'])}"
+               f"\tn_batches={int(z['n_batches'])}")
+        yield f"batch_occ\thead={_head(z['batch_occ'], n)}"
+        for part in z["sig"].tolist():
+            yield f"sig\t{part}"
+        return
+
+    if {"term_ids", "indptr", "pair_doc", "pair_tf", "df"} <= have:
+        # part-NNNNN.npz shard outside an index dir (no vocab at
+        # hand, so terms print as ids)
+        tids = z["term_ids"]
+        yield f"{base}: postings shard\tterms={len(tids)}" \
+              f"\tpairs={len(z['pair_doc'])}"
+        for i, tid in enumerate(tids[:n].tolist()):
+            lo, hi = int(z["indptr"][i]), int(z["indptr"][i + 1])
+            posts = list(zip(z["pair_doc"][lo:hi][:n].tolist(),
+                             z["pair_tf"][lo:hi][:n].tolist()))
+            yield f"term_id={tid}\tdf={int(z['df'][i])}\t{posts}"
+        return
+
+    # anything else: named-array listing (the generic dump)
+    kind = "arena" if isinstance(z, _ArenaView) else "npz"
+    yield f"{base}: {kind}\tarrays={len(names)}"
+    yield from _array_lines(z, names, n)
 
 
 def _inspect_serving_cache(path: str, n: int) -> Iterator[str]:
     with open(os.path.join(path, "manifest.json")) as f:
         manifest = json.load(f)
     yield f"{os.path.basename(path)}: serving cache\t{json.dumps(manifest)}"
+    arena = os.path.join(path, "cache.arena")
+    if os.path.exists(arena):
+        # cache v5: every array is a section of ONE mmap'd arena
+        for name, a in load_arena(arena, mmap=True).items():
+            yield f"cache.arena/{name}\t{a.dtype}\t{a.shape}\thead={_head(a)}"
+        return
     for name in sorted(os.listdir(path)):
         if not name.endswith(".npy"):
             continue
@@ -173,6 +214,8 @@ def inspect_path(path: str, n: int = 10) -> Iterator[str]:
         return
     if path.endswith(".npz"):
         yield from _inspect_npz(path, n)
+    elif path.endswith(ARENA_SUFFIX):
+        yield from _inspect_arena(path, n)
     elif path.endswith(".npy"):
         a = np.load(path, mmap_mode="r")
         yield (f"{os.path.basename(path)}: npy\t{a.dtype}\t{a.shape}"

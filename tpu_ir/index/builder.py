@@ -314,7 +314,8 @@ def _build_index(
         num_pairs=num_pairs,
         chargram_ks=chargram_ks if built_chargrams else [],
         version=2 if positions else fmt.FORMAT_VERSION,
-        has_positions=bool(positions))
+        has_positions=bool(positions),
+        format_version=fmt.resolve_format_version())
     meta.save_with_checksums(index_dir)
     report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     return meta

@@ -24,8 +24,12 @@ forward index reconstructs via (fileNo, byteOffset) pairs
 
 from __future__ import annotations
 
+import io
 import json
 import os
+import struct
+import threading
+import time
 import zipfile
 import zlib
 from dataclasses import dataclass, field
@@ -34,15 +38,24 @@ import numpy as np
 
 from .. import faults
 
-# exceptions that mean "this npz artifact is unreadable/corrupt": npz rides
-# ZIP, and zipfile CRC-checks every fully-read entry, so bit rot surfaces
-# as BadZipFile on a full read. One definition shared by every consumer
-# (resume validation, part quarantine, inspect) so the corruption taxonomy
-# cannot drift between paths.
+# exceptions that mean "this npz OR arena artifact is unreadable/corrupt":
+# npz rides ZIP, and zipfile CRC-checks every fully-read entry, so bit rot
+# surfaces as BadZipFile on a full read; the arena reader raises ValueError
+# on a bad magic/header/section-CRC and OSError on IO. One definition
+# shared by every consumer (resume validation, part quarantine, inspect)
+# so the corruption taxonomy cannot drift between paths.
 CORRUPT_NPZ = (OSError, ValueError, KeyError, zipfile.BadZipFile,
                zlib.error)
 
 FORMAT_VERSION = 1
+# artifact format v2: part (and serving-cache) files are page-aligned
+# raw-bytes ARENAS instead of npz zips — every array np.memmap-able
+# zero-copy, per-section CRC32s in the header, whole-file CRC in the
+# metadata checksums so a verified load is ONE streamed pass. New builds
+# emit v2 unless pinned back via TPU_IR_FORMAT_VERSION=1 (RUNBOOK
+# migration note) or an explicit builder format_version=1.
+ARENA_FORMAT_VERSION = 2
+DEFAULT_FORMAT_VERSION = ARENA_FORMAT_VERSION
 METADATA = "metadata.json"
 DOCNOS = "docnos.txt"
 VOCAB = "vocab.txt"
@@ -52,9 +65,35 @@ JOBS_DIR = "jobs"
 QUARANTINE_DIR = ".quarantine"
 
 
-def part_name(shard: int) -> str:
-    # reference output shards are part-00000..part-0000N (Hadoop naming)
+def resolve_format_version(format_version: int | None = None) -> int:
+    """The artifact format a writer should emit: an explicit argument
+    wins, else the TPU_IR_FORMAT_VERSION env pin, else the default (v2
+    arenas). One resolver shared by all four builders so a rollback pin
+    covers every write path at once."""
+    if format_version is not None:
+        return int(format_version)
+    return int(os.environ.get("TPU_IR_FORMAT_VERSION",
+                              DEFAULT_FORMAT_VERSION))
+
+
+def part_name(shard: int, format_version: int | None = None) -> str:
+    # reference output shards are part-00000..part-0000N (Hadoop naming);
+    # the extension carries the artifact format (npz v1, arena v2)
+    if resolve_format_version(format_version) >= ARENA_FORMAT_VERSION:
+        return f"part-{shard:05d}.arena"
     return f"part-{shard:05d}.npz"
+
+
+def part_path(index_dir: str, shard: int) -> str:
+    """The shard's on-disk part file, whichever format is present (arena
+    preferred — a mid-migration dir holds both and the arenas are the
+    complete copies). Falls back to the resolved-default name when
+    neither exists (callers get a clean FileNotFoundError on open)."""
+    for fv in (ARENA_FORMAT_VERSION, FORMAT_VERSION):
+        p = os.path.join(index_dir, part_name(shard, fv))
+        if os.path.exists(p):
+            return p
+    return os.path.join(index_dir, part_name(shard))
 
 
 def chargram_name(k: int) -> str:
@@ -77,6 +116,10 @@ class IndexMetadata:
     # by every builder at metadata-save time and verified on Scorer.load
     # / `tpu-ir verify`; pre-checksum metadata lacks the key (no checks)
     checksums: dict[str, str] = field(default_factory=dict)
+    # artifact format of the part/serving-cache files: 1 = npz zips,
+    # 2 = page-aligned raw-bytes arenas (zero-copy mmap loads, verify-
+    # while-read). Pre-v2 metadata lacks the key and defaults to 1.
+    format_version: int = FORMAT_VERSION
 
     def save(self, index_dir: str) -> None:
         with open(os.path.join(index_dir, METADATA), "w") as f:
@@ -97,48 +140,139 @@ class IndexMetadata:
             return cls(**json.load(f))
 
 
-def savez_atomic(path: str, **arrays) -> str:
-    """np.savez through a same-directory temp file + rename, so a file's
-    EXISTENCE implies it is complete — the invariant the streaming build's
-    crash-resume (streaming.py) trusts for spills and part files.
+# ---------------------------------------------------------------------------
+# streamed-read accounting + atomic write plumbing
+# ---------------------------------------------------------------------------
 
-    Every write runs under the supervised spill retry policy (transient
-    filesystem failures re-attempt with jittered backoff; exhaustion is a
-    structured BuildError naming the file) — one contract for token/pair
-    spills, position spills, and part files alike.
+# bytes streamed per file path (CRC folds, verified loads, checksum
+# passes) — the instrumentation behind the "exactly one streamed pass
+# over part bytes on the verified load path" pin (tests/test_arena.py).
+# mmap page-ins are not counted: they are not a second streamed read.
+# OFF until reset_read_bytes() arms it: a long-lived serving/build
+# process checksums an unbounded stream of distinct paths (spill temp
+# files included) and must not pay a per-chunk lock or grow a
+# path-keyed dict for a test-only ledger.
+_read_lock = threading.Lock()
+_read_bytes: dict[str, int] = {}
+_read_ledger_on = False
 
-    Returns the file's CRC ('crc32:XXXXXXXX'), computed from the TEMP file
-    before the rename: the digest certifies the bytes the writer intended,
-    so corruption that lands after the write (bit rot — or the
-    artifact_truncate fault below) always MISMATCHES a manifest that
-    recorded this return value."""
+
+def reset_read_bytes(arm: bool = True) -> None:
+    """Clear and (by default) ARM the streamed-read ledger (test hook).
+    `arm=False` disarms it — callers that armed the ledger should disarm
+    on the way out so a long-lived process doesn't keep paying the
+    per-chunk lock and growing the path-keyed dict forever."""
+    global _read_ledger_on
+    with _read_lock:
+        _read_ledger_on = arm
+        _read_bytes.clear()
+
+
+def read_bytes_streamed(path: str | None = None):
+    """Total bytes streamed per file since the last reset (test hook)."""
+    with _read_lock:
+        if path is None:
+            return dict(_read_bytes)
+        return _read_bytes.get(os.path.abspath(path), 0)
+
+
+def _iter_file_chunks(path: str, chunk_bytes: int = 1 << 22):
+    """Stream one file's bytes, counting them against the read ledger."""
+    key = os.path.abspath(path)
+    with open(path, "rb") as f:
+        while chunk := f.read(chunk_bytes):
+            if _read_ledger_on:
+                with _read_lock:
+                    _read_bytes[key] = _read_bytes.get(key, 0) + len(chunk)
+            yield chunk
+
+
+def _read_file_verified(path: str, chunk_bytes: int = 1 << 22):
+    """ONE streamed pass: read the whole file into a single preallocated
+    buffer (readinto — no per-chunk bytes objects, no join doubling peak
+    memory on GB-scale parts across the load thread pool), folding a
+    CRC32 over each slice as it lands. Returns (read-only memoryview,
+    crc, crc_seconds); bytes are counted against the read ledger."""
+    key = os.path.abspath(path)
+    size = os.path.getsize(path)
+    buf = bytearray(size)
+    mv = memoryview(buf)
+    pos = 0
+    crc = 0
+    t_crc = 0.0
+    with open(path, "rb") as f:
+        while pos < size:
+            n = f.readinto(mv[pos : pos + chunk_bytes])
+            if not n:
+                break
+            if _read_ledger_on:
+                with _read_lock:
+                    _read_bytes[key] = _read_bytes.get(key, 0) + n
+            t0 = time.perf_counter()
+            crc = zlib.crc32(mv[pos : pos + n], crc)
+            t_crc += time.perf_counter() - t0
+            pos += n
+    if pos != size:
+        raise ValueError(f"{path}: short read ({pos} of {size} bytes) — "
+                         "file truncated mid-load")
+    return mv.toreadonly(), crc, t_crc
+
+
+def _maybe_truncate(path: str, name: str) -> None:
+    """The artifact_truncate fault site, shared by the npz and arena
+    writers: simulate on-disk corruption (torn write / bit rot) by
+    chopping the tail off the just-renamed file. The per-entry CRCs (zip)
+    / per-section CRCs (arena) turn any later full read into a loud
+    failure, which is exactly what the quarantine-and-rebuild paths are
+    tested against."""
+    if faults.should_fire("artifact_truncate", name) is not None:
+        with open(path, "r+b") as f:
+            f.truncate(max(os.path.getsize(path) // 2, 1))
+
+
+def _write_atomic(path: str, tmp_suffix: str, write_tmp) -> str:
+    """Temp-file + rename atomic write under the supervised spill retry
+    policy, with the spill_write and artifact_truncate fault sites
+    threaded through — ONE contract for npz spills, npz parts and v2
+    arenas alike, so the PR-1 integrity semantics carry over to the new
+    format byte for byte. Returns the file's CRC ('crc32:XXXXXXXX'),
+    computed from the TEMP file before the rename: the digest certifies
+    the bytes the writer intended, so corruption that lands after the
+    write always MISMATCHES a manifest that recorded this value."""
     name = os.path.basename(path)
-    tmp = path + ".tmp.npz"
+    tmp = path + tmp_suffix
 
     def write() -> str:
         if faults.should_fire("spill_write", name) is not None:
             raise OSError(f"injected spill write failure: {path}")
-        np.savez(tmp, **arrays)
+        write_tmp(tmp)
         crc = file_checksum(tmp)
         os.replace(tmp, path)
         return crc
 
     crc = faults.run_with_retry(write, policy=faults.SPILL_RETRY,
                                 stage=f"write:{name}")
-    if faults.should_fire("artifact_truncate", name) is not None:
-        # simulate on-disk corruption (torn write / bit rot): chop the
-        # tail off the just-renamed file. zipfile's per-entry CRC turns
-        # any later full read into a loud failure, which is exactly what
-        # the quarantine-and-rebuild paths are tested against.
-        with open(path, "r+b") as f:
-            f.truncate(max(os.path.getsize(path) // 2, 1))
+    _maybe_truncate(path, name)
     return crc
 
 
+def savez_atomic(path: str, **arrays) -> str:
+    """np.savez through a same-directory temp file + rename, so a file's
+    EXISTENCE implies it is complete — the invariant the streaming build's
+    crash-resume (streaming.py) trusts for spills and part files.
+    See _write_atomic for the retry/fault/CRC contract."""
+    return _write_atomic(path, ".tmp.npz",
+                         lambda tmp: np.savez(tmp, **arrays))
+
+
 def readable_npz(path: str) -> bool:
-    """Fully read every array of an npz (zipfile verifies entry CRCs on a
-    full read), so True means the artifact's bytes are intact."""
+    """Fully read every array of an npz OR arena artifact (zip entry CRCs
+    / arena section CRCs verify on a full read), so True means the
+    artifact's bytes are intact."""
     try:
+        if path.endswith(ARENA_SUFFIX):
+            load_arena(path)
+            return True
         with np.load(path, allow_pickle=False) as z:
             for name in z.files:
                 z[name]
@@ -151,10 +285,191 @@ def file_checksum(path: str, chunk_bytes: int = 1 << 22) -> str:
     """Streamed CRC32 of one file, as 'crc32:XXXXXXXX' (the same digest
     the serving-cache key uses — ~1 s/GB from page cache)."""
     crc = 0
-    with open(path, "rb") as f:
-        while chunk := f.read(chunk_bytes):
-            crc = zlib.crc32(chunk, crc)
+    for chunk in _iter_file_chunks(path, chunk_bytes):
+        crc = zlib.crc32(chunk, crc)
     return f"crc32:{crc:08x}"
+
+
+# ---------------------------------------------------------------------------
+# artifact format v2: page-aligned raw-bytes arenas
+# ---------------------------------------------------------------------------
+#
+# Layout (all little-endian):
+#   [0:8)    magic b"TPUIRAR2"
+#   [8:16)   uint64 header length H
+#   [16:16+H) JSON header: {"align": A, "sections": [
+#                {"name", "dtype", "shape", "offset", "nbytes", "crc32"}]}
+#   data     starts at the first A-aligned offset >= 16+H; each section's
+#            "offset" is RELATIVE to that data start (so the header's own
+#            size never feeds back into its content) and itself A-aligned.
+#
+# Every section is the raw C-order bytes of one array: np.memmap-able
+# zero-copy (page alignment guarantees dtype alignment), np.frombuffer-
+# viewable from a single streamed read. Per-section CRC32s live in the
+# header for targeted diagnosis; the metadata checksum still pins the
+# whole file, and a verified load folds it into the one streamed read.
+
+ARENA_MAGIC = b"TPUIRAR2"
+ARENA_ALIGN = 4096
+ARENA_SUFFIX = ".arena"
+
+
+def _align_up(n: int, align: int = ARENA_ALIGN) -> int:
+    return -(-n // align) * align
+
+
+def _arena_header(arrays: dict[str, np.ndarray]) -> tuple[bytes, list]:
+    """(serialized header bytes, [(name, contiguous array)]) — offsets are
+    relative to the data start, so the header is computed in one pass."""
+    sections = []
+    contig = []
+    offset = 0
+    for name, a in arrays.items():
+        a = np.ascontiguousarray(a)
+        if a.dtype.hasobject:
+            raise ValueError(f"arena section {name!r}: object dtype")
+        contig.append((name, a))
+        sections.append({
+            "name": name, "dtype": a.dtype.str, "shape": list(a.shape),
+            "offset": offset, "nbytes": int(a.nbytes),
+            # CRC over a uint8 VIEW — no tobytes copy of a GB-scale
+            # section (the write below shares the same view)
+            "crc32": f"crc32:"
+                     f"{zlib.crc32(a.reshape(-1).view(np.uint8)):08x}",
+        })
+        offset = _align_up(offset + a.nbytes)
+    header = json.dumps({"align": ARENA_ALIGN, "sections": sections},
+                        sort_keys=True,
+                        separators=(",", ":")).encode("utf-8")
+    return header, contig
+
+
+def write_arena(path: str, arrays: dict[str, np.ndarray]) -> None:
+    """Write one arena file (NOT atomic, no fault sites — the raw
+    serializer shared by write_arena_atomic and the serving-cache
+    persist, whose tmp-dir rename supplies its own atomicity)."""
+    header, contig = _arena_header(arrays)
+    with open(path, "wb") as f:
+        f.write(ARENA_MAGIC)
+        f.write(struct.pack("<Q", len(header)))
+        f.write(header)
+        data_start = _align_up(16 + len(header))
+        f.write(b"\0" * (data_start - 16 - len(header)))
+        pos = 0
+        for _, a in contig:
+            f.write(memoryview(a.reshape(-1).view(np.uint8)))
+            pos += a.nbytes
+            pad = _align_up(pos) - pos
+            f.write(b"\0" * pad)
+            pos += pad
+
+
+def write_arena_atomic(path: str, **arrays) -> str:
+    """The v2 twin of savez_atomic: same temp+rename atomicity, same
+    supervised retry policy, same spill_write/artifact_truncate fault
+    sites, same returned pre-rename CRC."""
+    return _write_atomic(path, ".tmp.arena",
+                         lambda tmp: write_arena(tmp, arrays))
+
+
+def read_arena_header(path_or_buf) -> tuple[dict, int]:
+    """(header dict, absolute data start). Raises ValueError on a bad
+    magic / truncated header (a member of CORRUPT_NPZ)."""
+    if isinstance(path_or_buf, (bytes, memoryview)):
+        head = bytes(path_or_buf[:16])
+        buf = path_or_buf
+    else:
+        with open(path_or_buf, "rb") as f:
+            head = f.read(16)
+            if len(head) == 16:
+                hlen = struct.unpack("<Q", head[8:16])[0]
+                if hlen > (1 << 31):
+                    raise ValueError(
+                        f"{path_or_buf}: implausible arena header length")
+                buf = head + f.read(hlen)
+            else:
+                buf = head
+    if len(head) < 16 or head[:8] != ARENA_MAGIC:
+        raise ValueError(f"not an arena file (bad magic)")
+    hlen = struct.unpack("<Q", bytes(buf[8:16]))[0]
+    raw = bytes(buf[16 : 16 + hlen])
+    if len(raw) < hlen:
+        raise ValueError("truncated arena header")
+    header = json.loads(raw.decode("utf-8"))
+    return header, _align_up(16 + hlen, header.get("align", ARENA_ALIGN))
+
+
+def _check_section_crc(raw, sec: dict, path: str) -> None:
+    """Verify one section's bytes against its recorded CRC (ValueError on
+    mismatch — the corruption taxonomy resume/quarantine paths key on).
+    The single mismatch surface for both the in-memory and mmap readers,
+    so the error shape cannot drift between them."""
+    got = f"crc32:{zlib.crc32(raw):08x}"
+    if got != sec["crc32"]:
+        raise ValueError(
+            f"{path}: arena section {sec['name']!r} CRC mismatch "
+            f"(recorded {sec['crc32']}, found {got})")
+
+
+def _arena_views(buf, header: dict, data_start: int, path: str,
+                 verify: bool) -> dict[str, np.ndarray]:
+    """Zero-copy section views over one in-memory arena buffer, with
+    optional per-section CRC verification."""
+    out = {}
+    mv = memoryview(buf)
+    for sec in header["sections"]:
+        lo = data_start + sec["offset"]
+        hi = lo + sec["nbytes"]
+        if hi > len(mv):
+            raise ValueError(
+                f"{path}: arena section {sec['name']!r} extends past end "
+                "of file (truncated artifact)")
+        raw = mv[lo:hi]
+        if verify:
+            _check_section_crc(raw, sec, path)
+        out[sec["name"]] = np.frombuffer(
+            raw, dtype=np.dtype(sec["dtype"])).reshape(sec["shape"])
+    return out
+
+
+def load_arena(path: str, *, mmap: bool = False,
+               verify: bool | None = None) -> dict[str, np.ndarray]:
+    """Read one arena: {name: array} (arrays are read-only views).
+
+    `mmap=True` memory-maps every section zero-copy (NO streamed read, no
+    verification by default — the warm-load fast path); the default eager
+    read verifies every section CRC, matching npz's read-fully-implies-
+    intact contract that the resume/quarantine paths rely on."""
+    if verify is None:
+        verify = not mmap
+    if mmap:
+        header, data_start = read_arena_header(path)
+        out = {}
+        for sec in header["sections"]:
+            dt = np.dtype(sec["dtype"])
+            if sec["nbytes"] == 0:
+                out[sec["name"]] = np.zeros(sec["shape"], dt)
+                continue
+            m = np.memmap(path, dtype=dt, mode="r",
+                          offset=data_start + sec["offset"],
+                          shape=tuple(sec["shape"]))
+            if verify:
+                _check_section_crc(m.reshape(-1).view(np.uint8), sec, path)
+            out[sec["name"]] = m
+        return out
+    buf, _crc, _t = _read_file_verified(path)
+    header, data_start = read_arena_header(buf)
+    return _arena_views(buf, header, data_start, path, verify)
+
+
+def load_threads() -> int:
+    """Concurrent shard-load workers (TPU_IR_LOAD_THREADS; default
+    min(8, cores)). Numpy releases the GIL on large reads, so parallel
+    verified shard loads overlap disk, CRC fold and decompression."""
+    v = os.environ.get("TPU_IR_LOAD_THREADS")
+    if v:
+        return max(1, int(v))
+    return min(8, os.cpu_count() or 1)
 
 
 def integrity_names(index_dir: str, meta: "IndexMetadata") -> list[str]:
@@ -164,7 +479,11 @@ def integrity_names(index_dir: str, meta: "IndexMetadata") -> list[str]:
     store is excluded — it may legitimately be (re)built AFTER metadata
     (cmd_index --store on an existing index) and carries its own idx/bin
     consistency check."""
-    names = [part_name(s) for s in range(meta.num_shards)]
+    # both format versions' part names are listed and existence-filtered:
+    # a mid-migration dir (arena written, npz not yet removed) keeps every
+    # on-disk copy covered instead of silently dropping one
+    names = [part_name(s, fv) for s in range(meta.num_shards)
+             for fv in (FORMAT_VERSION, ARENA_FORMAT_VERSION)]
     if meta.has_positions:
         from .positions import positions_name
 
@@ -172,6 +491,37 @@ def integrity_names(index_dir: str, meta: "IndexMetadata") -> list[str]:
     names += [chargram_name(ck) for ck in meta.chargram_ks]
     names += [DOCLEN, DICTIONARY, DOCNOS, VOCAB, "tokens.txt"]
     return [n for n in names if os.path.exists(os.path.join(index_dir, n))]
+
+
+def _part_twin(index_dir: str, name: str) -> str | None:
+    """The same shard's part file under the OTHER format's extension, if
+    it exists — what a migration leaves behind for a shard it has
+    already converted (the source is unlinked, metadata stamped last)."""
+    for old, new in ((".npz", ARENA_SUFFIX), (ARENA_SUFFIX, ".npz")):
+        if name.startswith("part-") and name.endswith(old):
+            twin = os.path.join(index_dir, name[: -len(old)] + new)
+            if os.path.exists(twin):
+                return twin
+    return None
+
+
+def _self_verify_part(path: str) -> None:
+    """Verify a part file against its own internal CRCs (arena section
+    table / npz zip entries) — full read, every byte checked — raising
+    the structured IntegrityError surface on any corruption."""
+    try:
+        if path.endswith(ARENA_SUFFIX):
+            load_arena(path)  # eager read checks every section CRC
+        else:
+            with np.load(path) as z:
+                for k in z.files:
+                    z[k]  # zip inflate checks the entry CRC
+    except faults.IntegrityError:
+        raise
+    except CORRUPT_NPZ as e:
+        raise faults.IntegrityError(
+            path, f"corrupt part file ({e}); quarantine it and rebuild "
+            "the shard (or restore from a good copy)") from e
 
 
 def verify_checksums(index_dir: str, meta: "IndexMetadata",
@@ -189,6 +539,19 @@ def verify_checksums(index_dir: str, meta: "IndexMetadata",
             continue
         path = os.path.join(index_dir, name)
         if not os.path.exists(path):
+            # mid-migration dir: the shard was already rewritten in the
+            # OTHER format and its source unlinked; metadata (checksums
+            # + format stamp) is rewritten last, so the recorded name
+            # lags. The twin carries no metadata digest yet — verify it
+            # by its own internal CRCs (per-section for arenas, zip
+            # entry CRCs for npz), the same acceptance
+            # load_shard_verified applies, so `tpu-ir verify` passes on
+            # a dir that re-running the migration will complete.
+            twin = _part_twin(index_dir, name)
+            if twin is not None:
+                _self_verify_part(twin)
+                checked += 1
+                continue
             raise faults.IntegrityError(
                 path, "file recorded in metadata checksums is missing")
         got = file_checksum(path)
@@ -243,19 +606,34 @@ def quarantine(index_dir: str, name: str, *, keep: int | None = None) -> str:
 
 def save_shard(index_dir: str, shard: int, *, term_ids: np.ndarray,
                indptr: np.ndarray, pair_doc: np.ndarray,
-               pair_tf: np.ndarray, df: np.ndarray) -> None:
-    savez_atomic(
-        os.path.join(index_dir, part_name(shard)),
+               pair_tf: np.ndarray, df: np.ndarray,
+               format_version: int | None = None) -> None:
+    fv = resolve_format_version(format_version)
+    arrays = dict(
         term_ids=term_ids.astype(np.int32),
         indptr=indptr.astype(np.int64),
         pair_doc=pair_doc.astype(np.int32),
         pair_tf=pair_tf.astype(np.int32),
         df=df.astype(np.int32),
     )
+    path = os.path.join(index_dir, part_name(shard, fv))
+    if fv >= ARENA_FORMAT_VERSION:
+        write_arena_atomic(path, **arrays)
+    else:
+        savez_atomic(path, **arrays)
+    # drop the other-format twin so a rebuild over a migrated (or
+    # differently-pinned) dir can't leave a stale part both readers and
+    # the checksum recorder would keep honoring
+    for other in (FORMAT_VERSION, ARENA_FORMAT_VERSION):
+        if other != fv:
+            stale = os.path.join(index_dir, part_name(shard, other))
+            if os.path.exists(stale):
+                os.unlink(stale)
 
 
 def write_pair_shards(index_dir: str, df: np.ndarray, pair_doc: np.ndarray,
-                      pair_tf: np.ndarray, num_shards: int):
+                      pair_tf: np.ndarray, num_shards: int,
+                      format_version: int | None = None):
     """Write term-sharded part files from CSR-ordered pair columns (sorted
     by term id with per-term runs of length df). Returns (shard_of,
     offset_of) for the dictionary. Single source of truth for the shard
@@ -270,12 +648,70 @@ def write_pair_shards(index_dir: str, df: np.ndarray, pair_doc: np.ndarray,
         sel = pair_shard == s
         save_shard(index_dir, s, term_ids=tids, indptr=local_indptr,
                    pair_doc=pair_doc[sel], pair_tf=pair_tf[sel],
-                   df=df[tids])
+                   df=df[tids], format_version=format_version)
     return shard_of, offset_of
 
 
-def load_shard(index_dir: str, shard: int) -> dict[str, np.ndarray]:
-    with np.load(os.path.join(index_dir, part_name(shard))) as z:
+def load_shard(index_dir: str, shard: int, *,
+               mmap: bool = False) -> dict[str, np.ndarray]:
+    """Read one part shard, whichever format is on disk. A full (eager)
+    read verifies content CRCs in both formats (zip entry CRCs / arena
+    section CRCs), so corruption surfaces as a CORRUPT_NPZ member —
+    the invariant the resume/quarantine paths trust. `mmap=True` maps
+    arena sections zero-copy instead (no verification, no streamed
+    read); npz cannot mmap and ignores the flag."""
+    path = part_path(index_dir, shard)
+    if path.endswith(ARENA_SUFFIX):
+        return load_arena(path, mmap=mmap)
+    with np.load(path) as z:
+        return {k: z[k] for k in z.files}
+
+
+def load_shard_verified(index_dir: str, shard: int,
+                        meta: "IndexMetadata") -> dict[str, np.ndarray]:
+    """Verify-while-read shard load: ONE streamed pass over the part
+    bytes folds the whole-file CRC32 and compares it against the
+    metadata-recorded digest, then the arrays are viewed (arena) or
+    parsed (npz) from the in-memory buffer — replacing the old
+    verify-then-read double scan with the same structured IntegrityError
+    surface. Time spent folding/comparing CRCs lands in the load.verify
+    histogram; the read itself is the caller's load.read span."""
+    from ..obs import get_registry
+
+    name = part_name(shard, meta.format_version)
+    path = os.path.join(index_dir, name)
+    want = meta.checksums.get(name) if meta.checksums else None
+    if not os.path.exists(path):
+        # the metadata-named file is gone: a mid-migration dir (the
+        # shard already rewritten in the other format, metadata stamped
+        # last) or metadata that lags the files. The twin under the
+        # OTHER extension keeps the dir loadable throughout a migration
+        # — with its recorded digest when metadata has one, else its own
+        # per-section CRCs (arena) / zip entry CRCs (npz) below. Only
+        # when NO format's file exists is the part truly missing.
+        other = part_path(index_dir, shard)
+        if not os.path.exists(other):
+            raise faults.IntegrityError(
+                path, "file recorded in metadata checksums is missing"
+                if want else "part file missing")
+        path = other
+        name = os.path.basename(path)
+        want = meta.checksums.get(name) if meta.checksums else None
+    buf, crc, t_crc = _read_file_verified(path)
+    got = f"crc32:{crc:08x}"
+    get_registry().observe("load.verify", t_crc)
+    if want is not None and got != want:
+        raise faults.IntegrityError(
+            path, f"checksum mismatch (recorded {want}, found {got}); "
+            "the artifact is corrupt — quarantine it and rebuild the "
+            "index (or restore from a good copy)")
+    if path.endswith(ARENA_SUFFIX):
+        header, data_start = read_arena_header(buf)
+        # the whole-file digest matched, so section CRCs only need
+        # re-checking when metadata recorded nothing to pin the bytes
+        return _arena_views(buf, header, data_start, path,
+                            verify=want is None)
+    with np.load(io.BytesIO(buf), allow_pickle=False) as z:
         return {k: z[k] for k in z.files}
 
 

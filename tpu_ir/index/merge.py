@@ -255,7 +255,8 @@ def merge_indexes(
         num_pairs=int(len(pt)),
         chargram_ks=chargram_ks if built_chargrams else [],
         version=2 if has_positions else fmt.FORMAT_VERSION,
-        has_positions=has_positions)
+        has_positions=has_positions,
+        format_version=fmt.resolve_format_version())
     meta.save_with_checksums(out_dir)
     report.save(os.path.join(out_dir, fmt.JOBS_DIR))
     return meta

@@ -1,0 +1,77 @@
+"""In-place artifact format migration: v1 npz parts <-> v2 arenas.
+
+`tpu-ir migrate-index <dir>` rewrites every part shard of a built index
+into the target format (default: v2 page-aligned arenas, format.py) with
+the same atomic temp-file + rename discipline the builders use, then
+re-records the metadata integrity checksums and the format_version stamp
+in ONE final metadata write. Interrupted migrations leave a mixed dir
+that every reader already tolerates (part_path prefers the arena copy;
+integrity_names covers whichever files exist), and re-running the
+migration completes it — idempotent by construction.
+
+Rollback is the same operation with --to 1 (RUNBOOK: "Migration &
+rollback"): arenas re-serialize to npz and the metadata pin returns to
+format_version 1, so a fleet can be walked back without a rebuild.
+"""
+
+from __future__ import annotations
+
+import os
+
+from . import format as fmt
+
+
+def migrate_index(index_dir: str,
+                  to_version: int = fmt.ARENA_FORMAT_VERSION) -> dict:
+    """Convert every part shard of the index at `index_dir` to
+    `to_version` (1 = npz, 2 = arena), verify-while-read from the old
+    copies, re-record checksums, and stamp metadata.format_version.
+    Returns a summary dict; shards already in the target format are
+    counted as skipped (re-running a half-done migration finishes it)."""
+    if to_version not in (fmt.FORMAT_VERSION, fmt.ARENA_FORMAT_VERSION):
+        raise ValueError(f"unknown artifact format version: {to_version}")
+    meta = fmt.IndexMetadata.load(index_dir)
+    migrated = skipped = 0
+    for s in range(meta.num_shards):
+        src = fmt.part_path(index_dir, s)
+        if not os.path.exists(src):
+            raise FileNotFoundError(src)
+        if src == os.path.join(index_dir, fmt.part_name(s, to_version)):
+            # a crash between save_shard's rename and its twin-unlink can
+            # leave the source-format copy behind; drop it here (after
+            # self-verifying the kept target — never delete what might be
+            # the only good copy) so re-running truly completes the
+            # migration instead of carrying a stale twin in the checksum
+            # manifest forever
+            twin = fmt._part_twin(index_dir, os.path.basename(src))
+            if twin is not None:
+                fmt._self_verify_part(src)
+                os.remove(twin)
+            skipped += 1
+            continue
+        # verify-while-read against the RECORDED digests (when present):
+        # migration must never launder rotten bytes into freshly
+        # re-checksummed artifacts — corruption surfaces here as the
+        # same structured IntegrityError every load path raises
+        z = fmt.load_shard_verified(index_dir, s, meta)
+        # save_shard writes the target format atomically (temp+rename,
+        # supervised retries, fault sites) and unlinks the source twin
+        fmt.save_shard(index_dir, s, term_ids=z["term_ids"],
+                       indptr=z["indptr"], pair_doc=z["pair_doc"],
+                       pair_tf=z["pair_tf"], df=z["df"],
+                       format_version=to_version)
+        migrated += 1
+    # ONE final metadata write: checksums recomputed over the files now
+    # on disk (the new parts included, the unlinked sources gone) plus
+    # the format stamp readers key part names off
+    meta.format_version = to_version
+    meta.save_with_checksums(index_dir)
+    return {
+        "index_dir": index_dir,
+        "format_version": to_version,
+        "num_shards": meta.num_shards,
+        "migrated": migrated,
+        "skipped": skipped,
+        "checksums_recorded": len(meta.checksums),
+        "ok": True,
+    }

@@ -638,7 +638,11 @@ def _build_index_streaming(
     report_progress("pass3_reduce", total=num_shards)
     with report.phase("pass3_reduce"):
         for s in range(num_shards):
-            part = os.path.join(index_dir, fmt.part_name(s))
+            # whichever format the crashed run wrote (a resume may run
+            # under a different TPU_IR_FORMAT_VERSION pin than the
+            # original build — an existing part of EITHER format is
+            # this shard's final output)
+            part = fmt.part_path(index_dir, s)
             if positions:
                 # positions are written before the part, so an existing
                 # part implies its positions file too; a missing one
@@ -670,7 +674,8 @@ def _build_index_streaming(
                 try:
                     z = fmt.load_shard(index_dir, s)
                 except _CORRUPT_NPZ:
-                    qpath = fmt.quarantine(index_dir, fmt.part_name(s))
+                    qpath = fmt.quarantine(index_dir,
+                                           os.path.basename(part))
                     logger.warning(
                         "corrupt part file quarantined to %s; rebuilding "
                         "shard %d from its spills", qpath, s)
@@ -734,7 +739,8 @@ def _build_index_streaming(
         num_pairs=num_pairs_total,
         chargram_ks=chargram_ks if built_chargrams else [],
         version=2 if positions else fmt.FORMAT_VERSION,
-        has_positions=bool(positions))
+        has_positions=bool(positions),
+        format_version=fmt.resolve_format_version())
     meta.save_with_checksums(index_dir)
     report.save(os.path.join(index_dir, fmt.JOBS_DIR))
     return meta

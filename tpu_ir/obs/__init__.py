@@ -40,6 +40,7 @@ from .recorder import flight_dir, flight_dump, reset_rate_limit
 from .registry import (
     DECLARED_HISTOGRAMS,
     FAULT_SITES,
+    LOAD_STAGES,
     REQUEST_STAGES,
     SERVICE_LEVELS,
     SNAPSHOT_SCHEMA,

@@ -65,10 +65,17 @@ REQUEST_STAGES = (
 # `request.<level>` end-to-end latency histogram (shed = time-to-shed).
 SERVICE_LEVELS = ("full", "no_rerank", "hot_only", "shed")
 
+# Scorer cold/warm-load pipeline stages (ISSUE 5): checksum folding,
+# shard reads, CSR assembly, host-to-device streaming. Declared so
+# `tpu-ir metrics` and the bench's load breakdown always report the full
+# stage set, observed or not; load.h2d pairs with the load.h2d_bytes
+# counter for an effective-MB/s readout.
+LOAD_STAGES = ("load.verify", "load.read", "load.assemble", "load.h2d")
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
-DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + tuple(
+DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     f"request.{lv}" for lv in SERVICE_LEVELS)
 
 

@@ -323,12 +323,20 @@ def _build_index_multihost(
                 if name.startswith("pairs-"):
                     os.unlink(os.path.join(spill_dir, name))
             for row in my_rows:
-                for path in (os.path.join(index_dir, fmt.part_name(row)),
-                             os.path.join(index_dir, positions_name(row))):
+                # both part formats: the crashed run may have written
+                # under a different TPU_IR_FORMAT_VERSION pin
+                for path in (os.path.join(index_dir,
+                                          fmt.part_name(row, fv))
+                             for fv in (fmt.FORMAT_VERSION,
+                                        fmt.ARENA_FORMAT_VERSION)):
                     if os.path.exists(path):
                         os.unlink(path)
+                ppath = os.path.join(index_dir, positions_name(row))
+                if os.path.exists(ppath):
+                    os.unlink(ppath)
             if pi == 0:
-                stale = re.compile(r"^(?:part|positions)-(\d+)\.npz$")
+                stale = re.compile(
+                    r"^(?:part|positions)-(\d+)\.(?:npz|arena)$")
                 for name in os.listdir(index_dir):
                     m = stale.match(name)
                     if m and int(m.group(1)) >= s:
@@ -502,7 +510,8 @@ def _build_index_multihost(
     with report.phase("pass3_reduce"):
         shard_of, offset_of = fmt.shard_local_offsets(df, s)
         for row in my_rows:
-            part = os.path.join(index_dir, fmt.part_name(row))
+            # whichever format the crashed run wrote (see the wipe above)
+            part = fmt.part_path(index_dir, row)
             # resume: an existing part (plus its positions file — written
             # AFTER the part here, so the pair must be checked together)
             # is this shard's final output from the crashed run. A part
@@ -528,7 +537,7 @@ def _build_index_multihost(
                     report_progress("pass3_reduce", advance=1,
                                     resumed_shards=1)
                 except fmt.CORRUPT_NPZ:
-                    fmt.quarantine(index_dir, fmt.part_name(row))
+                    fmt.quarantine(index_dir, os.path.basename(part))
                     report.incr("Fault.QUARANTINED_PARTS", 1)
             if npairs is None:
                 _, npairs = reduce_shard_spills(
@@ -587,7 +596,8 @@ def _build_index_multihost(
             num_pairs=int(df.sum()),
             chargram_ks=list(chargram_ks) if built_chargrams else [],
             version=2 if positions else fmt.FORMAT_VERSION,
-            has_positions=bool(positions))
+            has_positions=bool(positions),
+            format_version=fmt.resolve_format_version())
         # after the pass-3 barrier every process's parts exist, so
         # process 0 can checksum the whole artifact set
         meta.save_with_checksums(index_dir)

@@ -152,11 +152,13 @@ def make_sharded_tiered(
         tuple(tier_docs), tuple(tier_tfs), dl, doc_base, dblk)
 
 
-def _sharded_cache_key(index_dir: str, meta, num_shards: int) -> dict:
+def _sharded_cache_key(index_dir: str, meta, num_shards: int,
+                       part_crcs: dict | None = None) -> dict:
     from ..search.layout import _serving_cache_key
 
     return dict(_serving_cache_key(index_dir, meta,
-                                   HOT_BUDGET, BASE_CAP, GROWTH),
+                                   HOT_BUDGET, BASE_CAP, GROWTH,
+                                   part_crcs=part_crcs),
                 kind="sharded", num_shards=num_shards)
 
 
@@ -168,12 +170,16 @@ def load_sharded_serving_cache(index_dir: str, *, meta, num_shards: int):
     f32 strip is ~2 GB of mostly zeros at 1M docs) and densified here on
     host — the same bytes-on-disk reasoning as the single-device cache's
     v2 format (search/layout.py)."""
-    from ..search.layout import read_cache_manifest
+    from ..search.layout import (_part_stat, cache_revalidate_mode,
+                                 read_cache_manifest)
 
+    cache_revalidate_mode()  # a bogus knob raises HERE, not into except
     try:
         hit = read_cache_manifest(
             index_dir, f"serving-sharded-{num_shards}",
-            _sharded_cache_key(index_dir, meta, num_shards))
+            lambda part_crcs=None: _sharded_cache_key(
+                index_dir, meta, num_shards, part_crcs=part_crcs),
+            part_stat=lambda: _part_stat(index_dir, meta))
         if hit is None:
             return None
         m, arr = hit
@@ -196,7 +202,7 @@ def save_sharded_serving_cache(index_dir: str, lay: ShardedTieredLayout,
     """Persist via the shared atomic cache protocol
     (search/layout.py::write_cache_atomic); any failure leaves the
     in-memory layout in charge."""
-    from ..search.layout import _slim, write_cache_atomic
+    from ..search.layout import _part_stat, _slim, write_cache_atomic
 
     hot = np.asarray(lay.hot_tfs)
     flat_idx = np.flatnonzero(hot.reshape(-1))
@@ -215,7 +221,10 @@ def save_sharded_serving_cache(index_dir: str, lay: ShardedTieredLayout,
         arrays[f"tier_tfs_{i}"] = t
     write_cache_atomic(
         index_dir, f"serving-sharded-{num_shards}", arrays,
-        lambda: {"key": _sharded_cache_key(index_dir, meta, num_shards),
+        lambda: {"key": _sharded_cache_key(
+                     index_dir, meta, num_shards,
+                     part_crcs=getattr(meta, "checksums", None)),
+                 "part_stat": _part_stat(index_dir, meta),
                  "num_tiers": len(lay.tier_docs),
                  "hot_shape": list(np.asarray(lay.hot_tfs).shape),
                  "dblk": lay.dblk})

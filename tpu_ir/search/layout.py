@@ -71,11 +71,15 @@ class TieredPostings(NamedTuple):
 
     def hot_device(self):
         """Densify the hot strip ON DEVICE: upload the COO columns (the
-        postings, not the strip) and scatter under jit."""
+        postings, not the strip) via the chunked double-buffered streamer
+        — when they arrive as serving-cache mmaps, disk page-ins overlap
+        the in-flight transfers — and scatter under jit."""
+        from ..utils.transfer import stream_to_device
+
         return _densify_hot(
-            jnp.asarray(np.ascontiguousarray(self.hot_rows)),
-            jnp.asarray(np.ascontiguousarray(self.hot_docs)),
-            jnp.asarray(np.ascontiguousarray(self.hot_vals)),
+            stream_to_device(self.hot_rows),
+            stream_to_device(self.hot_docs),
+            stream_to_device(self.hot_vals),
             num_hot=self.num_hot, width=self.hot_width)
 
 
@@ -196,12 +200,41 @@ def build_tiered_layout(
 #  v3: keyed by part-file CRCs — a cache HIT needs no shard read or CSR
 #  assembly at all — and df + rerank doc-norms ride in the cache;
 #  v4: key CRCs carry fmt.file_checksum's tagged string form, shared with
-#  the metadata integrity checksums)
-_CACHE_VERSION = 4
+#  the metadata integrity checksums;
+#  v5: arrays persist in ONE page-aligned arena file (cache.arena,
+#  index/format.py) instead of N .npy files — mmap-identical reads, one
+#  open; the manifest additionally records part (size, mtime_ns) stats so
+#  an UNCHANGED index revalidates without re-streaming every part's CRC)
+_CACHE_VERSION = 5
+
+
+def _part_stat(index_dir: str, meta) -> list:
+    """[name, size, mtime_ns] per part file — the cheap revalidation
+    stamp. Any write through the filesystem API (in-place rebuilds
+    included) lands a new mtime_ns, so a stat match means the files are
+    the ones the CRC key certified at cache-write time; on any mismatch
+    the reader falls back to the full CRC key compare, so a
+    mtime-restoring copy still revalidates by content. What a stat match
+    can NOT see is sub-filesystem corruption (media bit-rot that
+    preserves size and mtime_ns): that rot surfaces only when shard
+    bytes actually stream (the lazy verified pairs loader), not on the
+    zero-part-IO cache hit itself — operators who want every warm load
+    to re-prove part content set TPU_IR_CACHE_REVALIDATE=crc and pay
+    one streamed CRC pass per part (read_cache_manifest)."""
+    import os
+
+    from ..index import format as fmt
+
+    out = []
+    for s in range(meta.num_shards):
+        path = fmt.part_path(index_dir, s)
+        st = os.stat(path)
+        out.append([os.path.basename(path), st.st_size, st.st_mtime_ns])
+    return out
 
 
 def _serving_cache_key(index_dir: str, meta, hot_budget, base_cap,
-                       growth) -> dict:
+                       growth, part_crcs: dict | None = None) -> dict:
     """Content-addressed key over the part FILES (streamed CRC32, ~1 s/GB
     from page cache), so an in-place rebuild misses even when every df is
     unchanged — without paying the shard-load + CSR assembly the old
@@ -209,16 +242,18 @@ def _serving_cache_key(index_dir: str, meta, hot_budget, base_cap,
     cost the cache exists to remove). The digest is fmt.file_checksum —
     the SAME helper metadata checksums use — because Scorer.load's
     "cache hit implies parts verified" shortcut is only sound while the
-    two stay one implementation."""
+    two stay one implementation. `part_crcs` ({name: digest}) supplies
+    digests a verified load already folded, skipping the re-stream."""
     import os
 
     from ..index import format as fmt
 
     files = []
     for s in range(meta.num_shards):
-        path = os.path.join(index_dir, fmt.part_name(s))
-        files.append([fmt.part_name(s), os.path.getsize(path),
-                      fmt.file_checksum(path)])
+        path = fmt.part_path(index_dir, s)
+        name = os.path.basename(path)
+        crc = (part_crcs or {}).get(name) or fmt.file_checksum(path)
+        files.append([name, os.path.getsize(path), crc])
     return {
         "version": _CACHE_VERSION,
         "num_docs": meta.num_docs,
@@ -241,13 +276,51 @@ def serving_cache_writable(index_dir: str) -> bool:
     return os.access(index_dir, os.W_OK)
 
 
-def read_cache_manifest(index_dir: str, cache_name: str, key: dict):
+def cache_revalidate_mode() -> str:
+    """The validated TPU_IR_CACHE_REVALIDATE setting: 'stat' (default;
+    trust unchanged name+size+mtime) or 'crc' (re-stream every part and
+    content-prove each cache hit). An integrity knob must not fail open,
+    so a bogus value raises instead of silently keeping the weaker stat
+    shortcut — cache loaders call this BEFORE their unreadable-cache
+    try/except so the error escapes to the operator."""
+    import os
+
+    mode = os.environ.get("TPU_IR_CACHE_REVALIDATE", "stat")
+    mode = mode.strip().lower() or "stat"
+    if mode not in ("stat", "crc"):
+        raise ValueError(
+            f"TPU_IR_CACHE_REVALIDATE={mode!r}: expected 'stat' or 'crc'")
+    return mode
+
+
+def read_cache_manifest(index_dir: str, cache_name: str, key,
+                        part_stat=None):
     """(manifest dict, arr loader) on a key match, else None. The shared
     half of the cache protocol: both the tiered and the sharded serving
     caches (parallel/sharded_tiered.py) speak exactly this format, so
-    version/manifest changes live in one place."""
+    version/manifest changes live in one place.
+
+    `key` may be a callable (accepting an optional part_crcs dict)
+    computed ONLY when needed: the manifest's recorded part (size,
+    mtime_ns) stats are compared first (one stat per part —
+    microseconds). On a stat match the key is REBUILT from the
+    manifest's own recorded per-file digests — zero part IO — and still
+    compared, so drift in the non-file key fields (hot_budget, cache
+    version, metadata counts) misses like it always did; only on a stat
+    mismatch (or absent `part_stat`) is the streamed-CRC key computed.
+    A fresh index with no cache returns None without touching a single
+    part byte. TPU_IR_CACHE_REVALIDATE=crc disables the stat shortcut
+    for operators who want every hit content-proven (stat revalidation
+    cannot see bit-rot that preserves size+mtime, see _part_stat).
+
+    Array loader: cache v5 serves sections zero-copy out of one mmap'd
+    cache.arena. Older .npy-per-array caches never reach the loader —
+    their key (older `version` field) misses above and the cache is
+    rebuilt."""
     import json
     import os
+
+    from ..index import format as fmt
 
     cache_dir = os.path.join(index_dir, cache_name)
     manifest = os.path.join(cache_dir, "manifest.json")
@@ -255,26 +328,47 @@ def read_cache_manifest(index_dir: str, cache_name: str, key: dict):
         return None
     with open(manifest) as f:
         m = json.load(f)
-    if m["key"] != key:
+    if cache_revalidate_mode() == "crc":
+        part_stat = None
+    stat_now = part_stat() if callable(part_stat) else part_stat
+    if (callable(key) and stat_now is not None
+            and m.get("part_stat") == stat_now):
+        # unchanged files (names+sizes+mtimes): recompute the key with
+        # the manifest's own digests instead of re-streaming every part
+        recorded = {f[0]: f[2]
+                    for f in m.get("key", {}).get("part_files", [])}
+        if m["key"] != key(recorded):
+            return None
+    elif m["key"] != (key() if callable(key) else key):
         return None
 
+    # cache v5: every array is a section of ONE mmap'd arena. No .npy
+    # fallback: the key embeds _CACHE_VERSION, so any pre-arena cache
+    # misses above and is rebuilt — a matching manifest implies a v5
+    # writer, which always emits cache.arena.
+    sections = fmt.load_arena(os.path.join(cache_dir, "cache.arena"),
+                              mmap=True)
+
     def arr(name):
-        return np.load(os.path.join(cache_dir, name + ".npy"),
-                       mmap_mode="r")
+        return sections[name]
 
     return m, arr
 
 
 def write_cache_atomic(index_dir: str, cache_name: str,
                        arrays: dict, manifest: dict) -> None:
-    """Atomic cache persist (tmp dir + rename): write every array as .npy
-    plus manifest.json, then swap the directory in. Any OSError — from key
-    computation IO included if the caller defers it into `manifest` via a
-    callable — degrades to no cache, never an exception."""
+    """Atomic cache persist (tmp dir + rename): every array packed into
+    ONE page-aligned arena file (cache.arena — the same zero-copy format
+    v2 part files use, per-section CRCs included) plus manifest.json,
+    then the directory swaps in. Any OSError — from key computation IO
+    included if the caller defers it into `manifest` via a callable —
+    degrades to no cache, never an exception."""
     import json
     import os
     import shutil
     import tempfile
+
+    from ..index import format as fmt
 
     cache_dir = os.path.join(index_dir, cache_name)
     tmp = None
@@ -282,8 +376,8 @@ def write_cache_atomic(index_dir: str, cache_name: str,
         if callable(manifest):
             manifest = manifest()
         tmp = tempfile.mkdtemp(dir=index_dir, prefix=f".{cache_name}-")
-        for name, a in arrays.items():
-            np.save(os.path.join(tmp, name + ".npy"), np.asarray(a))
+        fmt.write_arena(os.path.join(tmp, "cache.arena"),
+                        {n: np.asarray(a) for n, a in arrays.items()})
         with open(os.path.join(tmp, "manifest.json"), "w") as f:
             json.dump(manifest, f)
         shutil.rmtree(cache_dir, ignore_errors=True)
@@ -302,12 +396,20 @@ def load_serving_cache(
     growth: int = GROWTH,
 ):
     """Serving-cache hit: (TieredPostings, df, doc_norms) — every array
-    memory-mapped, NO shard IO — or None on any miss/corruption."""
+    memory-mapped out of one arena, NO shard IO — or None on any
+    miss/corruption. Revalidation is stat-first: an unchanged index
+    (names + sizes + mtimes) hits without re-streaming part CRCs, so the
+    warm load is mmap + upload only; any stat drift falls back to the
+    full content-addressed CRC key (TPU_IR_CACHE_REVALIDATE=crc forces
+    that full compare on every load)."""
+    cache_revalidate_mode()  # a bogus knob raises HERE, not into except
     try:
         hit = read_cache_manifest(
             index_dir, "serving-tiered",
-            _serving_cache_key(index_dir, meta, hot_budget, base_cap,
-                               growth))
+            lambda part_crcs=None: _serving_cache_key(
+                index_dir, meta, hot_budget, base_cap, growth,
+                part_crcs=part_crcs),
+            part_stat=lambda: _part_stat(index_dir, meta))
         if hit is None:
             return None
         m, arr = hit
@@ -344,12 +446,16 @@ def save_serving_cache(
     for i, (d, t) in enumerate(zip(tiers.tier_docs, tiers.tier_tfs)):
         arrays[f"tier_docs_{i}"] = d
         arrays[f"tier_tfs_{i}"] = t
-    # key computation reads every part file; a vanished/unreadable one
-    # must degrade like any other failed write (deferred via callable)
+    # key computation reads every part file (unless the load already
+    # folded their CRCs — metadata digests are reused when recorded); a
+    # vanished/unreadable one must degrade like any other failed write
+    # (deferred via callable)
     write_cache_atomic(
         index_dir, "serving-tiered", arrays,
-        lambda: {"key": _serving_cache_key(index_dir, meta, hot_budget,
-                                           base_cap, growth),
+        lambda: {"key": _serving_cache_key(
+                     index_dir, meta, hot_budget, base_cap, growth,
+                     part_crcs=getattr(meta, "checksums", None)),
+                 "part_stat": _part_stat(index_dir, meta),
                  "num_tiers": len(tiers.tier_docs),
                  "num_hot": tiers.num_hot,
                  "hot_width": tiers.hot_width})

@@ -32,7 +32,7 @@ from ..index import format as fmt
 from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense
 from ..ops.scoring import dense_tf_matrix
 from ..utils.report import recovery_counters
-from ..utils.transfer import fetch_to_host
+from ..utils.transfer import fetch_to_host, stream_to_device
 from .layout import build_tiered_layout
 
 # dense [V, D+1] matrix budget in elements (f32); above this use sparse CSR
@@ -85,19 +85,36 @@ def compute_doc_norms(pair_term, pair_doc, pair_tf, df,
     """f32 [D+1] doc-vector norms under (1+ln tf)*idf weighting (the
     cosine rerank denominator), from the host CSR columns. Accumulated in
     bounded chunks: one float64 pass over 250M pairs would allocate
-    several multi-GB temporaries on this 1-core container."""
+    several multi-GB temporaries on this 1-core container.
+
+    `pair_term=None` derives each chunk's term ids from the CSR row
+    starts (cumsum of df) via one searchsorted — the columns are in
+    global CSR order, so the ~1 GB materialized pair_term column at 250M
+    pairs is never needed here (ISSUE 5 satellite)."""
     from ..ops import idf_weights
 
     # the same idf the rerank kernels use (single source of truth);
     # the rerank model is float idf regardless of compat mode
     idf = np.asarray(idf_weights(jnp.asarray(df), num_docs),
                      dtype=np.float32)
+    indptr = (None if pair_term is not None
+              else np.cumsum(np.asarray(df, np.int64)))
     sq = np.zeros(num_docs + 1, np.float64)
     step = 1 << 24
-    for lo in range(0, len(pair_term), step):
-        sl = slice(lo, lo + step)
+    for lo in range(0, len(pair_doc), step):
+        sl = slice(lo, min(lo + step, len(pair_doc)))
+        if pair_term is not None:
+            terms = pair_term[sl]
+        else:
+            # pair i's term is the df-run it falls in: the first row
+            # start STRICTLY greater than i (side='right' skips empty
+            # runs whose start equals i)
+            terms = np.searchsorted(indptr,
+                                    np.arange(sl.start, sl.stop,
+                                              dtype=np.int64),
+                                    side="right").astype(np.int64)
         w = (1.0 + np.log(np.maximum(pair_tf[sl], 1)
-                          .astype(np.float32))) * idf[pair_term[sl]]
+                          .astype(np.float32))) * idf[terms]
         sq += np.bincount(pair_doc[sl], weights=w * w,
                           minlength=num_docs + 1)
     return np.sqrt(sq[: num_docs + 1]).astype(np.float32)
@@ -169,7 +186,10 @@ class Scorer:
         self._wildcard = None
         self._wildcard_tried = False
         self._phrase = None  # lazy PhraseIndex (format-v2 positions)
-        self._pairs_cols = (None if pair_term is None
+        # the pair_term slot may be None: the verified load path keeps it
+        # lazy (derivable from df — at 250M pairs it is ~1 GB nobody on
+        # the tiered serving path reads); _pairs materializes on demand
+        self._pairs_cols = (None if pair_doc is None
                             else (pair_term, pair_doc, pair_tf))
         self._pairs_loader = pairs_loader
         self._norms_np = doc_norms
@@ -194,6 +214,8 @@ class Scorer:
             raise ValueError(f"layout {layout!r} needs the postings "
                              "columns or a prebuilt serving layout")
         if layout == "dense":
+            if pair_term is None:
+                pair_term = self._pair_term()  # dense scatter needs it
             self.doc_matrix = dense_doc_matrix(
                 jnp.asarray(pair_term), jnp.asarray(pair_doc),
                 jnp.asarray(pair_tf), vocab_size=v, num_docs=d)
@@ -211,6 +233,8 @@ class Scorer:
             self._mesh = make_mesh(n_dev)
             lay = sharded_layout
             if lay is None:
+                if pair_term is None:
+                    pair_term = self._pair_term()  # per-shard df bincount
                 lay = make_sharded_tiered(
                     pair_term, pair_doc, pair_tf, np.asarray(df),
                     np.asarray(doc_len), num_docs=d, num_shards=n_dev)
@@ -234,19 +258,31 @@ class Scorer:
             if tiers is None:
                 tiers = build_tiered_layout(pair_doc, pair_tf, df,
                                             num_docs=d)
-            self.hot_rank = jnp.asarray(tiers.hot_rank)
-            # the dense strip is materialized ON DEVICE from the COO hot
-            # postings — at 1M docs that uploads a few hundred MB instead
-            # of the ~2 GB dense matrix over the H2D link (the serving
-            # cold-start bottleneck; search/layout.py::hot_device)
+            # every upload streams through the double-buffered chunked
+            # path (utils/transfer.py::stream_to_device), each call its
+            # own load.h2d span: disk page-ins of mmap'd cache sections
+            # overlap the in-flight transfers instead of one monolithic
+            # blocking device_put per array
+            self.hot_rank = stream_to_device(tiers.hot_rank,
+                                             label="hot_rank")
+            # the dense strip is materialized ON DEVICE from the COO
+            # hot postings — at 1M docs that uploads a few hundred MB
+            # instead of the ~2 GB dense matrix over the H2D link
+            # (the serving cold-start bottleneck; layout.hot_device)
             self.hot_tfs = tiers.hot_device()
             # (no hot_max_tf here: the runtime-bounded prune kernels
-            # that take it are not the production path — the scheduled
-            # static skip needs only hot_rank; tests compute it locally)
-            self.tier_of = jnp.asarray(tiers.tier_of)
-            self.row_of = jnp.asarray(tiers.row_of)
-            self.tier_docs = tuple(jnp.asarray(a) for a in tiers.tier_docs)
-            self.tier_tfs = tuple(jnp.asarray(a) for a in tiers.tier_tfs)
+            # that take it are not the production path — the
+            # scheduled static skip needs only hot_rank; tests
+            # compute it locally)
+            self.tier_of = stream_to_device(tiers.tier_of,
+                                            label="tier_of")
+            self.row_of = stream_to_device(tiers.row_of, label="row_of")
+            self.tier_docs = tuple(
+                stream_to_device(a, label=f"tier_docs_{i}")
+                for i, a in enumerate(tiers.tier_docs))
+            self.tier_tfs = tuple(
+                stream_to_device(a, label=f"tier_tfs_{i}")
+                for i, a in enumerate(tiers.tier_tfs))
 
     # -- loading -----------------------------------------------------------
 
@@ -270,30 +306,33 @@ class Scorer:
         enable_compilation_cache()
         meta = fmt.IndexMetadata.load(index_dir)
         if verify_integrity:
-            # side artifacts are small — verify their recorded checksums on
-            # every load. Part shards are verified on the paths that read
-            # them (below before CSR assembly, and inside the lazy
-            # pairs_loader); a serving-cache HIT needs no up-front part
-            # check because its content-addressed key already CRC-matches
-            # every part file (layout.py::_serving_cache_key), so a
-            # corrupted part forces a miss into the verified path.
-            fmt.verify_checksums(
-                index_dir, meta, names=[fmt.DOCLEN, fmt.DOCNOS, fmt.VOCAB])
+            # side artifacts are small — verify their recorded checksums
+            # on every load. Part shards are verified BY the reads that
+            # consume them (verify-while-read inside _assemble_csr and
+            # the lazy pairs_loader — one streamed pass, not the old
+            # verify-then-read double scan). A serving-cache HIT skips
+            # part checks: any filesystem-API change to a part (rebuild,
+            # migrate, overwrite) bumps size/mtime_ns and misses into
+            # the verified path, but stat revalidation deliberately does
+            # NOT re-prove content, so stat-preserving media bit-rot
+            # rides a hit undetected until shard bytes actually stream
+            # (layout.py::_part_stat; TPU_IR_CACHE_REVALIDATE=crc forces
+            # content-proven hits).
+            with obs_trace("load.verify", files="side"):
+                fmt.verify_checksums(
+                    index_dir, meta,
+                    names=[fmt.DOCLEN, fmt.DOCNOS, fmt.VOCAB])
         vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
         mapping = DocnoMapping.load(os.path.join(index_dir, fmt.DOCNOS))
         doc_len = np.load(os.path.join(index_dir, fmt.DOCLEN))
 
         def load_pairs_verified():
             """Lazy CSR assembly for the cache fast path — parts may have
-            rotted since the cache key was computed, so verify their
-            recorded CRCs before reading them (same structured-error
-            surface as the eager path)."""
-            if verify_integrity:
-                fmt.verify_checksums(
-                    index_dir, meta,
-                    names=[fmt.part_name(s)
-                           for s in range(meta.num_shards)])
-            return cls._assemble_csr(index_dir, meta)[1]
+            rotted since the cache key was computed, so their recorded
+            CRCs are verified as the shards stream in (same structured-
+            error surface as the eager path)."""
+            return cls._assemble_csr(index_dir, meta,
+                                     verify=verify_integrity)[1]
 
         v, d = meta.vocab_size, meta.num_docs
         resolved = layout
@@ -338,20 +377,16 @@ class Scorer:
                     pairs_loader=load_pairs_verified, prune=prune,
                     deadline_s=deadline_s)
 
-        if verify_integrity:
-            # about to read every part shard: verify their recorded CRCs
-            # first so corruption surfaces as ONE structured IntegrityError
-            # naming the file, not a deep numpy/zip traceback. This is a
-            # second streamed read on top of _assemble_csr's (page-cache
-            # warm), and it is NOT redundant with zip's per-entry CRCs:
-            # those prove well-formedness, while the metadata digest pins
-            # CONTENT — a stale or swapped-in part from another build
-            # parses perfectly and would serve a silently wrong index.
-            fmt.verify_checksums(
-                index_dir, meta,
-                names=[fmt.part_name(s) for s in range(meta.num_shards)])
-        df, (pair_term, pair_doc, pair_tf) = cls._assemble_csr(
-            index_dir, meta)
+        # the eager shard read: recorded CRCs are folded into the SAME
+        # streamed pass that reads the bytes (verify-while-read), so
+        # corruption still surfaces as ONE structured IntegrityError
+        # naming the file — without the old second scan. The metadata
+        # digest pins CONTENT, not just well-formedness: a stale or
+        # swapped-in part from another build parses perfectly and would
+        # serve a silently wrong index.
+        df, (pair_doc, pair_tf) = cls._assemble_csr(
+            index_dir, meta, verify=verify_integrity)
+        pair_term = None  # derived lazily from df when something needs it
         tiers = norms = None
         sharded_layout = None
         # cache miss: build + persist here in load(), where the arrays
@@ -368,16 +403,17 @@ class Scorer:
         if resolved == "sharded":
             import jax
 
+            from ..ops.postings import pair_term_from_df
             from ..parallel.sharded_tiered import (
                 make_sharded_tiered,
                 save_sharded_serving_cache,
             )
 
-            n_dev = len(jax.devices())
+            pair_term = pair_term_from_df(df)  # per-shard df bincounts
             sharded_layout = make_sharded_tiered(
                 pair_term, pair_doc, pair_tf, np.asarray(df),
                 np.asarray(doc_len), num_docs=meta.num_docs,
-                num_shards=n_dev)
+                num_shards=len(jax.devices()))
             if save_cache:
                 norms = compute_doc_norms(pair_term, pair_doc, pair_tf,
                                           df, meta.num_docs)
@@ -386,14 +422,18 @@ class Scorer:
                 if jax.process_index() == 0:
                     save_sharded_serving_cache(index_dir, sharded_layout,
                                                df, norms, meta=meta,
-                                               num_shards=n_dev)
+                                               num_shards=len(
+                                                   jax.devices()))
         elif resolved == "sparse":
             from .layout import save_serving_cache
 
             tiers = build_tiered_layout(pair_doc, pair_tf, df,
                                         num_docs=meta.num_docs)
             if save_cache:
-                norms = compute_doc_norms(pair_term, pair_doc, pair_tf,
+                # pair_term stays lazy: the norms pass derives each
+                # chunk's term ids from the df row starts instead of
+                # materializing the ~1 GB column (ISSUE 5 satellite)
+                norms = compute_doc_norms(None, pair_doc, pair_tf,
                                           df, meta.num_docs)
                 save_serving_cache(index_dir, tiers, df, norms, meta=meta)
         return cls(
@@ -406,37 +446,64 @@ class Scorer:
             deadline_s=deadline_s)
 
     @staticmethod
-    def _assemble_csr(index_dir: str, meta):
-        """Shard files -> (df, (pair_term, pair_doc, pair_tf)) in global
-        CSR order: a shard holds its terms ascending with contiguous
-        per-term runs, so every run's destination is the global indptr
-        slice of its term — no sort needed (a stable argsort over the pair
-        columns costs ~2 min at 250M pairs on one core; this is a few
-        vectorized passes)."""
+    def _assemble_csr(index_dir: str, meta, verify: bool = False):
+        """Shard files -> (df, (pair_doc, pair_tf)) in global CSR order:
+        a shard holds its terms ascending with contiguous per-term runs,
+        so every run's destination is the global indptr slice of its
+        term — no sort needed (a stable argsort over the pair columns
+        costs ~2 min at 250M pairs on one core; this is a few vectorized
+        passes). pair_term is NOT materialized — it is derivable from df
+        alone and nothing on the assembly path reads it.
+
+        Shards load concurrently through a thread pool
+        (TPU_IR_LOAD_THREADS; numpy releases the GIL on large reads, so
+        disk, CRC fold and zip decompression overlap across shards).
+        `verify=True` folds each part's recorded CRC into its ONE
+        streamed read (fmt.load_shard_verified) — the verify-then-read
+        double scan is gone for v1 npz and v2 arenas alike; v2 arenas
+        additionally read zero-copy (np.frombuffer views / mmap)."""
+        from concurrent.futures import ThreadPoolExecutor
+
         v = meta.vocab_size
-        df = np.zeros(v, np.int32)
-        shards = []
-        for s in range(meta.num_shards):
-            z = fmt.load_shard(index_dir, s)
-            df[z["term_ids"]] = z["df"]
-            shards.append(z)
-        indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
-        total = int(indptr[-1])
-        pair_doc = np.empty(total, np.int32)
-        pair_tf = np.empty(total, np.int32)
-        for z in shards:
-            lens = np.diff(z["indptr"]).astype(np.int64)
-            n = int(lens.sum())
-            if n == 0:
-                continue
-            ends = np.cumsum(lens)
-            within = np.arange(n, dtype=np.int64) - np.repeat(ends - lens,
-                                                              lens)
-            dest = np.repeat(indptr[z["term_ids"]], lens) + within
-            pair_doc[dest] = z["pair_doc"]
-            pair_tf[dest] = z["pair_tf"]
-        pair_term = np.repeat(np.arange(v, dtype=np.int32), df)
-        return df, (pair_term, pair_doc, pair_tf)
+        n_threads = max(1, min(fmt.load_threads(), meta.num_shards))
+
+        def read_one(s: int):
+            if verify:
+                return fmt.load_shard_verified(index_dir, s, meta)
+            # unverified eager load: arenas still map zero-copy
+            return fmt.load_shard(index_dir, s, mmap=True)
+
+        with obs_trace("load.read", shards=meta.num_shards,
+                       threads=n_threads, verify=verify):
+            if n_threads > 1:
+                with ThreadPoolExecutor(
+                        max_workers=n_threads,
+                        thread_name_prefix="tpu-ir-load") as ex:
+                    shards = list(ex.map(read_one,
+                                         range(meta.num_shards)))
+            else:
+                shards = [read_one(s) for s in range(meta.num_shards)]
+
+        with obs_trace("load.assemble", shards=meta.num_shards):
+            df = np.zeros(v, np.int32)
+            for z in shards:
+                df[z["term_ids"]] = z["df"]
+            indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
+            total = int(indptr[-1])
+            pair_doc = np.empty(total, np.int32)
+            pair_tf = np.empty(total, np.int32)
+            for z in shards:
+                lens = np.diff(z["indptr"]).astype(np.int64)
+                n = int(lens.sum())
+                if n == 0:
+                    continue
+                ends = np.cumsum(lens)
+                within = np.arange(n, dtype=np.int64) - np.repeat(
+                    ends - lens, lens)
+                dest = np.repeat(indptr[z["term_ids"]], lens) + within
+                pair_doc[dest] = z["pair_doc"]
+                pair_tf[dest] = z["pair_tf"]
+        return df, (pair_doc, pair_tf)
 
     # -- query pipeline ----------------------------------------------------
 
@@ -980,7 +1047,7 @@ class Scorer:
                 "degraded fallback is assembling the postings columns "
                 "from the part shards (one-time; the serving cache does "
                 "not carry them)")
-        pt, pd, ptf = self._pairs
+        pd, ptf = self._pairs_doc_tf
         df = self._df_host().astype(np.int64)
         indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
         n = self.meta.num_docs
@@ -1202,14 +1269,13 @@ class Scorer:
                 hot_only=hot_only)
         return s, d
 
-    @property
-    def _pairs(self):
-        """Host CSR columns (pair_term, pair_doc, pair_tf) — assembled
-        lazily on the serving-cache fast path, where nothing on the query
-        path needs them (norms ride in the cache; only the dense layouts
-        and exhaustive oracles do). Double-checked under the lazy lock:
-        two concurrent degraded batches must not both pay (or interleave)
-        the shard read."""
+    def _ensure_pairs(self):
+        """The 3-slot host CSR column tuple (pair_term-or-None, pair_doc,
+        pair_tf) — assembled lazily on the serving-cache fast path, where
+        nothing on the query path needs it (norms ride in the cache; only
+        the dense layouts and exhaustive oracles do). Double-checked
+        under the lazy lock: two concurrent degraded batches must not
+        both pay (or interleave) the shard read."""
         if self._pairs_cols is None:
             with self._lazy_lock:
                 if self._pairs_cols is None:
@@ -1217,8 +1283,44 @@ class Scorer:
                         raise RuntimeError(
                             "postings columns unavailable: Scorer was "
                             "built from serving arrays only")
-                    self._pairs_cols = self._pairs_loader()
+                    cols = self._pairs_loader()
+                    if len(cols) == 2:  # (pair_doc, pair_tf): term lazy
+                        cols = (None,) + tuple(cols)
+                    self._pairs_cols = cols
         return self._pairs_cols
+
+    def _pair_term(self) -> np.ndarray:
+        """The materialized pair_term column, built on demand from df
+        (np.repeat over the CSR runs — ~1 GB at 250M pairs, which is why
+        the load path leaves it lazy; ISSUE 5 satellite). Cached back
+        into the column tuple so oracles pay it once."""
+        cols = self._ensure_pairs()
+        if cols[0] is None:
+            with self._lazy_lock:
+                cols = self._pairs_cols
+                if cols[0] is None:
+                    from ..ops.postings import pair_term_from_df
+
+                    cols = ((pair_term_from_df(self._df_host()),)
+                            + tuple(cols[1:]))
+                    self._pairs_cols = cols
+        return self._pairs_cols[0]
+
+    @property
+    def _pairs_doc_tf(self):
+        """(pair_doc, pair_tf) WITHOUT materializing pair_term — the host
+        fallback scorer walks postings by indptr slices and never reads
+        the term column."""
+        cols = self._ensure_pairs()
+        return cols[1], cols[2]
+
+    @property
+    def _pairs(self):
+        """Host CSR columns (pair_term, pair_doc, pair_tf); materializes
+        pair_term — callers that only need doc/tf use _pairs_doc_tf."""
+        pt = self._pair_term()
+        cols = self._pairs_cols
+        return pt, cols[1], cols[2]
 
     def _doc_norms_host(self) -> np.ndarray:
         """Host rerank norms; from the serving cache when present, else
@@ -1228,9 +1330,11 @@ class Scorer:
         if self._norms_np is None:
             with self._lazy_lock:
                 if self._norms_np is None:
-                    pt, pd, ptf = self._pairs
+                    pd, ptf = self._pairs_doc_tf
+                    # term ids derive from the df row starts per chunk —
+                    # no materialized pair_term column needed
                     self._norms_np = compute_doc_norms(
-                        pt, pd, ptf, np.asarray(self.df),
+                        None, pd, ptf, self._df_host(),
                         self.meta.num_docs)
         return self._norms_np
 
