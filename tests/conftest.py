@@ -53,6 +53,30 @@ def _fresh_telemetry():
 
 
 @pytest.fixture(autouse=True)
+def _ordered_locks(request, monkeypatch):
+    """TSan-lite lock-order verification for the serving/chaos tests
+    (ISSUE 6): every threading.Lock/RLock CREATED by repo code during
+    these tests is swapped for lint.OrderedLock, which records the
+    acquisition order per thread and raises LockOrderInversion the
+    moment two locks are ever taken in both orders — deterministically,
+    on every schedule, instead of needing the one unlucky interleaving
+    that deadlocks. The chaos soak therefore re-verifies the whole
+    serving stack's lock discipline on every tier-1 run. Locks created
+    by jax/stdlib internals keep their real classes (the factory checks
+    the creation site's filename)."""
+    if request.module.__name__.rsplit(".", 1)[-1] != "test_serving":
+        yield
+        return
+    from tpu_ir.lint import ordered_lock
+
+    graph = ordered_lock.install(monkeypatch, strict=True)
+    yield
+    assert not graph.inversions, (
+        "lock-order inversions recorded during test: "
+        + "; ".join(graph.inversions))
+
+
+@pytest.fixture(autouse=True)
 def _no_leaked_nondaemon_threads():
     """Fail any test that leaks a live non-daemon thread.
 

@@ -1,0 +1,766 @@
+"""Acceptance suite for the static-analysis layer (ISSUE 6).
+
+Four contracts:
+
+- each rule fires on a minimal positive fixture and stays silent on the
+  matching negative (the taint machinery's precision is pinned too —
+  static args threaded positionally must not poison helpers);
+- the runtime OrderedLock catches a seeded lock-order inversion
+  deterministically (no deadlock interleaving needed) and tolerates the
+  legal patterns (nesting in one consistent order, RLock re-entry);
+- the CLI honors the exit-code contract: 0 clean, 1 findings, 2 usage
+  error; baselines match on (rule, file, message), absorb at most
+  `count` occurrences, and --fix-baseline round-trips;
+- THE SELF-CHECK: the full suite over the shipped tpu_ir/ package with
+  the checked-in lint_baseline.json yields zero un-baselined findings —
+  the analyzers gate the codebase that ships them, so re-introducing
+  any hazard this PR fixed (a lock held across a device dispatch, an
+  undeclared counter/env read) fails tier-1 with the rule id.
+"""
+
+from __future__ import annotations
+
+import json
+import textwrap
+import threading
+from pathlib import Path
+
+import pytest
+
+import tpu_ir
+from tpu_ir.cli import main as cli_main
+from tpu_ir.lint import (
+    Baseline,
+    Finding,
+    LockOrderInversion,
+    OrderedLock,
+    PackageIndex,
+    run_lint,
+)
+from tpu_ir.lint.ordered_lock import _OrderGraph
+
+REPO = Path(tpu_ir.__file__).parent.parent
+
+
+# ---------------------------------------------------------------------------
+# fixture-package harness
+# ---------------------------------------------------------------------------
+
+
+def lint_src(tmp_path, source: str, *, name: str = "mod.py",
+             families=("jit", "concurrency", "contracts")):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / name).write_text(textwrap.dedent(source))
+    return run_lint(str(pkg), pkg_name="fixpkg", rel_root=str(tmp_path),
+                    families=families)
+
+
+def rules_of(findings) -> set:
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# TPU1xx: jit hazards
+# ---------------------------------------------------------------------------
+
+
+def test_tpu101_host_sync_in_jitted_function(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def bad(x):
+            return float(np.asarray(x).sum())
+
+        def fine(x):
+            return float(np.asarray(x).sum())   # host code: allowed
+    """)
+    hits = [f for f in fs if f.rule == "TPU101"]
+    assert hits and all("bad" in f.message for f in hits)
+
+
+def test_tpu101_item_and_wrapper_assignment_roots(tmp_path):
+    # jit roots created by `name = jax.jit(fn)` wrapper assignment are
+    # covered, and helpers they call are in the reachable closure
+    fs = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def helper(x):
+            return x.item()
+
+        def kernel(x):
+            return helper(x) + 1
+
+        kernel_jit = jax.jit(kernel)
+    """)
+    assert any(f.rule == "TPU101" and ".item()" in f.message for f in fs)
+
+
+def test_tpu101_numpy_utilities_allowed(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def ok(x):
+            return x.astype(np.dtype(np.int32))
+    """)
+    assert not [f for f in fs if f.rule == "TPU101"]
+
+
+def test_tpu102_branch_on_tracer(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def bad(x):
+            if x > 0:
+                return x
+            return -x
+    """)
+    assert any(f.rule == "TPU102" and "'x'" in f.message for f in fs)
+
+
+def test_tpu102_static_and_shape_branches_allowed(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+        from functools import partial
+
+        @partial(jax.jit, static_argnames=("flag", "k"))
+        def ok(x, mask, *, flag, k):
+            if flag:                      # static argument
+                x = x + 1
+            if x.shape[0] > k:            # shapes are static
+                x = x * 2
+            if mask is not None:          # identity test is static
+                x = x + mask
+            return x
+    """)
+    assert not [f for f in fs if f.rule == "TPU102"]
+
+
+def test_tpu102_taint_does_not_leak_through_static_positional_args(
+        tmp_path):
+    # the regression the first self-run caught: a helper receiving a
+    # STATIC value positionally (compat_int_idf / k) must not have that
+    # param treated as traced
+    fs = lint_src(tmp_path, """
+        import jax
+        from functools import partial
+
+        def weights(df, compat):
+            if compat:                    # static at every call site
+                return df * 2
+            return df * 3
+
+        @partial(jax.jit, static_argnames=("compat",))
+        def kernel(df, *, compat):
+            return weights(df, compat)
+    """)
+    assert not [f for f in fs if f.rule == "TPU102"]
+
+
+def test_tpu102_taint_flows_through_locals_and_helpers(tmp_path):
+    # idf = helper(traced) is traced; branching on it in a second helper
+    # that receives it positionally must fire
+    fs = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        def shift(w):
+            while w > 0:
+                w = w - 1
+            return w
+
+        @jax.jit
+        def kernel(df):
+            idf = jnp.log(df)
+            return shift(idf)
+    """)
+    assert any(f.rule == "TPU102" and "while" in f.message for f in fs)
+
+
+def test_tpu103_print_and_fstring_on_tracer(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def chatty(x):
+            print("score:", x)
+            label = f"got {x}"
+            return x
+    """)
+    msgs = [f.message for f in fs if f.rule == "TPU103"]
+    assert any("print" in m for m in msgs)
+    assert any("f-string" in m for m in msgs)
+
+
+def test_tpu104_missing_donation(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+        from functools import partial
+
+        @jax.jit
+        def bad(buf, chunk, off):
+            return jax.lax.dynamic_update_slice(buf, chunk, (off,))
+
+        @partial(jax.jit, donate_argnums=0)
+        def good(buf, chunk, off):
+            return jax.lax.dynamic_update_slice(buf, chunk, (off,))
+
+        @jax.jit
+        def fresh(chunk):
+            buf = jax.numpy.zeros(8)      # local buffer: nothing to donate
+            return jax.lax.dynamic_update_slice(buf, chunk, (0,))
+    """)
+    hits = [f for f in fs if f.rule == "TPU104"]
+    assert len(hits) == 1 and "bad" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# TPU2xx: concurrency
+# ---------------------------------------------------------------------------
+
+
+def test_tpu201_lock_order_cycle(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _b:
+                with _a:
+                    pass
+    """)
+    hits = [f for f in fs if f.rule == "TPU201"]
+    assert hits and "cycle" in hits[0].message
+
+
+def test_tpu201_consistent_order_is_clean(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        _a = threading.Lock()
+        _b = threading.Lock()
+
+        def one():
+            with _a:
+                with _b:
+                    pass
+
+        def two():
+            with _a:
+                with _b:
+                    pass
+    """)
+    assert not [f for f in fs if f.rule == "TPU201"]
+
+
+def test_tpu202_lock_across_device_dispatch(tmp_path):
+    # the shape of the scorer bug this PR fixed: lazy init dispatching
+    # device work under the lock — including through a helper call
+    fs = lint_src(tmp_path, """
+        import threading
+        import jax.numpy as jnp
+
+        def upload(x):
+            return jnp.asarray(x)
+
+        class Lazy:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._val = None
+
+            def get_direct(self, x):
+                with self._lock:
+                    if self._val is None:
+                        self._val = jnp.asarray(x)
+                return self._val
+
+            def get_via_helper(self, x):
+                with self._lock:
+                    if self._val is None:
+                        self._val = upload(x)
+                return self._val
+
+            def get_fixed(self, x):
+                val = jnp.asarray(x)
+                with self._lock:
+                    if self._val is None:
+                        self._val = val
+                return self._val
+    """)
+    hits = [f for f in fs if f.rule == "TPU202"]
+    assert len(hits) == 2
+    assert {("get_direct" in f.message, "get_via_helper" in f.message)
+            for f in hits} == {(True, False), (False, True)}
+
+
+def test_tpu203_lock_across_file_io(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        _lock = threading.Lock()
+
+        def save(path, data):
+            with _lock:
+                with open(path, "w") as f:
+                    f.write(data)
+
+        def fine(path, data):
+            blob = data.encode()
+            with _lock:
+                pass
+    """)
+    hits = [f for f in fs if f.rule == "TPU203"]
+    assert len(hits) == 1 and "save" in hits[0].message
+
+
+def test_tpu204_directly_nested_same_lock(tmp_path):
+    # the blatant form: `with lock:` nested straight inside `with lock:`
+    # (deadlocks on first execution) must fire without any helper call
+    fs = lint_src(tmp_path, """
+        import threading
+
+        _lock = threading.Lock()
+        _rlock = threading.RLock()
+
+        def bad():
+            with _lock:
+                with _lock:
+                    pass
+
+        def fine():
+            with _rlock:
+                with _rlock:
+                    pass
+    """)
+    hits = [f for f in fs if f.rule == "TPU204"]
+    assert len(hits) == 1 and "bad" in hits[0].message
+
+
+def test_tpu202_through_call_cycle(tmp_path):
+    # mutual recursion f<->g where f does the IO: the effect summary of
+    # g computed mid-cycle must not be memoized incomplete — a caller
+    # holding a lock across g must still see the transitive open()
+    fs = lint_src(tmp_path, """
+        import threading
+
+        _lock = threading.Lock()
+
+        def f(path, depth):
+            if depth > 0:
+                return g(path, depth - 1)
+            with open(path) as fh:
+                return fh.read()
+
+        def g(path, depth):
+            return f(path, depth)
+
+        def locked_read(path):
+            with _lock:
+                return g(path, 1)
+    """)
+    hits = [f for f in fs if f.rule == "TPU203"]
+    assert len(hits) == 1 and "locked_read" in hits[0].message
+
+
+def test_tpu204_self_deadlock_through_helper(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+
+            def bump(self):
+                with self._lock:
+                    self.n += 1
+
+            def bump_twice(self):
+                with self._lock:
+                    self.bump()       # re-acquires the non-reentrant lock
+    """)
+    hits = [f for f in fs if f.rule == "TPU204"]
+    assert len(hits) == 1 and "bump_twice" in hits[0].message
+
+
+def test_rlock_reentry_not_flagged(tmp_path):
+    fs = lint_src(tmp_path, """
+        import threading
+
+        class Box:
+            _lock = threading.RLock()
+
+            def inner(self):
+                with self._lock:
+                    return 1
+
+            def outer(self):
+                with self._lock:
+                    return self.inner()
+    """)
+    assert not [f for f in fs if f.rule in ("TPU201", "TPU204")]
+
+
+# ---------------------------------------------------------------------------
+# TPU3xx: contracts
+# ---------------------------------------------------------------------------
+
+
+def test_tpu301_raw_env_read(tmp_path):
+    fs = lint_src(tmp_path, """
+        import os
+
+        def knob():
+            return os.environ.get("TPU_IR_SHINY_NEW_KNOB", "1")
+
+        def other_env_fine():
+            return os.environ.get("JAX_PLATFORMS")
+    """, families=("contracts",))
+    hits = [f for f in fs if f.rule == "TPU301"]
+    assert len(hits) == 1 and "TPU_IR_SHINY_NEW_KNOB" in hits[0].message
+
+
+def test_tpu301_subscript_and_from_import_forms(tmp_path):
+    # the evasions the call-only check missed: subscript reads,
+    # `from os import environ/getenv`, and setdefault; a subscript
+    # STORE is a write, not a knob read
+    fs = lint_src(tmp_path, """
+        import os
+        from os import environ, getenv
+
+        def knobs():
+            a = os.environ["TPU_IR_SUB_KNOB"]
+            b = environ.get("TPU_IR_FROMIMP_KNOB")
+            c = getenv("TPU_IR_GETENV_KNOB")
+            d = os.environ.setdefault("TPU_IR_SETDEF_KNOB", "1")
+            return a, b, c, d
+
+        def writer():
+            os.environ["TPU_IR_WRITTEN"] = "1"
+    """, families=("contracts",))
+    named = {m for f in fs if f.rule == "TPU301"
+             for m in [f.message.split()[4]]}
+    assert named == {"TPU_IR_SUB_KNOB", "TPU_IR_FROMIMP_KNOB",
+                     "TPU_IR_GETENV_KNOB", "TPU_IR_SETDEF_KNOB"}
+
+
+def test_tpu302_undeclared_accessor_read(tmp_path):
+    fs = lint_src(tmp_path, """
+        from tpu_ir.utils import envvars
+
+        def knob():
+            return envvars.get_int("TPU_IR_NOT_DECLARED")
+    """, families=("contracts",))
+    assert any(f.rule == "TPU302" and "TPU_IR_NOT_DECLARED" in f.message
+               for f in fs)
+
+
+def test_tpu303_undeclared_counter(tmp_path):
+    fs = lint_src(tmp_path, """
+        from tpu_ir.obs import get_registry
+        from tpu_ir.utils.report import recovery_counters
+
+        def emit():
+            get_registry().incr("mystery.counter")
+            recovery_counters().incr("retries")          # declared: ok
+            recovery_counters().incr("typo_retries")     # not declared
+    """, families=("contracts",))
+    msgs = [f.message for f in fs if f.rule == "TPU303"]
+    assert any("mystery.counter" in m for m in msgs)
+    assert any("typo_retries" in m for m in msgs)
+    assert not any("'retries'" in m for m in msgs)
+
+
+def test_tpu304_undeclared_fault_site(tmp_path):
+    fs = lint_src(tmp_path, """
+        from tpu_ir import faults
+
+        def risky():
+            faults.maybe_crash("crash.not_a_real_site")
+            faults.maybe_crash("crash.pass1")            # declared: ok
+    """, families=("contracts",))
+    hits = [f for f in fs if f.rule == "TPU304"]
+    assert len(hits) == 1 and "crash.not_a_real_site" in hits[0].message
+
+
+def test_tpu305_undeclared_span(tmp_path):
+    fs = lint_src(tmp_path, """
+        from tpu_ir.obs import trace
+
+        def serve():
+            with trace("mystery_stage"):
+                pass
+            with trace("dispatch"):       # declared: ok
+                pass
+            with trace("build.custom"):   # declared family: ok
+                pass
+    """, families=("contracts",))
+    hits = [f for f in fs if f.rule == "TPU305"]
+    assert len(hits) == 1 and "mystery_stage" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# the runtime OrderedLock (TSan-lite)
+# ---------------------------------------------------------------------------
+
+
+def test_ordered_lock_detects_seeded_inversion_deterministically():
+    """A→B then B→A raises on the SECOND ordering, single-threaded —
+    no deadlock interleaving required."""
+    graph = _OrderGraph()
+    a = OrderedLock("A", graph=graph)
+    b = OrderedLock("B", graph=graph)
+    with a:
+        with b:
+            pass
+    with pytest.raises(LockOrderInversion) as ei:
+        with b:
+            with a:
+                pass
+        # the inner `with a` raises before blocking; release b cleanly
+    assert "'A'" in str(ei.value) and "'B'" in str(ei.value)
+    assert graph.inversions
+
+
+def test_ordered_lock_consistent_nesting_and_rlock_reentry():
+    graph = _OrderGraph()
+    a = OrderedLock("A", graph=graph)
+    b = OrderedLock("B", graph=graph)
+    r = OrderedLock("R", reentrant=True, graph=graph)
+    for _ in range(3):
+        with a:
+            with b:
+                with r:
+                    with r:       # legal re-entry
+                        pass
+    assert graph.inversions == []
+
+
+def test_ordered_lock_nonreentrant_reacquire_raises():
+    graph = _OrderGraph()
+    a = OrderedLock("A", graph=graph)
+    with pytest.raises(LockOrderInversion):
+        with a:
+            with a:
+                pass
+
+
+def test_ordered_lock_inversion_across_threads():
+    """Thread 1 records A→B; thread 2's B→A is caught even though the
+    two never actually contend."""
+    graph = _OrderGraph()
+    a = OrderedLock("A", graph=graph, strict=False)
+    b = OrderedLock("B", graph=graph, strict=False)
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+    t2()
+    assert len(graph.inversions) == 1
+
+
+def test_ordered_lock_failed_try_acquire_commits_no_edge():
+    """try-lock-and-back-off in the "wrong" order cannot deadlock (the
+    thread never blocks) — a FAILED non-blocking acquire must not
+    poison the order graph for the legitimate reverse order."""
+    graph = _OrderGraph()
+    a = OrderedLock("A", graph=graph)
+    b = OrderedLock("B", graph=graph)
+    b._inner.acquire()          # make B busy so the try-acquire fails
+    try:
+        with a:
+            assert b.acquire(blocking=False) is False
+    finally:
+        b._inner.release()
+    # the legitimate order B -> A is NOT an inversion
+    with b:
+        with a:
+            pass
+    assert graph.inversions == []
+
+
+def test_envvars_minimum_clamps_not_raises(monkeypatch):
+    """Values below a declared minimum clamp (the pre-registry sites'
+    max(1, ...) idiom) — several accessors run at module import time,
+    where a raise would kill the whole CLI before argument parsing."""
+    from tpu_ir.utils import envvars
+
+    monkeypatch.setenv("TPU_IR_TRACE_SAMPLE", "0")
+    assert envvars.get_int("TPU_IR_TRACE_SAMPLE") == 1
+    monkeypatch.setenv("TPU_IR_SPOOL_INTERVAL", "0")
+    assert envvars.get_float("TPU_IR_SPOOL_INTERVAL") == 0.1
+    # malformed values still raise, naming the variable
+    monkeypatch.setenv("TPU_IR_TRACE_SAMPLE", "banana")
+    with pytest.raises(ValueError, match="TPU_IR_TRACE_SAMPLE"):
+        envvars.get_int("TPU_IR_TRACE_SAMPLE")
+
+
+def test_install_scopes_to_repo_code(monkeypatch, tmp_path):
+    from tpu_ir.lint import ordered_lock
+
+    graph = ordered_lock.install(monkeypatch, strict=True)
+    lk = threading.Lock()          # created from repo test code: wrapped
+    assert isinstance(lk, OrderedLock)
+    # stdlib-created locks stay real: Semaphore's internals don't break
+    sem = threading.Semaphore(2)
+    assert sem.acquire(blocking=False)
+    sem.release()
+    with lk:
+        pass
+    assert graph.inversions == []
+
+
+# ---------------------------------------------------------------------------
+# baseline semantics
+# ---------------------------------------------------------------------------
+
+
+def _f(rule, file, line, message):
+    return Finding(rule, file, line, message)
+
+
+def test_baseline_matches_on_message_not_line(tmp_path):
+    f1 = _f("TPU203", "pkg/a.py", 10, "lock X held across blocking IO")
+    path = tmp_path / "bl.json"
+    path.write_text(Baseline.render([f1]))
+    bl = Baseline.load(str(path))
+    moved = _f("TPU203", "pkg/a.py", 99, "lock X held across blocking IO")
+    fresh, stale = bl.filter([moved])
+    assert fresh == [] and stale == []
+
+
+def test_baseline_count_absorbs_exactly_n(tmp_path):
+    f1 = _f("TPU203", "pkg/a.py", 10, "same message")
+    path = tmp_path / "bl.json"
+    path.write_text(Baseline.render([f1, _f("TPU203", "pkg/a.py", 20,
+                                            "same message")]))
+    bl = Baseline.load(str(path))
+    three = [_f("TPU203", "pkg/a.py", n, "same message")
+             for n in (10, 20, 30)]
+    fresh, _ = bl.filter(three)
+    assert len(fresh) == 1    # the third occurrence is NEW
+
+
+def test_baseline_reports_stale_entries(tmp_path):
+    path = tmp_path / "bl.json"
+    path.write_text(Baseline.render([_f("TPU203", "pkg/a.py", 1, "gone")]))
+    bl = Baseline.load(str(path))
+    fresh, stale = bl.filter([])
+    assert fresh == [] and len(stale) == 1
+
+
+def test_fix_baseline_preserves_reasons(tmp_path):
+    f1 = _f("TPU203", "pkg/a.py", 1, "kept")
+    path = tmp_path / "bl.json"
+    first = json.loads(Baseline.render([f1]))
+    first["findings"][0]["reason"] = "the lock exists to serialize this IO"
+    path.write_text(json.dumps(first))
+    rendered = Baseline.render([f1], Baseline.load(str(path)))
+    assert "the lock exists to serialize this IO" in rendered
+
+
+# ---------------------------------------------------------------------------
+# CLI exit codes (0 clean / 1 findings / 2 usage)
+# ---------------------------------------------------------------------------
+
+
+def test_cli_exit_0_on_shipped_package(capsys):
+    assert cli_main(["lint"]) == 0
+    assert "0 finding(s)" in capsys.readouterr().err
+
+
+def test_cli_exit_1_on_findings_and_json_shape(tmp_path, capsys):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "bad.py").write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def bad(x):
+            if x > 0:
+                return x
+            return -x
+    """))
+    assert cli_main(["lint", str(pkg), "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["findings"] and out["findings"][0]["rule"] == "TPU102"
+    assert {"rule", "severity", "file", "line", "message"} <= set(
+        out["findings"][0])
+
+
+def test_cli_exit_2_on_usage_errors(tmp_path, capsys):
+    assert cli_main(["lint", str(tmp_path / "nope")]) == 2
+    bad = tmp_path / "bl.json"
+    bad.write_text("{\"version\": 99}")
+    assert cli_main(["lint", "--baseline", str(bad)]) == 2
+
+
+def test_cli_env_table_and_locks(capsys):
+    assert cli_main(["lint", "--env-table"]) == 0
+    table = capsys.readouterr().out
+    assert "TPU_IR_CACHE_REVALIDATE" in table and "TPU_IR_TRACE_RING" in table
+    assert cli_main(["lint", "--locks"]) == 0
+    report = json.loads(capsys.readouterr().out)
+    assert "tpu_ir.search.scorer.Scorer._lazy_lock" in report["locks"]
+    assert isinstance(report["order_edges"], list)
+
+
+# ---------------------------------------------------------------------------
+# THE self-check: the analyzers gate the codebase that ships them
+# ---------------------------------------------------------------------------
+
+
+def test_shipped_package_is_lint_clean_under_checked_in_baseline():
+    """Zero un-baselined findings over tpu_ir/ — removing any fix this
+    PR shipped (scorer lock-across-dispatch, envvar centralization,
+    counter declarations, RUNBOOK table) makes this fail with the
+    corresponding rule id. Tier-1's `tpu-ir lint` gate."""
+    findings = run_lint(str(REPO / "tpu_ir"), rel_root=str(REPO))
+    baseline_path = REPO / "lint_baseline.json"
+    baseline = (Baseline.load(str(baseline_path))
+                if baseline_path.exists() else Baseline())
+    fresh, _stale = baseline.filter(findings)
+    assert not fresh, "un-baselined lint findings:\n" + "\n".join(
+        str(f) for f in fresh)
+
+
+def test_self_check_sees_the_package():
+    """The gate is only meaningful if the index actually sees the
+    package: jit roots, the lock inventory, and fault sites must all be
+    non-trivial (a silently-empty scan must fail loudly here)."""
+    from tpu_ir.lint import contracts
+
+    index = PackageIndex(str(REPO / "tpu_ir"), rel_root=str(REPO))
+    roots = [f for m in index.modules.values()
+             for f in m.functions.values() if f.jit_root]
+    assert len(roots) >= 10, "jit-root detection rotted"
+    assert len(index.all_locks()) >= 10, "lock inventory rotted"
+    assert len(contracts.collect_fault_sites(index)) >= 5, \
+        "fault-site scan rotted"
+    assert contracts.collect_service_levels(index) == {
+        "full", "no_rerank", "hot_only", "shed"}

@@ -19,7 +19,6 @@ Pins the four contracts of tpu_ir.obs:
 import json
 import math
 import random
-import re
 import threading
 import time
 from pathlib import Path
@@ -344,37 +343,50 @@ def test_prometheus_exposition_shape():
 # coverage by construction (the static-analysis-style tests)
 # ---------------------------------------------------------------------------
 
-_SITE_RE = re.compile(
-    r"""(?:should_fire|maybe_crash|maybe_hang)\(\s*["']([A-Za-z0-9_.@-]+)["']""")
+# PR 3's regex-based source scans for fault-site and service-level
+# coverage now live in tpu_ir/lint/contracts.py (ISSUE 6) as AST-precise
+# contract passes shared with `tpu-ir lint`; these tests are thin
+# wrappers pinning (a) the passes still SEE the package (a rotted scan
+# reports nothing, which must fail here, not pass silently) and (b) the
+# runtime registry honors what the passes verified statically.
 
 
-def test_every_injection_site_in_source_is_declared_and_registered():
-    """Scan the package source for fault-injection call sites; every
-    site name must be in obs.FAULT_SITES AND have a pre-registered
-    fault.<site> counter — a failure path cannot exist untelemetered."""
+@pytest.fixture(scope="module")
+def _lint_index():
+    from tpu_ir.lint import PackageIndex
+
     pkg = Path(tpu_ir.__file__).parent
-    found = set()
-    for py in pkg.rglob("*.py"):
-        if py.name == "faults.py" or "obs" in py.parts:
-            continue   # definitions / the telemetry layer itself
-        found |= set(_SITE_RE.findall(py.read_text()))
-    assert found, "no injection sites found — the scan regex rotted"
-    declared = set(obs.FAULT_SITES)
-    assert found <= declared, \
-        f"injection sites missing a declared counter: {found - declared}"
+    return PackageIndex(str(pkg), rel_root=str(pkg.parent))
+
+
+def test_every_injection_site_in_source_is_declared_and_registered(
+        _lint_index):
+    """Every fault-injection call site found in the source must be in
+    obs.FAULT_SITES AND have a pre-registered fault.<site> counter — a
+    failure path cannot exist untelemetered. (Logic: lint TPU304.)"""
+    from tpu_ir.lint import contracts
+
+    found = contracts.collect_fault_sites(_lint_index)
+    assert found, "no injection sites found — the lint scan rotted"
+    violations = [f for f in contracts.check(_lint_index)
+                  if f.rule == "TPU304"]
+    assert not violations, violations
     names = set(obs.get_registry().counter_names())
-    for site in declared:
+    for site in obs.FAULT_SITES:
         assert f"fault.{site}" in names
 
 
-def test_every_service_level_has_a_request_histogram():
+def test_every_service_level_has_a_request_histogram(_lint_index):
     """Every LEVEL_* the frontend's ladder can emit must appear in the
-    declared histogram label set (request.<level>) and be registered."""
-    from tpu_ir.serving import frontend as fe_mod
+    declared histogram label set (request.<level>) and be registered.
+    (Logic: lint TPU305's service-level drift check.)"""
+    from tpu_ir.lint import contracts
 
-    levels = {v for k, v in vars(fe_mod).items()
-              if k.startswith("LEVEL_") and isinstance(v, str)}
+    levels = contracts.collect_service_levels(_lint_index)
     assert levels == set(obs.SERVICE_LEVELS)
+    violations = [f for f in contracts.check(_lint_index)
+                  if f.rule == "TPU305"]
+    assert not violations, violations
     registered = set(obs.get_registry().histogram_names())
     for lv in levels:
         assert f"request.{lv}" in obs.DECLARED_HISTOGRAMS

@@ -348,6 +348,92 @@ def _read_trec_topics(path: str) -> tuple[list[str], list[str]]:
     return qids, queries
 
 
+def cmd_lint(args) -> int:
+    """Static analysis over the package source (ISSUE 6): jit-hazard,
+    concurrency, and contract passes — pure AST, no JAX import, fast
+    enough for a pre-commit hook. Exit 0 clean / 1 findings / 2 usage
+    error (the CI contract tests/test_lint.py pins)."""
+    from .lint import Baseline, run_lint
+    from .lint.concurrency import build_lock_report
+    from .lint.core import RULES
+
+    pkg_root = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.abspath(args.path) if args.path else pkg_root
+    if not os.path.isdir(root):
+        print(f"error: not a directory: {root}", file=sys.stderr)
+        return 2
+    rel_root = os.path.dirname(root)
+    # the package's import name scopes the whole-package-only contracts
+    # (declared-but-never-emitted, RUNBOOK table): linting an external
+    # fixture dir must not compare IT against tpu_ir's declarations
+    pkg_name = os.path.basename(root)
+
+    if args.env_table:
+        from .utils import envvars
+
+        print(envvars.markdown_table())
+        return 0
+    if args.locks:
+        from .lint import PackageIndex
+
+        print(json.dumps(build_lock_report(
+            PackageIndex(root, pkg_name=pkg_name, rel_root=rel_root)), indent=2))
+        return 0
+
+    findings = run_lint(root, pkg_name=pkg_name, rel_root=rel_root)
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        candidate = os.path.join(rel_root, "lint_baseline.json")
+        if os.path.exists(candidate):
+            baseline_path = candidate
+    baseline = Baseline()
+    if baseline_path is not None:
+        try:
+            baseline = Baseline.load(baseline_path)
+        except FileNotFoundError:
+            print(f"error: baseline not found: {baseline_path}",
+                  file=sys.stderr)
+            return 2
+        except (ValueError, KeyError) as e:
+            print(f"error: unreadable baseline {baseline_path}: {e}",
+                  file=sys.stderr)
+            return 2
+
+    if args.fix_baseline:
+        out_path = baseline_path or os.path.join(rel_root,
+                                                 "lint_baseline.json")
+        with open(out_path, "w", encoding="utf-8") as f:
+            f.write(Baseline.render(findings, baseline))
+        print(f"wrote {out_path} ({len(findings)} finding(s) "
+              "grandfathered — review the reasons before merging)",
+              file=sys.stderr)
+        return 0
+
+    fresh, stale = baseline.filter(findings)
+    if args.json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in fresh],
+            "baselined": len(findings) - len(fresh),
+            "stale_baseline": stale,
+            "rules": {r: {"severity": sev, "doc": doc}
+                      for r, (sev, doc) in RULES.items()} if args.rules
+            else None,
+        }, indent=2))
+    else:
+        for f in fresh:
+            print(f)
+        for e in stale:
+            print(f"note: stale baseline entry (finding no longer "
+                  f"occurs): {e['rule']} {e['file']}: {e['message']}",
+                  file=sys.stderr)
+        summary = (f"{len(fresh)} finding(s), "
+                   f"{len(findings) - len(fresh)} baselined, "
+                   f"{len(stale)} stale baseline entr(y/ies)")
+        print(("FAIL: " if fresh else "ok: ") + summary, file=sys.stderr)
+    return 1 if fresh else 0
+
+
 def cmd_inspect(args) -> int:
     # artifact reading only — no jax backend needed
     from .collection import Vocab
@@ -595,7 +681,9 @@ def cmd_serve_bench(args) -> int:
     from . import faults
 
     if faults.active() is not None:
-        spec = args.faults or os.environ.get("TPU_IR_FAULTS") or spec
+        from .utils import envvars
+
+        spec = args.faults or envvars.get_str("TPU_IR_FAULTS") or spec
         faults.install(None)
     with _MaybeTrack(args.metrics_port) as track:
         report = run_soak(
@@ -1019,6 +1107,31 @@ def main(argv: list[str] | None = None) -> int:
     pe.add_argument("--chargram-k", type=int, default=3)
     pe.add_argument("-n", type=int, default=50)
     pe.set_defaults(fn=cmd_expand)
+
+    pl = sub.add_parser(
+        "lint", help="static analysis: jit hazards, lock discipline, "
+        "telemetry/env contracts (pure AST, no JAX; RUNBOOK §13)")
+    pl.add_argument("path", nargs="?", default=None,
+                    help="package dir to analyze (default: the installed "
+                         "tpu_ir package)")
+    pl.add_argument("--json", action="store_true",
+                    help="structured findings on stdout")
+    pl.add_argument("--baseline", metavar="FILE", default=None,
+                    help="grandfathered-findings file (default: "
+                         "lint_baseline.json next to the package, if "
+                         "present)")
+    pl.add_argument("--fix-baseline", action="store_true",
+                    help="rewrite the baseline from current findings "
+                         "(an explicit, reviewable accept — new entries "
+                         "get a TODO reason)")
+    pl.add_argument("--rules", action="store_true",
+                    help="include the rule catalog in --json output")
+    pl.add_argument("--locks", action="store_true",
+                    help="dump the whole-program lock inventory and "
+                         "acquisition-order graph as JSON")
+    pl.add_argument("--env-table", action="store_true",
+                    help="print the generated RUNBOOK env-var table")
+    pl.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)
     from .faults import BuildError, IntegrityError

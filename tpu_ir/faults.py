@@ -43,7 +43,6 @@ Example: `TPU_IR_FAULTS="spill_write@pairs-:first@2,crash.pass2:once@3"`.
 from __future__ import annotations
 
 import logging
-import os
 import random
 import threading
 import time
@@ -83,6 +82,13 @@ class IntegrityError(AssertionError):
         self.path = path
         self.detail = detail
         super().__init__(f"artifact integrity failure: {path}: {detail}")
+        # one canonical ledger site: every integrity failure — whichever
+        # loader or verifier detects it — is observable in `tpu-ir stats`
+        # (the counter was documented since PR 1 but never incremented;
+        # the lint contract pass now pins emitted == declared)
+        from .utils.report import recovery_counters
+
+        recovery_counters().incr("integrity_failures")
 
 
 class DeviceLoss(RuntimeError):
@@ -257,7 +263,9 @@ def active() -> FaultPlan | None:
     global _PLAN, _ENV_CHECKED
     if not _ENV_CHECKED:
         _ENV_CHECKED = True
-        spec = os.environ.get("TPU_IR_FAULTS")
+        from .utils import envvars
+
+        spec = envvars.get_str("TPU_IR_FAULTS")
         if spec:
             _PLAN = parse_plan(spec)
     return _PLAN

@@ -72,8 +72,9 @@ def resolve_format_version(format_version: int | None = None) -> int:
     covers every write path at once."""
     if format_version is not None:
         return int(format_version)
-    return int(os.environ.get("TPU_IR_FORMAT_VERSION",
-                              DEFAULT_FORMAT_VERSION))
+    from ..utils import envvars
+
+    return envvars.get_int("TPU_IR_FORMAT_VERSION", DEFAULT_FORMAT_VERSION)
 
 
 def part_name(shard: int, format_version: int | None = None) -> str:
@@ -466,9 +467,11 @@ def load_threads() -> int:
     """Concurrent shard-load workers (TPU_IR_LOAD_THREADS; default
     min(8, cores)). Numpy releases the GIL on large reads, so parallel
     verified shard loads overlap disk, CRC fold and decompression."""
-    v = os.environ.get("TPU_IR_LOAD_THREADS")
-    if v:
-        return max(1, int(v))
+    from ..utils import envvars
+
+    v = envvars.get_int("TPU_IR_LOAD_THREADS")
+    if v is not None:
+        return v
     return min(8, os.cpu_count() or 1)
 
 
@@ -582,8 +585,9 @@ def quarantine(index_dir: str, name: str, *, keep: int | None = None) -> str:
     from ..utils.report import recovery_counters
 
     if keep is None:
-        keep = int(os.environ.get("TPU_IR_QUARANTINE_KEEP",
-                                  QUARANTINE_KEEP))
+        from ..utils import envvars
+
+        keep = envvars.get_int("TPU_IR_QUARANTINE_KEEP", QUARANTINE_KEEP)
     qdir = os.path.join(index_dir, QUARANTINE_DIR)
     os.makedirs(qdir, exist_ok=True)
     dest = os.path.join(qdir, name)

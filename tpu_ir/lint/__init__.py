@@ -1,0 +1,27 @@
+"""tpu_ir.lint — TPU-hazard, concurrency, and contract static analysis.
+
+The analyzer suite behind `tpu-ir lint` (ISSUE 6): pure-AST passes over
+the package source — no JAX import, milliseconds per run — organized in
+three families (core.RULES is the catalog, DESIGN §10 the prose):
+
+- jit_hazards:  TPU101-104 — what must never happen inside a trace
+- concurrency:  TPU201-204 — the whole-program lock inventory, order
+                graph, and held-across-dispatch/IO hazards; plus the
+                runtime OrderedLock verifier (ordered_lock.py)
+- contracts:    TPU301-305 — emitted names == declared names (env vars,
+                counters, histograms, fault sites, RUNBOOK)
+
+Findings are structured (rule, file, line, message); reviewed ones are
+grandfathered in lint_baseline.json with reasons. The self-check test
+(tests/test_lint.py) runs the suite over tpu_ir/ itself in tier-1, so
+the analyzers gate the codebase that ships them.
+"""
+
+from .astindex import PackageIndex
+from .core import RULES, Baseline, Finding, run_lint
+from .ordered_lock import GRAPH, LockOrderInversion, OrderedLock, install
+
+__all__ = [
+    "PackageIndex", "RULES", "Baseline", "Finding", "run_lint",
+    "GRAPH", "LockOrderInversion", "OrderedLock", "install",
+]

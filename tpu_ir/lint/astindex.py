@@ -1,0 +1,750 @@
+"""The shared whole-package AST index every lint pass reads.
+
+One parse of every module under the target root yields:
+
+- a **function index** (top-level functions, methods, nested defs) with
+  per-function parameter lists, resolved decorators, and raw call sites;
+- an **import map** per module (aliases and from-imports, relative
+  imports resolved against the package root), so a call node can be
+  resolved either to a `FuncInfo` inside the package or to a normalized
+  dotted name (`jax.numpy.asarray`, `os.replace`) for hazard matching;
+- **jit roots**: functions entering a `jax.jit` / `pjit` / `shard_map`
+  trace — via decorator, `partial(jax.jit, ...)` decorator, module-level
+  `name = jax.jit(fn, ...)` wrapper assignments, or being passed as the
+  first argument to a jit/shard_map call — with their declared
+  `static_argnames` and donation flags; plus the transitive
+  **jit-reachable** closure over package-internal calls (the set of
+  functions whose bodies execute under tracing);
+- a **lock inventory**: every `threading.Lock()`/`RLock()` creation site
+  (module-level, class-level, or `self.X = ...` in a method) and every
+  `with <lock>:` acquisition site, identified by stable dotted ids
+  (`module.Class.attr`);
+- per-function **effect summaries** (does this function, transitively
+  through package calls, dispatch device work / perform blocking IO /
+  acquire locks), memoized for the concurrency pass.
+
+Everything is plain `ast` — no imports of the analyzed code, no JAX, so
+`tpu-ir lint` stays a fast pure-CPU command usable as a pre-commit gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+
+# call names that enter a trace: the wrapped callable's body runs traced
+JIT_WRAPPERS = frozenset({
+    "jax.jit", "jax.pjit", "jax.experimental.pjit.pjit",
+    "jax.shard_map", "jax.experimental.shard_map.shard_map",
+})
+# package-local wrapper names that behave like jit wrappers when resolved
+# by from-import (the mesh compat shim re-exports shard_map)
+JIT_WRAPPER_NAMES = frozenset({"jit", "pjit", "shard_map"})
+
+# attribute accesses that are static under tracing (never force a sync)
+STATIC_ATTRS = frozenset({
+    "shape", "ndim", "dtype", "size", "itemsize", "nbytes", "sharding"})
+
+# method calls that force a device sync / host round-trip
+HOST_SYNC_METHODS = frozenset({
+    "item", "tolist", "block_until_ready", "copy_to_host_async",
+    "__array__",
+})
+
+# numpy utility calls that are safe inside a traced body (no array data)
+NUMPY_SAFE = frozenset({
+    "dtype", "iinfo", "finfo", "ndim", "result_type", "promote_types",
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bool_", "intp",
+})
+
+# blocking-IO calls a lock must not be held across (curated, not "all of
+# os" — os.path.* and friends are pure)
+IO_CALLS = frozenset({
+    "open",
+    "os.replace", "os.rename", "os.remove", "os.unlink", "os.makedirs",
+    "os.mkdir", "os.rmdir", "os.listdir", "os.scandir", "os.utime",
+    "os.stat", "os.fsync", "os.truncate",
+    "shutil.rmtree", "shutil.copy", "shutil.copyfile", "shutil.move",
+    "numpy.load", "numpy.save", "numpy.savez", "numpy.savez_compressed",
+    "numpy.memmap", "numpy.fromfile",
+    "json.dump", "json.load",
+    "time.sleep",
+    "tempfile.mkstemp", "tempfile.mkdtemp", "tempfile.NamedTemporaryFile",
+})
+
+LOCK_CTORS = frozenset({"threading.Lock", "threading.RLock"})
+
+
+def refs_any(node: ast.AST, names: frozenset) -> str | None:
+    """The first name in `names` that `node` references AS A VALUE, or
+    None. Subtrees under static attribute access (x.shape, x.dtype, ...),
+    `x is (not) None` comparisons, and static builtins (len/isinstance/
+    getattr/hasattr/type) are exempt — those are trace-time constants."""
+    if isinstance(node, ast.Attribute) and node.attr in STATIC_ATTRS:
+        return None
+    if isinstance(node, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+        return None
+    if isinstance(node, ast.Call):
+        fname = node.func.id if isinstance(node.func, ast.Name) else None
+        if fname in ("len", "isinstance", "getattr", "hasattr", "type"):
+            return None
+    if isinstance(node, ast.Name) and node.id in names:
+        return node.id
+    for child in ast.iter_child_nodes(node):
+        hit = refs_any(child, names)
+        if hit:
+            return hit
+    return None
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """The dotted-name string of a Name/Attribute chain, or None."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class LockDef:
+    lock_id: str          # "module.Class.attr" or "module.attr"
+    kind: str             # "Lock" | "RLock"
+    path: str
+    line: int
+
+
+@dataclass
+class LockAcq:
+    lock_id: str
+    func: "FuncInfo"
+    node: ast.With
+    path: str
+    line: int
+
+
+@dataclass
+class FuncInfo:
+    module: str
+    qual: str
+    name: str
+    cls: str | None
+    node: ast.AST
+    path: str
+    params: list[str] = field(default_factory=list)    # positional
+    kwonly: list[str] = field(default_factory=list)
+    jit_root: bool = False
+    jit_reachable: bool = False
+    jit_via: str = ""
+    static_params: frozenset = frozenset()
+    donates: bool = False
+    parent: "FuncInfo | None" = None
+    children: list = field(default_factory=list)
+    # params observed to receive traced values (filled by propagation:
+    # per-call-site taint of arguments, unioned across call sites)
+    traced_params: set = field(default_factory=set)
+    # memoized transitive effect summaries (None = not computed yet)
+    _effects: dict | None = None
+
+    @property
+    def ref(self) -> str:
+        return f"{self.module}.{self.qual}"
+
+    def tracer_params(self) -> frozenset:
+        """Parameter names holding tracers when this function runs under
+        jit. For roots: everything not declared static (the jit
+        boundary). For functions reached through calls: exactly the
+        params some call site passed a traced value into — a static
+        `num_docs` threaded positionally stays static."""
+        if self.jit_root:
+            return frozenset(p for p in (*self.params, *self.kwonly)
+                             if p not in self.static_params
+                             and p not in ("self", "cls"))
+        return frozenset(self.traced_params)
+
+
+class ModuleInfo:
+    def __init__(self, modname: str, path: str, tree: ast.Module):
+        self.modname = modname
+        self.path = path
+        self.tree = tree
+        self.import_alias: dict[str, str] = {}       # alias -> dotted module
+        self.from_imports: dict[str, tuple] = {}     # name -> (module, orig)
+        self.functions: dict[str, FuncInfo] = {}     # qual -> info
+        self.classes: dict[str, dict] = {}           # cls -> {meth: info}
+        self.lock_defs: dict[str, LockDef] = {}
+        self.lock_acqs: list[LockAcq] = []
+
+
+class PackageIndex:
+    """Parse every *.py under `root` (package dir) and build the index.
+
+    `root` is the directory of the package being analyzed; `pkg_name` its
+    dotted import name (used to resolve relative imports)."""
+
+    def __init__(self, root: str, pkg_name: str = "tpu_ir",
+                 rel_root: str | None = None):
+        self.root = os.path.abspath(root)
+        self.pkg_name = pkg_name
+        # paths in findings are reported relative to rel_root (repo root)
+        self.rel_root = os.path.abspath(rel_root or os.path.dirname(self.root))
+        self.modules: dict[str, ModuleInfo] = {}
+        self.errors: list[tuple] = []   # (path, message) syntax failures
+        self._scan()
+        self._mark_jit_roots()
+        self._propagate_jit()
+
+    # -- scanning ----------------------------------------------------------
+
+    def relpath(self, path: str) -> str:
+        return os.path.relpath(path, self.rel_root)
+
+    def _modname(self, path: str) -> str:
+        rel = os.path.relpath(path, self.root)
+        parts = rel[:-3].split(os.sep)
+        if parts[-1] == "__init__":
+            parts = parts[:-1]
+        return ".".join([self.pkg_name, *parts]) if parts else self.pkg_name
+
+    def _scan(self) -> None:
+        for dirpath, dirnames, filenames in os.walk(self.root):
+            dirnames[:] = sorted(d for d in dirnames
+                                 if d != "__pycache__")
+            for fn in sorted(filenames):
+                if not fn.endswith(".py"):
+                    continue
+                path = os.path.join(dirpath, fn)
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        tree = ast.parse(f.read(), filename=path)
+                except (SyntaxError, ValueError, OSError) as e:
+                    self.errors.append((path, str(e)))
+                    continue
+                mod = ModuleInfo(self._modname(path), path, tree)
+                self.modules[mod.modname] = mod
+                self._index_module(mod)
+
+    def _resolve_relative(self, mod: ModuleInfo, node: ast.ImportFrom) -> str:
+        if node.level == 0:
+            return node.module or ""
+        base = mod.modname.split(".")
+        # within a package __init__, level 1 is the package itself
+        if not mod.path.endswith("__init__.py"):
+            base = base[:-1]
+        base = base[: len(base) - (node.level - 1)]
+        return ".".join([*base, node.module] if node.module else base)
+
+    def _index_module(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mod.import_alias[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom):
+                src = self._resolve_relative(mod, node)
+                for a in node.names:
+                    if a.name != "*":
+                        mod.from_imports[a.asname or a.name] = (src, a.name)
+
+        def add_func(node, cls, parent, prefix):
+            qual = f"{prefix}{node.name}"
+            fi = FuncInfo(
+                mod.modname, qual, node.name, cls, node, mod.path,
+                params=[a.arg for a in (*node.args.posonlyargs,
+                                        *node.args.args)],
+                kwonly=[a.arg for a in node.args.kwonlyargs],
+                parent=parent)
+            mod.functions[qual] = fi
+            if cls is not None and parent is None:
+                mod.classes.setdefault(cls, {})[node.name] = fi
+            if parent is not None:
+                parent.children.append(fi)
+            for child in ast.iter_child_nodes(node):
+                walk_body(child, cls, fi, f"{qual}.<locals>.")
+            return fi
+
+        def walk_body(node, cls, parent, prefix):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                add_func(node, cls, parent, prefix)
+            elif isinstance(node, ast.ClassDef) and parent is None:
+                for child in ast.iter_child_nodes(node):
+                    walk_body(child, node.name, None, f"{node.name}.")
+            else:
+                for child in ast.iter_child_nodes(node):
+                    walk_body(child, cls, parent, prefix)
+
+        for top in mod.tree.body:
+            walk_body(top, None, None, "")
+
+        self._index_locks(mod)
+
+    # -- locks -------------------------------------------------------------
+
+    def _lock_kind(self, mod: ModuleInfo, value: ast.AST) -> str | None:
+        if not isinstance(value, ast.Call):
+            return None
+        name = self.normalize(mod, value.func)
+        if isinstance(name, str) and name in LOCK_CTORS:
+            return name.rsplit(".", 1)[1]
+        return None
+
+    def _index_locks(self, mod: ModuleInfo) -> None:
+        # creation sites
+        def record(target, kind, line, cls=None):
+            if isinstance(target, ast.Name):
+                base = (f"{mod.modname}.{cls}.{target.id}" if cls
+                        else f"{mod.modname}.{target.id}")
+            elif (isinstance(target, ast.Attribute)
+                  and isinstance(target.value, ast.Name)
+                  and target.value.id == "self" and cls):
+                base = f"{mod.modname}.{cls}.{target.attr}"
+            else:
+                return
+            mod.lock_defs.setdefault(
+                base, LockDef(base, kind, mod.path, line))
+
+        def scan(node, cls):
+            if isinstance(node, ast.ClassDef):
+                for child in node.body:
+                    scan(child, node.name)
+                return
+            if isinstance(node, ast.Assign):
+                kind = self._lock_kind(mod, node.value)
+                if kind:
+                    for t in node.targets:
+                        record(t, kind, node.lineno, cls)
+            for child in ast.iter_child_nodes(node):
+                if not isinstance(child, ast.ClassDef):
+                    scan(child, cls)
+
+        for top in mod.tree.body:
+            scan(top, None)
+
+        # acquisition sites: `with <lock-expr>:` inside any function
+        for fi in mod.functions.values():
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.With):
+                    continue
+                for item in node.items:
+                    lock_id = self._lock_id_of(mod, fi, item.context_expr)
+                    if lock_id:
+                        mod.lock_acqs.append(LockAcq(
+                            lock_id, fi, node, mod.path, node.lineno))
+
+    def _lock_id_of(self, mod: ModuleInfo, fi: FuncInfo,
+                    expr: ast.AST) -> str | None:
+        """The stable lock id a with-item acquires, or None when the
+        context manager is not a recognizable lock."""
+        if isinstance(expr, ast.Name):
+            lid = f"{mod.modname}.{expr.id}"
+            if lid in mod.lock_defs:
+                return lid
+            return None
+        if (isinstance(expr, ast.Attribute)
+                and isinstance(expr.value, ast.Name)
+                and expr.value.id in ("self", "cls") and fi.cls):
+            lid = f"{mod.modname}.{fi.cls}.{expr.attr}"
+            if lid in mod.lock_defs:
+                return lid
+            # inherited lock attribute: identify by name heuristic so a
+            # subclass acquiring a base-class lock is still inventoried
+            if "lock" in expr.attr.lower():
+                mod.lock_defs.setdefault(lid, LockDef(
+                    lid, "Lock", mod.path, expr.lineno))
+                return lid
+        return None
+
+    def all_locks(self) -> dict[str, LockDef]:
+        out: dict[str, LockDef] = {}
+        for mod in self.modules.values():
+            out.update(mod.lock_defs)
+        return out
+
+    def all_acquisitions(self) -> list[LockAcq]:
+        return [a for mod in self.modules.values() for a in mod.lock_acqs]
+
+    # -- name resolution ---------------------------------------------------
+
+    def normalize(self, mod: ModuleInfo, func: ast.AST) -> object:
+        """Resolve a call's func expression to either a FuncInfo (package
+        function/method), a normalized dotted string ("jax.numpy.asarray",
+        "os.replace", bare "open"), a method marker ("*.item" — method
+        call on an unresolvable receiver), or None (unresolvable)."""
+        if isinstance(func, ast.Name):
+            hit = self._resolve_name(mod, None, func.id)
+            return hit if hit is not None else func.id
+        if isinstance(func, ast.Attribute):
+            dotted = _dotted(func)
+            if dotted is not None:
+                head, _, rest = dotted.partition(".")
+                # alias-rooted: np.asarray -> numpy.asarray
+                if head in mod.import_alias:
+                    full = f"{mod.import_alias[head]}.{rest}"
+                    return self._pkg_function(full) or full
+                # from-import of a module: `from jax.experimental import
+                # multihost_utils` -> multihost_utils.process_allgather
+                if head in mod.from_imports:
+                    src, orig = mod.from_imports[head]
+                    target = f"{src}.{orig}" if src else orig
+                    # from-imported CLASS: Vocab.load -> method lookup
+                    m = self._pkg_method(target, rest)
+                    if m is not None:
+                        return m
+                    full = f"{target}.{rest}"
+                    return self._pkg_function(full) or full
+                if head in ("self", "cls"):
+                    return None  # handled by caller with class context
+                # module-level class: Scorer.load inside its own module
+                m = self._pkg_method(f"{mod.modname}.{head}", rest)
+                if m is not None:
+                    return m
+                # method call on an unresolvable receiver (a local, a
+                # parameter): the method-name marker still matters —
+                # `x.item()` is a host sync whoever x is
+                return f"*.{func.attr}"
+            return f"*.{func.attr}"
+        return None
+
+    def _pkg_function(self, dotted: str):
+        """FuncInfo for a fully-qualified package function name."""
+        modname, _, func = dotted.rpartition(".")
+        mod = self.modules.get(modname)
+        if mod is not None:
+            return mod.functions.get(func)
+        return None
+
+    def _pkg_method(self, cls_dotted: str, meth: str):
+        modname, _, cls = cls_dotted.rpartition(".")
+        mod = self.modules.get(modname)
+        if mod is not None and cls in mod.classes:
+            return mod.classes[cls].get(meth.split(".")[0])
+        return None
+
+    def _resolve_name(self, mod: ModuleInfo, fi: FuncInfo | None,
+                      name: str):
+        """A bare-name lookup: enclosing nested defs, module top-levels,
+        then from-imports into other package modules."""
+        scope = fi
+        while scope is not None:
+            for child in scope.children:
+                if child.name == name:
+                    return child
+            scope = scope.parent
+        hit = mod.functions.get(name)
+        if hit is not None:
+            return hit
+        if name in mod.from_imports:
+            src, orig = mod.from_imports[name]
+            target = self._pkg_function(f"{src}.{orig}")
+            if target is not None:
+                return target
+            return f"{src}.{orig}" if src else orig
+        return None
+
+    def resolve_call(self, mod: ModuleInfo, fi: FuncInfo,
+                     call: ast.Call) -> object:
+        func = call.func
+        if (isinstance(func, ast.Attribute)
+                and isinstance(func.value, ast.Name)
+                and func.value.id in ("self", "cls") and fi.cls):
+            m = mod.classes.get(fi.cls, {}).get(func.attr)
+            return m if m is not None else f"*.{func.attr}"
+        if isinstance(func, ast.Name):
+            hit = self._resolve_name(mod, fi, func.id)
+            return hit if hit is not None else func.id
+        return self.normalize(mod, func)
+
+    # -- jit roots + reachability -----------------------------------------
+
+    def _is_jit_wrapper(self, mod: ModuleInfo, func: ast.AST) -> bool:
+        name = self.normalize(mod, func) if not isinstance(func, str) \
+            else func
+        if isinstance(name, str):
+            if name in JIT_WRAPPERS:
+                return True
+            # from-imported wrapper (from .mesh import shard_map;
+            # from jax import jit)
+            tail = name.rsplit(".", 1)[-1]
+            return tail in JIT_WRAPPER_NAMES and (
+                name.startswith("jax") or name.startswith(self.pkg_name)
+                or name == tail)
+        return False
+
+    @staticmethod
+    def _jit_kwargs(call: ast.Call) -> tuple[frozenset, bool]:
+        static: set[str] = set()
+        donates = False
+        for kw in call.keywords:
+            if kw.arg in ("static_argnames", "static_argnums"):
+                if isinstance(kw.value, (ast.Tuple, ast.List)):
+                    for el in kw.value.elts:
+                        if isinstance(el, ast.Constant) and isinstance(
+                                el.value, str):
+                            static.add(el.value)
+                elif isinstance(kw.value, ast.Constant) and isinstance(
+                        kw.value.value, str):
+                    static.add(kw.value.value)
+            if kw.arg in ("donate_argnums", "donate_argnames"):
+                donates = True
+        return frozenset(static), donates
+
+    def _mark_root(self, fi: FuncInfo, static: frozenset, donates: bool,
+                   via: str) -> None:
+        fi.jit_root = True
+        fi.jit_reachable = True
+        fi.jit_via = via
+        fi.static_params = fi.static_params | static
+        fi.donates = fi.donates or donates
+
+    def _mark_jit_roots(self) -> None:
+        for mod in self.modules.values():
+            # decorators
+            for fi in mod.functions.values():
+                node = fi.node
+                for dec in getattr(node, "decorator_list", []):
+                    if self._is_jit_wrapper(mod, dec):
+                        self._mark_root(fi, frozenset(), False,
+                                        "decorator")
+                    elif isinstance(dec, ast.Call):
+                        dn = self.normalize(mod, dec.func)
+                        if isinstance(dn, str) and dn.rsplit(".", 1)[-1] \
+                                == "partial" and dec.args \
+                                and self._is_jit_wrapper(mod, dec.args[0]):
+                            static, donates = self._jit_kwargs(dec)
+                            self._mark_root(fi, static, donates,
+                                            "partial decorator")
+                        elif self._is_jit_wrapper(mod, dec.func):
+                            static, donates = self._jit_kwargs(dec)
+                            self._mark_root(fi, static, donates,
+                                            "decorator")
+            # call-site wrapping: jit(fn, ...) / shard_map(fn, ...)
+            # anywhere in the module (wrapper assignments included)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                if not self._is_jit_wrapper(mod, node.func):
+                    continue
+                if node.args and isinstance(node.args[0], ast.Name):
+                    target = self._resolve_name(mod, None, node.args[0].id)
+                    if target is None:
+                        # nested def wrapped where it was defined: find
+                        # the innermost function containing this call
+                        target = self._enclosing_def(mod, node.args[0].id,
+                                                     node)
+                    if isinstance(target, FuncInfo):
+                        static, donates = self._jit_kwargs(node)
+                        self._mark_root(target, static, donates,
+                                        "wrapper call")
+
+    def _enclosing_def(self, mod: ModuleInfo, name: str,
+                       call: ast.Call):
+        for fi in mod.functions.values():
+            if fi.name == name and fi.parent is not None:
+                return fi
+        return None
+
+    def visible_tracers(self, fi: FuncInfo) -> frozenset:
+        """Traced names visible in `fi`'s body: its own tracer params
+        plus, for closures, every enclosing traced function's (free
+        variables captured from the trace)."""
+        names = set(fi.tracer_params())
+        p = fi.parent
+        while p is not None and p.jit_reachable:
+            names |= p.tracer_params()
+            p = p.parent
+        return frozenset(names)
+
+    def local_taint(self, fi: FuncInfo) -> frozenset:
+        """Names holding traced values inside `fi`: visible tracer
+        params/free-vars plus locals ASSIGNED from them — including
+        results of jnp./jax.lax. calls and of jit-reachable package
+        helpers fed traced arguments (`idf = idf_weights(df, ...)`).
+        A bounded fixpoint over the assignment set (ast.walk order is
+        arbitrary, three passes close any realistic chain)."""
+        mod = self.modules[fi.module]
+        tainted = set(self.visible_tracers(fi))
+
+        def expr_traced(expr) -> bool:
+            if refs_any(expr, frozenset(tainted)):
+                return True
+            for sub in ast.walk(expr):
+                if not isinstance(sub, ast.Call):
+                    continue
+                t = self.resolve_call(mod, fi, sub)
+                if isinstance(t, str) and (
+                        t.startswith("jax.numpy.")
+                        or t.startswith("jax.lax.")):
+                    return True
+                if isinstance(t, FuncInfo) and t.jit_reachable:
+                    argv = (*sub.args, *(k.value for k in sub.keywords))
+                    if any(refs_any(a, frozenset(tainted)) for a in argv):
+                        return True
+            return False
+
+        stmts = [n for n in ast.walk(fi.node)
+                 if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign))]
+        for _ in range(3):
+            changed = False
+            for node in stmts:
+                value = getattr(node, "value", None)
+                if value is None or not expr_traced(value):
+                    continue
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in tainted:
+                            tainted.add(n.id)
+                            changed = True
+            if not changed:
+                break
+        return frozenset(tainted)
+
+    def _propagate_jit(self) -> None:
+        """Fixpoint worklist over the call graph: reachability plus
+        per-call-site argument taint. A callee param is traced only if
+        SOME call site passes it an expression referencing a traced
+        value — `tfidf_topk_tiered(q, ..., num_docs=num_docs)` with
+        static num_docs does not poison the helper's num_docs."""
+        work = [fi for mod in self.modules.values()
+                for fi in mod.functions.values() if fi.jit_root]
+        while work:
+            fi = work.pop()
+            mod = self.modules[fi.module]
+            tracers = self.local_taint(fi)
+            # nested defs of traced code run traced; their params' taint
+            # comes from their call sites (or jax-combinator passing)
+            for child in fi.children:
+                if not child.jit_reachable:
+                    child.jit_reachable = True
+                    child.jit_via = f"defined in {fi.ref}"
+                    work.append(child)
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = self.resolve_call(mod, fi, node)
+                if isinstance(target, FuncInfo):
+                    changed = not target.jit_reachable
+                    target.jit_reachable = True
+                    target.jit_via = (target.jit_via
+                                      or f"called from {fi.ref}")
+                    changed |= self._taint_call(target, node, tracers)
+                    if changed:
+                        work.append(target)
+                elif isinstance(target, str) and (
+                        target.startswith("jax.")
+                        or target.rsplit(".", 1)[-1] in (
+                            "cond", "scan", "while_loop", "fori_loop",
+                            "vmap", "switch", "checkpoint", "remat")):
+                    # closures handed to jax combinators are invoked by
+                    # the tracer with traced operands: every positional
+                    # param of such a callee is traced
+                    for arg in node.args:
+                        if not isinstance(arg, ast.Name):
+                            continue
+                        t2 = self._resolve_name(mod, fi, arg.id)
+                        if isinstance(t2, FuncInfo):
+                            newly = {p for p in t2.params
+                                     if p not in ("self", "cls")}
+                            changed = (not t2.jit_reachable
+                                       or not newly <= t2.traced_params)
+                            t2.jit_reachable = True
+                            t2.jit_via = (t2.jit_via
+                                          or f"passed to {target}")
+                            t2.traced_params |= newly
+                            if changed:
+                                work.append(t2)
+
+    @staticmethod
+    def _taint_call(target: FuncInfo, node: ast.Call,
+                    tracers: frozenset) -> bool:
+        """Union traced argument positions into target.traced_params;
+        True when the set grew."""
+        params = target.params
+        off = 1 if params and params[0] in ("self", "cls") else 0
+        newly: set = set()
+        for i, arg in enumerate(node.args):
+            if isinstance(arg, ast.Starred):
+                break
+            if i + off < len(params) and refs_any(arg, tracers):
+                newly.add(params[i + off])
+        known = set(params) | set(target.kwonly)
+        for kw in node.keywords:
+            if kw.arg and kw.arg in known and refs_any(kw.value, tracers):
+                newly.add(kw.arg)
+        if newly <= target.traced_params:
+            return False
+        target.traced_params |= newly
+        return True
+
+    # -- effect summaries (for the concurrency pass) ----------------------
+
+    def is_device_call(self, target: object) -> str | None:
+        """A human-readable tag when `target` dispatches device work."""
+        if isinstance(target, FuncInfo):
+            if target.jit_root:
+                return f"jit-compiled {target.name}()"
+            return None
+        if isinstance(target, str):
+            if target.startswith("jax.numpy."):
+                return target.replace("jax.numpy.", "jnp.")
+            if target.startswith("jax."):
+                return target
+        return None
+
+    def is_io_call(self, target: object) -> str | None:
+        if isinstance(target, str):
+            if target in IO_CALLS:
+                return target
+        return None
+
+    def effects(self, fi: FuncInfo, _stack: frozenset = frozenset()) -> dict:
+        """Transitive effect summary {device: tag|None, io: tag|None,
+        locks: {lock_id: line}} over package-internal calls."""
+        if fi._effects is not None:
+            return fi._effects
+        if fi.ref in _stack:
+            # cycle back-edge: return an empty summary, but flag WHOSE
+            # frame was cut so intermediate results aren't memoized
+            return {"device": None, "io": None, "locks": {},
+                    "cuts": {fi.ref}}
+        out = {"device": None, "io": None, "locks": {}, "cuts": set()}
+        mod = self.modules[fi.module]
+        for acq in mod.lock_acqs:
+            if acq.func is fi:
+                out["locks"].setdefault(acq.lock_id, acq.line)
+        for node in ast.walk(fi.node):
+            if not isinstance(node, ast.Call):
+                continue
+            target = self.resolve_call(mod, fi, node)
+            tag = self.is_device_call(target)
+            if tag and not out["device"]:
+                out["device"] = tag
+            tag = self.is_io_call(target)
+            if tag and not out["io"]:
+                out["io"] = tag
+            if isinstance(target, FuncInfo) and target is not fi:
+                sub = self.effects(target, _stack | {fi.ref})
+                out["cuts"] |= sub.get("cuts", set())
+                if sub["device"] and not out["device"]:
+                    out["device"] = f"{target.name}() -> {sub['device']}"
+                if sub["io"] and not out["io"]:
+                    out["io"] = f"{target.name}() -> {sub['io']}"
+                for lid, line in sub["locks"].items():
+                    out["locks"].setdefault(lid, line)
+        # memoize only COMPLETE summaries: a frame whose subtree was cut
+        # at a function still on the stack is missing that function's
+        # contributions. A cut at fi itself is fine — fi's own effects
+        # are already counted in this frame — so the cycle root caches
+        # and later top-level calls on the other members converge.
+        if not (out["cuts"] - {fi.ref}):
+            out["cuts"] = set()
+            fi._effects = out
+        return out

@@ -1,0 +1,195 @@
+"""Concurrency passes: the whole-program lock story, statically.
+
+The package index supplies the lock inventory (every
+`threading.Lock()`/`RLock()` creation site, identified as
+`module.Class.attr` / `module.attr`) and every `with <lock>:`
+acquisition site. This pass derives:
+
+- the **acquisition-order graph**: an edge A→B for every `with A:` body
+  that — directly or transitively through package-internal calls —
+  acquires B. `with` nesting and call chains both contribute; nested
+  `def`s inside a with-body do not (their execution point is unknown).
+- **TPU201**: cycles in that graph — two call paths that take the same
+  locks in opposite orders, i.e. a deadlock awaiting the right thread
+  interleaving. Reported once per participating edge.
+- **TPU202/TPU203**: a lock held across a device dispatch (TPU202: any
+  `jax.*`/`jnp.*` call or a call into a jit entry point — every other
+  thread needing that lock stalls behind a device round-trip) or across
+  blocking file IO (TPU203: open/os.replace/np.load/... — legitimate
+  exactly when the lock's JOB is serializing that IO, which is what the
+  baseline's reason field is for).
+- **TPU204**: a non-reentrant lock whose holder calls a path that
+  re-acquires it — self-deadlock, the reason Scorer's lazy state uses
+  an RLock.
+
+The runtime complement (ordered_lock.OrderedLock) catches the orders
+the static pass cannot see — locks passed through callbacks, dynamic
+dispatch — by recording real acquisitions under the chaos soak.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astindex import FuncInfo, LockAcq, PackageIndex
+from .core import Finding, make_finding
+
+
+def _with_body_calls(acq: LockAcq):
+    """Call nodes executed while the lock is held: the With body, minus
+    nested function definitions (deferred execution) and minus nested
+    With statements' own scan (they are their own acquisition sites —
+    but the nested acquisition itself is yielded as a With)."""
+    stack = list(acq.node.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    locks = index.all_locks()
+    acqs = index.all_acquisitions()
+    findings: list[Finding] = []
+
+    # -- per-site: what runs under the lock --------------------------------
+    edges: dict[tuple, LockAcq] = {}   # (held, acquired) -> first site
+    for acq in acqs:
+        mod = index.modules[acq.func.module]
+        held_kind = locks[acq.lock_id].kind
+        device = io = reacquire = None
+        for node in _with_body_calls(acq):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    inner = index._lock_id_of(mod, acq.func,
+                                              item.context_expr)
+                    if inner and inner != acq.lock_id:
+                        edges.setdefault((acq.lock_id, inner), acq)
+                    elif (inner == acq.lock_id and held_kind != "RLock"
+                            and reacquire is None):
+                        # the blatant form: `with lock:` nested directly
+                        # inside `with lock:` — deadlocks on first run
+                        reacquire = (node.lineno,
+                                     "a nested `with` re-acquires it "
+                                     f"(line {node.lineno})")
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            target = index.resolve_call(mod, acq.func, node)
+            tag = index.is_device_call(target)
+            if tag and device is None:
+                device = (node.lineno, tag)
+            tag = index.is_io_call(target)
+            if tag and io is None:
+                io = (node.lineno, tag)
+            if isinstance(target, FuncInfo) and target is not acq.func:
+                eff = index.effects(target)
+                if eff["device"] and device is None:
+                    device = (node.lineno,
+                              f"{target.name}() -> {eff['device']}")
+                if eff["io"] and io is None:
+                    io = (node.lineno, f"{target.name}() -> {eff['io']}")
+                for inner in eff["locks"]:
+                    if inner == acq.lock_id:
+                        if held_kind != "RLock" and reacquire is None:
+                            reacquire = (node.lineno,
+                                         f"calling {target.name}(), "
+                                         "which re-acquires it")
+                    else:
+                        edges.setdefault((acq.lock_id, inner), acq)
+        short = _short(acq.lock_id)
+        fn = f"{acq.func.qual}()"
+        if device:
+            findings.append(make_finding(
+                index, "TPU202", acq.path, acq.line,
+                f"lock {short} held across device dispatch "
+                f"({device[1]}) in {fn} — compute outside the lock, "
+                "re-check and publish under it"))
+        if io:
+            findings.append(make_finding(
+                index, "TPU203", acq.path, acq.line,
+                f"lock {short} held across blocking IO ({io[1]}) "
+                f"in {fn}"))
+        if reacquire:
+            findings.append(make_finding(
+                index, "TPU204", acq.path, acq.line,
+                f"non-reentrant lock {short} held in {fn} while "
+                f"{reacquire[1]} — self-deadlock"))
+
+    # -- the order graph: cycles ------------------------------------------
+    graph: dict[str, set] = {}
+    for (a, b) in edges:
+        graph.setdefault(a, set()).add(b)
+    for cyc in _cycles(graph):
+        for i, a in enumerate(cyc):
+            b = cyc[(i + 1) % len(cyc)]
+            acq = edges[(a, b)]
+            findings.append(make_finding(
+                index, "TPU201", acq.path, acq.line,
+                f"lock-order cycle: {' -> '.join(_short(x) for x in cyc)}"
+                f" -> {_short(cyc[0])}; this site acquires {_short(b)} "
+                f"while holding {_short(a)}"))
+    return findings
+
+
+def _short(lock_id: str) -> str:
+    parts = lock_id.split(".")
+    return ".".join(parts[-2:]) if len(parts) > 1 else lock_id
+
+
+def _cycles(graph: dict[str, set]) -> list[list[str]]:
+    """Elementary cycles via DFS with a path stack (small graphs; the
+    lock inventory is tens of nodes). Each cycle reported once, rotated
+    to start at its smallest node."""
+    seen_cycles: set = set()
+    out: list[list[str]] = []
+
+    def dfs(node, path, on_path):
+        for nxt in sorted(graph.get(node, ())):
+            if nxt in on_path:
+                cyc = path[path.index(nxt):]
+                lo = cyc.index(min(cyc))
+                norm = tuple(cyc[lo:] + cyc[:lo])
+                if norm not in seen_cycles:
+                    seen_cycles.add(norm)
+                    out.append(list(norm))
+            elif (node, nxt) not in visited_edges:
+                visited_edges.add((node, nxt))
+                dfs(nxt, path + [nxt], on_path | {nxt})
+
+    visited_edges: set = set()
+    for start in sorted(graph):
+        dfs(start, [start], {start})
+    return out
+
+
+def build_lock_report(index: PackageIndex) -> dict:
+    """The whole-program lock inventory + order graph as data (the
+    `tpu-ir lint --locks` view): every lock with its creation site, and
+    every acquisition-order edge observed statically."""
+    locks = index.all_locks()
+    edges = set()
+    for acq in index.all_acquisitions():
+        mod = index.modules[acq.func.module]
+        for node in _with_body_calls(acq):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    inner = index._lock_id_of(mod, acq.func,
+                                              item.context_expr)
+                    if inner and inner != acq.lock_id:
+                        edges.add((acq.lock_id, inner))
+            elif isinstance(node, ast.Call):
+                target = index.resolve_call(mod, acq.func, node)
+                if isinstance(target, FuncInfo):
+                    for inner in index.effects(target)["locks"]:
+                        if inner != acq.lock_id:
+                            edges.add((acq.lock_id, inner))
+    return {
+        "locks": {lid: {"kind": d.kind,
+                        "file": index.relpath(d.path), "line": d.line}
+                  for lid, d in sorted(locks.items())},
+        "order_edges": sorted([a, b] for a, b in edges),
+    }

@@ -1,0 +1,324 @@
+"""Contract passes: emitted names must equal declared names.
+
+PR 3 proved this style of check pays for itself: two source-introspection
+tests in tests/test_obs.py (regex scans for fault-site and service-level
+coverage) caught real drift between what the code emits and what the
+telemetry layer declares. This module is those checks grown into a
+first-class pass family — AST-precise instead of regex, covering every
+name-shaped contract the stack now has, and shared between `tpu-ir lint`
+and the (now thin) test wrappers:
+
+- **TPU301** — every `TPU_IR_*` env read goes through utils/envvars.py.
+  A raw `os.environ.get("TPU_IR_X")` anywhere else means an undeclared,
+  unvalidated, undocumented knob.
+- **TPU302** — the env registry, its accessor call sites, and RUNBOOK.md
+  agree: every accessor call names a declared variable, every declared
+  variable appears in RUNBOOK, every `TPU_IR_*` token in RUNBOOK is
+  declared, and the generated env-var table embedded in RUNBOOK §13
+  matches a fresh render.
+- **TPU303** — counter names: `get_registry().incr()` literals must be
+  in DECLARED_COUNTERS (or the recovery./serving./fault. namespaces);
+  `recovery_counters().incr()` literals in RECOVERY_COUNTER_NAMES;
+  `serving_counters().incr()` / frontend `self._count()` literals in
+  SERVING_COUNTER_NAMES. Dynamic (f-string) names are skipped — their
+  families are declared as expansions.
+- **TPU304** — every `faults.should_fire/maybe_crash/maybe_hang` site
+  literal is in FAULT_SITES (the registry pre-registers its counter).
+- **TPU305** — every span/histogram literal (`trace("x")`,
+  `observe("x", ...)`) is in DECLARED_HISTOGRAMS or the declared
+  `build.` family.
+
+The declared sets are imported from the live modules (they are data,
+not behavior — no JAX touched); the emit sites come from the shared
+package AST index.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+
+from .astindex import PackageIndex, _dotted
+from .core import Finding, make_finding
+
+_ENV_TOKEN = re.compile(r"TPU_IR_[A-Z][A-Z0-9_]*")
+_FAULT_FUNCS = ("should_fire", "maybe_crash", "maybe_hang")
+_ENV_ACCESSORS = ("get_str", "get_int", "get_float", "get_bool",
+                  "get_choice")
+
+# RUNBOOK markers delimiting the generated env-var table
+TABLE_START = "<!-- envvar-table-start (generated) -->"
+TABLE_END = "<!-- envvar-table-end -->"
+
+
+def _declared():
+    """The live contract constants. Imported lazily so the AST passes
+    stay importable even in a stripped-down environment."""
+    from ..obs import registry
+    from ..utils import envvars
+
+    return envvars, registry
+
+
+def _const_str(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def collect_fault_sites(index: PackageIndex) -> dict[str, list]:
+    """Every fault-injection call-site literal in the package (site ->
+    [(file, line), ...]), excluding the defining/telemetry layers. The
+    AST-precise replacement for tests/test_obs.py's old regex scan —
+    the test is now a thin wrapper asserting this is non-empty and that
+    check() reports no TPU304."""
+    out: dict[str, list] = {}
+    for mod in index.modules.values():
+        rel = index.relpath(mod.path).replace(os.sep, "/")
+        if rel.endswith("faults.py") or "/obs/" in rel or "/lint/" in rel:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = (node.func.attr if isinstance(node.func, ast.Attribute)
+                    else node.func.id if isinstance(node.func, ast.Name)
+                    else "")
+            if tail in _FAULT_FUNCS and node.args:
+                site = _const_str(node.args[0])
+                if site is not None:
+                    out.setdefault(site, []).append((rel, node.lineno))
+    return out
+
+
+def collect_service_levels(index: PackageIndex) -> set:
+    """The LEVEL_* string constants the serving frontend defines (the
+    ladder's vocabulary), read from its AST. check() pins this set
+    against registry.SERVICE_LEVELS so a new ladder level cannot ship
+    without its request.<level> histogram."""
+    mod = index.modules.get("tpu_ir.serving.frontend")
+    levels: set = set()
+    if mod is not None:
+        for node in mod.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                        node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name) and \
+                            t.id.startswith("LEVEL_"):
+                        levels.add(node.value.value)
+    return levels
+
+
+def check(index: PackageIndex, runbook_path: str | None = None,
+          ) -> list[Finding]:
+    envvars, registry = _declared()
+    declared_env = set(envvars.declared_names())
+    declared_counters = set(registry.DECLARED_COUNTERS)
+    declared_hists = set(registry.DECLARED_HISTOGRAMS)
+    fault_sites = set(registry.FAULT_SITES)
+    recovery_names = set(registry.RECOVERY_COUNTER_NAMES)
+    serving_names = set(registry.SERVING_COUNTER_NAMES)
+
+    findings: list[Finding] = []
+    emitted_recovery: set = set()
+
+    for mod in index.modules.values():
+        rel = index.relpath(mod.path).replace(os.sep, "/")
+        in_envvars = rel.endswith("utils/envvars.py")
+        # the telemetry/lint layers define these surfaces (dynamic
+        # names, prefix views) — their own code is exempt from the
+        # emit-side checks; faults.py EMITS real counters, so only
+        # TPU304 (via collect_fault_sites) excludes it
+        in_obs = "/obs/" in rel or "/lint/" in rel
+        for node in ast.walk(mod.tree):
+            # TPU301 (subscript form): os.environ["TPU_IR_X"] — reads
+            # and setdefault/pop are handled with the calls below;
+            # stores (os.environ[...] = v) are writes, not knob reads
+            if isinstance(node, ast.Subscript) and not in_envvars and \
+                    isinstance(node.ctx, ast.Load) and \
+                    _dotted(node.value) in ("os.environ", "environ"):
+                name = _const_str(node.slice)
+                if name and name.startswith("TPU_IR_"):
+                    findings.append(make_finding(
+                        index, "TPU301", mod.path, node.lineno,
+                        f"raw environment read of {name} — declare it in "
+                        "utils/envvars.py and use a typed accessor"))
+                continue
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = _dotted(node.func) or ""
+            # dotted collapses for chained-call receivers like
+            # `recovery_counters().incr`; the attribute name is the tail
+            if isinstance(node.func, ast.Attribute):
+                tail = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                tail = node.func.id
+            else:
+                tail = dotted.rsplit(".", 1)[-1]
+
+            # TPU301: raw env reads of TPU_IR_* outside the registry —
+            # the bare `environ.*` forms cover `from os import environ`
+            if not in_envvars and dotted in (
+                    "os.environ.get", "os.getenv", "environ.get", "getenv",
+                    "os.environ.setdefault", "environ.setdefault",
+                    "os.environ.pop", "environ.pop"):
+                name = _const_str(node.args[0]) if node.args else None
+                if name and name.startswith("TPU_IR_"):
+                    findings.append(make_finding(
+                        index, "TPU301", mod.path, node.lineno,
+                        f"raw environment read of {name} — declare it in "
+                        "utils/envvars.py and use a typed accessor"))
+
+            # TPU302 (accessor side): envvars.get_*("X") of an
+            # undeclared name would KeyError at runtime
+            if tail in _ENV_ACCESSORS and (
+                    dotted.startswith("envvars.")
+                    or dotted.startswith("tpu_ir.utils.envvars.")):
+                name = _const_str(node.args[0]) if node.args else None
+                if name and name not in declared_env:
+                    findings.append(make_finding(
+                        index, "TPU302", mod.path, node.lineno,
+                        f"envvars accessor reads undeclared variable "
+                        f"{name}"))
+
+            # TPU303: counter names by receiver shape
+            if tail == "incr" and not in_obs:
+                name = _const_str(node.args[0]) if node.args else None
+                if name is None:
+                    continue
+                recv = node.func.value if isinstance(
+                    node.func, ast.Attribute) else None
+                recv_call = (_dotted(recv.func) or "" if isinstance(
+                    recv, ast.Call) else "")
+                recv_tail = recv_call.rsplit(".", 1)[-1]
+                if recv_tail == "get_registry":
+                    ok = (name in declared_counters
+                          or name.split(".")[0] in ("recovery", "serving",
+                                                    "fault"))
+                    if not ok:
+                        findings.append(make_finding(
+                            index, "TPU303", mod.path, node.lineno,
+                            f"registry counter {name!r} is not in "
+                            "DECLARED_COUNTERS"))
+                elif recv_tail == "recovery_counters":
+                    emitted_recovery.add(name)
+                    if name not in recovery_names:
+                        findings.append(make_finding(
+                            index, "TPU303", mod.path, node.lineno,
+                            f"recovery counter {name!r} is not in "
+                            "RECOVERY_COUNTER_NAMES"))
+                elif recv_tail == "serving_counters":
+                    if name not in serving_names:
+                        findings.append(make_finding(
+                            index, "TPU303", mod.path, node.lineno,
+                            f"serving counter {name!r} is not in "
+                            "SERVING_COUNTER_NAMES"))
+            if tail == "_count" and isinstance(node.func, ast.Attribute) \
+                    and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id == "self":
+                name = _const_str(node.args[0]) if node.args else None
+                if name is not None and name not in serving_names:
+                    findings.append(make_finding(
+                        index, "TPU303", mod.path, node.lineno,
+                        f"serving counter {name!r} (via self._count) is "
+                        "not in SERVING_COUNTER_NAMES"))
+
+            # TPU305: span / histogram name literals
+            span_name = None
+            if tail in ("trace", "obs_trace") and not in_obs:
+                span_name = _const_str(node.args[0]) if node.args else None
+            elif tail == "observe" and not in_obs:
+                recv = node.func.value if isinstance(
+                    node.func, ast.Attribute) else None
+                recv_call = (_dotted(recv.func) or "" if isinstance(
+                    recv, ast.Call) else "")
+                if recv_call.rsplit(".", 1)[-1] == "get_registry":
+                    span_name = _const_str(node.args[0]) \
+                        if node.args else None
+            if span_name is not None and span_name not in declared_hists \
+                    and not span_name.startswith("build."):
+                findings.append(make_finding(
+                    index, "TPU305", mod.path, node.lineno,
+                    f"span/histogram {span_name!r} is not in "
+                    "DECLARED_HISTOGRAMS (nor the build.* family)"))
+
+    # TPU304: fault-injection site literals (one collector, shared with
+    # the test_obs wrapper)
+    for site, sites in sorted(collect_fault_sites(index).items()):
+        if site in fault_sites:
+            continue
+        for rel, line in sites:
+            findings.append(Finding(
+                "TPU304", rel, line,
+                f"fault-injection site {site!r} is not declared in "
+                "obs.registry.FAULT_SITES — its fire counter does not "
+                "exist"))
+
+    # whole-package-only contracts: these compare the package against
+    # its OWN declarations, which is meaningless for fixture packages
+    if index.pkg_name == "tpu_ir":
+        # TPU303 (reverse direction): declared recovery counters no site
+        # emits — documentation describing telemetry that cannot happen
+        for name in sorted(recovery_names - emitted_recovery):
+            findings.append(Finding(
+                "TPU303", "tpu_ir/obs/registry.py", 0,
+                f"recovery counter {name!r} is declared but never "
+                "incremented anywhere in the package"))
+        # TPU305: ladder levels (frontend LEVEL_* constants) must equal
+        # the registry's SERVICE_LEVELS — each level's request.<level>
+        # histogram exists exactly when this holds
+        levels = collect_service_levels(index)
+        if levels and levels != set(registry.SERVICE_LEVELS):
+            drift = levels.symmetric_difference(registry.SERVICE_LEVELS)
+            findings.append(Finding(
+                "TPU305", "tpu_ir/serving/frontend.py", 0,
+                f"service levels drift from registry.SERVICE_LEVELS: "
+                f"{sorted(drift)}"))
+        # a serving level must also have its served_<level> counter
+        for lv in registry.SERVICE_LEVELS:
+            if lv != "shed" and f"served_{lv}" not in serving_names:
+                findings.append(Finding(
+                    "TPU303", "tpu_ir/obs/registry.py", 0,
+                    f"service level {lv!r} has no served_{lv} counter in "
+                    "SERVING_COUNTER_NAMES"))
+        findings += _check_runbook(index, declared_env, runbook_path)
+    return findings
+
+
+def _check_runbook(index: PackageIndex, declared_env: set,
+                   runbook_path: str | None) -> list[Finding]:
+    """TPU302: RUNBOOK.md and the env registry must agree, and the
+    embedded generated table must be a fresh render."""
+    from ..utils import envvars
+
+    path = runbook_path or os.path.join(index.rel_root, "RUNBOOK.md")
+    if not os.path.exists(path):
+        return []   # linting a bare package checkout: nothing to pin
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    findings: list[Finding] = []
+    documented = set(_ENV_TOKEN.findall(text))
+    for name in sorted(declared_env - documented):
+        findings.append(Finding(
+            "TPU302", os.path.basename(path), 0,
+            f"declared env var {name} is not documented in RUNBOOK.md"))
+    for name in sorted(documented - declared_env):
+        findings.append(Finding(
+            "TPU302", os.path.basename(path), 0,
+            f"RUNBOOK.md documents {name}, which is not declared in "
+            "utils/envvars.py (stale doc or missing declaration)"))
+    start, end = text.find(TABLE_START), text.find(TABLE_END)
+    if start < 0 or end < 0:
+        findings.append(Finding(
+            "TPU302", os.path.basename(path), 0,
+            "RUNBOOK.md is missing the generated env-var table markers "
+            f"({TABLE_START} ... {TABLE_END})"))
+    else:
+        embedded = text[start + len(TABLE_START):end].strip()
+        if embedded != envvars.markdown_table().strip():
+            findings.append(Finding(
+                "TPU302", os.path.basename(path), 0,
+                "RUNBOOK.md's embedded env-var table is stale — "
+                "regenerate with `tpu-ir lint --env-table`"))
+    return findings

@@ -1,0 +1,207 @@
+"""tpu-ir lint core: findings, the rule catalog, baseline, the runner.
+
+A finding is (rule, file, line, message, severity). The baseline file
+(`lint_baseline.json`, checked in at the repo root) grandfathers
+REVIEWED findings: its entries match on (rule, file, message) — line
+numbers drift with every edit and deliberately do not participate — and
+each carries a `reason` explaining why the finding is accepted rather
+than fixed. The self-check contract (tests/test_lint.py) runs the full
+suite over `tpu_ir/` and asserts zero un-baselined findings, so:
+
+- a new hazard anywhere in the package fails tier-1 until it is either
+  fixed or explicitly accepted in a reviewed baseline diff;
+- `--fix-baseline` rewrites the file from the current findings
+  (preserving reasons for entries that survive), making "we accept this"
+  an explicit, reviewable diff — never a silent drift.
+
+Exit codes (the CI contract): 0 = clean (all findings baselined),
+1 = un-baselined findings, 2 = usage error (unknown path, unreadable
+baseline). Everything here is stdlib-only — no JAX, no numpy — so the
+gate costs milliseconds, not a backend init.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from .astindex import PackageIndex
+
+BASELINE_VERSION = 1
+
+# The rule catalog (DESIGN §10 renders this). Severity is advisory —
+# every un-baselined finding fails the gate; severity tells the reader
+# whether the finding is a correctness hazard or a discipline breach.
+RULES: dict[str, tuple[str, str]] = {
+    # jit-hazard family
+    "TPU101": ("error",
+               "host sync inside a jit-traced function (.item()/.tolist()/"
+               "np.* array op/float()/int() on a tracer forces a device "
+               "round-trip per call, or fails to trace at all)"),
+    "TPU102": ("error",
+               "Python `if`/`while`/`assert` branches on a traced value "
+               "(TracerBoolConversionError at trace time; use lax.cond/"
+               "jnp.where or declare the argument static)"),
+    "TPU103": ("warning",
+               "print()/f-string formats a traced value (concretizes the "
+               "tracer — a silent host sync on every call)"),
+    "TPU104": ("warning",
+               "jit entry point rebuilds a parameter buffer without "
+               "donate_argnums (the update allocates a second copy of the "
+               "buffer in HBM instead of reusing the input's)"),
+    # concurrency family
+    "TPU201": ("error",
+               "lock acquisition-order cycle (two call paths take these "
+               "locks in opposite orders — a deadlock waiting for the "
+               "right interleaving)"),
+    "TPU202": ("error",
+               "lock held across a device dispatch (every thread needing "
+               "the lock stalls behind a ~100ms device round-trip; "
+               "compute outside, publish under the lock)"),
+    "TPU203": ("warning",
+               "lock held across blocking file IO (acceptable only when "
+               "the lock exists to serialize that IO — baseline with a "
+               "reason, or move the IO out)"),
+    "TPU204": ("error",
+               "non-reentrant lock re-acquired on a path that may already "
+               "hold it (self-deadlock)"),
+    # contract family
+    "TPU301": ("error",
+               "raw os.environ read of a TPU_IR_* variable outside "
+               "utils/envvars.py (declare it in the registry; typed "
+               "accessors validate and document in one place)"),
+    "TPU302": ("error",
+               "env-var registry and RUNBOOK drift (variable declared but "
+               "undocumented, documented but undeclared, or the generated "
+               "table is stale)"),
+    "TPU303": ("error",
+               "counter emitted but not declared (every registry counter "
+               "name must be pre-declared so scrape surfaces are total)"),
+    "TPU304": ("error",
+               "fault-injection site not declared in FAULT_SITES (an "
+               "undeclared site has no fault.<site> counter — an "
+               "untelemetered failure path)"),
+    "TPU305": ("error",
+               "span/histogram name not declared in DECLARED_HISTOGRAMS "
+               "(latency surfaces must be total: serve-bench and metrics "
+               "report the declared set, observed or not)"),
+}
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    file: str          # repo-relative, forward slashes
+    line: int
+    message: str
+
+    @property
+    def severity(self) -> str:
+        return RULES.get(self.rule, ("error", ""))[0]
+
+    @property
+    def key(self) -> tuple:
+        return (self.rule, self.file, self.message)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "file": self.file, "line": self.line,
+                "message": self.message}
+
+    def __str__(self) -> str:
+        return (f"{self.file}:{self.line}: {self.rule} "
+                f"[{self.severity}] {self.message}")
+
+
+def make_finding(index: PackageIndex, rule: str, path: str, line: int,
+                 message: str) -> Finding:
+    return Finding(rule, index.relpath(path).replace(os.sep, "/"),
+                   line, message)
+
+
+# -- baseline ---------------------------------------------------------------
+
+
+@dataclass
+class Baseline:
+    path: str | None = None
+    entries: dict[tuple, dict] = field(default_factory=dict)
+
+    @classmethod
+    def load(cls, path: str) -> "Baseline":
+        """Parse a baseline file. Raises ValueError on malformed content
+        (a usage error — exit 2 — not a finding)."""
+        with open(path, encoding="utf-8") as f:
+            raw = json.load(f)
+        if not isinstance(raw, dict) or raw.get("version") != \
+                BASELINE_VERSION:
+            raise ValueError(
+                f"{path}: expected a baseline object with version="
+                f"{BASELINE_VERSION}")
+        out = cls(path=path)
+        for e in raw.get("findings", []):
+            key = (e["rule"], e["file"], e["message"])
+            e.setdefault("count", 1)
+            out.entries[key] = e
+        return out
+
+    def filter(self, findings: list[Finding]) -> tuple[list, list]:
+        """(un-baselined findings, stale baseline entries). A baseline
+        entry absorbs up to `count` identical findings; finding N+1 of a
+        grandfathered (rule, file, message) is NEW and reported."""
+        remaining = {k: e["count"] for k, e in self.entries.items()}
+        fresh: list[Finding] = []
+        for f in sorted(findings, key=lambda f: (f.file, f.line, f.rule)):
+            if remaining.get(f.key, 0) > 0:
+                remaining[f.key] -= 1
+            else:
+                fresh.append(f)
+        stale = [self.entries[k] for k, n in remaining.items()
+                 if n == self.entries[k]["count"]]
+        return fresh, stale
+
+    @staticmethod
+    def render(findings: list[Finding], previous: "Baseline | None" = None,
+               ) -> str:
+        """The serialized baseline for the current findings, with reasons
+        carried over from `previous` where the entry survives. New
+        entries get an explicit TODO reason — a reviewer must replace it."""
+        counts: dict[tuple, int] = {}
+        for f in findings:
+            counts[f.key] = counts.get(f.key, 0) + 1
+        old = previous.entries if previous else {}
+        entries = []
+        for (rule, file, message), n in sorted(counts.items()):
+            e = {"rule": rule, "file": file, "message": message, "count": n}
+            prev = old.get((rule, file, message))
+            e["reason"] = (prev.get("reason") if prev and prev.get("reason")
+                           else "TODO: justify or fix before merging")
+            entries.append(e)
+        return json.dumps({"version": BASELINE_VERSION,
+                           "findings": entries}, indent=2) + "\n"
+
+
+# -- the runner -------------------------------------------------------------
+
+
+def run_lint(root: str, *, pkg_name: str = "tpu_ir",
+             rel_root: str | None = None,
+             families: tuple = ("jit", "concurrency", "contracts"),
+             ) -> list[Finding]:
+    """Run the analyzer families over the package at `root` and return
+    all findings (unfiltered — baseline handling is the caller's)."""
+    from . import concurrency, contracts, jit_hazards
+
+    index = PackageIndex(root, pkg_name=pkg_name, rel_root=rel_root)
+    findings: list[Finding] = []
+    for path, err in index.errors:
+        findings.append(make_finding(index, "TPU101", path, 0,
+                                     f"unparsable module: {err}"))
+    if "jit" in families:
+        findings += jit_hazards.check(index)
+    if "concurrency" in families:
+        findings += concurrency.check(index)
+    if "contracts" in families:
+        findings += contracts.check(index)
+    return sorted(findings, key=lambda f: (f.file, f.line, f.rule))

@@ -1,0 +1,174 @@
+"""jit-hazard passes: what must never happen inside a traced function.
+
+Scope: the jit-reachable closure computed by the package index — every
+function whose body executes under a `jax.jit`/`pjit`/`shard_map` trace,
+whether it is the decorated entry point, a module-level `jax.jit(fn)`
+wrapper target, a helper it calls, or a closure defined inside one.
+
+- **TPU101 host sync**: `.item()`, `.tolist()`, `.block_until_ready()`,
+  `.copy_to_host_async()`, numpy array ops (`np.asarray` and friends —
+  a numpy call on a tracer either concretizes or fails the trace), and
+  `float()`/`int()`/`bool()` applied to a traced value. Telemetry code
+  (`tpu_ir/obs/`) is not jit-reachable, so the "block_until_ready is
+  fine in telemetry" carve-out falls out structurally.
+- **TPU102 tracer branch**: `if`/`while`/`assert`/ternary tests that
+  reference a traced parameter as a VALUE. Static accesses
+  (`x.shape[0]`, `x.ndim`, `x is None`) are recognized and exempt —
+  they are what the kernels legitimately branch on.
+- **TPU103 tracer format**: `print(x)` / f-strings interpolating a
+  traced value — a concretization (and host sync) per call.
+- **TPU104 missing donation**: a jit ENTRY POINT whose body rebuilds a
+  parameter buffer (`jax.lax.dynamic_update_slice(param, ...)` or
+  `param.at[...]...`) without `donate_argnums`: the functional update
+  allocates a second full buffer in HBM when the caller's could have
+  been reused (the SNIPPETS.md donation pattern; utils/transfer.py's
+  `_stream_update` is the shipped positive example).
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astindex import (
+    HOST_SYNC_METHODS,
+    NUMPY_SAFE,
+    FuncInfo,
+    PackageIndex,
+    _dotted,
+    refs_any,
+)
+from .core import Finding, make_finding
+
+_CONCRETIZERS = ("float", "int", "bool", "complex")
+_refs_tracer = refs_any
+
+
+def _own_statements(fi: FuncInfo):
+    """Walk fi's body EXCLUDING nested function definitions (they are
+    analyzed as their own FuncInfos with their own tracer sets)."""
+    stack = list(ast.iter_child_nodes(fi.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        for fi in mod.functions.values():
+            if not fi.jit_reachable:
+                continue
+            findings += _check_traced_body(index, mod, fi)
+            if fi.jit_root and not fi.donates:
+                findings += _check_donation(index, fi)
+    return findings
+
+
+def _check_traced_body(index, mod, fi: FuncInfo) -> list[Finding]:
+    out: list[Finding] = []
+    tracers = index.local_taint(fi)
+    where = f"in jit-traced {fi.qual}()"
+    for node in _own_statements(fi):
+        # TPU101: host syncs
+        if isinstance(node, ast.Call):
+            target = index.resolve_call(mod, fi, node)
+            if isinstance(target, str):
+                if target.startswith("*.") and \
+                        target[2:] in HOST_SYNC_METHODS:
+                    out.append(make_finding(
+                        index, "TPU101", fi.path, node.lineno,
+                        f"host sync .{target[2:]}() {where}"))
+                elif target.startswith("numpy.") and \
+                        target.split(".", 1)[1] not in NUMPY_SAFE:
+                    out.append(make_finding(
+                        index, "TPU101", fi.path, node.lineno,
+                        f"numpy call {target} {where} (numpy ops "
+                        "concretize tracers; use jnp)"))
+                elif target in ("jax.device_get",):
+                    out.append(make_finding(
+                        index, "TPU101", fi.path, node.lineno,
+                        f"host sync {target} {where}"))
+                elif target in _CONCRETIZERS and node.args:
+                    hit = _refs_tracer(node.args[0], tracers)
+                    if hit:
+                        out.append(make_finding(
+                            index, "TPU101", fi.path, node.lineno,
+                            f"{target}() concretizes traced value "
+                            f"{hit!r} {where}"))
+                elif target == "print":
+                    hit = None
+                    for a in node.args:
+                        hit = _refs_tracer(a, tracers)
+                        if hit:
+                            break
+                    if hit:
+                        out.append(make_finding(
+                            index, "TPU103", fi.path, node.lineno,
+                            f"print() of traced value {hit!r} {where}"))
+        # TPU102: control flow on tracers
+        elif isinstance(node, (ast.If, ast.While, ast.IfExp)):
+            hit = _refs_tracer(node.test, tracers)
+            if hit:
+                kind = {"If": "if", "While": "while",
+                        "IfExp": "conditional expression"}[
+                            type(node).__name__]
+                out.append(make_finding(
+                    index, "TPU102", fi.path, node.lineno,
+                    f"Python {kind} branches on traced value {hit!r} "
+                    f"{where} (use jax.lax.cond/jnp.where or mark the "
+                    "argument static)"))
+        elif isinstance(node, ast.Assert):
+            hit = _refs_tracer(node.test, tracers)
+            if hit:
+                out.append(make_finding(
+                    index, "TPU102", fi.path, node.lineno,
+                    f"assert on traced value {hit!r} {where}"))
+        # TPU103: f-strings interpolating tracers
+        elif isinstance(node, ast.JoinedStr):
+            for part in node.values:
+                if isinstance(part, ast.FormattedValue):
+                    hit = _refs_tracer(part.value, tracers)
+                    if hit:
+                        out.append(make_finding(
+                            index, "TPU103", fi.path, node.lineno,
+                            f"f-string interpolates traced value {hit!r} "
+                            f"{where}"))
+                        break
+    return out
+
+
+def _check_donation(index, fi: FuncInfo) -> list[Finding]:
+    """TPU104 on a non-donating jit root: does the body functionally
+    rebuild one of its own (traced) parameter buffers?"""
+    out: list[Finding] = []
+    tracers = fi.tracer_params()
+    for node in _own_statements(fi):
+        if not isinstance(node, ast.Call):
+            continue
+        param = None
+        dotted = _dotted(node.func)
+        if dotted and dotted.endswith("dynamic_update_slice") and \
+                node.args and isinstance(node.args[0], ast.Name) and \
+                node.args[0].id in tracers:
+            param = node.args[0].id
+        # param.at[...].set/add/...: Call(Attribute(Subscript(
+        #   Attribute(Name(param), 'at'))))
+        elif isinstance(node.func, ast.Attribute) and isinstance(
+                node.func.value, ast.Subscript):
+            base = node.func.value.value
+            if (isinstance(base, ast.Attribute) and base.attr == "at"
+                    and isinstance(base.value, ast.Name)
+                    and base.value.id in tracers):
+                param = base.value.id
+        if param:
+            out.append(make_finding(
+                index, "TPU104", fi.path, fi.node.lineno,
+                f"jit entry point {fi.qual}() functionally updates "
+                f"parameter {param!r} without donate_argnums — the "
+                "update allocates a second buffer instead of reusing "
+                "the caller's"))
+            break
+    return out

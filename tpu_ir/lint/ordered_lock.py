@@ -1,0 +1,200 @@
+"""OrderedLock: runtime lock-order verification (TSan-lite).
+
+The static concurrency pass sees the orders the AST shows; this wrapper
+sees the orders that actually HAPPEN. Each OrderedLock records, at every
+successful acquisition, which other ordered locks the acquiring thread
+already holds, into one process-wide acquisition-order graph keyed by lock
+*name* (two locks created at the same call site share a name, so the
+discipline is per-role, not per-instance). Acquiring B while holding A
+records the edge A→B; if the graph already holds B→A — ANY thread, ANY
+earlier moment of the process — the inversion is reported immediately
+and deterministically, no deadlock interleaving required. That is the
+whole trick: a deadlock needs the unlucky schedule, the inverted ORDER
+happens on every schedule.
+
+`install(monkeypatch)` swaps `threading.Lock`/`RLock` for ordering-
+checked factories for the duration of a test; only locks whose creation
+site lives under this repo are wrapped (JAX's and the stdlib's internal
+locks keep their real classes — their ordering discipline is not ours
+to police). tests/conftest.py activates this for the serving/chaos-soak
+tests, so every future locking change is soak-verified against
+inversions for free.
+
+Reentrancy: re-acquiring a lock the thread already holds is legal for
+RLock-kind locks (counted, no edge) and reported for plain locks.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# the REAL constructors, bound at import time: OrderedLock's own inner
+# lock and the graph's mutex must never route through a patched
+# threading.Lock (that is instant infinite recursion)
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+
+
+class LockOrderInversion(RuntimeError):
+    """Two ordered locks were taken in both A→B and B→A orders."""
+
+
+class _OrderGraph:
+    """The process-wide edge set. One graph serves all OrderedLocks so
+    inversions BETWEEN subsystems are visible; reset() between tests."""
+
+    def __init__(self):
+        self._mu = _REAL_LOCK()         # a real lock: the graph itself
+        self._edges: dict[tuple, str] = {}   # (a, b) -> first site
+        self.inversions: list[str] = []
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+            self.inversions.clear()
+
+    def check(self, held: list[str], acquiring: str, site: str,
+              strict: bool) -> None:
+        """Detect (and in strict mode raise on) an inversion WITHOUT
+        committing any edge — called before a blocking acquire so the
+        raise preempts the potential deadlock instead of following it."""
+        with self._mu:
+            for h in held:
+                if h == acquiring:
+                    continue
+                rev = self._edges.get((acquiring, h))
+                if rev is not None:
+                    msg = (f"lock-order inversion: acquiring "
+                           f"{acquiring!r} while holding {h!r} at {site}"
+                           f", but the opposite order was recorded at "
+                           f"{rev}")
+                    self.inversions.append(msg)
+                    if strict:
+                        raise LockOrderInversion(msg)
+
+    def commit(self, held: list[str], acquiring: str, site: str) -> None:
+        """Record held→acquiring edges after a SUCCESSFUL acquisition.
+        A failed try-acquire commits nothing: try-lock-and-back-off in
+        the "wrong" order cannot deadlock (the thread never blocks) and
+        must not poison the graph for the legitimate reverse order."""
+        with self._mu:
+            for h in held:
+                if h != acquiring and (acquiring, h) not in self._edges:
+                    self._edges.setdefault((h, acquiring), site)
+
+
+GRAPH = _OrderGraph()
+_tls = threading.local()
+
+
+def _held() -> list:
+    stack = getattr(_tls, "held", None)
+    if stack is None:
+        stack = _tls.held = []
+    return stack
+
+
+def _call_site(depth: int = 2) -> str:
+    f = sys._getframe(depth)
+    return f"{f.f_code.co_filename}:{f.f_lineno}"
+
+
+class OrderedLock:
+    """Drop-in threading.Lock/RLock replacement with order recording.
+    Duck-type-complete for `with`, Condition(lock=...), and
+    acquire/release callers."""
+
+    def __init__(self, name: str | None = None, *, reentrant: bool = False,
+                 strict: bool = True, graph: _OrderGraph | None = None):
+        self.name = name or f"anon@{_call_site(2)}"
+        self.reentrant = reentrant
+        self.strict = strict
+        self._graph = graph or GRAPH
+        self._inner = _REAL_RLOCK() if reentrant else _REAL_LOCK()
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        held = _held()
+        already = self.name in held
+        if already and not self.reentrant:
+            # a plain Lock re-acquired by its holder IS the deadlock —
+            # report deterministically instead of hanging the test
+            msg = (f"non-reentrant ordered lock {self.name!r} "
+                   f"re-acquired by its holder at {_call_site(2)}")
+            self._graph.inversions.append(msg)
+            if self.strict:
+                raise LockOrderInversion(msg)
+        site = _call_site(2)
+        if not already and blocking:
+            # pre-flight so a strict inversion raises BEFORE this thread
+            # blocks — the raise must preempt the deadlock it predicts
+            self._graph.check(held, self.name, site, self.strict)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok and not already:
+            try:
+                if not blocking:
+                    # try-acquire: detection deferred until we know it
+                    # took (a failed try-acquire is not an ordering)
+                    self._graph.check(held, self.name, site, self.strict)
+                self._graph.commit(held, self.name, site)
+            except LockOrderInversion:
+                self._inner.release()
+                raise
+        if ok:
+            held.append(self.name)
+        return ok
+
+    def release(self) -> None:
+        held = _held()
+        # remove the innermost occurrence (reentrant locks stack)
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] == self.name:
+                del held[i]
+                break
+        self._inner.release()
+
+    def __enter__(self) -> bool:
+        return self.acquire()
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        inner = self._inner
+        return inner.locked() if hasattr(inner, "locked") else False
+
+    def __repr__(self) -> str:
+        return f"<OrderedLock {self.name!r} reentrant={self.reentrant}>"
+
+
+def _factory(reentrant: bool, strict: bool, scope_root: str):
+    real = _REAL_RLOCK if reentrant else _REAL_LOCK
+
+    def make(*args, **kwargs):
+        site = sys._getframe(1)
+        fname = site.f_code.co_filename
+        if not fname.startswith(scope_root):
+            return real(*args, **kwargs)   # not our code: stay out
+        return OrderedLock(f"{os.path.relpath(fname, scope_root)}:"
+                           f"{site.f_lineno}",
+                           reentrant=reentrant, strict=strict)
+
+    return make
+
+
+def install(monkeypatch, *, strict: bool = True,
+            scope_root: str | None = None) -> _OrderGraph:
+    """Swap threading.Lock/RLock for ordering-checked factories via a
+    pytest monkeypatch (undone automatically at test end). Only locks
+    created by code under `scope_root` (default: this repo) are
+    wrapped. Returns the shared order graph; the caller asserts
+    `graph.inversions == []` at teardown."""
+    GRAPH.reset()
+    root = os.path.abspath(scope_root or _REPO_ROOT)
+    monkeypatch.setattr(threading, "Lock", _factory(False, strict, root))
+    monkeypatch.setattr(threading, "RLock", _factory(True, strict, root))
+    return GRAPH

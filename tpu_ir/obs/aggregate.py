@@ -38,6 +38,7 @@ import time
 
 import numpy as np
 
+from ..utils import envvars
 from .histogram import NUM_BUCKETS, summary_from_counts
 from .registry import SNAPSHOT_SCHEMA, get_registry
 
@@ -153,7 +154,7 @@ def gather_cluster(reset: bool = False) -> dict:
 
 def spool_dir() -> str | None:
     """The telemetry spool directory, or None when spooling is off."""
-    return os.environ.get("TPU_IR_TELEMETRY_DIR") or None
+    return envvars.get_str("TPU_IR_TELEMETRY_DIR")
 
 
 def spool_write(out_dir: str | None = None) -> str | None:
@@ -237,8 +238,8 @@ class SpoolWriter:
     def __init__(self, out_dir: str | None = None,
                  interval_s: float | None = None):
         self._dir = out_dir or spool_dir()
-        self._interval = (interval_s if interval_s is not None else float(
-            os.environ.get("TPU_IR_SPOOL_INTERVAL", "5") or 5))
+        self._interval = (interval_s if interval_s is not None
+                          else envvars.get_float("TPU_IR_SPOOL_INTERVAL"))
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
 

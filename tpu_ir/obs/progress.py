@@ -35,9 +35,10 @@ from __future__ import annotations
 import collections
 import contextlib
 import itertools
-import os
 import threading
 import time
+
+from ..utils import envvars
 
 # Version of Job.to_dict()'s shape (the /jobs payload); bump on any
 # change a poller could trip over.
@@ -45,7 +46,7 @@ JOB_SCHEMA = 1
 
 _lock = threading.Lock()
 _jobs: collections.deque = collections.deque(
-    maxlen=max(1, int(os.environ.get("TPU_IR_JOB_HISTORY", "16") or 16)))
+    maxlen=envvars.get_int("TPU_IR_JOB_HISTORY"))
 _ids = itertools.count(1)
 
 

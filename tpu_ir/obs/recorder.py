@@ -28,6 +28,7 @@ import tempfile
 import threading
 import time
 
+from ..utils import envvars
 from .registry import get_registry
 from .trace import recent_traces
 
@@ -52,11 +53,11 @@ def _next_seq() -> int:
 
 
 def _min_interval_s() -> float:
-    return float(os.environ.get("TPU_IR_FLIGHT_INTERVAL", "30") or 30)
+    return envvars.get_float("TPU_IR_FLIGHT_INTERVAL")
 
 
 def flight_dir() -> str:
-    return (os.environ.get("TPU_IR_FLIGHT_DIR")
+    return (envvars.get_str("TPU_IR_FLIGHT_DIR")
             or os.path.join(tempfile.gettempdir(), "tpu_ir_flight"))
 
 

@@ -72,7 +72,33 @@ SERVICE_LEVELS = ("full", "no_rerank", "hot_only", "shed")
 # counter for an effective-MB/s readout.
 LOAD_STAGES = ("load.verify", "load.read", "load.assemble", "load.h2d")
 
-DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES)
+# Recovery-event counter names (the `recovery.` namespace, incremented
+# via utils/report.recovery_counters()). Declared so the lint contract
+# pass (TPU303) can reject an increment of an undeclared name — a typo'd
+# counter would otherwise silently split its event stream.
+RECOVERY_COUNTER_NAMES = (
+    "retries", "retry_exhausted", "overflow_retries", "degraded_batches",
+    "deadline_expired", "device_loss", "forced_host_batches",
+    "integrity_failures", "quarantined", "quarantine_evicted",
+    "spill_integrity_discards",
+)
+
+# Serving-frontend counter names (the `serving.` namespace; the dynamic
+# families served_<level>, shed_<reason>, level_step_<dir> are declared
+# as their expansions over SERVICE_LEVELS / shed reasons / directions).
+SERVING_COUNTER_NAMES = (
+    "submitted", "degraded", "breaker_opened", "breaker_probes",
+    "served_breaker_host",
+    "served_full", "served_no_rerank", "served_hot_only",
+    "shed_level", "shed_queue_full", "shed_queue_timeout",
+    "level_step_down", "level_step_up",
+)
+
+DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
+    # bytes streamed host-to-device across all uploads (pairs with the
+    # load.h2d histogram for an effective-MB/s readout)
+    "load.h2d_bytes",
+)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(

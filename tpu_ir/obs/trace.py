@@ -33,21 +33,20 @@ surfacing as orphan roots.
 from __future__ import annotations
 
 import collections
-import os
 import threading
 import time
 from contextlib import nullcontext
 
+from ..utils import envvars
 from .registry import get_registry
 
 _tls = threading.local()
 _ring_lock = threading.Lock()
 
-_ENABLED = os.environ.get("TPU_IR_TRACE", "1") != "0"
-_SAMPLE_N = max(1, int(os.environ.get("TPU_IR_TRACE_SAMPLE", "1") or 1))
-_RING = collections.deque(
-    maxlen=max(1, int(os.environ.get("TPU_IR_TRACE_RING", "64") or 64)))
-_JAX_ANNOTATE = os.environ.get("TPU_IR_JAX_TRACE", "0") != "0"
+_ENABLED = envvars.get_bool("TPU_IR_TRACE")
+_SAMPLE_N = envvars.get_int("TPU_IR_TRACE_SAMPLE")
+_RING = collections.deque(maxlen=envvars.get_int("TPU_IR_TRACE_RING"))
+_JAX_ANNOTATE = envvars.get_bool("TPU_IR_JAX_TRACE")
 _root_seq = 0
 
 

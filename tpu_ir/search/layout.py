@@ -282,15 +282,12 @@ def cache_revalidate_mode() -> str:
     content-prove each cache hit). An integrity knob must not fail open,
     so a bogus value raises instead of silently keeping the weaker stat
     shortcut — cache loaders call this BEFORE their unreadable-cache
-    try/except so the error escapes to the operator."""
-    import os
+    try/except so the error escapes to the operator. Now a thin wrapper
+    over the declared-knob registry (utils/envvars.py) — this function
+    was the template the registry's get_choice generalizes."""
+    from ..utils import envvars
 
-    mode = os.environ.get("TPU_IR_CACHE_REVALIDATE", "stat")
-    mode = mode.strip().lower() or "stat"
-    if mode not in ("stat", "crc"):
-        raise ValueError(
-            f"TPU_IR_CACHE_REVALIDATE={mode!r}: expected 'stat' or 'crc'")
-    return mode
+    return envvars.get_choice("TPU_IR_CACHE_REVALIDATE")
 
 
 def read_cache_manifest(index_dir: str, cache_name: str, key,

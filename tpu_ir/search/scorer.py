@@ -1237,14 +1237,22 @@ class Scorer:
         elif scoring == "bm25":
             if self.layout == "dense":
                 if self._tf_matrix is None:
+                    # build OUTSIDE the lazy lock: dense_tf_matrix is a
+                    # device dispatch, and a lock held across it stalls
+                    # every concurrent lazy-state reader behind the
+                    # upload (lint TPU202). Two racing threads may both
+                    # build; the loser's copy is garbage-collected —
+                    # bounded waste, never corruption (publish is one
+                    # reference assignment under the lock).
+                    pt, pd, ptf = self._pairs
+                    tf_matrix = dense_tf_matrix(
+                        jnp.asarray(pt), jnp.asarray(pd),
+                        jnp.asarray(ptf),
+                        vocab_size=self.meta.vocab_size,
+                        num_docs=self.meta.num_docs)
                     with self._lazy_lock:
                         if self._tf_matrix is None:
-                            pt, pd, ptf = self._pairs
-                            self._tf_matrix = dense_tf_matrix(
-                                jnp.asarray(pt), jnp.asarray(pd),
-                                jnp.asarray(ptf),
-                                vocab_size=self.meta.vocab_size,
-                                num_docs=self.meta.num_docs)
+                            self._tf_matrix = tf_matrix
                 s, d = bm25_topk_dense(q, self._tf_matrix, self.df,
                                        self.doc_len, n, k=k)
             else:
@@ -1328,24 +1336,29 @@ class Scorer:
         pipeline stops here — its host cosine never needs the device
         copy, which at 10M docs would be a ~40 MB upload for nothing."""
         if self._norms_np is None:
+            # compute_doc_norms dispatches device work per chunk: run it
+            # outside the lazy lock, publish the result under it (lint
+            # TPU202 — see _topk_device_raw's tf_matrix note). _pairs_doc_tf
+            # re-enters the RLock internally for the CSR assembly.
+            pd, ptf = self._pairs_doc_tf
+            # term ids derive from the df row starts per chunk —
+            # no materialized pair_term column needed
+            norms = compute_doc_norms(None, pd, ptf, self._df_host(),
+                                      self.meta.num_docs)
             with self._lazy_lock:
                 if self._norms_np is None:
-                    pd, ptf = self._pairs_doc_tf
-                    # term ids derive from the df row starts per chunk —
-                    # no materialized pair_term column needed
-                    self._norms_np = compute_doc_norms(
-                        None, pd, ptf, self._df_host(),
-                        self.meta.num_docs)
+                    self._norms_np = norms
         return self._norms_np
 
     def _doc_norms(self):
         """Device copy of the rerank norms (the batch rerank kernels)."""
         if getattr(self, "_norms", None) is None:
+            # upload outside the lazy lock, publish under it (TPU202)
+            norms = jnp.asarray(
+                np.ascontiguousarray(self._doc_norms_host()), jnp.float32)
             with self._lazy_lock:
                 if getattr(self, "_norms", None) is None:
-                    self._norms = jnp.asarray(
-                        np.ascontiguousarray(self._doc_norms_host()),
-                        jnp.float32)
+                    self._norms = norms
         return self._norms
 
     def rerank_topk(
@@ -1394,18 +1407,20 @@ class Scorer:
             from ..parallel.sharded_tiered import put_doc_sharded
 
             if self._sharded_norm is None:
+                # host norms feed shard_slices directly — _doc_norms()
+                # would upload a device copy only to fetch it back. The
+                # sharded device_put runs OUTSIDE the lazy lock; only
+                # the reference assignment is under it (TPU202 — see
+                # _topk_device_raw's tf_matrix note).
+                norms_np = np.ascontiguousarray(self._doc_norms_host())
+                sharded_norm = put_doc_sharded(
+                    shard_slices(norms_np,
+                                 num_docs=self.meta.num_docs,
+                                 num_shards=self._mesh.devices.size),
+                    self._mesh)
                 with self._lazy_lock:
                     if self._sharded_norm is None:
-                        # host norms feed shard_slices directly —
-                        # _doc_norms() would upload a device copy only to
-                        # fetch it back
-                        norms_np = np.ascontiguousarray(
-                            self._doc_norms_host())
-                        self._sharded_norm = put_doc_sharded(
-                            shard_slices(norms_np,
-                                         num_docs=self.meta.num_docs,
-                                         num_shards=self._mesh.devices.size),
-                            self._mesh)
+                        self._sharded_norm = sharded_norm
 
             def dispatch(q):
                 # same per-block injection sites as _topk_device: the

@@ -1,0 +1,220 @@
+"""The TPU_IR_* environment-variable registry: one declaration per knob.
+
+Before ISSUE 6, 15 `TPU_IR_*` env vars were read at 15 ad-hoc
+`os.environ.get` sites across nine modules — each with its own parsing,
+its own (sometimes absent) validation, and no single place an operator
+could ask "what knobs exist?". PR 5's `cache_revalidate_mode()` showed
+the right shape for ONE var (validated, fails loudly on a bogus value,
+documented); this module generalizes it to all of them:
+
+- every variable is DECLARED here once: name, type, default, allowed
+  choices, the RUNBOOK section that documents it, and a one-line
+  description;
+- typed accessors (`get_str/get_int/get_float/get_bool/get_choice`)
+  parse + validate in one place — a malformed value raises a
+  `ValueError` naming the variable instead of a bare int() traceback
+  (or worse, a silent fall-back to the default); numeric values below a
+  declared minimum clamp to it (the pre-registry sites' `max(1, ...)`
+  idiom — several accessors run at import time, where raising would
+  kill every command before argument parsing);
+- `markdown_table()` renders the registry as the RUNBOOK's env-var
+  table, so the documentation is GENERATED from the declarations and
+  the lint contract pass (tpu_ir/lint/contracts.py, rule TPU302) pins
+  the two against drift in either direction;
+- the lint pass TPU301 rejects any raw `os.environ` read of a
+  `TPU_IR_*` name outside this file, so a new knob cannot ship
+  undeclared.
+
+Deliberately dependency-free (os + dataclasses only): the linter loads
+this module straight from its file path, keeping `tpu-ir lint` a
+pure-CPU, no-JAX command.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+_UNSET = object()
+
+
+@dataclass(frozen=True)
+class EnvVar:
+    """One declared knob. `kind` drives parsing/validation; `default` is
+    the PARSED default (what accessors return when the var is unset or
+    set to the empty string). `runbook` anchors the RUNBOOK section that
+    explains the knob — the generated table links it."""
+
+    name: str
+    kind: str                 # "str" | "int" | "float" | "bool" | "choice"
+    default: object
+    description: str
+    runbook: str              # RUNBOOK.md section anchor, e.g. "§7"
+    choices: tuple = ()       # for kind == "choice"
+    # for int/float: values below this are CLAMPED to it, not rejected —
+    # the pre-registry read sites clamped (`max(1, ...)`), and several
+    # accessors run at module import time, where a raise would take the
+    # whole CLI down before argument parsing
+    minimum: float | None = None
+
+
+REGISTRY: dict[str, EnvVar] = {}
+
+
+def _declare(name: str, kind: str, default, description: str, runbook: str,
+             *, choices: tuple = (), minimum: float | None = None) -> None:
+    REGISTRY[name] = EnvVar(name, kind, default, description, runbook,
+                            choices=choices, minimum=minimum)
+
+
+# -- the declarations (one line per knob an operator can set) ---------------
+
+_declare("TPU_IR_FAULTS", "str", None,
+         "fault-injection plan spec (site[@match]:rule entries, seed=N)",
+         "§7")
+_declare("TPU_IR_QUARANTINE_KEEP", "int", 8,
+         "corrupt artifacts kept in .quarantine/ before eviction", "§7",
+         minimum=0)
+_declare("TPU_IR_TRACE", "bool", True,
+         "0 disables spans AND every latency histogram (one flag test)",
+         "§9")
+_declare("TPU_IR_TRACE_SAMPLE", "int", 1,
+         "keep every N-th root trace in the flight-recorder ring", "§9",
+         minimum=1)
+_declare("TPU_IR_TRACE_RING", "int", 64,
+         "capacity of the recent-traces ring buffer", "§9", minimum=1)
+_declare("TPU_IR_JAX_TRACE", "bool", False,
+         "1 wraps kernel dispatches in jax.profiler named regions", "§9")
+_declare("TPU_IR_FLIGHT_DIR", "str", None,
+         "flight-recorder artifact directory (default: system temp)", "§9")
+_declare("TPU_IR_FLIGHT_INTERVAL", "float", 30.0,
+         "min seconds between two dumps for one reason (rate limit)", "§9",
+         minimum=0.0)
+_declare("TPU_IR_JOB_HISTORY", "int", 16,
+         "finished jobs kept for /jobs (the JobTracker last-K pages)",
+         "§10", minimum=1)
+_declare("TPU_IR_TELEMETRY_DIR", "str", None,
+         "telemetry spool directory enabling cross-process merge", "§10")
+_declare("TPU_IR_SPOOL_INTERVAL", "float", 5.0,
+         "seconds between background spool refreshes (SpoolWriter)", "§10",
+         minimum=0.1)
+_declare("TPU_IR_FORMAT_VERSION", "int", 2,
+         "artifact format writers emit (1 = npz rollback pin, 2 = arenas)",
+         "§12", choices=(1, 2))
+_declare("TPU_IR_LOAD_THREADS", "int", None,
+         "concurrent verified shard loads (default min(8, cores))", "§12",
+         minimum=1)
+_declare("TPU_IR_H2D_CHUNK_BYTES", "int", 64 << 20,
+         "host-to-device streaming chunk size in bytes", "§12", minimum=1)
+_declare("TPU_IR_CACHE_REVALIDATE", "choice", "stat",
+         "serving-cache revalidation: stat (trust size+mtime) or crc "
+         "(re-stream and content-prove every hit)", "§12",
+         choices=("stat", "crc"))
+
+
+def _raw(name: str) -> str | None:
+    """The raw value, with unset and empty-string both meaning 'use the
+    default' (the long-standing `or default` idiom at the old sites)."""
+    if name not in REGISTRY:
+        raise KeyError(f"undeclared environment variable {name!r}: add it "
+                       "to tpu_ir/utils/envvars.py REGISTRY")
+    v = os.environ.get(name)
+    return v if v else None
+
+
+def _bad(name: str, value: str, expected: str) -> ValueError:
+    return ValueError(f"{name}={value!r}: expected {expected}")
+
+
+def get_str(name: str, default=_UNSET) -> str | None:
+    v = _raw(name)
+    if v is None:
+        return REGISTRY[name].default if default is _UNSET else default
+    return v
+
+
+def get_int(name: str, default=_UNSET) -> int | None:
+    decl = REGISTRY.get(name)
+    v = _raw(name)
+    if v is None:
+        return decl.default if default is _UNSET else default
+    try:
+        out = int(v)
+    except ValueError:
+        raise _bad(name, v, "an integer") from None
+    if decl.choices and out not in decl.choices:
+        raise _bad(name, v, f"one of {decl.choices}")
+    if decl.minimum is not None and out < decl.minimum:
+        return int(decl.minimum)
+    return out
+
+
+def get_float(name: str, default=_UNSET) -> float | None:
+    decl = REGISTRY.get(name)
+    v = _raw(name)
+    if v is None:
+        return decl.default if default is _UNSET else default
+    try:
+        out = float(v)
+    except ValueError:
+        raise _bad(name, v, "a number") from None
+    if decl.minimum is not None and out < decl.minimum:
+        return float(decl.minimum)
+    return out
+
+
+def get_bool(name: str, default=_UNSET) -> bool:
+    """The documented 0/1 convention: "0" (exactly) is False for
+    default-True flags; any non-empty value is True for default-False
+    flags — matching the original `!= "0"` / `== "1"`-ish reads so no
+    operator setting changes meaning."""
+    decl = REGISTRY.get(name)
+    v = _raw(name)
+    if v is None:
+        return decl.default if default is _UNSET else default
+    if decl.default is True:
+        return v != "0"
+    return v not in ("0", "false", "False")
+
+
+def get_choice(name: str) -> str:
+    """Validated closed-set value (the `cache_revalidate_mode` template):
+    case/space-normalized; a value outside the declared choices raises —
+    an integrity knob must not fail open to its weaker default."""
+    decl = REGISTRY[name]
+    v = _raw(name)
+    if v is None:
+        return decl.default
+    out = v.strip().lower()
+    if out == "":
+        return decl.default
+    if out not in decl.choices:
+        raise _bad(name, v, f"one of {decl.choices}")
+    return out
+
+
+def declared_names() -> tuple:
+    """Every declared TPU_IR_* name, sorted — the contract surface the
+    lint pass (TPU301/TPU302) and the RUNBOOK table check against."""
+    return tuple(sorted(REGISTRY))
+
+
+def markdown_table() -> str:
+    """The RUNBOOK env-var table, generated from the declarations.
+    RUNBOOK §13 embeds this between `<!-- envvar-table -->` markers; the
+    lint contract pass re-renders it and fails on any drift, so the
+    docs cannot silently rot."""
+    rows = ["| variable | type | default | doc | description |",
+            "|---|---|---|---|---|"]
+    for name in declared_names():
+        d = REGISTRY[name]
+        if d.kind == "bool":
+            default = "1" if d.default else "0"
+        elif d.default is None:
+            default = "(unset)"
+        else:
+            default = str(d.default)
+        kind = (f"choice{d.choices}" if d.kind == "choice" else d.kind)
+        rows.append(f"| `{name}` | {kind} | `{default}` | {d.runbook} | "
+                    f"{d.description} |")
+    return "\n".join(rows)

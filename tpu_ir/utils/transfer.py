@@ -179,10 +179,10 @@ def stream_to_device(a, *, chunk_bytes: int | None = None,
     from ..obs import trace as obs_trace
 
     if chunk_bytes is None:
-        import os
+        from . import envvars
 
-        chunk_bytes = int(os.environ.get("TPU_IR_H2D_CHUNK_BYTES",
-                                         _STREAM_CHUNK_BYTES))
+        chunk_bytes = envvars.get_int("TPU_IR_H2D_CHUNK_BYTES",
+                                      _STREAM_CHUNK_BYTES)
     a = np.asarray(a)
     # dynamic_update_slice offsets are int32 under the default
     # x64-disabled config: past 2**31-1 elements a wrapped offset would
