@@ -572,6 +572,7 @@ def run_msmarco(args) -> dict:
         "quality_gate_enforced": n_queries >= _GATE_MIN_QUERIES,
         **eval_out,
         **prune_info,
+        **profile_breakdown(),
         "layout": scorer.layout,
         "config": "msmarco",
     }
@@ -649,6 +650,7 @@ print("WARM_JSON=" + json.dumps({{
     "init_s": round(init_s, 2),
     "index_s": round(index_s, 2),
     **bench.load_stage_breakdown(),
+    **bench.profile_breakdown(),
     **probe,
 }}))
 """
@@ -676,6 +678,39 @@ def load_stage_breakdown() -> dict:
     out["load_h2d_mbps"] = (round(h2d_bytes / (1 << 20) / h2d_s, 1)
                             if h2d_s > 0 and h2d_bytes else -1.0)
     return out
+
+
+# keys profile_breakdown emits; the warm child's copies ride into the
+# BENCH row warm_-prefixed (like the load_* stage split)
+PROFILE_KEYS = ("compile_s", "recompiles", "device_time_ms",
+                "peak_hbm_bytes")
+
+
+def profile_breakdown() -> dict:
+    """The device-cost profiling fields of a BENCH row (ISSUE 7), from
+    this process's registry: total XLA compile seconds (`compile.time`
+    sum), recompile count (same-signature compiles — the micro-batching
+    ladder's classic silent failure), per-dispatch device time
+    (`dispatch.device` p50, the pure compute+wait slice split out of
+    the host-measured `device_rtt_ms`), and peak HBM bytes (the
+    `device.peak_bytes` gauge; -1 on hosts whose backend reports no
+    memory_stats, e.g. CPU)."""
+    from tpu_ir.obs import get_registry
+
+    snap = get_registry().snapshot()
+    hists = snap.get("histograms", {})
+    comp = hists.get("compile.time", {})
+    dd = hists.get("dispatch.device", {})
+    peak = int(snap.get("gauges", {}).get("device.peak_bytes", 0))
+    return {
+        "compile_s": round((comp.get("sum_ms") or 0.0) / 1e3, 3),
+        "recompiles": int(snap.get("counters", {}).get(
+            "compile.recompiles", 0)),
+        "device_time_ms": (round(dd["p50_ms"], 3)
+                           if dd.get("count") and dd.get("p50_ms")
+                           is not None else -1.0),
+        "peak_hbm_bytes": peak if peak > 0 else -1,
+    }
 
 
 def _warm_load_subprocess(index_dir: str, cpu: bool,
@@ -719,14 +754,17 @@ def _warm_load_subprocess(index_dir: str, cpu: bool,
         "warm_index_load_s": best["index_s"],
         "warm_h2d_mbps": best.get("h2d_mbps", -1.0),
         "warm_device_rtt_ms": best.get("device_rtt_ms", -1.0),
-        # the child's own load.* stage split, warm_-prefixed so the row
-        # carries both cold (parent) and warm (child) breakdowns; the
-        # child's total load_s is excluded — it already lands above as
+        # the child's own load.* stage split and profiling fields
+        # (compile seconds / recompiles / peak HBM of a true process
+        # restart), warm_-prefixed so the row carries both cold
+        # (parent) and warm (child) breakdowns; the child's total
+        # load_s is excluded — it already lands above as
         # scorer_load_warm_s, and a warm_load_s twin would double-count
         # the total into the warm_load_* stage keys for any consumer
         # summing them
         **{f"warm_{k}": v for k, v in best.items()
-           if k.startswith("load_") and k != "load_s"},
+           if (k.startswith("load_") or k in PROFILE_KEYS)
+           and k != "load_s"},
         "warm_runs": runs,
     }
 
@@ -1171,6 +1209,7 @@ def main() -> int:
                 "config": args.config,
                 "build_only": True,
                 **phases,
+                **profile_breakdown(),
             }
             _append_history(out)
             print(json.dumps(out))
@@ -1358,6 +1397,11 @@ def main() -> int:
         **warm,
         "verify_s": round(verify_s, 2),
         "recall_at_10": recall,
+        # device-cost profiling (ISSUE 7): whole-process compile wall,
+        # recompile count, per-dispatch device time split out of
+        # device_rtt_ms, and peak HBM — cold-run side of the pair (the
+        # warm_ twins above come from the restart child)
+        **profile_breakdown(),
         "backend": backend,
         "config": args.config,
         **phases,

@@ -485,6 +485,38 @@ def test_tpu303_undeclared_counter(tmp_path):
     assert not any("'retries'" in m for m in msgs)
 
 
+def test_tpu303_undeclared_gauge(tmp_path):
+    fs = lint_src(tmp_path, """
+        from tpu_ir.obs import get_registry
+
+        def emit():
+            get_registry().set_gauge("mystery.level", 1.0)
+            get_registry().update_gauge_max("mystery.peak", 2.0)
+            get_registry().set_gauge("host.rss_bytes", 3.0)  # declared: ok
+    """, families=("contracts",))
+    msgs = [f.message for f in fs if f.rule == "TPU303"]
+    assert any("mystery.level" in m for m in msgs)
+    assert any("mystery.peak" in m for m in msgs)
+    assert not any("host.rss_bytes" in m for m in msgs)
+
+
+def test_profiled_jit_wrapped_functions_are_jit_roots():
+    """obs/profiling.py's profiled_jit is the instrumented jax.jit
+    drop-in (ISSUE 7): the index must keep treating its decorator and
+    wrapper-assignment forms as jit roots — with static_argnames
+    parsed — or every wrapped entry point silently leaves TPU1xx
+    coverage."""
+    index = PackageIndex(str(REPO / "tpu_ir"), rel_root=str(REPO))
+    fns = {f.qual: f for m in index.modules.values()
+           for f in m.functions.values()}
+    tiered = fns["tfidf_topk_tiered"]
+    assert tiered.jit_root
+    assert {"k", "num_docs"} <= set(tiered.static_params)
+    assert fns["build_postings_packed"].jit_root    # wrapper assignment
+    assert fns["_sharded_topk_jit"].jit_root
+    assert "mesh" in fns["_sharded_topk_jit"].static_params
+
+
 def test_tpu304_undeclared_fault_site(tmp_path):
     fs = lint_src(tmp_path, """
         from tpu_ir import faults

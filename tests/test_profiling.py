@@ -1,0 +1,488 @@
+"""Acceptance suite for the device-cost profiling layer (ISSUE 7).
+
+Pins the five contracts:
+
+- the shape ladder: a profiled jit compiles exactly once per abstract
+  signature — N distinct shapes = N compiles, a repeated shape adds
+  ZERO — with per-executable cost_analysis FLOPs/bytes captured;
+- recompiles are detected (same signature compiling again — the
+  fresh-jit-per-call failure mode), counted, surfaced in the /healthz
+  60 s window, and a storm past the limit writes a flight record;
+- the Gauge primitive: snapshot/reset presence semantics, last-wins vs
+  max merge policies (deterministic under permutation), Prometheus
+  exposition;
+- the scorer's dispatch span subdivides on the CPU backend:
+  dispatch.device on every dispatch, dispatch.trace/dispatch.compile
+  when a kernel call compiled, and a memory-gauge sample per dispatch;
+- `tpu-ir bench-check`: pass / breach / insufficient-history exit
+  codes on synthetic histories, direction-aware thresholds with noise
+  floors, and the tier-1 `--self-test` that skips cleanly on the young
+  checked-in history.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.request
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from tpu_ir import obs
+from tpu_ir.cli import main as cli_main
+from tpu_ir.obs import aggregate, profiling
+from tpu_ir.obs.profiling import profiled_jit
+from tpu_ir.obs.registry import TelemetryRegistry
+
+
+@pytest.fixture(autouse=True)
+def _profiling_defaults():
+    """Tests below flip the runtime profiling knobs; restore defaults
+    (the ledger itself is cleared by conftest's telemetry fixture)."""
+    yield
+    profiling.configure(enabled=True, cost=True, recompile_limit=3)
+    obs.configure(enabled=True)
+
+
+# ---------------------------------------------------------------------------
+# the shape ladder
+# ---------------------------------------------------------------------------
+
+
+def test_shape_ladder_compiles_exactly_once_per_signature():
+    f = profiled_jit(lambda x: x * 2.0, label="ladder_fn")
+    for n in (4, 8, 16):
+        f(np.zeros(n, np.float32))
+    reg = obs.get_registry()
+    assert reg.get("compile.count") == 3
+    assert reg.get("compile.recompiles") == 0
+    rep = profiling.profile_report()
+    fn = next(r for r in rep["functions"] if r["name"] == "ladder_fn")
+    assert fn["compiles"] == 3
+    assert len(fn["signatures"]) == 3
+    assert all(s["compiles"] == 1 for s in fn["signatures"])
+    # the compile wall landed in the histogram too
+    assert reg.histogram("compile.time").count == 3
+    # a REPEATED shape adds zero compiles anywhere
+    f(np.zeros(8, np.float32))
+    f(np.zeros(16, np.float32))
+    assert reg.get("compile.count") == 3
+    rep2 = profiling.profile_report()
+    fn2 = next(r for r in rep2["functions"] if r["name"] == "ladder_fn")
+    assert fn2["compiles"] == 3
+
+
+def test_static_arg_change_is_a_new_signature():
+    f = profiled_jit(lambda x, n: x * n, label="static_fn",
+                     static_argnames=("n",))
+    x = np.zeros(4, np.float32)
+    f(x, n=2)
+    f(x, n=3)
+    f(x, n=2)  # cached
+    rep = profiling.profile_report()
+    fn = next(r for r in rep["functions"] if r["name"] == "static_fn")
+    assert fn["compiles"] == 2
+    assert len(fn["signatures"]) == 2
+    assert {"n=2", "n=3"} == {
+        s["signature"].split(", ")[-1] for s in fn["signatures"]}
+
+
+def test_cost_analysis_flops_and_bytes_captured():
+    f = profiled_jit(lambda x: (x * 2.0).sum(), label="cost_fn")
+    f(np.zeros(64, np.float32))
+    rep = profiling.profile_report()
+    sig = next(r for r in rep["functions"]
+               if r["name"] == "cost_fn")["signatures"][0]
+    assert sig["flops"] is not None and sig["flops"] > 0
+    assert sig["bytes_accessed"] is not None and sig["bytes_accessed"] > 0
+    assert sig["last_compile_s"] > 0
+
+
+def test_profile_disabled_is_a_passthrough():
+    profiling.configure(enabled=False)
+    f = profiled_jit(lambda x: x + 1.0, label="disabled_fn")
+    out = f(np.zeros(4, np.float32))
+    assert np.asarray(out).shape == (4,)
+    assert obs.get_registry().get("compile.count") == 0
+    assert profiling.profile_report()["functions"] == []
+
+
+# ---------------------------------------------------------------------------
+# recompile detection + storms
+# ---------------------------------------------------------------------------
+
+
+def test_recompile_storm_counts_window_and_flight_record(
+        tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path))
+    profiling.configure(recompile_limit=2)
+    # the classic failure mode: a fresh jit per call — one signature,
+    # compiled over and over
+    for _ in range(4):
+        f = profiled_jit(lambda x: x + 1.0, label="storm_fn")
+        f(np.zeros(4, np.float32))
+    reg = obs.get_registry()
+    assert reg.get("compile.count") == 4
+    assert reg.get("compile.recompiles") == 3
+    assert profiling.recompiles_last_60s() == 3
+    rep = profiling.profile_report()
+    fn = next(r for r in rep["functions"] if r["name"] == "storm_fn")
+    assert fn["recompiles"] == 3
+    assert len(fn["signatures"]) == 1
+    # compiles 3 and 4 exceeded the limit of 2 -> storm record (the
+    # recorder's per-reason rate limit collapses them into one file)
+    records = list(tmp_path.glob("flight-*recompile_storm*.jsonl"))
+    assert records, "no recompile_storm flight record written"
+    header = json.loads(records[0].read_text().splitlines()[0])
+    assert header["reason"] == "recompile_storm"
+    assert header["extra"]["fn"] == "storm_fn"
+    assert header["compile_cache"]["recompiles"] >= 2
+    assert "memory" in header and header["memory"]["host_rss_bytes"] > 0
+
+
+def test_healthy_repeated_calls_keep_recompile_window_zero():
+    f = profiled_jit(lambda x: x * 3.0, label="healthy_fn")
+    for _ in range(5):
+        f(np.zeros(8, np.float32))
+    assert profiling.recompiles_last_60s() == 0
+    assert obs.get_registry().get("compile.recompiles") == 0
+
+
+# ---------------------------------------------------------------------------
+# gauges: snapshot / merge / exposition
+# ---------------------------------------------------------------------------
+
+
+def test_gauge_set_max_snapshot_and_reset():
+    reg = TelemetryRegistry()
+    reg.set_gauge("device.bytes_in_use", 100.0)
+    reg.update_gauge_max("device.peak_bytes", 500.0)
+    reg.update_gauge_max("device.peak_bytes", 300.0)   # peak never walks back
+    snap = reg.snapshot()
+    assert snap["gauges"]["device.bytes_in_use"] == 100.0
+    assert snap["gauges"]["device.peak_bytes"] == 500.0
+    # declared gauges are PRESENT at 0 before any sample (the contract)
+    assert snap["gauges"]["host.rss_bytes"] == 0.0
+    reg.set_gauge("custom.level", 7.0)
+    reg.reset()
+    snap2 = reg.snapshot()
+    assert snap2["gauges"]["device.peak_bytes"] == 0.0   # declared: kept at 0
+    assert "custom.level" not in snap2["gauges"]          # undeclared: dropped
+
+
+def test_gauge_merge_last_wins_and_max_policies_permutation_invariant():
+    a = TelemetryRegistry()
+    b = TelemetryRegistry()
+    a.set_gauge("device.bytes_in_use", 100.0)
+    a.update_gauge_max("device.peak_bytes", 900.0)
+    b.set_gauge("device.bytes_in_use", 250.0)
+    b.update_gauge_max("device.peak_bytes", 400.0)
+    sa, sb = a.collect_state(), b.collect_state()
+    sa["time"], sb["time"] = "2026-01-01T00:00:00", "2026-01-02T00:00:00"
+    for snaps in ([sa, sb], [sb, sa]):   # permutation invariant
+        merged = aggregate.merge_snapshots(snaps)
+        # "last": the NEWER snapshot's level wins regardless of order
+        assert merged["gauges"]["device.bytes_in_use"] == 250.0
+        # "max": the cluster-wide peak survives
+        assert merged["gauges"]["device.peak_bytes"] == 900.0
+    # snapshots without a gauges section (pre-ISSUE-7 spools) merge fine
+    del sa["gauges"]
+    merged = aggregate.merge_snapshots([sa, sb])
+    assert merged["gauges"]["device.peak_bytes"] == 400.0
+
+
+def test_warm_calls_racing_a_compiling_thread_record_no_recompile():
+    """Compile detection is thread-local (monitoring events fire on the
+    compiling thread): a warm-signature call racing another thread's
+    compiles must never be misattributed as a recompile — the false
+    recompile_storm that a process-global cache-size delta would
+    produce under concurrent serving."""
+    import threading
+
+    f = profiled_jit(lambda x: x * 2.0, label="race_fn")
+    warm = np.zeros(4, np.float32)
+    f(warm)  # compile the warm signature up front
+    stop = threading.Event()
+
+    def churn():
+        n = 5
+        while not stop.is_set():
+            f(np.zeros(n, np.float32))  # a fresh shape: compiles
+            n += 1
+
+    t = threading.Thread(target=churn)
+    t.start()
+    try:
+        for _ in range(300):
+            f(warm)
+    finally:
+        stop.set()
+        t.join()
+    assert obs.get_registry().get("compile.recompiles") == 0
+
+
+def test_idle_process_gauges_do_not_zero_the_cluster_merge():
+    # a process that never sampled memory serializes NO gauges, so its
+    # (newer) snapshot cannot last-wins-zero real levels in the merge
+    live = TelemetryRegistry()
+    live.set_gauge("device.bytes_in_use", 777.0)
+    idle = TelemetryRegistry()
+    s_live, s_idle = live.collect_state(), idle.collect_state()
+    assert s_idle["gauges"] == {}
+    s_live["time"], s_idle["time"] = ("2026-01-01T00:00:00",
+                                      "2026-01-02T00:00:00")  # idle newest
+    merged = aggregate.merge_snapshots([s_live, s_idle])
+    assert merged["gauges"]["device.bytes_in_use"] == 777.0
+    # the LOCAL snapshot keeps the presence-at-0 contract regardless
+    assert idle.snapshot()["gauges"]["device.bytes_in_use"] == 0.0
+
+
+def test_gauge_prometheus_exposition():
+    reg = TelemetryRegistry()
+    reg.set_gauge("host.rss_bytes", 12345.0)
+    text = reg.prometheus_text()
+    assert "# TYPE tpu_ir_gauge gauge" in text
+    assert 'tpu_ir_gauge{name="host.rss_bytes"} 12345.0' in text
+
+
+# ---------------------------------------------------------------------------
+# the dispatch split on a real scorer (CPU backend)
+# ---------------------------------------------------------------------------
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+
+@pytest.fixture(scope="module")
+def scorer_index(tmp_path_factory):
+    from tpu_ir.index import build_index
+
+    tmp = tmp_path_factory.mktemp("profiling")
+    body = []
+    for i in range(60):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + (i % 5)))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    corpus = tmp / "corpus.trec"
+    corpus.write_text("".join(body))
+    out = str(tmp / "idx")
+    build_index([str(corpus)], out, k=1, num_shards=2, chargram_ks=[])
+    return out
+
+
+def test_dispatch_span_subdivides_and_samples_memory(scorer_index):
+    from tpu_ir.search import Scorer
+
+    scorer = Scorer.load(scorer_index, layout="sparse")
+    q = scorer.analyze_queries(["salmon fishing"])
+    obs.clear_traces()
+    scorer.topk(q, k=5, scoring="tfidf")
+    disp = [t for c in obs.recent_traces() for t in [c]
+            if t.name == "dispatch"][-1]
+    names = [c.name for c in disp.children]
+    # every dispatch carries the device-completion wait
+    assert "dispatch.device" in names
+    kernel = next(c for c in disp.children if c.name == "kernel")
+    reg = obs.get_registry()
+    assert reg.histogram("dispatch.device").count >= 1
+    if reg.get("compile.count"):
+        # a cold kernel call: the split sub-spans ride inside the tree
+        sub = [c.name for c in kernel.children]
+        assert "dispatch.compile" in sub
+    # the per-dispatch memory sample landed (host RSS always available)
+    assert reg.get_gauge("host.rss_bytes") > 0
+    assert reg.get_gauge("host.peak_rss_bytes") >= \
+        reg.get_gauge("host.rss_bytes")
+    # repeat dispatch at the same shape: no new compiles
+    before = reg.get("compile.count")
+    scorer.topk(q, k=5, scoring="tfidf")
+    assert reg.get("compile.count") == before
+
+
+# ---------------------------------------------------------------------------
+# the report surfaces: CLI, /profile, /healthz
+# ---------------------------------------------------------------------------
+
+
+def test_profile_cli_reports_functions_and_split(capsys):
+    f = profiled_jit(lambda x: x - 1.0, label="cli_fn")
+    f(np.zeros(4, np.float32))
+    assert cli_main(["profile"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["enabled"] is True
+    names = [fn["name"] for fn in out["functions"]]
+    assert "cli_fn" in names
+    fn = out["functions"][names.index("cli_fn")]
+    assert fn["signatures"][0]["signature"] == "float32[4]"
+    assert "dispatch.device" in out["dispatch"]
+    assert "compile.time" in out["dispatch"]
+    assert "gauges" in out and "recompiles_last_60s" in out
+
+
+def test_profile_endpoint_and_healthz_window():
+    from tpu_ir.obs.server import MetricsServer
+
+    f = profiled_jit(lambda x: x * 5.0, label="http_fn")
+    f(np.zeros(4, np.float32))
+    with MetricsServer(port=0) as srv:
+        with urllib.request.urlopen(srv.url + "/profile",
+                                    timeout=10) as r:
+            prof = json.loads(r.read())
+        assert any(fn["name"] == "http_fn" for fn in prof["functions"])
+        assert prof["compile_counters"]["compile.count"] >= 1
+        with urllib.request.urlopen(srv.url + "/healthz",
+                                    timeout=10) as r:
+            health = json.loads(r.read())
+        assert health["recompiles_last_60s"] == 0
+        # the root index advertises the new endpoint
+        with urllib.request.urlopen(srv.url + "/", timeout=10) as r:
+            assert "/profile" in json.loads(r.read())["endpoints"]
+
+
+def test_flight_header_carries_memory_and_compile_cache():
+    from tpu_ir.obs.recorder import artifact_lines
+
+    f = profiled_jit(lambda x: x / 2.0, label="flight_fn")
+    f(np.zeros(4, np.float32))
+    header = json.loads(artifact_lines("unit_test")[0])
+    assert header["memory"]["host_rss_bytes"] > 0
+    assert header["compile_cache"]["compiles"] >= 1
+    assert header["compile_cache"]["functions"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# bench-check: the regression sentry
+# ---------------------------------------------------------------------------
+
+
+def _history(path: Path, rows: list) -> str:
+    path.write_text("".join(json.dumps(r) + "\n" for r in rows))
+    return str(path)
+
+
+def _rows(n: int, **last_overrides) -> list:
+    base = {"config": "ref", "backend": "cpu", "metric":
+            "docs_per_sec_indexed", "value": 300.0, "queries_per_sec":
+            50_000.0, "query_p50_ms": 10.0, "scorer_load_cold_s": 5.0,
+            "compile_s": 20.0, "recompiles": 0, "peak_hbm_bytes": -1}
+    rows = [dict(base, value=300.0 + i) for i in range(n)]
+    rows[-1].update(last_overrides)
+    return rows
+
+
+def test_bench_check_pass_exit_zero(tmp_path, capsys):
+    p = _history(tmp_path / "h.jsonl", _rows(6))
+    assert cli_main(["bench-check", "--history", p]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["status"] == "ok"
+    assert "value" in out["checked"]
+    assert out["breaches"] == []
+
+
+def test_bench_check_breach_exit_one_and_names_metric(tmp_path, capsys):
+    p = _history(tmp_path / "h.jsonl",
+                 _rows(6, queries_per_sec=10_000.0,    # −80%: breach
+                       query_p50_ms=100.0,             # 10× worse: breach
+                       compile_s=21.0))                # within tolerance
+    assert cli_main(["bench-check", "--history", p]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert out["status"] == "breach"
+    breached = {b["metric"] for b in out["breaches"]}
+    assert breached == {"queries_per_sec", "query_p50_ms"}
+
+
+def test_bench_check_noise_floor_absorbs_tiny_absolute_swings(tmp_path,
+                                                              capsys):
+    # p50 0.4 ms -> 0.6 ms is +50% relative but under the 2 ms floor:
+    # scheduler jitter, not a regression
+    rows = _rows(6)
+    for r in rows:
+        r["query_p50_ms"] = 0.4
+    rows[-1]["query_p50_ms"] = 0.6
+    p = _history(tmp_path / "h.jsonl", rows)
+    assert cli_main(["bench-check", "--history", p]) == 0
+    assert json.loads(capsys.readouterr().out)["status"] == "ok"
+
+
+def test_bench_check_envelope_absorbs_revisited_values(tmp_path, capsys):
+    # the window itself swung 100..500 on identical code (this
+    # container's measured weather): a new 150 is 50% below the median
+    # but INSIDE the observed envelope — weather, not a regression
+    rows = _rows(6)
+    for r, qps in zip(rows, (100.0, 300.0, 500.0, 450.0, 120.0, 150.0)):
+        r["queries_per_sec"] = qps
+    p = _history(tmp_path / "h.jsonl", rows)
+    assert cli_main(["bench-check", "--history", p]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["status"] == "ok"
+    # but a value the trajectory has NEVER visited still breaches
+    rows[-1]["queries_per_sec"] = 40.0
+    p = _history(tmp_path / "h.jsonl", rows)
+    assert cli_main(["bench-check", "--history", p]) == 1
+    capsys.readouterr()
+
+
+def test_bench_check_recompile_regression_breaches(tmp_path, capsys):
+    p = _history(tmp_path / "h.jsonl", _rows(6, recompiles=12))
+    assert cli_main(["bench-check", "--history", p]) == 1
+    out = json.loads(capsys.readouterr().out)
+    assert [b["metric"] for b in out["breaches"]] == ["recompiles"]
+
+
+def test_bench_check_survives_torn_binary_append(tmp_path, capsys):
+    # a writer killed mid-append can leave a partial multi-byte UTF-8
+    # sequence; the gate must skip the torn line, not traceback
+    p = tmp_path / "h.jsonl"
+    p.write_text("".join(json.dumps(r) + "\n" for r in _rows(6)))
+    with p.open("ab") as f:
+        f.write(b'{"config": "ref", "va\xc3')   # torn mid-rune
+    assert cli_main(["bench-check", "--history", str(p)]) == 0
+    assert json.loads(capsys.readouterr().out)["status"] == "ok"
+
+
+def test_bench_check_insufficient_history_exit_two(tmp_path, capsys):
+    p = _history(tmp_path / "h.jsonl", _rows(2))
+    assert cli_main(["bench-check", "--history", p]) == 2
+    out = json.loads(capsys.readouterr().out)
+    assert out["status"] == "insufficient_history"
+    # --self-test maps the same state to a clean skip
+    assert cli_main(["bench-check", "--history", p, "--self-test"]) == 0
+
+
+def test_bench_check_groups_by_config_and_backend(tmp_path, capsys):
+    # five tpu rows cannot vouch for a cpu row: comparable = same
+    # (config, backend, build_only) key only
+    rows = [dict(r, backend="tpu") for r in _rows(5)]
+    rows.append(dict(_rows(1)[0], backend="cpu"))
+    p = _history(tmp_path / "h.jsonl", rows)
+    assert cli_main(["bench-check", "--history", p]) == 2
+
+
+def test_bench_check_negative_sentinels_are_excluded(tmp_path, capsys):
+    # -1.0 means "measurement failed", not "latency of -1 ms"
+    p = _history(tmp_path / "h.jsonl", _rows(6, query_p50_ms=-1.0))
+    assert cli_main(["bench-check", "--history", p]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "query_p50_ms" not in out["checked"]
+
+
+def test_bench_check_self_test_gates_the_checked_in_history():
+    """The tier-1 gate: bench-check over the repo's own
+    BENCH_HISTORY.jsonl must exit 0 — either a genuine pass once the
+    history is deep enough, or the explicit clean skip while it is not
+    (the lint-self-check pattern: the gate gates itself)."""
+    assert cli_main(["bench-check", "--self-test"]) == 0
+
+
+def test_bench_rows_carry_the_profiling_fields():
+    import bench
+
+    f = profiled_jit(lambda x: x * 7.0, label="bench_fn")
+    f(np.zeros(4, np.float32))
+    out = bench.profile_breakdown()
+    assert set(bench.PROFILE_KEYS) <= set(out)
+    assert out["compile_s"] > 0
+    assert out["recompiles"] == 0
+    assert out["peak_hbm_bytes"] == -1   # CPU backend: no memory_stats

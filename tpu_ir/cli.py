@@ -640,6 +640,41 @@ def cmd_metrics(args) -> int:
     return 0
 
 
+def cmd_profile(args) -> int:
+    """The device-cost profiling view (obs/profiling.py): per-signature
+    compile counts with wall time and cost_analysis FLOPs/bytes, the
+    dispatch time split (trace / compile / device), memory gauges and
+    the recompile window, one JSON object. Per-process like `tpu-ir
+    stats` — meaningful from a serving/bench process or the /profile
+    endpoint of a tracked run; empty (the SHAPE is the contract) from a
+    fresh CLI invocation."""
+    from .obs.profiling import profile_report
+
+    print(json.dumps(profile_report(), sort_keys=True, default=repr))
+    return 0
+
+
+def cmd_bench_check(args) -> int:
+    """The BENCH_HISTORY.jsonl regression sentry (obs/bench_check.py):
+    compare the newest row against the trailing-window median of its
+    comparable predecessors and exit non-zero on a breach — the bench
+    trajectory as an enforced contract instead of an append-only log.
+    Exit 0 pass, 1 breach, 2 insufficient history; `--self-test` (the
+    tier-1 gate) treats insufficient history as a clean skip."""
+    from .obs.bench_check import run_check
+
+    rc, report = run_check(
+        args.history, window=args.window, min_rows=args.min_rows,
+        tolerance=args.tolerance, self_test=args.self_test)
+    print(json.dumps(report, sort_keys=True))
+    if report.get("status") == "breach":
+        for b in report.get("breaches", []):
+            print(f"bench-check: {b['metric']} = {b['value']} is worse "
+                  f"than the window median {b['median']} "
+                  f"({b['direction']} is better)", file=sys.stderr)
+    return rc
+
+
 def cmd_trace_dump(args) -> int:
     """Dump the flight-recorder state on demand: the recent-trace ring
     (per-request / per-build span trees) plus a registry snapshot, as
@@ -1021,6 +1056,33 @@ def main(argv: list[str] | None = None) -> int:
     ptd.add_argument("--out", default=None,
                      help="write the JSONL here instead of stdout")
     ptd.set_defaults(fn=cmd_trace_dump)
+
+    ppr = sub.add_parser(
+        "profile", help="device-cost profiling report: per-signature "
+                        "compile counts + FLOPs/bytes, dispatch time "
+                        "split, memory gauges, recompile window")
+    ppr.set_defaults(fn=cmd_profile)
+
+    pbc = sub.add_parser(
+        "bench-check",
+        help="BENCH_HISTORY.jsonl regression sentry: newest row vs the "
+             "trailing-window median per metric; non-zero exit on breach")
+    pbc.add_argument("--history", default=None, metavar="PATH",
+                     help="history file (default: BENCH_HISTORY.jsonl in "
+                          "the CWD, then the repo checkout)")
+    pbc.add_argument("--window", type=int, default=None,
+                     help="trailing comparable rows to median over "
+                          "(default TPU_IR_BENCH_CHECK_WINDOW)")
+    pbc.add_argument("--min-rows", type=int, default=None,
+                     help="comparable prior rows required to enforce "
+                          "(default TPU_IR_BENCH_CHECK_MIN_ROWS)")
+    pbc.add_argument("--tolerance", type=float, default=None,
+                     help="relative degradation vs the median that "
+                          "breaches (default TPU_IR_BENCH_CHECK_TOLERANCE)")
+    pbc.add_argument("--self-test", action="store_true",
+                     help="gate mode: insufficient history is a clean "
+                          "skip (exit 0) instead of exit 2")
+    pbc.set_defaults(fn=cmd_bench_check)
 
     pb = sub.add_parser(
         "serve-bench",

@@ -39,8 +39,10 @@ JIT_WRAPPERS = frozenset({
     "jax.shard_map", "jax.experimental.shard_map.shard_map",
 })
 # package-local wrapper names that behave like jit wrappers when resolved
-# by from-import (the mesh compat shim re-exports shard_map)
-JIT_WRAPPER_NAMES = frozenset({"jit", "pjit", "shard_map"})
+# by from-import (the mesh compat shim re-exports shard_map; profiled_jit
+# is obs/profiling.py's instrumented drop-in for jax.jit — same
+# static_argnames/donate kwargs, same traced-body semantics)
+JIT_WRAPPER_NAMES = frozenset({"jit", "pjit", "shard_map", "profiled_jit"})
 
 # attribute accesses that are static under tracing (never force a sync)
 STATIC_ATTRS = frozenset({
@@ -465,6 +467,12 @@ class PackageIndex:
     def _is_jit_wrapper(self, mod: ModuleInfo, func: ast.AST) -> bool:
         name = self.normalize(mod, func) if not isinstance(func, str) \
             else func
+        if isinstance(name, FuncInfo):
+            # from-imports of package-DEFINED wrappers resolve to their
+            # FuncInfo (unlike the mesh shim's shard_map re-export,
+            # which is an assignment and stays a dotted string)
+            return name.name == "profiled_jit" and \
+                name.module.endswith("obs.profiling")
         if isinstance(name, str):
             if name in JIT_WRAPPERS:
                 return True

@@ -20,7 +20,9 @@ and the (now thin) test wrappers:
   in DECLARED_COUNTERS (or the recovery./serving./fault. namespaces);
   `recovery_counters().incr()` literals in RECOVERY_COUNTER_NAMES;
   `serving_counters().incr()` / frontend `self._count()` literals in
-  SERVING_COUNTER_NAMES. Dynamic (f-string) names are skipped — their
+  SERVING_COUNTER_NAMES; `set_gauge()`/`update_gauge_max()` literals in
+  DECLARED_GAUGES (a typo'd gauge would silently split its level from
+  every scrape surface). Dynamic (f-string) names are skipped — their
   families are declared as expansions.
 - **TPU304** — every `faults.should_fire/maybe_crash/maybe_hang` site
   literal is in FAULT_SITES (the registry pre-registers its counter).
@@ -115,6 +117,7 @@ def check(index: PackageIndex, runbook_path: str | None = None,
     envvars, registry = _declared()
     declared_env = set(envvars.declared_names())
     declared_counters = set(registry.DECLARED_COUNTERS)
+    declared_gauges = set(registry.DECLARED_GAUGES)
     declared_hists = set(registry.DECLARED_HISTOGRAMS)
     fault_sites = set(registry.FAULT_SITES)
     recovery_names = set(registry.RECOVERY_COUNTER_NAMES)
@@ -214,6 +217,14 @@ def check(index: PackageIndex, runbook_path: str | None = None,
                             index, "TPU303", mod.path, node.lineno,
                             f"serving counter {name!r} is not in "
                             "SERVING_COUNTER_NAMES"))
+            # TPU303 (gauges): a set of an undeclared gauge name would
+            # ship a level no DECLARED_GAUGES-driven surface reports
+            if tail in ("set_gauge", "update_gauge_max") and not in_obs:
+                name = _const_str(node.args[0]) if node.args else None
+                if name is not None and name not in declared_gauges:
+                    findings.append(make_finding(
+                        index, "TPU303", mod.path, node.lineno,
+                        f"gauge {name!r} is not in DECLARED_GAUGES"))
             if tail == "_count" and isinstance(node.func, ast.Attribute) \
                     and isinstance(node.func.value, ast.Name) \
                     and node.func.value.id == "self":

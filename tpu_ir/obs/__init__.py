@@ -38,8 +38,11 @@ from .histogram import LatencyHistogram, bucket_index
 from .progress import current_job, report_progress, start_job
 from .recorder import flight_dir, flight_dump, reset_rate_limit
 from .registry import (
+    DECLARED_GAUGES,
     DECLARED_HISTOGRAMS,
+    DISPATCH_STAGES,
     FAULT_SITES,
+    GAUGE_MERGE,
     LOAD_STAGES,
     REQUEST_STAGES,
     SERVICE_LEVELS,
@@ -56,7 +59,15 @@ from .trace import (
     enabled,
     kernel_annotation,
     recent_traces,
+    record_span,
     trace,
+)
+from . import profiling  # noqa: E402 — needs trace/registry bound above
+from .profiling import (
+    profile_report,
+    profiled_jit,
+    recompiles_last_60s,
+    sample_memory,
 )
 
 
@@ -71,6 +82,7 @@ def reset_all() -> None:
     clear_traces()
     progress.clear_jobs()
     reset_rate_limit()
+    profiling.reset_profile()
 
 
 __all__ = [
@@ -78,9 +90,12 @@ __all__ = [
     "flight_dir", "flight_dump", "reset_rate_limit",
     "TelemetryRegistry", "get_registry", "SNAPSHOT_SCHEMA",
     "FAULT_SITES", "REQUEST_STAGES", "SERVICE_LEVELS",
-    "DECLARED_HISTOGRAMS",
+    "DECLARED_HISTOGRAMS", "DECLARED_GAUGES", "DISPATCH_STAGES",
+    "GAUGE_MERGE",
     "progress", "start_job", "report_progress", "current_job",
     "Span", "trace", "attach", "current_span", "recent_traces",
     "clear_traces", "configure", "enabled", "kernel_annotation",
-    "reset_all",
+    "record_span", "reset_all",
+    "profiling", "profiled_jit", "profile_report", "sample_memory",
+    "recompiles_last_60s",
 ]

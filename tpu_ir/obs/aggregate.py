@@ -62,9 +62,15 @@ def local_snapshot(reset: bool = False) -> dict:
 def merge_snapshots(snaps: list) -> dict:
     """Fold N raw snapshots into the cluster view: counter totals are
     sums, histogram buckets add element-wise (exact — the fixed shared
-    bucket layout is what makes distributed percentiles honest), and
-    the per-process identities ride along. Snapshots from an unknown
-    future schema are rejected loudly rather than mis-summed."""
+    bucket layout is what makes distributed percentiles honest), gauges
+    merge by their declared policy (registry.GAUGE_MERGE: "max" keeps
+    the cluster-wide peak, "last" takes the newest snapshot's level —
+    ordered by (time, seq, run_id), so the merge is deterministic under
+    any input permutation), and the per-process identities ride along.
+    Snapshots from an unknown future schema are rejected loudly rather
+    than mis-summed."""
+    from .registry import GAUGE_MERGE
+
     for s in snaps:
         if s.get("schema", 0) > SNAPSHOT_SCHEMA:
             raise ValueError(
@@ -73,6 +79,16 @@ def merge_snapshots(snaps: list) -> dict:
     counters: dict[str, int] = {}
     hist_counts: dict[str, list] = {}
     hist_sums: dict[str, float] = {}
+    gauges: dict[str, float] = {}
+    # newest-last deterministic order for the last-wins gauge policy
+    for s in sorted(snaps, key=lambda s: (s.get("time") or "",
+                                          s.get("seq", 0),
+                                          s.get("run_id") or "")):
+        for k, v in s.get("gauges", {}).items():
+            if GAUGE_MERGE.get(k) == "max":
+                gauges[k] = max(gauges.get(k, float(v)), float(v))
+            else:
+                gauges[k] = float(v)
     for s in snaps:
         for k, v in s.get("counters", {}).items():
             counters[k] = counters.get(k, 0) + int(v)
@@ -93,6 +109,7 @@ def merge_snapshots(snaps: list) -> dict:
         "schema": SNAPSHOT_SCHEMA,
         "processes": len(snaps),
         "counters": counters,
+        "gauges": gauges,
         "histograms": {n: summary_from_counts(c, hist_sums[n])
                        for n, c in sorted(hist_counts.items())},
         "per_process": [

@@ -1,0 +1,238 @@
+"""`tpu-ir bench-check`: the BENCH_HISTORY.jsonl regression sentry.
+
+BENCH_HISTORY.jsonl (bench.py appends one commit-stamped summary row per
+run) was an append-only log: a regression landed as one more line nobody
+diffed. This module turns the trajectory into an ENFORCED contract — the
+newest row is compared against the trailing-window median of its
+comparable predecessors, per metric, with noise-tolerant thresholds, and
+a breach exits non-zero so CI (or an operator) sees it the run it lands.
+
+Semantics:
+
+- **comparable** rows share the newest row's (config, backend,
+  build_only) key — a CPU-control build is never judged against TPU
+  rows, nor msmarco quality rows against ref throughput rows.
+- **window**: the last TPU_IR_BENCH_CHECK_WINDOW comparable rows before
+  the newest; fewer than TPU_IR_BENCH_CHECK_MIN_ROWS of them is
+  "insufficient history" (exit 2 — not a pass: the sentry must not
+  claim a trajectory it cannot see; `--self-test` maps this to a clean
+  skip so the gate can gate itself from day one).
+- **metrics**: the curated METRICS table — each with a direction
+  (higher/lower is better) and an absolute noise floor. Negative values
+  are failure sentinels (-1.0) and are excluded on either side.
+- **breach**: three conditions, ALL required — worse than the median
+  by more than TPU_IR_BENCH_CHECK_TOLERANCE relative (default 30%),
+  worse by more than the metric's absolute floor (so a 0.4 ms p50
+  cannot breach on scheduler jitter), and OUTSIDE the window's
+  observed envelope (worse than every prior windowed value). The
+  envelope term is what makes the sentry honest on noisy hosts: the
+  checked-in history shows ±40% run-to-run swings on IDENTICAL code
+  (container weather), so "below the median" alone would cry wolf —
+  a value the trajectory itself has already visited is weather, a
+  value it has never been is a regression.
+
+Exit codes (the CLI contract, test-pinned): 0 pass, 1 breach,
+2 insufficient history / unreadable file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from ..utils import envvars
+
+# metric -> (direction, absolute noise floor). Directions: "higher" =
+# bigger is better (throughput, quality, bandwidth), "lower" = smaller
+# is better (wall times, latencies, compile cost, memory peaks).
+METRICS: dict[str, tuple[str, float]] = {
+    # headline + throughput
+    "value": ("higher", 0.0),
+    "queries_per_sec": ("higher", 0.0),
+    "tfidf_queries_per_sec": ("higher", 0.0),
+    "bm25_queries_per_sec": ("higher", 0.0),
+    "rerank_queries_per_sec": ("higher", 0.0),
+    "top1000_queries_per_sec": ("higher", 0.0),
+    "load_h2d_mbps": ("higher", 0.0),
+    # quality (msmarco rows; the quality gate hard-fails, this trends)
+    "rerank_ndcg_at_10": ("higher", 0.0),
+    "bm25_mrr_at_10": ("higher", 0.0),
+    "recall_at_10": ("higher", 0.0),
+    "top1000_recall": ("higher", 0.0),
+    # wall / latency
+    "index_wall_s": ("lower", 1.0),
+    "index_wall_s_cold": ("lower", 2.0),
+    "query_p50_ms": ("lower", 2.0),
+    # p99 of the 50-call REPL loop is a max-of-50: on the shared-host
+    # containers the bench runs on, scheduler/GC spikes of 10-35 ms hit
+    # it with profiling on OR off (measured) — the floor sits above
+    # that weather band; the relative term still guards the TPU regime
+    # where real p99 is ~100 ms+
+    "query_p99_ms": ("lower", 50.0),
+    "scorer_load_cold_s": ("lower", 1.0),
+    "scorer_load_warm_s": ("lower", 1.0),
+    "warm_index_load_s": ("lower", 1.0),
+    "verify_s": ("lower", 0.5),
+    # device-cost profiling (ISSUE 7 row fields)
+    "compile_s": ("lower", 1.0),
+    "warm_compile_s": ("lower", 1.0),
+    "recompiles": ("lower", 2.0),
+    "warm_recompiles": ("lower", 2.0),
+    "device_time_ms": ("lower", 2.0),
+    "warm_device_time_ms": ("lower", 2.0),
+    "peak_hbm_bytes": ("lower", float(64 << 20)),
+    "warm_peak_hbm_bytes": ("lower", float(64 << 20)),
+}
+
+
+def _group_key(row: dict) -> tuple:
+    return (row.get("config"), row.get("backend"),
+            bool(row.get("build_only")))
+
+
+def _metric_value(row: dict, name: str) -> float | None:
+    v = row.get(name)
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    if v < 0:  # -1.0 = the bench's failed-measurement sentinel
+        return None
+    return float(v)
+
+
+def _median(values: list[float]) -> float:
+    s = sorted(values)
+    n = len(s)
+    return s[n // 2] if n % 2 else (s[n // 2 - 1] + s[n // 2]) / 2.0
+
+
+def read_history(path: str) -> list[dict]:
+    """Parse the jsonl, skipping unparseable lines (a torn append must
+    not wedge the gate forever). errors="replace": a partial multi-byte
+    sequence from a killed writer must surface as a skipped line, not a
+    UnicodeDecodeError out of the line iterator itself."""
+    rows = []
+    with open(path, encoding="utf-8", errors="replace") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                row = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(row, dict):
+                rows.append(row)
+    return rows
+
+
+def check_history(rows: list[dict], *, window: int | None = None,
+                  min_rows: int | None = None,
+                  tolerance: float | None = None) -> dict:
+    """THE sentry decision, pure on a parsed row list (tests feed
+    synthetic histories). Returns {"status": "ok"|"breach"|
+    "insufficient_history", "checked": N, "breaches": [...], ...}."""
+    # clamp like the env declarations do (minimum=1/0.0): the CLI flags
+    # bypass envvars validation, and prior[-0:] would silently select
+    # the ENTIRE history instead of zero rows
+    window = max(1, window if window is not None else envvars.get_int(
+        "TPU_IR_BENCH_CHECK_WINDOW"))
+    min_rows = max(1, min_rows if min_rows is not None else envvars.get_int(
+        "TPU_IR_BENCH_CHECK_MIN_ROWS"))
+    tolerance = max(0.0, tolerance if tolerance is not None
+                    else envvars.get_float("TPU_IR_BENCH_CHECK_TOLERANCE"))
+    if not rows:
+        return {"status": "insufficient_history", "reason": "empty history",
+                "rows": 0, "comparable": 0, "min_rows": min_rows}
+    newest = rows[-1]
+    key = _group_key(newest)
+    prior = [r for r in rows[:-1] if _group_key(r) == key]
+    windowed = prior[-window:]
+    out: dict = {
+        "config": newest.get("config"),
+        "backend": newest.get("backend"),
+        "build_only": bool(newest.get("build_only")),
+        "commit": newest.get("commit"),
+        "ts": newest.get("ts"),
+        "rows": len(rows),
+        "comparable": len(prior),
+        "window": len(windowed),
+        "min_rows": min_rows,
+        "tolerance": tolerance,
+    }
+    if len(windowed) < min_rows:
+        out["status"] = "insufficient_history"
+        out["reason"] = (f"{len(windowed)} comparable prior row(s) for "
+                         f"{key}, need {min_rows}")
+        return out
+    breaches, checked, skipped = [], [], []
+    for name, (direction, floor) in sorted(METRICS.items()):
+        new = _metric_value(newest, name)
+        if new is None:
+            continue
+        past = [v for v in (_metric_value(r, name) for r in windowed)
+                if v is not None]
+        if len(past) < min_rows:
+            skipped.append(name)
+            continue
+        med = _median(past)
+        if direction == "higher":
+            worse_by = med - new
+            outside_envelope = new < min(past)
+        else:
+            worse_by = new - med
+            outside_envelope = new > max(past)
+        rel_limit = med * tolerance
+        entry = {"metric": name, "value": new, "median": round(med, 4),
+                 "direction": direction, "window": len(past)}
+        checked.append(name)
+        if worse_by > rel_limit and worse_by > floor and outside_envelope:
+            entry["worse_by"] = round(worse_by, 4)
+            breaches.append(entry)
+    out["checked"] = checked
+    out["skipped"] = skipped
+    out["breaches"] = breaches
+    out["status"] = "breach" if breaches else "ok"
+    return out
+
+
+def default_history_path() -> str | None:
+    """BENCH_HISTORY.jsonl in the CWD, else next to the package (the
+    checked-in repo file `--self-test` gates on)."""
+    for cand in (
+        os.path.join(os.getcwd(), "BENCH_HISTORY.jsonl"),
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "BENCH_HISTORY.jsonl"),
+    ):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def run_check(path: str | None = None, *, window: int | None = None,
+              min_rows: int | None = None, tolerance: float | None = None,
+              self_test: bool = False) -> tuple[int, dict]:
+    """The CLI body: (exit_code, report). Exit 0 pass, 1 breach, 2
+    insufficient history or unreadable file; `--self-test` downgrades
+    insufficient history to a clean skip (exit 0), so the tier-1 gate
+    can run against the young checked-in history and harden itself as
+    rows accumulate — the lint self-check pattern."""
+    path = path or default_history_path()
+    if not path or not os.path.exists(path):
+        report = {"status": "insufficient_history",
+                  "reason": f"no history file ({path or 'not found'})"}
+        return (0 if self_test else 2), report
+    try:
+        rows = read_history(path)
+    except OSError as e:
+        return (0 if self_test else 2), {
+            "status": "insufficient_history",
+            "reason": f"unreadable history: {e}"}
+    report = check_history(rows, window=window, min_rows=min_rows,
+                           tolerance=tolerance)
+    report["history"] = path
+    if report["status"] == "ok":
+        return 0, report
+    if report["status"] == "breach":
+        return 1, report
+    return (0 if self_test else 2), report

@@ -82,6 +82,17 @@ def artifact_lines(reason: str, extra: dict | None = None,
         "time": time.strftime("%Y-%m-%dT%H:%M:%S"),
         "pid": os.getpid(),
     }
+    try:
+        # memory + compile-cache state AT the moment of failure: a
+        # post-mortem can tell an OOM-adjacent breach or a recompile
+        # storm from the header alone (lazy import — profiling imports
+        # this module for storm dumps)
+        from .profiling import compile_cache_snapshot, memory_snapshot
+
+        header["memory"] = memory_snapshot()
+        header["compile_cache"] = compile_cache_snapshot()
+    except Exception:  # noqa: BLE001 — the header must always write
+        pass
     if extra:
         header["extra"] = extra
     lines = [json.dumps(header, default=repr)]

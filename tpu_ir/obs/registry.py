@@ -94,15 +94,44 @@ SERVING_COUNTER_NAMES = (
     "level_step_down", "level_step_up",
 )
 
+# Dispatch sub-stages the device-cost profiler (obs/profiling.py)
+# subdivides the scorer's "dispatch" span into (ISSUE 7): tracing +
+# lowering, XLA backend compilation, and device execution up to
+# block_until_ready — the decomposition of the fixed per-dispatch RTT.
+DISPATCH_STAGES = ("dispatch.trace", "dispatch.compile", "dispatch.device")
+
+# Compile-observability counters: every jit compile event through the
+# profiling shim, and the subset that re-compiled an already-seen
+# abstract signature (the recompile-storm signal).
+COMPILE_COUNTER_NAMES = ("compile.count", "compile.recompiles")
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
-)
+) + COMPILE_COUNTER_NAMES
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
-    f"request.{lv}" for lv in SERVICE_LEVELS)
+    f"request.{lv}" for lv in SERVICE_LEVELS) + DISPATCH_STAGES + (
+    # wall time per compile event (trace + backend compile)
+    "compile.time",
+)
+
+# Gauges: point-in-time values (memory levels, cache sizes) — unlike
+# counters they neither accumulate nor reset-to-interval; the merge
+# policy says how N process snapshots fold into one cluster value:
+# "last" = the newest snapshot's value wins (current level), "max" =
+# the cluster-wide peak survives (high-water marks). obs/aggregate.py
+# reads this map; an undeclared gauge merges "last".
+GAUGE_MERGE = {
+    "device.bytes_in_use": "last",   # device HBM currently allocated
+    "device.peak_bytes": "max",      # high-water HBM across the run
+    "host.rss_bytes": "last",        # process resident set size
+    "host.peak_rss_bytes": "max",    # high-water RSS across the run
+    "compile.signatures": "last",    # distinct (fn, signature) pairs seen
+}
+DECLARED_GAUGES = tuple(sorted(GAUGE_MERGE))
 
 
 def _prom_name(name: str) -> str:
@@ -118,6 +147,12 @@ class TelemetryRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._counters: dict[str, int] = {n: 0 for n in DECLARED_COUNTERS}
+        self._gauges: dict[str, float] = {n: 0.0 for n in DECLARED_GAUGES}
+        # gauges a caller actually SET this interval: the local snapshot
+        # reports every declared gauge (presence contract), but only set
+        # ones cross process boundaries — a process that never sampled
+        # memory must not last-wins-zero the cluster's real levels
+        self._gauges_set: set[str] = set()
         self._hists: dict[str, LatencyHistogram] = {
             n: LatencyHistogram() for n in DECLARED_HISTOGRAMS}
         # seq: strictly monotonic per scrape/reset, NEVER zeroed — two
@@ -179,6 +214,31 @@ class TelemetryRegistry:
             self._seq += 1
             self._resets += 1
 
+    # -- gauges ------------------------------------------------------------
+
+    def set_gauge(self, name: str, value: float) -> None:
+        """Set a point-in-time level (bytes in use, RSS, cache size)."""
+        with self._lock:
+            self._gauges[name] = float(value)
+            self._gauges_set.add(name)
+
+    def update_gauge_max(self, name: str, value: float) -> None:
+        """Raise a high-water-mark gauge to `value` if it is higher —
+        the peak-memory idiom (a level sample must never WALK a peak
+        back down)."""
+        with self._lock:
+            self._gauges_set.add(name)
+            if float(value) > self._gauges.get(name, 0.0):
+                self._gauges[name] = float(value)
+
+    def get_gauge(self, name: str) -> float:
+        with self._lock:
+            return self._gauges.get(name, 0.0)
+
+    def gauges(self) -> dict[str, float]:
+        with self._lock:
+            return dict(self._gauges)
+
     # -- histograms --------------------------------------------------------
 
     def histogram(self, name: str) -> LatencyHistogram:
@@ -234,26 +294,42 @@ class TelemetryRegistry:
             meta = {"schema": SNAPSHOT_SCHEMA, "seq": self._seq,
                     "resets": self._resets, "run_id": self.run_id}
             counters = dict(self._counters)
+            gauges = dict(self._gauges)
+            gauges_set = set(self._gauges_set)
             if reset:
                 for k in list(self._counters):
                     if k in DECLARED_COUNTERS:
                         self._counters[k] = 0
                     else:
                         del self._counters[k]
+                # gauges reset with everything else: declared levels
+                # return to 0 (presence is the contract, and the next
+                # sample restores the live level), undeclared ones drop
+                for k in list(self._gauges):
+                    if k in DECLARED_GAUGES:
+                        self._gauges[k] = 0.0
+                    else:
+                        del self._gauges[k]
+                self._gauges_set.clear()
             hists = dict(self._hists)
         states = {n: (h.drain() if reset else h.state())
                   for n, h in hists.items()}
-        return counters, states, meta
+        return counters, gauges, gauges_set, states, meta
 
     def collect_state(self, reset: bool = False) -> dict:
         """The SERIALIZABLE raw snapshot: counters plus raw histogram
         bucket counts (not percentile summaries), stamped with schema /
         seq / resets / run_id. This is the cross-process exchange unit —
         obs/aggregate.py spools it, allgathers it, and merges N of them
-        bucket-wise; summaries don't merge, bucket counts do."""
-        counters, states, meta = self._collect(reset)
+        bucket-wise; summaries don't merge, bucket counts do. Gauges
+        here carry only the names a caller actually SET: an idle
+        process's declared-at-0.0 defaults must not last-wins-zero the
+        cluster's real levels in the merge."""
+        counters, gauges, gauges_set, states, meta = self._collect(reset)
         return {**meta,
                 "counters": counters,
+                "gauges": {k: v for k, v in gauges.items()
+                           if k in gauges_set},
                 "histograms": {n: {"counts": list(c), "sum_s": s}
                                for n, (c, s) in states.items()}}
 
@@ -263,9 +339,10 @@ class TelemetryRegistry:
         `reset=True` is the per-interval scrape — the explicit
         between-runs reset `tpu-ir stats`/serve-bench lacked (see
         _collect for the no-lost-update guarantee)."""
-        counters, states, meta = self._collect(reset)
+        counters, gauges, _set, states, meta = self._collect(reset)
         return {**meta,
                 "counters": counters,
+                "gauges": gauges,
                 "histograms": {n: summary_from_counts(c, s)
                                for n, (c, s) in states.items()}}
 
@@ -280,6 +357,12 @@ class TelemetryRegistry:
                     self._counters[k] = 0
                 else:
                     del self._counters[k]
+            for k in list(self._gauges):
+                if k in DECLARED_GAUGES:
+                    self._gauges[k] = 0.0
+                else:
+                    del self._gauges[k]
+            self._gauges_set.clear()
             self._seq += 1
             self._resets += 1
             hists = dict(self._hists)
@@ -296,10 +379,13 @@ class TelemetryRegistry:
         drains atomically, same as snapshot(reset=True)."""
         from .histogram import BOUNDS
 
-        counters, states, _ = self._collect(reset)
+        counters, gauges, _set, states, _ = self._collect(reset)
         lines = ["# TYPE tpu_ir_events_total counter"]
         for name, v in sorted(counters.items()):
             lines.append(f'tpu_ir_events_total{{name="{name}"}} {v}')
+        lines.append("# TYPE tpu_ir_gauge gauge")
+        for name, v in sorted(gauges.items()):
+            lines.append(f'tpu_ir_gauge{{name="{name}"}} {v!r}')
         lines.append("# TYPE tpu_ir_stage_latency_seconds histogram")
         for name in sorted(states):
             counts, sum_s = states[name]

@@ -20,6 +20,10 @@ it runs:
                         JSON; `?format=html` renders the minimal HTML
                         table echoing the reference's saved pages.
   GET /jobs/<id>        One job, JSON or `?format=html`.
+  GET /profile          The device-cost profiling report
+                        (obs/profiling.py): per-signature compile
+                        counts + FLOPs/bytes, the dispatch time split,
+                        memory gauges, recompile window.
   GET /flight           Recent flight-recorder artifact headers
                         (reason/time/seq/path), newest first.
   GET /cluster          The spool-merged cluster view (this process's
@@ -87,6 +91,15 @@ def health_snapshot() -> dict:
         "jobs_running": len(running),
         "registry_seq": get_registry().seq,
     }
+    try:
+        # a recompile storm in progress is a liveness problem (every
+        # affected dispatch pays seconds of XLA); surface the trailing
+        # window where the alerting rules already look
+        from .profiling import recompiles_last_60s
+
+        out["recompiles_last_60s"] = recompiles_last_60s()
+    except Exception:  # noqa: BLE001 — health must not 500
+        out["recompiles_last_60s"] = None
     for fe in fes:
         try:
             st = fe.stats()
@@ -205,6 +218,10 @@ class _Handler(BaseHTTPRequestHandler):
                         .encode("utf-8"), "text/html; charset=utf-8")
                 else:
                     self._json(d)
+            elif route == "/profile":
+                from .profiling import profile_report
+
+                self._json(profile_report())
             elif route == "/flight":
                 self._json({"flight_records": recent_headers()})
             elif route == "/cluster":
@@ -218,8 +235,8 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/":
                 self._json({"endpoints": ["/metrics", "/metrics.json",
                                           "/healthz", "/jobs",
-                                          "/jobs/<id>", "/flight",
-                                          "/cluster"]})
+                                          "/jobs/<id>", "/profile",
+                                          "/flight", "/cluster"]})
             else:
                 self._json({"error": "unknown endpoint"}, code=404)
         except BrokenPipeError:

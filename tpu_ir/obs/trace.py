@@ -171,6 +171,27 @@ def trace(name: str, **attrs):
     return Span(name, attrs)
 
 
+def record_span(name: str, dur_ns: int, **attrs) -> None:
+    """Record an externally-timed region as a completed child span of
+    this thread's current span, plus the histogram observation of the
+    same name — the profiling shim's compile/trace sub-spans, whose
+    durations come from jax's own monitoring events rather than a
+    context manager. Follows trace()'s discipline: with tracing
+    disabled this is one flag test and nothing is recorded."""
+    if not _ENABLED:
+        return
+    get_registry().observe(name, dur_ns / 1e9)
+    span = Span(name, attrs)
+    span.dur_ns = int(dur_ns)
+    span.start_ns = time.perf_counter_ns() - span.dur_ns
+    stack = getattr(_tls, "stack", None)
+    parent = stack[-1] if stack else None
+    if parent is not None:
+        parent.children.append(span)
+    else:
+        _push_root(span)
+
+
 def current_span() -> Span | None:
     """This thread's innermost open span (None outside any trace), the
     handle `attach()` re-parents worker threads onto."""

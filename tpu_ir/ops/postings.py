@@ -21,6 +21,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..obs.profiling import profiled_jit
+
 # Padding sentinel: sorts after every real term id.
 PAD_TERM = np.int32(np.iinfo(np.int32).max)
 
@@ -98,7 +100,7 @@ def build_postings(
                     doc_len, jnp.asarray(num_pairs, jnp.int32))
 
 
-build_postings_jit = jax.jit(
+build_postings_jit = profiled_jit(
     build_postings, static_argnames=("vocab_size", "num_docs"))
 
 # uint16 term-id padding sentinel for the slim-upload path (vocab < 65535)
@@ -134,7 +136,7 @@ def build_postings_packed(
     return build_postings(t32, doc, vocab_size=vocab_size, num_docs=num_docs)
 
 
-build_postings_packed_jit = jax.jit(
+build_postings_packed_jit = profiled_jit(
     build_postings_packed, static_argnames=("vocab_size", "num_docs"))
 
 
@@ -183,7 +185,7 @@ def reduce_weighted_postings(term, doc, tf, *, vocab_size: int):
             jnp.asarray(num_pairs, jnp.int32))
 
 
-reduce_weighted_postings_jit = jax.jit(
+reduce_weighted_postings_jit = profiled_jit(
     reduce_weighted_postings, static_argnames=("vocab_size",))
 
 

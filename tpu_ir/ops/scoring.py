@@ -29,6 +29,8 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
+from ..obs.profiling import profiled_jit
+
 PAD_QTERM = -1
 
 # cold tiers at least this wide run under a whole-block lax.cond skip (the
@@ -113,7 +115,7 @@ def dense_tf_matrix(postings_pair_term, postings_pair_doc, postings_pair_tf,
                           vocab_size=vocab_size, num_docs=num_docs)
 
 
-@partial(jax.jit, static_argnames=("k", "compat_int_idf"))
+@partial(profiled_jit, static_argnames=("k", "compat_int_idf"))
 def tfidf_topk_dense(
     q_terms: jax.Array,   # int32 [B, L], PAD_QTERM padding
     doc_matrix: jax.Array,  # f32 [V, D+1]
@@ -139,7 +141,7 @@ def tfidf_topk_dense(
     return _topk_from_scores(scores, k)
 
 
-@partial(jax.jit, static_argnames=("k", "k1", "b"))
+@partial(profiled_jit, static_argnames=("k", "k1", "b"))
 def bm25_topk_dense(
     q_terms: jax.Array,      # int32 [B, L]
     tf_matrix: jax.Array,    # f32 [V, D+1] raw tf
@@ -337,7 +339,7 @@ def _hot_stage_pruned(partial, hot_tfs, hot_max_w, q_w, rank, is_hot,
     return (scores, safe_q) if with_stats else scores
 
 
-@partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf",
+@partial(profiled_jit, static_argnames=("k", "num_docs", "compat_int_idf",
                                    "prune", "skip_hot", "hot_only"))
 def tfidf_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
@@ -395,7 +397,7 @@ def tfidf_topk_tiered(
     return _topk_from_scores(scores, k)
 
 
-@partial(jax.jit, static_argnames=("k", "num_docs", "k1", "b", "prune",
+@partial(profiled_jit, static_argnames=("k", "num_docs", "k1", "b", "prune",
                                    "skip_hot", "hot_only"))
 def bm25_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
@@ -465,7 +467,7 @@ def bm25_topk_tiered(
     return _topk_from_scores(scores, k)
 
 
-@partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
+@partial(profiled_jit, static_argnames=("k", "num_docs", "compat_int_idf"))
 def tfidf_prune_diag(
     q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
     df, n_scalar, hot_max_tf, *, num_docs: int, k: int = 10,
@@ -496,7 +498,7 @@ def _topk_over_candidates(cand_scores, cand_docnos, k):
             jnp.where(matched, docnos, 0).astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("k",))
+@partial(profiled_jit, static_argnames=("k",))
 def cosine_rerank_dense(
     q_terms: jax.Array,     # int32 [B, L]
     doc_matrix: jax.Array,  # f32 [V, D+1] (1+ln tf)
@@ -529,7 +531,7 @@ def cosine_rerank_dense(
     return _topk_over_candidates(scores, cand_docnos, k)
 
 
-@partial(jax.jit, static_argnames=("k", "num_docs"))
+@partial(profiled_jit, static_argnames=("k", "num_docs"))
 def cosine_rerank_tiered(
     q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
     df, doc_norm, n_scalar, cand_docnos, *, num_docs: int, k: int = 10,
@@ -552,7 +554,7 @@ def cosine_rerank_tiered(
     return _topk_over_candidates(cand_scores, cand_docnos, k)
 
 
-@partial(jax.jit, static_argnames=("k", "num_docs", "compat_int_idf"))
+@partial(profiled_jit, static_argnames=("k", "num_docs", "compat_int_idf"))
 def tfidf_topk_sparse(
     q_terms: jax.Array,        # int32 [B, L]
     post_docs: jax.Array,      # int32 [V, P] padded per-term postings (docnos)

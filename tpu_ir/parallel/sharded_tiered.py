@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..ops.scoring import (_lntf, _tiered_scores, _topk_over_candidates,
                            bm25_idf_weights, bm25_saturation, idf_weights)
+from ..obs.profiling import profiled_jit
 from ..search.layout import BASE_CAP, GROWTH, HOT_BUDGET, build_tiered_layout
 from .mesh import SHARD_AXIS, shard_map
 
@@ -347,8 +348,9 @@ def _unpack_local(hot_rank, hot_tfs, tier_of, row_of, doc_len, doc_base,
             doc_base.reshape(()))
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "scoring", "compat_int_idf",
-                                  "k1", "b", "dblk", "hot_only"))
+@partial(profiled_jit,
+         static_argnames=("mesh", "k", "scoring", "compat_int_idf",
+                          "k1", "b", "dblk", "hot_only"))
 def _sharded_topk_jit(q_terms, df, n_scalar, hot_rank, hot_tfs, tier_of,
                       row_of, doc_len, doc_base, tier_docs, tier_tfs, *,
                       mesh, dblk, k, scoring, compat_int_idf, k1, b,
@@ -406,8 +408,9 @@ def sharded_tiered_topk(q_terms, layout: ShardedTieredLayout, df, num_docs,
         hot_only=hot_only)
 
 
-@partial(jax.jit, static_argnames=("mesh", "k", "candidates", "k1", "b",
-                                  "dblk"))
+@partial(profiled_jit,
+         static_argnames=("mesh", "k", "candidates", "k1", "b",
+                          "dblk"))
 def _sharded_rerank_jit(q_terms, df, n_scalar, doc_norm, hot_rank, hot_tfs,
                         tier_of, row_of, doc_len, doc_base, tier_docs,
                         tier_tfs, *, mesh, dblk, k, candidates, k1, b):

@@ -32,7 +32,7 @@ from ..index import format as fmt
 from ..ops import bm25_topk_dense, dense_doc_matrix, tfidf_topk_dense
 from ..ops.scoring import dense_tf_matrix
 from ..utils.report import recovery_counters
-from ..utils.transfer import fetch_to_host, stream_to_device
+from ..utils.transfer import issue_host_copies, stream_to_device
 from .layout import build_tiered_layout
 
 # dense [V, D+1] matrix budget in elements (f32); above this use sparse CSR
@@ -863,7 +863,18 @@ class Scorer:
         dispatch reuses one compiled shape. All blocks are dispatched before
         any result is fetched, and the score / docno copies run concurrently
         — the device transport has a large fixed per-fetch latency, so
-        overlapping transfers is worth more than any compute tuning here."""
+        overlapping transfers is worth more than any compute tuning here.
+
+        Profiling (ISSUE 7): the D2H copies are issued async first (the
+        overlap above, unchanged), then the wait for device completion is
+        timed as the `dispatch.device` span — with the shim's
+        dispatch.trace/dispatch.compile this decomposes the fixed
+        per-dispatch RTT — and one memory gauge sample lands after every
+        dispatch (device bytes_in_use/peak + host RSS)."""
+        import jax
+
+        from ..obs import profiling
+
         b = arrays_pads[0][0].shape[0]
         if b == 0:
             return np.zeros((0, 0), np.float32), np.zeros((0, 0), np.int32)
@@ -878,7 +889,12 @@ class Scorer:
                     for i in range(0, padded, block)]
         else:
             outs = [dispatch(*(a for a, _ in arrays_pads))]
-        flat = fetch_to_host(*[a for pair in outs for a in pair])
+        flat_outs = [a for pair in outs for a in pair]
+        issue_host_copies(flat_outs)  # in flight before the wait, as before
+        with obs_trace("dispatch.device", blocks=len(outs)):
+            jax.block_until_ready(flat_outs)
+        profiling.sample_memory()
+        flat = [np.asarray(a) for a in flat_outs]
         parts = [(flat[i], flat[i + 1]) for i in range(0, len(flat), 2)]
         if len(parts) == 1:
             return parts[0]

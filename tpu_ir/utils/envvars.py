@@ -110,6 +110,24 @@ _declare("TPU_IR_CACHE_REVALIDATE", "choice", "stat",
          "serving-cache revalidation: stat (trust size+mtime) or crc "
          "(re-stream and content-prove every hit)", "§12",
          choices=("stat", "crc"))
+_declare("TPU_IR_PROFILE", "bool", True,
+         "0 disables the jit compile/recompile profiler (one flag test)",
+         "§14")
+_declare("TPU_IR_PROFILE_COST", "bool", True,
+         "0 skips the per-signature cost_analysis probe (FLOPs/bytes)",
+         "§14")
+_declare("TPU_IR_PROFILE_RECOMPILE_LIMIT", "int", 3,
+         "compiles of ONE signature before a recompile-storm flight dump",
+         "§14", minimum=1)
+_declare("TPU_IR_BENCH_CHECK_WINDOW", "int", 8,
+         "trailing comparable BENCH_HISTORY rows the sentry medians over",
+         "§14", minimum=1)
+_declare("TPU_IR_BENCH_CHECK_MIN_ROWS", "int", 3,
+         "comparable prior rows required before bench-check enforces",
+         "§14", minimum=1)
+_declare("TPU_IR_BENCH_CHECK_TOLERANCE", "float", 0.3,
+         "relative degradation vs the window median that breaches "
+         "bench-check", "§14", minimum=0.0)
 
 
 def _raw(name: str) -> str | None:

@@ -15,16 +15,24 @@ from functools import partial
 import numpy as np
 
 
+def issue_host_copies(arrays) -> None:
+    """Start the async D2H copy of every device array (numpy passes
+    through) — THE overlap primitive fetch_to_host and the scorer's
+    timed dispatch share, so the in-flight-together discipline has one
+    implementation."""
+    for a in arrays:
+        f = getattr(a, "copy_to_host_async", None)
+        if f is not None:
+            f()
+
+
 def fetch_to_host(*arrays) -> list[np.ndarray]:
     """Fetch any number of jax Arrays to host numpy, overlapping the copies.
 
     Plain numpy arrays pass through unchanged, so callers can mix host and
     device values.
     """
-    for a in arrays:
-        f = getattr(a, "copy_to_host_async", None)
-        if f is not None:
-            f()
+    issue_host_copies(arrays)
     return [np.asarray(a) for a in arrays]
 
 
@@ -33,12 +41,16 @@ _SLICE_CAST = None
 
 def _slice_cast(a, *, n: int, dtype):
     # the jitted callable is created once so its compilation cache persists
-    # across calls (a fresh jax.jit per call would recompile every time)
+    # across calls (a fresh jax.jit per call would recompile every time —
+    # and now also trips the profiler's recompile-storm detector)
     global _SLICE_CAST
     if _SLICE_CAST is None:
         import jax
 
-        @partial(jax.jit, static_argnames=("n", "dtype"))
+        from ..obs.profiling import profiled_jit
+
+        @partial(profiled_jit, label="transfer.slice_cast",
+                 static_argnames=("n", "dtype"))
         def run(x, *, n, dtype):
             return jax.lax.slice(x, (0,), (n,)).astype(dtype)
 
@@ -74,7 +86,10 @@ def _slice_cast_rows(a, *, n: int, dtype):
     if _SLICE_CAST_ROWS is None:
         import jax
 
-        @partial(jax.jit, static_argnames=("n", "dtype"))
+        from ..obs.profiling import profiled_jit
+
+        @partial(profiled_jit, label="transfer.slice_cast_rows",
+                 static_argnames=("n", "dtype"))
         def run(x, *, n, dtype):
             return jax.lax.slice(x, (0, 0),
                                  (x.shape[0], n)).astype(dtype)
@@ -93,7 +108,10 @@ def _slice_cast_rows_masked(a, valid_rows, *, n: int, dtype):
         import jax
         import jax.numpy as jnp
 
-        @partial(jax.jit, static_argnames=("n", "dtype"))
+        from ..obs.profiling import profiled_jit
+
+        @partial(profiled_jit, label="transfer.slice_cast_rows_masked",
+                 static_argnames=("n", "dtype"))
         def run(x, rows, *, n, dtype):
             y = jax.lax.slice(x, (0, 0), (x.shape[0], n))
             col = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
@@ -142,7 +160,10 @@ def _stream_update(buf, chunk, offset):
     if _STREAM_UPDATE is None:
         import jax
 
-        @partial(jax.jit, donate_argnums=0)
+        from ..obs.profiling import profiled_jit
+
+        @partial(profiled_jit, label="transfer.stream_update",
+                 donate_argnums=0)
         def run(b, c, o):
             return jax.lax.dynamic_update_slice(b, c, (o,))
 
@@ -213,6 +234,11 @@ def stream_to_device(a, *, chunk_bytes: int | None = None,
                 _check_crc(crc, expected_crc, label)
             out = buf.reshape(a.shape)
     get_registry().incr("load.h2d_bytes", int(a.nbytes))
+    # memory gauge sample per upload: cold-start HBM growth becomes
+    # readable from /metrics and the bench's peak_hbm_bytes
+    from ..obs.profiling import sample_memory
+
+    sample_memory()
     return out
 
 
