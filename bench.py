@@ -1365,18 +1365,24 @@ def main() -> int:
     # query phases (build.* per pipeline phase, kernel/dispatch per
     # query block) — BENCH_*.json finally carries WHERE time went, not
     # just the headline throughput
-    from tpu_ir.obs import get_registry
+    from tpu_ir.obs import get_registry, querylog
 
     stage_latency = {
         name: {k: s[k] for k in ("count", "p50_ms", "p95_ms", "p99_ms")}
         for name, s in sorted(
             get_registry().snapshot()["histograms"].items())
         if s["count"]}
+    # the query-log view of the bench's own query phases: recorded
+    # entries and how many tripped the slow-query trap (ISSUE 8) — a
+    # bench row that ran with TPU_IR_SLOW_QUERY_MS set shows offenders
+    ql = querylog.summary()
 
     out = {
         "metric": "docs_per_sec_indexed",
         "value": round(docs_per_sec, 1),
         "stage_latency": stage_latency,
+        "querylog_recorded": ql["recorded"],
+        "slow_queries": ql["slow_trapped"],
         "unit": "docs/s",
         "vs_baseline": round(docs_per_sec / BASELINE_DOCS_PER_SEC, 2),
         "index_wall_s": round(build_s, 2),

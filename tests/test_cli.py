@@ -397,3 +397,100 @@ def test_repl_trec_run_qids_advance(setup, capsys, monkeypatch):
     qids = {ln.split()[0] for ln in out.splitlines()
             if ln.endswith(" repl")}
     assert qids == {"1", "2"}
+
+
+# ---------------------------------------------------------------------------
+# the CLI smoke matrix (ISSUE 8 satellite): EVERY tpu-ir subcommand runs
+# against a tiny fixture index, exits 0, and (where the command's contract
+# is JSON) emits schema-checked JSON. The matrix is pinned complete
+# against the parser source, so a future subcommand cannot ship without a
+# direct invocation test.
+# ---------------------------------------------------------------------------
+
+
+def _smoke_matrix(index_dir: str, corpus: str, tmp) -> dict:
+    """{subcommand: (argv, required-JSON-keys | None)}; None = text/
+    human output, only the exit code is the contract."""
+    run = tmp / "smoke_run.txt"
+    run.write_text("1 Q0 D-1 1 2.0 t\n")
+    qrels = tmp / "smoke_qrels.txt"
+    qrels.write_text("1 0 D-1 1\n")
+    lines = tmp / "smoke_lines.txt"
+    lines.write_text("one line\n")
+    return {
+        "index": (["index", corpus, str(tmp / "smoke_idx"),
+                   "--no-chargrams"], {"num_docs"}),
+        "search": (["search", index_dir, "-q", "alpha"], None),
+        "inspect": (["inspect", index_dir, "-n", "2"], None),
+        "verify": (["verify", index_dir], {"ok"}),
+        "migrate-index": (["migrate-index", index_dir, "--to", "2"],
+                          {"ok", "format_version"}),
+        "warm": (["warm", index_dir], {"cache_written", "warm_load_s"}),
+        "merge": (["merge", index_dir, str(tmp / "smoke_merged"),
+                   "--no-chargrams"], {"num_docs"}),
+        "stats": (["stats"], {"recovery", "serving", "histograms"}),
+        "metrics": (["metrics"], {"counters", "histograms", "schema"}),
+        "trace-dump": (["trace-dump", "--out",
+                        str(tmp / "smoke_dump.jsonl")],
+                       {"traces", "out"}),
+        "profile": (["profile"], {"functions", "dispatch", "gauges"}),
+        "querylog": (["querylog"],
+                     {"ring", "entries", "slow_entries", "recorded"}),
+        "doctor": (["doctor", index_dir],
+                   {"metadata", "df", "shards", "tiers", "warnings"}),
+        "bench-check": (["bench-check", "--self-test"], {"status"}),
+        "serve-bench": (["serve-bench", index_dir, "--threads", "2",
+                         "--queries", "8", "--deadline", "5.0"],
+                        {"submitted", "served", "shed", "latency",
+                         "querylog"}),
+        "eval": (["eval", str(run), str(qrels)], {"map", "queries"}),
+        "pack": (["pack", str(lines), str(tmp / "smoke_packed.trec")],
+                 {"docs_packed"}),
+        "count": (["count", corpus], {"Count.DOCS"}),
+        "docno": (["docno", index_dir, "list"], None),
+        "expand": (["expand", index_dir, "al*", "--chargram-k", "2"],
+                   None),
+        "lint": (["lint"], None),
+    }
+
+
+# ONE name list drives both the parametrization and the completeness
+# pin — a new subcommand without a matrix row (or a matrix row without
+# a parametrized run) fails below instead of silently never smoking
+_SMOKE_NAMES = sorted(
+    ["index", "search", "inspect", "verify", "migrate-index", "warm",
+     "merge", "stats", "metrics", "trace-dump", "profile", "querylog",
+     "doctor", "bench-check", "serve-bench", "eval", "pack", "count",
+     "docno", "expand", "lint"])
+
+
+def test_cli_smoke_matrix_is_complete(setup):
+    """Every subcommand the parser registers has a matrix row AND a
+    parametrized smoke run (the two lists cannot drift apart)."""
+    import re as _re
+
+    import tpu_ir.cli as cli_mod
+
+    src = open(cli_mod.__file__, encoding="utf-8").read()
+    registered = set(_re.findall(r'sub\.add_parser\(\s*"([\w-]+)"', src))
+    corpus, index_dir, tmp = setup
+    matrix = _smoke_matrix(index_dir, corpus, tmp)
+    assert set(matrix) == registered, (
+        "CLI smoke matrix drifted from the registered subcommands: "
+        f"missing {registered - set(matrix)}, "
+        f"stale {set(matrix) - registered}")
+    assert set(_SMOKE_NAMES) == set(matrix), (
+        "the parametrized name list drifted from the matrix: "
+        f"{set(_SMOKE_NAMES) ^ set(matrix)}")
+
+
+@pytest.mark.parametrize("name", _SMOKE_NAMES)
+def test_cli_smoke(setup, capsys, tmp_path, name):
+    corpus, index_dir, tmp = setup
+    argv, keys = _smoke_matrix(index_dir, corpus, tmp)[name]
+    assert main(argv) == 0, name
+    out = capsys.readouterr().out
+    if keys is not None:
+        payload = json.loads(out.strip().splitlines()[-1])
+        missing = keys - set(payload)
+        assert not missing, (name, missing, sorted(payload))

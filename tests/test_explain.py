@@ -1,0 +1,333 @@
+"""Explain-vs-kernel bit-exact parity (ISSUE 8 acceptance).
+
+The contract under test: for every hit a search returns, the explain
+decomposition's float64-telescoped per-term contributions sum to the
+production kernel's reported score BIT-exactly — across the dense /
+tiered / doc-sharded layouts, tfidf / bm25 / compat-int-idf scoring,
+and the hot_only / scheduled-static-skip / runtime-prune kernel
+variants — and the explained docs appear in exactly the top-k's
+tie-break order. The decomposition is exact by construction
+(search/explain.py shares the kernels' accumulation expressions); these
+tests are the tripwire that keeps that construction true as kernels
+evolve.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_ir.index import build_index
+from tpu_ir.search import Scorer
+from tpu_ir.search.explain import explain_hits
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+# mixed shapes: hot+cold, cold-only (the scheduled static-skip path),
+# duplicate slots, unknown terms, hot-term-only, empty-after-analysis
+QUERIES = [
+    "common salmon",
+    "salmon fishing river",
+    "honey bears",
+    "salmon salmon fishing",
+    "zzznope salmon",
+    "common",
+]
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("explain")
+    body = []
+    for i in range(150):
+        # "common" in every doc -> a real hot-strip row (df = N)
+        text = "common " + " ".join(WORDS[(i + j) % len(WORDS)]
+                                    for j in range(3 + i % 7))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    corpus = tmp / "corpus.trec"
+    corpus.write_text("".join(body))
+    out = str(tmp / "idx")
+    build_index([str(corpus)], out, num_shards=3,
+                compute_chargrams=False)
+    return out
+
+
+@pytest.fixture(scope="module")
+def scorers(index_dir):
+    out = {
+        "dense": Scorer.load(index_dir, layout="dense"),
+        "sparse": Scorer.load(index_dir, layout="sparse"),
+        "sharded": Scorer.load(index_dir, layout="sharded"),
+    }
+    hr = np.asarray(out["sparse"].hot_rank)
+    assert (hr >= 0).sum() >= 1, "fixture must have a non-empty hot strip"
+    return out
+
+
+def _check_hits(scorer, res, texts, *, expect_explained: int) -> int:
+    """The parity core: every explained hit's contribution sum equals
+    the reported score bit-exactly, and explain order IS result order
+    (tie-breaks included)."""
+    checked = 0
+    for r, text in zip(res, texts):
+        assert r.explain is not None or not r
+        for (key, score), e in zip(r, r.explain or []):
+            assert e["contribution_sum"] == e["score"] == score, (
+                text, key, e["score"], e["contribution_sum"], score)
+            assert scorer.mapping.get_docno(key) == e["docno"]
+            assert len(e["terms"]) == e["terms"][-1]["slot"] + 1 \
+                if e["terms"] else True
+            checked += 1
+    assert checked >= expect_explained
+    return checked
+
+
+@pytest.mark.parametrize("layout", ["dense", "sparse", "sharded"])
+@pytest.mark.parametrize("scoring", ["tfidf", "bm25"])
+def test_explain_sums_bit_exact_per_layout_and_scoring(
+        scorers, layout, scoring):
+    s = scorers[layout]
+    res = s.search_batch(QUERIES, k=5, scoring=scoring, explain_k=3)
+    _check_hits(s, res, QUERIES, expect_explained=8)
+
+
+def test_explain_compat_int_idf(index_dir):
+    s = Scorer.load(index_dir, layout="sparse", compat_int_idf=True)
+    res = s.search_batch(QUERIES[:3], k=5, explain_k=2)
+    _check_hits(s, res, QUERIES[:3], expect_explained=4)
+
+
+@pytest.mark.parametrize("layout", ["sparse", "sharded"])
+def test_explain_hot_only_variant(scorers, layout):
+    """The overload ladder's cheapest level: only the hot strip scores,
+    and the decomposition must still reproduce those partial scores
+    bit-exactly (bm25 — the hot term's tfidf idf is 0 at df == N)."""
+    s = scorers[layout]
+    res = s.search_batch(["common salmon"], k=5, scoring="bm25",
+                         hot_only=True, explain_k=3)
+    n = _check_hits(s, res, ["common salmon"], expect_explained=1)
+    assert n >= 1
+    e = res[0].explain[0]
+    assert e["dispatch"]["hot_only"] is True
+    # only the hot term contributes at this level
+    by_term = {t["term"]: t for t in e["terms"]}
+    assert by_term["common"]["placement"] == "hot" or \
+        by_term["common"].get("shard") is not None
+    assert by_term["salmon"]["contribution"] == 0.0
+
+
+def test_explain_scheduled_static_skip_path(scorers):
+    """The NOTES round-5 production MaxScore specialization: a hot-free
+    query is dispatched on the STATIC skip_hot kernel; explain must
+    follow it there (same flags, same floats) and say so."""
+    s = scorers["sparse"]
+    res = s.search_batch(["salmon fishing river"], k=5, scoring="bm25",
+                         explain_k=2)
+    e = res[0].explain[0]
+    assert e["dispatch"]["prune_scheduling"] is True
+    assert e["dispatch"]["has_hot_terms"] is False
+    assert e["dispatch"]["skip_hot"] is True
+    _check_hits(s, res, ["x"], expect_explained=2)
+    # and the mixed query takes the full kernel
+    res2 = s.search_batch(["common salmon"], k=5, scoring="bm25",
+                          explain_k=1)
+    assert res2[0].explain[0]["dispatch"]["skip_hot"] is False
+
+
+@pytest.mark.parametrize("layout", ["dense", "sparse", "sharded"])
+def test_explain_rerank_decomposes_cosine_stage(scorers, layout):
+    """Two-stage retrieval: the reported score is the cosine stage's —
+    explain decomposes THAT bit-exactly and carries the stage-1 BM25
+    score + delta."""
+    s = scorers[layout]
+    res = s.search_batch(["salmon fishing"], k=5, rerank=25,
+                         explain_k=3)
+    n = _check_hits(s, res, ["salmon fishing"], expect_explained=2)
+    assert n >= 2
+    for e in res[0].explain:
+        rr = e["rerank"]
+        assert rr["in_candidates"] is True and rr["candidates"] == 25
+        assert rr["stage1_score"] > 0
+        # delta is exact in float64 over the two f32 stage scores
+        assert np.float64(rr["stage1_score"]) + np.float64(rr["delta"]) \
+            == pytest.approx(np.float64(e["score"]), abs=0)
+
+
+def test_explain_metadata_fields(scorers):
+    """tf/df/idf/length-norm/placement ride along and are consistent
+    with the host arrays."""
+    s = scorers["sparse"]
+    e = s.search_batch(["common salmon"], k=3, scoring="bm25",
+                       explain_k=1)[0].explain[0]
+    assert e["k1"] == 0.9 and e["b"] == 0.4
+    assert e["doc_len"] > 0 and e["avg_doc_len"] > 0
+    assert 0 < e["dl_norm"] < 3
+    df_host = np.asarray(s.df)
+    for t in e["terms"]:
+        assert t["df"] == int(df_host[t["term_id"]])
+        assert t["tf"] >= 1  # every explained hit matched both terms
+    by_term = {t["term"]: t for t in e["terms"]}
+    assert by_term["common"]["placement"] == "hot"
+    assert by_term["common"]["df"] == s.meta.num_docs
+    assert by_term["salmon"]["placement"].startswith("tier:")
+
+
+def test_explain_public_api_and_edge_cases(scorers):
+    s = scorers["sparse"]
+    res = s.search(
+        "honey bears", k=1, scoring="bm25")
+    key = res[0][0]
+    e = s.explain("honey bears", key, scoring="bm25")
+    assert e["docid"] == key
+    assert e["contribution_sum"] == e["score"] == res[0][1]
+
+    # unknown-terms-only query: empty decomposition, score 0
+    e0 = explain_hits(s, "zzznope qqqnope", [1], scoring="bm25")[0]
+    assert e0["terms"] == [] and e0["score"] == 0.0
+    assert e0["contribution_sum"] == 0.0
+
+    # out-of-range docno: structured error entry, no crash
+    bad = explain_hits(s, "honey", [10 ** 6], scoring="bm25")[0]
+    assert "error" in bad
+
+    # rerank explain of a doc outside the candidate set is tagged
+    cand_out = explain_hits(s, "honey bears",
+                            [s.meta.num_docs], rerank=5)
+    assert cand_out[0]["rerank"]["in_candidates"] in (True, False)
+
+
+def test_degraded_results_carry_no_explain(scorers):
+    import tpu_ir.faults as faults
+
+    s = scorers["sparse"]
+    faults.install(faults.parse_plan("score.device_loss:first@1"))
+    try:
+        res = s.search_batch(["honey bears"], k=3, scoring="bm25",
+                             deadline_s=5.0, explain_k=2)
+    finally:
+        faults.clear()
+    assert res[0].degraded
+    assert res[0].explain is None
+
+
+# ---------------------------------------------------------------------------
+# the runtime-prune variant (ops-level: production never passes prune=True,
+# so the parity pin runs against the kernels directly, engagement proven
+# via the diag — the test_maxscore fixture technique)
+# ---------------------------------------------------------------------------
+
+
+from tpu_ir.ops.scoring import (  # noqa: E402
+    MAXSCORE_CAND,
+    bm25_scores_at_tiered,
+    bm25_topk_tiered,
+    tfidf_prune_diag,
+    tfidf_scores_at_tiered,
+    tfidf_topk_tiered,
+)
+from tpu_ir.search.layout import build_tiered_layout  # noqa: E402
+
+NDOCS = 2 * MAXSCORE_CAND + 500
+
+
+def _zipf_pairs(vocab=2000, ndocs=NDOCS, n_occ=90_000, seed=5):
+    rng = np.random.default_rng(seed)
+    p = 1.0 / np.arange(1, vocab + 1)
+    p /= p.sum()
+    t = rng.choice(vocab, n_occ, p=p).astype(np.int64)
+    d = rng.integers(1, ndocs + 1, n_occ).astype(np.int64)
+    key, tf = np.unique(t * (ndocs + 1) + d, return_counts=True)
+    pair_doc = (key % (ndocs + 1)).astype(np.int32)
+    pair_tf = tf.astype(np.int32)
+    df = np.bincount((key // (ndocs + 1)).astype(np.int32),
+                     minlength=vocab).astype(np.int32)
+    return pair_doc, pair_tf, df
+
+
+@pytest.fixture(scope="module")
+def prune_layout():
+    pair_doc, pair_tf, df = _zipf_pairs()
+    lay = build_tiered_layout(pair_doc, pair_tf, df, num_docs=NDOCS,
+                              hot_budget=24 * (NDOCS + 1))
+    args = (jnp.asarray(lay.hot_rank), lay.hot_device(),
+            jnp.asarray(lay.tier_of), jnp.asarray(lay.row_of),
+            tuple(jnp.asarray(a) for a in lay.tier_docs),
+            tuple(jnp.asarray(a) for a in lay.tier_tfs))
+    hot_max_tf = jnp.max(args[1], axis=1)
+    return df, lay, args, hot_max_tf
+
+
+def _safe_queries(df, lay, seed=11):
+    hot = np.nonzero(lay.hot_rank >= 0)[0]
+    hottest = int(hot[np.argmax(df[hot])])
+    cold_mid = np.nonzero((lay.hot_rank < 0) & (df >= 30)
+                          & (df <= 200))[0]
+    rng = np.random.default_rng(seed)
+    rows = []
+    for i in range(8):
+        if i % 2 == 0:
+            rows.append([int(rng.choice(cold_mid)),
+                         int(rng.choice(cold_mid)), -1])
+        else:
+            rows.append([hottest, int(rng.choice(cold_mid)),
+                         int(rng.choice(cold_mid))])
+    return np.array(rows, np.int32)
+
+
+@pytest.mark.parametrize("scoring", ["tfidf", "bm25"])
+def test_prune_variant_gather_and_telescope_bit_exact(
+        prune_layout, scoring):
+    """With the pruned branch PROVABLY engaged (diag-certified), the
+    explain gather variant must return the pruned kernel's exact floats
+    for the returned docs, and the prefix-telescoped contributions must
+    sum to them bit-exactly."""
+    df, lay, args, hot_max_tf = prune_layout
+    q = _safe_queries(df, lay)
+    dfj, n = jnp.asarray(df), jnp.int32(NDOCS)
+    safe = np.asarray(tfidf_prune_diag(
+        jnp.asarray(q), *args, dfj, n, hot_max_tf, num_docs=NDOCS, k=10))
+    assert safe.all(), "constructed-safe batch must engage pruning"
+
+    if scoring == "tfidf":
+        s1, d1 = tfidf_topk_tiered(jnp.asarray(q), *args, dfj, n,
+                                   hot_max_tf, num_docs=NDOCS, k=10,
+                                   prune=True)
+        got = tfidf_scores_at_tiered(jnp.asarray(q), *args, dfj, n, d1,
+                                     hot_max_tf, num_docs=NDOCS,
+                                     prune_k=10, prune=True)
+    else:
+        dl = jnp.asarray(
+            np.random.default_rng(0).integers(
+                5, 50, NDOCS + 1).astype(np.int32))
+        s1, d1 = bm25_topk_tiered(jnp.asarray(q), *args, dfj, dl, n,
+                                  hot_max_tf, num_docs=NDOCS, k=10,
+                                  prune=True)
+        got = bm25_scores_at_tiered(jnp.asarray(q), *args, dfj, dl, n,
+                                    d1, hot_max_tf, num_docs=NDOCS,
+                                    prune_k=10, prune=True)
+    s1, d1, got = np.asarray(s1), np.asarray(d1), np.asarray(got)
+    valid = d1 > 0
+    assert valid.any()
+    np.testing.assert_array_equal(got[valid], s1[valid])
+
+    # telescoped per-slot contributions on the pruned kernel: prefix
+    # rows of the first query, gathered at its top doc
+    qi = 0
+    ids = [int(t) for t in q[qi] if t >= 0]
+    qp = np.full((len(ids) + 1, q.shape[1]), -1, np.int32)
+    for j in range(1, len(ids) + 1):
+        qp[j, :j] = ids[:j]
+    cand = np.tile(d1[qi : qi + 1, :1], (len(qp), 1))
+    if scoring == "tfidf":
+        prefix = np.asarray(tfidf_scores_at_tiered(
+            jnp.asarray(qp), *args, dfj, n, jnp.asarray(cand),
+            hot_max_tf, num_docs=NDOCS, prune_k=10, prune=True))
+    else:
+        prefix = np.asarray(bm25_scores_at_tiered(
+            jnp.asarray(qp), *args, dfj, dl, n, jnp.asarray(cand),
+            hot_max_tf, num_docs=NDOCS, prune_k=10, prune=True))
+    col = prefix[:, 0].astype(np.float64)
+    contribs = [col[j] - col[j - 1] for j in range(1, len(ids) + 1)]
+    assert float(np.sum(contribs)) == float(s1[qi, 0])

@@ -1,0 +1,350 @@
+"""Query-log + slow-query-trap acceptance (ISSUE 8).
+
+Pins the four contracts of tpu_ir.obs.querylog:
+
+- recording: every Scorer-answered query lands one entry with the
+  attribution fields (hash/terms, level, stage split, batch id, top-k,
+  prune decision); sampling and the ring bound hold; redaction strips
+  readable terms but keeps the hash; the frontend's request_context
+  stamps the ladder's true level;
+- the slow-query trap: a forced slow query produces a capture with the
+  request's span tree + a bit-exact explain + a `slow_query` flight
+  record (readable via `tpu-ir querylog` and /querylog), the explain
+  cost rides the flight recorder's rate gate, and flight-record
+  headers carry the compact last-K slow entries;
+- the scrape surfaces: /querylog, /doctor, /healthz's
+  slow_queries_last_60s, and the cross-linked HTML nav;
+- overhead: the always-on steady state costs <= 5% on the serve soak
+  (same guard style as PR 3's <= 10% tracing pin).
+"""
+
+import json
+import time
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import tpu_ir.faults as faults
+from tpu_ir import obs
+from tpu_ir.index import build_index
+from tpu_ir.obs import querylog
+from tpu_ir.search import Scorer
+from tpu_ir.serving import ServingConfig, ServingFrontend
+from tpu_ir.serving.soak import make_queries
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+
+@pytest.fixture(autouse=True)
+def _restore_querylog_config():
+    yield
+    querylog.configure(enabled=True, sample=1, ring_capacity=256,
+                       redact=False, slow_ms=0.0, slow_keep=16)
+    obs.configure(enabled=True)
+    faults.clear()
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("querylog")
+    body = []
+    for i in range(100):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + i % 7))
+        body.append(f"<DOC>\n<DOCNO> Q-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    corpus = tmp / "corpus.trec"
+    corpus.write_text("".join(body))
+    out = str(tmp / "idx")
+    build_index([str(corpus)], out, num_shards=2,
+                compute_chargrams=False)
+    return out
+
+
+@pytest.fixture(scope="module")
+def scorer(index_dir):
+    s = Scorer.load(index_dir, layout="sparse")
+    s.search_batch(["salmon fishing"], k=5, scoring="bm25")
+    s.search_batch(["salmon fishing"], k=5, scoring="tfidf")
+    s.search_batch(["salmon fishing"], k=5, rerank=25)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# recording
+# ---------------------------------------------------------------------------
+
+
+def test_entries_carry_attribution_fields(scorer):
+    res = scorer.search_batch(["salmon fishing", "honey bears"], k=5,
+                              scoring="bm25")
+    entries = querylog.recent()
+    assert len(entries) == 2
+    a, b = entries
+    assert a["batch_id"] == b["batch_id"] and a["batch_size"] == 2
+    for e, text in zip(entries, ("salmon fishing", "honey bears")):
+        assert e["level"] == "full" and e["degraded"] is False
+        assert e["scoring"] == "bm25" and e["k"] == 5
+        assert e["n_terms"] == 2 and len(e["query_hash"]) == 8
+        assert e["total_ms"] >= e["dispatch_ms"] >= 0
+        assert "analyze_ms" in e
+        assert e["prune"]["dispatch_mode"] in ("all_skip", "all_full",
+                                               "split")
+        assert isinstance(e["prune"]["has_hot"], bool)
+    # top-k docids + scores match the results
+    assert entries[0]["top"][0][0] == res[0][0][0]
+    assert entries[0]["top"][0][1] == pytest.approx(res[0][0][1],
+                                                    abs=1e-6)
+    assert entries[0]["terms"] == ["salmon", "fish"]
+
+
+def test_sampling_keeps_every_nth(scorer):
+    querylog.configure(sample=3)
+    for i in range(9):
+        scorer.search_batch([f"salmon query{i}"], k=2)
+    assert len(querylog.recent()) == 3
+    # the registry counter counts KEPT entries (the scrape contract)
+    assert obs.get_registry().get("querylog.recorded") == 3
+
+
+def test_ring_is_bounded(scorer):
+    querylog.configure(ring_capacity=4)
+    for i in range(10):
+        scorer.search_batch(["honey"], k=2)
+    assert len(querylog.recent()) == 4
+
+
+def test_redaction_strips_terms_keeps_hash(scorer):
+    querylog.configure(redact=True)
+    scorer.search_batch(["salmon fishing"], k=3)
+    e = querylog.recent()[-1]
+    assert "terms" not in e
+    assert len(e["query_hash"]) == 8
+    querylog.configure(redact=False)
+    scorer.search_batch(["salmon fishing"], k=3)
+    e2 = querylog.recent()[-1]
+    # the hash is the stable join key across the redaction switch
+    assert e2["query_hash"] == e["query_hash"]
+    assert e2["terms"] == ["salmon", "fish"]
+
+
+def test_frontend_context_stamps_true_level(scorer):
+    with querylog.request_context(level="no_rerank", queue_depth=3):
+        scorer.search_batch(["honey bears"], k=3)
+    e = querylog.recent()[-1]
+    assert e["level"] == "no_rerank" and e["queue_depth"] == 3
+
+
+def test_phrase_queries_record_slim_entries(index_dir, tmp_path):
+    """Phrase queries run on the host pipeline; they still land in the
+    log (positions-built index)."""
+    corpus = tmp_path / "c.trec"
+    corpus.write_text(
+        "<DOC>\n<DOCNO> P-1 </DOCNO>\n<TEXT>\nsalmon river fishing\n"
+        "</TEXT>\n</DOC>\n"
+        "<DOC>\n<DOCNO> P-2 </DOCNO>\n<TEXT>\nriver salmon\n</TEXT>\n"
+        "</DOC>\n")
+    idx = str(tmp_path / "pidx")
+    build_index([str(corpus)], idx, compute_chargrams=False,
+                positions=True)
+    s = Scorer.load(idx)
+    res = s.search_batch(['"salmon river"'], k=5)
+    assert res[0]
+    e = querylog.recent()[-1]
+    assert e.get("phrase") is True and e["total_ms"] >= 0
+    assert e["top"][0][0] == res[0][0][0]
+
+
+def test_disabled_querylog_records_nothing(scorer):
+    querylog.configure(enabled=False)
+    scorer.search_batch(["salmon"], k=2)
+    assert querylog.recent() == []
+    assert querylog.summary()["enabled"] is False
+
+
+# ---------------------------------------------------------------------------
+# the slow-query trap
+# ---------------------------------------------------------------------------
+
+
+def test_slow_query_trap_end_to_end(scorer, tmp_path, monkeypatch):
+    """THE acceptance pin: a forced slow query produces a flight record
+    containing its explain + span tree, reachable via `tpu-ir querylog`
+    and /querylog."""
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path))
+    querylog.configure(slow_ms=0.0001)   # everything is slow
+    obs.reset_rate_limit()
+    frontend = ServingFrontend(scorer)
+    res = frontend.search("salmon fishing", k=5, scoring="bm25")
+    assert res.level == "full"
+    caps = querylog.slow_recent()
+    assert caps, "no slow capture"
+    cap = caps[-1]
+    assert cap["slow"] is True
+    # span tree: the frontend's still-open request root
+    assert cap["span_tree"]["name"] == "request"
+    assert any(c["name"] == "dispatch"
+               for c in cap["span_tree"]["children"])
+    # explain: bit-exact decomposition of the top hit
+    ex = cap["explain"][0]
+    assert ex["contribution_sum"] == ex["score"] == res[0][1]
+    # flight record on disk, explain + slow window in the header
+    path = cap["flight_record"]
+    assert path and Path(path).exists()
+    recs = [json.loads(line) for line in open(path)]
+    header = recs[0]
+    assert header["reason"] == "slow_query"
+    assert header["extra"]["slow_query"]["explain"][0]["score"] == \
+        ex["score"]
+    assert header["slow_queries"] and \
+        header["slow_queries"][-1]["query_hash"] == cap["query_hash"]
+    assert recs[-1]["record"] == "telemetry"
+    # the registry counters + the health window see it
+    assert obs.get_registry().get("querylog.slow") >= 1
+    assert querylog.slow_last_60s() >= 1
+
+    # ... and the CLI surfaces the capture
+    from tpu_ir.cli import main
+    import io, contextlib
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main(["querylog", "--slow"]) == 0
+    out = json.loads(buf.getvalue())
+    assert out["slow_entries"][-1]["query_hash"] == cap["query_hash"]
+    assert out["slow_entries"][-1]["explain"][0]["score"] == ex["score"]
+
+
+def test_slow_trap_explain_rides_the_rate_gate(scorer, tmp_path,
+                                               monkeypatch):
+    """A storm of slow queries must not multiply load with explain
+    dispatches: only a dump the per-reason rate limit admits computes
+    one."""
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path))
+    querylog.configure(slow_ms=0.0001)
+    obs.reset_rate_limit()
+    scorer.search_batch(["salmon fishing"], k=3, scoring="bm25")
+    scorer.search_batch(["honey bears"], k=3, scoring="bm25")
+    caps = querylog.slow_recent()
+    assert len(caps) == 2
+    assert caps[0].get("explain") and caps[0]["flight_record"]
+    # second offender inside the interval: captured, but no explain
+    # dispatches and no second artifact
+    assert caps[1].get("explain") is None
+    assert caps[1]["flight_record"] is None
+    assert len(list(Path(tmp_path).glob("*slow_query.jsonl"))) == 1
+
+
+def test_slow_capture_without_frontend_uses_ring_span(scorer, tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path))
+    querylog.configure(slow_ms=0.0001)
+    obs.reset_rate_limit()
+    scorer.search_batch(["salmon fishing"], k=3, scoring="bm25")
+    cap = querylog.slow_recent()[-1]
+    assert cap.get("span_tree") is not None
+    assert cap.get("span_tree_source") == "ring"
+
+
+# ---------------------------------------------------------------------------
+# scrape surfaces: /querylog, /doctor, /healthz, nav
+# ---------------------------------------------------------------------------
+
+
+def _get(url: str) -> bytes:
+    import urllib.request
+
+    return urllib.request.urlopen(url, timeout=10).read()
+
+
+def test_server_querylog_doctor_healthz_and_nav(scorer, index_dir):
+    from tpu_ir.obs.server import start_server
+
+    scorer.search_batch(["salmon fishing"], k=3)
+    srv = start_server(port=0)
+    try:
+        ql = json.loads(_get(f"{srv.url}/querylog"))
+        assert ql["ring"]["capacity"] >= 1
+        assert ql["entries"][-1]["query_hash"]
+        ql_slow = json.loads(_get(f"{srv.url}/querylog?slow=1"))
+        assert "entries" not in ql_slow and "slow_entries" in ql_slow
+
+        h = json.loads(_get(f"{srv.url}/healthz"))
+        assert h["slow_queries_last_60s"] is not None
+
+        dr = json.loads(_get(f"{srv.url}/doctor"))
+        assert index_dir in list(dr["indexes"]) or dr["indexes"]
+        rep = list(dr["indexes"].values())[0]
+        assert "tiers" in rep and "shards" in rep
+        # a second scrape serves the cached report (same object shape)
+        dr2 = json.loads(_get(f"{srv.url}/doctor"))
+        assert dr2 == dr
+        # unregistered paths are refused, not read
+        bad = json.loads(_get(f"{srv.url}/doctor?index=/etc"))
+        assert "error" in bad
+
+        # nav cross-links on every HTML page
+        for page in ("/jobs?format=html", "/querylog?format=html",
+                     "/doctor?format=html", "/profile?format=html"):
+            html = _get(f"{srv.url}{page}").decode()
+            for target in ("/querylog?format=html", "/doctor?format=html",
+                           "/jobs?format=html", "/profile?format=html",
+                           "/healthz"):
+                assert target in html, (page, target)
+    finally:
+        srv.stop()
+
+
+def test_querylog_counters_are_declared(scorer):
+    """Lint TPU303 contract: the querylog names are declared, so the
+    registry pre-registers them and the scrape surfaces always show
+    them (the coverage-by-construction idiom)."""
+    names = set(obs.get_registry().counter_names())
+    assert {"querylog.recorded", "querylog.slow"} <= names
+    assert "querylog.slow_capture" in obs.DECLARED_HISTOGRAMS
+    assert "explain" in obs.DECLARED_HISTOGRAMS
+
+
+def test_serve_bench_report_carries_querylog(index_dir, capsys):
+    from tpu_ir.cli import main
+
+    rc = main(["serve-bench", index_dir, "--threads", "2", "--queries",
+               "12", "--deadline", "5.0"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["querylog"]["recorded"] >= 12
+    assert "slow_entries" in out["querylog"]
+
+
+# ---------------------------------------------------------------------------
+# overhead
+# ---------------------------------------------------------------------------
+
+
+def test_querylog_overhead_within_five_percent(scorer):
+    """The steady-state pin: a 200-query serving soak with the query
+    log on stays within 5% of off (plus absolute slack for scheduler
+    noise on a loaded CI box) — same guard style as the PR 3 tracing
+    pin."""
+    reqs = make_queries(scorer, 200, seed=7)
+    frontend = ServingFrontend(scorer, ServingConfig(
+        max_concurrency=4, max_queue=16))
+
+    def soak_once() -> float:
+        t0 = time.perf_counter()
+        for r in reqs:
+            frontend.search(r["text"], k=r["k"], scoring=r["scoring"],
+                            rerank=r["rerank"])
+        return time.perf_counter() - t0
+
+    soak_once()                      # warm every query shape
+    timings = {}
+    for enabled in (True, False):
+        querylog.configure(enabled=enabled)
+        timings[enabled] = min(soak_once() for _ in range(2))
+    querylog.configure(enabled=True)
+    assert timings[True] <= timings[False] * 1.05 + 0.15, (
+        f"querylog overhead too high: on {timings[True]:.3f}s vs "
+        f"off {timings[False]:.3f}s")

@@ -654,6 +654,39 @@ def cmd_profile(args) -> int:
     return 0
 
 
+def cmd_querylog(args) -> int:
+    """Dump the sampled query log (obs/querylog.py): config + counters
+    header, the ring entries, and the slow-query captures (span tree +
+    explain for trapped offenders), one JSON object. Per-process like
+    `tpu-ir stats` — meaningful from a serving/bench process or the
+    /querylog endpoint of a tracked run; empty (the SHAPE is the
+    contract) from a fresh CLI invocation."""
+    from .obs import querylog
+
+    out = dict(querylog.summary())
+    if not args.slow:
+        out["entries"] = querylog.recent(args.n)
+    out["slow_entries"] = querylog.slow_recent(args.n)
+    print(json.dumps(out, sort_keys=True, default=repr))
+    return 0
+
+
+def cmd_doctor(args) -> int:
+    """Index health report (index/doctor.py): df distribution and
+    posting-list skew, per-shard term/postings balance, the EXACT
+    hot-strip/tier occupancy serving will use, arena section sizes and
+    serving-cache contents, plus heuristic warnings. Always exits 0 on
+    a readable index — a health report, not a gate; the `warnings`
+    list is the advisory surface."""
+    from .index.doctor import doctor_report
+
+    report = doctor_report(args.index_dir, top_terms=args.top)
+    print(json.dumps(report, sort_keys=True))
+    for w in report["warnings"]:
+        print(f"doctor: warning: {w}", file=sys.stderr)
+    return 0
+
+
 def cmd_bench_check(args) -> int:
     """The BENCH_HISTORY.jsonl regression sentry (obs/bench_check.py):
     compare the newest row against the trailing-window median of its
@@ -732,6 +765,12 @@ def cmd_serve_bench(args) -> int:
             timeout_s=args.timeout, flight_dir=args.flight_dir)
         if track.server is not None:
             report["metrics_url"] = track.server.url
+    # the soak's query-log view: recorded/slow counts + the last slow
+    # captures, so a slow-query incident during the soak is in the JSON
+    from .obs import querylog
+
+    report["querylog"] = {**querylog.summary(),
+                          "slow_entries": querylog.slow_header_entries()}
     print(json.dumps(report, sort_keys=True, default=repr))
     ok = (report["errors"] == 0 and report["deadlocked"] == 0
           and report["untagged_mismatches"] == 0
@@ -874,7 +913,7 @@ def cmd_expand(args) -> int:
 _ARTIFACT_ENTRY_CMDS = frozenset({
     "cmd_search", "cmd_inspect", "cmd_verify", "cmd_warm", "cmd_docno",
     "cmd_expand", "cmd_eval", "cmd_count", "cmd_pack", "cmd_merge",
-    "cmd_serve_bench", "cmd_migrate_index",
+    "cmd_serve_bench", "cmd_migrate_index", "cmd_doctor",
 })
 
 
@@ -1062,6 +1101,27 @@ def main(argv: list[str] | None = None) -> int:
                         "compile counts + FLOPs/bytes, dispatch time "
                         "split, memory gauges, recompile window")
     ppr.set_defaults(fn=cmd_profile)
+
+    pql = sub.add_parser(
+        "querylog", help="dump the sampled query log: per-request "
+                         "entries (terms/hash, level, stage split, "
+                         "top-k, prune decision) + slow-query captures")
+    pql.add_argument("-n", type=int, default=None,
+                     help="newest N entries only (default: the whole "
+                          "ring)")
+    pql.add_argument("--slow", action="store_true",
+                     help="slow-query captures only (span tree + "
+                          "explain of trapped offenders)")
+    pql.set_defaults(fn=cmd_querylog)
+
+    pdr = sub.add_parser(
+        "doctor", help="index health report: df skew, per-shard "
+                       "term/doc balance, tier occupancy, arena "
+                       "section sizes, heuristic warnings")
+    pdr.add_argument("index_dir")
+    pdr.add_argument("--top", type=int, default=10,
+                     help="top-N terms by df to list")
+    pdr.set_defaults(fn=cmd_doctor)
 
     pbc = sub.add_parser(
         "bench-check",

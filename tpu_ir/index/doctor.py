@@ -1,0 +1,235 @@
+"""`tpu-ir doctor`: the index health report — shape, skew, and balance.
+
+The reference's only index introspection was ReadSequenceFile dumping
+records; nothing answered "is this index SHAPED well for serving?". This
+module computes that report from the on-disk artifacts alone (no scorer
+load, no device):
+
+- **df distribution / posting-list skew**: percentiles, the top terms by
+  df and the postings share they soak up — the stopword-grade tail that
+  decides how much work every query's hot strip does;
+- **per-shard term/doc balance**: postings and term counts per part
+  shard with max/mean balance ratios — the imbalance lens the
+  scatter-gather router (ROADMAP 4) will consume for shard routing;
+- **tier occupancy**: the EXACT hot-strip/tier assignment serving uses
+  (search/layout.py::plan_tiers — shared code, not a re-derivation),
+  with per-rung fill fractions and the padding-waste total;
+- **arena section sizes** from the v2 section tables (per-name byte
+  totals across shards, plus each serving cache's sections);
+- **doc-length stats** and a short heuristic `warnings` list.
+
+Everything is host-side artifact IO; a report over a GB-scale index
+costs roughly one pass over the shard headers + df columns.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import format as fmt
+
+# balance ratios (max/mean) above this land in `warnings`
+BALANCE_WARN = 1.5
+# cold-tier padding-waste fraction above this lands in `warnings`
+WASTE_WARN = 0.6
+
+
+def _pct(a, qs=(50, 90, 99)) -> dict:
+    if not len(a):
+        return {f"p{q}": None for q in qs}
+    return {f"p{q}": float(np.percentile(a, q)) for q in qs}
+
+
+def _shard_scan(index_dir: str, meta) -> tuple[np.ndarray, list, dict]:
+    """One pass over the part shards: the assembled global df column,
+    per-shard stats, and (v2) per-section byte totals."""
+    df = np.zeros(meta.vocab_size, np.int64)
+    shards = []
+    sections: dict[str, int] = {}
+    for s in range(meta.num_shards):
+        path = fmt.part_path(index_dir, s)
+        z = fmt.load_shard(index_dir, s, mmap=True)
+        df[z["term_ids"]] = z["df"]
+        shards.append({
+            "shard": s,
+            "file": os.path.basename(path),
+            "bytes": os.path.getsize(path),
+            "terms": int(len(z["term_ids"])),
+            "postings": int(z["indptr"][-1]) if len(z["indptr"]) else 0,
+        })
+        if path.endswith(fmt.ARENA_SUFFIX):
+            header, _ = fmt.read_arena_header(path)
+            for sec in header["sections"]:
+                sections[sec["name"]] = (sections.get(sec["name"], 0)
+                                         + int(sec["nbytes"]))
+    return df, shards, sections
+
+
+def _balance(values) -> float | None:
+    """max/mean — 1.0 is perfectly balanced, 2.0 means the worst shard
+    carries twice its fair share."""
+    v = [x for x in values]
+    if not v or not sum(v):
+        return None
+    return round(max(v) / (sum(v) / len(v)), 4)
+
+
+def _tier_report(df: np.ndarray, num_docs: int) -> dict:
+    """The tier-occupancy report, from the SAME assignment the serving
+    layout builder runs (search/layout.py::plan_tiers)."""
+    from ..search.layout import BASE_CAP, GROWTH, HOT_BUDGET, plan_tiers
+
+    hot_tids, cold, caps, want = plan_tiers(df, num_docs=num_docs)
+    total_postings = int(df.sum())
+    hot_postings = int(df[hot_tids].sum())
+    tiers = []
+    cells_total = waste_total = 0
+    for i, cap in enumerate(caps):
+        tids = cold[want == i]
+        if not len(tids):
+            continue
+        postings = int(df[tids].sum())
+        cells = int(len(tids)) * cap
+        cells_total += cells
+        waste_total += cells - postings
+        tiers.append({
+            "cap": int(cap),
+            "rows": int(len(tids)),
+            "postings": postings,
+            "fill_fraction": round(postings / cells, 4),
+        })
+    return {
+        "ladder": {"hot_budget": HOT_BUDGET, "base_cap": BASE_CAP,
+                   "growth": GROWTH},
+        "hot": {
+            "terms": int(len(hot_tids)),
+            "budget_rows": max(int(HOT_BUDGET // (num_docs + 1)), 1),
+            "postings": hot_postings,
+            "postings_fraction": round(
+                hot_postings / max(total_postings, 1), 4),
+        },
+        "tiers": tiers,
+        "cold_padding_waste_fraction": round(
+            waste_total / max(cells_total, 1), 4),
+    }
+
+
+def _serving_caches(index_dir: str) -> list:
+    """Every serving-cache dir present, with its arena section sizes —
+    the deploy-time answer to "what will a warm load actually mmap"."""
+    out = []
+    try:
+        names = sorted(n for n in os.listdir(index_dir)
+                       if n.startswith("serving-"))
+    except OSError:
+        return out
+    for name in names:
+        arena = os.path.join(index_dir, name, "cache.arena")
+        entry = {"cache": name}
+        try:
+            header, _ = fmt.read_arena_header(arena)
+            entry["bytes"] = os.path.getsize(arena)
+            entry["sections"] = {
+                sec["name"]: int(sec["nbytes"])
+                for sec in header["sections"]}
+        except (OSError, ValueError) as e:
+            entry["error"] = repr(e)
+        out.append(entry)
+    return out
+
+
+def doctor_report(index_dir: str, top_terms: int = 10) -> dict:
+    """The full health report (see module docstring); raises
+    FileNotFoundError for a non-index dir — the CLI's artifact-entry
+    handling turns that into the clean usage message."""
+    meta = fmt.IndexMetadata.load(index_dir)
+    df, shards, sections = _shard_scan(index_dir, meta)
+    nz = df[df > 0]
+    total_postings = int(df.sum())
+
+    # top terms by df, with term strings from the vocabulary
+    order = np.argsort(df, kind="stable")[::-1][:top_terms]
+    from ..collection import Vocab
+
+    vocab = Vocab.load(os.path.join(index_dir, fmt.VOCAB))
+    top = [{"term": vocab.term(int(t)), "df": int(df[t]),
+            "df_fraction": round(int(df[t]) / max(meta.num_docs, 1), 4)}
+           for t in order if df[t] > 0]
+    top_share = round(sum(e["df"] for e in top)
+                      / max(total_postings, 1), 4)
+
+    doc_len = np.load(os.path.join(index_dir, fmt.DOCLEN))
+    dl = doc_len[1:].astype(np.int64)  # slot 0 is the dead column
+
+    report = {
+        "index_dir": os.path.abspath(index_dir),
+        "metadata": {
+            "num_docs": meta.num_docs,
+            "vocab_size": meta.vocab_size,
+            "num_pairs": meta.num_pairs,
+            "num_shards": meta.num_shards,
+            "k": meta.k,
+            "format_version": meta.format_version,
+        },
+        "docs": {
+            "count": int(len(dl)),
+            "empty": int((dl == 0).sum()),
+            "mean_len": round(float(dl.mean()), 2) if len(dl) else None,
+            **{k: (round(v, 2) if v is not None else None)
+               for k, v in _pct(dl).items()},
+            "max_len": int(dl.max()) if len(dl) else None,
+        },
+        "df": {
+            "zero_df_terms": int((df == 0).sum()),
+            "max": int(df.max()) if len(df) else 0,
+            **{k: (round(v, 2) if v is not None else None)
+               for k, v in _pct(nz).items()},
+            "top_terms": top,
+            f"top{top_terms}_postings_fraction": top_share,
+        },
+        "shards": {
+            "per_shard": shards,
+            "terms_balance": _balance(s["terms"] for s in shards),
+            "postings_balance": _balance(s["postings"] for s in shards),
+            "bytes_balance": _balance(s["bytes"] for s in shards),
+        },
+        "tiers": _tier_report(df, meta.num_docs),
+        "arena_sections": sections or None,
+        "serving_caches": _serving_caches(index_dir),
+    }
+    report["warnings"] = _warnings(report)
+    return report
+
+
+def _warnings(report: dict) -> list[str]:
+    """Heuristic red flags — advisory (the command still exits 0; this
+    is a health report, not a gate)."""
+    out = []
+    sh = report["shards"]
+    for key in ("terms_balance", "postings_balance"):
+        v = sh.get(key)
+        if v is not None and v > BALANCE_WARN:
+            out.append(
+                f"shard {key.split('_')[0]} imbalance {v}x (max/mean > "
+                f"{BALANCE_WARN}x): hot shards will bound scatter-gather "
+                "latency (ROADMAP 4)")
+    waste = report["tiers"]["cold_padding_waste_fraction"]
+    if waste > WASTE_WARN:
+        out.append(
+            f"cold-tier padding waste {waste:.0%} (> {WASTE_WARN:.0%}): "
+            "the geometric capacity ladder fits this df distribution "
+            "poorly; consider tuning BASE_CAP/GROWTH")
+    docs = report["docs"]
+    if docs["count"] and docs["empty"] / docs["count"] > 0.1:
+        out.append(
+            f"{docs['empty']} of {docs['count']} documents are empty "
+            "after analysis: check the corpus parser / stopword list")
+    top = report["df"]["top_terms"]
+    if top and top[0]["df_fraction"] >= 0.5:
+        out.append(
+            f"term {top[0]['term']!r} appears in {top[0]['df_fraction']:.0%} "
+            "of documents (stopword-grade; its idf contributes ~nothing "
+            "while its postings dominate the hot strip)")
+    return out

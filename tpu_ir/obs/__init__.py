@@ -69,20 +69,22 @@ from .profiling import (
     recompiles_last_60s,
     sample_memory,
 )
+from . import querylog  # noqa: E402 — needs recorder/registry bound above
 
 
 def reset_all() -> None:
     """Full telemetry reset: registry counters + histograms, the trace
-    ring, the job history, and the flight recorder's rate limiter. The
-    test-isolation hook (tests/conftest.py autouse fixture) — one
-    process-wide telemetry state must not leak between tests or between
-    runs. (The registry's seq/resets stamps stay monotonic through
-    this — that IS their contract.)"""
+    ring, the query log, the job history, and the flight recorder's
+    rate limiter. The test-isolation hook (tests/conftest.py autouse
+    fixture) — one process-wide telemetry state must not leak between
+    tests or between runs. (The registry's seq/resets stamps stay
+    monotonic through this — that IS their contract.)"""
     get_registry().reset()
     clear_traces()
     progress.clear_jobs()
     reset_rate_limit()
     profiling.reset_profile()
+    querylog.clear()
 
 
 __all__ = [
@@ -98,4 +100,5 @@ __all__ = [
     "record_span", "reset_all",
     "profiling", "profiled_jit", "profile_report", "sample_memory",
     "recompiles_last_60s",
+    "querylog",
 ]

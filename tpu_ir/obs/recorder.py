@@ -73,7 +73,11 @@ def artifact_lines(reason: str, extra: dict | None = None,
     header (schema, seq, reason, wall time, pid, extra context), then
     one trace record per ring entry, then a full registry snapshot.
     Shared by flight_dump and `tpu-ir trace-dump` so an operator dump
-    and a breach dump are byte-shape-identical and cannot drift."""
+    and a breach dump are byte-shape-identical and cannot drift.
+
+    `extra` may be a callable producing the dict — flight_dump defers
+    expensive context assembly (the slow-query trap's explain
+    dispatches) behind its rate-limit gate this way."""
     header = {
         "record": "header",
         "schema": FLIGHT_SCHEMA,
@@ -93,6 +97,21 @@ def artifact_lines(reason: str, extra: dict | None = None,
         header["compile_cache"] = compile_cache_snapshot()
     except Exception:  # noqa: BLE001 — the header must always write
         pass
+    try:
+        # the last-K slow-query entries (hash, level, stage split): a
+        # breach dump answers "what was slow just before this" without
+        # a separate /querylog scrape (lazy import — querylog imports
+        # this module for trap dumps)
+        from .querylog import slow_header_entries
+
+        header["slow_queries"] = slow_header_entries()
+    except Exception:  # noqa: BLE001 — the header must always write
+        pass
+    if callable(extra):
+        try:
+            extra = extra()
+        except Exception:  # noqa: BLE001 — deferred context must not
+            extra = None   # kill the artifact that reports the failure
     if extra:
         header["extra"] = extra
     lines = [json.dumps(header, default=repr)]

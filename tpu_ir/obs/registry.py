@@ -105,17 +105,26 @@ DISPATCH_STAGES = ("dispatch.trace", "dispatch.compile", "dispatch.device")
 # abstract signature (the recompile-storm signal).
 COMPILE_COUNTER_NAMES = ("compile.count", "compile.recompiles")
 
+# Query-log counters (obs/querylog.py, ISSUE 8): entries recorded into
+# the sampled ring, and the subset the slow-query trap force-captured.
+QUERYLOG_COUNTER_NAMES = ("querylog.recorded", "querylog.slow")
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
-) + COMPILE_COUNTER_NAMES
+) + COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     f"request.{lv}" for lv in SERVICE_LEVELS) + DISPATCH_STAGES + (
     # wall time per compile event (trace + backend compile)
     "compile.time",
+    # one score-explain computation (search/explain.py — the (L+1)-row
+    # prefix dispatch plus metadata assembly)
+    "explain",
+    # one slow-query force-capture (span tree + explain + flight dump)
+    "querylog.slow_capture",
 )
 
 # Gauges: point-in-time values (memory levels, cache sizes) — unlike

@@ -24,8 +24,19 @@ it runs:
                         (obs/profiling.py): per-signature compile
                         counts + FLOPs/bytes, the dispatch time split,
                         memory gauges, recompile window.
+  GET /querylog         The sampled query log (obs/querylog.py):
+                        config + ring entries + slow-query captures
+                        (`?slow=1` captures only, `?n=N` newest N).
+  GET /doctor           Index health reports for the index dirs this
+                        process loaded (index/doctor.py: df skew, shard
+                        balance, tier occupancy, arena section sizes);
+                        `?index=PATH` narrows to one registered dir.
   GET /flight           Recent flight-recorder artifact headers
                         (reason/time/seq/path), newest first.
+
+Every `?format=html` page shares one nav row, so the JobTracker-style
+pages cross-link (/jobs <-> /cluster <-> /profile <-> /querylog <->
+/doctor) instead of each being a dead end.
   GET /cluster          The spool-merged cluster view (this process's
                         live registry folded in) when
                         TPU_IR_TELEMETRY_DIR is configured.
@@ -58,6 +69,9 @@ logger = logging.getLogger(__name__)
 
 _health_lock = threading.Lock()
 _frontends: list = []  # weakrefs to live ServingFrontends, oldest first
+_index_dirs: list = []  # index dirs this process loaded, oldest first
+_MAX_INDEX_DIRS = 4
+_doctor_cache: dict = {}  # dir -> (metadata mtime_ns, report)
 
 
 def register_health_source(frontend) -> None:
@@ -66,6 +80,86 @@ def register_health_source(frontend) -> None:
     server must never keep a dead frontend's scorer resident."""
     with _health_lock:
         _frontends.append(weakref.ref(frontend))
+
+
+def register_index_dir(path) -> None:
+    """Called by Scorer.load: /doctor introspects the index dirs THIS
+    process actually serves — the endpoint never reads an arbitrary
+    caller-supplied path, only registered ones (last-K distinct)."""
+    import os
+
+    path = os.path.abspath(path)
+    with _health_lock:
+        if path in _index_dirs:
+            _index_dirs.remove(path)
+        _index_dirs.append(path)
+        del _index_dirs[:-_MAX_INDEX_DIRS]
+        # evict cached reports for rotated-out dirs: a long-lived
+        # process cycling through many indexes must not pin one full
+        # doctor report per ever-seen dir
+        for stale in [d for d in _doctor_cache if d not in _index_dirs]:
+            del _doctor_cache[stale]
+
+
+def registered_index_dirs() -> list:
+    with _health_lock:
+        return list(_index_dirs)
+
+
+def _doctor_payload(query: dict) -> dict:
+    """/doctor body: one health report per registered index dir (newest
+    first), cached by metadata mtime — the report reads every shard
+    header, which must not re-run per scrape. `?index=PATH` narrows to
+    one REGISTERED dir (unregistered paths are refused, not read)."""
+    import os
+
+    from ..index.doctor import doctor_report
+
+    dirs = list(reversed(registered_index_dirs()))
+    want = query.get("index", [None])[0]
+    if want is not None:
+        want = os.path.abspath(want)
+        if want not in dirs:
+            return {"error": f"{want} is not a registered index dir",
+                    "registered": dirs}
+        dirs = [want]
+    if not dirs:
+        return {"error": "no index loaded in this process yet",
+                "indexes": {}}
+    out = {}
+    for d in dirs:
+        try:
+            stamp = _doctor_stamp(d)
+            with _health_lock:
+                cached = _doctor_cache.get(d)
+            if cached is not None and cached[0] == stamp:
+                out[d] = cached[1]
+                continue
+            report = doctor_report(d)
+            with _health_lock:
+                _doctor_cache[d] = (stamp, report)
+            out[d] = report
+        except Exception as e:  # noqa: BLE001 — one sick index must not
+            out[d] = {"error": repr(e)}  # hide the others' reports
+    return {"indexes": out}
+
+
+def _doctor_stamp(d: str):
+    """Cache-invalidation stamp for one index dir: metadata.json mtime
+    PLUS the serving-cache dirs' mtimes — `tpu-ir warm` writes a new
+    serving-*/ without touching metadata.json, and the report's
+    serving_caches section must not stay stale for the process's life."""
+    import os
+
+    stamp = [os.stat(os.path.join(d, "metadata.json")).st_mtime_ns]
+    try:
+        for name in sorted(os.listdir(d)):
+            if name.startswith("serving-"):
+                stamp.append(
+                    (name, os.stat(os.path.join(d, name)).st_mtime_ns))
+    except OSError:
+        pass
+    return tuple(stamp)
 
 
 def _live_frontends() -> list:
@@ -100,6 +194,14 @@ def health_snapshot() -> dict:
         out["recompiles_last_60s"] = recompiles_last_60s()
     except Exception:  # noqa: BLE001 — health must not 500
         out["recompiles_last_60s"] = None
+    try:
+        # same trailing window for the slow-query trap: a latency
+        # incident shows here before any percentile moves
+        from .querylog import slow_last_60s
+
+        out["slow_queries_last_60s"] = slow_last_60s()
+    except Exception:  # noqa: BLE001 — health must not 500
+        out["slow_queries_last_60s"] = None
     for fe in fes:
         try:
             st = fe.stats()
@@ -116,6 +218,38 @@ def health_snapshot() -> dict:
 
 # -- the JobTracker HTML echo ----------------------------------------------
 
+# every HTML page carries the same nav row, so the JobTracker-style
+# pages cross-link instead of each being a dead end (satellite: the
+# /jobs <-> /cluster <-> /profile <-> /querylog <-> /doctor drift fix)
+_NAV_ROUTES = ("/healthz", "/jobs?format=html", "/cluster?format=html",
+               "/profile?format=html", "/querylog?format=html",
+               "/doctor?format=html", "/flight", "/metrics")
+
+
+def _nav_html() -> str:
+    links = " &middot; ".join(
+        f"<a href='{r}'>{html.escape(r.split('?')[0])}</a>"
+        for r in _NAV_ROUTES)
+    return f"<p class='nav'>{links}</p>"
+
+
+_STYLE = ("<style>body{font-family:sans-serif;margin:1em}"
+          "table{border-collapse:collapse;margin:0 0 1.5em}"
+          "td,th{border:1px solid #999;padding:2px 8px;text-align:left}"
+          "th{background:#ddd}.pct{font-weight:bold}"
+          "pre{background:#f4f4f4;padding:8px;overflow-x:auto}"
+          ".nav{margin:0 0 1em}</style>")
+
+
+def _json_page_html(title: str, obj) -> str:
+    """Minimal HTML rendering of a JSON payload (nav + <pre>): the
+    /profile /cluster /querylog /doctor pages — one shape, one place."""
+    body = html.escape(json.dumps(obj, indent=2, default=repr))
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>{html.escape(title)}</title>{_STYLE}</head><body>"
+            f"<h1>{html.escape(title)}</h1>{_nav_html()}"
+            f"<pre>{body}</pre></body></html>")
+
 
 def _jobs_html(job_dicts: list, title: str) -> str:
     """A minimal single-page echo of the reference's saved JobTracker
@@ -124,11 +258,9 @@ def _jobs_html(job_dicts: list, title: str) -> str:
     parts = [
         "<!DOCTYPE html><html><head><meta charset='utf-8'>",
         f"<title>{html.escape(title)}</title>",
-        "<style>body{font-family:sans-serif;margin:1em}"
-        "table{border-collapse:collapse;margin:0 0 1.5em}"
-        "td,th{border:1px solid #999;padding:2px 8px;text-align:left}"
-        "th{background:#ddd}.pct{font-weight:bold}</style>",
+        _STYLE,
         f"</head><body><h1>{html.escape(title)}</h1>",
+        _nav_html(),
     ]
     for d in job_dicts:
         eta = f" &middot; ETA {d['eta_s']}s" if "eta_s" in d else ""
@@ -177,6 +309,15 @@ class _Handler(BaseHTTPRequestHandler):
         self._send(code, json.dumps(obj, default=repr).encode("utf-8"),
                    "application/json")
 
+    def _json_or_html(self, q: dict, title: str, obj) -> None:
+        """JSON by default, the minimal nav-linked HTML page with
+        `?format=html` — the shared shape of the introspection routes."""
+        if q.get("format", [""])[0] == "html":
+            self._send(200, _json_page_html(title, obj).encode("utf-8"),
+                       "text/html; charset=utf-8")
+        else:
+            self._json(obj)
+
     def do_GET(self) -> None:  # noqa: N802 — stdlib handler contract
         try:
             url = urlparse(self.path)
@@ -221,7 +362,30 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/profile":
                 from .profiling import profile_report
 
-                self._json(profile_report())
+                self._json_or_html(q, "tpu-ir profile", profile_report())
+            elif route == "/querylog":
+                from . import querylog
+
+                n = None
+                if q.get("n"):
+                    try:
+                        n = max(int(q["n"][0]), 1)
+                    except ValueError:
+                        self._json({"error": "n must be an integer"},
+                                   code=400)
+                        return
+                slow_only = q.get("slow", ["0"])[0] not in ("", "0",
+                                                            "false")
+                payload = {
+                    **querylog.summary(),
+                    "slow_entries": querylog.slow_recent(n),
+                }
+                if not slow_only:
+                    payload["entries"] = querylog.recent(n)
+                self._json_or_html(q, "tpu-ir querylog", payload)
+            elif route == "/doctor":
+                self._json_or_html(q, "tpu-ir doctor",
+                                   _doctor_payload(q))
             elif route == "/flight":
                 self._json({"flight_records": recent_headers()})
             elif route == "/cluster":
@@ -231,11 +395,14 @@ class _Handler(BaseHTTPRequestHandler):
                     self._json({"error": "TPU_IR_TELEMETRY_DIR not set"},
                                code=404)
                     return
-                self._json(aggregate.merge_spool(include_local=True))
+                self._json_or_html(q, "tpu-ir cluster",
+                                   aggregate.merge_spool(
+                                       include_local=True))
             elif route == "/":
                 self._json({"endpoints": ["/metrics", "/metrics.json",
                                           "/healthz", "/jobs",
                                           "/jobs/<id>", "/profile",
+                                          "/querylog", "/doctor",
                                           "/flight", "/cluster"]})
             else:
                 self._json({"error": "unknown endpoint"}, code=404)

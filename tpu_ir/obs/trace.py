@@ -199,6 +199,15 @@ def current_span() -> Span | None:
     return stack[-1] if stack else None
 
 
+def current_root() -> Span | None:
+    """This thread's OUTERMOST open span — the request root the
+    slow-query trap (obs/querylog.py) serializes while the request is
+    still in flight (its tree won't reach the ring until it closes;
+    to_dict() copies child lists, so a mid-flight snapshot is safe)."""
+    stack = getattr(_tls, "stack", None)
+    return stack[0] if stack else None
+
+
 class _Attach:
     __slots__ = ("_parent", "_saved")
 

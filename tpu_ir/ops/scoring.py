@@ -115,6 +115,25 @@ def dense_tf_matrix(postings_pair_term, postings_pair_doc, postings_pair_tf,
                           vocab_size=vocab_size, num_docs=num_docs)
 
 
+def _tfidf_dense_scores(q_terms, doc_matrix, df, num_docs,
+                        compat_int_idf) -> jax.Array:
+    """[B, D+1] TF-IDF accumulation on the dense layout — THE expression
+    both the production top-k kernel and the explain score-gather variant
+    trace, so a gathered explain score is bit-identical to what the
+    top-k saw (search/explain.py pins this)."""
+    vocab_size = doc_matrix.shape[0]
+    idf = idf_weights(df, num_docs, compat_int_idf)
+
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)
+    q_valid = (q_terms >= 0) & (q_terms < vocab_size)
+    q_idf = jnp.where(q_valid, idf[safe_q], 0.0)          # [B, L]
+    # no separate row mask: q_idf is already 0 exactly where q_valid is
+    # False, and the clamped gather returns finite real rows — a mask
+    # here would re-multiply the [B, L, D+1] tensor for nothing
+    rows = doc_matrix[safe_q]                              # [B, L, D+1]
+    return jnp.einsum("bld,bl->bd", rows, q_idf)           # [B, D+1]
+
+
 @partial(profiled_jit, static_argnames=("k", "compat_int_idf"))
 def tfidf_topk_dense(
     q_terms: jax.Array,   # int32 [B, L], PAD_QTERM padding
@@ -127,18 +146,45 @@ def tfidf_topk_dense(
 ) -> tuple[jax.Array, jax.Array]:
     """Batched TF-IDF top-k. Returns (scores [B,k], docnos [B,k]);
     docno 0 marks an empty slot (fewer than k docs matched)."""
-    vocab_size = doc_matrix.shape[0]
-    idf = idf_weights(df, num_docs, compat_int_idf)
+    scores = _tfidf_dense_scores(q_terms, doc_matrix, df, num_docs,
+                                 compat_int_idf)
+    return _topk_from_scores(scores, k)
+
+
+@partial(profiled_jit, static_argnames=("compat_int_idf",))
+def tfidf_scores_at_dense(
+    q_terms: jax.Array,     # int32 [B, L]
+    doc_matrix: jax.Array,  # f32 [V, D+1]
+    df: jax.Array,          # int32 [V]
+    num_docs: jax.Array,    # int32 scalar
+    cand: jax.Array,        # int32 [B, C] docnos to read out
+    *,
+    compat_int_idf: bool = False,
+) -> jax.Array:
+    """Explain debug variant: the SAME accumulation as tfidf_topk_dense,
+    read out at the requested docnos instead of top-k'd — [B, C] f32."""
+    scores = _tfidf_dense_scores(q_terms, doc_matrix, df, num_docs,
+                                 compat_int_idf)
+    return jnp.take_along_axis(scores, cand.astype(jnp.int32), axis=1)
+
+
+def _bm25_dense_scores(q_terms, tf_matrix, df, doc_len, num_docs,
+                       k1, b) -> jax.Array:
+    """[B, D+1] BM25 accumulation on the dense layout (see
+    _tfidf_dense_scores for the shared-expression contract)."""
+    vocab_size = tf_matrix.shape[0]
+    n = jnp.asarray(num_docs, jnp.float32)
+    idf = bm25_idf_weights(df, n)
+    avg_dl = jnp.sum(doc_len.astype(jnp.float32)) / jnp.maximum(n, 1.0)
+    dl_norm = 1.0 - b + b * doc_len.astype(jnp.float32) / jnp.maximum(avg_dl, 1e-9)
 
     safe_q = jnp.where(q_terms >= 0, q_terms, 0)
     q_valid = (q_terms >= 0) & (q_terms < vocab_size)
-    q_idf = jnp.where(q_valid, idf[safe_q], 0.0)          # [B, L]
-    # no separate row mask: q_idf is already 0 exactly where q_valid is
-    # False, and the clamped gather returns finite real rows — a mask
-    # here would re-multiply the [B, L, D+1] tensor for nothing
-    rows = doc_matrix[safe_q]                              # [B, L, D+1]
-    scores = jnp.einsum("bld,bl->bd", rows, q_idf)         # [B, D+1]
-    return _topk_from_scores(scores, k)
+    q_idf = jnp.where(q_valid, idf[safe_q], 0.0)           # [B, L]
+    tf = tf_matrix[safe_q]                                  # [B, L, D+1]
+    return jnp.einsum("bld,bl->bd",
+                      bm25_saturation(tf, dl_norm[None, None, :], k1=k1),
+                      q_idf)
 
 
 @partial(profiled_jit, static_argnames=("k", "k1", "b"))
@@ -155,20 +201,27 @@ def bm25_topk_dense(
 ) -> tuple[jax.Array, jax.Array]:
     """Batched Okapi BM25 top-k (the scorer variant the reference never had
     but the MS MARCO config needs; SURVEY.md §7 build order)."""
-    vocab_size = tf_matrix.shape[0]
-    n = jnp.asarray(num_docs, jnp.float32)
-    idf = bm25_idf_weights(df, n)
-    avg_dl = jnp.sum(doc_len.astype(jnp.float32)) / jnp.maximum(n, 1.0)
-    dl_norm = 1.0 - b + b * doc_len.astype(jnp.float32) / jnp.maximum(avg_dl, 1e-9)
-
-    safe_q = jnp.where(q_terms >= 0, q_terms, 0)
-    q_valid = (q_terms >= 0) & (q_terms < vocab_size)
-    q_idf = jnp.where(q_valid, idf[safe_q], 0.0)           # [B, L]
-    tf = tf_matrix[safe_q]                                  # [B, L, D+1]
-    scores = jnp.einsum("bld,bl->bd",
-                        bm25_saturation(tf, dl_norm[None, None, :], k1=k1),
-                        q_idf)
+    scores = _bm25_dense_scores(q_terms, tf_matrix, df, doc_len, num_docs,
+                                k1, b)
     return _topk_from_scores(scores, k)
+
+
+@partial(profiled_jit, static_argnames=("k1", "b"))
+def bm25_scores_at_dense(
+    q_terms: jax.Array,      # int32 [B, L]
+    tf_matrix: jax.Array,    # f32 [V, D+1]
+    df: jax.Array,           # int32 [V]
+    doc_len: jax.Array,      # int32 [D+1]
+    num_docs: jax.Array,     # int32 scalar
+    cand: jax.Array,         # int32 [B, C]
+    *,
+    k1: float = 0.9,
+    b: float = 0.4,
+) -> jax.Array:
+    """Explain debug variant of bm25_topk_dense — [B, C] f32 at `cand`."""
+    scores = _bm25_dense_scores(q_terms, tf_matrix, df, doc_len, num_docs,
+                                k1, b)
+    return jnp.take_along_axis(scores, cand.astype(jnp.int32), axis=1)
 
 
 def _topk_from_scores(scores: jax.Array, k: int):
@@ -339,6 +392,32 @@ def _hot_stage_pruned(partial, hot_tfs, hot_max_w, q_w, rank, is_hot,
     return (scores, safe_q) if with_stats else scores
 
 
+def _tfidf_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
+                         tier_docs, tier_tfs, df, n_scalar, hot_max_tf, *,
+                         num_docs, prune_k, compat_int_idf, prune,
+                         skip_hot, hot_only) -> jax.Array:
+    """[B, D+1] tiered TF-IDF accumulation — shared verbatim between the
+    production top-k kernel and the explain score-gather variant
+    (prune_k is the production kernel's k; the prune gate and candidate
+    machinery must see the same value to trace the same program)."""
+    idf = idf_weights(df, n_scalar, compat_int_idf)
+
+    do_prune = (not skip_hot and not hot_only
+                and _prune_applicable(prune_k, num_docs, prune)
+                and hot_max_tf is not None)
+    # one weight model for cold postings AND pruned hot candidates: the
+    # rank-safety contract depends on the two staying identical
+    cell_fn = lambda tfs, docs: _lntf(tfs)  # noqa: E731
+    return _tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        idf, num_docs=num_docs, hot_weight_fn=_lntf,
+        cold_weight_fn=cell_fn,
+        hot_cell_fn=cell_fn if do_prune else None,
+        hot_max_w=_lntf(hot_max_tf.astype(jnp.float32)) if do_prune else None,
+        prune_k=prune_k if do_prune else None, skip_hot=skip_hot,
+        skip_cold=hot_only)
+
+
 @partial(profiled_jit, static_argnames=("k", "num_docs", "compat_int_idf",
                                    "prune", "skip_hot", "hot_only"))
 def tfidf_topk_tiered(
@@ -378,23 +457,32 @@ def tfidf_topk_tiered(
     `hot_only=True` (static) is the opposite degradation: score ONLY the
     hot strip (the overload ladder's cheapest device level; results are
     partial and must be tagged by the caller)."""
-    idf = idf_weights(df, n_scalar, compat_int_idf)
-
-    do_prune = (not skip_hot and not hot_only
-                and _prune_applicable(k, num_docs, prune)
-                and hot_max_tf is not None)
-    # one weight model for cold postings AND pruned hot candidates: the
-    # rank-safety contract depends on the two staying identical
-    cell_fn = lambda tfs, docs: _lntf(tfs)  # noqa: E731
-    scores = _tiered_scores(
+    scores = _tfidf_tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
-        idf, num_docs=num_docs, hot_weight_fn=_lntf,
-        cold_weight_fn=cell_fn,
-        hot_cell_fn=cell_fn if do_prune else None,
-        hot_max_w=_lntf(hot_max_tf.astype(jnp.float32)) if do_prune else None,
-        prune_k=k if do_prune else None, skip_hot=skip_hot,
-        skip_cold=hot_only)
+        df, n_scalar, hot_max_tf, num_docs=num_docs, prune_k=k,
+        compat_int_idf=compat_int_idf, prune=prune, skip_hot=skip_hot,
+        hot_only=hot_only)
     return _topk_from_scores(scores, k)
+
+
+@partial(profiled_jit, static_argnames=("num_docs", "prune_k",
+                                   "compat_int_idf", "prune", "skip_hot",
+                                   "hot_only"))
+def tfidf_scores_at_tiered(
+    q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+    df, n_scalar, cand, hot_max_tf=None, *, num_docs: int,
+    prune_k: int = 10, compat_int_idf: bool = False, prune: bool = False,
+    skip_hot: bool = False, hot_only: bool = False,
+) -> jax.Array:
+    """Explain debug variant of tfidf_topk_tiered: the same accumulation
+    (same static flags, `prune_k` = the production k so the prune gate
+    and candidate set trace identically), read out at `cand` [B, C]."""
+    scores = _tfidf_tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        df, n_scalar, hot_max_tf, num_docs=num_docs, prune_k=prune_k,
+        compat_int_idf=compat_int_idf, prune=prune, skip_hot=skip_hot,
+        hot_only=hot_only)
+    return jnp.take_along_axis(scores, cand.astype(jnp.int32), axis=1)
 
 
 @partial(profiled_jit, static_argnames=("k", "num_docs", "k1", "b", "prune",
@@ -431,6 +519,19 @@ def bm25_topk_tiered(
     (max tf, min doc-length norm): saturation is increasing in tf and
     decreasing in dl_norm, so sat(tf, d) <= sat(max_tf, dl_min) for every
     posting of the row."""
+    scores = _bm25_tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        df, doc_len, n_scalar, hot_max_tf, num_docs=num_docs, prune_k=k,
+        k1=k1, b=b, prune=prune, skip_hot=skip_hot, hot_only=hot_only)
+    return _topk_from_scores(scores, k)
+
+
+def _bm25_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
+                        tier_docs, tier_tfs, df, doc_len, n_scalar,
+                        hot_max_tf, *, num_docs, prune_k, k1, b, prune,
+                        skip_hot, hot_only) -> jax.Array:
+    """[B, D+1] tiered BM25 accumulation — shared verbatim between the
+    production top-k kernel and the explain score-gather variant."""
     n = jnp.asarray(n_scalar, jnp.float32)
     idf = bm25_idf_weights(df, n)
     dlf = doc_len.astype(jnp.float32)
@@ -438,7 +539,7 @@ def bm25_topk_tiered(
     dl_norm = 1.0 - b + b * dlf / jnp.maximum(avg_dl, 1e-9)  # [D+1]
 
     do_prune = (not skip_hot and not hot_only
-                and _prune_applicable(k, num_docs, prune)
+                and _prune_applicable(prune_k, num_docs, prune)
                 and hot_max_tf is not None)
     if do_prune:
         # slot 0 is the dead column (doc_len 0 -> the global minimum of
@@ -453,7 +554,7 @@ def bm25_topk_tiered(
     # rank-safety contract depends on the two staying identical
     cell_fn = (lambda tfs, docs: bm25_saturation(tfs, dl_norm[docs],
                                                  k1=k1))
-    scores = _tiered_scores(
+    return _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
         idf, num_docs=num_docs,
         # hot_weight_fn sees the whole [H, D+1] strip (doc axis last)
@@ -462,9 +563,26 @@ def bm25_topk_tiered(
         cold_weight_fn=cell_fn,
         hot_cell_fn=cell_fn if do_prune else None,
         hot_max_w=hot_max_w,
-        prune_k=k if do_prune else None, skip_hot=skip_hot,
+        prune_k=prune_k if do_prune else None, skip_hot=skip_hot,
         skip_cold=hot_only)
-    return _topk_from_scores(scores, k)
+
+
+@partial(profiled_jit, static_argnames=("num_docs", "prune_k", "k1", "b",
+                                   "prune", "skip_hot", "hot_only"))
+def bm25_scores_at_tiered(
+    q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+    df, doc_len, n_scalar, cand, hot_max_tf=None, *, num_docs: int,
+    prune_k: int = 10, k1: float = 0.9, b: float = 0.4,
+    prune: bool = False, skip_hot: bool = False, hot_only: bool = False,
+) -> jax.Array:
+    """Explain debug variant of bm25_topk_tiered — [B, C] f32 at `cand`
+    (see tfidf_scores_at_tiered for the shared-accumulation contract)."""
+    scores = _bm25_tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        df, doc_len, n_scalar, hot_max_tf, num_docs=num_docs,
+        prune_k=prune_k, k1=k1, b=b, prune=prune, skip_hot=skip_hot,
+        hot_only=hot_only)
+    return jnp.take_along_axis(scores, cand.astype(jnp.int32), axis=1)
 
 
 @partial(profiled_jit, static_argnames=("k", "num_docs", "compat_int_idf"))
@@ -518,6 +636,16 @@ def cosine_rerank_dense(
     (IntDocVectorsForwardIndex.java:192-223). The reference has no rerank;
     this is the MS MARCO-shaped candidates->rerank composition. Work is
     B*L*C, not B*L*D: only the candidates' matrix cells are gathered."""
+    scores = _cosine_dense_scores(q_terms, doc_matrix, df, doc_norm,
+                                  cand_docnos, num_docs)
+    return _topk_over_candidates(scores, cand_docnos, k)
+
+
+def _cosine_dense_scores(q_terms, doc_matrix, df, doc_norm, cand_docnos,
+                         num_docs) -> jax.Array:
+    """[B, C] per-candidate cosine scores — shared between the production
+    rerank kernel and the explain variant (same candidate-set shape =>
+    the same traced program => bit-identical per-candidate floats)."""
     vocab_size = doc_matrix.shape[0]
     idf = idf_weights(df, num_docs)
     safe_q = jnp.where(q_terms >= 0, q_terms, 0)
@@ -527,8 +655,18 @@ def cosine_rerank_dense(
     cand_tf = doc_matrix[safe_q[:, :, None],
                          cand_docnos.astype(jnp.int32)[:, None, :]]
     scores = jnp.einsum("blc,bl->bc", cand_tf, q_idf * q_idf)
-    scores = scores / jnp.maximum(doc_norm[cand_docnos], 1e-30)
-    return _topk_over_candidates(scores, cand_docnos, k)
+    return scores / jnp.maximum(doc_norm[cand_docnos], 1e-30)
+
+
+@profiled_jit
+def cosine_scores_at_dense(q_terms, doc_matrix, df, doc_norm, cand_docnos,
+                           num_docs) -> jax.Array:
+    """Explain debug variant of cosine_rerank_dense: the per-candidate
+    cosine scores in CANDIDATE order ([B, C]), no top-k reorder. Callers
+    must pass the SAME candidate matrix shape the production rerank used
+    so the traced reduction is identical."""
+    return _cosine_dense_scores(q_terms, doc_matrix, df, doc_norm,
+                                cand_docnos, num_docs)
 
 
 @partial(profiled_jit, static_argnames=("k", "num_docs"))
@@ -539,6 +677,17 @@ def cosine_rerank_tiered(
     """cosine_rerank_dense on the tiered sparse layout (large corpora).
     The tiered accumulation is doc-axis-wide by construction, so this path
     scores [B, D+1] and then gathers the candidates."""
+    cand_scores = _cosine_tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        df, doc_norm, n_scalar, cand_docnos, num_docs=num_docs)
+    return _topk_over_candidates(cand_scores, cand_docnos, k)
+
+
+def _cosine_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
+                          tier_docs, tier_tfs, df, doc_norm, n_scalar,
+                          cand_docnos, *, num_docs) -> jax.Array:
+    """[B, C] per-candidate tiered cosine scores — shared between the
+    production rerank kernel and the explain variant."""
     idf = idf_weights(df, n_scalar)
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
@@ -549,9 +698,19 @@ def cosine_rerank_tiered(
     # plus a full-width temporary per rerank block (elementwise divide
     # commutes with take_along_axis, like cosine_rerank_dense)
     cand = cand_docnos.astype(jnp.int32)
-    cand_scores = (jnp.take_along_axis(scores, cand, axis=1)
-                   / jnp.maximum(doc_norm[cand], 1e-30))
-    return _topk_over_candidates(cand_scores, cand_docnos, k)
+    return (jnp.take_along_axis(scores, cand, axis=1)
+            / jnp.maximum(doc_norm[cand], 1e-30))
+
+
+@partial(profiled_jit, static_argnames=("num_docs",))
+def cosine_scores_at_tiered(q_terms, hot_rank, hot_tfs, tier_of, row_of,
+                            tier_docs, tier_tfs, df, doc_norm, n_scalar,
+                            cand_docnos, *, num_docs: int) -> jax.Array:
+    """Explain debug variant of cosine_rerank_tiered: per-candidate
+    cosine scores in candidate order ([B, C]), no top-k reorder."""
+    return _cosine_tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        df, doc_norm, n_scalar, cand_docnos, num_docs=num_docs)
 
 
 @partial(profiled_jit, static_argnames=("k", "num_docs", "compat_int_idf"))

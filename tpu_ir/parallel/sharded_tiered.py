@@ -408,6 +408,115 @@ def sharded_tiered_topk(q_terms, layout: ShardedTieredLayout, df, num_docs,
         hot_only=hot_only)
 
 
+def _gather_candidates(scores, cand, doc_base, dblk):
+    """Read local [B, dblk+1] scores out at global docnos `cand` [B, C]:
+    the owning shard contributes its value, every other shard exact 0.0,
+    and the psum assembles the replicated [B, C] result — the same
+    each-candidate-lives-on-one-device idiom the production rerank's
+    stage 2 uses, so gathered floats equal what _merge_topk saw."""
+    li = cand - doc_base                                  # local 1..dblk
+    in_blk = (li >= 1) & (li <= dblk) & (cand > 0)
+    safe = jnp.where(in_blk, li, 0)
+    cs = jnp.take_along_axis(scores, safe, axis=1) * in_blk
+    return jax.lax.psum(cs, SHARD_AXIS)
+
+
+@partial(profiled_jit,
+         static_argnames=("mesh", "scoring", "compat_int_idf", "k1", "b",
+                          "dblk", "hot_only"))
+def _sharded_scores_at_jit(q_terms, df, n_scalar, cand, hot_rank, hot_tfs,
+                           tier_of, row_of, doc_len, doc_base, tier_docs,
+                           tier_tfs, *, mesh, dblk, scoring,
+                           compat_int_idf, k1, b, hot_only=False):
+    n_f = jnp.asarray(n_scalar, jnp.float32)
+    if scoring == "bm25":
+        q_weight = bm25_idf_weights(df, n_f)
+    else:
+        q_weight = idf_weights(df, n_scalar, compat_int_idf)
+
+    def body(q, qw, c, *leaves):
+        lay, base = _unpack_local(*leaves)
+        scores = _local_scores(q, qw, lay, dblk=dblk, scoring=scoring,
+                               n_f=n_f, k1=k1, b=b, hot_only=hot_only)
+        return _gather_candidates(scores, c, base, dblk)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, None), P(None), P(None, None))
+        + _layout_specs_flat(tier_docs),
+        out_specs=P(None, None),
+        check_vma=False)
+    return fn(q_terms, q_weight, cand, hot_rank, hot_tfs, tier_of, row_of,
+              doc_len, doc_base, tier_docs, tier_tfs)
+
+
+def sharded_tiered_scores_at(q_terms, layout: ShardedTieredLayout, df,
+                             num_docs, cand, *, mesh,
+                             scoring: str = "tfidf",
+                             compat_int_idf: bool = False,
+                             k1: float = 0.9, b: float = 0.4,
+                             hot_only: bool = False):
+    """Explain debug variant of sharded_tiered_topk: [B, C] f32 scores at
+    global docnos `cand` instead of the merged top-k. Each shard runs the
+    identical `_local_scores` accumulation, so the gathered value for a
+    doc is bit-identical to the local score the production merge top-k'd
+    (search/explain.py pins this)."""
+    q_terms = replicated_global(q_terms, mesh)
+    df = replicated_global(df, mesh)
+    num_docs = replicated_global(np.int32(num_docs), mesh)
+    cand = replicated_global(jnp.asarray(cand, jnp.int32), mesh)
+    return _sharded_scores_at_jit(
+        q_terms, df, num_docs, cand, layout.hot_rank, layout.hot_tfs,
+        layout.tier_of, layout.row_of, layout.doc_len, layout.doc_base,
+        layout.tier_docs, layout.tier_tfs, mesh=mesh, dblk=layout.dblk,
+        scoring=scoring, compat_int_idf=compat_int_idf, k1=k1, b=b,
+        hot_only=hot_only)
+
+
+@partial(profiled_jit, static_argnames=("mesh", "dblk", "k1", "b"))
+def _sharded_cosine_at_jit(q_terms, df, n_scalar, doc_norm, cand,
+                           hot_rank, hot_tfs, tier_of, row_of, doc_len,
+                           doc_base, tier_docs, tier_tfs, *, mesh, dblk,
+                           k1, b):
+    n_f = jnp.asarray(n_scalar, jnp.float32)
+    idf = idf_weights(df, n_scalar)
+    w_cos = idf * idf
+
+    def body(q, w2, norm, c, *leaves):
+        lay, base = _unpack_local(*leaves)
+        # stage 2 of _sharded_rerank_jit verbatim: cosine scores over the
+        # block, normalized, candidates assembled by psum
+        s2 = _local_scores(q, w2, lay, dblk=dblk, scoring="tfidf",
+                           n_f=n_f, k1=k1, b=b)
+        s2 = s2 / jnp.maximum(norm.reshape(norm.shape[-1]), 1e-30)[None, :]
+        return _gather_candidates(s2, c, base, dblk)
+
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, None), P(None), P(SHARD_AXIS, None),
+                  P(None, None)) + _layout_specs_flat(tier_docs),
+        out_specs=P(None, None),
+        check_vma=False)
+    return fn(q_terms, w_cos, doc_norm, cand, hot_rank, hot_tfs, tier_of,
+              row_of, doc_len, doc_base, tier_docs, tier_tfs)
+
+
+def sharded_tiered_cosine_at(q_terms, layout: ShardedTieredLayout, df,
+                             num_docs, doc_norm, cand, *, mesh,
+                             k1: float = 0.9, b: float = 0.4):
+    """Explain debug variant of sharded_tiered_rerank's cosine stage:
+    [B, C] per-candidate cosine scores in candidate order."""
+    q_terms = replicated_global(q_terms, mesh)
+    df = replicated_global(df, mesh)
+    num_docs = replicated_global(np.int32(num_docs), mesh)
+    cand = replicated_global(jnp.asarray(cand, jnp.int32), mesh)
+    return _sharded_cosine_at_jit(
+        q_terms, df, num_docs, doc_norm, cand, layout.hot_rank,
+        layout.hot_tfs, layout.tier_of, layout.row_of, layout.doc_len,
+        layout.doc_base, layout.tier_docs, layout.tier_tfs, mesh=mesh,
+        dblk=layout.dblk, k1=k1, b=b)
+
+
 @partial(profiled_jit,
          static_argnames=("mesh", "k", "candidates", "k1", "b",
                           "dblk"))
@@ -431,11 +540,7 @@ def _sharded_rerank_jit(q_terms, df, n_scalar, doc_norm, hot_rank, hot_tfs,
         s2 = _local_scores(q, w2, lay, dblk=dblk, scoring="tfidf",
                            n_f=n_f, k1=k1, b=b)
         s2 = s2 / jnp.maximum(norm.reshape(norm.shape[-1]), 1e-30)[None, :]
-        li = cand - base                                  # local 1..dblk
-        in_blk = (li >= 1) & (li <= dblk) & (cand > 0)
-        safe = jnp.where(in_blk, li, 0)
-        cs = jnp.take_along_axis(s2, safe, axis=1) * in_blk
-        cs = jax.lax.psum(cs, SHARD_AXIS)                 # [B, C]
+        cs = _gather_candidates(s2, cand, base, dblk)     # [B, C]
         return _topk_over_candidates(cs, cand, k)
 
     fn = shard_map(

@@ -112,6 +112,46 @@ def _scatter_rows(tids: np.ndarray, indptr: np.ndarray, counts: np.ndarray):
     return rows, within, src
 
 
+def plan_tiers(
+    df: np.ndarray,
+    *,
+    num_docs: int,
+    hot_budget: int = HOT_BUDGET,
+    base_cap: int = BASE_CAP,
+    growth: int = GROWTH,
+):
+    """The ASSIGNMENT half of build_tiered_layout: which terms get a
+    hot-strip row (the p99-df threshold decides who *wants* one, the
+    element budget decides how many *get* one — largest dfs win), the
+    geometric tier-capacity ladder, and each cold term's rung.
+
+    Returns (hot_tids, cold_tids, caps, want): sorted hot term ids, the
+    cold term ids, the capacity ladder, and `want[i]` = the ladder rung
+    of cold_tids[i]. Shared between the layout builder and `tpu-ir
+    doctor`'s tier-occupancy report (index/doctor.py) so the health
+    report describes the layout serving actually uses, by construction."""
+    d = num_docs
+    nonzero_df = df[df > 0]
+    pcap = max(int(np.percentile(nonzero_df, 99)) if len(nonzero_df) else 1,
+               1)
+    hot_tids = np.nonzero(df > pcap)[0]
+    max_hot = max(int(hot_budget // (d + 1)), 1)
+    if len(hot_tids) > max_hot:
+        order = np.argsort(df[hot_tids], kind="stable")[::-1]
+        hot_tids = np.sort(hot_tids[order[:max_hot]])
+    is_hot = np.zeros(len(df), bool)
+    is_hot[hot_tids] = True
+    cold = np.nonzero(~is_hot & (df > 0))[0]
+    caps: list[int] = []
+    want = np.zeros(0, np.int64)
+    if len(cold):
+        caps = [base_cap]
+        while caps[-1] < int(df[cold].max()):
+            caps.append(caps[-1] * growth)
+        want = np.searchsorted(caps, df[cold], side="left")
+    return hot_tids, cold, caps, want
+
+
 def build_tiered_layout(
     pair_doc: np.ndarray,
     pair_tf: np.ndarray,
@@ -130,16 +170,9 @@ def build_tiered_layout(
     d = num_docs
     indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
 
-    # hot strip: the p99-df threshold decides who *wants* a dense row; the
-    # element budget decides how many *get* one (largest dfs win)
-    nonzero_df = df[df > 0]
-    pcap = max(int(np.percentile(nonzero_df, 99)) if len(nonzero_df) else 1,
-               1)
-    hot_tids = np.nonzero(df > pcap)[0]
-    max_hot = max(int(hot_budget // (d + 1)), 1)
-    if len(hot_tids) > max_hot:
-        order = np.argsort(df[hot_tids], kind="stable")[::-1]
-        hot_tids = np.sort(hot_tids[order[:max_hot]])
+    hot_tids, cold, caps, want = plan_tiers(
+        df, num_docs=num_docs, hot_budget=hot_budget, base_cap=base_cap,
+        growth=growth)
     hot_rank = np.full(v, -1, np.int32)
     hot_rank[hot_tids] = np.arange(len(hot_tids), dtype=np.int32)
 
@@ -160,15 +193,10 @@ def build_tiered_layout(
     # weight functions that are zero at df == 0, which BM25's idf is not.
     tier_of = np.full(v, -1, np.int32)
     row_of = np.zeros(v, np.int32)
-    cold = np.nonzero((hot_rank < 0) & (df > 0))[0]
     tier_docs: list[np.ndarray] = []
     tier_tfs: list[np.ndarray] = []
     max_tf = int(pair_tf.max(initial=0))
     if len(cold):
-        caps = [base_cap]
-        while caps[-1] < int(df[cold].max()):
-            caps.append(caps[-1] * growth)
-        want = np.searchsorted(caps, df[cold], side="left")
         for i in range(len(caps)):
             tids = cold[want == i]
             if not len(tids):

@@ -18,6 +18,7 @@ import logging
 import os
 import re
 import threading
+import time
 from typing import Sequence
 
 import jax.numpy as jnp
@@ -74,10 +75,17 @@ class SearchResult(list):
     unless a serving frontend stepped its degradation ladder down:
     "no_rerank" dropped the rerank/snippet stages, "hot_only" scored only
     the hot tier). Set per-request by tpu_ir.serving.ServingFrontend;
-    plain Scorer calls always serve "full"."""
+    plain Scorer calls always serve "full".
+
+    `explain` (None unless `search_batch(..., explain_k=N)` asked for
+    it) holds one score-decomposition dict per top-N hit
+    (search/explain.py); degraded responses carry None — their scores
+    came from the host fallback, not the device kernels the explain
+    decomposes."""
 
     degraded: bool = False
     level: str = "full"
+    explain: list | None = None
 
 
 def compute_doc_norms(pair_term, pair_doc, pair_tf, df,
@@ -305,6 +313,11 @@ class Scorer:
         # enable this; the serving process must too)
         enable_compilation_cache()
         meta = fmt.IndexMetadata.load(index_dir)
+        # the embedded server's /doctor introspects the index dirs this
+        # process actually serves (obs/server.py keeps the last few)
+        from ..obs.server import register_index_dir
+
+        register_index_dir(index_dir)
         if verify_integrity:
             # side artifacts are small — verify their recorded checksums
             # on every load. Part shards are verified BY the reads that
@@ -1252,25 +1265,8 @@ class Scorer:
                 hot_only=hot_only)
         elif scoring == "bm25":
             if self.layout == "dense":
-                if self._tf_matrix is None:
-                    # build OUTSIDE the lazy lock: dense_tf_matrix is a
-                    # device dispatch, and a lock held across it stalls
-                    # every concurrent lazy-state reader behind the
-                    # upload (lint TPU202). Two racing threads may both
-                    # build; the loser's copy is garbage-collected —
-                    # bounded waste, never corruption (publish is one
-                    # reference assignment under the lock).
-                    pt, pd, ptf = self._pairs
-                    tf_matrix = dense_tf_matrix(
-                        jnp.asarray(pt), jnp.asarray(pd),
-                        jnp.asarray(ptf),
-                        vocab_size=self.meta.vocab_size,
-                        num_docs=self.meta.num_docs)
-                    with self._lazy_lock:
-                        if self._tf_matrix is None:
-                            self._tf_matrix = tf_matrix
-                s, d = bm25_topk_dense(q, self._tf_matrix, self.df,
-                                       self.doc_len, n, k=k)
+                s, d = bm25_topk_dense(q, self._ensure_tf_matrix(),
+                                       self.df, self.doc_len, n, k=k)
             else:
                 from ..ops.scoring import bm25_topk_tiered
 
@@ -1292,6 +1288,26 @@ class Scorer:
                 compat_int_idf=self.compat_int_idf, skip_hot=skip_hot,
                 hot_only=hot_only)
         return s, d
+
+    def _ensure_tf_matrix(self):
+        """Lazy dense [V, D+1] raw-tf matrix (BM25 on the dense layout;
+        the explain debug kernels share it). Built OUTSIDE the lazy
+        lock: dense_tf_matrix is a device dispatch, and a lock held
+        across it stalls every concurrent lazy-state reader behind the
+        upload (lint TPU202). Two racing threads may both build; the
+        loser's copy is garbage-collected — bounded waste, never
+        corruption (publish is one reference assignment under the
+        lock)."""
+        if self._tf_matrix is None:
+            pt, pd, ptf = self._pairs
+            tf_matrix = dense_tf_matrix(
+                jnp.asarray(pt), jnp.asarray(pd), jnp.asarray(ptf),
+                vocab_size=self.meta.vocab_size,
+                num_docs=self.meta.num_docs)
+            with self._lazy_lock:
+                if self._tf_matrix is None:
+                    self._tf_matrix = tf_matrix
+        return self._tf_matrix
 
     def _ensure_pairs(self):
         """The 3-slot host CSR column tuple (pair_term-or-None, pair_doc,
@@ -1377,6 +1393,28 @@ class Scorer:
                     self._norms = norms
         return self._norms
 
+    def _ensure_sharded_norm(self):
+        """Lazy sharded [S, dblk+1] rerank doc norms on the mesh (the
+        sharded rerank + its explain variant). Host norms feed
+        shard_slices directly — _doc_norms() would upload a device copy
+        only to fetch it back. The sharded device_put runs OUTSIDE the
+        lazy lock; only the reference assignment is under it (TPU202 —
+        see _ensure_tf_matrix's note)."""
+        if self._sharded_norm is None:
+            from ..parallel import shard_slices
+            from ..parallel.sharded_tiered import put_doc_sharded
+
+            norms_np = np.ascontiguousarray(self._doc_norms_host())
+            sharded_norm = put_doc_sharded(
+                shard_slices(norms_np,
+                             num_docs=self.meta.num_docs,
+                             num_shards=self._mesh.devices.size),
+                self._mesh)
+            with self._lazy_lock:
+                if self._sharded_norm is None:
+                    self._sharded_norm = sharded_norm
+        return self._sharded_norm
+
     def rerank_topk(
         self, q_terms: np.ndarray, k: int = 10, candidates: int = 1000,
         deadline_s: float | None = None, *, force_host: bool = False,
@@ -1419,24 +1457,9 @@ class Scorer:
         if self.layout == "sharded":
             # both stages run inside one SPMD program; the global doc norms
             # ride to the mesh in sharded [S, dblk+1] form (built once)
-            from ..parallel import shard_slices, sharded_tiered_rerank
-            from ..parallel.sharded_tiered import put_doc_sharded
+            from ..parallel import sharded_tiered_rerank
 
-            if self._sharded_norm is None:
-                # host norms feed shard_slices directly — _doc_norms()
-                # would upload a device copy only to fetch it back. The
-                # sharded device_put runs OUTSIDE the lazy lock; only
-                # the reference assignment is under it (TPU202 — see
-                # _topk_device_raw's tf_matrix note).
-                norms_np = np.ascontiguousarray(self._doc_norms_host())
-                sharded_norm = put_doc_sharded(
-                    shard_slices(norms_np,
-                                 num_docs=self.meta.num_docs,
-                                 num_shards=self._mesh.devices.size),
-                    self._mesh)
-                with self._lazy_lock:
-                    if self._sharded_norm is None:
-                        self._sharded_norm = sharded_norm
+            self._ensure_sharded_norm()
 
             def dispatch(q):
                 # same per-block injection sites as _topk_device: the
@@ -1486,7 +1509,7 @@ class Scorer:
         return_docids: bool = True, rerank: int | None = None,
         prox: bool = False, phrase_slop: int = 0, *,
         deadline_s: float | None = None, force_host: bool = False,
-        hot_only: bool = False,
+        hot_only: bool = False, explain_k: int = 0,
     ) -> list[SearchResult]:
         """Ranked retrieval for query texts. `rerank=N` switches to the
         two-stage pipeline: BM25 top-N candidates, cosine TF-IDF rerank;
@@ -1502,7 +1525,13 @@ class Scorer:
         tier on tiered/sharded layouts. Each SearchResult's `degraded`
         flag is tagged from THIS request's outcome (thread-safe), not the
         racy `degraded_last` alias. Phrase queries already run on the
-        host and ignore the device knobs."""
+        host and ignore the device knobs.
+
+        `explain_k=N` attaches a per-term score decomposition for each
+        query's top-N hits (SearchResult.explain; search/explain.py) —
+        exact kernel floats, extra debug dispatches, so a forensics
+        knob, not a default. Degraded responses and phrase/prox results
+        (host-scored) carry explain=None."""
         if prox and not rerank:
             raise ValueError("the proximity boost is stage 3 of the "
                              "two-stage rerank; pass rerank=N (--rerank) "
@@ -1512,7 +1541,8 @@ class Scorer:
         plain_iter = iter(self._search_batch_plain(
             plain, k=k, scoring=scoring, return_docids=return_docids,
             rerank=rerank, prox=prox, deadline_s=deadline_s,
-            force_host=force_host, hot_only=hot_only) if plain else [])
+            force_host=force_host, hot_only=hot_only,
+            explain_k=explain_k) if plain else [])
         return [self._search_phrase(t, k=k, scoring=scoring,
                                     slop=phrase_slop,
                                     return_docids=return_docids,
@@ -1523,9 +1553,11 @@ class Scorer:
         self, texts: Sequence[str], *, k: int, scoring: str,
         return_docids: bool, rerank: int | None, prox: bool,
         deadline_s: float | None = None, force_host: bool = False,
-        hot_only: bool = False,
+        hot_only: bool = False, explain_k: int = 0,
     ) -> list[SearchResult]:
+        t0 = time.perf_counter()
         q = self.analyze_queries(texts)
+        t_analyzed = time.perf_counter()
         if rerank:
             from .phrase import PROX_DEPTH
 
@@ -1540,6 +1572,7 @@ class Scorer:
             scores, docnos, degraded = self.topk_tagged(
                 q, k=k, scoring=scoring, deadline_s=deadline_s,
                 hot_only=hot_only, force_host=force_host)
+        t_dispatched = time.perf_counter()
         out = []
         for qi in range(len(texts)):
             res = SearchResult()
@@ -1556,7 +1589,106 @@ class Scorer:
                 key = self.mapping.get_docid(int(dn)) if return_docids else int(dn)
                 res.append((key, float(s)))
             out.append(res)
+        # the request's serving latency, captured BEFORE the optional
+        # explain block: the forensics knob's debug dispatches must not
+        # inflate total_ms and trip the slow-query trap on requests
+        # whose actual serving was fast
+        total_s = time.perf_counter() - t0
+        if explain_k and not degraded and not prox:
+            # prox rescoring happens on the host AFTER the kernels — its
+            # final scores are not a kernel decomposition target
+            from .explain import explain_hits
+
+            for qi, text in enumerate(texts):
+                top = [int(dn) for dn in docnos[qi][:explain_k] if dn > 0]
+                if top:
+                    out[qi].explain = explain_hits(
+                        self, text, top, scoring=scoring, rerank=rerank,
+                        hot_only=hot_only)
+        self._querylog_record(
+            texts, q, docnos, out, k=k, scoring=scoring, rerank=rerank,
+            hot_only=hot_only, force_host=force_host, degraded=degraded,
+            prox=prox, analyze_s=t_analyzed - t0,
+            dispatch_s=t_dispatched - t_analyzed, total_s=total_s)
         return out
+
+    def _querylog_record(self, texts, q, docnos, results, *, k, scoring,
+                         rerank, hot_only, force_host, degraded, prox,
+                         analyze_s, dispatch_s, total_s) -> None:
+        """One query-log entry per query of this batch (obs/querylog.py):
+        terms (hash when redacted), level, the batch's stage-latency
+        split, batch id (the per-request attribution key inside a shared
+        batch), top-k docids + scores, and the MaxScore scheduling
+        decision. The slow-query trap's explain capture is deferred
+        behind the flight recorder's rate gate via a callable."""
+        from ..obs import querylog
+
+        if not querylog.enabled() or not texts:
+            return
+        batch_id = querylog.next_batch_id()
+        mode = has_hot = None
+        if self.layout == "sparse" and self.prune and not hot_only:
+            # re-derived once per batch (one [B, L] host gather) — the
+            # dispatch path's identical decision is not threaded back
+            # out through the tagged-return plumbing just to save it
+            has_hot, _, mode = self._skip_plan(q)
+        level = "hot_only" if hot_only else "full"
+        stage = {"analyze_ms": round(analyze_s * 1e3, 3),
+                 "dispatch_ms": round(dispatch_s * 1e3, 3),
+                 "total_ms": round(total_s * 1e3, 3)}
+        for qi, text in enumerate(texts):
+            ids = [int(t) for t in q[qi] if t >= 0]
+            entry = {
+                "query_hash": querylog.query_hash(ids),
+                "n_terms": len(ids),
+                "level": level,
+                "degraded": bool(degraded),
+                "forced_host": bool(force_host),
+                "scoring": scoring,
+                "rerank": rerank,
+                "prox": bool(prox),
+                "k": k,
+                "batch_id": batch_id,
+                "batch_size": len(texts),
+                # batch-level attribution: every entry of the batch
+                # carries the batch's split, joined by batch_id — the
+                # shared-padded-batch lens ROADMAP 3 needs
+                **stage,
+                "top": [[key, round(float(s), 6)]
+                        for key, s in results[qi][:10]],
+            }
+            if not querylog.redacted():
+                entry["terms"] = [self.vocab.term(t) for t in ids]
+            if mode is not None:
+                entry["prune"] = {"dispatch_mode": mode,
+                                  "has_hot": bool(has_hot[qi])}
+            explain_fn = None
+            top_dn = [int(dn) for dn in docnos[qi][:1] if dn > 0]
+            if qi == 0 and top_dn and not degraded and not prox:
+                # the trap's force-capture target: the batch's first
+                # query's top hit (batch latency is attributed batch-
+                # wide, so any member stands for the offender)
+                def explain_fn(text=text, dn=top_dn):
+                    from .explain import explain_hits
+
+                    return explain_hits(self, text, dn, scoring=scoring,
+                                        rerank=rerank, hot_only=hot_only)
+            querylog.record(entry, explain_fn=explain_fn)
+
+    def explain(self, text: str, key, *, is_docid: bool = True,
+                scoring: str = "tfidf", rerank: int | None = None,
+                hot_only: bool = False) -> dict:
+        """Lucene-explain for one (query, doc): the exact per-term score
+        decomposition of what the production kernels computed —
+        tf/df/idf/length-norm per term, tier placement, the prune/skip
+        dispatch decision, marginal per-slot contributions whose float64
+        sum reproduces the kernel score bit-exactly, and the rerank
+        stage split when `rerank` is set (search/explain.py)."""
+        from .explain import explain_hits
+
+        docno = self.mapping.get_docno(key) if is_docid else int(key)
+        return explain_hits(self, text, [docno], scoring=scoring,
+                            rerank=rerank, hot_only=hot_only)[0]
 
     # -- positions-backed retrieval (format v2) ---------------------------
 
@@ -1604,13 +1736,16 @@ class Scorer:
             return self._search_batch_plain(
                 [text.replace('"', ' ')], k=k, scoring=scoring,
                 return_docids=return_docids, rerank=rerank, prox=prox)[0]
+        t0 = time.perf_counter()
         pidx = self._phrase_index()
         matched: set[int] | None = None
         for _, toks in analyzed:
             docs = set(pidx.match_window(toks, slop=slop))
             matched = docs if matched is None else matched & docs
             if not matched:
-                return SearchResult()
+                return self._querylog_phrase(text, SearchResult(), t0,
+                                             k=k, scoring=scoring,
+                                             rerank=rerank)
         all_terms = self._query_term_sequence(text.replace('"', ' '))
         if rerank:
             # stage 1: BM25 over the matched docs, keep top-`rerank`
@@ -1653,6 +1788,37 @@ class Scorer:
             dn = int(docnos[i])
             key = self.mapping.get_docid(dn) if return_docids else dn
             res.append((key, float(scores[i])))
+        return self._querylog_phrase(text, res, t0, k=k, scoring=scoring,
+                                     rerank=rerank)
+
+    def _querylog_phrase(self, text, res, t0, *, k, scoring, rerank):
+        """Query-log entry for one host-scored phrase query (slim form:
+        no device stage split, no explain trap target — the phrase
+        pipeline never touches the kernels the explain decomposes)."""
+        from ..obs import querylog
+
+        if querylog.enabled():
+            total_ms = round((time.perf_counter() - t0) * 1e3, 3)
+            terms = self._query_term_sequence(text.replace('"', ' '))
+            ids = [self.vocab.id_or(t) for t in terms]
+            entry = {
+                "query_hash": querylog.query_hash([i for i in ids
+                                                   if i >= 0]),
+                "n_terms": len(terms),
+                "level": "full",
+                "degraded": False,
+                "phrase": True,
+                "scoring": scoring,
+                "rerank": rerank,
+                "k": k,
+                "batch_id": querylog.next_batch_id(),
+                "batch_size": 1,
+                "total_ms": total_ms,
+                "top": [[key, round(float(s), 6)] for key, s in res[:10]],
+            }
+            if not querylog.redacted():
+                entry["terms"] = terms
+            querylog.record(entry)
         return res
 
     def _apply_proximity(self, texts, scores, docnos, k: int):

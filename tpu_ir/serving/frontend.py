@@ -281,10 +281,17 @@ class ServingFrontend:
             self._count("breaker_probes")
         use_rerank = rerank if level == LEVEL_FULL else None
         try:
-            res = self.scorer.search_batch(
-                [text], k=k, scoring=scoring, rerank=use_rerank,
-                deadline_s=self.config.deadline_s, force_host=force_host,
-                hot_only=(level == LEVEL_HOT_ONLY))[0]
+            # the query log records inside the scorer, which only knows
+            # flags; the context stamps each entry with the ladder's
+            # true service level + the queue depth it was served under
+            with obs.querylog.request_context(
+                    level=level,
+                    queue_depth=self.admission.queue_depth()):
+                res = self.scorer.search_batch(
+                    [text], k=k, scoring=scoring, rerank=use_rerank,
+                    deadline_s=self.config.deadline_s,
+                    force_host=force_host,
+                    hot_only=(level == LEVEL_HOT_ONLY))[0]
         except BaseException:
             # not a device verdict (bad query, program bug): release any
             # probe slot this request held so the breaker cannot wedge

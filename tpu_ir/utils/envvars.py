@@ -128,6 +128,24 @@ _declare("TPU_IR_BENCH_CHECK_MIN_ROWS", "int", 3,
 _declare("TPU_IR_BENCH_CHECK_TOLERANCE", "float", 0.3,
          "relative degradation vs the window median that breaches "
          "bench-check", "§14", minimum=0.0)
+_declare("TPU_IR_QUERYLOG", "bool", True,
+         "0 disables the sampled query log AND the slow-query trap",
+         "§15")
+_declare("TPU_IR_QUERYLOG_RING", "int", 256,
+         "capacity of the per-process query-log ring", "§15", minimum=1)
+_declare("TPU_IR_QUERYLOG_SAMPLE", "int", 1,
+         "keep every N-th query entry in the ring (slow queries always "
+         "record)", "§15", minimum=1)
+_declare("TPU_IR_QUERYLOG_REDACT", "bool", False,
+         "1 stores only a stable hash of the analyzed query terms "
+         "(privacy: no readable query text in telemetry)", "§15")
+_declare("TPU_IR_QUERYLOG_SLOW_KEEP", "int", 16,
+         "slow-query captures (span tree + explain) kept in memory",
+         "§15", minimum=1)
+_declare("TPU_IR_SLOW_QUERY_MS", "float", 0.0,
+         "requests at/above this latency are force-captured (explain + "
+         "span tree + flight record); 0 disables the trap", "§15",
+         minimum=0.0)
 
 
 def _raw(name: str) -> str | None:
