@@ -968,23 +968,14 @@ def _append_history(out: dict) -> None:
     BENCH_HISTORY.jsonl next to this script (timestamp- and
     commit-sha-stamped), so the perf trajectory across PRs is one
     machine-readable file instead of scattered BENCH_*.json snapshots.
-    Best-effort: a read-only checkout must not fail the bench."""
-    import subprocess
+    Best-effort: a read-only checkout must not fail the bench. ONE
+    stamping/writing implementation, shared with the serve-bench sweep
+    (obs/bench_check.append_history_row) so the row schema cannot
+    diverge."""
+    from tpu_ir.obs.bench_check import append_history_row
 
     here = os.path.dirname(os.path.abspath(__file__))
-    try:
-        commit = subprocess.run(
-            ["git", "rev-parse", "--short", "HEAD"], cwd=here,
-            capture_output=True, text=True, timeout=10).stdout.strip()
-    except (subprocess.SubprocessError, OSError):
-        commit = ""
-    row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
-           "commit": commit or None, **out}
-    try:
-        with open(os.path.join(here, "BENCH_HISTORY.jsonl"), "a") as f:
-            f.write(json.dumps(row, default=repr) + "\n")
-    except OSError:
-        pass
+    append_history_row(out, path=os.path.join(here, "BENCH_HISTORY.jsonl"))
 
 
 def _build_phase_timings(index_dir: str) -> dict:

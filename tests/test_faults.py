@@ -476,19 +476,21 @@ def test_hung_dispatch_degrades_within_deadline(served):
     try:
         q = s.analyze_queries(["salmon fishing", "stock market"])
         t0 = time.perf_counter()
-        scores, docnos = s.topk(q, k=5, scoring="bm25")
+        scores, docnos, degraded = s.topk_tagged(q, k=5, scoring="bm25")
         elapsed = time.perf_counter() - t0
     finally:
         s.deadline_s = None
         faults.clear()
     assert elapsed < 3.0, "deadline did not bound the hung dispatch"
-    assert s.degraded_last
+    # the per-request tagged return is THE degradation surface (the
+    # single-threaded degraded_last alias is gone — ISSUE 9)
+    assert degraded
     assert recovery_counters().get("deadline_expired") == 1
     assert recovery_counters().get("degraded_batches") == 1
     assert (docnos[0] > 0).any() and (docnos[1] > 0).any()
     # degraded results are real rankings: same docs as the primary path
-    ps, pd = s.topk(q, k=5, scoring="bm25")
-    assert not s.degraded_last
+    ps, pd, degraded2 = s.topk_tagged(q, k=5, scoring="bm25")
+    assert not degraded2
     np.testing.assert_array_equal(docnos, pd)
     np.testing.assert_allclose(scores, ps, rtol=1e-4)
 
@@ -513,11 +515,11 @@ def test_rerank_degrades_to_host_bm25(served):
     s = served
     faults.install(faults.parse_plan("score.device_loss:once@1"))
     try:
-        scores, docnos = s.rerank_topk(
+        scores, docnos, degraded = s.rerank_topk_tagged(
             s.analyze_queries(["salmon fishing"]), k=5, candidates=50)
     finally:
         faults.clear()
-    assert s.degraded_last
+    assert degraded
     assert (docnos > 0).any()
     assert recovery_counters().get("degraded_batches") == 1
 
@@ -562,8 +564,8 @@ def test_cache_fast_path_lazy_pairs_verified(tmp_path, ref):
 def test_no_deadline_no_plan_takes_primary_path(served):
     s = served
     q = s.analyze_queries(["salmon fishing"])
-    scores, docnos = s.topk(q, k=5)
-    assert not s.degraded_last
+    scores, docnos, degraded = s.topk_tagged(q, k=5)
+    assert not degraded
     assert (docnos > 0).any()
 
 

@@ -499,6 +499,40 @@ def test_soak_without_faults_serves_everything_full(scorer):
     assert report["full_bitidentical"] == 60
 
 
+def test_soak_with_coalescing_under_chaos(scorer):
+    """The PR 2 chaos soak THROUGH the continuous micro-batching
+    frontend (ISSUE 9): all the original invariants must survive shared
+    padded batches — shed + served == submitted, every response
+    bit-identical-full / tagged / structurally rejected, zero deadlocks
+    (and the module's OrderedLock arming re-verifies the scheduler's
+    lock discipline on every schedule) — plus the batching-specific
+    pin: degradation within one coalesced batch is UNIFORM, so no
+    request is ever charged a deadline a batch-mate's slow slot burned
+    (batch_mixed_degraded == 0)."""
+    from tpu_ir.obs import querylog
+
+    querylog.clear()
+    report = run_soak(
+        scorer, threads=8, queries=200, seed=7,
+        fault_spec=("score.hang:p=0.12:sleep=0.5,"
+                    "score.device_loss:p=0.08,seed=9"),
+        config=ServingConfig(max_concurrency=6, max_queue=8,
+                             deadline_s=0.2, queue_timeout_s=0.15,
+                             breaker_threshold=4,
+                             breaker_cooldown_s=0.2, coalesce=True),
+        timeout_s=120.0, pacing_s=0.002)
+    _assert_soak_invariants(report)
+    assert report["submitted"] == 200
+    assert report["degraded"] > 0, "the chaos never bit"
+    batching = report["batching"]
+    assert batching["batches"] > 0
+    assert batching["coalesced"] + batching["solo_flush"] == \
+        batching["batches"]
+    assert batching["queued"] == 0 and not batching["dispatching"]
+    # the per-slot attribution invariant (tag, don't drop)
+    assert report["batch_mixed_degraded"] == 0
+
+
 @pytest.mark.slow
 def test_soak_long_sustained_chaos(scorer):
     """The long soak: sustained mixed traffic with heavier chaos and

@@ -82,6 +82,15 @@ METRICS: dict[str, tuple[str, float]] = {
     "warm_device_time_ms": ("lower", 2.0),
     "peak_hbm_bytes": ("lower", float(64 << 20)),
     "warm_peak_hbm_bytes": ("lower", float(64 << 20)),
+    # coalesced serving (ISSUE 9 serve-sweep rows): throughput at the
+    # sweep's largest concurrency, its tail latency (same max-of-N
+    # weather floor as query_p99_ms), the solo-path p50 the bounded
+    # coalescing wait must not regress, and median batch occupancy
+    # (occupancy collapsing to ~1 means coalescing silently disengaged)
+    "batched_qps": ("higher", 0.0),
+    "batched_p99_ms": ("lower", 50.0),
+    "solo_p50_ms": ("lower", 2.0),
+    "batch_occupancy_mean": ("higher", 0.0),
 }
 
 
@@ -207,6 +216,34 @@ def default_history_path() -> str | None:
         if os.path.exists(cand):
             return cand
     return None
+
+
+def append_history_row(row: dict, path: str | None = None) -> str | None:
+    """Append one commit/timestamp-stamped summary row to
+    BENCH_HISTORY.jsonl (the bench.py `_append_history` contract, shared
+    so `tpu-ir serve-bench --concurrency N,N,...` sweep rows land in the
+    same trajectory the sentry gates). Best-effort: a read-only checkout
+    must not fail the run. Returns the path written, or None."""
+    import subprocess
+    import time
+
+    path = path or default_history_path() or os.path.join(
+        os.getcwd(), "BENCH_HISTORY.jsonl")
+    try:
+        commit = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            cwd=os.path.dirname(path) or ".",
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except (subprocess.SubprocessError, OSError):
+        commit = ""
+    stamped = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"),
+               "commit": commit or None, **row}
+    try:
+        with open(path, "a", encoding="utf-8") as f:
+            f.write(json.dumps(stamped, default=repr) + "\n")
+    except OSError:
+        return None
+    return path
 
 
 def run_check(path: str | None = None, *, window: int | None = None,

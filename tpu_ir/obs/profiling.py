@@ -521,6 +521,18 @@ def profile_report() -> dict:
         },
         "recompiles_last_60s": recompiles_last_60s(),
         "dispatch": dispatch,
+        # the coalescing scheduler's view (ISSUE 9): batches packed vs
+        # solo-flushed, the occupancy distribution (1..top-rung on the
+        # bucket scale) and per-slot coalesce wait — read next to the
+        # dispatch split above to see what each kernel call amortized
+        "batching": {
+            "batch.coalesced": snap["counters"].get("batch.coalesced", 0),
+            "batch.solo_flush": snap["counters"].get("batch.solo_flush",
+                                                     0),
+            **{name: hists[name]
+               for name in ("batch.occupancy", "batch.wait")
+               if name in hists},
+        },
         "gauges": snap.get("gauges", {}),
         "memory": memory_snapshot(),
     }

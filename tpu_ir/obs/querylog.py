@@ -68,7 +68,12 @@ _slow_times: collections.deque = collections.deque(maxlen=1024)
 _slow_headers: collections.deque = collections.deque(maxlen=64)
 
 _HEADER_KEYS = ("query_hash", "level", "total_ms", "analyze_ms",
-                "dispatch_ms", "time", "batch_id")
+                "dispatch_ms", "time", "batch_id",
+                # coalesced-serving attribution (ISSUE 9): how long the
+                # slow offender waited to coalesce and how full its
+                # shared batch was — the first two questions a slow
+                # query inside a batch raises
+                "queue_wait_ms", "batch_occupancy")
 
 
 def configure(enabled: bool | None = None, sample: int | None = None,

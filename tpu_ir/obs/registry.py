@@ -109,11 +109,17 @@ COMPILE_COUNTER_NAMES = ("compile.count", "compile.recompiles")
 # the sampled ring, and the subset the slow-query trap force-captured.
 QUERYLOG_COUNTER_NAMES = ("querylog.recorded", "querylog.slow")
 
+# Coalescing-scheduler counters (serving/batching.py, ISSUE 9): batches
+# that actually packed >1 concurrent query into one padded dispatch, and
+# batches flushed with a single occupant (idle arrivals dispatch
+# immediately — the solo-latency guarantee).
+BATCH_COUNTER_NAMES = ("batch.coalesced", "batch.solo_flush")
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
-) + COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES
+) + COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
@@ -125,6 +131,12 @@ DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     "explain",
     # one slow-query force-capture (span tree + explain + flight dump)
     "querylog.slow_capture",
+    # coalescing scheduler (ISSUE 9): batch occupancy per dispatched
+    # batch (a COUNT observed on the latency bucket scale — 1..64 lands
+    # exactly; p50 occupancy > 1 is the "coalescing engaged" proof) and
+    # per-slot queue wait (enqueue -> dispatch start, seconds)
+    "batch.occupancy",
+    "batch.wait",
 )
 
 # Gauges: point-in-time values (memory levels, cache sizes) — unlike

@@ -213,6 +213,10 @@ def health_snapshot() -> dict:
         out["breaker"] = latest.get("breaker")
         out["ladder"] = latest.get("ladder")
         out["queue_depth"] = latest.get("queue_depth")
+        # the coalescer's control-plane state (ISSUE 9): occupancy
+        # collapsing to ~1 under load means batching silently
+        # disengaged — an alerting-grade signal, so it rides top-level
+        out["batching"] = latest.get("batching")
     return out
 
 

@@ -131,7 +131,14 @@ def _tfidf_dense_scores(q_terms, doc_matrix, df, num_docs,
     # False, and the clamped gather returns finite real rows — a mask
     # here would re-multiply the [B, L, D+1] tensor for nothing
     rows = doc_matrix[safe_q]                              # [B, L, D+1]
-    return jnp.einsum("bld,bl->bd", rows, q_idf)           # [B, D+1]
+    # explicit multiply + reduce over the term axis, NOT an einsum: a
+    # dot_general's algorithm (fma fusion, lane order) is chosen per
+    # SHAPE, so the same query row could round differently at batch
+    # size 1 vs 4 — the coalescing frontend (ISSUE 9) pins coalesced ==
+    # solo BIT-exactly, which needs a batch-size-invariant lowering.
+    # The [B, L, D+1] intermediate already exists (the gather above),
+    # so this costs no extra memory.
+    return jnp.sum(rows * q_idf[:, :, None], axis=1)       # [B, D+1]
 
 
 @partial(profiled_jit, static_argnames=("k", "compat_int_idf"))
@@ -182,9 +189,10 @@ def _bm25_dense_scores(q_terms, tf_matrix, df, doc_len, num_docs,
     q_valid = (q_terms >= 0) & (q_terms < vocab_size)
     q_idf = jnp.where(q_valid, idf[safe_q], 0.0)           # [B, L]
     tf = tf_matrix[safe_q]                                  # [B, L, D+1]
-    return jnp.einsum("bld,bl->bd",
-                      bm25_saturation(tf, dl_norm[None, None, :], k1=k1),
-                      q_idf)
+    # mul + reduce, not einsum: batch-size-invariant rounding (see
+    # _tfidf_dense_scores — the coalesced == solo bit-exactness pin)
+    sat = bm25_saturation(tf, dl_norm[None, None, :], k1=k1)
+    return jnp.sum(sat * q_idf[:, :, None], axis=1)
 
 
 @partial(profiled_jit, static_argnames=("k", "k1", "b"))
@@ -526,6 +534,36 @@ def bm25_topk_tiered(
     return _topk_from_scores(scores, k)
 
 
+# -- donated-query twins (ISSUE 9) ------------------------------------------
+# The coalescing serving frontend dispatches one padded query batch per
+# kernel call; the int32 [B, L] query block is freshly uploaded per call
+# and never read again host-side, so its device buffer is DONATED
+# (SNIPPETS.md pjit donate_argnums pattern) — XLA may alias it into the
+# outputs instead of holding both live. The index-side operands stay
+# resident and undonated. These are separate entry points (not a flag on
+# the production kernels) because the rerank pipeline REUSES its query
+# array across two kernel calls — donating there would be use-after-free.
+
+
+def _donated_query_twin(kernel, **jit_kwargs):
+    """Twin of a profiled_jit kernel with arg 0 (the query block)
+    donated; identical math — same traced function object."""
+    return profiled_jit(kernel.__wrapped__, label=kernel.label + "_dq",
+                        donate_argnums=(0,), **jit_kwargs)
+
+
+tfidf_topk_dense_dq = _donated_query_twin(
+    tfidf_topk_dense, static_argnames=("k", "compat_int_idf"))
+bm25_topk_dense_dq = _donated_query_twin(
+    bm25_topk_dense, static_argnames=("k", "k1", "b"))
+tfidf_topk_tiered_dq = _donated_query_twin(
+    tfidf_topk_tiered, static_argnames=("k", "num_docs", "compat_int_idf",
+                                        "prune", "skip_hot", "hot_only"))
+bm25_topk_tiered_dq = _donated_query_twin(
+    bm25_topk_tiered, static_argnames=("k", "num_docs", "k1", "b", "prune",
+                                       "skip_hot", "hot_only"))
+
+
 def _bm25_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
                         tier_docs, tier_tfs, df, doc_len, n_scalar,
                         hot_max_tf, *, num_docs, prune_k, k1, b, prune,
@@ -654,7 +692,9 @@ def _cosine_dense_scores(q_terms, doc_matrix, df, doc_norm, cand_docnos,
     # one fused gather of exactly the candidate columns: [B, L, C]
     cand_tf = doc_matrix[safe_q[:, :, None],
                          cand_docnos.astype(jnp.int32)[:, None, :]]
-    scores = jnp.einsum("blc,bl->bc", cand_tf, q_idf * q_idf)
+    # mul + reduce, not einsum: batch-size-invariant rounding (see
+    # _tfidf_dense_scores — the coalesced == solo bit-exactness pin)
+    scores = jnp.sum(cand_tf * (q_idf * q_idf)[:, :, None], axis=1)
     return scores / jnp.maximum(doc_norm[cand_docnos], 1e-30)
 
 

@@ -62,6 +62,33 @@ _GLOB_SPLIT_RE = re.compile(
 logger = logging.getLogger(__name__)
 
 
+def _rtt_dominated_backend() -> bool:
+    """True where the fixed per-dispatch round trip dominates per-row
+    kernel cost (TPU: ~100 ms RTT, batch rows ~free on the MXU) — the
+    regime in which folding a small hot-free group into the full
+    dispatch beats paying a second RTT. On CPU the hot-strip matmul
+    dominates instead, so the split wins."""
+    import jax
+
+    return jax.default_backend() == "tpu"
+
+
+def _donation_enabled() -> bool:
+    """Whether coalesced dispatches should use the donated-query kernel
+    twins (ops/scoring.py `*_dq`). TPU_IR_BATCH_DONATE: "auto" donates
+    only on backends that implement input-output aliasing (TPU) — on CPU
+    jax warns and ignores the donation, pure noise; "1"/"0" force it for
+    A/B runs and the parity test."""
+    from ..utils import envvars
+
+    mode = envvars.get_choice("TPU_IR_BATCH_DONATE")
+    if mode == "auto":
+        import jax
+
+        return jax.default_backend() == "tpu"
+    return mode == "1"
+
+
 class SearchResult(list):
     """List of (docno, score) or (docid, score) tuples for one query.
 
@@ -81,11 +108,16 @@ class SearchResult(list):
     it) holds one score-decomposition dict per top-N hit
     (search/explain.py); degraded responses carry None — their scores
     came from the host fallback, not the device kernels the explain
-    decomposes."""
+    decomposes.
+
+    `breaker_vote` (serving-internal): inside a coalesced shared batch,
+    exactly one result carries True — the serving frontend feeds the
+    circuit breaker one verdict per DISPATCH, not per slot."""
 
     degraded: bool = False
     level: str = "full"
     explain: list | None = None
+    breaker_vote: bool = True
 
 
 def compute_doc_norms(pair_term, pair_doc, pair_tf, df,
@@ -132,12 +164,11 @@ class Scorer:
     # class-level defaults so minimal Scorers (tests build them with
     # object.__new__ over synthetic layouts) get the no-deadline behavior
     deadline_s: float | None = None
-    # DEPRECATED single-threaded alias: True when the last tagged dispatch
-    # THIS scorer ran was answered by a fallback. Racy the moment two
-    # queries run concurrently — concurrent callers must use the
-    # per-request flag (topk_tagged / rerank_topk_tagged return it;
-    # search_batch tags each SearchResult.degraded from it).
-    degraded_last: bool = False
+    # (the old single-threaded `degraded_last` alias is GONE — ISSUE 9:
+    # under coalesced shared batches only the per-request tagged path
+    # (topk_tagged / rerank_topk_tagged -> SearchResult.degraded) is a
+    # correct source; the alias was racy the moment two queries ran
+    # concurrently and PR 2 kept it for compat only.)
     # guards lazy expensive state (_pairs assembly, rerank norms, the
     # dense tf matrix, wildcard lookups) under concurrent serving; an
     # RLock because the norms path re-enters _pairs. __init__ gives each
@@ -182,8 +213,6 @@ class Scorer:
         self.compat_int_idf = compat_int_idf
         self.deadline_s = deadline_s
         self._lazy_lock = threading.RLock()
-        # True when the LAST topk/rerank batch was answered by a fallback
-        self.degraded_last = False
         # rank-safe MaxScore pruning of the tiered hot-strip stage
         # (ops/scoring.py::_hot_stage_pruned); results are identical with
         # it off — the toggle exists for the bench's device-control A/B
@@ -805,14 +834,21 @@ class Scorer:
         return row
 
     def analyze_queries(
-        self, texts: Sequence[str], max_terms: int | None = None
+        self, texts: Sequence[str], max_terms: int | None = None,
+        width_floor: int | None = None,
     ) -> np.ndarray:
         """Analyze query texts into an int32 [B, L] id array (PAD -1).
 
         Unknown terms (not in the vocabulary) are dropped, like the
         reference's dictionary miss path (IntDocVectorsForwardIndex.java:
         150-153 returns null -> term skipped). Glob tokens expand to an OR
-        over matching vocabulary terms via the char-k-gram index."""
+        over matching vocabulary terms via the char-k-gram index.
+
+        `width_floor` pads L up to at least that many slots before the
+        pow2 bucketing (never truncates): the coalescing frontend pins
+        every batch to ONE precompilable width, so batch content cannot
+        mint per-batch compile shapes (-1 slots score exact 0.0 — the
+        explain suite pins PAD exactness, so a wider row is bit-exact)."""
         rows = []
         for text in texts:
             extra: list[int] = []
@@ -853,6 +889,8 @@ class Scorer:
         cap = max_terms or max((len(r) for r in rows), default=1)
         cap = max(cap, 1)
         if max_terms is None:
+            if width_floor:
+                cap = max(cap, int(width_floor))
             # bucket the width to a power of two so the set of compiled
             # programs stays small (wildcard expansion would otherwise mint
             # a fresh width — and a fresh XLA compile — per query shape)
@@ -929,7 +967,8 @@ class Scorer:
         here or on the Scorer), a dispatch that overruns it — or dies
         with a device loss — falls back down the serving chain (resident
         device layout -> host CPU scoring over the postings columns) and
-        the batch is flagged via `degraded_last` / SearchResult.degraded,
+        the batch is flagged via the tagged return (topk_tagged /
+        SearchResult.degraded),
         so the engine returns bounded-latency answers instead of hanging
         ("The Tail at Scale"). A deadline of None with no fault plan
         installed takes the primary path with zero added work.
@@ -960,16 +999,34 @@ class Scorer:
     def topk_tagged(
         self, q_terms: np.ndarray, k: int = 10, scoring: str = "tfidf",
         deadline_s: float | None = None, *, hot_only: bool = False,
-        force_host: bool = False,
+        force_host: bool = False, donate: bool = False,
+        uniform: tuple | None = None,
     ) -> tuple[np.ndarray, np.ndarray, bool]:
         """topk() with the per-request degraded flag threaded through the
-        return value: (scores, docnos, degraded). This is the
-        thread-safe surface — `degraded_last` is only a deprecated alias
-        for single-threaded callers (two concurrent queries reading it
-        observe each other's outcome)."""
+        return value: (scores, docnos, degraded). This is THE thread-safe
+        surface (ISSUE 9 retired the racy scorer-level `degraded_last`
+        alias — under coalesced shared batches the tagged return is the
+        only correct source).
+
+        `donate=True` routes the dispatch through the donated-query
+        kernel twins (ops/scoring.py `*_dq`): the [B, L] query block's
+        device buffer is donated to XLA — the coalescing frontend's
+        per-batch upload never needs it back. Applied only where
+        supported (dense/tiered device path on a donating backend).
+
+        `uniform=(rungs...)` (the coalesced serving path) replaces the
+        content-dependent pow2 group padding of MaxScore scheduling
+        with LADDER-RUNG padding: the hot-free and hot groups each pad
+        to the smallest rung that fits, so the whole compiled-program
+        set is rungs x {skip, full} per scoring model — precompilable
+        at frontend start, and batch content can never mint a fresh
+        XLA shape mid-serving. Group membership still follows the
+        scheduler's exact plan (skip kernel pinned bit-identical on
+        hot-free rows), so results cannot differ."""
         q = np.asarray(q_terms, np.int32)
         return self._dispatch_degradable(
-            lambda: self._topk_primary(q, k, scoring, hot_only=hot_only),
+            lambda: self._topk_primary(q, k, scoring, hot_only=hot_only,
+                                       donate=donate, uniform=uniform),
             lambda: self._topk_host(q, k, scoring),
             deadline_s, "score dispatch",
             "answering from the host CPU backend", force_host=force_host)
@@ -984,19 +1041,18 @@ class Scorer:
         installed this is a plain call.
 
         Returns (result..., degraded): the per-request degraded flag is
-        appended to the primary/fallback (scores, docnos) tuple, and also
-        mirrored into the deprecated `degraded_last` alias.
+        appended to the primary/fallback (scores, docnos) tuple — the
+        ONLY degradation source; under coalesced shared batches a
+        scorer-level "last outcome" field would be cross-request state.
 
         `force_host=True` skips the device path entirely — the serving
         frontend's open circuit breaker routes here so a known-down
         device costs host-fallback latency, not a deadline per request."""
         if force_host:
             recovery_counters().incr("forced_host_batches")
-            self.degraded_last = True
             with obs_trace("fallback", label=label, forced=True):
                 return fallback() + (True,)
         deadline = self.deadline_s if deadline_s is None else deadline_s
-        self.degraded_last = False
         if deadline is None and faults.active() is None:
             with obs_trace("dispatch", label=label):
                 return primary() + (False,)
@@ -1018,41 +1074,51 @@ class Scorer:
             reason = f"device loss: {e}"
         recovery_counters().incr("degraded_batches")
         logger.warning("%s degraded (%s); %s", label, reason, consequence)
-        self.degraded_last = True
         with obs_trace("fallback", label=label, reason=reason):
             return fallback() + (True,)
 
     def _topk_primary(self, q: np.ndarray, k: int, scoring: str,
-                      hot_only: bool = False):
-        """The device scoring path (all layouts + MaxScore scheduling)."""
+                      hot_only: bool = False, donate: bool = False,
+                      uniform: tuple | None = None):
+        """The device scoring path (all layouts + MaxScore scheduling;
+        `uniform=(rungs...)` = rung-padded scheduled groups — the
+        coalesced static-shape serving path, see topk_tagged)."""
         block = self._block_size()
+        if (uniform and not hot_only and self.layout == "sparse"
+                and self.prune):
+            return self._topk_uniform(q, k, scoring, uniform,
+                                      donate=donate)
         if hot_only or self.layout != "sparse" or not self.prune:
             # hot_only: no MaxScore scheduling — the cold stages it
             # schedules around are statically absent
             return self._blocked_dispatch(
                 block, lambda qb: self._topk_device(qb, k, scoring,
-                                                    hot_only=hot_only),
+                                                    hot_only=hot_only,
+                                                    donate=donate),
                 (q, -1))
         has_hot, n_free, mode = self._skip_plan(q)
         if mode == "all_skip":
             return self._blocked_dispatch(
                 block,
                 lambda qb: self._topk_device(qb, k, scoring,
-                                             skip_hot=True), (q, -1))
+                                             skip_hot=True,
+                                             donate=donate), (q, -1))
         if mode == "all_full":
             # too few hot-free queries to pay an extra dispatch for
             return self._blocked_dispatch(
-                block, lambda qb: self._topk_device(qb, k, scoring),
+                block, lambda qb: self._topk_device(qb, k, scoring,
+                                                    donate=donate),
                 (q, -1))
         order = self._schedule_order(has_hot)
         inv = np.argsort(order, kind="stable")
         qs = q[order]
         s1, d1 = self._group_dispatch(qs[:n_free], block,
                                       lambda qb: self._topk_device(
-                                          qb, k, scoring, skip_hot=True))
+                                          qb, k, scoring, skip_hot=True,
+                                          donate=donate))
         s2, d2 = self._group_dispatch(qs[n_free:], block,
                                       lambda qb: self._topk_device(
-                                          qb, k, scoring))
+                                          qb, k, scoring, donate=donate))
         return (np.concatenate([s1, s2])[inv],
                 np.concatenate([d1, d2])[inv])
 
@@ -1134,6 +1200,66 @@ class Scorer:
         else:
             mode = "split"
         return has_hot, n_free, mode
+
+    def _topk_uniform(self, q: np.ndarray, k: int, scoring: str,
+                      rungs: tuple, *, donate: bool = False):
+        """The coalesced static-shape dispatch (ISSUE 9): the exact
+        MaxScore partition (hot-free rows — including the rung pad rows,
+        which are all -1 — never pay the hot-strip matmul), with each
+        group padded to the smallest LADDER rung that fits instead of a
+        content-dependent pow2 bucket. The compiled-program universe is
+        `rungs x {skip, full}` per scoring model, walked once by the
+        frontend's precompile, so no serving batch ever waits on XLA."""
+        block = self._block_size()
+        has_hot = self._has_hot(q)
+        n_free = int((~has_hot).sum())
+
+        def skip_fn(qb):
+            return self._topk_device(qb, k, scoring, skip_hot=True,
+                                     donate=donate)
+
+        def full_fn(qb):
+            return self._topk_device(qb, k, scoring, donate=donate)
+
+        if n_free == len(q):
+            return self._rung_dispatch(q, block, rungs, skip_fn)
+        # all-PAD rows (rung padding, empty-after-analysis queries)
+        # score exact 0.0 under EITHER kernel — when they are the only
+        # "hot-free" content, a separate skip dispatch would burn a
+        # whole per-dispatch round trip scoring nothing but padding
+        real_free = int((~has_hot & ~(q < 0).all(axis=1)).sum())
+        if real_free == 0:
+            return self._rung_dispatch(q, block, rungs, full_fn)
+        if real_free < self.MIN_SKIP_GROUP and _rtt_dominated_backend():
+            # the MIN_SKIP_GROUP economy, serving edition — but only
+            # where it holds: on an RTT-dominated backend (TPU) the
+            # second dispatch costs a full round trip while the hot
+            # matmul rides nearly free on the MXU, so small hot-free
+            # groups fold into the full dispatch (bit-identical,
+            # pinned). On CPU the inequality flips — the matmul is the
+            # dominant cost and the extra dispatch is ~nothing — so
+            # there the split always wins and the fold is skipped.
+            return self._rung_dispatch(q, block, rungs, full_fn)
+        order = self._schedule_order(has_hot)
+        inv = np.argsort(order, kind="stable")
+        qs = q[order]
+        s1, d1 = self._rung_dispatch(qs[:n_free], block, rungs, skip_fn)
+        s2, d2 = self._rung_dispatch(qs[n_free:], block, rungs, full_fn)
+        return (np.concatenate([s1, s2])[inv],
+                np.concatenate([d1, d2])[inv])
+
+    def _rung_dispatch(self, qg: np.ndarray, block: int, rungs: tuple,
+                       dispatch):
+        """Dispatch one scheduled group padded to its ladder rung (cf.
+        _group_dispatch, whose pow2 buckets depend on batch content)."""
+        b = len(qg)
+        pad_to = next((r for r in rungs if r >= b), b)
+        if pad_to <= b:
+            return self._blocked_dispatch(block, dispatch, (qg, -1))
+        qp = np.full((pad_to, qg.shape[1]), -1, np.int32)
+        qp[:b] = qg
+        s, d = self._blocked_dispatch(block, dispatch, (qp, -1))
+        return s[:b], d[:b]
 
     def _group_dispatch(self, qg: np.ndarray, block: int, dispatch):
         """Dispatch one schedule group, padding its row count to a
@@ -1224,7 +1350,8 @@ class Scorer:
         return self.meta.num_docs + 1
 
     def _topk_device(self, q_terms: np.ndarray, k: int, scoring: str,
-                     skip_hot: bool = False, hot_only: bool = False):
+                     skip_hot: bool = False, hot_only: bool = False,
+                     donate: bool = False):
         """Dispatch one query block; returns device arrays without
         waiting. `skip_hot` statically omits the tiered hot-strip stage
         (exact only for blocks the scheduler certified hot-free);
@@ -1243,13 +1370,16 @@ class Scorer:
                     f"tpu_ir.topk.{self.layout}.{scoring}"):
             return self._topk_device_raw(q_terms, k, scoring,
                                          skip_hot=skip_hot,
-                                         hot_only=hot_only)
+                                         hot_only=hot_only,
+                                         donate=donate)
 
     def _topk_device_raw(self, q_terms: np.ndarray, k: int, scoring: str,
-                         skip_hot: bool = False, hot_only: bool = False):
+                         skip_hot: bool = False, hot_only: bool = False,
+                         donate: bool = False):
         faults.maybe_hang("score.hang")
         if faults.should_fire("score.device_loss") is not None:
             raise faults.DeviceLoss("injected device loss")
+        donate = donate and _donation_enabled() and self.layout != "sharded"
         q = jnp.asarray(q_terms)
         n = jnp.int32(self.meta.num_docs)
         if self.layout == "sharded":
@@ -1265,23 +1395,31 @@ class Scorer:
                 hot_only=hot_only)
         elif scoring == "bm25":
             if self.layout == "dense":
-                s, d = bm25_topk_dense(q, self._ensure_tf_matrix(),
-                                       self.df, self.doc_len, n, k=k)
-            else:
-                from ..ops.scoring import bm25_topk_tiered
+                from ..ops.scoring import bm25_topk_dense_dq
 
-                s, d = bm25_topk_tiered(
+                fn = bm25_topk_dense_dq if donate else bm25_topk_dense
+                s, d = fn(q, self._ensure_tf_matrix(),
+                          self.df, self.doc_len, n, k=k)
+            else:
+                from ..ops.scoring import bm25_topk_tiered, bm25_topk_tiered_dq
+
+                fn = bm25_topk_tiered_dq if donate else bm25_topk_tiered
+                s, d = fn(
                     q, self.hot_rank, self.hot_tfs, self.tier_of,
                     self.row_of, self.tier_docs, self.tier_tfs, self.df,
                     self.doc_len, n, num_docs=self.meta.num_docs, k=k,
                     skip_hot=skip_hot, hot_only=hot_only)
         elif self.layout == "dense":
-            s, d = tfidf_topk_dense(q, self.doc_matrix, self.df, n, k=k,
-                                    compat_int_idf=self.compat_int_idf)
-        else:
-            from ..ops.scoring import tfidf_topk_tiered
+            from ..ops.scoring import tfidf_topk_dense_dq
 
-            s, d = tfidf_topk_tiered(
+            fn = tfidf_topk_dense_dq if donate else tfidf_topk_dense
+            s, d = fn(q, self.doc_matrix, self.df, n, k=k,
+                      compat_int_idf=self.compat_int_idf)
+        else:
+            from ..ops.scoring import tfidf_topk_tiered, tfidf_topk_tiered_dq
+
+            fn = tfidf_topk_tiered_dq if donate else tfidf_topk_tiered
+            s, d = fn(
                 q, self.hot_rank, self.hot_tfs, self.tier_of, self.row_of,
                 self.tier_docs, self.tier_tfs, self.df, n,
                 num_docs=self.meta.num_docs, k=k,
@@ -1428,7 +1566,8 @@ class Scorer:
         Under a deadline the whole two-stage dispatch is bounded; on
         expiry/device loss the batch degrades to single-stage host BM25
         (the rerank is a quality refinement — dropping it under duress is
-        the intended degradation, tagged via `degraded_last`)."""
+        the intended degradation, tagged via the rerank_topk_tagged
+        return / SearchResult.degraded)."""
         s, d, _ = self.rerank_topk_tagged(q_terms, k=k,
                                           candidates=candidates,
                                           deadline_s=deadline_s,
@@ -1510,6 +1649,11 @@ class Scorer:
         prox: bool = False, phrase_slop: int = 0, *,
         deadline_s: float | None = None, force_host: bool = False,
         hot_only: bool = False, explain_k: int = 0,
+        explain_ks: Sequence[int] | None = None,
+        pad_to: int | None = None, width_floor: int | None = None,
+        rung_ladder: tuple | None = None,
+        donate_queries: bool = False,
+        slot_meta: Sequence[dict] | None = None,
     ) -> list[SearchResult]:
         """Ranked retrieval for query texts. `rerank=N` switches to the
         two-stage pipeline: BM25 top-N candidates, cosine TF-IDF rerank;
@@ -1523,26 +1667,54 @@ class Scorer:
         `force_host` answers from the host backend with no device
         dispatch (circuit breaker open), `hot_only` scores only the hot
         tier on tiered/sharded layouts. Each SearchResult's `degraded`
-        flag is tagged from THIS request's outcome (thread-safe), not the
-        racy `degraded_last` alias. Phrase queries already run on the
-        host and ignore the device knobs.
+        flag is tagged from THIS request's outcome (thread-safe — the
+        tagged dispatch return is the only degradation source). Phrase
+        queries already run on the host and ignore the device knobs.
 
         `explain_k=N` attaches a per-term score decomposition for each
         query's top-N hits (SearchResult.explain; search/explain.py) —
         exact kernel floats, extra debug dispatches, so a forensics
         knob, not a default. Degraded responses and phrase/prox results
-        (host-scored) carry explain=None."""
+        (host-scored) carry explain=None.
+
+        Batch-entry knobs (ISSUE 9 — the coalescing frontend is the
+        intended caller; per-request semantics are tagged PER SLOT, not
+        batch-wide): `explain_ks` overrides explain_k per query;
+        `pad_to=R` pads the analyzed query-row axis to R rows of -1
+        before dispatch (the compiled-rung ladder — results for the pad
+        rows are never materialized as SearchResults); `rung_ladder`
+        additionally makes the MaxScore schedule pad its groups to
+        ladder rungs (topk_tagged `uniform` — the closed shape
+        universe); `width_floor`
+        pins the analyzed width (see analyze_queries); `donate_queries`
+        uses the donated-query kernel twins on the plain topk path;
+        `slot_meta[i]` merges per-slot fields (service level, queue
+        wait, occupancy) into query i's querylog entry. Phrase queries
+        cannot ride a padded batch (they score on the host)."""
         if prox and not rerank:
             raise ValueError("the proximity boost is stage 3 of the "
                              "two-stage rerank; pass rerank=N (--rerank) "
                              "together with prox (--prox)")
         texts = list(texts)
         plain = [t for t in texts if '"' not in t]
+        if len(plain) != len(texts) and (
+                pad_to is not None or explain_ks is not None
+                or slot_meta is not None):
+            # the per-slot lists index the PLAIN batch — a phrase query
+            # in the middle would silently shift every later slot's
+            # explain depth and querylog attribution
+            raise ValueError("a coalesced batch (pad_to / explain_ks / "
+                             "slot_meta) cannot contain phrase queries "
+                             "— the coalescing frontend routes them "
+                             "solo")
         plain_iter = iter(self._search_batch_plain(
             plain, k=k, scoring=scoring, return_docids=return_docids,
             rerank=rerank, prox=prox, deadline_s=deadline_s,
             force_host=force_host, hot_only=hot_only,
-            explain_k=explain_k) if plain else [])
+            explain_k=explain_k, explain_ks=explain_ks, pad_to=pad_to,
+            width_floor=width_floor, rung_ladder=rung_ladder,
+            donate_queries=donate_queries,
+            slot_meta=slot_meta) if plain else [])
         return [self._search_phrase(t, k=k, scoring=scoring,
                                     slop=phrase_slop,
                                     return_docids=return_docids,
@@ -1554,9 +1726,22 @@ class Scorer:
         return_docids: bool, rerank: int | None, prox: bool,
         deadline_s: float | None = None, force_host: bool = False,
         hot_only: bool = False, explain_k: int = 0,
+        explain_ks: Sequence[int] | None = None,
+        pad_to: int | None = None, width_floor: int | None = None,
+        rung_ladder: tuple | None = None,
+        donate_queries: bool = False,
+        slot_meta: Sequence[dict] | None = None,
     ) -> list[SearchResult]:
         t0 = time.perf_counter()
-        q = self.analyze_queries(texts)
+        q = self.analyze_queries(texts, width_floor=width_floor)
+        if pad_to is not None and pad_to > len(q):
+            # the coalescing rung ladder: pad the ROW axis with -1 rows
+            # (score exact 0.0, top-k all-empty) so every dispatch
+            # reuses one of the precompiled batch shapes; the pad rows'
+            # outputs are sliced off below — no SearchResult, no
+            # querylog entry, no caller ever sees them
+            q = np.vstack([q, np.full((pad_to - len(q), q.shape[1]),
+                                      -1, np.int32)])
         t_analyzed = time.perf_counter()
         if rerank:
             from .phrase import PROX_DEPTH
@@ -1567,11 +1752,14 @@ class Scorer:
                 force_host=force_host)
             if prox:
                 scores, docnos = self._apply_proximity(
-                    texts, np.asarray(scores), np.asarray(docnos), k)
+                    texts, np.asarray(scores[: len(texts)]),
+                    np.asarray(docnos[: len(texts)]), k)
         else:
             scores, docnos, degraded = self.topk_tagged(
                 q, k=k, scoring=scoring, deadline_s=deadline_s,
-                hot_only=hot_only, force_host=force_host)
+                hot_only=hot_only, force_host=force_host,
+                donate=donate_queries,
+                uniform=(rung_ladder if pad_to is not None else None))
         t_dispatched = time.perf_counter()
         out = []
         for qi in range(len(texts)):
@@ -1580,8 +1768,7 @@ class Scorer:
             # are real rankings from the host backend, but SLAs/metrics
             # must be able to tell them apart from the primary pipeline.
             # Tagged from the per-request flag the tagged dispatch
-            # returned — NOT degraded_last, which another thread's batch
-            # may have overwritten in the meantime.
+            # returned, which no other thread's batch can overwrite.
             res.degraded = degraded
             for s, dn in zip(scores[qi], docnos[qi]):
                 if dn <= 0:
@@ -1594,13 +1781,17 @@ class Scorer:
         # inflate total_ms and trip the slow-query trap on requests
         # whose actual serving was fast
         total_s = time.perf_counter() - t0
-        if explain_k and not degraded and not prox:
+        if (explain_k or explain_ks) and not degraded and not prox:
             # prox rescoring happens on the host AFTER the kernels — its
             # final scores are not a kernel decomposition target
             from .explain import explain_hits
 
             for qi, text in enumerate(texts):
-                top = [int(dn) for dn in docnos[qi][:explain_k] if dn > 0]
+                # per-slot forensics depth inside a shared batch (tag,
+                # don't drop): only the slots that ASKED pay the debug
+                # dispatches
+                ek = explain_ks[qi] if explain_ks is not None else explain_k
+                top = [int(dn) for dn in docnos[qi][:ek] if dn > 0]
                 if top:
                     out[qi].explain = explain_hits(
                         self, text, top, scoring=scoring, rerank=rerank,
@@ -1609,18 +1800,25 @@ class Scorer:
             texts, q, docnos, out, k=k, scoring=scoring, rerank=rerank,
             hot_only=hot_only, force_host=force_host, degraded=degraded,
             prox=prox, analyze_s=t_analyzed - t0,
-            dispatch_s=t_dispatched - t_analyzed, total_s=total_s)
+            dispatch_s=t_dispatched - t_analyzed, total_s=total_s,
+            slot_meta=slot_meta)
         return out
 
     def _querylog_record(self, texts, q, docnos, results, *, k, scoring,
                          rerank, hot_only, force_host, degraded, prox,
-                         analyze_s, dispatch_s, total_s) -> None:
+                         analyze_s, dispatch_s, total_s,
+                         slot_meta=None) -> None:
         """One query-log entry per query of this batch (obs/querylog.py):
         terms (hash when redacted), level, the batch's stage-latency
         split, batch id (the per-request attribution key inside a shared
         batch), top-k docids + scores, and the MaxScore scheduling
         decision. The slow-query trap's explain capture is deferred
-        behind the flight recorder's rate gate via a callable."""
+        behind the flight recorder's rate gate via a callable.
+
+        `slot_meta[qi]` (the coalescing frontend) merges per-slot fields
+        into entry qi — each slot's TRUE service level, queue_wait_ms
+        and batch_occupancy — overriding the batch-wide defaults (the
+        leader thread's request_context is not the followers')."""
         from ..obs import querylog
 
         if not querylog.enabled() or not texts:
@@ -1657,6 +1855,8 @@ class Scorer:
                 "top": [[key, round(float(s), 6)]
                         for key, s in results[qi][:10]],
             }
+            if slot_meta is not None:
+                entry.update(slot_meta[qi])
             if not querylog.redacted():
                 entry["terms"] = [self.vocab.term(t) for t in ids]
             if mode is not None:

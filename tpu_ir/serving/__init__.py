@@ -3,6 +3,7 @@ ladder, circuit breaker) for a loaded Scorer — see frontend.py for the
 architecture and RUNBOOK "Serving under overload" for operations."""
 
 from .admission import AdmissionController, Overloaded
+from .batching import BatchKey, CoalescingScheduler, batch_ladder
 from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from .frontend import (
     LEVEL_FULL,
@@ -13,12 +14,19 @@ from .frontend import (
     ServingConfig,
     ServingFrontend,
 )
-from .soak import DEFAULT_CHAOS_PLAN, make_queries, run_soak
+from .soak import (
+    DEFAULT_CHAOS_PLAN,
+    make_queries,
+    run_concurrency_sweep,
+    run_soak,
+)
 
 __all__ = [
     "AdmissionController", "Overloaded",
     "CircuitBreaker", "CLOSED", "OPEN", "HALF_OPEN",
     "ServingFrontend", "ServingConfig", "DegradationLadder",
+    "CoalescingScheduler", "BatchKey", "batch_ladder",
     "LEVEL_FULL", "LEVEL_NO_RERANK", "LEVEL_HOT_ONLY", "LEVEL_SHED",
-    "run_soak", "make_queries", "DEFAULT_CHAOS_PLAN",
+    "run_soak", "make_queries", "run_concurrency_sweep",
+    "DEFAULT_CHAOS_PLAN",
 ]

@@ -1,0 +1,389 @@
+"""Continuous micro-batching: coalesce concurrent queries into one
+padded device dispatch (ISSUE 9; ROADMAP 3).
+
+Every BENCH row tells the same story: query p50 sits at ~`device_rtt_ms`
+at every corpus size — latency is a fixed per-dispatch round trip, and
+each caller pays it ALONE. The reference engine answered one query per
+JVM invocation (PAPER.md §0), so it never faced the question; LLM
+serving did, and answered with continuous batching (Orca-style
+iteration-level scheduling). This module is that trick for retrieval:
+
+    callers ──► admission ──► COALESCER ──► one padded kernel call ──► demux
+                (PR 2)        (this file)   (Scorer.search_batch)
+
+**Leader-follower combining, no owned threads.** The frontend owns no
+worker pool (nothing to shut down, nothing to leak — the PR 2 design
+rule), so the dispatcher is elected: the first caller to arrive while no
+dispatch is in flight becomes the LEADER, drains every compatible queued
+request into one batch, dispatches, and demuxes results to the waiting
+FOLLOWERS via per-slot events. While a dispatch is in flight, new
+arrivals queue — the in-flight window IS the coalescing window, so under
+concurrency batches fill naturally with ZERO added wait; an idle arrival
+dispatches immediately (`batch.solo_flush`), which is why the solo-query
+path cannot regress. `TPU_IR_BATCH_WAIT_MS` optionally lets a PROMOTED
+leader linger toward the next rung (bounded, default 0).
+
+**The rung ladder.** Batches are padded (with -1 query rows inside the
+scorer — exact 0.0 score contribution, pinned by the explain suite) to a
+small ladder of compiled batch sizes (`TPU_IR_BATCH_LADDER`, default
+1/4/16/64) and ONE pinned query width (`TPU_IR_BATCH_WIDTH`), so content
+cannot mint per-batch XLA programs; `precompile()` walks the ladder at
+frontend start so no caller ever eats a compile. The query-side device
+buffer is donated on capable backends (`TPU_IR_BATCH_DONATE`,
+ops/scoring.py `*_dq` twins); the index stays resident.
+
+**Per-request semantics survive inside a shared batch** (tag, don't
+drop): requests coalesce only with an identical BatchKey (k, scoring,
+rerank, hot_only, force_host — everything that changes the traced
+program or the serving route), while service level, explain depth, queue
+wait and occupancy are tagged PER SLOT into results and querylog
+entries. The dispatch deadline stays the scorer-level per-batch bound
+all slots share — a slot's coalescing wait is bounded separately and
+never charged against a batch-mate (the soak invariant: degradation
+within one batch is uniform, from the shared dispatch, never from a
+mate's slot).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import NamedTuple
+
+from .. import obs
+from ..obs import get_registry
+from ..utils import envvars
+
+
+class BatchKey(NamedTuple):
+    """Everything that must MATCH for two requests to share one kernel
+    call: k and scoring/rerank select the traced program, hot_only and
+    force_host select the serving route. Mismatched arrivals stay queued
+    for the next leader (FIFO — no starvation: the next leader is always
+    the oldest queued slot, so its key is served next)."""
+
+    k: int
+    scoring: str
+    rerank: int | None
+    hot_only: bool
+    force_host: bool
+
+
+class _Slot:
+    """One enqueued request. `state` transitions under the scheduler
+    lock: None (queued) -> "lead" (promoted to dispatcher) -> taken into
+    a batch -> "done"/"error"; or None -> "abandoned" (wait timeout
+    while still queued). Results are written by the leader before the
+    event is set — the event is the publication barrier."""
+
+    __slots__ = ("text", "key", "explain_k", "level", "queue_depth",
+                 "t_enqueue", "event", "state", "result", "error")
+
+    def __init__(self, text: str, key: BatchKey, explain_k: int,
+                 level: str, queue_depth: int):
+        self.text = text
+        self.key = key
+        self.explain_k = explain_k
+        self.level = level
+        self.queue_depth = queue_depth
+        self.t_enqueue = time.perf_counter()
+        self.event = threading.Event()
+        self.state = None
+        self.result = None
+        self.error = None
+
+
+def batch_ladder() -> tuple:
+    """The compiled batch-size rungs, parsed from TPU_IR_BATCH_LADDER
+    (sorted, deduped, all >= 1). A malformed spec raises — a silently
+    empty ladder would disable coalescing without a trace."""
+    spec = envvars.get_str("TPU_IR_BATCH_LADDER")
+    try:
+        rungs = sorted({max(1, int(p)) for p in spec.split(",") if p.strip()})
+    except ValueError:
+        raise ValueError(
+            f"TPU_IR_BATCH_LADDER={spec!r}: expected comma-separated "
+            "integers like '1,4,16,64'") from None
+    if not rungs:
+        raise ValueError("TPU_IR_BATCH_LADDER is empty")
+    return tuple(rungs)
+
+
+class CoalescingScheduler:
+    """The coalescer between AdmissionController and device dispatch —
+    see the module docstring for the protocol. One instance per
+    ServingFrontend; thread-safe; owns no threads."""
+
+    # leader poll granularity while lingering toward a fuller rung
+    _POLL_S = 0.0005
+
+    def __init__(self, scorer, *, deadline_s: float | None = None,
+                 wait_ms: float | None = None, ladder: tuple | None = None,
+                 width: int | None = None):
+        self._scorer = scorer
+        self._deadline_s = deadline_s
+        self._wait_s = (envvars.get_float("TPU_IR_BATCH_WAIT_MS")
+                        if wait_ms is None else max(0.0, wait_ms)) / 1e3
+        # a caller-supplied ladder gets the same normalization the env
+        # path applies (sorted ascending, deduped, >= 1): _take_batch /
+        # _rung / _linger all assume ascending order — an unsorted
+        # tuple would silently cap batches at ladder[-1] slots
+        self._ladder = (tuple(sorted({max(1, int(r)) for r in ladder}))
+                        if ladder else batch_ladder())
+        width = (envvars.get_int("TPU_IR_BATCH_WIDTH")
+                 if width is None else max(1, width))
+        # normalize to the pow2 bucket analyze_queries will actually
+        # emit for this floor — otherwise a width of e.g. 12 would
+        # precompile (rung, 12) shapes while serving dispatches
+        # (rung, 16), silently defeating the whole ladder precompile
+        self._width = 1 << (int(width) - 1).bit_length()
+        self._lock = threading.Lock()
+        self._queue: list[_Slot] = []
+        self._dispatching = False   # exactly one leader token
+        # control-plane stats (served via frontend.stats() -> /healthz)
+        self._batches = 0
+        self._coalesced = 0
+        self._solo = 0
+        self._last_occupancy = 0
+        self._max_occupancy = 0
+
+    # -- the caller surface ------------------------------------------------
+
+    def submit(self, text: str, *, k: int, scoring: str,
+               rerank: int | None, hot_only: bool, force_host: bool,
+               level: str, queue_depth: int = 0, explain_k: int = 0):
+        """Serve one query through the coalescer; returns its
+        SearchResult (per-slot tagged), raises whatever the shared
+        dispatch raised. Blocks the calling thread — concurrency is the
+        caller population's, bounded by admission upstream."""
+        if '"' in text:
+            raise ValueError("phrase queries cannot ride a coalesced "
+                             "batch (host-scored); route them solo")
+        slot = _Slot(text, BatchKey(k, scoring, rerank, bool(hot_only),
+                                    bool(force_host)),
+                     explain_k, level, queue_depth)
+        with self._lock:
+            self._queue.append(slot)
+            lead = not self._dispatching
+            if lead:
+                self._dispatching = True
+        if lead:
+            return self._lead(slot, promoted=False)
+        return self._follow(slot)
+
+    def _follow(self, slot: _Slot):
+        """Wait for the leader to deliver — or for a promotion to
+        leadership when the previous batch completes first."""
+        base = self._deadline_s if self._deadline_s else 0.0
+        timeout = max(base * 4.0, 30.0) + self._wait_s
+        deadline = time.monotonic() + timeout
+        promoted = False
+        while True:
+            slot.event.wait(min(5.0, max(0.05, deadline - time.monotonic())))
+            with self._lock:
+                if slot.state == "lead":
+                    slot.event.clear()
+                    slot.state = None
+                    promoted = True
+                    break  # lead outside the lock
+                if slot.state in ("done", "error"):
+                    break
+                if time.monotonic() >= deadline:
+                    if slot in self._queue:
+                        # still queued: abandon structurally (the caller
+                        # gets an error, conservation holds upstream)
+                        self._queue.remove(slot)
+                        slot.state = "abandoned"
+                        raise RuntimeError(
+                            "coalesced request timed out waiting for a "
+                            f"dispatch slot after {timeout:.1f}s")
+                    # taken into an executing batch: the leader WILL
+                    # deliver (or error) — extend, mirroring a solo
+                    # caller blocked in its own dispatch
+                    deadline = time.monotonic() + timeout
+        if promoted:
+            return self._lead(slot, promoted=True)
+        if slot.error is not None:
+            raise slot.error
+        return slot.result
+
+    # -- the leader --------------------------------------------------------
+
+    def _lead(self, slot: _Slot, *, promoted: bool):
+        """Run one batch as its dispatcher, then hand the token to the
+        next queued slot (or release it). The token is held from
+        election to hand-off, so exactly one batch collects/dispatches
+        at a time — arrivals during OUR dispatch are the NEXT batch."""
+        try:
+            if promoted:
+                self._linger(slot.key)
+            with self._lock:
+                batch = self._take_batch(slot)
+            self._execute(batch)
+        finally:
+            with self._lock:
+                nxt = self._queue[0] if self._queue else None
+                if nxt is None:
+                    self._dispatching = False
+                else:
+                    nxt.state = "lead"
+                    nxt.event.set()
+        if slot.error is not None:
+            raise slot.error
+        return slot.result
+
+    def _linger(self, key: BatchKey) -> None:
+        """The bounded coalescing wait (TPU_IR_BATCH_WAIT_MS): a
+        PROMOTED leader may linger briefly so near-simultaneous arrivals
+        make the batch — bounded, and skipped entirely for idle solo
+        arrivals (they dispatch immediately; the <10% solo-regression
+        acceptance bound rides on that)."""
+        if self._wait_s <= 0.0:
+            return
+        top = self._ladder[-1]
+        deadline = time.perf_counter() + self._wait_s
+        while time.perf_counter() < deadline:
+            with self._lock:
+                if sum(1 for s in self._queue if s.key == key) >= top:
+                    return
+            time.sleep(self._POLL_S)
+
+    def _take_batch(self, lead_slot: _Slot) -> list[_Slot]:
+        """Drain (under the lock) every queued slot sharing the leader's
+        key, FIFO, up to the top rung. The leader's own slot rides the
+        same rule — it is always the oldest matching slot or the
+        freshly promoted queue head."""
+        top = self._ladder[-1]
+        batch, rest = [], []
+        for s in self._queue:
+            if s.key == lead_slot.key and len(batch) < top:
+                batch.append(s)
+            else:
+                rest.append(s)
+        self._queue[:] = rest
+        return batch
+
+    def _rung(self, n: int) -> int:
+        """Smallest ladder rung >= n (n never exceeds the top rung)."""
+        for r in self._ladder:
+            if r >= n:
+                return r
+        return self._ladder[-1]
+
+    def _execute(self, slots: list[_Slot]) -> None:
+        """One padded kernel call for the whole batch, demuxed per slot.
+        Never raises: errors are delivered to every slot (the leader's
+        own re-raises in _lead)."""
+        t0 = time.perf_counter()
+        b = len(slots)
+        key = slots[0].key
+        meta = [{"level": s.level,
+                 "queue_depth": s.queue_depth,
+                 "queue_wait_ms": round((t0 - s.t_enqueue) * 1e3, 3),
+                 "batch_occupancy": b} for s in slots]
+        reg = get_registry()
+        reg.incr("batch.coalesced" if b > 1 else "batch.solo_flush")
+        if obs.enabled():
+            # occupancy is a COUNT observed on the histogram's bucket
+            # scale (1..top-rung lands exactly); wait is per-slot seconds
+            reg.observe("batch.occupancy", float(b))
+            for m in meta:
+                reg.observe("batch.wait", m["queue_wait_ms"] / 1e3)
+        try:
+            results = self._scorer.search_batch(
+                [s.text for s in slots], k=key.k, scoring=key.scoring,
+                rerank=key.rerank, deadline_s=self._deadline_s,
+                force_host=key.force_host, hot_only=key.hot_only,
+                explain_ks=[s.explain_k for s in slots],
+                pad_to=self._rung(b), width_floor=self._width,
+                rung_ladder=self._ladder,
+                donate_queries=True, slot_meta=meta)
+        except BaseException as e:  # delivered, not swallowed: every
+            for s in slots:         # slot's caller re-raises it
+                s.error = e
+                s.state = "error"
+                s.event.set()
+            return
+        with self._lock:
+            self._batches += 1
+            if b > 1:
+                self._coalesced += 1
+            else:
+                self._solo += 1
+            self._last_occupancy = b
+            self._max_occupancy = max(self._max_occupancy, b)
+        for i, (s, res) in enumerate(zip(slots, results)):
+            # exactly ONE slot per shared dispatch carries the breaker
+            # vote: N slots each recording the SAME dispatch outcome
+            # would turn one transient deadline miss at occupancy >=
+            # breaker_threshold into an instant breaker trip (the
+            # threshold is documented in consecutive DISPATCH failures)
+            res.breaker_vote = i == 0
+            s.result = res
+            s.state = "done"
+            s.event.set()
+
+    # -- warm-up + introspection -------------------------------------------
+
+    def precompile(self, scorings=("tfidf", "bm25"), *,
+                   ks: tuple = (10,)) -> int:
+        """Compile every program steady-state serving can dispatch — the
+        whole `rungs x {skip, full, hot_only} x scorings` universe at
+        the pinned width (the coalesced path's _topk_uniform pads each
+        scheduled group to a ladder rung, so this set is CLOSED: batch
+        content cannot mint a shape outside it; hot_only is included so
+        the ladder stepping down under overload — the one moment a
+        compile stall hurts most — hits a warm kernel too) — so no
+        caller ever eats an XLA compile on the topk path. Returns the
+        number of warm dispatches. Driven through the scorer's kernel
+        dispatch directly: a synthetic all-PAD batch cannot steer the
+        content-dependent scheduler into the full-kernel variant, so the
+        public search path cannot warm it. Known gaps: `k` is a static
+        kernel argument, so only the depths in `ks`
+        (ServingConfig.precompile_ks) are warmed — a caller-chosen k
+        outside that set compiles once on first use; likewise rerank
+        batches (cosine stage), whose candidate count is caller-chosen.
+
+        Rungs are capped at the scorer's SCORE_BUDGET block size: a
+        rung above it is dispatched by _blocked_dispatch as (block,
+        width) slices in production, so THOSE are the shapes to warm —
+        dispatching the raw rung would both compile a shape serving
+        never uses and allocate the oversized score accumulator the
+        budget exists to prevent."""
+        import jax
+        import numpy as np
+
+        n = 0
+        scorer = self._scorer
+        variants = [{}]
+        if scorer.layout == "sparse":
+            variants = [{"skip_hot": True}, {}, {"hot_only": True}]
+        elif scorer.layout == "sharded":
+            variants = [{}, {"hot_only": True}]
+        block = max(1, scorer._block_size())
+        for rows in sorted({min(rung, block) for rung in self._ladder}):
+            q = np.full((rows, self._width), -1, np.int32)
+            for scoring in scorings:
+                for k in ks:
+                    for kw in variants:
+                        out = scorer._topk_device(q, k, scoring,
+                                                  donate=True, **kw)
+                        jax.block_until_ready(out)
+                        n += 1
+        return n
+
+    def snapshot(self) -> dict:
+        """Control-plane state for frontend.stats() / /healthz."""
+        with self._lock:
+            return {
+                "wait_ms": round(self._wait_s * 1e3, 3),
+                "ladder": list(self._ladder),
+                "width": self._width,
+                "queued": len(self._queue),
+                "dispatching": self._dispatching,
+                "batches": self._batches,
+                "coalesced": self._coalesced,
+                "solo_flush": self._solo,
+                "last_occupancy": self._last_occupancy,
+                "max_occupancy": self._max_occupancy,
+            }

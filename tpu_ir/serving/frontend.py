@@ -23,11 +23,18 @@ of requests — the overload axis:
   means requests go straight to the host-CPU fallback with no device
   dispatch and NO deadline wait (the ≥10× latency save when the device
   is plain gone), with half-open probes to detect recovery.
+- **Coalescer** (batching.py, ISSUE 9; opt-in via ServingConfig
+  .coalesce): between admission and dispatch, concurrent compatible
+  requests are packed into ONE padded kernel call from a precompiled
+  batch-size ladder and demuxed — amortizing the fixed per-dispatch
+  round trip that each caller otherwise pays alone. Per-request
+  semantics (level, breaker verdict, explain depth, deadline
+  accounting) stay tagged per slot.
 
 Everything here is thread-safe: the intended caller is one frontend
 shared by many request threads. Correctness under concurrency rides on
-Scorer's per-request tagged dispatch (topk_tagged), not the deprecated
-`degraded_last` alias.
+Scorer's per-request tagged dispatch (topk_tagged); the racy
+`degraded_last` alias is gone.
 """
 
 from __future__ import annotations
@@ -70,6 +77,14 @@ class ServingConfig:
     fail_threshold: int = 3        # consecutive failures that step down
     recover_successes: int = 16    # calm observations to step up one level
     down_cooldown_s: float = 0.05  # min time between two down-steps
+    # continuous micro-batching (ISSUE 9; batching.py). None defaults
+    # defer to the TPU_IR_BATCH_* env knobs at frontend construction.
+    coalesce: bool = False            # coalesce concurrent queries
+    coalesce_wait_ms: float | None = None  # promoted-leader linger bound
+    batch_ladder: tuple | None = None      # compiled batch-size rungs
+    batch_width: int | None = None         # pinned analyzed query width
+    precompile: bool = True           # walk the ladder at start
+    precompile_ks: tuple = (10,)      # k depths the ladder walk warms
 
 
 class DegradationLadder:
@@ -167,6 +182,21 @@ class ServingFrontend:
                   if scorer.layout in ("sparse", "sharded")
                   else (LEVEL_FULL, LEVEL_NO_RERANK, LEVEL_SHED))
         self.ladder = DegradationLadder(levels, cfg, self._on_transition)
+        # the coalescing scheduler (ISSUE 9): packs concurrent
+        # compatible requests into one padded dispatch; precompiling the
+        # rung ladder here means no serving caller ever eats an XLA
+        # compile (the acceptance pin: zero compile.recompiles across a
+        # steady-state sweep)
+        self.batcher = None
+        if cfg.coalesce:
+            from .batching import CoalescingScheduler
+
+            self.batcher = CoalescingScheduler(
+                scorer, deadline_s=cfg.deadline_s,
+                wait_ms=cfg.coalesce_wait_ms, ladder=cfg.batch_ladder,
+                width=cfg.batch_width)
+            if cfg.precompile:
+                self.batcher.precompile(ks=cfg.precompile_ks)
         self._counters = RecoveryCounters()
         # the embedded metrics server's /healthz reports this frontend's
         # breaker/ladder/queue state for as long as it is alive (weakref
@@ -205,18 +235,22 @@ class ServingFrontend:
         out["ladder"] = self.ladder.snapshot()
         out["breaker"] = self.breaker.snapshot()
         out["queue_depth"] = self.admission.queue_depth()
+        if self.batcher is not None:
+            out["batching"] = self.batcher.snapshot()
         return out
 
     # -- the request path --------------------------------------------------
 
     def search(self, text: str, *, k: int = 10, scoring: str = "tfidf",
                rerank: int | None = None,
-               snippets: bool = False) -> SearchResult:
+               snippets: bool = False,
+               explain_k: int = 0) -> SearchResult:
         """Serve one query. Returns a SearchResult tagged with the
         service level (`level`) and fallback flag (`degraded`) that
         produced it, or raises Overloaded (a structured shed — the
-        request was NOT executed). `rerank`/`snippets` are what the
-        caller WANTS; the ladder decides what it gets.
+        request was NOT executed). `rerank`/`snippets`/`explain_k` are
+        what the caller WANTS; the ladder decides what it gets —
+        explain_k rides per-slot even inside a coalesced batch.
 
         Telemetry: the whole call is one "request" span tree (ladder →
         admission_wait → breaker → dispatch/kernel → fallback) and its
@@ -255,7 +289,7 @@ class ServingFrontend:
                 try:
                     res = self._serve(text, k=k, scoring=scoring,
                                       rerank=rerank, snippets=snippets,
-                                      level=level)
+                                      level=level, explain_k=explain_k)
                 finally:
                     admit_cm.__exit__(None, None, None)
                 self._observe_latency(f"request.{level}", t0)
@@ -271,7 +305,7 @@ class ServingFrontend:
 
     def _serve(self, text: str, *, k: int, scoring: str,
                rerank: int | None, snippets: bool,
-               level: str) -> SearchResult:
+               level: str, explain_k: int = 0) -> SearchResult:
         with obs_trace("breaker") as bsp:
             allowed, is_probe = self.breaker.allow_device()
             bsp.set("allowed", allowed)
@@ -281,17 +315,32 @@ class ServingFrontend:
             self._count("breaker_probes")
         use_rerank = rerank if level == LEVEL_FULL else None
         try:
-            # the query log records inside the scorer, which only knows
-            # flags; the context stamps each entry with the ladder's
-            # true service level + the queue depth it was served under
-            with obs.querylog.request_context(
-                    level=level,
-                    queue_depth=self.admission.queue_depth()):
-                res = self.scorer.search_batch(
-                    [text], k=k, scoring=scoring, rerank=use_rerank,
-                    deadline_s=self.config.deadline_s,
-                    force_host=force_host,
-                    hot_only=(level == LEVEL_HOT_ONLY))[0]
+            if self.batcher is not None and '"' not in text:
+                # the coalesced path: this thread's request may ride a
+                # batch-mate's kernel call — its level/wait/occupancy
+                # are tagged per SLOT by the scheduler (the leader's
+                # thread-local context would be wrong for followers);
+                # phrase queries score on the host and go solo below
+                res = self.batcher.submit(
+                    text, k=k, scoring=scoring, rerank=use_rerank,
+                    hot_only=(level == LEVEL_HOT_ONLY),
+                    force_host=force_host, level=level,
+                    queue_depth=self.admission.queue_depth(),
+                    explain_k=explain_k)
+            else:
+                # the query log records inside the scorer, which only
+                # knows flags; the context stamps each entry with the
+                # ladder's true service level + the queue depth it was
+                # served under
+                with obs.querylog.request_context(
+                        level=level,
+                        queue_depth=self.admission.queue_depth()):
+                    res = self.scorer.search_batch(
+                        [text], k=k, scoring=scoring, rerank=use_rerank,
+                        deadline_s=self.config.deadline_s,
+                        force_host=force_host,
+                        hot_only=(level == LEVEL_HOT_ONLY),
+                        explain_k=explain_k)[0]
         except BaseException:
             # not a device verdict (bad query, program bug): release any
             # probe slot this request held so the breaker cannot wedge
@@ -301,6 +350,13 @@ class ServingFrontend:
             raise
         res.level = level
         dispatch_failed = False
+        # under coalescing, one shared dispatch serves many slots; only
+        # the batch's voting slot feeds the breaker (its threshold
+        # counts consecutive DISPATCH failures — N slots echoing one
+        # failed dispatch would trip it from a single event). Probe
+        # slots always vote: the half-open slot must be released by its
+        # own verdict. Solo/non-coalesced results vote by default.
+        votes = getattr(res, "breaker_vote", True) or is_probe
         if force_host:
             self._count("served_breaker_host")
         else:
@@ -308,7 +364,8 @@ class ServingFrontend:
             # dispatch that expired its deadline or lost the device
             dispatch_failed = res.degraded
             if dispatch_failed:
-                if self.breaker.record_failure(is_probe=is_probe):
+                if votes and self.breaker.record_failure(
+                        is_probe=is_probe):
                     self._count("breaker_opened")
                     # an opening breaker is an incident boundary: freeze
                     # the recent traces + telemetry (rate-limited — a
@@ -316,7 +373,7 @@ class ServingFrontend:
                     obs.flight_dump("breaker_open", extra={
                         "breaker": self.breaker.snapshot(),
                         "ladder": self.ladder.snapshot()})
-            else:
+            elif votes:
                 self.breaker.record_success(is_probe=is_probe)
         if res.degraded:
             self._count("degraded")

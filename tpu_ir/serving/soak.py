@@ -24,6 +24,7 @@ Used by tests/test_serving.py (fast + slow variants), the
 from __future__ import annotations
 
 import random
+import threading
 import time
 from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
 
@@ -88,7 +89,8 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
              seed: int = 0, fault_spec: str | None = DEFAULT_CHAOS_PLAN,
              config: ServingConfig | None = None,
              timeout_s: float = 120.0, pacing_s: float = 0.004,
-             flight_dir: str | None = None) -> dict:
+             flight_dir: str | None = None,
+             coalesce: bool = False) -> dict:
     """Run the soak; returns the invariant report (no asserts here — the
     callers decide what is fatal; tests assert on the report fields).
     The report's `latency` section holds per-stage p50/p95/p99 for the
@@ -99,7 +101,15 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
 
     The scorer must be loaded and fault-plan-free on entry; the given
     `fault_spec` (None = no chaos) is installed only around the
-    concurrent phase and cleared after."""
+    concurrent phase and cleared after.
+
+    `coalesce=True` (ISSUE 9) runs the soak through the continuous
+    micro-batching frontend: concurrent requests share padded kernel
+    dispatches. All the PR 2 invariants must survive UNCHANGED, plus
+    one batching-specific pin the report carries: within any shared
+    batch, degradation is uniform (`batch_mixed_degraded` == 0 — the
+    dispatch outcome is shared, so no slot can be charged a deadline a
+    batch-mate's slow slot burned while it itself was served clean)."""
     if faults.active() is not None:
         raise RuntimeError("a fault plan is already installed")
     reqs = make_queries(scorer, queries, seed=seed)
@@ -118,9 +128,18 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
         reference = _serial_reference(scorer, reqs)
         obs.report_progress("serve", total=len(reqs))
 
-        cfg = config or ServingConfig(max_concurrency=4, max_queue=8,
-                                      deadline_s=0.25, breaker_threshold=4,
-                                      breaker_cooldown_s=0.2)
+        if config is None:
+            cfg = ServingConfig(max_concurrency=4, max_queue=8,
+                                deadline_s=0.25, breaker_threshold=4,
+                                breaker_cooldown_s=0.2, coalesce=coalesce)
+        elif coalesce and not config.coalesce:
+            # coalesce=True must not be silently ignored just because a
+            # caller also tuned the admission/breaker knobs
+            from dataclasses import replace
+
+            cfg = replace(config, coalesce=True)
+        else:
+            cfg = config
         frontend = ServingFrontend(scorer, cfg)
         recovery_before = recovery_counters().snapshot()
         hist_before = obs.get_registry().hist_state()
@@ -238,6 +257,21 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
                 hist_before, always=("admission_wait", "dispatch", "kernel",
                                      "fallback")),
         }
+        if frontend.batcher is not None:
+            report["batching"] = frontend.stats().get("batching")
+            # the per-slot-attribution invariant: entries that shared a
+            # coalesced batch (joined on batch_id, the PR 8 key) must
+            # carry ONE degraded verdict — the shared dispatch's. A
+            # mixed batch would mean a slot was charged a batch-mate's
+            # deadline. Best-effort over the bounded querylog ring.
+            by_batch: dict = {}
+            for e in obs.querylog.recent():
+                if e.get("batch_size", 1) > 1 and "batch_id" in e:
+                    by_batch.setdefault(e["batch_id"], set()).add(
+                        bool(e.get("degraded")))
+            report["batch_mixed_degraded"] = sum(
+                1 for flags in by_batch.values() if len(flags) > 1)
+            report["batches_observed"] = len(by_batch)
         if errors or deadlocked or untagged_mismatches:
             # invariant breach: this is exactly the moment the flight
             # recorder exists for — the offending requests' span trees are
@@ -262,3 +296,154 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
         # instead of leaving a ghost "running" soak
         job.finish(error=repr(e))
         raise
+
+
+def _sweep_queries(scorer, n: int, seed: int) -> list[str]:
+    """Seeded 1-3 term query texts over the index's own vocabulary —
+    one scoring model, no rerank, so every request shares one BatchKey
+    and the sweep measures COALESCING, not key fragmentation."""
+    rng = random.Random(seed)
+    terms = list(scorer.vocab.terms)
+    if not terms:
+        raise ValueError("scorer has an empty vocabulary")
+    return [" ".join(rng.choice(terms)
+                     for _ in range(rng.randint(1, 3)))
+            for _ in range(n)]
+
+
+def run_concurrency_sweep(scorer, *, levels=(1, 4, 16),
+                          queries_per_level: int = 192, seed: int = 0,
+                          k: int = 10, scoring: str = "bm25",
+                          coalesce: bool = True,
+                          deadline_s: float | None = None,
+                          wait_ms: float | None = None) -> dict:
+    """The ISSUE 9 acceptance instrument: closed-loop client sweeps at
+    each concurrency level through a (by default) coalescing frontend,
+    recording batched p50/p95/p99, QPS, the batch-occupancy histogram,
+    per-slot coalesce wait, and the compile.recompiles delta per level
+    — the numbers that prove concurrent p50 drops below the solo
+    dispatch RTT, that occupancy > 1 (coalescing actually engaged), and
+    that the precompiled rung ladder holds (zero recompiles).
+
+    Level 1 doubles as the solo-regression guard: its p50 against
+    `solo_rtt_ms` (the per-dispatch round trip measured right here,
+    same process, same index) bounds what the coalescing wait costs a
+    lone caller."""
+    reg = obs.get_registry()
+    texts = _sweep_queries(scorer, max(queries_per_level, 64), seed)
+    # warm EVERY probe query once (1-3 term texts mint distinct pow2
+    # analyze widths — an unwarmed width would bill its XLA compile to
+    # the RTT), then measure the solo round trip: p50 of 20 post-warm
+    # single-query dispatches — the per-dispatch cost a caller pays alone
+    for t in texts[:20]:
+        scorer.search_batch([t], k=k, scoring=scoring)
+    rtts = []
+    for t in texts[:20]:
+        t0 = time.perf_counter()
+        scorer.search_batch([t], k=k, scoring=scoring)
+        rtts.append((time.perf_counter() - t0) * 1e3)
+    solo_rtt_ms = sorted(rtts)[len(rtts) // 2]
+
+    job = obs.start_job(
+        "sweep", f"sweep-{'x'.join(str(n) for n in levels)}",
+        phases=("sweep",),
+        config={"levels": list(levels), "scoring": scoring, "k": k,
+                "coalesce": coalesce, "queries_per_level": queries_per_level})
+    out_levels = []
+    try:
+        obs.report_progress("sweep", total=len(levels) * queries_per_level)
+        for level in levels:
+            cfg = ServingConfig(
+                max_concurrency=int(level),
+                max_queue=max(int(level) * 2, 8),
+                deadline_s=deadline_s, coalesce=coalesce,
+                coalesce_wait_ms=wait_ms)
+            frontend = ServingFrontend(scorer, cfg)
+            per_client = max(1, queries_per_level // int(level))
+            hist_before = reg.hist_state()
+            recompiles_before = reg.get("compile.recompiles")
+            counters_before = {n: reg.get(n) for n in
+                               ("batch.coalesced", "batch.solo_flush")}
+            lat_ms: list = []
+            shed = errors = 0
+            lock = threading.Lock()
+
+            def client(ci: int) -> None:
+                nonlocal shed, errors
+                rng = random.Random(seed * 7919 + ci)
+                local: list = []
+                for _ in range(per_client):
+                    text = texts[rng.randrange(len(texts))]
+                    t0 = time.perf_counter()
+                    try:
+                        frontend.search(text, k=k, scoring=scoring)
+                        local.append((time.perf_counter() - t0) * 1e3)
+                    except Overloaded:
+                        with lock:
+                            shed += 1
+                    except Exception:  # noqa: BLE001 — tallied below
+                        with lock:
+                            errors += 1
+                    job.report("sweep", advance=1)
+                with lock:
+                    lat_ms.extend(local)
+
+            t_start = time.perf_counter()
+            pool = ThreadPoolExecutor(max_workers=int(level),
+                                      thread_name_prefix="sweep-client")
+            try:
+                futs = [pool.submit(client, ci) for ci in range(int(level))]
+                wait(futs)
+            finally:
+                pool.shutdown(wait=True)
+            wall_s = time.perf_counter() - t_start
+
+            lat_sorted = sorted(lat_ms)
+
+            def pct(p: float) -> float:
+                if not lat_sorted:
+                    return -1.0
+                i = min(len(lat_sorted) - 1,
+                        int(round(p / 100.0 * (len(lat_sorted) - 1))))
+                return round(lat_sorted[i], 3)
+
+            delta = reg.delta_summary(hist_before,
+                                      always=("batch.occupancy",
+                                              "batch.wait"))
+            row = {
+                "concurrency": int(level),
+                "served": len(lat_ms),
+                "shed": shed,
+                "errors": errors,
+                "wall_s": round(wall_s, 3),
+                "qps": round(len(lat_ms) / wall_s, 1) if wall_s else -1.0,
+                "p50_ms": pct(50), "p95_ms": pct(95), "p99_ms": pct(99),
+                "occupancy": delta.get("batch.occupancy"),
+                "coalesce_wait": delta.get("batch.wait"),
+                "coalesced": reg.get("batch.coalesced")
+                - counters_before["batch.coalesced"],
+                "solo_flush": reg.get("batch.solo_flush")
+                - counters_before["batch.solo_flush"],
+                "recompiles": reg.get("compile.recompiles")
+                - recompiles_before,
+            }
+            batches = row["coalesced"] + row["solo_flush"]
+            # EXACT mean occupancy (served / batches) — the histogram
+            # above is log-2-bucketed, good for shape, off by up to one
+            # bucket for the single number the sentry trends
+            row["occupancy_mean"] = (round(len(lat_ms) / batches, 2)
+                                     if batches else -1.0)
+            out_levels.append(row)
+        job.finish()
+    except BaseException as e:
+        job.finish(error=repr(e))
+        raise
+    return {
+        "solo_rtt_ms": round(solo_rtt_ms, 3),
+        "coalesce": coalesce,
+        "scoring": scoring,
+        "k": k,
+        "queries_per_level": queries_per_level,
+        "seed": seed,
+        "levels": out_levels,
+    }

@@ -128,6 +128,22 @@ _declare("TPU_IR_BENCH_CHECK_MIN_ROWS", "int", 3,
 _declare("TPU_IR_BENCH_CHECK_TOLERANCE", "float", 0.3,
          "relative degradation vs the window median that breaches "
          "bench-check", "§14", minimum=0.0)
+_declare("TPU_IR_BATCH_WAIT_MS", "float", 0.0,
+         "max extra ms a promoted batch leader waits to fill toward the "
+         "next rung (0 = dispatch immediately; idle solo queries never "
+         "wait)", "§16", minimum=0.0)
+_declare("TPU_IR_BATCH_LADDER", "str", "1,4,16,64",
+         "compiled batch-size rungs the coalescer pads to (bounds "
+         "recompilation; largest rung caps batch occupancy)", "§16")
+_declare("TPU_IR_BATCH_WIDTH", "int", 8,
+         "query-width floor (padded term slots) for coalesced batches — "
+         "one precompilable width; longer queries bump to their pow2 "
+         "bucket (kernel cost scales with width on CPU — keep it near "
+         "the real query-length ceiling)", "§16", minimum=1)
+_declare("TPU_IR_BATCH_DONATE", "choice", "auto",
+         "donate the query-side device buffer on coalesced topk "
+         "dispatches: auto (TPU backends only), 1 (force), 0 (off)",
+         "§16", choices=("auto", "0", "1"))
 _declare("TPU_IR_QUERYLOG", "bool", True,
          "0 disables the sampled query log AND the slow-query trap",
          "§15")
