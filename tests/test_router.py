@@ -1,0 +1,507 @@
+"""Scatter-gather serving tier acceptance suite (ISSUE 10).
+
+The contract: N doc-shard workers behind the router serve ONE logical
+index — all-healthy merged results are BIT-identical to the
+single-process Scorer (tie order included) across layouts × scorings;
+a lost shard yields a tagged `partial` response that is a provably
+correct subset; a SIGKILLed replica is invisible (failover); slow
+replicas get hedged; and the whole taxonomy (full / degraded / partial
+/ rejected) survives real multi-process chaos with conservation intact.
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from tpu_ir.index.streaming import build_index_streaming
+from tpu_ir.search import Scorer
+from tpu_ir.search.layout import restrict_tiers, shard_doc_ranges
+from tpu_ir.serving import (
+    Overloaded,
+    Router,
+    RouterConfig,
+    merge_shard_topk,
+    run_distributed_soak,
+    serve_worker,
+)
+from tpu_ir.obs.server import MetricsServer, health_snapshot
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+N_SHARDS = 3
+
+
+def write_corpus(path, n_docs=150):
+    body = []
+    for i in range(n_docs):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + (i % 7)))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    path.write_text("".join(body))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("router")
+    corpus = write_corpus(tmp / "corpus.trec")
+    out = str(tmp / "idx")
+    build_index_streaming([corpus], out, k=1, num_shards=3,
+                          batch_docs=40, chargram_ks=[])
+    return out
+
+
+@pytest.fixture(scope="module")
+def ref_scorers(index_dir):
+    """Single-process reference scorers per layout (the merge oracle)."""
+    return {layout: Scorer.load(index_dir, layout=layout)
+            for layout in ("sparse", "sharded")}
+
+
+@pytest.fixture(scope="module")
+def worker_scorers(index_dir, ref_scorers):
+    """In-process doc-range-restricted worker scorers per layout —
+    the merge property is about the SCORERS + merge function; the HTTP
+    plumbing is exercised separately."""
+    num_docs = ref_scorers["sparse"].meta.num_docs
+    ranges = shard_doc_ranges(num_docs, N_SHARDS)
+    return {layout: [Scorer.load(index_dir, layout=layout, doc_range=rg)
+                     for rg in ranges]
+            for layout in ("sparse", "sharded")}
+
+
+QUERIES = ["salmon fishing", "bears honey market", "quick",
+           "rain forest investor", "asset bond stock season",
+           "dog dog salmon", "nosuchterm", "fox market rain"]
+
+
+# ---------------------------------------------------------------------------
+# partition + restriction units
+# ---------------------------------------------------------------------------
+
+
+def test_shard_doc_ranges_partition():
+    ranges = shard_doc_ranges(10, 3)
+    assert ranges == [(1, 4), (5, 8), (9, 10)]
+    # disjoint cover of 1..D
+    seen = [d for lo, hi in ranges for d in range(lo, hi + 1)]
+    assert seen == list(range(1, 11))
+    # more shards than docs: trailing shards own empty ranges
+    ranges = shard_doc_ranges(3, 5)
+    assert ranges[0] == (1, 1)
+    assert all(hi < lo for lo, hi in ranges[3:])
+    with pytest.raises(ValueError):
+        shard_doc_ranges(10, 0)
+
+
+def test_restrict_tiers_zeroes_only_out_of_range(ref_scorers, index_dir):
+    from tpu_ir.search.layout import load_serving_cache
+
+    meta = ref_scorers["sparse"].meta
+    tiers, _df, _norms = load_serving_cache(index_dir, meta=meta)
+    lo, hi = 10, 60
+    masked = restrict_tiers(tiers, lo, hi)
+    # geometry untouched — identical programs by construction
+    assert masked.hot_rank is tiers.hot_rank
+    assert masked.num_hot == tiers.num_hot
+    assert all(a.shape == b.shape for a, b in
+               zip(masked.tier_tfs, tiers.tier_tfs))
+    for td, tt_old, tt_new in zip(tiers.tier_docs, tiers.tier_tfs,
+                                  masked.tier_tfs):
+        td = np.asarray(td).astype(np.int64)
+        in_range = (td >= lo) & (td <= hi)
+        np.testing.assert_array_equal(
+            np.asarray(tt_new)[in_range], np.asarray(tt_old)[in_range])
+        assert not np.asarray(tt_new)[~in_range].any()
+    hd = np.asarray(tiers.hot_docs).astype(np.int64)
+    in_range = (hd >= lo) & (hd <= hi)
+    np.testing.assert_array_equal(np.asarray(masked.hot_vals)[in_range],
+                                  np.asarray(tiers.hot_vals)[in_range])
+    assert not np.asarray(masked.hot_vals)[~in_range].any()
+
+
+def test_doc_range_validates(index_dir):
+    with pytest.raises(ValueError):
+        Scorer.load(index_dir, layout="sparse", doc_range=(0, 10))
+    with pytest.raises(ValueError):
+        Scorer.load(index_dir, layout="sparse", doc_range=(1, 10 ** 9))
+
+
+# ---------------------------------------------------------------------------
+# THE property: N-shard exact merge == single-index top-k, bit-exact
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("layout", ["sparse", "sharded"])
+@pytest.mark.parametrize("scoring", ["tfidf", "bm25"])
+def test_nshard_merge_bitexact(ref_scorers, worker_scorers, layout,
+                               scoring):
+    """All-healthy: merge of per-shard top-k == single-process top-k —
+    full (docid, score) tuples, float bits and tie order included."""
+    ref = ref_scorers[layout]
+    workers = worker_scorers[layout]
+    for q in QUERIES:
+        full = list(ref.search_batch([q], k=10, scoring=scoring,
+                                     return_docids=False)[0])
+        shard_hits = [list(w.search_batch([q], k=10, scoring=scoring,
+                                          return_docids=False)[0])
+                      for w in workers]
+        merged = [(int(d), float(s))
+                  for d, s in merge_shard_topk(shard_hits, 10)]
+        assert merged == full, (layout, scoring, q)
+
+
+@pytest.mark.parametrize("layout", ["sparse", "sharded"])
+@pytest.mark.parametrize("scoring", ["tfidf", "bm25"])
+def test_nshard_merge_one_shard_lost(ref_scorers, worker_scorers,
+                                     layout, scoring):
+    """One shard lost: the merge of the SURVIVING shards equals the
+    full ranking filtered to their doc ranges — the partial-subset
+    correctness the router's `partial` tag promises."""
+    ref = ref_scorers[layout]
+    workers = worker_scorers[layout]
+    num_docs = ref.meta.num_docs
+    ranges = shard_doc_ranges(num_docs, N_SHARDS)
+    for lost in range(N_SHARDS):
+        ok_ranges = [rg for s, rg in enumerate(ranges) if s != lost]
+        for q in QUERIES[:4]:
+            # the independent oracle: the FULL positive ranking,
+            # filtered to the surviving ranges
+            rank = list(ref.search_batch([q], k=num_docs,
+                                         scoring=scoring,
+                                         return_docids=False)[0])
+            expect = [(int(d), float(s)) for d, s in rank
+                      if any(lo <= d <= hi for lo, hi in ok_ranges)][:10]
+            shard_hits = [
+                list(w.search_batch([q], k=10, scoring=scoring,
+                                    return_docids=False)[0])
+                for s, w in enumerate(workers) if s != lost]
+            merged = [(int(d), float(s))
+                      for d, s in merge_shard_topk(shard_hits, 10)]
+            assert merged == expect, (layout, scoring, q, lost)
+
+
+# ---------------------------------------------------------------------------
+# the HTTP worker + router path (in-process workers, real sockets)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def http_workers(index_dir):
+    """Three in-process HTTP workers (sparse layout, one replica each)
+    + teardown. Function-scoped: the obs-server threads must start and
+    stop within one test (the conftest thread-leak guard)."""
+    started = []
+    for s in range(N_SHARDS):
+        srv, fe, sc = serve_worker(index_dir, s, N_SHARDS,
+                                   layout="sparse", warm=False)
+        started.append((srv, fe, sc))
+    yield [[f"127.0.0.1:{srv.port}"] for srv, _, _ in started]
+    for srv, _, _ in started:
+        srv.stop()
+
+
+def test_routed_search_bitexact_and_health(index_dir, ref_scorers,
+                                           http_workers):
+    ref = ref_scorers["sparse"]
+    with Router(index_dir, http_workers,
+                RouterConfig(deadline_ms=30000)) as router:
+        for scoring in ("tfidf", "bm25"):
+            for q in QUERIES[:4]:
+                full = list(ref.search_batch([q], k=10,
+                                             scoring=scoring)[0])
+                res = router.search(q, k=10, scoring=scoring)
+                assert Router.classify(res) == "full"
+                assert res.shards_ok == tuple(range(N_SHARDS))
+                assert not res.missing_shards
+                assert list(res) == full, (scoring, q)
+        # two-phase rerank: bit-identical to the single-process
+        # rerank pipeline
+        for q in QUERIES[:4]:
+            full = list(ref.search_batch([q], k=10, rerank=25)[0])
+            res = router.search(q, k=10, rerank=25)
+            assert Router.classify(res) == "full"
+            assert list(res) == full, q
+        # phrase queries are not routable — loud, not silent
+        with pytest.raises(ValueError):
+            router.search('"salmon fishing"')
+        # aggregated health: every replica up, worker identity present
+        h = router.health_summary()
+        assert h["num_shards"] == N_SHARDS
+        for s, sh in enumerate(h["shards"]):
+            assert sh["doc_range"][0] >= 1
+            (rep,) = sh["replicas"]
+            assert rep["up"] is True
+            assert rep["worker"]["shard"] == s
+            assert rep["worker"]["generation"] == 0
+            assert rep["breaker"]["state"] == "closed"
+        # the router rides the process /healthz via register_router
+        snap = health_snapshot()
+        assert snap["shards"]["num_shards"] == N_SHARDS
+        # querylog: routed requests record their fan-out decision
+        from tpu_ir.obs import querylog
+
+        routed = [e for e in querylog.recent() if e.get("router")]
+        assert routed
+        assert routed[-1]["shards_ok"] == list(range(N_SHARDS))
+        assert routed[-1]["partial"] is False
+
+
+def test_routed_partial_and_failover(index_dir, ref_scorers,
+                                     http_workers):
+    """Kill shard 2's only replica -> responses ship partial with the
+    healthy shards' exact subset; with a second replica present the
+    same kill is invisible (failover)."""
+    ref = ref_scorers["sparse"]
+    num_docs = ref.meta.num_docs
+    ranges = shard_doc_ranges(num_docs, N_SHARDS)
+    # a dead address: bind-and-release a port so connects are refused
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead = f"127.0.0.1:{s.getsockname()[1]}"
+    s.close()
+
+    grid_partial = [http_workers[0], http_workers[1], [dead]]
+    with Router(index_dir, grid_partial,
+                RouterConfig(deadline_ms=30000)) as router:
+        q = "salmon fishing"
+        res = router.search(q, k=10, scoring="bm25")
+        assert Router.classify(res) == "partial"
+        assert res.missing_shards == (2,)
+        assert res.shards_ok == (0, 1)
+        rank = list(ref.search_batch([q], k=num_docs, scoring="bm25",
+                                     return_docids=False)[0])
+        expect = [(ref.mapping.get_docid(int(d)), float(s_))
+                  for d, s_ in rank
+                  if any(lo <= d <= hi
+                         for lo, hi in ranges[:2])][:10]
+        assert list(res) == expect
+
+    # failover: same dead primary, but a live replica behind it
+    grid_failover = [http_workers[0], http_workers[1],
+                     [dead, http_workers[2][0]]]
+    with Router(index_dir, grid_failover,
+                RouterConfig(deadline_ms=30000)) as router:
+        for q in QUERIES[:3]:
+            full = list(ref.search_batch([q], k=10, scoring="bm25")[0])
+            res = router.search(q, k=10, scoring="bm25")
+            assert Router.classify(res) == "full", q
+            assert list(res) == full
+
+
+def test_all_shards_down_sheds_structurally(index_dir):
+    import socket
+
+    dead = []
+    for _ in range(2):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        dead.append([f"127.0.0.1:{s.getsockname()[1]}"])
+        s.close()
+    with Router(index_dir, dead,
+                RouterConfig(deadline_ms=2000)) as router:
+        with pytest.raises(Overloaded) as ei:
+            router.search("salmon", k=5)
+        assert ei.value.reason == "no_healthy_shards"
+
+
+# ---------------------------------------------------------------------------
+# hedging + breakers (fake workers: handler behavior under our control)
+# ---------------------------------------------------------------------------
+
+
+def _fake_worker(hits, sleep_s=0.0):
+    """A worker stub returning fixed hits after an optional delay."""
+
+    calls = []
+
+    def search(payload):
+        calls.append(payload)
+        if sleep_s:
+            time.sleep(sleep_s)
+        return {"hits": hits, "level": "full", "degraded": False}
+
+    srv = MetricsServer(rpc_handlers={"search": search}).start()
+    return srv, calls
+
+
+def test_hedged_dispatch_beats_slow_replica(index_dir):
+    slow_srv, slow_calls = _fake_worker([[1, 3.0]], sleep_s=1.5)
+    fast_srv, fast_calls = _fake_worker([[1, 3.0]])
+    try:
+        with Router(index_dir, [[f"127.0.0.1:{slow_srv.port}",
+                                 f"127.0.0.1:{fast_srv.port}"]],
+                    RouterConfig(deadline_ms=10000,
+                                 hedge_ms=60.0)) as router:
+            from tpu_ir.obs import get_registry
+
+            # force the slow replica to be the round-robin primary
+            router._stats[0]._cursor = len(router._topology()[0]) - 1
+            fired0 = get_registry().get("router.hedge_fired")
+            t0 = time.perf_counter()
+            res = router.search("whatever", k=5, return_docids=False)
+            elapsed = time.perf_counter() - t0
+            assert list(res) == [(1, 3.0)]
+            assert get_registry().get("router.hedge_fired") == fired0 + 1
+            assert res.hedges == 1
+            # the hedge answered; the slow primary's 1.5 s never gated
+            assert elapsed < 1.2
+            assert fast_calls  # hedge actually reached the backup
+    finally:
+        slow_srv.stop()
+        fast_srv.stop()
+
+
+def test_none_placeholder_replica_slots_are_skipped(index_dir):
+    """A static grid may carry None for unstaffed replica slots; the
+    router must dial only addressed replicas, keeping grid-aligned
+    replica numbering (regression: the order used filtered positions
+    while dialing indexed the unfiltered row)."""
+    srv, calls = _fake_worker([[5, 2.0]])
+    try:
+        with Router(index_dir, [[None, f"127.0.0.1:{srv.port}", None]],
+                    RouterConfig(deadline_ms=5000)) as router:
+            for _ in range(3):  # round-robin must never land on a None
+                res = router.search("q", k=5, return_docids=False)
+                assert Router.classify(res) == "full"
+                assert list(res) == [(5, 2.0)]
+            assert len(calls) == 3
+    finally:
+        srv.stop()
+
+
+def test_replica_breaker_opens_and_probes(index_dir):
+    """Consecutive replica failures open its breaker (fast-fail);
+    a later success through the half-open probe closes it."""
+    flaky_state = {"fail": True}
+
+    def search(payload):
+        if flaky_state["fail"]:
+            raise RuntimeError("injected worker failure")
+        return {"hits": [[2, 1.0]], "level": "full", "degraded": False}
+
+    srv = MetricsServer(rpc_handlers={"search": search}).start()
+    try:
+        with Router(index_dir, [[f"127.0.0.1:{srv.port}"]],
+                    RouterConfig(deadline_ms=2000, breaker_threshold=2,
+                                 breaker_cooldown_s=0.1)) as router:
+            for _ in range(3):
+                with pytest.raises(Overloaded):
+                    router.search("q", k=5)
+            assert router._breaker(0, 0).state == "open"
+            flaky_state["fail"] = False
+            time.sleep(0.15)  # past the cooldown: next try is a probe
+            res = router.search("q", k=5, return_docids=False)
+            assert list(res) == [(2, 1.0)]
+            assert router._breaker(0, 0).state == "closed"
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# adaptive batch ladder (ROADMAP 3 follow-up satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_batch_ladder_adapts_to_cpu_backend(monkeypatch):
+    from tpu_ir.serving import batch_ladder
+
+    # unset: the CPU-class probe drops rungs above 16
+    monkeypatch.delenv("TPU_IR_BATCH_LADDER", raising=False)
+    assert batch_ladder() == (1, 4, 16)
+    # explicit setting always wins over the probe
+    monkeypatch.setenv("TPU_IR_BATCH_LADDER", "1,4,16,64")
+    assert batch_ladder() == (1, 4, 16, 64)
+    monkeypatch.setenv("TPU_IR_BATCH_LADDER", "2,8")
+    assert batch_ladder() == (2, 8)
+
+
+def test_batch_ladder_keeps_top_rung_on_rtt_backend(monkeypatch):
+    import tpu_ir.search.scorer as scorer_mod
+    from tpu_ir.serving import batch_ladder
+
+    monkeypatch.delenv("TPU_IR_BATCH_LADDER", raising=False)
+    monkeypatch.setattr(scorer_mod, "_rtt_dominated_backend",
+                        lambda: True)
+    assert batch_ladder() == (1, 4, 16, 64)
+
+
+# ---------------------------------------------------------------------------
+# bench-check: routed metrics are gated, direction-aware
+# ---------------------------------------------------------------------------
+
+
+def test_bench_check_gates_routed_metrics():
+    from tpu_ir.obs.bench_check import METRICS, check_history
+
+    for name in ("routed_qps", "routed_p99_ms", "partial_fraction",
+                 "hedge_fired"):
+        assert name in METRICS
+    base = {"config": "serve_routed-100q-s2r2", "backend": "cpu",
+            "routed_qps": 100.0, "routed_p99_ms": 80.0,
+            "partial_fraction": 0.0, "hedge_fired": 2}
+    rows = [dict(base) for _ in range(4)]
+    # a collapse in routed throughput breaches (direction: higher)
+    rows.append(dict(base, routed_qps=20.0))
+    rep = check_history(rows, window=8, min_rows=3, tolerance=0.3)
+    assert rep["status"] == "breach"
+    assert [b["metric"] for b in rep["breaches"]] == ["routed_qps"]
+    # a partial_fraction that was never seen before breaches (lower)
+    rows[-1] = dict(base, partial_fraction=0.5)
+    rep = check_history(rows, window=8, min_rows=3, tolerance=0.3)
+    assert [b["metric"] for b in rep["breaches"]] == ["partial_fraction"]
+
+
+def test_serve_bench_shards_arg_validation(index_dir):
+    from tpu_ir.cli import main
+
+    assert main(["serve-bench", index_dir, "--shards", "0"]) == 2
+    assert main(["serve-bench", index_dir, "--shards", "2",
+                 "--layout", "sharded"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# THE acceptance: distributed chaos soak (real subprocesses, SIGKILL)
+# ---------------------------------------------------------------------------
+
+
+def test_distributed_chaos_soak(index_dir, tmp_path):
+    """Tier-1 fast variant of the ISSUE 10 acceptance: 2 shards x 2
+    replicas as real subprocesses; mid-soak a replica is SIGKILLed
+    (failover must hide it), then a WHOLE shard (partial results must
+    appear, each a pinned-correct subset), then everything respawns
+    (recovery must close partial_fraction). Conservation and the
+    response taxonomy hold throughout."""
+    report = run_distributed_soak(
+        str(index_dir), shards=2, replicas=2, threads=6, queries=100,
+        seed=0, rundir=str(tmp_path / "run"),
+        flight_dir=str(tmp_path / "flight"))
+    # conservation: nothing vanishes, nothing breaks structure
+    assert report["served"] + report["shed"] == report["submitted"]
+    assert report["errors"] == 0, report["error_samples"]
+    assert report["deadlocked"] == 0
+    # zero caller-visible failures from the replica SIGKILL: every
+    # request got a response (shed==0 with these admission bounds)
+    assert report["shed"] == 0
+    # taxonomy: every served response classified exactly once
+    assert sum(report["classes"].values()) == report["served"]
+    # the whole-shard outage produced partial responses...
+    assert report["classes"]["partial"] > 0
+    assert report["partial_fraction"] > 0
+    # ...and every checked one was a bit-exact healthy-shard subset
+    assert report["partial_checked"] > 0
+    assert report["partial_mismatches"] == 0
+    # full responses are bit-identical to the single-process scorer
+    assert report["classes"]["full"] > 0
+    assert report["full_mismatches"] == 0
+    # chaos actually happened: kills -> respawns (1 replica + 1 shard)
+    assert report["router"]["router.worker_respawn"] >= 3
+    # recovery: with the shard back, the topology serves full again
+    assert report["recovery_full"] == report["recovery_probes"]
+    # the routed latency section is present for the bench row
+    assert report["latency"]["router.request"]["count"] > 0

@@ -785,12 +785,74 @@ def _serve_sweep(args, scorer, levels: list) -> int:
     return 0 if all(lv["errors"] == 0 for lv in report["levels"]) else 1
 
 
+def _serve_routed(args) -> int:
+    """The serve-bench scatter-gather mode (ISSUE 10): spawn the S x R
+    worker topology, drive the routed (optionally chaos) soak through
+    the hedging router, print the invariant report, and append the
+    routed_* sentry summary row to BENCH_HISTORY.jsonl where
+    `tpu-ir bench-check` gates it (direction-aware)."""
+    import jax
+
+    from .obs.bench_check import append_history_row
+    from .serving import run_distributed_soak
+
+    if args.shards < 1 or args.replicas < 1:
+        print("--shards and --replicas must be >= 1", file=sys.stderr)
+        return 2
+    layout = "sparse" if args.layout == "auto" else args.layout
+    if layout == "sharded":
+        print("--shards mode runs one single-device scorer per worker; "
+              "use --layout sparse or dense", file=sys.stderr)
+        return 2
+    with _MaybeTrack(args.metrics_port) as track:
+        report = run_distributed_soak(
+            args.index_dir, shards=args.shards, replicas=args.replicas,
+            threads=args.threads, queries=args.queries, seed=args.seed,
+            layout=layout, chaos=args.chaos,
+            worker_deadline_s=(1.0 if args.deadline is None
+                               else args.deadline),
+            timeout_s=args.timeout, flight_dir=args.flight_dir)
+        if track.server is not None:
+            report["metrics_url"] = track.server.url
+    req_lat = report["latency"].get("router.request") or {}
+    p99 = req_lat.get("p99_ms")
+    row = {
+        # chaos runs are a structurally different regime (a third of
+        # the soak serves with a shard down) — their own comparability
+        # group, so they never drag the healthy medians
+        "config": (f"serve_routed-{report['submitted']}q-"
+                   f"s{args.shards}r{args.replicas}"
+                   + ("-chaos" if args.chaos else "")),
+        "backend": jax.default_backend(),
+        "shards": args.shards,
+        "replicas": args.replicas,
+        "routed_qps": (round(report["served"] / report["wall_s"], 1)
+                       if report["wall_s"] else -1.0),
+        "routed_p99_ms": -1.0 if p99 is None else p99,
+        "partial_fraction": report["partial_fraction"],
+        "hedge_fired": report["router"].get("router.hedge_fired", 0),
+        "recovery_full": report["recovery_full"],
+    }
+    report["history"] = append_history_row(row)
+    report["history_row"] = row
+    print(json.dumps(report, sort_keys=True, default=repr))
+    ok = (report["errors"] == 0 and report["deadlocked"] == 0
+          and report["full_mismatches"] == 0
+          and report["partial_mismatches"] == 0
+          and report["served"] + report["shed"] == report["submitted"])
+    return 0 if ok else 1
+
+
 def cmd_serve_bench(args) -> int:
     """Drive the overload soak (serving/soak.py) against an index: N
     worker threads of mixed seeded traffic through a ServingFrontend,
     optionally under a chaos fault plan, reporting the invariant
     counters as JSON. The operational twin of tests/test_serving.py's
     soak — what the tests assert, an operator can reproduce.
+
+    `--shards N [--replicas R]` switches to the ISSUE 10 scatter-gather
+    mode: S x R worker processes behind the hedging router, the routed
+    chaos soak, and routed_* summary fields in BENCH_HISTORY.jsonl.
 
     `--concurrency N,N,...` (a comma list) switches to the ISSUE 9
     concurrency SWEEP: closed-loop clients at each level through the
@@ -800,6 +862,8 @@ def cmd_serve_bench(args) -> int:
     bench-check` gates `batched_qps`/`batched_p99_ms`/`solo_p50_ms`/
     `batch_occupancy_mean`."""
     _apply_backend(args)
+    if args.shards is not None:
+        return _serve_routed(args)
     from .search import Scorer
     from .serving import DEFAULT_CHAOS_PLAN, ServingConfig, run_soak
 
@@ -1263,7 +1327,19 @@ def main(argv: list[str] | None = None) -> int:
     pb.add_argument("--chaos", action="store_true",
                     help="inject the default chaos plan (hangs + device "
                          "losses on the score dispatch); --faults SPEC "
-                         "overrides with a custom plan")
+                         "overrides with a custom plan. In --shards mode "
+                         "chaos is process kills: a replica SIGKILL, then "
+                         "a whole shard, then respawn")
+    pb.add_argument("--shards", type=int, default=None, metavar="N",
+                    help="scatter-gather mode (serving/router.py): spawn "
+                         "N doc-shard worker processes behind a hedging "
+                         "query router and drive the routed soak instead "
+                         "of the single-process one; summary fields "
+                         "routed_qps/routed_p99_ms/partial_fraction/"
+                         "hedge_fired append to BENCH_HISTORY.jsonl")
+    pb.add_argument("--replicas", type=int, default=1, metavar="R",
+                    help="replicas per shard in --shards mode (failover "
+                         "+ hedging need R >= 2)")
     pb.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded"],
                     default="auto")

@@ -91,6 +91,16 @@ METRICS: dict[str, tuple[str, float]] = {
     "batched_p99_ms": ("lower", 50.0),
     "solo_p50_ms": ("lower", 2.0),
     "batch_occupancy_mean": ("higher", 0.0),
+    # scatter-gather serving (ISSUE 10 serve_routed rows): routed
+    # throughput and tail (the same max-of-N weather floor), the
+    # fraction of responses that shipped partial (more partials = more
+    # shard loss — lower is better; small absolute floor so one extra
+    # partial in a small soak is weather), and hedges fired (a hedging
+    # regression shows as a sustained jump — floor absorbs run jitter)
+    "routed_qps": ("higher", 0.0),
+    "routed_p99_ms": ("lower", 50.0),
+    "partial_fraction": ("lower", 0.05),
+    "hedge_fired": ("lower", 5.0),
 }
 
 

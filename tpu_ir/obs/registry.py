@@ -115,11 +115,28 @@ QUERYLOG_COUNTER_NAMES = ("querylog.recorded", "querylog.slow")
 # immediately — the solo-latency guarantee).
 BATCH_COUNTER_NAMES = ("batch.coalesced", "batch.solo_flush")
 
+# Scatter-gather router counters (serving/router.py, ISSUE 10): the
+# one-logical-index-over-N-shard-workers fan-out. requests/served_* and
+# shed follow the frontend taxonomy at the ROUTER scope (a routed
+# response is exactly one of full/degraded/partial/rejected);
+# hedge_fired/hedge_won instrument tail-latency hedging; replica_failed
+# and shard_lost count failover events; breaker_opened is the
+# per-replica breaker's transition count (the frontend counter of the
+# same name is per-process, this one is per-replica-channel).
+ROUTER_COUNTER_NAMES = (
+    "router.requests", "router.served_full", "router.served_degraded",
+    "router.served_partial", "router.shed",
+    "router.hedge_fired", "router.hedge_won",
+    "router.replica_failed", "router.shard_lost",
+    "router.breaker_opened", "router.worker_respawn",
+)
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
-) + COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
+) + (COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
+     + ROUTER_COUNTER_NAMES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
@@ -137,6 +154,13 @@ DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     # per-slot queue wait (enqueue -> dispatch start, seconds)
     "batch.occupancy",
     "batch.wait",
+    # scatter-gather router (ISSUE 10): end-to-end routed request
+    # latency, per-shard worker round trips (hedges observe too — each
+    # completed replica call is one RTT sample), and the host-side
+    # exact top-k merge cost
+    "router.request",
+    "router.shard_rtt",
+    "router.merge",
 )
 
 # Gauges: point-in-time values (memory levels, cache sizes) — unlike

@@ -69,6 +69,7 @@ logger = logging.getLogger(__name__)
 
 _health_lock = threading.Lock()
 _frontends: list = []  # weakrefs to live ServingFrontends, oldest first
+_routers: list = []    # weakrefs to live routers (serving/router.py)
 _index_dirs: list = []  # index dirs this process loaded, oldest first
 _MAX_INDEX_DIRS = 4
 _doctor_cache: dict = {}  # dir -> (metadata mtime_ns, report)
@@ -80,6 +81,17 @@ def register_health_source(frontend) -> None:
     server must never keep a dead frontend's scorer resident."""
     with _health_lock:
         _frontends.append(weakref.ref(frontend))
+
+
+def register_router(router) -> None:
+    """Called by serving/router.py Router.__init__: /healthz aggregates
+    the whole shard topology — per shard, each replica's liveness /
+    breaker state / trailing latency plus the worker's own /healthz
+    payload (polled, TTL-cached) — instead of only the weakref-
+    registered in-process frontends. Weakref, like the frontends: the
+    server must never keep a closed router's connections alive."""
+    with _health_lock:
+        _routers.append(weakref.ref(router))
 
 
 def register_index_dir(path) -> None:
@@ -169,6 +181,13 @@ def _live_frontends() -> list:
         return [f for _, f in alive if f is not None]
 
 
+def _live_routers() -> list:
+    with _health_lock:
+        alive = [(r, r()) for r in _routers]
+        _routers[:] = [r for r, f in alive if f is not None]
+        return [f for _, f in alive if f is not None]
+
+
 def health_snapshot() -> dict:
     """The /healthz payload. The newest live frontend's control-plane
     state is lifted to the top-level `breaker`/`ladder`/`queue_depth`
@@ -208,6 +227,15 @@ def health_snapshot() -> dict:
         except Exception as e:  # noqa: BLE001 — health must not 500
             st = {"error": repr(e)}
         out["frontends"].append(st)
+    routers = _live_routers()
+    if routers:
+        # the scatter-gather topology (ISSUE 10): shard id -> replica
+        # states / breakers / worker health / generation, aggregated by
+        # the newest live router (TTL-cached worker polls inside)
+        try:
+            out["shards"] = routers[-1].health_summary()
+        except Exception as e:  # noqa: BLE001 — health must not 500
+            out["shards"] = {"error": repr(e)}
     if out["frontends"]:
         latest = out["frontends"][-1]
         out["breaker"] = latest.get("breaker")
@@ -322,6 +350,60 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._json(obj)
 
+    def do_POST(self) -> None:  # noqa: N802 — stdlib handler contract
+        """RPC surface for the scatter-gather tier (ISSUE 10): a shard
+        WORKER process registers instance-scoped handlers
+        (`MetricsServer(rpc_handlers={"search": fn, ...})`) and the
+        router POSTs JSON to /rpc/<name>. Registration is per server
+        instance — two in-process workers on different ports must not
+        share one global handler table. Error contract: a structured
+        Overloaded shed is 503 (the router retries another replica), any
+        other failure is 500 with the repr (the router counts it as a
+        replica failure)."""
+        try:
+            url = urlparse(self.path)
+            route = url.path.rstrip("/")
+            handlers = getattr(self.server, "rpc_handlers", None) or {}
+            if not route.startswith("/rpc/"):
+                self._json({"error": "unknown endpoint"}, code=404)
+                return
+            name = route[len("/rpc/"):]
+            fn = handlers.get(name)
+            if fn is None:
+                self._json({"error": f"no rpc handler {name!r}"},
+                           code=404)
+                return
+            try:
+                length = int(self.headers.get("Content-Length", 0))
+            except (TypeError, ValueError):
+                length = 0
+            try:
+                payload = json.loads(self.rfile.read(length) or b"{}")
+            except ValueError:
+                self._json({"error": "malformed JSON body"}, code=400)
+                return
+            try:
+                self._json(fn(payload))
+            except Exception as e:  # noqa: BLE001 — classified below
+                # the serving Overloaded shed is structural, not a bug:
+                # 503 tells the router "retry elsewhere", 500 "replica
+                # failure" (import is lazy — obs must not import serving)
+                from ..serving.admission import Overloaded
+
+                if isinstance(e, Overloaded):
+                    self._json({"error": "overloaded",
+                                "reason": e.reason,
+                                "level": e.level}, code=503)
+                else:
+                    self._json({"error": repr(e)}, code=500)
+        except BrokenPipeError:
+            pass  # caller hung up mid-response; its problem
+        except Exception as e:  # noqa: BLE001 — an RPC must never kill
+            try:                # the worker process it runs in
+                self._json({"error": repr(e)}, code=500)
+            except Exception:  # noqa: BLE001
+                pass
+
     def do_GET(self) -> None:  # noqa: N802 — stdlib handler contract
         try:
             url = urlparse(self.path)
@@ -339,7 +421,14 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/metrics.json":
                 self._json(get_registry().snapshot())
             elif route == "/healthz":
-                self._json(health_snapshot())
+                payload = health_snapshot()
+                extra = getattr(self.server, "extra_health", None)
+                if extra is not None:
+                    # worker identity (shard id, replica, doc range,
+                    # generation) — the router's health aggregation and
+                    # failover decisions read these fields
+                    payload.update(extra() if callable(extra) else extra)
+                self._json(payload)
             elif route == "/jobs":
                 dicts = [j.to_dict() for j in reversed(progress.jobs())]
                 if q.get("format", [""])[0] == "html":
@@ -427,9 +516,16 @@ class MetricsServer:
     uses (try/finally)."""
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1",
-                 spool: bool | None = None):
+                 spool: bool | None = None,
+                 rpc_handlers: dict | None = None,
+                 extra_health=None):
         self._httpd = ThreadingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
+        # instance-scoped RPC handlers + health annotations (the shard
+        # WORKER surface, ISSUE 10) — deliberately not module globals:
+        # tests run several in-process workers on different ports
+        self._httpd.rpc_handlers = dict(rpc_handlers or {})
+        self._httpd.extra_health = extra_health
         self.host = host
         self.port = self._httpd.server_address[1]
         self._thread: threading.Thread | None = None

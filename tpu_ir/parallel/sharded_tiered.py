@@ -153,6 +153,34 @@ def make_sharded_tiered(
         tuple(tier_docs), tuple(tier_tfs), dl, doc_base, dblk)
 
 
+def restrict_sharded_layout(lay: ShardedTieredLayout, lo: int,
+                            hi: int) -> ShardedTieredLayout:
+    """Doc-range-restricted COPY of a sharded layout (the scatter-gather
+    worker entry, search/layout.py::restrict_tiers's SPMD sibling):
+    postings whose GLOBAL docno (local + doc_base) falls outside
+    [lo, hi] have their tf zeroed; shapes, geometry and every in-range
+    posting are untouched, so the SPMD programs trace identically and
+    in-range docs score bit-identically to the unrestricted layout —
+    the exact-merge correctness argument, distributed form."""
+    hot = np.array(lay.hot_tfs)          # [S, H, dblk+1]; may be mmap
+    doc_base = np.asarray(lay.doc_base).astype(np.int64)
+    n_shards = hot.shape[0]
+    # global docno of each local column, per device shard (column 0 is
+    # the dead slot — already excluded by the kernels, zero it anyway)
+    local = np.arange(hot.shape[-1], dtype=np.int64)[None, :]
+    g = local + doc_base[:, None]                        # [S, dblk+1]
+    col_out = (g < lo) | (g > hi) | (local == 0)
+    hot[np.broadcast_to(col_out[:, None, :], hot.shape)] = 0.0
+    tier_tfs = []
+    for td, tt in zip(lay.tier_docs, lay.tier_tfs):
+        td64 = np.asarray(td).astype(np.int64)           # [S, V_t, P_t]
+        gd = td64 + doc_base[:n_shards, None, None]
+        tf = np.array(tt)
+        tf[(td64 == 0) | (gd < lo) | (gd > hi)] = 0
+        tier_tfs.append(tf)
+    return lay._replace(hot_tfs=hot, tier_tfs=tuple(tier_tfs))
+
+
 def _sharded_cache_key(index_dir: str, meta, num_shards: int,
                        part_crcs: dict | None = None) -> dict:
     from ..search.layout import _serving_cache_key

@@ -223,6 +223,49 @@ def build_tiered_layout(
                           tuple(tier_docs), tuple(tier_tfs))
 
 
+def shard_doc_ranges(num_docs: int, num_shards: int) -> list:
+    """The scatter-gather tier's doc partition: contiguous 1-based
+    inclusive [lo, hi] docid ranges, one per shard, matching the block
+    math of parallel/sharded_tiered.shard_slices (dblk = ceil(D/S), so
+    trailing shards past num_docs own an empty range, hi < lo). Docid 0
+    is the dead slot and belongs to nobody."""
+    if num_shards < 1:
+        raise ValueError("num_shards must be >= 1")
+    dblk = -(-num_docs // num_shards)
+    return [(s * dblk + 1, min((s + 1) * dblk, num_docs))
+            for s in range(num_shards)]
+
+
+def restrict_tiers(tiers: TieredPostings, lo: int, hi: int) -> TieredPostings:
+    """A doc-range-restricted COPY of a tiered layout: postings whose
+    docno falls outside [lo, hi] have their tf zeroed, everything else —
+    hot_rank, tier geometry, array shapes, posting positions — is left
+    BYTE-IDENTICAL. Shape preservation is the whole point: the scoring
+    kernels trace the exact same programs as the unrestricted layout, and
+    a doc inside the range keeps every one of its postings at the same
+    position, so its score is BIT-IDENTICAL to the full single-process
+    scorer's (a zeroed tf contributes exact 0.0 — the same PAD-exactness
+    the explain suite pins). Docs outside the range score exactly 0.0 and
+    fall out of the top-k with the empty-slot mask. This is what makes
+    the router's exact merge provably correct (DESIGN §14): per-doc
+    scores do not depend on the partition at all.
+
+    Inputs may be read-only serving-cache mmaps — only the tf columns
+    are copied; index/geometry arrays are shared as-is."""
+    hot_docs = np.asarray(tiers.hot_docs)
+    hot_vals = np.array(tiers.hot_vals)  # copy: may be a read-only mmap
+    out_of_range = (hot_docs.astype(np.int64) < lo) | (
+        hot_docs.astype(np.int64) > hi)
+    hot_vals[out_of_range] = 0
+    tier_tfs = []
+    for td, tt in zip(tiers.tier_docs, tiers.tier_tfs):
+        td64 = np.asarray(td).astype(np.int64)
+        tf = np.array(tt)
+        tf[(td64 < lo) | (td64 > hi)] = 0
+        tier_tfs.append(tf)
+    return tiers._replace(hot_vals=hot_vals, tier_tfs=tuple(tier_tfs))
+
+
 # serving-cache format version; bump when the layout semantics change
 # (v2: hot strip cached as COO postings instead of the dense matrix;
 #  v3: keyed by part-file CRCs — a cache HIT needs no shard read or CSR

@@ -112,12 +112,24 @@ class SearchResult(list):
 
     `breaker_vote` (serving-internal): inside a coalesced shared batch,
     exactly one result carries True — the serving frontend feeds the
-    circuit breaker one verdict per DISPATCH, not per slot."""
+    circuit breaker one verdict per DISPATCH, not per slot.
+
+    `partial` (the scatter-gather tier, serving/router.py): True when at
+    least one doc shard missed its deadline on every replica, so the
+    merged top-k covers only the healthy shards — a correct subset, not
+    the full index. `shards_ok` / `missing_shards` name the shard ids
+    that did / did not contribute; `hedges` counts hedged dispatches the
+    request fired. Rides the PR-2 tagging ladder: every routed response
+    is exactly one of full / degraded / partial / rejected."""
 
     degraded: bool = False
     level: str = "full"
     explain: list | None = None
     breaker_vote: bool = True
+    partial: bool = False
+    shards_ok: tuple = ()
+    missing_shards: tuple = ()
+    hedges: int = 0
 
 
 def compute_doc_norms(pair_term, pair_doc, pair_tf, df,
@@ -164,6 +176,9 @@ class Scorer:
     # class-level defaults so minimal Scorers (tests build them with
     # object.__new__ over synthetic layouts) get the no-deadline behavior
     deadline_s: float | None = None
+    # shard-worker doc restriction (scatter-gather tier); None = whole
+    # index. Set by __init__(doc_range=...), consulted by _topk_host.
+    doc_range: tuple | None = None
     # (the old single-threaded `degraded_last` alias is GONE — ISSUE 9:
     # under coalesced shared batches only the per-request tagged path
     # (topk_tagged / rerank_topk_tagged -> SearchResult.degraded) is a
@@ -197,6 +212,7 @@ class Scorer:
         sharded_layout=None,
         prune: bool = True,
         deadline_s: float | None = None,
+        doc_range: tuple | None = None,
     ):
         """`pair_*` may be omitted on the tiered path when prebuilt `tiers`
         (+ cached `doc_norms`) are supplied — the serving-cache fast path;
@@ -206,7 +222,17 @@ class Scorer:
         `deadline_s` bounds every score dispatch: a batch that has not
         returned within the deadline (or whose device is lost) falls back
         to the host CPU scorer and is tagged degraded, instead of hanging
-        the serving process (degraded-mode serving; "The Tail at Scale")."""
+        the serving process (degraded-mode serving; "The Tail at Scale").
+
+        `doc_range=(lo, hi)` (1-based inclusive global docids) makes this
+        a SHARD WORKER scorer for the scatter-gather serving tier
+        (serving/router.py): the loaded layout keeps its full geometry
+        but every posting outside the range is tf-zeroed
+        (layout.restrict_tiers), so in-range docs score BIT-identically
+        to the unrestricted scorer while out-of-range docs score exact
+        0.0 and never surface — the property the router's exact top-k
+        merge rides on. Global statistics (df, N, doc lengths, rerank
+        norms) stay global by construction."""
         self.vocab = vocab
         self.mapping = mapping
         self.meta = meta
@@ -243,6 +269,23 @@ class Scorer:
             raise ValueError(f"unknown layout {layout!r}; expected "
                              "'auto', 'dense', 'sparse' or 'sharded'")
         self.layout = layout
+        self.doc_range = None
+        if doc_range is not None:
+            lo, hi = int(doc_range[0]), int(doc_range[1])
+            if lo < 1 or hi > d:
+                raise ValueError(f"doc_range {doc_range!r} outside the "
+                                 f"index's 1..{d} docid space")
+            self.doc_range = (lo, hi)
+            if layout == "dense" and pair_tf is not None:
+                # mask the tf column itself: doc_matrix, the lazy BM25
+                # tf matrix and the host fallback all derive from the
+                # pair columns, so one mask restricts every dense path
+                # (out-of-range docs' norms are polluted by the zeroed
+                # entries, but no out-of-range doc is ever a candidate)
+                pdoc = np.asarray(pair_doc).astype(np.int64)
+                pair_tf = np.array(pair_tf)
+                pair_tf[(pdoc < lo) | (pdoc > hi)] = 0
+                self._pairs_cols = (pair_term, pair_doc, pair_tf)
         self._tf_matrix = None  # built lazily on first BM25 call
         if self._pairs_cols is None and (
                 layout == "dense"
@@ -275,6 +318,12 @@ class Scorer:
                 lay = make_sharded_tiered(
                     pair_term, pair_doc, pair_tf, np.asarray(df),
                     np.asarray(doc_len), num_docs=d, num_shards=n_dev)
+            if self.doc_range is not None:
+                from ..parallel.sharded_tiered import (
+                    restrict_sharded_layout,
+                )
+
+                lay = restrict_sharded_layout(lay, *self.doc_range)
             self._sharded = put_sharded(lay, self._mesh)
             self._sharded_norm = None  # built lazily for rerank
             # df replicated over the mesh ONCE: multi-process serving
@@ -295,6 +344,10 @@ class Scorer:
             if tiers is None:
                 tiers = build_tiered_layout(pair_doc, pair_tf, df,
                                             num_docs=d)
+            if self.doc_range is not None:
+                from .layout import restrict_tiers
+
+                tiers = restrict_tiers(tiers, *self.doc_range)
             # every upload streams through the double-buffered chunked
             # path (utils/transfer.py::stream_to_device), each call its
             # own load.h2d span: disk page-ins of mmap'd cache sections
@@ -327,7 +380,8 @@ class Scorer:
     def load(cls, index_dir: str, *, layout: str = "auto",
              compat_int_idf: bool = False, prune: bool = True,
              deadline_s: float | None = None,
-             verify_integrity: bool = True) -> "Scorer":
+             verify_integrity: bool = True,
+             doc_range: tuple | None = None) -> "Scorer":
         if layout not in ("auto", "dense", "sparse", "sharded"):
             # fail before any IO — a typo'd layout should not cost the
             # minutes-long shard read + CSR assembly of a large index
@@ -398,7 +452,7 @@ class Scorer:
                     index_dir=index_dir, tiers=tiers,
                     doc_norms=np.asarray(norms),
                     pairs_loader=load_pairs_verified, prune=prune,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, doc_range=doc_range)
         elif resolved == "sharded":
             # same fast path for distributed serving, per mesh size
             import jax
@@ -417,7 +471,7 @@ class Scorer:
                     index_dir=index_dir, sharded_layout=lay,
                     doc_norms=np.asarray(norms),
                     pairs_loader=load_pairs_verified, prune=prune,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, doc_range=doc_range)
 
         # the eager shard read: recorded CRCs are folded into the SAME
         # streamed pass that reads the bytes (verify-while-read), so
@@ -485,7 +539,7 @@ class Scorer:
             layout=layout, compat_int_idf=compat_int_idf,
             index_dir=index_dir, tiers=tiers, doc_norms=norms,
             sharded_layout=sharded_layout, prune=prune,
-            deadline_s=deadline_s)
+            deadline_s=deadline_s, doc_range=doc_range)
 
     @staticmethod
     def _assemble_csr(index_dir: str, meta, verify: bool = False):
@@ -1179,6 +1233,15 @@ class Scorer:
                 # docnos are unique within one term's postings run, so
                 # fancy-index += accumulates correctly across terms
                 scores[pd[sl]] += w
+            if self.doc_range is not None:
+                # shard-worker restriction: the sparse layout's pair
+                # columns stay GLOBAL (the device layout is what's
+                # masked), so the host fallback must apply the range
+                # itself or a degraded batch would leak docs another
+                # shard owns into this worker's results
+                lo, hi = self.doc_range
+                scores[:lo] = 0.0
+                scores[hi + 1:] = 0.0
             top = np.argsort(-scores[1:], kind="stable")[:k] + 1
             keep = scores[top] > 0.0
             m = int(keep.sum())  # desc order => positives are a prefix
@@ -1552,6 +1615,28 @@ class Scorer:
                 if self._sharded_norm is None:
                     self._sharded_norm = sharded_norm
         return self._sharded_norm
+
+    def cosine_scores_at(self, texts: Sequence[str],
+                         cand: np.ndarray) -> np.ndarray:
+        """[B, C] cosine rerank-stage scores at global docids `cand` —
+        the scatter-gather router's stage-2 RPC (serving/router.py).
+
+        Delegates to the shared explain gather (_cosine_scores_at): the
+        SAME accumulation the production rerank kernel traces, at the
+        same candidate-matrix shape, so per-candidate floats are
+        bit-identical to what a single-process rerank would have seen.
+        On a doc-range-restricted worker, candidates outside the range
+        score exact 0.0 (their postings are masked) — the router takes
+        each candidate's value from its owning shard."""
+        from .explain import _cosine_scores_at
+
+        texts = list(texts)
+        q = self.analyze_queries(texts)
+        cand = np.asarray(cand, np.int32)
+        if cand.ndim == 1:
+            cand = np.broadcast_to(cand[None, :],
+                                   (len(texts), cand.shape[0]))
+        return _cosine_scores_at(self, q, cand)
 
     def rerank_topk(
         self, q_terms: np.ndarray, k: int = 10, candidates: int = 1000,

@@ -14,10 +14,18 @@ from .frontend import (
     ServingConfig,
     ServingFrontend,
 )
+from .router import (
+    Router,
+    RouterConfig,
+    merge_candidate_scores,
+    merge_shard_topk,
+)
+from .shardset import ShardSet, serve_worker, worker_rpc_handlers
 from .soak import (
     DEFAULT_CHAOS_PLAN,
     make_queries,
     run_concurrency_sweep,
+    run_distributed_soak,
     run_soak,
 )
 
@@ -27,6 +35,8 @@ __all__ = [
     "ServingFrontend", "ServingConfig", "DegradationLadder",
     "CoalescingScheduler", "BatchKey", "batch_ladder",
     "LEVEL_FULL", "LEVEL_NO_RERANK", "LEVEL_HOT_ONLY", "LEVEL_SHED",
+    "Router", "RouterConfig", "ShardSet", "serve_worker",
+    "worker_rpc_handlers", "merge_shard_topk", "merge_candidate_scores",
     "run_soak", "make_queries", "run_concurrency_sweep",
-    "DEFAULT_CHAOS_PLAN",
+    "run_distributed_soak", "DEFAULT_CHAOS_PLAN",
 ]

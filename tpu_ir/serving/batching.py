@@ -93,10 +93,24 @@ class _Slot:
         self.error = None
 
 
+# rungs above this are dropped from the DEFAULT ladder on backends where
+# per-row kernel cost is real (CPU-class): a 17-query burst padding to
+# 64 pays 47 rows of actual compute there, while on a TPU the padded
+# rows ride ~free on the MXU behind one fixed RTT (the ISSUE 9 sweep
+# measured the split; ROADMAP 3 named this follow-up)
+_CPU_MAX_RUNG = 16
+
+
 def batch_ladder() -> tuple:
     """The compiled batch-size rungs, parsed from TPU_IR_BATCH_LADDER
     (sorted, deduped, all >= 1). A malformed spec raises — a silently
-    empty ladder would disable coalescing without a trace."""
+    empty ladder would disable coalescing without a trace.
+
+    Adaptive default: when the variable is UNSET, CPU-class backends
+    drop rungs above 16 (padded rows cost real compute where the kernel
+    is compute-bound, so the top rung buys occupancy the hardware makes
+    you pay for). An explicit TPU_IR_BATCH_LADDER always wins — the
+    probe only picks the default."""
     spec = envvars.get_str("TPU_IR_BATCH_LADDER")
     try:
         rungs = sorted({max(1, int(p)) for p in spec.split(",") if p.strip()})
@@ -106,6 +120,11 @@ def batch_ladder() -> tuple:
             "integers like '1,4,16,64'") from None
     if not rungs:
         raise ValueError("TPU_IR_BATCH_LADDER is empty")
+    if not envvars.is_set("TPU_IR_BATCH_LADDER"):
+        from ..search.scorer import _rtt_dominated_backend
+
+        if not _rtt_dominated_backend():
+            rungs = [r for r in rungs if r <= _CPU_MAX_RUNG] or rungs[:1]
     return tuple(rungs)
 
 

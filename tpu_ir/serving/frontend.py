@@ -244,7 +244,8 @@ class ServingFrontend:
     def search(self, text: str, *, k: int = 10, scoring: str = "tfidf",
                rerank: int | None = None,
                snippets: bool = False,
-               explain_k: int = 0) -> SearchResult:
+               explain_k: int = 0,
+               return_docids: bool = True) -> SearchResult:
         """Serve one query. Returns a SearchResult tagged with the
         service level (`level`) and fallback flag (`degraded`) that
         produced it, or raises Overloaded (a structured shed — the
@@ -289,7 +290,8 @@ class ServingFrontend:
                 try:
                     res = self._serve(text, k=k, scoring=scoring,
                                       rerank=rerank, snippets=snippets,
-                                      level=level, explain_k=explain_k)
+                                      level=level, explain_k=explain_k,
+                                      return_docids=return_docids)
                 finally:
                     admit_cm.__exit__(None, None, None)
                 self._observe_latency(f"request.{level}", t0)
@@ -305,7 +307,8 @@ class ServingFrontend:
 
     def _serve(self, text: str, *, k: int, scoring: str,
                rerank: int | None, snippets: bool,
-               level: str, explain_k: int = 0) -> SearchResult:
+               level: str, explain_k: int = 0,
+               return_docids: bool = True) -> SearchResult:
         with obs_trace("breaker") as bsp:
             allowed, is_probe = self.breaker.allow_device()
             bsp.set("allowed", allowed)
@@ -315,12 +318,15 @@ class ServingFrontend:
             self._count("breaker_probes")
         use_rerank = rerank if level == LEVEL_FULL else None
         try:
-            if self.batcher is not None and '"' not in text:
+            if (self.batcher is not None and '"' not in text
+                    and return_docids):
                 # the coalesced path: this thread's request may ride a
                 # batch-mate's kernel call — its level/wait/occupancy
                 # are tagged per SLOT by the scheduler (the leader's
                 # thread-local context would be wrong for followers);
-                # phrase queries score on the host and go solo below
+                # phrase queries score on the host and go solo below,
+                # as do raw-docid requests (the shard-worker RPC
+                # surface): BatchKey doesn't carry the result-key flavor
                 res = self.batcher.submit(
                     text, k=k, scoring=scoring, rerank=use_rerank,
                     hot_only=(level == LEVEL_HOT_ONLY),
@@ -340,7 +346,8 @@ class ServingFrontend:
                         deadline_s=self.config.deadline_s,
                         force_host=force_host,
                         hot_only=(level == LEVEL_HOT_ONLY),
-                        explain_k=explain_k)[0]
+                        explain_k=explain_k,
+                        return_docids=return_docids)[0]
         except BaseException:
             # not a device verdict (bad query, program bug): release any
             # probe slot this request held so the breaker cannot wedge

@@ -1,0 +1,702 @@
+"""Scatter-gather query router: one logical index over N shard workers.
+
+The serving tier's distribution layer (ISSUE 10; ROADMAP 4 — the
+"millions of users" topology). Every request fans out to S doc-shard
+workers (shardset.py: full per-worker serving stacks over doc-range-
+restricted scorers), each shard answers with its LOCAL top-k, and the
+router merges exactly:
+
+    request ─► admission ─► fan-out (S shards × R replicas) ─► exact merge
+               (PR 2)        │ per-shard deadline                │ partial
+                             │ hedged dispatch (tail at scale)   │ tagging
+                             └ per-replica circuit breakers      ┘
+
+**Exact merge.** Doc sharding makes the merge provably correct: a doc's
+score depends only on its own postings plus GLOBAL statistics (df, N,
+doc lengths), never on which shard holds it — and the workers' masked
+layouts (layout.restrict_tiers) keep the kernel programs bit-identical
+to the single-process scorer, so per-doc floats match exactly. The
+host-side merge reproduces `lax.top_k` tie order (score desc, docid asc:
+a stable sort over shard-ascending, rank-ordered lists), so merged
+results are BIT-identical to the single-process Scorer — tie order
+included (tests/test_router.py pins it across layouts × scorings).
+
+**Tail tolerance ("The Tail at Scale").**
+- *Hedged dispatch*: when a shard's primary replica exceeds
+  max(TPU_IR_ROUTER_HEDGE_MS, the shard's trailing p99), the SAME
+  request is sent to another replica and the first answer wins — a slow
+  replica costs ~p99, not the deadline.
+- *Failover*: a replica that FAILS (connection refused/reset, 5xx,
+  shed) is immediately retried on the next replica within the shard
+  deadline — a SIGKILLed worker costs one connect error, not an outage.
+- *Per-replica breakers* (breaker.py, reused verbatim): consecutive
+  failures stop the router from even trying a flapping replica; a
+  half-open probe per cooldown detects recovery.
+- *Partial results*: a shard that misses its deadline on EVERY replica
+  is dropped from the merge and the response ships `partial=True` with
+  `missing_shards` named — the PR-2 tagging ladder's fourth word. Every
+  routed response is exactly one of full / degraded / partial /
+  rejected (the distributed soak pins the taxonomy under chaos).
+
+**Two-phase exact rerank.** `rerank=C` needs global candidates before
+the cosine stage, so the router runs it in two RPCs: (1) per-shard BM25
+top-C, merged to the global top-C — bit-identical to the single-process
+stage 1; (2) `cosine_at` on every healthy shard at the merged candidate
+list (each candidate's score comes from its owning shard; the kernel is
+the same shared accumulation the production rerank traces), then the
+final top-k over candidate order — the single-process tie rule.
+
+Observability: `router.*` counters + `router.request`/`router.shard_rtt`
+/`router.merge` histograms (declared in obs/registry.py), one querylog
+entry per routed request recording the fan-out/hedge/partial decision,
+and an aggregated `/healthz` (obs/server.register_router): shard →
+replica liveness, breaker state, worker identity/generation, trailing
+latency.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import os
+import threading
+import time
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
+from dataclasses import dataclass
+
+from .. import obs
+from ..obs import get_registry
+from ..obs import trace as obs_trace
+from ..utils import envvars
+from .admission import AdmissionController, Overloaded
+from .breaker import CircuitBreaker
+
+logger = logging.getLogger(__name__)
+
+# service levels ordered best-first; the routed response carries the
+# WORST level any contributing shard served at
+_LEVEL_ORDER = ("full", "no_rerank", "hot_only")
+
+
+@dataclass(frozen=True)
+class RouterConfig:
+    """Routing knobs. None defaults defer to the TPU_IR_ROUTER_* env
+    registry at construction (RUNBOOK §17 documents how to pick them)."""
+
+    deadline_ms: float | None = None   # per-shard budget per request
+    hedge_ms: float | None = None      # hedge-delay floor; 0 = no hedging
+    connect_ms: float | None = None    # TCP connect timeout per attempt
+    breaker_threshold: int = 3         # consecutive failures to open
+    breaker_cooldown_s: float = 1.0    # open time before a probe
+    max_concurrency: int = 16          # routed requests executing at once
+    max_queue: int = 64                # routed requests allowed to wait
+    rtt_window: int = 64               # trailing RTTs per shard (p99 src)
+    health_ttl_s: float | None = None  # worker-health poll cache age
+
+
+def merge_shard_topk(shard_hits, k: int) -> list:
+    """EXACT top-k merge of per-shard hit lists.
+
+    `shard_hits`: per-shard [(docid, score), ...] lists in per-shard
+    rank order (score desc, docid asc — the kernel's own tie rule),
+    ordered by ascending shard id; doc shards are contiguous ascending
+    docid ranges, so a STABLE sort on score alone reproduces
+    `lax.top_k`'s global tie order (lowest docid first) without ever
+    comparing docids. Empty slots (docid <= 0 / score <= 0) are
+    dropped, like the kernels' matched mask."""
+    merged = [h for hits in shard_hits for h in hits
+              if h[0] > 0 and h[1] > 0.0]
+    merged.sort(key=lambda h: -h[1])  # Timsort is stable
+    return merged[:k]
+
+
+def merge_candidate_scores(cand: list, per_shard: dict,
+                           ranges: list, k: int) -> list:
+    """Stage-2 assembly of the two-phase rerank: each global candidate's
+    cosine score comes from its OWNING shard's `cosine_at` response
+    (per_shard: shard id -> [C] scores, aligned with `cand`), then the
+    final top-k picks over CANDIDATE ORDER — `_topk_over_candidates`'s
+    tie rule (lowest candidate position first), which a stable sort on
+    score alone reproduces. Candidates whose owner is missing (a shard
+    lost between the two phases) are dropped — the partial contract."""
+    scored = []
+    for pos, docid in enumerate(cand):
+        if docid <= 0:
+            continue
+        owner = next((s for s, (lo, hi) in enumerate(ranges)
+                      if lo <= docid <= hi), None)
+        if owner is None or owner not in per_shard:
+            continue
+        scored.append((docid, per_shard[owner][pos]))
+    scored.sort(key=lambda h: -h[1])
+    return [(d, s) for d, s in scored[:k] if s > 0.0]
+
+
+class _ShardStats:
+    """Per-shard trailing latency window (the hedge-delay source) plus a
+    round-robin cursor for replica selection. One tiny lock per shard —
+    never held across IO."""
+
+    def __init__(self, window: int):
+        self._lock = threading.Lock()
+        self._rtts: list = []
+        self._window = window
+        self._cursor = 0
+
+    def observe(self, rtt_s: float) -> None:
+        with self._lock:
+            self._rtts.append(rtt_s)
+            if len(self._rtts) > self._window:
+                del self._rtts[: len(self._rtts) - self._window]
+
+    def p99_s(self) -> float | None:
+        with self._lock:
+            if not self._rtts:
+                return None
+            s = sorted(self._rtts)
+        return s[min(len(s) - 1, int(0.99 * (len(s) - 1)))]
+
+    def next_cursor(self, n: int) -> int:
+        with self._lock:
+            self._cursor = (self._cursor + 1) % max(n, 1)
+            return self._cursor
+
+
+class Router:
+    """The scatter-gather front door. Thread-safe; callers' threads run
+    their own requests (admission bounds concurrency) while one owned
+    pool runs the per-replica RPCs — sized so a full house of admitted
+    requests can fan out and hedge without queuing behind each other.
+    `close()` (or the context manager) shuts the pool down."""
+
+    def __init__(self, index_dir: str, topology,
+                 config: RouterConfig | None = None):
+        from ..index import format as fmt
+        from ..search.layout import shard_doc_ranges
+
+        self.index_dir = index_dir
+        self.config = cfg = config or RouterConfig()
+        self._deadline_s = (cfg.deadline_ms if cfg.deadline_ms is not None
+                            else envvars.get_float(
+                                "TPU_IR_ROUTER_DEADLINE_MS")) / 1e3
+        self._hedge_floor_s = (cfg.hedge_ms if cfg.hedge_ms is not None
+                               else envvars.get_float(
+                                   "TPU_IR_ROUTER_HEDGE_MS")) / 1e3
+        self._connect_s = (cfg.connect_ms if cfg.connect_ms is not None
+                           else envvars.get_float(
+                               "TPU_IR_ROUTER_CONNECT_MS")) / 1e3
+        self._health_ttl_s = (cfg.health_ttl_s
+                              if cfg.health_ttl_s is not None
+                              else envvars.get_float(
+                                  "TPU_IR_ROUTER_HEALTH_TTL_S"))
+        # topology: a ShardSet, a callable, or a static [shard][replica]
+        # address grid — normalized to a callable re-read per request so
+        # respawned workers (new ports) are picked up without plumbing
+        if callable(topology):
+            self._topology = topology
+        elif hasattr(topology, "addresses"):
+            self._topology = topology.addresses
+        else:
+            static = [list(row) for row in topology]
+            self._topology = lambda: static
+        grid = self._topology()
+        self.num_shards = len(grid)
+        if self.num_shards < 1:
+            raise ValueError("topology has no shards")
+        meta = fmt.IndexMetadata.load(index_dir)
+        self.num_docs = meta.num_docs
+        self._ranges = shard_doc_ranges(meta.num_docs, self.num_shards)
+        self._mapping = None  # docid -> docno, loaded lazily
+        self.admission = AdmissionController(cfg.max_concurrency,
+                                             cfg.max_queue)
+        self._breakers: dict = {}
+        self._breakers_lock = threading.Lock()
+        self._stats = [_ShardStats(cfg.rtt_window)
+                       for _ in range(self.num_shards)]
+        # sized for a full admission house fanning out AND hedging: the
+        # request threads are the callers', only RPC attempts run here
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, cfg.max_concurrency * self.num_shards * 2),
+            thread_name_prefix="tpu-ir-router")
+        self._closed = False
+        self._health_lock = threading.Lock()
+        self._health_cache: tuple | None = None  # (monotonic, payload)
+        self._health_polling = False
+        from ..obs.server import register_router
+
+        register_router(self)
+
+    # -- plumbing ----------------------------------------------------------
+
+    def _breaker(self, shard: int, replica: int) -> CircuitBreaker:
+        key = (shard, replica)
+        with self._breakers_lock:
+            b = self._breakers.get(key)
+            if b is None:
+                b = self._breakers[key] = CircuitBreaker(
+                    self.config.breaker_threshold,
+                    self.config.breaker_cooldown_s)
+            return b
+
+    def _mapping_loaded(self):
+        if self._mapping is None:
+            from ..collection import DocnoMapping
+            from ..index import format as fmt
+
+            self._mapping = DocnoMapping.load(
+                os.path.join(self.index_dir, fmt.DOCNOS))
+        return self._mapping
+
+    def _post(self, addr: str, path: str, payload: dict,
+              timeout_s: float) -> dict:
+        """One HTTP RPC attempt; raises on any failure (the caller's
+        breaker records the verdict). The socket timeout bounds connect
+        AND read, so a SIGKILLed worker costs one refused connect and a
+        hung one at most `timeout_s`."""
+        host, port = addr.rsplit(":", 1)
+        conn = http.client.HTTPConnection(
+            host, int(port), timeout=max(timeout_s, 1e-3))
+        try:
+            conn.request("POST", f"/rpc/{path}",
+                         body=json.dumps(payload),
+                         headers={"Content-Type": "application/json"})
+            resp = conn.getresponse()
+            body = resp.read()
+            if resp.status != 200:
+                raise RuntimeError(
+                    f"worker {addr} /rpc/{path} -> {resp.status}: "
+                    f"{body[:200]!r}")
+            return json.loads(body)
+        finally:
+            conn.close()
+
+    def _call_replica(self, shard: int, replica: int, addr: str,
+                      path: str, payload: dict, timeout_s: float):
+        """One replica attempt with its breaker verdict + RTT sample.
+        Returns (ok, data_or_error)."""
+        breaker = self._breaker(shard, replica)
+        allowed, is_probe = breaker.allow_device()
+        if not allowed:
+            return False, "breaker_open"
+        t0 = time.perf_counter()
+        try:
+            data = self._post(addr, path, payload, timeout_s)
+        except BaseException as e:  # noqa: BLE001 — every failure is a
+            # replica verdict here (refused, reset, timeout, 5xx, shed)
+            if breaker.record_failure(is_probe=is_probe):
+                get_registry().incr("router.breaker_opened")
+            get_registry().incr("router.replica_failed")
+            return False, repr(e)
+        rtt = time.perf_counter() - t0
+        breaker.record_success(is_probe=is_probe)
+        self._stats[shard].observe(rtt)
+        if obs.enabled():
+            get_registry().observe("router.shard_rtt", rtt)
+        return True, data
+
+    def _replica_order(self, shard: int, avail: list) -> list:
+        """Replica try-order for one request over the ADDRESSED replica
+        indices (`avail` — a grid row may carry None placeholders for
+        slots with no worker): round-robin start (spread load over
+        replicas), open-breaker replicas pushed to the end (still
+        listed — with everything open, trying one is how the half-open
+        probe path re-discovers a recovered worker)."""
+        if not avail:
+            return []
+        start = self._stats[shard].next_cursor(len(avail))
+        order = [avail[(start + i) % len(avail)]
+                 for i in range(len(avail))]
+        open_state = []
+        closed = []
+        for r in order:
+            b = self._breaker(shard, r)
+            (closed if b.state == "closed" else open_state).append(r)
+        return closed + open_state
+
+    def _hedge_delay_s(self, shard: int) -> float:
+        """THIS shard's hedge delay: max(floor, the shard's own
+        trailing p99), capped at the deadline. Per shard by design — a
+        globally-pooled delay would let one slow shard defeat the tail
+        cap on every fast one."""
+        if self._hedge_floor_s <= 0.0:
+            return float("inf")  # hedging disabled
+        p99 = self._stats[shard].p99_s()
+        return min(max(self._hedge_floor_s, p99 or 0.0),
+                   self._deadline_s)
+
+    # -- the fan-out -------------------------------------------------------
+
+    def _fanout(self, path: str, payload_of, shards: list) -> dict:
+        """Run one RPC against every shard in `shards` concurrently,
+        with failover + hedging per shard. Returns {shard: (data,
+        hedges_fired)} for the shards that answered within the deadline.
+
+        All futures are submitted from THIS (caller's) thread — pool
+        tasks never submit to the pool, so a saturated pool delays but
+        cannot deadlock."""
+        grid = self._topology()
+        deadline = time.monotonic() + self._deadline_s
+        hedge_delay = {s: self._hedge_delay_s(s) for s in shards}
+
+        class _ShardJob:
+            __slots__ = ("order", "next_i", "futs", "t0", "hedged",
+                         "result", "hedges")
+
+            def __init__(self):
+                self.order: list = []
+                self.next_i = 0
+                self.futs: list = []       # (replica, fut, is_hedge)
+                self.t0 = time.monotonic()
+                self.hedged = False
+                self.result = None
+                self.hedges = 0
+
+        jobs: dict[int, _ShardJob] = {}
+        for s in shards:
+            job = _ShardJob()
+            row = grid[s] if s < len(grid) else []
+            # order carries GRID indices of addressed replicas only —
+            # a None placeholder slot is never dialed, and breaker /
+            # health numbering stays aligned with the grid
+            avail = [i for i, a in enumerate(row) if a]
+            job.order = self._replica_order(s, avail)
+            jobs[s] = job
+            self._submit_next(s, job, grid, path, payload_of(s),
+                              deadline, is_hedge=False)
+
+        while True:
+            now = time.monotonic()
+            pending = []
+            for s, job in jobs.items():
+                if job.result is not None:
+                    continue
+                # harvest completed attempts: first success wins; a
+                # failure immediately triggers the next replica
+                # (failover), distinct from the timed hedge below
+                still = []
+                for replica, fut, is_hedge in job.futs:
+                    if not fut.done():
+                        still.append((replica, fut, is_hedge))
+                        continue
+                    ok, data = fut.result()
+                    if ok and job.result is None:
+                        job.result = data
+                        if is_hedge:
+                            get_registry().incr("router.hedge_won")
+                job.futs = still
+                if job.result is not None:
+                    continue
+                if not job.futs and job.next_i < len(job.order):
+                    # every in-flight attempt failed: fail over now
+                    self._submit_next(s, job, grid, path, payload_of(s),
+                                      deadline, is_hedge=False)
+                elif (not job.hedged and job.futs
+                        and now - job.t0 >= hedge_delay[s]
+                        and job.next_i < len(job.order)):
+                    # the primary is slow, not dead: hedge to the next
+                    # replica and let the fastest answer win
+                    job.hedged = True
+                    job.hedges += 1
+                    get_registry().incr("router.hedge_fired")
+                    self._submit_next(s, job, grid, path, payload_of(s),
+                                      deadline, is_hedge=True)
+                pending.extend(f for _, f, _ in job.futs)
+            unresolved = [s for s, j in jobs.items() if j.result is None]
+            if not unresolved or now >= deadline:
+                break
+            if not pending:
+                # nothing in flight and nothing left to try: the shard
+                # is lost for this request, no point burning the clock
+                if all(jobs[s].next_i >= len(jobs[s].order)
+                       and not jobs[s].futs for s in unresolved):
+                    break
+                continue
+            # wake on the next interesting instant: a completion, the
+            # earliest pending hedge deadline, or the shard deadline
+            next_hedge = min(
+                (jobs[s].t0 + hedge_delay[s] for s in unresolved
+                 if not jobs[s].hedged
+                 and jobs[s].next_i < len(jobs[s].order)),
+                default=deadline)
+            wait(pending, timeout=max(
+                0.001, min(next_hedge, deadline) - time.monotonic()),
+                return_when=FIRST_COMPLETED)
+        return {s: (j.result, j.hedges) for s, j in jobs.items()
+                if j.result is not None}
+
+    def _submit_next(self, shard: int, job, grid, path: str,
+                     payload: dict, deadline: float,
+                     *, is_hedge: bool) -> None:
+        if job.next_i >= len(job.order):
+            return
+        replica = job.order[job.next_i]
+        job.next_i += 1
+        addr = grid[shard][replica]
+        timeout_s = max(deadline - time.monotonic(), 1e-3)
+        # connect timeout never exceeds the attempt budget, and a dead
+        # host must fail fast enough to leave room for failover
+        timeout_s = min(timeout_s, self._deadline_s)
+        fut = self._pool.submit(self._call_replica, shard, replica,
+                                addr, path, payload, timeout_s)
+        job.futs.append((replica, fut, is_hedge))
+
+    # -- the request path --------------------------------------------------
+
+    def search(self, text: str, *, k: int = 10, scoring: str = "tfidf",
+               rerank: int | None = None,
+               return_docids: bool = True):
+        """Serve one query across the shard fleet. Returns a
+        SearchResult tagged with the routed taxonomy (level, degraded,
+        partial + shards_ok/missing_shards/hedges), or raises Overloaded
+        (router admission shed, or no shard answered at all). Phrase
+        queries score on the host against positions the workers don't
+        fan out — route them to a single-process frontend instead."""
+        if '"' in text:
+            raise ValueError("phrase queries are not routable; serve "
+                             "them through a single-process frontend")
+        t0 = time.perf_counter()
+        get_registry().incr("router.requests")
+        with obs_trace("request", scoring=scoring, router=True) as root:
+            try:
+                admit = self.admission.admit(
+                    queue_timeout_s=self._deadline_s)
+                with obs_trace("admission_wait"):
+                    admit.__enter__()
+            except Overloaded:
+                get_registry().incr("router.shed")
+                self._observe("router.request", t0)
+                raise
+            try:
+                res = self._route(text, k=k, scoring=scoring,
+                                  rerank=rerank)
+            except Overloaded:
+                # the no-shard-answered shed is a rejection like any
+                # other: it must land in router.shed and the request
+                # histogram, or the declared counter conservation
+                # (requests == served_* + shed) drifts exactly during
+                # an outage window
+                get_registry().incr("router.shed")
+                self._observe("router.request", t0)
+                raise
+            finally:
+                admit.__exit__(None, None, None)
+            root.set("partial", res.partial)
+            root.set("level", res.level)
+        if return_docids and len(res):
+            mapping = self._mapping_loaded()
+            res[:] = [(mapping.get_docid(int(d)), s) for d, s in res]
+        self._observe("router.request", t0)
+        self._count_served(res)
+        self._querylog(text, res, k=k, scoring=scoring, rerank=rerank,
+                       t0=t0)
+        return res
+
+    def _route(self, text: str, *, k: int, scoring: str,
+               rerank: int | None):
+        all_shards = list(range(self.num_shards))
+        if rerank:
+            return self._route_rerank(text, k=k, candidates=rerank,
+                                      shards=all_shards)
+        payload = {"text": text, "k": k, "scoring": scoring}
+        got = self._fanout("search", lambda s: payload, all_shards)
+        if not got:
+            get_registry().incr("router.shard_lost", self.num_shards)
+            raise Overloaded("no_healthy_shards",
+                             queue_depth=self.admission.queue_depth(),
+                             level="shed")
+        t_merge = time.perf_counter()
+        hits = merge_shard_topk(
+            [got[s][0]["hits"] for s in sorted(got)], k)
+        self._observe("router.merge", t_merge)
+        return self._assemble(hits, got, all_shards)
+
+    def _route_rerank(self, text: str, *, k: int, candidates: int,
+                      shards: list):
+        """Two-phase exact rerank (module docstring): BM25 top-C per
+        shard -> global top-C -> cosine_at on every phase-1-healthy
+        shard -> final top-k over candidate order."""
+        p1 = {"text": text, "k": candidates, "scoring": "bm25"}
+        got = self._fanout("search", lambda s: p1, shards)
+        if not got:
+            get_registry().incr("router.shard_lost", self.num_shards)
+            raise Overloaded("no_healthy_shards",
+                             queue_depth=self.admission.queue_depth(),
+                             level="shed")
+        cand_hits = merge_shard_topk(
+            [got[s][0]["hits"] for s in sorted(got)], candidates)
+        # the fixed candidate-matrix width the single-process kernel
+        # would have used: pad to C with empty slots (docid 0)
+        cand = [d for d, _ in cand_hits]
+        cand += [0] * (candidates - len(cand))
+        p2 = {"text": text, "cand": cand}
+        got2 = self._fanout("cosine_at", lambda s: p2, sorted(got))
+        if not got2:
+            get_registry().incr("router.shard_lost", len(got))
+            raise Overloaded("no_healthy_shards",
+                             queue_depth=self.admission.queue_depth(),
+                             level="shed")
+        t_merge = time.perf_counter()
+        hits = merge_candidate_scores(
+            cand, {s: d["scores"] for s, (d, _) in got2.items()},
+            self._ranges, k)
+        self._observe("router.merge", t_merge)
+        # a shard must survive BOTH phases to count as contributing
+        merged_meta = {s: got[s] for s in got2}
+        res = self._assemble(hits, merged_meta, shards)
+        res.hedges += sum(h for _, h in got2.values())
+        return res
+
+    def _assemble(self, hits: list, got: dict, shards: list):
+        from ..search.scorer import SearchResult
+
+        res = SearchResult((int(d), float(s)) for d, s in hits)
+        ok = tuple(sorted(got))
+        missing = tuple(s for s in shards if s not in got)
+        # trailing shards past num_docs own an empty range — their
+        # absence loses no documents and must not tag the response
+        missing = tuple(s for s in missing
+                        if self._ranges[s][0] <= self._ranges[s][1])
+        res.shards_ok = ok
+        res.missing_shards = missing
+        res.partial = bool(missing)
+        if missing:
+            get_registry().incr("router.shard_lost", len(missing))
+        res.hedges = sum(h for _, h in got.values())
+        res.degraded = any(d.get("degraded") for d, _ in got.values())
+        levels = [d.get("level", "full") for d, _ in got.values()]
+        res.level = max(levels, key=lambda lv: _LEVEL_ORDER.index(lv)
+                        if lv in _LEVEL_ORDER else len(_LEVEL_ORDER))
+        return res
+
+    # -- accounting / introspection ----------------------------------------
+
+    @staticmethod
+    def classify(res) -> str:
+        """The routed-response taxonomy (exactly one of): partial beats
+        degraded beats full; rejections raise and never reach here."""
+        if res.partial:
+            return "partial"
+        if res.degraded or res.level != "full":
+            return "degraded"
+        return "full"
+
+    def _count_served(self, res) -> None:
+        get_registry().incr(f"router.served_{self.classify(res)}")
+
+    @staticmethod
+    def _observe(name: str, t0: float) -> None:
+        if obs.enabled():
+            get_registry().observe(name, time.perf_counter() - t0)
+
+    def _querylog(self, text: str, res, *, k: int, scoring: str,
+                  rerank: int | None, t0: float) -> None:
+        from ..obs import querylog
+
+        entry = {
+            "router": True,
+            "query_hash": querylog.query_hash(text.split()),
+            "k": k, "scoring": scoring, "rerank": rerank,
+            "level": res.level, "degraded": bool(res.degraded),
+            "partial": bool(res.partial),
+            "shards_ok": list(res.shards_ok),
+            "missing_shards": list(res.missing_shards),
+            "hedges": int(res.hedges),
+            "total_ms": round((time.perf_counter() - t0) * 1e3, 3),
+        }
+        if not querylog.redacted():
+            entry["text"] = text
+        querylog.record(entry)
+
+    def health_summary(self) -> dict:
+        """The aggregated shard-health view /healthz serves (TTL-cached:
+        one poll sweep per TPU_IR_ROUTER_HEALTH_TTL_S, not per scrape):
+        per shard, each replica's liveness + breaker + the worker's own
+        reported identity (shard/replica/generation/doc_range) and
+        control-plane state."""
+        with self._health_lock:
+            cached = self._health_cache
+            if (cached is not None
+                    and time.monotonic() - cached[0] < self._health_ttl_s):
+                return cached[1]
+            if self._health_polling:
+                # re-entrancy guard: when router and workers share one
+                # process (in-process workers in tests), a poll sweep's
+                # GET /healthz lands back here through the worker's own
+                # handler — answer shallow instead of sweeping forever
+                return {"num_shards": self.num_shards,
+                        "in_progress": True}
+            self._health_polling = True
+        try:
+            return self._health_sweep()
+        finally:
+            with self._health_lock:
+                self._health_polling = False
+
+    def _health_sweep(self) -> dict:
+        grid = self._topology()
+        shards = []
+        for s in range(self.num_shards):
+            row = grid[s] if s < len(grid) else []
+            replicas = []
+            for r, addr in enumerate(row):
+                item = {"replica": r, "addr": addr,
+                        "breaker": self._breaker(s, r).snapshot()}
+                item.update(self._poll_worker_health(addr))
+                replicas.append(item)
+            p99 = self._stats[s].p99_s()
+            hedge = self._hedge_delay_s(s)
+            shards.append({
+                "shard": s,
+                "doc_range": list(self._ranges[s]),
+                "rtt_p99_ms": (round(p99 * 1e3, 3)
+                               if p99 is not None else None),
+                "hedge_delay_ms": (round(hedge * 1e3, 3)
+                                   if hedge != float("inf") else None),
+                "replicas": replicas,
+            })
+        payload = {"num_shards": self.num_shards,
+                   "hedge_floor_ms": round(self._hedge_floor_s * 1e3, 3),
+                   "deadline_ms": round(self._deadline_s * 1e3, 3),
+                   "shards": shards}
+        with self._health_lock:
+            self._health_cache = (time.monotonic(), payload)
+        return payload
+
+    def _poll_worker_health(self, addr: str | None) -> dict:
+        if not addr:
+            return {"up": False, "error": "no address"}
+        host, port = addr.rsplit(":", 1)
+        try:
+            conn = http.client.HTTPConnection(host, int(port),
+                                              timeout=self._connect_s)
+            try:
+                conn.request("GET", "/healthz")
+                resp = conn.getresponse()
+                body = json.loads(resp.read())
+            finally:
+                conn.close()
+        except Exception as e:  # noqa: BLE001 — down IS the answer
+            return {"up": False, "error": repr(e)}
+        return {"up": True,
+                "worker": body.get("worker"),
+                "ladder": body.get("ladder"),
+                "breaker_worker": body.get("breaker"),
+                "queue_depth": body.get("queue_depth")}
+
+    def stats(self) -> dict:
+        reg = get_registry()
+        return {name: reg.get(name)
+                for name in reg.counter_names()
+                if name.startswith("router.")}
+
+    def close(self) -> None:
+        if not self._closed:
+            self._closed = True
+            self._pool.shutdown(wait=True, cancel_futures=True)
+
+    def __enter__(self) -> "Router":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

@@ -1,0 +1,420 @@
+"""Shard workers for the scatter-gather serving tier (ISSUE 10).
+
+One logical index, N doc shards, R replicas per shard: every WORKER is a
+full serving process — PR-1/2 Scorer + ServingFrontend (admission,
+degradation ladder, circuit breaker, deadline fallback) — restricted to
+its shard's doc range (Scorer.load(doc_range=...): the layout keeps full
+geometry, out-of-range postings are tf-zeroed, so in-range docs score
+BIT-identically to the single-process scorer — the router's exact-merge
+contract). The worker's serving surface is the PR-4 observability server
+(obs/server.py) grown an RPC route:
+
+  POST /rpc/search     {"text", "k", "scoring"}        -> local top-k
+                       (raw docids + scores; level/degraded tagged)
+  POST /rpc/cosine_at  {"text", "cand": [docids]}      -> stage-2 cosine
+                       scores at the router's merged candidates
+  GET  /healthz        the PR-4 payload + worker identity (shard id,
+                       replica, doc range, spawn generation) — the
+                       router's failover/aggregation signal
+
+Two deployment forms share all of this code:
+
+- **in-process workers** (`serve_worker()`): scorer + frontend + server
+  in the calling process — the form the router unit tests and property
+  suite drive (no subprocess cost, full HTTP path);
+- **subprocess workers** (`ShardSet`): one `python -m
+  tpu_ir.serving.shardset <config.json>` process per (shard, replica),
+  ready-file handshake, SIGKILL-able for chaos, respawnable with a
+  bumped generation. A worker watches its stdin pipe and exits when the
+  parent dies — no orphan serving processes.
+
+The reference's only distribution was HDFS reads under one JVM
+(PAPER.md §0); this is the "millions of users" fan-out topology ROADMAP
+item 4 names, built from the fault machinery PRs 1-9 already proved.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+logger = logging.getLogger(__name__)
+
+READY_POLL_S = 0.05
+
+
+def worker_rpc_handlers(frontend, scorer) -> dict:
+    """The worker's RPC surface over one (doc-range-restricted) scorer.
+    Handlers run on the HTTP server's request threads; concurrency is
+    bounded by the frontend's admission control, errors surface as the
+    server's 503 (Overloaded) / 500 (anything else) contract."""
+
+    def search(payload: dict) -> dict:
+        res = frontend.search(
+            str(payload["text"]),
+            k=int(payload.get("k", 10)),
+            scoring=str(payload.get("scoring", "tfidf")),
+            rerank=None,
+            return_docids=False)
+        return {
+            "hits": [[int(d), float(s)] for d, s in res],
+            "level": res.level,
+            "degraded": bool(res.degraded),
+        }
+
+    def cosine_at(payload: dict) -> dict:
+        scores = scorer.cosine_scores_at(
+            [str(payload["text"])],
+            [int(c) for c in payload.get("cand", [])])
+        return {"scores": [float(s) for s in scores[0]]}
+
+    return {"search": search, "cosine_at": cosine_at}
+
+
+def serve_worker(index_dir: str, shard: int, num_shards: int, *,
+                 layout: str = "sparse", port: int = 0,
+                 replica: int = 0, generation: int = 0,
+                 deadline_s: float | None = None,
+                 max_concurrency: int = 4, max_queue: int = 16,
+                 warm: bool = True):
+    """Load a shard-restricted scorer, wrap it in a ServingFrontend, and
+    serve it over an RPC-enabled obs server. Returns (server, frontend,
+    scorer) — the caller owns `server.stop()`. This is the whole worker;
+    the subprocess main below is just config plumbing around it."""
+    from ..search.scorer import Scorer
+    from ..obs.server import MetricsServer
+    from .frontend import ServingConfig, ServingFrontend
+
+    lo, hi = shard_doc_ranges_for(index_dir, shard, num_shards)
+    scorer = Scorer.load(index_dir, layout=layout, deadline_s=deadline_s,
+                         doc_range=(lo, hi))
+    frontend = ServingFrontend(scorer, ServingConfig(
+        max_concurrency=max_concurrency, max_queue=max_queue,
+        deadline_s=deadline_s))
+    info = {"worker": {
+        "shard": shard, "replica": replica, "num_shards": num_shards,
+        "doc_range": [lo, hi], "generation": generation,
+        "pid": os.getpid(), "layout": scorer.layout,
+    }}
+    server = MetricsServer(
+        port=port, rpc_handlers=worker_rpc_handlers(frontend, scorer),
+        extra_health=lambda: info).start()
+    if warm:
+        _warm_worker(scorer)
+    return server, frontend, scorer
+
+
+def shard_doc_ranges_for(index_dir: str, shard: int,
+                         num_shards: int) -> tuple:
+    """This shard's (lo, hi) docid range from the index metadata — the
+    partition every worker and the router derive identically."""
+    from ..index import format as fmt
+    from ..search.layout import shard_doc_ranges
+
+    meta = fmt.IndexMetadata.load(index_dir)
+    return shard_doc_ranges(meta.num_docs, num_shards)[shard]
+
+
+def _warm_worker(scorer, ks=(10,), rerank_ks=(25,)) -> None:
+    """Warm the compile shapes real traffic mints (1-3 term queries ->
+    pow2 widths 1/2/4; k is STATIC, so each serving depth in `ks` and
+    each rerank candidate count in `rerank_ks` is its own program) for
+    both scoring models, so the first routed request never eats an XLA
+    compile inside its shard deadline. The persistent compilation cache
+    (Scorer.load enables it) makes this near-free for every worker
+    after the first."""
+    import numpy as np
+
+    all_terms = list(scorer.vocab.terms)
+    if not all_terms:
+        return
+    # the MaxScore schedule compiles DIFFERENT programs for hot-free vs
+    # hot-bearing query blocks — warm both: prefix texts over cold
+    # terms, plus the same widths seeded with a hot-strip term when the
+    # layout has one
+    def prefixes(ts):
+        return [" ".join(ts[:n]) for n in range(1, len(ts) + 1)]
+
+    texts = prefixes(all_terms[:3])
+    if scorer.layout == "sparse":
+        hot_ids = np.nonzero(scorer._hot_rank_host() >= 0)[0]
+        if len(hot_ids):
+            texts += prefixes([scorer.vocab.term(int(hot_ids[0]))]
+                              + all_terms[:2])
+    for scoring in ("tfidf", "bm25"):
+        for txt in texts:
+            for k in ks:
+                scorer.search_batch([txt], k=int(k),
+                                    scoring=scoring, return_docids=False)
+    for c in rerank_ks:
+        # the two-phase rerank's shapes: stage-1 BM25 top-C plus the
+        # [1, C] cosine_at gather the router's phase 2 dispatches
+        for txt in texts:
+            scorer.search_batch([txt], k=int(c), scoring="bm25",
+                                return_docids=False)
+            scorer.cosine_scores_at([txt], [0] * int(c))
+
+
+# -- subprocess worker ------------------------------------------------------
+
+
+def _watch_parent() -> None:
+    """Exit when the parent closes our stdin pipe (it died or stopped
+    us): a SIGKILLed router must never leave orphan workers serving."""
+
+    def run():
+        try:
+            while sys.stdin.buffer.read(1):
+                pass
+        except Exception:  # noqa: BLE001 — any read failure means gone
+            pass
+        os._exit(0)
+
+    threading.Thread(target=run, name="tpu-ir-worker-parent-watch",
+                     daemon=True).start()
+
+
+def worker_main(config_path: str) -> int:
+    """`python -m tpu_ir.serving.shardset <config.json>`: the subprocess
+    entry. Serves until SIGTERM / parent death; writes the ready file
+    (port + pid, atomic rename) only after the warm-up, so a parent that
+    saw the file can fan out immediately."""
+    with open(config_path, encoding="utf-8") as f:
+        cfg = json.load(f)
+    _watch_parent()
+    server, _frontend, _scorer = serve_worker(
+        cfg["index_dir"], int(cfg["shard"]), int(cfg["num_shards"]),
+        layout=cfg.get("layout", "sparse"), port=int(cfg.get("port", 0)),
+        replica=int(cfg.get("replica", 0)),
+        generation=int(cfg.get("generation", 0)),
+        deadline_s=cfg.get("deadline_s"),
+        max_concurrency=int(cfg.get("max_concurrency", 4)),
+        max_queue=int(cfg.get("max_queue", 16)),
+        warm=bool(cfg.get("warm", True)))
+    ready = {"port": server.port, "pid": os.getpid(),
+             "shard": cfg["shard"], "replica": cfg.get("replica", 0),
+             "generation": cfg.get("generation", 0)}
+    tmp = cfg["ready_path"] + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(ready, f)
+    os.replace(tmp, cfg["ready_path"])
+
+    stop = threading.Event()
+    signal.signal(signal.SIGTERM, lambda *_: stop.set())
+    try:
+        stop.wait()
+    finally:
+        server.stop()
+        # deadline-abandoned dispatch threads may still sit in XLA;
+        # drain them so interpreter teardown doesn't race native code
+        from .. import faults
+
+        faults.drain_abandoned(timeout_s=5.0)
+    return 0
+
+
+class WorkerHandle:
+    """One (shard, replica) subprocess: its Popen, address, generation.
+    `alive` distinguishes a serving worker from a SIGKILLed corpse whose
+    slot awaits respawn."""
+
+    __slots__ = ("shard", "replica", "generation", "proc", "host",
+                 "port", "pid")
+
+    def __init__(self, shard: int, replica: int, generation: int,
+                 proc, host: str, port: int, pid: int):
+        self.shard = shard
+        self.replica = replica
+        self.generation = generation
+        self.proc = proc
+        self.host = host
+        self.port = port
+        self.pid = pid
+
+    @property
+    def addr(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    @property
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class ShardSet:
+    """Spawn + manage the S x R worker grid as subprocesses.
+
+    The grid is mutable under one lock: the chaos soak SIGKILLs replicas
+    (`kill`) and brings them back (`respawn`, generation bumped) while
+    the router keeps reading `addresses()` — a killed slot keeps its
+    stale address until respawn (the router's breaker/deadline machinery
+    is what handles the corpse, exactly as it would a remote host that
+    dropped off the network)."""
+
+    def __init__(self, index_dir: str, *, shards: int, replicas: int = 1,
+                 layout: str = "sparse", deadline_s: float | None = None,
+                 rundir: str | None = None, warm: bool = True,
+                 max_concurrency: int = 4, max_queue: int = 16,
+                 spawn_timeout_s: float = 120.0):
+        if shards < 1 or replicas < 1:
+            raise ValueError("shards and replicas must be >= 1")
+        self.index_dir = index_dir
+        self.shards = shards
+        self.replicas = replicas
+        self.layout = layout
+        self.deadline_s = deadline_s
+        self.warm = warm
+        self.max_concurrency = max_concurrency
+        self.max_queue = max_queue
+        self.spawn_timeout_s = spawn_timeout_s
+        import tempfile
+
+        self.rundir = rundir or tempfile.mkdtemp(prefix="tpu-ir-shardset-")
+        os.makedirs(self.rundir, exist_ok=True)
+        self._lock = threading.Lock()
+        self._grid: list[list[WorkerHandle | None]] = [
+            [None] * replicas for _ in range(shards)]
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> "ShardSet":
+        """Spawn every worker CONCURRENTLY (each pays an interpreter +
+        jax import + index load; serial spawn would multiply that by
+        S*R), then wait for all ready files."""
+        procs = [(s, r, self._spawn(s, r, generation=0))
+                 for s in range(self.shards)
+                 for r in range(self.replicas)]
+        deadline = time.monotonic() + self.spawn_timeout_s
+        for s, r, (proc, ready_path) in procs:
+            handle = self._wait_ready(s, r, 0, proc, ready_path, deadline)
+            with self._lock:
+                self._grid[s][r] = handle
+        return self
+
+    def _cfg_paths(self, shard: int, replica: int, generation: int):
+        base = f"worker-{shard}-{replica}-g{generation}"
+        return (os.path.join(self.rundir, base + ".json"),
+                os.path.join(self.rundir, base + ".ready"))
+
+    def _spawn(self, shard: int, replica: int, *, generation: int):
+        cfg_path, ready_path = self._cfg_paths(shard, replica, generation)
+        cfg = {
+            "index_dir": self.index_dir, "shard": shard,
+            "num_shards": self.shards, "replica": replica,
+            "generation": generation, "layout": self.layout,
+            "deadline_s": self.deadline_s, "warm": self.warm,
+            "max_concurrency": self.max_concurrency,
+            "max_queue": self.max_queue, "port": 0,
+            "ready_path": ready_path,
+        }
+        with open(cfg_path, "w", encoding="utf-8") as f:
+            json.dump(cfg, f)
+        log = open(os.path.join(
+            self.rundir, f"worker-{shard}-{replica}.log"), "ab")
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "tpu_ir.serving.shardset",
+                 cfg_path],
+                stdin=subprocess.PIPE, stdout=log, stderr=log,
+                cwd=os.getcwd())
+        finally:
+            log.close()  # the child holds its own descriptor
+        return proc, ready_path
+
+    def _wait_ready(self, shard: int, replica: int, generation: int,
+                    proc, ready_path: str, deadline: float):
+        while time.monotonic() < deadline:
+            if os.path.exists(ready_path):
+                with open(ready_path, encoding="utf-8") as f:
+                    ready = json.load(f)
+                return WorkerHandle(shard, replica, generation, proc,
+                                    "127.0.0.1", int(ready["port"]),
+                                    int(ready["pid"]))
+            if proc.poll() is not None:
+                raise RuntimeError(
+                    f"worker {shard}/{replica} died during startup "
+                    f"(rc={proc.returncode}); see "
+                    f"{self.rundir}/worker-{shard}-{replica}.log")
+            time.sleep(READY_POLL_S)
+        proc.kill()
+        raise TimeoutError(
+            f"worker {shard}/{replica} not ready within "
+            f"{self.spawn_timeout_s}s")
+
+    def kill(self, shard: int, replica: int, sig=signal.SIGKILL) -> int:
+        """SIGKILL one replica (the chaos primitive). Returns the pid it
+        killed. The slot keeps its handle (and stale address) — exactly
+        what a crashed remote host looks like to the router."""
+        with self._lock:
+            h = self._grid[shard][replica]
+        if h is None or h.proc is None:
+            raise RuntimeError(f"no live worker at {shard}/{replica}")
+        h.proc.send_signal(sig)
+        h.proc.wait(timeout=30.0)
+        return h.pid
+
+    def respawn(self, shard: int, replica: int) -> WorkerHandle:
+        """Bring a killed replica back with a bumped generation (fresh
+        process, fresh port). The router notices via addresses()."""
+        with self._lock:
+            old = self._grid[shard][replica]
+            generation = (old.generation + 1) if old else 0
+        proc, ready_path = self._spawn(shard, replica,
+                                       generation=generation)
+        handle = self._wait_ready(
+            shard, replica, generation, proc, ready_path,
+            time.monotonic() + self.spawn_timeout_s)
+        with self._lock:
+            self._grid[shard][replica] = handle
+        from ..obs import get_registry
+
+        get_registry().incr("router.worker_respawn")
+        return handle
+
+    def addresses(self) -> list:
+        """[shard][replica] -> "host:port" — the router's topology view
+        (re-read per request, so respawned workers are picked up)."""
+        with self._lock:
+            return [[h.addr if h else None for h in row]
+                    for row in self._grid]
+
+    def handles(self) -> list:
+        with self._lock:
+            return [list(row) for row in self._grid]
+
+    def stop(self) -> None:
+        """Terminate every worker (idempotent; corpses are fine)."""
+        with self._lock:
+            handles = [h for row in self._grid for h in row if h]
+        for h in handles:
+            if h.proc is not None and h.proc.poll() is None:
+                h.proc.terminate()
+        deadline = time.monotonic() + 15.0
+        for h in handles:
+            if h.proc is None:
+                continue
+            try:
+                h.proc.wait(timeout=max(0.1, deadline - time.monotonic()))
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                h.proc.wait(timeout=10.0)
+            if h.proc.stdin:
+                try:
+                    h.proc.stdin.close()
+                except OSError:
+                    pass
+
+    def __enter__(self) -> "ShardSet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+if __name__ == "__main__":  # pragma: no cover — subprocess entry
+    sys.exit(worker_main(sys.argv[1]))

@@ -23,6 +23,7 @@ Used by tests/test_serving.py (fast + slow variants), the
 
 from __future__ import annotations
 
+import logging
 import random
 import threading
 import time
@@ -32,6 +33,8 @@ from .. import faults, obs
 from ..utils.report import recovery_counters
 from .admission import Overloaded
 from .frontend import ServingConfig, ServingFrontend
+
+logger = logging.getLogger(__name__)
 
 # default fault plan for chaos runs: occasional hangs long enough to trip
 # any sane deadline, plus sporadic device losses — both sites fire on the
@@ -294,6 +297,321 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
         # they already ran; anything escaping earlier (malformed fault
         # spec, frontend init, report assembly) marks the job failed
         # instead of leaving a ghost "running" soak
+        job.finish(error=repr(e))
+        raise
+
+
+def run_distributed_soak(index_dir: str, *, shards: int = 2,
+                         replicas: int = 2, threads: int = 8,
+                         queries: int = 160, seed: int = 0,
+                         layout: str = "sparse",
+                         worker_deadline_s: float = 1.0,
+                         router_config=None,
+                         kill_replica_at: float = 0.3,
+                         kill_shard_at: float = 0.55,
+                         respawn_at: float = 0.75,
+                         chaos: bool = True,
+                         timeout_s: float = 240.0,
+                         pacing_s: float = 0.002,
+                         rundir: str | None = None,
+                         flight_dir: str | None = None,
+                         recovery_probes: int = 16) -> dict:
+    """The scatter-gather chaos soak (ISSUE 10): mixed traffic through a
+    REAL multi-process topology — S doc shards x R replica workers
+    behind a Router — while a chaos controller SIGKILLs a replica, then
+    a WHOLE shard, then brings everything back. The PR-2 invariants,
+    end to end across process boundaries:
+
+    - conservation: shed + served == submitted, zero unstructured
+      errors, zero deadlocks;
+    - taxonomy: every response is exactly ONE of full / degraded /
+      partial (rejections raise Overloaded and count as shed);
+    - full responses are BIT-identical to a single-process serial
+      reference (docnos, float scores, tie order);
+    - partial non-degraded responses are a PINNED-CORRECT subset: equal
+      to the exact merge of the healthy shards' ranges, computed from
+      an independent full-ranking oracle (not from the workers);
+    - with a whole shard dead, partial responses appear
+      (partial_fraction > 0) and RECOVERY closes the gap: after
+      respawn, a serial probe run must come back all-full.
+
+    Chaos schedule (fractions of completed requests): `kill_replica_at`
+    SIGKILLs replica 1 of shard 0 (failover must hide it),
+    `kill_shard_at` SIGKILLs every replica of the LAST shard (partial
+    results must appear), `respawn_at` restarts all corpses. The
+    returned report carries the per-class counts and check results; the
+    caller asserts."""
+    from ..obs import get_registry
+    from ..search.layout import shard_doc_ranges
+    from ..search.scorer import Scorer
+    from .router import Router, RouterConfig
+    from .shardset import ShardSet
+
+    if faults.active() is not None:
+        raise RuntimeError("a fault plan is already installed")
+    ref_scorer = Scorer.load(index_dir, layout=layout)
+    reqs = make_queries(ref_scorer, queries, seed=seed)
+    num_docs = ref_scorer.meta.num_docs
+    ranges = shard_doc_ranges(num_docs, shards)
+
+    job = obs.start_job(
+        "soak", f"routed-soak-{queries}q-{shards}s{replicas}r",
+        phases=("reference", "serve", "recovery"),
+        config={"threads": threads, "queries": queries, "seed": seed,
+                "shards": shards, "replicas": replicas, "chaos": chaos})
+    try:
+        # -- oracles (single-process, before any worker exists) -----------
+        distinct = list({_req_key(r): r for r in reqs}.values())
+        obs.report_progress("reference", total=len(distinct))
+        reference: dict = {}
+        full_rank: dict = {}
+        oracle_k = min(num_docs, 1000)
+        for r in distinct:
+            key = _req_key(r)
+            res = ref_scorer.search_batch(
+                [r["text"]], k=r["k"], scoring=r["scoring"],
+                rerank=r["rerank"])[0]
+            if res.degraded:
+                raise RuntimeError("reference run degraded — clear the "
+                                   "fault plan before the soak")
+            reference[key] = list(res)
+            if not r["rerank"]:
+                # the independent partial-subset oracle: the FULL
+                # positive ranking by docid, filtered per healthy-shard
+                # set at check time (per-doc scores are partition-
+                # independent, so a filter of the full ranking IS the
+                # healthy shards' exact merge)
+                full_rank[key] = list(ref_scorer.search_batch(
+                    [r["text"]], k=oracle_k, scoring=r["scoring"],
+                    return_docids=False)[0])
+            obs.report_progress("reference", advance=1)
+
+        reg = get_registry()
+        counters_before = {n: reg.get(n) for n in reg.counter_names()
+                           if n.startswith("router.")}
+        hist_before = reg.hist_state()
+        obs.report_progress("serve", total=len(reqs))
+        results: list = [None] * len(reqs)
+        completed = threading.Event()
+        progress = [0]
+        progress_lock = threading.Lock()
+
+        with ShardSet(index_dir, shards=shards, replicas=replicas,
+                      layout=layout, deadline_s=worker_deadline_s,
+                      rundir=rundir) as shardset:
+            # the soak default: a generous per-shard deadline. Dead
+            # workers fail at connection-refused speed regardless (the
+            # failover/partial paths never wait it out), so a large
+            # budget only spares slow-but-alive workers on a contended
+            # CI box — it does not slow loss detection.
+            router = Router(index_dir, shardset,
+                            router_config
+                            or RouterConfig(deadline_ms=3000.0))
+            try:
+                # -- chaos controller ---------------------------------
+                killed: list = []
+
+                def chaos_controller():
+                    fired = {"replica": False, "shard": False,
+                             "respawn": False}
+                    while not completed.is_set():
+                        with progress_lock:
+                            frac = progress[0] / max(len(reqs), 1)
+                        try:
+                            if chaos and not fired["replica"] \
+                                    and frac >= kill_replica_at \
+                                    and replicas > 1:
+                                fired["replica"] = True
+                                shardset.kill(0, 1)
+                                killed.append((0, 1))
+                            if chaos and not fired["shard"] \
+                                    and frac >= kill_shard_at:
+                                fired["shard"] = True
+                                for rr in range(replicas):
+                                    if (shards - 1, rr) not in killed:
+                                        shardset.kill(shards - 1, rr)
+                                        killed.append((shards - 1, rr))
+                            if chaos and not fired["respawn"] \
+                                    and frac >= respawn_at:
+                                fired["respawn"] = True
+                                for s_, r_ in list(killed):
+                                    shardset.respawn(s_, r_)
+                                killed.clear()
+                        except Exception:  # noqa: BLE001 — chaos must
+                            logger.exception("chaos controller")  # not
+                        completed.wait(0.02)  # kill the soak itself
+                    # whatever is still dead comes back for recovery
+                    for s_, r_ in list(killed):
+                        try:
+                            shardset.respawn(s_, r_)
+                        except Exception:  # noqa: BLE001
+                            logger.exception("post-soak respawn")
+
+                ctrl = threading.Thread(target=chaos_controller,
+                                        name="soak-chaos", daemon=True)
+                ctrl.start()
+
+                def worker(i: int, r: dict) -> None:
+                    if pacing_s:
+                        time.sleep(random.Random(
+                            seed * 1_000_003 + i).random()
+                            * pacing_s * threads)
+                    try:
+                        results[i] = ("ok", router.search(
+                            r["text"], k=r["k"], scoring=r["scoring"],
+                            rerank=r["rerank"]))
+                    except Overloaded as e:
+                        results[i] = ("shed", e)
+                    except BaseException as e:  # structured or nothing
+                        results[i] = ("error", e)
+                    with progress_lock:
+                        progress[0] += 1
+                    job.report("serve", advance=1)
+
+                t0 = time.perf_counter()
+                pool = ThreadPoolExecutor(
+                    max_workers=threads,
+                    thread_name_prefix="routed-soak")
+                try:
+                    futs = [pool.submit(worker, i, r)
+                            for i, r in enumerate(reqs)]
+                    done, not_done = wait(futs, timeout=timeout_s)
+                    for f in not_done:
+                        f.cancel()
+                finally:
+                    completed.set()
+                    pool.shutdown(wait=len(results) == len(
+                        [o for o in results if o is not None]),
+                        cancel_futures=True)
+                    ctrl.join(timeout=120.0)
+                wall_s = time.perf_counter() - t0
+
+                # -- recovery probes (topology healthy again) ---------
+                # breakers opened during chaos need a success per
+                # replica to close, and respawned workers may still be
+                # warming: retry each probe briefly instead of judging
+                # recovery on the first post-chaos instant
+                obs.report_progress("recovery", total=recovery_probes)
+                recovery_full = 0
+                probe_reqs = reqs[:recovery_probes]
+                recovery_deadline = time.monotonic() + 60.0
+                for r in probe_reqs:
+                    while True:
+                        try:
+                            pres = router.search(r["text"], k=r["k"],
+                                                 scoring=r["scoring"],
+                                                 rerank=r["rerank"])
+                            if Router.classify(pres) == "full" and \
+                                    list(pres) == reference[_req_key(r)]:
+                                recovery_full += 1
+                                break
+                        except Overloaded:
+                            pass
+                        if time.monotonic() >= recovery_deadline:
+                            break
+                        time.sleep(0.2)
+                    obs.report_progress("recovery", advance=1)
+            finally:
+                router.close()
+
+        # -- invariant evaluation -------------------------------------
+        outcomes = list(results)
+        deadlocked = sum(1 for o in outcomes if o is None)
+        served = shed = errors = 0
+        classes = {"full": 0, "degraded": 0, "partial": 0}
+        full_mismatches = partial_mismatches = 0
+        partial_checked = tagged_divergent = 0
+        hedged_requests = 0
+        error_reprs: list = []
+        for out, r in zip(outcomes, reqs):
+            if out is None:
+                continue
+            state, payload = out
+            if state == "shed":
+                shed += 1
+                continue
+            if state == "error":
+                errors += 1
+                if len(error_reprs) < 5:
+                    error_reprs.append(repr(payload))
+                continue
+            served += 1
+            res = payload
+            cls = Router.classify(res)
+            classes[cls] += 1
+            hedged_requests += bool(res.hedges)
+            key = _req_key(r)
+            if cls == "full":
+                if list(res) != reference[key]:
+                    full_mismatches += 1
+            elif cls == "partial" and not res.degraded \
+                    and res.level == "full" and not r["rerank"]:
+                # the pinned-correct-subset check: filter the full
+                # oracle ranking to the shards that contributed
+                ok_ranges = [ranges[s] for s in res.shards_ok]
+                expect = [(d, s) for d, s in full_rank[key]
+                          if any(lo <= d <= hi
+                                 for lo, hi in ok_ranges)][: r["k"]]
+                mapping = ref_scorer.mapping
+                expect = [(mapping.get_docid(int(d)), float(s))
+                          for d, s in expect]
+                partial_checked += 1
+                if list(res) != expect:
+                    partial_mismatches += 1
+            elif list(res) != reference[key]:
+                tagged_divergent += 1
+
+        router_delta = {
+            n: reg.get(n) - counters_before.get(n, 0)
+            for n in reg.counter_names() if n.startswith("router.")}
+        report = {
+            "submitted": len(reqs),
+            "served": served,
+            "shed": shed,
+            "errors": errors,
+            "error_samples": error_reprs,
+            "deadlocked": deadlocked,
+            "classes": classes,
+            "partial_fraction": round(
+                classes["partial"] / max(served, 1), 4),
+            "full_mismatches": full_mismatches,
+            "partial_checked": partial_checked,
+            "partial_mismatches": partial_mismatches,
+            "tagged_divergent": tagged_divergent,
+            "hedged_requests": hedged_requests,
+            "recovery_probes": len(probe_reqs),
+            "recovery_full": recovery_full,
+            "wall_s": round(wall_s, 3),
+            "shards": shards,
+            "replicas": replicas,
+            "chaos": chaos,
+            "router": router_delta,
+            # routed-stage percentiles for THIS run (registry delta):
+            # end-to-end routed requests, per-shard worker RTTs, and
+            # the host-side exact-merge cost
+            "latency": reg.delta_summary(
+                hist_before, always=("router.request", "router.shard_rtt",
+                                     "router.merge")),
+        }
+        breach = (errors or deadlocked or full_mismatches
+                  or partial_mismatches
+                  or served + shed != len(reqs))
+        if breach:
+            report["flight_record"] = obs.flight_dump(
+                "routed_soak_invariant_breach",
+                extra={k: report[k] for k in
+                       ("submitted", "served", "shed", "errors",
+                        "deadlocked", "full_mismatches",
+                        "partial_mismatches", "error_samples")},
+                out_dir=flight_dir, force=True)
+            job.finish(error=f"invariant breach: errors={errors} "
+                             f"deadlocked={deadlocked} "
+                             f"full_mismatches={full_mismatches} "
+                             f"partial_mismatches={partial_mismatches}")
+        else:
+            job.finish()
+        return report
+    except BaseException as e:
         job.finish(error=repr(e))
         raise
 

@@ -134,7 +134,9 @@ _declare("TPU_IR_BATCH_WAIT_MS", "float", 0.0,
          "wait)", "§16", minimum=0.0)
 _declare("TPU_IR_BATCH_LADDER", "str", "1,4,16,64",
          "compiled batch-size rungs the coalescer pads to (bounds "
-         "recompilation; largest rung caps batch occupancy)", "§16")
+         "recompilation; largest rung caps batch occupancy). When UNSET, "
+         "CPU-class backends drop rungs above 16 — padded rows cost real "
+         "compute there; setting the variable overrides the probe", "§16")
 _declare("TPU_IR_BATCH_WIDTH", "int", 8,
          "query-width floor (padded term slots) for coalesced batches — "
          "one precompilable width; longer queries bump to their pow2 "
@@ -162,6 +164,20 @@ _declare("TPU_IR_SLOW_QUERY_MS", "float", 0.0,
          "requests at/above this latency are force-captured (explain + "
          "span tree + flight record); 0 disables the trap", "§15",
          minimum=0.0)
+_declare("TPU_IR_ROUTER_DEADLINE_MS", "float", 500.0,
+         "per-shard deadline for one routed request: a shard that "
+         "answers on no replica within it ships the response partial",
+         "§17", minimum=1.0)
+_declare("TPU_IR_ROUTER_HEDGE_MS", "float", 25.0,
+         "hedge-delay floor: a second replica is tried once the primary "
+         "exceeds max(this, the shard's trailing p99); 0 disables "
+         "hedging", "§17", minimum=0.0)
+_declare("TPU_IR_ROUTER_CONNECT_MS", "float", 250.0,
+         "TCP connect timeout for one shard-worker RPC attempt", "§17",
+         minimum=1.0)
+_declare("TPU_IR_ROUTER_HEALTH_TTL_S", "float", 2.0,
+         "max age of cached per-worker /healthz payloads in the "
+         "router's aggregated health view", "§17", minimum=0.0)
 
 
 def _raw(name: str) -> str | None:
@@ -243,6 +259,14 @@ def get_choice(name: str) -> str:
     if out not in decl.choices:
         raise _bad(name, v, f"one of {decl.choices}")
     return out
+
+
+def is_set(name: str) -> bool:
+    """Whether the operator explicitly set the (declared) variable to a
+    non-empty value — the hook adaptive defaults use to yield ("auto
+    unless overridden": the batch ladder's CPU backend probe must not
+    second-guess an explicit TPU_IR_BATCH_LADDER)."""
+    return _raw(name) is not None
 
 
 def declared_names() -> tuple:
