@@ -1038,6 +1038,79 @@ def _tpu_probe_ok(timeout_s: int = 120) -> bool:
         return False
 
 
+def pass_metrics(phases: dict, build_s: float) -> dict:
+    """Sentry-gated per-phase aliases for a streaming build row: the
+    curated METRICS names (build_s / pass1_tokenize_s / pass2_combine_s
+    / pass3_reduce_s, direction-aware lower-is-better in
+    obs/bench_check.py) lifted out of the phase_* decomposition so the
+    regression sentry gates build performance from this PR on."""
+    out = {"build_s": round(build_s, 2)}
+    for phase in ("pass1_tokenize", "pass2_combine", "pass3_reduce"):
+        v = phases.get(f"phase_{phase}_s")
+        if isinstance(v, (int, float)):
+            out[f"{phase}_s"] = round(v, 2)
+    return out
+
+
+def run_scaling(args, backend: str) -> int:
+    """`--scaling N,N,...`: per-phase build scaling sweep (ISSUE 11).
+
+    For each docs count, synthesizes a proportional corpus (~2.7 KB/doc,
+    the wiki configs' shape), runs the streaming radix build, and
+    records one build_scale-<docs>d row per count — pass1/pass2/pass3
+    wall seconds, corpus + spill bytes, pairs — in BENCH_HISTORY.jsonl.
+    Linear build scaling is the claim; these rows are the evidence (and
+    the bench-check comparability groups that gate it)."""
+    from tpu_ir.index.streaming import build_index_streaming
+    from tpu_ir.obs import get_registry
+
+    counts = [int(x) for x in args.scaling.split(",") if x]
+    radix = args.radix_buckets if args.radix_buckets is not None else 16
+    rows = []
+    for n_docs in counts:
+        with tempfile.TemporaryDirectory() as tmp:
+            corpus = os.path.join(tmp, "corpus.trec")
+            nbytes = make_corpus(
+                corpus, n_docs=n_docs, target_bytes=n_docs * 2_700,
+                vocab_size=max(30_000, n_docs // 2))
+            index_dir = os.path.join(tmp, "index")
+            get_registry().snapshot(reset=True)
+            t0 = time.perf_counter()
+            build_index_streaming(
+                [corpus], index_dir, k=1, num_shards=10,
+                compute_chargrams=False, radix_buckets=radix,
+                tokenize_procs=args.tokenize_procs)
+            build_s = time.perf_counter() - t0
+            phases = _build_phase_timings(index_dir)
+            snap = get_registry().snapshot()
+            # the comparability key carries the BUILD SHAPE (bucket
+            # count, pool size) like serve_sweep-<docs>d-c<top> does:
+            # bench-check groups rows by config, and a radix run judged
+            # against a legacy-row median would breach (or mask) on the
+            # mode difference, not a regression
+            shape = f"-r{radix}" + (
+                f"-p{args.tokenize_procs}" if args.tokenize_procs else "")
+            row = {
+                "metric": "build_scale",
+                "config": f"build_scale-{n_docs}d{shape}",
+                "backend": backend,
+                "build_only": True,
+                "num_docs": n_docs,
+                "radix_buckets": radix,
+                "tokenize_procs": args.tokenize_procs or 1,
+                "corpus_bytes": nbytes,
+                "spill_bytes": snap["counters"].get(
+                    "build.radix.spill_bytes", 0),
+                "docs_per_sec": round(n_docs / build_s, 1),
+                **pass_metrics(phases, build_s),
+                **phases,
+            }
+            rows.append(row)
+            _append_history(row)
+            print(json.dumps(row))
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
@@ -1051,6 +1124,21 @@ def main() -> int:
     ap.add_argument("--no-controls", action="store_true",
                     help="skip the transport probe, device-only build "
                          "control, and CPU control subprocess")
+    ap.add_argument("--scaling", default=None, metavar="DOCS[,DOCS...]",
+                    help="per-phase build scaling sweep: for each docs "
+                         "count, synthesize a proportional corpus, run "
+                         "the streaming radix build, and append a "
+                         "build_scale-<docs>d row (pass1/pass2/pass3 "
+                         "wall + bytes) to BENCH_HISTORY.jsonl — the "
+                         "rows the bench-check sentry gates build perf "
+                         "on; skips all query/serving measurement")
+    ap.add_argument("--radix-buckets", type=int, default=None,
+                    help="radix buckets for streaming builds (default: "
+                         "16 for streaming configs and the scaling "
+                         "sweep; 0 = legacy per-batch pass 2)")
+    ap.add_argument("--tokenize-procs", type=int, default=None,
+                    help="tokenizer pool size for the pure-Python "
+                         "analyzer path (default: env/1)")
     ap.add_argument("--config",
                     choices=["ref", "wiki100k", "wiki1m", "msmarco"],
                     default="ref",
@@ -1090,6 +1178,9 @@ def main() -> int:
     import jax
 
     backend = jax.devices()[0].platform
+
+    if args.scaling:
+        return run_scaling(args, backend)
 
     if args.config == "msmarco":
         out = run_msmarco(args)
@@ -1133,13 +1224,20 @@ def main() -> int:
         if streaming:
             from tpu_ir.index.streaming import build_index_streaming
 
+            radix = (args.radix_buckets if args.radix_buckets is not None
+                     else 16)
+
             # store=True: the docstore rides pass 1's text spills (zero
             # extra corpus reads — VERDICT r4 next #5); its cost shows up
-            # attributed as phase_docstore_s + the pass-1 spill overhead
+            # attributed as phase_docstore_s + the pass-1 spill overhead.
+            # Streaming configs default to the radix-partitioned pass 2
+            # (ISSUE 11) — bit-identical artifacts, so the row stays
+            # comparable to its pre-radix history.
             def one_build(out):
                 build_index_streaming([corpus], out, k=1,
                                       chargram_ks=[2, 3], num_shards=10,
-                                      store=True)
+                                      store=True, radix_buckets=radix,
+                                      tokenize_procs=args.tokenize_procs)
         else:
             def one_build(out):
                 build_index([corpus], out, k=1, chargram_ks=[2, 3],
@@ -1401,6 +1499,7 @@ def main() -> int:
         **profile_breakdown(),
         "backend": backend,
         "config": args.config,
+        **(pass_metrics(phases, build_s) if streaming else {}),
         **phases,
         **controls,
     }

@@ -738,3 +738,111 @@ def test_inspect_reports_corrupt_artifact_cleanly(tmp_path, ref):
         f.truncate(os.path.getsize(part) // 2)
     lines = list(inspect_path(part))
     assert any("CORRUPT" in ln for ln in lines)
+
+
+# ---------------------------------------------------------------------------
+# radix-bucketed spill fault sites (ISSUE 11): the pass-1 rpairs spills
+# and pass-2 per-bucket pair spills ride the SAME spill_write /
+# artifact_truncate sites as every other atomic artifact, keyed by their
+# new file names — so an operator plan can target exactly them, and
+# every fault class keeps its recovery contract at bucket scope.
+# ---------------------------------------------------------------------------
+
+
+def test_radix_spill_write_failures_retried_to_identical(
+        tmp_path, ref):
+    """Transient write failures on BUCKETED spill files (pass-1 rpairs
+    AND pass-2 per-bucket pairs) retry under SPILL_RETRY and converge on
+    byte-identical artifacts."""
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    faults.install(faults.parse_plan(
+        "spill_write@rpairs-:first@2,spill_write@pairs-:first@1"))
+    build_index_streaming([corpus], out, radix_buckets=4, **BUILD_KW)
+    assert recovery_counters().get("retries") >= 3
+    assert_identical(out, ref_dir)
+
+
+def test_radix_spill_write_exhaustion_is_structured(tmp_path, ref):
+    corpus, _ = ref
+    out = str(tmp_path / "idx")
+    faults.install(faults.parse_plan("spill_write@rpairs-:first@99"))
+    with pytest.raises(faults.BuildError) as ei:
+        build_index_streaming([corpus], out, radix_buckets=4, **BUILD_KW)
+    assert ei.value.stage.startswith("write:rpairs-")
+
+
+def test_truncated_rpairs_spill_discards_pass1_state(
+        tmp_path, monkeypatch, ref):
+    """artifact_truncate corrupts an rpairs spill AFTER its CRC was
+    recorded: the resume's manifest check catches the mismatch, discards
+    the whole pass-1 state (a bucketed pair spill cannot be rebuilt
+    without re-tokenizing) and the rebuild converges."""
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    small_chunks(monkeypatch)
+    faults.install(faults.parse_plan(
+        "artifact_truncate@rpairs-:once@3,crash.pass2:once@1"))
+    with pytest.raises(faults.InjectedCrash):
+        build_index_streaming([corpus], out, radix_buckets=4, **BUILD_KW)
+    faults.clear()
+    tokenized = {"n": 0}
+
+    def counting(*a, **kw):
+        tokenized["n"] += 1
+        return _REAL_TOKENIZER(paths=a[0], k=kw.get("k", 1),
+                               chunk_bytes=400,
+                               with_text=kw.get("with_text", False))
+
+    monkeypatch.setattr(streaming, "make_chunked_tokenizer", counting)
+    build_index_streaming([corpus], out, radix_buckets=4, **BUILD_KW)
+    assert tokenized["n"] == 1
+    assert recovery_counters().get("spill_integrity_discards") >= 1
+    assert_identical(out, ref_dir)
+
+
+def test_truncated_bucket_pair_spill_quarantines_only_that_bucket(
+        tmp_path, monkeypatch, ref):
+    """artifact_truncate on a PASS-2 bucket spill: resume validation
+    deletes only that bucket's per-shard spills and recomputes the one
+    bucket — pass 1 untouched, every other bucket untouched."""
+    corpus, ref_dir = ref
+    out = str(tmp_path / "idx")
+    buckets = 5
+    faults.install(faults.parse_plan(
+        "artifact_truncate@pairs-001-00003:always,crash.pass3:once@1"))
+    with pytest.raises(faults.InjectedCrash):
+        build_index_streaming([corpus], out, radix_buckets=buckets,
+                              **BUILD_KW)
+    faults.clear()
+    forbid_tokenizer(monkeypatch)
+    calls = {"n": 0}
+    real = streaming.build_postings_packed_jit
+    monkeypatch.setattr(
+        streaming, "build_postings_packed_jit",
+        lambda *a, **kw: (calls.__setitem__("n", calls["n"] + 1),
+                          real(*a, **kw))[1])
+    build_index_streaming([corpus], out, radix_buckets=buckets,
+                          **BUILD_KW)
+    assert calls["n"] == 1  # only bucket 3 reduced again
+    assert recovery_counters().get("spill_integrity_discards") >= 1
+    assert_identical(out, ref_dir)
+
+
+def test_radix_mid_pass_death_matrix(tmp_path, monkeypatch, ref):
+    """SIGKILL-equivalent deaths in every radix pass recover
+    byte-identical on restart (the legacy matrix, at bucket scope)."""
+    corpus, ref_dir = ref
+    small_chunks(monkeypatch)
+    for i, (site, rule) in enumerate([("crash.pass1", "once@2"),
+                                      ("crash.pass2", "once@2"),
+                                      ("crash.pass3", "once@2")]):
+        out = str(tmp_path / f"idx{i}")
+        faults.install(faults.parse_plan(f"{site}:{rule}"))
+        with pytest.raises(faults.InjectedCrash):
+            build_index_streaming([corpus], out, radix_buckets=4,
+                                  **BUILD_KW)
+        faults.clear()
+        build_index_streaming([corpus], out, radix_buckets=4, **BUILD_KW)
+        assert_identical(out, ref_dir)
+        assert verify_index(out)["ok"]

@@ -109,3 +109,26 @@ def test_missing_docno_raises_same_error_on_every_path(tmp_path):
             list(tok.deltas())
         finally:
             tok.close()
+
+
+def test_native_analyzer_thread_safe():
+    """One NativeAnalyzer instance is shared by every concurrent serving
+    thread; its per-call output buffer must be per-THREAD or parallel
+    ir_analyze calls scribble over each other's token strings (caught by
+    the soak's bit-identical invariant the day the cached .so started
+    loading again). Pin: massively concurrent analyze == serial."""
+    import concurrent.futures
+
+    from tpu_ir.analysis.native import NativeAnalyzer
+
+    an = NativeAnalyzer()
+    texts = [
+        " ".join(f"running quickly fished w{i % 23} token{j}"
+                 for j in range(30))
+        for i in range(64)
+    ]
+    want = [an.analyze(t) for t in texts]
+    with concurrent.futures.ThreadPoolExecutor(max_workers=8) as ex:
+        for _ in range(5):
+            got = list(ex.map(an.analyze, texts))
+            assert got == want

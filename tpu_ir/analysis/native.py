@@ -35,14 +35,33 @@ _lib_failed = False
 
 
 def _build_so() -> bool:
+    # compile to a per-process temp and atomically rename: the rebuild
+    # path can run CONCURRENTLY in every tokenizer-pool worker process
+    # (the module lock is per-process only), and compiling straight to
+    # _SO would let one worker dlopen a half-written library another is
+    # emitting — failing them all over to the 10x-slower Python path
+    # and possibly leaving a corrupt .so for the next run
+    tmp = f"{_SO}.tmp.{os.getpid()}"
     try:
         subprocess.run(
             ["g++", "-O2", "-shared", "-fPIC", "-std=c++17",
-             "-o", _SO, _SRC],
+             "-o", tmp, _SRC],
             check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _SO)
         return True
     except (OSError, subprocess.SubprocessError):
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
+
+
+def _try_dlopen() -> ctypes.CDLL | None:
+    try:
+        return ctypes.CDLL(_SO)
+    except OSError:
+        return None
 
 
 def load_native() -> ctypes.CDLL | None:
@@ -53,16 +72,28 @@ def load_native() -> ctypes.CDLL | None:
             return _lib
         if _lib_failed:
             return None
-        if not os.path.exists(_SO) or (
-            os.path.exists(_SRC)
-            and os.path.getmtime(_SRC) > os.path.getmtime(_SO)
-        ):
-            if not os.path.exists(_SRC) or not _build_so():
-                _lib_failed = True
-                return None
-        try:
-            lib = ctypes.CDLL(_SO)
-        except OSError:
+    # slow path OUTSIDE the lock (g++ + dlopen are seconds of blocking
+    # work — TPU203): the temp+rename build is idempotent, so threads
+    # racing here at worst compile twice and both dlopen the same file;
+    # the winner is published under the lock below.
+    lib = None
+    stale = (not os.path.exists(_SO)
+             or (os.path.exists(_SRC)
+                 and os.path.getmtime(_SRC) > os.path.getmtime(_SO)))
+    if not stale:
+        lib = _try_dlopen()
+    if lib is None:
+        # missing, stale, or — the case a cached .so from ANOTHER
+        # toolchain hits (checked out on a host with a newer libstdc++)
+        # — present but undlopenable: rebuild once from source before
+        # falling back to the (10x slower) pure-Python analyzer for
+        # every build on this machine
+        if os.path.exists(_SRC) and _build_so():
+            lib = _try_dlopen()
+    with _lock:
+        if _lib is not None:
+            return _lib
+        if lib is None:
             _lib_failed = True
             return None
         lib.ir_analyze.restype = ctypes.c_int32
@@ -76,32 +107,46 @@ def load_native() -> ctypes.CDLL | None:
 
 
 class NativeAnalyzer:
-    """Drop-in Analyzer using the C++ pipeline when possible."""
+    """Drop-in Analyzer using the C++ pipeline when possible.
+
+    Thread-safe: the C++ side is pure (const tables + a thread_local
+    stem cache), and the OUTPUT buffer here is per-thread — one
+    NativeAnalyzer instance is shared by every concurrent serving
+    thread (scorer._analyze under the soak), and a process-shared
+    buffer would let two ir_analyze calls scribble over each other's
+    token strings, silently mis-analyzing queries."""
 
     def __init__(self, out_cap: int = 1 << 20):
         self._lib = load_native()
         self._py = Analyzer()
-        self._buf = ctypes.create_string_buffer(out_cap)
+        self._out_cap = out_cap
+        self._tls = threading.local()
 
     @property
     def is_native(self) -> bool:
         return self._lib is not None
 
+    def _buf(self):
+        buf = getattr(self._tls, "buf", None)
+        if buf is None:
+            buf = self._tls.buf = ctypes.create_string_buffer(
+                self._out_cap)
+        return buf
+
     def analyze(self, text: str) -> list[str]:
         if self._lib is None or not text.isascii():
             return self._py.analyze(text)
         raw = text.encode("ascii")
-        n = self._lib.ir_analyze(raw, len(raw), self._buf,
-                                 len(self._buf) - 1)
+        buf = self._buf()
+        n = self._lib.ir_analyze(raw, len(raw), buf, len(buf) - 1)
         if n < 0:  # grow and retry once
-            self._buf = ctypes.create_string_buffer(2 * -n)
-            n = self._lib.ir_analyze(raw, len(raw), self._buf,
-                                     len(self._buf) - 1)
+            buf = self._tls.buf = ctypes.create_string_buffer(2 * -n)
+            n = self._lib.ir_analyze(raw, len(raw), buf, len(buf) - 1)
             if n < 0:
                 return self._py.analyze(text)
         if n == 0:
             return []
-        return self._buf.raw[: n - 1].decode("ascii").split("\n") if n > 1 else []
+        return buf.raw[: n - 1].decode("ascii").split("\n") if n > 1 else []
 
 
 def tokenize_corpus_native(paths):
@@ -429,10 +474,18 @@ class PyChunkedTokenizer:
     streaming builders' crash-resume batches spills per delta, so the
     fallback must chunk the same way or a library-less host silently
     loses the multi-batch resume granularity (and every resume test with
-    small chunk_bytes along with it)."""
+    small chunk_bytes along with it).
+
+    `procs` (default: TPU_IR_TOKENIZE_PROCS) > 1 analyzes chunks in a
+    process pool (analysis/pool.py): the parent keeps reading records
+    and deciding the SAME chunk boundaries (they depend only on raw doc
+    lengths), workers analyze, and term interning stays in the parent in
+    submission order — so the deltas (and every spill downstream) are
+    byte-identical to the serial path."""
 
     def __init__(self, paths, k: int = 1, batch_docs: int = 5_000,
-                 with_text: bool = False, chunk_bytes: int = 8 << 20):
+                 with_text: bool = False, chunk_bytes: int = 8 << 20,
+                 procs: int | None = None):
         self._paths = ([paths] if isinstance(paths, (str, bytes))
                        else list(paths))
         self._k = k
@@ -441,6 +494,11 @@ class PyChunkedTokenizer:
         self._an = make_analyzer()
         self._vocab: dict[str, int] = {}
         self._with_text = with_text
+        if procs is None:
+            from .pool import tokenize_procs
+
+            procs = tokenize_procs()
+        self._procs = max(int(procs), 1)
 
     def _intern(self, term: str) -> int:
         tid = self._vocab.get(term)
@@ -449,34 +507,77 @@ class PyChunkedTokenizer:
             self._vocab[term] = tid
         return tid
 
-    def deltas(self):
-        from ..collection import kgram_terms, read_trec_corpus
-
-        docids, flat, lens, texts = [], [], [], []
-        acc_bytes = 0
-
-        def drain():
-            nonlocal docids, flat, lens, texts, acc_bytes
-            out = _delta_batch(self._with_text, docids, flat, lens, texts)
-            docids, flat, lens, texts = [], [], [], []
-            acc_bytes = 0
-            return out
+    def _iter_raw_chunks(self):
+        """(docids, contents) per delta chunk — THE boundary decision,
+        shared verbatim by the serial and pooled paths so the chunk-
+        parity contract cannot drift between them: drain after the doc
+        that crosses batch_docs or chunk_bytes, and at file ends."""
+        from ..collection import read_trec_corpus
 
         for path in self._paths:
+            docids: list[str] = []
+            contents: list[str] = []
+            acc_bytes = 0
             for doc in read_trec_corpus([path]):
-                toks = self._an.analyze(doc.content)
-                grams = kgram_terms(toks, self._k) if self._k > 1 else toks
                 docids.append(doc.docid)
-                flat.extend(self._intern(g) for g in grams)
-                lens.append(len(grams))
+                contents.append(doc.content)
                 acc_bytes += len(doc.content)
-                if self._with_text:
-                    texts.append(doc.content.encode("utf-8"))
                 if (len(docids) >= self._batch
                         or acc_bytes >= self._chunk_bytes):
-                    yield drain()
+                    yield docids, contents
+                    docids, contents, acc_bytes = [], [], 0
             if docids:  # file boundary, like the native per-file scan
-                yield drain()
+                yield docids, contents
+
+    def _chunk_delta(self, docids, contents, tok_lists):
+        """Intern one chunk's analyzed tokens (parent-side, in order)."""
+        flat: list[int] = []
+        lens: list[int] = []
+        for toks in tok_lists:
+            flat.extend(self._intern(t) for t in toks)
+            lens.append(len(toks))
+        texts = ([c.encode("utf-8") for c in contents]
+                 if self._with_text else [])
+        return _delta_batch(self._with_text, docids, flat, lens, texts)
+
+    def _analyze_docs(self, contents):
+        from ..collection import kgram_terms
+
+        for content in contents:
+            toks = self._an.analyze(content)
+            yield kgram_terms(toks, self._k) if self._k > 1 else toks
+
+    def deltas(self):
+        if self._procs > 1:
+            yield from self._deltas_pooled()
+            return
+        for docids, contents in self._iter_raw_chunks():
+            yield self._chunk_delta(docids, contents,
+                                    self._analyze_docs(contents))
+
+    def _deltas_pooled(self):
+        import collections
+
+        from ..utils.transfer import pipeline_depth
+        from .pool import AnalysisPool
+
+        pool = AnalysisPool(self._procs, k=self._k,
+                            ahead=self._procs + pipeline_depth())
+        raw: collections.deque = collections.deque()
+        try:
+            def drain_one():
+                docids, contents = raw.popleft()
+                return self._chunk_delta(docids, contents, pool.collect())
+
+            for docids, contents in self._iter_raw_chunks():
+                while pool.in_flight >= pool.ahead:
+                    yield drain_one()
+                pool.submit(contents)
+                raw.append((docids, contents))
+            while raw:
+                yield drain_one()
+        finally:
+            pool.close()
 
     def vocab(self) -> list[str]:
         return list(self._vocab)
@@ -486,10 +587,15 @@ class PyChunkedTokenizer:
 
 
 def make_chunked_tokenizer(paths, k: int = 1, chunk_bytes: int = 8 << 20,
-                           with_text: bool = False):
+                           with_text: bool = False,
+                           procs: int | None = None):
     """Native chunked ingestion when possible (k == 1, library present),
     else the Python fallback. Both yield insertion-ordered temp ids;
-    `with_text` adds each doc's raw record bytes to every delta."""
+    `with_text` adds each doc's raw record bytes to every delta.
+    `procs` reaches only the Python path — the C++ scanner already
+    parses at memory-bandwidth speed in one core's worth of native code,
+    while the pure-Python analyzer is the one that serializes a build
+    on one interpreter."""
     if k == 1:
         try:
             return NativeChunkedTokenizer(paths, chunk_bytes=chunk_bytes,
@@ -499,7 +605,7 @@ def make_chunked_tokenizer(paths, k: int = 1, chunk_bytes: int = 8 << 20,
             # file etc.) propagate instead of masquerading as a fallback
             pass
     return PyChunkedTokenizer(paths, k=k, with_text=with_text,
-                              chunk_bytes=chunk_bytes)
+                              chunk_bytes=chunk_bytes, procs=procs)
 
 
 def make_analyzer(native: bool = True):

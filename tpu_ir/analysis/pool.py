@@ -1,0 +1,139 @@
+"""Process-pool tokenization for the pure-Python analyzer path.
+
+The pure-Python tokenizer (PyChunkedTokenizer — the k>1 path and the
+fallback on hosts without the C++ library) serializes the expensive half
+of pass 1, analysis (tokenize + stopword + Porter2 stem + k-gram
+composition), on one core. This module fans exactly that half out to a
+process pool while keeping the BYTE-IDENTICAL contract of the serial
+path:
+
+- the PARENT keeps reading records and deciding chunk boundaries (the
+  chunk-parity contract from PR 1: one delta per ~chunk_bytes of record
+  text / batch_docs docs, never spanning an input path — boundaries
+  depend only on raw document lengths, which the parent sees without
+  analyzing anything);
+- WORKERS analyze whole chunks and return per-document token lists
+  (strings — no vocab state crosses the process boundary);
+- the parent collects results IN SUBMISSION ORDER and interns terms
+  into the single corpus-wide vocab, so temp-id assignment (first-
+  occurrence order over documents in corpus order) is exactly the
+  serial path's. `TPU_IR_TOKENIZE_PROCS=1` vs `N` produce byte-identical
+  token/pair spills by construction; tests/test_radix.py pins it.
+
+Collection is PIPELINED: up to `procs + pipeline depth` chunks are in
+flight, so the parent's read/intern/spill work overlaps the workers'
+analysis (the host half of ISSUE 11's tokenize->device overlap).
+
+Fault-plan inheritance is deterministic: the pool initializer re-parses
+the parent's TPU_IR_FAULTS spec in every worker (spawn- and fork-safe;
+under fork a programmatically installed plan is additionally inherited
+by memory image). The `tokenize.pool` site fires in the worker, keyed
+`chunk=<index>` — key-matched rules (`tokenize.pool@chunk=3:always`)
+fire on the same chunk regardless of which worker drew it.
+"""
+
+from __future__ import annotations
+
+import collections
+import multiprocessing
+
+from .. import faults
+from ..utils import envvars
+
+# worker-process globals, built once per worker by _pool_init
+_WORKER_ANALYZER = None
+
+
+def tokenize_procs() -> int:
+    """Declared TPU_IR_TOKENIZE_PROCS (1 = serial, the default)."""
+    return envvars.get_int("TPU_IR_TOKENIZE_PROCS")
+
+
+def _pool_init(faults_spec: str | None) -> None:
+    """Worker initializer: one Analyzer per process, and the parent's
+    env fault plan re-installed so injection behaves identically under
+    fork and spawn start methods."""
+    global _WORKER_ANALYZER
+    from .native import make_analyzer
+
+    _WORKER_ANALYZER = make_analyzer()
+    if faults_spec:
+        faults.install(faults.parse_plan(faults_spec))
+
+
+def _analyze_chunk(payload) -> list[list[str]]:
+    """Analyze one chunk of raw document contents; returns each doc's
+    final term list (k-grams composed when k > 1). Runs in a worker."""
+    chunk_idx, k, contents = payload
+    if faults.should_fire("tokenize.pool", f"chunk={chunk_idx}") is not None:
+        # an OSError (not InjectedCrash) so the failure travels back
+        # through the pool's result pickling as a normal exception and
+        # the parent's supervised-retry/structured-error machinery —
+        # not a worker death the pool would have to detect
+        raise OSError(f"injected tokenizer pool failure (chunk={chunk_idx})")
+    an = _WORKER_ANALYZER
+    out = []
+    for content in contents:
+        toks = an.analyze(content)
+        if k > 1:
+            from ..collection import kgram_terms
+
+            toks = kgram_terms(toks, k)
+        out.append(toks)
+    return out
+
+
+class AnalysisPool:
+    """Bounded, order-preserving chunk pipeline over a process pool.
+
+    submit() enqueues one chunk's contents; results() yields each
+    chunk's per-doc token lists in submission order, blocking only when
+    the OLDEST in-flight chunk is unfinished. At most `ahead` chunks are
+    in flight, so memory stays bounded no matter how fast the parent
+    reads."""
+
+    def __init__(self, procs: int, *, k: int = 1, ahead: int | None = None):
+        self._k = k
+        self._ahead = ahead if ahead is not None else procs + 2
+        # NEVER fork: the parent has JAX's compilation/dispatch threads
+        # running by build time, and forking a multithreaded process can
+        # deadlock the child on any lock a thread held mid-fork (JAX
+        # itself warns on fork). Workers import only the pure-Python
+        # analysis stack (~0.3 s, no JAX — the tpu_ir package __init__
+        # is deliberately lazy), so a clean start method costs almost
+        # nothing; forkserver amortizes even that across workers.
+        methods = multiprocessing.get_all_start_methods()
+        ctx = multiprocessing.get_context(
+            "forkserver" if "forkserver" in methods else "spawn")
+        self._pool = ctx.Pool(
+            processes=procs, initializer=_pool_init,
+            initargs=(envvars.get_str("TPU_IR_FAULTS"),))
+        self._pending: collections.deque = collections.deque()
+        self._next_idx = 0
+
+    def submit(self, contents: list[str]):
+        """Queue one chunk; blocks (collecting nothing) only via the
+        caller draining ready() first — see pipe()."""
+        r = self._pool.apply_async(
+            _analyze_chunk, ((self._next_idx, self._k, list(contents)),))
+        self._next_idx += 1
+        self._pending.append(r)
+        from ..obs import get_registry
+
+        get_registry().incr("build.tokenize.pool_chunks")
+
+    @property
+    def in_flight(self) -> int:
+        return len(self._pending)
+
+    @property
+    def ahead(self) -> int:
+        return self._ahead
+
+    def collect(self) -> list[list[str]]:
+        """Block for (and return) the OLDEST submitted chunk's result."""
+        return self._pending.popleft().get()
+
+    def close(self) -> None:
+        self._pool.terminate()
+        self._pool.join()

@@ -184,7 +184,8 @@ def _run_index(args) -> int:
             compute_chargrams=not args.no_chargrams,
             spmd_devices=args.spmd_devices,
             overwrite=args.overwrite, positions=args.positions,
-            store=args.store)
+            store=args.store, radix_buckets=args.radix_buckets,
+            tokenize_procs=args.tokenize_procs)
     else:
         from .index import build_index
 
@@ -1081,6 +1082,18 @@ def main(argv: list[str] | None = None) -> int:
                          "than memory")
     pi.add_argument("--batch-docs", type=int, default=50000,
                     help="streaming: documents per tokenize batch")
+    pi.add_argument("--radix-buckets", type=int, default=None,
+                    metavar="B",
+                    help="streaming: radix-partition pass-1 pair spills "
+                         "into B buckets so pass 2 runs as per-bucket "
+                         "local device reduces (default: "
+                         "$TPU_IR_RADIX_BUCKETS, 0 = per-batch combine; "
+                         "artifacts are bit-identical either way)")
+    pi.add_argument("--tokenize-procs", type=int, default=None,
+                    metavar="N",
+                    help="worker processes for the pure-Python tokenizer "
+                         "path (default: $TPU_IR_TOKENIZE_PROCS; spills "
+                         "are byte-identical to the serial tokenizer)")
     pi.add_argument("--spmd-devices", type=int, default=None,
                     help="build over an N-device mesh (doc-sharded map, "
                          "all_to_all shuffle, term-sharded reduce); implies "

@@ -20,14 +20,37 @@ with the per-batch combine as a device op:
     merge, and the spills start and end on host disk. Peak memory is one
     shard's pairs, never the whole index.
 
-With `spmd_devices=N`, pass 2 runs as the mesh program instead: each
-batch's occurrences are doc-dealt across the N devices, the combiner +
-all_to_all shuffle + term-shard reduce run inside one jit
+RADIX MODE (ISSUE 11, `radix_buckets`/TPU_IR_RADIX_BUCKETS > 0) moves the
+partition to where Hadoop put it — spill time — and the pass-2 global
+combine disappears:
+
+  pass 1 additionally radix-partitions each batch's occurrence stream by
+    destination bucket (temp_id % B; stable across resume because temp
+    ids are pinned by the manifest) as the spills are written
+    (rpairs-RRR-BBBBB.npz, documents run-length packed), on a pipeline
+    thread one batch behind the tokenizer;
+  pass 2 becomes B embarrassingly-parallel per-bucket LOCAL device
+    reduces: read bucket R's spills (a prefetch thread keeps the host one
+    bucket ahead of the device), remap temp->sorted ids, one device
+    group-by, split the result by final term shard — no global sort, no
+    token-spill re-read. A bucket is a function of the TERM alone, so
+    per-bucket tf aggregation is exact and final.
+  pass 3 is unchanged (spills arrive keyed by bucket instead of batch),
+    so radix artifacts are bit-identical to the legacy streaming build
+    AND the one-shot builder — fuzz-pinned across bucket counts, resume
+    points and meshes (tests/test_radix.py). TPU_IR_RADIX_PARTS skips the
+    pass-3 sort and writes bucket-segmented parts instead (readers accept
+    both layouts; bytes differ — see write_bucketed_shard).
+
+With `spmd_devices=N`, pass 2 runs as the mesh program instead: legacy
+mode doc-deals each batch across the N devices and runs the combiner +
+all_to_all shuffle + term-shard reduce in one jit
 (parallel/sharded_build.py — the splits -> shuffle -> reducers pipeline of
-TermKGramDocIndexer.java:227-283, with the corpus streamed from disk), and
-every device's reduced output spills directly as its term shard's pairs.
-Pass 1 and pass 3 are unchanged, so scale (out-of-core) and distribution
-(mesh) compose: the artifacts are byte-identical to the single-device
+TermKGramDocIndexer.java:227-283, with the corpus streamed from disk);
+radix mode round-robins buckets across devices and reduces N buckets per
+dispatch with ZERO collectives (radix_bucket_reduce — the partition
+already did the routing), donating the occurrence upload on TPU backends.
+Either way the artifacts are byte-identical to the single-device
 streaming build at the same shard count.
 
 Crash resume: every spill and part file is written atomically (temp +
@@ -50,6 +73,7 @@ from __future__ import annotations
 import logging
 import os
 import shutil
+import time
 from typing import Iterable, Sequence
 
 import jax.numpy as jnp
@@ -83,6 +107,8 @@ def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
                 spmd_devices: int | None,
                 positions: bool = False,
                 store: bool = False,
+                radix_buckets: int = 0,
+                radix_parts: bool = False,
                 extra: Sequence[str] = ()) -> np.ndarray:
     """Build-config signature stored in the pass-1 manifest: a resume is
     only valid against spills produced by the SAME corpus files and build
@@ -90,9 +116,14 @@ def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
     BuildIntDocVectorsForwardIndex.java:186-194 — generalized here to the
     pass DAG within one job per SURVEY §5). `extra` carries additional
     shape facts (the multi-host build pins process index/count and batch
-    size, which all change the spill layout)."""
+    size, which all change the spill layout). `radix_buckets` is folded
+    in so a radix-config change (bucket count, or radix on/off) can
+    never resume over spills partitioned the other way — the bucket id
+    is baked into every pass-1 spill's NAME and CONTENT."""
     parts = [f"k={k}", f"shards={num_shards}", f"spmd={spmd_devices or 0}",
-             f"pos={int(positions)}", f"store={int(store)}", *extra]
+             f"pos={int(positions)}", f"store={int(store)}",
+             f"radix={radix_buckets}", f"rparts={int(radix_parts)}",
+             *extra]
     for p in corpus_paths:
         ap = os.path.abspath(p)
         if os.path.exists(ap):
@@ -107,14 +138,49 @@ def _config_sig(corpus_paths: Sequence[str], k: int, num_shards: int,
     return np.array(parts, dtype=np.str_)
 
 
+def radix_spill_name(bucket: int, batch: int) -> str:
+    """Pass-1 bucketed pair spill for (radix bucket, tokenize batch):
+    the occurrence stream of every term whose temp id hashes to
+    `bucket`, run-length packed per document. The bucket id leads so an
+    `ls` groups a bucket's inputs the way pass 2 reads them."""
+    return f"rpairs-{bucket:03d}-{batch:05d}.npz"
+
+
+class _ResumeState:
+    """Complete pass-1 state recovered from a matching manifest: the
+    docids (corpus order), the native vocab (temp-id order), the batch
+    count + per-batch stats — and, for a radix build, the bucket count
+    its spills were partitioned by plus every doc's occurrence count
+    (doc_len no longer falls out of re-reading token spills, because
+    radix mode writes pair spills instead)."""
+
+    def __init__(self, docids, vocab, n_batches, batch_occ,
+                 radix_buckets=0, doc_lens=None):
+        self.docids = docids
+        self.vocab = vocab
+        self.n_batches = n_batches
+        self.batch_occ = batch_occ
+        self.radix_buckets = radix_buckets
+        self.doc_lens = doc_lens
+
+
+def _pass1_spill_paths(spill_dir: str, b: int, radix_buckets: int):
+    """Batch b's pass-1 spill files, manifest-CRC order: the token spill
+    (legacy) or its per-bucket rpairs spills (radix)."""
+    if radix_buckets:
+        return [os.path.join(spill_dir, radix_spill_name(r, b))
+                for r in range(radix_buckets)]
+    return [os.path.join(spill_dir, f"tokens-{b:05d}.npz")]
+
+
 def _load_resume_state(spill_dir: str, sig: np.ndarray):
-    """Returns (all_docids, vocab_list, n_batches, batch_occ) when the
-    spill dir holds a complete pass-1 state for this exact config, else
-    None. Manifest + spills are written atomically, so existence implies
-    completeness; the manifest additionally records each token spill's
-    CRC, and a mismatch (bit rot, torn disk) discards the whole pass-1
-    state — a corrupt token spill cannot be rebuilt without re-tokenizing,
-    so the only safe recovery is a fresh pass 1."""
+    """Returns a _ResumeState when the spill dir holds a complete pass-1
+    state for this exact config, else None. Manifest + spills are
+    written atomically, so existence implies completeness; the manifest
+    additionally records each pass-1 spill's CRC, and a mismatch (bit
+    rot, torn disk) discards the whole pass-1 state — a corrupt token or
+    bucketed pair spill cannot be rebuilt without re-tokenizing, so the
+    only safe recovery is a fresh pass 1."""
     path = os.path.join(spill_dir, PASS1_MANIFEST)
     if not os.path.exists(path):
         return None
@@ -124,23 +190,33 @@ def _load_resume_state(spill_dir: str, sig: np.ndarray):
                     or not (z["sig"] == sig).all()):
                 return None
             n_batches = int(z["n_batches"])
+            radix = (int(z["radix_buckets"])
+                     if "radix_buckets" in z.files else 0)
             spill_crc = (z["spill_crc"].tolist()
                          if "spill_crc" in z.files else None)
+            if (spill_crc is not None
+                    and len(spill_crc) != n_batches * max(radix, 1)):
+                return None  # torn/foreign manifest: CRC inventory short
+            i = 0
             for b in range(n_batches):
-                spill = os.path.join(spill_dir, f"tokens-{b:05d}.npz")
-                if not os.path.exists(spill):
-                    return None
-                if (spill_crc is not None
-                        and fmt.file_checksum(spill) != spill_crc[b]):
-                    from ..utils.report import recovery_counters
+                for spill in _pass1_spill_paths(spill_dir, b, radix):
+                    if not os.path.exists(spill):
+                        return None
+                    if (spill_crc is not None
+                            and fmt.file_checksum(spill) != spill_crc[i]):
+                        from ..utils.report import recovery_counters
 
-                    recovery_counters().incr("spill_integrity_discards")
-                    logger.warning(
-                        "token spill %s fails its manifest checksum; "
-                        "discarding the pass-1 resume state", spill)
-                    return None
-            return (z["docids"].tolist(), z["vocab"].tolist(), n_batches,
-                    z["batch_occ"])
+                        recovery_counters().incr(
+                            "spill_integrity_discards")
+                        logger.warning(
+                            "pass-1 spill %s fails its manifest checksum;"
+                            " discarding the pass-1 resume state", spill)
+                        return None
+                    i += 1
+            return _ResumeState(
+                z["docids"].tolist(), z["vocab"].tolist(), n_batches,
+                z["batch_occ"], radix_buckets=radix,
+                doc_lens=z["doc_lens"] if "doc_lens" in z.files else None)
     except _CORRUPT_NPZ:
         return None
 
@@ -247,14 +323,115 @@ def _reduce_shard_spills(spill_dir, index_dir, row, n_batches, vocab_size,
     return rdf, len(t)
 
 
+def write_radix_spills(spill_dir: str, b: int, ids: np.ndarray,
+                       lengths: np.ndarray, doc_ofs: int,
+                       radix_buckets: int) -> list[str]:
+    """Radix-partition one tokenize batch's occurrence stream by
+    destination bucket (temp_id % B — stable for the whole build because
+    temp ids are pinned by the pass-1 manifest) and spill each bucket's
+    share atomically. Documents ride as RUNS (global doc ordinal + run
+    length): partitioning preserves emission order, so one doc's
+    occurrences within a bucket stay contiguous, and the run encoding
+    both shrinks the spill and feeds build_postings_packed's upload-slim
+    device reconstruction in pass 2. Returns the spill CRCs in bucket
+    order (the manifest's verification order)."""
+    from ..obs import get_registry
+
+    reg = get_registry()
+    flat_ord = np.repeat(
+        np.arange(doc_ofs, doc_ofs + len(lengths), dtype=np.int64),
+        lengths.astype(np.int64)).astype(np.int32)
+    bucket = ids % np.int32(radix_buckets)
+    # counting-sort the occurrences by bucket: one stable O(n) partition
+    # pass instead of B boolean scans over the whole batch
+    order = np.argsort(bucket, kind="stable")
+    ids_p = ids[order].astype(np.int32)
+    ord_p = flat_ord[order]
+    counts = np.bincount(bucket, minlength=radix_buckets)
+    starts = np.concatenate([[0], np.cumsum(counts)])
+    crcs = []
+    for r in range(radix_buckets):
+        lo, hi = int(starts[r]), int(starts[r + 1])
+        t_r, o_r = ids_p[lo:hi], ord_p[lo:hi]
+        if len(o_r):
+            run_start = np.concatenate(
+                [[0], np.flatnonzero(np.diff(o_r) != 0) + 1])
+            run_docs = o_r[run_start]
+            run_lens = np.diff(np.concatenate(
+                [run_start, [len(o_r)]])).astype(np.int32)
+        else:
+            run_docs = np.zeros(0, np.int32)
+            run_lens = np.zeros(0, np.int32)
+        path = os.path.join(spill_dir, radix_spill_name(r, b))
+        crcs.append(fmt.savez_atomic(path, term=t_r, doc=run_docs,
+                                     len=run_lens))
+        reg.incr("build.radix.bucket_spills")
+        reg.incr("build.radix.spill_bytes", int(os.path.getsize(path)))
+    return crcs
+
+
+def write_bucketed_shard(spill_dir: str, index_dir: str, row: int,
+                         num_buckets: int, vocab_size: int, *,
+                         offset_of: np.ndarray | None = None
+                         ) -> tuple[np.ndarray, int]:
+    """Pass 3 for ONE term shard in the BUCKET-SEGMENTED layout
+    (TPU_IR_RADIX_PARTS): each pass-2 bucket spill already holds final
+    postings in final per-term order (term asc within its bucket, tf
+    desc / doc asc within each term — the device reduce's lexsort), so
+    the part file is the CONCATENATION of its bucket segments and the
+    global per-shard sort is skipped entirely. Term ids are unique
+    across the part (a term lives in exactly one bucket) but only
+    ascending within each segment; readers assemble by term id, not
+    file order, so the layout round-trips through Scorer/_assemble_csr,
+    verify, inspect and migrate-index unchanged — but the part BYTES
+    (and the dictionary) differ from the canonical layout.
+
+    `offset_of` (int64 [V], optional) is filled with each term's
+    postings start inside its part — what write_dictionary must record
+    for this layout."""
+    with obs_trace("build.spill_reduce", shard=row, buckets=num_buckets,
+                   segmented=True):
+        tids_l, df_l, doc_l, tf_l = [], [], [], []
+        for r in range(num_buckets):
+            path = os.path.join(spill_dir, f"pairs-{row:03d}-{r:05d}.npz")
+            with np.load(path) as z:
+                t, d, w = z["term"], z["doc"], z["tf"]
+            if not len(t):
+                continue
+            # t ascends within the spill, so unique() preserves order
+            ut, counts = np.unique(t, return_counts=True)
+            tids_l.append(ut.astype(np.int32))
+            df_l.append(counts.astype(np.int32))
+            doc_l.append(d)
+            tf_l.append(w)
+        tids = (np.concatenate(tids_l) if tids_l
+                else np.zeros(0, np.int32))
+        df_part = (np.concatenate(df_l) if df_l
+                   else np.zeros(0, np.int32))
+        indptr = np.concatenate(
+            [[0], np.cumsum(df_part, dtype=np.int64)])
+        pair_doc = (np.concatenate(doc_l) if doc_l
+                    else np.zeros(0, np.int32))
+        pair_tf = (np.concatenate(tf_l) if tf_l
+                   else np.zeros(0, np.int32))
+        fmt.save_shard(index_dir, row, term_ids=tids, indptr=indptr,
+                       pair_doc=pair_doc, pair_tf=pair_tf, df=df_part)
+        if offset_of is not None:
+            offset_of[tids] = indptr[:-1]
+        rdf = np.zeros(vocab_size, np.int32)
+        rdf[tids] = df_part
+    return rdf, len(pair_doc)
+
+
 def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
-                     report, *, text_path_fn, batch_stat):
+                     report, *, text_path_fn, batch_stat,
+                     radix_buckets: int = 0):
     """THE pass-1 spill loop (chunked tokenize -> batch -> atomic spill),
     shared by the single-process streaming build and the multi-host build
     so the crash-resume invariants live exactly once:
 
-    - text spill FIRST: a token spill's existence is the batch's resume
-      marker, so its text twin must never trail it (index/docstore.py
+    - text spill FIRST: a batch's token/rpairs spills are its resume
+      marker, so its text twin must never trail them (index/docstore.py
       assembles the store from text spills after pass 3 — zero extra
       corpus reads);
     - the CALLER writes its manifest LAST (atomic) to certify the pass.
@@ -262,9 +439,19 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
     `text_path_fn(b)` names batch b's text spill (the two builders place
     them differently); `batch_stat(ids, lengths)` is the per-batch int
     recorded for pass 2 (total occurrences single-process; the
-    per-device occupancy cap multi-host). Returns
-    (docids, vocab_list, n_batches, stats, spill_crcs) — the CRCs go in
-    the caller's manifest so a resume can verify the spills' bytes."""
+    per-device occupancy cap multi-host).
+
+    With `radix_buckets` > 0 each batch spills as per-bucket (term, doc
+    run) pair files instead of one token spill (write_radix_spills), and
+    the partition+spill work runs on a pipeline thread one batch behind
+    the tokenizer (prefetch_iter) — tokenize N+1 overlaps spill-write N.
+
+    Returns (docids, vocab_list, n_batches, stats, spill_crcs,
+    doc_lens) — the CRCs go in the caller's manifest so a resume can
+    verify the spills' bytes; doc_lens (int64, corpus order) is every
+    doc's occurrence count, which radix pass 2 can no longer recover
+    from token spills."""
+    from ..utils.transfer import prefetch_iter
     from .docstore import write_text_spill
 
     acc_ids: list[np.ndarray] = []
@@ -275,37 +462,59 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
     all_docids: list[str] = []
     stats: list[int] = []
     spill_crcs: list[str] = []
-    n_batches = 0
+    all_lens: list[np.ndarray] = []
+    n_written = 0
 
-    def flush():
-        nonlocal n_batches, acc_docs
-        if not acc_docs:
-            return
-        with obs_trace("build.spill", batch=n_batches, docs=acc_docs):
+    def spill_batch(b: int, ids, lengths, texts, docids, doc_ofs):
+        """Write batch b's spills (consumer side of the pipeline)."""
+        nonlocal n_written
+        with obs_trace("build.spill", batch=b, docs=len(lengths),
+                       radix=radix_buckets):
             if store:
-                write_text_spill(text_path_fn(n_batches), acc_texts,
-                                 acc_docids)
-                acc_texts.clear()
-                acc_docids.clear()
+                write_text_spill(text_path_fn(b), texts, docids)
+            if radix_buckets:
+                spill_crcs.extend(write_radix_spills(
+                    spill_dir, b, ids, lengths, doc_ofs, radix_buckets))
+            else:
+                spill = os.path.join(spill_dir, f"tokens-{b:05d}.npz")
+                # the returned CRC is computed pre-rename, so post-write
+                # corruption of the spill can never match the manifest
+                # that records it
+                spill_crcs.append(fmt.savez_atomic(spill, ids=ids,
+                                                   lengths=lengths))
+        report_progress("pass1_tokenize", advance=1,
+                        docs_parsed=len(lengths),
+                        spills_written=max(radix_buckets, 1) + int(store),
+                        occurrences=len(ids))
+        n_written = b + 1
+        faults.maybe_crash("crash.pass1", f"b={b + 1}")
+
+    def batches():
+        """Producer: drain the tokenizer into batch-sized arrays. Yields
+        (b, ids, lengths, texts, docids, doc_ofs) where doc_ofs is the
+        global ordinal of the batch's first document."""
+        nonlocal acc_docs
+        state = {"b": 0, "doc_ofs": 0}
+
+        def flush():
+            nonlocal acc_docs
+            if not acc_docs:
+                return None
             ids = np.concatenate(acc_ids)
             lengths = np.concatenate(acc_lens)
-            spill = os.path.join(spill_dir, f"tokens-{n_batches:05d}.npz")
-            # the returned CRC is computed pre-rename, so post-write
-            # corruption of the spill can never match the manifest that
-            # records it
-            spill_crcs.append(fmt.savez_atomic(spill, ids=ids,
-                                               lengths=lengths))
-        report_progress("pass1_tokenize", advance=1, docs_parsed=acc_docs,
-                        spills_written=1 + int(store),
-                        occurrences=len(ids))
-        stats.append(int(batch_stat(ids, lengths)))
-        n_batches += 1
-        acc_ids.clear()
-        acc_lens.clear()
-        acc_docs = 0
-        faults.maybe_crash("crash.pass1", f"b={n_batches}")
+            all_lens.append(lengths.astype(np.int64))
+            stats.append(int(batch_stat(ids, lengths)))
+            out = (state["b"], ids, lengths, list(acc_texts),
+                   list(acc_docids), state["doc_ofs"])
+            acc_ids.clear()
+            acc_lens.clear()
+            acc_texts.clear()
+            acc_docids.clear()
+            acc_docs = 0
+            state["b"] += 1
+            state["doc_ofs"] += len(lengths)
+            return out
 
-    try:
         for delta in tok.deltas():
             if store:
                 docids_d, ids_d, lens_d, texts_d = delta
@@ -319,12 +528,34 @@ def run_pass1_spills(tok, spill_dir: str, batch_docs: int, store: bool,
             acc_lens.append(lens_d)
             acc_docs += len(docids_d)
             if acc_docs >= batch_docs:
-                flush()
-        flush()
+                item = flush()
+                if item is not None:
+                    yield item
+        item = flush()
+        if item is not None:
+            yield item
+
+    it = batches()
+    if radix_buckets:
+        # double-buffered: the tokenizer (producer thread) runs one
+        # pipeline-depth ahead of the partition+spill consumer
+        it = prefetch_iter(it, name="pass1-spill")
+    try:
+        for args in it:
+            spill_batch(*args)
         vocab_list = tok.vocab()
     finally:
+        # close the pipeline BEFORE the tokenizer: generator close waits
+        # for the producer thread to exit, so tok.close() can never free
+        # the native corpus handle while the thread is still inside
+        # tok.deltas() (a consumer-side crash would otherwise race a
+        # C++ use-after-free instead of surfacing the structured error)
+        it.close()
         tok.close()
-    return all_docids, vocab_list, n_batches, stats, spill_crcs
+    doc_lens = (np.concatenate(all_lens) if all_lens
+                else np.zeros(0, np.int64))
+    return (all_docids, vocab_list, n_written, stats, spill_crcs,
+            doc_lens)
 
 
 def build_index_streaming(corpus_paths, index_dir,
@@ -342,6 +573,7 @@ def build_index_streaming(corpus_paths, index_dir,
                  config={"k": kwargs.get("k", 1),
                          "spmd_devices": kwargs.get("spmd_devices"),
                          "num_shards": kwargs.get("num_shards"),
+                         "radix_buckets": kwargs.get("radix_buckets"),
                          "streaming": True}):
         return _build_index_streaming(corpus_paths, index_dir, **kwargs)
 
@@ -364,10 +596,27 @@ def _build_index_streaming(
     overwrite: bool = False,
     positions: bool = False,
     store: bool = False,
+    radix_buckets: int | None = None,
+    radix_parts: bool | None = None,
+    tokenize_procs: int | None = None,
 ) -> fmt.IndexMetadata:
+    from ..utils import envvars
+
     if isinstance(corpus_paths, (str, os.PathLike)):
         corpus_paths = [corpus_paths]
     chargram_ks = list(chargram_ks)
+    if radix_buckets is None:
+        radix_buckets = envvars.get_int("TPU_IR_RADIX_BUCKETS")
+    radix_buckets = int(radix_buckets or 0)
+    if radix_buckets and positions:
+        # position runs need each doc's flat token order, which the
+        # radix partition destroys; the legacy per-batch combine keeps it
+        logger.warning("radix partitioning is unavailable with "
+                       "positions=True; using the per-batch pass 2")
+        radix_buckets = 0
+    if radix_parts is None:
+        radix_parts = envvars.get_bool("TPU_IR_RADIX_PARTS")
+    radix_parts = bool(radix_parts) and radix_buckets > 0
     if spmd_devices:
         # each device's reduce output IS one term shard (Hadoop's
         # reducer-count = partition-count identity)
@@ -392,8 +641,13 @@ def _build_index_streaming(
     # reusable when its pass-1 manifest matches this exact config; stale or
     # mismatched state (and any half-written artifacts) is discarded ----
     spill_dir = os.path.join(index_dir, "_spill")
+    # radix_parts is part of the signature too: a resume across a
+    # TPU_IR_RADIX_PARTS flip would otherwise keep some shards in one
+    # layout, rebuild the rest in the other, and write a dictionary
+    # whose offsets are wrong for every resumed-shard term
     sig = _config_sig(corpus_paths, k, num_shards, spmd_devices, positions,
-                      store)
+                      store, radix_buckets=radix_buckets,
+                      radix_parts=radix_parts)
     resume_state = _load_resume_state(spill_dir, sig)
     if resume_state is None and os.path.isdir(spill_dir):
         shutil.rmtree(spill_dir, ignore_errors=True)
@@ -410,37 +664,48 @@ def _build_index_streaming(
     report = JobReport("TermKGramDocIndexer", config={
         "k": k, "num_shards": num_shards, "streaming": True,
         "batch_docs": batch_docs, "spmd_devices": spmd_devices,
-        "store": store, "resumed": resume_state is not None})
+        "store": store, "radix_buckets": radix_buckets,
+        "radix_parts": radix_parts, "resumed": resume_state is not None})
 
     # ---- pass 1: chunked tokenize -> spill temp-id batches ----
     # (each spill batch covers a contiguous docid range; pass 2 walks the
     # same order, so batch b's docids are all_docids[ofs : ofs + len(lens)])
     if resume_state is not None:
-        all_docids, vocab_list, n_batches, batch_occ = resume_state
+        all_docids = resume_state.docids
+        vocab_list = resume_state.vocab
+        n_batches = resume_state.n_batches
+        batch_occ = resume_state.batch_occ
+        all_doc_lens = resume_state.doc_lens
         report.incr("Count.DOCS", len(all_docids))
         report.set_counter("pass1_resumed_batches", n_batches)
         report_progress("pass1_tokenize", advance=n_batches,
                         total=n_batches, docs_parsed=len(all_docids),
                         resumed_batches=n_batches)
     else:
-        tok = make_chunked_tokenizer(corpus_paths, k=k, with_text=store)
+        tok = make_chunked_tokenizer(corpus_paths, k=k, with_text=store,
+                                     procs=tokenize_procs)
         with report.phase("pass1_tokenize"):
-            all_docids, vocab_list, n_batches, occ_per_batch, spill_crcs = \
-                run_pass1_spills(
+            (all_docids, vocab_list, n_batches, occ_per_batch,
+             spill_crcs, all_doc_lens) = run_pass1_spills(
                     tok, spill_dir, batch_docs, store, report,
                     text_path_fn=lambda b: os.path.join(
                         spill_dir, f"text-{b:05d}.npz"),
-                    batch_stat=lambda ids, lengths: len(ids))
+                    batch_stat=lambda ids, lengths: len(ids),
+                    radix_buckets=radix_buckets)
         batch_occ = np.array(occ_per_batch, dtype=np.int64)
         # manifest LAST: its existence certifies pass 1 (docids in corpus
         # order, the native vocab in temp-id order, per-batch occurrence
-        # counts, per-spill CRCs) so a restart never re-tokenizes — and
-        # never trusts a spill whose bytes rotted under it
+        # counts, per-doc occurrence counts, the radix bucket count the
+        # spills were partitioned by, per-spill CRCs) so a restart never
+        # re-tokenizes — and never trusts a spill whose bytes rotted
+        # under it
         fmt.savez_atomic(
             os.path.join(spill_dir, PASS1_MANIFEST), sig=sig,
             docids=np.array(all_docids, dtype=np.str_),
             vocab=np.array(vocab_list, dtype=np.str_),
             n_batches=np.int64(n_batches), batch_occ=batch_occ,
+            radix_buckets=np.int64(radix_buckets),
+            doc_lens=np.asarray(all_doc_lens, dtype=np.int64),
             spill_crc=np.array(spill_crcs, dtype=np.str_))
 
     num_docs = len(all_docids)
@@ -464,10 +729,65 @@ def _build_index_streaming(
         v = len(vocab)
         report.set_counter("reduce_output_groups", v)
 
-    # ---- pass 2: combine per batch, spill pairs per term shard ----
+    # ---- pass 2: combine per batch (legacy) or reduce per radix bucket,
+    # spill pairs per term shard ----
     doc_len = np.zeros(num_docs + 1, np.int64)
     occurrences = int(batch_occ.sum())
     resuming = resume_state is not None
+
+    if radix_buckets:
+        # every doc's final docno, indexed by its global ordinal (ONE
+        # vectorized searchsorted for the whole corpus instead of one
+        # per batch); with bucketed pair spills pass 2 never re-walks
+        # token spills, so doc_len comes straight from the manifest-
+        # recorded per-doc occurrence counts
+        docno_of = (np.searchsorted(
+            sorted_docids, np.array(all_docids, dtype=np.str_)) + 1
+        ).astype(np.int32)
+        doc_len[docno_of] = np.asarray(all_doc_lens, dtype=np.int64)
+
+    def iter_buckets():
+        """Radix pass-2 input: (r, term_ids, docnos, run_lens) per
+        bucket that still needs its per-shard pair spills — the same
+        tuple shape iter_batches yields, so ONE device loop serves both
+        (documents ride as runs; build_postings_packed re-expands them
+        on device). Runs on the prefetch thread: the host reads/remaps
+        bucket N+1 while the device reduces bucket N.
+
+        Resume: a bucket whose pass-2 spills all exist is complete
+        (atomic writes) and is skipped without reading its inputs;
+        validation quarantines a corrupt pass-2 spill's WHOLE BUCKET
+        only — the smallest recovery scope the layout allows. A corrupt
+        pass-1 rpairs spill cannot be rebuilt without re-tokenizing and
+        surfaces as one structured IntegrityError instead."""
+        for r in range(radix_buckets):
+            done = resuming and _batch_pairs_done(
+                spill_dir, r, num_shards, validate=True)
+            if done:
+                report.incr("pass2_resumed_buckets", 1)
+                report_progress("pass2_combine", advance=1,
+                                resumed_buckets=1)
+                continue
+            terms, rdocs, rlens = [], [], []
+            for b in range(n_batches):
+                spill = os.path.join(spill_dir, radix_spill_name(r, b))
+                try:
+                    with np.load(spill) as z:
+                        terms.append(z["term"])
+                        rdocs.append(z["doc"])
+                        rlens.append(z["len"])
+                except _CORRUPT_NPZ as e:
+                    raise faults.IntegrityError(
+                        spill, f"bucketed pair spill unreadable ({e}); "
+                        "re-run the build — the restart re-tokenizes "
+                        "the corpus") from e
+            t = (rank[np.concatenate(terms)] if terms
+                 else np.zeros(0, np.int32))
+            d = (docno_of[np.concatenate(rdocs)] if rdocs
+                 else np.zeros(0, np.int32))
+            ln = (np.concatenate(rlens).astype(np.int32) if rlens
+                  else np.zeros(0, np.int32))
+            yield r, t, d, ln
 
     def iter_batches():
         """Yield (b, term_ids, docnos, lengths) per spill batch that still
@@ -522,14 +842,18 @@ def _build_index_streaming(
                         pos_indptr=indptr_, pos_delta=delta_)
             yield b, term_ids, docnos, lengths
 
-    def pass2_single_device():
+    def pass2_single_device(batch_iter, unit="batches"):
         # depth-1 dispatch/collect pipeline: batch b+1's host prep + device
         # program overlap batch b's D2H copies; the pair columns are sliced
         # + narrowed on device before the copy (see builder.py — the
-        # tunnel's D2H bandwidth is the critical path)
+        # tunnel's D2H bandwidth is the critical path). In radix mode the
+        # iterator additionally runs on a prefetch thread, so disk reads +
+        # temp-id remaps for item N+1 overlap the device reduce of item N
+        # AND the D2H collect of item N-1 — the double-buffered pipeline.
         use16 = v < int(PAD_TERM_U16)
+        buckets = unit == "buckets"
 
-        def collect_batch(b, p, tf_max):
+        def collect_batch(b, p, tf_max, t0):
             df_b, tfm = fetch_to_host(p.df, tf_max)
             npairs = int(df_b.sum())
             pd, ptf = fetch_to_host(*shrink_pairs(
@@ -546,10 +870,18 @@ def _build_index_streaming(
                     term=pt[sel], doc=pd[sel], tf=ptf[sel])
             report_progress("pass2_combine", advance=1,
                             spills_written=num_shards, pairs=npairs)
+            if buckets:
+                from ..obs import get_registry
+
+                reg = get_registry()
+                reg.observe("build.radix.bucket_pairs", float(npairs))
+                reg.observe("build.radix.bucket_s",
+                            time.perf_counter() - t0)
             faults.maybe_crash("crash.pass2", f"b={b}")
 
         pending = None
-        for b, term_ids, docnos, lengths in iter_batches():
+        for b, term_ids, docnos, lengths in batch_iter:
+            t0 = time.perf_counter()
             cap = _round_cap(len(term_ids))
             t_pad = np.full(cap, PAD_TERM_U16 if use16 else PAD_TERM,
                             np.uint16 if use16 else np.int32)
@@ -571,7 +903,7 @@ def _build_index_streaming(
                 a.copy_to_host_async()
             if pending is not None:
                 collect_batch(*pending)
-            pending = (b, p, tf_max)
+            pending = (b, p, tf_max, t0)
         if pending is not None:
             collect_batch(*pending)
 
@@ -621,20 +953,114 @@ def _build_index_streaming(
                             pairs=int(npairs.sum()))
             faults.maybe_crash("crash.pass2", f"b={b}")
 
-    report_progress("pass2_combine", total=n_batches)
+    def pass2_spmd_radix():
+        # buckets partitioned ACROSS devices: rounds of S buckets, each
+        # device running the whole local reduce for its own bucket (no
+        # collective — a bucket's pairs never leave the device that
+        # reduced them) with donated input buffers (the SNIPPETS pjit
+        # donation pattern: the occurrence upload is dead after the
+        # reduce consumes it, so XLA reuses its pages for the output).
+        from ..parallel import make_mesh
+        from ..parallel.sharded_build import radix_bucket_reduce
+
+        s = spmd_devices
+        mesh = make_mesh(s)
+        use16 = v < int(PAD_TERM_U16)
+        round_items: list = []
+
+        def reduce_round(items):
+            t_cap = _round_cap(max(len(t_) for _, t_, _, _ in items))
+            d_cap = _round_cap(max(len(l_) for _, _, _, l_ in items),
+                               1 << 14)
+            t_arr = np.full((s, t_cap),
+                            PAD_TERM_U16 if use16 else PAD_TERM,
+                            np.uint16 if use16 else np.int32)
+            d_arr = np.zeros((s, d_cap), np.int32)
+            l_arr = np.zeros((s, d_cap), np.int32)
+            for i, (_, t_, d_, l_) in enumerate(items):
+                t_arr[i, : len(t_)] = t_
+                d_arr[i, : len(d_)] = d_
+                l_arr[i, : len(l_)] = l_
+            out = radix_bucket_reduce(t_arr, d_arr, l_arr, vocab_size=v,
+                                      total_docs=num_docs, mesh=mesh)
+            npairs, tf_max = fetch_to_host(out.num_pairs,
+                                           jnp.max(out.pair_tf))
+            valid = int(npairs.max()) if len(npairs) else 1
+            pt, pd, ptf = fetch_to_host(
+                shrink_rows_for_fetch(out.pair_term, valid,
+                                      dtype=narrow_uint(v - 1),
+                                      valid_rows=out.num_pairs),
+                shrink_rows_for_fetch(out.pair_doc, valid,
+                                      dtype=narrow_uint(num_docs),
+                                      valid_rows=out.num_pairs),
+                shrink_rows_for_fetch(out.pair_tf, valid,
+                                      dtype=narrow_uint(int(tf_max)),
+                                      valid_rows=out.num_pairs))
+            from ..obs import get_registry
+
+            reg = get_registry()
+            for i, (r, _, _, _) in enumerate(items):
+                if r < 0:  # tail-round pad row, owns no bucket
+                    continue
+                n_r = int(npairs[i])
+                t_row = pt[i][:n_r].astype(np.int32)
+                d_row, w_row = pd[i][:n_r], ptf[i][:n_r]
+                shard = t_row % num_shards
+                for sh in range(num_shards):
+                    sel = shard == sh
+                    fmt.savez_atomic(
+                        os.path.join(spill_dir,
+                                     f"pairs-{sh:03d}-{r:05d}.npz"),
+                        term=t_row[sel], doc=d_row[sel], tf=w_row[sel])
+                reg.observe("build.radix.bucket_pairs", float(n_r))
+                report_progress("pass2_combine", advance=1,
+                                spills_written=num_shards, pairs=n_r)
+                faults.maybe_crash("crash.pass2", f"b={r}")
+
+        from ..utils.transfer import prefetch_iter
+
+        for item in prefetch_iter(iter_buckets(), name="bucket-read"):
+            round_items.append(item)
+            if len(round_items) == s:
+                reduce_round(round_items)
+                round_items = []
+        if round_items:
+            # tail round: pad to the mesh width with empty buckets
+            while len(round_items) < s:
+                round_items.append((-1, np.zeros(0, np.int32),
+                                    np.zeros(0, np.int32),
+                                    np.zeros(0, np.int32)))
+            reduce_round(round_items)
+
+    report_progress("pass2_combine", total=radix_buckets or n_batches,
+                    unit="buckets" if radix_buckets else "batches")
     with report.phase("pass2_combine"):
-        if spmd_devices:
+        if radix_buckets and spmd_devices:
+            pass2_spmd_radix()
+        elif radix_buckets:
+            from ..utils.transfer import prefetch_iter
+
+            pass2_single_device(
+                prefetch_iter(iter_buckets(), name="bucket-read"),
+                unit="buckets")
+        elif spmd_devices:
             pass2_spmd()
         else:
-            pass2_single_device()
+            pass2_single_device(iter_batches())
     report.set_counter("map_output_records", occurrences)
 
     # ---- pass 3: per-shard reduce -> part files ----
     # (reduce_shard_spills: pure host sort per shard; the device keeps the
-    # role it wins at — the per-batch shuffle+reduce)
+    # role it wins at — the per-batch shuffle+reduce. With radix buckets
+    # the "batch" index of the pass-2 spills is the bucket id; with
+    # radix_parts the sort is skipped entirely and parts come out
+    # bucket-segmented, with the dictionary offsets derived from the
+    # actual part layout instead of the canonical term order.)
+    n_units = radix_buckets or n_batches
     df = np.zeros(v, np.int32)
     num_pairs_total = 0
     shard_of = fmt.shard_assignment(v, num_shards)
+    offset_of_parts = np.zeros(v, np.int64) if radix_parts else None
     report_progress("pass3_reduce", total=num_shards)
     with report.phase("pass3_reduce"):
         for s in range(num_shards):
@@ -684,12 +1110,21 @@ def _build_index_streaming(
                 rdf = np.zeros(v, np.int32)
                 rdf[z["term_ids"]] = z["df"]
                 npairs = len(z["pair_doc"])
+                if offset_of_parts is not None:
+                    offset_of_parts[z["term_ids"]] = \
+                        np.asarray(z["indptr"][:-1], np.int64)
                 report.incr("pass3_resumed_shards", 1)
                 report_progress("pass3_reduce", advance=1,
                                 resumed_shards=1)
+            elif radix_parts:
+                rdf, npairs = write_bucketed_shard(
+                    spill_dir, index_dir, s, radix_buckets, v,
+                    offset_of=offset_of_parts)
+                report_progress("pass3_reduce", advance=1,
+                                shards_reduced=1, pairs=npairs)
             else:
                 rdf, npairs = reduce_shard_spills(
-                    spill_dir, index_dir, s, n_batches, v, shard_of,
+                    spill_dir, index_dir, s, n_units, v, shard_of,
                     positions=positions)
             faults.maybe_crash("crash.pass3", f"s={s}")
             num_pairs_total += npairs
@@ -700,7 +1135,13 @@ def _build_index_streaming(
     with report.phase("dictionary"):
         np.save(os.path.join(index_dir, fmt.DOCLEN),
                 doc_len.astype(np.int32))
-        _, offset_of = fmt.shard_local_offsets(df, num_shards)
+        if offset_of_parts is not None:
+            # bucket-segmented parts: a term's postings start where its
+            # part actually put them, not where the canonical sorted
+            # order would — the dictionary must point into the real file
+            offset_of = offset_of_parts
+        else:
+            _, offset_of = fmt.shard_local_offsets(df, num_shards)
         fmt.write_dictionary(index_dir, vocab.terms, shard_of, offset_of)
         dict_report = JobReport("BuildIntDocVectorsForwardIndex")
         dict_report.set_counter("Dictionary.Size", v)

@@ -48,6 +48,14 @@ def verify_index(index_dir: str) -> dict:
 
     seen_terms = np.zeros(meta.vocab_size, bool)
     df_global = np.zeros(meta.vocab_size, np.int64)
+    # each term's actual postings start inside its part, read off the
+    # part's own indptr: for the canonical (globally term-sorted) layout
+    # this reproduces fmt.shard_local_offsets exactly, and for the
+    # bucket-segmented layout (radix_parts builds — term ids ascend only
+    # within each bucket segment) it is the offset the dictionary MUST
+    # record, so one collection serves both layouts
+    offset_actual = np.zeros(meta.vocab_size, np.int64)
+    segmented_shards = 0
     total_pairs = 0
     total_tf = 0
     for s in range(meta.num_shards):
@@ -55,9 +63,21 @@ def verify_index(index_dir: str) -> dict:
         tids, indptr = z["term_ids"], z["indptr"]
         pd, ptf, df = z["pair_doc"], z["pair_tf"], z["df"]
         assert ((tids % meta.num_shards) == s).all(), f"shard {s}: foreign term"
-        assert (np.diff(tids) > 0).all(), f"shard {s}: term ids not sorted"
+        if len(tids) > 1 and not (np.diff(tids) > 0).all():
+            # bucket-segmented part (index/streaming.write_bucketed_shard):
+            # terms must still be UNIQUE across the part, and every
+            # descending step must be a segment boundary — i.e. within
+            # each maximal ascending run the ids strictly ascend, which
+            # the run decomposition gives by construction; uniqueness is
+            # the real invariant (a duplicated term would double-count
+            # df and desync the dictionary)
+            segmented_shards += 1
+            sorted_tids = np.sort(tids)
+            assert (np.diff(sorted_tids) > 0).all(), \
+                f"shard {s}: duplicated terms"
         assert not seen_terms[tids].any(), f"shard {s}: duplicated terms"
         seen_terms[tids] = True
+        offset_actual[tids] = indptr[:-1]
         assert len(indptr) == len(tids) + 1, f"shard {s}: indptr length"
         assert (np.diff(indptr) >= 0).all(), f"shard {s}: indptr not monotone"
         assert indptr[-1] == len(pd) == len(ptf), f"shard {s}: nnz mismatch"
@@ -121,13 +141,21 @@ def verify_index(index_dir: str) -> dict:
     assert total_pairs == meta.num_pairs, "num_pairs != metadata"
     assert total_tf == int(doc_len.sum()), "sum(tf) != sum(doc_len)"
 
-    # dictionary: sorted, complete, offsets point at real slices. The whole
-    # expected file is regenerated from the vocab + df (offsets are each
-    # term's local CSR position within its shard) and compared as one string
-    # — the reference's one-position-per-term assert, without a per-term loop.
-    shard_of, offset_of = fmt.shard_local_offsets(df_global, meta.num_shards)
+    # dictionary: sorted, complete, offsets point at real slices. The
+    # whole expected file is regenerated from the vocab + the offsets
+    # COLLECTED from the parts themselves (for the canonical layout
+    # these equal fmt.shard_local_offsets' derivation from df; for
+    # bucket-segmented parts they are the only correct answer) and
+    # compared as one string — the reference's one-position-per-term
+    # assert, without a per-term loop.
+    shard_of = fmt.shard_assignment(meta.vocab_size, meta.num_shards)
+    if not segmented_shards:
+        _, offset_canon = fmt.shard_local_offsets(df_global,
+                                                  meta.num_shards)
+        assert (offset_actual == offset_canon).all(), \
+            "part CSR offsets diverge from the canonical term order"
     expected = "".join(
-        f"{term}\t{shard_of[tid]}\t{offset_of[tid]}\n"
+        f"{term}\t{shard_of[tid]}\t{offset_actual[tid]}\n"
         for tid, term in enumerate(vocab.terms))
     assert dict_text == expected, "dictionary content mismatch"
     terms_arr = np.array(vocab.terms, dtype=np.str_)
@@ -154,6 +182,7 @@ def verify_index(index_dir: str) -> dict:
     return {
         "checksums_verified": checksums_verified,
         "dictionary_terms_checked": dict_checked,
+        "bucket_segmented_shards": segmented_shards,
         "has_positions": meta.has_positions,
         "num_docs": meta.num_docs,
         "vocab_size": meta.vocab_size,

@@ -101,6 +101,15 @@ METRICS: dict[str, tuple[str, float]] = {
     "routed_p99_ms": ("lower", 50.0),
     "partial_fraction": ("lower", 0.05),
     "hedge_fired": ("lower", 5.0),
+    # streaming-build phase walls (ISSUE 11: wiki/build_scale rows) —
+    # the radix restructure's whole point is driving pass2_combine_s
+    # down, so the sentry gates each pass plus the end-to-end build
+    # wall, direction-aware lower-is-better with per-phase noise floors
+    # sized to container weather on second-scale builds
+    "build_s": ("lower", 2.0),
+    "pass1_tokenize_s": ("lower", 1.0),
+    "pass2_combine_s": ("lower", 1.0),
+    "pass3_reduce_s": ("lower", 1.0),
 }
 
 

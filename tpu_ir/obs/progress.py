@@ -69,21 +69,25 @@ class Job:
         self._lock = threading.Lock()
         self._phases: dict[str, dict] = {
             p: {"done": 0, "total": None, "counters": {},
-                "started": None} for p in phases}
+                "started": None, "unit": None} for p in phases}
         self._current: str | None = None
         self._max_percent = 0.0
 
     # -- mutation ----------------------------------------------------------
 
     def report(self, phase: str | None, advance: int = 0,
-               total: int | None = None, **counters) -> None:
+               total: int | None = None, unit: str | None = None,
+               **counters) -> None:
         """Record progress against `phase` (created on first mention and
         made the current phase; None targets whatever phase is current —
         the shape shared helpers like the SPMD shuffle use, since they
         run under different phases in different builds): `advance` bumps
-        its done count, `total` (re)declares its task count, and keyword
-        counters add into its free-form counter table. Safe from any
-        thread."""
+        its done count, `total` (re)declares its task count, `unit`
+        labels what the tasks ARE (a radix build's pass 2 counts
+        "buckets" where the legacy build counts "batches" — the /jobs
+        page renders the label so the done/total needle is readable),
+        and keyword counters add into its free-form counter table. Safe
+        from any thread."""
         with self._lock:
             if phase is None:
                 phase = self._current or "main"
@@ -91,7 +95,7 @@ class Job:
             if st is None:
                 st = self._phases[phase] = {
                     "done": 0, "total": None, "counters": {},
-                    "started": None}
+                    "started": None, "unit": None}
             if st["started"] is None:
                 st["started"] = time.time()
             if self._current != phase:
@@ -100,6 +104,8 @@ class Job:
                 self._current = phase
             if total is not None:
                 st["total"] = int(total)
+            if unit is not None:
+                st["unit"] = unit
             if advance:
                 st["done"] += int(advance)
             for k, v in counters.items():
@@ -160,6 +166,8 @@ class Job:
                 row = {"phase": name, "done": st["done"],
                        "total": st["total"],
                        "counters": dict(st["counters"])}
+                if st.get("unit"):
+                    row["unit"] = st["unit"]
                 if st["total"]:
                     row["percent"] = round(
                         100.0 * min(st["done"] / st["total"], 1.0), 2)
@@ -208,14 +216,16 @@ def current_job() -> Job | None:
 
 
 def report_progress(phase: str | None, advance: int = 0,
-                    total: int | None = None, **counters) -> None:
+                    total: int | None = None, unit: str | None = None,
+                    **counters) -> None:
     """THE hook the builders/soak call: forward to the current job, or
     do nothing when no job is registered (a bare library call — e.g. a
     test driving build_index directly — must pay one lock + deque scan,
     nothing more)."""
     job = current_job()
     if job is not None:
-        job.report(phase, advance=advance, total=total, **counters)
+        job.report(phase, advance=advance, total=total, unit=unit,
+                   **counters)
 
 
 @contextlib.contextmanager

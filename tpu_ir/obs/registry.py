@@ -47,6 +47,7 @@ FAULT_SITES = (
     "shuffle_overflow",    # parallel/sharded_build.py: all_to_all drop
     "score.hang",          # search/scorer.py: hung device dispatch
     "score.device_loss",   # search/scorer.py: device lost mid-dispatch
+    "tokenize.pool",       # analysis/pool.py: tokenizer pool chunk failure
 )
 
 # Serving-stage span names (the per-request span tree) — each gets a
@@ -131,12 +132,24 @@ ROUTER_COUNTER_NAMES = (
     "router.breaker_opened", "router.worker_respawn",
 )
 
+# Radix-partitioned streaming build (ISSUE 11): pass-1 bucketed pair
+# spills and the pass-2 per-bucket device reduces. bucket_spills counts
+# spill files written, spill_bytes their on-disk size (the per-phase
+# bytes the scaling sweep records), tokenize.pool_chunks the chunks the
+# multiprocess tokenizer analyzed out-of-process, and pipeline_stalls
+# the times the device had to WAIT on the host prefetch (a high count
+# says raise TPU_IR_PIPE_DEPTH or bucket count).
+BUILD_COUNTER_NAMES = (
+    "build.radix.bucket_spills", "build.radix.spill_bytes",
+    "build.radix.pipeline_stalls", "build.tokenize.pool_chunks",
+)
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
 ) + (COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
-     + ROUTER_COUNTER_NAMES)
+     + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
@@ -161,6 +174,12 @@ DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     "router.request",
     "router.shard_rtt",
     "router.merge",
+    # radix streaming build (ISSUE 11): valid pairs each pass-2 bucket
+    # reduce produced (bucket-balance readout — a skewed distribution
+    # shows up as a wide histogram) and the wall seconds one bucket's
+    # read->remap->reduce->spill round took
+    "build.radix.bucket_pairs",
+    "build.radix.bucket_s",
 )
 
 # Gauges: point-in-time values (memory levels, cache sizes) — unlike

@@ -225,8 +225,10 @@ def _build_index_multihost(
     n_batches = 0
     batch_dev_caps: list[int] = []  # max per-device occupancy per batch
     if resume_state is not None:
-        my_docids, local_vocab, n_batches, caps = resume_state
-        batch_dev_caps = [int(c) for c in caps]
+        my_docids = resume_state.docids
+        local_vocab = resume_state.vocab
+        n_batches = resume_state.n_batches
+        batch_dev_caps = [int(c) for c in resume_state.batch_occ]
         report.incr("Count.DOCS", len(my_docids))
         report.set_counter("pass1_resumed_batches", n_batches)
         report_progress("pass1_tokenize", advance=n_batches,
@@ -240,8 +242,8 @@ def _build_index_multihost(
             # the shared loop records the batch's max per-device
             # occupancy — pass 2 negotiates one global capacity from
             # these, with no second read of the spills
-            my_docids, local_vocab, n_batches, batch_dev_caps, spill_crcs \
-                = run_pass1_spills(
+            (my_docids, local_vocab, n_batches, batch_dev_caps,
+             spill_crcs, _doc_lens) = run_pass1_spills(
                     tok, spill_dir, batch_docs, store, report,
                     text_path_fn=lambda b: os.path.join(
                         text_dir, f"text-p{pi:03d}-{b:05d}.npz"),

@@ -544,12 +544,18 @@ class Scorer:
     @staticmethod
     def _assemble_csr(index_dir: str, meta, verify: bool = False):
         """Shard files -> (df, (pair_doc, pair_tf)) in global CSR order:
-        a shard holds its terms ascending with contiguous per-term runs,
-        so every run's destination is the global indptr slice of its
-        term — no sort needed (a stable argsort over the pair columns
-        costs ~2 min at 250M pairs on one core; this is a few vectorized
-        passes). pair_term is NOT materialized — it is derivable from df
-        alone and nothing on the assembly path reads it.
+        a shard holds contiguous per-term runs, so every run's
+        destination is the global indptr slice of its TERM ID — no sort
+        needed (a stable argsort over the pair columns costs ~2 min at
+        250M pairs on one core; this is a few vectorized passes), and
+        no dependence on the runs' order WITHIN the part: the canonical
+        layout (terms globally ascending) and the bucket-segmented
+        radix_parts layout (terms ascending only within each bucket
+        segment — index/streaming.write_bucketed_shard) assemble to the
+        same global CSR through the same scatter. pair_term is NOT
+        materialized — it is derivable from df alone (both layouts keep
+        one contiguous run per term) and nothing on the assembly path
+        reads it.
 
         Shards load concurrently through a thread pool
         (TPU_IR_LOAD_THREADS; numpy releases the GIL on large reads, so

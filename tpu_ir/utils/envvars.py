@@ -146,6 +146,23 @@ _declare("TPU_IR_BATCH_DONATE", "choice", "auto",
          "donate the query-side device buffer on coalesced topk "
          "dispatches: auto (TPU backends only), 1 (force), 0 (off)",
          "§16", choices=("auto", "0", "1"))
+_declare("TPU_IR_RADIX_BUCKETS", "int", 0,
+         "radix buckets the streaming pass-1 partitions its pair spills "
+         "into (0 = legacy per-batch pass-2 combine; >0 turns pass 2 "
+         "into per-bucket local device reduces)", "§18", minimum=0)
+_declare("TPU_IR_TOKENIZE_PROCS", "int", 1,
+         "worker processes for the pure-Python tokenizer (1 = in-process;"
+         " N>1 analyzes chunks in a pool, byte-identical to serial)",
+         "§18", minimum=1)
+_declare("TPU_IR_PIPE_DEPTH", "int", 2,
+         "build pipeline depth: spill batches / pass-2 buckets the host "
+         "prepares ahead of the device (1 = no overlap)", "§18",
+         minimum=1)
+_declare("TPU_IR_RADIX_PARTS", "bool", False,
+         "1 writes bucket-segmented part files straight from the pass-2 "
+         "bucket reduces (skips the pass-3 global per-shard sort; parts "
+         "are NOT byte-identical to the canonical layout — readers "
+         "accept both)", "§18")
 _declare("TPU_IR_QUERYLOG", "bool", True,
          "0 disables the sampled query log AND the slow-query trap",
          "§15")

@@ -10,6 +10,8 @@ faster than sequential fetches for the index-build result set.
 
 from __future__ import annotations
 
+import threading
+import time
 from functools import partial
 
 import numpy as np
@@ -251,6 +253,118 @@ def _check_crc(crc: int, expected: str, label: str | None) -> None:
             label or "<array>",
             f"checksum mismatch during device upload (recorded "
             f"{expected}, found {got}); the artifact is corrupt")
+
+
+def pipeline_depth() -> int:
+    """Host-side build pipeline depth (TPU_IR_PIPE_DEPTH, default 2):
+    how many items the prefetch side of a producer->device pipeline may
+    run ahead of the consumer. 1 disables overlap (strict lockstep)."""
+    from . import envvars
+
+    return envvars.get_int("TPU_IR_PIPE_DEPTH")
+
+
+_PREFETCH_STOP = object()
+
+
+def prefetch_iter(it, depth: int | None = None, name: str = "prefetch"):
+    """Run an iterator on a background thread, `depth` items ahead.
+
+    The double-buffering primitive of the streaming build's
+    tokenize->device pipeline (ISSUE 11), generalized from the
+    stream_to_device overlap machinery (ISSUE 5): while the consumer —
+    typically a device dispatch plus its D2H collection — works on item
+    N, the producer thread is already reading/preparing items N+1..N+d.
+    numpy file reads and zlib CRC folds release the GIL, so host IO
+    genuinely overlaps XLA compute even on the CPU backend.
+
+    Exceptions (BaseException included — an InjectedCrash must propagate
+    like a real death) raised by the producer are re-raised in the
+    consumer at the point the poisoned item would have been yielded.
+    The producer thread is a daemon and is joined on clean exhaustion;
+    an abandoned consumer (its own exception) unblocks the producer by
+    draining the queue on close."""
+    import queue
+
+    if depth is None:
+        depth = pipeline_depth()
+    if depth <= 1:
+        yield from it
+        return
+    q: queue.Queue = queue.Queue(maxsize=depth)
+    # cancellation flag, not just a drain: an abandoned consumer (its
+    # own exception mid-build) must STOP the producer, or a tokenizer
+    # with hours of corpus left would keep running — parking forever on
+    # put() with batch-sized arrays pinned once the one-shot drain below
+    # stopped. The producer re-checks the flag on every bounded put.
+    stop = threading.Event()
+
+    def produce():
+        def put(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        try:
+            for item in it:
+                if not put((None, item)):
+                    return
+        except BaseException as e:  # re-raised on the consumer side
+            put((e, None))
+        else:
+            put((None, _PREFETCH_STOP))
+
+    t = threading.Thread(target=produce, daemon=True,
+                         name=f"tpu-ir-{name}")
+    t.start()
+    from ..obs import get_registry
+
+    started = False
+    try:
+        while True:
+            if started and q.empty() and t.is_alive():
+                # the device outran the host MID-STREAM: the stall
+                # counter is the "raise the pipeline depth" signal in
+                # tpu-ir stats. The guaranteed-empty wait for the first
+                # item and the end-of-stream sentinel are not stalls —
+                # counting them would report a 25-50% phantom stall
+                # rate on small bucket counts.
+                get_registry().incr("build.radix.pipeline_stalls")
+            exc, item = q.get()
+            if exc is not None:
+                raise exc
+            if item is _PREFETCH_STOP:
+                break
+            started = True
+            yield item
+        t.join()
+    finally:
+        stop.set()
+        # unblock a producer parked in its put wait, then wait for it to
+        # actually EXIT: callers (run_pass1_spills) free native state the
+        # producer reads (the tokenizer handle) right after closing this
+        # generator, so returning while the thread still runs would be a
+        # use-after-free. The producer re-checks `stop` every 0.1 s, so
+        # this only blocks for the item currently being produced; a
+        # warning fires if that item is pathologically slow.
+        waited = 0.0
+        while t.is_alive():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.5)
+            waited += 0.5
+            if waited and waited % 30.0 == 0.0:
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "prefetch producer %r still draining after %.0fs "
+                    "(slow source read?)", name, waited)
 
 
 def narrow_uint(max_value: int):
