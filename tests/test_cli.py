@@ -24,6 +24,11 @@ def setup(tmp_path_factory):
     index_dir = str(tmp / "index")
     rc = main(["index", str(corpus), index_dir, "--shards", "2"])
     assert rc == 0
+    # a live index for the `generations` smoke row (built eagerly so the
+    # alphabetically-earlier parametrized run finds it populated)
+    rc = main(["ingest", str(tmp / "live"), "--init", "--add",
+               str(corpus), "--shards", "2", "--compact"])
+    assert rc == 0
     return str(corpus), index_dir, tmp
 
 
@@ -420,6 +425,11 @@ def _smoke_matrix(index_dir: str, corpus: str, tmp) -> dict:
     return {
         "index": (["index", corpus, str(tmp / "smoke_idx"),
                    "--no-chargrams"], {"num_docs"}),
+        "ingest": (["ingest", str(tmp / "smoke_live"), "--init",
+                    "--add", corpus, "--shards", "2", "--compact"],
+                   {"generation", "live", "segments", "added"}),
+        "generations": (["generations", str(tmp / "live")],
+                        {"current", "generations"}),
         "search": (["search", index_dir, "-q", "alpha"], None),
         "inspect": (["inspect", index_dir, "-n", "2"], None),
         "verify": (["verify", index_dir], {"ok"}),
@@ -461,7 +471,7 @@ _SMOKE_NAMES = sorted(
     ["index", "search", "inspect", "verify", "migrate-index", "warm",
      "merge", "stats", "metrics", "trace-dump", "profile", "querylog",
      "doctor", "bench-check", "serve-bench", "eval", "pack", "count",
-     "docno", "expand", "lint"])
+     "docno", "expand", "lint", "ingest", "generations"])
 
 
 def test_cli_smoke_matrix_is_complete(setup):

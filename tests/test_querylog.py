@@ -323,11 +323,17 @@ def test_serve_bench_report_carries_querylog(index_dir, capsys):
 # ---------------------------------------------------------------------------
 
 
-def test_querylog_overhead_within_five_percent(scorer):
+def test_querylog_overhead_within_bound(scorer):
     """The steady-state pin: a 200-query serving soak with the query
-    log on stays within 5% of off (plus absolute slack for scheduler
-    noise on a loaded CI box) — same guard style as the PR 3 tracing
-    pin."""
+    log on stays close to off — same guard style as the PR 3 tracing
+    pin. Thresholds are sized for PARALLEL CI, not an idle box (the
+    ISSUE 12 deflake): best-of-N absorbs one descheduled run, the 10%
+    relative term still catches a real per-entry regression (the log's
+    actual cost measured ~1%), and the absolute slack covers the
+    scheduler/GC spikes a loaded 2-core container lands on EITHER arm
+    of the comparison. Under heavy external load the comparison is
+    meaningless noise — detected via a control re-run of the SAME arm
+    and skipped rather than flaking."""
     reqs = make_queries(scorer, 200, seed=7)
     frontend = ServingFrontend(scorer, ServingConfig(
         max_concurrency=4, max_queue=16))
@@ -341,10 +347,18 @@ def test_querylog_overhead_within_five_percent(scorer):
 
     soak_once()                      # warm every query shape
     timings = {}
+    spread = {}
     for enabled in (True, False):
         querylog.configure(enabled=enabled)
-        timings[enabled] = min(soak_once() for _ in range(2))
+        runs = sorted(soak_once() for _ in range(3))
+        timings[enabled] = runs[0]
+        spread[enabled] = runs[-1] / max(runs[0], 1e-9)
     querylog.configure(enabled=True)
-    assert timings[True] <= timings[False] * 1.05 + 0.15, (
+    if max(spread.values()) > 1.5:
+        # same-arm repeats disagreeing by >50% means the box is under
+        # external load — the A/B delta is weather, not signal
+        pytest.skip(f"host too loaded for a timing comparison "
+                    f"(same-arm spread {spread})")
+    assert timings[True] <= timings[False] * 1.10 + 0.6, (
         f"querylog overhead too high: on {timings[True]:.3f}s vs "
         f"off {timings[False]:.3f}s")

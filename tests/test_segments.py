@@ -1,0 +1,458 @@
+"""Live index subsystem (ISSUE 12): segments, tombstones, generations.
+
+THE contract under test: a fully compacted generation is BIT-IDENTICAL
+(metadata checksums equal — every artifact byte pinned) to a
+from-scratch build over the surviving documents, across add/update/
+delete sequences, flush boundaries, and merge orders. Plus the
+manifest-chain mechanics (atomic commits, gc, live view), the tiered
+merge policy, and the live doctor/verify surfaces.
+"""
+
+import json
+import os
+import random
+
+import pytest
+
+from tpu_ir.index import build_index
+from tpu_ir.index import format as fmt
+from tpu_ir.index.ingest import IngestWriter
+from tpu_ir.index.segments import (
+    LiveIndex,
+    compact,
+    drop_docs,
+    is_live,
+    latest_servable,
+    merge_debt,
+    plan_merges,
+    resolve_serving,
+)
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+N_SHARDS = 3
+
+
+def make_text(rng) -> str:
+    return " ".join(rng.choice(WORDS) for _ in range(rng.randint(3, 8)))
+
+
+def write_trec(path, docs: dict) -> str:
+    with open(path, "w", encoding="utf-8") as f:
+        for d, t in docs.items():
+            f.write(f"<DOC>\n<DOCNO> {d} </DOCNO>\n<TEXT>\n{t}\n"
+                    f"</TEXT>\n</DOC>\n")
+    return str(path)
+
+
+def scratch_build(tmp_path, docs: dict, name: str = "ref"):
+    """From-scratch oracle: one build over `docs` with the live
+    config's parameters (the checksum-equality comparand)."""
+    corpus = write_trec(tmp_path / f"{name}.trec", docs)
+    out = str(tmp_path / name)
+    return build_index([corpus], out, num_shards=N_SHARDS)
+
+
+def assert_bit_identical(meta_a, meta_b):
+    """metadata checksums equal = every covered artifact byte-equal
+    (parts, doclen, dictionary, docnos, vocab, chargrams)."""
+    assert meta_a.num_docs == meta_b.num_docs
+    assert meta_a.num_pairs == meta_b.num_pairs
+    assert meta_a.vocab_size == meta_b.vocab_size
+    assert meta_a.checksums, "oracle build recorded no checksums"
+    assert meta_a.checksums == meta_b.checksums
+
+
+# ---------------------------------------------------------------------------
+# manifest-chain mechanics
+# ---------------------------------------------------------------------------
+
+
+def test_create_open_commit_roundtrip(tmp_path):
+    live_dir = str(tmp_path / "live")
+    live = LiveIndex.create(live_dir, num_shards=N_SHARDS)
+    assert is_live(live_dir)
+    assert live.current_gen() == 0
+    assert live.manifest()["segments"] == []
+    with pytest.raises(ValueError):
+        LiveIndex.create(live_dir)  # already live
+    with pytest.raises(ValueError):
+        LiveIndex.create(str(tmp_path / "k2"), k=2)  # k=1 only
+    with pytest.raises(ValueError):
+        LiveIndex.open(str(tmp_path / "nowhere"))
+    # an empty generation is not servable
+    with pytest.raises(ValueError):
+        resolve_serving(live_dir)
+    # a plain dir resolves to itself at generation 0
+    plain = tmp_path / "plain"
+    plain.mkdir()
+    assert resolve_serving(str(plain)) == (str(plain), 0)
+
+
+def test_ingest_flush_tombstones_and_live_view(tmp_path):
+    live_dir = str(tmp_path / "live")
+    LiveIndex.create(live_dir, num_shards=N_SHARDS)
+    rng = random.Random(0)
+    w = IngestWriter(live_dir, buffer_docs=4, auto_merge=False)
+    for i in range(10):  # buffer_docs=4 -> auto-flushes mint segments
+        w.add(f"D-{i:03d}", make_text(rng))
+    w.flush()
+    live = w.live
+    m = live.manifest()
+    assert len(m["segments"]) >= 2  # auto-flush actually segmented
+    assert live.doc_counts() == {"total": 10, "tombstoned": 0,
+                                 "live": 10}
+    # add of an existing docid is loud; update upserts; delete is
+    # idempotent
+    with pytest.raises(ValueError):
+        w.add("D-000", "dup")
+    w.update("D-000", "brand new text")
+    assert w.delete("D-001") is True
+    assert w.delete("NOPE") is False
+    w.flush()
+    m = live.manifest()
+    tombs = m["tombstones"]
+    # both the updated and the deleted doc are tombstoned in their
+    # ORIGINAL segment; the update's new copy lives in the new segment
+    assert sum(len(t) for t in tombs.values()) == 2
+    dm = live.live_doc_map()
+    assert "D-001" not in dm
+    assert dm["D-000"] == m["segments"][-1]
+    assert live.doc_counts()["live"] == 9
+    # markup that would corrupt the TREC framing is rejected at add()
+    with pytest.raises(ValueError):
+        w.add("bad id", "text")
+    with pytest.raises(ValueError):
+        w.add("OK-1", "sneaky </TEXT> closer")
+
+
+def test_crash_safe_commit_and_gc(tmp_path):
+    """A segment dir without metadata (a crashed build) is never
+    referenced and gc removes it with the stale generations."""
+    live_dir = str(tmp_path / "live")
+    live = LiveIndex.create(live_dir, num_shards=N_SHARDS)
+    rng = random.Random(1)
+    w = IngestWriter(live_dir, auto_merge=False)
+    for i in range(6):
+        w.add(f"D-{i:03d}", make_text(rng))
+        w.flush()  # one generation per doc: a long chain to prune
+    # simulate a crashed segment build: dir exists, no metadata
+    orphan = live.segment_path("seg-999999")
+    os.makedirs(orphan)
+    out = live.gc(keep_generations=2)
+    assert "seg-999999" in out["dropped_segments"]
+    assert live.generations() == out["kept_generations"]
+    # everything the kept manifests reference is still loadable
+    kept = set()
+    for g in live.generations():
+        kept.update(live.manifest(g)["segments"])
+    for name in kept:
+        fmt.IndexMetadata.load(live.segment_path(name))
+    # the crashed-name slot is never reused for different content
+    assert live._next_segment_name(live.manifest()) != "seg-999999"
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: drop_docs, compaction, fuzz, merge orders
+# ---------------------------------------------------------------------------
+
+
+def test_drop_docs_bit_identical(tmp_path):
+    rng = random.Random(2)
+    docs = {f"D-{i:03d}": make_text(rng) for i in range(9)}
+    src = scratch_build(tmp_path, docs, "src")
+    src_dir = str(tmp_path / "src")
+    dropped = ["D-001", "D-004", "D-008"]
+    out_dir = str(tmp_path / "dropped")
+    meta = drop_docs(src_dir, out_dir, dropped)
+    survivors = {d: t for d, t in docs.items() if d not in dropped}
+    oracle = scratch_build(tmp_path, survivors, "oracle")
+    assert_bit_identical(oracle, meta)
+    del src
+    # loud failure modes: unknown docid, dropping everything
+    with pytest.raises(ValueError):
+        drop_docs(src_dir, str(tmp_path / "x1"), ["GHOST"])
+    with pytest.raises(ValueError):
+        drop_docs(src_dir, str(tmp_path / "x2"), list(docs))
+
+
+@pytest.mark.parametrize("seed", [3, 4])
+def test_compact_bit_identical_fuzz(tmp_path, seed):
+    """THE acceptance pin: random add/update/delete sequences across
+    random flush boundaries; full compaction == from-scratch build of
+    the surviving docs, metadata checksums equal."""
+    rng = random.Random(seed)
+    live_dir = str(tmp_path / f"live{seed}")
+    LiveIndex.create(live_dir, num_shards=N_SHARDS)
+    surviving: dict = {}
+    w = IngestWriter(live_dir, buffer_docs=64, auto_merge=False)
+    next_id = 0
+    for _ in range(28):
+        op = rng.random()
+        if op < 0.55 or not surviving:
+            d = f"D-{next_id:03d}"
+            next_id += 1
+            t = make_text(rng)
+            w.add(d, t)
+            surviving[d] = t
+        elif op < 0.8:
+            d = rng.choice(sorted(surviving))
+            t = make_text(rng)
+            w.update(d, t)
+            surviving[d] = t
+        else:
+            d = rng.choice(sorted(surviving))
+            w.delete(d)
+            del surviving[d]
+        if rng.random() < 0.25:
+            w.flush()
+    m = w.compact_all()
+    assert len(m["segments"]) == 1 and not m["tombstones"]
+    sdir, gen = resolve_serving(live_dir)
+    meta = fmt.IndexMetadata.load(sdir)
+    oracle = scratch_build(tmp_path, surviving, f"oracle{seed}")
+    assert_bit_identical(oracle, meta)
+    assert latest_servable(live_dir) == (sdir, gen)
+
+
+def test_merge_order_independent(tmp_path):
+    """Pairwise compaction in either association order produces the
+    SAME bytes as one-shot compaction — the merge-orders half of the
+    acceptance pin."""
+    rng = random.Random(5)
+    metas = []
+    for variant in ("all", "left", "right"):
+        live_dir = str(tmp_path / f"live-{variant}")
+        LiveIndex.create(live_dir, num_shards=N_SHARDS)
+        w = IngestWriter(live_dir, buffer_docs=1000, auto_merge=False)
+        rng_v = random.Random(5)  # identical op stream per variant
+        for i in range(12):
+            w.add(f"D-{i:03d}", make_text(rng_v))
+            if i % 4 == 3:
+                w.flush()
+        w.delete("D-002")
+        w.update("D-005", "fresh text for five")
+        w.flush()
+        live = w.live
+        segs = live.manifest()["segments"]
+        assert len(segs) >= 3
+        if variant == "all":
+            compact(live)
+        elif variant == "left":
+            compact(live, segs[:2])
+            compact(live)
+        else:
+            compact(live, segs[-2:])
+            compact(live)
+        sdir, _ = resolve_serving(live_dir)
+        metas.append(fmt.IndexMetadata.load(sdir))
+    assert_bit_identical(metas[0], metas[1])
+    assert_bit_identical(metas[0], metas[2])
+
+
+def test_fully_tombstoned_segment_is_dropped(tmp_path):
+    live_dir = str(tmp_path / "live")
+    LiveIndex.create(live_dir, num_shards=N_SHARDS)
+    rng = random.Random(6)
+    w = IngestWriter(live_dir, auto_merge=False)
+    for i in range(3):
+        w.add(f"A-{i}", make_text(rng))
+    w.flush()
+    doomed = w.live.manifest()["segments"][0]
+    for i in range(3):
+        w.add(f"B-{i}", make_text(rng))
+    w.flush()
+    for i in range(3):
+        w.delete(f"A-{i}")
+    w.flush()
+    m = compact(w.live, [doomed])
+    # the dead segment left the set without a merge minting a new one
+    assert doomed not in m["segments"]
+    assert w.live.doc_counts()["live"] == 3
+    m = compact(w.live)
+    sdir, _ = resolve_serving(live_dir)
+    assert fmt.IndexMetadata.load(sdir).num_docs == 3
+
+
+# ---------------------------------------------------------------------------
+# merge policy
+# ---------------------------------------------------------------------------
+
+
+def test_plan_merges_tier_policy():
+    def manifest(docs, tombs=None):
+        return {"segments": list(docs), "docs": docs,
+                "tombstones": tombs or {}}
+
+    # under factor: no debt
+    assert plan_merges(manifest({"a": 10, "b": 12}),
+                       factor=4, tier_ratio=8.0) == []
+    # four small segments in one tier: one group, manifest order
+    m = manifest({"a": 5, "b": 6, "c": 7, "d": 7, "big": 5000})
+    assert plan_merges(m, factor=4, tier_ratio=8.0) == [
+        ["a", "b", "c", "d"]]
+    # a half-dead segment joins the indebted group even off-tier
+    m = manifest({"a": 5, "b": 6, "c": 7, "d": 7, "big": 5000},
+                 {"big": [f"D{i}" for i in range(2600)]})
+    (group,) = plan_merges(m, factor=4, tier_ratio=8.0)
+    assert "big" in group
+    # a lone half-dead segment still compacts (reclamation)
+    m = manifest({"big": 100}, {"big": [f"D{i}" for i in range(60)]})
+    assert plan_merges(m, factor=4, tier_ratio=8.0) == [["big"]]
+    # merge_debt mirrors the plan
+    debt = merge_debt(m)
+    assert debt["pending_merge_groups"] == [["big"]]
+    assert debt["live_doc_fraction"] == 0.4
+
+
+def test_auto_merge_bounds_segment_count(tmp_path):
+    """With auto_merge on, the tiered policy keeps the segment count
+    bounded while flushes keep landing."""
+    live_dir = str(tmp_path / "live")
+    LiveIndex.create(live_dir, num_shards=N_SHARDS)
+    rng = random.Random(7)
+    w = IngestWriter(live_dir, buffer_docs=1000, auto_merge=True)
+    peak = 0
+    for i in range(7):
+        for j in range(2):
+            w.add(f"D-{i:02d}-{j}", make_text(rng))
+        w.flush()
+        peak = max(peak, len(w.live.manifest()["segments"]))
+    factor = 4  # the TPU_IR_MERGE_FACTOR default
+    assert peak <= factor, (
+        f"auto-merge let {peak} segments accumulate past the factor")
+
+
+# ---------------------------------------------------------------------------
+# verify / doctor / CLI surfaces
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def small_live(tmp_path):
+    live_dir = str(tmp_path / "live")
+    LiveIndex.create(live_dir, num_shards=N_SHARDS)
+    rng = random.Random(8)
+    w = IngestWriter(live_dir, auto_merge=False)
+    for i in range(6):
+        w.add(f"D-{i:03d}", make_text(rng))
+    w.flush()
+    for i in range(3):
+        w.add(f"E-{i:03d}", make_text(rng))
+    w.delete("D-001")
+    w.flush()
+    return live_dir
+
+
+def test_serving_follows_latest_servable(small_live):
+    """An uncompacted HEAD generation is normal between flushes: the
+    default (gen=None) resolution falls back to the newest SERVABLE
+    generation instead of killing a worker spawn/reload/router start;
+    an EXPLICIT uncompacted generation still raises with the recipe."""
+    live = LiveIndex.open(small_live)
+    head = live.current_gen()
+    sdir, gen = resolve_serving(small_live)
+    assert gen < head  # the head (2 segments + tombstone) was skipped
+    assert (sdir, gen) == latest_servable(small_live)
+    fmt.IndexMetadata.load(sdir)  # actually loadable
+    with pytest.raises(ValueError):
+        resolve_serving(small_live, head)  # explicit stays strict
+
+
+def test_verify_live(small_live):
+    from tpu_ir import faults
+    from tpu_ir.index.verify import verify_live
+
+    out = verify_live(small_live)
+    assert out["ok"] and out["live"]
+    assert out["num_segments"] == 2
+    assert out["num_docs"] == 8 and out["tombstoned"] == 1
+    # a tombstone naming a doc its segment never indexed is corruption
+    live = LiveIndex.open(small_live)
+    m = live.manifest()
+    m["tombstones"] = {m["segments"][0]: ["GHOST-DOC"]}
+    live.commit(m["segments"], m["tombstones"], m["docs"], note="bad")
+    with pytest.raises(faults.IntegrityError):
+        verify_live(small_live)
+
+
+def test_doctor_live_topology(small_live):
+    from tpu_ir.index.doctor import doctor_report
+
+    report = doctor_report(small_live)
+    assert report["live"] is True
+    assert report["segment_count"] == 2
+    kinds = {s["kind"] for s in report["segments"]}
+    assert kinds == {"base", "delta"}
+    assert report["docs"] == {"total": 9, "tombstoned": 1, "live": 8}
+    assert report["base_bytes"] > 0 and report["delta_bytes"] > 0
+    assert 0 < report["live_doc_fraction"] < 1
+    assert "merge_debt" in report
+    # multi-segment + tombstones => the not-directly-servable warning
+    assert any("not directly servable" in w for w in report["warnings"])
+
+
+def test_cli_verify_and_doctor_route_live(small_live, capsys):
+    from tpu_ir.cli import main
+
+    assert main(["verify", small_live]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["live"] and out["ok"]
+    assert main(["doctor", small_live]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["live"] and out["segment_count"] == 2
+
+
+def test_cli_ingest_and_generations(tmp_path, capsys):
+    from tpu_ir.cli import main
+
+    corpus = write_trec(tmp_path / "c.trec",
+                        {f"D-{i}": make_text(random.Random(9))
+                         for i in range(5)})
+    live_dir = str(tmp_path / "live")
+    rc = main(["ingest", live_dir, "--init", "--add", corpus,
+               "--shards", str(N_SHARDS)])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["added"] == 5 and out["live"] == 5
+    assert out["generation"] >= 1
+    rc = main(["ingest", live_dir, "--delete", "D-1", "--compact"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["deleted"] == 1 and out["live"] == 4
+    assert len(out["segments"]) == 1
+    rc = main(["generations", live_dir])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["current"] == max(e["gen"] for e in out["generations"])
+    assert out["generations"][-1]["servable"] is True
+    # adding an existing docid is the loud error path (exit 1, message)
+    rc = main(["ingest", live_dir, "--add", corpus])
+    assert rc == 1
+
+
+def test_ingest_counters_and_gauges_declared(small_live):
+    from tpu_ir import obs
+    from tpu_ir.obs.registry import (
+        DECLARED_COUNTERS,
+        DECLARED_GAUGES,
+        DECLARED_HISTOGRAMS,
+    )
+
+    for name in ("ingest.docs_added", "ingest.flushes", "merge.runs",
+                 "merge.docs_dropped", "generation.commits",
+                 "router.mixed_generation"):
+        assert name in DECLARED_COUNTERS
+    for name in ("ingest.flush", "merge.run", "generation.swap"):
+        assert name in DECLARED_HISTOGRAMS
+    for name in ("generation.current", "generation.segments",
+                 "generation.tombstones"):
+        assert name in DECLARED_GAUGES
+    # the fixture's ingest actually moved the ledgers
+    reg = obs.get_registry()
+    assert reg.get("ingest.docs_added") == 9
+    assert reg.get("ingest.docs_deleted") == 1
+    assert reg.get("ingest.flushes") == 2
+    assert reg.get("generation.commits") == 2
+    assert reg.get_gauge("generation.current") == 2

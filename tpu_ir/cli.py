@@ -491,9 +491,115 @@ def cmd_inspect(args) -> int:
 
 
 def cmd_verify(args) -> int:
-    from .index.verify import verify_index
+    from .index import segments as seg
+    from .index.verify import verify_index, verify_live
 
-    print(json.dumps(verify_index(args.index_dir)))
+    if seg.is_live(args.index_dir):
+        print(json.dumps(verify_live(args.index_dir)))
+    else:
+        print(json.dumps(verify_index(args.index_dir)))
+    return 0
+
+
+def cmd_ingest(args) -> int:
+    """The live-index write surface (ISSUE 12; index/ingest.py):
+    `--init` creates a live dir; `--add`/`--update` feed TREC corpora
+    through the IngestWriter (buffer -> delta segments + tombstones ->
+    committed generations), `--delete` tombstones docids, `--merge`
+    runs one tiered-merge step, `--compact` folds everything into one
+    canonical servable segment, `--gc` prunes old generations. One
+    JSON summary on stdout; serving picks up new generations via
+    `reload_generation` / POST /rpc/reload (RUNBOOK §19)."""
+    _apply_backend(args)
+    from .index import segments as seg
+    from .index.ingest import IngestWriter, ingest_corpus
+
+    if args.swap_bench:
+        from .obs.bench_check import append_history_row
+        from .serving.generation import swap_microbench
+
+        report = swap_microbench(args.live_dir)
+        import jax
+
+        row = {
+            "config": "ingest_swap",
+            "backend": jax.default_backend(),
+            "num_docs": report["num_docs_b"],
+            "swap_gap_ms": report["swap_gap_ms"],
+            "swap_staleness_ms": report["swap_staleness_ms"],
+            "swap_wall_s": report["swap_wall_s"],
+        }
+        report["history"] = append_history_row(row)
+        report["history_row"] = row
+        print(json.dumps(report, sort_keys=True))
+        return 0
+    if args.init and not seg.is_live(args.live_dir):
+        seg.LiveIndex.create(args.live_dir, k=args.k,
+                             num_shards=args.shards,
+                             chargram_ks=args.chargram_k)
+    missing = [p for p in args.add + args.update
+               if not os.path.exists(p)]
+    if missing:
+        print(f"error: corpus path(s) not found: {', '.join(missing)}",
+              file=sys.stderr)
+        return 1
+    writer = IngestWriter(args.live_dir, buffer_docs=args.buffer_docs,
+                          auto_merge=not args.no_auto_merge)
+    added = sum(ingest_corpus(writer, p) for p in args.add)
+    updated = sum(ingest_corpus(writer, p, update=True)
+                  for p in args.update)
+    deleted = sum(bool(writer.delete(d)) for d in args.delete)
+    writer.close()
+    if args.compact:
+        writer.compact_all()
+    elif args.merge:
+        writer.maybe_merge()
+    live = writer.live
+    out = {
+        "live_dir": os.path.abspath(args.live_dir),
+        "generation": live.current_gen(),
+        "added": added, "updated": updated, "deleted": deleted,
+        **live.doc_counts(),
+        "segments": live.manifest()["segments"],
+    }
+    if args.gc:
+        out["gc"] = live.gc()
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def cmd_generations(args) -> int:
+    """List a live index's generation chain (ISSUE 12): per generation
+    the segment set, doc counts, tombstones and whether it is directly
+    servable — the operator view behind `reload_generation`. `--gc`
+    prunes manifests/segments past TPU_IR_INGEST_KEEP_GENERATIONS."""
+    from .index import segments as seg
+
+    live = seg.LiveIndex.open(args.live_dir)
+    gens = live.generations()
+    if args.n:
+        gens = gens[-args.n:]
+    entries = []
+    for g in gens:
+        m = live.manifest(g)
+        tombs = sum(len(t) for t in m.get("tombstones", {}).values())
+        entries.append({
+            "gen": g,
+            "parent": m.get("parent"),
+            "segments": m["segments"],
+            "docs": sum(m.get("docs", {}).values()) - tombs,
+            "tombstones": tombs,
+            "servable": len(m["segments"]) == 1
+            and not m.get("tombstones"),
+            "note": m.get("note", ""),
+            "created": m.get("created"),
+        })
+    out = {"live_dir": os.path.abspath(args.live_dir),
+           "current": live.current_gen(),
+           "generations": entries}
+    if args.gc:
+        out["gc"] = live.gc()
+    print(json.dumps(out, sort_keys=True))
     return 0
 
 
@@ -1061,6 +1167,7 @@ _ARTIFACT_ENTRY_CMDS = frozenset({
     "cmd_search", "cmd_inspect", "cmd_verify", "cmd_warm", "cmd_docno",
     "cmd_expand", "cmd_eval", "cmd_count", "cmd_pack", "cmd_merge",
     "cmd_serve_bench", "cmd_migrate_index", "cmd_doctor",
+    "cmd_generations",
 })
 
 
@@ -1192,6 +1299,68 @@ def main(argv: list[str] | None = None) -> int:
                      help="target format_version (2 = zero-copy arenas, "
                           "1 = npz rollback)")
     pmi.set_defaults(fn=cmd_migrate_index)
+
+    pin = sub.add_parser(
+        "ingest",
+        help="live index writes: buffered add/update/delete flushed to "
+             "delta segments + tombstones, tiered merges, compaction "
+             "(RUNBOOK §19)")
+    pin.add_argument("live_dir", help="live index dir (see --init)")
+    pin.add_argument("--init", action="store_true",
+                     help="create the live dir first if it is not one "
+                          "yet (pins k/shards/chargrams for every "
+                          "future segment)")
+    pin.add_argument("--add", nargs="*", default=[], metavar="TREC",
+                     help="TREC corpus file(s) to ADD (a docid that "
+                          "already exists is an error — use --update)")
+    pin.add_argument("--update", nargs="*", default=[], metavar="TREC",
+                     help="TREC corpus file(s) to UPSERT (existing "
+                          "copies are tombstoned)")
+    pin.add_argument("--delete", nargs="*", default=[], metavar="DOCID",
+                     help="docids to tombstone (unknown ids are "
+                          "ignored — idempotent feed semantics)")
+    pin.add_argument("--merge", action="store_true",
+                     help="run one tiered-merge step if any size tier "
+                          "carries merge debt")
+    pin.add_argument("--compact", action="store_true",
+                     help="full compaction: one canonical segment, "
+                          "zero tombstones — the generation serving "
+                          "swaps to (bit-identical to a from-scratch "
+                          "build of the surviving docs)")
+    pin.add_argument("--gc", action="store_true",
+                     help="prune generations past "
+                          "TPU_IR_INGEST_KEEP_GENERATIONS and delete "
+                          "unreferenced segment dirs")
+    pin.add_argument("--buffer-docs", type=int, default=None,
+                     help="auto-flush threshold (default: "
+                          "TPU_IR_INGEST_BUFFER_DOCS)")
+    pin.add_argument("--no-auto-merge", action="store_true",
+                     help="skip the post-flush tiered-merge check")
+    pin.add_argument("--k", type=int, default=1,
+                     help="--init: term-k-gram size (live indexes "
+                          "support k=1 only)")
+    pin.add_argument("--shards", type=int, default=10,
+                     help="--init: term shards per segment")
+    pin.add_argument("--chargram-k", type=int, nargs="*",
+                     default=[2, 3], help="--init: char-gram sizes")
+    pin.add_argument("--swap-bench", action="store_true",
+                     help="run the ingest->compact->swap micro-bench "
+                          "against live_dir (created if missing) and "
+                          "append swap_gap_ms to BENCH_HISTORY.jsonl")
+    _add_backend_arg(pin)
+    pin.set_defaults(fn=cmd_ingest)
+
+    pgen = sub.add_parser(
+        "generations",
+        help="list a live index's generation chain: segments, doc "
+             "counts, tombstones, servability")
+    pgen.add_argument("live_dir")
+    pgen.add_argument("-n", type=int, default=None,
+                      help="newest N generations only")
+    pgen.add_argument("--gc", action="store_true",
+                      help="prune old generations + unreferenced "
+                           "segments after listing")
+    pgen.set_defaults(fn=cmd_generations)
 
     pw = sub.add_parser("warm", help="prebuild the serving cache (tiered "
                                      "layout + df + rerank norms) so later "

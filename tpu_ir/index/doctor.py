@@ -140,10 +140,92 @@ def _serving_caches(index_dir: str) -> list:
     return out
 
 
+def _dir_bytes(path: str) -> int:
+    total = 0
+    for root, _dirs, files in os.walk(path):
+        for f in files:
+            try:
+                total += os.path.getsize(os.path.join(root, f))
+            except OSError:
+                continue
+    return total
+
+
+def live_doctor_report(live_dir: str) -> dict:
+    """The live-index topology report (ISSUE 12 satellite): per-segment
+    docs/pairs/bytes with base-vs-delta split, tombstone counts,
+    live-doc fraction, and the merge-debt readout — what the tiered
+    policy would do right now. `tpu-ir doctor` routes live dirs here;
+    point it at a segment dir for the per-artifact report."""
+    from . import segments as seg
+
+    live = seg.LiveIndex.open(live_dir)
+    gen = live.current_gen()
+    manifest = live.manifest(gen)
+    tombs = manifest.get("tombstones", {})
+    segments = []
+    for name in manifest["segments"]:
+        p = live.segment_path(name)
+        meta = fmt.IndexMetadata.load(p)
+        segments.append({
+            "segment": name,
+            "docs": meta.num_docs,
+            "num_pairs": meta.num_pairs,
+            "bytes": _dir_bytes(p),
+            "tombstones": len(tombs.get(name, [])),
+        })
+    base = max(segments, key=lambda s: s["docs"], default=None)
+    for s in segments:
+        s["kind"] = "base" if base is not None and s is base else "delta"
+    base_bytes = base["bytes"] if base else 0
+    debt = seg.merge_debt(manifest)
+    counts = live.doc_counts(gen)
+    report = {
+        "live_dir": os.path.abspath(live_dir),
+        "live": True,
+        "generation": gen,
+        "generations_on_disk": live.generations(),
+        "config": live.config,
+        "docs": counts,
+        "live_doc_fraction": debt["live_doc_fraction"],
+        "segments": segments,
+        "segment_count": len(segments),
+        "base_bytes": base_bytes,
+        "delta_bytes": sum(s["bytes"] for s in segments) - base_bytes,
+        "merge_debt": debt,
+    }
+    warnings = []
+    if debt["pending_merge_groups"]:
+        warnings.append(
+            f"merge debt: {len(debt['pending_merge_groups'])} tier(s) "
+            f"over TPU_IR_MERGE_FACTOR — run `tpu-ir ingest "
+            f"{live_dir} --merge` (or let auto-merge catch up) before "
+            "delta count bounds swap freshness")
+    frac = debt["live_doc_fraction"]
+    if frac is not None and frac < 0.8:
+        warnings.append(
+            f"only {frac:.0%} of indexed documents are live — "
+            "tombstone debt is paying index bytes and merge time for "
+            "dead docs; compact (`tpu-ir ingest --compact`)")
+    if len(segments) > 1 or tombs:
+        warnings.append(
+            f"generation {gen} is not directly servable "
+            f"({len(segments)} segments, "
+            f"{counts['tombstoned']} tombstones); serving follows the "
+            "latest COMPACTED generation until the next compaction")
+    report["warnings"] = warnings
+    return report
+
+
 def doctor_report(index_dir: str, top_terms: int = 10) -> dict:
     """The full health report (see module docstring); raises
     FileNotFoundError for a non-index dir — the CLI's artifact-entry
-    handling turns that into the clean usage message."""
+    handling turns that into the clean usage message. Live index dirs
+    (index/segments.py) get the topology report instead."""
+    from . import segments as seg
+
+    if seg.is_live(index_dir):
+        return live_doctor_report(index_dir)
     meta = fmt.IndexMetadata.load(index_dir)
     df, shards, sections = _shard_scan(index_dir, meta)
     nz = df[df > 0]

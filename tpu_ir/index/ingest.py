@@ -1,0 +1,262 @@
+"""IngestWriter: the live index's document write API.
+
+`add` / `update` / `delete` mutate a bounded in-memory buffer; `flush`
+turns the buffer into one DELTA segment (an ordinary index dir built by
+the ordinary fuzz-pinned builder — the existing corpus is never
+re-tokenized) plus per-segment tombstones for every replaced or deleted
+on-disk document, committed as the next generation
+(index/segments.py). Auto-flush fires at TPU_IR_INGEST_BUFFER_DOCS
+buffered docs; auto-merge runs the tiered size-ratio policy after every
+flush so merge debt amortizes instead of accumulating.
+
+Single-writer contract (like the LiveIndex it drives): one IngestWriter
+per live dir, no internal locks — commits are sequences of atomic
+renames, and readers only ever see committed generations. "Background"
+merges are background with respect to SERVING, not to the writer:
+serving processes keep answering from their mmap'd generation while a
+merge builds the next one; nothing on the query path ever waits on a
+merge.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+
+from ..obs import get_registry
+from . import format as fmt
+from .segments import LiveIndex, compact, plan_merges
+
+# markup that would corrupt the TREC framing of the buffered corpus —
+# rejected loudly at add() time rather than silently mis-parsed at flush
+_TEXT_FORBIDDEN = ("<DOC", "</DOC", "<TEXT", "</TEXT", "<DOCNO", "</DOCNO")
+
+
+def _check_doc(docid: str, text: str) -> None:
+    if not docid or any(c.isspace() for c in docid) or "<" in docid \
+            or ">" in docid:
+        raise ValueError(f"invalid docid {docid!r}: docids must be "
+                         "non-empty and markup/whitespace-free")
+    up = text.upper()
+    bad = next((t for t in _TEXT_FORBIDDEN if t in up), None)
+    if bad is not None:
+        raise ValueError(f"document {docid!r} text contains TREC markup "
+                         f"({bad}...) — it would corrupt the corpus "
+                         "framing at flush")
+
+
+class IngestWriter:
+    """Buffered add/update/delete over one live index.
+
+    Semantics:
+      - `add(docid, text)` — a NEW document; adding a docid that is
+        already live (on disk or buffered) raises — silent replacement
+        is what `update` is for.
+      - `update(docid, text)` — upsert: the on-disk copy (if any) is
+        tombstoned in its owning segment, the new text buffers.
+      - `delete(docid)` — removes a live document (tombstone for an
+        on-disk copy, buffer eviction for a buffered one); returns
+        False for an unknown docid instead of raising (idempotent
+        delete is the ergonomic contract for feed-driven ingest).
+      - `flush()` — buffer -> delta segment + tombstones -> committed
+        generation; returns the new manifest (or None when there was
+        nothing to commit).
+
+    Not thread-safe: one writer per live dir (segments.py's
+    single-writer discipline)."""
+
+    def __init__(self, live_dir: str, *, buffer_docs: int | None = None,
+                 auto_merge: bool = True):
+        from ..utils import envvars
+
+        self.live = LiveIndex.open(live_dir)
+        self.buffer_docs = (buffer_docs if buffer_docs is not None
+                            else envvars.get_int(
+                                "TPU_IR_INGEST_BUFFER_DOCS"))
+        self.auto_merge = auto_merge
+        self._buf: dict[str, str] = {}   # docid -> text, arrival order
+        self._tombs: dict[str, set] = {}  # segment -> dead docids
+        self._doc_seg: dict[str, str] | None = None  # lazy live view
+
+    # -- the live-document view -------------------------------------------
+
+    def _docs(self) -> dict:
+        if self._doc_seg is None:
+            self._doc_seg = self.live.live_doc_map()
+            # pending (uncommitted) tombstones still shadow the disk view
+            for seg, dead in self._tombs.items():
+                for d in dead:
+                    if self._doc_seg.get(d) == seg:
+                        del self._doc_seg[d]
+        return self._doc_seg
+
+    def buffered(self) -> int:
+        return len(self._buf)
+
+    def pending_tombstones(self) -> int:
+        return sum(len(t) for t in self._tombs.values())
+
+    # -- mutations ---------------------------------------------------------
+
+    def add(self, docid: str, text: str) -> None:
+        _check_doc(docid, text)
+        if docid in self._buf or docid in self._docs():
+            raise ValueError(f"docid {docid!r} already exists — use "
+                             "update() to replace it")
+        self._buf[docid] = text
+        get_registry().incr("ingest.docs_added")
+        self._maybe_flush()
+
+    def update(self, docid: str, text: str) -> None:
+        _check_doc(docid, text)
+        seg = self._docs().get(docid)
+        if seg is not None:
+            self._tombs.setdefault(seg, set()).add(docid)
+            del self._doc_seg[docid]
+        self._buf[docid] = text
+        get_registry().incr("ingest.docs_updated")
+        self._maybe_flush()
+
+    def delete(self, docid: str) -> bool:
+        if docid in self._buf:
+            del self._buf[docid]
+            get_registry().incr("ingest.docs_deleted")
+            return True
+        seg = self._docs().get(docid)
+        if seg is None:
+            return False
+        self._tombs.setdefault(seg, set()).add(docid)
+        del self._doc_seg[docid]
+        get_registry().incr("ingest.docs_deleted")
+        return True
+
+    def _maybe_flush(self) -> None:
+        if len(self._buf) >= max(self.buffer_docs, 1):
+            self.flush()
+
+    # -- flush / merge -----------------------------------------------------
+
+    def flush(self, *, note: str = "flush") -> dict | None:
+        """Commit the buffer (and pending tombstones) as the next
+        generation. The delta segment is built by the ordinary builder
+        into its final segment dir — a crash mid-build leaves an
+        unreferenced dir gc() removes, never a half-committed
+        generation."""
+        from .builder import build_index
+
+        if not self._buf and not self._tombs:
+            return None
+        t0 = time.perf_counter()
+        manifest = self.live.manifest()
+        reg = get_registry()
+        segments = list(manifest["segments"])
+        docs = dict(manifest.get("docs", {}))
+        new_name = None
+        if self._buf:
+            cfg = self.live.config
+            new_name = self.live._next_segment_name(manifest)
+            seg_dir = self.live.segment_path(new_name)
+            os.makedirs(seg_dir, exist_ok=True)
+            corpus = os.path.join(seg_dir, "corpus.trec.tmp")
+            with open(corpus, "w", encoding="utf-8") as f:
+                for docid, text in self._buf.items():
+                    f.write(f"<DOC>\n<DOCNO> {docid} </DOCNO>\n<TEXT>\n"
+                            f"{text}\n</TEXT>\n</DOC>\n")
+            try:
+                meta = build_index(
+                    [corpus], seg_dir, k=int(cfg["k"]),
+                    chargram_ks=list(cfg["chargram_ks"]),
+                    num_shards=int(cfg["num_shards"]))
+            finally:
+                if os.path.exists(corpus):
+                    os.unlink(corpus)
+            segments.append(new_name)
+            docs[new_name] = meta.num_docs
+            reg.incr("ingest.segments_built")
+        tombs = {s: sorted(t) for s, t in
+                 {**{k: set(v) for k, v in
+                     manifest.get("tombstones", {}).items()},
+                  **{s: set(manifest.get("tombstones", {}).get(s, []))
+                     | dead for s, dead in self._tombs.items()}}.items()}
+        m = self.live.commit(segments, tombs, docs, note=note)
+        # the just-flushed docs join the live view in place (no rescan)
+        if self._doc_seg is not None and new_name is not None:
+            for d in self._buf:
+                self._doc_seg[d] = new_name
+        self._buf.clear()
+        self._tombs.clear()
+        reg.incr("ingest.flushes")
+        reg.observe("ingest.flush", time.perf_counter() - t0)
+        if self.auto_merge:
+            self.maybe_merge()
+        return m
+
+    def maybe_merge(self) -> dict | None:
+        """Run ONE step of the tiered merge policy if any tier carries
+        merge debt; returns the new manifest or None. Called after
+        every flush when auto_merge is on; safe to call any time."""
+        manifest = self.live.manifest()
+        groups = plan_merges(manifest)
+        if not groups:
+            return None
+        m = compact(self.live, groups[0], note="auto-merge")
+        self._doc_seg = None  # segment ownership moved; rebuild lazily
+        return m
+
+    def compact_all(self, *, note: str = "compact") -> dict:
+        """Full compaction: every segment + every tombstone folded into
+        ONE canonical segment — the generation `resolve_serving`
+        accepts, bit-identical to a from-scratch build of the surviving
+        corpus. Pending buffered state flushes first."""
+        self.flush()
+        m = compact(self.live, note=note)
+        self._doc_seg = None
+        return m
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> dict | None:
+        return self.flush(note="close")
+
+    def __enter__(self) -> "IngestWriter":
+        return self
+
+    def __exit__(self, exc_type, *exc) -> None:
+        if exc_type is None:
+            self.close()
+
+
+import re as _re
+
+_TEXT_RE = _re.compile(r"<TEXT>\s*(.*?)\s*</TEXT>", _re.S | _re.I)
+
+
+def trec_payload(content: str) -> str:
+    """The ingestable text of one raw TREC record: the <TEXT> section
+    payload(s), framing stripped. The flush re-frames it through the
+    canonical shape (collection/parsers.to_trec), so a canonical record
+    round-trips BYTE-identically — which is what keeps ingest-built
+    segments bit-equal to a from-scratch build over the same corpus."""
+    sections = _TEXT_RE.findall(content)
+    if sections:
+        return "\n".join(sections)
+    # no TEXT section: strip the DOC/DOCNO framing, keep the rest
+    body = _re.sub(r"</?DOC>|<DOCNO>.*?</DOCNO>", "", content,
+                   flags=_re.S | _re.I)
+    return body.strip()
+
+
+def ingest_corpus(writer: IngestWriter, corpus_paths, *,
+                  update: bool = False) -> int:
+    """Feed TREC corpus file(s) through the writer (`tpu-ir ingest
+    --add/--update`); returns the document count."""
+    from ..collection import read_trec_corpus
+
+    if isinstance(corpus_paths, (str, os.PathLike)):
+        corpus_paths = [corpus_paths]
+    n = 0
+    for doc in read_trec_corpus(list(corpus_paths)):
+        (writer.update if update else writer.add)(
+            doc.docid, trec_payload(doc.content))
+        n += 1
+    return n

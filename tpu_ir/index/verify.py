@@ -191,3 +191,52 @@ def verify_index(index_dir: str) -> dict:
         "total_tf": total_tf,
         "ok": True,
     }
+
+
+def verify_live(live_dir: str) -> dict:
+    """Verify a LIVE index dir (index/segments.py): the CURRENT pointer
+    resolves to a readable manifest, every referenced segment passes
+    the full structural + integrity verification above, and every
+    tombstone names a document its segment actually indexed. Raises
+    (AssertionError / IntegrityError) on violation, like verify_index;
+    `tpu-ir verify` routes live dirs here automatically."""
+    from .. import faults
+    from ..collection import DocnoMapping
+    from . import segments as seg
+
+    live = seg.LiveIndex.open(live_dir)
+    gen = live.current_gen()
+    manifest = live.manifest(gen)
+    segments_out = {}
+    total_docs = 0
+    for name in manifest["segments"]:
+        p = live.segment_path(name)
+        r = verify_index(p)
+        recorded = int(manifest["docs"].get(name, -1))
+        assert recorded == r["num_docs"], (
+            f"segment {name}: manifest records {recorded} docs, "
+            f"artifacts hold {r['num_docs']}")
+        tombs = manifest.get("tombstones", {}).get(name, [])
+        if tombs:
+            known = set(DocnoMapping.load(
+                os.path.join(p, fmt.DOCNOS)).docids)
+            ghost = [d for d in tombs if d not in known]
+            if ghost:
+                raise faults.IntegrityError(
+                    p, f"tombstones name docids segment {name} never "
+                    f"indexed: {ghost[:5]}")
+        segments_out[name] = {
+            "num_docs": r["num_docs"], "num_pairs": r["num_pairs"],
+            "tombstones": len(tombs), "ok": True}
+        total_docs += r["num_docs"]
+    counts = live.doc_counts(gen)
+    return {
+        "ok": True,
+        "live": True,
+        "generation": gen,
+        "num_segments": len(manifest["segments"]),
+        "num_docs": counts["live"],
+        "docs_indexed": total_docs,
+        "tombstoned": counts["tombstoned"],
+        "segments": segments_out,
+    }

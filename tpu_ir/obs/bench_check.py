@@ -110,6 +110,17 @@ METRICS: dict[str, tuple[str, float]] = {
     "pass1_tokenize_s": ("lower", 1.0),
     "pass2_combine_s": ("lower", 1.0),
     "pass3_reduce_s": ("lower", 1.0),
+    # live-index generation swap (ISSUE 12 ingest_swap rows): the
+    # widest gap between consecutive successful probe responses across
+    # the swap window — zero-downtime means this is ordinary request
+    # latency; a load-blocking swap regression shows as a seconds-scale
+    # jump. Floor absorbs scheduler weather on shared CI hosts (the
+    # probe loop is a max-of-N like the p99 metrics above).
+    "swap_gap_ms": ("lower", 100.0),
+    # reload-to-first-new-generation-response: dominated by the new
+    # generation's load+warm, so the floor is generous — the metric
+    # guards against an order-of-magnitude staleness regression, not ms
+    "swap_staleness_ms": ("lower", 2000.0),
 }
 
 

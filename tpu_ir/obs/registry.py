@@ -93,6 +93,9 @@ SERVING_COUNTER_NAMES = (
     "served_full", "served_no_rerank", "served_hot_only",
     "shed_level", "shed_queue_full", "shed_queue_timeout",
     "level_step_down", "level_step_up",
+    # live index (ISSUE 12): one frontend published a new generation's
+    # scorer (+ coalescer) without dropping in-flight requests
+    "generation_swap",
 )
 
 # Dispatch sub-stages the device-cost profiler (obs/profiling.py)
@@ -130,6 +133,25 @@ ROUTER_COUNTER_NAMES = (
     "router.hedge_fired", "router.hedge_won",
     "router.replica_failed", "router.shard_lost",
     "router.breaker_opened", "router.worker_respawn",
+    # live-index rolling upgrades (ISSUE 12): requests whose fan-out saw
+    # MORE than one index generation — the router merges only the
+    # winning generation's responses and tags the rest missing, so this
+    # counts the mixed-generation window's width in requests
+    "router.mixed_generation",
+)
+
+# Live index subsystem (ISSUE 12): incremental ingest (index/ingest.py),
+# tombstone-applying tiered merges (index/segments.py), and the
+# zero-downtime generation swap (serving/generation.py). docs_* count
+# API-level mutations; flushes/segments_built the delta-segment commits;
+# merge.runs one policy-driven compaction step, merge.segments_merged
+# its inputs, merge.docs_dropped tombstones physically applied;
+# generation.commits every manifest+CURRENT flip.
+INGEST_COUNTER_NAMES = (
+    "ingest.docs_added", "ingest.docs_updated", "ingest.docs_deleted",
+    "ingest.flushes", "ingest.segments_built",
+    "merge.runs", "merge.segments_merged", "merge.docs_dropped",
+    "generation.commits",
 )
 
 # Radix-partitioned streaming build (ISSUE 11): pass-1 bucketed pair
@@ -149,7 +171,7 @@ DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
 ) + (COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
-     + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES)
+     + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES + INGEST_COUNTER_NAMES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
@@ -180,6 +202,13 @@ DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     # read->remap->reduce->spill round took
     "build.radix.bucket_pairs",
     "build.radix.bucket_s",
+    # live index (ISSUE 12): one buffer->delta-segment flush (build +
+    # commit), one tombstone-applying tiered merge step, and one serving
+    # generation swap (new-generation load + precompile + publish — the
+    # requests-keep-flowing wall, not a downtime window)
+    "ingest.flush",
+    "merge.run",
+    "generation.swap",
 )
 
 # Gauges: point-in-time values (memory levels, cache sizes) — unlike
@@ -194,6 +223,12 @@ GAUGE_MERGE = {
     "host.rss_bytes": "last",        # process resident set size
     "host.peak_rss_bytes": "max",    # high-water RSS across the run
     "compile.signatures": "last",    # distinct (fn, signature) pairs seen
+    # live index (ISSUE 12): the generation a process last committed or
+    # swapped to, and that generation's segment/tombstone topology —
+    # "last" merges: the levels are per-process currents, not peaks
+    "generation.current": "last",
+    "generation.segments": "last",
+    "generation.tombstones": "last",
 }
 DECLARED_GAUGES = tuple(sorted(GAUGE_MERGE))
 

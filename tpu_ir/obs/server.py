@@ -241,6 +241,9 @@ def health_snapshot() -> dict:
         out["breaker"] = latest.get("breaker")
         out["ladder"] = latest.get("ladder")
         out["queue_depth"] = latest.get("queue_depth")
+        # the live-index generation this process currently serves
+        # (ISSUE 12) — the rolling-swap driver confirms handoffs here
+        out["generation"] = latest.get("generation")
         # the coalescer's control-plane state (ISSUE 9): occupancy
         # collapsing to ~1 under load means batching silently
         # disengaged — an alerting-grade signal, so it rides top-level

@@ -130,6 +130,12 @@ class SearchResult(list):
     shards_ok: tuple = ()
     missing_shards: tuple = ()
     hedges: int = 0
+    # the index GENERATION that answered (the live-index subsystem,
+    # index/segments.py): 0 for plain batch-built indexes; stamped by
+    # the serving frontend and the scatter-gather router so a response
+    # served across a rolling generation swap is attributable to
+    # exactly one corpus snapshot
+    generation: int = 0
 
 
 def compute_doc_norms(pair_term, pair_doc, pair_tf, df,
@@ -179,6 +185,12 @@ class Scorer:
     # shard-worker doc restriction (scatter-gather tier); None = whole
     # index. Set by __init__(doc_range=...), consulted by _topk_host.
     doc_range: tuple | None = None
+    # index generation this scorer serves (live indexes; 0 = a plain
+    # batch-built dir). Stamped by load_generation(); responses carry it
+    # (SearchResult.generation) through the frontend and router.
+    generation: int = 0
+    # the live dir load_generation() resolved from (reload target)
+    _live_dir: str | None = None
     # (the old single-threaded `degraded_last` alias is GONE — ISSUE 9:
     # under coalesced shared batches only the per-request tagged path
     # (topk_tagged / rerank_topk_tagged -> SearchResult.degraded) is a
@@ -540,6 +552,50 @@ class Scorer:
             index_dir=index_dir, tiers=tiers, doc_norms=norms,
             sharded_layout=sharded_layout, prune=prune,
             deadline_s=deadline_s, doc_range=doc_range)
+
+    @classmethod
+    def load_generation(cls, live_dir: str, generation: int | None = None,
+                        **load_kwargs) -> "Scorer":
+        """Load one GENERATION of a live index (index/segments.py) — or
+        a plain index dir, which serves as generation 0. The generation
+        must be servable (one canonical segment, no tombstones:
+        `tpu-ir ingest --compact` produces one); the returned scorer is
+        stamped with its generation and remembers the live dir, so
+        `reload_generation()` can follow the corpus as new generations
+        land. `load_kwargs` pass through to Scorer.load (and are
+        replayed on reload — a worker's layout/deadline/doc_range
+        follow it across swaps unless overridden)."""
+        from ..index import segments as seg
+
+        index_dir, gen = seg.resolve_serving(live_dir, generation)
+        scorer = cls.load(index_dir, **load_kwargs)
+        scorer.generation = int(gen)
+        scorer._live_dir = os.path.abspath(live_dir) \
+            if seg.is_live(live_dir) else None
+        scorer._load_kwargs = dict(load_kwargs)
+        return scorer
+
+    def reload_generation(self, generation: int | None = None,
+                          **override_kwargs) -> "Scorer":
+        """A NEW Scorer over the (given or current) generation of this
+        scorer's live dir, loaded with the same kwargs as the original
+        (overridable — a shard worker passes its recomputed doc_range,
+        since the doc partition follows num_docs across generations).
+
+        Deliberately a functional swap, not in-place mutation: the
+        query path reads a dozen attributes per request, and mutating
+        them under a running request would tear it (old vocab, new
+        layout — silently wrong floats, exactly what the soak's
+        bit-exactness invariant exists to catch). The OLD scorer stays
+        fully valid — in-flight requests finish on the arrays they
+        already hold — and the publish is the caller's single reference
+        swap (ServingFrontend.reload_generation)."""
+        if self._live_dir is None:
+            raise ValueError("this scorer was not loaded from a live "
+                             "index dir (use Scorer.load_generation)")
+        kwargs = {**getattr(self, "_load_kwargs", {}), **override_kwargs}
+        return type(self).load_generation(self._live_dir, generation,
+                                          **kwargs)
 
     @staticmethod
     def _assemble_csr(index_dir: str, meta, verify: bool = False):

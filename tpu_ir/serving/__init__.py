@@ -14,6 +14,7 @@ from .frontend import (
     ServingConfig,
     ServingFrontend,
 )
+from .generation import rolling_swap, swap_microbench
 from .router import (
     Router,
     RouterConfig,
@@ -39,4 +40,5 @@ __all__ = [
     "worker_rpc_handlers", "merge_shard_topk", "merge_candidate_scores",
     "run_soak", "make_queries", "run_concurrency_sweep",
     "run_distributed_soak", "DEFAULT_CHAOS_PLAN",
+    "rolling_swap", "swap_microbench",
 ]

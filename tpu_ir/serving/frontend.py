@@ -170,7 +170,6 @@ class ServingFrontend:
     not by thread ownership."""
 
     def __init__(self, scorer: Scorer, config: ServingConfig | None = None):
-        self.scorer = scorer
         self.config = cfg = config or ServingConfig()
         self.admission = AdmissionController(cfg.max_concurrency,
                                              cfg.max_queue)
@@ -182,21 +181,12 @@ class ServingFrontend:
                   if scorer.layout in ("sparse", "sharded")
                   else (LEVEL_FULL, LEVEL_NO_RERANK, LEVEL_SHED))
         self.ladder = DegradationLadder(levels, cfg, self._on_transition)
-        # the coalescing scheduler (ISSUE 9): packs concurrent
-        # compatible requests into one padded dispatch; precompiling the
-        # rung ladder here means no serving caller ever eats an XLA
-        # compile (the acceptance pin: zero compile.recompiles across a
-        # steady-state sweep)
-        self.batcher = None
-        if cfg.coalesce:
-            from .batching import CoalescingScheduler
-
-            self.batcher = CoalescingScheduler(
-                scorer, deadline_s=cfg.deadline_s,
-                wait_ms=cfg.coalesce_wait_ms, ladder=cfg.batch_ladder,
-                width=cfg.batch_width)
-            if cfg.precompile:
-                self.batcher.precompile(ks=cfg.precompile_ks)
+        # (scorer, batcher) ride ONE tuple published by a single
+        # reference assignment: the request path reads the pair once at
+        # entry, so a generation swap (reload_generation) can never
+        # tear a request across two scorers — or hand it a batcher
+        # whose internal scorer is not the one it captured
+        self._serving = (scorer, self._make_batcher(scorer))
         self._counters = RecoveryCounters()
         # the embedded metrics server's /healthz reports this frontend's
         # breaker/ladder/queue state for as long as it is alive (weakref
@@ -204,6 +194,61 @@ class ServingFrontend:
         from ..obs.server import register_health_source
 
         register_health_source(self)
+
+    def _make_batcher(self, scorer: Scorer):
+        """The coalescing scheduler (ISSUE 9) for one scorer: packs
+        concurrent compatible requests into one padded dispatch;
+        precompiling the rung ladder here means no serving caller ever
+        eats an XLA compile — on construction AND on every generation
+        swap (the first post-swap request is the worst moment to
+        compile)."""
+        cfg = self.config
+        if not cfg.coalesce:
+            return None
+        from .batching import CoalescingScheduler
+
+        batcher = CoalescingScheduler(
+            scorer, deadline_s=cfg.deadline_s,
+            wait_ms=cfg.coalesce_wait_ms, ladder=cfg.batch_ladder,
+            width=cfg.batch_width)
+        if cfg.precompile:
+            batcher.precompile(ks=cfg.precompile_ks)
+        return batcher
+
+    @property
+    def scorer(self) -> Scorer:
+        return self._serving[0]
+
+    @property
+    def batcher(self):
+        return self._serving[1]
+
+    def reload_generation(self, scorer: Scorer | None = None, *,
+                          generation: int | None = None) -> Scorer:
+        """Swap serving to a new index generation with ZERO downtime:
+        load (or accept) the new generation's scorer, build + warm its
+        coalescer, then publish both as one reference assignment.
+        In-flight requests finish untouched on the scorer they captured
+        at entry (its arrays stay alive exactly as long as they hold
+        them); every request entering after the publish serves the new
+        generation and is tagged with it. Nothing here blocks the
+        request path — the expensive work (mmap load, precompile) runs
+        before the publish, outside any lock."""
+        import time as _time
+
+        from .. import obs
+
+        t0 = _time.perf_counter()
+        if scorer is None:
+            scorer = self.scorer.reload_generation(generation)
+        batcher = self._make_batcher(scorer)
+        self._serving = (scorer, batcher)   # THE publish
+        self._count("generation_swap")
+        reg = obs.get_registry()
+        reg.set_gauge("generation.current", scorer.generation)
+        reg.observe("generation.swap", _time.perf_counter() - t0)
+        logger.info("serving swapped to generation %s", scorer.generation)
+        return scorer
 
     # -- accounting --------------------------------------------------------
 
@@ -231,12 +276,14 @@ class ServingFrontend:
 
     def stats(self) -> dict:
         """This frontend's counters + control-plane state, one dict."""
+        scorer, batcher = self._serving
         out = dict(self._counters.snapshot())
         out["ladder"] = self.ladder.snapshot()
         out["breaker"] = self.breaker.snapshot()
         out["queue_depth"] = self.admission.queue_depth()
-        if self.batcher is not None:
-            out["batching"] = self.batcher.snapshot()
+        out["generation"] = scorer.generation
+        if batcher is not None:
+            out["batching"] = batcher.snapshot()
         return out
 
     # -- the request path --------------------------------------------------
@@ -260,6 +307,10 @@ class ServingFrontend:
         number that proves shedding is cheap)."""
         t0 = time.perf_counter()
         self._count("submitted")
+        # ONE read of the (scorer, batcher) pair for the whole request:
+        # a concurrent generation swap republishes the tuple, and this
+        # request must finish entirely on the pair it entered with
+        scorer, batcher = self._serving
         with obs_trace("request", scoring=scoring) as root:
             with obs_trace("ladder") as lsp:
                 level = self.ladder.level()
@@ -291,7 +342,8 @@ class ServingFrontend:
                     res = self._serve(text, k=k, scoring=scoring,
                                       rerank=rerank, snippets=snippets,
                                       level=level, explain_k=explain_k,
-                                      return_docids=return_docids)
+                                      return_docids=return_docids,
+                                      scorer=scorer, batcher=batcher)
                 finally:
                     admit_cm.__exit__(None, None, None)
                 self._observe_latency(f"request.{level}", t0)
@@ -308,7 +360,11 @@ class ServingFrontend:
     def _serve(self, text: str, *, k: int, scoring: str,
                rerank: int | None, snippets: bool,
                level: str, explain_k: int = 0,
-               return_docids: bool = True) -> SearchResult:
+               return_docids: bool = True,
+               scorer: Scorer | None = None,
+               batcher=None) -> SearchResult:
+        if scorer is None:  # direct callers (tests) without the capture
+            scorer, batcher = self._serving
         with obs_trace("breaker") as bsp:
             allowed, is_probe = self.breaker.allow_device()
             bsp.set("allowed", allowed)
@@ -318,7 +374,7 @@ class ServingFrontend:
             self._count("breaker_probes")
         use_rerank = rerank if level == LEVEL_FULL else None
         try:
-            if (self.batcher is not None and '"' not in text
+            if (batcher is not None and '"' not in text
                     and return_docids):
                 # the coalesced path: this thread's request may ride a
                 # batch-mate's kernel call — its level/wait/occupancy
@@ -327,7 +383,7 @@ class ServingFrontend:
                 # phrase queries score on the host and go solo below,
                 # as do raw-docid requests (the shard-worker RPC
                 # surface): BatchKey doesn't carry the result-key flavor
-                res = self.batcher.submit(
+                res = batcher.submit(
                     text, k=k, scoring=scoring, rerank=use_rerank,
                     hot_only=(level == LEVEL_HOT_ONLY),
                     force_host=force_host, level=level,
@@ -341,7 +397,7 @@ class ServingFrontend:
                 with obs.querylog.request_context(
                         level=level,
                         queue_depth=self.admission.queue_depth()):
-                    res = self.scorer.search_batch(
+                    res = scorer.search_batch(
                         [text], k=k, scoring=scoring, rerank=use_rerank,
                         deadline_s=self.config.deadline_s,
                         force_host=force_host,
@@ -356,6 +412,9 @@ class ServingFrontend:
                 self.breaker.abort(is_probe=is_probe)
             raise
         res.level = level
+        # attribution across rolling upgrades: the response names the
+        # exact corpus snapshot that answered it
+        res.generation = scorer.generation
         dispatch_failed = False
         # under coalescing, one shared dispatch serves many slots; only
         # the batch's voting slot feeds the breaker (its threshold
@@ -386,7 +445,7 @@ class ServingFrontend:
             self._count("degraded")
         self._count(f"served_{level}")
         if snippets and level == LEVEL_FULL and not res.degraded:
-            res.snippets = [self.scorer.snippet(text, key) for key, _ in res]
+            res.snippets = [scorer.snippet(text, key) for key, _ in res]
         self.ladder.observe(pressure=self.admission.pressure(),
                             failed=dispatch_failed)
         return res

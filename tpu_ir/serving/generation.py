@@ -1,0 +1,215 @@
+"""Generation swap orchestration: rolling upgrades + the swap bench.
+
+The live index (index/segments.py, index/ingest.py) produces immutable
+GENERATIONS; this module moves a SERVING fleet from one to the next
+with zero downtime:
+
+- in one process, `ServingFrontend.reload_generation()` is the whole
+  story (load + warm outside the request path, publish as one
+  reference swap — frontend.py);
+- across the scatter-gather tier, `rolling_swap()` walks the worker
+  grid replica by replica, POSTing /rpc/reload and confirming each
+  worker's /healthz names the new generation before moving on. Every
+  worker keeps SERVING its old generation until its own publish
+  instant, so the fleet never has a dark replica; the router
+  (serving/router.py) tolerates the resulting mixed-generation window
+  by merging only the winning generation's responses per request and
+  tagging the rest missing (partial) — every response names exactly
+  one corpus snapshot.
+
+`swap_microbench()` is the number behind the claim: serve a probe
+stream while ingesting a delta and swapping, and report `swap_gap_ms` —
+the widest gap between consecutive successful responses across the
+swap window. Zero-downtime means that gap is ordinary request latency,
+not a load-time outage; the row lands in BENCH_HISTORY.jsonl where
+`tpu-ir bench-check` gates it direction-aware.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+
+from ..obs import get_registry
+from .shardset import get_worker_health, rpc_post
+
+logger = logging.getLogger(__name__)
+
+
+def rolling_swap(topology, generation: int | None = None, *,
+                 reload_timeout_s: float = 300.0,
+                 confirm: bool = True) -> dict:
+    """Roll the worker fleet onto a new index generation, one replica
+    at a time. `topology` is a ShardSet, a callable, or a static
+    [shard][replica] address grid (the Router's own contract). Each
+    worker loads + warms the new generation while STILL serving its
+    old one (the reload RPC returns only after the worker's publish),
+    and `confirm=True` re-reads /healthz to pin the handoff before the
+    next replica starts — the rolling order is what bounds the
+    mixed-generation window to the walk itself.
+
+    Dead/unreachable replicas are skipped and reported (`failed`) —
+    a rolling upgrade must not wedge on the corpse the chaos schedule
+    just SIGKILLed; the respawn path brings it back on the new
+    generation."""
+    if callable(topology):
+        grid = topology()
+    elif hasattr(topology, "addresses"):
+        grid = topology.addresses()
+    else:
+        grid = [list(row) for row in topology]
+    t0 = time.perf_counter()
+    swapped, failed = [], []
+    result_gen = generation
+    for shard, row in enumerate(grid):
+        for replica, addr in enumerate(row):
+            if not addr:
+                continue
+            payload = ({} if generation is None
+                       else {"generation": int(generation)})
+            try:
+                out = rpc_post(addr, "reload", payload,
+                               reload_timeout_s)
+                result_gen = out.get("generation", result_gen)
+                if confirm:
+                    h = get_worker_health(addr, 10.0)
+                    got = (h.get("worker") or {}).get("index_generation")
+                    if result_gen is not None and got != result_gen:
+                        raise RuntimeError(
+                            f"worker {addr} reports index_generation "
+                            f"{got!r} after reload to {result_gen}")
+                swapped.append((shard, replica, addr))
+            except Exception as e:  # noqa: BLE001 — a dead replica must
+                # not wedge the roll; it respawns on the new generation
+                logger.warning("rolling swap: %s failed: %r", addr, e)
+                failed.append((shard, replica, addr, repr(e)))
+    if hasattr(topology, "set_index_generation"):
+        # future respawns must come back on the NEW generation
+        topology.set_index_generation(result_gen)
+    return {"generation": result_gen,
+            "swapped": swapped, "failed": failed,
+            "wall_s": round(time.perf_counter() - t0, 3)}
+
+
+# ---------------------------------------------------------------------------
+# the ingest -> swap micro-bench
+# ---------------------------------------------------------------------------
+
+_BENCH_WORDS = ("salmon fishing river bears honey quick brown fox lazy "
+                "dog market investor asset bond stock season rain "
+                "forest".split())
+
+
+def _bench_doc(i: int) -> tuple[str, str]:
+    text = " ".join(_BENCH_WORDS[(i + j) % len(_BENCH_WORDS)]
+                    for j in range(4 + (i % 6)))
+    return f"SWAP-{i:05d}", text
+
+
+def swap_microbench(live_dir: str, *, base_docs: int = 64,
+                    delta_docs: int = 16, probe_s: float = 1.0,
+                    num_shards: int = 4) -> dict:
+    """Measure the serving cost of one ingest -> compact -> swap cycle.
+
+    Builds (or reuses) a live index at `live_dir`, serves generation A
+    through a frontend while a probe thread issues back-to-back
+    queries, then ingests a delta, compacts to generation B and calls
+    `frontend.reload_generation()`. Reported:
+
+    - `swap_gap_ms`   — widest gap between consecutive successful probe
+                        responses across the swap window (the
+                        zero-downtime claim, measured);
+    - `swap_staleness_ms` — reload call to first generation-B-tagged
+                        response (how long the new corpus takes to
+                        reach traffic: load + warm + publish);
+    - `swap_wall_s`   — the whole reload_generation call.
+
+    The probe thread is owned and joined HERE (bench harness, not
+    library serving code — the PR-2 no-owned-threads rule applies to
+    the frontend, not its benches)."""
+    from ..index.ingest import IngestWriter
+    from ..index.segments import LiveIndex, is_live
+    from ..search.scorer import Scorer
+    from .frontend import ServingConfig, ServingFrontend
+
+    if not is_live(live_dir):
+        LiveIndex.create(live_dir, num_shards=num_shards)
+    live = LiveIndex.open(live_dir)
+    with IngestWriter(live_dir, auto_merge=False) as w:
+        existing = w._docs()
+        for i in range(base_docs):
+            docid, text = _bench_doc(i)
+            if docid not in existing:
+                w.add(docid, text)
+        w.compact_all(note="swap-bench base")
+
+    scorer_a = Scorer.load_generation(live_dir, layout="sparse")
+    frontend = ServingFrontend(scorer_a, ServingConfig(
+        max_concurrency=4, max_queue=16))
+
+    # prepare generation B while A serves (exactly the production shape)
+    with IngestWriter(live_dir, auto_merge=False) as w:
+        for i in range(base_docs, base_docs + delta_docs):
+            w.update(*_bench_doc(i))
+        w.compact_all(note="swap-bench delta")
+    gen_b = live.current_gen()
+
+    texts = [" ".join(_BENCH_WORDS[i % len(_BENCH_WORDS)]
+                      for i in range(j, j + 2)) for j in range(8)]
+    for t in texts:  # warm every probe shape before measuring
+        frontend.search(t, k=5, scoring="bm25")
+
+    stop = threading.Event()
+    events: list[tuple[float, int]] = []  # (completion time, generation)
+    lock = threading.Lock()
+
+    def probe() -> None:
+        i = 0
+        while not stop.is_set():
+            try:
+                res = frontend.search(texts[i % len(texts)], k=5,
+                                      scoring="bm25")
+                with lock:
+                    events.append((time.perf_counter(), res.generation))
+            except Exception:  # noqa: BLE001 — a shed during the swap
+                # window would BE the finding; count it as a gap
+                pass
+            i += 1
+
+    th = threading.Thread(target=probe, name="tpu-ir-swap-bench-probe")
+    th.start()
+    try:
+        time.sleep(probe_s / 2)
+        t_swap0 = time.perf_counter()
+        frontend.reload_generation(generation=gen_b)
+        t_swap1 = time.perf_counter()
+        time.sleep(probe_s / 2)
+    finally:
+        stop.set()
+        th.join(timeout=30.0)
+
+    with lock:
+        evs = list(events)
+    window = [t for t, _ in evs
+              if t_swap0 - 0.25 <= t <= t_swap1 + 0.25]
+    gap_ms = 0.0
+    prev = t_swap0 - 0.25
+    for t in sorted(window) + [t_swap1 + 0.25]:
+        gap_ms = max(gap_ms, (t - prev) * 1e3)
+        prev = t
+    first_b = next((t for t, g in evs if g == gen_b and t >= t_swap0),
+                   None)
+    gens_seen = sorted({g for _, g in evs})
+    get_registry().set_gauge("generation.current", gen_b)
+    return {
+        "generation_a": scorer_a.generation,
+        "generation_b": gen_b,
+        "probes": len(evs),
+        "generations_seen": gens_seen,
+        "swap_gap_ms": round(gap_ms, 3),
+        "swap_staleness_ms": (round((first_b - t_swap0) * 1e3, 3)
+                              if first_b is not None else -1.0),
+        "swap_wall_s": round(t_swap1 - t_swap0, 3),
+        "num_docs_b": frontend.scorer.meta.num_docs,
+    }

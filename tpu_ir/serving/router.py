@@ -173,9 +173,17 @@ class Router:
     def __init__(self, index_dir: str, topology,
                  config: RouterConfig | None = None):
         from ..index import format as fmt
+        from ..index import segments as seg
         from ..search.layout import shard_doc_ranges
 
         self.index_dir = index_dir
+        # live index (ISSUE 12): the docno space, doc partition and
+        # docno->docid mapping are PER GENERATION; a plain index dir is
+        # the degenerate single-generation (0) case
+        self._live_dir = index_dir if seg.is_live(index_dir) else None
+        self._gen_infos: dict = {}
+        self._gen_lock = threading.Lock()
+        resolved_dir, self._gen0 = seg.resolve_serving(index_dir)
         self.config = cfg = config or RouterConfig()
         self._deadline_s = (cfg.deadline_ms if cfg.deadline_ms is not None
                             else envvars.get_float(
@@ -204,10 +212,12 @@ class Router:
         self.num_shards = len(grid)
         if self.num_shards < 1:
             raise ValueError("topology has no shards")
-        meta = fmt.IndexMetadata.load(index_dir)
+        meta = fmt.IndexMetadata.load(resolved_dir)
         self.num_docs = meta.num_docs
         self._ranges = shard_doc_ranges(meta.num_docs, self.num_shards)
-        self._mapping = None  # docid -> docno, loaded lazily
+        self._gen_infos[self._gen0] = {
+            "dir": resolved_dir, "num_docs": meta.num_docs,
+            "ranges": self._ranges, "mapping": None}
         self.admission = AdmissionController(cfg.max_concurrency,
                                              cfg.max_queue)
         self._breakers: dict = {}
@@ -239,37 +249,64 @@ class Router:
                     self.config.breaker_cooldown_s)
             return b
 
-    def _mapping_loaded(self):
-        if self._mapping is None:
+    def _gen_info(self, gen: int) -> dict | None:
+        """The per-generation view (servable dir, num_docs, doc-range
+        partition, lazy docno->docid mapping), or None when the
+        generation cannot be resolved (its manifest was gc'd, or a
+        worker reported a generation this router's filesystem view
+        doesn't know) — the caller treats its responses as lost rather
+        than 500ing the request. Looked up once per new generation a
+        worker reports; the load happens OUTSIDE the lock (manifest +
+        metadata IO must not stall concurrent requests on other
+        generations)."""
+        with self._gen_lock:
+            info = self._gen_infos.get(gen)
+        if info is not None:
+            return info
+        from ..index import format as fmt
+        from ..index import segments as seg
+        from ..search.layout import shard_doc_ranges
+
+        src = self._live_dir or self.index_dir
+        try:
+            resolved, _ = seg.resolve_serving(src, gen if self._live_dir
+                                              else None)
+            meta = fmt.IndexMetadata.load(resolved)
+        except (OSError, ValueError) as e:
+            logger.warning("cannot resolve index generation %s: %r",
+                           gen, e)
+            return None
+        info = {"dir": resolved, "num_docs": meta.num_docs,
+                "ranges": shard_doc_ranges(meta.num_docs,
+                                           self.num_shards),
+                "mapping": None}
+        with self._gen_lock:
+            return self._gen_infos.setdefault(gen, info)
+
+    def _mapping_loaded(self, gen: int | None = None):
+        info = self._gen_info(self._gen0 if gen is None else gen)
+        if info is None:  # winners always resolved; belt-and-braces
+            raise RuntimeError(f"generation {gen} is not resolvable")
+        if info["mapping"] is None:
             from ..collection import DocnoMapping
             from ..index import format as fmt
 
-            self._mapping = DocnoMapping.load(
-                os.path.join(self.index_dir, fmt.DOCNOS))
-        return self._mapping
+            # benign race: two loaders both read, last reference wins
+            info["mapping"] = DocnoMapping.load(
+                os.path.join(info["dir"], fmt.DOCNOS))
+        return info["mapping"]
 
     def _post(self, addr: str, path: str, payload: dict,
               timeout_s: float) -> dict:
-        """One HTTP RPC attempt; raises on any failure (the caller's
-        breaker records the verdict). The socket timeout bounds connect
-        AND read, so a SIGKILLed worker costs one refused connect and a
+        """One HTTP RPC attempt via the SHARED worker-RPC client
+        (shardset.rpc_post — one framing for router fan-out and
+        rolling swaps); raises on any failure (the caller's breaker
+        records the verdict). The socket timeout bounds connect AND
+        read, so a SIGKILLed worker costs one refused connect and a
         hung one at most `timeout_s`."""
-        host, port = addr.rsplit(":", 1)
-        conn = http.client.HTTPConnection(
-            host, int(port), timeout=max(timeout_s, 1e-3))
-        try:
-            conn.request("POST", f"/rpc/{path}",
-                         body=json.dumps(payload),
-                         headers={"Content-Type": "application/json"})
-            resp = conn.getresponse()
-            body = resp.read()
-            if resp.status != 200:
-                raise RuntimeError(
-                    f"worker {addr} /rpc/{path} -> {resp.status}: "
-                    f"{body[:200]!r}")
-            return json.loads(body)
-        finally:
-            conn.close()
+        from .shardset import rpc_post
+
+        return rpc_post(addr, path, payload, timeout_s)
 
     def _call_replica(self, shard: int, replica: int, addr: str,
                       path: str, payload: dict, timeout_s: float):
@@ -484,13 +521,40 @@ class Router:
             root.set("partial", res.partial)
             root.set("level", res.level)
         if return_docids and len(res):
-            mapping = self._mapping_loaded()
+            # the docno->docid mapping of the generation that ANSWERED
+            # — a gen-A mapping applied to gen-B docnos would silently
+            # name the wrong documents across a rolling swap
+            mapping = self._mapping_loaded(res.generation)
             res[:] = [(mapping.get_docid(int(d)), s) for d, s in res]
         self._observe("router.request", t0)
         self._count_served(res)
         self._querylog(text, res, k=k, scoring=scoring, rerank=rerank,
                        t0=t0)
         return res
+
+    def _winning_generation(self, got: dict) -> tuple[int, dict, bool]:
+        """Split one fan-out's responses by the index generation each
+        worker reported and pick the winner: most responding shards,
+        ties to the NEWEST generation. Docnos, doc ranges and scores
+        are only comparable within one generation — merging across two
+        corpus snapshots would return docids from neither — so the
+        losers are discarded and tagged missing (partial). A candidate
+        generation this router cannot RESOLVE (manifest gc'd, foreign
+        report) is skipped the same way — its responses are lost, not
+        a request-killing error; with no resolvable candidate at all
+        the request sheds structurally. Returns (generation, winning
+        {shard: (data, hedges)}, mixed?)."""
+        by_gen: dict[int, dict] = {}
+        for s, (d, h) in got.items():
+            by_gen.setdefault(int(d.get("generation", 0)), {})[s] = (d, h)
+        mixed = len(by_gen) > 1
+        for gen in sorted(by_gen, key=lambda g: (len(by_gen[g]), g),
+                          reverse=True):
+            if self._gen_info(gen) is not None:
+                return gen, by_gen[gen], mixed
+        raise Overloaded("no_resolvable_generation",
+                         queue_depth=self.admission.queue_depth(),
+                         level="shed")
 
     def _route(self, text: str, *, k: int, scoring: str,
                rerank: int | None):
@@ -505,11 +569,14 @@ class Router:
             raise Overloaded("no_healthy_shards",
                              queue_depth=self.admission.queue_depth(),
                              level="shed")
+        gen, winners, mixed = self._winning_generation(got)
+        if mixed:
+            get_registry().incr("router.mixed_generation")
         t_merge = time.perf_counter()
         hits = merge_shard_topk(
-            [got[s][0]["hits"] for s in sorted(got)], k)
+            [winners[s][0]["hits"] for s in sorted(winners)], k)
         self._observe("router.merge", t_merge)
-        return self._assemble(hits, got, all_shards)
+        return self._assemble(hits, winners, all_shards, gen=gen)
 
     def _route_rerank(self, text: str, *, k: int, candidates: int,
                       shards: list):
@@ -523,14 +590,22 @@ class Router:
             raise Overloaded("no_healthy_shards",
                              queue_depth=self.admission.queue_depth(),
                              level="shed")
+        gen, winners, mixed = self._winning_generation(got)
         cand_hits = merge_shard_topk(
-            [got[s][0]["hits"] for s in sorted(got)], candidates)
+            [winners[s][0]["hits"] for s in sorted(winners)], candidates)
         # the fixed candidate-matrix width the single-process kernel
         # would have used: pad to C with empty slots (docid 0)
         cand = [d for d, _ in cand_hits]
         cand += [0] * (candidates - len(cand))
         p2 = {"text": text, "cand": cand}
-        got2 = self._fanout("cosine_at", lambda s: p2, sorted(got))
+        got2 = self._fanout("cosine_at", lambda s: p2, sorted(winners))
+        # phase 2 must answer from the SAME generation phase 1 won —
+        # the candidate list is gen-local docnos; a worker that swapped
+        # between phases would score the wrong documents' ids
+        got2 = {s: v for s, v in got2.items()
+                if int(v[0].get("generation", 0)) == gen}
+        if mixed:
+            get_registry().incr("router.mixed_generation")
         if not got2:
             get_registry().incr("router.shard_lost", len(got))
             raise Overloaded("no_healthy_shards",
@@ -539,24 +614,28 @@ class Router:
         t_merge = time.perf_counter()
         hits = merge_candidate_scores(
             cand, {s: d["scores"] for s, (d, _) in got2.items()},
-            self._ranges, k)
+            self._gen_info(gen)["ranges"], k)
         self._observe("router.merge", t_merge)
         # a shard must survive BOTH phases to count as contributing
-        merged_meta = {s: got[s] for s in got2}
-        res = self._assemble(hits, merged_meta, shards)
+        merged_meta = {s: winners[s] for s in got2}
+        res = self._assemble(hits, merged_meta, shards, gen=gen)
         res.hedges += sum(h for _, h in got2.values())
         return res
 
-    def _assemble(self, hits: list, got: dict, shards: list):
+    def _assemble(self, hits: list, got: dict, shards: list,
+                  gen: int | None = None):
         from ..search.scorer import SearchResult
 
+        gen = self._gen0 if gen is None else gen
+        ranges = self._gen_info(gen)["ranges"]
         res = SearchResult((int(d), float(s)) for d, s in hits)
+        res.generation = gen
         ok = tuple(sorted(got))
         missing = tuple(s for s in shards if s not in got)
         # trailing shards past num_docs own an empty range — their
         # absence loses no documents and must not tag the response
         missing = tuple(s for s in missing
-                        if self._ranges[s][0] <= self._ranges[s][1])
+                        if ranges[s][0] <= ranges[s][1])
         res.shards_ok = ok
         res.missing_shards = missing
         res.partial = bool(missing)
@@ -598,6 +677,7 @@ class Router:
             "query_hash": querylog.query_hash(text.split()),
             "k": k, "scoring": scoring, "rerank": rerank,
             "level": res.level, "degraded": bool(res.degraded),
+            "generation": int(res.generation),
             "partial": bool(res.partial),
             "shards_ok": list(res.shards_ok),
             "missing_shards": list(res.missing_shards),
@@ -655,9 +735,15 @@ class Router:
                                    if hedge != float("inf") else None),
                 "replicas": replicas,
             })
+        with self._gen_lock:
+            gens = sorted(self._gen_infos)
         payload = {"num_shards": self.num_shards,
                    "hedge_floor_ms": round(self._hedge_floor_s * 1e3, 3),
                    "deadline_ms": round(self._deadline_s * 1e3, 3),
+                   # the live-index view: generations this router has
+                   # seen workers answer from (each worker's own
+                   # index_generation rides in its replica entry)
+                   "generations_seen": gens,
                    "shards": shards}
         with self._health_lock:
             self._health_cache = (time.monotonic(), payload)

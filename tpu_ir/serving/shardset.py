@@ -49,11 +49,65 @@ logger = logging.getLogger(__name__)
 READY_POLL_S = 0.05
 
 
-def worker_rpc_handlers(frontend, scorer) -> dict:
+def rpc_post(addr: str, path: str, payload: dict,
+             timeout_s: float) -> dict:
+    """One worker HTTP RPC attempt — THE client-side framing of the
+    /rpc contract (router fan-out, rolling swaps, tests), defined once
+    next to the server side so the two cannot drift. Raises on any
+    failure (refused, reset, timeout, non-200); the caller decides
+    what a failure means (breaker verdict, skip-and-respawn, ...).
+    The socket timeout bounds connect AND read."""
+    import http.client
+    import json as _json
+
+    host, port = addr.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port),
+                                      timeout=max(timeout_s, 1e-3))
+    try:
+        conn.request("POST", f"/rpc/{path}",
+                     body=_json.dumps(payload),
+                     headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        body = resp.read()
+        if resp.status != 200:
+            raise RuntimeError(
+                f"worker {addr} /rpc/{path} -> {resp.status}: "
+                f"{body[:200]!r}")
+        return _json.loads(body)
+    finally:
+        conn.close()
+
+
+def get_worker_health(addr: str, timeout_s: float) -> dict:
+    """GET one worker's /healthz payload (the identity/generation view
+    serve_worker's extra_health merges in)."""
+    import http.client
+    import json as _json
+
+    host, port = addr.rsplit(":", 1)
+    conn = http.client.HTTPConnection(host, int(port),
+                                      timeout=max(timeout_s, 1e-3))
+    try:
+        conn.request("GET", "/healthz")
+        return _json.loads(conn.getresponse().read())
+    finally:
+        conn.close()
+
+
+def worker_rpc_handlers(frontend, scorer=None, *, reload_fn=None) -> dict:
     """The worker's RPC surface over one (doc-range-restricted) scorer.
     Handlers run on the HTTP server's request threads; concurrency is
     bounded by the frontend's admission control, errors surface as the
-    server's 503 (Overloaded) / 500 (anything else) contract."""
+    server's 503 (Overloaded) / 500 (anything else) contract.
+
+    The scorer is read through the frontend PER CALL (not captured):
+    a generation swap republishes frontend.scorer, and the very next
+    RPC must serve — and tag — the new generation. Every response
+    carries `generation` so the router can refuse to merge hits from
+    two different corpus snapshots (the mixed-generation window).
+    `reload_fn(generation|None)` (live-index workers only) serves
+    POST /rpc/reload — the rolling-upgrade handoff."""
+    del scorer  # back-compat positional slot; frontend.scorer is live
 
     def search(payload: dict) -> dict:
         res = frontend.search(
@@ -66,45 +120,100 @@ def worker_rpc_handlers(frontend, scorer) -> dict:
             "hits": [[int(d), float(s)] for d, s in res],
             "level": res.level,
             "degraded": bool(res.degraded),
+            "generation": int(res.generation),
         }
 
     def cosine_at(payload: dict) -> dict:
-        scores = scorer.cosine_scores_at(
+        sc = frontend.scorer
+        scores = sc.cosine_scores_at(
             [str(payload["text"])],
             [int(c) for c in payload.get("cand", [])])
-        return {"scores": [float(s) for s in scores[0]]}
+        return {"scores": [float(s) for s in scores[0]],
+                "generation": int(sc.generation)}
 
-    return {"search": search, "cosine_at": cosine_at}
+    handlers = {"search": search, "cosine_at": cosine_at}
+    if reload_fn is not None:
+        def reload(payload: dict) -> dict:
+            gen = payload.get("generation")
+            return reload_fn(None if gen is None else int(gen))
+
+        handlers["reload"] = reload
+    return handlers
 
 
 def serve_worker(index_dir: str, shard: int, num_shards: int, *,
                  layout: str = "sparse", port: int = 0,
                  replica: int = 0, generation: int = 0,
+                 index_generation: int | None = None,
                  deadline_s: float | None = None,
                  max_concurrency: int = 4, max_queue: int = 16,
                  warm: bool = True):
     """Load a shard-restricted scorer, wrap it in a ServingFrontend, and
     serve it over an RPC-enabled obs server. Returns (server, frontend,
     scorer) — the caller owns `server.stop()`. This is the whole worker;
-    the subprocess main below is just config plumbing around it."""
+    the subprocess main below is just config plumbing around it.
+
+    `index_dir` may be a LIVE index dir (index/segments.py): the worker
+    then serves its (`index_generation` or current-servable) generation
+    and exposes POST /rpc/reload — load the named (default: latest
+    servable) generation with a freshly computed doc_range, WARM it,
+    and swap with zero downtime (the old generation keeps serving until
+    the publish). `generation` is the SPAWN generation (process
+    lifetime, bumped by ShardSet.respawn); the index generation is a
+    separate axis and both ride /healthz."""
+    from ..index import segments as seg
     from ..search.scorer import Scorer
     from ..obs.server import MetricsServer
     from .frontend import ServingConfig, ServingFrontend
 
-    lo, hi = shard_doc_ranges_for(index_dir, shard, num_shards)
-    scorer = Scorer.load(index_dir, layout=layout, deadline_s=deadline_s,
-                         doc_range=(lo, hi))
+    live = seg.is_live(index_dir)
+
+    def load_for(gen: int | None) -> "Scorer":
+        from ..index import format as fmt
+        from ..search.layout import shard_doc_ranges
+
+        resolved, g = seg.resolve_serving(index_dir, gen)
+        meta = fmt.IndexMetadata.load(resolved)
+        # the doc partition follows num_docs: each generation re-deals
+        # the (possibly grown) corpus over the SAME shard grid
+        rg = shard_doc_ranges(meta.num_docs, num_shards)[shard]
+        return Scorer.load_generation(
+            index_dir, g, layout=layout, deadline_s=deadline_s,
+            doc_range=rg)
+
+    scorer = load_for(index_generation)
     frontend = ServingFrontend(scorer, ServingConfig(
         max_concurrency=max_concurrency, max_queue=max_queue,
         deadline_s=deadline_s))
-    info = {"worker": {
-        "shard": shard, "replica": replica, "num_shards": num_shards,
-        "doc_range": [lo, hi], "generation": generation,
-        "pid": os.getpid(), "layout": scorer.layout,
-    }}
+
+    def info() -> dict:
+        sc = frontend.scorer
+        return {"worker": {
+            "shard": shard, "replica": replica, "num_shards": num_shards,
+            "doc_range": list(sc.doc_range or ()),
+            "generation": generation,
+            "index_generation": sc.generation,
+            "live": live,
+            "pid": os.getpid(), "layout": sc.layout,
+        }}
+
+    reload_fn = None
+    if live:
+        def reload_fn(gen: int | None) -> dict:
+            new = load_for(gen)
+            if warm:
+                # warm BEFORE the publish: the first post-swap request
+                # must not eat an XLA compile inside a shard deadline
+                _warm_worker(new)
+            frontend.reload_generation(new)
+            return {"generation": new.generation,
+                    "num_docs": new.meta.num_docs,
+                    "doc_range": list(new.doc_range or ())}
+
     server = MetricsServer(
-        port=port, rpc_handlers=worker_rpc_handlers(frontend, scorer),
-        extra_health=lambda: info).start()
+        port=port,
+        rpc_handlers=worker_rpc_handlers(frontend, reload_fn=reload_fn),
+        extra_health=info).start()
     if warm:
         _warm_worker(scorer)
     return server, frontend, scorer
@@ -113,11 +222,14 @@ def serve_worker(index_dir: str, shard: int, num_shards: int, *,
 def shard_doc_ranges_for(index_dir: str, shard: int,
                          num_shards: int) -> tuple:
     """This shard's (lo, hi) docid range from the index metadata — the
-    partition every worker and the router derive identically."""
+    partition every worker and the router derive identically. A live
+    dir resolves to its current servable generation first."""
     from ..index import format as fmt
+    from ..index import segments as seg
     from ..search.layout import shard_doc_ranges
 
-    meta = fmt.IndexMetadata.load(index_dir)
+    resolved, _ = seg.resolve_serving(index_dir)
+    meta = fmt.IndexMetadata.load(resolved)
     return shard_doc_ranges(meta.num_docs, num_shards)[shard]
 
 
@@ -188,11 +300,14 @@ def worker_main(config_path: str) -> int:
     with open(config_path, encoding="utf-8") as f:
         cfg = json.load(f)
     _watch_parent()
+    index_generation = cfg.get("index_generation")
     server, _frontend, _scorer = serve_worker(
         cfg["index_dir"], int(cfg["shard"]), int(cfg["num_shards"]),
         layout=cfg.get("layout", "sparse"), port=int(cfg.get("port", 0)),
         replica=int(cfg.get("replica", 0)),
         generation=int(cfg.get("generation", 0)),
+        index_generation=(None if index_generation is None
+                          else int(index_generation)),
         deadline_s=cfg.get("deadline_s"),
         max_concurrency=int(cfg.get("max_concurrency", 4)),
         max_queue=int(cfg.get("max_queue", 16)),
@@ -260,10 +375,15 @@ class ShardSet:
                  layout: str = "sparse", deadline_s: float | None = None,
                  rundir: str | None = None, warm: bool = True,
                  max_concurrency: int = 4, max_queue: int = 16,
-                 spawn_timeout_s: float = 120.0):
+                 spawn_timeout_s: float = 120.0,
+                 index_generation: int | None = None):
         if shards < 1 or replicas < 1:
             raise ValueError("shards and replicas must be >= 1")
         self.index_dir = index_dir
+        # live indexes: pin spawns to one index generation (the upgrade
+        # soak starts the fleet on gen A with gen B already prepared);
+        # None = each worker resolves the current servable generation
+        self.index_generation = index_generation
         self.shards = shards
         self.replicas = replicas
         self.layout = layout
@@ -306,7 +426,9 @@ class ShardSet:
         cfg = {
             "index_dir": self.index_dir, "shard": shard,
             "num_shards": self.shards, "replica": replica,
-            "generation": generation, "layout": self.layout,
+            "generation": generation,
+            "index_generation": self.index_generation,
+            "layout": self.layout,
             "deadline_s": self.deadline_s, "warm": self.warm,
             "max_concurrency": self.max_concurrency,
             "max_queue": self.max_queue, "port": 0,
@@ -375,6 +497,13 @@ class ShardSet:
 
         get_registry().incr("router.worker_respawn")
         return handle
+
+    def set_index_generation(self, generation: int | None) -> None:
+        """Re-pin the generation FUTURE spawns load (rolling_swap calls
+        this after a live-index handoff so a later chaos respawn comes
+        back on the new corpus, not the pinned old one)."""
+        with self._lock:
+            self.index_generation = generation
 
     def addresses(self) -> list:
         """[shard][replica] -> "host:port" — the router's topology view

@@ -311,11 +311,14 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                          kill_shard_at: float = 0.55,
                          respawn_at: float = 0.75,
                          chaos: bool = True,
+                         upgrade_at: float | None = None,
+                         upgrade_docs: int = 8,
                          timeout_s: float = 240.0,
                          pacing_s: float = 0.002,
                          rundir: str | None = None,
                          flight_dir: str | None = None,
-                         recovery_probes: int = 16) -> dict:
+                         recovery_probes: int = 16,
+                         recovery_timeout_s: float = 60.0) -> dict:
     """The scatter-gather chaos soak (ISSUE 10): mixed traffic through a
     REAL multi-process topology — S doc shards x R replica workers
     behind a Router — while a chaos controller SIGKILLs a replica, then
@@ -340,7 +343,20 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
     `kill_shard_at` SIGKILLs every replica of the LAST shard (partial
     results must appear), `respawn_at` restarts all corpses. The
     returned report carries the per-class counts and check results; the
-    caller asserts."""
+    caller asserts.
+
+    Upgrade-mid-soak (ISSUE 12; `upgrade_at` set, `index_dir` a LIVE
+    index): generation B (gen A + `upgrade_docs` synthetic docs) is
+    prepared BEFORE the fleet spawns (the swap is what's under test,
+    not mid-soak indexing), workers spawn pinned to generation A, and
+    at the scheduled fraction a rolling per-replica handoff walks the
+    grid. The invariants extend per generation: every response is
+    tagged with exactly one generation, full responses are bit-
+    identical to THAT generation's serial reference, the mixed window
+    is bounded (no old-generation response can complete more than one
+    in-flight wave after the roll finishes), and the post-soak recovery
+    probes must all serve generation B."""
+    from ..index import segments as seg
     from ..obs import get_registry
     from ..search.layout import shard_doc_ranges
     from ..search.scorer import Scorer
@@ -349,42 +365,71 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
 
     if faults.active() is not None:
         raise RuntimeError("a fault plan is already installed")
-    ref_scorer = Scorer.load(index_dir, layout=layout)
+    if upgrade_at is not None and not seg.is_live(index_dir):
+        raise ValueError("upgrade_at needs a LIVE index dir "
+                         "(index/segments.py; `tpu-ir ingest --init`)")
+    ref_scorer = Scorer.load_generation(index_dir, layout=layout)
+    gen_a = ref_scorer.generation
     reqs = make_queries(ref_scorer, queries, seed=seed)
-    num_docs = ref_scorer.meta.num_docs
-    ranges = shard_doc_ranges(num_docs, shards)
+
+    # -- generation B: prepared up front, swapped in mid-soak ----------
+    gen_b = None
+    ref_scorers = {gen_a: ref_scorer}
+    if upgrade_at is not None:
+        from ..index.ingest import IngestWriter
+
+        rng_u = random.Random(seed * 31 + 7)
+        terms = list(ref_scorer.vocab.terms)
+        with IngestWriter(index_dir, auto_merge=False) as w:
+            for i in range(upgrade_docs):
+                w.update(f"UPG-{i:04d}",
+                         " ".join(rng_u.choice(terms)
+                                  for _ in range(5)))
+            w.compact_all(note="upgrade-mid-soak")
+        gen_b = seg.LiveIndex.open(index_dir).current_gen()
+        ref_scorers[gen_b] = Scorer.load_generation(
+            index_dir, gen_b, layout=layout)
+
+    ranges_by_gen = {g: shard_doc_ranges(sc.meta.num_docs, shards)
+                     for g, sc in ref_scorers.items()}
 
     job = obs.start_job(
         "soak", f"routed-soak-{queries}q-{shards}s{replicas}r",
         phases=("reference", "serve", "recovery"),
         config={"threads": threads, "queries": queries, "seed": seed,
-                "shards": shards, "replicas": replicas, "chaos": chaos})
+                "shards": shards, "replicas": replicas, "chaos": chaos,
+                "upgrade_at": upgrade_at})
     try:
-        # -- oracles (single-process, before any worker exists) -----------
+        # -- oracles (single-process, before any worker exists):
+        # one serial reference + partial-subset oracle PER GENERATION —
+        # a response is judged against the corpus snapshot it is tagged
+        # with, never across snapshots -------------------------------
         distinct = list({_req_key(r): r for r in reqs}.values())
-        obs.report_progress("reference", total=len(distinct))
-        reference: dict = {}
-        full_rank: dict = {}
-        oracle_k = min(num_docs, 1000)
-        for r in distinct:
-            key = _req_key(r)
-            res = ref_scorer.search_batch(
-                [r["text"]], k=r["k"], scoring=r["scoring"],
-                rerank=r["rerank"])[0]
-            if res.degraded:
-                raise RuntimeError("reference run degraded — clear the "
-                                   "fault plan before the soak")
-            reference[key] = list(res)
-            if not r["rerank"]:
-                # the independent partial-subset oracle: the FULL
-                # positive ranking by docid, filtered per healthy-shard
-                # set at check time (per-doc scores are partition-
-                # independent, so a filter of the full ranking IS the
-                # healthy shards' exact merge)
-                full_rank[key] = list(ref_scorer.search_batch(
-                    [r["text"]], k=oracle_k, scoring=r["scoring"],
-                    return_docids=False)[0])
-            obs.report_progress("reference", advance=1)
+        obs.report_progress("reference",
+                            total=len(distinct) * len(ref_scorers))
+        reference: dict = {g: {} for g in ref_scorers}
+        full_rank: dict = {g: {} for g in ref_scorers}
+        for g, sc in ref_scorers.items():
+            oracle_k = min(sc.meta.num_docs, 1000)
+            for r in distinct:
+                key = _req_key(r)
+                res = sc.search_batch(
+                    [r["text"]], k=r["k"], scoring=r["scoring"],
+                    rerank=r["rerank"])[0]
+                if res.degraded:
+                    raise RuntimeError("reference run degraded — clear "
+                                       "the fault plan before the soak")
+                reference[g][key] = list(res)
+                if not r["rerank"]:
+                    # the independent partial-subset oracle: the FULL
+                    # positive ranking by docid, filtered per healthy-
+                    # shard set at check time (per-doc scores are
+                    # partition-independent, so a filter of the full
+                    # ranking IS the healthy shards' exact merge)
+                    full_rank[g][key] = list(sc.search_batch(
+                        [r["text"]], k=oracle_k, scoring=r["scoring"],
+                        return_docids=False)[0])
+                obs.report_progress("reference", advance=1)
 
         reg = get_registry()
         counters_before = {n: reg.get(n) for n in reg.counter_names()
@@ -392,13 +437,16 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
         hist_before = reg.hist_state()
         obs.report_progress("serve", total=len(reqs))
         results: list = [None] * len(reqs)
+        completion_order: list = [0] * len(reqs)
         completed = threading.Event()
         progress = [0]
         progress_lock = threading.Lock()
 
         with ShardSet(index_dir, shards=shards, replicas=replicas,
                       layout=layout, deadline_s=worker_deadline_s,
-                      rundir=rundir) as shardset:
+                      rundir=rundir,
+                      index_generation=(gen_a if upgrade_at is not None
+                                        else None)) as shardset:
             # the soak default: a generous per-shard deadline. Dead
             # workers fail at connection-refused speed regardless (the
             # failover/partial paths never wait it out), so a large
@@ -408,12 +456,14 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                             router_config
                             or RouterConfig(deadline_ms=3000.0))
             try:
-                # -- chaos controller ---------------------------------
+                # -- chaos + upgrade controller -----------------------
                 killed: list = []
+                swap_state = {"done_at": None, "result": None}
+                swap_complete = threading.Event()
 
                 def chaos_controller():
                     fired = {"replica": False, "shard": False,
-                             "respawn": False}
+                             "respawn": False, "upgrade": False}
                     while not completed.is_set():
                         with progress_lock:
                             frac = progress[0] / max(len(reqs), 1)
@@ -437,6 +487,26 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                                 for s_, r_ in list(killed):
                                     shardset.respawn(s_, r_)
                                 killed.clear()
+                            if upgrade_at is not None \
+                                    and not fired["upgrade"] \
+                                    and frac >= upgrade_at:
+                                fired["upgrade"] = True
+                                # the tentpole moment: roll the fleet
+                                # onto generation B replica by replica
+                                # while traffic keeps flowing
+                                from .generation import rolling_swap
+
+                                try:
+                                    out = rolling_swap(shardset,
+                                                       generation=gen_b)
+                                    with progress_lock:
+                                        swap_state["done_at"] = \
+                                            progress[0]
+                                    swap_state["result"] = out
+                                finally:
+                                    # even a failed roll must release
+                                    # the held-back traffic tranche
+                                    swap_complete.set()
                         except Exception:  # noqa: BLE001 — chaos must
                             logger.exception("chaos controller")  # not
                         completed.wait(0.02)  # kill the soak itself
@@ -466,6 +536,7 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                         results[i] = ("error", e)
                     with progress_lock:
                         progress[0] += 1
+                        completion_order[i] = progress[0]
                     job.report("serve", advance=1)
 
                 t0 = time.perf_counter()
@@ -473,8 +544,32 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                     max_workers=threads,
                     thread_name_prefix="routed-soak")
                 try:
+                    # upgrade-mid-soak: hold the LAST tranche of
+                    # requests until the rolling swap confirms, so the
+                    # schedule deterministically exercises traffic on
+                    # BOTH sides of the handoff no matter how the
+                    # soak's wall clock races the reload (workers keep
+                    # serving the old generation throughout — nothing
+                    # here waits on a dark fleet)
+                    hold = 0
+                    if upgrade_at is not None:
+                        # the held tranche must (a) leave enough
+                        # pre-swap traffic for `frac` to actually REACH
+                        # upgrade_at (or the trigger dead-stalls until
+                        # the wait times out) and (b) never exceed the
+                        # request list (worker(-i) would corrupt the
+                        # results array)
+                        hold = min(max(len(reqs) // 4, threads),
+                                   len(reqs) // 2,
+                                   int(len(reqs) * (1.0 - upgrade_at)))
+                        hold = max(hold, 0)
+                    n_pre = len(reqs) - hold
                     futs = [pool.submit(worker, i, r)
-                            for i, r in enumerate(reqs)]
+                            for i, r in enumerate(reqs[:n_pre])]
+                    if hold:
+                        swap_complete.wait(min(timeout_s * 0.5, 120.0))
+                        futs += [pool.submit(worker, n_pre + j, r)
+                                 for j, r in enumerate(reqs[n_pre:])]
                     done, not_done = wait(futs, timeout=timeout_s)
                     for f in not_done:
                         f.cancel()
@@ -494,7 +589,11 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                 obs.report_progress("recovery", total=recovery_probes)
                 recovery_full = 0
                 probe_reqs = reqs[:recovery_probes]
-                recovery_deadline = time.monotonic() + 60.0
+                # after an upgrade the fleet must have CONVERGED: every
+                # probe must serve generation B and match ITS reference
+                want_gen = gen_b if gen_b is not None else gen_a
+                recovery_deadline = (time.monotonic()
+                                     + max(recovery_timeout_s, 1.0))
                 for r in probe_reqs:
                     while True:
                         try:
@@ -502,7 +601,9 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                                                  scoring=r["scoring"],
                                                  rerank=r["rerank"])
                             if Router.classify(pres) == "full" and \
-                                    list(pres) == reference[_req_key(r)]:
+                                    pres.generation == want_gen and \
+                                    list(pres) == reference[want_gen][
+                                        _req_key(r)]:
                                 recovery_full += 1
                                 break
                         except Overloaded:
@@ -521,9 +622,12 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
         classes = {"full": 0, "degraded": 0, "partial": 0}
         full_mismatches = partial_mismatches = 0
         partial_checked = tagged_divergent = 0
-        hedged_requests = 0
+        hedged_requests = unknown_generation = late_old_generation = 0
+        generations_served: dict = {}
         error_reprs: list = []
-        for out, r in zip(outcomes, reqs):
+        swap_done_at = swap_state["done_at"] if upgrade_at is not None \
+            else None
+        for idx, (out, r) in enumerate(zip(outcomes, reqs)):
             if out is None:
                 continue
             state, payload = out
@@ -540,25 +644,41 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
             cls = Router.classify(res)
             classes[cls] += 1
             hedged_requests += bool(res.hedges)
+            gen = int(getattr(res, "generation", 0))
+            generations_served[gen] = generations_served.get(gen, 0) + 1
+            if gen not in reference:
+                # a response tagged with a generation no oracle knows is
+                # an attribution bug, not weather
+                unknown_generation += 1
+                continue
+            if swap_done_at is not None and gen != gen_b \
+                    and completion_order[idx] > swap_done_at + threads:
+                # the bounded-mixed-window pin: once the rolling swap
+                # has confirmed every replica, only the <= `threads`
+                # requests already in flight may still answer from the
+                # old generation; anything later is an unbounded window
+                late_old_generation += 1
             key = _req_key(r)
             if cls == "full":
-                if list(res) != reference[key]:
+                if list(res) != reference[gen][key]:
                     full_mismatches += 1
             elif cls == "partial" and not res.degraded \
                     and res.level == "full" and not r["rerank"]:
                 # the pinned-correct-subset check: filter the full
-                # oracle ranking to the shards that contributed
-                ok_ranges = [ranges[s] for s in res.shards_ok]
-                expect = [(d, s) for d, s in full_rank[key]
+                # oracle ranking (of the generation that ANSWERED) to
+                # the shards that contributed
+                g_ranges = ranges_by_gen[gen]
+                ok_ranges = [g_ranges[s] for s in res.shards_ok]
+                expect = [(d, s) for d, s in full_rank[gen][key]
                           if any(lo <= d <= hi
                                  for lo, hi in ok_ranges)][: r["k"]]
-                mapping = ref_scorer.mapping
+                mapping = ref_scorers[gen].mapping
                 expect = [(mapping.get_docid(int(d)), float(s))
                           for d, s in expect]
                 partial_checked += 1
                 if list(res) != expect:
                     partial_mismatches += 1
-            elif list(res) != reference[key]:
+            elif list(res) != reference[gen][key]:
                 tagged_divergent += 1
 
         router_delta = {
@@ -585,6 +705,9 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
             "shards": shards,
             "replicas": replicas,
             "chaos": chaos,
+            "generations_served": {str(g): n for g, n in
+                                   sorted(generations_served.items())},
+            "unknown_generation": unknown_generation,
             "router": router_delta,
             # routed-stage percentiles for THIS run (registry delta):
             # end-to-end routed requests, per-shard worker RTTs, and
@@ -593,8 +716,19 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                 hist_before, always=("router.request", "router.shard_rtt",
                                      "router.merge")),
         }
+        if upgrade_at is not None:
+            report["upgrade"] = {
+                "generation_a": gen_a,
+                "generation_b": gen_b,
+                "swap": swap_state["result"],
+                "swap_done_at_request": swap_done_at,
+                "late_old_generation": late_old_generation,
+                "mixed_generation_requests": router_delta.get(
+                    "router.mixed_generation", 0),
+            }
         breach = (errors or deadlocked or full_mismatches
-                  or partial_mismatches
+                  or partial_mismatches or unknown_generation
+                  or late_old_generation
                   or served + shed != len(reqs))
         if breach:
             report["flight_record"] = obs.flight_dump(

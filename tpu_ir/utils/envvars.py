@@ -181,6 +181,20 @@ _declare("TPU_IR_SLOW_QUERY_MS", "float", 0.0,
          "requests at/above this latency are force-captured (explain + "
          "span tree + flight record); 0 disables the trap", "§15",
          minimum=0.0)
+_declare("TPU_IR_INGEST_BUFFER_DOCS", "int", 1000,
+         "buffered documents that auto-flush the IngestWriter into one "
+         "delta segment", "§19", minimum=1)
+_declare("TPU_IR_INGEST_KEEP_GENERATIONS", "int", 8,
+         "generation manifests gc() keeps; unreferenced segment dirs "
+         "are deleted with the manifests that named them", "§19",
+         minimum=1)
+_declare("TPU_IR_MERGE_FACTOR", "int", 4,
+         "segments in one size tier that trigger a tiered merge step "
+         "(merge debt threshold)", "§19", minimum=2)
+_declare("TPU_IR_MERGE_TIER_RATIO", "float", 8.0,
+         "geometric doc-count ratio between merge tiers (each doc is "
+         "rewritten about log_ratio(N) times over its lifetime)", "§19",
+         minimum=2.0)
 _declare("TPU_IR_ROUTER_DEADLINE_MS", "float", 500.0,
          "per-shard deadline for one routed request: a shard that "
          "answers on no replica within it ships the response partial",
