@@ -505,10 +505,31 @@ def run_msmarco(args) -> dict:
         eval_out.update(real_out)
 
         m = min(256, n_queries)
+        from tpu_ir.obs import get_registry
+
+        def _blockmax_delta(before, after):
+            """Realized block-max skip fraction over a measured window
+            (blocks_masked / blocks_considered; None when the kernels
+            never engaged — e.g. TPU_IR_BLOCKMAX=0 control runs)."""
+            cons = (after.get("blockmax.blocks_considered", 0)
+                    - before.get("blockmax.blocks_considered", 0))
+            if cons <= 0:
+                return None
+            masked = (after.get("blockmax.blocks_masked", 0)
+                      - before.get("blockmax.blocks_masked", 0))
+            return round(masked / cons, 4)
+
+        c0 = dict(get_registry().snapshot()["counters"])
+        t0 = time.perf_counter()
         scorer.topk(q_ids[:m], k=1000, scoring="bm25")  # compile
+        cold_s = time.perf_counter() - t0
+        c1 = dict(get_registry().snapshot()["counters"])
         t0 = time.perf_counter()
         _, docnos1k = scorer.topk(q_ids[:m], k=1000, scoring="bm25")
         cand_s = time.perf_counter() - t0
+        c2 = dict(get_registry().snapshot()["counters"])
+        skip_cold = _blockmax_delta(c0, c1)
+        skip_warm = _blockmax_delta(c1, c2)
         recall1k = float(np.mean([
             rel_docnos[qi] in docnos1k[qi] for qi in range(m)]))
 
@@ -567,6 +588,14 @@ def run_msmarco(args) -> dict:
         **metrics,
         **speeds,
         "top1000_queries_per_sec": round(m / cand_s, 1),
+        # deep-k headline twins (ISSUE 13): the warmed deep top-k rate
+        # under its own name for the sentry, the cold (first-dispatch,
+        # compile included) rate, and the realized block-max skip
+        # fraction over each window
+        "topk1000_qps": round(m / cand_s, 1),
+        "topk1000_qps_cold": round(m / cold_s, 1),
+        "blockmax_skip_block_fraction": skip_warm,
+        "blockmax_skip_block_fraction_cold": skip_cold,
         "top1000_recall": round(recall1k, 4),
         "quality_gate": "ok" if not gate else "; ".join(gate),
         "quality_gate_enforced": n_queries >= _GATE_MIN_QUERIES,

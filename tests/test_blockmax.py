@@ -1,0 +1,610 @@
+"""Block-max pruning tests (ISSUE 13): impact-ordered per-block score
+bounds in the arena + the branchless block-max top-k kernel family.
+
+THE contract: block-max results are BIT-IDENTICAL (docids, float bits,
+tie order) to the exact kernels, whichever in-kernel branch runs — the
+masked hot stage computes surviving columns with the same elementwise
+weights and the same gemm reduction the full-width stage uses, masked
+docs provably cannot reach the top-k, and the overflow fallback IS the
+exact stage. The suite pins that across layouts x scorings x k, through
+the scorer (scheduled groups, doc_range-restricted workers, coalesced
+rung-padded batches), over the serving-cache warm path, and for the
+pre-weighted strip cache; plus the artifact half — builder-written
+bounds, `migrate-index --add-bounds` backfill, corrupt-bounds
+quarantine, and doctor's bound report.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_ir.index import blockmax as bmx
+from tpu_ir.index import format as fmt
+from tpu_ir.ops.scoring import (
+    blockmax_cand_blocks,
+    bm25_strip,
+    bm25_topk_blockmax,
+    bm25_topk_tiered,
+    lntf_strip,
+    tfidf_topk_blockmax,
+    tfidf_topk_tiered,
+)
+from tpu_ir.search.layout import build_tiered_layout, restrict_tiers
+
+NDOCS = 6000  # > 8 blocks at width 512, wide enough for k=1000
+
+
+def _zipf_pairs(vocab=2600, ndocs=NDOCS, n_occ=150_000, seed=7):
+    rng = np.random.default_rng(seed)
+    p = 1.0 / np.arange(1, vocab + 1)
+    p /= p.sum()
+    t = rng.choice(vocab, n_occ, p=p).astype(np.int64)
+    d = rng.integers(1, ndocs + 1, n_occ).astype(np.int64)
+    key, tf = np.unique(t * (ndocs + 1) + d, return_counts=True)
+    pair_term = (key // (ndocs + 1)).astype(np.int32)
+    pair_doc = (key % (ndocs + 1)).astype(np.int32)
+    pair_tf = tf.astype(np.int32)
+    df = np.bincount(pair_term, minlength=vocab).astype(np.int32)
+    return pair_term, pair_doc, pair_tf, df
+
+
+@pytest.fixture(scope="module")
+def layout():
+    pair_term, pair_doc, pair_tf, df = _zipf_pairs()
+    lay = build_tiered_layout(pair_doc, pair_tf, df, num_docs=NDOCS,
+                              hot_budget=16 * (NDOCS + 1))
+    doc_len = np.zeros(NDOCS + 1, np.int32)
+    np.add.at(doc_len, pair_doc, pair_tf)
+    args = (jnp.asarray(lay.hot_rank), lay.hot_device(),
+            jnp.asarray(lay.tier_of), jnp.asarray(lay.row_of),
+            tuple(jnp.asarray(a) for a in lay.tier_docs),
+            tuple(jnp.asarray(a) for a in lay.tier_tfs))
+    return (pair_term, pair_doc, pair_tf, df), lay, args, doc_len
+
+
+def _bound_table(lay, doc_len, scoring, *, k1=0.9, b=0.4):
+    """The per-mode [H, nblk] bound table, the scorer's construction."""
+    max_tf = np.asarray(lay.hot_blk_max, np.float32)
+    if scoring == "tfidf":
+        return jnp.asarray(np.where(
+            max_tf > 0, 1.0 + np.log(np.maximum(max_tf, 1.0)), 0.0))
+    width = lay.blockmax_width
+    nblk = max_tf.shape[1]
+    dlf = doc_len.astype(np.float32)
+    avg = float(dlf.sum()) / NDOCS
+    dl_norm = 1.0 - b + b * dlf / max(avg, 1e-9)
+    padded = np.full(nblk * width, np.inf, np.float32)
+    padded[1: NDOCS + 1] = dl_norm[1: NDOCS + 1]
+    dl_min = padded.reshape(nblk, width).min(axis=1)
+    dl_min = np.where(np.isfinite(dl_min), dl_min, 0.0)
+    sat = max_tf * (k1 + 1.0) / np.maximum(max_tf + k1 * dl_min[None, :],
+                                           1e-9)
+    return jnp.asarray(np.where(max_tf > 0, sat, 0.0))
+
+
+def _queries(lay, df, kind, seed=3, rows=6):
+    """`rare_hot`: very rare cold terms + one hot term — blocks without
+    cold postings are maskable, the pruned branch engages. `hot_only`:
+    tau = 0, provably the overflow fallback. `mixed`: everything."""
+    rng = np.random.default_rng(seed)
+    hot = np.nonzero(lay.hot_rank >= 0)[0]
+    rare = np.nonzero((lay.hot_rank < 0) & (df >= 2) & (df <= 8))[0]
+    mid = np.nonzero((lay.hot_rank < 0) & (df >= 30) & (df <= 300))[0]
+    out = []
+    for i in range(rows):
+        if kind == "rare_hot":
+            out.append([int(rng.choice(hot)), int(rng.choice(rare)),
+                        int(rng.choice(rare)), int(rng.choice(rare))])
+        elif kind == "hot_only":
+            out.append([int(rng.choice(hot)), int(rng.choice(hot)), -1, -1])
+        else:
+            out.append([int(rng.choice(hot)), int(rng.choice(mid)),
+                        int(rng.choice(rare)), -1])
+    return np.array(out, np.int32)
+
+
+def _kernel_pair(args, df, doc_len, scoring, lay):
+    n = jnp.int32(NDOCS)
+    bound = _bound_table(lay, doc_len, scoring)
+    width = lay.blockmax_width
+    dl = jnp.asarray(doc_len)
+
+    def exact(q, k):
+        if scoring == "bm25":
+            return bm25_topk_tiered(jnp.asarray(q), *args, jnp.asarray(df),
+                                    dl, n, num_docs=NDOCS, k=k)
+        return tfidf_topk_tiered(jnp.asarray(q), *args, jnp.asarray(df),
+                                 n, num_docs=NDOCS, k=k)
+
+    def blockmax(q, k, cand_blocks=None):
+        cb = cand_blocks or blockmax_cand_blocks(k, NDOCS, width)
+        if scoring == "bm25":
+            return bm25_topk_blockmax(
+                jnp.asarray(q), *args, jnp.asarray(df), dl, n, bound,
+                num_docs=NDOCS, width=width, cand_blocks=cb, k=k)
+        return tfidf_topk_blockmax(
+            jnp.asarray(q), *args, jnp.asarray(df), n, bound,
+            num_docs=NDOCS, width=width, cand_blocks=cb, k=k)
+
+    return exact, blockmax
+
+
+@pytest.mark.parametrize("scoring", ["tfidf", "bm25"])
+@pytest.mark.parametrize("k", [10, 100, 1000])
+def test_blockmax_bit_identical_to_exact_kernel(layout, scoring, k):
+    """THE kernel contract, across the scoring x k matrix and all three
+    query regimes (pruned branch, overflow fallback, mixed): identical
+    float bits, identical docids, identical tie order."""
+    (pt, pd, ptf, df), lay, args, doc_len = layout
+    exact, blockmax = _kernel_pair(args, df, doc_len, scoring, lay)
+    for kind in ("rare_hot", "hot_only", "mixed"):
+        q = _queries(lay, df, kind)
+        s_e, d_e = (np.asarray(a) for a in exact(q, k))
+        s_b, d_b, _ = (np.asarray(a) for a in blockmax(q, k))
+        assert (s_e == s_b).all(), (kind, scoring, k)
+        assert (d_e == d_b).all(), (kind, scoring, k)
+
+
+@pytest.mark.parametrize("scoring", ["tfidf", "bm25"])
+def test_pruned_branch_engages_and_masks(scoring, monkeypatch):
+    """The masked path must actually RUN (stats fallback flag 0) with a
+    real skip fraction — not pass vacuously through the fallback — and
+    still match the exact kernel bitwise. Fine blocks (width 128) so
+    a handful of very rare cold terms leaves most blocks provably
+    cold-free; the query's hot term is the HOTTEST (lowest idf -> a hot
+    bound the rare-term threshold dominates); k below the positive cold
+    count so tau > 0."""
+    monkeypatch.setenv("TPU_IR_BLOCKMAX_WIDTH", "128")
+    pair_term, pair_doc, pair_tf, df = _zipf_pairs()
+    lay = build_tiered_layout(pair_doc, pair_tf, df, num_docs=NDOCS,
+                              hot_budget=16 * (NDOCS + 1))
+    assert lay.blockmax_width == 128
+    doc_len = np.zeros(NDOCS + 1, np.int32)
+    np.add.at(doc_len, pair_doc, pair_tf)
+    args = (jnp.asarray(lay.hot_rank), lay.hot_device(),
+            jnp.asarray(lay.tier_of), jnp.asarray(lay.row_of),
+            tuple(jnp.asarray(a) for a in lay.tier_docs),
+            tuple(jnp.asarray(a) for a in lay.tier_tfs))
+    exact, blockmax = _kernel_pair(args, df, doc_len, scoring, lay)
+    hot = np.nonzero(lay.hot_rank >= 0)[0]
+    hottest = int(hot[np.argmax(df[hot])])
+    rare = np.nonzero((lay.hot_rank < 0) & (df >= 2) & (df <= 4))[0]
+    rng = np.random.default_rng(9)
+    engaged = masked_total = 0
+    for i in range(8):
+        qb = np.array([[hottest, int(rng.choice(rare)),
+                        int(rng.choice(rare)), -1]], np.int32)
+        s_e, d_e = (np.asarray(a) for a in exact(qb, 5))
+        s_b, d_b, stats = (np.asarray(a) for a in blockmax(qb, 5))
+        assert (s_e == s_b).all() and (d_e == d_b).all()
+        considered, masked, fallback = (int(x) for x in stats)
+        assert considered == lay.hot_blk_max.shape[1]
+        if not fallback:
+            engaged += 1
+            masked_total += masked
+    assert engaged > 0, "pruned branch never ran — the test corpus no " \
+                        "longer produces maskable blocks"
+    assert masked_total > 0
+
+
+def test_overflow_fallback_flagged(layout):
+    """Hot-only queries have tau = 0 (no cold partial): every block
+    survives, the budget overflows, the stats say fallback — and the
+    result is still exact (pinned above); here we pin the FLAG."""
+    (pt, pd, ptf, df), lay, args, doc_len = layout
+    _, blockmax = _kernel_pair(args, df, doc_len, "bm25", lay)
+    q = _queries(lay, df, "hot_only", rows=2)
+    _, _, stats = blockmax(q, 10)
+    assert int(np.asarray(stats)[2]) == 1
+
+
+@pytest.mark.parametrize("scoring", ["tfidf", "bm25"])
+def test_hot_preweighted_strip_bit_identical(layout, scoring):
+    """The device-cached pre-weighted strip (lntf_strip / bm25_strip)
+    must be a pure reordering of WHEN the weighting runs: same floats
+    from the tiered and block-max kernels either way."""
+    (pt, pd, ptf, df), lay, args, doc_len = layout
+    hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs = args
+    n = jnp.int32(NDOCS)
+    dl = jnp.asarray(doc_len)
+    if scoring == "bm25":
+        ws = bm25_strip(hot_tfs, dl, n)
+    else:
+        ws = lntf_strip(hot_tfs)
+    wargs = (hot_rank, ws, tier_of, row_of, tier_docs, tier_tfs)
+    bound = _bound_table(lay, doc_len, scoring)
+    width = lay.blockmax_width
+    cb = blockmax_cand_blocks(10, NDOCS, width)
+    for kind in ("rare_hot", "mixed", "hot_only"):
+        q = jnp.asarray(_queries(lay, df, kind))
+        if scoring == "bm25":
+            raw = bm25_topk_tiered(q, *args, jnp.asarray(df), dl, n,
+                                   num_docs=NDOCS, k=10)
+            pre = bm25_topk_tiered(q, *wargs, jnp.asarray(df), dl, n,
+                                   num_docs=NDOCS, k=10,
+                                   hot_preweighted=True)
+            braw = bm25_topk_blockmax(q, *args, jnp.asarray(df), dl, n,
+                                      bound, num_docs=NDOCS, width=width,
+                                      cand_blocks=cb, k=10)
+            bpre = bm25_topk_blockmax(q, *wargs, jnp.asarray(df), dl, n,
+                                      bound, num_docs=NDOCS, width=width,
+                                      cand_blocks=cb, k=10,
+                                      hot_preweighted=True)
+        else:
+            raw = tfidf_topk_tiered(q, *args, jnp.asarray(df), n,
+                                    num_docs=NDOCS, k=10)
+            pre = tfidf_topk_tiered(q, *wargs, jnp.asarray(df), n,
+                                    num_docs=NDOCS, k=10,
+                                    hot_preweighted=True)
+            braw = tfidf_topk_blockmax(q, *args, jnp.asarray(df), n,
+                                       bound, num_docs=NDOCS, width=width,
+                                       cand_blocks=cb, k=10)
+            bpre = tfidf_topk_blockmax(q, *wargs, jnp.asarray(df), n,
+                                       bound, num_docs=NDOCS, width=width,
+                                       cand_blocks=cb, k=10,
+                                       hot_preweighted=True)
+        for a, b in zip(raw, pre):
+            assert (np.asarray(a) == np.asarray(b)).all(), (scoring, kind)
+        for a, b in zip(braw[:2], bpre[:2]):
+            assert (np.asarray(a) == np.asarray(b)).all(), (scoring, kind)
+
+
+def test_restricted_bounds_stay_sound(layout):
+    """restrict_tiers composes with bounds: blocks wholly outside the
+    doc range drop to 0, every other bound still dominates the
+    restricted strip's actual block maxima (sound overestimates)."""
+    (pt, pd, ptf, df), lay, args, doc_len = layout
+    lo, hi = NDOCS // 3, 2 * NDOCS // 3
+    r = restrict_tiers(lay, lo, hi)
+    w = r.blockmax_width
+    actual = bmx.coo_block_max(r.hot_rows, r.hot_docs,
+                               np.where((np.asarray(r.hot_docs) >= lo)
+                                        & (np.asarray(r.hot_docs) <= hi),
+                                        r.hot_vals, 0),
+                               num_rows=r.num_hot, num_docs=NDOCS, width=w)
+    assert (np.asarray(r.hot_blk_max) >= actual).all()
+    nblk = r.hot_blk_max.shape[1]
+    starts = np.arange(nblk) * w
+    outside = (starts + w - 1 < lo) | (starts > hi)
+    assert (np.asarray(r.hot_blk_max)[:, outside] == 0).all()
+
+
+def test_cand_blocks_budget():
+    # covers 2k candidate docs, floors at 4, env override wins
+    assert blockmax_cand_blocks(10, 100_000, 512) >= 4
+    nblk = -(-100_001 // 512)
+    assert blockmax_cand_blocks(10, 100_000, 512) >= nblk // 4
+    assert blockmax_cand_blocks(5000, 100_000, 512) * 512 >= 10_000
+    os.environ["TPU_IR_BLOCKMAX_BLOCKS"] = "7"
+    try:
+        assert blockmax_cand_blocks(10, 100_000, 512) == 7
+    finally:
+        del os.environ["TPU_IR_BLOCKMAX_BLOCKS"]
+
+
+# -- end-to-end through the Scorer ------------------------------------------
+
+
+def _write_corpus(path, ndocs=4000, seed=5):
+    import bench
+
+    bench.make_corpus(path, seed=seed, n_docs=ndocs)
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    from tpu_ir.index import build_index
+
+    tmp = tmp_path_factory.mktemp("bmxidx")
+    corpus = os.path.join(tmp, "c.trec")
+    _write_corpus(corpus)
+    idx = os.path.join(tmp, "index")
+    build_index([corpus], idx, k=1, chargram_ks=[], num_shards=3,
+                compute_chargrams=False)
+    return idx
+
+
+def _scorer_queries(s, seed=0, rows=24, pools_from=None):
+    rng = np.random.default_rng(seed)
+    src = pools_from if pools_from is not None else s
+    df = np.asarray(src.df)
+    hr = np.asarray(src.hot_rank)
+    hot = np.nonzero(hr >= 0)[0]
+    rare = np.nonzero((hr < 0) & (df >= 2) & (df <= 10))[0]
+    mid = np.nonzero((hr < 0) & (df >= 20) & (df <= 400))[0]
+    rows_out = []
+    for i in range(rows):
+        pools = ([hot, rare, rare], [hot, mid, rare], [mid, mid, rare],
+                 [hot, hot, hot])[i % 4]
+        rows_out.append([int(rng.choice(p)) for p in pools] + [-1])
+    return np.array(rows_out, np.int32)
+
+
+def _on_off(s, fn):
+    on = fn()
+    os.environ["TPU_IR_BLOCKMAX"] = "0"
+    os.environ["TPU_IR_BLOCKMAX_STRIP_CACHE"] = "0"
+    try:
+        off = fn()
+    finally:
+        del os.environ["TPU_IR_BLOCKMAX"]
+        del os.environ["TPU_IR_BLOCKMAX_STRIP_CACHE"]
+    return on, off
+
+
+@pytest.mark.parametrize("scoring", ["tfidf", "bm25"])
+@pytest.mark.parametrize("k", [10, 100, 1000])
+def test_scorer_parity_tiered(index_dir, scoring, k, monkeypatch):
+    """Scorer-level block-max on == off, bit-identical, through the
+    scheduled-group dispatch (mixed hot/hot-free batches), at every k —
+    the engagement knob can never change a result."""
+    from tpu_ir.search import Scorer
+
+    s = Scorer.load(index_dir, layout="sparse")
+    q = _scorer_queries(s)
+    (s_on, d_on), (s_off, d_off) = _on_off(
+        s, lambda: s.topk(q, k=k, scoring=scoring))
+    assert (np.asarray(s_on) == np.asarray(s_off)).all()
+    assert (np.asarray(d_on) == np.asarray(d_off)).all()
+
+
+def test_scorer_parity_dense_layout(index_dir):
+    """Across layouts: on the dense layout block-max is a documented
+    no-op — the knob must not change a single bit there either."""
+    from tpu_ir.search import Scorer
+
+    s = Scorer.load(index_dir, layout="dense")
+    assert s._blockmax_plan(10, "bm25") is None
+    pools = Scorer.load(index_dir, layout="sparse")
+    q = _scorer_queries(s, pools_from=pools)
+    (s_on, d_on), (s_off, d_off) = _on_off(
+        s, lambda: s.topk(q, k=10, scoring="bm25"))
+    assert (np.asarray(s_on) == np.asarray(s_off)).all()
+    assert (np.asarray(d_on) == np.asarray(d_off)).all()
+
+
+def test_scorer_parity_sharded_layout(index_dir):
+    """Sharded layout (single-device mesh here): same no-op contract."""
+    from tpu_ir.search import Scorer
+
+    s = Scorer.load(index_dir, layout="sharded")
+    assert s._blockmax_plan(10, "bm25") is None
+    pools = Scorer.load(index_dir, layout="sparse")
+    q = _scorer_queries(s, pools_from=pools)
+    (s_on, d_on), (s_off, d_off) = _on_off(
+        s, lambda: s.topk(q, k=10, scoring="bm25"))
+    assert (np.asarray(s_on) == np.asarray(s_off)).all()
+    assert (np.asarray(d_on) == np.asarray(d_off)).all()
+
+
+def test_scorer_parity_hot_only_and_doc_range(index_dir):
+    """hot_only (ladder degradation) and doc_range (scatter-gather
+    worker restriction) both compose: on == off bitwise."""
+    from tpu_ir.search import Scorer
+
+    s = Scorer.load(index_dir, layout="sparse")
+    q = _scorer_queries(s)
+    (a_on, b_on), (a_off, b_off) = _on_off(
+        s, lambda: s.topk(q, k=10, scoring="bm25", hot_only=True))
+    assert (np.asarray(a_on) == np.asarray(a_off)).all()
+    assert (np.asarray(b_on) == np.asarray(b_off)).all()
+
+    d = s.meta.num_docs
+    w = Scorer.load(index_dir, layout="sparse",
+                    doc_range=(d // 4, 3 * d // 4))
+    (a_on, b_on), (a_off, b_off) = _on_off(
+        w, lambda: w.topk(q, k=100, scoring="bm25"))
+    assert (np.asarray(a_on) == np.asarray(a_off)).all()
+    assert (np.asarray(b_on) == np.asarray(b_off)).all()
+
+
+def test_scorer_parity_coalesced_rungs(index_dir):
+    """The coalesced serving shape (rung-padded uniform dispatch): the
+    block-max program rides the same rung ladder; on == off bitwise,
+    and coalesced == plain for the same queries."""
+    from tpu_ir.search import Scorer
+
+    s = Scorer.load(index_dir, layout="sparse")
+    q = _scorer_queries(s, rows=6)
+    rungs = (1, 4, 16)
+
+    def run():
+        sc, dc, deg = s.topk_tagged(q, k=10, scoring="bm25",
+                                    uniform=rungs)
+        assert not deg
+        return sc, dc
+
+    (s_on, d_on), (s_off, d_off) = _on_off(s, run)
+    assert (np.asarray(s_on) == np.asarray(s_off)).all()
+    assert (np.asarray(d_on) == np.asarray(d_off)).all()
+    # (coalesced vs non-uniform topk() is NOT asserted bitwise: the two
+    # pad to different batch shapes, whose gemm rounding may differ —
+    # the ladder pins coalesced == solo through equal rung shapes,
+    # test_batching's contract; here the knob-parity is the claim)
+
+
+def test_scorer_engagement_counters(index_dir):
+    """The registry ledger: block-max dispatches land raw counters
+    (considered/masked + saved-or-fallback), and the scheduled-skip
+    plan lands the prune.* raw terms."""
+    from tpu_ir.obs import get_registry
+    from tpu_ir.search import Scorer
+
+    s = Scorer.load(index_dir, layout="sparse")
+    q = _scorer_queries(s)
+    get_registry().snapshot(reset=True)
+    s.topk(q, k=10, scoring="bm25")
+    c = get_registry().snapshot()["counters"]
+    assert c["prune.queries"] == len(q)
+    assert c["prune.blocks_total"] >= 1
+    assert c["blockmax.blocks_considered"] > 0
+    assert (c["blockmax.saved_dispatches"]
+            + c["blockmax.fallback_dispatches"]) >= 1
+
+
+def test_explain_pins_blockmax_scores(index_dir):
+    """The PR 8 explain harness closes the loop: the telescoped partial
+    sums must equal the block-max-served score bit-exactly (the explain
+    gather traces the same cold-first accumulation the block-max kernel
+    realizes)."""
+    from tpu_ir.search import Scorer
+
+    s = Scorer.load(index_dir, layout="sparse")
+    vocab_terms = s.vocab.terms
+    hr = np.asarray(s.hot_rank)
+    df = np.asarray(s.df)
+    hot = np.nonzero(hr >= 0)[0]
+    rare = np.nonzero((hr < 0) & (df >= 2) & (df <= 10))[0]
+    text = f"{vocab_terms[hot[0]]} {vocab_terms[rare[0]]} " \
+           f"{vocab_terms[rare[1]]}"
+    res = s.search_batch([text], k=5, scoring="bm25", explain_k=1,
+                         return_docids=True)[0]
+    if not res:
+        pytest.skip("query matched nothing")
+    e = res.explain[0]
+    assert e["contribution_sum"] == e["score"] == res[0][1]
+
+
+# -- artifact half ----------------------------------------------------------
+
+
+def test_bounds_artifact_written_and_consistent(index_dir):
+    """Every builder finalize writes blockmax.arena (the
+    save_with_checksums hook); its stored maxima equal what the layout
+    recomputes from the postings, and the checksum covers it."""
+    from tpu_ir.search import Scorer
+
+    path = os.path.join(index_dir, bmx.BLOCKMAX_ARENA)
+    assert os.path.exists(path)
+    meta = fmt.IndexMetadata.load(index_dir)
+    assert bmx.BLOCKMAX_ARENA in meta.checksums
+    tids, max_tf, width = bmx.load_block_bounds(index_dir, meta)
+    s = Scorer.load(index_dir, layout="sparse")
+    hr = np.asarray(s.hot_rank)
+    assert np.array_equal(np.sort(np.nonzero(hr >= 0)[0]), tids)
+    # stored rows, reordered to strip rank order == the served table
+    rank = hr[tids]
+    served = np.asarray(s._hot_blk_max)
+    assert np.array_equal(served[rank], max_tf)
+    assert width == s._blockmax_width
+
+
+def test_migrate_add_bounds_roundtrip(index_dir, tmp_path):
+    """Backfill: strip the bounds from a copy (a pre-13 index), verify
+    still passes, `migrate-index --add-bounds` restores byte-identical
+    bounds, is idempotent, and the index serves identically."""
+    import shutil
+
+    from tpu_ir.index.migrate import migrate_index
+    from tpu_ir.index.verify import verify_index
+    from tpu_ir.search import Scorer
+
+    idx = str(tmp_path / "copy")
+    shutil.copytree(index_dir, idx)
+    want = open(os.path.join(index_dir, bmx.BLOCKMAX_ARENA), "rb").read()
+    os.remove(os.path.join(idx, bmx.BLOCKMAX_ARENA))
+    shutil.rmtree(os.path.join(idx, "serving-tiered"), ignore_errors=True)
+    meta = fmt.IndexMetadata.load(idx)
+    meta.save_with_checksums(idx, block_bounds=False)
+    verify_index(idx)  # a pre-bounds index stays verify-clean
+
+    out = migrate_index(idx, add_bounds=True)
+    assert out["ok"] and out["add_bounds"]
+    got = open(os.path.join(idx, bmx.BLOCKMAX_ARENA), "rb").read()
+    assert got == want  # deterministic backfill == builder output
+    verify_index(idx)
+    out2 = migrate_index(idx, add_bounds=True)  # idempotent
+    assert out2["ok"]
+    assert open(os.path.join(idx, bmx.BLOCKMAX_ARENA), "rb").read() == want
+
+    s0 = Scorer.load(index_dir, layout="sparse")
+    s1 = Scorer.load(idx, layout="sparse")
+    q = _scorer_queries(s0)
+    a = s0.topk(q, k=10, scoring="bm25")
+    b = s1.topk(q, k=10, scoring="bm25")
+    assert (np.asarray(a[0]) == np.asarray(b[0])).all()
+    assert (np.asarray(a[1]) == np.asarray(b[1])).all()
+
+
+def test_corrupt_bounds_quarantined_and_served(index_dir, tmp_path):
+    """PR 1 discipline for the bounds artifact: flipped bytes are
+    quarantined on load (bounds are derived data — the scorer recomputes
+    and serves bit-identically), while `tpu-ir verify` still fails the
+    dir loudly."""
+    import shutil
+
+    from tpu_ir import faults
+    from tpu_ir.index.verify import verify_index
+    from tpu_ir.search import Scorer
+
+    idx = str(tmp_path / "corrupt")
+    shutil.copytree(index_dir, idx)
+    shutil.rmtree(os.path.join(idx, "serving-tiered"), ignore_errors=True)
+    path = os.path.join(idx, bmx.BLOCKMAX_ARENA)
+    raw = bytearray(open(path, "rb").read())
+    raw[len(raw) // 2] ^= 0xFF
+    open(path, "wb").write(bytes(raw))
+
+    with pytest.raises(faults.IntegrityError):
+        verify_index(idx)
+
+    s = Scorer.load(idx, layout="sparse")  # quarantines, then recomputes
+    assert not os.path.exists(path)
+    qdir = os.path.join(idx, ".quarantine")
+    assert any(bmx.BLOCKMAX_ARENA in n for n in os.listdir(qdir))
+    assert s._hot_blk_max is not None
+    s0 = Scorer.load(index_dir, layout="sparse")
+    q = _scorer_queries(s0)
+    a = s0.topk(q, k=10, scoring="bm25")
+    b = s.topk(q, k=10, scoring="bm25")
+    assert (np.asarray(a[0]) == np.asarray(b[0])).all()
+    assert (np.asarray(a[1]) == np.asarray(b[1])).all()
+
+
+def test_serving_cache_v6_carries_bounds(index_dir):
+    """The warm path: a serving-cache hit yields the same bounds (and
+    the same results) with zero postings IO."""
+    from tpu_ir.search import Scorer
+
+    s_cold = Scorer.load(index_dir, layout="sparse")
+    s_warm = Scorer.load(index_dir, layout="sparse")
+    assert s_warm._pairs_cols is None  # cache fast path engaged
+    assert s_warm._hot_blk_max is not None
+    assert np.array_equal(np.asarray(s_warm._hot_blk_max),
+                          np.asarray(s_cold._hot_blk_max))
+    q = _scorer_queries(s_cold)
+    a = s_cold.topk(q, k=100, scoring="bm25")
+    b = s_warm.topk(q, k=100, scoring="bm25")
+    assert (np.asarray(a[0]) == np.asarray(b[0])).all()
+    assert (np.asarray(a[1]) == np.asarray(b[1])).all()
+
+
+def test_doctor_reports_bounds(index_dir):
+    from tpu_ir.index.doctor import doctor_report
+
+    rep = doctor_report(index_dir)
+    bb = rep["block_bounds"]
+    assert bb["present"] and bb["ok"] and not bb["stale"]
+    assert bb["bounds_exact"]
+    assert 0.0 < bb["block_occupancy"] <= 1.0
+
+
+def test_cli_migrate_add_bounds_smoke(index_dir, tmp_path):
+    import shutil
+
+    idx = str(tmp_path / "cli")
+    shutil.copytree(index_dir, idx)
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    r = subprocess.run(
+        [sys.executable, "-m", "tpu_ir.cli", "migrate-index", idx,
+         "--add-bounds"],
+        capture_output=True, text=True, env=env)
+    assert r.returncode == 0, r.stderr
+    out = json.loads(r.stdout)
+    assert out["ok"] and out["add_bounds"] and out["terms"] >= 0

@@ -167,8 +167,12 @@ def test_disabled_plan_is_inert():
 
 def test_spill_write_failures_retried_to_identical_artifacts(
         tmp_path, monkeypatch, ref):
+    # pins the LEGACY spill retry accounting (pairs- batch spills +
+    # token spills); the radix default (ISSUE 13) has its own fault-site
+    # coverage below — request the legacy path explicitly
     corpus, ref_dir = ref
     out = str(tmp_path / "idx")
+    monkeypatch.setenv("TPU_IR_RADIX_BUCKETS", "0")
     small_chunks(monkeypatch)
     # fail the first 2 pair-spill writes AND the first token-spill write:
     # the supervised retry must absorb all of them
@@ -182,8 +186,11 @@ def test_spill_write_failures_retried_to_identical_artifacts(
 
 def test_spill_write_exhaustion_is_structured_build_error(
         tmp_path, monkeypatch, ref):
+    # legacy path: token spills only exist there (radix packs lengths
+    # into the pass-1 manifest instead)
     corpus, _ = ref
     out = str(tmp_path / "idx")
+    monkeypatch.setenv("TPU_IR_RADIX_BUCKETS", "0")
     small_chunks(monkeypatch)
     faults.install(faults.parse_plan("spill_write@tokens-:first@99"))
     with pytest.raises(faults.BuildError) as ei:
@@ -215,6 +222,7 @@ def test_truncated_token_spill_is_structured_then_recovers(
     and re-tokenizes to a byte-identical index."""
     corpus, ref_dir = ref
     out = str(tmp_path / "idx")
+    monkeypatch.setenv("TPU_IR_RADIX_BUCKETS", "0")  # token spills: legacy
     small_chunks(monkeypatch)
     faults.install(faults.parse_plan("artifact_truncate@tokens-:once@2"))
     with pytest.raises(faults.IntegrityError) as ei:
@@ -300,6 +308,7 @@ def test_corrupt_token_spill_discards_resume(tmp_path, monkeypatch, ref):
     still converges to byte-identical artifacts."""
     corpus, ref_dir = ref
     out = str(tmp_path / "idx")
+    monkeypatch.setenv("TPU_IR_RADIX_BUCKETS", "0")  # token spills: legacy
     small_chunks(monkeypatch)
     faults.install(faults.parse_plan("crash.pass2:once@2"))
     with pytest.raises(faults.InjectedCrash):
@@ -712,13 +721,15 @@ def test_cli_surfaces_integrity_error_cleanly(tmp_path, ref, capsys):
     assert fmt.part_name(0) in err
 
 
-def test_cli_faults_flag_surfaces_build_error(tmp_path, ref, capsys):
+def test_cli_faults_flag_surfaces_build_error(tmp_path, ref, capsys,
+                                              monkeypatch):
     """--faults installs the plan and retry exhaustion reaches the user as
     ONE clean structured error line, not a traceback."""
     from tpu_ir.cli import main
 
     corpus, _ = ref
     out = str(tmp_path / "idx")
+    monkeypatch.setenv("TPU_IR_RADIX_BUCKETS", "0")  # token spills: legacy
     rc = main(["index", corpus, out, "--streaming", "--shards", "2",
                "--no-chargrams", "--faults",
                "spill_write@tokens-:first@99"])

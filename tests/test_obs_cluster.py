@@ -561,8 +561,12 @@ def test_streaming_build_registers_a_tracked_job(tmp_path):
         f"</TEXT>\n</DOC>\n" for i in range(30))
     corpus = tmp_path / "c.trec"
     corpus.write_text(body)
+    # the LEGACY per-batch phase shape is what this test pins
+    # (one spill per batch, pass2 done == batches); the radix default
+    # (ISSUE 13) tracks per-bucket progress, covered in test_radix.py
     build_index_streaming([str(corpus)], str(tmp_path / "idx"), k=1,
-                          num_shards=2, batch_docs=10, chargram_ks=[])
+                          num_shards=2, batch_docs=10, chargram_ks=[],
+                          radix_buckets=0)
     job = [j for j in obs.progress.jobs() if j.kind == "build"][-1]
     d = job.to_dict()
     assert d["state"] == "succeeded" and d["percent"] == 100.0

@@ -79,8 +79,13 @@ def small_chunks(monkeypatch):
 
 
 def test_resume_after_pass2_crash(tmp_path, monkeypatch, ref):
+    # pins the LEGACY per-batch pass-2 resume specifically: since the
+    # radix path became the library default (ISSUE 13 flipped
+    # TPU_IR_RADIX_BUCKETS to 16), the legacy path must be requested
+    # explicitly (its radix twin lives in test_radix.py)
     corpus, ref_dir = ref
     out = str(tmp_path / "idx")
+    monkeypatch.setenv("TPU_IR_RADIX_BUCKETS", "0")
 
     small_chunks(monkeypatch)
     real_postings = streaming.build_postings_packed_jit

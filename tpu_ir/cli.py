@@ -611,7 +611,8 @@ def cmd_migrate_index(args) -> int:
     finishes an interrupted migration."""
     from .index.migrate import migrate_index
 
-    print(json.dumps(migrate_index(args.index_dir, to_version=args.to)))
+    print(json.dumps(migrate_index(args.index_dir, to_version=args.to,
+                                   add_bounds=args.add_bounds)))
     return 0
 
 
@@ -704,6 +705,11 @@ def cmd_stats(args) -> int:
         "serving": section("serving."),
         "fault_injection": {k: v for k, v in section("fault.").items()
                             if v},
+        # dynamic pruning (ISSUE 13): the scheduled-skip raw terms and
+        # the block-max mask ledger, full names (two namespaces share
+        # the section, so no prefix is stripped)
+        "pruning": {k: v for k, v in snap["counters"].items()
+                    if k.startswith(("prune.", "blockmax."))},
         "histograms": snap["histograms"],
         **extra,
     }, sort_keys=True))
@@ -1298,6 +1304,11 @@ def main(argv: list[str] | None = None) -> int:
     pmi.add_argument("--to", type=int, choices=[1, 2], default=2,
                      help="target format_version (2 = zero-copy arenas, "
                           "1 = npz rollback)")
+    pmi.add_argument("--add-bounds", action="store_true",
+                     help="backfill the block-max bounds artifact "
+                          "(blockmax.arena) from the postings in place — "
+                          "no part rewrite, idempotent, verify-clean "
+                          "(RUNBOOK §20)")
     pmi.set_defaults(fn=cmd_migrate_index)
 
     pin = sub.add_parser(

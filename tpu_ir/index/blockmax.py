@@ -1,0 +1,330 @@
+"""Block-max score bounds: per-term, per-doc-block posting maxima.
+
+The impact-ordered pruning literature (Block-Max WAND, Ding & Suel 2011;
+MaxScore, Turtle & Flood 1995) skips postings a ranked query provably
+cannot surface by keeping, per posting block, an upper bound on the
+block's score contribution. This module is the ARTIFACT half of the
+TPU-native recast (ops/scoring.py holds the kernel half): the doc axis
+is cut into fixed-width blocks and, for every hot-strip-candidate term
+(the high-df terms search/layout.plan_tiers promotes — the only terms
+whose per-block bounds the serving kernels consume), the maximum raw tf
+inside each block is recorded in ONE arena v2 side artifact,
+`blockmax.arena`.
+
+Why max raw tf and not per-mode score floats: both scoring models weight
+a posting by a function MONOTONE-INCREASING in tf ((1 + ln tf) for
+TF-IDF, the k1/b saturation curve for BM25), so the block's max tf is a
+sufficient statistic — each mode's bound derives at load time as
+weight_fn(max_tf) (BM25 additionally folds the block's minimum
+doc-length norm, derived from the doclen artifact, never stored). Stored
+score floats would go stale whenever avg_dl shifts under live ingest or
+the BM25 constants change; the tf statistic cannot.
+
+The artifact is written by EVERY finalize path — the in-memory builder,
+streaming (radix included), the multihost SPMD build, index merge, and
+the live-index segment compaction — through one hook in
+IndexMetadata.save_with_checksums, so all builders emit byte-identical
+bounds for identical postings (the cross-builder fuzz pins extend over
+it for free) and live generations carry bounds without special cases.
+`tpu-ir migrate-index --add-bounds` backfills an existing index in place
+by running the same hook.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+import numpy as np
+
+from .. import faults
+from . import format as fmt
+
+logger = logging.getLogger(__name__)
+
+#: the bounds side artifact (one arena v2 file, integrity-checksummed)
+BLOCKMAX_ARENA = "blockmax.arena"
+
+#: blockmax.arena schema version (the `info` section's first slot)
+BLOCKMAX_VERSION = 1
+
+
+def block_width() -> int:
+    """Doc-axis block width (TPU_IR_BLOCKMAX_WIDTH). Fixed per artifact:
+    the width used at write time rides in the arena's info section and
+    wins over the env at read time, so serving and doctor always
+    interpret stored bounds at the width they were computed at."""
+    from ..utils import envvars
+
+    return envvars.get_int("TPU_IR_BLOCKMAX_WIDTH")
+
+
+def num_blocks(num_docs: int, width: int) -> int:
+    """Blocks covering the [0, num_docs] doc axis (slot 0 included —
+    the dead column rides in block 0 and is masked by the kernels)."""
+    return -(-(num_docs + 1) // width)
+
+
+def hot_candidate_tids(df: np.ndarray, num_docs: int) -> np.ndarray:
+    """The terms whose bounds the serving kernels can consume: exactly
+    the hot-strip assignment search/layout.plan_tiers makes — the SAME
+    function serving calls, so the stored term set and the served hot
+    strip agree by construction (a df drift between them is what
+    `tpu-ir doctor` reports as stale bounds)."""
+    from ..search.layout import plan_tiers
+
+    hot_tids, _, _, _ = plan_tiers(np.asarray(df), num_docs=num_docs)
+    return np.asarray(hot_tids, np.int64)
+
+
+def term_block_max(pair_doc: np.ndarray, pair_tf: np.ndarray,
+                   *, num_docs: int, width: int) -> np.ndarray:
+    """[nblk] max tf per doc block for ONE term's postings run."""
+    out = np.zeros(num_blocks(num_docs, width), np.int32)
+    blk = np.asarray(pair_doc, np.int64) // width
+    np.maximum.at(out, blk, np.asarray(pair_tf, np.int64))
+    return out
+
+
+def compute_block_max(tids, pair_doc, pair_tf, indptr, *, num_docs: int,
+                      width: int) -> np.ndarray:
+    """int32 [len(tids), nblk] per-block max tf for the given terms, from
+    global-CSR-ordered pair columns (`indptr` = df row starts). One
+    vectorized maximum-scatter over the covered postings — the covered
+    set is the hot strip, whose postings the layout builder gathers with
+    the same indptr arithmetic."""
+    nblk = num_blocks(num_docs, width)
+    out = np.zeros((len(tids), nblk), np.int32)
+    if not len(tids):
+        return out
+    tids = np.asarray(tids, np.int64)
+    counts = (np.asarray(indptr)[tids + 1]
+              - np.asarray(indptr)[tids]).astype(np.int64)
+    rows = np.repeat(np.arange(len(tids), dtype=np.int64), counts)
+    ends = np.cumsum(counts)
+    within = np.arange(int(counts.sum()), dtype=np.int64) - np.repeat(
+        ends - counts, counts)
+    src = np.repeat(np.asarray(indptr)[tids], counts) + within
+    blk = np.asarray(pair_doc)[src].astype(np.int64) // width
+    np.maximum.at(out, (rows, blk), np.asarray(pair_tf)[src])
+    return out
+
+
+def coo_block_max(rows, docs, vals, *, num_rows: int, num_docs: int,
+                  width: int) -> np.ndarray:
+    """int32 [num_rows, nblk] per-block max from COO hot-strip postings
+    (the serving-layout form — layout.TieredPostings hot_rows/docs/vals).
+    Identical values to compute_block_max over the same postings."""
+    nblk = num_blocks(num_docs, width)
+    out = np.zeros((num_rows, nblk), np.int32)
+    if len(np.asarray(docs)):
+        blk = np.asarray(docs, np.int64) // width
+        np.maximum.at(out, (np.asarray(rows, np.int64), blk),
+                      np.asarray(vals, np.int64))
+    return out
+
+
+def _iter_shards(index_dir: str, meta, verify: bool):
+    """Yield each part shard's dict — verified streamed reads when
+    `verify` (the migrate backfill: never launder rot into fresh
+    bounds), zero-copy mmap views otherwise (the finalize hook: the
+    builder just wrote these bytes)."""
+    for s in range(meta.num_shards):
+        if verify:
+            yield fmt.load_shard_verified(index_dir, s, meta)
+        else:
+            yield fmt.load_shard(index_dir, s, mmap=True)
+
+
+def write_block_bounds(index_dir: str, meta, *, verify: bool = False,
+                       df=None, pair_doc=None, pair_tf=None) -> dict:
+    """Compute and atomically write `blockmax.arena` for the index at
+    `index_dir`, ONE SHARD AT A TIME: each part's term runs are scanned
+    in place (mmap'd arena views — no global CSR columns are ever
+    materialized, so a finalize on a 250M-pair index costs one shard's
+    working set, not gigabytes of assembled pair arrays) and only the
+    hot-candidate terms' block maxima are kept. Builders that still
+    hold the global pair columns may pass them to skip the read-back.
+    Deterministic: identical postings -> identical bytes, so the
+    cross-builder byte-identity fuzz pins hold over the new artifact.
+
+    Sections: `tids` int64 [T] covered term ids (ascending), `max_tf`
+    int32 [T, nblk], `info` int64 [version, width, nblk, num_docs]."""
+    width = block_width()
+    nblk = num_blocks(meta.num_docs, width)
+    if pair_doc is not None and df is not None:
+        df = np.asarray(df)
+        tids = hot_candidate_tids(df, meta.num_docs)
+        indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
+        max_tf = compute_block_max(tids, pair_doc, pair_tf, indptr,
+                                   num_docs=meta.num_docs, width=width)
+    else:
+        tids, max_tf, _ = _sharded_bounds(index_dir, meta, width,
+                                          verify=verify)
+    info = np.array([BLOCKMAX_VERSION, width, nblk, meta.num_docs],
+                    np.int64)
+    fmt.write_arena_atomic(
+        os.path.join(index_dir, BLOCKMAX_ARENA),
+        tids=np.asarray(tids, np.int64), max_tf=max_tf.astype(np.int32),
+        info=info)
+    return {"terms": int(len(tids)), "width": width, "blocks": int(nblk)}
+
+
+def _sharded_bounds(index_dir: str, meta, width: int, *,
+                    verify: bool = False, want_tids=None):
+    """(tids, max_tf [T, nblk], df) computed shard by shard. Pass 1
+    collects global dfs (one small [V] array) to pick the hot set —
+    unless `want_tids` pins it (the doctor's stored-vs-actual compare);
+    pass 2 block-maxes ONLY the covered terms' runs per shard (local
+    indptr addresses the shard's own columns, pair_doc carries global
+    docnos — no global CSR is ever materialized)."""
+    df = np.zeros(meta.vocab_size, np.int64)
+    for z in _iter_shards(index_dir, meta, verify):
+        # pass 1 keeps only the tiny term_ids/df arrays; the shard's
+        # pair columns are dropped before the next one loads, so the
+        # working set stays ONE shard even on a verify-read backfill
+        df[np.asarray(z["term_ids"])] = np.asarray(z["df"])
+        del z
+    tids = (np.asarray(want_tids, np.int64) if want_tids is not None
+            else hot_candidate_tids(df, meta.num_docs))
+    max_tf = np.zeros((len(tids), num_blocks(meta.num_docs, width)),
+                      np.int32)
+    for z in (_iter_shards(index_dir, meta, verify) if len(tids)
+              else ()):
+        stids = np.asarray(z["term_ids"], np.int64)
+        pos = np.searchsorted(tids, stids)
+        pos_c = np.minimum(pos, len(tids) - 1)
+        covered = np.nonzero(tids[pos_c] == stids)[0]
+        if not len(covered):
+            continue
+        local = compute_block_max(
+            covered, np.asarray(z["pair_doc"]),
+            np.asarray(z["pair_tf"]), np.asarray(z["indptr"]),
+            num_docs=meta.num_docs, width=width)
+        # a term's postings may span parts in bucket-segmented
+        # layouts; fold with max, not assignment
+        np.maximum.at(max_tf, pos_c[covered], local.astype(np.int32))
+    return tids, max_tf, df
+
+
+def ensure_block_bounds(index_dir: str, meta, **pairs) -> None:
+    """The save_with_checksums hook: (re)write the bounds artifact before
+    the checksum pass records it. Indexes with no postings still get an
+    (empty) artifact so doctor can tell "no bounds needed" from "bounds
+    missing". Failures never block an index finalize — an index without
+    bounds serves correctly (the scorer recomputes bounds from the
+    postings at layout build), so this degrades loudly instead of
+    turning every build error surface into a bounds error surface."""
+    try:
+        write_block_bounds(index_dir, meta, **pairs)
+    except Exception as e:  # noqa: BLE001 — bounds are OPTIONAL derived
+        # data (the scorer recomputes from postings at load): an ENOSPC,
+        # MemoryError or rot here must degrade to a bounds-less index,
+        # never fail an otherwise-complete multi-hour build finalize
+        logger.warning("block-max bounds not written for %s (%s); "
+                       "serving falls back to computing bounds at load — "
+                       "backfill with `tpu-ir migrate-index --add-bounds`",
+                       index_dir, e)
+
+
+def load_block_bounds(index_dir: str, meta=None, *,
+                      quarantine_corrupt: bool = False):
+    """(tids [T], max_tf [T, nblk], width) from blockmax.arena, or None
+    when the artifact is absent. With `quarantine_corrupt` (the serving
+    load path) a corrupt artifact is quarantined (PR 1 discipline) and
+    None returned — bounds are derived data, so serving recomputes them
+    rather than failing the load; `tpu-ir verify` still fails the dir
+    via the recorded metadata checksum."""
+    path = os.path.join(index_dir, BLOCKMAX_ARENA)
+    if not os.path.exists(path):
+        return None
+    try:
+        want = (meta.checksums or {}).get(BLOCKMAX_ARENA) if meta else None
+        if want is not None:
+            got = f"crc32:{fmt._read_file_verified(path)[1]:08x}"
+            if got != want:
+                raise faults.IntegrityError(
+                    path, f"checksum mismatch (recorded {want}, found "
+                    f"{got}); the bounds artifact is corrupt")
+        sections = fmt.load_arena(path)  # eager read checks section CRCs
+        info = sections["info"]
+        if int(info[0]) > BLOCKMAX_VERSION:
+            raise faults.IntegrityError(
+                path, f"bounds schema v{int(info[0])} is newer than this "
+                f"reader (v{BLOCKMAX_VERSION})")
+        return (np.asarray(sections["tids"]),
+                np.asarray(sections["max_tf"]), int(info[1]))
+    except (faults.IntegrityError, *fmt.CORRUPT_NPZ, IndexError) as e:
+        if not quarantine_corrupt:
+            raise
+        logger.warning("quarantining corrupt bounds artifact %s (%s); "
+                       "serving recomputes bounds from the postings",
+                       path, e)
+        from ..utils.report import recovery_counters
+
+        fmt.quarantine(index_dir, BLOCKMAX_ARENA)
+        recovery_counters().incr("integrity_failures")
+        return None
+
+
+def bounds_report(index_dir: str, meta) -> dict:
+    """The `tpu-ir doctor` block-bound section: presence, staleness (the
+    stored term set vs the hot set the CURRENT dfs would promote),
+    bound tightness (stored bound vs the actual per-block max — equal
+    unless the postings changed under the artifact), and the expected
+    block skip fraction at representative score thresholds."""
+    stored = None
+    try:
+        stored = load_block_bounds(index_dir, meta)
+    except (faults.IntegrityError, *fmt.CORRUPT_NPZ) as e:
+        return {"present": True, "ok": False, "error": str(e)}
+    if stored is None:
+        return {"present": False,
+                "hint": "backfill with `tpu-ir migrate-index "
+                        "--add-bounds`"}
+    tids, max_tf, width = stored
+    _, actual, df = _sharded_bounds(index_dir, meta, int(width),
+                                    want_tids=tids)
+    want_tids = hot_candidate_tids(df, meta.num_docs)
+    stale = not np.array_equal(np.asarray(tids), want_tids)
+    out = {
+        "present": True, "ok": not stale, "stale": stale,
+        "terms": int(len(tids)), "width": int(width),
+        "blocks": int(max_tf.shape[1]) if max_tf.ndim == 2 else 0,
+    }
+    if stale:
+        out["hint"] = ("stored bounds cover a different hot-term set "
+                       "than the current dfs promote — re-run "
+                       "`tpu-ir migrate-index --add-bounds`")
+        return out
+    if len(tids):
+        occupied = actual > 0
+        exact = bool(np.array_equal(max_tf, actual))
+        out["bounds_exact"] = exact
+        if not exact:
+            out["ok"] = False
+            with np.errstate(divide="ignore", invalid="ignore"):
+                ratio = np.where(occupied, max_tf / np.maximum(actual, 1),
+                                 np.nan)
+            out["tightness"] = {
+                "p50": float(np.nanpercentile(ratio, 50)),
+                "p99": float(np.nanpercentile(ratio, 99)),
+            }
+            out["hint"] = ("stored bounds diverge from the postings — "
+                           "the artifact is stale; re-run "
+                           "`tpu-ir migrate-index --add-bounds`")
+        # expected skip fraction: a block lane is maskable for a term at
+        # threshold t when its bound weight (1 + ln max_tf) falls below
+        # t. Quantiles of the occupied-bound weight distribution give
+        # the fraction of occupied block lanes a kernel threshold at
+        # that weight percentile would mask — the engagement signal an
+        # operator reads before trusting deep-k throughput to pruning.
+        w = np.where(occupied, 1.0 + np.log(np.maximum(max_tf, 1)), 0.0)
+        occ_w = w[occupied]
+        out["block_occupancy"] = round(float(occupied.mean()), 4)
+        if len(occ_w):
+            out["expected_skip_fraction"] = {
+                f"p{q}": round(float((occ_w < np.percentile(occ_w, q))
+                                     .mean()), 4)
+                for q in (50, 90, 99)}
+    return out

@@ -173,6 +173,12 @@ def live_doctor_report(live_dir: str) -> dict:
             "num_pairs": meta.num_pairs,
             "bytes": _dir_bytes(p),
             "tombstones": len(tombs.get(name, [])),
+            # block-max bounds presence per segment (ISSUE 13): a
+            # generation serves block-max only from segments that carry
+            # bounds; compaction and `migrate-index --add-bounds` both
+            # restore them
+            "block_bounds": os.path.exists(
+                os.path.join(p, "blockmax.arena")),
         })
     base = max(segments, key=lambda s: s["docs"], default=None)
     for s in segments:
@@ -195,6 +201,14 @@ def live_doctor_report(live_dir: str) -> dict:
         "merge_debt": debt,
     }
     warnings = []
+    missing_bounds = [s["segment"] for s in segments
+                      if not s["block_bounds"]]
+    if missing_bounds:
+        warnings.append(
+            f"generation {gen} has segment(s) without block-max bounds "
+            f"({', '.join(missing_bounds)}): deep-k serving falls back "
+            "to recomputing bounds at load — backfill with `tpu-ir "
+            "migrate-index <segment> --add-bounds` or compact")
     if debt["pending_merge_groups"]:
         warnings.append(
             f"merge debt: {len(debt['pending_merge_groups'])} tier(s) "
@@ -280,9 +294,23 @@ def doctor_report(index_dir: str, top_terms: int = 10) -> dict:
         "tiers": _tier_report(df, meta.num_docs),
         "arena_sections": sections or None,
         "serving_caches": _serving_caches(index_dir),
+        # block-max bound health (ISSUE 13): presence, staleness vs the
+        # hot set the current dfs promote, bound-vs-actual tightness,
+        # and the expected block skip fraction at representative
+        # thresholds (index/blockmax.bounds_report)
+        "block_bounds": _bounds_report(index_dir, meta),
     }
     report["warnings"] = _warnings(report)
     return report
+
+
+def _bounds_report(index_dir: str, meta) -> dict:
+    from .blockmax import bounds_report
+
+    try:
+        return bounds_report(index_dir, meta)
+    except Exception as e:  # noqa: BLE001 — doctor reports, never dies
+        return {"present": None, "error": repr(e)}
 
 
 def _warnings(report: dict) -> list[str]:

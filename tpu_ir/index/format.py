@@ -126,11 +126,23 @@ class IndexMetadata:
         with open(os.path.join(index_dir, METADATA), "w") as f:
             json.dump(self.__dict__, f, indent=2, sort_keys=True)
 
-    def save_with_checksums(self, index_dir: str) -> None:
+    def save_with_checksums(self, index_dir: str,
+                            block_bounds: bool = True) -> None:
         """Checksum every integrity-covered artifact currently on disk,
         record the digests, then save. The single finalization call every
         builder (in-memory, streaming, multi-host, merge) ends with —
-        metadata existence certifies the index AND pins its bytes."""
+        metadata existence certifies the index AND pins its bytes.
+
+        Being THE finalize choke point, this is also where the block-max
+        bounds artifact (index/blockmax.py) is written: every builder —
+        and the merge/compaction paths live generations flow through —
+        emits bounds before the checksum pass pins them, with no
+        per-builder wiring to drift. `block_bounds=False` skips the pass
+        (migrate --add-bounds recomputes explicitly first)."""
+        if block_bounds:
+            from .blockmax import ensure_block_bounds
+
+            ensure_block_bounds(index_dir, self)
         self.checksums = {name: file_checksum(os.path.join(index_dir, name))
                           for name in integrity_names(index_dir, self)}
         self.save(index_dir)
@@ -492,7 +504,11 @@ def integrity_names(index_dir: str, meta: "IndexMetadata") -> list[str]:
 
         names += [positions_name(s) for s in range(meta.num_shards)]
     names += [chargram_name(ck) for ck in meta.chargram_ks]
-    names += [DOCLEN, DICTIONARY, DOCNOS, VOCAB, "tokens.txt"]
+    # the block-max bounds side artifact (index/blockmax.py) is covered
+    # like any other read artifact; existence-filtered so pre-bounds
+    # indexes stay verifiable until they are backfilled
+    names += [DOCLEN, DICTIONARY, DOCNOS, VOCAB, "tokens.txt",
+              "blockmax.arena"]
     return [n for n in names if os.path.exists(os.path.join(index_dir, n))]
 
 

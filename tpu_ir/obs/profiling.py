@@ -533,6 +533,19 @@ def profile_report() -> dict:
                for name in ("batch.occupancy", "batch.wait")
                if name in hists},
         },
+        # dynamic pruning (ISSUE 13): the raw scheduling terms behind
+        # prune_diag's derived fractions, and the block-max kernels'
+        # mask ledger — blocks_masked / blocks_considered is the
+        # realized skip fraction, fallback vs saved the engagement rate
+        "pruning": {
+            name: snap["counters"].get(name, 0)
+            for name in ("prune.queries", "prune.queries_hot_free",
+                         "prune.blocks_total", "prune.blocks_skip_hot",
+                         "blockmax.blocks_considered",
+                         "blockmax.blocks_masked",
+                         "blockmax.saved_dispatches",
+                         "blockmax.fallback_dispatches")
+        },
         "gauges": snap.get("gauges", {}),
         "memory": memory_snapshot(),
     }

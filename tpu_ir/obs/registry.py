@@ -166,12 +166,29 @@ BUILD_COUNTER_NAMES = (
     "build.radix.pipeline_stalls", "build.tokenize.pool_chunks",
 )
 
+# Dynamic pruning (ISSUE 13). prune.*: the raw terms behind the derived
+# fractions Scorer.prune_diag reports — queries scheduled, the hot-free
+# subset (hot-stage upper bound exactly 0, dispatched through the static
+# cold-only kernel), and dispatch blocks total / cold-only.
+# blockmax.*: the block-max kernels' mask decisions — doc-block lanes
+# considered and masked (the skip fraction's raw terms), dispatches
+# whose bounds let the pruned hot stage run (saved), and dispatches
+# whose surviving blocks overflowed the candidate budget and fell back
+# to the exact full-width stage in-kernel.
+PRUNE_COUNTER_NAMES = (
+    "prune.queries", "prune.queries_hot_free",
+    "prune.blocks_total", "prune.blocks_skip_hot",
+    "blockmax.blocks_considered", "blockmax.blocks_masked",
+    "blockmax.saved_dispatches", "blockmax.fallback_dispatches",
+)
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
 ) + (COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
-     + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES + INGEST_COUNTER_NAMES)
+     + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES + INGEST_COUNTER_NAMES
+     + PRUNE_COUNTER_NAMES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(

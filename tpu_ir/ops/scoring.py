@@ -299,11 +299,16 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
     # runtime-cond variant, the unconditional top-C over [B, D+1] cost
     # more than the matmul it skips on CPU backends; the host already
     # knows which queries have ub = 0, so the skip is free.
-    # Without pruning, keep the original accumulation order (hot stage
-    # first) so existing callers' float rounding is unchanged.
-    scores = (jnp.zeros((b, num_docs + 1), jnp.float32)
-              if pruning or skip_hot
-              else hot_matmul(jnp.zeros((b, num_docs + 1), jnp.float32)))
+    #
+    # Accumulation order is COLD-FIRST on every path (ISSUE 13): the
+    # block-max kernels must see the cold partial before the hot stage
+    # (the running threshold derives from it), and bit-identity between
+    # them and this exact kernel requires ONE accumulation order — so
+    # the no-prune path moved its hot matmul to the end. This shifts
+    # ulp-level rounding vs the pre-13 hot-first kernels; every
+    # cross-path pin recomputes both sides, and the explain prefix
+    # harness traces this same order.
+    scores = jnp.zeros((b, num_docs + 1), jnp.float32)
 
     tof = tier_of[safe_q]                                    # [B, L]
     row = row_of[safe_q]
@@ -338,7 +343,10 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
         else:
             scores = do_tier(scores)
 
-    if skip_hot or not pruning:
+    if skip_hot:
+        return (scores, jnp.ones((b,), bool)) if with_stats else scores
+    if not pruning:
+        scores = hot_matmul(scores)
         return (scores, jnp.ones((b,), bool)) if with_stats else scores
     return _hot_stage_pruned(
         scores, hot_tfs, hot_max_w, q_w, rank, is_hot, hot_matmul,
@@ -400,17 +408,280 @@ def _hot_stage_pruned(partial, hot_tfs, hot_max_w, q_w, rank, is_hot,
     return (scores, safe_q) if with_stats else scores
 
 
+# -- block-max pruning (ISSUE 13) -------------------------------------------
+# The deep-top-k production path on the tiered layout. The doc axis is
+# cut into fixed-width blocks; blockmax.arena (index/blockmax.py) pins a
+# per-(hot term, block) score upper bound. The kernel scores the cold
+# tiers exactly, takes the running k-th partial score as its threshold,
+# and masks every doc block whose best partial plus summed hot bounds
+# cannot reach it — a branchless 0/1 lane mask, not a branch — then pays
+# the hot-strip stage (the per-dispatch cost: an O(H*D) elementwise
+# weighting plus the [B,H]@[H,D+1] matmul) ONLY for the surviving
+# blocks' columns. Bit-identity with the exact kernel is structural:
+# surviving columns are computed by the same elementwise weighting and
+# the same gemm reduction the full-width stage uses (per-column results
+# are bit-equal under column restriction — pinned by tests), masked
+# docs provably cannot reach the top-k, and the selected columns stay
+# doc-ascending so lax.top_k tie order is preserved. A batch whose
+# surviving blocks overflow the static budget falls back to the exact
+# full-width stage inside the same program (lax.cond) — also
+# bit-identical, just unpruned.
+
+# sound-bound safety margins: the ub reduction and the actual hot
+# contributions are computed by different f32 expression trees, so the
+# mask comparison pads the bound exactly like _hot_stage_pruned does
+BLOCKMAX_REL_MARGIN = 1.0001
+BLOCKMAX_ABS_MARGIN = 1e-6
+
+
+def blockmax_cand_blocks(k: int, num_docs: int, width: int) -> int:
+    """The static selected-block budget for one block-max dispatch: a
+    quarter of the doc axis, floored so the candidate columns can hold
+    at least 2k docs (deep k engages instead of tripping the overflow
+    fallback) plus a small minimum. TPU_IR_BLOCKMAX_BLOCKS overrides."""
+    from ..utils import envvars
+
+    nblk = -(-(num_docs + 1) // width)
+    override = envvars.get_int("TPU_IR_BLOCKMAX_BLOCKS")
+    if override:
+        return min(nblk, override)
+    need_k = -(-2 * k // width) + 1
+    return min(nblk, max(nblk // 4, need_k, 4))
+
+
+def _blockmax_topk(q_terms, hot_rank, hot_tfs, tier_of, row_of,
+                   tier_docs, tier_tfs, q_weight, hot_blk_bound, *,
+                   num_docs, k, width, cand_blocks, hot_weight_fn,
+                   cold_weight_fn, hot_cell_fn):
+    """Shared block-max top-k accumulation (see the section comment).
+
+    `hot_blk_bound` f32 [H, nblk] is the per-mode per-block score upper
+    bound (weight_fn of the stored block max tf; BM25 folds the block's
+    min doc-length norm — search/scorer.py builds it). Returns
+    (scores [B,k], docnos [B,k], stats int64 [3]) with stats =
+    (block lanes considered, block lanes masked, fallback flag)."""
+    b = q_terms.shape[0]
+    d1 = num_docs + 1
+    h = hot_tfs.shape[0]
+    nblk = hot_blk_bound.shape[1]
+    dpad = nblk * width
+    cbw = cand_blocks * width
+    if k > cbw or k > d1:
+        raise ValueError(f"k={k} exceeds the block-max candidate budget "
+                         f"({cand_blocks} blocks x {width}, doc axis "
+                         f"{d1}); widen TPU_IR_BLOCKMAX_BLOCKS or "
+                         "disable blockmax")
+    vocab_size = hot_rank.shape[0]
+    safe_q = jnp.where(q_terms >= 0, q_terms, 0)
+    q_valid = (q_terms >= 0) & (q_terms < vocab_size)
+    q_w = q_weight[safe_q] * q_valid                         # [B, L]
+    rank = hot_rank[safe_q]
+    is_hot = (rank >= 0) & q_valid
+
+    def hot_matmul_w(w_cells):
+        # the SAME scatter + gemm expression the exact kernel's hot
+        # stage uses — w_cells is the (full or column-restricted)
+        # weighted strip
+        w_hot = jnp.zeros((b, h), jnp.float32).at[
+            jnp.broadcast_to(jnp.arange(b)[:, None], rank.shape),
+            jnp.where(is_hot, rank, h),
+        ].add(jnp.where(is_hot, q_w, 0.0), mode="drop")      # [B, H]
+        return w_hot @ w_cells
+
+    # exact cold partial — the identical tier accumulation the exact
+    # kernel runs first (cold-first order, see _tiered_scores)
+    partial = _tiered_scores(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
+        tier_tfs, q_weight, num_docs=num_docs,
+        hot_weight_fn=hot_weight_fn, cold_weight_fn=cold_weight_fn,
+        skip_hot=True)                                       # [B, D+1]
+
+    # running threshold: the k-th best cold partial is a lower bound on
+    # the true k-th full score (hot contributions are non-negative).
+    # Col 0 is the dead slot, excluded exactly like _topk_from_scores.
+    # The k-th value is read as a MIN-reduce over the descending top-k
+    # values, not vals[:, -1]: slicing a top_k output whose indices are
+    # unused makes XLA CPU rewrite the TopK custom call into a full
+    # variadic sort (measured 8 ms -> 410 ms on [64, 50001]).
+    pmask = partial.at[:, 0].set(-jnp.inf)
+    tau = jnp.min(jax.lax.top_k(pmask, k)[0], axis=1)        # [B]
+
+    # per-(query, block) hot upper bound: sum of each hot query slot's
+    # weighted block bound — mul+reduce over L (batch-size-invariant
+    # rounding, the ISSUE 9 rule; soundness is margin-padded below)
+    brows = hot_blk_bound[jnp.where(is_hot, rank, 0)]        # [B, L, nblk]
+    ub = jnp.sum(brows * jnp.where(is_hot, q_w, 0.0)[:, :, None],
+                 axis=1)                                     # [B, nblk]
+
+    ppad = jnp.pad(pmask, ((0, 0), (0, dpad - d1)),
+                   constant_values=-jnp.inf)
+    blk_pmax = ppad.reshape(b, nblk, width).max(axis=2)      # [B, nblk]
+    # THE 0/1 block-lane mask: a lane survives iff some doc in it could
+    # still reach the top-k (best partial + summed hot bounds >= tau).
+    # Blocks holding current top-k partials survive automatically
+    # (blk_pmax >= tau with ub >= 0), so the final subset top-k below
+    # can never lose a winner.
+    need = (blk_pmax + ub * BLOCKMAX_REL_MARGIN
+            + BLOCKMAX_ABS_MARGIN >= tau[:, None])           # [B, nblk]
+    # rows with NO valid terms (rung/block padding, empty-after-analysis
+    # queries) contribute exact 0.0 everywhere and can never surface a
+    # doc — but their tau is 0, which would mark every block needed and
+    # poison the batch union into the fallback on every padded dispatch.
+    # Masking their need rows is bit-safe: their outputs are all-empty
+    # under either branch.
+    need = need & q_valid.any(axis=1)[:, None]
+    needed_any = jnp.any(need, axis=0)                       # [nblk]
+    n_needed = jnp.sum(needed_any)
+    # selected blocks: the batch-union of surviving lanes (ties and
+    # spare budget fill deterministically by block order). Ascending
+    # sort keeps candidate columns doc-ascending — lax.top_k tie order.
+    sel = jnp.sort(
+        jax.lax.top_k(needed_any.astype(jnp.float32), cand_blocks)[1])
+    safe = n_needed <= cand_blocks
+    cols = (sel[:, None] * width
+            + jnp.arange(width)[None, :]).reshape(-1)        # [CBW]
+    # blocks_masked reports REALIZED skips: a fallback dispatch ran the
+    # exact full-width stage, so its maskable lanes count 0 — operators
+    # read masked/considered as the achieved skip fraction (RUNBOOK §20)
+    stats = jnp.stack([
+        jnp.int32(b * nblk),
+        jnp.where(safe,
+                  jnp.int32(b * nblk) - jnp.sum(need).astype(jnp.int32),
+                  jnp.int32(0)),
+        jnp.where(safe, jnp.int32(0), jnp.int32(1))])
+
+    def pruned(_):
+        # weight + gemm over the surviving columns only: each column's
+        # result is bit-equal to the full-width stage's same column
+        # (same elementwise weights, same gemm reduction — pinned)
+        cols_c = jnp.minimum(cols, d1 - 1)
+        cells = hot_cell_fn(hot_tfs[:, cols_c], cols_c[None, :])
+        cand = ppad[:, cols] + hot_matmul_w(cells)           # [B, CBW]
+        top_s, idx = jax.lax.top_k(cand, k)
+        docnos = cols[idx]
+        matched = top_s > 0.0
+        return (jnp.where(matched, top_s, 0.0),
+                jnp.where(matched, docnos, 0).astype(jnp.int32))
+
+    def full(_):
+        # overflow fallback: the exact kernel's hot stage, verbatim
+        scores = partial + hot_matmul_w(hot_weight_fn(hot_tfs))
+        return _topk_from_scores(scores, k)
+
+    s, d = jax.lax.cond(safe, pruned, full, None)
+    return s, d, stats
+
+
+@partial(profiled_jit, static_argnames=("k", "num_docs", "width",
+                                   "cand_blocks", "compat_int_idf",
+                                   "hot_preweighted"))
+def tfidf_topk_blockmax(
+    q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+    df, n_scalar, hot_blk_bound, *, num_docs: int, width: int,
+    cand_blocks: int, k: int = 10, compat_int_idf: bool = False,
+    hot_preweighted: bool = False,
+):
+    """Block-max TF-IDF top-k on the tiered layout — the deep-k
+    production kernel (see the section comment). Returns
+    (scores [B,k], docnos [B,k], stats [3])."""
+    idf = idf_weights(df, n_scalar, compat_int_idf)
+    cell_fn = lambda tfs, docs: _lntf(tfs)  # noqa: E731
+    return _blockmax_topk(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        idf, hot_blk_bound, num_docs=num_docs, k=k, width=width,
+        cand_blocks=cand_blocks,
+        hot_weight_fn=_identity_weight if hot_preweighted else _lntf,
+        cold_weight_fn=cell_fn,
+        hot_cell_fn=((lambda tfs, docs: tfs) if hot_preweighted
+                     else cell_fn))
+
+
+@partial(profiled_jit, static_argnames=("k", "num_docs", "width",
+                                   "cand_blocks", "k1", "b",
+                                   "hot_preweighted"))
+def bm25_topk_blockmax(
+    q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+    df, doc_len, n_scalar, hot_blk_bound, *, num_docs: int, width: int,
+    cand_blocks: int, k: int = 10, k1: float = 0.9, b: float = 0.4,
+    hot_preweighted: bool = False,
+):
+    """Block-max Okapi BM25 top-k on the tiered layout (see
+    tfidf_topk_blockmax). The per-block bound operand must dominate the
+    saturation weights (the scorer folds each block's min doc-length
+    norm into it); the hot cell weights here gather the SAME per-doc
+    dl_norm the exact kernel broadcasts, so surviving columns are
+    bit-equal to the full-width stage."""
+    n = jnp.asarray(n_scalar, jnp.float32)
+    idf = bm25_idf_weights(df, n)
+    dlf = doc_len.astype(jnp.float32)
+    avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
+    dl_norm = 1.0 - b + b * dlf / jnp.maximum(avg_dl, 1e-9)   # [D+1]
+    cell_fn = (lambda tfs, docs: bm25_saturation(tfs, dl_norm[docs],
+                                                 k1=k1))
+    return _blockmax_topk(
+        q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
+        idf, hot_blk_bound, num_docs=num_docs, k=k, width=width,
+        cand_blocks=cand_blocks,
+        hot_weight_fn=(_identity_weight if hot_preweighted else
+                       lambda tf: bm25_saturation(tf, dl_norm[None, :],
+                                                  k1=k1)),
+        cold_weight_fn=cell_fn,
+        hot_cell_fn=((lambda tfs, docs: tfs) if hot_preweighted
+                     else cell_fn))
+
+
+# -- pre-weighted hot strips (ISSUE 13) -------------------------------------
+# The tiered hot stage is weight_fn(strip) followed by a gemm; the
+# weighting is an O(H * D) elementwise pass over a QUERY-INDEPENDENT
+# surface, recomputed every dispatch (measured: the dominant full-kernel
+# cost on CPU-class backends — ~5x the gemm it feeds). These kernels
+# materialize each scoring mode's weighted strip once; the Scorer caches
+# the result on device (budget-gated) and the tiered kernels take it
+# through `hot_preweighted=True` with an identity weight fn. Values are
+# bit-identical to the in-kernel weighting — the same elementwise
+# expression on the same operands, and elementwise chains have no
+# reassociation freedom — which the parity suite pins.
+
+
+def _identity_weight(strip):
+    return strip
+
+
+@profiled_jit
+def lntf_strip(hot_tfs: jax.Array) -> jax.Array:
+    """(1 + ln tf) over the raw-tf hot strip — the TF-IDF (and cosine
+    rerank) hot weighting, materialized."""
+    return _lntf(hot_tfs)
+
+
+@partial(profiled_jit, static_argnames=("k1", "b"))
+def bm25_strip(hot_tfs: jax.Array, doc_len: jax.Array, n_scalar: jax.Array,
+               *, k1: float = 0.9, b: float = 0.4) -> jax.Array:
+    """BM25 saturation over the raw-tf hot strip with the doc-length
+    norm broadcast — the same expression _bm25_tiered_scores' hot
+    weighting traces, materialized."""
+    n = jnp.asarray(n_scalar, jnp.float32)
+    dlf = doc_len.astype(jnp.float32)
+    avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
+    dl_norm = 1.0 - b + b * dlf / jnp.maximum(avg_dl, 1e-9)
+    return bm25_saturation(hot_tfs, dl_norm[None, :], k1=k1)
+
+
 def _tfidf_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
                          tier_docs, tier_tfs, df, n_scalar, hot_max_tf, *,
                          num_docs, prune_k, compat_int_idf, prune,
-                         skip_hot, hot_only) -> jax.Array:
+                         skip_hot, hot_only,
+                         hot_preweighted=False) -> jax.Array:
     """[B, D+1] tiered TF-IDF accumulation — shared verbatim between the
     production top-k kernel and the explain score-gather variant
     (prune_k is the production kernel's k; the prune gate and candidate
     machinery must see the same value to trace the same program)."""
     idf = idf_weights(df, n_scalar, compat_int_idf)
 
-    do_prune = (not skip_hot and not hot_only
+    # the runtime-bounded prune variant gathers RAW cells, so it and the
+    # pre-weighted strip are mutually exclusive (production passes
+    # neither hot_max_tf nor prune there — this is belt and braces)
+    do_prune = (not skip_hot and not hot_only and not hot_preweighted
                 and _prune_applicable(prune_k, num_docs, prune)
                 and hot_max_tf is not None)
     # one weight model for cold postings AND pruned hot candidates: the
@@ -418,7 +689,8 @@ def _tfidf_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
     cell_fn = lambda tfs, docs: _lntf(tfs)  # noqa: E731
     return _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
-        idf, num_docs=num_docs, hot_weight_fn=_lntf,
+        idf, num_docs=num_docs,
+        hot_weight_fn=_identity_weight if hot_preweighted else _lntf,
         cold_weight_fn=cell_fn,
         hot_cell_fn=cell_fn if do_prune else None,
         hot_max_w=_lntf(hot_max_tf.astype(jnp.float32)) if do_prune else None,
@@ -427,7 +699,8 @@ def _tfidf_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
 
 
 @partial(profiled_jit, static_argnames=("k", "num_docs", "compat_int_idf",
-                                   "prune", "skip_hot", "hot_only"))
+                                   "prune", "skip_hot", "hot_only",
+                                   "hot_preweighted"))
 def tfidf_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
     hot_rank: jax.Array,       # int32 [V]: row in hot_tfs, or -1 (cold)
@@ -446,6 +719,7 @@ def tfidf_topk_tiered(
     prune: bool = False,
     skip_hot: bool = False,
     hot_only: bool = False,
+    hot_preweighted: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """TF-IDF top-k on the tiered sparse layout (search/layout.py): the
     budget-capped hot strip bounds dense memory, geometric tier capacities
@@ -464,12 +738,14 @@ def tfidf_topk_tiered(
     runtime-bounded variant (`_hot_stage_pruned`) for mixed blocks.
     `hot_only=True` (static) is the opposite degradation: score ONLY the
     hot strip (the overload ladder's cheapest device level; results are
-    partial and must be tagged by the caller)."""
+    partial and must be tagged by the caller). `hot_preweighted=True`
+    (static) declares `hot_tfs` ALREADY weighted (lntf_strip) — the hot
+    stage skips its per-dispatch elementwise pass; bit-identical."""
     scores = _tfidf_tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
         df, n_scalar, hot_max_tf, num_docs=num_docs, prune_k=k,
         compat_int_idf=compat_int_idf, prune=prune, skip_hot=skip_hot,
-        hot_only=hot_only)
+        hot_only=hot_only, hot_preweighted=hot_preweighted)
     return _topk_from_scores(scores, k)
 
 
@@ -494,7 +770,8 @@ def tfidf_scores_at_tiered(
 
 
 @partial(profiled_jit, static_argnames=("k", "num_docs", "k1", "b", "prune",
-                                   "skip_hot", "hot_only"))
+                                   "skip_hot", "hot_only",
+                                   "hot_preweighted"))
 def bm25_topk_tiered(
     q_terms: jax.Array,        # int32 [B, L]
     hot_rank: jax.Array,       # int32 [V]
@@ -515,6 +792,7 @@ def bm25_topk_tiered(
     prune: bool = False,
     skip_hot: bool = False,
     hot_only: bool = False,
+    hot_preweighted: bool = False,
 ) -> tuple[jax.Array, jax.Array]:
     """Okapi BM25 on the tiered sparse layout — the scorer variant that
     makes BM25 usable past the dense-matrix budget (MS MARCO-scale corpora).
@@ -526,11 +804,14 @@ def bm25_topk_tiered(
     the hot-strip stage. The BM25 upper bound uses the saturation curve at
     (max tf, min doc-length norm): saturation is increasing in tf and
     decreasing in dl_norm, so sat(tf, d) <= sat(max_tf, dl_min) for every
-    posting of the row."""
+    posting of the row. `hot_preweighted=True` (static) declares
+    `hot_tfs` ALREADY saturated (bm25_strip) — bit-identical, minus the
+    per-dispatch elementwise pass."""
     scores = _bm25_tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
         df, doc_len, n_scalar, hot_max_tf, num_docs=num_docs, prune_k=k,
-        k1=k1, b=b, prune=prune, skip_hot=skip_hot, hot_only=hot_only)
+        k1=k1, b=b, prune=prune, skip_hot=skip_hot, hot_only=hot_only,
+        hot_preweighted=hot_preweighted)
     return _topk_from_scores(scores, k)
 
 
@@ -558,16 +839,27 @@ bm25_topk_dense_dq = _donated_query_twin(
     bm25_topk_dense, static_argnames=("k", "k1", "b"))
 tfidf_topk_tiered_dq = _donated_query_twin(
     tfidf_topk_tiered, static_argnames=("k", "num_docs", "compat_int_idf",
-                                        "prune", "skip_hot", "hot_only"))
+                                        "prune", "skip_hot", "hot_only",
+                                        "hot_preweighted"))
 bm25_topk_tiered_dq = _donated_query_twin(
     bm25_topk_tiered, static_argnames=("k", "num_docs", "k1", "b", "prune",
-                                       "skip_hot", "hot_only"))
+                                       "skip_hot", "hot_only",
+                                       "hot_preweighted"))
+tfidf_topk_blockmax_dq = _donated_query_twin(
+    tfidf_topk_blockmax, static_argnames=("k", "num_docs", "width",
+                                          "cand_blocks", "compat_int_idf",
+                                          "hot_preweighted"))
+bm25_topk_blockmax_dq = _donated_query_twin(
+    bm25_topk_blockmax, static_argnames=("k", "num_docs", "width",
+                                         "cand_blocks", "k1", "b",
+                                         "hot_preweighted"))
 
 
 def _bm25_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
                         tier_docs, tier_tfs, df, doc_len, n_scalar,
                         hot_max_tf, *, num_docs, prune_k, k1, b, prune,
-                        skip_hot, hot_only) -> jax.Array:
+                        skip_hot, hot_only,
+                        hot_preweighted=False) -> jax.Array:
     """[B, D+1] tiered BM25 accumulation — shared verbatim between the
     production top-k kernel and the explain score-gather variant."""
     n = jnp.asarray(n_scalar, jnp.float32)
@@ -576,7 +868,7 @@ def _bm25_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
     avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
     dl_norm = 1.0 - b + b * dlf / jnp.maximum(avg_dl, 1e-9)  # [D+1]
 
-    do_prune = (not skip_hot and not hot_only
+    do_prune = (not skip_hot and not hot_only and not hot_preweighted
                 and _prune_applicable(prune_k, num_docs, prune)
                 and hot_max_tf is not None)
     if do_prune:
@@ -596,8 +888,9 @@ def _bm25_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
         idf, num_docs=num_docs,
         # hot_weight_fn sees the whole [H, D+1] strip (doc axis last)
-        hot_weight_fn=lambda tf: bm25_saturation(tf, dl_norm[None, :],
-                                                 k1=k1),
+        hot_weight_fn=(_identity_weight if hot_preweighted else
+                       lambda tf: bm25_saturation(tf, dl_norm[None, :],
+                                                  k1=k1)),
         cold_weight_fn=cell_fn,
         hot_cell_fn=cell_fn if do_prune else None,
         hot_max_w=hot_max_w,
@@ -709,29 +1002,35 @@ def cosine_scores_at_dense(q_terms, doc_matrix, df, doc_norm, cand_docnos,
                                 cand_docnos, num_docs)
 
 
-@partial(profiled_jit, static_argnames=("k", "num_docs"))
+@partial(profiled_jit, static_argnames=("k", "num_docs", "hot_preweighted"))
 def cosine_rerank_tiered(
     q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
     df, doc_norm, n_scalar, cand_docnos, *, num_docs: int, k: int = 10,
+    hot_preweighted: bool = False,
 ):
     """cosine_rerank_dense on the tiered sparse layout (large corpora).
     The tiered accumulation is doc-axis-wide by construction, so this path
-    scores [B, D+1] and then gathers the candidates."""
+    scores [B, D+1] and then gathers the candidates. `hot_preweighted`
+    takes the cached (1 + ln tf) strip (lntf_strip — the SAME weighting
+    this kernel applies; the TF-IDF top-k shares the cache)."""
     cand_scores = _cosine_tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
-        df, doc_norm, n_scalar, cand_docnos, num_docs=num_docs)
+        df, doc_norm, n_scalar, cand_docnos, num_docs=num_docs,
+        hot_preweighted=hot_preweighted)
     return _topk_over_candidates(cand_scores, cand_docnos, k)
 
 
 def _cosine_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
                           tier_docs, tier_tfs, df, doc_norm, n_scalar,
-                          cand_docnos, *, num_docs) -> jax.Array:
+                          cand_docnos, *, num_docs,
+                          hot_preweighted=False) -> jax.Array:
     """[B, C] per-candidate tiered cosine scores — shared between the
     production rerank kernel and the explain variant."""
     idf = idf_weights(df, n_scalar)
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
-        idf * idf, num_docs=num_docs, hot_weight_fn=_lntf,
+        idf * idf, num_docs=num_docs,
+        hot_weight_fn=_identity_weight if hot_preweighted else _lntf,
         cold_weight_fn=lambda tfs, docs: _lntf(tfs))
     # gather the C candidates FIRST, then normalize: dividing the full
     # [B, D+1] matrix before a [B, C] gather is ~D/C times the divides

@@ -60,6 +60,12 @@ class TieredPostings(NamedTuple):
     row_of: np.ndarray     # int32 [V]: row within the tier (0 likewise)
     tier_docs: tuple       # each int32 [V_t, P_t], docnos, 0 = empty slot
     tier_tfs: tuple        # each int32 [V_t, P_t], tfs, 0 = empty slot
+    # block-max pruning (ISSUE 13): per-(hot row, doc block) max raw tf
+    # — int [H, nblk] at `blockmax_width` doc columns per block, or None
+    # when bounds were unavailable (pre-13 serving caches). The scorer
+    # derives each scoring mode's per-block score upper bound from it.
+    hot_blk_max: np.ndarray | None = None
+    blockmax_width: int = 0
 
     def hot_dense(self) -> np.ndarray:
         """Densify the hot strip on HOST — for the sharded stacker and
@@ -161,11 +167,21 @@ def build_tiered_layout(
     hot_budget: int = HOT_BUDGET,
     base_cap: int = BASE_CAP,
     growth: int = GROWTH,
+    block_bounds: tuple | None = None,
 ) -> TieredPostings:
     """Build the layout from global-CSR-ordered postings columns.
 
     `pair_doc`/`pair_tf` must be sorted by term id with per-term runs of
-    length `df[tid]` (the Scorer.load order)."""
+    length `df[tid]` (the Scorer.load order).
+
+    `block_bounds` = (tids, max_tf, width) from blockmax.arena
+    (index/blockmax.py): per-term per-doc-block max tf the builders
+    recorded. When supplied AND covering this layout's hot set, the hot
+    rows' bounds are sliced from it; otherwise they are recomputed from
+    the postings (identical values — the artifact saves the pass, it
+    never changes the result)."""
+    from ..index import blockmax as bmx
+
     v = len(df)
     d = num_docs
     indptr = np.concatenate([[0], np.cumsum(df, dtype=np.int64)])
@@ -218,9 +234,31 @@ def build_tiered_layout(
         tier_docs.append(np.zeros((1, 1), np.int32))
         tier_tfs.append(np.zeros((1, 1), np.int32))
 
+    # block-max bounds for the hot rows: sliced from the builders'
+    # blockmax.arena when it covers this hot set, else recomputed from
+    # the postings (one vectorized maximum-scatter over the hot runs)
+    width = bmx.block_width()
+    hot_blk_max = None
+    if block_bounds is not None and len(hot_tids):
+        btids, bmax, bwidth = block_bounds
+        pos = np.searchsorted(btids, hot_tids)
+        if (len(btids) and pos.max(initial=0) < len(btids)
+                and np.array_equal(np.asarray(btids)[pos], hot_tids)):
+            hot_blk_max = np.asarray(bmax)[pos].astype(np.int32)
+            width = int(bwidth)
+    if hot_blk_max is None:
+        if len(hot_tids):
+            hot_blk_max = bmx.compute_block_max(
+                hot_tids, pair_doc, pair_tf, indptr, num_docs=d,
+                width=width)
+        else:
+            hot_blk_max = np.zeros((1, bmx.num_blocks(d, width)),
+                                   np.int32)
+
     return TieredPostings(hot_rank, hot_rows, hot_docs, hot_vals,
                           num_hot, d + 1, tier_of, row_of,
-                          tuple(tier_docs), tuple(tier_tfs))
+                          tuple(tier_docs), tuple(tier_tfs),
+                          hot_blk_max, width)
 
 
 def shard_doc_ranges(num_docs: int, num_shards: int) -> list:
@@ -263,7 +301,21 @@ def restrict_tiers(tiers: TieredPostings, lo: int, hi: int) -> TieredPostings:
         tf = np.array(tt)
         tf[(td64 < lo) | (td64 > hi)] = 0
         tier_tfs.append(tf)
-    return tiers._replace(hot_vals=hot_vals, tier_tfs=tuple(tier_tfs))
+    # block-max bounds compose with the restriction: a doc block wholly
+    # outside [lo, hi] has every hot tf zeroed above, so its bound drops
+    # to exact 0; boundary blocks keep the GLOBAL bound — an
+    # overestimate over the surviving postings, which is sound (bounds
+    # must only dominate) and merely a hair less tight at the two edges
+    hot_blk_max = tiers.hot_blk_max
+    if hot_blk_max is not None and tiers.blockmax_width:
+        w = int(tiers.blockmax_width)
+        nblk = hot_blk_max.shape[1]
+        starts = np.arange(nblk, dtype=np.int64) * w
+        outside = (starts + w - 1 < lo) | (starts > hi)
+        hot_blk_max = np.array(hot_blk_max)
+        hot_blk_max[:, outside] = 0
+    return tiers._replace(hot_vals=hot_vals, tier_tfs=tuple(tier_tfs),
+                          hot_blk_max=hot_blk_max)
 
 
 # serving-cache format version; bump when the layout semantics change
@@ -275,8 +327,11 @@ def restrict_tiers(tiers: TieredPostings, lo: int, hi: int) -> TieredPostings:
 #  v5: arrays persist in ONE page-aligned arena file (cache.arena,
 #  index/format.py) instead of N .npy files — mmap-identical reads, one
 #  open; the manifest additionally records part (size, mtime_ns) stats so
-#  an UNCHANGED index revalidates without re-streaming every part's CRC)
-_CACHE_VERSION = 5
+#  an UNCHANGED index revalidates without re-streaming every part's CRC;
+#  v6: the hot strip's block-max bounds (hot_blk_max [H, nblk] +
+#  manifest blockmax_width) ride in the cache, so warm loads serve
+#  block-max pruning with zero postings IO)
+_CACHE_VERSION = 6
 
 
 def _part_stat(index_dir: str, meta) -> list:
@@ -486,7 +541,8 @@ def load_serving_cache(
             arr("hot_vals"), m["num_hot"], m["hot_width"],
             arr("tier_of"), arr("row_of"),
             tuple(arr(f"tier_docs_{i}") for i in range(m["num_tiers"])),
-            tuple(arr(f"tier_tfs_{i}") for i in range(m["num_tiers"])))
+            tuple(arr(f"tier_tfs_{i}") for i in range(m["num_tiers"])),
+            arr("hot_blk_max"), m["blockmax_width"])
         return tiers, arr("df"), arr("doc_norms")
     except (OSError, KeyError, ValueError):
         return None  # unreadable/stale cache: caller rebuilds
@@ -510,6 +566,12 @@ def save_serving_cache(
         "tier_of": tiers.tier_of, "row_of": tiers.row_of,
         "df": np.asarray(df, np.int32),
         "doc_norms": np.asarray(doc_norms, np.float32),
+        # cache v6: block-max bounds ride along (an all-zero [1, nblk]
+        # row when the layout has no hot terms — same convention as the
+        # dummy tier)
+        "hot_blk_max": np.asarray(
+            tiers.hot_blk_max if tiers.hot_blk_max is not None
+            else np.zeros((1, 1), np.int32), np.int32),
     }
     for i, (d, t) in enumerate(zip(tiers.tier_docs, tiers.tier_tfs)):
         arrays[f"tier_docs_{i}"] = d
@@ -526,4 +588,5 @@ def save_serving_cache(
                  "part_stat": _part_stat(index_dir, meta),
                  "num_tiers": len(tiers.tier_docs),
                  "num_hot": tiers.num_hot,
-                 "hot_width": tiers.hot_width})
+                 "hot_width": tiers.hot_width,
+                 "blockmax_width": int(tiers.blockmax_width)})

@@ -203,6 +203,10 @@ class Scorer:
     # other's multi-second lazy loads); the class-level fallback covers
     # minimal object.__new__ Scorers in tests.
     _lazy_lock = threading.RLock()
+    # block-max state defaults, so minimal object.__new__ Scorers (and
+    # non-tiered layouts) read "no bounds" instead of AttributeError
+    _hot_blk_max: np.ndarray | None = None
+    _blockmax_width: int = 0
 
     def __init__(
         self,
@@ -376,6 +380,13 @@ class Scorer:
             # that take it are not the production path — the
             # scheduled static skip needs only hot_rank; tests
             # compute it locally)
+            # block-max bounds (ISSUE 13): per-(hot row, doc block) max
+            # tf from the layout/cache; each scoring mode's f32 bound
+            # table is derived lazily on first engaged dispatch
+            self._hot_blk_max = (None if tiers.hot_blk_max is None
+                                 else np.asarray(tiers.hot_blk_max))
+            self._blockmax_width = int(tiers.blockmax_width or 0)
+            self._blockmax_tables: dict = {}
             self.tier_of = stream_to_device(tiers.tier_of,
                                             label="tier_of")
             self.row_of = stream_to_device(tiers.row_of, label="row_of")
@@ -533,10 +544,17 @@ class Scorer:
                                                num_shards=len(
                                                    jax.devices()))
         elif resolved == "sparse":
+            from ..index.blockmax import load_block_bounds
             from .layout import save_serving_cache
 
+            # the builders' block-max bounds artifact saves the bounds
+            # pass; corrupt copies quarantine and the pass recomputes
+            # (bounds are derived data — never a load failure)
+            bounds = load_block_bounds(index_dir, meta,
+                                       quarantine_corrupt=True)
             tiers = build_tiered_layout(pair_doc, pair_tf, df,
-                                        num_docs=meta.num_docs)
+                                        num_docs=meta.num_docs,
+                                        block_bounds=bounds)
             if save_cache:
                 # pair_term stays lazy: the norms pass derives each
                 # chunk's term ids from the df row starts instead of
@@ -1140,12 +1158,16 @@ class Scorer:
         scheduler's exact plan (skip kernel pinned bit-identical on
         hot-free rows), so results cannot differ."""
         q = np.asarray(q_terms, np.int32)
-        return self._dispatch_degradable(
+        out = self._dispatch_degradable(
             lambda: self._topk_primary(q, k, scoring, hot_only=hot_only,
                                        donate=donate, uniform=uniform),
             lambda: self._topk_host(q, k, scoring),
             deadline_s, "score dispatch",
             "answering from the host CPU backend", force_host=force_host)
+        # ledger the batch's block-max mask decisions AFTER its results
+        # were fetched (never serializing the dispatch overlap)
+        self._drain_blockmax_stats()
+        return out
 
     def _dispatch_degradable(self, primary, fallback, deadline_s,
                              label, consequence, force_host=False):
@@ -1214,6 +1236,8 @@ class Scorer:
                 (q, -1))
         has_hot, n_free, mode = self._skip_plan(q)
         if mode == "all_skip":
+            self._ledger_skip_plan(len(q), n_free,
+                                   -(-len(q) // block), 0)
             return self._blocked_dispatch(
                 block,
                 lambda qb: self._topk_device(qb, k, scoring,
@@ -1221,10 +1245,14 @@ class Scorer:
                                              donate=donate), (q, -1))
         if mode == "all_full":
             # too few hot-free queries to pay an extra dispatch for
+            self._ledger_skip_plan(len(q), n_free, 0,
+                                   -(-len(q) // block))
             return self._blocked_dispatch(
                 block, lambda qb: self._topk_device(qb, k, scoring,
                                                     donate=donate),
                 (q, -1))
+        self._ledger_skip_plan(len(q), n_free, -(-n_free // block),
+                               -(-(len(q) - n_free) // block))
         order = self._schedule_order(has_hot)
         inv = np.argsort(order, kind="stable")
         qs = q[order]
@@ -1326,6 +1354,19 @@ class Scorer:
             mode = "split"
         return has_hot, n_free, mode
 
+    def _ledger_skip_plan(self, n_queries: int, n_free: int,
+                          skip_blocks: int, full_blocks: int) -> None:
+        """Raw MaxScore-scheduling counters (ISSUE 13 satellite): the
+        derived fractions prune_diag reports stay, but operators scrape
+        the raw terms from /profile, `tpu-ir stats` and Prometheus."""
+        from ..obs import get_registry
+
+        reg = get_registry()
+        reg.incr("prune.queries", n_queries)
+        reg.incr("prune.queries_hot_free", n_free)
+        reg.incr("prune.blocks_total", skip_blocks + full_blocks)
+        reg.incr("prune.blocks_skip_hot", skip_blocks)
+
     def _topk_uniform(self, q: np.ndarray, k: int, scoring: str,
                       rungs: tuple, *, donate: bool = False):
         """The coalesced static-shape dispatch (ISSUE 9): the exact
@@ -1347,6 +1388,8 @@ class Scorer:
             return self._topk_device(qb, k, scoring, donate=donate)
 
         if n_free == len(q):
+            self._ledger_skip_plan(len(q), n_free,
+                                   -(-len(q) // block), 0)
             return self._rung_dispatch(q, block, rungs, skip_fn)
         # all-PAD rows (rung padding, empty-after-analysis queries)
         # score exact 0.0 under EITHER kernel — when they are the only
@@ -1354,6 +1397,8 @@ class Scorer:
         # whole per-dispatch round trip scoring nothing but padding
         real_free = int((~has_hot & ~(q < 0).all(axis=1)).sum())
         if real_free == 0:
+            self._ledger_skip_plan(len(q), n_free, 0,
+                                   -(-len(q) // block))
             return self._rung_dispatch(q, block, rungs, full_fn)
         if real_free < self.MIN_SKIP_GROUP and _rtt_dominated_backend():
             # the MIN_SKIP_GROUP economy, serving edition — but only
@@ -1364,7 +1409,15 @@ class Scorer:
             # pinned). On CPU the inequality flips — the matmul is the
             # dominant cost and the extra dispatch is ~nothing — so
             # there the split always wins and the fold is skipped.
+            self._ledger_skip_plan(len(q), n_free, 0,
+                                   -(-len(q) // block))
             return self._rung_dispatch(q, block, rungs, full_fn)
+        # the same dispatch-block unit _topk_primary ledgers (ceil of
+        # real group rows over the block size): the scraped fractions
+        # must measure one thing whichever dispatch path served
+        self._ledger_skip_plan(len(q), n_free,
+                               max(-(-n_free // block), 1),
+                               max(-(-(len(q) - n_free) // block), 1))
         order = self._schedule_order(has_hot)
         inv = np.argsort(order, kind="stable")
         qs = q[order]
@@ -1474,6 +1527,150 @@ class Scorer:
             return self._sharded.dblk + 1
         return self.meta.num_docs + 1
 
+    # -- block-max pruning (ISSUE 13) -----------------------------------
+
+    def _blockmax_plan(self, k: int, scoring: str):
+        """Static engagement decision for one full (hot-containing)
+        tiered dispatch: (bound_table, width, cand_blocks) or None.
+        Deterministic per (k, scoring, layout, knobs), so the coalescing
+        frontend's precompile walks the same program the serving path
+        dispatches. Results are bit-identical engaged or not — the knob
+        (TPU_IR_BLOCKMAX) exists for A/B runs and rollback."""
+        if (self.layout != "sparse" or not self.prune
+                or self._hot_blk_max is None
+                or not self._blockmax_width
+                or scoring not in ("tfidf", "bm25")):
+            return None
+        from ..utils import envvars
+
+        if envvars.get_choice("TPU_IR_BLOCKMAX") == "0":
+            return None
+        from ..ops.scoring import blockmax_cand_blocks
+
+        width = self._blockmax_width
+        nblk = self._hot_blk_max.shape[1]
+        cand = blockmax_cand_blocks(k, self.meta.num_docs, width)
+        # engage only when the mask can actually skip work (a budget at
+        # or above the block count degenerates to the full stage plus
+        # machinery) and the candidate columns can hold the top-k
+        if (cand + 2 > nblk or k > cand * width
+                or k > self.meta.num_docs + 1):
+            return None
+        return self._blockmax_bound_table(scoring), width, cand
+
+    def _hot_wstrip(self, scoring: str):
+        """The device-cached PRE-WEIGHTED hot strip for a scoring mode
+        (ops/scoring.py lntf_strip / bm25_strip), or None when disabled
+        (TPU_IR_BLOCKMAX_STRIP_CACHE) or over the memory budget. The
+        weighting is query-independent, yet the in-kernel hot stage
+        recomputes it per dispatch — an O(H * D) elementwise pass that
+        measures ~5x the gemm it feeds on CPU backends; caching it turns
+        the hot stage into the gemm alone. Values are bit-identical
+        (same elementwise expression, no reassociation freedom — pinned
+        by the block-max parity suite). TF-IDF and the cosine rerank
+        share the (1 + ln tf) strip; BM25 gets its saturated twin."""
+        if self.layout != "sparse":
+            return None
+        from ..utils import envvars
+
+        mode = envvars.get_choice("TPU_IR_BLOCKMAX_STRIP_CACHE")
+        if mode == "0":
+            return None
+        h, d1 = self.hot_tfs.shape
+        if mode == "auto":
+            from .layout import HOT_BUDGET
+
+            # each cached mode costs one more strip-sized buffer; stay
+            # within half the hot budget per strip so the raw strip plus
+            # both mode twins cannot exceed 2x the budgeted footprint
+            if h * d1 > HOT_BUDGET // 2:
+                return None
+        cache = self.__dict__.setdefault("_wstrip_cache", {})
+        key = "bm25" if scoring == "bm25" else "tfidf"
+        if key in cache:
+            return cache[key]
+        from ..ops.scoring import bm25_strip, lntf_strip
+
+        # computed OUTSIDE the lazy lock (device dispatch — lint TPU202);
+        # a racing loser's copy is garbage-collected, never corruption
+        if key == "bm25":
+            from .phrase import B as _b, K1 as _k1
+
+            # the SAME k1/b the kernels are called with (and the bound
+            # table is built from) — one parameterization everywhere
+            strip = bm25_strip(self.hot_tfs, self.doc_len,
+                               jnp.int32(self.meta.num_docs),
+                               k1=_k1, b=_b)
+        else:
+            strip = lntf_strip(self.hot_tfs)
+        with self._lazy_lock:
+            return cache.setdefault(key, strip)
+
+    def _blockmax_bound_table(self, scoring: str):
+        """The per-mode f32 [H, nblk] per-block score upper bound the
+        block-max kernels consume: weight_fn of the stored block max tf
+        — (1 + ln tf) for TF-IDF; for BM25 the saturation curve at the
+        block's MINIMUM doc-length norm (saturation increases in tf and
+        decreases in dl_norm, so the pair dominates every posting in
+        the block). Device-resident, built once per mode (double-checked
+        publish, computed outside the lock — lint TPU202)."""
+        tables = self.__dict__.setdefault("_blockmax_tables", {})
+        if scoring in tables:
+            return tables[scoring]
+        max_tf = np.asarray(self._hot_blk_max, np.float32)
+        if scoring == "tfidf":
+            bound = np.where(max_tf > 0,
+                             1.0 + np.log(np.maximum(max_tf, 1.0)), 0.0)
+        else:
+            from .phrase import B as _b, K1 as _k1
+
+            width = self._blockmax_width
+            d = self.meta.num_docs
+            nblk = max_tf.shape[1]
+            dlf = np.asarray(self.doc_len).astype(np.float32)
+            avg = float(dlf.sum()) / max(d, 1)
+            dl_norm = 1.0 - _b + _b * dlf / max(avg, 1e-9)
+            # dead slot 0 and the pad tail must not drag the block min
+            # down (a lower dl_norm only loosens the bound, but slot 0's
+            # zero length would loosen block 0 for nothing)
+            padded = np.full(nblk * width, np.inf, np.float32)
+            padded[1: d + 1] = dl_norm[1: d + 1]
+            dl_min = padded.reshape(nblk, width).min(axis=1)
+            dl_min = np.where(np.isfinite(dl_min), dl_min, 0.0)
+            sat = max_tf * (_k1 + 1.0) / np.maximum(
+                max_tf + _k1 * dl_min[None, :], 1e-9)
+            bound = np.where(max_tf > 0, sat, 0.0)
+        table = stream_to_device(np.ascontiguousarray(bound, np.float32),
+                                 label="hot_blk_bound")
+        with self._lazy_lock:
+            return tables.setdefault(scoring, table)
+
+    def _note_blockmax_stats(self, stats) -> None:
+        """Queue one dispatch's (considered, masked, fallback) device
+        triple; drained AFTER the batch's results are fetched so the
+        stats read never serializes the dispatch overlap."""
+        with self._lazy_lock:
+            self.__dict__.setdefault("_blockmax_pending", []).append(stats)
+
+    def _drain_blockmax_stats(self) -> None:
+        from ..obs import get_registry
+
+        with self._lazy_lock:
+            pending = self.__dict__.get("_blockmax_pending") or []
+            self.__dict__["_blockmax_pending"] = []
+        if not pending:
+            return
+        reg = get_registry()
+        for stats in pending:
+            considered, masked, fallback = (int(x) for x in
+                                            np.asarray(stats))
+            reg.incr("blockmax.blocks_considered", considered)
+            reg.incr("blockmax.blocks_masked", masked)
+            if fallback:
+                reg.incr("blockmax.fallback_dispatches")
+            else:
+                reg.incr("blockmax.saved_dispatches")
+
     def _topk_device(self, q_terms: np.ndarray, k: int, scoring: str,
                      skip_hot: bool = False, hot_only: bool = False,
                      donate: bool = False):
@@ -1525,31 +1722,87 @@ class Scorer:
                 fn = bm25_topk_dense_dq if donate else bm25_topk_dense
                 s, d = fn(q, self._ensure_tf_matrix(),
                           self.df, self.doc_len, n, k=k)
+            elif (plan := None if (skip_hot or hot_only)
+                    else self._blockmax_plan(k, scoring)) is not None:
+                # block-max pruning (ISSUE 13): the full-group deep-k
+                # production path — bit-identical to the exact kernel,
+                # the hot stage paid only for surviving doc blocks
+                from ..ops.scoring import (
+                    bm25_topk_blockmax,
+                    bm25_topk_blockmax_dq,
+                )
+
+                from .phrase import B as _b, K1 as _k1
+
+                bound, width, cand = plan
+                ws = self._hot_wstrip(scoring)
+                fn = bm25_topk_blockmax_dq if donate else bm25_topk_blockmax
+                # k1/b ride explicitly from THE shared constants: the
+                # bound table (_blockmax_bound_table) is built from
+                # phrase.K1/B, and a kernel saturating with different
+                # constants would silently break bound domination
+                s, d, stats = fn(
+                    q, self.hot_rank,
+                    ws if ws is not None else self.hot_tfs, self.tier_of,
+                    self.row_of, self.tier_docs, self.tier_tfs, self.df,
+                    self.doc_len, n, bound, num_docs=self.meta.num_docs,
+                    width=width, cand_blocks=cand, k=k, k1=_k1, b=_b,
+                    hot_preweighted=ws is not None)
+                self._note_blockmax_stats(stats)
             else:
                 from ..ops.scoring import bm25_topk_tiered, bm25_topk_tiered_dq
+                from .phrase import B as _b, K1 as _k1
 
+                # the pre-weighted strip serves every variant that runs
+                # the hot stage; the cold-only skip kernel keeps the raw
+                # strip operand (the stage is statically absent)
+                ws = (None if skip_hot
+                      else self._hot_wstrip(scoring))
                 fn = bm25_topk_tiered_dq if donate else bm25_topk_tiered
                 s, d = fn(
-                    q, self.hot_rank, self.hot_tfs, self.tier_of,
+                    q, self.hot_rank,
+                    ws if ws is not None else self.hot_tfs, self.tier_of,
                     self.row_of, self.tier_docs, self.tier_tfs, self.df,
                     self.doc_len, n, num_docs=self.meta.num_docs, k=k,
-                    skip_hot=skip_hot, hot_only=hot_only)
+                    k1=_k1, b=_b, skip_hot=skip_hot, hot_only=hot_only,
+                    hot_preweighted=ws is not None)
         elif self.layout == "dense":
             from ..ops.scoring import tfidf_topk_dense_dq
 
             fn = tfidf_topk_dense_dq if donate else tfidf_topk_dense
             s, d = fn(q, self.doc_matrix, self.df, n, k=k,
                       compat_int_idf=self.compat_int_idf)
+        elif (plan := None if (skip_hot or hot_only)
+                else self._blockmax_plan(k, scoring)) is not None:
+            from ..ops.scoring import (
+                tfidf_topk_blockmax,
+                tfidf_topk_blockmax_dq,
+            )
+
+            bound, width, cand = plan
+            ws = self._hot_wstrip(scoring)
+            fn = tfidf_topk_blockmax_dq if donate else tfidf_topk_blockmax
+            s, d, stats = fn(
+                q, self.hot_rank,
+                ws if ws is not None else self.hot_tfs, self.tier_of,
+                self.row_of, self.tier_docs, self.tier_tfs, self.df, n,
+                bound, num_docs=self.meta.num_docs, width=width,
+                cand_blocks=cand, k=k,
+                compat_int_idf=self.compat_int_idf,
+                hot_preweighted=ws is not None)
+            self._note_blockmax_stats(stats)
         else:
             from ..ops.scoring import tfidf_topk_tiered, tfidf_topk_tiered_dq
 
+            ws = None if skip_hot else self._hot_wstrip(scoring)
             fn = tfidf_topk_tiered_dq if donate else tfidf_topk_tiered
             s, d = fn(
-                q, self.hot_rank, self.hot_tfs, self.tier_of, self.row_of,
-                self.tier_docs, self.tier_tfs, self.df, n,
+                q, self.hot_rank,
+                ws if ws is not None else self.hot_tfs, self.tier_of,
+                self.row_of, self.tier_docs, self.tier_tfs, self.df, n,
                 num_docs=self.meta.num_docs, k=k,
                 compat_int_idf=self.compat_int_idf, skip_hot=skip_hot,
-                hot_only=hot_only)
+                hot_only=hot_only, hot_preweighted=ws is not None)
         return s, d
 
     def _ensure_tf_matrix(self):
@@ -1728,12 +1981,15 @@ class Scorer:
         """rerank_topk() with the per-request degraded flag threaded
         through the return value (see topk_tagged)."""
         q = np.asarray(q_terms, np.int32)
-        return self._dispatch_degradable(
+        out = self._dispatch_degradable(
             lambda: self._rerank_primary(q, k, candidates),
             lambda: self._topk_host(q, k, "bm25"),
             deadline_s, "rerank dispatch",
             "answering with host BM25, rerank stage dropped",
             force_host=force_host)
+        # the BM25 candidate stage may have dispatched through block-max
+        self._drain_blockmax_stats()
+        return out
 
     def _rerank_primary(self, q_terms: np.ndarray, k: int, candidates: int):
         from ..ops import cosine_rerank_dense
@@ -1781,10 +2037,15 @@ class Scorer:
             if self.layout == "dense":
                 return cosine_rerank_dense(
                     qd, self.doc_matrix, self.df, norms, cand_d, n, k=k)
+            # the cosine stage weights the hot strip with the SAME
+            # (1 + ln tf) curve as TF-IDF, so it rides the cached strip
+            ws = self._hot_wstrip("tfidf")
             return cosine_rerank_tiered(
-                qd, self.hot_rank, self.hot_tfs, self.tier_of, self.row_of,
-                self.tier_docs, self.tier_tfs, self.df, norms, n, cand_d,
-                num_docs=self.meta.num_docs, k=k)
+                qd, self.hot_rank,
+                ws if ws is not None else self.hot_tfs, self.tier_of,
+                self.row_of, self.tier_docs, self.tier_tfs, self.df,
+                norms, n, cand_d, num_docs=self.meta.num_docs, k=k,
+                hot_preweighted=ws is not None)
 
         return self._blocked_dispatch(
             self._block_size(), dispatch,

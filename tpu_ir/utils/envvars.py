@@ -146,10 +146,13 @@ _declare("TPU_IR_BATCH_DONATE", "choice", "auto",
          "donate the query-side device buffer on coalesced topk "
          "dispatches: auto (TPU backends only), 1 (force), 0 (off)",
          "§16", choices=("auto", "0", "1"))
-_declare("TPU_IR_RADIX_BUCKETS", "int", 0,
+_declare("TPU_IR_RADIX_BUCKETS", "int", 16,
          "radix buckets the streaming pass-1 partitions its pair spills "
          "into (0 = legacy per-batch pass-2 combine; >0 turns pass 2 "
-         "into per-bucket local device reduces)", "§18", minimum=0)
+         "into per-bucket local device reduces). Default 16: the radix "
+         "path is the library default after its PR 11 soak — every "
+         "bucket count is fuzz-pinned bit-identical to legacy, so 0 is "
+         "a rollback pin, not a safety valve", "§18", minimum=0)
 _declare("TPU_IR_TOKENIZE_PROCS", "int", 1,
          "worker processes for the pure-Python tokenizer (1 = in-process;"
          " N>1 analyzes chunks in a pool, byte-identical to serial)",
@@ -195,6 +198,31 @@ _declare("TPU_IR_MERGE_TIER_RATIO", "float", 8.0,
          "geometric doc-count ratio between merge tiers (each doc is "
          "rewritten about log_ratio(N) times over its lifetime)", "§19",
          minimum=2.0)
+_declare("TPU_IR_BLOCKMAX", "choice", "auto",
+         "block-max pruning of the tiered hot-strip stage: auto/1 "
+         "engage when bounds exist and the doc axis is wide enough, 0 "
+         "disables (results are bit-identical either way — the toggle "
+         "exists for A/B runs and incident rollback)", "§20",
+         choices=("auto", "0", "1"))
+_declare("TPU_IR_BLOCKMAX_WIDTH", "int", 512,
+         "doc-axis block width for block-max score bounds; fixed per "
+         "blockmax.arena artifact at write time (readers use the stored "
+         "width). Smaller blocks = tighter bounds but a larger bounds "
+         "table and more mask lanes", "§20", minimum=64)
+_declare("TPU_IR_BLOCKMAX_STRIP_CACHE", "choice", "auto",
+         "device-cache each scoring mode's pre-weighted hot strip "
+         "(lntf/saturation of the raw strip — query-independent, yet "
+         "recomputed per dispatch in-kernel): auto caches within the "
+         "memory budget, 1 forces, 0 disables. Bit-identical either "
+         "way; one more strip-sized device buffer per cached mode",
+         "§20", choices=("auto", "0", "1"))
+_declare("TPU_IR_BLOCKMAX_BLOCKS", "int", 0,
+         "doc blocks one block-max dispatch scores exactly (the static "
+         "candidate budget); 0 sizes it automatically from k, the block "
+         "width and the doc-axis length. Batches whose surviving blocks "
+         "overflow the budget fall back to the exact full-width stage "
+         "in-kernel (bit-identical, counted as blockmax.fallback)",
+         "§20", minimum=0)
 _declare("TPU_IR_ROUTER_DEADLINE_MS", "float", 500.0,
          "per-shard deadline for one routed request: a shard that "
          "answers on no replica within it ships the response partial",
