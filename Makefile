@@ -1,0 +1,29 @@
+# Developer entry points (README §Development, RUNBOOK §13).
+# Everything here is also reachable without make — the recipes are
+# one-liners on purpose.
+
+PY ?= python
+
+.PHONY: lint lint-diff lint-selftest test test-fast
+
+# the full static-analysis gate (exit 0 clean / 1 findings / 2 usage)
+lint:
+	$(PY) -m tpu_ir.lint
+
+# pre-commit mode: per-file rules restricted to files changed vs HEAD
+# (package-level contracts stay whole-package) — see RUNBOOK §13 for
+# the git-hook recipe
+lint-diff:
+	$(PY) -m tpu_ir.lint --diff HEAD
+
+# prove the rules still catch their seeded positives/negatives
+lint-selftest:
+	$(PY) -m tpu_ir.lint --self-test
+
+# tier-1 (the CI gate): everything not marked slow
+test:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q -m 'not slow'
+
+test-fast:
+	JAX_PLATFORMS=cpu $(PY) -m pytest tests/test_lint.py \
+		tests/test_lint_hazards.py -q

@@ -36,6 +36,21 @@ import threading  # noqa: E402
 import pytest  # noqa: E402
 
 
+@pytest.fixture(scope="session", autouse=True)
+def _lint_self_test():
+    """The lint gate's own gate (ISSUE 14, mirroring bench-check
+    --self-test): once per tier-1 session, every rule must still fire
+    on its seeded positive fixture and stay silent on the negative.
+    The per-rule self-check over the shipped package (test_lint.py)
+    proves the CODE is clean; this proves the ANALYZERS still work —
+    a pass that silently stops matching fails here, not never."""
+    from tpu_ir.lint.selftest import run_selftest
+
+    failures = run_selftest()
+    assert not failures, "lint rule self-test failures:\n" + "\n".join(
+        failures)
+
+
 @pytest.fixture(autouse=True)
 def _fresh_telemetry():
     """Reset the process-wide telemetry (registry counters + histograms,

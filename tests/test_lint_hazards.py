@@ -1,0 +1,505 @@
+"""Acceptance suite for the determinism & shape-universe analyzer
+families (ISSUE 14): TPU401-405 (lint/lowering.py), TPU501-503
+(lint/shapeflow.py), TPU306 (contracts), fingerprinted v2 baselines,
+`--diff` / `--self-test`, and the shipped-package regression pins.
+
+Contracts:
+
+- every new rule fires on its seeded positive fixture and stays silent
+  on the matching negative (the selftest corpus IS the seed corpus —
+  parametrized here so a lobotomized rule names itself);
+- the PR-13 top_k pitfall (DESIGN §17) is a permanent regression pin:
+  no production kernel slices top_k values with dead indices — the
+  thin source-introspection wrapper over TPU402, mirroring the PR 3
+  pattern;
+- TPU501/TPU502 prove the REAL serving path's shape universe closed,
+  and NOT vacuously: the flow engine must have audited the production
+  kernels through the coalescer -> search_batch -> dispatch chain (the
+  static side of the runtime `compile.count == 0` pin that
+  test_batching enforces dynamically);
+- v2 baselines match on fingerprints (line- AND message-move
+  tolerant), read v1 files compatibly, and migrate reasons;
+- `--diff` restricts per-file findings to changed files while
+  package-level contracts stay whole-package; `--self-test` honors the
+  exit-code contract.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import textwrap
+from pathlib import Path
+
+import pytest
+
+import tpu_ir
+from tpu_ir.cli import main as cli_main
+from tpu_ir.lint import Baseline, Finding, PackageIndex, run_lint
+from tpu_ir.lint.selftest import FIXTURES, run_selftest
+
+REPO = Path(tpu_ir.__file__).parent.parent
+
+
+def lint_src(tmp_path, source: str, *, families=("lowering", "shapeflow")):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent(source))
+    return run_lint(str(pkg), pkg_name="fixpkg", rel_root=str(tmp_path),
+                    families=families)
+
+
+# ---------------------------------------------------------------------------
+# the seeded fixture corpus, one test per fixture
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "rule,name,should_fire,source",
+    FIXTURES, ids=[f"{r}-{n}" for r, n, _, _ in FIXTURES])
+def test_rule_fixture(tmp_path, rule, name, should_fire, source):
+    findings = lint_src(tmp_path, source)
+    fired = [f for f in findings if f.rule == rule]
+    if should_fire:
+        assert fired, f"{rule} must fire on {name}"
+    else:
+        assert not fired, f"{rule} must stay silent on {name}: {fired}"
+
+
+def test_selftest_runner_is_green():
+    assert run_selftest() == []
+
+
+# ---------------------------------------------------------------------------
+# rule-specific sharpening beyond the corpus
+# ---------------------------------------------------------------------------
+
+
+def test_tpu402_exact_pr13_pattern(tmp_path):
+    """The verbatim shape of the PR-13 regression (DESIGN §17): the
+    running threshold read as vals[:, k-1] from a top_k whose indices
+    die — 8 ms -> 410 ms on XLA CPU at [64, 50001]."""
+    fs = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def threshold(partial, k):
+            pmask = partial.at[:, 0].set(-jnp.inf)
+            vals, idx = jax.lax.top_k(pmask, k)
+            tau = vals[:, k - 1]
+            return tau
+    """)
+    hits = [f for f in fs if f.rule == "TPU402"]
+    assert len(hits) == 1 and "min-reduce" in hits[0].fix_hint
+
+
+def test_tpu403_allowlist_comment(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q_terms, df):
+            # lint: invariant-ok (cheap; fused in-trace by design)
+            idf = jnp.log(1.0 + df)
+            return idf[q_terms]
+    """)
+    assert not [f for f in fs if f.rule == "TPU403"]
+
+
+def test_tpu401_static_batch_helpers_stay_silent(tmp_path):
+    """A contraction over pure index state (no query operand) is not a
+    batch-shape hazard — the batch axis is what varies per dispatch."""
+    fs = lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def gram(strip):
+            return strip @ strip.T
+    """)
+    assert not [f for f in fs if f.rule == "TPU401"]
+
+
+def test_tpu404_values_view_accumulation(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+
+        @jax.jit
+        def kernel(x, table):
+            total = 0.0
+            for w in table.values():
+                total += w
+            return x * total
+    """)
+    assert [f for f in fs if f.rule == "TPU404"]
+
+
+def test_tpu501_suppression_comment(tmp_path):
+    fs = lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        LADDER = (1, 4)
+
+        @jax.jit
+        def kernel(q):
+            return q.sum()
+
+        def serve(texts):
+            # lint: shape-universe-ok (a one-shot diagnostic dispatch)
+            return kernel(np.full((17, 8), -1, np.int32))
+    """)
+    assert not [f for f in fs if f.rule == "TPU501"]
+
+
+def test_tpu502_scoring_default_must_cover_dispatched_literal(tmp_path):
+    fs = lint_src(tmp_path, """
+        import numpy as np
+
+        class Sched:
+            def __init__(self, scorer, ladder=(1, 4)):
+                self._scorer = scorer
+                self._ladder = tuple(ladder)
+
+            def precompile(self, scorings=("tfidf",)):
+                for rows in sorted({min(r, 8) for r in self._ladder}):
+                    q = np.full((rows, 8), -1, np.int32)
+                    self._scorer._topk_device(q, 10, "tfidf")
+
+            def _execute(self, slots):
+                q = np.full((4, 8), -1, np.int32)
+                return self._scorer._topk_device(q, 10, "bm25")
+    """)
+    hits = [f for f in fs if f.rule == "TPU502"]
+    assert hits and any("'bm25'" in f.message for f in hits)
+
+
+# ---------------------------------------------------------------------------
+# shipped-package regression pins
+# ---------------------------------------------------------------------------
+
+
+def test_no_production_kernel_slices_topk_with_dead_indices():
+    """The memory/DESIGN §17 pitfall, promoted to a permanent pin: a
+    re-introduction of `top_k(...)[0][...]`-with-dead-indices anywhere
+    in shipped tpu_ir/ fails tier-1 with the file:line (the thin
+    wrapper over TPU402, mirroring PR 3's source-introspection
+    tests)."""
+    from tpu_ir.lint import lowering
+
+    index = PackageIndex(str(REPO / "tpu_ir"), rel_root=str(REPO))
+    hits = [f for f in lowering.check(index) if f.rule == "TPU402"]
+    assert not hits, "dead-index top_k slice re-introduced:\n" + \
+        "\n".join(str(f) for f in hits)
+
+
+def test_shipped_serving_shape_universe_is_closed():
+    """TPU501/TPU502 over shipped tpu_ir/: the coalesced serving path's
+    shape universe is provably closed over the precompile walk — the
+    static side of the runtime compile.count == 0 pin."""
+    from tpu_ir.lint import shapeflow
+
+    index = PackageIndex(str(REPO / "tpu_ir"), rel_root=str(REPO))
+    findings = shapeflow.check(index)
+    assert not findings, "shape-universe findings:\n" + "\n".join(
+        str(f) for f in findings)
+
+
+def test_shape_universe_proof_is_not_vacuous():
+    """The zero-finding run above is only a proof if the engine walked
+    the real dispatch chain: the audited set must include the
+    production top-k kernels, reached through the coalescer ->
+    search_batch -> blocked-dispatch chain, and the rung ladder must
+    have been parsed from the env registry."""
+    from tpu_ir.lint import shapeflow
+
+    index = PackageIndex(str(REPO / "tpu_ir"), rel_root=str(REPO))
+    flow = shapeflow.analyze(index)
+    assert flow.rung_values >= {1, 4, 16, 64}, "ladder parse rotted"
+    audited_roots = {root.rsplit(".", 1)[-1]
+                     for _, _, root in flow._audited}
+    assert {"tfidf_topk_tiered", "bm25_topk_tiered"} <= audited_roots, \
+        f"serving dispatch chain not walked (audited: {audited_roots})"
+    assert len(flow._audited) >= 8, "audit coverage rotted"
+    # the chain facts themselves: the kernels' batch argument arrived
+    # CLOSED (rung/block), not merely unreported
+    raw = flow.param_facts[
+        "tpu_ir.search.scorer.Scorer._topk_device_raw"]["q_terms"]
+    assert raw[0] == "arr" and "?" not in raw[1] and "?" not in raw[2]
+
+
+def test_tpu306_dead_declared_names(tmp_path):
+    """TPU306 both ways on a fixture: literal + f-string emissions keep
+    declared names alive; a never-emitted name is dead."""
+    from tpu_ir.lint import contracts
+
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent("""
+        from tpu_ir.obs import get_registry
+
+        def emit(level):
+            get_registry().incr("alive.literal")
+            get_registry().incr(f"served_{level}")
+    """))
+    index = PackageIndex(str(pkg), pkg_name="fixpkg",
+                         rel_root=str(tmp_path))
+    emitted = contracts.collect_emitted(index)
+    findings = contracts.check_dead_declared(index, emitted, {
+        "counters": (("alive.literal", "served_full", "dead.name"),
+                     "reg.py", "counter")})
+    assert [f.message.split("'")[1] for f in findings] == ["dead.name"]
+    assert all(f.rule == "TPU306" for f in findings)
+
+
+def test_shipped_package_has_no_dead_declared_names():
+    from tpu_ir.lint import contracts
+
+    index = PackageIndex(str(REPO / "tpu_ir"), rel_root=str(REPO))
+    hits = [f for f in contracts.check(index) if f.rule == "TPU306"]
+    assert not hits, "\n".join(str(f) for f in hits)
+
+
+# ---------------------------------------------------------------------------
+# fingerprints + v2 baselines
+# ---------------------------------------------------------------------------
+
+
+def test_fingerprint_survives_line_and_message_moves():
+    a = Finding("TPU401", "pkg/a.py", 10, "msg v1", ast_path="f/x")
+    b = Finding("TPU401", "pkg/a.py", 99, "msg v2 reworded",
+                ast_path="f/x")
+    assert a.fingerprint == b.fingerprint
+    c = Finding("TPU401", "pkg/a.py", 10, "msg v1", ast_path="g/y")
+    assert a.fingerprint != c.fingerprint
+
+
+def test_baseline_v2_matches_on_fingerprint(tmp_path):
+    f1 = Finding("TPU403", "pkg/a.py", 10, "old message",
+                 ast_path="kernel/invariant/idf")
+    path = tmp_path / "bl.json"
+    path.write_text(Baseline.render([f1]))
+    assert json.loads(path.read_text())["version"] == 2
+    bl = Baseline.load(str(path))
+    moved = Finding("TPU403", "pkg/a.py", 55, "REWRITTEN message",
+                    ast_path="kernel/invariant/idf")
+    fresh, stale = bl.filter([moved])
+    assert fresh == [] and stale == []
+
+
+def test_baseline_v1_compat_reader_and_migration(tmp_path):
+    v1 = {"version": 1, "findings": [{
+        "rule": "TPU203", "file": "pkg/a.py",
+        "message": "lock X held across blocking IO", "count": 1,
+        "reason": "the lock exists to serialize this IO"}]}
+    path = tmp_path / "bl.json"
+    path.write_text(json.dumps(v1))
+    bl = Baseline.load(str(path))          # v1 parses
+    f = Finding("TPU203", "pkg/a.py", 12,
+                "lock X held across blocking IO", ast_path="save/io")
+    fresh, stale = bl.filter([f])          # key-matching still absorbs
+    assert fresh == [] and stale == []
+    migrated = Baseline.render([f], bl)    # --fix-baseline migrates
+    data = json.loads(migrated)
+    assert data["version"] == 2
+    assert data["findings"][0]["fingerprint"] == f.fingerprint
+    assert data["findings"][0]["reason"] == \
+        "the lock exists to serialize this IO"
+
+
+def test_baseline_same_message_distinct_fingerprints_roundtrip(tmp_path):
+    """Two findings sharing (rule, file, message) but anchored at
+    different AST sites render as two entries and BOTH absorb after a
+    reload — a freshly written --fix-baseline file must never fail its
+    own gate (the key-collision regression)."""
+    a = Finding("TPU403", "pkg/a.py", 10, "same message",
+                ast_path="f/invariant/x")
+    b = Finding("TPU403", "pkg/a.py", 40, "same message",
+                ast_path="g/invariant/y")
+    path = tmp_path / "bl.json"
+    path.write_text(Baseline.render([a, b]))
+    assert len(json.loads(path.read_text())["findings"]) == 2
+    bl = Baseline.load(str(path))
+    fresh, stale = bl.filter([a, b])
+    assert fresh == [] and stale == []
+
+
+def test_tpu502_plain_ladder_loop_is_covered(tmp_path):
+    """`for rows in self._ladder:` walks every rung by construction —
+    the rung-coverage check must accept the uncapped plain form, not
+    just the min(·, block) comprehension."""
+    fs = lint_src(tmp_path, """
+        import numpy as np
+
+        class Sched:
+            def __init__(self, scorer, ladder=(1, 4)):
+                self._scorer = scorer
+                self._ladder = tuple(ladder)
+
+            def precompile(self, scorings=("tfidf",)):
+                for rows in self._ladder:
+                    q = np.full((rows, 8), -1, np.int32)
+                    self._scorer._topk_device(q, 10, "tfidf")
+
+            def _execute(self, slots):
+                q = np.full((4, 8), -1, np.int32)
+                return self._scorer._topk_device(q, 10, "tfidf")
+    """)
+    assert not [f for f in fs if f.rule == "TPU502"]
+
+
+def test_json_output_carries_fingerprint_and_fix_hint(tmp_path, capsys):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "bad.py").write_text(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def kernel(scores, k):
+            vals, idx = jax.lax.top_k(scores, k)
+            return vals[:, -1]
+    """))
+    assert cli_main(["lint", str(pkg), "--json"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    f = out["findings"][0]
+    assert f["rule"] == "TPU402"
+    assert len(f["fingerprint"]) == 12
+    assert "min-reduce" in f["fix_hint"] or "jnp.min" in f["fix_hint"]
+
+
+# ---------------------------------------------------------------------------
+# CLI: --self-test and --diff
+# ---------------------------------------------------------------------------
+
+
+def test_cli_self_test_exit_0(capsys):
+    assert cli_main(["lint", "--self-test"]) == 0
+    err = capsys.readouterr().err
+    assert "fixtures ok" in err
+
+
+def _git(cwd, *args):
+    subprocess.run(["git", "-C", str(cwd), *args], check=True,
+                   capture_output=True)
+
+
+def test_cli_diff_restricts_per_file_rules(tmp_path, capsys):
+    """Two files with TPU402 findings; only the one changed vs the ref
+    is reported under --diff REF (whole-package index still built)."""
+    bad = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def kernel(scores, k):
+            vals, idx = jax.lax.top_k(scores, k)
+            return vals[:, -1]
+    """)
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "old.py").write_text(bad)
+    (pkg / "new.py").write_text("")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "add", ".")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+    (pkg / "new.py").write_text(bad.replace("kernel", "kernel2"))
+
+    assert cli_main(["lint", str(pkg), "--json", "--diff", "HEAD"]) == 1
+    out = json.loads(capsys.readouterr().out)
+    files = {f["file"] for f in out["findings"]}
+    assert files == {"fixpkg/new.py"}, files
+
+
+def test_cli_diff_never_truncates_baseline_or_reports_false_stale(
+        tmp_path, capsys):
+    """--fix-baseline always rewrites from the FULL finding set, and
+    --diff must not report out-of-scope (but still occurring) baseline
+    entries as stale — the diff filter is a REPORTING restriction."""
+    bad = textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def kernel(scores, k):
+            vals, idx = jax.lax.top_k(scores, k)
+            return vals[:, -1]
+    """)
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "old.py").write_text(bad)
+    (pkg / "other.py").write_text("")
+    _git(tmp_path, "init", "-q")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "add", ".")
+    _git(tmp_path, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed")
+    bl = tmp_path / "bl.json"
+    bl.write_text('{"version": 2, "findings": []}\n')
+    # baseline the old.py finding, then change ONLY other.py
+    assert cli_main(["lint", str(pkg), "--baseline", str(bl),
+                     "--fix-baseline"]) == 0
+    capsys.readouterr()
+    (pkg / "other.py").write_text("x = 1\n")
+    # out-of-scope entry neither reported as a finding nor as stale
+    assert cli_main(["lint", str(pkg), "--baseline", str(bl),
+                     "--diff", "HEAD"]) == 0
+    out = capsys.readouterr()
+    assert "note: stale" not in out.err and "0 stale" in out.err
+    # --diff combined with --fix-baseline keeps the full entry set
+    assert cli_main(["lint", str(pkg), "--baseline", str(bl),
+                     "--diff", "HEAD", "--fix-baseline"]) == 0
+    assert len(json.loads(bl.read_text())["findings"]) == 1
+    # and the untouched tree still passes under the preserved baseline
+    assert cli_main(["lint", str(pkg), "--baseline", str(bl)]) == 0
+
+
+def test_cli_diff_bad_ref_is_usage_error(tmp_path, capsys):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    _git(tmp_path, "init", "-q")
+    assert cli_main(["lint", str(pkg), "--diff",
+                     "no-such-ref"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# suppression-comment semantics
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_scans_contiguous_comment_block(tmp_path):
+    pkg = tmp_path / "fixpkg"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "mod.py").write_text(textwrap.dedent("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q_terms, strip):
+            w_hot = q_terms * 1.0
+            # lint: reassoc-ok (reason line one of a block —
+            # continuation line two)
+            # final line of the block
+            return w_hot @ strip
+
+        @jax.jit
+        def kernel2(q_terms, strip):
+            # a comment block WITHOUT the token
+
+            # lint: reassoc-ok — but separated by a blank line: the
+            # annotation does not leak past non-comment lines
+            w_hot = q_terms * 1.0
+            return w_hot @ strip
+    """))
+    findings = run_lint(str(pkg), pkg_name="fixpkg",
+                        rel_root=str(tmp_path), families=("lowering",))
+    hits = [f for f in findings if f.rule == "TPU401"]
+    assert len(hits) == 1 and "kernel2" in hits[0].message

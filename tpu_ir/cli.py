@@ -350,10 +350,13 @@ def _read_trec_topics(path: str) -> tuple[list[str], list[str]]:
 
 
 def cmd_lint(args) -> int:
-    """Static analysis over the package source (ISSUE 6): jit-hazard,
-    concurrency, and contract passes — pure AST, no JAX import, fast
-    enough for a pre-commit hook. Exit 0 clean / 1 findings / 2 usage
-    error (the CI contract tests/test_lint.py pins)."""
+    """Static analysis over the package source (ISSUEs 6 + 14):
+    jit-hazard, concurrency, contract, determinism/lowering, and
+    shape-universe passes — pure AST, no JAX import, fast enough for a
+    pre-commit hook (`--diff REF` restricts per-file findings to
+    changed files; `--self-test` re-proves the rules against their
+    seeded fixtures). Exit 0 clean / 1 findings / 2 usage error (the
+    CI contract tests/test_lint.py pins)."""
     from .lint import Baseline, run_lint
     from .lint.concurrency import build_lock_report
     from .lint.core import RULES
@@ -380,6 +383,15 @@ def cmd_lint(args) -> int:
         print(json.dumps(build_lock_report(
             PackageIndex(root, pkg_name=pkg_name, rel_root=rel_root)), indent=2))
         return 0
+    if args.self_test:
+        from .lint.selftest import FIXTURES, run_selftest
+
+        failures = run_selftest()
+        for line in failures:
+            print(f"FAIL: {line}", file=sys.stderr)
+        print(f"lint self-test: {len(FIXTURES) - len(failures)}/"
+              f"{len(FIXTURES)} fixtures ok", file=sys.stderr)
+        return 1 if failures else 0
 
     findings = run_lint(root, pkg_name=pkg_name, rel_root=rel_root)
 
@@ -412,6 +424,41 @@ def cmd_lint(args) -> int:
         return 0
 
     fresh, stale = baseline.filter(findings)
+
+    if args.diff is not None:
+        # pre-commit mode: per-file REPORTING restricts to files changed
+        # vs the git ref; package-level contracts (TPU30x registry
+        # drift, TPU50x shape universe) stay whole-package — they can
+        # break through ANY file. Applied after baseline filtering (and
+        # never to --fix-baseline, which always rewrites from the FULL
+        # finding set), so out-of-scope baseline entries are neither
+        # reported stale nor dropped from a rewritten baseline.
+        import subprocess
+
+        from .lint.core import PACKAGE_LEVEL_RULES
+
+        try:
+            # --relative: paths come back relative to rel_root, the
+            # same space findings' `file` fields live in (the package
+            # may sit below the git top-level)
+            res = subprocess.run(
+                ["git", "-C", rel_root, "diff", "--name-only",
+                 "--relative", args.diff, "--"],
+                capture_output=True, text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired) as e:
+            print(f"error: --diff needs git: {e}", file=sys.stderr)
+            return 2
+        if res.returncode != 0:
+            print(f"error: git diff {args.diff} failed: "
+                  f"{res.stderr.strip()}", file=sys.stderr)
+            return 2
+        changed = {ln.strip() for ln in res.stdout.splitlines()
+                   if ln.strip()}
+        fresh = [f for f in fresh
+                 if f.rule.startswith(PACKAGE_LEVEL_RULES)
+                 or f.file in changed]
+        stale = []   # out-of-scope entries are not "no longer occurs"
+
     if args.json:
         print(json.dumps({
             "findings": [f.to_dict() for f in fresh],
@@ -1592,7 +1639,8 @@ def main(argv: list[str] | None = None) -> int:
 
     pl = sub.add_parser(
         "lint", help="static analysis: jit hazards, lock discipline, "
-        "telemetry/env contracts (pure AST, no JAX; RUNBOOK §13)")
+        "telemetry/env contracts, determinism/lowering hazards, and the "
+        "shape-universe proof (pure AST, no JAX; RUNBOOK §13)")
     pl.add_argument("path", nargs="?", default=None,
                     help="package dir to analyze (default: the installed "
                          "tpu_ir package)")
@@ -1613,6 +1661,16 @@ def main(argv: list[str] | None = None) -> int:
                          "acquisition-order graph as JSON")
     pl.add_argument("--env-table", action="store_true",
                     help="print the generated RUNBOOK env-var table")
+    pl.add_argument("--diff", nargs="?", const="HEAD", default=None,
+                    metavar="REF",
+                    help="restrict per-file findings to files changed vs "
+                         "the git ref (default HEAD); package-level "
+                         "contracts (TPU30x/TPU50x) stay whole-package — "
+                         "the fast pre-commit mode (RUNBOOK §13)")
+    pl.add_argument("--self-test", action="store_true",
+                    help="run the seeded positive/negative rule fixtures "
+                         "instead of linting (exit 1 if any rule stopped "
+                         "catching what it claims to catch)")
     pl.set_defaults(fn=cmd_lint)
 
     args = p.parse_args(argv)

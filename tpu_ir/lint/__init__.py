@@ -1,20 +1,35 @@
-"""tpu_ir.lint — TPU-hazard, concurrency, and contract static analysis.
+"""tpu_ir.lint — TPU-hazard, concurrency, contract, determinism, and
+shape-universe static analysis.
 
-The analyzer suite behind `tpu-ir lint` (ISSUE 6): pure-AST passes over
-the package source — no JAX import, milliseconds per run — organized in
-three families (core.RULES is the catalog, DESIGN §10 the prose):
+The analyzer suite behind `tpu-ir lint` (ISSUEs 6 + 14): pure-AST
+passes over the package source — no JAX import; the full gate with the
+shape-flow fixpoint runs in ~3 s — organized in five families
+(core.RULES is the catalog, DESIGN §10 the prose):
 
 - jit_hazards:  TPU101-104 — what must never happen inside a trace
 - concurrency:  TPU201-204 — the whole-program lock inventory, order
                 graph, and held-across-dispatch/IO hazards; plus the
                 runtime OrderedLock verifier (ordered_lock.py)
-- contracts:    TPU301-305 — emitted names == declared names (env vars,
-                counters, histograms, fault sites, RUNBOOK)
+- contracts:    TPU301-306 — emitted names == declared names (env vars,
+                counters, histograms, fault sites, RUNBOOK), in BOTH
+                directions (306 = declared-but-dead)
+- lowering:     TPU401-405 — determinism & XLA-lowering hazards (batch-
+                shape-dependent contractions, dead-index top_k slices,
+                per-dispatch invariant recomputes, unordered float
+                accumulation, dtype-mixed selects)
+- shapeflow:    TPU501-503 — the static shape-universe proof of the
+                zero-recompile serving contract (rung-ladder closure,
+                precompile-walk coverage, derived-shape minting)
 
-Findings are structured (rule, file, line, message); reviewed ones are
-grandfathered in lint_baseline.json with reasons. The self-check test
-(tests/test_lint.py) runs the suite over tpu_ir/ itself in tier-1, so
-the analyzers gate the codebase that ships them.
+Findings are structured (rule, file, line, message, fingerprint,
+fix_hint); reviewed ones are grandfathered in lint_baseline.json (v2:
+fingerprint-matched, line- and message-move tolerant) with reasons, or
+allowlisted in-code with `# lint: <token>` comments that carry their
+reason at the site. The self-check test (tests/test_lint.py) runs the
+suite over tpu_ir/ itself in tier-1, and the selftest fixtures
+(`tpu-ir lint --self-test`, session-scoped in conftest) prove each rule
+still catches its seeded positive — the analyzers gate the codebase
+that ships them, and the codebase gates the analyzers back.
 """
 
 from .astindex import PackageIndex

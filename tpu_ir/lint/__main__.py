@@ -1,0 +1,13 @@
+"""`python -m tpu_ir.lint` — the lint gate as a standalone entry point.
+
+Exactly `tpu-ir lint` (same flags, same exit codes: 0 clean / 1
+findings / 2 usage), for environments where the console script is not
+on PATH — pre-commit hooks, bare CI runners, `make lint`.
+"""
+
+import sys
+
+from ..cli import main
+
+if __name__ == "__main__":
+    sys.exit(main(["lint", *sys.argv[1:]]))

@@ -172,16 +172,36 @@ class FuncInfo:
 
 
 class ModuleInfo:
-    def __init__(self, modname: str, path: str, tree: ast.Module):
+    def __init__(self, modname: str, path: str, tree: ast.Module,
+                 source: str = ""):
         self.modname = modname
         self.path = path
         self.tree = tree
+        self.lines = source.splitlines()
         self.import_alias: dict[str, str] = {}       # alias -> dotted module
         self.from_imports: dict[str, tuple] = {}     # name -> (module, orig)
         self.functions: dict[str, FuncInfo] = {}     # qual -> info
         self.classes: dict[str, dict] = {}           # cls -> {meth: info}
         self.lock_defs: dict[str, LockDef] = {}
         self.lock_acqs: list[LockAcq] = []
+
+    def suppressed(self, line: int, token: str) -> bool:
+        """True when the 1-based source line — or the contiguous block
+        of comment lines directly above it — carries an in-code
+        allowlist annotation `# lint: <token>`. Unlike a baseline
+        entry, the annotation travels WITH the code it justifies and
+        survives renames/moves; the reason rides in the same comment
+        block."""
+        marker = f"lint: {token}"
+        if 1 <= line <= len(self.lines) and marker in self.lines[line - 1]:
+            return True
+        ln = line - 1
+        while 1 <= ln <= len(self.lines) and \
+                self.lines[ln - 1].lstrip().startswith("#"):
+            if marker in self.lines[ln - 1]:
+                return True
+            ln -= 1
+        return False
 
 
 class PackageIndex:
@@ -224,11 +244,12 @@ class PackageIndex:
                 path = os.path.join(dirpath, fn)
                 try:
                     with open(path, encoding="utf-8") as f:
-                        tree = ast.parse(f.read(), filename=path)
+                        source = f.read()
+                    tree = ast.parse(source, filename=path)
                 except (SyntaxError, ValueError, OSError) as e:
                     self.errors.append((path, str(e)))
                     continue
-                mod = ModuleInfo(self._modname(path), path, tree)
+                mod = ModuleInfo(self._modname(path), path, tree, source)
                 self.modules[mod.modname] = mod
                 self._index_module(mod)
 

@@ -29,6 +29,13 @@ and the (now thin) test wrappers:
 - **TPU305** — every span/histogram literal (`trace("x")`,
   `observe("x", ...)`) is in DECLARED_HISTOGRAMS or the declared
   `build.` family.
+- **TPU306** — the inverse of TPU303 (ISSUE 14): every DECLARED_*
+  counter/histogram/gauge name must be emitted by SOME code path — a
+  declared-but-dead name is documentation describing telemetry that
+  cannot happen, and a scrape surface forever reporting zero. Dynamic
+  emissions count: an f-string emit site (`incr(f"served_{level}")`)
+  is collected as a prefix/suffix pattern and matches every declared
+  expansion of its family.
 
 The declared sets are imported from the live modules (they are data,
 not behavior — no JAX touched); the emit sites come from the shared
@@ -67,6 +74,113 @@ def _const_str(node: ast.AST) -> str | None:
     if isinstance(node, ast.Constant) and isinstance(node.value, str):
         return node.value
     return None
+
+
+def _fstring_pattern(node: ast.AST) -> str | None:
+    """A fullmatch regex for the names an f-string emit site can
+    produce (constant parts verbatim, each interpolation `.+`), or None
+    when the node is not a JoinedStr."""
+    if not isinstance(node, ast.JoinedStr):
+        return None
+    parts = []
+    for v in node.values:
+        if isinstance(v, ast.Constant):
+            parts.append(re.escape(str(v.value)))
+        else:
+            parts.append(".+")
+    return "".join(parts)
+
+
+class EmittedNames:
+    """Every registry name the package can emit, by surface: literal
+    names plus f-string patterns (collected package-wide — the
+    telemetry layer's own emissions count; only declaration sites
+    don't)."""
+
+    def __init__(self):
+        self.counters: set = set()
+        self.recovery: set = set()
+        self.serving: set = set()
+        self.hists: set = set()
+        self.gauges: set = set()
+        self.patterns: dict[str, list] = {
+            "counters": [], "recovery": [], "serving": [], "hists": [],
+            "gauges": []}
+
+    def _add(self, surface: str, node: ast.AST) -> None:
+        if isinstance(node, ast.IfExp):
+            # incr("a" if cond else "b") emits either branch
+            self._add(surface, node.body)
+            self._add(surface, node.orelse)
+            return
+        name = _const_str(node)
+        if name is not None:
+            getattr(self, surface).add(name)
+            return
+        pat = _fstring_pattern(node)
+        if pat is not None:
+            self.patterns[surface].append(re.compile(pat))
+
+    def emits(self, surface: str, name: str) -> bool:
+        return name in getattr(self, surface) or any(
+            p.fullmatch(name) for p in self.patterns[surface])
+
+
+def collect_emitted(index: PackageIndex) -> EmittedNames:
+    out = EmittedNames()
+    for mod in index.modules.values():
+        rel = index.relpath(mod.path).replace(os.sep, "/")
+        if "/lint/" in rel:
+            continue
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            if isinstance(node.func, ast.Attribute):
+                tail = node.func.attr
+            elif isinstance(node.func, ast.Name):
+                tail = node.func.id
+            else:
+                continue
+            arg = node.args[0]
+            if tail == "incr":
+                recv = node.func.value if isinstance(
+                    node.func, ast.Attribute) else None
+                recv_call = (_dotted(recv.func) or "" if isinstance(
+                    recv, ast.Call) else "")
+                recv_tail = recv_call.rsplit(".", 1)[-1]
+                if recv_tail == "recovery_counters":
+                    out._add("recovery", arg)
+                elif recv_tail == "serving_counters":
+                    out._add("serving", arg)
+                else:
+                    out._add("counters", arg)
+            elif tail == "_count":
+                out._add("serving", arg)
+            elif tail in ("observe", "trace", "obs_trace", "record_span",
+                          "_observe_latency", "_observe"):
+                out._add("hists", arg)
+            elif tail in ("set_gauge", "update_gauge_max"):
+                out._add("gauges", arg)
+    return out
+
+
+def check_dead_declared(index: PackageIndex, emitted: EmittedNames,
+                        surfaces: dict) -> list[Finding]:
+    """TPU306 over `surfaces`: {surface: (declared names, where, what)}.
+    Split out from check() so tests can pin the rule against a fixture
+    package with synthetic declared sets."""
+    findings: list[Finding] = []
+    for surface, (declared, where, what) in sorted(surfaces.items()):
+        for name in sorted(set(declared)):
+            if emitted.emits(surface, name):
+                continue
+            findings.append(Finding(
+                "TPU306", where, 0,
+                f"{what} {name!r} is declared but never emitted by any "
+                "code path (dead telemetry — fix the emit site or "
+                "delete the declaration)",
+                ast_path=f"{surface}/{name}"))
+    return findings
 
 
 def collect_fault_sites(index: PackageIndex) -> dict[str, list]:
@@ -124,7 +238,6 @@ def check(index: PackageIndex, runbook_path: str | None = None,
     serving_names = set(registry.SERVING_COUNTER_NAMES)
 
     findings: list[Finding] = []
-    emitted_recovery: set = set()
 
     for mod in index.modules.values():
         rel = index.relpath(mod.path).replace(os.sep, "/")
@@ -205,7 +318,6 @@ def check(index: PackageIndex, runbook_path: str | None = None,
                             f"registry counter {name!r} is not in "
                             "DECLARED_COUNTERS"))
                 elif recv_tail == "recovery_counters":
-                    emitted_recovery.add(name)
                     if name not in recovery_names:
                         findings.append(make_finding(
                             index, "TPU303", mod.path, node.lineno,
@@ -269,13 +381,16 @@ def check(index: PackageIndex, runbook_path: str | None = None,
     # whole-package-only contracts: these compare the package against
     # its OWN declarations, which is meaningless for fixture packages
     if index.pkg_name == "tpu_ir":
-        # TPU303 (reverse direction): declared recovery counters no site
-        # emits — documentation describing telemetry that cannot happen
-        for name in sorted(recovery_names - emitted_recovery):
-            findings.append(Finding(
-                "TPU303", "tpu_ir/obs/registry.py", 0,
-                f"recovery counter {name!r} is declared but never "
-                "incremented anywhere in the package"))
+        # TPU306: declared-but-dead names over every surface (subsumes
+        # the old TPU303 reverse-direction recovery check)
+        reg_path = "tpu_ir/obs/registry.py"
+        findings += check_dead_declared(index, collect_emitted(index), {
+            "counters": (declared_counters, reg_path, "counter"),
+            "recovery": (recovery_names, reg_path, "recovery counter"),
+            "serving": (serving_names, reg_path, "serving counter"),
+            "hists": (declared_hists, reg_path, "histogram"),
+            "gauges": (declared_gauges, reg_path, "gauge"),
+        })
         # TPU305: ladder levels (frontend LEVEL_* constants) must equal
         # the registry's SERVICE_LEVELS — each level's request.<level>
         # histogram exists exactly when this holds

@@ -22,13 +22,14 @@ gate costs milliseconds, not a backend init.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 from dataclasses import dataclass, field
 
 from .astindex import PackageIndex
 
-BASELINE_VERSION = 1
+BASELINE_VERSION = 2
 
 # The rule catalog (DESIGN §10 renders this). Severity is advisory —
 # every un-baselined finding fails the gate; severity tells the reader
@@ -86,6 +87,87 @@ RULES: dict[str, tuple[str, str]] = {
                "span/histogram name not declared in DECLARED_HISTOGRAMS "
                "(latency surfaces must be total: serve-bench and metrics "
                "report the declared set, observed or not)"),
+    "TPU306": ("error",
+               "declared-but-dead registry name (a counter/histogram/"
+               "gauge in a DECLARED_* set that no code path ever emits — "
+               "documentation describing telemetry that cannot happen; "
+               "the inverse of TPU303)"),
+    # determinism & XLA-lowering hazards (lint/lowering.py, ISSUE 14)
+    "TPU401": ("error",
+               "einsum/dot_general contraction over the query batch axis "
+               "inside traced code (shape-dependent algorithm choice — "
+               "the coalesced==solo ulp class; allowlist a pinned "
+               "contraction with `# lint: reassoc-ok`)"),
+    "TPU402": ("error",
+               "top_k values subscripted while the indices element is "
+               "never read (XLA CPU rewrites the dead-index TopK into a "
+               "full variadic sort — ~50x; use a min-reduce)"),
+    "TPU403": ("warning",
+               "query-independent array expression recomputed on every "
+               "dispatch (operands are all load-time state — a loop-"
+               "invariant hoisting candidate; the strip-cache class)"),
+    "TPU404": ("error",
+               "float accumulation over a set/dict-view iteration inside "
+               "traced code (unordered source + non-associative addition "
+               "= cross-process drift)"),
+    "TPU405": ("warning",
+               "jnp.where/lax.select branches with different explicit "
+               "dtypes (silent backend-dependent upcast — cross-backend "
+               "ulp drift)"),
+    # shape universe (lint/shapeflow.py, ISSUE 14)
+    "TPU501": ("error",
+               "jit root reachable from the serving path whose argument "
+               "shape set is not provably closed over the precompile "
+               "universe (a statically-detected recompile storm)"),
+    "TPU502": ("error",
+               "precompile() variant walk misses a statically reachable "
+               "(rung, kernel-variant, scoring) combination — steady-"
+               "state serving would eat the compile the walk exists to "
+               "absorb"),
+    "TPU503": ("error",
+               "Python-level shape read deriving a NEW shape from a "
+               "query-batch value (.shape arithmetic fed to an array "
+               "constructor multiplies the compiled-shape universe)"),
+}
+
+# Per-rule remediation one-liners for `lint --json` consumers; a finding
+# may override with an instance-specific hint at construction.
+FIX_HINTS: dict[str, str] = {
+    "TPU101": "move the sync out of the traced closure, or mark the "
+              "argument static",
+    "TPU102": "use jax.lax.cond/jnp.where, or declare the argument "
+              "static",
+    "TPU103": "format host-side values only (or jax.debug.print)",
+    "TPU104": "add donate_argnums/donate_argnames for the updated "
+              "parameter",
+    "TPU201": "pick one global acquisition order and hold it everywhere",
+    "TPU202": "compute outside the lock, publish the result under it",
+    "TPU203": "move the IO out, or baseline with a reason if the lock "
+              "exists to serialize it",
+    "TPU204": "use an RLock, or split the locked region",
+    "TPU301": "declare the variable in utils/envvars.py and read it "
+              "through a typed accessor",
+    "TPU302": "declare/document the variable; regenerate the table with "
+              "`tpu-ir lint --env-table`",
+    "TPU303": "add the name to the matching DECLARED_*/…_NAMES set",
+    "TPU304": "add the site to obs.registry.FAULT_SITES",
+    "TPU305": "add the span to DECLARED_HISTOGRAMS",
+    "TPU306": "emit the declared name on its intended path, or delete "
+              "the declaration",
+    "TPU401": "rewrite as multiply + reduce over the contracted axis, "
+              "or `# lint: reassoc-ok (<why>)`",
+    "TPU402": "jnp.min(vals, axis=-1) for the k-th value, or consume "
+              "the indices",
+    "TPU403": "hoist to load time / cache per mode, or "
+              "`# lint: invariant-ok (<why>)`",
+    "TPU404": "iterate sorted() or reduce over an array with a fixed "
+              "axis order",
+    "TPU405": "cast both branches to one explicit dtype",
+    "TPU501": "pad the batch axis to a ladder rung / pow2 bucket before "
+              "dispatch (cf. Scorer._rung_dispatch)",
+    "TPU502": "extend the precompile walk to cover the combination",
+    "TPU503": "derive the shape from static config, not from a query "
+              "batch value",
 }
 
 
@@ -95,6 +177,12 @@ class Finding:
     file: str          # repo-relative, forward slashes
     line: int
     message: str
+    # stable AST-path anchor (e.g. "Scorer._rung_dispatch/@/w_hot"):
+    # line-move tolerant, refactor-friendlier than the message — the
+    # fingerprint hashes it when present, the message otherwise
+    ast_path: str = ""
+    # instance-specific remediation; falls back to the rule's FIX_HINTS
+    hint: str = ""
 
     @property
     def severity(self) -> str:
@@ -104,10 +192,21 @@ class Finding:
     def key(self) -> tuple:
         return (self.rule, self.file, self.message)
 
+    @property
+    def fingerprint(self) -> str:
+        raw = f"{self.rule}|{self.file}|{self.ast_path or self.message}"
+        return hashlib.sha1(raw.encode()).hexdigest()[:12]
+
+    @property
+    def fix_hint(self) -> str:
+        return self.hint or FIX_HINTS.get(self.rule, "")
+
     def to_dict(self) -> dict:
         return {"rule": self.rule, "severity": self.severity,
                 "file": self.file, "line": self.line,
-                "message": self.message}
+                "message": self.message,
+                "fingerprint": self.fingerprint,
+                "fix_hint": self.fix_hint}
 
     def __str__(self) -> str:
         return (f"{self.file}:{self.line}: {self.rule} "
@@ -115,9 +214,10 @@ class Finding:
 
 
 def make_finding(index: PackageIndex, rule: str, path: str, line: int,
-                 message: str) -> Finding:
+                 message: str, *, ast_path: str = "",
+                 fix_hint: str = "") -> Finding:
     return Finding(rule, index.relpath(path).replace(os.sep, "/"),
-                   line, message)
+                   line, message, ast_path=ast_path, hint=fix_hint)
 
 
 # -- baseline ---------------------------------------------------------------
@@ -126,55 +226,80 @@ def make_finding(index: PackageIndex, rule: str, path: str, line: int,
 @dataclass
 class Baseline:
     path: str | None = None
-    entries: dict[tuple, dict] = field(default_factory=dict)
+    # authoritative entry list — two v2 entries may share (rule, file,
+    # message) while carrying distinct fingerprints (same message, two
+    # AST sites), so entries are NOT keyed by message alone
+    rows: list = field(default_factory=list)
+    # version-2 entries carry a stable `fingerprint` (rule+file+ast-path
+    # hash) that matches even when a refactor rewrites the message
+    by_fingerprint: dict[str, dict] = field(default_factory=dict)
+    by_key: dict[tuple, list] = field(default_factory=dict)
 
     @classmethod
     def load(cls, path: str) -> "Baseline":
-        """Parse a baseline file. Raises ValueError on malformed content
-        (a usage error — exit 2 — not a finding)."""
+        """Parse a baseline file (schema v2, or v1 for compatibility —
+        v1 entries match on (rule, file, message) only). Raises
+        ValueError on malformed content (a usage error — exit 2 — not a
+        finding)."""
         with open(path, encoding="utf-8") as f:
             raw = json.load(f)
-        if not isinstance(raw, dict) or raw.get("version") != \
-                BASELINE_VERSION:
+        if not isinstance(raw, dict) or raw.get("version") not in (
+                1, BASELINE_VERSION):
             raise ValueError(
                 f"{path}: expected a baseline object with version="
-                f"{BASELINE_VERSION}")
+                f"{BASELINE_VERSION} (or the v1 compat schema)")
         out = cls(path=path)
         for e in raw.get("findings", []):
-            key = (e["rule"], e["file"], e["message"])
             e.setdefault("count", 1)
-            out.entries[key] = e
+            out.rows.append(e)
+            out.by_key.setdefault(
+                (e["rule"], e["file"], e["message"]), []).append(e)
+            if e.get("fingerprint"):
+                out.by_fingerprint[e["fingerprint"]] = e
         return out
 
     def filter(self, findings: list[Finding]) -> tuple[list, list]:
         """(un-baselined findings, stale baseline entries). A baseline
-        entry absorbs up to `count` identical findings; finding N+1 of a
-        grandfathered (rule, file, message) is NEW and reported."""
-        remaining = {k: e["count"] for k, e in self.entries.items()}
+        entry absorbs up to `count` matching findings — matched by
+        fingerprint when the entry has one (line- AND message-move
+        tolerant), falling back to (rule, file, message); finding N+1
+        of a grandfathered entry is NEW and reported."""
+        remaining = {id(e): e["count"] for e in self.rows}
         fresh: list[Finding] = []
         for f in sorted(findings, key=lambda f: (f.file, f.line, f.rule)):
-            if remaining.get(f.key, 0) > 0:
-                remaining[f.key] -= 1
+            e = self.by_fingerprint.get(f.fingerprint)
+            if e is None or remaining.get(id(e), 0) <= 0:
+                e = next((c for c in self.by_key.get(f.key, ())
+                          if remaining.get(id(c), 0) > 0), e)
+            if e is not None and remaining.get(id(e), 0) > 0:
+                remaining[id(e)] -= 1
             else:
                 fresh.append(f)
-        stale = [self.entries[k] for k, n in remaining.items()
-                 if n == self.entries[k]["count"]]
+        stale = [e for e in self.rows
+                 if remaining.get(id(e), 0) == e["count"]]
         return fresh, stale
 
     @staticmethod
     def render(findings: list[Finding], previous: "Baseline | None" = None,
                ) -> str:
-        """The serialized baseline for the current findings, with reasons
-        carried over from `previous` where the entry survives. New
-        entries get an explicit TODO reason — a reviewer must replace it."""
-        counts: dict[tuple, int] = {}
+        """The serialized v2 baseline for the current findings, with
+        reasons carried over from `previous` where the entry survives
+        (matched by fingerprint or key — a v1 file migrates to v2 with
+        its reasons intact). New entries get an explicit TODO reason —
+        a reviewer must replace it."""
+        groups: dict[tuple, list] = {}
         for f in findings:
-            counts[f.key] = counts.get(f.key, 0) + 1
-        old = previous.entries if previous else {}
+            groups.setdefault((f.fingerprint, *f.key), []).append(f)
         entries = []
-        for (rule, file, message), n in sorted(counts.items()):
-            e = {"rule": rule, "file": file, "message": message, "count": n}
-            prev = old.get((rule, file, message))
+        for (fp, rule, file, message), fs in sorted(groups.items()):
+            e = {"fingerprint": fp, "rule": rule, "file": file,
+                 "message": message, "count": len(fs)}
+            prev = None
+            if previous is not None:
+                prev = previous.by_fingerprint.get(fp) or next(
+                    (c for c in previous.by_key.get(
+                        (rule, file, message), ()) if c.get("reason")),
+                    None)
             e["reason"] = (prev.get("reason") if prev and prev.get("reason")
                            else "TODO: justify or fix before merging")
             entries.append(e)
@@ -185,13 +310,22 @@ class Baseline:
 # -- the runner -------------------------------------------------------------
 
 
+ALL_FAMILIES = ("jit", "concurrency", "contracts", "lowering",
+                "shapeflow")
+
+# families whose findings are PACKAGE-level contracts (registry drift,
+# shape-universe closure): `lint --diff` keeps these whole-package even
+# when per-file families are restricted to the changed set
+PACKAGE_LEVEL_RULES = ("TPU30", "TPU50")
+
+
 def run_lint(root: str, *, pkg_name: str = "tpu_ir",
              rel_root: str | None = None,
-             families: tuple = ("jit", "concurrency", "contracts"),
+             families: tuple = ALL_FAMILIES,
              ) -> list[Finding]:
     """Run the analyzer families over the package at `root` and return
     all findings (unfiltered — baseline handling is the caller's)."""
-    from . import concurrency, contracts, jit_hazards
+    from . import concurrency, contracts, jit_hazards, lowering, shapeflow
 
     index = PackageIndex(root, pkg_name=pkg_name, rel_root=rel_root)
     findings: list[Finding] = []
@@ -204,4 +338,8 @@ def run_lint(root: str, *, pkg_name: str = "tpu_ir",
         findings += concurrency.check(index)
     if "contracts" in families:
         findings += contracts.check(index)
+    if "lowering" in families:
+        findings += lowering.check(index)
+    if "shapeflow" in families:
+        findings += shapeflow.check(index)
     return sorted(findings, key=lambda f: (f.file, f.line, f.rule))

@@ -1,0 +1,497 @@
+"""Determinism & XLA-lowering hazard passes: TPU401-405 (ISSUE 14).
+
+The bit-exactness contracts (coalesced == solo, radix == legacy,
+blockmax on == off, distributed == serial) and the zero-steady-state-
+compile pin are enforced dynamically by fuzz pins and soak acceptances —
+but the last three PRs each shipped a violation class that is visible at
+parse time. This family is those classes as rules:
+
+- **TPU401 batch-shape-dependent contraction**: `einsum` / `dot_general`
+  / `jnp.dot` / `jnp.matmul` / the `@` operator inside traced code with
+  the QUERY BATCH axis in an operand. A dot_general's algorithm (fma
+  fusion, lane order) is chosen per SHAPE, so the same query row can
+  round differently at batch size 1 vs 4 — the PR 9 einsum ulp that
+  broke coalesced == solo. Allowlist a deliberate, dynamically-pinned
+  contraction with `# lint: reassoc-ok (<why>)` on the line.
+- **TPU402 sliced top_k values with dead indices**: subscripting the
+  VALUES of a `lax.top_k` whose indices tuple element is never read.
+  XLA CPU rewrites the TopK custom call into a full variadic sort when
+  the indices are dead and the values get sliced (measured 8 ms ->
+  410 ms on [64, 50001] — PR 13, DESIGN §17). The fix is a min-reduce
+  over the full values (`jnp.min(vals, axis=-1)` for the k-th).
+- **TPU403 per-dispatch recomputation of query-independent state**: an
+  assignment inside traced per-dispatch code whose RHS is an array
+  computation over load-time state only (no query/batch taint in any
+  operand) and whose result then meets query-tainted work. The class
+  behind PR 13's headline win (the O(H*D) strip weighting recomputed
+  per dispatch). Deliberate in-trace recomputes (e.g. an expression
+  shared bit-exactly with an explain variant) are allowlisted with
+  `# lint: invariant-ok (<why>)`.
+- **TPU404 unordered float accumulation**: a `+=`-style accumulation
+  inside traced code iterating a set / set(), frozenset(), or
+  `.keys()/.values()/.items()` view. Float addition is not associative;
+  an unordered iteration order is free to differ across processes and
+  versions, silently breaking distributed == serial.
+- **TPU405 dtype-mismatched select branches**: `jnp.where`/`lax.select`
+  whose two branches carry different EXPLICIT dtypes (`.astype`, dtype
+  constructors, dtype= kwargs). The silent upcast picks a backend- and
+  version-dependent promotion, drifting ulps across backends. Weak
+  Python scalars are exempt — JAX's weak typing keeps them latched to
+  the other branch's dtype.
+
+Query-vs-state coloring: batch-shape dependence and loop invariance
+both need to know which traced values carry the QUERY batch axis and
+which are load-time index state. The coloring seeds on the package's
+query-parameter naming convention (`q`, `q_terms`, `qg`, `texts`, ...)
+at traced functions and propagates interprocedurally through call-site
+arguments and local assignments — the same fixpoint discipline the
+jit-taint propagation uses. A convention, not an inference — but one
+the package holds everywhere, and fixtures pin both directions.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astindex import FuncInfo, PackageIndex, _dotted, refs_any
+from .core import Finding, make_finding
+
+# parameter names that carry the query batch axis (the package's naming
+# convention for per-request values; everything else traced is load-time
+# index state)
+QUERY_ROOT_NAMES = frozenset({
+    "q", "qb", "qd", "qg", "qp", "qs", "q_terms", "q_pad", "q_gram",
+    "queries", "query", "texts", "text", "cand", "cand_d", "candidates",
+})
+
+# contraction entry points whose lowering picks a shape-dependent
+# algorithm (all lower to dot_general)
+_CONTRACTIONS = ("einsum", "dot_general", "dot", "matmul", "tensordot",
+                 "vdot", "inner")
+
+_ARRAY_CTORS = ("zeros", "ones", "full", "empty", "arange")
+
+# explicit-dtype tails for TPU405 branch inference
+_DTYPE_NAMES = frozenset({
+    "float16", "float32", "float64", "bfloat16",
+    "int8", "int16", "int32", "int64",
+    "uint8", "uint16", "uint32", "uint64", "bool_",
+})
+
+
+def _looks_query(name: str) -> bool:
+    return name in QUERY_ROOT_NAMES or name.startswith("q_")
+
+
+class QueryColor:
+    """Per-function sets of names carrying the query batch axis.
+
+    Seeded from query-named parameters of jit-reachable functions, then
+    closed over (a) local assignments whose RHS references a colored
+    name and (b) package call sites passing a colored expression into a
+    callee parameter — a worklist fixpoint mirroring the index's jit
+    taint propagation, but tracking the query COLOR instead of
+    tracedness."""
+
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self._colored: dict[str, set] = {}   # fi.ref -> colored names
+        self._propagate()
+
+    def colored(self, fi: FuncInfo) -> frozenset:
+        names = set(self._colored.get(fi.ref, ()))
+        # closures see the enclosing traced frame's colored names
+        p = fi.parent
+        while p is not None:
+            names |= self._colored.get(p.ref, set())
+            p = p.parent
+        return frozenset(names)
+
+    def _local_close(self, fi: FuncInfo, colored: set) -> bool:
+        """Extend `colored` with locals assigned from colored
+        expressions (bounded fixpoint, same shape as local_taint)."""
+        stmts = [n for n in ast.walk(fi.node)
+                 if isinstance(n, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign, ast.For))]
+        grew = False
+        for _ in range(3):
+            changed = False
+            for node in stmts:
+                if isinstance(node, ast.For):
+                    value, targets = node.iter, [node.target]
+                else:
+                    value = getattr(node, "value", None)
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                if value is None or not refs_any(value, frozenset(colored)):
+                    continue
+                for t in targets:
+                    for n in ast.walk(t):
+                        if isinstance(n, ast.Name) and n.id not in colored:
+                            colored.add(n.id)
+                            changed = grew = True
+            if not changed:
+                break
+        return grew
+
+    def _propagate(self) -> None:
+        index = self.index
+        work: list[FuncInfo] = []
+        for mod in index.modules.values():
+            for fi in mod.functions.values():
+                seed = {p for p in (*fi.params, *fi.kwonly)
+                        if _looks_query(p)}
+                self._colored[fi.ref] = seed
+                if seed:
+                    work.append(fi)
+        while work:
+            fi = work.pop()
+            mod = index.modules[fi.module]
+            colored = self._colored[fi.ref]
+            self._local_close(fi, colored)
+            visible = set(self.colored(fi))
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                target = index.resolve_call(mod, fi, node)
+                if not isinstance(target, FuncInfo):
+                    continue
+                tgt_colored = self._colored.setdefault(target.ref, set())
+                params = target.params
+                off = 1 if params and params[0] in ("self", "cls") else 0
+                newly: set = set()
+                for i, arg in enumerate(node.args):
+                    if isinstance(arg, ast.Starred):
+                        break
+                    if i + off < len(params) and refs_any(
+                            arg, frozenset(visible)):
+                        newly.add(params[i + off])
+                known = set(params) | set(target.kwonly)
+                for kw in node.keywords:
+                    if kw.arg and kw.arg in known and refs_any(
+                            kw.value, frozenset(visible)):
+                        newly.add(kw.arg)
+                if not newly <= tgt_colored:
+                    tgt_colored |= newly
+                    work.append(target)
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    color = QueryColor(index)
+    findings: list[Finding] = []
+    for mod in index.modules.values():
+        for fi in mod.functions.values():
+            if not fi.jit_reachable:
+                continue
+            colored = color.colored(fi)
+            findings += _check_contractions(index, mod, fi, colored)
+            findings += _check_topk_slices(index, mod, fi)
+            findings += _check_invariants(index, mod, fi, colored)
+            findings += _check_unordered_accum(index, mod, fi)
+            findings += _check_select_dtypes(index, mod, fi)
+    return findings
+
+
+def _own_statements(fi: FuncInfo):
+    stack = list(ast.iter_child_nodes(fi.node))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+# -- TPU401 -----------------------------------------------------------------
+
+
+def _is_contraction(index, mod, node: ast.Call) -> str | None:
+    target = index.normalize(mod, node.func)
+    name = target if isinstance(target, str) else None
+    if name is None:
+        return None
+    tail = name.rsplit(".", 1)[-1]
+    if tail in _CONTRACTIONS and (
+            name.startswith("jax.") or name.startswith("numpy.")
+            or name == tail):
+        return tail
+    return None
+
+
+def _check_contractions(index, mod, fi, colored) -> list[Finding]:
+    out: list[Finding] = []
+    where = f"in jit-traced {fi.qual}()"
+    for node in _own_statements(fi):
+        hit = op = None
+        if isinstance(node, ast.Call):
+            op = _is_contraction(index, mod, node)
+            if op:
+                argv = (*node.args, *(k.value for k in node.keywords))
+                hit = next((h for a in argv
+                            for h in [refs_any(a, colored)] if h), None)
+        elif isinstance(node, ast.BinOp) and isinstance(
+                node.op, ast.MatMult):
+            op = "@"
+            hit = refs_any(node.left, colored) or refs_any(
+                node.right, colored)
+        if op and hit:
+            if mod.suppressed(node.lineno, "reassoc-ok"):
+                continue
+            out.append(make_finding(
+                index, "TPU401", fi.path, node.lineno,
+                f"{op} contraction over the query batch axis ({hit!r}) "
+                f"{where} — dot_general's algorithm is chosen per shape, "
+                "so results can differ between batch sizes (the "
+                "coalesced == solo ulp class)",
+                ast_path=f"{fi.qual}/{op}/{hit}",
+                fix_hint="rewrite as an explicit multiply + reduce over "
+                         "the contracted axis (batch-size-invariant "
+                         "rounding), or annotate the line with "
+                         "`# lint: reassoc-ok (<why the pin holds>)`"))
+    return out
+
+
+# -- TPU402 -----------------------------------------------------------------
+
+
+def _is_topk(index, mod, node: ast.Call) -> bool:
+    target = index.normalize(mod, node.func)
+    return isinstance(target, str) and \
+        target.rsplit(".", 1)[-1] == "top_k"
+
+
+def _check_topk_slices(index, mod, fi) -> list[Finding]:
+    out: list[Finding] = []
+
+    def hazard(line: int, how: str) -> None:
+        if mod.suppressed(line, "topk-slice-ok"):
+            return
+        out.append(make_finding(
+            index, "TPU402", fi.path, line,
+            f"top_k values {how} while the indices element is never "
+            f"read in {fi.qual}() — XLA CPU rewrites the dead-index "
+            "TopK into a full variadic sort (~50x at serving widths)",
+            ast_path=f"{fi.qual}/top_k_slice",
+            fix_hint="read the k-th value as a min-reduce over the full "
+                     "values (`jnp.min(vals, axis=-1)`), or consume the "
+                     "indices so TopK survives lowering"))
+
+    # direct form: top_k(...)[0][...] — the indices are unreachable
+    for node in _own_statements(fi):
+        if not (isinstance(node, ast.Subscript)
+                and isinstance(node.value, ast.Subscript)
+                and isinstance(node.value.value, ast.Call)
+                and _is_topk(index, mod, node.value.value)):
+            continue
+        sel = node.value.slice
+        if isinstance(sel, ast.Constant) and sel.value == 0:
+            hazard(node.lineno, "subscripted (top_k(...)[0][...])")
+
+    # unpack form: vals, idx = top_k(...); vals[...] with idx never read
+    unpacks: list[tuple] = []
+    for node in _own_statements(fi):
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Tuple)
+                and len(node.targets[0].elts) == 2
+                and isinstance(node.value, ast.Call)
+                and _is_topk(index, mod, node.value)):
+            continue
+        v, i = node.targets[0].elts
+        if isinstance(v, ast.Name) and isinstance(i, ast.Name):
+            unpacks.append((v.id, i.id, node.lineno))
+    for vals_name, idx_name, line in unpacks:
+        # reads anywhere in the function INCLUDING nested closures — the
+        # indices are alive if any inner def consumes them
+        idx_read = any(
+            isinstance(n, ast.Name) and n.id == idx_name
+            and isinstance(n.ctx, ast.Load)
+            for n in ast.walk(fi.node))
+        if idx_read:
+            continue
+        for n in ast.walk(fi.node):
+            if isinstance(n, ast.Subscript) and isinstance(
+                    n.value, ast.Name) and n.value.id == vals_name:
+                hazard(n.lineno,
+                       f"sliced ({vals_name}[...] with {idx_name} dead)")
+                break
+    return out
+
+
+# -- TPU403 -----------------------------------------------------------------
+
+
+def _check_invariants(index, mod, fi, colored) -> list[Finding]:
+    """Assignments whose RHS is an array computation over load-time
+    state only, inside a function that ALSO processes query-colored
+    values (a per-dispatch function), where the invariant result later
+    meets query work. Reported as hoisting candidates."""
+    if not colored:
+        return []          # not a per-dispatch function
+    tainted = index.local_taint(fi)
+    state = frozenset(tainted - colored)
+    if not state:
+        return []
+    out: list[Finding] = []
+    for node in _own_statements(fi):
+        if not isinstance(node, ast.Assign) or not isinstance(
+                node.value, ast.Call):
+            continue
+        target = index.resolve_call(mod, fi, node.value)
+        tail = target.rsplit(".", 1)[-1] if isinstance(target, str) \
+            else ""
+        if isinstance(target, FuncInfo):
+            tail = target.name
+        if tail in ("partial", *_ARRAY_CTORS) or (
+                isinstance(target, str)
+                and index._is_jit_wrapper(mod, target)) or \
+                tail in ("jit", "pjit", "shard_map", "profiled_jit"):
+            # fn = shard_map(partial(...)) wraps a kernel — it is not a
+            # recomputed array value
+            continue
+        is_array_call = (
+            isinstance(target, str)
+            and (target.startswith("jax.numpy.")
+                 or target.startswith("jax.lax."))
+        ) or (isinstance(target, FuncInfo) and target.jit_reachable)
+        if not is_array_call:
+            continue
+        if refs_any(node.value, colored):
+            continue                       # query-dependent: real work
+        hit = refs_any(node.value, state)
+        if hit is None:
+            continue                       # constants only: trivial
+        names = [n.id for t in node.targets for n in ast.walk(t)
+                 if isinstance(n, ast.Name)]
+        meets_query = any(
+            isinstance(n, ast.Name) and n.id in names
+            and refs_any(stmt, colored)
+            for stmt in _own_statements(fi) if stmt is not node
+            for n in ast.walk(stmt))
+        if not meets_query:
+            continue
+        if mod.suppressed(node.lineno, "invariant-ok"):
+            continue
+        out.append(make_finding(
+            index, "TPU403", fi.path, node.lineno,
+            f"query-independent array expression over {hit!r} is "
+            f"recomputed on every dispatch of {fi.qual}() (operands are "
+            "all load-time state — the per-dispatch strip-weighting "
+            "class)",
+            ast_path=f"{fi.qual}/invariant/{names[0] if names else hit}",
+            fix_hint="hoist to load time / cache per mode (cf. the "
+                     "TPU_IR_BLOCKMAX_STRIP_CACHE fix), or annotate "
+                     "with `# lint: invariant-ok (<why in-trace>)`"))
+    return out
+
+
+# -- TPU404 -----------------------------------------------------------------
+
+
+def _unordered_iter(index, mod, fi, node: ast.AST) -> str | None:
+    """A human tag when `node` iterates an unordered source."""
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "a set literal"
+    if isinstance(node, ast.Call):
+        target = index.resolve_call(mod, fi, node)
+        if isinstance(target, str):
+            tail = target.rsplit(".", 1)[-1]
+            if target in ("set", "frozenset"):
+                return f"{target}()"
+            if tail in ("keys", "values", "items") and \
+                    target.startswith("*."):
+                return f".{tail}() view"
+    return None
+
+
+def _check_unordered_accum(index, mod, fi) -> list[Finding]:
+    out: list[Finding] = []
+    for node in _own_statements(fi):
+        src = None
+        if isinstance(node, ast.For):
+            src = _unordered_iter(index, mod, fi, node.iter)
+            accum = src and any(
+                isinstance(n, ast.AugAssign) and isinstance(
+                    n.op, (ast.Add, ast.Sub))
+                for n in ast.walk(node))
+        elif isinstance(node, ast.Call) and isinstance(
+                node.func, ast.Name) and node.func.id == "sum" \
+                and node.args:
+            a = node.args[0]
+            inner = a.generators[0].iter if isinstance(
+                a, ast.GeneratorExp) and a.generators else a
+            src = _unordered_iter(index, mod, fi, inner)
+            accum = src is not None
+        else:
+            continue
+        if src and accum:
+            if mod.suppressed(node.lineno, "unordered-ok"):
+                continue
+            out.append(make_finding(
+                index, "TPU404", fi.path, node.lineno,
+                f"float accumulation over {src} in jit-traced "
+                f"{fi.qual}() — iteration order is not guaranteed, and "
+                "float addition is not associative (distributed == "
+                "serial drift)",
+                ast_path=f"{fi.qual}/unordered_accum",
+                fix_hint="iterate a sorted() view or accumulate through "
+                         "an array reduction with a fixed axis order"))
+    return out
+
+
+# -- TPU405 -----------------------------------------------------------------
+
+
+def _strong_dtype(index, mod, node: ast.AST) -> str | None:
+    """The explicit dtype of a branch expression, or None (weak/unknown).
+    Recognized: `.astype(D)`, dtype constructors (`jnp.float32(x)`),
+    and `dtype=D` kwargs on array calls."""
+    if not isinstance(node, ast.Call):
+        return None
+    if isinstance(node.func, ast.Attribute) and node.func.attr == "astype" \
+            and node.args:
+        d = _dotted(node.args[0])
+        if d:
+            tail = d.rsplit(".", 1)[-1]
+            if tail in _DTYPE_NAMES:
+                return tail
+    target = index.normalize(mod, node.func)
+    if isinstance(target, str):
+        tail = target.rsplit(".", 1)[-1]
+        if tail in _DTYPE_NAMES:
+            return tail
+    for kw in node.keywords:
+        if kw.arg == "dtype":
+            d = _dotted(kw.value)
+            if d and d.rsplit(".", 1)[-1] in _DTYPE_NAMES:
+                return d.rsplit(".", 1)[-1]
+    return None
+
+
+def _check_select_dtypes(index, mod, fi) -> list[Finding]:
+    out: list[Finding] = []
+    for node in _own_statements(fi):
+        if not isinstance(node, ast.Call) or len(node.args) < 3:
+            continue
+        target = index.normalize(mod, node.func)
+        if not isinstance(target, str):
+            continue
+        tail = target.rsplit(".", 1)[-1]
+        if tail not in ("where", "select"):
+            continue
+        if not (target.startswith("jax.") or target == tail):
+            continue
+        d1 = _strong_dtype(index, mod, node.args[1])
+        d2 = _strong_dtype(index, mod, node.args[2])
+        if d1 and d2 and d1 != d2:
+            if mod.suppressed(node.lineno, "mixed-select-ok"):
+                continue
+            out.append(make_finding(
+                index, "TPU405", fi.path, node.lineno,
+                f"{tail}() branches carry different explicit dtypes "
+                f"({d1} vs {d2}) in jit-traced {fi.qual}() — the silent "
+                "upcast promotes by backend-dependent rules (cross-"
+                "backend ulp drift)",
+                ast_path=f"{fi.qual}/select/{d1}:{d2}",
+                fix_hint=f"cast both branches to one dtype explicitly "
+                         f"(pick {d1} or {d2}) before the select"))
+    return out

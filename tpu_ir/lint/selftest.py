@@ -1,0 +1,284 @@
+"""`tpu-ir lint --self-test`: seeded positive/negative fixtures per rule.
+
+Mirrors bench-check's `--self-test` (obs/bench_check.py): before trusting
+the gate, prove the gate can still catch what it claims to catch. Each
+fixture is a minimal package source; a POSITIVE must fire its rule, a
+NEGATIVE must stay silent. The tier-1 conftest runs this once per
+session, so a refactor that lobotomizes a pass (a rule that silently
+stops matching) fails CI even while the self-check over the (clean)
+shipped package would keep passing.
+
+The fixtures live here — not in tests/ — so the CLI flag works in any
+checkout, and tests/test_lint_hazards.py reuses them as its seed corpus.
+"""
+
+from __future__ import annotations
+
+import os
+import tempfile
+import textwrap
+
+# (rule, name, should_fire, source) — sources are whole fixture modules
+FIXTURES: list[tuple] = [
+    ("TPU401", "einsum-batch", True, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q_terms, strip):
+            w = strip[q_terms]
+            return jnp.einsum("blc,bl->bc", w, q_terms * 1.0)
+    """),
+    ("TPU401", "matmul-batch", True, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q_terms, strip):
+            w_hot = q_terms * 1.0
+            return w_hot @ strip
+    """),
+    ("TPU401", "mul-reduce", False, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q_terms, strip):
+            rows = strip[q_terms]
+            return jnp.sum(rows * (q_terms * 1.0)[:, :, None], axis=1)
+    """),
+    ("TPU401", "allowlisted", False, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q_terms, strip):
+            w_hot = q_terms * 1.0
+            # lint: reassoc-ok (pinned dynamically by the parity suite)
+            return w_hot @ strip
+    """),
+    ("TPU402", "sliced-dead-indices", True, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(scores, k):
+            vals, idx = jax.lax.top_k(scores, k)
+            return vals[:, -1]
+    """),
+    ("TPU402", "direct-slice", True, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(scores, k):
+            return jax.lax.top_k(scores, k)[0][:, -1]
+    """),
+    ("TPU402", "min-reduce-fix", False, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(scores, k):
+            return jnp.min(jax.lax.top_k(scores, k)[0], axis=1)
+    """),
+    ("TPU402", "indices-used", False, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(scores, k):
+            vals, idx = jax.lax.top_k(scores, k)
+            return vals[:, -1], idx
+    """),
+    ("TPU403", "invariant-recompute", True, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q_terms, df):
+            idf = jnp.log(1.0 + df)
+            return idf[q_terms]
+    """),
+    ("TPU403", "query-dependent", False, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q_terms, df):
+            w = jnp.log(1.0 + df[q_terms])
+            return w
+    """),
+    ("TPU404", "set-accumulation", True, """
+        import jax
+
+        @jax.jit
+        def kernel(x, weights):
+            total = 0.0
+            for w in set(weights):
+                total += w
+            return x * total
+    """),
+    ("TPU404", "sorted-accumulation", False, """
+        import jax
+
+        @jax.jit
+        def kernel(x, weights):
+            total = 0.0
+            for w in sorted(set(weights)):
+                total += w
+            return x * total
+    """),
+    ("TPU405", "mixed-select", True, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(mask, x):
+            return jnp.where(mask, x.astype(jnp.float32),
+                             jnp.int32(0))
+    """),
+    ("TPU405", "uniform-select", False, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(mask, x):
+            return jnp.where(mask, x.astype(jnp.float32),
+                             jnp.float32(0))
+    """),
+    ("TPU501", "off-ladder-dispatch", True, """
+        import jax
+        import numpy as np
+
+        LADDER = (1, 4, 16, 64)
+
+        @jax.jit
+        def kernel(q):
+            return q.sum()
+
+        def serve(texts):
+            q = np.full((17, 8), -1, np.int32)
+            return kernel(q)
+    """),
+    ("TPU501", "unbounded-dispatch", True, """
+        import jax
+        import numpy as np
+
+        LADDER = (1, 4, 16, 64)
+
+        @jax.jit
+        def kernel(q):
+            return q.sum()
+
+        def serve(texts):
+            q = np.full((len(texts), 8), -1, np.int32)
+            return kernel(q)
+    """),
+    ("TPU501", "rung-padded-dispatch", False, """
+        import jax
+        import numpy as np
+
+        LADDER = (1, 4, 16, 64)
+
+        @jax.jit
+        def kernel(q):
+            return q.sum()
+
+        def serve(texts):
+            b = len(texts)
+            pad = next((r for r in LADDER if r >= b), b)
+            q = np.full((pad, 8), -1, np.int32)
+            return kernel(q)
+    """),
+    ("TPU502", "unwarmed-variant", True, """
+        import numpy as np
+
+        class Sched:
+            def __init__(self, scorer, ladder=(1, 4)):
+                self._scorer = scorer
+                self._ladder = tuple(ladder)
+
+            def precompile(self, scorings=("tfidf",)):
+                block = 8
+                for rows in sorted({min(r, block) for r in self._ladder}):
+                    q = np.full((rows, 8), -1, np.int32)
+                    self._scorer._topk_device(q, 10, "tfidf")
+
+            def _execute(self, slots):
+                q = np.full((4, 8), -1, np.int32)
+                return self._scorer._topk_device(q, 10, "tfidf",
+                                                 skip_hot=True)
+    """),
+    ("TPU502", "warmed-variants", False, """
+        import numpy as np
+
+        class Sched:
+            def __init__(self, scorer, ladder=(1, 4)):
+                self._scorer = scorer
+                self._ladder = tuple(ladder)
+
+            def precompile(self, scorings=("tfidf",)):
+                block = 8
+                variants = [{}, {"skip_hot": True}]
+                for rows in sorted({min(r, block) for r in self._ladder}):
+                    q = np.full((rows, 8), -1, np.int32)
+                    for kw in variants:
+                        self._scorer._topk_device(q, 10, "tfidf", **kw)
+
+            def _execute(self, slots):
+                q = np.full((4, 8), -1, np.int32)
+                return self._scorer._topk_device(q, 10, "tfidf",
+                                                 skip_hot=True)
+    """),
+    ("TPU503", "derived-shape", True, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q):
+            b = q.shape[0]
+            pad = jnp.zeros((2 * b, 4))
+            return pad
+    """),
+    ("TPU503", "identity-shape", False, """
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def kernel(q):
+            b = q.shape[0]
+            return jnp.zeros((b, 4))
+    """),
+]
+
+
+def run_fixture(rule: str, source: str, tmp: str, name: str) -> list:
+    """Lint one fixture source as its own package; returns findings."""
+    from .core import run_lint
+
+    pkg = os.path.join(tmp, f"fix_{name.replace('-', '_')}")
+    os.makedirs(pkg, exist_ok=True)
+    with open(os.path.join(pkg, "__init__.py"), "w") as f:
+        f.write("")
+    with open(os.path.join(pkg, "mod.py"), "w") as f:
+        f.write(textwrap.dedent(source))
+    return run_lint(pkg, pkg_name=os.path.basename(pkg), rel_root=tmp)
+
+
+def run_selftest() -> list[str]:
+    """Run every fixture; returns human-readable failure lines (empty =
+    the analyzers still catch what they claim to catch)."""
+    failures: list[str] = []
+    with tempfile.TemporaryDirectory(prefix="tpu_ir_lint_selftest_") \
+            as tmp:
+        for rule, name, should_fire, source in FIXTURES:
+            findings = run_fixture(rule, source, tmp, f"{rule}_{name}")
+            fired = any(f.rule == rule for f in findings)
+            if fired != should_fire:
+                got = sorted({f.rule for f in findings}) or ["nothing"]
+                failures.append(
+                    f"{rule}/{name}: expected "
+                    f"{'a finding' if should_fire else 'silence'}, got "
+                    f"{', '.join(got)}")
+    return failures

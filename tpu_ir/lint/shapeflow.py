@@ -1,0 +1,1027 @@
+"""Shape-universe flow analysis: TPU501-503 (ISSUE 14).
+
+The zero-recompile serving contract (PR 9) says: once `precompile()` has
+walked the rung ladder, steady-state serving never hands XLA a shape it
+has not already compiled. The contract is enforced at runtime by the
+`compile.count == 0` soak pin — which COUNTS storms after they happen.
+This pass proves the property statically, by propagating the static
+shape facts the serving stack is built from:
+
+- the rung-ladder constants (`TPU_IR_BATCH_LADDER` parsing, or any
+  module-level `*LADDER*` tuple literal),
+- the `SCORE_BUDGET` dispatch-block cap (`_block_size()`),
+- the pow2 width bucketing (`1 << (w - 1).bit_length()`),
+- `pad_to` / `width_floor` call-site facts,
+
+from the serving entry points (`CoalescingScheduler._execute`,
+`precompile`, top-level `serve*` functions) through every
+host-side dispatch function into each `profiled_jit` root, as an
+abstract value per batch axis:
+
+    fact ::= {rung} | {block} | {pow2} | {width} | {ladder}
+           | {const(n)} | unions thereof | ? (unknown)
+
+A jit-root call site whose query-batch argument carries `?` — or a
+constant outside the ladder — is **TPU501**: a shape XLA will see that
+the precompile universe cannot contain. **TPU502** checks the other
+side structurally: the `precompile()` walk itself must cover every
+ladder rung (capped at the dispatch block) and every statically
+reachable kernel-variant combination (`skip_hot`/`hot_only`/...) and
+scoring model that serving dispatch sites can request. **TPU503** flags
+Python-level `.shape[i]` arithmetic on a query-batch value feeding an
+array constructor inside traced code — each distinct input shape then
+mints a NEW derived shape, multiplying the compiled universe.
+
+Conservatism and the trusted idioms. The transfer rules cover exactly
+the package's shape-closing idioms — `np.full((rows, w))`, the
+pad-up-to-rung `vstack`, block-sized slices `a[i:i+block]`,
+`next((r for r in rungs if r >= b), b)`, pow2 bucketing — and join
+everything else to `?`. Two idioms are trusted rather than proven
+relationally (both are guarded by the runtime pin this pass
+cross-checks): the pad-up guard (`if pad_to > len(q)` — the fallthrough
+is certified equal to the rung by the coalescer's occupancy cap) and
+its `pad_to <= b` twin in `_rung_dispatch`. Branch joins are
+last-write-wins in source order, which deliberately lets the padded
+branch win.
+
+Scope: modules whose name matches `_EXEMPT` (explain/doctor/telemetry/
+load paths — sampled forensics and one-shot load dispatches, off the
+steady-state contract) are neither propagated into nor audited.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astindex import FuncInfo, PackageIndex, _dotted, refs_any
+from .core import Finding, make_finding
+from .lowering import QueryColor
+
+UNK: frozenset = frozenset({"?"})
+
+_EXEMPT = ("explain", "doctor", "querylog", "bench", "obs", "lint",
+           "faults", "transfer", "compat", "cli", "soak")
+
+# kernel-variant axes the precompile walk must cover (TPU502)
+_VARIANT_KWS = ("skip_hot", "hot_only", "skip_cold")
+
+_CTORS = ("full", "zeros", "ones", "empty")
+_PASSTHROUGH = ("asarray", "ascontiguousarray", "array", "sorted",
+                "tuple", "list", "set", "frozenset", "reversed")
+
+
+def _const(n) -> frozenset:
+    return frozenset({("const", n)})
+
+
+def _closed(fact) -> bool:
+    return bool(fact) and "?" not in fact
+
+
+def _join(*facts) -> frozenset:
+    out: set = set()
+    for f in facts:
+        if f is None or not isinstance(f, frozenset) or not f:
+            return UNK
+        out |= f
+    return frozenset(out)
+
+
+def _is_arr(fact) -> bool:
+    return isinstance(fact, tuple) and len(fact) == 3 and fact[0] == "arr"
+
+
+def _arr(rows, width=UNK):
+    return ("arr", rows if rows else UNK, width if width else UNK)
+
+
+class ShapeFlow:
+    def __init__(self, index: PackageIndex):
+        self.index = index
+        self.findings: list[Finding] = []
+        self.rung_values: set = set()
+        self.module_env: dict[str, dict] = {}
+        self.class_attrs: dict[tuple, object] = {}
+        self.param_facts: dict[str, dict] = {}
+        self.envs: dict[str, dict] = {}
+        # (fi.ref, name) -> {key: target} — targets are FuncInfos or
+        # ("lam", node, owner) triples, keyed hashably by ref / node id
+        self.bindings: dict[tuple, dict] = {}
+        self.ret_facts: dict[str, object] = {}
+        self.callers: dict[str, dict] = {}     # ref -> {ref: FuncInfo}
+        self._prepass = False
+        self._len_of: dict[tuple, str] = {}    # (fi.ref, int name) -> arr
+        self._audited: set = set()
+        self._work: list[FuncInfo] = []
+        self._queued: set = set()
+        self._methods: dict[str, list] = {}
+        for mod in index.modules.values():
+            for cls, meths in mod.classes.items():
+                for name, f in meths.items():
+                    self._methods.setdefault(name, []).append(f)
+        self._scan_constants()
+        self._scan_class_attrs()
+
+    # -- constant / seed scanning -----------------------------------------
+
+    def _scan_constants(self) -> None:
+        """Rung-ladder constants: the TPU_IR_BATCH_LADDER declaration
+        default in the env registry, plus any module-level `*LADDER*`
+        tuple-of-ints literal (the fixture form)."""
+        for mod in self.index.modules.values():
+            env = self.module_env.setdefault(mod.modname, {})
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Name) and node.func.id == "_declare" \
+                        and len(node.args) >= 3:
+                    name = node.args[0]
+                    if isinstance(name, ast.Constant) and \
+                            name.value == "TPU_IR_BATCH_LADDER" and \
+                            isinstance(node.args[2], ast.Constant):
+                        for p in str(node.args[2].value).split(","):
+                            if p.strip().isdigit():
+                                self.rung_values.add(int(p))
+                elif isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Tuple) and all(
+                        isinstance(e, ast.Constant) and isinstance(
+                            e.value, int) for e in node.value.elts):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name) and "LADDER" in t.id:
+                            env[t.id] = frozenset({"ladder"})
+                            for e in node.value.elts:
+                                self.rung_values.add(e.value)
+
+    def _seed_name(self, name: str) -> frozenset | None:
+        """Name-convention recognizers (documented in the module
+        docstring): ladder-named values are rung collections, `pad_to`
+        is a rung, width-named values are the pinned width."""
+        if "ladder" in name or name == "rungs":
+            return frozenset({"ladder"})
+        if name == "pad_to":
+            return frozenset({"rung"})
+        if name in ("width_floor", "width"):
+            return frozenset({"width"})
+        return None
+
+    def _scan_class_attrs(self) -> None:
+        """`self.X = ...` facts per class, evaluated with the seed
+        recognizers only (enough for `_ladder`/`_width`)."""
+        self._prepass = True
+        for mod in self.index.modules.values():
+            for fi in mod.functions.values():
+                if fi.cls is None:
+                    continue
+                env = {p: self._seed_name(p) or UNK for p in fi.params}
+                for node in ast.walk(fi.node):
+                    if not isinstance(node, ast.Assign):
+                        continue
+                    for t in node.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == "self"):
+                            fact = self._eval(fi, env, node.value)
+                            if not _is_arr(fact) and _closed(fact):
+                                key = (f"{fi.module}.{fi.cls}", t.attr)
+                                old = self.class_attrs.get(key)
+                                self.class_attrs[key] = fact if old is \
+                                    None else _join(old, fact)
+        self._prepass = False
+
+    # -- the engine --------------------------------------------------------
+
+    def _exempt(self, fi: FuncInfo) -> bool:
+        tail = fi.module.rsplit(".", 1)[-1]
+        return any(s in tail for s in _EXEMPT) or any(
+            f".{s}" in fi.module for s in ("obs", "lint"))
+
+    def entries(self) -> list[FuncInfo]:
+        out = []
+        for mod in self.index.modules.values():
+            for fi in mod.functions.values():
+                if fi.parent is not None:
+                    continue
+                if fi.cls and fi.name in ("_execute", "precompile"):
+                    out.append(fi)
+                elif fi.cls is None and fi.name.startswith("serve"):
+                    out.append(fi)
+        return out
+
+    def run(self) -> list[Finding]:
+        for fi in self.entries():
+            self._enqueue(fi)
+        steps = 0
+        while self._work and steps < 20000:
+            steps += 1
+            fi = self._work.pop()
+            self._queued.discard(fi.ref)
+            self._eval_function(fi)
+        return self.findings
+
+    def _enqueue(self, fi: FuncInfo) -> None:
+        if fi.ref not in self._queued:
+            self._queued.add(fi.ref)
+            self._work.append(fi)
+
+    def _eval_function(self, fi: FuncInfo) -> None:
+        env: dict = {}
+        defaults = list(getattr(fi.node.args, "defaults", []))
+        dparams = fi.params[len(fi.params) - len(defaults):] \
+            if defaults else []
+        for p in fi.params:
+            fact = self.param_facts.get(fi.ref, {}).get(p)
+            if fact is None:
+                fact = self._seed_name(p)
+            if fact is None and p in dparams:
+                fact = self._eval(fi, env, defaults[dparams.index(p)])
+            env[p] = fact if fact is not None else UNK
+        for p in fi.kwonly:
+            fact = self.param_facts.get(fi.ref, {}).get(p) \
+                or self._seed_name(p)
+            env[p] = fact if fact is not None else UNK
+        vararg = getattr(fi.node.args, "vararg", None)
+        if vararg is not None:
+            fact = self.param_facts.get(fi.ref, {}).get(vararg.arg)
+            env[vararg.arg] = fact if fact is not None else UNK
+        self.envs[fi.ref] = env
+        ret: object = None
+        for stmt in fi.node.body:
+            r = self._walk(fi, env, stmt)
+            if r is None:
+                continue
+            ret = r if ret is None else self._merge(ret, r)
+        if ret is not None and self.ret_facts.get(fi.ref) != ret:
+            self.ret_facts[fi.ref] = ret
+            for caller in self.callers.get(fi.ref, {}).values():
+                self._enqueue(caller)
+
+    def _walk(self, fi, env, node) -> object:
+        """Evaluate one statement; returns a join-able return fact when
+        the subtree returns. Last-write-wins envs, the documented
+        branch-join choice."""
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            return None
+        if isinstance(node, ast.Return):
+            return self._eval(fi, env, node.value) \
+                if node.value is not None else None
+        if isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = getattr(node, "value", None)
+            if value is None:
+                return None
+            fact = self._eval(fi, env, value)
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                self._bind_target(fi, env, t, fact, value)
+            return None
+        if isinstance(node, ast.Expr):
+            self._eval(fi, env, node.value)
+            return None
+        if isinstance(node, ast.For):
+            it = self._eval(fi, env, node.iter)
+            self._bind_target(fi, env, node.target,
+                              self._element_of(it), node.iter)
+            ret = None
+            for child in (*node.body, *node.orelse):
+                r = self._walk(fi, env, child)
+                ret = r if r is not None else ret
+            return ret
+        if isinstance(node, ast.If):
+            self._refine_guard(fi, env, node.test)
+            ret = None
+            for child in (*node.body, *node.orelse):
+                r = self._walk(fi, env, child)
+                ret = r if r is not None else ret
+            return ret
+        if isinstance(node, (ast.With, ast.While, ast.Try)):
+            for attr in ("items", "test"):
+                sub = getattr(node, attr, None)
+                if isinstance(sub, list):
+                    for item in sub:
+                        self._eval(fi, env, item.context_expr)
+                elif sub is not None:
+                    self._eval(fi, env, sub)
+            ret = None
+            for child in (*getattr(node, "body", []),
+                          *getattr(node, "orelse", []),
+                          *getattr(node, "finalbody", []),
+                          *[s for h in getattr(node, "handlers", [])
+                            for s in h.body]):
+                r = self._walk(fi, env, child)
+                ret = r if r is not None else ret
+            return ret
+        if isinstance(node, (ast.Raise, ast.Assert)):
+            return None
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._eval(fi, env, child)
+        return None
+
+    def _bind_target(self, fi, env, target, fact, value) -> None:
+        if isinstance(target, ast.Name):
+            env[target.id] = fact
+            # record `b = len(q)` / `b = q.shape[0]` links for the
+            # trusted guard refinements
+            src = None
+            if isinstance(value, ast.Call) and isinstance(
+                    value.func, ast.Name) and value.func.id == "len" \
+                    and value.args and isinstance(value.args[0], ast.Name):
+                src = value.args[0].id
+            elif isinstance(value, ast.Subscript) and isinstance(
+                    value.value, ast.Attribute) and \
+                    value.value.attr == "shape" and isinstance(
+                    value.value.value, ast.Name):
+                src = value.value.value.id
+            if src is not None:
+                self._len_of[(fi.ref, target.id)] = src
+            # FuncInfo-valued assignment: a dispatch-closure binding
+            tgt = self._callable_targets(fi, value)
+            if tgt:
+                self.bindings.setdefault((fi.ref, target.id),
+                                         {}).update(tgt)
+        elif isinstance(target, ast.Tuple) and target.elts:
+            first = target.elts[0]
+            if isinstance(first, ast.Name):
+                env[first.id] = fact
+            for other in target.elts[1:]:
+                if isinstance(other, ast.Name):
+                    env[other.id] = UNK
+
+    def _element_of(self, fact) -> object:
+        if _is_arr(fact):
+            return fact               # a collection of like arrays
+        if isinstance(fact, tuple) and fact and fact[0] == "tup":
+            return _join(*[f for f in fact[1] if not _is_arr(f)]) \
+                if not any(_is_arr(f) for f in fact[1]) else fact[1][0]
+        if isinstance(fact, frozenset):
+            if "ladder" in fact:
+                return frozenset({"rung"})
+            consts = {t for t in fact if isinstance(t, tuple)
+                      and t[0] == "const"}
+            if consts and consts == fact:
+                return fact
+        return UNK
+
+    def _refine_guard(self, fi, env, test) -> None:
+        """The trusted pad-to-rung guard: a comparison between a closed
+        int fact and a `len(arr)`-derived value certifies the array's
+        row fact as the closed side (see the module docstring)."""
+        for cmp in [n for n in ast.walk(test) if isinstance(n, ast.Compare)]:
+            if len(cmp.ops) != 1 or not isinstance(
+                    cmp.ops[0], (ast.LtE, ast.Lt, ast.Gt, ast.GtE,
+                                 ast.Eq)):
+                continue
+            sides = [cmp.left, cmp.comparators[0]]
+            for a, b in (sides, sides[::-1]):
+                if not isinstance(a, ast.Name):
+                    continue
+                fa = env.get(a.id)
+                if not isinstance(fa, frozenset) or not _closed(fa):
+                    continue
+                arr_name = None
+                if isinstance(b, ast.Name):
+                    arr_name = self._len_of.get((fi.ref, b.id))
+                elif isinstance(b, ast.Call) and isinstance(
+                        b.func, ast.Name) and b.func.id == "len" \
+                        and b.args and isinstance(b.args[0], ast.Name):
+                    arr_name = b.args[0].id
+                if arr_name is None:
+                    continue
+                old = env.get(arr_name)
+                if _is_arr(old):
+                    env[arr_name] = _arr(fa, old[2])
+
+    # -- expression evaluation --------------------------------------------
+
+    def _callable_targets(self, fi, node) -> dict:
+        """FuncInfo / lambda targets a callable-valued expression can
+        denote (Name, IfExp of names, inline Lambda), keyed hashably."""
+        out: dict = {}
+        if isinstance(node, ast.Lambda):
+            out[("lam", id(node))] = ("lam", node, fi)
+        elif isinstance(node, ast.IfExp):
+            out.update(self._callable_targets(fi, node.body))
+            out.update(self._callable_targets(fi, node.orelse))
+        elif isinstance(node, ast.Name):
+            key = (fi.ref, node.id)
+            if key in self.bindings:
+                out.update(self.bindings[key])
+            else:
+                mod = self.index.modules[fi.module]
+                hit = self.index._resolve_name(mod, fi, node.id)
+                if isinstance(hit, FuncInfo):
+                    out[("fn", hit.ref)] = hit
+        return out
+
+    def _lookup(self, fi, env, name) -> object:
+        if name in env:
+            return env[name]
+        p = fi.parent
+        while p is not None:
+            penv = self.envs.get(p.ref)
+            if penv and name in penv:
+                return penv[name]
+            p = p.parent
+        menv = self.module_env.get(fi.module, {})
+        if name in menv:
+            return menv[name]
+        seeded = self._seed_name(name)
+        return seeded if seeded is not None else UNK
+
+    def _eval(self, fi, env, node, depth: int = 0) -> object:
+        if node is None or depth > 40:
+            return UNK
+        if isinstance(node, ast.Constant):
+            if isinstance(node.value, int) and not isinstance(
+                    node.value, bool):
+                return _const(node.value)
+            return UNK
+        if isinstance(node, ast.Name):
+            return self._lookup(fi, env, node.id)
+        if isinstance(node, ast.NamedExpr):
+            fact = self._eval(fi, env, node.value, depth + 1)
+            self._bind_target(fi, env, node.target, fact, node.value)
+            return fact
+        if isinstance(node, ast.Attribute):
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id in ("self", "cls") and fi.cls:
+                key = (f"{fi.module}.{fi.cls}", node.attr)
+                if key in self.class_attrs:
+                    return self.class_attrs[key]
+                seeded = self._seed_name(node.attr.lstrip("_"))
+                return seeded if seeded is not None else UNK
+            if node.attr == "shape":
+                base = self._eval(fi, env, node.value, depth + 1)
+                if _is_arr(base):
+                    return ("tup", [base[1], base[2]])
+            return UNK
+        if isinstance(node, ast.Tuple):
+            return ("tup", [self._eval(fi, env, e, depth + 1)
+                            for e in node.elts])
+        if isinstance(node, ast.List):
+            if len(node.elts) == 1:
+                return self._eval(fi, env, node.elts[0], depth + 1)
+            return ("tup", [self._eval(fi, env, e, depth + 1)
+                            for e in node.elts])
+        if isinstance(node, ast.IfExp):
+            a = self._eval(fi, env, node.body, depth + 1)
+            b = self._eval(fi, env, node.orelse, depth + 1)
+            if _is_arr(a) and _is_arr(b):
+                return _arr(_join(a[1], b[1]), _join(a[2], b[2]))
+            if _is_arr(a) or _is_arr(b):
+                return a if _is_arr(a) else b
+            return _join(a, b)
+        if isinstance(node, ast.BinOp):
+            return self._eval_binop(fi, env, node, depth)
+        if isinstance(node, ast.Subscript):
+            return self._eval_subscript(fi, env, node, depth)
+        if isinstance(node, (ast.SetComp, ast.GeneratorExp, ast.ListComp)):
+            it = self._eval(fi, env, node.generators[0].iter, depth + 1)
+            self._bind_target(fi, env, node.generators[0].target,
+                              self._element_of(it), node.generators[0].iter)
+            elt = self._eval(fi, env, node.elt, depth + 1)
+            if _is_arr(elt):
+                return elt
+            if isinstance(elt, frozenset) and "rung" in elt:
+                return frozenset({"ladder"})
+            return ("coll", elt)
+        if isinstance(node, ast.Starred):
+            return self._eval(fi, env, node.value, depth + 1)
+        if isinstance(node, ast.Call):
+            return self._eval_call(fi, env, node, depth)
+        if isinstance(node, ast.UnaryOp):
+            return self._eval(fi, env, node.operand, depth + 1)
+        if isinstance(node, (ast.BoolOp, ast.Compare)):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.expr):
+                    self._eval(fi, env, child, depth + 1)
+            return UNK
+        return UNK
+
+    def _eval_binop(self, fi, env, node, depth) -> object:
+        # pow2 bucketing: 1 << (...).bit_length() closes ANY width
+        if isinstance(node.op, ast.LShift) and isinstance(
+                node.left, ast.Constant) and node.left.value == 1:
+            return frozenset({"pow2"})
+        l = self._eval(fi, env, node.left, depth + 1)
+        r = self._eval(fi, env, node.right, depth + 1)
+        if isinstance(node.op, ast.Mult):
+            for f in (l, r):
+                if isinstance(f, frozenset) and "block" in f:
+                    # n whole blocks: dispatched as block-sized slices
+                    return frozenset({"block"})
+        return UNK
+
+    def _eval_subscript(self, fi, env, node, depth) -> object:
+        base = self._eval(fi, env, node.value, depth + 1)
+        sel = node.slice
+        if isinstance(base, tuple) and base and base[0] == "tup" \
+                and isinstance(sel, ast.Constant) and isinstance(
+                sel.value, int) and 0 <= sel.value < len(base[1]):
+            return base[1][sel.value]
+        if _is_arr(base):
+            if isinstance(sel, ast.Slice):
+                if sel.lower is None and sel.upper is not None:
+                    return _arr(self._as_int_fact(
+                        fi, env, sel.upper, depth), base[2])
+                if (isinstance(sel.upper, ast.BinOp)
+                        and isinstance(sel.upper.op, ast.Add)):
+                    # a[i : i + K] — a K-sized window
+                    for side in (sel.upper.left, sel.upper.right):
+                        k = self._as_int_fact(fi, env, side, depth)
+                        if _closed(k) and not (
+                                isinstance(sel.lower, ast.Name)
+                                and isinstance(side, ast.Name)
+                                and side.id == sel.lower.id):
+                            return _arr(k, base[2])
+                return _arr(UNK, base[2])
+            # constant / fancy indexing: keep treating as the same array
+            # family (the vararg-collection convention)
+            if isinstance(sel, ast.Constant):
+                return base
+            return _arr(UNK, base[2])
+        return UNK
+
+    def _as_int_fact(self, fi, env, node, depth) -> frozenset:
+        f = self._eval(fi, env, node, depth + 1)
+        return f if isinstance(f, frozenset) else UNK
+
+    # -- calls -------------------------------------------------------------
+
+    def _eval_call(self, fi, env, node, depth) -> object:
+        index, mod = self.index, self.index.modules[fi.module]
+        # list accumulation: xs.append(arr) folds into xs's fact (the
+        # padded_arrays idiom in _blocked_dispatch)
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr == "append" and isinstance(
+                node.func.value, ast.Name) and node.args:
+            item = self._eval(fi, env, node.args[0], depth + 1)
+            name = node.func.value.id
+            old = env.get(name)
+            env[name] = item if not _is_arr(old) else self._merge(
+                old, item)
+            return UNK
+        # bound dispatch closures: dispatch(...) / fn(...)
+        if isinstance(node.func, ast.Name):
+            key = (fi.ref, node.func.id)
+            targets = self.bindings.get(key)
+            if targets:
+                arg_facts = self._arg_facts(fi, env, node, depth)
+                rets = []
+                for t in list(targets.values()):
+                    rets.append(self._invoke(fi, t, node, arg_facts,
+                                             depth))
+                return rets[0] if rets else UNK
+        target = index.resolve_call(mod, fi, node)
+        if isinstance(target, str) and target.startswith("*."):
+            cands = self._methods.get(target[2:], [])
+            if len(cands) == 1:
+                target = cands[0]
+        if isinstance(target, FuncInfo):
+            name = target.name
+            if name == "_rung" or name.endswith("_rung"):
+                return frozenset({"rung"})
+            if "block_size" in name:
+                return frozenset({"block"})
+            if "ladder" in name:
+                return frozenset({"ladder"})
+            if self._prepass:
+                return UNK
+            if target.jit_root:
+                self._audit(fi, target, node, env, depth)
+                return UNK
+            if not self._exempt(target):
+                self._propagate(fi, target, node, env, depth)
+                return self.ret_facts.get(target.ref, UNK)
+            return UNK
+        if isinstance(target, str):
+            tail = target.rsplit(".", 1)[-1]
+            if tail in _CTORS and node.args:
+                shape = self._eval(fi, env, node.args[0], depth + 1)
+                if isinstance(shape, tuple) and shape and \
+                        shape[0] == "tup":
+                    dims = shape[1]
+                    return _arr(
+                        dims[0] if isinstance(dims[0], frozenset)
+                        else UNK,
+                        (dims[1] if len(dims) > 1 and isinstance(
+                            dims[1], frozenset) else UNK))
+                if isinstance(shape, frozenset):
+                    return _arr(shape)
+                return _arr(UNK)
+            if tail in ("vstack", "concatenate") and node.args:
+                return self._eval_vstack(fi, env, node.args[0], depth)
+            if tail in _PASSTHROUGH or tail == "astype":
+                if node.args:
+                    return self._eval(fi, env, node.args[0], depth + 1)
+                if isinstance(node.func, ast.Attribute):
+                    return self._eval(fi, env, node.func.value, depth + 1)
+                return UNK
+            if target == "len" and node.args:
+                f = self._eval(fi, env, node.args[0], depth + 1)
+                return f[1] if _is_arr(f) else UNK
+            if target in ("min", "max") and node.args:
+                facts = [self._eval(fi, env, a, depth + 1)
+                         for a in node.args]
+                if all(isinstance(f, frozenset) and _closed(f)
+                       for f in facts):
+                    return _join(*facts)
+                return UNK
+            if target == "next" and node.args:
+                gen = node.args[0]
+                if isinstance(gen, ast.GeneratorExp):
+                    it = self._eval(fi, env, gen.generators[0].iter,
+                                    depth + 1)
+                    if isinstance(it, frozenset) and "ladder" in it:
+                        # the pad-to-rung idiom (trusted default: the
+                        # caller's occupancy cap, runtime-pinned)
+                        return frozenset({"rung"})
+                return UNK
+            if target == "int" and node.args:
+                return self._eval(fi, env, node.args[0], depth + 1)
+        # evaluate arguments for their propagation side effects
+        for a in (*node.args, *(k.value for k in node.keywords)):
+            self._eval(fi, env, a, depth + 1)
+        return UNK
+
+    def _eval_vstack(self, fi, env, arg, depth) -> object:
+        """vstack([x, np.full((P - len(x), ...), ...)]) — the pad-up
+        idiom: result rows are P."""
+        if not isinstance(arg, (ast.List, ast.Tuple)) or \
+                len(arg.elts) != 2:
+            return _arr(UNK)
+        first = self._eval(fi, env, arg.elts[0], depth + 1)
+        width = first[2] if _is_arr(first) else UNK
+        pad = arg.elts[1]
+        if isinstance(pad, ast.Call):
+            t = _dotted(pad.func) or ""
+            if t.rsplit(".", 1)[-1] in _CTORS and pad.args and \
+                    isinstance(pad.args[0], ast.Tuple) and \
+                    pad.args[0].elts:
+                rows_expr = pad.args[0].elts[0]
+                if isinstance(rows_expr, ast.BinOp) and isinstance(
+                        rows_expr.op, ast.Sub):
+                    p = self._eval(fi, env, rows_expr.left, depth + 1)
+                    if isinstance(p, frozenset) and _closed(p):
+                        return _arr(p, width)
+        return _arr(UNK, width)
+
+    def _arg_facts(self, fi, env, node, depth) -> list:
+        out = []
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                out.append(self._eval(fi, env, a.value, depth + 1))
+            else:
+                out.append(self._eval(fi, env, a, depth + 1))
+        return out
+
+    def _invoke(self, caller, target, node, arg_facts, depth) -> object:
+        if isinstance(target, tuple) and target[0] == "lam":
+            _, lam, owner = target
+            if depth > 8:
+                return UNK
+            lenv = dict(self.envs.get(owner.ref, {}))
+            for p, f in zip([a.arg for a in lam.args.args], arg_facts):
+                lenv[p] = f
+            return self._eval(owner, lenv, lam.body, depth + 1)
+        if isinstance(target, FuncInfo):
+            if target.jit_root:
+                self._audit_facts(caller, target, node,
+                                  arg_facts[0] if arg_facts else None)
+                return UNK
+            changed = self._join_params(target, target.params, arg_facts,
+                                        {})
+            self.callers.setdefault(target.ref, {})[caller.ref] = caller
+            if changed:
+                self._enqueue(target)
+            return self.ret_facts.get(target.ref, UNK)
+        return UNK
+
+    @staticmethod
+    def _merge(old, new):
+        """Monotone per-param join across call sites: arrays join
+        dimension-wise, int facts union, mixed kinds fall to UNK."""
+        if old is None:
+            return new
+        if old == new:
+            return old
+        if _is_arr(old) and _is_arr(new):
+            return _arr(_join(old[1], new[1]), _join(old[2], new[2]))
+        if isinstance(old, frozenset) and isinstance(new, frozenset):
+            return _join(old, new)
+        return UNK
+
+    def _join_params(self, target, params, pos_facts, kw_facts) -> bool:
+        store = self.param_facts.setdefault(target.ref, {})
+        off = 1 if params and params[0] in ("self", "cls") else 0
+        changed = False
+        vararg = getattr(target.node.args, "vararg", None)
+
+        def put(name, f):
+            nonlocal changed
+            merged = self._merge(store.get(name), f)
+            if store.get(name) != merged:
+                store[name] = merged
+                changed = True
+
+        for i, f in enumerate(pos_facts):
+            if i + off < len(params):
+                put(params[i + off], f)
+            elif vararg is not None:
+                # vararg of (array, pad) tuples: keep the array fact
+                if isinstance(f, tuple) and f and f[0] == "tup" and \
+                        f[1] and _is_arr(f[1][0]):
+                    f = f[1][0]
+                put(vararg.arg, f)
+        for name, f in kw_facts.items():
+            put(name, f)
+        return changed
+
+    def _propagate(self, caller, target, node, env, depth) -> None:
+        pos = self._arg_facts(caller, env, node, depth)
+        known = set(target.params) | set(target.kwonly)
+        kw = {}
+        for k in node.keywords:
+            if k.arg and k.arg in known:
+                kw[k.arg] = self._eval(caller, env, k.value, depth + 1)
+        # closure-valued arguments become dispatch bindings
+        params = target.params
+        off = 1 if params and params[0] in ("self", "cls") else 0
+        for i, a in enumerate(node.args):
+            if isinstance(a, ast.Starred) or i + off >= len(params):
+                continue
+            tgts = self._callable_targets(caller, a)
+            if tgts:
+                self.bindings.setdefault(
+                    (target.ref, params[i + off]), {}).update(tgts)
+        changed = self._join_params(target, params, pos, kw)
+        self.callers.setdefault(target.ref, {})[caller.ref] = caller
+        if changed or target.ref not in self.envs:
+            self._enqueue(target)
+
+    # -- the audit (TPU501) ------------------------------------------------
+
+    def _audit(self, caller, root, node, env, depth) -> None:
+        fact = self._eval(caller, env, node.args[0], depth + 1) \
+            if node.args else None
+        self._audit_facts(caller, root, node, fact)
+
+    def _not_closed(self, fact) -> str | None:
+        if fact is None:
+            return None
+        if _is_arr(fact):
+            for axis, f in (("batch", fact[1]), ("width", fact[2])):
+                if not _closed(f):
+                    return f"{axis} axis is not provably bounded"
+                if axis == "batch":
+                    for t in f:
+                        if isinstance(t, tuple) and t[0] == "const" and \
+                                self.rung_values and \
+                                t[1] not in self.rung_values and t[1] != 1:
+                            return (f"constant batch size {t[1]} is "
+                                    "outside the precompile ladder "
+                                    f"{sorted(self.rung_values)}")
+            return None
+        if isinstance(fact, frozenset):
+            return None if _closed(fact) else \
+                "argument shape is not provably bounded"
+        return "argument shape is not provably bounded"
+
+    def _audit_facts(self, caller, root, node, fact) -> None:
+        if self._exempt(caller) or self._exempt(root):
+            return
+        key = (caller.ref, node.lineno, root.ref)
+        if key in self._audited:
+            return
+        self._audited.add(key)
+        mod = self.index.modules[caller.module]
+        reason = self._not_closed(fact)
+        if reason is None:
+            return
+        if mod.suppressed(node.lineno, "shape-universe-ok"):
+            return
+        self.findings.append(make_finding(
+            self.index, "TPU501", caller.path, node.lineno,
+            f"jit root {root.qual}() dispatched from {caller.qual}() "
+            f"with a shape outside the precompile universe: {reason} "
+            "(a statically-detected recompile storm)",
+            ast_path=f"{caller.qual}/dispatch/{root.qual}"))
+
+
+# -- TPU502: the precompile walk must cover the reachable universe ----------
+
+
+def _check_precompile(index: PackageIndex) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in index.modules.values():
+        for cls, meths in mod.classes.items():
+            if "precompile" not in meths or "_execute" not in meths:
+                continue
+            pre = meths["precompile"]
+            out += _check_precompile_rungs(index, mod, pre)
+            out += _check_precompile_variants(index, mod, cls, pre)
+    return out
+
+
+def _collect_variant_combos(node: ast.AST) -> set:
+    """frozensets of _VARIANT_KWS keys from dict literals (the
+    `variants = [...]` form) and from direct `_topk_device(...,
+    skip_hot=True)` kwargs."""
+    combos: set = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Dict):
+            keys = {k.value for k in n.keys
+                    if isinstance(k, ast.Constant)
+                    and k.value in _VARIANT_KWS}
+            if keys or not n.keys:
+                combos.add(frozenset(keys))
+        elif isinstance(n, ast.Call) and isinstance(
+                n.func, ast.Attribute) and n.func.attr == "_topk_device":
+            keys = {k.arg for k in n.keywords if k.arg in _VARIANT_KWS
+                    and isinstance(k.value, ast.Constant)
+                    and k.value.value is True}
+            combos.add(frozenset(keys))
+    return combos
+
+
+def _required_combos(call: ast.Call) -> set:
+    """The variant combos one serving `_topk_device` call site can
+    request: True-literal kwargs are always on; Name-valued variant
+    kwargs may be either — both sides are statically reachable."""
+    base = {k.arg for k in call.keywords if k.arg in _VARIANT_KWS
+            and isinstance(k.value, ast.Constant)
+            and k.value.value is True}
+    optional = [k.arg for k in call.keywords if k.arg in _VARIANT_KWS
+                and isinstance(k.value, ast.Name)]
+    combos = {frozenset(base)}
+    for name in optional:
+        combos |= {c | {name} for c in combos}
+    return combos
+
+
+def _check_precompile_rungs(index, mod, pre) -> list[Finding]:
+    """The rung loop must iterate the FULL ladder (`self._ladder`) —
+    directly, or through a min(·, block)-capping comprehension — not a
+    subset of it."""
+    for node in ast.walk(pre.node):
+        if not isinstance(node, ast.For):
+            continue
+        # plain form: `for rows in self._ladder:` (or any ladder-named
+        # source) walks every rung by construction
+        if "ladder" in (_dotted(node.iter) or "").lower():
+            return []
+        for sub in ast.walk(node.iter):
+            if isinstance(sub, (ast.SetComp, ast.GeneratorExp)):
+                src = sub.generators[0].iter
+                dotted = _dotted(src) or ""
+                if "ladder" in dotted.lower():
+                    return []
+                if isinstance(src, ast.Subscript) and "ladder" in (
+                        _dotted(src.value) or "").lower():
+                    return [make_finding(
+                        index, "TPU502", pre.path, node.lineno,
+                        f"{pre.qual}() walks a SUBSET of the ladder — "
+                        "every rung serving can pad to must be warmed",
+                        ast_path=f"{pre.qual}/rung_subset")]
+    return [make_finding(
+        index, "TPU502", pre.path, pre.node.lineno,
+        f"{pre.qual}() does not walk a ladder-derived rung set — the "
+        "precompile universe cannot cover the serving rungs",
+        ast_path=f"{pre.qual}/no_rung_walk")]
+
+
+def _check_precompile_variants(index, mod, cls, pre) -> list[Finding]:
+    out: list[Finding] = []
+    warmed = _collect_variant_combos(pre.node)
+    scorings: set = set()
+    # the scorings tuple default on precompile(scorings=(...))
+    defaults = pre.node.args.defaults
+    dparams = pre.params[len(pre.params) - len(defaults):] if defaults \
+        else []
+    for p, d in zip(dparams, defaults):
+        if p == "scorings" and isinstance(d, (ast.Tuple, ast.List)):
+            scorings = {e.value for e in d.elts
+                        if isinstance(e, ast.Constant)}
+    required: dict[frozenset, tuple] = {}
+    req_scorings: dict[str, tuple] = {}
+    for m in index.modules.values():
+        rel = index.relpath(m.path)
+        if any(s in m.modname.rsplit(".", 1)[-1] for s in _EXEMPT):
+            continue
+        for f in m.functions.values():
+            if f is pre:
+                continue
+            for node in ast.walk(f.node):
+                if not (isinstance(node, ast.Call) and isinstance(
+                        node.func, ast.Attribute)
+                        and node.func.attr == "_topk_device"):
+                    continue
+                for combo in _required_combos(node):
+                    required.setdefault(combo, (f, node.lineno))
+                sc = None
+                if len(node.args) >= 3 and isinstance(
+                        node.args[2], ast.Constant):
+                    sc = node.args[2].value
+                for k in node.keywords:
+                    if k.arg == "scoring" and isinstance(
+                            k.value, ast.Constant):
+                        sc = k.value.value
+                if isinstance(sc, str):
+                    req_scorings.setdefault(sc, (f, node.lineno))
+    for combo, (f, line) in sorted(required.items(),
+                                   key=lambda kv: sorted(kv[0])):
+        if combo not in warmed:
+            pretty = "+".join(sorted(combo)) or "(plain)"
+            out.append(make_finding(
+                index, "TPU502", pre.path, pre.node.lineno,
+                f"{pre.qual}() never warms the kernel variant "
+                f"[{pretty}] that {f.qual}() (line {line}) can "
+                "dispatch — its first serving hit eats the compile",
+                ast_path=f"{pre.qual}/variant/{pretty}"))
+    for sc, (f, line) in sorted(req_scorings.items()):
+        if scorings and sc not in scorings:
+            out.append(make_finding(
+                index, "TPU502", pre.path, pre.node.lineno,
+                f"{pre.qual}() scorings default omits {sc!r}, which "
+                f"{f.qual}() (line {line}) dispatches",
+                ast_path=f"{pre.qual}/scoring/{sc}"))
+    return out
+
+
+# -- TPU503: derived shapes from query-batch values -------------------------
+
+
+def _check_shape_derivation(index: PackageIndex,
+                            color: QueryColor) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in index.modules.values():
+        for fi in mod.functions.values():
+            if not fi.jit_reachable:
+                continue
+            colored = color.colored(fi)
+            if not colored:
+                continue
+            # names bound to a .shape[i] read of a query-colored value
+            shape_names: set = set()
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Subscript) and isinstance(
+                        node.value.value, ast.Attribute) and \
+                        node.value.value.attr == "shape" and \
+                        refs_any(node.value.value.value, colored):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            shape_names.add(t.id)
+
+            def derived(expr) -> bool:
+                for n in ast.walk(expr):
+                    if not isinstance(n, ast.BinOp):
+                        continue
+                    for sub in ast.walk(n):
+                        if isinstance(sub, ast.Name) and \
+                                sub.id in shape_names:
+                            return True
+                        if isinstance(sub, ast.Attribute) and \
+                                sub.attr == "shape" and refs_any(
+                                sub.value, colored):
+                            return True
+                return False
+
+            for node in ast.walk(fi.node):
+                if not isinstance(node, ast.Call):
+                    continue
+                t = index.resolve_call(mod, fi, node)
+                if not (isinstance(t, str) and t.rsplit(
+                        ".", 1)[-1] in (*_CTORS, "arange")
+                        and (t.startswith("jax.") or t.startswith(
+                            "numpy.") or "." not in t)):
+                    continue
+                if node.args and derived(node.args[0]):
+                    if mod.suppressed(node.lineno, "shape-derive-ok"):
+                        continue
+                    out.append(make_finding(
+                        index, "TPU503", fi.path, node.lineno,
+                        f"array constructor in jit-traced {fi.qual}() "
+                        "derives a NEW shape arithmetically from a "
+                        "query-batch value's .shape — every distinct "
+                        "input shape mints another compiled program",
+                        ast_path=f"{fi.qual}/shape_derive"))
+    return out
+
+
+def analyze(index: PackageIndex) -> ShapeFlow:
+    """Run the flow engine and return it — tests introspect `_audited`
+    to prove the serving path was actually walked (a vacuous zero-
+    finding run must fail loudly, like test_self_check_sees_the_package
+    does for the base index)."""
+    flow = ShapeFlow(index)
+    flow.run()
+    return flow
+
+
+def check(index: PackageIndex) -> list[Finding]:
+    flow = analyze(index)
+    findings = list(flow.findings)
+    findings += _check_precompile(index)
+    findings += _check_shape_derivation(index, QueryColor(index))
+    return findings

@@ -122,6 +122,9 @@ def _tfidf_dense_scores(q_terms, doc_matrix, df, num_docs,
     trace, so a gathered explain score is bit-identical to what the
     top-k saw (search/explain.py pins this)."""
     vocab_size = doc_matrix.shape[0]
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = idf_weights(df, num_docs, compat_int_idf)
 
     safe_q = jnp.where(q_terms >= 0, q_terms, 0)
@@ -181,6 +184,9 @@ def _bm25_dense_scores(q_terms, tf_matrix, df, doc_len, num_docs,
     _tfidf_dense_scores for the shared-expression contract)."""
     vocab_size = tf_matrix.shape[0]
     n = jnp.asarray(num_docs, jnp.float32)
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = bm25_idf_weights(df, n)
     avg_dl = jnp.sum(doc_len.astype(jnp.float32)) / jnp.maximum(n, 1.0)
     dl_norm = 1.0 - b + b * doc_len.astype(jnp.float32) / jnp.maximum(avg_dl, 1e-9)
@@ -281,6 +287,9 @@ def _tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs,
             jnp.broadcast_to(jnp.arange(b)[:, None], rank.shape),
             jnp.where(is_hot, rank, h),
         ].add(jnp.where(is_hot, q_w, 0.0), mode="drop")      # [B, H]
+        # lint: reassoc-ok (THE production MXU matmul — per-row batch
+        # invariance is pinned dynamically by the coalesced==solo suite,
+        # and a mul+reduce here would materialize [B, H, D+1])
         return s + w_hot @ hot_weight_fn(hot_tfs)            # [B, D+1]
 
     # `skip_cold` (static): the hot-tier-only degraded service level — the
@@ -399,8 +408,13 @@ def _hot_stage_pruned(partial, hot_tfs, hot_max_w, q_w, rank, is_hot,
         # cells instead of the [H, D+1] strip sweep
         cells = hot_tfs[r_h[:, :, None], cand_idx[:, None, :]]
         w = hot_cell_fn(cells, cand_idx[:, None, :])
-        contrib = jnp.einsum("blc,bl->bc", w,
-                             jnp.where(is_hot, q_w, 0.0))
+        # mul + reduce over L, NOT an einsum (TPU401): a dot_general's
+        # algorithm is chosen per shape, so an einsum here could round
+        # the same query's candidate sums differently at batch size 1
+        # vs 4 — the coalesced == solo pin needs batch-size-invariant
+        # lowering (the [B, L, C] intermediate already exists above)
+        contrib = jnp.sum(w * jnp.where(is_hot, q_w, 0.0)[:, :, None],
+                          axis=1)
         bidx = jnp.broadcast_to(jnp.arange(b)[:, None], cand_idx.shape)
         return s.at[bidx, cand_idx].add(contrib)
 
@@ -486,6 +500,10 @@ def _blockmax_topk(q_terms, hot_rank, hot_tfs, tier_of, row_of,
             jnp.broadcast_to(jnp.arange(b)[:, None], rank.shape),
             jnp.where(is_hot, rank, h),
         ].add(jnp.where(is_hot, q_w, 0.0), mode="drop")      # [B, H]
+        # lint: reassoc-ok (same contraction as the exact kernel's hot
+        # matmul — column-restriction bit-equality with it is exactly
+        # what the blockmax parity suite pins, so both sides must keep
+        # the SAME gemm lowering)
         return w_hot @ w_cells
 
     # exact cold partial — the identical tier accumulation the exact
@@ -584,6 +602,9 @@ def tfidf_topk_blockmax(
     """Block-max TF-IDF top-k on the tiered layout — the deep-k
     production kernel (see the section comment). Returns
     (scores [B,k], docnos [B,k], stats [3])."""
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = idf_weights(df, n_scalar, compat_int_idf)
     cell_fn = lambda tfs, docs: _lntf(tfs)  # noqa: E731
     return _blockmax_topk(
@@ -612,6 +633,9 @@ def bm25_topk_blockmax(
     dl_norm the exact kernel broadcasts, so surviving columns are
     bit-equal to the full-width stage."""
     n = jnp.asarray(n_scalar, jnp.float32)
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = bm25_idf_weights(df, n)
     dlf = doc_len.astype(jnp.float32)
     avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
@@ -676,6 +700,9 @@ def _tfidf_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
     production top-k kernel and the explain score-gather variant
     (prune_k is the production kernel's k; the prune gate and candidate
     machinery must see the same value to trace the same program)."""
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = idf_weights(df, n_scalar, compat_int_idf)
 
     # the runtime-bounded prune variant gathers RAW cells, so it and the
@@ -863,6 +890,9 @@ def _bm25_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
     """[B, D+1] tiered BM25 accumulation — shared verbatim between the
     production top-k kernel and the explain score-gather variant."""
     n = jnp.asarray(n_scalar, jnp.float32)
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = bm25_idf_weights(df, n)
     dlf = doc_len.astype(jnp.float32)
     avg_dl = jnp.sum(dlf) / jnp.maximum(n, 1.0)
@@ -874,7 +904,13 @@ def _bm25_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
     if do_prune:
         # slot 0 is the dead column (doc_len 0 -> the global minimum of
         # dl_norm); exclude it so the bound reflects real documents
+        # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+        # the explain variants pin this exact traced expression — hoisting
+        # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
         dl_min = jnp.min(dl_norm[1:])
+        # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+        # the explain variants pin this exact traced expression — hoisting
+        # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
         hot_max_w = bm25_saturation(hot_max_tf.astype(jnp.float32),
                                     dl_min, k1=k1)
     else:
@@ -926,6 +962,9 @@ def tfidf_prune_diag(
     (True = the query alone would permit pruning; the block prunes iff all
     are True). Used by tests and the bench's engagement report — the
     scoring kernels keep their (scores, docnos) signature."""
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = idf_weights(df, n_scalar, compat_int_idf)
     cell_fn = lambda tfs, docs: _lntf(tfs)  # noqa: E731
     _, safe = _tiered_scores(
@@ -978,6 +1017,9 @@ def _cosine_dense_scores(q_terms, doc_matrix, df, doc_norm, cand_docnos,
     rerank kernel and the explain variant (same candidate-set shape =>
     the same traced program => bit-identical per-candidate floats)."""
     vocab_size = doc_matrix.shape[0]
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = idf_weights(df, num_docs)
     safe_q = jnp.where(q_terms >= 0, q_terms, 0)
     q_valid = (q_terms >= 0) & (q_terms < vocab_size)
@@ -1026,6 +1068,9 @@ def _cosine_tiered_scores(q_terms, hot_rank, hot_tfs, tier_of, row_of,
                           hot_preweighted=False) -> jax.Array:
     """[B, C] per-candidate tiered cosine scores — shared between the
     production rerank kernel and the explain variant."""
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = idf_weights(df, n_scalar)
     scores = _tiered_scores(
         q_terms, hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
@@ -1066,6 +1111,9 @@ def tfidf_topk_sparse(
 ) -> tuple[jax.Array, jax.Array]:
     """Sparse scoring: scatter each query term's postings into a doc-axis
     accumulator. Work is B*L*P instead of B*L*D."""
+    # lint: invariant-ok (O(V)/O(D) weight-vector prep, fused in-trace;
+    # the explain variants pin this exact traced expression — hoisting
+    # would fork it. The O(H*D) strip class IS cached: _hot_wstrip)
     idf = idf_weights(df, n_scalar, compat_int_idf)
 
     # both bounds, like every sibling kernel: an id >= V would clamp all

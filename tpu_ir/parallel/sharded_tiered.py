@@ -330,6 +330,8 @@ def _local_scores(q_terms, q_weight, lay_local, *, dblk, scoring, n_f,
     (hot_rank, hot_tfs, tier_of, row_of, tier_docs, tier_tfs,
      doc_len) = lay_local
     if scoring == "bm25":
+        # lint: invariant-ok (per-shard weight-vector prep inside the SPMD
+        # program; hoisting would add a host round-trip per dispatch)
         hot_fn, cold_fn = _bm25_weight_fns(doc_len, n_f, k1, b)
     else:
         hot_fn = _lntf
@@ -385,8 +387,12 @@ def _sharded_topk_jit(q_terms, df, n_scalar, hot_rank, hot_tfs, tier_of,
                       hot_only=False):
     n_f = jnp.asarray(n_scalar, jnp.float32)
     if scoring == "bm25":
+        # lint: invariant-ok (per-shard weight-vector prep inside the SPMD
+        # program; hoisting would add a host round-trip per dispatch)
         q_weight = bm25_idf_weights(df, n_f)
     else:
+        # lint: invariant-ok (per-shard weight-vector prep inside the SPMD
+        # program; hoisting would add a host round-trip per dispatch)
         q_weight = idf_weights(df, n_scalar, compat_int_idf)
 
     def body(q, qw, *leaves):
@@ -458,8 +464,12 @@ def _sharded_scores_at_jit(q_terms, df, n_scalar, cand, hot_rank, hot_tfs,
                            compat_int_idf, k1, b, hot_only=False):
     n_f = jnp.asarray(n_scalar, jnp.float32)
     if scoring == "bm25":
+        # lint: invariant-ok (per-shard weight-vector prep inside the SPMD
+        # program; hoisting would add a host round-trip per dispatch)
         q_weight = bm25_idf_weights(df, n_f)
     else:
+        # lint: invariant-ok (per-shard weight-vector prep inside the SPMD
+        # program; hoisting would add a host round-trip per dispatch)
         q_weight = idf_weights(df, n_scalar, compat_int_idf)
 
     def body(q, qw, c, *leaves):
@@ -552,6 +562,8 @@ def _sharded_rerank_jit(q_terms, df, n_scalar, doc_norm, hot_rank, hot_tfs,
                         tier_of, row_of, doc_len, doc_base, tier_docs,
                         tier_tfs, *, mesh, dblk, k, candidates, k1, b):
     n_f = jnp.asarray(n_scalar, jnp.float32)
+    # lint: invariant-ok (per-shard weight-vector prep inside the SPMD
+    # program; hoisting would add a host round-trip per dispatch)
     w_bm25 = bm25_idf_weights(df, n_f)
     idf = idf_weights(df, n_scalar)
     w_cos = idf * idf
