@@ -1,0 +1,676 @@
+"""Zipf workload engine + generation-keyed result-cache tier (ISSUE 15).
+
+The contract under test:
+
+- the Workload model is SEEDED (replayable), its skew actually
+  concentrates the draw, and skew 0 is a uniform control;
+- a cache hit is BIT-IDENTICAL to the miss path — docids, float bits,
+  tie order — across tiered(sparse)/sharded layouts x tfidf/bm25 x
+  rerank, at both the frontend and the router;
+- a generation swap invalidates BY KEY: zero stale-generation cache
+  responses (every cached response's generation matches a known
+  manifest, and post-swap lookups answer the new generation);
+- cache-aware hedging: a request served from cache never arms a hedge
+  timer and never pollutes the per-shard trailing-RTT window;
+- eviction is LRU under the bounded capacity (pinned at capacity 1);
+- TPU_IR_MERGE_AUTO=0 + `tpu-ir compact` reach an end state pinned
+  equivalent (metadata checksums) to inline auto-merge.
+"""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from tpu_ir.index.builder import build_index
+from tpu_ir.search import Scorer
+from tpu_ir.serving import (
+    Overloaded,
+    ResultCache,
+    Router,
+    RouterConfig,
+    ServingConfig,
+    ServingFrontend,
+    Workload,
+    make_queries,
+    rolling_swap,
+    run_distributed_soak,
+    serve_worker,
+)
+from tpu_ir import obs
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+N_SHARDS = 2
+
+
+def _write_corpus(path, n_docs=80):
+    body = []
+    for i in range(n_docs):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + (i % 5)))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    path.write_text("".join(body))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("cache_tier")
+    corpus = _write_corpus(tmp / "corpus.trec")
+    out = str(tmp / "idx")
+    build_index([corpus], out, num_shards=2, compute_chargrams=False)
+    return out
+
+
+@pytest.fixture(scope="module")
+def scorers(index_dir):
+    return {layout: Scorer.load(index_dir, layout=layout)
+            for layout in ("sparse", "sharded")}
+
+
+# ---------------------------------------------------------------------------
+# the workload model
+# ---------------------------------------------------------------------------
+
+
+def test_workload_seeded_and_shaped(scorers):
+    sc = scorers["sparse"]
+    w1 = Workload.from_scorer(sc, kind="zipf", skew=1.1, seed=7)
+    w2 = Workload.from_scorer(sc, kind="zipf", skew=1.1, seed=7)
+    q1, q2 = w1.make_queries(50), w2.make_queries(50)
+    assert q1 == q2, "same seed must replay the same workload"
+    # the request-dict shape matches the legacy soak maker
+    assert set(q1[0]) == {"text", "scoring", "rerank", "k"}
+    assert all(1 <= len(r["text"].split()) <= 3 for r in q1)
+    # uniform kind resolves to None -> the legacy draw
+    assert Workload.from_scorer(sc, kind="uniform") is None
+
+
+def test_workload_skew_concentrates_the_draw(scorers):
+    """At s=1.5 the head term dominates; at s=0 the draw is uniform —
+    the property the per-skew bench rows ride on."""
+    sc = scorers["sparse"]
+    rng = random.Random(0)
+
+    def head_share(skew):
+        w = Workload.from_scorer(sc, kind="zipf", skew=skew, seed=0)
+        counts: dict = {}
+        for _ in range(2000):
+            t = w.draw_term(rng)
+            counts[t] = counts.get(t, 0) + 1
+        return max(counts.values()) / 2000.0, len(counts)
+
+    hot_share, hot_distinct = head_share(1.5)
+    uni_share, uni_distinct = head_share(0.0)
+    assert hot_share > 3 * uni_share, (hot_share, uni_share)
+    assert hot_distinct <= uni_distinct
+    # exact-repeat queries appear under skew — the cache's fuel
+    w = Workload.from_scorer(sc, kind="zipf", skew=1.5, seed=0)
+    texts = [r["text"] for r in w.make_queries(200)]
+    assert len(set(texts)) < len(texts)
+
+
+def test_workload_burst_schedule():
+    w = Workload(["a", "b"], burst=1.0)
+    scales = [w.pacing_scale(f / 100.0) for f in range(100)]
+    assert min(scales) < 0.8 < 1.2 < max(scales)
+    flat = Workload(["a", "b"], burst=0.0)
+    assert all(flat.pacing_scale(f / 10.0) == 1.0 for f in range(10))
+
+
+def test_make_queries_env_workload(scorers, monkeypatch):
+    """TPU_IR_WORKLOAD=zipf reshapes the soak's query maker; unset, the
+    legacy uniform draw is byte-reproducible (history comparability)."""
+    sc = scorers["sparse"]
+    monkeypatch.delenv("TPU_IR_WORKLOAD", raising=False)
+    legacy = make_queries(sc, 20, seed=3)
+    monkeypatch.setenv("TPU_IR_WORKLOAD", "zipf")
+    monkeypatch.setenv("TPU_IR_WORKLOAD_SKEW", "1.3")
+    zipf = make_queries(sc, 20, seed=3)
+    assert zipf != legacy
+    monkeypatch.delenv("TPU_IR_WORKLOAD")
+    assert make_queries(sc, 20, seed=3) == legacy
+
+
+# ---------------------------------------------------------------------------
+# ResultCache units
+# ---------------------------------------------------------------------------
+
+
+def test_cache_lru_eviction_at_capacity_one():
+    c = ResultCache(1, name="t")
+    c.put(("a",), 1, generation=0)
+    assert c.get(("a",)) == 1
+    c.put(("b",), 2, generation=0)          # evicts a
+    assert obs.get_registry().get("cache.evict") == 1
+    assert c.get(("a",)) is None
+    assert c.get(("b",)) == 2
+    assert len(c) == 1
+
+
+def test_cache_generation_bump_purges_and_refuses_old():
+    c = ResultCache(8, name="t")
+    c.put(("a",), 1, generation=1)
+    c.put(("b",), 2, generation=2)
+    assert c.bump_generation(2) == 1        # only gen-1 purged
+    assert obs.get_registry().get("cache.stale_generation") == 1
+    assert c.get(("b",)) == 2
+    # a slow miss completing after the swap cannot resurrect gen 1
+    c.put(("c",), 3, generation=1)
+    assert c.get(("c",)) is None
+    # the bump is monotonic
+    assert c.bump_generation(1) == 0
+    assert c.generation() == 2
+
+
+def test_cache_disabled_is_inert():
+    c = ResultCache(0, name="t")
+    before = obs.get_registry().get("cache.miss")
+    c.put(("a",), 1, generation=0)
+    assert c.get(("a",)) is None
+    assert not c.enabled
+    assert obs.get_registry().get("cache.miss") == before
+
+
+# ---------------------------------------------------------------------------
+# THE property: frontend hit == miss, bit-identical
+# ---------------------------------------------------------------------------
+
+
+QUERIES = ["salmon fishing", "bears honey market", "quick",
+           "dog dog salmon", "rain forest investor"]
+
+
+@pytest.mark.parametrize("layout", ["sparse", "sharded"])
+def test_frontend_hit_bitidentical_to_miss(scorers, layout):
+    """Across layouts x scorings x rerank: the second (cached) response
+    carries the exact tuples of the first (missed) one — and a fresh
+    no-cache frontend agrees, so the hit IS the miss path's bits."""
+    sc = scorers[layout]
+    fe = ServingFrontend(sc, ServingConfig(cache_entries=128))
+    bare = ServingFrontend(sc, ServingConfig(cache_entries=0))
+    assert bare.cache is None
+    reg = obs.get_registry()
+    for scoring in ("tfidf", "bm25"):
+        for rerank in (None, 10):
+            for q in QUERIES:
+                miss = fe.search(q, k=5, scoring=scoring, rerank=rerank)
+                hits_before = reg.get("cache.hit")
+                hit = fe.search(q, k=5, scoring=scoring, rerank=rerank)
+                assert reg.get("cache.hit") == hits_before + 1
+                assert list(hit) == list(miss), (layout, scoring, q)
+                ref = bare.search(q, k=5, scoring=scoring, rerank=rerank)
+                assert list(hit) == list(ref), (layout, scoring, q)
+                assert hit.level == "full" and not hit.degraded
+
+
+def test_frontend_key_separates_routes(scorers):
+    """k / scoring / rerank each mint distinct keys — a hit can never
+    answer a request the miss path would route differently."""
+    sc = scorers["sparse"]
+    fe = ServingFrontend(sc, ServingConfig(cache_entries=128))
+    reg = obs.get_registry()
+    fe.search("salmon fishing", k=5, scoring="bm25")
+    for kwargs in ({"k": 10, "scoring": "bm25"},
+                   {"k": 5, "scoring": "tfidf"},
+                   {"k": 5, "scoring": "bm25", "rerank": 10}):
+        before = reg.get("cache.hit")
+        fe.search("salmon fishing", **kwargs)
+        assert reg.get("cache.hit") == before, kwargs
+
+
+def test_frontend_uncacheable_texts_bypass(scorers):
+    """Glob/fuzzy operators expand against the vocabulary — the key
+    must not collide them with literal terms; they bypass entirely."""
+    sc = scorers["sparse"]
+    fe = ServingFrontend(sc, ServingConfig(cache_entries=128))
+    reg = obs.get_registry()
+    for q in ("salm*", "salmn~"):
+        fe.search(q, k=5, scoring="bm25")
+        fe.search(q, k=5, scoring="bm25")
+    assert reg.get("cache.hit") == 0
+    assert reg.get("cache.miss") == 0
+    assert len(fe.cache) == 0
+
+
+def test_frontend_normalized_terms_share_one_entry(scorers):
+    """The frontend key is the ANALYZED term-id sequence: whitespace
+    and case variants of one query share one entry; term ORDER does
+    not (float accumulation follows slot order)."""
+    sc = scorers["sparse"]
+    fe = ServingFrontend(sc, ServingConfig(cache_entries=128))
+    reg = obs.get_registry()
+    first = fe.search("salmon fishing", k=5, scoring="bm25")
+    for variant in ("  salmon   fishing ", "Salmon FISHING"):
+        before = reg.get("cache.hit")
+        res = fe.search(variant, k=5, scoring="bm25")
+        assert reg.get("cache.hit") == before + 1, variant
+        assert list(res) == list(first)
+    # reversed term order is a DIFFERENT key (and may be different bits)
+    before = reg.get("cache.hit")
+    fe.search("fishing salmon", k=5, scoring="bm25")
+    assert reg.get("cache.hit") == before
+
+
+def test_frontend_generation_swap_invalidates_by_key(tmp_path):
+    """A live-index reload moves the key space: the first post-swap
+    request MISSES and answers the new generation's bits; the old
+    entries are purged as cache.stale_generation."""
+    from tpu_ir.index.ingest import IngestWriter
+    from tpu_ir.index.segments import LiveIndex
+
+    live = str(tmp_path / "live")
+    LiveIndex.create(live, num_shards=2)
+    rng = random.Random(5)
+    with IngestWriter(live, auto_merge=False) as w:
+        for i in range(30):
+            w.add(f"D-{i:03d}",
+                  " ".join(rng.choice(WORDS) for _ in range(5)))
+        w.compact_all(note="gen A")
+    gen_a = LiveIndex.open(live).current_gen()
+    fe = ServingFrontend(Scorer.load_generation(live, layout="sparse"),
+                         ServingConfig(cache_entries=64))
+    q = "salmon fishing"
+    r_a = fe.search(q, k=5, scoring="bm25")
+    assert r_a.generation == gen_a
+    assert fe.search(q, k=5, scoring="bm25").generation == gen_a
+    reg = obs.get_registry()
+    assert reg.get("cache.hit") == 1
+
+    with IngestWriter(live, auto_merge=False) as w:
+        for i in range(4):
+            w.update(f"D-{i:03d}",
+                     " ".join(rng.choice(WORDS) for _ in range(5)))
+        w.compact_all(note="gen B")
+    gen_b = LiveIndex.open(live).current_gen()
+    fe.reload_generation()
+    assert reg.get("cache.stale_generation") >= 1
+    hits_before = reg.get("cache.hit")
+    r_b = fe.search(q, k=5, scoring="bm25")
+    assert reg.get("cache.hit") == hits_before  # a MISS, by key
+    assert r_b.generation == gen_b
+    ref_b = Scorer.load_generation(live, gen_b, layout="sparse")
+    assert list(r_b) == list(ref_b.search_batch([q], k=5,
+                                                scoring="bm25")[0])
+    # and the new generation's entry serves hits again
+    assert fe.search(q, k=5, scoring="bm25").generation == gen_b
+    assert reg.get("cache.hit") == hits_before + 1
+
+
+# ---------------------------------------------------------------------------
+# the router cache: no fan-out, no hedge, no RTT pollution
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def http_workers(index_dir):
+    started = [serve_worker(index_dir, s, N_SHARDS, layout="sparse",
+                            warm=False) for s in range(N_SHARDS)]
+    yield [[f"127.0.0.1:{srv.port}"] for srv, _, _ in started]
+    for srv, _, _ in started:
+        srv.stop()
+
+
+def test_router_hit_bitidentical_and_skips_fanout(index_dir, scorers,
+                                                  http_workers):
+    ref = scorers["sparse"]
+    reg = obs.get_registry()
+    with Router(index_dir, http_workers,
+                RouterConfig(deadline_ms=30000,
+                             cache_entries=64)) as router:
+        for scoring in ("tfidf", "bm25"):
+            for q in QUERIES[:3]:
+                full = list(ref.search_batch([q], k=5,
+                                             scoring=scoring)[0])
+                miss = router.search(q, k=5, scoring=scoring)
+                rtts_before = [len(st._rtts) for st in router._stats]
+                hits_before = reg.get("cache.hit")
+                hit = router.search(q, k=5, scoring=scoring)
+                assert reg.get("cache.hit") == hits_before + 1
+                # bit-identical to the miss path AND the single-process
+                # oracle — docids, float bits, tie order
+                assert list(hit) == list(miss) == full, (scoring, q)
+                assert Router.classify(hit) == "full"
+                assert hit.shards_ok == tuple(range(N_SHARDS))
+                assert hit.hedges == 0
+                # no worker RPC ran: the trailing-RTT hedge source saw
+                # NOTHING (cache-aware hedging's no-pollution half)
+                assert [len(st._rtts) for st in router._stats] \
+                    == rtts_before
+        # two-phase rerank rides the same cache
+        q = QUERIES[0]
+        miss = router.search(q, k=5, rerank=10)
+        hit = router.search(q, k=5, rerank=10)
+        assert list(hit) == list(miss)
+        # conservation: requests == served_full here (nothing shed)
+        assert reg.get("router.requests") \
+            == reg.get("router.served_full")
+        # the health view carries the cache section
+        h = router.health_summary()
+        assert h["cache"]["entries"] == len(router.cache)
+        assert h["cache"]["cache.hit"] == reg.get("cache.hit")
+
+
+def test_router_hit_never_arms_hedge_timer(index_dir):
+    """A slow primary makes the miss path hedge; the cached repeat must
+    fire ZERO hedges (the hedge timer is never armed — there is no
+    fan-out to hedge)."""
+    import time as _time
+
+    from tpu_ir.obs.server import MetricsServer
+
+    calls = []
+
+    def slow_search(payload):
+        calls.append(1)
+        _time.sleep(0.4)
+        return {"hits": [[1, 3.0]], "level": "full", "degraded": False}
+
+    def fast_search(payload):
+        calls.append(1)
+        return {"hits": [[1, 3.0]], "level": "full", "degraded": False}
+
+    slow = MetricsServer(rpc_handlers={"search": slow_search}).start()
+    fast = MetricsServer(rpc_handlers={"search": fast_search}).start()
+    reg = obs.get_registry()
+    try:
+        with Router(index_dir,
+                    [[f"127.0.0.1:{slow.port}",
+                      f"127.0.0.1:{fast.port}"]],
+                    RouterConfig(deadline_ms=10000, hedge_ms=50.0,
+                                 cache_entries=16)) as router:
+            router._stats[0]._cursor = 1  # slow replica is primary
+            miss = router.search("whatever", k=5, return_docids=False)
+            assert reg.get("router.hedge_fired") == 1
+            assert miss.hedges == 1
+            calls_before = len(calls)
+            hit = router.search("whatever", k=5, return_docids=False)
+            # no hedge fired, no worker dialed, same bits
+            assert reg.get("router.hedge_fired") == 1
+            assert hit.hedges == 0
+            assert len(calls) == calls_before
+            assert list(hit) == list(miss)
+    finally:
+        slow.stop()
+        fast.stop()
+
+
+def test_router_swap_zero_stale_generation_responses(tmp_path):
+    """The swap acceptance, in-process: entries cached at gen A, the
+    fleet rolls to gen B, the swap driver calls note_generation — the
+    very next lookup answers gen B's bits. Every cached response's
+    generation matches a known manifest throughout (zero stale)."""
+    from tpu_ir.index.ingest import IngestWriter
+    from tpu_ir.index.segments import LiveIndex
+
+    live = str(tmp_path / "live")
+    LiveIndex.create(live, num_shards=2)
+    rng = random.Random(9)
+    with IngestWriter(live, auto_merge=False) as w:
+        for i in range(30):
+            w.add(f"D-{i:03d}",
+                  " ".join(rng.choice(WORDS) for _ in range(5)))
+        w.compact_all(note="gen A")
+    gen_a = LiveIndex.open(live).current_gen()
+    with IngestWriter(live, auto_merge=False) as w:
+        for i in range(4):
+            w.update(f"N-{i:03d}",
+                     " ".join(rng.choice(WORDS) for _ in range(5)))
+        w.compact_all(note="gen B")
+    gen_b = LiveIndex.open(live).current_gen()
+
+    workers = [serve_worker(live, s, 2, index_generation=gen_a,
+                            warm=False) for s in range(2)]
+    servers = [w[0] for w in workers]
+    grid = [[f"127.0.0.1:{srv.port}"] for srv in servers]
+    reg = obs.get_registry()
+    known = {gen_a, gen_b}
+    try:
+        with Router(live, grid,
+                    RouterConfig(deadline_ms=10000, health_ttl_s=0.0,
+                                 cache_entries=64)) as router:
+            q = "salmon fishing"
+            r0 = router.search(q, k=5, scoring="bm25")
+            r1 = router.search(q, k=5, scoring="bm25")  # cached, gen A
+            assert r0.generation == r1.generation == gen_a
+            assert reg.get("cache.hit") == 1
+            # the rolling swap + the driver's note to the router
+            out = rolling_swap(grid, generation=gen_b)
+            assert not out["failed"]
+            assert router.note_generation(gen_b) >= 1
+            assert reg.get("cache.stale_generation") >= 1
+            # first post-swap request: a MISS answering gen B's bits
+            hits_before = reg.get("cache.hit")
+            r2 = router.search(q, k=5, scoring="bm25")
+            assert reg.get("cache.hit") == hits_before
+            assert r2.generation == gen_b
+            ref_b = Scorer.load_generation(live, gen_b, layout="sparse")
+            assert list(r2) == list(ref_b.search_batch(
+                [q], k=5, scoring="bm25")[0])
+            # and the repeat is a hit on the NEW generation
+            r3 = router.search(q, k=5, scoring="bm25")
+            assert reg.get("cache.hit") == hits_before + 1
+            assert r3.generation == gen_b and list(r3) == list(r2)
+            for r in (r0, r1, r2, r3):
+                assert r.generation in known
+    finally:
+        for srv in servers:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# the distributed acceptance: zipf traffic + cache through real workers
+# ---------------------------------------------------------------------------
+
+
+def test_distributed_soak_zipf_with_cache(index_dir, tmp_path):
+    """The measured-regime pin: a routed soak under Zipf traffic with
+    the router cache on — conservation holds, every full response
+    (cached or routed) is bit-identical to the serial reference, and
+    the skewed head actually HITS (hit_fraction > 0)."""
+    report = run_distributed_soak(
+        index_dir, shards=2, replicas=1, threads=6, queries=80,
+        seed=0, chaos=False,
+        workload={"kind": "zipf", "skew": 1.2, "burst": 0.0},
+        cache_entries=256,
+        worker_deadline_s=3.0,
+        router_config=RouterConfig(deadline_ms=8000.0, max_queue=128),
+        rundir=str(tmp_path / "run"),
+        flight_dir=str(tmp_path / "flight"),
+        recovery_timeout_s=60.0)
+    assert report["served"] + report["shed"] == report["submitted"]
+    assert report["errors"] == 0, report["error_samples"]
+    assert report["deadlocked"] == 0
+    assert report["full_mismatches"] == 0
+    assert report["partial_mismatches"] == 0
+    assert report["unknown_generation"] == 0
+    wl = report["workload"]
+    assert wl["kind"] == "zipf" and wl["skew"] == 1.2
+    assert wl["seed"] == 0 and wl["burst"] == 0.0
+    cache = report["cache"]
+    assert cache["hit"] > 0, cache
+    assert cache["hit_fraction"] > 0.0
+    assert cache["stale_generation"] == 0
+    assert report["recovery_full"] == report["recovery_probes"]
+
+
+# ---------------------------------------------------------------------------
+# residency hint + df skew
+# ---------------------------------------------------------------------------
+
+
+def test_df_skew_report_math():
+    from tpu_ir.index.doctor import df_skew_report
+
+    # 10 terms: one holds 91 of 100 postings -> decile share 0.91
+    df = np.array([91, 1, 1, 1, 1, 1, 1, 1, 1, 1])
+    rep = df_skew_report(df)
+    assert rep["nonzero_terms"] == 10
+    assert rep["top_decile_terms"] == 1
+    assert rep["top_decile_postings_share"] == pytest.approx(0.91)
+    empty = df_skew_report(np.zeros(4, np.int64))
+    assert empty["top_decile_postings_share"] is None
+
+
+def test_prewarm_residency_is_pure_warmup(scorers):
+    from tpu_ir.serving import prewarm_hot_residency
+
+    sc = scorers["sparse"]
+    before = [list(sc.search_batch([q], k=5, scoring=s)[0])
+              for q in QUERIES for s in ("tfidf", "bm25")]
+    rep = prewarm_hot_residency(sc, mode="1")
+    assert rep["engaged"] is True
+    assert any(w.startswith("strip.") for w in rep["warmed"]), rep
+    after = [list(sc.search_batch([q], k=5, scoring=s)[0])
+             for q in QUERIES for s in ("tfidf", "bm25")]
+    assert after == before  # a hint can never change a bit
+    off = prewarm_hot_residency(sc, mode="0")
+    assert off["engaged"] is False and not off["warmed"]
+
+
+def test_doctor_reports_df_skew(index_dir, capsys):
+    from tpu_ir.cli import main
+
+    assert main(["doctor", index_dir]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    skew = out["df"]["skew"]
+    assert skew["nonzero_terms"] > 0
+    assert 0.0 <= skew["top_decile_postings_share"] <= 1.0
+
+
+def test_worker_healthz_carries_residency(index_dir):
+    srv, fe, sc = serve_worker(index_dir, 0, 2, layout="sparse",
+                               warm=True)
+    try:
+        from tpu_ir.serving.shardset import get_worker_health
+
+        h = get_worker_health(f"127.0.0.1:{srv.port}", 5.0)
+        res = h["worker"]["residency"]
+        assert "engaged" in res and "top_decile_postings_share" in res
+    finally:
+        srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite: TPU_IR_MERGE_AUTO=0 + tpu-ir compact — equivalent end state
+# ---------------------------------------------------------------------------
+
+
+def _ingest_in_batches(live, docs, monkeypatch=None):
+    from tpu_ir.index.ingest import IngestWriter
+    from tpu_ir.index.segments import LiveIndex
+
+    LiveIndex.create(live, num_shards=2)
+    with IngestWriter(live, buffer_docs=4) as w:
+        for docid, text in docs:
+            w.add(docid, text)
+    return LiveIndex.open(live)
+
+
+def test_merge_auto_off_defers_and_compact_drains(tmp_path, monkeypatch):
+    rng = random.Random(11)
+    docs = [(f"D-{i:03d}", " ".join(rng.choice(WORDS) for _ in range(5)))
+            for i in range(24)]
+
+    # inline auto-merge (the default): flushes amortize debt as they go
+    monkeypatch.delenv("TPU_IR_MERGE_AUTO", raising=False)
+    live_auto = _ingest_in_batches(str(tmp_path / "auto"), docs)
+
+    # decoupled: flushes never merge; debt accumulates
+    monkeypatch.setenv("TPU_IR_MERGE_AUTO", "0")
+    live_defer = _ingest_in_batches(str(tmp_path / "defer"), docs)
+    n_defer = len(live_defer.manifest()["segments"])
+    assert n_defer > len(live_auto.manifest()["segments"])
+    assert n_defer == 6  # one segment per 4-doc flush, untouched
+
+    # `tpu-ir compact` drains the deferred debt explicitly
+    from tpu_ir.cli import main
+
+    assert main(["compact", str(tmp_path / "defer")]) == 0
+    drained = live_defer.manifest()
+    assert len(drained["segments"]) < n_defer
+
+    # pinned-equivalent end state: full compaction of both paths yields
+    # the SAME canonical artifacts (metadata checksums equal) — the
+    # merge order never leaks into the bytes
+    from tpu_ir.index import format as fmt
+    from tpu_ir.index.segments import compact, resolve_serving
+
+    compact(live_auto)
+    compact(live_defer)
+    metas = []
+    for d in (str(tmp_path / "auto"), str(tmp_path / "defer")):
+        resolved, _ = resolve_serving(d)
+        metas.append(fmt.IndexMetadata.load(resolved))
+    assert metas[0].num_docs == metas[1].num_docs == len(docs)
+    assert metas[0].checksums == metas[1].checksums
+
+
+def test_compact_cli_all_and_non_live(tmp_path, capsys):
+    from tpu_ir.cli import main
+    from tpu_ir.index.segments import LiveIndex
+
+    assert main(["compact", str(tmp_path / "nope")]) == 1
+    rng = random.Random(2)
+    docs = [(f"D-{i:02d}", " ".join(rng.choice(WORDS) for _ in range(4)))
+            for i in range(9)]
+    live = str(tmp_path / "live")
+    _ingest_in_batches(live, docs)
+    capsys.readouterr()
+    assert main(["compact", live, "--all"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["mode"] == "all"
+    assert len(out["segments"]) == 1
+    assert LiveIndex.open(live).doc_counts()["live"] == 9
+
+
+# ---------------------------------------------------------------------------
+# CLI / bench-check wiring
+# ---------------------------------------------------------------------------
+
+
+def test_serve_bench_skew_validation(index_dir):
+    from tpu_ir.cli import main
+
+    assert main(["serve-bench", index_dir, "--workload", "zipf",
+                 "--skew", "-1", "--shards", "2"]) == 2
+    assert main(["serve-bench", index_dir, "--workload", "zipf",
+                 "--skew", "0,0.7", "--threads", "2",
+                 "--queries", "8"]) == 2  # multi-skew needs --shards
+
+
+def test_bench_check_gates_cache_hit_fraction():
+    from tpu_ir.obs.bench_check import METRICS, check_history
+
+    assert "cache_hit_fraction" in METRICS
+    base = {"config": "serve_routed-100q-s2r1-zipf1.1", "backend": "cpu",
+            "routed_qps": 100.0, "cache_hit_fraction": 0.5}
+    rows = [dict(base) for _ in range(4)]
+    rows.append(dict(base, cache_hit_fraction=0.05))
+    rep = check_history(rows, window=8, min_rows=3, tolerance=0.3)
+    assert rep["status"] == "breach"
+    assert [b["metric"] for b in rep["breaches"]] \
+        == ["cache_hit_fraction"]
+
+
+def test_cache_cli_stats_and_clear(scorers, capsys):
+    from tpu_ir.cli import main
+
+    fe = ServingFrontend(scorers["sparse"],
+                         ServingConfig(cache_entries=16))
+    fe.search("salmon fishing", k=5, scoring="bm25")
+    fe.search("salmon fishing", k=5, scoring="bm25")
+    assert main(["cache"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["counters"]["cache.hit"] == 1
+    assert any(c["name"] == "frontend" and c["entries"] == 1
+               for c in out["caches"])
+    assert main(["cache", "clear"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["cleared_entries"] >= 1
+    assert len(fe.cache) == 0
+    assert obs.get_registry().get("cache.hit") == 0

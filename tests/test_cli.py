@@ -453,6 +453,12 @@ def _smoke_matrix(index_dir: str, corpus: str, tmp) -> dict:
                          "--queries", "8", "--deadline", "5.0"],
                         {"submitted", "served", "shed", "latency",
                          "querylog"}),
+        "cache": (["cache"], {"counters", "caches"}),
+        "compact": (["compact", str(tmp / "live")],
+                    {"steps", "segments", "generation", "mode"}),
+        "serve-worker": (["serve-worker", index_dir, "--shard", "0/2",
+                          "--no-warm", "--run-for", "0.05"],
+                         {"addr", "shard", "num_shards", "doc_range"}),
         "eval": (["eval", str(run), str(qrels)], {"map", "queries"}),
         "pack": (["pack", str(lines), str(tmp / "smoke_packed.trec")],
                  {"docs_packed"}),
@@ -471,7 +477,8 @@ _SMOKE_NAMES = sorted(
     ["index", "search", "inspect", "verify", "migrate-index", "warm",
      "merge", "stats", "metrics", "trace-dump", "profile", "querylog",
      "doctor", "bench-check", "serve-bench", "eval", "pack", "count",
-     "docno", "expand", "lint", "ingest", "generations"])
+     "docno", "expand", "lint", "ingest", "generations", "cache",
+     "compact", "serve-worker"])
 
 
 def test_cli_smoke_matrix_is_complete(setup):

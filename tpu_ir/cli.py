@@ -945,12 +945,38 @@ def _serve_sweep(args, scorer, levels: list) -> int:
     return 0 if all(lv["errors"] == 0 for lv in report["levels"]) else 1
 
 
+def _workload_specs(args) -> list:
+    """The serve-bench workload plan: one (label, spec) per run. The
+    uniform workload is one run (spec None — the legacy seeded mixed
+    draw); `--workload zipf --skew S[,S...]` is one run PER skew level
+    (skew 0 = the uniform-control shape through the same generator, so
+    the per-skew rows stay comparable)."""
+    from .utils import envvars
+
+    kind = args.workload or envvars.get_choice("TPU_IR_WORKLOAD")
+    if kind == "uniform":
+        return [("uniform", None)]
+    if args.skew is None:
+        skews = [envvars.get_float("TPU_IR_WORKLOAD_SKEW")]
+    else:
+        skews = [float(p) for p in str(args.skew).split(",") if p.strip()]
+        if not skews or any(s < 0 for s in skews):
+            raise ValueError(
+                f"--skew {args.skew!r}: expected a non-negative number "
+                "or comma list like 0,0.7,1.1")
+    return [(f"zipf{s:g}", {"kind": "zipf", "skew": s,
+                            "burst": args.burst})
+            for s in skews]
+
+
 def _serve_routed(args) -> int:
-    """The serve-bench scatter-gather mode (ISSUE 10): spawn the S x R
-    worker topology, drive the routed (optionally chaos) soak through
-    the hedging router, print the invariant report, and append the
-    routed_* sentry summary row to BENCH_HISTORY.jsonl where
-    `tpu-ir bench-check` gates it (direction-aware)."""
+    """The serve-bench scatter-gather mode (ISSUE 10 + 15): spawn the
+    S x R worker topology, drive the routed (optionally chaos) soak
+    through the hedging router — once per workload skew level — print
+    the invariant report(s), and append one routed_* sentry summary row
+    per level to BENCH_HISTORY.jsonl where `tpu-ir bench-check` gates
+    it (direction-aware; cache_hit_fraction / routed_qps /
+    routed_p99_ms recorded per skew)."""
     import jax
 
     from .obs.bench_check import append_history_row
@@ -964,42 +990,71 @@ def _serve_routed(args) -> int:
         print("--shards mode runs one single-device scorer per worker; "
               "use --layout sparse or dense", file=sys.stderr)
         return 2
+    try:
+        specs = _workload_specs(args)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    from .serving.result_cache import resolve_capacity
+
+    cache_n = resolve_capacity(args.cache)
+    reports = []
+    ok = True
     with _MaybeTrack(args.metrics_port) as track:
-        report = run_distributed_soak(
-            args.index_dir, shards=args.shards, replicas=args.replicas,
-            threads=args.threads, queries=args.queries, seed=args.seed,
-            layout=layout, chaos=args.chaos,
-            worker_deadline_s=(1.0 if args.deadline is None
-                               else args.deadline),
-            timeout_s=args.timeout, flight_dir=args.flight_dir)
-        if track.server is not None:
-            report["metrics_url"] = track.server.url
-    req_lat = report["latency"].get("router.request") or {}
-    p99 = req_lat.get("p99_ms")
-    row = {
-        # chaos runs are a structurally different regime (a third of
-        # the soak serves with a shard down) — their own comparability
-        # group, so they never drag the healthy medians
-        "config": (f"serve_routed-{report['submitted']}q-"
-                   f"s{args.shards}r{args.replicas}"
-                   + ("-chaos" if args.chaos else "")),
-        "backend": jax.default_backend(),
-        "shards": args.shards,
-        "replicas": args.replicas,
-        "routed_qps": (round(report["served"] / report["wall_s"], 1)
-                       if report["wall_s"] else -1.0),
-        "routed_p99_ms": -1.0 if p99 is None else p99,
-        "partial_fraction": report["partial_fraction"],
-        "hedge_fired": report["router"].get("router.hedge_fired", 0),
-        "recovery_full": report["recovery_full"],
-    }
-    report["history"] = append_history_row(row)
-    report["history_row"] = row
-    print(json.dumps(report, sort_keys=True, default=repr))
-    ok = (report["errors"] == 0 and report["deadlocked"] == 0
-          and report["full_mismatches"] == 0
-          and report["partial_mismatches"] == 0
-          and report["served"] + report["shed"] == report["submitted"])
+        for label, spec in specs:
+            report = run_distributed_soak(
+                args.index_dir, shards=args.shards,
+                replicas=args.replicas,
+                threads=args.threads, queries=args.queries,
+                seed=args.seed,
+                layout=layout, chaos=args.chaos,
+                worker_deadline_s=(1.0 if args.deadline is None
+                                   else args.deadline),
+                timeout_s=args.timeout, flight_dir=args.flight_dir,
+                workload=spec, cache_entries=args.cache)
+            if track.server is not None:
+                report["metrics_url"] = track.server.url
+            req_lat = report["latency"].get("router.request") or {}
+            p99 = req_lat.get("p99_ms")
+            row = {
+                # chaos runs, each workload shape, and cache-on vs
+                # cache-off are structurally different regimes — each
+                # gets its own comparability group, so none drags
+                # another's medians (a cached run's 2x QPS must not
+                # read as an uncached regression, or vice versa)
+                "config": (f"serve_routed-{report['submitted']}q-"
+                           f"s{args.shards}r{args.replicas}"
+                           + ("-chaos" if args.chaos else "")
+                           + ("" if label == "uniform" else f"-{label}")
+                           + (f"-c{cache_n}" if cache_n else "")),
+                "backend": jax.default_backend(),
+                "shards": args.shards,
+                "replicas": args.replicas,
+                "workload": label,
+                "cache_entries": cache_n,
+                "routed_qps": (round(report["served"]
+                                     / report["wall_s"], 1)
+                               if report["wall_s"] else -1.0),
+                "routed_p99_ms": -1.0 if p99 is None else p99,
+                "cache_hit_fraction": report["cache"]["hit_fraction"],
+                "partial_fraction": report["partial_fraction"],
+                "hedge_fired": report["router"].get(
+                    "router.hedge_fired", 0),
+                "recovery_full": report["recovery_full"],
+            }
+            report["history"] = append_history_row(row)
+            report["history_row"] = row
+            reports.append(report)
+            ok = ok and (
+                report["errors"] == 0 and report["deadlocked"] == 0
+                and report["full_mismatches"] == 0
+                and report["partial_mismatches"] == 0
+                and report["served"] + report["shed"]
+                == report["submitted"])
+    out = reports[0] if len(reports) == 1 else {
+        "runs": reports,
+        "levels": [r["history_row"]["workload"] for r in reports]}
+    print(json.dumps(out, sort_keys=True, default=repr))
     return 0 if ok else 1
 
 
@@ -1038,6 +1093,25 @@ def cmd_serve_bench(args) -> int:
         return 2
     if not levels:
         levels = [4]
+    try:
+        wl_specs = _workload_specs(args)
+    except ValueError as e:
+        print(str(e), file=sys.stderr)
+        return 2
+    if len(wl_specs) > 1:
+        print("a multi-skew sweep records per-skew ROUTED rows; use "
+              "--shards N with --skew 0,0.7,1.1 (the single-process "
+              "soak takes one skew)", file=sys.stderr)
+        return 2
+    workload = wl_specs[0][1]
+    if len(levels) > 1 and workload is not None:
+        # the sweep drives its own fixed closed-loop query set; running
+        # it anyway would silently record uniform rows under a zipf flag
+        print("--concurrency sweep and --workload zipf are exclusive: "
+              "the sweep measures coalescing under a fixed query set; "
+              "use the soak (single --concurrency) for traffic shapes",
+              file=sys.stderr)
+        return 2
     scorer = Scorer.load(args.index_dir, layout=args.layout)
     if len(levels) > 1:
         return _serve_sweep(args, scorer, levels)
@@ -1067,8 +1141,10 @@ def cmd_serve_bench(args) -> int:
                 deadline_s=(0.25 if args.deadline is None
                             else args.deadline),
                 breaker_threshold=args.breaker_threshold,
-                coalesce=(args.coalesce == "on")),
-            timeout_s=args.timeout, flight_dir=args.flight_dir)
+                coalesce=(args.coalesce == "on"),
+                cache_entries=args.cache),
+            timeout_s=args.timeout, flight_dir=args.flight_dir,
+            workload=workload)
         if track.server is not None:
             report["metrics_url"] = track.server.url
     # the soak's query-log view: recorded/slow counts + the last slow
@@ -1082,6 +1158,124 @@ def cmd_serve_bench(args) -> int:
           and report["untagged_mismatches"] == 0
           and report["served"] + report["shed"] == report["submitted"])
     return 0 if ok else 1
+
+
+def cmd_cache(args) -> int:
+    """The result-cache tier's CLI (ISSUE 15; serving/result_cache.py):
+    `stats` prints the process-wide cache.* counters + every live
+    cache's control-plane snapshot; `clear` drops all live caches'
+    entries and resets the cache.* counters. Per-process like
+    `tpu-ir stats` — meaningful from a serving or bench process."""
+    from .obs import get_registry
+    from .serving.result_cache import cache_counters, clear_all, live_caches
+
+    out = {
+        "counters": cache_counters(),
+        "caches": [c.snapshot() for c in live_caches()],
+    }
+    if args.verb == "clear":
+        out["cleared_entries"] = clear_all()
+        get_registry().reset_counters("cache.")
+        out["counters_reset"] = True
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def cmd_compact(args) -> int:
+    """Explicit merge/compaction driver for a live index (ISSUE 15
+    satellite — the other half of TPU_IR_MERGE_AUTO=0): by default
+    drains the tiered merge policy's debt (repeated plan_merges steps —
+    exactly what auto-merge would have run inline after flushes); with
+    --all folds EVERYTHING into one canonical servable segment.
+    Serving never waits on this: readers keep their committed
+    generation until the final atomic rename publishes the next."""
+    _apply_backend(args)
+    from .index import segments as seg
+    from .index.ingest import IngestWriter
+
+    if not seg.is_live(args.live_dir):
+        print(f"error: {args.live_dir} is not a live index dir "
+              "(`tpu-ir ingest DIR --init` creates one)", file=sys.stderr)
+        return 1
+    writer = IngestWriter(args.live_dir, auto_merge=False)
+    before = writer.live.manifest()
+    if args.all:
+        m = writer.compact_all()
+        steps = 1
+    else:
+        drained = writer.drain_merges(max_steps=args.max_steps)
+        m, steps = drained["manifest"], drained["steps"]
+    out = {
+        "live_dir": os.path.abspath(args.live_dir),
+        "mode": "all" if args.all else "drain",
+        "steps": steps,
+        "segments_before": len(before["segments"]),
+        "segments": m["segments"],
+        "generation": writer.live.current_gen(),
+        **writer.live.doc_counts(),
+    }
+    if args.gc:
+        out["gc"] = writer.live.gc()
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def cmd_serve_worker(args) -> int:
+    """Standalone shard worker (ISSUE 15 satellite; ROADMAP 5 cross-host
+    ergonomics): load a doc-range-restricted scorer and serve the /rpc
+    surface the router fans out to — the same serve_worker() the
+    ShardSet subprocesses run, minus the parent-death plumbing, so a
+    static address grid can span hosts (`Router(dir, [[\"hostA:9201\"],
+    [\"hostB:9201\"]])`; RUNBOOK §21 has the recipe). Prints one ready
+    JSON line (addr/shard/pid) on stdout, then serves until SIGTERM /
+    Ctrl-C; --run-for S bounds the lifetime (smoke tests, drills)."""
+    _apply_backend(args)
+    import threading as _threading
+
+    from .serving.shardset import serve_worker
+
+    try:
+        shard_s, _, total_s = args.shard.partition("/")
+        shard, num_shards = int(shard_s), int(total_s)
+    except ValueError:
+        print(f"--shard {args.shard!r}: expected i/S (e.g. 0/4)",
+              file=sys.stderr)
+        return 2
+    if not (0 <= shard < num_shards):
+        print(f"--shard {args.shard!r}: shard index out of range",
+              file=sys.stderr)
+        return 2
+    layout = "sparse" if args.layout == "auto" else args.layout
+    server, frontend, scorer = serve_worker(
+        args.index_dir, shard, num_shards, layout=layout,
+        port=args.port, host=args.host, replica=args.replica,
+        deadline_s=args.deadline,
+        max_concurrency=args.concurrency, max_queue=args.queue_depth,
+        warm=not args.no_warm)
+    print(json.dumps({
+        "addr": f"{args.host}:{server.port}", "port": server.port,
+        "shard": shard, "num_shards": num_shards,
+        "replica": args.replica, "pid": os.getpid(),
+        "index_generation": scorer.generation,
+        "doc_range": list(scorer.doc_range or ()),
+    }, sort_keys=True), flush=True)
+    stop = _threading.Event()
+    try:
+        import signal as _signal
+
+        _signal.signal(_signal.SIGTERM, lambda *_: stop.set())
+    except (ValueError, OSError):  # non-main thread (tests)
+        pass
+    try:
+        stop.wait(args.run_for if args.run_for else None)
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.stop()
+        from . import faults
+
+        faults.drain_abandoned(timeout_s=5.0)
+    return 0
 
 
 def cmd_eval(args) -> int:
@@ -1583,6 +1777,27 @@ def main(argv: list[str] | None = None) -> int:
     pb.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded"],
                     default="auto")
+    pb.add_argument("--workload", choices=["uniform", "zipf"],
+                    default=None,
+                    help="traffic shape (serving/workload.py): uniform "
+                         "= the legacy seeded mixed draw; zipf = "
+                         "rank-skewed term draw over the df-ordered "
+                         "vocabulary. Default: TPU_IR_WORKLOAD")
+    pb.add_argument("--skew", default=None, metavar="S[,S...]",
+                    help="Zipf exponent(s) for --workload zipf; a comma "
+                         "list in --shards mode runs one routed soak "
+                         "PER level and appends one BENCH_HISTORY row "
+                         "each (0 = uniform control). Default: "
+                         "TPU_IR_WORKLOAD_SKEW")
+    pb.add_argument("--burst", type=float, default=None,
+                    help="diurnal burst amplitude for the workload "
+                         "arrival schedule (default: "
+                         "TPU_IR_WORKLOAD_BURST)")
+    pb.add_argument("--cache", type=int, default=None, metavar="N",
+                    help="generation-keyed exact-hit result cache "
+                         "capacity (entries) at the router / frontend "
+                         "(serving/result_cache.py); 0 disables. "
+                         "Default: TPU_IR_CACHE_RESULTS")
     pb.add_argument("--flight-dir", default=None,
                     help="where an invariant breach writes its "
                          "flight-recorder JSONL (default: "
@@ -1595,6 +1810,72 @@ def main(argv: list[str] | None = None) -> int:
                          "stderr)")
     _add_backend_arg(pb)
     pb.set_defaults(fn=cmd_serve_bench)
+
+    pca = sub.add_parser(
+        "cache",
+        help="result-cache tier introspection: cache.* counters + live "
+             "cache snapshots (stats), or drop every live cache's "
+             "entries and reset the counters (clear)")
+    pca.add_argument("verb", nargs="?", choices=["stats", "clear"],
+                     default="stats")
+    pca.set_defaults(fn=cmd_cache)
+
+    pco = sub.add_parser(
+        "compact",
+        help="drive live-index merges explicitly (the TPU_IR_MERGE_AUTO"
+             "=0 companion): drain the tiered merge policy's debt, or "
+             "--all for full compaction into one canonical segment")
+    pco.add_argument("live_dir")
+    pco.add_argument("--all", action="store_true",
+                     help="full compaction (every segment + tombstone "
+                          "folded into one canonical servable segment)")
+    pco.add_argument("--max-steps", type=int, default=64,
+                     help="bound on tiered merge steps when draining")
+    pco.add_argument("--gc", action="store_true",
+                     help="prune old generation manifests + "
+                          "unreferenced segment dirs afterwards")
+    _add_backend_arg(pco)
+    pco.set_defaults(fn=cmd_compact)
+
+    psw = sub.add_parser(
+        "serve-worker",
+        help="standalone shard worker for cross-host serving: serve "
+             "one doc-shard's /rpc surface on a fixed port so a "
+             "router's static address grid can span hosts")
+    psw.add_argument("index_dir")
+    psw.add_argument("--shard", required=True, metavar="i/S",
+                     help="this worker's shard index and the total "
+                          "shard count, e.g. 0/4 (every worker and the "
+                          "router derive the same doc partition)")
+    psw.add_argument("--port", type=int, default=0,
+                     help="listen port (0 = ephemeral, announced in "
+                          "the ready JSON)")
+    psw.add_argument("--host", default="127.0.0.1",
+                     help="bind address; a cross-host worker must bind "
+                          "a routable interface (0.0.0.0 or the host's "
+                          "address) — the loopback default is only "
+                          "reachable from the same machine")
+    psw.add_argument("--replica", type=int, default=0,
+                     help="replica index within the shard (identity "
+                          "only; shown in /healthz)")
+    psw.add_argument("--layout",
+                     choices=["auto", "dense", "sparse"],
+                     default="auto")
+    psw.add_argument("--deadline", type=float, default=None,
+                     help="per-request device dispatch deadline (s)")
+    psw.add_argument("--concurrency", type=int, default=4,
+                     help="admission: requests executing at once")
+    psw.add_argument("--queue-depth", type=int, default=16,
+                     help="admission: max requests waiting for a slot")
+    psw.add_argument("--no-warm", action="store_true",
+                     help="skip the compile-shape warm-up + residency "
+                          "prewarm (faster start; the first requests "
+                          "pay the compiles instead)")
+    psw.add_argument("--run-for", type=float, default=None, metavar="S",
+                     help="serve for S seconds then exit (default: "
+                          "until SIGTERM/Ctrl-C)")
+    _add_backend_arg(psw)
+    psw.set_defaults(fn=cmd_serve_worker)
 
     pe = sub.add_parser("eval", help="score a trec_eval-format run file "
                                      "against qrels (MAP/MRR/NDCG@10/...)")

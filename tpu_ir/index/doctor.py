@@ -76,6 +76,30 @@ def _balance(values) -> float | None:
     return round(max(v) / (sum(v) / len(v)), 4)
 
 
+def df_skew_report(df: np.ndarray) -> dict:
+    """The df-skew signal (ISSUE 15): how much of the postings mass the
+    top-df decile of (nonzero-df) terms soaks up. This is the doctor's
+    report AND the per-worker hot-postings residency hint's input
+    (serving/residency.py) — one computation, two consumers, so the
+    hint can never drift from what the doctor shows an operator.
+    A share near 1.0 means a Zipf-shaped corpus: pre-warming the
+    top-decile postings (block-max strips / dense tf matrix) at load
+    buys almost every query's hot work."""
+    df = np.asarray(df).reshape(-1)
+    nz = np.sort(df[df > 0])[::-1]
+    if not len(nz):
+        return {"nonzero_terms": 0, "top_decile_terms": 0,
+                "top_decile_postings_share": None}
+    decile = max(int(len(nz) * 0.1), 1)
+    total = int(nz.sum())
+    return {
+        "nonzero_terms": int(len(nz)),
+        "top_decile_terms": int(decile),
+        "top_decile_postings_share": round(
+            int(nz[:decile].sum()) / max(total, 1), 4),
+    }
+
+
 def _tier_report(df: np.ndarray, num_docs: int) -> dict:
     """The tier-occupancy report, from the SAME assignment the serving
     layout builder runs (search/layout.py::plan_tiers)."""
@@ -284,6 +308,9 @@ def doctor_report(index_dir: str, top_terms: int = 10) -> dict:
                for k, v in _pct(nz).items()},
             "top_terms": top,
             f"top{top_terms}_postings_fraction": top_share,
+            # the residency hint's input (serving/residency.py consumes
+            # this exact shape): postings share of the top-df decile
+            "skew": df_skew_report(df),
         },
         "shards": {
             "per_shard": shards,

@@ -66,14 +66,21 @@ class IngestWriter:
     single-writer discipline)."""
 
     def __init__(self, live_dir: str, *, buffer_docs: int | None = None,
-                 auto_merge: bool = True):
+                 auto_merge: bool | None = None):
         from ..utils import envvars
 
         self.live = LiveIndex.open(live_dir)
         self.buffer_docs = (buffer_docs if buffer_docs is not None
                             else envvars.get_int(
                                 "TPU_IR_INGEST_BUFFER_DOCS"))
-        self.auto_merge = auto_merge
+        # auto_merge=None defers to TPU_IR_MERGE_AUTO (ISSUE 15): 0
+        # decouples compaction from flush — ingest stops paying merge
+        # cost inline, debt accumulates until `tpu-ir compact` (or an
+        # explicit maybe_merge/drain_merges call) drains it. The end
+        # state is pinned equivalent: merges are bit-deterministic, so
+        # deferred-then-drained == merged-inline after full compaction.
+        self.auto_merge = (auto_merge if auto_merge is not None
+                           else envvars.get_bool("TPU_IR_MERGE_AUTO"))
         self._buf: dict[str, str] = {}   # docid -> text, arrival order
         self._tombs: dict[str, set] = {}  # segment -> dead docids
         self._doc_seg: dict[str, str] | None = None  # lazy live view
@@ -202,6 +209,22 @@ class IngestWriter:
         m = compact(self.live, groups[0], note="auto-merge")
         self._doc_seg = None  # segment ownership moved; rebuild lazily
         return m
+
+    def drain_merges(self, *, max_steps: int = 64) -> dict:
+        """Run tiered merge steps until no tier carries debt (the
+        `tpu-ir compact` default): each step takes plan_merges' first
+        group, exactly what auto-merge would have run after some flush.
+        Returns {steps, manifest} — manifest is the final one even when
+        zero steps ran."""
+        steps = 0
+        m = self.live.manifest()
+        while steps < max_steps:
+            out = self.maybe_merge()
+            if out is None:
+                break
+            m = out
+            steps += 1
+        return {"steps": steps, "manifest": m}
 
     def compact_all(self, *, note: str = "compact") -> dict:
         """Full compaction: every segment + every tombstone folded into

@@ -112,6 +112,12 @@ METRICS: dict[str, tuple[str, float]] = {
     "routed_p99_ms": ("lower", 50.0),
     "partial_fraction": ("lower", 0.05),
     "hedge_fired": ("lower", 5.0),
+    # result-cache tier (ISSUE 15; per-skew serve_routed rows): the
+    # realized exact-hit fraction under the row's workload shape — a
+    # collapse means the cache silently disengaged (key drift, a
+    # generation bump storm, capacity misconfig). The 0.05 floor
+    # absorbs draw-to-draw jitter in which head queries repeat.
+    "cache_hit_fraction": ("higher", 0.05),
     # streaming-build phase walls (ISSUE 11: wiki/build_scale rows) —
     # the radix restructure's whole point is driving pass2_combine_s
     # down, so the sentry gates each pass plus the end-to-end build

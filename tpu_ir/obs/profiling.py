@@ -546,9 +546,24 @@ def profile_report() -> dict:
                          "blockmax.saved_dispatches",
                          "blockmax.fallback_dispatches")
         },
+        # the result-cache tier (ISSUE 15): hit/miss/evict/stale
+        # counters + derived hit fraction, the lookup-cost histogram,
+        # and every live cache's control-plane snapshot — read next to
+        # the dispatch split to see what each hit SKIPPED paying
+        "cache": _cache_section(snap, hists),
         "gauges": snap.get("gauges", {}),
         "memory": memory_snapshot(),
     }
+
+
+def _cache_section(snap: dict, hists: dict) -> dict:
+    from ..serving.result_cache import cache_counters, live_caches
+
+    out = dict(cache_counters())
+    if "cache.lookup" in hists:
+        out["cache.lookup"] = hists["cache.lookup"]
+    out["caches"] = [c.snapshot() for c in live_caches()]
+    return out
 
 
 def reset_profile() -> None:

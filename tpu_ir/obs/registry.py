@@ -91,6 +91,9 @@ SERVING_COUNTER_NAMES = (
     "submitted", "degraded", "breaker_opened", "breaker_probes",
     "served_breaker_host",
     "served_full", "served_no_rerank", "served_hot_only",
+    # result-cache tier (ISSUE 15): requests answered from the
+    # frontend's exact-hit cache — no admission slot, no dispatch
+    "served_cache",
     "shed_level", "shed_queue_full", "shed_queue_timeout",
     "level_step_down", "level_step_up",
     # live index (ISSUE 12): one frontend published a new generation's
@@ -182,13 +185,24 @@ PRUNE_COUNTER_NAMES = (
     "blockmax.saved_dispatches", "blockmax.fallback_dispatches",
 )
 
+# Generation-keyed exact-hit result cache (ISSUE 15,
+# serving/result_cache.py): hit/miss the lookup verdicts (hit_fraction =
+# hit / (hit + miss)), evict the LRU displacements under the bounded
+# capacity, stale_generation the entries invalidated because the serving
+# generation moved past them (unreachable by key the moment the
+# generation bumped — the count is accounting for the purge, never the
+# invalidation mechanism).
+CACHE_COUNTER_NAMES = (
+    "cache.hit", "cache.miss", "cache.evict", "cache.stale_generation",
+)
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
 ) + (COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
      + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES + INGEST_COUNTER_NAMES
-     + PRUNE_COUNTER_NAMES)
+     + PRUNE_COUNTER_NAMES + CACHE_COUNTER_NAMES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
@@ -226,6 +240,10 @@ DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     "ingest.flush",
     "merge.run",
     "generation.swap",
+    # result-cache tier (ISSUE 15): one cache lookup (key build + LRU
+    # probe) — the cost a hit pays INSTEAD of the fan-out/dispatch, so
+    # p50 here vs router.request/request.full is the cache's win
+    "cache.lookup",
 )
 
 # Gauges: point-in-time values (memory levels, cache sizes) — unlike

@@ -241,6 +241,11 @@ def health_snapshot() -> dict:
         out["breaker"] = latest.get("breaker")
         out["ladder"] = latest.get("ladder")
         out["queue_depth"] = latest.get("queue_depth")
+        # the result-cache tier (ISSUE 15): hit fraction collapsing
+        # under steady traffic is an alerting-grade signal (the cache
+        # disengaged), so the newest frontend's view rides top-level;
+        # router caches appear under shards.cache
+        out["cache"] = latest.get("cache")
         # the live-index generation this process currently serves
         # (ISSUE 12) — the rolling-swap driver confirms handoffs here
         out["generation"] = latest.get("generation")

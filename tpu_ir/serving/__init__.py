@@ -15,6 +15,9 @@ from .frontend import (
     ServingFrontend,
 )
 from .generation import rolling_swap, swap_microbench
+from .residency import prewarm_hot_residency, residency_hint
+from .result_cache import ResultCache, cache_counters, live_caches
+from .workload import Workload, resolve_workload
 from .router import (
     Router,
     RouterConfig,
@@ -41,4 +44,7 @@ __all__ = [
     "run_soak", "make_queries", "run_concurrency_sweep",
     "run_distributed_soak", "DEFAULT_CHAOS_PLAN",
     "rolling_swap", "swap_microbench",
+    "Workload", "resolve_workload",
+    "ResultCache", "cache_counters", "live_caches",
+    "prewarm_hot_residency", "residency_hint",
 ]

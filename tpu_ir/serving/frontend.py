@@ -85,6 +85,10 @@ class ServingConfig:
     batch_width: int | None = None         # pinned analyzed query width
     precompile: bool = True           # walk the ladder at start
     precompile_ks: tuple = (10,)      # k depths the ladder walk warms
+    # generation-keyed exact-hit result cache (ISSUE 15;
+    # result_cache.py), consulted ahead of admission and the coalescer.
+    # None defers to TPU_IR_CACHE_RESULTS; 0 disables.
+    cache_entries: int | None = None
 
 
 class DegradationLadder:
@@ -187,6 +191,17 @@ class ServingFrontend:
         # tear a request across two scorers — or hand it a batcher
         # whose internal scorer is not the one it captured
         self._serving = (scorer, self._make_batcher(scorer))
+        # the single-process exact-hit result cache (ISSUE 15): keyed
+        # on analyzed term ids + every route-selecting flag + the
+        # serving generation; consulted BEFORE admission (a hit costs
+        # no slot) and ahead of the coalescer
+        from .result_cache import ResultCache, resolve_capacity
+
+        cap = resolve_capacity(cfg.cache_entries)
+        self.cache = (ResultCache(cap, name="frontend")
+                      if cap > 0 else None)
+        if self.cache is not None:
+            self.cache.bump_generation(scorer.generation)
         self._counters = RecoveryCounters()
         # the embedded metrics server's /healthz reports this frontend's
         # breaker/ladder/queue state for as long as it is alive (weakref
@@ -243,6 +258,12 @@ class ServingFrontend:
             scorer = self.scorer.reload_generation(generation)
         batcher = self._make_batcher(scorer)
         self._serving = (scorer, batcher)   # THE publish
+        if self.cache is not None:
+            # invalidation is by KEY (the generation is in it); the
+            # bump purges the now-unreachable old-generation entries so
+            # the bounded capacity serves the new corpus, and counts
+            # them as cache.stale_generation
+            self.cache.bump_generation(scorer.generation)
         self._count("generation_swap")
         reg = obs.get_registry()
         reg.set_gauge("generation.current", scorer.generation)
@@ -284,6 +305,10 @@ class ServingFrontend:
         out["generation"] = scorer.generation
         if batcher is not None:
             out["batching"] = batcher.snapshot()
+        if self.cache is not None:
+            from .result_cache import cache_counters
+
+            out["cache"] = {**self.cache.snapshot(), **cache_counters()}
         return out
 
     # -- the request path --------------------------------------------------
@@ -327,6 +352,27 @@ class ServingFrontend:
                 raise Overloaded("shed_level",
                                  queue_depth=self.admission.queue_depth(),
                                  level=level)
+            # exact-hit result cache (ISSUE 15), ahead of admission AND
+            # the coalescer: a hit costs the lookup alone — no slot, no
+            # breaker consult, no dispatch — and replays a stored
+            # full-route response bit-identically
+            cache_key = self._cache_key(scorer, text, k=k,
+                                        scoring=scoring, rerank=rerank,
+                                        level=level, snippets=snippets,
+                                        explain_k=explain_k,
+                                        return_docids=return_docids)
+            if cache_key is not None:
+                t_lookup = time.perf_counter()
+                hit = self.cache.get(cache_key)
+                self._observe_latency("cache.lookup", t_lookup)
+                if hit is not None:
+                    res = SearchResult(hit)
+                    res.level = level
+                    res.generation = scorer.generation
+                    root.set("cached", True)
+                    self._count("served_cache")
+                    self._observe_latency(f"request.{level}", t0)
+                    return res
             timeout = (self.config.queue_timeout_s
                        if self.config.queue_timeout_s is not None
                        else self.config.deadline_s)
@@ -346,6 +392,15 @@ class ServingFrontend:
                                       scorer=scorer, batcher=batcher)
                 finally:
                     admit_cm.__exit__(None, None, None)
+                if (cache_key is not None and not res.degraded
+                        and not res.partial):
+                    # only clean outcomes are frozen: a degraded
+                    # response is transient serving weather, and the
+                    # key's level flags already guarantee this entry
+                    # can only answer requests the ladder would route
+                    # identically
+                    self.cache.put(cache_key, tuple(res),
+                                   generation=res.generation)
                 self._observe_latency(f"request.{level}", t0)
                 return res
             except Overloaded as e:
@@ -356,6 +411,35 @@ class ServingFrontend:
                 self.ladder.observe(pressure=1.0, failed=False)
                 self._observe_latency("request.shed", t0)
                 raise
+
+    def _cache_key(self, scorer: Scorer, text: str, *, k: int,
+                   scoring: str, rerank: int | None, level: str,
+                   snippets: bool, explain_k: int,
+                   return_docids: bool) -> tuple | None:
+        """The exact-hit cache key for one request, or None when the
+        request is not cacheable (cache off; phrase/glob/fuzzy text —
+        operator expansion must not collide with literal terms;
+        explain/snippet requests — they attach per-request artifacts;
+        raw-docno requests — the worker RPC surface rides the ROUTER
+        cache above it instead).
+
+        Normalized terms are the analyzed term-id SEQUENCE (order and
+        multiplicity preserved: float accumulation follows slot order,
+        so reordering terms may change result bits — the key must not
+        merge such requests). Every flag that selects the traced
+        program or the serving route is in the key, plus the captured
+        scorer's generation — a swap moves the key space, never the
+        entries."""
+        from .result_cache import cacheable_text
+
+        if (self.cache is None or snippets or explain_k
+                or not return_docids or not cacheable_text(text)):
+            return None
+        row = scorer.analyze_queries([text])[0]
+        terms = tuple(int(t) for t in row if t >= 0)
+        use_rerank = rerank if level == LEVEL_FULL else None
+        return (terms, int(k), scoring, use_rerank,
+                level == LEVEL_HOT_ONLY, int(scorer.generation))
 
     def _serve(self, text: str, *, k: int, scoring: str,
                rerank: int | None, snippets: bool,

@@ -1,0 +1,92 @@
+"""Hot-postings residency hint (ISSUE 15, tentpole d).
+
+A worker's first requests after load pay for lazy device state: the
+block-max pre-weighted strips (the deep-k lever from ISSUE 13 — built
+per scoring mode on first use) and, on dense layouts, the [V, D+1] tf
+matrix. Under Zipf traffic that cost lands exactly where it hurts most:
+on the HEAD queries, whose terms are the top-df postings.
+
+This module turns `tpu-ir doctor`'s df-skew report into a load-time
+residency decision: when the top-df decile of terms holds most of the
+postings mass (the hot strip IS the head of the query distribution —
+search/layout.plan_tiers promotes terms by df, so the strip covers the
+top-df terms by construction), pre-build the strips / tf matrix at
+worker start, before the ready file is written. The first routed
+request then finds every head-term structure already device-resident.
+
+TPU_IR_HOT_RESIDENCY: auto (engage when the decile share clears
+SKEW_ENGAGE), 1 (force), 0 (off). The hint is a pure warm-up — it
+builds exactly the state the first requests would lazily build, so it
+can never change a bit of any response.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+logger = logging.getLogger(__name__)
+
+# auto mode engages when the top-df decile holds at least this share of
+# the postings mass — below it, the corpus is flat enough that eager
+# residency mostly warms postings uniform traffic rarely revisits
+SKEW_ENGAGE = 0.5
+
+
+def residency_hint(scorer) -> dict:
+    """The df-skew signal for THIS scorer's (possibly doc-range-
+    restricted) df column — the same computation the doctor reports
+    (index/doctor.df_skew_report)."""
+    from ..index.doctor import df_skew_report
+
+    return df_skew_report(scorer._df_host())
+
+
+def prewarm_hot_residency(scorer, *, mode: str | None = None) -> dict:
+    """Apply the residency hint to one loaded scorer; returns the
+    decision report (/healthz worker identity carries it). Safe to call
+    on any layout — it only ever touches state the layout actually
+    serves from."""
+    from ..utils import envvars
+
+    if mode is None:
+        mode = envvars.get_choice("TPU_IR_HOT_RESIDENCY")
+    hint = residency_hint(scorer)
+    share = hint.get("top_decile_postings_share")
+    engage = mode == "1" or (mode == "auto" and share is not None
+                             and share >= SKEW_ENGAGE)
+    report = {"mode": mode, "engaged": bool(engage), "warmed": [],
+              **hint}
+    if not engage:
+        return report
+    t0 = time.perf_counter()
+    warmed = report["warmed"]
+    if scorer.layout == "sparse":
+        # the block-max pre-weighted strips, one per scoring mode (the
+        # TF-IDF strip doubles as the cosine rerank's) — each is one
+        # device buffer over the hot (= top-df) strip
+        for scoring in ("tfidf", "bm25"):
+            try:
+                if scorer._hot_wstrip(scoring) is not None:
+                    warmed.append(f"strip.{scoring}")
+            except Exception:  # noqa: BLE001 — a hint must never fail a load
+                logger.exception("residency strip warm (%s)", scoring)
+        # the per-mode block-max bound tables ride the same hot strip;
+        # warm them only when the index carries stored bounds
+        if getattr(scorer, "_hot_blk_max", None) is not None:
+            for scoring in ("tfidf", "bm25"):
+                try:
+                    scorer._blockmax_bound_table(scoring)
+                    warmed.append(f"bounds.{scoring}")
+                except Exception:  # noqa: BLE001
+                    logger.exception("residency bounds warm (%s)", scoring)
+    elif scorer.layout == "dense":
+        # dense BM25 + the explain kernels score from the lazy tf
+        # matrix — on this layout it IS the postings residency
+        try:
+            scorer._ensure_tf_matrix()
+            warmed.append("tf_matrix")
+        except Exception:  # noqa: BLE001
+            logger.exception("residency tf-matrix warm")
+    report["warm_s"] = round(time.perf_counter() - t0, 4)
+    return report

@@ -1,0 +1,226 @@
+"""Generation-keyed exact-hit result cache (ISSUE 15).
+
+The serving tier's first cache layer: a bounded LRU mapping an EXACT
+request identity to its full-level response. The key is
+
+    (normalized terms, k, scoring, rerank, hot_only, generation)
+
+— every field that selects the traced program or the serving route,
+PLUS the index generation that would answer a miss. The generation
+component is what makes staleness structurally impossible: a live-index
+swap (ISSUE 12) bumps the generation, every subsequent lookup key names
+the new generation, and every pre-swap entry becomes UNREACHABLE — the
+cache is invalidated by key construction, never by a correctness-
+critical scan. (`bump_generation` does purge the dead entries, but
+that is capacity hygiene + accounting: by the time it runs, no lookup
+can reach them.)
+
+Exact-hit only, full-level only: an entry is stored from a non-degraded
+non-partial response and replayed verbatim, so a hit is BIT-IDENTICAL
+to the miss path — same docids, same float bits, same tie order (the
+same contract every prior serving layer carries; the property suite
+pins hit == miss across layouts x scorings x rerank). Degraded and
+partial responses are transient serving weather and are never frozen
+into the cache.
+
+Two deployments share this class:
+- the Router's fan-out cache (serving/router.py): a hit skips the
+  entire shard fan-out — no RPC, no hedge timer, no shard-RTT sample
+  (cache-aware hedging: the trailing-p99 hedge estimate only ever sees
+  real worker round trips);
+- the ServingFrontend's single-process variant (serving/frontend.py),
+  consulted ahead of admission and the coalescer.
+
+Telemetry: cache.hit / cache.miss / cache.evict / cache.stale_generation
+counters + the cache.lookup histogram (obs/registry.py), `tpu-ir cache`
+(stats / clear), and cache sections on /healthz and /profile.
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+
+from ..obs import get_registry
+
+# live caches, weakly referenced — the `tpu-ir cache` CLI and /profile
+# enumerate them; registration must not extend an owner's lifetime
+_live_caches: list = []
+_live_lock = threading.Lock()
+
+
+def _drop_dead_ref(ref) -> None:
+    # weakref finalizer: keep the registry bounded by live owners (a
+    # process that churns Routers/frontends must not grow this forever)
+    with _live_lock:
+        try:
+            _live_caches.remove(ref)
+        except ValueError:
+            pass
+
+
+def live_caches() -> list:
+    """The process's live ResultCache instances (newest last)."""
+    with _live_lock:
+        alive = []
+        for ref in _live_caches:
+            c = ref()
+            if c is not None:
+                alive.append(c)
+        return alive
+
+
+def clear_all() -> int:
+    """Drop every live cache's entries (the `tpu-ir cache clear` verb);
+    returns the number of entries dropped."""
+    return sum(c.clear() for c in live_caches())
+
+
+def normalize_terms(text: str) -> tuple:
+    """The router-side key normalization: whitespace-collapse only.
+    The router has no analyzer (workers analyze), so this is the
+    strongest normalization that is PROVABLY result-preserving — two
+    texts with equal splits are byte-equal modulo whitespace, and the
+    workers' analyzer is whitespace-insensitive. Weaker normalization
+    than the frontend's analyzed-term-id key costs only missed hits,
+    never a wrong one."""
+    return tuple(text.split())
+
+
+def cacheable_text(text: str) -> bool:
+    """Texts the exact-hit key covers: no phrase spans (host-scored,
+    not routable anyway) and no glob/fuzzy operators — those expand
+    against the vocabulary at analyze time, and a normalized key that
+    dropped the operator would collide with the literal query."""
+    return not any(ch in text for ch in '"*?~')
+
+
+class ResultCache:
+    """Bounded thread-safe LRU of (key -> (generation, payload)).
+
+    `name` labels this instance in stats ("router" / "frontend").
+    `capacity` <= 0 disables puts and gets (a convenience so callers
+    can construct unconditionally). The payload is opaque to the cache
+    (the owners store raw hit tuples + response metadata); `generation`
+    rides alongside for the swap-time purge accounting."""
+
+    def __init__(self, capacity: int, *, name: str = "cache"):
+        self.name = name
+        self.capacity = int(capacity)
+        self._lock = threading.Lock()
+        self._entries: OrderedDict = OrderedDict()
+        self._generation = 0
+        with _live_lock:
+            _live_caches.append(weakref.ref(self, _drop_dead_ref))
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    # -- the key-generation axis -------------------------------------------
+
+    def generation(self) -> int:
+        with self._lock:
+            return self._generation
+
+    def bump_generation(self, gen: int) -> int:
+        """Advance the cache's generation (monotonic — a stale caller
+        cannot walk it backwards). Entries keyed to older generations
+        are already unreachable (the generation is IN the key); this
+        purges them so the bounded capacity serves the new generation,
+        and counts them as cache.stale_generation. Returns the number
+        purged."""
+        purged = 0
+        with self._lock:
+            if gen <= self._generation:
+                return 0
+            self._generation = int(gen)
+            dead = [k for k, (g, _) in self._entries.items() if g < gen]
+            for k in dead:
+                del self._entries[k]
+            purged = len(dead)
+        if purged:
+            get_registry().incr("cache.stale_generation", purged)
+        return purged
+
+    # -- lookup / store ----------------------------------------------------
+
+    def get(self, key: tuple):
+        """The payload for `key`, or None (counts cache.hit/cache.miss;
+        a disabled cache counts nothing). Hits refresh LRU order."""
+        if not self.enabled:
+            return None
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+        reg = get_registry()
+        if entry is None:
+            reg.incr("cache.miss")
+            return None
+        reg.incr("cache.hit")
+        return entry[1]
+
+    def put(self, key: tuple, payload, *, generation: int) -> None:
+        """Store one full-level response payload under its exact key.
+        An entry older than the cache's current generation is refused
+        (a slow miss completing after a swap must not resurrect the old
+        corpus in a fresh slot)."""
+        if not self.enabled:
+            return
+        evicted = 0
+        with self._lock:
+            if generation < self._generation:
+                return
+            self._entries[key] = (int(generation), payload)
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                evicted += 1
+        if evicted:
+            get_registry().incr("cache.evict", evicted)
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._entries)
+            self._entries.clear()
+        return n
+
+    # -- introspection -----------------------------------------------------
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def snapshot(self) -> dict:
+        """Control-plane state for /healthz, /profile and `tpu-ir
+        cache`: size/capacity/generation, never entry contents (the
+        querylog redaction story must hold here too)."""
+        with self._lock:
+            return {"name": self.name, "capacity": self.capacity,
+                    "entries": len(self._entries),
+                    "generation": self._generation}
+
+
+def cache_counters() -> dict:
+    """The process-wide cache.* counter view + derived hit fraction
+    (`tpu-ir cache stats`, the /profile cache section, soak reports)."""
+    from ..obs.registry import CACHE_COUNTER_NAMES
+
+    reg = get_registry()
+    out = {name: reg.get(name) for name in CACHE_COUNTER_NAMES}
+    looked = out["cache.hit"] + out["cache.miss"]
+    out["hit_fraction"] = (round(out["cache.hit"] / looked, 4)
+                           if looked else 0.0)
+    return out
+
+
+def resolve_capacity(explicit: int | None) -> int:
+    """Capacity resolution shared by RouterConfig / ServingConfig: an
+    explicit setting wins; None defers to TPU_IR_CACHE_RESULTS."""
+    if explicit is not None:
+        return max(int(explicit), 0)
+    from ..utils import envvars
+
+    return envvars.get_int("TPU_IR_CACHE_RESULTS")

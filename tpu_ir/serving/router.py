@@ -93,6 +93,11 @@ class RouterConfig:
     max_queue: int = 64                # routed requests allowed to wait
     rtt_window: int = 64               # trailing RTTs per shard (p99 src)
     health_ttl_s: float | None = None  # worker-health poll cache age
+    # generation-keyed exact-hit result cache (ISSUE 15;
+    # result_cache.py): a hit skips the whole fan-out — no RPC, no
+    # hedge timer, no shard-RTT sample. None defers to
+    # TPU_IR_CACHE_RESULTS; 0 disables.
+    cache_entries: int | None = None
 
 
 def merge_shard_topk(shard_hits, k: int) -> list:
@@ -220,6 +225,19 @@ class Router:
             "ranges": self._ranges, "mapping": None}
         self.admission = AdmissionController(cfg.max_concurrency,
                                              cfg.max_queue)
+        # the fan-out result cache (ISSUE 15): exact-hit, keyed by
+        # normalized terms + route flags + the newest generation this
+        # router has seen WIN a merge — a rolling swap moves the key
+        # space, making every old-generation entry unreachable
+        from .result_cache import ResultCache, resolve_capacity
+
+        cap = resolve_capacity(cfg.cache_entries)
+        self.cache = ResultCache(cap, name="router") if cap > 0 else None
+        # the cache generation deliberately starts at 0 and converges
+        # from RESPONSES (and note_generation), not from the newest
+        # servable manifest: a fleet pinned to an older generation (the
+        # upgrade soak's pre-swap phase) must still cache — the cache
+        # follows what the workers actually serve, never the filesystem
         self._breakers: dict = {}
         self._breakers_lock = threading.Lock()
         self._stats = [_ShardStats(cfg.rtt_window)
@@ -494,6 +512,24 @@ class Router:
                              "them through a single-process frontend")
         t0 = time.perf_counter()
         get_registry().incr("router.requests")
+        # exact-hit cache, ahead of admission and the fan-out (ISSUE
+        # 15): a request that will be served from cache never takes an
+        # admission slot, never dials a worker, and never arms a hedge
+        # timer — and because no replica RPC runs, the per-shard
+        # trailing-p99 hedge estimate only ever sees real round trips
+        cache_key = self._cache_key(text, k=k, scoring=scoring,
+                                    rerank=rerank)
+        if cache_key is not None:
+            t_lookup = time.perf_counter()
+            entry = self.cache.get(cache_key)
+            self._observe("cache.lookup", t_lookup)
+            if entry is not None:
+                res = self._from_cache(entry, return_docids=return_docids)
+                self._observe("router.request", t0)
+                self._count_served(res)
+                self._querylog(text, res, k=k, scoring=scoring,
+                               rerank=rerank, t0=t0, cached=True)
+                return res
         with obs_trace("request", scoring=scoring, router=True) as root:
             try:
                 admit = self.admission.admit(
@@ -520,6 +556,20 @@ class Router:
                 admit.__exit__(None, None, None)
             root.set("partial", res.partial)
             root.set("level", res.level)
+        if self.cache is not None:
+            # follow the fleet: the newest generation to win a merge
+            # moves the cache's key space (old entries go unreachable)
+            self.cache.bump_generation(int(res.generation))
+            if cache_key is not None and self.classify(res) == "full":
+                # only clean full-route responses are frozen — partial
+                # and degraded responses are weather; stored as raw
+                # docnos so one entry serves both docid flavors
+                self.cache.put(
+                    (cache_key[0], int(res.generation)) + cache_key[2:],
+                    {"hits": tuple(res), "shards_ok": res.shards_ok,
+                     "generation": int(res.generation),
+                     "level": res.level},
+                    generation=int(res.generation))
         if return_docids and len(res):
             # the docno->docid mapping of the generation that ANSWERED
             # — a gen-A mapping applied to gen-B docnos would silently
@@ -531,6 +581,55 @@ class Router:
         self._querylog(text, res, k=k, scoring=scoring, rerank=rerank,
                        t0=t0)
         return res
+
+    def _cache_key(self, text: str, *, k: int, scoring: str,
+                   rerank: int | None) -> tuple | None:
+        """The router-side exact-hit key, or None when uncacheable
+        (cache off; glob/fuzzy operators — their expansion is vocab-
+        dependent and must not collide with literal terms). Terms are
+        whitespace-normalized only (the router has no analyzer; weaker
+        normalization costs missed hits, never wrong ones); slot 1 is
+        the newest generation this router has seen win — the lookup
+        face of invalidation-by-key."""
+        from .result_cache import cacheable_text, normalize_terms
+
+        if self.cache is None or not cacheable_text(text):
+            return None
+        return (normalize_terms(text), self.cache.generation(),
+                int(k), scoring, rerank)
+
+    def _from_cache(self, entry: dict, *, return_docids: bool):
+        """Rebuild a SearchResult from a stored full-route payload —
+        bit-identical to the miss path by construction (the stored hits
+        ARE a miss path's merge; the docno->docid mapping is
+        deterministic per generation)."""
+        from ..search.scorer import SearchResult
+
+        res = SearchResult((int(d), float(s)) for d, s in entry["hits"])
+        res.generation = entry["generation"]
+        res.level = entry["level"]
+        res.shards_ok = tuple(entry["shards_ok"])
+        res.missing_shards = ()
+        res.partial = False
+        res.degraded = False
+        res.hedges = 0
+        if return_docids and len(res):
+            mapping = self._mapping_loaded(res.generation)
+            res[:] = [(mapping.get_docid(int(d)), s) for d, s in res]
+        return res
+
+    def note_generation(self, generation: int) -> int:
+        """Tell the router a newer index generation is being rolled out
+        (the rolling-swap driver calls this the moment every replica
+        confirmed): the cache key space moves immediately instead of
+        waiting for the first new-generation response to win a merge —
+        without it, a head query cached pre-swap could keep answering
+        from the old (still known, correctly tagged) generation until
+        traffic happened to reveal the new one. Returns purged entry
+        count; no-op without a cache."""
+        if self.cache is None:
+            return 0
+        return self.cache.bump_generation(int(generation))
 
     def _winning_generation(self, got: dict) -> tuple[int, dict, bool]:
         """Split one fan-out's responses by the index generation each
@@ -669,11 +768,13 @@ class Router:
             get_registry().observe(name, time.perf_counter() - t0)
 
     def _querylog(self, text: str, res, *, k: int, scoring: str,
-                  rerank: int | None, t0: float) -> None:
+                  rerank: int | None, t0: float,
+                  cached: bool = False) -> None:
         from ..obs import querylog
 
         entry = {
             "router": True,
+            "cached": cached,
             "query_hash": querylog.query_hash(text.split()),
             "k": k, "scoring": scoring, "rerank": rerank,
             "level": res.level, "degraded": bool(res.degraded),
@@ -745,6 +846,11 @@ class Router:
                    # index_generation rides in its replica entry)
                    "generations_seen": gens,
                    "shards": shards}
+        if self.cache is not None:
+            from .result_cache import cache_counters
+
+            payload["cache"] = {**self.cache.snapshot(),
+                                **cache_counters()}
         with self._health_lock:
             self._health_cache = (time.monotonic(), payload)
         return payload

@@ -143,6 +143,7 @@ def worker_rpc_handlers(frontend, scorer=None, *, reload_fn=None) -> dict:
 
 def serve_worker(index_dir: str, shard: int, num_shards: int, *,
                  layout: str = "sparse", port: int = 0,
+                 host: str = "127.0.0.1",
                  replica: int = 0, generation: int = 0,
                  index_generation: int | None = None,
                  deadline_s: float | None = None,
@@ -185,6 +186,11 @@ def serve_worker(index_dir: str, shard: int, num_shards: int, *,
     frontend = ServingFrontend(scorer, ServingConfig(
         max_concurrency=max_concurrency, max_queue=max_queue,
         deadline_s=deadline_s))
+    # the hot-postings residency hint (ISSUE 15, serving/residency.py):
+    # fed by the doctor's df-skew report over THIS shard's df column —
+    # on a Zipf-shaped corpus the block-max strips / tf matrix go
+    # device-resident at load, before the ready file is written
+    residency = {"engaged": False}
 
     def info() -> dict:
         sc = frontend.scorer
@@ -194,28 +200,36 @@ def serve_worker(index_dir: str, shard: int, num_shards: int, *,
             "generation": generation,
             "index_generation": sc.generation,
             "live": live,
+            "residency": residency,
             "pid": os.getpid(), "layout": sc.layout,
         }}
 
     reload_fn = None
     if live:
         def reload_fn(gen: int | None) -> dict:
+            from .residency import prewarm_hot_residency
+
             new = load_for(gen)
             if warm:
                 # warm BEFORE the publish: the first post-swap request
                 # must not eat an XLA compile inside a shard deadline
                 _warm_worker(new)
+                residency.clear()
+                residency.update(prewarm_hot_residency(new))
             frontend.reload_generation(new)
             return {"generation": new.generation,
                     "num_docs": new.meta.num_docs,
                     "doc_range": list(new.doc_range or ())}
 
     server = MetricsServer(
-        port=port,
+        port=port, host=host,
         rpc_handlers=worker_rpc_handlers(frontend, reload_fn=reload_fn),
         extra_health=info).start()
     if warm:
+        from .residency import prewarm_hot_residency
+
         _warm_worker(scorer)
+        residency.update(prewarm_hot_residency(scorer))
     return server, frontend, scorer
 
 

@@ -43,10 +43,21 @@ DEFAULT_CHAOS_PLAN = ("score.hang:p=0.12:sleep=0.6,"
                       "score.device_loss:p=0.08,seed=1")
 
 
-def make_queries(scorer, n: int, seed: int = 0) -> list[dict]:
+def make_queries(scorer, n: int, seed: int = 0,
+                 workload=None) -> list[dict]:
     """A deterministic mixed workload over the index's own vocabulary:
     1-3 term queries, tfidf/bm25 split, ~25% requesting the two-stage
-    rerank. Seeded so a soak run is replayable."""
+    rerank. Seeded so a soak run is replayable.
+
+    `workload` (ISSUE 15; serving/workload.py) reshapes the traffic:
+    None defers to TPU_IR_WORKLOAD (default uniform = this function's
+    historical draw, bit-reproducible), "zipf"/a Workload instance
+    draws terms rank-skewed over the df-ordered vocabulary."""
+    from .workload import resolve_workload
+
+    wl = resolve_workload(scorer, workload, seed=seed)
+    if wl is not None:
+        return wl.make_queries(n, seed=seed)
     rng = random.Random(seed)
     terms = list(scorer.vocab.terms)
     if not terms:
@@ -66,6 +77,25 @@ def make_queries(scorer, n: int, seed: int = 0) -> list[dict]:
 
 def _req_key(r: dict) -> tuple:
     return (r["text"], r["scoring"], r["rerank"], r["k"])
+
+
+def _cache_counters_now() -> dict:
+    from ..obs.registry import CACHE_COUNTER_NAMES
+
+    reg = obs.get_registry()
+    return {n: reg.get(n) for n in CACHE_COUNTER_NAMES}
+
+
+def _cache_delta(before: dict) -> dict:
+    """THIS run's result-cache activity (registry delta — repeated
+    soaks in one process must not bleed), with the derived hit
+    fraction the bench rows record per skew level."""
+    now = _cache_counters_now()
+    out = {n.split(".", 1)[1]: now[n] - before.get(n, 0) for n in now}
+    looked = out["hit"] + out["miss"]
+    out["hit_fraction"] = (round(out["hit"] / looked, 4)
+                           if looked else 0.0)
+    return out
 
 
 def _serial_reference(scorer, reqs: list[dict]) -> dict:
@@ -93,7 +123,7 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
              config: ServingConfig | None = None,
              timeout_s: float = 120.0, pacing_s: float = 0.004,
              flight_dir: str | None = None,
-             coalesce: bool = False) -> dict:
+             coalesce: bool = False, workload=None) -> dict:
     """Run the soak; returns the invariant report (no asserts here — the
     callers decide what is fatal; tests assert on the report fields).
     The report's `latency` section holds per-stage p50/p95/p99 for the
@@ -113,9 +143,12 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
     batch, degradation is uniform (`batch_mixed_degraded` == 0 — the
     dispatch outcome is shared, so no slot can be charged a deadline a
     batch-mate's slow slot burned while it itself was served clean)."""
+    from .workload import resolve_workload
+
     if faults.active() is not None:
         raise RuntimeError("a fault plan is already installed")
-    reqs = make_queries(scorer, queries, seed=seed)
+    wl = resolve_workload(scorer, workload, seed=seed)
+    reqs = make_queries(scorer, queries, seed=seed, workload=wl)
     # JobTracker-style progress: /jobs shows the soak's reference and
     # concurrent phases live, with percent-complete over the request
     # count (obs/progress.py; the `tpu-ir serve-bench --metrics-port`
@@ -146,6 +179,7 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
         frontend = ServingFrontend(scorer, cfg)
         recovery_before = recovery_counters().snapshot()
         hist_before = obs.get_registry().hist_state()
+        cache_before = _cache_counters_now()
         results: list = [None] * len(reqs)
 
         def worker(i: int, r: dict) -> None:
@@ -153,9 +187,13 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
                 # spread arrivals (seeded jitter): back-to-back submission of
                 # the whole workload is a thundering herd, which the ladder
                 # answers by shedding everything — pacing keeps the soak
-                # exercising RECOVERY too, not just collapse
+                # exercising RECOVERY too, not just collapse. A workload
+                # burst schedule compresses/stretches the jitter window
+                # per request — the diurnal wave.
+                scale = (wl.pacing_scale(i / max(len(reqs), 1))
+                         if wl is not None else 1.0)
                 time.sleep(random.Random(seed * 1_000_003 + i).random()
-                           * pacing_s * threads)
+                           * pacing_s * threads * scale)
             try:
                 results[i] = ("ok", frontend.search(
                     r["text"], k=r["k"], scoring=r["scoring"],
@@ -260,6 +298,9 @@ def run_soak(scorer, *, threads: int = 8, queries: int = 240,
                 hist_before, always=("admission_wait", "dispatch", "kernel",
                                      "fallback")),
         }
+        if wl is not None:
+            report["workload"] = wl.describe()
+        report["cache"] = _cache_delta(cache_before)
         if frontend.batcher is not None:
             report["batching"] = frontend.stats().get("batching")
             # the per-slot-attribution invariant: entries that shared a
@@ -318,7 +359,9 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                          rundir: str | None = None,
                          flight_dir: str | None = None,
                          recovery_probes: int = 16,
-                         recovery_timeout_s: float = 60.0) -> dict:
+                         recovery_timeout_s: float = 60.0,
+                         workload=None,
+                         cache_entries: int | None = None) -> dict:
     """The scatter-gather chaos soak (ISSUE 10): mixed traffic through a
     REAL multi-process topology — S doc shards x R replica workers
     behind a Router — while a chaos controller SIGKILLs a replica, then
@@ -363,6 +406,8 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
     from .router import Router, RouterConfig
     from .shardset import ShardSet
 
+    from .workload import resolve_workload
+
     if faults.active() is not None:
         raise RuntimeError("a fault plan is already installed")
     if upgrade_at is not None and not seg.is_live(index_dir):
@@ -370,7 +415,8 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                          "(index/segments.py; `tpu-ir ingest --init`)")
     ref_scorer = Scorer.load_generation(index_dir, layout=layout)
     gen_a = ref_scorer.generation
-    reqs = make_queries(ref_scorer, queries, seed=seed)
+    wl = resolve_workload(ref_scorer, workload, seed=seed)
+    reqs = make_queries(ref_scorer, queries, seed=seed, workload=wl)
 
     # -- generation B: prepared up front, swapped in mid-soak ----------
     gen_b = None
@@ -435,6 +481,7 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
         counters_before = {n: reg.get(n) for n in reg.counter_names()
                            if n.startswith("router.")}
         hist_before = reg.hist_state()
+        cache_before = _cache_counters_now()
         obs.report_progress("serve", total=len(reqs))
         results: list = [None] * len(reqs)
         completion_order: list = [0] * len(reqs)
@@ -452,9 +499,16 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
             # failover/partial paths never wait it out), so a large
             # budget only spares slow-but-alive workers on a contended
             # CI box — it does not slow loss detection.
-            router = Router(index_dir, shardset,
-                            router_config
-                            or RouterConfig(deadline_ms=3000.0))
+            cfg_r = router_config or RouterConfig(deadline_ms=3000.0)
+            if cache_entries is not None \
+                    and cfg_r.cache_entries != cache_entries:
+                # an explicit soak-level cache size must not be
+                # silently ignored just because a caller also tuned
+                # the router knobs (the run_soak coalesce rule)
+                from dataclasses import replace as _replace
+
+                cfg_r = _replace(cfg_r, cache_entries=cache_entries)
+            router = Router(index_dir, shardset, cfg_r)
             try:
                 # -- chaos + upgrade controller -----------------------
                 killed: list = []
@@ -499,6 +553,13 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                                 try:
                                     out = rolling_swap(shardset,
                                                        generation=gen_b)
+                                    # the swap driver tells the router
+                                    # (ISSUE 15): the result cache's
+                                    # key space moves NOW, not when
+                                    # traffic happens to reveal gen B —
+                                    # a pre-swap head-query entry must
+                                    # not stretch the mixed window
+                                    router.note_generation(gen_b)
                                     with progress_lock:
                                         swap_state["done_at"] = \
                                             progress[0]
@@ -523,9 +584,11 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
 
                 def worker(i: int, r: dict) -> None:
                     if pacing_s:
+                        scale = (wl.pacing_scale(i / max(len(reqs), 1))
+                                 if wl is not None else 1.0)
                         time.sleep(random.Random(
                             seed * 1_000_003 + i).random()
-                            * pacing_s * threads)
+                            * pacing_s * threads * scale)
                     try:
                         results[i] = ("ok", router.search(
                             r["text"], k=r["k"], scoring=r["scoring"],
@@ -715,7 +778,13 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
             "latency": reg.delta_summary(
                 hist_before, always=("router.request", "router.shard_rtt",
                                      "router.merge")),
+            # the result-cache tier's activity for THIS run (ISSUE 15):
+            # hit/miss/evict/stale_generation deltas + hit fraction —
+            # the per-skew numbers the bench rows record
+            "cache": _cache_delta(cache_before),
         }
+        if wl is not None:
+            report["workload"] = wl.describe()
         if upgrade_at is not None:
             report["upgrade"] = {
                 "generation_a": gen_a,

@@ -1,0 +1,207 @@
+"""Traffic-shape models for the serving soaks and `tpu-ir serve-bench`.
+
+Every soak before ISSUE 15 drove UNIFORM random queries at a flat
+arrival rate — the one shape production traffic never has. Real query
+logs are Zipf-distributed (a handful of head queries dominate; web
+query-log studies measure exponents around 0.7-1.2) and arrive in
+diurnal waves. This module makes that a first-class, SEEDED model:
+
+- **query popularity**: each request draws a query RANK from a Zipf(s)
+  distribution over a large query universe (the query-log shape: a
+  handful of head queries soak up the volume; s = 0 is the uniform
+  control — with a 100k-query universe, repeats are negligible). A
+  rank deterministically materializes one request (text + scoring +
+  rerank), so a repeated rank is a repeated REQUEST — the exact-hit
+  cache's fuel, exactly as in a real log.
+- **term draw**: each query's terms are drawn over the index's own
+  vocabulary, ranked by document frequency (df descending), with term
+  rank r drawn proportional to 1/r^s — head queries use head terms, so
+  the head of the query distribution correlates with the head of the
+  postings distribution (which is what makes the hot-postings
+  residency hint pay).
+- **query-length distribution**: seeded 1..3 terms per query (the
+  legacy soak's shape), configurable.
+- **request mix**: the soak's historical tfidf/bm25 split and ~25%
+  rerank fraction, so Zipf rows stay comparable to the uniform history.
+- **diurnal burst schedule** (optional): `pacing_scale(frac)` modulates
+  inter-arrival pacing sinusoidally over the run — amplitude b means
+  peak-rate traffic arrives ~(1+b)x faster than trough traffic.
+
+Determinism: one `Workload` with one seed yields one query list and one
+arrival schedule, so every soak remains replayable — the property the
+whole chaos harness rides on. The draw itself is a cumulative-weight
+inverse transform (no numpy RNG state), so it is stable across numpy
+versions.
+"""
+
+from __future__ import annotations
+
+import math
+import random
+from bisect import bisect_left
+
+import numpy as np
+
+# the legacy soak mix (soak.make_queries): preserved so a Zipf run
+# differs from the uniform history ONLY in the term draw + arrivals
+SCORINGS = ("tfidf", "bm25")
+RERANK_CHOICES = (None, None, None, 25)
+BURST_CYCLES = 2.0  # "diurnal" periods across one soak run
+
+
+class Workload:
+    """One seeded traffic model over a fixed term universe.
+
+    `terms` must already be ranked most-popular-first (df descending for
+    the from_scorer constructor); `skew` is the Zipf exponent s (0 =
+    uniform). `burst` is the diurnal amplitude (0 = flat arrivals)."""
+
+    # distinct queries the popularity draw ranges over: large enough
+    # that the s=0 control virtually never repeats a request, small
+    # enough that the rank CDF builds in microseconds
+    UNIVERSE = 100_000
+
+    def __init__(self, terms, *, skew: float = 0.0, seed: int = 0,
+                 burst: float = 0.0, lengths=(1, 3), k: int = 10,
+                 universe: int | None = None):
+        self.terms = list(terms)
+        if not self.terms:
+            raise ValueError("workload needs a non-empty term universe")
+        self.skew = float(skew)
+        self.seed = int(seed)
+        self.burst = float(burst)
+        self.lengths = (int(lengths[0]), int(lengths[1]))
+        self.k = int(k)
+        self.universe = int(universe or self.UNIVERSE)
+        # cumulative 1/r^s weights: draw by inverse transform (bisect
+        # on one random float). s = 0 degenerates to the exact uniform
+        # draw. One CDF over term ranks (within-query content), one
+        # over query ranks (request popularity) — same exponent.
+        self._term_cum, self._term_total = self._zipf_cdf(
+            len(self.terms))
+        self._rank_cum, self._rank_total = self._zipf_cdf(self.universe)
+        self._rank_cache: dict[int, dict] = {}
+
+    def _zipf_cdf(self, n: int) -> tuple[list, float]:
+        ranks = np.arange(1, n + 1, dtype=np.float64)
+        cum = np.cumsum(ranks ** (-self.skew))
+        return cum.tolist(), float(cum[-1])
+
+    @classmethod
+    def from_scorer(cls, scorer, *, kind: str | None = None,
+                    skew: float | None = None, seed: int = 0,
+                    burst: float | None = None) -> "Workload | None":
+        """Build the model from a loaded scorer's vocabulary, ranked by
+        df descending (stable — ties keep vocabulary order, so the rank
+        list is deterministic per generation). `kind`/`skew`/`burst`
+        default to the TPU_IR_WORKLOAD* env knobs; returns None for the
+        uniform kind — callers fall back to the legacy query maker,
+        keeping historical soak rows bit-reproducible."""
+        from ..utils import envvars
+
+        kind = kind or envvars.get_choice("TPU_IR_WORKLOAD")
+        if kind == "uniform":
+            return None
+        if skew is None:
+            skew = envvars.get_float("TPU_IR_WORKLOAD_SKEW")
+        if burst is None:
+            burst = envvars.get_float("TPU_IR_WORKLOAD_BURST")
+        terms = list(scorer.vocab.terms)
+        if not terms:
+            raise ValueError("scorer has an empty vocabulary")
+        df = _df_ranking(scorer, len(terms))
+        if df is not None:
+            order = np.argsort(-df, kind="stable")
+            terms = [terms[int(i)] for i in order]
+        return cls(terms, skew=skew, seed=seed, burst=burst)
+
+    # -- the draw ----------------------------------------------------------
+
+    def draw_term(self, rng: random.Random) -> str:
+        i = bisect_left(self._term_cum, rng.random() * self._term_total)
+        return self.terms[min(i, len(self.terms) - 1)]
+
+    def draw_rank(self, rng: random.Random) -> int:
+        """One query-popularity rank (0-based) from the Zipf(s) draw
+        over the query universe."""
+        i = bisect_left(self._rank_cum, rng.random() * self._rank_total)
+        return min(i, self.universe - 1)
+
+    def query_for_rank(self, rank: int) -> dict:
+        """The request query rank `rank` ALWAYS materializes to — one
+        deterministic per-rank RNG seeds the length, term and
+        scoring/rerank draws, so a repeated rank is a repeated exact
+        request (text AND route flags), like a real query log."""
+        cached = self._rank_cache.get(rank)
+        if cached is not None:
+            return dict(cached)
+        rng = random.Random((self.seed + 1) * 0x9E3779B1 + rank)
+        lo, hi = self.lengths
+        req = {
+            "text": " ".join(self.draw_term(rng)
+                             for _ in range(rng.randint(lo, hi))),
+            "scoring": rng.choice(SCORINGS),
+            "rerank": rng.choice(RERANK_CHOICES),
+            "k": self.k,
+        }
+        if len(self._rank_cache) < 4096:  # head ranks; bounded
+            self._rank_cache[rank] = req
+        return dict(req)
+
+    def make_queries(self, n: int, seed: int | None = None) -> list[dict]:
+        """The soak request list: same dict shape as soak.make_queries
+        (text/scoring/rerank/k) — each request is the materialization
+        of one Zipf-drawn query rank."""
+        rng = random.Random(self.seed if seed is None else seed)
+        return [self.query_for_rank(self.draw_rank(rng))
+                for _ in range(int(n))]
+
+    # -- the arrival schedule ----------------------------------------------
+
+    def pacing_scale(self, frac: float) -> float:
+        """Multiplier on the soak's inter-arrival pacing for the request
+        at completed-fraction `frac` of the run: 1.0 everywhere when
+        burst = 0; otherwise a sinusoid over BURST_CYCLES periods whose
+        trough paces ~(1+burst)x slower than its peak — the compressed
+        diurnal wave. Mean pacing stays near the flat schedule so a
+        burst run's wall clock is comparable to its flat twin."""
+        if self.burst <= 0.0:
+            return 1.0
+        wave = 0.5 + 0.5 * math.sin(2.0 * math.pi * BURST_CYCLES
+                                    * float(frac))
+        # wave=1 (peak) -> 1/(1+b); wave=0 (trough) -> 1+b... normalized
+        # around 1: peak arrivals are (1+b)x denser than trough arrivals
+        return (1.0 + self.burst * (1.0 - wave)) / (1.0 + self.burst / 2.0)
+
+    def describe(self) -> dict:
+        return {"kind": "zipf", "skew": self.skew, "seed": self.seed,
+                "burst": self.burst, "terms": len(self.terms),
+                "universe": self.universe,
+                "lengths": list(self.lengths)}
+
+
+def _df_ranking(scorer, vocab_size: int) -> np.ndarray | None:
+    """The df vector for rank ordering, best-effort: the scorer's device
+    df array when its length matches the vocabulary (tiered/sharded
+    serving layouts keep the full-vocab df), else None (vocabulary
+    order — still deterministic, just unranked)."""
+    df = getattr(scorer, "df", None)
+    if df is None:
+        return None
+    host = np.asarray(df).reshape(-1)
+    if len(host) < vocab_size:
+        return None
+    return host[:vocab_size].astype(np.int64)
+
+
+def resolve_workload(scorer, workload, *, seed: int = 0):
+    """Normalize a soak's `workload` argument: None defers to the
+    TPU_IR_WORKLOAD env knobs, "uniform"/"zipf" build from the scorer
+    (skew/burst from env), a dict spec ({"kind", "skew", "burst"} —
+    the serve-bench per-skew sweep) builds explicitly, a Workload
+    instance passes through. Returns None for uniform."""
+    if workload is None or isinstance(workload, str):
+        return Workload.from_scorer(scorer, kind=workload, seed=seed)
+    if isinstance(workload, dict):
+        return Workload.from_scorer(scorer, seed=seed, **workload)
+    return workload

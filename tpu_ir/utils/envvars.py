@@ -223,6 +223,38 @@ _declare("TPU_IR_BLOCKMAX_BLOCKS", "int", 0,
          "overflow the budget fall back to the exact full-width stage "
          "in-kernel (bit-identical, counted as blockmax.fallback)",
          "§20", minimum=0)
+_declare("TPU_IR_MERGE_AUTO", "bool", True,
+         "0 decouples compaction from flush: IngestWriter stops running "
+         "the tiered merge policy inline after every flush — drive "
+         "merges explicitly with `tpu-ir compact` (ingest latency stops "
+         "paying merge cost; merge debt accumulates until drained)",
+         "§19")
+_declare("TPU_IR_CACHE_RESULTS", "int", 0,
+         "entry capacity of the generation-keyed exact-hit result cache "
+         "(router fan-out cache + the serving frontend's single-process "
+         "variant); 0 disables both. Hits are bit-identical to the miss "
+         "path and invalidate by key on a generation swap", "§21",
+         minimum=0)
+_declare("TPU_IR_WORKLOAD", "choice", "uniform",
+         "traffic shape for soaks and serve-bench: uniform (the legacy "
+         "seeded mixed workload) or zipf (rank-skewed term draw over "
+         "the index vocabulary — the 'millions of users' shape)", "§21",
+         choices=("uniform", "zipf"))
+_declare("TPU_IR_WORKLOAD_SKEW", "float", 1.1,
+         "Zipf exponent s for --workload zipf: term rank r drawn with "
+         "probability proportional to 1/r^s (0 = uniform control; web "
+         "query logs measure ~0.7-1.2)", "§21", minimum=0.0)
+_declare("TPU_IR_WORKLOAD_BURST", "float", 0.0,
+         "diurnal burst amplitude for the workload arrival schedule: 0 "
+         "= flat arrivals; b > 0 modulates inter-arrival pacing "
+         "sinusoidally so peak-rate traffic runs ~(1+b)x the trough",
+         "§21", minimum=0.0)
+_declare("TPU_IR_HOT_RESIDENCY", "choice", "auto",
+         "pre-warm the hot-postings residency set (block-max strips / "
+         "dense tf matrix) at worker load, fed by the doctor's df-skew "
+         "report: auto engages when the top-df decile holds most "
+         "postings, 1 forces, 0 disables", "§21",
+         choices=("auto", "0", "1"))
 _declare("TPU_IR_ROUTER_DEADLINE_MS", "float", 500.0,
          "per-shard deadline for one routed request: a shard that "
          "answers on no replica within it ships the response partial",
