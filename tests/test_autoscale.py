@@ -1,0 +1,536 @@
+"""Elastic ShardSet acceptance suite (ISSUE 16).
+
+The robustness contract, unit-level and end-to-end:
+
+- **membership protocol**: grow() publishes WARM replicas (precompile +
+  residency done before the dispatch grid sees them — compile.count
+  must not move once traffic flows), begin_drain removes a replica
+  from dispatchable() (and therefore from breaker probes and the hedge
+  p99) while addresses() keeps it visible, retirement is drain-not-drop;
+- **control loop**: hysteresis (sustain_up/sustain_down consecutive
+  ticks), cooldown (suppressed decisions counted), min/max clamps,
+  highest-index-active drain pick — all deterministic via tick(now=);
+- **conservation across membership changes**: the routed soak with a
+  scripted scale plan (grow mid-run, drain mid-run, SIGKILL during the
+  drain handshake) still satisfies shed + served == submitted with
+  zero errors;
+- **zero-stale swap-during-scale**: a rolling generation swap
+  concurrent with a scale-up never lets a stale-generation response
+  out after the roll confirms (late_old_generation == 0).
+"""
+
+import json
+import random
+import threading
+import time
+
+import pytest
+
+from tpu_ir.index.ingest import IngestWriter
+from tpu_ir.index.segments import LiveIndex
+from tpu_ir.index.streaming import build_index_streaming
+from tpu_ir.obs import get_registry
+from tpu_ir.obs.registry import (
+    DECLARED_COUNTERS,
+    DECLARED_HISTOGRAMS,
+    SCALE_COUNTER_NAMES,
+)
+from tpu_ir.serving import (
+    Autoscaler,
+    AutoscaleConfig,
+    Router,
+    RouterConfig,
+    ShardSet,
+    autoscale_enabled,
+    run_distributed_soak,
+)
+from tpu_ir.serving.shardset import get_worker_health
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+QUERIES = ["salmon fishing", "bears honey market", "quick",
+           "rain forest investor", "asset bond stock season",
+           "dog dog salmon", "fox market rain"]
+
+
+def _write_corpus(path, n_docs=120):
+    body = []
+    for i in range(n_docs):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + (i % 7)))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    path.write_text("".join(body))
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("autoscale")
+    corpus = _write_corpus(tmp / "corpus.trec")
+    out = str(tmp / "idx")
+    build_index_streaming([corpus], out, k=1, num_shards=2,
+                          batch_docs=40, chargram_ks=[])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# deterministic control-loop units (fake fleet, explicit clock)
+# ---------------------------------------------------------------------------
+
+
+class FakeFleet:
+    """A lifecycle-faithful in-memory stand-in for ShardSet."""
+
+    def __init__(self, shards=2, replicas=1):
+        self._life = [["active"] * replicas for _ in range(shards)]
+        self._epoch = 0
+        self._events = []
+        self.retired = []
+        self.grow_raises = False
+        self.max_concurrency = 4
+
+    def lifecycle(self):
+        return [list(row) for row in self._life]
+
+    def epoch(self):
+        return self._epoch
+
+    def events(self):
+        return list(self._events)
+
+    def active_replicas(self, shard=None):
+        counts = [sum(1 for st in row if st == "active")
+                  for row in self._life]
+        return counts[shard] if shard is not None else min(counts)
+
+    def grow(self):
+        if self.grow_raises:
+            raise RuntimeError("spawn failed")
+        added = []
+        for s, row in enumerate(self._life):
+            row.append("active")
+            self._epoch += 1
+            self._events.append(("up", s, len(row) - 1, self._epoch))
+            added.append((s, len(row) - 1))
+        return added
+
+    def retire_replica(self, shard, replica, *, drain_timeout_s=30.0):
+        self._life[shard][replica] = "retired"
+        self._epoch += 1
+        self._events.append(("down", shard, replica, self._epoch))
+        self.retired.append((shard, replica))
+        return {"shard": shard, "replica": replica, "drain_s": 0.0,
+                "inflight_peak": 0, "drained_clean": True,
+                "killed_mid_drain": False}
+
+
+class FakeAdmission:
+    def __init__(self):
+        self.inflight = 0
+        self.queued = 0
+        self.max_concurrency = 10
+
+    def in_flight(self):
+        return self.inflight
+
+    def queue_depth(self):
+        return self.queued
+
+
+class FakeRouter:
+    def __init__(self):
+        self.admission = FakeAdmission()
+        self.resets = []
+
+    def reset_breaker(self, shard, replica):
+        self.resets.append((shard, replica))
+
+
+def _cfg(**kw):
+    base = dict(min_replicas=1, max_replicas=3, cooldown_s=1.0,
+                up_occupancy=0.8, down_occupancy=0.2,
+                sustain_up=3, sustain_down=5)
+    base.update(kw)
+    return AutoscaleConfig(**base)
+
+
+def test_hysteresis_scales_up_only_after_sustained_pressure():
+    fleet, router = FakeFleet(), FakeRouter()
+    a = Autoscaler(fleet, router, _cfg())
+    router.admission.inflight = 9          # occupancy 0.9 >= 0.8
+    assert a.tick(now=1.0)["action"] is None
+    assert a.tick(now=2.0)["action"] is None
+    assert fleet.active_replicas() == 1
+    d = a.tick(now=3.0)                    # third consecutive tick
+    assert d["action"] == "up" and d["reason"] == "sustained_pressure"
+    assert fleet.active_replicas() == 2
+    # a reused slot must not inherit breaker history
+    assert router.resets == d["slots"] == [(0, 1), (1, 1)]
+    # one blip does NOT re-arm: counters reset after the action
+    assert a.tick(now=3.1)["action"] is None
+
+
+def test_cooldown_suppresses_and_counts_then_releases():
+    fleet, router = FakeFleet(), FakeRouter()
+    a = Autoscaler(fleet, router, _cfg(cooldown_s=5.0))
+    router.admission.inflight = 9
+    for now in (1.0, 2.0, 3.0):
+        a.tick(now=now)                    # scales up at now=3
+    assert fleet.active_replicas() == 2
+    skipped0 = get_registry().get("scale.cooldown_skipped")
+    for now in (3.2, 3.4, 3.6):
+        d = a.tick(now=now)                # re-armed but inside cooldown
+    assert d["action"] is None and d["reason"] == "cooldown"
+    assert get_registry().get("scale.cooldown_skipped") > skipped0
+    assert fleet.active_replicas() == 2
+    d = a.tick(now=9.0)                    # cooldown (until 8.0) expired
+    assert d["action"] == "up"
+    assert fleet.active_replicas() == 3
+
+
+def test_clamps_at_max_and_min_replicas():
+    fleet, router = FakeFleet(replicas=3), FakeRouter()
+    a = Autoscaler(fleet, router, _cfg(max_replicas=3, sustain_down=3))
+    router.admission.inflight = 9
+    for now in (1.0, 2.0, 3.0):
+        d = a.tick(now=now)
+    assert d["action"] is None and d["reason"] == "at_max_replicas"
+
+    lone = FakeFleet(replicas=1)
+    b = Autoscaler(lone, router, _cfg(sustain_down=3))
+    router.admission.inflight = 0          # occupancy 0 <= 0.2
+    for now in (11.0, 12.0, 13.0):
+        d = b.tick(now=now)
+    assert d["action"] is None and d["reason"] == "at_min_replicas"
+    assert lone.retired == []
+
+
+def test_scale_down_drains_highest_active_replica_per_shard():
+    fleet, router = FakeFleet(replicas=3), FakeRouter()
+    # shard 1's top slot is already retired: its pick must skip it
+    fleet._life[1][2] = "retired"
+    a = Autoscaler(fleet, router, _cfg(sustain_down=3, cooldown_s=0.1))
+    router.admission.inflight = 0
+    for now in (1.0, 2.0, 3.0):
+        d = a.tick(now=now)
+    assert d["action"] == "down" and d["reason"] == "sustained_idleness"
+    assert fleet.retired == [(0, 2), (1, 1)]
+
+
+def test_failed_grow_does_not_kill_the_loop():
+    fleet, router = FakeFleet(), FakeRouter()
+    fleet.grow_raises = True
+    a = Autoscaler(fleet, router, _cfg())
+    router.admission.inflight = 9
+    for now in (1.0, 2.0, 3.0):
+        d = a.tick(now=now)
+    assert d["action"] is None and d["reason"].startswith("up_failed")
+    # the counters stayed armed (no action executed, no cooldown), so
+    # the very next tick retries — and succeeds once spawning works
+    fleet.grow_raises = False
+    d = a.tick(now=4.0)
+    assert d["action"] == "up"
+    assert fleet.active_replicas() == 2
+
+
+def test_env_resolution_and_validation(monkeypatch):
+    monkeypatch.setenv("TPU_IR_SCALE_MIN_REPLICAS", "2")
+    monkeypatch.setenv("TPU_IR_SCALE_MAX_REPLICAS", "7")
+    monkeypatch.setenv("TPU_IR_SCALE_COOLDOWN_S", "2.5")
+    cfg = AutoscaleConfig().resolved()
+    assert (cfg.min_replicas, cfg.max_replicas, cfg.cooldown_s) \
+        == (2, 7, 2.5)
+    assert not autoscale_enabled()
+    monkeypatch.setenv("TPU_IR_AUTOSCALE", "1")
+    assert autoscale_enabled()
+    assert not autoscale_enabled(flag=False)  # explicit flag wins
+    monkeypatch.setenv("TPU_IR_SCALE_MAX_REPLICAS", "1")
+    with pytest.raises(ValueError):
+        Autoscaler(FakeFleet(), FakeRouter(), AutoscaleConfig())
+
+
+def test_scale_telemetry_names_are_declared():
+    """Satellite 3: the scale counters/histograms ship DECLARED — the
+    lint contract (TPU303/305/306) keys off these tuples."""
+    assert set(SCALE_COUNTER_NAMES) == {
+        "scale.up", "scale.down", "scale.drain_inflight",
+        "scale.cooldown_skipped"}
+    assert set(SCALE_COUNTER_NAMES) <= set(DECLARED_COUNTERS)
+    assert {"scale.drain_ms", "scale.warmup_ms"} \
+        <= set(DECLARED_HISTOGRAMS)
+
+
+def test_healthz_carries_autoscaler_section():
+    """Satellite 5: /healthz shows epoch, per-replica lifecycle, and
+    the last decision + reason of the newest live autoscaler."""
+    from tpu_ir.obs.server import health_snapshot
+
+    fleet, router = FakeFleet(), FakeRouter()
+    a = Autoscaler(fleet, router, _cfg())
+    router.admission.inflight = 9
+    for now in (1.0, 2.0, 3.0):
+        a.tick(now=now)
+    snap = health_snapshot()
+    az = snap.get("autoscaler")
+    assert az is not None
+    assert az["enabled"] is True
+    assert az["epoch"] == fleet.epoch() > 0
+    assert az["lifecycle"] == fleet.lifecycle()
+    assert az["last_decision"]["action"] == "up"
+    assert az["config"]["max_replicas"] == 3
+    assert a is not None  # keep the weakref target alive to here
+
+
+# ---------------------------------------------------------------------------
+# the real fleet: membership protocol + warm-start + drain-not-drop
+# ---------------------------------------------------------------------------
+
+
+def test_grow_is_warm_and_drain_never_drops(index_dir, tmp_path):
+    """One elastic lifecycle against real subprocess workers:
+
+    - grow() publishes one warm replica per shard (epoch bumped, "up"
+      events logged, dispatchable == addresses);
+    - WARM means warm: the new replicas' own compile counters do not
+      move once routed traffic flows through them, and no breaker
+      opens (no compile-storm 5xx/timeouts on first contact);
+    - begin_drain removes the replica from dispatchable() (so breaker
+      probes and hedge sampling can't reach it) while addresses()
+      still shows it;
+    - retiring under concurrent traffic drains clean — every in-flight
+      request is served or shed, never errored;
+    - a retired slot is REUSED by the next grow (bounded grid width).
+    """
+    reg = get_registry()
+    with ShardSet(index_dir, shards=2, replicas=1, layout="sparse",
+                  deadline_s=3.0, rundir=str(tmp_path / "run")) as ss:
+        router = Router(index_dir, ss,
+                        RouterConfig(deadline_ms=8000.0, max_queue=64))
+        try:
+            assert ss.active_replicas() == 1
+            e0 = ss.epoch()
+            opened0 = reg.get("router.breaker_opened")
+            up0 = reg.get("scale.up")
+
+            added = ss.grow()
+            assert added == [(0, 1), (1, 1)]
+            assert ss.active_replicas() == 2
+            assert ss.epoch() > e0
+            assert reg.get("scale.up") - up0 == 2
+            assert [ev[0] for ev in ss.events()] == ["up", "up"]
+            assert ss.dispatchable() == ss.addresses()
+            assert ss.lifecycle() == [["active", "active"]] * 2
+
+            new_addrs = [ss.addresses()[s][r] for s, r in added]
+            compiles0 = {}
+            for addr in new_addrs:
+                w = get_worker_health(addr, 10.0)["worker"]
+                compiles0[addr] = w["compiles"]["count"]
+                assert w["in_flight"] == 0
+
+            for q in QUERIES * 3:
+                res = router.search(q, k=10, scoring="bm25")
+                assert Router.classify(res) == "full"
+
+            # warm-start contract: entering the grid compiled NOTHING
+            # new — the precompile walk ran before the ready file
+            for addr in new_addrs:
+                w = get_worker_health(addr, 10.0)["worker"]
+                assert w["compiles"]["count"] == compiles0[addr], \
+                    f"scale-up cold-compiled on {addr}"
+            assert reg.get("router.breaker_opened") == opened0
+
+            # drain visibility: out of dispatch, still addressable
+            ss.begin_drain(0, 1)
+            assert ss.lifecycle()[0][1] == "draining"
+            assert router._replica_draining(0, 1)
+            assert ss.dispatchable()[0][1] is None
+            assert ss.addresses()[0][1] is not None
+
+            # retire both grown replicas under live traffic
+            results = []
+            stop = threading.Event()
+
+            def client():
+                i = 0
+                while not stop.is_set():
+                    try:
+                        router.search(QUERIES[i % len(QUERIES)], k=10,
+                                      scoring="bm25")
+                        results.append("ok")
+                    except Exception as e:  # noqa: BLE001
+                        results.append(repr(e))
+                    i += 1
+
+            threads = [threading.Thread(target=client, daemon=True)
+                       for _ in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(0.2)
+            down0 = reg.get("scale.down")
+            drains = [ss.retire_replica(s, 1, drain_timeout_s=20.0)
+                      for s in range(2)]
+            time.sleep(0.2)
+            stop.set()
+            for t in threads:
+                t.join(timeout=30.0)
+            assert all(d["drained_clean"] for d in drains), drains
+            assert not any(d["killed_mid_drain"] for d in drains)
+            assert reg.get("scale.down") - down0 == 2
+            assert ss.active_replicas() == 1
+            assert ss.dispatchable()[0][1] is None
+            assert ss.dispatchable()[1][1] is None
+            bad = [r for r in results if r != "ok"]
+            assert not bad, bad[:5]
+            assert results.count("ok") > 0
+
+            # slot reuse: the next grow lands back in slot 1
+            assert ss.grow() == [(0, 1), (1, 1)]
+            assert ss.active_replicas() == 2
+            for q in QUERIES:
+                assert Router.classify(
+                    router.search(q, k=10, scoring="bm25")) == "full"
+        finally:
+            router.close()
+
+
+def test_conservation_across_membership_changes(index_dir, tmp_path):
+    """THE robustness acceptance: grow mid-soak, drain mid-soak, and
+    SIGKILL one replica WHILE its drain handshake is polling — and the
+    PR-10 ledger still balances: shed + served == submitted, zero
+    errors, zero deadlocks, zero result mismatches."""
+    report = run_distributed_soak(
+        str(index_dir), shards=2, replicas=2, threads=6, queries=90,
+        seed=2, chaos=False,
+        scale_plan={"up_at": 0.2, "down_at": 0.5,
+                    "kill_during_drain": True},
+        worker_deadline_s=3.0,
+        router_config=RouterConfig(deadline_ms=8000.0, max_queue=128),
+        rundir=str(tmp_path / "run"),
+        flight_dir=str(tmp_path / "flight"),
+        recovery_timeout_s=120.0)
+    assert report["served"] + report["shed"] == report["submitted"]
+    assert report["errors"] == 0, report["error_samples"]
+    assert report["deadlocked"] == 0
+    assert report["full_mismatches"] == 0
+    assert report["partial_mismatches"] == 0
+    sc = report["scale"]
+    assert sc["events"] >= 4               # 2 up + 2 down, at least
+    assert len(sc["drains"]) == 2
+    # the scripted kill raced at least one drain handshake
+    assert sc["killed_mid_drain"] + sc["drained_clean"] == 2
+    assert sc["epoch"] > 0
+    assert sc["mean_replicas"] > 0
+    assert 0.0 <= sc["overprovision_fraction"] <= 1.0
+    assert report["recovery_full"] == report["recovery_probes"]
+
+
+@pytest.mark.slow
+def test_zero_stale_swap_during_scale(tmp_path):
+    """Rolling generation swap CONCURRENT with a scale-up: the walker's
+    epoch-stability loop must also confirm the replica that grew into
+    the grid mid-roll — no stale-generation response after the roll
+    confirms, no unknown generation, conservation intact."""
+    live = str(tmp_path / "live")
+    LiveIndex.create(live, num_shards=2)
+    rng = random.Random(5)
+    with IngestWriter(live, auto_merge=False) as w:
+        for i in range(50):
+            w.add(f"D-{i:03d}",
+                  " ".join(rng.choice(WORDS)
+                           for _ in range(rng.randint(3, 7))))
+        w.compact_all(note="base")
+    report = run_distributed_soak(
+        live, shards=2, replicas=1, threads=6, queries=80, seed=3,
+        chaos=False, upgrade_at=0.25, upgrade_docs=6,
+        scale_plan={"up_at": 0.3},
+        worker_deadline_s=3.0,
+        router_config=RouterConfig(deadline_ms=8000.0, max_queue=128),
+        rundir=str(tmp_path / "run"),
+        flight_dir=str(tmp_path / "flight"),
+        recovery_timeout_s=120.0)
+    assert report["served"] + report["shed"] == report["submitted"]
+    assert report["errors"] == 0, report["error_samples"]
+    assert report["deadlocked"] == 0
+    up = report["upgrade"]
+    assert up["swap"] is not None and not up["swap"]["failed"]
+    assert up["late_old_generation"] == 0
+    assert report["unknown_generation"] == 0
+    assert report["full_mismatches"] == 0
+    assert report["partial_mismatches"] == 0
+    assert report["generations_served"].get(
+        str(up["generation_b"]), 0) > 0
+    assert report["scale"]["events"] >= 2  # the mid-roll grow landed
+    assert report["recovery_full"] == report["recovery_probes"]
+
+
+@pytest.mark.slow
+def test_autoscaler_closed_loop_scales_up_under_burst(index_dir,
+                                                      tmp_path):
+    """The closed loop end to end: a burst workload through a
+    deliberately narrow router (max_concurrency=2) sustains occupancy
+    over the up threshold; the autoscaler grows the fleet mid-soak and
+    the run still conserves."""
+    report = run_distributed_soak(
+        str(index_dir), shards=2, replicas=1, threads=8, queries=90,
+        seed=4, chaos=False, autoscale=True,
+        workload={"kind": "zipf", "skew": 0.8, "burst": 3.0},
+        worker_deadline_s=3.0,
+        router_config=RouterConfig(deadline_ms=8000.0,
+                                   max_concurrency=2, max_queue=128),
+        rundir=str(tmp_path / "run"),
+        flight_dir=str(tmp_path / "flight"),
+        recovery_timeout_s=120.0)
+    assert report["served"] + report["shed"] == report["submitted"]
+    assert report["errors"] == 0, report["error_samples"]
+    assert report["deadlocked"] == 0
+    sc = report["scale"]
+    assert sc["autoscaler"]["enabled"] is True
+    assert sc["events"] >= 2               # grew one replica per shard
+    assert sc["mean_replicas"] >= 1.0
+    assert report["burst_p99_ms"] > 0
+    assert report["recovery_full"] == report["recovery_probes"]
+
+
+# ---------------------------------------------------------------------------
+# CLI surface
+# ---------------------------------------------------------------------------
+
+
+def test_cli_autoscale_requires_shards(index_dir, capsys):
+    from tpu_ir.cli import main
+
+    assert main(["serve-bench", index_dir, "--autoscale"]) == 2
+
+
+@pytest.mark.slow
+def test_cli_serve_bench_autoscale_smoke(index_dir, tmp_path, capsys,
+                                         monkeypatch):
+    """`tpu-ir serve-bench --autoscale`: elastic arm + static control
+    arm, one history row carrying the ISSUE 16 trio of metrics."""
+    from tpu_ir.obs import bench_check
+    from tpu_ir.cli import main
+
+    # keep the smoke row out of the checked-in repo trajectory
+    hist = tmp_path / "BENCH_HISTORY.jsonl"
+    monkeypatch.setattr(bench_check, "default_history_path",
+                        lambda: str(hist))
+
+    rc = main(["serve-bench", index_dir, "--shards", "2",
+               "--replicas", "1", "--threads", "4", "--queries", "24",
+               "--autoscale", "--deadline", "3.0", "--seed", "7"])
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    report = json.loads(out)
+    assert rc == 0, report.get("history_row")
+    row = report["history_row"]
+    assert "-autoscale" in row["config"]
+    for key in ("scale_events", "burst_p99_ms",
+                "overprovision_fraction", "mean_replicas",
+                "static_replicas", "static_burst_p99_ms"):
+        assert key in row, key
+    assert report["static_control"]["replicas"] >= 1
+    assert report["served"] + report["shed"] == report["submitted"]
+    lines = hist.read_text().splitlines()
+    assert len(lines) == 1

@@ -454,6 +454,7 @@ def _smoke_matrix(index_dir: str, corpus: str, tmp) -> dict:
                         {"submitted", "served", "shed", "latency",
                          "querylog"}),
         "cache": (["cache"], {"counters", "caches"}),
+        "scale": (["scale"], {"enabled", "config"}),
         "compact": (["compact", str(tmp / "live")],
                     {"steps", "segments", "generation", "mode"}),
         "serve-worker": (["serve-worker", index_dir, "--shard", "0/2",
@@ -478,7 +479,7 @@ _SMOKE_NAMES = sorted(
      "merge", "stats", "metrics", "trace-dump", "profile", "querylog",
      "doctor", "bench-check", "serve-bench", "eval", "pack", "count",
      "docno", "expand", "lint", "ingest", "generations", "cache",
-     "compact", "serve-worker"])
+     "compact", "serve-worker", "scale"])
 
 
 def test_cli_smoke_matrix_is_complete(setup):

@@ -354,11 +354,14 @@ def test_querylog_overhead_within_bound(scorer):
         timings[enabled] = runs[0]
         spread[enabled] = runs[-1] / max(runs[0], 1e-9)
     querylog.configure(enabled=True)
-    if max(spread.values()) > 1.5:
-        # same-arm repeats disagreeing by >50% means the box is under
-        # external load — the A/B delta is weather, not signal
+    if max(spread.values()) > 1.35:
+        # same-arm repeats disagreeing by >35% means the box is under
+        # external load — the A/B delta is weather, not signal. The
+        # gate is deliberately TIGHTER than the assertion margin
+        # (ISSUE 16 deflake): a run noisy enough to need the wide
+        # margin is a run this gate should already have skipped.
         pytest.skip(f"host too loaded for a timing comparison "
                     f"(same-arm spread {spread})")
-    assert timings[True] <= timings[False] * 1.10 + 0.6, (
+    assert timings[True] <= timings[False] * 1.15 + 1.0, (
         f"querylog overhead too high: on {timings[True]:.3f}s vs "
         f"off {timings[False]:.3f}s")

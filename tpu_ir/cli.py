@@ -976,7 +976,16 @@ def _serve_routed(args) -> int:
     the invariant report(s), and append one routed_* sentry summary row
     per level to BENCH_HISTORY.jsonl where `tpu-ir bench-check` gates
     it (direction-aware; cache_hit_fraction / routed_qps /
-    routed_p99_ms recorded per skew)."""
+    routed_p99_ms recorded per skew).
+
+    `--autoscale` (ISSUE 16) makes the topology ELASTIC: the soak runs
+    with the closed-loop autoscaler (serving/autoscale.py — grow one
+    warm replica per shard on sustained pressure, drain-not-drop retire
+    on sustained idleness), then a STATIC control run at the autoscaled
+    run's mean active replica count, and the history row records
+    scale_events / burst_p99_ms / overprovision_fraction next to the
+    control's burst p99 — the measured claim that elasticity buys burst
+    latency without buying idle replicas."""
     import jax
 
     from .obs.bench_check import append_history_row
@@ -1011,9 +1020,37 @@ def _serve_routed(args) -> int:
                 worker_deadline_s=(1.0 if args.deadline is None
                                    else args.deadline),
                 timeout_s=args.timeout, flight_dir=args.flight_dir,
-                workload=spec, cache_entries=args.cache)
+                workload=spec, cache_entries=args.cache,
+                autoscale=bool(args.autoscale))
             if track.server is not None:
                 report["metrics_url"] = track.server.url
+            static = None
+            if args.autoscale:
+                # the control arm: a STATIC fleet at the autoscaled
+                # run's mean active replica count — "equal capacity
+                # spend" — same workload, same seed. The comparison the
+                # row records: did elasticity put its replicas where
+                # the burst was?
+                ctrl_replicas = max(1, int(round(
+                    report["scale"]["mean_replicas"])))
+                static = run_distributed_soak(
+                    args.index_dir, shards=args.shards,
+                    replicas=ctrl_replicas,
+                    threads=args.threads, queries=args.queries,
+                    seed=args.seed,
+                    layout=layout, chaos=args.chaos,
+                    worker_deadline_s=(1.0 if args.deadline is None
+                                       else args.deadline),
+                    timeout_s=args.timeout,
+                    flight_dir=args.flight_dir,
+                    workload=spec, cache_entries=args.cache)
+                report["static_control"] = {
+                    "replicas": ctrl_replicas,
+                    "burst_p99_ms": static["burst_p99_ms"],
+                    "served": static["served"],
+                    "shed": static["shed"],
+                    "errors": static["errors"],
+                }
             req_lat = report["latency"].get("router.request") or {}
             p99 = req_lat.get("p99_ms")
             row = {
@@ -1026,6 +1063,7 @@ def _serve_routed(args) -> int:
                            f"s{args.shards}r{args.replicas}"
                            + ("-chaos" if args.chaos else "")
                            + ("" if label == "uniform" else f"-{label}")
+                           + ("-autoscale" if args.autoscale else "")
                            + (f"-c{cache_n}" if cache_n else "")),
                 "backend": jax.default_backend(),
                 "shards": args.shards,
@@ -1042,6 +1080,15 @@ def _serve_routed(args) -> int:
                     "router.hedge_fired", 0),
                 "recovery_full": report["recovery_full"],
             }
+            if args.autoscale:
+                row["scale_events"] = report["scale"]["events"]
+                row["burst_p99_ms"] = report["burst_p99_ms"]
+                row["overprovision_fraction"] = (
+                    report["scale"]["overprovision_fraction"])
+                row["mean_replicas"] = report["scale"]["mean_replicas"]
+                row["static_replicas"] = (
+                    report["static_control"]["replicas"])
+                row["static_burst_p99_ms"] = static["burst_p99_ms"]
             report["history"] = append_history_row(row)
             report["history_row"] = row
             reports.append(report)
@@ -1051,6 +1098,20 @@ def _serve_routed(args) -> int:
                 and report["partial_mismatches"] == 0
                 and report["served"] + report["shed"]
                 == report["submitted"])
+            if static is not None:
+                # both arms must conserve, and the elastic arm must not
+                # LOSE to equal static spend at the burst peak (a
+                # generous bound — bench-check trends the exact number)
+                ok = ok and (
+                    static["errors"] == 0 and static["deadlocked"] == 0
+                    and static["served"] + static["shed"]
+                    == static["submitted"])
+                if static["burst_p99_ms"] > 0:
+                    # a generous smoke bound (a loaded box jitters small
+                    # runs by 100s of ms); bench-check trends the exact
+                    # burst_p99_ms number across the history
+                    ok = ok and (report["burst_p99_ms"]
+                                 <= static["burst_p99_ms"] * 1.5 + 250.0)
     out = reports[0] if len(reports) == 1 else {
         "runs": reports,
         "levels": [r["history_row"]["workload"] for r in reports]}
@@ -1077,6 +1138,10 @@ def cmd_serve_bench(args) -> int:
     bench-check` gates `batched_qps`/`batched_p99_ms`/`solo_p50_ms`/
     `batch_occupancy_mean`."""
     _apply_backend(args)
+    if args.autoscale and args.shards is None:
+        print("--autoscale needs --shards N: the elastic topology is "
+              "the routed worker fleet", file=sys.stderr)
+        return 2
     if args.shards is not None:
         return _serve_routed(args)
     from .search import Scorer
@@ -1158,6 +1223,48 @@ def cmd_serve_bench(args) -> int:
           and report["untagged_mismatches"] == 0
           and report["served"] + report["shed"] == report["submitted"])
     return 0 if ok else 1
+
+
+def cmd_scale(args) -> int:
+    """Elastic-serving introspection (ISSUE 16; serving/autoscale.py):
+    print the resolved autoscaler configuration — TPU_IR_AUTOSCALE and
+    the TPU_IR_SCALE_* knobs as the Autoscaler would actually consume
+    them — and, with --url, a live serving process's /healthz
+    autoscaler section: membership epoch, per-replica lifecycle state,
+    hysteresis counters, and the last scaling decision with its reason.
+    The page an operator reads to answer "why did the fleet just grow
+    (or refuse to)?" without attaching a debugger."""
+    from .serving.autoscale import AutoscaleConfig, autoscale_enabled
+
+    cfg = AutoscaleConfig().resolved()
+    out = {
+        "enabled": autoscale_enabled(),
+        "config": {
+            "min_replicas": cfg.min_replicas,
+            "max_replicas": cfg.max_replicas,
+            "cooldown_s": cfg.cooldown_s,
+            "up_occupancy": cfg.up_occupancy,
+            "down_occupancy": cfg.down_occupancy,
+            "sustain_up": cfg.sustain_up,
+            "sustain_down": cfg.sustain_down,
+            "drain_timeout_s": cfg.drain_timeout_s,
+        },
+    }
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/") + "/healthz"
+        try:
+            with urllib.request.urlopen(url, timeout=10.0) as r:
+                payload = json.loads(r.read().decode("utf-8"))
+        except Exception as e:  # noqa: BLE001 — a dead server is the
+            # answer here, not a traceback
+            print(f"error: cannot read {url}: {e!r}", file=sys.stderr)
+            return 1
+        out["live"] = payload.get("autoscaler") or {
+            "error": "no autoscaler registered in that process"}
+    print(json.dumps(out, sort_keys=True))
+    return 0
 
 
 def cmd_cache(args) -> int:
@@ -1774,6 +1881,15 @@ def main(argv: list[str] | None = None) -> int:
     pb.add_argument("--replicas", type=int, default=1, metavar="R",
                     help="replicas per shard in --shards mode (failover "
                          "+ hedging need R >= 2)")
+    pb.add_argument("--autoscale", action="store_true",
+                    help="elastic --shards mode (serving/autoscale.py): "
+                         "run the routed soak under the closed-loop "
+                         "autoscaler (warm grow on sustained pressure, "
+                         "drain-not-drop retire on idleness), then a "
+                         "static control at the same mean replica "
+                         "count; scale_events / burst_p99_ms / "
+                         "overprovision_fraction append to "
+                         "BENCH_HISTORY.jsonl")
     pb.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded"],
                     default="auto")
@@ -1810,6 +1926,18 @@ def main(argv: list[str] | None = None) -> int:
                          "stderr)")
     _add_backend_arg(pb)
     pb.set_defaults(fn=cmd_serve_bench)
+
+    psc = sub.add_parser(
+        "scale",
+        help="elastic-serving introspection (serving/autoscale.py): "
+             "the resolved TPU_IR_AUTOSCALE / TPU_IR_SCALE_* config, "
+             "plus a live server's /healthz autoscaler section "
+             "(epoch, per-replica lifecycle, last decision) via --url")
+    psc.add_argument("--url", default=None, metavar="URL",
+                     help="base URL of a running --metrics-port "
+                          "telemetry server; prints its /healthz "
+                          "autoscaler section")
+    psc.set_defaults(fn=cmd_scale)
 
     pca = sub.add_parser(
         "cache",

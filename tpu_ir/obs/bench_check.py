@@ -118,6 +118,16 @@ METRICS: dict[str, tuple[str, float]] = {
     # generation bump storm, capacity misconfig). The 0.05 floor
     # absorbs draw-to-draw jitter in which head queries repeat.
     "cache_hit_fraction": ("higher", 0.05),
+    # elastic serving (ISSUE 16; serve_routed -autoscale rows): burst
+    # p99 is the claim (served latency during the diurnal PEAK window —
+    # the max-of-N weather floor of routed_p99_ms applies), scale
+    # events trending UP means the dampers stopped damping (flapping),
+    # and overprovision creeping up means the scaler buys replicas the
+    # demand series never needed. Floors absorb one extra event / one
+    # tick-accounting wobble per run.
+    "burst_p99_ms": ("lower", 50.0),
+    "scale_events": ("lower", 2.0),
+    "overprovision_fraction": ("lower", 0.05),
     # streaming-build phase walls (ISSUE 11: wiki/build_scale rows) —
     # the radix restructure's whole point is driving pass2_combine_s
     # down, so the sentry gates each pass plus the end-to-end build

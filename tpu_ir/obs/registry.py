@@ -196,13 +196,26 @@ CACHE_COUNTER_NAMES = (
     "cache.hit", "cache.miss", "cache.evict", "cache.stale_generation",
 )
 
+# Elastic ShardSet membership protocol (ISSUE 16, serving/autoscale.py +
+# shardset.py): scale.up / scale.down count replicas that ENTERED /
+# LEFT the dispatch grid (one per (shard, replica) membership change,
+# so a whole-fleet grow on S shards counts S); scale.drain_inflight the
+# peak in-flight requests a draining replica was observed finishing
+# (drain-not-drop accounting: these requests completed, none dropped);
+# scale.cooldown_skipped decisions the autoscaler WANTED to take but
+# suppressed inside the cooldown window — the flap-damper's readout.
+SCALE_COUNTER_NAMES = (
+    "scale.up", "scale.down", "scale.drain_inflight",
+    "scale.cooldown_skipped",
+)
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
 ) + (COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
      + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES + INGEST_COUNTER_NAMES
-     + PRUNE_COUNTER_NAMES + CACHE_COUNTER_NAMES)
+     + PRUNE_COUNTER_NAMES + CACHE_COUNTER_NAMES + SCALE_COUNTER_NAMES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
@@ -244,6 +257,13 @@ DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     # probe) — the cost a hit pays INSTEAD of the fan-out/dispatch, so
     # p50 here vs router.request/request.full is the cache's win
     "cache.lookup",
+    # elastic membership (ISSUE 16): wall seconds one drain took
+    # (draining-state entry -> process exit; the summary reports it in
+    # ms like every histogram) and wall seconds one scale-up's spawn +
+    # precompile/residency warm-up took before the replica entered the
+    # dispatch grid — the warm-start gate's cost, paid OUTSIDE traffic
+    "scale.drain_ms",
+    "scale.warmup_ms",
 )
 
 # Gauges: point-in-time values (memory levels, cache sizes) — unlike

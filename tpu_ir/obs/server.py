@@ -70,6 +70,7 @@ logger = logging.getLogger(__name__)
 _health_lock = threading.Lock()
 _frontends: list = []  # weakrefs to live ServingFrontends, oldest first
 _routers: list = []    # weakrefs to live routers (serving/router.py)
+_autoscalers: list = []  # weakrefs to live Autoscalers (serving/autoscale.py)
 _index_dirs: list = []  # index dirs this process loaded, oldest first
 _MAX_INDEX_DIRS = 4
 _doctor_cache: dict = {}  # dir -> (metadata mtime_ns, report)
@@ -92,6 +93,15 @@ def register_router(router) -> None:
     server must never keep a closed router's connections alive."""
     with _health_lock:
         _routers.append(weakref.ref(router))
+
+
+def register_autoscaler(autoscaler) -> None:
+    """Called by serving/autoscale.py Autoscaler.__init__: /healthz
+    reports the elastic-membership control loop — membership epoch,
+    per-replica lifecycle, hysteresis counters, and the last scaling
+    decision with its reason (ISSUE 16). Weakref, like the routers."""
+    with _health_lock:
+        _autoscalers.append(weakref.ref(autoscaler))
 
 
 def register_index_dir(path) -> None:
@@ -188,6 +198,13 @@ def _live_routers() -> list:
         return [f for _, f in alive if f is not None]
 
 
+def _live_autoscalers() -> list:
+    with _health_lock:
+        alive = [(r, r()) for r in _autoscalers]
+        _autoscalers[:] = [r for r, f in alive if f is not None]
+        return [f for _, f in alive if f is not None]
+
+
 def health_snapshot() -> dict:
     """The /healthz payload. The newest live frontend's control-plane
     state is lifted to the top-level `breaker`/`ladder`/`queue_depth`
@@ -236,6 +253,15 @@ def health_snapshot() -> dict:
             out["shards"] = routers[-1].health_summary()
         except Exception as e:  # noqa: BLE001 — health must not 500
             out["shards"] = {"error": repr(e)}
+    scalers = _live_autoscalers()
+    if scalers:
+        # the elastic-membership control loop (ISSUE 16): epoch,
+        # per-replica lifecycle, last decision + reason — the page an
+        # operator reads to answer "why did the fleet just grow?"
+        try:
+            out["autoscaler"] = scalers[-1].snapshot()
+        except Exception as e:  # noqa: BLE001 — health must not 500
+            out["autoscaler"] = {"error": repr(e)}
     if out["frontends"]:
         latest = out["frontends"][-1]
         out["breaker"] = latest.get("breaker")

@@ -3,6 +3,7 @@ ladder, circuit breaker) for a loaded Scorer — see frontend.py for the
 architecture and RUNBOOK "Serving under overload" for operations."""
 
 from .admission import AdmissionController, Overloaded
+from .autoscale import Autoscaler, AutoscaleConfig, autoscale_enabled
 from .batching import BatchKey, CoalescingScheduler, batch_ladder
 from .breaker import CLOSED, HALF_OPEN, OPEN, CircuitBreaker
 from .frontend import (
@@ -44,6 +45,7 @@ __all__ = [
     "run_soak", "make_queries", "run_concurrency_sweep",
     "run_distributed_soak", "DEFAULT_CHAOS_PLAN",
     "rolling_swap", "swap_microbench",
+    "Autoscaler", "AutoscaleConfig", "autoscale_enabled",
     "Workload", "resolve_workload",
     "ResultCache", "cache_counters", "live_caches",
     "prewarm_hot_residency", "residency_hint",

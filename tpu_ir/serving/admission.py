@@ -54,11 +54,21 @@ class AdmissionController:
         self._slots = threading.Semaphore(max_concurrency)
         self._lock = threading.Lock()
         self._waiting = 0
+        self._executing = 0
 
     def queue_depth(self) -> int:
         """Requests currently waiting for an execution slot."""
         with self._lock:
             return self._waiting
+
+    def in_flight(self) -> int:
+        """Requests currently EXECUTING (admitted, slot held). With
+        queue_depth() this is the whole admitted population — the
+        drain handshake (ISSUE 16) terminates a retiring server only
+        once both read zero, and the autoscaler reads occupancy
+        (in_flight / max_concurrency) as its pressure signal."""
+        with self._lock:
+            return self._executing
 
     def pressure(self) -> float:
         """Queue occupancy in [0, 1] — the degradation ladder's input
@@ -95,7 +105,11 @@ class AdmissionController:
                     depth = self._waiting
             if not got:
                 raise Overloaded("queue_timeout", queue_depth=depth)
+        with self._lock:
+            self._executing += 1
         try:
             yield
         finally:
+            with self._lock:
+                self._executing -= 1
             self._slots.release()

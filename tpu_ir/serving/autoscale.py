@@ -1,0 +1,322 @@
+"""Elastic capacity: the autoscaler over the ShardSet membership
+protocol (ISSUE 16).
+
+PR 15 made "millions of users" traffic a measured regime — diurnal Zipf
+waves through the routed soak — but the topology stayed static, so a
+burst either overprovisions every trough or trips breakers at every
+peak. This module closes the loop: an `Autoscaler` reads SUSTAINED
+telemetry the serving tier already emits (router admission occupancy —
+executing + queued over capacity) and answers through the ShardSet's
+membership protocol:
+
+- **scale up** on sustained pressure: one WARM replica per shard
+  (`ShardSet.grow()` — spawn, precompile walk, residency pre-warm, and
+  only then enter the dispatch grid, so a burst can never cold-start a
+  replica into compile storms that trip its breaker);
+- **scale down** on sustained idleness: `ShardSet.retire_replica()` —
+  drain-not-drop (the replica leaves the dispatch grid immediately,
+  finishes its in-flight requests, then exits; conservation
+  `shed + served == submitted` holds across the change).
+
+Two dampers keep the diurnal schedule from making the fleet flap:
+
+- **hysteresis**: a decision needs `sustain_up` / `sustain_down`
+  CONSECUTIVE over/under-threshold ticks — a single descheduled poll
+  or one hot instant is weather, not a trend (and the up/down
+  thresholds are far apart, so the signal can breathe between them
+  without triggering either);
+- **cooldown**: after any membership change, decisions are suppressed
+  for `cooldown_s` (counted as `scale.cooldown_skipped`) — the fleet
+  observes the EFFECT of its last action before taking another, which
+  bounds the scale-event rate to one per cooldown regardless of how
+  violent the wave is.
+
+The control loop runs wherever the caller wants it: `tick()` is one
+synchronous decision (deterministic tests, the soak's chaos thread),
+`run_in_thread()` owns a daemon poller for live serving. The
+`snapshot()` payload rides /healthz (obs/server.register_autoscaler):
+membership epoch, per-replica lifecycle, the last decision + reason.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+import threading
+import time
+from dataclasses import dataclass
+
+from ..obs import get_registry
+from ..utils import envvars
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class AutoscaleConfig:
+    """Autoscaler knobs. None defaults defer to the TPU_IR_AUTOSCALE /
+    TPU_IR_SCALE_* env registry (RUNBOOK §22)."""
+
+    min_replicas: int | None = None    # per-shard floor (never drained)
+    max_replicas: int | None = None    # per-shard ceiling
+    cooldown_s: float | None = None    # min seconds between changes
+    interval_s: float = 0.05           # thread-mode tick period
+    up_occupancy: float = 0.75         # admitted/capacity to arm scale-up
+    down_occupancy: float = 0.15       # admitted/capacity to arm drain
+    sustain_up: int = 4                # consecutive ticks to scale up
+    sustain_down: int = 20             # consecutive ticks to drain
+    drain_timeout_s: float = 30.0      # retire's in-flight wait bound
+
+    def resolved(self) -> "AutoscaleConfig":
+        from dataclasses import replace
+
+        return replace(
+            self,
+            min_replicas=(self.min_replicas
+                          if self.min_replicas is not None else
+                          envvars.get_int("TPU_IR_SCALE_MIN_REPLICAS")),
+            max_replicas=(self.max_replicas
+                          if self.max_replicas is not None else
+                          envvars.get_int("TPU_IR_SCALE_MAX_REPLICAS")),
+            cooldown_s=(self.cooldown_s
+                        if self.cooldown_s is not None else
+                        envvars.get_float("TPU_IR_SCALE_COOLDOWN_S")))
+
+
+def autoscale_enabled(flag: bool | None = None) -> bool:
+    """The enablement knob: an explicit flag wins, else
+    TPU_IR_AUTOSCALE."""
+    return (envvars.get_bool("TPU_IR_AUTOSCALE")
+            if flag is None else bool(flag))
+
+
+class Autoscaler:
+    """One control loop over (shardset, router). Thread-safe: `tick()`
+    may be driven externally or by the owned poller, and `snapshot()`
+    is read concurrently by /healthz."""
+
+    def __init__(self, shardset, router,
+                 config: AutoscaleConfig | None = None):
+        self.shardset = shardset
+        self.router = router
+        self.config = (config or AutoscaleConfig()).resolved()
+        if self.config.max_replicas < self.config.min_replicas:
+            raise ValueError("TPU_IR_SCALE_MAX_REPLICAS < "
+                             "TPU_IR_SCALE_MIN_REPLICAS")
+        self._lock = threading.Lock()
+        self._ticks_over = 0
+        self._ticks_under = 0
+        self._cooldown_until = 0.0
+        self._last_decision = {"action": None, "reason": "never_ticked"}
+        self._ticks = 0
+        # (active_replicas_min, router in-flight) per tick — the
+        # provisioned-vs-needed series overprovision_fraction integrates
+        self._samples: list = []
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        from ..obs.server import register_autoscaler
+
+        register_autoscaler(self)
+
+    # -- the signal --------------------------------------------------------
+
+    def occupancy(self) -> float:
+        """The pressure signal: the router's ADMITTED population
+        (executing + queued) over its execution capacity. > 1 means
+        requests are queueing; ~0 means the fleet is idle. Router-side
+        by design: it sees the whole fleet's demand in one number,
+        where any single worker's view is one shard's weather."""
+        adm = self.router.admission
+        return ((adm.in_flight() + adm.queue_depth())
+                / max(adm.max_concurrency, 1))
+
+    # -- the decision ------------------------------------------------------
+
+    def tick(self, now: float | None = None) -> dict:
+        """One decision instant. Reads the signal, advances the
+        hysteresis counters, and (outside cooldown) executes at most
+        one membership change. Returns the decision record."""
+        cfg = self.config
+        now = time.monotonic() if now is None else now
+        occ = self.occupancy()
+        active = self.shardset.active_replicas()
+        with self._lock:
+            self._ticks += 1
+            if len(self._samples) < 200_000:
+                self._samples.append((active, self.router.admission
+                                      .in_flight()))
+            if occ >= cfg.up_occupancy:
+                self._ticks_over += 1
+                self._ticks_under = 0
+            elif occ <= cfg.down_occupancy:
+                self._ticks_under += 1
+                self._ticks_over = 0
+            else:
+                self._ticks_over = 0
+                self._ticks_under = 0
+            want = None
+            if self._ticks_over >= cfg.sustain_up:
+                want = "up"
+            elif self._ticks_under >= cfg.sustain_down:
+                want = "down"
+            in_cooldown = now < self._cooldown_until
+        decision = {"action": None, "reason": "steady",
+                    "occupancy": round(occ, 3), "active": active,
+                    "tick": self._ticks}
+        if want == "up":
+            if active >= cfg.max_replicas:
+                decision["reason"] = "at_max_replicas"
+            elif in_cooldown:
+                get_registry().incr("scale.cooldown_skipped")
+                decision["reason"] = "cooldown"
+            else:
+                decision.update(self._scale_up(now))
+        elif want == "down":
+            if active <= cfg.min_replicas:
+                decision["reason"] = "at_min_replicas"
+            elif in_cooldown:
+                get_registry().incr("scale.cooldown_skipped")
+                decision["reason"] = "cooldown"
+            else:
+                decision.update(self._scale_down(now))
+        with self._lock:
+            if decision["action"] is not None:
+                self._ticks_over = 0
+                self._ticks_under = 0
+            self._last_decision = decision
+        return decision
+
+    def _scale_up(self, now: float) -> dict:
+        try:
+            added = self.shardset.grow()
+        except Exception as e:  # noqa: BLE001 — a failed spawn must not
+            # kill the control loop; pressure re-arms the next attempt
+            logger.exception("autoscaler scale-up failed")
+            return {"action": None, "reason": f"up_failed: {e!r}"}
+        # a grown slot may REUSE a retired index: the fresh worker must
+        # not inherit the previous occupant's breaker history
+        if hasattr(self.router, "reset_breaker"):
+            for s, r in added:
+                self.router.reset_breaker(s, r)
+        with self._lock:
+            self._cooldown_until = now + self.config.cooldown_s
+        return {"action": "up", "reason": "sustained_pressure",
+                "slots": added}
+
+    def _scale_down(self, now: float) -> dict:
+        # drain the highest-index active replica of every shard (the
+        # symmetric inverse of grow) — chosen under the shardset's own
+        # lifecycle view so a concurrent kill can't desync the pick
+        life = self.shardset.lifecycle()
+        picks = []
+        for s, states in enumerate(life):
+            active_rs = [r for r, st in enumerate(states)
+                         if st == "active"]
+            if len(active_rs) > self.config.min_replicas:
+                picks.append((s, active_rs[-1]))
+        if not picks:
+            return {"action": None, "reason": "no_drainable_replica"}
+        drains = []
+        for s, r in picks:
+            try:
+                drains.append(self.shardset.retire_replica(
+                    s, r, drain_timeout_s=self.config.drain_timeout_s))
+            except Exception:  # noqa: BLE001 — a chaos kill racing the
+                # pick loses the race benignly; the slot is a corpse
+                logger.exception("autoscaler drain failed")
+        with self._lock:
+            self._cooldown_until = now + self.config.cooldown_s
+        return {"action": "down", "reason": "sustained_idleness",
+                "drains": drains}
+
+    # -- thread mode -------------------------------------------------------
+
+    def run_in_thread(self) -> "Autoscaler":
+        self._thread = threading.Thread(
+            target=self._run, name="tpu-ir-autoscaler", daemon=True)
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self.tick()
+            except Exception:  # noqa: BLE001 — the loop survives any
+                logger.exception("autoscaler tick")  # one bad tick
+            self._stop.wait(self.config.interval_s)
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=60.0)
+            self._thread = None
+
+    def __enter__(self) -> "Autoscaler":
+        return self.run_in_thread()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- accounting / introspection ----------------------------------------
+
+    def utilization_report(self, worker_concurrency: int | None = None
+                           ) -> dict:
+        """Integrate the tick series into the bench row's two numbers:
+
+        - `mean_replicas`: mean active replicas per shard across ticks
+          (the "equal mean replica count" the static control matches);
+        - `overprovision_fraction`: mean over ticks of the ACTIVE
+          replicas that the observed in-flight load did not need —
+          needed(t) = ceil(in_flight(t) / worker max_concurrency),
+          clamped to [1, active(t)] (every routed request fans out to
+          every shard, so the router's in-flight count IS each shard's
+          concurrent demand). 0 = perfectly sized, 0.5 = half the
+          fleet idle on average."""
+        wc = max(worker_concurrency
+                 or getattr(self.shardset, "max_concurrency", 1), 1)
+        with self._lock:
+            samples = list(self._samples)
+        if not samples:
+            return {"mean_replicas": -1.0,
+                    "overprovision_fraction": -1.0, "ticks": 0}
+        over = 0.0
+        for active, inflight in samples:
+            if active <= 0:
+                continue
+            needed = min(active, max(1, math.ceil(inflight / wc)))
+            over += (active - needed) / active
+        return {
+            "mean_replicas": round(
+                sum(a for a, _ in samples) / len(samples), 3),
+            "overprovision_fraction": round(over / len(samples), 4),
+            "ticks": len(samples),
+        }
+
+    def snapshot(self) -> dict:
+        """The /healthz autoscaler section: epoch, per-replica
+        lifecycle, hysteresis state, the last decision + reason."""
+        cfg = self.config
+        with self._lock:
+            last = dict(self._last_decision)
+            over, under = self._ticks_over, self._ticks_under
+            cooldown_left = max(0.0,
+                                self._cooldown_until - time.monotonic())
+        return {
+            "enabled": True,
+            "epoch": self.shardset.epoch(),
+            "lifecycle": self.shardset.lifecycle(),
+            "events": len(self.shardset.events()),
+            "occupancy": round(self.occupancy(), 3),
+            "ticks_over": over, "ticks_under": under,
+            "cooldown_remaining_s": round(cooldown_left, 3),
+            "last_decision": last,
+            "config": {
+                "min_replicas": cfg.min_replicas,
+                "max_replicas": cfg.max_replicas,
+                "cooldown_s": cfg.cooldown_s,
+                "up_occupancy": cfg.up_occupancy,
+                "down_occupancy": cfg.down_occupancy,
+                "sustain_up": cfg.sustain_up,
+                "sustain_down": cfg.sustain_down,
+            },
+        }

@@ -128,6 +128,23 @@ class CircuitBreaker:
                 self._state = OPEN
                 self._opened_at = self._clock()
 
+    def reset(self) -> None:
+        """Return to pristine CLOSED — the membership-change hook
+        (ISSUE 16). When an elastic scale-up reuses a retired slot
+        index, the new occupant is a different process on a different
+        port: breaker state its predecessor earned (open state,
+        consecutive-failure count, an outstanding probe slot) must not
+        transfer, or a warm replica would enter the grid already
+        half-condemned. In place rather than by discarding the object:
+        a request thread that resolved this breaker before the scale
+        event must record its verdict where later requests will read
+        it. Cumulative telemetry (opened/probe counts) is kept — it
+        narrates the slot's history, not the new worker's health."""
+        with self._lock:
+            self._state = CLOSED
+            self._consecutive = 0
+            self._probe_inflight = False
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

@@ -302,6 +302,7 @@ class ServingFrontend:
         out["ladder"] = self.ladder.snapshot()
         out["breaker"] = self.breaker.snapshot()
         out["queue_depth"] = self.admission.queue_depth()
+        out["in_flight"] = self.admission.in_flight()
         out["generation"] = scorer.generation
         if batcher is not None:
             out["batching"] = batcher.snapshot()

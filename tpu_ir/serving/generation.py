@@ -52,43 +52,93 @@ def rolling_swap(topology, generation: int | None = None, *,
     Dead/unreachable replicas are skipped and reported (`failed`) —
     a rolling upgrade must not wedge on the corpse the chaos schedule
     just SIGKILLed; the respawn path brings it back on the new
-    generation."""
-    if callable(topology):
-        grid = topology()
-    elif hasattr(topology, "addresses"):
-        grid = topology.addresses()
-    else:
-        grid = [list(row) for row in topology]
+    generation.
+
+    **Swap-during-scale (ISSUE 16).** An elastic topology can grow,
+    shrink and respawn replicas WHILE the walk runs — a replica that
+    publishes after the snapshot this walk took would silently stay on
+    the old generation. Two mechanisms close that window: when the
+    target generation is known up front it is pinned onto the topology
+    BEFORE the walk (every spawn from that instant loads the new
+    generation — and ShardSet.grow re-checks the pin before a new
+    replica enters the dispatch grid), and after each pass the
+    topology's membership EPOCH is re-read: if it moved, the grid is
+    re-walked (already-confirmed addresses skipped) until one full
+    pass observes a stable epoch — so a swap concurrent with any
+    membership change still ends zero-stale."""
+    def read_grid():
+        if callable(topology):
+            return topology()
+        if hasattr(topology, "addresses"):
+            return topology.addresses()
+        return [list(row) for row in topology]
+
+    epoch_fn = getattr(topology, "epoch", None)
+    lifecycle_fn = getattr(topology, "lifecycle", None)
     t0 = time.perf_counter()
     swapped, failed = [], []
+    confirmed: set = set()
     result_gen = generation
-    for shard, row in enumerate(grid):
-        for replica, addr in enumerate(row):
-            if not addr:
-                continue
-            payload = ({} if generation is None
-                       else {"generation": int(generation)})
-            try:
-                out = rpc_post(addr, "reload", payload,
-                               reload_timeout_s)
-                result_gen = out.get("generation", result_gen)
-                if confirm:
-                    h = get_worker_health(addr, 10.0)
-                    got = (h.get("worker") or {}).get("index_generation")
-                    if result_gen is not None and got != result_gen:
-                        raise RuntimeError(
-                            f"worker {addr} reports index_generation "
-                            f"{got!r} after reload to {result_gen}")
-                swapped.append((shard, replica, addr))
-            except Exception as e:  # noqa: BLE001 — a dead replica must
-                # not wedge the roll; it respawns on the new generation
-                logger.warning("rolling swap: %s failed: %r", addr, e)
-                failed.append((shard, replica, addr, repr(e)))
+    if generation is not None \
+            and hasattr(topology, "set_index_generation"):
+        # pin FIRST: a replica spawning concurrently with this walk
+        # must load the new generation, not the old pin
+        topology.set_index_generation(int(generation))
+    rounds = 0
+    while True:
+        rounds += 1
+        epoch_before = epoch_fn() if epoch_fn else None
+        grid = read_grid()
+        life = lifecycle_fn() if lifecycle_fn else None
+        for shard, row in enumerate(grid):
+            for replica, addr in enumerate(row):
+                if not addr or addr in confirmed:
+                    continue
+                if life is not None:
+                    st = life[shard][replica] if (
+                        shard < len(life)
+                        and replica < len(life[shard])) else None
+                    if st in ("draining", "retired"):
+                        # a replica LEAVING the fleet is not rolled: a
+                        # draining worker only finishes old in-flight
+                        # work (bounded window), a retired slot is a
+                        # corpse
+                        continue
+                payload = ({} if generation is None
+                           else {"generation": int(generation)})
+                try:
+                    out = rpc_post(addr, "reload", payload,
+                                   reload_timeout_s)
+                    result_gen = out.get("generation", result_gen)
+                    if confirm:
+                        h = get_worker_health(addr, 10.0)
+                        got = (h.get("worker")
+                               or {}).get("index_generation")
+                        if result_gen is not None and got != result_gen:
+                            raise RuntimeError(
+                                f"worker {addr} reports index_"
+                                f"generation {got!r} after reload to "
+                                f"{result_gen}")
+                    swapped.append((shard, replica, addr))
+                    confirmed.add(addr)
+                except Exception as e:  # noqa: BLE001 — a dead replica
+                    # must not wedge the roll; it respawns on the new
+                    # generation
+                    logger.warning("rolling swap: %s failed: %r",
+                                   addr, e)
+                    failed.append((shard, replica, addr, repr(e)))
+        if epoch_fn is None or epoch_fn() == epoch_before:
+            break
+        if rounds >= 8:
+            logger.warning("rolling swap: membership still churning "
+                           "after %d passes; stopping (the grow gate "
+                           "re-pins late spawns)", rounds)
+            break
     if hasattr(topology, "set_index_generation"):
         # future respawns must come back on the NEW generation
         topology.set_index_generation(result_gen)
     return {"generation": result_gen,
-            "swapped": swapped, "failed": failed,
+            "swapped": swapped, "failed": failed, "rounds": rounds,
             "wall_s": round(time.perf_counter() - t0, 3)}
 
 

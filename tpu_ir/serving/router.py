@@ -205,15 +205,26 @@ class Router:
                                   "TPU_IR_ROUTER_HEALTH_TTL_S"))
         # topology: a ShardSet, a callable, or a static [shard][replica]
         # address grid — normalized to a callable re-read per request so
-        # respawned workers (new ports) are picked up without plumbing
+        # respawned workers (new ports) are picked up without plumbing.
+        # An elastic topology (ISSUE 16) exposes TWO views: the router
+        # DIALS `dispatchable()` (warming/draining/retired slots nulled
+        # — a draining replica leaves the dispatch grid, its breaker's
+        # probe rotation and the hedge p99 the instant its drain
+        # begins) while health reporting reads the raw `addresses()`.
+        self._lifecycle_fn = getattr(topology, "lifecycle", None)
+        self._epoch_fn = getattr(topology, "epoch", None)
         if callable(topology):
             self._topology = topology
+            self._full_topology = topology
         elif hasattr(topology, "addresses"):
-            self._topology = topology.addresses
+            self._topology = getattr(topology, "dispatchable",
+                                     topology.addresses)
+            self._full_topology = topology.addresses
         else:
             static = [list(row) for row in topology]
             self._topology = lambda: static
-        grid = self._topology()
+            self._full_topology = self._topology
+        grid = self._full_topology()
         self.num_shards = len(grid)
         if self.num_shards < 1:
             raise ValueError("topology has no shards")
@@ -345,10 +356,34 @@ class Router:
             return False, repr(e)
         rtt = time.perf_counter() - t0
         breaker.record_success(is_probe=is_probe)
-        self._stats[shard].observe(rtt)
+        # a replica that went DRAINING while this call was in flight
+        # still answers (drain-not-drop), but its RTT must not feed the
+        # hedge estimate: a drain is membership change, not a slow peer
+        if not self._replica_draining(shard, replica):
+            self._stats[shard].observe(rtt)
         if obs.enabled():
             get_registry().observe("router.shard_rtt", rtt)
         return True, data
+
+    def _replica_draining(self, shard: int, replica: int) -> bool:
+        if self._lifecycle_fn is None:
+            return False
+        try:
+            life = self._lifecycle_fn()
+            return life[shard][replica] == "draining"
+        except (IndexError, TypeError):
+            return False
+
+    def reset_breaker(self, shard: int, replica: int) -> None:
+        """Forget one replica's breaker history — the autoscaler calls
+        this when a scale-up REUSES a retired slot index: the fresh
+        warm worker must not inherit whatever state the slot's previous
+        occupant earned (breaker.reset() keeps the object in place so
+        in-flight verdicts still land where later requests read)."""
+        with self._breakers_lock:
+            b = self._breakers.get((shard, replica))
+        if b is not None:
+            b.reset()
 
     def _replica_order(self, shard: int, avail: list) -> list:
         """Replica try-order for one request over the ADDRESSED replica
@@ -815,7 +850,13 @@ class Router:
                 self._health_polling = False
 
     def _health_sweep(self) -> dict:
-        grid = self._topology()
+        grid = self._full_topology()
+        life = None
+        if self._lifecycle_fn is not None:
+            try:
+                life = self._lifecycle_fn()
+            except Exception:  # noqa: BLE001 — health must not 500
+                life = None
         shards = []
         for s in range(self.num_shards):
             row = grid[s] if s < len(grid) else []
@@ -823,6 +864,9 @@ class Router:
             for r, addr in enumerate(row):
                 item = {"replica": r, "addr": addr,
                         "breaker": self._breaker(s, r).snapshot()}
+                if life is not None and s < len(life) \
+                        and r < len(life[s]):
+                    item["lifecycle"] = life[s][r]
                 item.update(self._poll_worker_health(addr))
                 replicas.append(item)
             p99 = self._stats[s].p99_s()
@@ -839,6 +883,8 @@ class Router:
         with self._gen_lock:
             gens = sorted(self._gen_infos)
         payload = {"num_shards": self.num_shards,
+                   "membership_epoch": (self._epoch_fn()
+                                        if self._epoch_fn else None),
                    "hedge_floor_ms": round(self._hedge_floor_s * 1e3, 3),
                    "deadline_ms": round(self._deadline_s * 1e3, 3),
                    # the live-index view: generations this router has

@@ -193,7 +193,10 @@ def serve_worker(index_dir: str, shard: int, num_shards: int, *,
     residency = {"engaged": False}
 
     def info() -> dict:
+        from ..obs import get_registry
+
         sc = frontend.scorer
+        reg = get_registry()
         return {"worker": {
             "shard": shard, "replica": replica, "num_shards": num_shards,
             "doc_range": list(sc.doc_range or ()),
@@ -201,6 +204,15 @@ def serve_worker(index_dir: str, shard: int, num_shards: int, *,
             "index_generation": sc.generation,
             "live": live,
             "residency": residency,
+            # the drain handshake's signal (ISSUE 16): a retiring
+            # worker is terminated only once executing + queued read 0
+            "in_flight": frontend.admission.in_flight(),
+            "queued": frontend.admission.queue_depth(),
+            # THIS process's compile counters: the warm-start pin reads
+            # them across a scale-up (delta must be 0 — the precompile
+            # walk ran before the replica entered the dispatch grid)
+            "compiles": {"count": reg.get("compile.count"),
+                         "recompiles": reg.get("compile.recompiles")},
             "pid": os.getpid(), "layout": sc.layout,
         }}
 
@@ -383,14 +395,44 @@ class ShardSet:
     the router keeps reading `addresses()` — a killed slot keeps its
     stale address until respawn (the router's breaker/deadline machinery
     is what handles the corpse, exactly as it would a remote host that
-    dropped off the network)."""
+    dropped off the network).
+
+    **Elastic membership (ISSUE 16).** The replica axis is ELASTIC:
+    `grow()` adds one warm replica to every shard, `retire_replica()`
+    drains one away. Every slot carries a lifecycle state —
+
+        warming -> active -> draining -> retired
+
+    — and every state transition bumps a MEMBERSHIP EPOCH (a counter
+    concurrent walkers like `rolling_swap` use to detect that the grid
+    changed under them and re-walk until it is stable). Two views of
+    the grid exist: `addresses()` is the raw truth (stale corpse and
+    draining addresses included — the health/chaos view), while
+    `dispatchable()` nulls every non-active slot — the router dials
+    ONLY dispatchable addresses, which is what makes the two contracts
+    hold:
+
+    - **warm-start**: a growing replica is `warming` (not dispatchable)
+      until its ready file lands — and the worker writes that file only
+      AFTER the precompile walk + residency pre-warm, so no routed
+      request ever reaches a cold process (no compile storm, no breaker
+      trip attributable to scale-up);
+    - **drain-not-drop**: a retiring replica flips to `draining` (not
+      dispatchable — new fan-outs exclude it immediately) but keeps
+      serving; retire polls its in-flight count to zero before SIGTERM,
+      so requests already dispatched to it complete normally. The one
+      unavoidable race (an RPC from a pre-drain grid snapshot landing
+      after the poll) is covered by the router's failover — the request
+      is re-dispatched, never dropped, so `shed + served == submitted`
+      holds across every membership change."""
 
     def __init__(self, index_dir: str, *, shards: int, replicas: int = 1,
                  layout: str = "sparse", deadline_s: float | None = None,
                  rundir: str | None = None, warm: bool = True,
                  max_concurrency: int = 4, max_queue: int = 16,
                  spawn_timeout_s: float = 120.0,
-                 index_generation: int | None = None):
+                 index_generation: int | None = None,
+                 grow_nice: int = 5):
         if shards < 1 or replicas < 1:
             raise ValueError("shards and replicas must be >= 1")
         self.index_dir = index_dir
@@ -406,6 +448,11 @@ class ShardSet:
         self.max_concurrency = max_concurrency
         self.max_queue = max_queue
         self.spawn_timeout_s = spawn_timeout_s
+        # scale-up spawns warm up (interpreter + jax import + precompile)
+        # at this nice level so they don't steal CPU from the live
+        # serving path they exist to relieve; priority is restored once
+        # the ready file lands (best-effort — needs CAP_SYS_NICE)
+        self.grow_nice = grow_nice
         import tempfile
 
         self.rundir = rundir or tempfile.mkdtemp(prefix="tpu-ir-shardset-")
@@ -413,6 +460,13 @@ class ShardSet:
         self._lock = threading.Lock()
         self._grid: list[list[WorkerHandle | None]] = [
             [None] * replicas for _ in range(shards)]
+        # per-slot lifecycle, parallel to the grid; every transition
+        # bumps the membership epoch (start() publishes "active")
+        self._state: list[list[str]] = [
+            ["warming"] * replicas for _ in range(shards)]
+        self._epoch = 0
+        # op-level membership log: ("up"|"down", shard, replica, epoch)
+        self._events: list[tuple] = []
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -428,6 +482,8 @@ class ShardSet:
             handle = self._wait_ready(s, r, 0, proc, ready_path, deadline)
             with self._lock:
                 self._grid[s][r] = handle
+                self._state[s][r] = "active"
+                self._epoch += 1
         return self
 
     def _cfg_paths(self, shard: int, replica: int, generation: int):
@@ -435,8 +491,13 @@ class ShardSet:
         return (os.path.join(self.rundir, base + ".json"),
                 os.path.join(self.rundir, base + ".ready"))
 
-    def _spawn(self, shard: int, replica: int, *, generation: int):
+    def _spawn(self, shard: int, replica: int, *, generation: int,
+               nice: int = 0):
         cfg_path, ready_path = self._cfg_paths(shard, replica, generation)
+        # a reused (shard, replica, generation) slot must never read a
+        # previous incarnation's ready file as its own
+        if os.path.exists(ready_path):
+            os.unlink(ready_path)
         cfg = {
             "index_dir": self.index_dir, "shard": shard,
             "num_shards": self.shards, "replica": replica,
@@ -457,7 +518,8 @@ class ShardSet:
                 [sys.executable, "-m", "tpu_ir.serving.shardset",
                  cfg_path],
                 stdin=subprocess.PIPE, stdout=log, stderr=log,
-                cwd=os.getcwd())
+                cwd=os.getcwd(),
+                preexec_fn=(lambda: os.nice(nice)) if nice else None)
         finally:
             log.close()  # the child holds its own descriptor
         return proc, ready_path
@@ -507,6 +569,8 @@ class ShardSet:
             time.monotonic() + self.spawn_timeout_s)
         with self._lock:
             self._grid[shard][replica] = handle
+            self._state[shard][replica] = "active"
+            self._epoch += 1
         from ..obs import get_registry
 
         get_registry().incr("router.worker_respawn")
@@ -519,9 +583,211 @@ class ShardSet:
         with self._lock:
             self.index_generation = generation
 
+    # -- elastic membership (ISSUE 16) -------------------------------------
+
+    def epoch(self) -> int:
+        """The membership epoch: bumped on EVERY grid/state transition
+        (publish, respawn, drain begin, retire). A concurrent walker
+        (rolling_swap) snapshots it before a pass and re-walks until a
+        full pass observes no change — the convergence handshake that
+        keeps swap-during-scale zero-stale."""
+        with self._lock:
+            return self._epoch
+
+    def lifecycle(self) -> list:
+        """[shard][replica] -> lifecycle state string (warming / active
+        / draining / retired) — the /healthz + router drain-awareness
+        view, parallel to addresses()."""
+        with self._lock:
+            return [list(row) for row in self._state]
+
+    def events(self) -> list:
+        """Op-level membership log: ("up"|"down", shard, replica,
+        epoch) per replica that entered/left the dispatch grid."""
+        with self._lock:
+            return list(self._events)
+
+    def dispatchable(self) -> list:
+        """addresses() with every non-active slot nulled — the view the
+        router dials. A draining replica disappears from here the
+        instant its drain begins (new fan-outs exclude it; its breaker
+        sees no probes, the hedge p99 no samples) while addresses()
+        keeps showing it to health/chaos tooling until it exits."""
+        with self._lock:
+            return [[h.addr if h and st == "active" else None
+                     for h, st in zip(row, states)]
+                    for row, states in zip(self._grid, self._state)]
+
+    def grow(self) -> list:
+        """Add one WARM replica to every shard: spawn concurrently (the
+        start() rationale), wait for every ready file — written only
+        after the worker's precompile walk + residency pre-warm — and
+        only then publish the handles into the dispatch grid. Returns
+        the [(shard, replica)] slots added. Reuses the lowest retired
+        slot per shard (spawn generation bumped past the retiree's) so
+        a breathing workload doesn't widen the grid without bound."""
+        from ..obs import get_registry
+
+        t0 = time.perf_counter()
+        slots: list = []
+        with self._lock:
+            for s in range(self.shards):
+                row, states = self._grid[s], self._state[s]
+                for r, st in enumerate(states):
+                    if st == "retired":
+                        gen = (row[r].generation + 1) if row[r] else 0
+                        break
+                else:
+                    r, gen = len(row), 0
+                    row.append(None)
+                    states.append("warming")
+                states[r] = "warming"
+                self._epoch += 1
+                slots.append((s, r, gen))
+        # warm up at lower CPU priority: on a saturated host a full-speed
+        # spawn (interpreter + jax import + precompile) steals cycles
+        # from the very serving path the scale-up exists to relieve
+        procs = [(s, r, g,
+                  self._spawn(s, r, generation=g, nice=self.grow_nice))
+                 for s, r, g in slots]
+        deadline = time.monotonic() + self.spawn_timeout_s
+        added = []
+        for s, r, g, (proc, ready_path) in procs:
+            handle = self._wait_ready(s, r, g, proc, ready_path, deadline)
+            if self.grow_nice:
+                try:  # restore full priority before it takes traffic
+                    os.setpriority(os.PRIO_PROCESS, handle.pid, 0)
+                except (OSError, AttributeError):
+                    pass  # no CAP_SYS_NICE: it serves niced, still warm
+            with self._lock:
+                # the swap-during-scale gate: if a rolling swap re-pinned
+                # the index generation while this worker was loading the
+                # OLD pin, reload it onto the current one BEFORE it can
+                # serve a single routed request
+                pinned = self.index_generation
+            if pinned is not None and pinned != self._worker_index_gen(
+                    handle):
+                rpc_post(handle.addr, "reload", {"generation": pinned},
+                         timeout_s=self.spawn_timeout_s)
+            with self._lock:
+                self._grid[s][r] = handle
+                self._state[s][r] = "active"
+                self._epoch += 1
+                self._events.append(("up", s, r, self._epoch))
+            added.append((s, r))
+        reg = get_registry()
+        reg.incr("scale.up", len(added))
+        reg.observe("scale.warmup_ms", time.perf_counter() - t0)
+        return added
+
+    def _worker_index_gen(self, handle) -> int | None:
+        """The index generation a just-readied worker actually loaded
+        (None when unreadable — the caller's reload is then a no-op
+        guard against a pin the worker already satisfies)."""
+        try:
+            w = get_worker_health(handle.addr, 2.0).get("worker") or {}
+            g = w.get("index_generation")
+            return None if g is None else int(g)
+        except Exception:  # noqa: BLE001 — unreadable = don't reload
+            return None
+
+    def begin_drain(self, shard: int, replica: int) -> WorkerHandle:
+        """Flip one active replica to `draining`: it leaves
+        dispatchable() (the router stops dialing it) but keeps serving
+        whatever is already in flight. Returns its handle."""
+        with self._lock:
+            h = self._grid[shard][replica]
+            st = self._state[shard][replica]
+            if h is None or st != "active":
+                raise RuntimeError(
+                    f"cannot drain {shard}/{replica}: state={st}")
+            self._state[shard][replica] = "draining"
+            self._epoch += 1
+        return h
+
+    def retire_replica(self, shard: int, replica: int, *,
+                       drain_timeout_s: float = 30.0) -> dict:
+        """Drain-not-drop retirement: begin_drain (dispatch stops
+        immediately), poll the worker's admitted population (executing
+        + queued) to zero, then SIGTERM and mark the slot `retired`.
+        A replica SIGKILLed mid-drain (chaos) just ends the poll early
+        — its in-flight requests fail over at the router and are still
+        served or shed, never dropped. Returns the drain report."""
+        from ..obs import get_registry
+
+        t0 = time.perf_counter()
+        with self._lock:
+            already_draining = self._state[shard][replica] == "draining"
+            h = self._grid[shard][replica] if already_draining else None
+        if h is None:
+            h = self.begin_drain(shard, replica)
+        inflight_peak = 0
+        zeros = 0
+        deadline = time.monotonic() + max(drain_timeout_s, 0.1)
+        killed_mid_drain = False
+        settled = False
+        while time.monotonic() < deadline:
+            if not h.alive:
+                killed_mid_drain = True
+                break
+            try:
+                w = get_worker_health(h.addr, 1.0).get("worker") or {}
+                admitted = (int(w.get("in_flight", 0))
+                            + int(w.get("queued", 0)))
+            except Exception:  # noqa: BLE001
+                if not h.alive:  # died between the alive check and the
+                    killed_mid_drain = True  # health read — still a kill
+                else:
+                    settled = True  # unreachable = nothing left in
+                break  # flight we can observe; stop waiting
+            inflight_peak = max(inflight_peak, admitted)
+            if admitted == 0:
+                zeros += 1
+                if zeros >= 2:  # two consecutive empty reads: settled
+                    settled = True
+                    break
+            else:
+                zeros = 0
+            time.sleep(0.05)
+        drained_clean = settled and not killed_mid_drain
+        if h.proc is not None and h.proc.poll() is None:
+            h.proc.terminate()
+            try:
+                h.proc.wait(timeout=15.0)
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                h.proc.wait(timeout=10.0)
+        with self._lock:
+            self._state[shard][replica] = "retired"
+            self._epoch += 1
+            self._events.append(("down", shard, replica, self._epoch))
+        reg = get_registry()
+        reg.incr("scale.down")
+        reg.incr("scale.drain_inflight", inflight_peak)
+        drain_s = time.perf_counter() - t0
+        reg.observe("scale.drain_ms", drain_s)
+        return {"shard": shard, "replica": replica,
+                "drain_s": round(drain_s, 3),
+                "inflight_peak": inflight_peak,
+                "drained_clean": drained_clean,
+                "killed_mid_drain": killed_mid_drain}
+
+    def active_replicas(self, shard: int | None = None) -> int:
+        """Active (dispatchable) replica count — for one shard, or the
+        MINIMUM across shards (the fleet's effective replication; the
+        autoscaler's clamp input) when shard is None."""
+        with self._lock:
+            counts = [sum(1 for st in states if st == "active")
+                      for states in self._state]
+        if shard is not None:
+            return counts[shard]
+        return min(counts) if counts else 0
+
     def addresses(self) -> list:
-        """[shard][replica] -> "host:port" — the router's topology view
-        (re-read per request, so respawned workers are picked up)."""
+        """[shard][replica] -> "host:port" — the raw topology truth
+        (re-read per request, so respawned workers are picked up).
+        Corpse and draining slots keep their addresses here; the
+        router dials `dispatchable()` instead."""
         with self._lock:
             return [[h.addr if h else None for h in row]
                     for row in self._grid]

@@ -24,6 +24,7 @@ Used by tests/test_serving.py (fast + slow variants), the
 from __future__ import annotations
 
 import logging
+import math
 import random
 import threading
 import time
@@ -77,6 +78,14 @@ def make_queries(scorer, n: int, seed: int = 0,
 
 def _req_key(r: dict) -> tuple:
     return (r["text"], r["scoring"], r["rerank"], r["k"])
+
+
+def _p99_ms(vals: list) -> float:
+    if not vals:
+        return -1.0
+    vs = sorted(vals)
+    return round(vs[min(len(vs) - 1,
+                        int(round(0.99 * (len(vs) - 1))))], 3)
 
 
 def _cache_counters_now() -> dict:
@@ -361,7 +370,9 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                          recovery_probes: int = 16,
                          recovery_timeout_s: float = 60.0,
                          workload=None,
-                         cache_entries: int | None = None) -> dict:
+                         cache_entries: int | None = None,
+                         autoscale=False,
+                         scale_plan: dict | None = None) -> dict:
     """The scatter-gather chaos soak (ISSUE 10): mixed traffic through a
     REAL multi-process topology — S doc shards x R replica workers
     behind a Router — while a chaos controller SIGKILLs a replica, then
@@ -398,7 +409,24 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
     identical to THAT generation's serial reference, the mixed window
     is bounded (no old-generation response can complete more than one
     in-flight wave after the roll finishes), and the post-soak recovery
-    probes must all serve generation B."""
+    probes must all serve generation B.
+
+    Elastic membership (ISSUE 16): `scale_plan` scripts deterministic
+    scale events into the chaos schedule — `{"up_at": frac}` grows one
+    warm replica per shard, `{"down_at": frac}` drains + retires one,
+    and `{"kill_during_drain": True}` SIGKILLs the draining replica
+    mid-drain (the worst membership race: the drain handshake must
+    settle as killed_mid_drain and the router's failover must keep
+    conservation). `autoscale=True` (or an AutoscaleConfig) runs the
+    closed-loop Autoscaler instead, ticked from the chaos controller
+    thread so decisions interleave with kills and swaps. Either way the
+    report gains a `scale` section (membership epoch, events, drain
+    handshakes, mean active replicas, overprovision_fraction) and a
+    top-level `burst_p99_ms` — the p99 of served latency during the
+    workload's PEAK window (pacing_scale < 1), the number the
+    autoscaled-vs-static bench comparison is about. Conservation
+    (`shed + served == submitted`) is checked by the SAME breach
+    condition across every membership change — that is the contract."""
     from ..index import segments as seg
     from ..obs import get_registry
     from ..search.layout import shard_doc_ranges
@@ -484,6 +512,7 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
         cache_before = _cache_counters_now()
         obs.report_progress("serve", total=len(reqs))
         results: list = [None] * len(reqs)
+        latencies: list = [None] * len(reqs)  # served requests only, ms
         completion_order: list = [0] * len(reqs)
         completed = threading.Event()
         progress = [0]
@@ -509,15 +538,99 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
 
                 cfg_r = _replace(cfg_r, cache_entries=cache_entries)
             router = Router(index_dir, shardset, cfg_r)
+            scaler = None
+            if autoscale:
+                from .autoscale import AutoscaleConfig, Autoscaler
+
+                a_cfg = (autoscale
+                         if isinstance(autoscale, AutoscaleConfig) else
+                         AutoscaleConfig(
+                             min_replicas=replicas,
+                             max_replicas=replicas + 1,
+                             cooldown_s=0.5,
+                             up_occupancy=0.6, down_occupancy=0.15,
+                             sustain_up=3, sustain_down=25,
+                             drain_timeout_s=15.0))
+                # ticked from the chaos controller's own loop (no owned
+                # thread): scaling decisions interleave deterministically
+                # with the kill/respawn/swap schedule at the same 20ms
+                # cadence
+                scaler = Autoscaler(shardset, router, a_cfg)
             try:
                 # -- chaos + upgrade controller -----------------------
                 killed: list = []
                 swap_state = {"done_at": None, "result": None}
                 swap_complete = threading.Event()
+                scale_state: dict = {"drains": [], "samples": []}
+                drain_threads: list = []
+
+                def _retire(s_: int, r_: int) -> None:
+                    try:
+                        scale_state["drains"].append(
+                            shardset.retire_replica(
+                                s_, r_, drain_timeout_s=15.0))
+                    except Exception:  # noqa: BLE001 — a chaos kill
+                        # racing the retire is the scenario, not a crash
+                        logger.exception("scale-down retire")
+
+                def _scripted_scale(frac: float, fired: dict) -> None:
+                    plan = scale_plan or {}
+                    up_at = plan.get("up_at")
+                    down_at = plan.get("down_at")
+                    if up_at is not None and not fired["scale_up"] \
+                            and frac >= up_at:
+                        fired["scale_up"] = True
+
+                        def _grow() -> None:
+                            try:
+                                for s_, r_ in shardset.grow():
+                                    # a grown slot may reuse a retired
+                                    # index — it must not inherit
+                                    # breaker history
+                                    router.reset_breaker(s_, r_)
+                            except Exception:  # noqa: BLE001
+                                logger.exception("scale-up grow")
+
+                        # grow() blocks on a full worker spawn (tens of
+                        # seconds) — in a thread, so the controller
+                        # keeps ticking and down_at still fires while
+                        # traffic is live
+                        gth = threading.Thread(target=_grow,
+                                               name="soak-grow",
+                                               daemon=True)
+                        gth.start()
+                        drain_threads.append(gth)
+                    if down_at is not None and not fired["scale_down"] \
+                            and frac >= down_at:
+                        fired["scale_down"] = True
+                        life = shardset.lifecycle()
+                        for s_, states in enumerate(life):
+                            active_rs = [r for r, st in enumerate(states)
+                                         if st == "active"]
+                            if len(active_rs) < 2:
+                                continue  # never drain a shard dark
+                            r_ = active_rs[-1]
+                            if not plan.get("kill_during_drain"):
+                                _retire(s_, r_)
+                                continue
+                            # the worst race, scripted: SIGKILL the
+                            # replica WHILE its drain handshake runs
+                            th = threading.Thread(
+                                target=_retire, args=(s_, r_),
+                                name="soak-drain", daemon=True)
+                            th.start()
+                            drain_threads.append(th)
+                            for _ in range(200):
+                                if shardset.lifecycle()[s_][r_] \
+                                        == "draining":
+                                    break
+                                time.sleep(0.005)
+                            shardset.kill(s_, r_)
 
                 def chaos_controller():
                     fired = {"replica": False, "shard": False,
-                             "respawn": False, "upgrade": False}
+                             "respawn": False, "upgrade": False,
+                             "scale_up": False, "scale_down": False}
                     while not completed.is_set():
                         with progress_lock:
                             frac = progress[0] / max(len(reqs), 1)
@@ -568,8 +681,17 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                                     # even a failed roll must release
                                     # the held-back traffic tranche
                                     swap_complete.set()
+                            if scale_plan:
+                                _scripted_scale(frac, fired)
+                            if scaler is not None:
+                                scaler.tick()
                         except Exception:  # noqa: BLE001 — chaos must
                             logger.exception("chaos controller")  # not
+                        # the provisioned-vs-demand series behind
+                        # mean_replicas / overprovision_fraction
+                        scale_state["samples"].append(
+                            (shardset.active_replicas(),
+                             router.admission.in_flight()))
                         completed.wait(0.02)  # kill the soak itself
                     # whatever is still dead comes back for recovery
                     for s_, r_ in list(killed):
@@ -590,9 +712,12 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                             seed * 1_000_003 + i).random()
                             * pacing_s * threads * scale)
                     try:
+                        t_req = time.perf_counter()
                         results[i] = ("ok", router.search(
                             r["text"], k=r["k"], scoring=r["scoring"],
                             rerank=r["rerank"]))
+                        latencies[i] = (time.perf_counter()
+                                        - t_req) * 1e3
                     except Overloaded as e:
                         results[i] = ("shed", e)
                     except BaseException as e:  # structured or nothing
@@ -642,6 +767,10 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                         [o for o in results if o is not None]),
                         cancel_futures=True)
                     ctrl.join(timeout=120.0)
+                    for th in drain_threads:
+                        # the drain handshake must SETTLE (clean or
+                        # killed_mid_drain) before invariants are judged
+                        th.join(timeout=60.0)
                 wall_s = time.perf_counter() - t0
 
                 # -- recovery probes (topology healthy again) ---------
@@ -785,6 +914,47 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
         }
         if wl is not None:
             report["workload"] = wl.describe()
+        # burst p99: served latency during the workload's PEAK window
+        # (pacing_scale < 1 — arrivals compressed); the whole run when
+        # the workload has no burst schedule. This is the number the
+        # autoscaled-vs-static comparison trends.
+        served_lat = [v for v in latencies if v is not None]
+        peak_lat = [latencies[i] for i in range(len(reqs))
+                    if latencies[i] is not None and wl is not None
+                    and wl.is_peak(i / len(reqs))]
+        report["burst_p99_ms"] = _p99_ms(peak_lat or served_lat)
+        if autoscale or scale_plan:
+            samples = scale_state["samples"]
+            wc = max(shardset.max_concurrency, 1)
+            over, mean_repl = 0.0, -1.0
+            if samples:
+                for active, inflight in samples:
+                    if active <= 0:
+                        continue
+                    # replicas the observed in-flight demand did not
+                    # need (every request fans out to every shard, so
+                    # router in-flight IS per-shard concurrent demand)
+                    needed = min(active,
+                                 max(1, math.ceil(inflight / wc)))
+                    over += (active - needed) / active
+                over /= len(samples)
+                mean_repl = sum(a for a, _ in samples) / len(samples)
+            drains = scale_state["drains"]
+            report["scale"] = {
+                "events": len(shardset.events()),
+                "epoch": shardset.epoch(),
+                "lifecycle": shardset.lifecycle(),
+                "drains": drains,
+                "drained_clean": sum(
+                    1 for d in drains if d.get("drained_clean")),
+                "killed_mid_drain": sum(
+                    1 for d in drains if d.get("killed_mid_drain")),
+                "mean_replicas": round(mean_repl, 3),
+                "overprovision_fraction": round(over, 4),
+                "ticks": len(samples),
+            }
+            if scaler is not None:
+                report["scale"]["autoscaler"] = scaler.snapshot()
         if upgrade_at is not None:
             report["upgrade"] = {
                 "generation_a": gen_a,

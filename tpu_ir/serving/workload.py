@@ -173,6 +173,13 @@ class Workload:
         # around 1: peak arrivals are (1+b)x denser than trough arrivals
         return (1.0 + self.burst * (1.0 - wave)) / (1.0 + self.burst / 2.0)
 
+    def is_peak(self, frac: float) -> bool:
+        """True when the request at completed-fraction `frac` lands in a
+        burst PEAK (arrivals denser than the flat schedule) — the window
+        `burst_p99_ms` is measured over. Always False for a flat
+        workload: a run with no wave has no peak to single out."""
+        return self.burst > 0.0 and self.pacing_scale(frac) < 1.0
+
     def describe(self) -> dict:
         return {"kind": "zipf", "skew": self.skew, "seed": self.seed,
                 "burst": self.burst, "terms": len(self.terms),

@@ -269,6 +269,25 @@ _declare("TPU_IR_ROUTER_CONNECT_MS", "float", 250.0,
 _declare("TPU_IR_ROUTER_HEALTH_TTL_S", "float", 2.0,
          "max age of cached per-worker /healthz payloads in the "
          "router's aggregated health view", "§17", minimum=0.0)
+_declare("TPU_IR_AUTOSCALE", "bool", False,
+         "1 runs the elastic-capacity autoscaler over the shard fleet "
+         "(serve-bench --autoscale and embedders): sustained admission "
+         "pressure adds a warm replica per shard, sustained idleness "
+         "drains one away (drain-not-drop — in-flight requests finish "
+         "before the process exits)", "§22")
+_declare("TPU_IR_SCALE_MIN_REPLICAS", "int", 1,
+         "autoscaler floor: replicas per shard it will never drain "
+         "below (the always-on capacity that serves the trough)", "§22",
+         minimum=1)
+_declare("TPU_IR_SCALE_MAX_REPLICAS", "int", 4,
+         "autoscaler ceiling: replicas per shard it will never grow "
+         "past (bounds spawn cost and memory under a runaway burst)",
+         "§22", minimum=1)
+_declare("TPU_IR_SCALE_COOLDOWN_S", "float", 5.0,
+         "minimum seconds between autoscaler membership changes: the "
+         "flap damper — a diurnal wave shorter than twice this value "
+         "cannot make the fleet oscillate (suppressed decisions count "
+         "as scale.cooldown_skipped)", "§22", minimum=0.0)
 
 
 def _raw(name: str) -> str | None:
