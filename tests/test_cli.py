@@ -457,6 +457,9 @@ def _smoke_matrix(index_dir: str, corpus: str, tmp) -> dict:
         "scale": (["scale"], {"enabled", "config"}),
         "compact": (["compact", str(tmp / "live")],
                     {"steps", "segments", "generation", "mode"}),
+        "backup": (["backup", str(tmp / "live"),
+                    str(tmp / "smoke_backup")],
+                   {"generation", "segments", "files", "dest"}),
         "serve-worker": (["serve-worker", index_dir, "--shard", "0/2",
                           "--no-warm", "--run-for", "0.05"],
                          {"addr", "shard", "num_shards", "doc_range"}),
@@ -479,7 +482,7 @@ _SMOKE_NAMES = sorted(
      "merge", "stats", "metrics", "trace-dump", "profile", "querylog",
      "doctor", "bench-check", "serve-bench", "eval", "pack", "count",
      "docno", "expand", "lint", "ingest", "generations", "cache",
-     "compact", "serve-worker", "scale"])
+     "compact", "serve-worker", "scale", "backup"])
 
 
 def test_cli_smoke_matrix_is_complete(setup):

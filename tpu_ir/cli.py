@@ -580,6 +580,26 @@ def cmd_ingest(args) -> int:
         report["history_row"] = row
         print(json.dumps(report, sort_keys=True))
         return 0
+    if args.soak_bench:
+        from .obs.bench_check import append_history_row
+        from .serving.soak import run_ingest_soak
+
+        report = run_ingest_soak(args.live_dir)
+        import jax
+
+        row = {
+            "config": "ingest_soak",
+            "backend": jax.default_backend(),
+            "docs": report["docs"],
+            "kills": report["kills"],
+            "swaps": report["swaps"],
+            "ingest_docs_per_s": report["ingest_docs_per_s"],
+            "freshness_lag_ms": report["freshness_lag_ms"],
+        }
+        report["history"] = append_history_row(row)
+        report["history_row"] = row
+        print(json.dumps(report, sort_keys=True))
+        return 0
     if args.init and not seg.is_live(args.live_dir):
         seg.LiveIndex.create(args.live_dir, k=args.k,
                              num_shards=args.shards,
@@ -596,11 +616,14 @@ def cmd_ingest(args) -> int:
     updated = sum(ingest_corpus(writer, p, update=True)
                   for p in args.update)
     deleted = sum(bool(writer.delete(d)) for d in args.delete)
-    writer.close()
+    # compact/merge BEFORE close: close releases the WAL handle and the
+    # writer lease, and a merge commit belongs inside the owned window
     if args.compact:
         writer.compact_all()
     elif args.merge:
+        writer.flush()
         writer.maybe_merge()
+    writer.close()
     live = writer.live
     out = {
         "live_dir": os.path.abspath(args.live_dir),
@@ -611,6 +634,24 @@ def cmd_ingest(args) -> int:
     }
     if args.gc:
         out["gc"] = live.gc()
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def cmd_backup(args) -> int:
+    """Disaster-recovery surface (ISSUE 17; index/backup.py): snapshot
+    a live dir's current generation — hardlinks where the filesystem
+    allows, so an immutable segment costs no new bytes — including the
+    WAL tail (acknowledged-but-unflushed writes restore via the
+    ordinary replay path). `--restore` materializes a snapshot into a
+    fresh dir and PROVES it with verify_live before reporting success
+    (RUNBOOK §23's recipe)."""
+    from .index.backup import backup_live, restore_live
+
+    if args.restore:
+        out = restore_live(args.src, args.dest)
+    else:
+        out = backup_live(args.src, args.dest)
     print(json.dumps(out, sort_keys=True))
     return 0
 
@@ -1521,7 +1562,7 @@ _ARTIFACT_ENTRY_CMDS = frozenset({
     "cmd_search", "cmd_inspect", "cmd_verify", "cmd_warm", "cmd_docno",
     "cmd_expand", "cmd_eval", "cmd_count", "cmd_pack", "cmd_merge",
     "cmd_serve_bench", "cmd_migrate_index", "cmd_doctor",
-    "cmd_generations",
+    "cmd_generations", "cmd_backup",
 })
 
 
@@ -1706,8 +1747,30 @@ def main(argv: list[str] | None = None) -> int:
                      help="run the ingest->compact->swap micro-bench "
                           "against live_dir (created if missing) and "
                           "append swap_gap_ms to BENCH_HISTORY.jsonl")
+    pin.add_argument("--soak-bench", action="store_true",
+                     help="run the durable ingest+serve soak (child "
+                          "feeder SIGKILLed mid-stream + exactly-once "
+                          "recovery, probes serving throughout) and "
+                          "append ingest_docs_per_s / freshness_lag_ms "
+                          "to BENCH_HISTORY.jsonl")
     _add_backend_arg(pin)
     pin.set_defaults(fn=cmd_ingest)
+
+    pbk = sub.add_parser(
+        "backup",
+        help="generation-pinned hardlink snapshot of a live dir "
+             "(current manifest + referenced segments + WAL tail; "
+             "acked-but-unflushed writes ride the WAL) — or, with "
+             "--restore, materialize+verify a snapshot into a new dir")
+    pbk.add_argument("src", help="live dir to snapshot (or, with "
+                                 "--restore, the backup to restore)")
+    pbk.add_argument("dest", help="destination dir (must not exist or "
+                                  "be empty)")
+    pbk.add_argument("--restore", action="store_true",
+                     help="treat src as a backup: link/copy it into "
+                          "dest and run the full verify_live gauntlet "
+                          "on the result")
+    pbk.set_defaults(fn=cmd_backup)
 
     pgen = sub.add_parser(
         "generations",

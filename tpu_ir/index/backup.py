@@ -1,0 +1,120 @@
+"""Generation-pinned live-dir snapshots (`tpu-ir backup` / --restore).
+
+A backup is the CURRENT generation, pinned: live.json, the one manifest
+CURRENT names, every segment dir that manifest references, and the WAL
+tail — so a snapshot taken mid-ingest carries the acknowledged-but-
+unflushed writes too (restoring and opening an IngestWriter replays
+them past the manifest watermark, exactly like crash recovery; the
+backup is literally a portable crash image of the writer).
+
+Files are HARDLINKED when the destination shares a filesystem (segments
+are immutable once committed, so a link is as safe as a copy and costs
+no bytes) and copied when the link crosses devices. Older generations,
+unreferenced segments, gc debris, and the LEASE file are all excluded —
+a restore never inherits another machine's writer lease.
+
+Restore verifies: `restore_live` runs the full `verify_live` gauntlet
+(per-segment structural + integrity checks, tombstone validity, WAL
+scan) before reporting success, so a restored dir is proven servable,
+not assumed.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+
+from .segments import (CURRENT, GENERATIONS_DIR, LIVE_CONFIG,
+                       SEGMENTS_DIR, LiveIndex, _manifest_name, is_live)
+from .wal import WAL_DIR, list_segments as wal_segments
+
+
+def _link_or_copy(src: str, dst: str) -> int:
+    """Hardlink `src` to `dst`, falling back to a byte copy across
+    devices; returns the file's size."""
+    try:
+        os.link(src, dst)
+    except OSError:
+        shutil.copy2(src, dst)
+    return os.path.getsize(dst)
+
+
+def _snap_tree(src_dir: str, dst_dir: str) -> tuple[int, int]:
+    """(files, bytes) linked/copied for one flat-or-nested dir."""
+    files = size = 0
+    for root, _dirs, names in os.walk(src_dir):
+        rel = os.path.relpath(root, src_dir)
+        out = os.path.join(dst_dir, rel) if rel != "." else dst_dir
+        os.makedirs(out, exist_ok=True)
+        for name in names:
+            size += _link_or_copy(os.path.join(root, name),
+                                  os.path.join(out, name))
+            files += 1
+    return files, size
+
+
+def backup_live(live_dir: str, dest: str) -> dict:
+    """Snapshot `live_dir`'s current generation into `dest` (which must
+    not already exist or must be empty). Returns a summary dict."""
+    live = LiveIndex.open(live_dir)
+    if os.path.exists(dest) and os.listdir(dest):
+        raise ValueError(f"backup destination {dest} exists and is not "
+                         "empty")
+    gen = live.current_gen()
+    manifest = live.manifest(gen)
+    os.makedirs(os.path.join(dest, GENERATIONS_DIR), exist_ok=True)
+    os.makedirs(os.path.join(dest, SEGMENTS_DIR), exist_ok=True)
+    files = size = 0
+    size += _link_or_copy(os.path.join(live_dir, LIVE_CONFIG),
+                          os.path.join(dest, LIVE_CONFIG))
+    size += _link_or_copy(
+        os.path.join(live_dir, GENERATIONS_DIR, _manifest_name(gen)),
+        os.path.join(dest, GENERATIONS_DIR, _manifest_name(gen)))
+    files += 2
+    # CURRENT is WRITTEN, not linked: the source writer will keep
+    # flipping its copy, and a hardlinked pointer would follow it
+    with open(os.path.join(dest, CURRENT + ".tmp"), "w") as f:
+        f.write(str(gen))
+    os.replace(os.path.join(dest, CURRENT + ".tmp"),
+               os.path.join(dest, CURRENT))
+    files += 1
+    for name in manifest["segments"]:
+        n, b = _snap_tree(live.segment_path(name),
+                          os.path.join(dest, SEGMENTS_DIR, name))
+        files += n
+        size += b
+    wal_files = 0
+    for _start, path in wal_segments(live_dir):
+        os.makedirs(os.path.join(dest, WAL_DIR), exist_ok=True)
+        size += _link_or_copy(path, os.path.join(
+            dest, WAL_DIR, os.path.basename(path)))
+        files += 1
+        wal_files += 1
+    return {"generation": gen, "segments": list(manifest["segments"]),
+            "wal_segments": wal_files, "files": files, "bytes": size,
+            "dest": os.path.abspath(dest)}
+
+
+def restore_live(backup_dir: str, dest: str) -> dict:
+    """Materialize a backup into `dest` (link/copy again — the backup
+    stays intact) and prove it: the full verify_live gauntlet runs
+    before this returns. Returns {**verify report, "restored": dest}."""
+    from .verify import verify_live
+
+    if not is_live(backup_dir):
+        raise ValueError(f"{backup_dir} is not a backup of a live dir "
+                         "(missing live.json/generations)")
+    if os.path.exists(dest) and os.listdir(dest):
+        raise ValueError(f"restore destination {dest} exists and is not "
+                         "empty")
+    files, size = _snap_tree(backup_dir, dest)
+    report = verify_live(dest)
+    with open(os.path.join(dest, CURRENT)) as f:
+        gen = int(f.read().strip())
+    manifest_path = os.path.join(dest, GENERATIONS_DIR,
+                                 _manifest_name(gen))
+    with open(manifest_path, encoding="utf-8") as f:
+        json.load(f)   # a malformed manifest fails restore, not serving
+    return {**report, "restored": os.path.abspath(dest),
+            "files": files, "bytes": size}

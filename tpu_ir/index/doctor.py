@@ -25,6 +25,7 @@ costs roughly one pass over the shard headers + df columns.
 from __future__ import annotations
 
 import os
+import time
 
 import numpy as np
 
@@ -210,6 +211,34 @@ def live_doctor_report(live_dir: str) -> dict:
     base_bytes = base["bytes"] if base else 0
     debt = seg.merge_debt(manifest)
     counts = live.doc_counts(gen)
+    # segment dirs NO on-disk manifest references (ISSUE 17 satellite):
+    # crashed half-builds or pre-gc leftovers — dead bytes either way
+    referenced: set = set()
+    for g in live.generations():
+        referenced.update(live.manifest(g).get("segments", []))
+    unreferenced = []
+    now = time.time()
+    seg_root = os.path.join(live.live_dir, seg.SEGMENTS_DIR)
+    for name in sorted(os.listdir(seg_root)):
+        if name.startswith(".") or name in referenced:
+            continue
+        try:
+            age_s = now - os.path.getmtime(os.path.join(seg_root, name))
+        except OSError:
+            continue
+        unreferenced.append({"segment": name, "age_s": round(age_s, 1),
+                             "bytes": _dir_bytes(
+                                 os.path.join(seg_root, name))})
+    # durable-ingest status (ISSUE 17): the replay backlog a writer
+    # open would re-apply, tail health, and who (if anyone) holds the
+    # writer lease right now
+    from .wal import lease_holder, verify_wal
+
+    try:
+        wal_info = verify_wal(
+            live_dir, watermark=manifest.get("wal", {}).get("seq", 0))
+    except AssertionError as e:   # IntegrityError: report, don't die —
+        wal_info = {"error": str(e)}  # the doctor diagnoses, verify raises
     report = {
         "live_dir": os.path.abspath(live_dir),
         "live": True,
@@ -223,8 +252,29 @@ def live_doctor_report(live_dir: str) -> dict:
         "base_bytes": base_bytes,
         "delta_bytes": sum(s["bytes"] for s in segments) - base_bytes,
         "merge_debt": debt,
+        "unreferenced_segments": unreferenced,
+        "wal": wal_info,
+        "lease": lease_holder(live_dir),
     }
     warnings = []
+    if unreferenced:
+        oldest = max(u["age_s"] for u in unreferenced)
+        warnings.append(
+            f"{len(unreferenced)} unreferenced segment dir(s) "
+            f"(oldest {oldest:.0f}s, "
+            f"{sum(u['bytes'] for u in unreferenced)} bytes): crashed "
+            "half-builds or pre-gc leftovers — the next IngestWriter "
+            "open (or `tpu-ir ingest --gc`) reclaims them")
+    if wal_info.get("torn_tail"):
+        warnings.append(
+            "the WAL tail is torn (a writer died mid-append): the next "
+            "writer open truncates it loudly — only unacknowledged "
+            "bytes are lost")
+    if wal_info.get("error"):
+        warnings.append(
+            f"WAL integrity: {wal_info['error']} — acknowledged history "
+            "is damaged; restore the live dir from a `tpu-ir backup` "
+            "snapshot")
     missing_bounds = [s["segment"] for s in segments
                       if not s["block_bounds"]]
     if missing_bounds:

@@ -15,7 +15,20 @@ renames, and readers only ever see committed generations. "Background"
 merges are background with respect to SERVING, not to the writer:
 serving processes keep answering from their mmap'd generation while a
 merge builds the next one; nothing on the query path ever waits on a
-merge.
+merge. Across PROCESSES the contract is enforced, not documented: open
+acquires the WAL writer lease (index/wal.py) — a live second writer
+gets WriterLeaseHeld, a stale/dead holder is taken over.
+
+Durability (ISSUE 17): every acknowledged mutation is framed into the
+write-ahead log BEFORE it touches the buffer, and every flush records
+the WAL high-water mark it folded in on the committed manifest. Open
+therefore recovers a crashed writer exactly-once: gc() the crash
+debris, then replay precisely the WAL suffix past the current
+manifest's watermark into the buffer (memory-only until the next flush
+commits — which is what makes replay idempotent under re-crash). The
+subprocess SIGKILL matrix in tests/test_wal.py pins recovered state
+bit-identical to a never-crashed control at every declared ingest
+fault site.
 """
 
 from __future__ import annotations
@@ -23,6 +36,7 @@ from __future__ import annotations
 import os
 import time
 
+from .. import faults
 from ..obs import get_registry
 from . import format as fmt
 from .segments import LiveIndex, compact, plan_merges
@@ -66,7 +80,8 @@ class IngestWriter:
     single-writer discipline)."""
 
     def __init__(self, live_dir: str, *, buffer_docs: int | None = None,
-                 auto_merge: bool | None = None):
+                 auto_merge: bool | None = None,
+                 wal: bool | None = None):
         from ..utils import envvars
 
         self.live = LiveIndex.open(live_dir)
@@ -84,6 +99,31 @@ class IngestWriter:
         self._buf: dict[str, str] = {}   # docid -> text, arrival order
         self._tombs: dict[str, set] = {}  # segment -> dead docids
         self._doc_seg: dict[str, str] | None = None  # lazy live view
+        self._wal_enabled = (wal if wal is not None
+                             else envvars.get_bool("TPU_IR_WAL"))
+        self.wal = None
+        self._lease = None
+        self._wal_seq = 0   # last sequence number appended OR replayed
+        self.replayed = 0   # records recovered by THIS open
+        if not self._wal_enabled:
+            self.live.gc()
+            return
+        from .wal import WriteAheadLog, WriterLease
+
+        self._lease = WriterLease(live_dir)
+        self.lease_info = self._lease.acquire()
+        try:
+            # crash hygiene before replay: a death mid-segment-build
+            # strands a half-built dir nothing references, and a death
+            # between manifest write and the CURRENT flip strands an
+            # orphan manifest the next commit overwrites — gc() clears
+            # what it can, replay re-derives the rest from the log
+            self.live.gc()
+            self._replay()
+            self.wal = WriteAheadLog(live_dir, start_seq=self._wal_seq + 1)
+        except BaseException:
+            self._lease.release()
+            raise
 
     # -- the live-document view -------------------------------------------
 
@@ -104,42 +144,114 @@ class IngestWriter:
         return sum(len(t) for t in self._tombs.values())
 
     # -- mutations ---------------------------------------------------------
+    #
+    # Every public mutation is: validate -> WAL append (the durability
+    # acknowledgment) -> the same in-memory application replay uses ->
+    # counter -> flush check. The _apply_* bodies carry NO validation
+    # and NO logging — they are exactly what `_replay` re-runs, so a
+    # recovered writer's memory is what the crashed writer's was.
 
-    def add(self, docid: str, text: str) -> None:
-        _check_doc(docid, text)
-        if docid in self._buf or docid in self._docs():
-            raise ValueError(f"docid {docid!r} already exists — use "
-                             "update() to replace it")
+    def _wal_append(self, record: dict, *, key: str) -> None:
+        if self.wal is not None:
+            self._wal_seq = self.wal.append(record, key=key)
+
+    def _apply_add(self, docid: str, text: str) -> None:
         self._buf[docid] = text
-        get_registry().incr("ingest.docs_added")
-        self._maybe_flush()
 
-    def update(self, docid: str, text: str) -> None:
-        _check_doc(docid, text)
+    def _apply_update(self, docid: str, text: str) -> None:
         seg = self._docs().get(docid)
         if seg is not None:
             self._tombs.setdefault(seg, set()).add(docid)
             del self._doc_seg[docid]
         self._buf[docid] = text
-        get_registry().incr("ingest.docs_updated")
-        self._maybe_flush()
 
-    def delete(self, docid: str) -> bool:
+    def _apply_delete(self, docid: str) -> bool:
         if docid in self._buf:
             del self._buf[docid]
-            get_registry().incr("ingest.docs_deleted")
             return True
         seg = self._docs().get(docid)
         if seg is None:
             return False
         self._tombs.setdefault(seg, set()).add(docid)
         del self._doc_seg[docid]
+        return True
+
+    def add(self, docid: str, text: str) -> None:
+        _check_doc(docid, text)
+        if docid in self._buf or docid in self._docs():
+            raise ValueError(f"docid {docid!r} already exists — use "
+                             "update() to replace it")
+        self._wal_append({"op": "add", "docid": docid, "text": text},
+                         key=docid)
+        self._apply_add(docid, text)
+        get_registry().incr("ingest.docs_added")
+        self._maybe_flush()
+
+    def update(self, docid: str, text: str) -> None:
+        _check_doc(docid, text)
+        self._wal_append({"op": "update", "docid": docid, "text": text},
+                         key=docid)
+        self._apply_update(docid, text)
+        get_registry().incr("ingest.docs_updated")
+        self._maybe_flush()
+
+    def delete(self, docid: str) -> bool:
+        if docid not in self._buf and self._docs().get(docid) is None:
+            # unknown docid: nothing changes, so nothing is logged —
+            # an idempotent no-op must not grow the WAL
+            return False
+        self._wal_append({"op": "delete", "docid": docid}, key=docid)
+        self._apply_delete(docid)
         get_registry().incr("ingest.docs_deleted")
+        self._maybe_flush()
         return True
 
     def _maybe_flush(self) -> None:
-        if len(self._buf) >= max(self.buffer_docs, 1):
+        # pending tombstones count toward the threshold: a delete-heavy
+        # feed must auto-flush too, or tombstones (and pre-WAL, the
+        # writes they acknowledge) accumulate without bound
+        if (len(self._buf) + self.pending_tombstones()
+                >= max(self.buffer_docs, 1)):
             self.flush()
+
+    # -- recovery ----------------------------------------------------------
+
+    def _replay(self) -> None:
+        """Re-apply the WAL suffix past the current manifest's
+        watermark — the acknowledged mutations a crashed writer never
+        flushed. Memory-only until a flush commits (so a re-crash
+        mid-replay changes nothing and the next open replays the same
+        suffix), EXCEPT that crossing the buffer threshold flushes
+        mid-replay exactly like it did on the original timeline — which
+        is what makes the recovered commit history converge on the
+        never-crashed writer's."""
+        from .wal import read_records
+
+        watermark = int(self.live.manifest().get("wal", {}).get("seq", 0))
+        self._wal_seq = watermark
+        t0 = time.perf_counter()
+        records, _info = read_records(self.live.live_dir,
+                                      after_seq=watermark,
+                                      truncate_torn=True)
+        for seq, rec in records:
+            self._wal_seq = seq
+            op = rec.get("op")
+            if op == "add":
+                self._apply_add(rec["docid"], rec["text"])
+            elif op == "update":
+                self._apply_update(rec["docid"], rec["text"])
+            elif op == "delete":
+                self._apply_delete(rec["docid"])
+            else:
+                raise fmt.faults.IntegrityError(
+                    self.live.live_dir,
+                    f"WAL record seq {seq} has unknown op {op!r}")
+            self._maybe_flush()
+        self.replayed = len(records)
+        if records:
+            reg = get_registry()
+            reg.incr("ingest.replayed", len(records))
+            reg.observe("ingest.replay", time.perf_counter() - t0)
 
     # -- flush / merge -----------------------------------------------------
 
@@ -154,6 +266,10 @@ class IngestWriter:
         if not self._buf and not self._tombs:
             return None
         t0 = time.perf_counter()
+        if self.wal is not None:
+            # the WAL must be at least as durable as the artifacts about
+            # to be derived from it — force the batched fsync now
+            self.wal.sync()
         manifest = self.live.manifest()
         reg = get_registry()
         segments = list(manifest["segments"])
@@ -169,6 +285,7 @@ class IngestWriter:
                 for docid, text in self._buf.items():
                     f.write(f"<DOC>\n<DOCNO> {docid} </DOCNO>\n<TEXT>\n"
                             f"{text}\n</TEXT>\n</DOC>\n")
+            faults.maybe_crash("ingest.flush_build", new_name)
             try:
                 meta = build_index(
                     [corpus], seg_dir, k=int(cfg["k"]),
@@ -185,7 +302,9 @@ class IngestWriter:
                      manifest.get("tombstones", {}).items()},
                   **{s: set(manifest.get("tombstones", {}).get(s, []))
                      | dead for s, dead in self._tombs.items()}}.items()}
-        m = self.live.commit(segments, tombs, docs, note=note)
+        m = self.live.commit(
+            segments, tombs, docs, note=note,
+            wal_seq=self._wal_seq if self._wal_enabled else None)
         # the just-flushed docs join the live view in place (no rescan)
         if self._doc_seg is not None and new_name is not None:
             for d in self._buf:
@@ -194,6 +313,9 @@ class IngestWriter:
         self._tombs.clear()
         reg.incr("ingest.flushes")
         reg.observe("ingest.flush", time.perf_counter() - t0)
+        if self.wal is not None:
+            # the watermark is durable: retire what it covers
+            self.wal.commit(self._wal_seq)
         if self.auto_merge:
             self.maybe_merge()
         return m
@@ -239,7 +361,34 @@ class IngestWriter:
     # -- lifecycle ---------------------------------------------------------
 
     def close(self) -> dict | None:
-        return self.flush(note="close")
+        """Flush, then release the WAL handle and the writer lease. The
+        writer is done after this — mutations would re-buffer without a
+        log or a lease behind them."""
+        try:
+            return self.flush(note="close")
+        finally:
+            self._shutdown()
+
+    def _shutdown(self) -> None:
+        if self.wal is not None:
+            self.wal.close()
+            self.wal = None
+        if self._lease is not None:
+            self._lease.release()
+            self._lease = None
+
+    def abandon(self) -> None:
+        """Crash simulation (tests + the soak's kill choreography):
+        drop the writer WITHOUT flushing or releasing anything, the way
+        a SIGKILL would — buffered state survives only in the WAL, and
+        the lease file is left behind for the next open to take over."""
+        if self.wal is not None:
+            self.wal._f.close()
+            self.wal = None
+        if self._lease is not None:
+            # stop only the heartbeat thread; the file stays, stale
+            self._lease._stop.set()
+            self._lease = None
 
     def __enter__(self) -> "IngestWriter":
         return self
@@ -247,6 +396,12 @@ class IngestWriter:
     def __exit__(self, exc_type, *exc) -> None:
         if exc_type is None:
             self.close()
+        else:
+            # an erroring writer still owns the lease/handles: release
+            # them WITHOUT committing the possibly-inconsistent buffer
+            # (the WAL has every acknowledged mutation; the next open
+            # replays it)
+            self._shutdown()
 
 
 import re as _re

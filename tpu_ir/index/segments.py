@@ -111,7 +111,7 @@ class LiveIndex:
                                   _manifest_name(0)),
                      {"gen": 0, "parent": None, "segments": [],
                       "tombstones": {}, "docs": {}, "note": "init",
-                      "created": time.time()})
+                      "wal": {"seq": 0}, "created": time.time()})
         _atomic_json(os.path.join(live_dir, LIVE_CONFIG),
                      {"k": int(k), "num_shards": int(num_shards),
                       "chargram_ks": [int(c) for c in chargram_ks],
@@ -183,23 +183,36 @@ class LiveIndex:
         return f"seg-{top + 1:06d}"
 
     def commit(self, segments: list[str], tombstones: dict,
-               docs: dict, *, note: str = "") -> dict:
+               docs: dict, *, note: str = "",
+               wal_seq: int | None = None) -> dict:
         """Write the next generation manifest, then flip CURRENT —
         manifest first, pointer last, each an atomic rename, so a crash
         anywhere leaves the previous generation fully intact and
         current. Tombstones are {segment_name: sorted [docid, ...]} —
         PER SEGMENT, because an updated document legitimately exists in
-        two segments at once (dead in the old one, live in the new)."""
+        two segments at once (dead in the old one, live in the new).
+
+        `wal_seq` is the WAL high-water mark this generation reflects
+        (index/wal.py): the IngestWriter passes the last sequence number
+        folded into the flush; commits that add no new mutations
+        (merges, compaction) pass None and inherit the parent's — the
+        watermark is a fact about ingested history, not about which
+        segment holds it."""
         parent = self.current_gen()
         gen = parent + 1
+        if wal_seq is None:
+            wal_seq = int(self.manifest(parent).get(
+                "wal", {}).get("seq", 0))
         tombstones = {s: sorted(set(t)) for s, t in tombstones.items()
                       if t and s in segments}
         m = {"gen": gen, "parent": parent, "segments": list(segments),
              "tombstones": tombstones,
              "docs": {s: int(docs[s]) for s in segments},
-             "note": note, "created": time.time()}
+             "note": note, "wal": {"seq": int(wal_seq)},
+             "created": time.time()}
         _atomic_json(os.path.join(self.live_dir, GENERATIONS_DIR,
                                   _manifest_name(gen)), m)
+        fmt.faults.maybe_crash("ingest.commit_between", str(gen))
         with open(os.path.join(self.live_dir, CURRENT + ".tmp"), "w") as f:
             f.write(str(gen))
         os.replace(os.path.join(self.live_dir, CURRENT + ".tmp"),
@@ -563,6 +576,7 @@ def compact(live: LiveIndex, segment_names: list[str] | None = None,
                 # manifest as-is (compacting one clean segment is a no-op)
                 return manifest
             else:
+                fmt.faults.maybe_crash("ingest.merge", new_name)
                 meta = merge_indexes(
                     inputs, out_dir, num_shards=int(cfg["num_shards"]),
                     compute_chargrams=bool(cfg["chargram_ks"]))

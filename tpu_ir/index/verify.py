@@ -230,6 +230,15 @@ def verify_live(live_dir: str) -> dict:
             "tombstones": len(tombs), "ok": True}
         total_docs += r["num_docs"]
     counts = live.doc_counts(gen)
+    # read-only WAL health (ISSUE 17): every record past the manifest
+    # watermark must parse (mid-file bit-rot raises IntegrityError like
+    # any verifier); a torn TAIL is reported, not raised — the next
+    # writer open truncates it loudly and loses only unacknowledged
+    # bytes, so it is a scar, not a corruption
+    from .wal import verify_wal
+
+    wal = verify_wal(live_dir,
+                     watermark=manifest.get("wal", {}).get("seq", 0))
     return {
         "ok": True,
         "live": True,
@@ -239,4 +248,5 @@ def verify_live(live_dir: str) -> dict:
         "docs_indexed": total_docs,
         "tombstoned": counts["tombstoned"],
         "segments": segments_out,
+        "wal": wal,
     }

@@ -148,6 +148,17 @@ METRICS: dict[str, tuple[str, float]] = {
     # generation's load+warm, so the floor is generous — the metric
     # guards against an order-of-magnitude staleness regression, not ms
     "swap_staleness_ms": ("lower", 2000.0),
+    # durable ingest (ISSUE 17 ingest_soak rows): sustained acked
+    # docs/s through the WAL'd writer, mid-soak SIGKILL+recovery
+    # included — the WAL's append/fsync cost and the replay wall both
+    # live inside this number, so a durability regression shows here
+    "ingest_docs_per_s": ("higher", 0.0),
+    # median flush-commit -> first-query-served-from-that-data: the
+    # freshness number ROADMAP item 2 asks for. Dominated by
+    # compaction + generation reload on these corpora, so the floor is
+    # generous like swap_staleness_ms — the sentry guards against an
+    # order-of-magnitude staleness regression, not ms-level weather
+    "freshness_lag_ms": ("lower", 2000.0),
 }
 
 

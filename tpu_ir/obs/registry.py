@@ -48,6 +48,16 @@ FAULT_SITES = (
     "score.hang",          # search/scorer.py: hung device dispatch
     "score.device_loss",   # search/scorer.py: device lost mid-dispatch
     "tokenize.pool",       # analysis/pool.py: tokenizer pool chunk failure
+    # Durable ingest (ISSUE 17) — every declared death point on the
+    # write path; the SIGKILL crash-fuzz matrix in tests/test_wal.py
+    # kills a child at each one and proves bit-identical recovery.
+    "ingest.wal_append",   # index/wal.py: death before a record is framed
+    "ingest.wal_torn",     # index/wal.py: death mid-append (torn tail)
+    "ingest.wal_retire",   # index/wal.py: death mid WAL-segment retirement
+    "ingest.flush_build",  # index/ingest.py: death mid delta-segment build
+    "ingest.commit_between",  # index/segments.py: between manifest write
+    #                           and the CURRENT pointer rename
+    "ingest.merge",        # index/segments.py: death mid-merge, pre-commit
 )
 
 # Serving-stage span names (the per-request span tree) — each gets a
@@ -155,6 +165,22 @@ INGEST_COUNTER_NAMES = (
     "ingest.flushes", "ingest.segments_built",
     "merge.runs", "merge.segments_merged", "merge.docs_dropped",
     "generation.commits",
+    # Durable ingest (ISSUE 17, index/wal.py): wal_appends one per
+    # acknowledged mutation framed into the log, wal_fsyncs the batched
+    # durability barriers actually paid (appends/fsyncs is the batching
+    # ratio), wal_torn_tail_truncated the mid-append death scars
+    # truncated loudly on reopen, wal_segments_retired log segments
+    # deleted once a committed watermark fully covered them;
+    # replayed the records re-applied past the manifest watermark on
+    # writer open (nonzero == a crash was recovered); lease_acquired /
+    # lease_takeovers / lease_conflicts the single-writer lease verdicts
+    # (takeover = stale-or-dead holder displaced, conflict = a live
+    # second writer refused with WriterLeaseHeld).
+    "ingest.wal_appends", "ingest.wal_fsyncs",
+    "ingest.wal_torn_tail_truncated", "ingest.wal_segments_retired",
+    "ingest.replayed",
+    "ingest.lease_acquired", "ingest.lease_takeovers",
+    "ingest.lease_conflicts",
 )
 
 # Radix-partitioned streaming build (ISSUE 11): pass-1 bucketed pair
@@ -253,6 +279,13 @@ DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     "ingest.flush",
     "merge.run",
     "generation.swap",
+    # durable ingest (ISSUE 17): wall seconds one WAL replay took on
+    # writer open (records past the watermark re-applied to the buffer),
+    # and the ingest+serve soak's freshness lag — flush commit to the
+    # FIRST query answered from a servable generation containing it
+    # (seconds on the wire, reported in ms like every histogram)
+    "ingest.replay",
+    "ingest.freshness",
     # result-cache tier (ISSUE 15): one cache lookup (key build + LRU
     # probe) — the cost a hit pays INSTEAD of the fan-out/dispatch, so
     # p50 here vs router.request/request.full is the cache's win

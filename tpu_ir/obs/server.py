@@ -238,6 +238,28 @@ def health_snapshot() -> dict:
         out["slow_queries_last_60s"] = slow_last_60s()
     except Exception:  # noqa: BLE001 — health must not 500
         out["slow_queries_last_60s"] = None
+    try:
+        # durable-ingest counters (ISSUE 17): this process's write-path
+        # health — WAL append/fsync volume (their ratio is the batching
+        # dial's readout), torn-tail scars, records replayed by crash
+        # recovery, and the single-writer lease verdicts. A nonzero
+        # `replayed` means a writer in this process recovered a crash;
+        # a climbing `lease_conflicts` means something keeps trying to
+        # double-write a live dir.
+        reg = get_registry()
+        out["ingest"] = {
+            "wal_appends": reg.get("ingest.wal_appends"),
+            "wal_fsyncs": reg.get("ingest.wal_fsyncs"),
+            "wal_torn_tail_truncated": reg.get(
+                "ingest.wal_torn_tail_truncated"),
+            "wal_segments_retired": reg.get("ingest.wal_segments_retired"),
+            "replayed": reg.get("ingest.replayed"),
+            "lease_takeovers": reg.get("ingest.lease_takeovers"),
+            "lease_conflicts": reg.get("ingest.lease_conflicts"),
+            "flushes": reg.get("ingest.flushes"),
+        }
+    except Exception:  # noqa: BLE001 — health must not 500
+        out["ingest"] = None
     for fe in fes:
         try:
             st = fe.stats()

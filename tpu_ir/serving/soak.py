@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import logging
 import math
+import os
 import random
 import threading
 import time
@@ -1138,3 +1139,424 @@ def run_concurrency_sweep(scorer, *, levels=(1, 4, 16),
         "seed": seed,
         "levels": out_levels,
     }
+
+
+# ---------------------------------------------------------------------------
+# the durable ingest + serve soak (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+# deterministic feed vocabulary — overlaps nothing magic; the probe
+# queries draw from the same words so every search has matches
+_FEED_WORDS = ("harbor lantern orchid tundra velvet quartz meadow "
+               "cinder falcon ripple anchor summit juniper marble "
+               "ember willow".split())
+
+
+def _feed_doc(i: int) -> tuple[str, str]:
+    """Deterministic document i of the ingest feed — child processes
+    and the recovering parent MUST generate identical text for the
+    bit-identity check to mean anything."""
+    text = " ".join(_FEED_WORDS[(i * 7 + j) % len(_FEED_WORDS)]
+                    for j in range(5 + i % 7))
+    return f"FEED-{i:06d}", text
+
+
+def ingest_feed_main(argv=None) -> int:
+    """Subprocess entry for the ingest child (soak + the SIGKILL crash
+    matrix): open an IngestWriter on `--live-dir`, upsert `_feed_doc(i)`
+    for i in [--start, --end), append each docid to `--ack` AFTER the
+    writer acknowledged it, flush+compact every `--compact-every` docs.
+
+    Crash realism: an InjectedCrash from the TPU_IR_FAULTS plan is
+    converted to a raw SIGKILL of this process — no atexit, no context
+    manager unwind, no lease release; exactly what the kernel OOM
+    killer leaves behind. Invoked as
+    `python -c "from tpu_ir.serving.soak import ingest_feed_main; ingest_feed_main()" ...`.
+    """
+    import argparse
+    import json as _json
+    import signal
+    import sys
+
+    from ..index.ingest import IngestWriter
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--live-dir", required=True)
+    p.add_argument("--ack", required=True)
+    p.add_argument("--start", type=int, required=True)
+    p.add_argument("--end", type=int, required=True)
+    p.add_argument("--buffer-docs", type=int, default=8)
+    p.add_argument("--compact-every", type=int, default=0)
+    p.add_argument("--pause-s", type=float, default=0.0)
+    a = p.parse_args(argv if argv is not None else sys.argv[1:])
+
+    ack = open(a.ack, "a", buffering=1)
+    try:
+        w = IngestWriter(a.live_dir, buffer_docs=a.buffer_docs,
+                         auto_merge=False)
+        for i in range(a.start, a.end):
+            docid, text = _feed_doc(i)
+            w.update(docid, text)
+            # acknowledge AFTER the writer returned: everything in this
+            # file must survive any crash (the WAL holds it)
+            ack.write(docid + "\n")
+            if a.compact_every and (i + 1 - a.start) % a.compact_every == 0:
+                w.flush()
+                w.compact_all()
+            if a.pause_s:
+                time.sleep(a.pause_s)
+        w.flush()
+        w.compact_all()
+        summary = {"acked": a.end - a.start, "replayed": w.replayed,
+                   "lease": getattr(w, "lease_info", None),
+                   "generation": w.live.current_gen()}
+        w.close()
+        print(_json.dumps(summary))
+        return 0
+    except faults.InjectedCrash:
+        os.kill(os.getpid(), signal.SIGKILL)
+        return 1   # unreachable
+    finally:
+        ack.close()
+
+
+def _spawn_feeder(live_dir: str, ack_path: str, start: int, end: int, *,
+                  buffer_docs: int = 8, compact_every: int = 0,
+                  pause_s: float = 0.0, fault_plan: str | None = None):
+    """Popen an ingest_feed_main child (the soak's crashable writer)."""
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    if fault_plan is not None:
+        env["TPU_IR_FAULTS"] = fault_plan
+    else:
+        env.pop("TPU_IR_FAULTS", None)
+    cmd = [sys.executable, "-c",
+           "from tpu_ir.serving.soak import ingest_feed_main; "
+           "raise SystemExit(ingest_feed_main())",
+           "--live-dir", live_dir, "--ack", ack_path,
+           "--start", str(start), "--end", str(end),
+           "--buffer-docs", str(buffer_docs),
+           "--compact-every", str(compact_every),
+           "--pause-s", str(pause_s)]
+    # child output goes to FILES, not pipes: the parent polls instead of
+    # reading, and a filled pipe would wedge the child mid-feed
+    out_path = ack_path + f".{start}.out"
+    err_path = ack_path + f".{start}.err"
+    proc = subprocess.Popen(
+        cmd, env=env,
+        stdout=open(out_path, "w"), stderr=open(err_path, "w"))
+    return proc, out_path, err_path
+
+
+def _flush_ancestor(live, gen: int) -> dict | None:
+    """The nearest ancestor manifest (self included) whose commit
+    actually carried mutations (note flush/close) — its `created` stamp
+    is when the docs a compacted generation serves became durable, i.e.
+    the freshness clock's start."""
+    g = gen
+    while g is not None:
+        try:
+            m = live.manifest(g)
+        except (OSError, ValueError):
+            return None
+        if m.get("note") in ("flush", "close"):
+            return m
+        g = m.get("parent")
+    return None
+
+
+def run_ingest_soak(live_dir: str, *, docs: int = 48, base_docs: int = 12,
+                    buffer_docs: int = 6, compact_every: int = 12,
+                    kill_fraction: float = 0.5, num_shards: int = 2,
+                    probe_threads: int = 2,
+                    timeout_s: float = 300.0, seed: int = 0) -> dict:
+    """Sustained concurrent ingest + serve, with a mid-soak SIGKILL of
+    the ingest process and exactly-once recovery — ROADMAP item 2's
+    "make ingest a measured regime", measured under the crash it must
+    survive.
+
+    Choreography: the parent seeds `base_docs` and compacts so serving
+    can start, then serves the live dir through a ServingFrontend
+    (probe threads issuing real queries, reloading onto every new
+    servable generation as ingest children land them) while a CHILD
+    process feeds `docs` documents through an IngestWriter, flushing +
+    compacting every `compact_every`, appending each docid to an ack
+    file AFTER the writer acknowledged it. At ~`kill_fraction` of the
+    feed the parent SIGKILLs the child mid-stream, then spawns a
+    successor that takes over the stale lease, REPLAYS the WAL suffix,
+    and resumes from the last acked document (update() upserts make the
+    overlap idempotent).
+
+    Asserted invariants (raises AssertionError on breach, with a flight
+    record):
+    - zero acknowledged-write loss: every acked docid is live in the
+      final generation;
+    - serving conservation throughout: shed + served + errors ==
+      submitted, errors == 0;
+    - zero stale responses: no response tagged with a generation older
+      than the one adopted before the request started;
+    - the successor child actually REPLAYED (the kill landed mid-work).
+
+    Reported: `ingest_docs_per_s` (acked docs over the feeding wall,
+    recovery included) and `freshness_lag_ms` (median flush-commit ->
+    first-query-served-from-a-generation-containing-it, the
+    flush-to-first-servable-query number ROADMAP names) — the two
+    bench-check-gated metrics `tpu-ir ingest --soak-bench` records.
+    """
+    import json as _json
+    import signal
+
+    from ..index.ingest import IngestWriter
+    from ..index.segments import LiveIndex, is_live, latest_servable
+    from ..search.scorer import Scorer
+    from .frontend import ServingConfig, ServingFrontend
+
+    job = obs.start_job(
+        "ingest-soak", f"ingest-soak-{docs}d",
+        phases=("seed", "feed", "recover", "verify"),
+        config={"docs": docs, "base_docs": base_docs,
+                "compact_every": compact_every,
+                "kill_fraction": kill_fraction, "seed": seed})
+    reg = obs.get_registry()
+    t_start = time.time()
+    try:
+        obs.report_progress("seed", total=base_docs)
+        if not is_live(live_dir):
+            # chargram_ks=(): the soak measures durability + freshness,
+            # not chargram recall, and word-only builds keep each child
+            # compaction cheap enough that the kill lands mid-feed
+            LiveIndex.create(live_dir, num_shards=num_shards,
+                             chargram_ks=())
+        with IngestWriter(live_dir, auto_merge=False) as w:
+            existing = w._docs()
+            for i in range(base_docs):
+                docid, text = _feed_doc(i)
+                if docid not in existing:
+                    w.update(docid, text)
+            w.compact_all(note="ingest-soak base")
+        live = LiveIndex.open(live_dir)
+
+        scorer = Scorer.load_generation(live_dir, layout="sparse")
+        frontend = ServingFrontend(scorer, ServingConfig(
+            max_concurrency=4, max_queue=16))
+        served_gen = scorer.generation
+
+        texts = [" ".join(_FEED_WORDS[j % len(_FEED_WORDS)]
+                          for j in range(q, q + 2)) for q in range(6)]
+        for t in texts:   # warm the probe shapes before the clock runs
+            frontend.search(t, k=5, scoring="bm25")
+
+        stop = threading.Event()
+        lock = threading.Lock()
+        counts = {"submitted": 0, "served": 0, "shed": 0, "errors": 0,
+                  "stale": 0}
+        gen_seen: set = {served_gen}
+        adoptions: list[dict] = []   # {gen, flush_created, first_query}
+        adopted = {"gen": served_gen}
+        error_samples: list[str] = []
+
+        def probe(tid: int) -> None:
+            i = tid
+            while not stop.is_set():
+                with lock:
+                    counts["submitted"] += 1
+                    gen_before = adopted["gen"]
+                try:
+                    res = frontend.search(texts[i % len(texts)], k=5,
+                                          scoring="bm25")
+                    now = time.time()
+                    with lock:
+                        counts["served"] += 1
+                        gen_seen.add(res.generation)
+                        if res.generation < gen_before:
+                            # older than the generation published
+                            # BEFORE this request started: stale
+                            counts["stale"] += 1
+                        for a in adoptions:
+                            if (a["first_query"] is None
+                                    and res.generation >= a["gen"]):
+                                a["first_query"] = now
+                except Overloaded:
+                    with lock:
+                        counts["shed"] += 1
+                except Exception as e:  # noqa: BLE001 — accounted
+                    with lock:
+                        counts["errors"] += 1
+                        if len(error_samples) < 5:
+                            error_samples.append(repr(e))
+                i += probe_threads
+
+        def adopt_new_generations() -> None:
+            try:
+                _path, g = latest_servable(live_dir)
+            except (ValueError, OSError):
+                return
+            if g <= adopted["gen"]:
+                return
+            flush_m = _flush_ancestor(live, g)
+            with lock:
+                adoptions.append({
+                    "gen": g,
+                    "flush_created": (flush_m or {}).get("created"),
+                    "first_query": None})
+            frontend.reload_generation(generation=g)
+            with lock:
+                adopted["gen"] = g
+
+        ack_path = os.path.join(live_dir, "ingest-soak.ack")
+        open(ack_path, "w").close()
+
+        def acked_now() -> list:
+            with open(ack_path, encoding="utf-8") as f:
+                return [ln.strip() for ln in f if ln.strip()]
+
+        probes = [threading.Thread(target=probe, args=(t,),
+                                   name=f"tpu-ir-ingest-soak-probe-{t}",
+                                   daemon=True)
+                  for t in range(probe_threads)]
+        kills = 0
+        child_summary = None
+        feed_deadline = time.time() + timeout_s
+        t_feed0 = time.time()
+        try:
+            for th in probes:
+                th.start()
+            obs.report_progress("feed", total=docs)
+            kill_off = max(1, int(docs * kill_fraction))
+            # land the kill MID-BUFFER: at an exact flush boundary the
+            # WAL suffix is empty and recovery degenerates to a no-op,
+            # which is not the regime this soak exists to measure
+            if buffer_docs > 1 and kill_off % buffer_docs == 0:
+                kill_off += 1
+            child, _out1, err1 = _spawn_feeder(
+                live_dir, ack_path, base_docs, base_docs + docs,
+                buffer_docs=buffer_docs, compact_every=compact_every,
+                pause_s=0.02)
+            while child.poll() is None:
+                # poll much faster than the child feeds (pause_s) so the
+                # kill overshoots by at most ~1 doc past kill_off
+                if len(acked_now()) >= kill_off and kills == 0:
+                    os.kill(child.pid, signal.SIGKILL)
+                    child.wait(timeout=30.0)
+                    kills += 1
+                    break
+                if time.time() > feed_deadline:
+                    child.kill()
+                    raise AssertionError("ingest soak: feeder child "
+                                         "exceeded the soak timeout")
+                adopt_new_generations()
+                time.sleep(0.005)
+
+            obs.report_progress("recover")
+            acked_mid = acked_now()
+            if kills:
+                # resume from the last ACKED doc; the overlap with any
+                # in-flight WAL'd doc is idempotent (update upserts)
+                resume_from = base_docs + len(acked_mid)
+                child2, out2, err2 = _spawn_feeder(
+                    live_dir, ack_path, resume_from, base_docs + docs,
+                    buffer_docs=buffer_docs,
+                    compact_every=compact_every)
+                while child2.poll() is None:
+                    if time.time() > feed_deadline:
+                        child2.kill()
+                        raise AssertionError(
+                            "ingest soak: recovery child exceeded the "
+                            "soak timeout")
+                    adopt_new_generations()
+                    time.sleep(0.02)
+                child2.wait()
+                with open(err2, encoding="utf-8") as f:
+                    err_text = f.read()
+                assert child2.returncode == 0, (
+                    f"recovery child failed rc={child2.returncode}: "
+                    f"{err_text[-2000:]}")
+                with open(out2, encoding="utf-8") as f:
+                    child_summary = _json.loads(
+                        f.read().strip().splitlines()[-1])
+            t_feed1 = time.time()
+            # let the probes observe the final generation
+            for _ in range(100):
+                adopt_new_generations()
+                with lock:
+                    last = adoptions[-1] if adoptions else None
+                if last is None or last["first_query"] is not None:
+                    break
+                time.sleep(0.02)
+        finally:
+            stop.set()
+            for th in probes:
+                th.join(timeout=30.0)
+
+        obs.report_progress("verify")
+        acked = acked_now()
+        recovered = set(LiveIndex.open(live_dir).live_doc_map())
+        lost = [d for d in acked if d not in recovered]
+        expected = {_feed_doc(i)[0] for i in range(base_docs + docs)}
+        unexpected = sorted(recovered - expected)
+        with lock:
+            snap = dict(counts)
+            adopts = [dict(a) for a in adoptions]
+            gens = sorted(gen_seen)
+
+        lags = [(a["first_query"] - a["flush_created"]) * 1e3
+                for a in adopts
+                if a["first_query"] is not None
+                and a["flush_created"] is not None]
+        for lag in lags:
+            reg.observe("ingest.freshness", lag / 1e3)
+        lags.sort()
+        freshness_ms = lags[len(lags) // 2] if lags else -1.0
+        feed_wall = max(t_feed1 - t_feed0, 1e-9)
+
+        report = {
+            "docs": docs,
+            "base_docs": base_docs,
+            "acked": len(acked),
+            "recovered_docs": len(recovered),
+            "lost_acked": len(lost),
+            "unexpected_docs": unexpected[:5],
+            "kills": kills,
+            "child_replayed": (child_summary or {}).get("replayed"),
+            "lease_takeover": bool(((child_summary or {}).get("lease")
+                                    or {}).get("taken_over")),
+            "feed_wall_s": round(feed_wall, 3),
+            "ingest_docs_per_s": round(len(acked) / feed_wall, 2),
+            "freshness_lag_ms": round(freshness_ms, 3),
+            "freshness_samples": len(lags),
+            "swaps": len(adopts),
+            "generations_seen": gens,
+            **snap,
+            "error_samples": error_samples,
+            "wall_s": round(time.time() - t_start, 3),
+        }
+        conserved = (snap["served"] + snap["shed"] + snap["errors"]
+                     == snap["submitted"])
+        breach = (lost or unexpected or not conserved
+                  or snap["errors"] or snap["stale"]
+                  or (kills and not (child_summary or {}).get("replayed")))
+        if breach:
+            report["flight_record"] = obs.flight_dump(
+                "ingest_soak_breach",
+                extra={k: report[k] for k in
+                       ("acked", "lost_acked", "unexpected_docs", "kills",
+                        "submitted", "served", "shed", "errors", "stale",
+                        "child_replayed", "error_samples")},
+                force=True)
+            job.finish(error=f"ingest soak breach: lost={len(lost)} "
+                             f"stale={snap['stale']} "
+                             f"errors={snap['errors']}")
+            raise AssertionError(
+                f"ingest soak invariant breach: lost_acked={len(lost)} "
+                f"unexpected={unexpected[:5]} conserved={conserved} "
+                f"errors={snap['errors']} stale={snap['stale']} "
+                f"replayed={(child_summary or {}).get('replayed')} "
+                f"(flight record: {report['flight_record']})")
+        job.finish()
+        return report
+    except BaseException as e:
+        job.finish(error=repr(e))
+        raise

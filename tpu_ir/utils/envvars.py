@@ -288,6 +288,26 @@ _declare("TPU_IR_SCALE_COOLDOWN_S", "float", 5.0,
          "flap damper — a diurnal wave shorter than twice this value "
          "cannot make the fleet oscillate (suppressed decisions count "
          "as scale.cooldown_skipped)", "§22", minimum=0.0)
+_declare("TPU_IR_WAL", "bool", True,
+         "0 disables the ingest write-ahead log AND the writer lease "
+         "(durability off: a crash loses every buffered write, and "
+         "nothing enforces single-writer) — a rollback pin, not a "
+         "tuning knob", "§23")
+_declare("TPU_IR_WAL_FSYNC_DOCS", "int", 32,
+         "appended WAL records between fsyncs (the Lucene-translog "
+         "durability/throughput dial: 1 fsyncs every acknowledged "
+         "mutation; a HOST power loss can lose at most one batch — a "
+         "process crash loses nothing either way)", "§23", minimum=1)
+_declare("TPU_IR_WAL_FSYNC_MS", "float", 50.0,
+         "max milliseconds an appended WAL record waits for its batched "
+         "fsync (bounds the host-power-loss window in time the way "
+         "_FSYNC_DOCS bounds it in records)", "§23", minimum=0.0)
+_declare("TPU_IR_WAL_LEASE_TTL_S", "float", 10.0,
+         "writer-lease heartbeat TTL: a lease whose heartbeat is older "
+         "than this (or whose holder pid is dead) is stale and taken "
+         "over on the next writer open; a fresh lease from a live pid "
+         "refuses the second writer with WriterLeaseHeld", "§23",
+         minimum=0.5)
 
 
 def _raw(name: str) -> str | None:
