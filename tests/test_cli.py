@@ -446,6 +446,7 @@ def _smoke_matrix(index_dir: str, corpus: str, tmp) -> dict:
         "profile": (["profile"], {"functions", "dispatch", "gauges"}),
         "querylog": (["querylog"],
                      {"ring", "entries", "slow_entries", "recorded"}),
+        "trace": (["trace"], {"traces"}),
         "doctor": (["doctor", index_dir],
                    {"metadata", "df", "shards", "tiers", "warnings"}),
         "bench-check": (["bench-check", "--self-test"], {"status"}),
@@ -482,7 +483,7 @@ _SMOKE_NAMES = sorted(
      "merge", "stats", "metrics", "trace-dump", "profile", "querylog",
      "doctor", "bench-check", "serve-bench", "eval", "pack", "count",
      "docno", "expand", "lint", "ingest", "generations", "cache",
-     "compact", "serve-worker", "scale", "backup"])
+     "compact", "serve-worker", "scale", "backup", "trace"])
 
 
 def test_cli_smoke_matrix_is_complete(setup):

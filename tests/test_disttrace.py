@@ -1,0 +1,640 @@
+"""Distributed request tracing + SLO burn-rate acceptance suite
+(ISSUE 18).
+
+The contract, unit-level (the routed chaos soak in test_router.py pins
+the end-to-end version):
+
+- **context**: traceparent mint/parse/adopt roundtrip, malformed
+  headers degrade to untraced (never to a failed request), `use` is
+  thread-local and restores, `child` parents under the exact attempt;
+- **assembly**: a finished local span tree flattens under the
+  installed context (root takes the context's span id), adopted roots
+  link their remote parent and ALWAYS export; `stitch` merges the live
+  store with the span spool deduped by span_id — the post-mortem path
+  works after `drop()` wiped the live side;
+- **tail sampling**: slow / partial / degraded / hedged / shed /
+  errored roots are force-kept no matter the dice; boring minted roots
+  fall to 1-in-N; TPU_IR_TRACE_TAIL=0 removes the force-keep;
+- **joins**: querylog entries and flight-recorder headers carry the
+  OPEN request's trace id (from the live context, not the ring), the
+  coalescer's shared dispatch span appears once per member trace under
+  the SAME span id;
+- **SLO**: good iff full-quality within TPU_IR_SLO_P99_MS; the breach
+  fires once per NOT-breached -> breached transition (multi-window
+  rule); the fast burn arms the Autoscaler's scale-up.
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from tpu_ir import obs
+from tpu_ir.index import build_index
+from tpu_ir.obs import disttrace, querylog
+from tpu_ir.obs.aggregate import read_span_spool
+from tpu_ir.obs.recorder import artifact_lines
+from tpu_ir.obs.registry import get_registry
+from tpu_ir.obs.server import MetricsServer
+from tpu_ir.search import Scorer
+from tpu_ir.serving import ServingConfig, ServingFrontend
+from tpu_ir.serving.autoscale import Autoscaler, AutoscaleConfig
+from tpu_ir.serving.shardset import rpc_post
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+
+@pytest.fixture(scope="module")
+def index_dir(tmp_path_factory):
+    tmp = tmp_path_factory.mktemp("disttrace")
+    body = []
+    for i in range(80):
+        text = "common " + " ".join(WORDS[(i + j) % len(WORDS)]
+                                    for j in range(3 + i % 5))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    corpus = tmp / "corpus.trec"
+    corpus.write_text("".join(body))
+    out = str(tmp / "idx")
+    build_index([str(corpus)], out, num_shards=1,
+                compute_chargrams=False)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the context
+# ---------------------------------------------------------------------------
+
+
+def test_traceparent_mint_header_adopt_roundtrip():
+    ctx = disttrace.mint()
+    assert ctx is not None and not ctx.adopted
+    assert len(ctx.trace_id) == 32 and len(ctx.span_id) == 16
+    header = ctx.to_header()
+    assert header == f"00-{ctx.trace_id}-{ctx.span_id}-01"
+    tid, sid, flags = disttrace.parse_traceparent(header)
+    assert (tid, sid, flags) == (ctx.trace_id, ctx.span_id, 1)
+    worker = disttrace.adopt(header)
+    assert worker.adopted
+    assert worker.trace_id == ctx.trace_id
+    assert worker.parent_id == ctx.span_id       # root links the caller
+    assert worker.span_id != ctx.span_id         # but is its own span
+
+
+@pytest.mark.parametrize("bad", [
+    None, "", "garbage", "01-" + "a" * 32 + "-" + "b" * 16 + "-01",
+    "00-short-" + "b" * 16 + "-01",              # trace_id wrong length
+    "00-" + "a" * 32 + "-short-01",              # span_id wrong length
+    "00-" + "z" * 32 + "-" + "b" * 16 + "-01",   # non-hex
+    "00-" + "0" * 32 + "-" + "b" * 16 + "-01",   # all-zero trace id
+    "00-" + "a" * 32 + "-" + "0" * 16 + "-01",   # all-zero span id
+    "00-" + "a" * 32 + "-" + "b" * 16 + "-01-x",
+])
+def test_malformed_traceparent_degrades_to_untraced(bad):
+    assert disttrace.parse_traceparent(bad) is None
+    assert disttrace.adopt(bad) is None
+
+
+def test_use_is_thread_local_and_restores():
+    ctx = disttrace.mint()
+    assert disttrace.current() is None
+    with disttrace.use(ctx):
+        assert disttrace.current() is ctx
+        assert disttrace.current_trace_id() == ctx.trace_id
+        inner = disttrace.mint()
+        with disttrace.use(inner):
+            assert disttrace.current() is inner
+        assert disttrace.current() is ctx       # nested restore
+        seen = []
+        t = threading.Thread(
+            target=lambda: seen.append(disttrace.current()))
+        t.start()
+        t.join(5)
+        assert seen == [None]                   # other threads blind
+    assert disttrace.current() is None
+    with disttrace.use(None):                   # None is a free no-op
+        assert disttrace.current() is None
+
+
+def test_child_parents_under_the_attempt():
+    ctx = disttrace.mint()
+    att = disttrace.child(ctx)
+    assert att.trace_id == ctx.trace_id
+    assert att.parent_id == ctx.span_id
+    assert att.span_id != ctx.span_id
+    assert disttrace.child(None) is None
+
+
+def test_disabled_mode_is_flag_tests_all_the_way_down():
+    disttrace.configure(enabled=False)
+    assert disttrace.mint() is None
+    assert disttrace.adopt("00-" + "a" * 32 + "-" + "b" * 16 + "-01") \
+        is None
+    assert disttrace.add_span("a" * 32, "x") is None
+    assert disttrace.piggyback("a" * 32) is None
+    with disttrace.use(None):
+        assert disttrace.current_trace_id() is None
+    with obs.trace("request"):
+        pass                                    # hook must not record
+    assert disttrace.trace_ids() == []
+
+
+# ---------------------------------------------------------------------------
+# root-close flattening + tail sampling
+# ---------------------------------------------------------------------------
+
+
+def test_root_close_flattens_local_tree_under_context():
+    disttrace.configure(sample=1)
+    disttrace.set_service("unit")
+    ctx = disttrace.mint()
+    with disttrace.use(ctx), obs.trace("request", scoring="bm25") as r:
+        r.set("level", "full")
+        with obs.trace("ladder"):
+            pass
+        with obs.trace("dispatch"):
+            pass
+    spans = disttrace.spans_for(ctx.trace_id)
+    by_name = {s["name"]: s for s in spans}
+    root = by_name["request"]
+    assert root["span_id"] == ctx.span_id       # the context IS the root
+    assert root["parent_id"] is None            # minted: no remote parent
+    assert root["attrs"]["level"] == "full"
+    assert root["service"] == "unit"
+    for name in ("ladder", "dispatch"):
+        child = by_name[name]
+        assert child["parent_id"] == ctx.span_id
+        assert len(child["span_id"]) == 16
+    assert len({s["span_id"] for s in spans}) == len(spans)
+
+
+def test_standalone_roots_without_context_are_not_recorded():
+    disttrace.configure(sample=1)
+    before = set(disttrace.trace_ids())
+    with obs.trace("ingest.wal_fsync"):         # no installed context
+        pass
+    assert set(disttrace.trace_ids()) == before
+
+
+def test_sampling_drops_boring_and_keeps_nth():
+    disttrace.configure(sample=1000)
+    reg = get_registry()
+    ctx = disttrace.mint()
+    with disttrace.use(ctx), obs.trace("request"):
+        pass
+    assert ctx.trace_id not in disttrace.trace_ids()
+    assert reg.get("disttrace.dropped_sampled") == 1
+    disttrace.configure(sample=1)
+    ctx2 = disttrace.mint()
+    with disttrace.use(ctx2), obs.trace("request"):
+        pass
+    assert ctx2.trace_id in disttrace.trace_ids()
+    assert reg.get("disttrace.kept_sampled") == 1
+
+
+@pytest.mark.parametrize("anomaly", ["slow", "error", "partial",
+                                     "degraded", "hedges", "shed"])
+def test_tail_rule_force_keeps_every_anomaly(anomaly):
+    # the dice alone would drop EVERY trace at this rate — anything
+    # kept below was kept by the tail rule
+    disttrace.configure(sample=10_000, slo_ms=1.0)
+    ctx = disttrace.mint()
+    try:
+        with disttrace.use(ctx), obs.trace("request") as r:
+            if anomaly == "slow":
+                time.sleep(0.003)
+            elif anomaly == "error":
+                raise RuntimeError("boom")
+            elif anomaly == "hedges":
+                r.set("hedges", 2)
+            else:
+                r.set(anomaly, True)
+    except RuntimeError:
+        pass
+    assert ctx.trace_id in disttrace.trace_ids(), anomaly
+    assert get_registry().get("disttrace.kept_tail") == 1
+
+
+def test_trace_tail_zero_drops_anomalies_to_the_dice():
+    disttrace.configure(sample=10_000, slo_ms=1.0, tail=False)
+    ctx = disttrace.mint()
+    with disttrace.use(ctx), obs.trace("request") as r:
+        r.set("partial", True)
+        time.sleep(0.003)
+    assert ctx.trace_id not in disttrace.trace_ids()
+
+
+def test_adopted_roots_always_keep_and_export(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_IR_TELEMETRY_DIR", str(tmp_path))
+    disttrace.configure(sample=10_000)          # dice says drop
+    minter = disttrace.mint()
+    ctx = disttrace.adopt(minter.to_header())
+    with disttrace.use(ctx), obs.trace("request"):
+        pass
+    spans = disttrace.spans_for(ctx.trace_id)
+    assert spans, "adopted root was dropped by the minter's dice"
+    root = spans[0]
+    assert root["parent_id"] == minter.span_id  # links the remote parent
+    spooled = read_span_spool(trace_id=ctx.trace_id)
+    assert {s["span_id"] for s in spooled} == \
+        {s["span_id"] for s in spans}
+
+
+# ---------------------------------------------------------------------------
+# add_span / annotate / store bounds
+# ---------------------------------------------------------------------------
+
+
+def test_annotate_late_binds_verdict_and_duration():
+    ctx = disttrace.mint()
+    sid = disttrace.add_span(ctx.trace_id, "rpc.search",
+                             parent_id=ctx.span_id, dur_ms=0.0,
+                             attrs={"shard": 0, "hedge": True})
+    disttrace.annotate(ctx.trace_id, sid, dur_ms=12.5, outcome="won")
+    (rec,) = disttrace.spans_for(ctx.trace_id)
+    assert rec["dur_ms"] == 12.5
+    assert rec["attrs"]["outcome"] == "won"
+    assert rec["attrs"]["hedge"] is True
+    # unknown ids are a silent no-op (harvest can outlive eviction)
+    disttrace.annotate(ctx.trace_id, "feedfeedfeedfeed", outcome="lost")
+    disttrace.annotate("f" * 32, sid, outcome="lost")
+
+
+def test_store_evicts_oldest_trace_whole():
+    disttrace.configure(max_traces=2)
+    tids = ["%032x" % i for i in (1, 2, 3)]
+    for t in tids:
+        disttrace.add_span(t, "x")
+    assert disttrace.trace_ids() == tids[1:]
+    assert disttrace.spans_for(tids[0]) == []
+
+
+def test_piggyback_ingest_remote_roundtrip_and_no_reexport():
+    disttrace.set_service("worker-s0r0")
+    minter = disttrace.mint()
+    ctx = disttrace.adopt(minter.to_header())
+    with disttrace.use(ctx), obs.trace("request") as r:
+        r.set("k", 10)
+    batch = disttrace.piggyback(ctx.trace_id)
+    assert batch and all(r["trace_id"] == ctx.trace_id for r in batch)
+    # the router's side: fold the batch in, stitch live
+    disttrace.drop(ctx.trace_id)
+    disttrace.ingest_remote(batch)
+    got = disttrace.spans_for(ctx.trace_id)
+    assert {r["span_id"] for r in got} == {r["span_id"] for r in batch}
+    # remote-ingested records are NOT re-piggybacked — they already
+    # live where they were born (double export = double-counted spans)
+    assert disttrace.piggyback(ctx.trace_id) is None
+
+
+# ---------------------------------------------------------------------------
+# stitching: live + post-mortem
+# ---------------------------------------------------------------------------
+
+
+def test_stitch_merges_store_and_spool_deduped(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_IR_TELEMETRY_DIR", str(tmp_path))
+    disttrace.configure(sample=1)
+    disttrace.set_service("router")
+    ctx = disttrace.mint()
+    with disttrace.use(ctx), obs.trace("request") as r:
+        r.set("level", "full")
+        with obs.trace("dispatch"):
+            pass
+    live = disttrace.stitch(ctx.trace_id)
+    assert live["span_count"] == 2
+    assert len(live["roots"]) == 1
+    root = live["roots"][0]
+    assert root["name"] == "request"
+    assert [c["name"] for c in root["children"]] == ["dispatch"]
+    assert live["services"] == ["router"]
+    assert live["dur_ms"] >= 0.0
+    # post-mortem: the live store is gone, the spool alone suffices
+    disttrace.drop(ctx.trace_id)
+    dead = disttrace.stitch(ctx.trace_id)
+    assert dead["span_count"] == 2
+    assert {s["span_id"] for s in _flat(dead)} == \
+        {s["span_id"] for s in _flat(live)}
+    assert disttrace.stitch("f" * 32) is None   # unknown trace
+
+
+def _flat(st):
+    out, stack = [], list(st["roots"])
+    while stack:
+        n = stack.pop()
+        out.append(n)
+        stack.extend(n.get("children", ()))
+    return out
+
+
+def test_stitch_orphan_spans_surface_as_roots():
+    tid = "a" * 32
+    disttrace.add_span(tid, "rpc.search", parent_id="b" * 16)
+    st = disttrace.stitch(tid, include_spool=False)
+    assert st["span_count"] == 1
+    assert st["roots"][0]["name"] == "rpc.search"   # orphan, not lost
+
+
+# ---------------------------------------------------------------------------
+# the joins: querylog, flight-recorder header, coalescer re-parent
+# ---------------------------------------------------------------------------
+
+
+def test_querylog_entries_carry_the_open_trace_id():
+    ctx = disttrace.mint()
+    with disttrace.use(ctx):
+        entry = querylog.record({"query_hash": "cafe0001",
+                                 "total_ms": 1.0})
+    assert entry["trace_id"] == ctx.trace_id
+    bare = querylog.record({"query_hash": "cafe0002", "total_ms": 1.0})
+    assert "trace_id" not in bare               # untraced stays clean
+    explicit = querylog.record({"query_hash": "cafe0003",
+                                "total_ms": 1.0, "trace_id": "x" * 32})
+    assert explicit["trace_id"] == "x" * 32     # a stamped id wins
+
+
+def test_querylog_cli_trace_filter(capsys):
+    from tpu_ir.cli import main
+    ctx = disttrace.mint()
+    with disttrace.use(ctx):
+        querylog.record({"query_hash": "beef0001", "total_ms": 1.0})
+    querylog.record({"query_hash": "beef0002", "total_ms": 1.0})
+    assert main(["querylog", "--trace", ctx.trace_id]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["trace_filter"] == ctx.trace_id
+    assert [e["query_hash"] for e in out["entries"]] == ["beef0001"]
+
+
+def test_flight_header_reads_trace_id_from_live_context():
+    """The ISSUE-18 bugfix pin: the header's join key comes from the
+    OPEN request's thread-local context + current_root — NOT the ring,
+    which may have evicted or sampled out the very request whose
+    failure triggered the dump."""
+    disttrace.configure(sample=10_000)          # ring would sample out
+    ctx = disttrace.mint()
+    with disttrace.use(ctx), obs.trace("request", scoring="bm25"):
+        header = json.loads(artifact_lines("unit_incident")[0])
+        assert header["trace_id"] == ctx.trace_id
+        assert header["open_root"]["name"] == "request"
+        assert header["open_root"]["attrs"]["scoring"] == "bm25"
+    bare = json.loads(artifact_lines("unit_incident")[0])
+    assert "trace_id" not in bare and "open_root" not in bare
+
+
+def test_coalesced_batch_reparents_under_every_member_trace(index_dir):
+    """The shared dispatch appears ONCE per member trace under the SAME
+    span id (the batch_id join), each with its own batch.slot child —
+    correlating two slow coalesced requests reduces to comparing one
+    span id."""
+    disttrace.configure(sample=1)
+    scorer = Scorer.load(index_dir, layout="sparse")
+    fe = ServingFrontend(scorer, ServingConfig(
+        max_concurrency=8, max_queue=32, coalesce=True,
+        batch_ladder=(1, 4, 16), batch_width=8))
+    queries = ["common salmon", "salmon fishing river", "honey bears",
+               "stock market investor"]
+    n = 8
+    barrier = threading.Barrier(n)
+    ctxs, errors = [disttrace.mint() for _ in range(n)], []
+
+    def client(ci):
+        try:
+            barrier.wait(10)
+            with disttrace.use(ctxs[ci]):
+                for i in range(6):
+                    fe.search(queries[(ci + i) % len(queries)],
+                              scoring="bm25")
+        except BaseException as e:  # noqa: BLE001
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=client, args=(i,), daemon=True)
+               for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors
+    assert fe.batcher.snapshot()["max_occupancy"] > 1
+    # group each trace's dispatch spans by span id across ALL traces
+    members = {}  # dispatch span_id -> [(trace_id, rec)]
+    slots = {}    # trace_id -> {parent dispatch ids of its slot spans}
+    for ctx in ctxs:
+        for rec in disttrace.spans_for(ctx.trace_id):
+            if rec["name"] == "batch.dispatch":
+                members.setdefault(rec["span_id"], []).append(
+                    (ctx.trace_id, rec))
+            elif rec["name"] == "batch.slot":
+                slots.setdefault(ctx.trace_id, set()).add(
+                    rec["parent_id"])
+                assert "queue_wait_ms" in rec["attrs"]
+    shared = {sid: mem for sid, mem in members.items()
+              if len({t for t, _ in mem}) > 1}
+    assert shared, "no dispatch span was shared across traces"
+    for sid, mem in members.items():
+        occ = {rec["attrs"]["occupancy"] for _, rec in mem}
+        assert len(occ) == 1                    # one batch, one story
+        # every member trace parents the shared span under ITS OWN
+        # slot context, and owns a slot child hanging off the join id
+        assert len({rec["parent_id"] for _, rec in mem}) == len(mem)
+        for tid, rec in mem:
+            assert sid in slots[tid]
+            assert rec["attrs"]["batch_id"] == sid
+
+
+# ---------------------------------------------------------------------------
+# the SLO burn-rate tracker
+# ---------------------------------------------------------------------------
+
+
+def test_slo_good_is_full_quality_within_budget():
+    disttrace.configure(slo_ms=100.0)
+    assert disttrace.slo_record("full", 5.0) is True
+    assert disttrace.slo_record("full", 500.0) is False           # slow
+    assert disttrace.slo_record("full", 5.0,
+                                classification="partial") is False
+    assert disttrace.slo_record("degraded", 5.0,
+                                classification="degraded") is False
+    assert disttrace.slo_record("shed", 1.0, ok=False,
+                                classification="shed") is False
+    snap = disttrace.slo_snapshot()
+    assert snap["windows"]["fast"]["total"] == 5
+    assert snap["windows"]["fast"]["bad"] == 4
+    assert snap["levels"]["full"] == {"good": 1, "bad": 2}
+    assert snap["levels"]["shed"] == {"good": 0, "bad": 1}
+    assert snap["good"] == 1 and snap["bad"] == 4
+
+
+def test_slo_breach_fires_once_per_transition(tmp_path, monkeypatch):
+    from tpu_ir.obs import recorder
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path / "flight"))
+    recorder.reset_rate_limit()
+    disttrace.configure(slo_ms=100.0, burn_threshold=2.0,
+                        min_samples=5, slo_target=0.9)
+    reg = get_registry()
+    for _ in range(6):
+        disttrace.slo_record("full", 500.0)     # all bad: burn = 100x
+    assert reg.get("slo.burn_breach") == 1      # fired on transition
+    assert disttrace.slo_snapshot()["breached"] is True
+    for _ in range(4):
+        disttrace.slo_record("full", 500.0)     # still breached
+    assert reg.get("slo.burn_breach") == 1      # ... not re-fired
+    from tpu_ir.obs.recorder import recent_headers
+    (hdr,) = recent_headers(str(tmp_path / "flight"))
+    assert hdr["reason"] == "slo_burn_breach"
+    assert hdr["extra"]["slo"]["breached"] is True
+    # recovery clears the latch; a NEW burn episode fires again
+    for _ in range(400):
+        disttrace.slo_record("full", 1.0)
+    assert disttrace.slo_snapshot()["breached"] is False
+    recorder.reset_rate_limit()
+    for _ in range(300):
+        disttrace.slo_record("full", 500.0)
+    assert reg.get("slo.burn_breach") == 2
+
+
+class _Fleet:
+    """The minimal lifecycle surface Autoscaler.tick reads/drives."""
+
+    def __init__(self):
+        self._replicas = 1
+
+    def active_replicas(self, shard=None):
+        return self._replicas
+
+    def grow(self):
+        self._replicas += 1
+        return [(0, self._replicas - 1)]
+
+    def retire_replica(self, shard, replica, *, drain_timeout_s=30.0):
+        self._replicas -= 1
+        return {"shard": shard, "replica": replica}
+
+
+class _Admission:
+    max_concurrency = 10
+
+    def in_flight(self):
+        return 0
+
+    def queue_depth(self):
+        return 0
+
+
+class _Router:
+    def __init__(self):
+        self.admission = _Admission()
+
+    def reset_breaker(self, shard, replica):
+        pass
+
+
+def test_slo_burn_arms_autoscaler_scale_up():
+    """Latency degradation adds a replica even when occupancy alone
+    would not: the burn signal feeds the SAME hysteresis counter."""
+    fleet, router = _Fleet(), _Router()
+    a = Autoscaler(fleet, router, AutoscaleConfig(
+        min_replicas=1, max_replicas=3, cooldown_s=0.0,
+        up_occupancy=0.8, down_occupancy=0.0, sustain_up=2,
+        sustain_down=100, slo_burn_up=2.0))
+    disttrace.configure(slo_ms=100.0)
+    for _ in range(10):
+        disttrace.slo_record("full", 500.0)     # burn = 100x
+    assert disttrace.slo_burn_signal() >= 2.0
+    d1 = a.tick(now=1.0)                        # occupancy is ~0
+    assert d1["action"] is None and d1["slo_burn"] >= 2.0
+    d2 = a.tick(now=2.0)
+    assert d2["action"] == "up"
+    assert d2["reason"] == "slo_burn"           # burn, not occupancy
+    assert fleet.active_replicas() == 2
+
+
+def test_slo_burn_signal_zero_disables_the_second_signal():
+    fleet, router = _Fleet(), _Router()
+    a = Autoscaler(fleet, router, AutoscaleConfig(
+        min_replicas=1, max_replicas=3, cooldown_s=0.0,
+        up_occupancy=0.8, down_occupancy=0.0, sustain_up=2,
+        sustain_down=100, slo_burn_up=0.0))
+    disttrace.configure(slo_ms=100.0)
+    for _ in range(10):
+        disttrace.slo_record("full", 500.0)
+    for now in (1.0, 2.0, 3.0):
+        assert a.tick(now=now)["action"] is None
+    assert fleet.active_replicas() == 1
+
+
+# ---------------------------------------------------------------------------
+# the HTTP surface: /slo, /trace, /trace/<id>, RPC adoption
+# ---------------------------------------------------------------------------
+
+
+def _get(url, timeout=10.0):
+    import urllib.error
+    import urllib.request
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def test_server_slo_and_trace_endpoints():
+    disttrace.configure(sample=1)
+    disttrace.set_service("router")
+    disttrace.slo_record("full", 1.0)
+    ctx = disttrace.mint()
+    with disttrace.use(ctx), obs.trace("request"):
+        with obs.trace("dispatch"):
+            pass
+    with MetricsServer(port=0) as srv:
+        code, body = _get(f"{srv.url}/slo")
+        assert code == 200
+        slo = json.loads(body)
+        assert {"slo_p99_ms", "target", "windows", "breached",
+                "levels"} <= set(slo)
+        code, body = _get(f"{srv.url}/trace")
+        assert code == 200
+        assert ctx.trace_id in json.loads(body)["traces"]
+        code, body = _get(f"{srv.url}/trace/{ctx.trace_id}")
+        assert code == 200
+        st = json.loads(body)
+        assert st["span_count"] == 2
+        assert st["roots"][0]["name"] == "request"
+        code, body = _get(f"{srv.url}/trace/{ctx.trace_id}?format=html")
+        assert code == 200
+        page = body.decode()
+        assert ctx.trace_id in page and "dispatch" in page
+        code, _ = _get(f"{srv.url}/trace/{'f' * 32}")
+        assert code == 404
+
+
+def test_rpc_handler_adopts_traceparent_and_piggybacks():
+    """The worker half of the wire contract: /rpc/<name> adopts the
+    caller's traceparent, the handler's spans join the caller's trace,
+    and the response carries the span batch (`_trace`) for live
+    stitching — zero extra round trips."""
+    disttrace.set_service("worker-s0r0")
+
+    def handler(payload):
+        with obs.trace("request") as r:
+            r.set("k", payload.get("k"))
+        return {"ok": True}
+
+    ctx = disttrace.mint()
+    attempt = disttrace.child(ctx)
+    with MetricsServer(port=0, rpc_handlers={"search": handler}) as srv:
+        out = rpc_post(f"{srv.host}:{srv.port}", "search", {"k": 7},
+                       timeout_s=10.0,
+                       headers={"traceparent": attempt.to_header()})
+        assert out["ok"] is True
+        batch = out["_trace"]
+        assert all(r["trace_id"] == ctx.trace_id for r in batch)
+        (root,) = [r for r in batch if r["name"] == "request"]
+        assert root["parent_id"] == attempt.span_id
+        assert root["attrs"]["k"] == 7
+        assert root["service"] == "worker-s0r0"
+        # untraced callers get a clean response — no _trace key
+        bare = rpc_post(f"{srv.host}:{srv.port}", "search", {"k": 1},
+                        timeout_s=10.0)
+        assert "_trace" not in bare

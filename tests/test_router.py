@@ -522,3 +522,19 @@ def test_distributed_chaos_soak(index_dir, tmp_path):
     assert report["recovery_full"] == report["recovery_probes"]
     # the routed latency section is present for the bench row
     assert report["latency"]["router.request"]["count"] > 0
+    # distributed tracing (ISSUE 18): every served, dispatched response
+    # joined exactly one stitched trace whose span population matches
+    # its fan-out + hedge + cross-process shape; partial / degraded /
+    # hedged traces (the tail rule's clientele) are never missing; and
+    # the bookkeeping overhead meets the acceptance bounds (<=5% of a
+    # mean request enabled, <=1% disabled)
+    dt = report["disttrace"]
+    assert dt["traced"] > 0
+    assert dt["violations"] == 0, dt["violation_samples"]
+    assert dt["tail_missing"] == 0
+    assert dt["mean_spans"] >= 3  # root + per-shard attempts at least
+    assert dt["overhead"]["enabled_overhead_fraction"] <= 0.05
+    assert dt["overhead"]["disabled_overhead_fraction"] <= 0.01
+    # the SLO tracker saw the run: every served/shed request recorded
+    slo = report["slo"]
+    assert slo["good"] + slo["bad"] >= report["served"]

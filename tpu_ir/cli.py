@@ -868,8 +868,79 @@ def cmd_querylog(args) -> int:
     if not args.slow:
         out["entries"] = querylog.recent(args.n)
     out["slow_entries"] = querylog.slow_recent(args.n)
+    if getattr(args, "trace", None):
+        # the distributed-trace join (ISSUE 18): only the entries this
+        # trace id produced — the querylog face of `tpu-ir trace <id>`
+        out["trace_filter"] = args.trace
+        for key in ("entries", "slow_entries"):
+            if key in out:
+                out[key] = [e for e in out[key]
+                            if e.get("trace_id") == args.trace]
     print(json.dumps(out, sort_keys=True, default=repr))
     return 0
+
+
+def cmd_trace(args) -> int:
+    """The distributed-trace surface (obs/disttrace.py). With no id:
+    list every trace id visible here — the in-process store plus the
+    span spool under TPU_IR_TELEMETRY_DIR (the post-mortem path: every
+    process exported its kept span batches there). With an id: stitch
+    the cross-process waterfall and render it — indented spans on a
+    shared timeline, each attempt marked with the verdict the router
+    recorded (won / lost / failed / cancelled / deadline). `--json`
+    prints the stitched structure instead."""
+    from .obs import disttrace
+    from .obs.aggregate import read_span_spool
+
+    if not args.trace_id:
+        ids = set(disttrace.trace_ids())
+        for rec in read_span_spool():
+            tid = rec.get("trace_id")
+            if tid:
+                ids.add(tid)
+        print(json.dumps({"traces": sorted(ids)}))
+        return 0
+    st = disttrace.stitch(args.trace_id)
+    if st is None:
+        print(json.dumps({"error": "unknown_trace",
+                          "trace_id": args.trace_id}))
+        return 1
+    if args.json:
+        print(json.dumps(st, sort_keys=True, default=repr))
+        return 0
+    _print_trace_waterfall(st)
+    return 0
+
+
+def _print_trace_waterfall(st: dict) -> None:
+    """ASCII waterfall of one stitched trace: the jobdetails.jsp of the
+    distributed tier, for terminals."""
+    t0 = st["start_ms"]
+    total = max(st["dur_ms"], 1e-9)
+    width = 40
+    print(f"trace {st['trace_id']}  spans={st['span_count']}  "
+          f"dur={st['dur_ms']}ms  services={','.join(st['services'])}")
+
+    def walk(node: dict, depth: int) -> None:
+        off = max(node.get("start_ms", t0) - t0, 0.0)
+        dur = node.get("dur_ms", 0.0)
+        lo = min(int(width * off / total), width - 1)
+        ln = max(1, min(int(round(width * dur / total)), width - lo))
+        bar = " " * lo + "#" * ln
+        a = node.get("attrs", {})
+        mark = ""
+        if a.get("outcome"):
+            mark = f" [{a['outcome']}{'+hedge' if a.get('hedge') else ''}]"
+        if node.get("error"):
+            mark += " [error]"
+        label = "  " * depth + node.get("name", "?")
+        print(f"{label:<34.34} |{bar:<{width}}| {off:9.2f}ms "
+              f"{dur:8.2f}ms  {node.get('service', '?')}{mark}")
+        for c in node.get("children", ()):
+            walk(c, depth + 1)
+
+    for r in st["roots"]:
+        walk(r, 0)
 
 
 def cmd_doctor(args) -> int:
@@ -1862,7 +1933,21 @@ def main(argv: list[str] | None = None) -> int:
     pql.add_argument("--slow", action="store_true",
                      help="slow-query captures only (span tree + "
                           "explain of trapped offenders)")
+    pql.add_argument("--trace", default=None, metavar="TRACE_ID",
+                     help="only entries recorded under this distributed "
+                          "trace id (the `tpu-ir trace` join key)")
     pql.set_defaults(fn=cmd_querylog)
+
+    ptr = sub.add_parser(
+        "trace", help="distributed request traces: list known trace ids "
+                      "(store + TPU_IR_TELEMETRY_DIR span spool), or "
+                      "stitch one id's cross-process waterfall")
+    ptr.add_argument("trace_id", nargs="?", default=None,
+                     help="trace id to stitch (omit to list)")
+    ptr.add_argument("--json", action="store_true",
+                     help="print the stitched span tree as JSON instead "
+                          "of the ASCII waterfall")
+    ptr.set_defaults(fn=cmd_trace)
 
     pdr = sub.add_parser(
         "doctor", help="index health report: df skew, per-shard "

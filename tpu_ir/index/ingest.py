@@ -38,6 +38,7 @@ import time
 
 from .. import faults
 from ..obs import get_registry
+from ..obs import trace as obs_trace
 from . import format as fmt
 from .segments import LiveIndex, compact, plan_merges
 
@@ -230,23 +231,26 @@ class IngestWriter:
         watermark = int(self.live.manifest().get("wal", {}).get("seq", 0))
         self._wal_seq = watermark
         t0 = time.perf_counter()
-        records, _info = read_records(self.live.live_dir,
-                                      after_seq=watermark,
-                                      truncate_torn=True)
-        for seq, rec in records:
-            self._wal_seq = seq
-            op = rec.get("op")
-            if op == "add":
-                self._apply_add(rec["docid"], rec["text"])
-            elif op == "update":
-                self._apply_update(rec["docid"], rec["text"])
-            elif op == "delete":
-                self._apply_delete(rec["docid"])
-            else:
-                raise fmt.faults.IntegrityError(
-                    self.live.live_dir,
-                    f"WAL record seq {seq} has unknown op {op!r}")
-            self._maybe_flush()
+        with obs_trace("ingest.wal_replay") as sp:
+            sp.set("watermark", watermark)
+            records, _info = read_records(self.live.live_dir,
+                                          after_seq=watermark,
+                                          truncate_torn=True)
+            for seq, rec in records:
+                self._wal_seq = seq
+                op = rec.get("op")
+                if op == "add":
+                    self._apply_add(rec["docid"], rec["text"])
+                elif op == "update":
+                    self._apply_update(rec["docid"], rec["text"])
+                elif op == "delete":
+                    self._apply_delete(rec["docid"])
+                else:
+                    raise fmt.faults.IntegrityError(
+                        self.live.live_dir,
+                        f"WAL record seq {seq} has unknown op {op!r}")
+                self._maybe_flush()
+            sp.set("replayed", len(records))
         self.replayed = len(records)
         if records:
             reg = get_registry()
@@ -287,10 +291,13 @@ class IngestWriter:
                             f"{text}\n</TEXT>\n</DOC>\n")
             faults.maybe_crash("ingest.flush_build", new_name)
             try:
-                meta = build_index(
-                    [corpus], seg_dir, k=int(cfg["k"]),
-                    chargram_ks=list(cfg["chargram_ks"]),
-                    num_shards=int(cfg["num_shards"]))
+                with obs_trace("ingest.flush_build") as sp:
+                    sp.set("segment", new_name)
+                    sp.set("docs", len(self._buf))
+                    meta = build_index(
+                        [corpus], seg_dir, k=int(cfg["k"]),
+                        chargram_ks=list(cfg["chargram_ks"]),
+                        num_shards=int(cfg["num_shards"]))
             finally:
                 if os.path.exists(corpus):
                     os.unlink(corpus)

@@ -67,6 +67,7 @@ import zlib
 
 from .. import faults
 from ..obs import get_registry
+from ..obs import trace as obs_trace
 
 WAL_DIR = "wal"
 LEASE_FILE = "LEASE"
@@ -273,40 +274,45 @@ class WriteAheadLog:
         acknowledgment to ITS caller is only as strong as this flush
         (fsync is batched; see module docstring)."""
         faults.maybe_crash("ingest.wal_append", key)
-        seq = self._next_seq
-        payload = json.dumps(record, sort_keys=True,
-                             separators=(",", ":")).encode("utf-8")
-        frame = _HEADER.pack(_crc(len(payload), seq, payload),
-                             len(payload), seq) + payload
-        if faults.should_fire("ingest.wal_torn", key) is not None:
-            # physically produce the torn tail a mid-append death leaves:
-            # half the frame reaches the OS, then the "process" dies
-            self._f.write(frame[:max(1, len(frame) // 2)])
+        with obs_trace("ingest.wal_append") as sp:
+            seq = self._next_seq
+            sp.set("seq", seq)
+            payload = json.dumps(record, sort_keys=True,
+                                 separators=(",", ":")).encode("utf-8")
+            frame = _HEADER.pack(_crc(len(payload), seq, payload),
+                                 len(payload), seq) + payload
+            if faults.should_fire("ingest.wal_torn", key) is not None:
+                # physically produce the torn tail a mid-append death
+                # leaves: half the frame reaches the OS, then the
+                # "process" dies
+                self._f.write(frame[:max(1, len(frame) // 2)])
+                self._f.flush()
+                raise faults.InjectedCrash(
+                    f"injected torn WAL record at seq {seq}")
+            self._f.write(frame)
             self._f.flush()
-            raise faults.InjectedCrash(
-                f"injected torn WAL record at seq {seq}")
-        self._f.write(frame)
-        self._f.flush()
-        self._next_seq = seq + 1
-        reg = get_registry()
-        reg.incr("ingest.wal_appends")
-        self._pending += 1
-        if (self._pending >= max(self.fsync_docs, 1)
-                or (time.monotonic() - self._last_fsync) * 1e3
-                >= self.fsync_ms):
-            self.sync()
+            self._next_seq = seq + 1
+            reg = get_registry()
+            reg.incr("ingest.wal_appends")
+            self._pending += 1
+            if (self._pending >= max(self.fsync_docs, 1)
+                    or (time.monotonic() - self._last_fsync) * 1e3
+                    >= self.fsync_ms):
+                self.sync()
         return seq
 
     def sync(self) -> None:
         """Force the batched fsync now (flush() calls this before the
         segment build: the WAL must be at least as durable as the
         artifacts about to be derived from it)."""
-        self._f.flush()
-        os.fsync(self._f.fileno())
-        if self._pending:
-            get_registry().incr("ingest.wal_fsyncs")
-        self._pending = 0
-        self._last_fsync = time.monotonic()
+        with obs_trace("ingest.wal_fsync") as sp:
+            sp.set("pending", self._pending)
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            if self._pending:
+                get_registry().incr("ingest.wal_fsyncs")
+            self._pending = 0
+            self._last_fsync = time.monotonic()
 
     def commit(self, watermark: int) -> int:
         """A generation manifest recording `watermark` just committed:

@@ -70,21 +70,24 @@ from .profiling import (
     sample_memory,
 )
 from . import querylog  # noqa: E402 — needs recorder/registry bound above
+from . import disttrace  # noqa: E402 — registers the root-close hook
 
 
 def reset_all() -> None:
     """Full telemetry reset: registry counters + histograms, the trace
-    ring, the query log, the job history, and the flight recorder's
-    rate limiter. The test-isolation hook (tests/conftest.py autouse
-    fixture) — one process-wide telemetry state must not leak between
-    tests or between runs. (The registry's seq/resets stamps stay
-    monotonic through this — that IS their contract.)"""
+    ring, the query log, the distributed-trace store + SLO windows, the
+    job history, and the flight recorder's rate limiter. The
+    test-isolation hook (tests/conftest.py autouse fixture) — one
+    process-wide telemetry state must not leak between tests or between
+    runs. (The registry's seq/resets stamps stay monotonic through this
+    — that IS their contract.)"""
     get_registry().reset()
     clear_traces()
     progress.clear_jobs()
     reset_rate_limit()
     profiling.reset_profile()
     querylog.clear()
+    disttrace.reset()
 
 
 __all__ = [
@@ -101,4 +104,5 @@ __all__ = [
     "profiling", "profiled_jit", "profile_report", "sample_memory",
     "recompiles_last_60s",
     "querylog",
+    "disttrace",
 ]

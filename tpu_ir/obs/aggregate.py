@@ -245,6 +245,81 @@ def merge_spool(out_dir: str | None = None,
     return merge_snapshots(snaps)
 
 
+# -- the span spool (distributed-trace post-mortem assembly) ---------------
+#
+# A second file family in the same TPU_IR_TELEMETRY_DIR:
+# `spans-<host>-<pid>-<seq>.json`, each an APPEND-ONLY batch of
+# completed span records {trace_id, span_id, parent_id, name, ...}
+# (obs/disttrace.py's export format). Unlike the cumulative telemetry
+# snapshots above — where only the newest file per run_id is truthful —
+# span batches are disjoint events: the reader folds EVERY file. The
+# writer bounds the family per process (oldest batches deleted past
+# _SPAN_SPOOL_KEEP), the bounded-ring discipline on disk.
+
+_SPAN_SPOOL_KEEP = 64
+_span_spool_lock = threading.Lock()
+_span_spool_seq = 0
+
+
+def span_spool_write(spans: list, out_dir: str | None = None
+                     ) -> str | None:
+    """Append one batch of completed span records to the spool (atomic
+    temp+rename per batch file). Returns the path, or None when no
+    spool dir is configured or the batch is empty. Never raises."""
+    d = out_dir or spool_dir()
+    if not d or not spans:
+        return None
+    global _span_spool_seq
+    try:
+        os.makedirs(d, exist_ok=True)
+        host = socket.gethostname()
+        pid = os.getpid()
+        with _span_spool_lock:
+            _span_spool_seq += 1
+            seq = _span_spool_seq
+        path = os.path.join(d, f"spans-{host}-{pid}-{seq:06d}.json")
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"host": host, "pid": pid, "seq": seq,
+                       "spans": spans}, f, default=repr)
+        os.replace(tmp, path)
+        # bound the family per process: keep the newest K batches
+        prefix = f"spans-{host}-{pid}-"
+        mine = sorted(n for n in os.listdir(d)
+                      if n.startswith(prefix) and n.endswith(".json"))
+        for name in mine[:-_SPAN_SPOOL_KEEP]:
+            try:
+                os.unlink(os.path.join(d, name))
+            except OSError:
+                pass
+        return path
+    except Exception:  # noqa: BLE001 — spooling must not fail serving
+        return None
+
+
+def read_span_spool(out_dir: str | None = None,
+                    trace_id: str | None = None) -> list:
+    """Every spooled span record (optionally filtered to one trace),
+    across ALL batch files of all processes — batches are disjoint
+    events, so unlike read_spool there is no newest-wins dedup."""
+    d = out_dir or spool_dir()
+    if not d or not os.path.isdir(d):
+        return []
+    out = []
+    for name in sorted(os.listdir(d)):
+        if not (name.startswith("spans-") and name.endswith(".json")):
+            continue
+        try:
+            with open(os.path.join(d, name)) as f:
+                batch = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for rec in batch.get("spans", ()):
+            if trace_id is None or rec.get("trace_id") == trace_id:
+                out.append(rec)
+    return out
+
+
 class SpoolWriter:
     """Background thread refreshing this process's spool file on an
     interval, so a crash leaves a near-final record for the post-mortem

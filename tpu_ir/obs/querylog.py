@@ -73,7 +73,11 @@ _HEADER_KEYS = ("query_hash", "level", "total_ms", "analyze_ms",
                 # slow offender waited to coalesce and how full its
                 # shared batch was — the first two questions a slow
                 # query inside a batch raises
-                "queue_wait_ms", "batch_occupancy")
+                "queue_wait_ms", "batch_occupancy",
+                # the distributed-trace join key (ISSUE 18): a slow
+                # offender's flight header points at the ONE stitched
+                # waterfall that explains it (`tpu-ir trace <id>`)
+                "trace_id")
 
 
 def configure(enabled: bool | None = None, sample: int | None = None,
@@ -169,6 +173,17 @@ def record(entry: dict, explain_fn=None) -> dict:
     entry.setdefault("time",
                      time.strftime("%Y-%m-%dT%H:%M:%S"))
     entry.update(context_fields())
+    if "trace_id" not in entry or entry["trace_id"] is None:
+        # the coalescer stamps a follower's id via slot_meta (the entry
+        # is recorded on the LEADER's thread); everyone else gets the
+        # thread-local context — None stays None (tracing off)
+        from . import disttrace
+
+        tid = disttrace.current_trace_id()
+        if tid is not None:
+            entry["trace_id"] = tid
+        else:
+            entry.pop("trace_id", None)
     slow = (_SLOW_MS > 0.0
             and float(entry.get("total_ms", 0.0)) >= _SLOW_MS)
     if slow:

@@ -107,6 +107,25 @@ def artifact_lines(reason: str, extra: dict | None = None,
         header["slow_queries"] = slow_header_entries()
     except Exception:  # noqa: BLE001 — the header must always write
         pass
+    try:
+        # the distributed-trace id of the OPEN request on this thread
+        # (ISSUE 18 bugfix): read from the live context + current_root,
+        # NOT the ring — by dump time the ring may have evicted (or
+        # sampled out) the root span of the very request whose failure
+        # triggered this dump, and the header's join key must survive
+        # that (lazy import — disttrace imports flight_dump)
+        from .disttrace import current_trace_id
+        from .trace import current_root
+
+        tid = current_trace_id()
+        if tid is not None:
+            header["trace_id"] = tid
+            root = current_root()
+            if root is not None:
+                header["open_root"] = {"name": root.name,
+                                       "attrs": dict(root.attrs)}
+    except Exception:  # noqa: BLE001 — the header must always write
+        pass
     if callable(extra):
         try:
             extra = extra()

@@ -235,13 +235,36 @@ SCALE_COUNTER_NAMES = (
     "scale.cooldown_skipped",
 )
 
+# Distributed request tracing + SLO layer (ISSUE 18, obs/disttrace.py).
+# disttrace.minted counts contexts born here (router admission or an
+# unrouted frontend search); adopted the contexts read off an incoming
+# traceparent header (worker side — an adopted trace always exports, the
+# sampling verdict belongs to the minting process); spans_exported span
+# records shipped off-process (RPC piggyback or spool); spans_dropped
+# records discarded because a store ring was full; kept_tail roots kept
+# by the tail rule (slow/partial/degraded/hedged/error), kept_sampled
+# roots kept by the 1-in-N dice, dropped_sampled roots the dice
+# discarded; stitched whole-trace assemblies served (live /trace/<id> or
+# post-mortem from spools). slo.good / slo.bad classify every finished
+# request against TPU_IR_SLO_P99_MS + the availability target;
+# slo.burn_breach counts multi-window budget-burn trips (each one also
+# flight-records).
+DISTTRACE_COUNTER_NAMES = (
+    "disttrace.minted", "disttrace.adopted",
+    "disttrace.spans_exported", "disttrace.spans_dropped",
+    "disttrace.kept_tail", "disttrace.kept_sampled",
+    "disttrace.dropped_sampled", "disttrace.stitched",
+    "slo.good", "slo.bad", "slo.burn_breach",
+)
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
     "load.h2d_bytes",
 ) + (COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
      + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES + INGEST_COUNTER_NAMES
-     + PRUNE_COUNTER_NAMES + CACHE_COUNTER_NAMES + SCALE_COUNTER_NAMES)
+     + PRUNE_COUNTER_NAMES + CACHE_COUNTER_NAMES + SCALE_COUNTER_NAMES
+     + DISTTRACE_COUNTER_NAMES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
@@ -297,6 +320,18 @@ DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     # dispatch grid — the warm-start gate's cost, paid OUTSIDE traffic
     "scale.drain_ms",
     "scale.warmup_ms",
+    # distributed tracing (ISSUE 18): wall seconds one whole-trace
+    # stitch took (live ingest_remote merge or post-mortem spool walk)
+    "disttrace.stitch",
+    # durable-ingest spans (ISSUE 18 satellite over ISSUE 17): every
+    # span name observed outside obs/ must be declared — one WAL record
+    # framed+written, one batched fsync barrier actually paid, one
+    # replay pass on writer open, and the segment-build half of a flush
+    # (ingest.flush above times the whole flush including commit)
+    "ingest.wal_append",
+    "ingest.wal_fsync",
+    "ingest.wal_replay",
+    "ingest.flush_build",
 )
 
 # Gauges: point-in-time values (memory levels, cache sizes) — unlike
@@ -317,6 +352,17 @@ GAUGE_MERGE = {
     "generation.current": "last",
     "generation.segments": "last",
     "generation.tombstones": "last",
+    # durable ingest (ISSUE 18 satellite): flush-commit -> first
+    # servable-query freshness lag, surfaced live in /healthz (the
+    # ingest.freshness histogram keeps the distribution; this gauge is
+    # the current level a scrape reads without a soak)
+    "ingest.freshness_lag_ms": "last",
+    # SLO burn-rate tracker (ISSUE 18, obs/disttrace.py): current
+    # multi-window budget-burn multiples — 1.0 burns the budget exactly
+    # at the allowed rate; the breach rule requires BOTH windows over
+    # threshold so a single spike can't page
+    "slo.burn_fast": "last",
+    "slo.burn_slow": "last",
 }
 DECLARED_GAUGES = tuple(sorted(GAUGE_MERGE))
 

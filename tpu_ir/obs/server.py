@@ -257,6 +257,11 @@ def health_snapshot() -> dict:
             "lease_takeovers": reg.get("ingest.lease_takeovers"),
             "lease_conflicts": reg.get("ingest.lease_conflicts"),
             "flushes": reg.get("ingest.flushes"),
+            # flush-commit -> first-servable-query lag (ISSUE 18
+            # satellite): the freshness number an operator previously
+            # only saw as an ingest-soak bench row, live
+            "freshness_lag_ms": reg.gauges().get(
+                "ingest.freshness_lag_ms"),
         }
     except Exception:  # noqa: BLE001 — health must not 500
         out["ingest"] = None
@@ -311,7 +316,8 @@ def health_snapshot() -> dict:
 # /jobs <-> /cluster <-> /profile <-> /querylog <-> /doctor drift fix)
 _NAV_ROUTES = ("/healthz", "/jobs?format=html", "/cluster?format=html",
                "/profile?format=html", "/querylog?format=html",
-               "/doctor?format=html", "/flight", "/metrics")
+               "/doctor?format=html", "/slo?format=html", "/flight",
+               "/metrics")
 
 
 def _nav_html() -> str:
@@ -377,6 +383,51 @@ def _jobs_html(job_dicts: list, title: str) -> str:
     return "".join(parts)
 
 
+def _trace_waterfall_html(st: dict) -> str:
+    """The /trace/<id> page: one row per span, indented by depth, with
+    an offset/width bar on the shared trace timeline — the JobTracker
+    jobdetails.jsp of the distributed tier (ISSUE 18)."""
+    total = max(float(st.get("dur_ms") or 0.0), 1e-9)
+    start0 = float(st.get("start_ms") or 0.0)
+    rows = []
+
+    def walk(node: dict, depth: int) -> None:
+        off = max(0.0, float(node.get("start_ms") or start0) - start0)
+        dur = float(node.get("dur_ms") or 0.0)
+        left = round(100.0 * off / total, 2)
+        width = max(round(100.0 * dur / total, 2), 0.3)
+        label = ("&nbsp;" * (depth * 3)) + html.escape(
+            str(node.get("name", "?")))
+        attrs = node.get("attrs") or {}
+        att = html.escape(", ".join(f"{k}={v}"
+                                    for k, v in sorted(attrs.items())))
+        err = " style='color:#b00'" if node.get("error") else ""
+        rows.append(
+            f"<tr><td{err}>{label}</td>"
+            f"<td>{html.escape(str(node.get('service', '?')))}</td>"
+            f"<td>{node.get('dur_ms', 0.0)}</td>"
+            f"<td><div style='position:relative;height:12px;"
+            f"background:#eee'><div style='position:absolute;"
+            f"left:{left}%;width:{width}%;height:12px;background:#69c'>"
+            f"</div></div></td><td>{att}</td></tr>")
+        for c in node.get("children", ()):
+            walk(c, depth + 1)
+
+    for r in st.get("roots", ()):
+        walk(r, 0)
+    tid = html.escape(str(st.get("trace_id", "?")))
+    services = html.escape(", ".join(st.get("services", ())))
+    return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
+            f"<title>trace {tid}</title>{_STYLE}</head><body>"
+            f"<h1>trace {tid}</h1>{_nav_html()}"
+            f"<p>{st.get('span_count', 0)} spans &middot; "
+            f"{st.get('dur_ms', 0.0)} ms &middot; services: {services}</p>"
+            "<table style='width:100%'><tr><th>span</th><th>service</th>"
+            "<th>ms</th><th style='width:45%'>waterfall</th>"
+            "<th>attrs</th></tr>" + "".join(rows)
+            + "</table></body></html>")
+
+
 # -- the server -------------------------------------------------------------
 
 
@@ -438,8 +489,22 @@ class _Handler(BaseHTTPRequestHandler):
             except ValueError:
                 self._json({"error": "malformed JSON body"}, code=400)
                 return
+            # distributed tracing (ISSUE 18): adopt the caller's
+            # traceparent so every span the handler's request opens
+            # joins the caller's trace, then piggyback this process's
+            # span batch on the response (`_trace`) — the router
+            # stitches live, no spool round-trip on the serving path
+            from . import disttrace
+
+            ctx = disttrace.adopt(self.headers.get("traceparent"))
             try:
-                self._json(fn(payload))
+                with disttrace.use(ctx):
+                    out = fn(payload)
+                if ctx is not None and isinstance(out, dict):
+                    batch = disttrace.piggyback(ctx.trace_id)
+                    if batch:
+                        out["_trace"] = batch
+                self._json(out)
             except Exception as e:  # noqa: BLE001 — classified below
                 # the serving Overloaded shed is structural, not a bug:
                 # 503 tells the router "retry elsewhere", 500 "replica
@@ -535,6 +600,29 @@ class _Handler(BaseHTTPRequestHandler):
             elif route == "/doctor":
                 self._json_or_html(q, "tpu-ir doctor",
                                    _doctor_payload(q))
+            elif route == "/slo":
+                from . import disttrace
+
+                self._json_or_html(q, "tpu-ir slo",
+                                   disttrace.slo_snapshot())
+            elif route == "/trace":
+                from . import disttrace
+
+                self._json({"traces": disttrace.trace_ids()})
+            elif route.startswith("/trace/"):
+                from . import disttrace
+
+                tid = route.split("/", 2)[2]
+                st = disttrace.stitch(tid)
+                if st is None:
+                    self._json({"error": f"no trace {tid!r}"}, code=404)
+                    return
+                if q.get("format", [""])[0] == "html":
+                    self._send(200,
+                               _trace_waterfall_html(st).encode("utf-8"),
+                               "text/html; charset=utf-8")
+                else:
+                    self._json(st)
             elif route == "/flight":
                 self._json({"flight_records": recent_headers()})
             elif route == "/cluster":
@@ -552,7 +640,9 @@ class _Handler(BaseHTTPRequestHandler):
                                           "/healthz", "/jobs",
                                           "/jobs/<id>", "/profile",
                                           "/querylog", "/doctor",
-                                          "/flight", "/cluster"]})
+                                          "/slo", "/trace",
+                                          "/trace/<id>", "/flight",
+                                          "/cluster"]})
             else:
                 self._json({"error": "unknown endpoint"}, code=404)
         except BrokenPipeError:

@@ -48,6 +48,13 @@ _SAMPLE_N = envvars.get_int("TPU_IR_TRACE_SAMPLE")
 _RING = collections.deque(maxlen=envvars.get_int("TPU_IR_TRACE_RING"))
 _JAX_ANNOTATE = envvars.get_bool("TPU_IR_JAX_TRACE")
 _root_seq = 0
+# Root-close hooks: callables fired with every COMPLETED root span,
+# unconditionally — BEFORE and independent of the ring's 1-in-N
+# sampling, because a subscriber (obs/disttrace.py) applies its own
+# keep/drop policy (tail-keeping must see the roots sampling would
+# discard). Hooks must never raise and must be cheap: they run inline
+# on the request thread at root close.
+_root_hooks: list = []
 
 
 def configure(enabled: bool | None = None, sample: int | None = None,
@@ -232,8 +239,26 @@ def attach(parent: Span | None):
     return _Attach(parent)
 
 
+def add_root_hook(fn) -> None:
+    """Subscribe `fn(span)` to every completed root span (idempotent:
+    re-adding the same callable is a no-op). The hook fires before ring
+    sampling — subscribers see ALL roots."""
+    if fn not in _root_hooks:
+        _root_hooks.append(fn)
+
+
+def remove_root_hook(fn) -> None:
+    if fn in _root_hooks:
+        _root_hooks.remove(fn)
+
+
 def _push_root(span: Span) -> None:
     global _root_seq
+    for hook in tuple(_root_hooks):
+        try:
+            hook(span)
+        except Exception:  # noqa: BLE001 — a hook bug must not fail the
+            pass  # request whose root just closed
     with _ring_lock:
         _root_seq += 1
         if _root_seq % _SAMPLE_N == 0:

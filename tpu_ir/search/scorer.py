@@ -136,6 +136,11 @@ class SearchResult(list):
     # served across a rolling generation swap is attributable to
     # exactly one corpus snapshot
     generation: int = 0
+    # distributed-trace id (obs/disttrace.py): stamped by whichever
+    # admission edge minted the context (router or unrouted frontend),
+    # None when tracing is disabled — the join key for
+    # `tpu-ir trace <id>` and the /trace/<id> waterfall
+    trace_id: str | None = None
 
 
 def compute_doc_norms(pair_term, pair_doc, pair_tf, df,

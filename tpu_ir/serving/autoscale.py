@@ -66,6 +66,8 @@ class AutoscaleConfig:
     sustain_up: int = 4                # consecutive ticks to scale up
     sustain_down: int = 20             # consecutive ticks to drain
     drain_timeout_s: float = 30.0      # retire's in-flight wait bound
+    slo_burn_up: float = 2.0           # fast-window SLO burn to arm
+    #                                    scale-up (0 disables the signal)
 
     def resolved(self) -> "AutoscaleConfig":
         from dataclasses import replace
@@ -139,13 +141,21 @@ class Autoscaler:
         cfg = self.config
         now = time.monotonic() if now is None else now
         occ = self.occupancy()
+        # the SECOND input signal (ISSUE 18): the SLO tracker's
+        # fast-window burn rate. Occupancy sees queue pressure; burn
+        # sees requests going bad (slow/partial/errored) even at modest
+        # occupancy — either sustained condition arms a scale-up
+        from ..obs import disttrace
+
+        burn = disttrace.slo_burn_signal()
+        burning = cfg.slo_burn_up > 0 and burn >= cfg.slo_burn_up
         active = self.shardset.active_replicas()
         with self._lock:
             self._ticks += 1
             if len(self._samples) < 200_000:
                 self._samples.append((active, self.router.admission
                                       .in_flight()))
-            if occ >= cfg.up_occupancy:
+            if occ >= cfg.up_occupancy or burning:
                 self._ticks_over += 1
                 self._ticks_under = 0
             elif occ <= cfg.down_occupancy:
@@ -162,7 +172,7 @@ class Autoscaler:
             in_cooldown = now < self._cooldown_until
         decision = {"action": None, "reason": "steady",
                     "occupancy": round(occ, 3), "active": active,
-                    "tick": self._ticks}
+                    "slo_burn": round(burn, 3), "tick": self._ticks}
         if want == "up":
             if active >= cfg.max_replicas:
                 decision["reason"] = "at_max_replicas"
@@ -171,6 +181,9 @@ class Autoscaler:
                 decision["reason"] = "cooldown"
             else:
                 decision.update(self._scale_up(now))
+                if (decision["action"] == "up" and burning
+                        and occ < cfg.up_occupancy):
+                    decision["reason"] = "slo_burn"
         elif want == "down":
             if active <= cfg.min_replicas:
                 decision["reason"] = "at_min_replicas"
@@ -318,5 +331,6 @@ class Autoscaler:
                 "down_occupancy": cfg.down_occupancy,
                 "sustain_up": cfg.sustain_up,
                 "sustain_down": cfg.sustain_down,
+                "slo_burn_up": cfg.slo_burn_up,
             },
         }

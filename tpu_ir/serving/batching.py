@@ -51,7 +51,7 @@ import time
 from typing import NamedTuple
 
 from .. import obs
-from ..obs import get_registry
+from ..obs import disttrace, get_registry
 from ..utils import envvars
 
 
@@ -77,7 +77,7 @@ class _Slot:
     event is set — the event is the publication barrier."""
 
     __slots__ = ("text", "key", "explain_k", "level", "queue_depth",
-                 "t_enqueue", "event", "state", "result", "error")
+                 "t_enqueue", "event", "state", "result", "error", "ctx")
 
     def __init__(self, text: str, key: BatchKey, explain_k: int,
                  level: str, queue_depth: int):
@@ -91,6 +91,10 @@ class _Slot:
         self.state = None
         self.result = None
         self.error = None
+        # the submitter's distributed-trace context, captured on ITS
+        # thread — the leader executes this slot on a different thread,
+        # where thread-local current() would read the leader's trace
+        self.ctx = disttrace.current()
 
 
 # rungs above this are dropped from the DEFAULT ladder on backends where
@@ -300,6 +304,12 @@ class CoalescingScheduler:
                  "queue_depth": s.queue_depth,
                  "queue_wait_ms": round((t0 - s.t_enqueue) * 1e3, 3),
                  "batch_occupancy": b} for s in slots]
+        for s, m in zip(slots, meta):
+            if s.ctx is not None:
+                # rides slot_meta into the scorer's querylog entry: the
+                # entry is recorded on the LEADER's thread, where the
+                # thread-local context is the leader's trace, not ours
+                m["trace_id"] = s.ctx.trace_id
         reg = get_registry()
         reg.incr("batch.coalesced" if b > 1 else "batch.solo_flush")
         if obs.enabled():
@@ -308,6 +318,7 @@ class CoalescingScheduler:
             reg.observe("batch.occupancy", float(b))
             for m in meta:
                 reg.observe("batch.wait", m["queue_wait_ms"] / 1e3)
+        t_dispatch = time.perf_counter()
         try:
             results = self._scorer.search_batch(
                 [s.text for s in slots], k=key.k, scoring=key.scoring,
@@ -318,11 +329,13 @@ class CoalescingScheduler:
                 rung_ladder=self._ladder,
                 donate_queries=True, slot_meta=meta)
         except BaseException as e:  # delivered, not swallowed: every
+            self._trace_batch(slots, meta, b, t_dispatch, error=repr(e))
             for s in slots:         # slot's caller re-raises it
                 s.error = e
                 s.state = "error"
                 s.event.set()
             return
+        self._trace_batch(slots, meta, b, t_dispatch)
         with self._lock:
             self._batches += 1
             if b > 1:
@@ -341,6 +354,38 @@ class CoalescingScheduler:
             s.result = res
             s.state = "done"
             s.event.set()
+
+    def _trace_batch(self, slots: list[_Slot], meta: list[dict], b: int,
+                     t_dispatch: float, error: str | None = None) -> None:
+        """Re-parent the shared dispatch across every member trace: ONE
+        `batch.dispatch` span id (the batch_id join) appears in each
+        traced slot's trace, parented under THAT slot's own context, so
+        a follower's waterfall shows the leader's kernel call it rode —
+        plus a per-slot `batch.slot` child carrying queue_wait /
+        occupancy. No-op when no member carries a context."""
+        traced = [(i, s) for i, s in enumerate(slots) if s.ctx is not None]
+        if not traced:
+            return
+        dispatch_ms = (time.perf_counter() - t_dispatch) * 1e3
+        start_ms = time.time() * 1e3 - dispatch_ms
+        batch_id = disttrace.new_span_id()
+        leader_trace = (slots[0].ctx.trace_id
+                        if slots[0].ctx is not None else None)
+        for i, s in traced:
+            disttrace.add_span(
+                s.ctx.trace_id, "batch.dispatch", span_id=batch_id,
+                parent_id=s.ctx.span_id, start_ms=start_ms,
+                dur_ms=dispatch_ms,
+                attrs={"batch_id": batch_id, "occupancy": b,
+                       "leader_trace": leader_trace,
+                       "leader": i == 0},
+                error=error)
+            disttrace.add_span(
+                s.ctx.trace_id, "batch.slot", parent_id=batch_id,
+                start_ms=start_ms, dur_ms=dispatch_ms,
+                attrs={"slot": i,
+                       "queue_wait_ms": meta[i]["queue_wait_ms"],
+                       "batch_occupancy": b})
 
     # -- warm-up + introspection -------------------------------------------
 

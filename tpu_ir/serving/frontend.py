@@ -45,6 +45,7 @@ import time
 from dataclasses import dataclass
 
 from .. import obs
+from ..obs import disttrace
 from ..obs import trace as obs_trace
 from ..search.scorer import Scorer, SearchResult
 from ..utils.report import RecoveryCounters, serving_counters
@@ -337,7 +338,15 @@ class ServingFrontend:
         # a concurrent generation swap republishes the tuple, and this
         # request must finish entirely on the pair it entered with
         scorer, batcher = self._serving
-        with obs_trace("request", scoring=scoring) as root:
+        # distributed trace context: adopt the router's (installed by the
+        # worker RPC handler) when present, mint fresh when this frontend
+        # IS the admission edge (unrouted / direct API callers)
+        ctx = disttrace.current()
+        minted = ctx is None and disttrace.enabled()
+        if minted:
+            ctx = disttrace.mint()
+        with disttrace.use(ctx if minted else None), \
+                obs_trace("request", scoring=scoring) as root:
             with obs_trace("ladder") as lsp:
                 level = self.ladder.level()
                 lsp.set("level", level)
@@ -350,6 +359,11 @@ class ServingFrontend:
                 # back up
                 self.ladder.observe(pressure=pressure, failed=False)
                 self._observe_latency("request.shed", t0)
+                root.set("shed", True)
+                if minted:
+                    disttrace.slo_record(
+                        "shed", (time.perf_counter() - t0) * 1e3,
+                        ok=False, classification="shed")
                 raise Overloaded("shed_level",
                                  queue_depth=self.admission.queue_depth(),
                                  level=level)
@@ -370,9 +384,14 @@ class ServingFrontend:
                     res = SearchResult(hit)
                     res.level = level
                     res.generation = scorer.generation
+                    res.trace_id = ctx.trace_id if ctx is not None else None
                     root.set("cached", True)
                     self._count("served_cache")
                     self._observe_latency(f"request.{level}", t0)
+                    if minted:
+                        disttrace.slo_record(
+                            level, (time.perf_counter() - t0) * 1e3,
+                            classification="full")
                     return res
             timeout = (self.config.queue_timeout_s
                        if self.config.queue_timeout_s is not None
@@ -402,7 +421,20 @@ class ServingFrontend:
                     # identically
                     self.cache.put(cache_key, tuple(res),
                                    generation=res.generation)
+                res.trace_id = ctx.trace_id if ctx is not None else None
+                # degraded/partial flags on the root make the trace
+                # tail-kept (the interesting traces survive sampling)
+                root.set("degraded", bool(res.degraded))
+                if getattr(res, "partial", False):
+                    root.set("partial", True)
                 self._observe_latency(f"request.{level}", t0)
+                if minted:
+                    cls = ("degraded" if res.degraded
+                           else "partial" if getattr(res, "partial", False)
+                           else "full")
+                    disttrace.slo_record(
+                        res.level, (time.perf_counter() - t0) * 1e3,
+                        classification=cls)
                 return res
             except Overloaded as e:
                 # only admission sheds reach here (queue_full /
@@ -411,6 +443,11 @@ class ServingFrontend:
                 # a full queue is the strongest pressure signal there is
                 self.ladder.observe(pressure=1.0, failed=False)
                 self._observe_latency("request.shed", t0)
+                root.set("shed", True)
+                if minted:
+                    disttrace.slo_record(
+                        "shed", (time.perf_counter() - t0) * 1e3,
+                        ok=False, classification="shed")
                 raise
 
     def _cache_key(self, scorer: Scorer, text: str, *, k: int,

@@ -66,6 +66,7 @@ from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor, wait
 from dataclasses import dataclass
 
 from .. import obs
+from ..obs import disttrace
 from ..obs import get_registry
 from ..obs import trace as obs_trace
 from ..utils import envvars
@@ -265,6 +266,10 @@ class Router:
         from ..obs.server import register_router
 
         register_router(self)
+        # waterfall lane label for this process's span records — best
+        # effort (an in-process worker sharing the router's process
+        # relabels; subprocess fleets, the real topology, never collide)
+        disttrace.set_service("router")
 
     # -- plumbing ----------------------------------------------------------
 
@@ -326,7 +331,7 @@ class Router:
         return info["mapping"]
 
     def _post(self, addr: str, path: str, payload: dict,
-              timeout_s: float) -> dict:
+              timeout_s: float, headers: dict | None = None) -> dict:
         """One HTTP RPC attempt via the SHARED worker-RPC client
         (shardset.rpc_post — one framing for router fan-out and
         rolling swaps); raises on any failure (the caller's breaker
@@ -335,26 +340,49 @@ class Router:
         hung one at most `timeout_s`."""
         from .shardset import rpc_post
 
-        return rpc_post(addr, path, payload, timeout_s)
+        return rpc_post(addr, path, payload, timeout_s, headers=headers)
 
     def _call_replica(self, shard: int, replica: int, addr: str,
-                      path: str, payload: dict, timeout_s: float):
+                      path: str, payload: dict, timeout_s: float,
+                      ctx=None):
         """One replica attempt with its breaker verdict + RTT sample.
-        Returns (ok, data_or_error)."""
+        Returns (ok, data_or_error). `ctx` is this attempt's derived
+        TraceContext (ISSUE 18): the worker adopts it off the
+        traceparent header, its span batch rides back on the response's
+        `_trace` key, and the attempt span (recorded at submit) gets
+        its true duration + verdict annotated here."""
         breaker = self._breaker(shard, replica)
         allowed, is_probe = breaker.allow_device()
         if not allowed:
+            if ctx is not None:
+                disttrace.annotate(ctx.trace_id, ctx.span_id,
+                                   ok=False, error="breaker_open")
             return False, "breaker_open"
+        headers = ({"traceparent": ctx.to_header()}
+                   if ctx is not None else None)
         t0 = time.perf_counter()
         try:
-            data = self._post(addr, path, payload, timeout_s)
+            data = self._post(addr, path, payload, timeout_s,
+                              headers=headers)
         except BaseException as e:  # noqa: BLE001 — every failure is a
             # replica verdict here (refused, reset, timeout, 5xx, shed)
             if breaker.record_failure(is_probe=is_probe):
                 get_registry().incr("router.breaker_opened")
             get_registry().incr("router.replica_failed")
+            if ctx is not None:
+                disttrace.annotate(
+                    ctx.trace_id, ctx.span_id,
+                    dur_ms=(time.perf_counter() - t0) * 1e3,
+                    ok=False, error=repr(e))
             return False, repr(e)
         rtt = time.perf_counter() - t0
+        if ctx is not None:
+            disttrace.annotate(ctx.trace_id, ctx.span_id,
+                               dur_ms=rtt * 1e3, ok=True)
+            if isinstance(data, dict):
+                # live stitching: fold the worker's span batch into the
+                # local store (runs on a pool thread — the store locks)
+                disttrace.ingest_remote(data.pop("_trace", None))
         breaker.record_success(is_probe=is_probe)
         # a replica that went DRAINING while this call was in flight
         # still answers (drain-not-drop), but its RTT must not feed the
@@ -428,6 +456,11 @@ class Router:
         grid = self._topology()
         deadline = time.monotonic() + self._deadline_s
         hedge_delay = {s: self._hedge_delay_s(s) for s in shards}
+        # the request's trace context, captured on THIS (caller's)
+        # thread — pool threads never see the request thread-local, so
+        # per-attempt child contexts derive from this explicit handle
+        ctx = disttrace.current()
+        tid = ctx.trace_id if ctx is not None else None
 
         class _ShardJob:
             __slots__ = ("order", "next_i", "futs", "t0", "hedged",
@@ -436,7 +469,7 @@ class Router:
             def __init__(self):
                 self.order: list = []
                 self.next_i = 0
-                self.futs: list = []       # (replica, fut, is_hedge)
+                self.futs: list = []   # (replica, fut, is_hedge, span)
                 self.t0 = time.monotonic()
                 self.hedged = False
                 self.result = None
@@ -453,7 +486,7 @@ class Router:
             job.order = self._replica_order(s, avail)
             jobs[s] = job
             self._submit_next(s, job, grid, path, payload_of(s),
-                              deadline, is_hedge=False)
+                              deadline, is_hedge=False, ctx=ctx)
 
         while True:
             now = time.monotonic()
@@ -465,22 +498,35 @@ class Router:
                 # failure immediately triggers the next replica
                 # (failover), distinct from the timed hedge below
                 still = []
-                for replica, fut, is_hedge in job.futs:
+                for replica, fut, is_hedge, sid in job.futs:
                     if not fut.done():
-                        still.append((replica, fut, is_hedge))
+                        still.append((replica, fut, is_hedge, sid))
                         continue
                     ok, data = fut.result()
                     if ok and job.result is None:
                         job.result = data
                         if is_hedge:
                             get_registry().incr("router.hedge_won")
+                        # the trace records WHICH attempt served the
+                        # response — the hedge post-mortem's first
+                        # question
+                        disttrace.annotate(tid, sid, outcome="won",
+                                           hedge=is_hedge)
+                    elif ok:
+                        # answered correctly, but another attempt had
+                        # already won this shard — the dropped loser
+                        disttrace.annotate(tid, sid, outcome="lost",
+                                           hedge=is_hedge)
+                    else:
+                        disttrace.annotate(tid, sid, outcome="failed",
+                                           hedge=is_hedge)
                 job.futs = still
                 if job.result is not None:
                     continue
                 if not job.futs and job.next_i < len(job.order):
                     # every in-flight attempt failed: fail over now
                     self._submit_next(s, job, grid, path, payload_of(s),
-                                      deadline, is_hedge=False)
+                                      deadline, is_hedge=False, ctx=ctx)
                 elif (not job.hedged and job.futs
                         and now - job.t0 >= hedge_delay[s]
                         and job.next_i < len(job.order)):
@@ -490,8 +536,8 @@ class Router:
                     job.hedges += 1
                     get_registry().incr("router.hedge_fired")
                     self._submit_next(s, job, grid, path, payload_of(s),
-                                      deadline, is_hedge=True)
-                pending.extend(f for _, f, _ in job.futs)
+                                      deadline, is_hedge=True, ctx=ctx)
+                pending.extend(f for _, f, _, _ in job.futs)
             unresolved = [s for s, j in jobs.items() if j.result is None]
             if not unresolved or now >= deadline:
                 break
@@ -512,12 +558,23 @@ class Router:
             wait(pending, timeout=max(
                 0.001, min(next_hedge, deadline) - time.monotonic()),
                 return_when=FIRST_COMPLETED)
+        if tid is not None:
+            # attempts still in flight when the fan-out returns: the
+            # winner made them moot (cancelled — the response will be
+            # silently dropped) or the deadline expired under them (the
+            # "why did this response go partial" answer)
+            for s, job in jobs.items():
+                for replica, fut, is_hedge, sid in job.futs:
+                    disttrace.annotate(
+                        tid, sid, hedge=is_hedge,
+                        outcome=("cancelled" if job.result is not None
+                                 else "deadline"))
         return {s: (j.result, j.hedges) for s, j in jobs.items()
                 if j.result is not None}
 
     def _submit_next(self, shard: int, job, grid, path: str,
                      payload: dict, deadline: float,
-                     *, is_hedge: bool) -> None:
+                     *, is_hedge: bool, ctx=None) -> None:
         if job.next_i >= len(job.order):
             return
         replica = job.order[job.next_i]
@@ -527,9 +584,21 @@ class Router:
         # connect timeout never exceeds the attempt budget, and a dead
         # host must fail fast enough to leave room for failover
         timeout_s = min(timeout_s, self._deadline_s)
+        # the attempt span records AT SUBMIT (duration + verdict
+        # annotated on completion): an attempt cancelled mid-flight
+        # must still appear in the waterfall, or the trace under-counts
+        # the fan-out it claims to explain
+        actx = disttrace.child(ctx)
+        sid = None
+        if actx is not None:
+            sid = disttrace.add_span(
+                actx.trace_id, f"rpc.{path}", span_id=actx.span_id,
+                parent_id=actx.parent_id,
+                attrs={"shard": shard, "replica": replica,
+                       "addr": addr, "hedge": is_hedge})
         fut = self._pool.submit(self._call_replica, shard, replica,
-                                addr, path, payload, timeout_s)
-        job.futs.append((replica, fut, is_hedge))
+                                addr, path, payload, timeout_s, actx)
+        job.futs.append((replica, fut, is_hedge, sid))
 
     # -- the request path --------------------------------------------------
 
@@ -560,12 +629,23 @@ class Router:
             self._observe("cache.lookup", t_lookup)
             if entry is not None:
                 res = self._from_cache(entry, return_docids=return_docids)
+                res.trace_id = None
                 self._observe("router.request", t0)
                 self._count_served(res)
+                disttrace.slo_record(
+                    res.level, (time.perf_counter() - t0) * 1e3,
+                    classification=self.classify(res))
                 self._querylog(text, res, k=k, scoring=scoring,
                                rerank=rerank, t0=t0, cached=True)
                 return res
-        with obs_trace("request", scoring=scoring, router=True) as root:
+        # distributed tracing (ISSUE 18): the trace is minted HERE, at
+        # router admission — the one process that sees the whole
+        # request — and installed thread-locally so the fan-out's
+        # per-attempt child contexts and the root-close keep/drop
+        # verdict all key off it
+        ctx = disttrace.mint()
+        with disttrace.use(ctx), \
+                obs_trace("request", scoring=scoring, router=True) as root:
             try:
                 admit = self.admission.admit(
                     queue_timeout_s=self._deadline_s)
@@ -574,6 +654,10 @@ class Router:
             except Overloaded:
                 get_registry().incr("router.shed")
                 self._observe("router.request", t0)
+                root.set("shed", True)
+                disttrace.slo_record(
+                    "shed", (time.perf_counter() - t0) * 1e3,
+                    ok=False, classification="shed")
                 raise
             try:
                 res = self._route(text, k=k, scoring=scoring,
@@ -586,11 +670,18 @@ class Router:
                 # an outage window
                 get_registry().incr("router.shed")
                 self._observe("router.request", t0)
+                root.set("shed", True)
+                disttrace.slo_record(
+                    "shed", (time.perf_counter() - t0) * 1e3,
+                    ok=False, classification="shed")
                 raise
             finally:
                 admit.__exit__(None, None, None)
             root.set("partial", res.partial)
             root.set("level", res.level)
+            root.set("degraded", bool(res.degraded))
+            root.set("hedges", int(res.hedges))
+        res.trace_id = ctx.trace_id if ctx is not None else None
         if self.cache is not None:
             # follow the fleet: the newest generation to win a merge
             # moves the cache's key space (old entries go unreachable)
@@ -613,6 +704,8 @@ class Router:
             res[:] = [(mapping.get_docid(int(d)), s) for d, s in res]
         self._observe("router.request", t0)
         self._count_served(res)
+        disttrace.slo_record(res.level, (time.perf_counter() - t0) * 1e3,
+                             classification=self.classify(res))
         self._querylog(text, res, k=k, scoring=scoring, rerank=rerank,
                        t0=t0)
         return res
@@ -820,6 +913,11 @@ class Router:
             "hedges": int(res.hedges),
             "total_ms": round((time.perf_counter() - t0) * 1e3, 3),
         }
+        # the slow-query capture's join key into its distributed
+        # waterfall (ISSUE 18): `tpu-ir querylog --trace <id>`
+        tid = getattr(res, "trace_id", None)
+        if tid:
+            entry["trace_id"] = tid
         if not querylog.redacted():
             entry["text"] = text
         querylog.record(entry)

@@ -50,23 +50,28 @@ READY_POLL_S = 0.05
 
 
 def rpc_post(addr: str, path: str, payload: dict,
-             timeout_s: float) -> dict:
+             timeout_s: float, headers: dict | None = None) -> dict:
     """One worker HTTP RPC attempt — THE client-side framing of the
     /rpc contract (router fan-out, rolling swaps, tests), defined once
     next to the server side so the two cannot drift. Raises on any
     failure (refused, reset, timeout, non-200); the caller decides
     what a failure means (breaker verdict, skip-and-respawn, ...).
-    The socket timeout bounds connect AND read."""
+    The socket timeout bounds connect AND read. `headers` merges extra
+    request headers in — the router's `traceparent` propagation
+    (ISSUE 18) rides here, invisible to the JSON payload contract."""
     import http.client
     import json as _json
 
     host, port = addr.rsplit(":", 1)
     conn = http.client.HTTPConnection(host, int(port),
                                       timeout=max(timeout_s, 1e-3))
+    hdrs = {"Content-Type": "application/json"}
+    if headers:
+        hdrs.update(headers)
     try:
         conn.request("POST", f"/rpc/{path}",
                      body=_json.dumps(payload),
-                     headers={"Content-Type": "application/json"})
+                     headers=hdrs)
         resp = conn.getresponse()
         body = resp.read()
         if resp.status != 200:
@@ -183,6 +188,10 @@ def serve_worker(index_dir: str, shard: int, num_shards: int, *,
             doc_range=rg)
 
     scorer = load_for(index_generation)
+    # distributed-trace service identity: every span this process emits
+    # is attributed to this (shard, replica) in the stitched waterfall
+    from ..obs import disttrace
+    disttrace.set_service(f"worker-s{shard}r{replica}")
     frontend = ServingFrontend(scorer, ServingConfig(
         max_concurrency=max_concurrency, max_queue=max_queue,
         deadline_s=deadline_s))

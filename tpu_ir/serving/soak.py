@@ -108,6 +108,145 @@ def _cache_delta(before: dict) -> dict:
     return out
 
 
+def _walk_spans(nodes):
+    """Depth-first over a stitched trace's span tree."""
+    for n in nodes:
+        yield n
+        yield from _walk_spans(n.get("children", ()))
+
+
+def _trace_shape_ok(st: dict, res) -> tuple[bool, str]:
+    """Does ONE stitched trace match the response it explains? The
+    checks are the causal claims the trace makes: one root (the router
+    admission span), a verdict on every RPC attempt (won / lost /
+    failed / cancelled / deadline — nothing vanishes), hedge spans
+    exactly equal to the hedges the response reports, and for every
+    shard that CONTRIBUTED a winning attempt plus that worker's own
+    request span (the cross-process join actually happened)."""
+    if len(st["roots"]) != 1:
+        return False, "multi_root"
+    spans = list(_walk_spans(st["roots"]))
+    rpc = [s for s in spans if s["name"].startswith("rpc.")]
+    if any(not s.get("attrs", {}).get("outcome") for s in rpc):
+        return False, "attempt_without_outcome"
+    hedged = sum(1 for s in rpc if s.get("attrs", {}).get("hedge"))
+    if hedged < int(res.hedges):
+        # >=, not ==: res.hedges counts only hedges whose shard ended
+        # up CONTRIBUTING — a hedge fired on a shard that then missed
+        # the deadline is exactly what the trace must still show
+        return False, f"hedge_spans={hedged}<res.hedges={res.hedges}"
+    won = {s["attrs"].get("shard") for s in rpc
+           if s["name"] == "rpc.search"
+           and s["attrs"].get("outcome") == "won"}
+    if not set(res.shards_ok) <= won:
+        return False, "contributing_shard_without_winning_attempt"
+    worker_shards = set()
+    for s in spans:
+        svc = s.get("service", "")
+        if s["name"] == "request" and svc.startswith("worker-s"):
+            try:
+                worker_shards.add(int(svc[8:].split("r", 1)[0]))
+            except ValueError:
+                pass
+    if not set(res.shards_ok) <= worker_shards:
+        return False, "contributing_shard_without_worker_spans"
+    return True, ""
+
+
+def _disttrace_eval(outcomes: list, reqs: list) -> dict:
+    """The routed soak's distributed-trace invariant (ISSUE 18): every
+    served, dispatched response joins via res.trace_id to exactly one
+    stitched trace whose span population matches its fan-out + hedge +
+    cross-process shape — and no partial/degraded/hedged (tail) trace
+    is missing. Returns the report section; `violations` > 0 is a
+    breach."""
+    from ..obs import disttrace
+
+    traced = untraced = stitch_missing = tail_missing = 0
+    shape_bad = 0
+    span_counts: list = []
+    samples: list = []
+    for out in outcomes:
+        if out is None or out[0] != "ok":
+            continue
+        res = out[1]
+        tid = getattr(res, "trace_id", None)
+        if tid is None:
+            # cache hits answer ahead of admission — nothing dispatched,
+            # nothing minted
+            untraced += 1
+            continue
+        traced += 1
+        st = disttrace.stitch(tid)
+        interesting = bool(res.partial or res.degraded or res.hedges)
+        if st is None:
+            stitch_missing += 1
+            tail_missing += interesting
+            if len(samples) < 5:
+                samples.append({"trace_id": tid, "why": "no_stitch"})
+            continue
+        span_counts.append(st["span_count"])
+        ok, why = _trace_shape_ok(st, res)
+        if not ok:
+            shape_bad += 1
+            if len(samples) < 5:
+                samples.append({"trace_id": tid, "why": why})
+    return {
+        "traced": traced,
+        "untraced_served": untraced,
+        "stitch_missing": stitch_missing,
+        "tail_missing": tail_missing,
+        "shape_violations": shape_bad,
+        "violations": stitch_missing + shape_bad,
+        "mean_spans": round(sum(span_counts) / len(span_counts), 2)
+        if span_counts else 0.0,
+        "violation_samples": samples,
+    }
+
+
+def _disttrace_overhead(mean_request_ms: float, n: int = 512) -> dict:
+    """The ISSUE-18 overhead acceptance, measured synthetically: time
+    the FULL per-request trace bookkeeping (mint, install, one attempt
+    span + annotations, SLO record, store churn) per iteration and
+    express it against this run's mean served latency — enabled and
+    disabled paths both. The soak itself runs traced, so the enabled
+    cost is also baked into its absolute latency numbers."""
+    from ..obs import disttrace
+
+    def per_req_ms() -> float:
+        t0 = time.perf_counter()
+        for _ in range(n):
+            ctx = disttrace.mint()
+            with disttrace.use(ctx):
+                if ctx is not None:
+                    c = disttrace.child(ctx)
+                    sid = disttrace.add_span(
+                        c.trace_id, "rpc.search", span_id=c.span_id,
+                        parent_id=c.parent_id, attrs={"shard": 0})
+                    disttrace.annotate(c.trace_id, sid, dur_ms=1.0,
+                                       outcome="won")
+                disttrace.slo_record("full", 1.0)
+                if ctx is not None:
+                    disttrace.drop(ctx.trace_id)
+        return (time.perf_counter() - t0) * 1e3 / n
+
+    was = disttrace.enabled()
+    try:
+        disttrace.configure(enabled=True)
+        enabled_ms = per_req_ms()
+        disttrace.configure(enabled=False)
+        disabled_ms = per_req_ms()
+    finally:
+        disttrace.configure(enabled=was)
+    base = max(mean_request_ms, 1e-6)
+    return {
+        "per_request_ms": round(enabled_ms, 6),
+        "per_request_disabled_ms": round(disabled_ms, 6),
+        "enabled_overhead_fraction": round(enabled_ms / base, 6),
+        "disabled_overhead_fraction": round(disabled_ms / base, 6),
+    }
+
+
 def _serial_reference(scorer, reqs: list[dict]) -> dict:
     """Full-level serial results per distinct request, computed BEFORE
     any fault plan installs (also warms every compile cache, so the
@@ -507,6 +646,14 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                 obs.report_progress("reference", advance=1)
 
         reg = get_registry()
+        # distributed tracing (ISSUE 18): keep EVERY trace this run and
+        # size the store to the request count — the invariant below
+        # joins each served response to its stitched waterfall, so the
+        # 1-in-N sampling dice and the default 256-trace ring would
+        # both make that join racy. reset_all()/process exit restores.
+        from ..obs import disttrace
+        if disttrace.enabled():
+            disttrace.configure(sample=1, max_traces=len(reqs) + 64)
         counters_before = {n: reg.get(n) for n in reg.counter_names()
                            if n.startswith("router.")}
         hist_before = reg.hist_state()
@@ -913,6 +1060,17 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
             # the per-skew numbers the bench rows record
             "cache": _cache_delta(cache_before),
         }
+        # distributed tracing + SLO (ISSUE 18): the per-response trace
+        # join/shape invariant, the run's SLO window state, and the
+        # synthetic overhead acceptance (enabled <=5%, disabled <=1% of
+        # a mean request) — snapshot BEFORE the overhead bench, whose
+        # synthetic slo_record calls would pollute the windows
+        if disttrace.enabled():
+            report["disttrace"] = _disttrace_eval(outcomes, reqs)
+            report["slo"] = disttrace.slo_snapshot()
+            served_ms = [v for v in latencies if v is not None]
+            report["disttrace"]["overhead"] = _disttrace_overhead(
+                sum(served_ms) / len(served_ms) if served_ms else 0.0)
         if wl is not None:
             report["workload"] = wl.describe()
         # burst p99: served latency during the workload's PEAK window
@@ -969,7 +1127,8 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
         breach = (errors or deadlocked or full_mismatches
                   or partial_mismatches or unknown_generation
                   or late_old_generation
-                  or served + shed != len(reqs))
+                  or served + shed != len(reqs)
+                  or report.get("disttrace", {}).get("violations", 0))
         if breach:
             report["flight_record"] = obs.flight_dump(
                 "routed_soak_invariant_breach",
@@ -1510,6 +1669,11 @@ def run_ingest_soak(live_dir: str, *, docs: int = 48, base_docs: int = 12,
             reg.observe("ingest.freshness", lag / 1e3)
         lags.sort()
         freshness_ms = lags[len(lags) // 2] if lags else -1.0
+        if lags:
+            # the live freshness number (ISSUE 18): /healthz surfaces
+            # the run's median flush->first-query lag as a gauge, so an
+            # operator reads staleness without digging up a soak report
+            reg.set_gauge("ingest.freshness_lag_ms", round(freshness_ms, 3))
         feed_wall = max(t_feed1 - t_feed0, 1e-9)
 
         report = {

@@ -308,6 +308,21 @@ _declare("TPU_IR_WAL_LEASE_TTL_S", "float", 10.0,
          "over on the next writer open; a fresh lease from a live pid "
          "refuses the second writer with WriterLeaseHeld", "§23",
          minimum=0.5)
+_declare("TPU_IR_DISTTRACE", "bool", True,
+         "0 disables distributed request tracing (traceparent minting, "
+         "propagation, span export, stitching) — the per-process span "
+         "rings under TPU_IR_TRACE keep working; this kills only the "
+         "cross-process layer", "§24")
+_declare("TPU_IR_TRACE_TAIL", "bool", True,
+         "0 disables tail-keeping: slow/partial/degraded/hedged/error "
+         "traces stop being force-kept and fall under the same "
+         "1-in-TPU_IR_TRACE_SAMPLE dice as everything else — a "
+         "load-shedding pin, not a tuning knob", "§24")
+_declare("TPU_IR_SLO_P99_MS", "float", 250.0,
+         "the latency SLO: a served request slower than this is a BAD "
+         "request for the sliding-window burn-rate tracker (/slo) and "
+         "its trace is tail-kept; also the disttrace slow-keep "
+         "threshold", "§24", minimum=1.0)
 
 
 def _raw(name: str) -> str | None:
