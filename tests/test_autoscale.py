@@ -528,9 +528,15 @@ def test_cli_serve_bench_autoscale_smoke(index_dir, tmp_path, capsys,
     assert "-autoscale" in row["config"]
     for key in ("scale_events", "burst_p99_ms",
                 "overprovision_fraction", "mean_replicas",
-                "static_replicas", "static_burst_p99_ms"):
+                "static_replicas", "static_burst_p99_ms",
+                "forecast_burst_p99_ms", "forecast_lead_s",
+                "reactive_lead_s"):
         assert key in row, key
     assert report["static_control"]["replicas"] >= 1
     assert report["served"] + report["shed"] == report["submitted"]
+    # the predictive A/B arm (ISSUE 19) conserves like the others
+    fc = report["forecast_arm"]
+    assert fc["errors"] == 0
+    assert fc["served"] + fc["shed"] == report["submitted"]
     lines = hist.read_text().splitlines()
     assert len(lines) == 1

@@ -456,6 +456,7 @@ def _smoke_matrix(index_dir: str, corpus: str, tmp) -> dict:
                          "querylog"}),
         "cache": (["cache"], {"counters", "caches"}),
         "scale": (["scale"], {"enabled", "config"}),
+        "top": (["top", "--json"], {"enabled", "tiers", "series"}),
         "compact": (["compact", str(tmp / "live")],
                     {"steps", "segments", "generation", "mode"}),
         "backup": (["backup", str(tmp / "live"),
@@ -483,7 +484,7 @@ _SMOKE_NAMES = sorted(
      "merge", "stats", "metrics", "trace-dump", "profile", "querylog",
      "doctor", "bench-check", "serve-bench", "eval", "pack", "count",
      "docno", "expand", "lint", "ingest", "generations", "cache",
-     "compact", "serve-worker", "scale", "backup", "trace"])
+     "compact", "serve-worker", "scale", "backup", "trace", "top"])
 
 
 def test_cli_smoke_matrix_is_complete(setup):

@@ -13,7 +13,8 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
 import pytest
 
 
-@pytest.mark.parametrize("seed", [201, 202])
+@pytest.mark.parametrize(
+    "seed", [pytest.param(201, marks=pytest.mark.slow), 202])
 def test_fuzz_seed(seed):
     from fuzz_builds import one_seed
 

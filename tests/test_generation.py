@@ -215,6 +215,7 @@ def test_cli_ingest_swap_bench(tmp_path, capsys, monkeypatch):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_upgrade_mid_soak(tmp_path):
     """Rolling generation handoff under live routed traffic (real
     subprocess workers): conservation holds, zero errors, every

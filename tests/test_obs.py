@@ -331,6 +331,14 @@ def test_prometheus_exposition_shape():
     assert '# TYPE tpu_ir_events_total counter' in text
     assert 'tpu_ir_events_total{name="serving.submitted"} 2' in text
     assert '# TYPE tpu_ir_stage_latency_seconds histogram' in text
+    # every family carries a # HELP line immediately before its # TYPE
+    lines = text.splitlines()
+    for family in ("tpu_ir_events_total", "tpu_ir_gauge",
+                   "tpu_ir_stage_latency_seconds"):
+        help_ln = [i for i, ln in enumerate(lines)
+                   if ln.startswith(f"# HELP {family} ")]
+        assert len(help_ln) == 1, f"missing # HELP for {family}"
+        assert lines[help_ln[0] + 1].startswith(f"# TYPE {family} ")
     assert 'le="+Inf"}' in text
     assert 'tpu_ir_stage_latency_seconds_count{stage="dispatch"} 1' in text
     # buckets are cumulative: +Inf count equals the _count line

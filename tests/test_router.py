@@ -470,6 +470,7 @@ def test_serve_bench_shards_arg_validation(index_dir):
 # ---------------------------------------------------------------------------
 
 
+@pytest.mark.slow
 def test_distributed_chaos_soak(index_dir, tmp_path):
     """Tier-1 fast variant of the ISSUE 10 acceptance: 2 shards x 2
     replicas as real subprocesses; mid-soak a replica is SIGKILLed

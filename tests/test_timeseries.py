@@ -1,0 +1,479 @@
+"""ISSUE 19 — the telemetry time machine.
+
+Pins the tentpole's three load-bearing properties:
+
+1. **Exact downsampling**: merging K fine windows is bit-identical to
+   one coarse window over the same activity — counters, bucket arrays,
+   sums, and every derived value (rate / level / percentile).
+2. **Fixed memory**: the serialized store stops growing once the rings
+   are full; ring lengths never exceed declared capacities.
+3. **Quiet/loud anomaly contract**: zero flight records on a clean
+   run, exactly one under an injected fault (the recorder's per-reason
+   rate limit absorbs the repeats).
+
+Plus the satellites that ride on the store: the sinusoid forecaster,
+the autoscaler's third scale-up signal, the /timeseries endpoint +
+dashboard, /healthz uptime/build fields, sampler lifecycle, declared
+names, and the sampling-overhead bound.
+"""
+
+import json
+import math
+import os
+import random
+import time
+import urllib.request
+
+import pytest
+
+from tpu_ir import obs
+from tpu_ir.obs import timeseries as ts
+from tpu_ir.obs.histogram import NUM_BUCKETS
+from tpu_ir.obs.registry import (
+    DECLARED_COUNTERS,
+    GAUGE_MERGE,
+    TIMESERIES_COUNTER_NAMES,
+    get_registry,
+)
+
+TIERS = ((1, 24), (6, 8), (12, 4))
+
+
+def _window(t, dur=1.0, c=None, g=None, h=None):
+    return {"t": t, "dur_s": dur, "c": dict(c or {}), "g": dict(g or {}),
+            "h": {k: (list(v[0]), v[1]) for k, v in (h or {}).items()}}
+
+
+def _rand_window(rng, t):
+    counts = [0] * NUM_BUCKETS
+    for _ in range(rng.randrange(0, 6)):
+        counts[rng.randrange(NUM_BUCKETS)] += rng.randrange(1, 4)
+    return _window(
+        t,
+        dur=rng.choice([0.5, 1.0, 2.0]),
+        c={"serving.submitted": rng.randrange(0, 50),
+           "router.shed": rng.randrange(0, 5)},
+        g={"router.occupancy": rng.random(),
+           "slo.burn_fast": rng.random() * 4},
+        h={"request": (counts, sum(counts) * 0.003)},
+    )
+
+
+# ---------------------------------------------------------------------------
+# property 1: exact downsampling
+# ---------------------------------------------------------------------------
+
+
+def test_merge_windows_is_exact_rollup():
+    """K fine windows merged == the single window a coarse sampler
+    would have produced: identical raw materials, hence identical
+    derived values. Randomized but seeded — a property test."""
+    rng = random.Random(190)
+    for trial in range(20):
+        k = rng.choice([2, 3, 6])
+        fines = [_rand_window(rng, t=100.0 + i) for i in range(k)]
+        merged = ts.merge_windows(fines)
+        # the coarse window built directly from the summed activity
+        direct = _window(
+            fines[-1]["t"],
+            dur=sum(w["dur_s"] for w in fines),
+            c={n: sum(w["c"].get(n, 0) for w in fines)
+               for n in {n for w in fines for n in w["c"]}},
+            g=fines[-1]["g"],     # both gauges declare "last"/absent
+            h={"request": (
+                [sum(w["h"]["request"][0][b] for w in fines)
+                 for b in range(NUM_BUCKETS)],
+                sum(w["h"]["request"][1] for w in fines))},
+        )
+        assert merged == direct, f"trial {trial}"
+        # derived values agree too (rate, gauge, percentile)
+        for kind, src in (("rate", "serving.submitted"),
+                          ("gauge", "router.occupancy"),
+                          ("p99", "request"), ("p50", "request")):
+            assert ts.window_value(merged, kind, src) == \
+                ts.window_value(direct, kind, src)
+
+
+def test_store_rollup_matches_manual_merge():
+    """The tier cascade IS merge_windows: tier-1 windows equal merging
+    each consecutive factor-sized group of tier-0 windows by hand."""
+    rng = random.Random(191)
+    store = ts.TimeseriesStore(tiers=TIERS, sample_s=1.0)
+    wins = [_rand_window(rng, t=200.0 + i) for i in range(24)]
+    for w in wins:
+        store.add_window(w)
+    t1 = store.windows(1)
+    assert len(t1) == 4
+    for i, coarse in enumerate(t1):
+        assert coarse == ts.merge_windows(wins[i * 6:(i + 1) * 6])
+    # tier 2 rolls up pairs of tier-1 windows (12 // 6)
+    t2 = store.windows(2)
+    assert len(t2) == 2
+    direct = ts.merge_windows(wins[0:12])
+    # counters and bucket counts are integer sums — exactly equal; the
+    # float sum_s differs only in association order (ulp-level)
+    assert t2[0]["c"] == direct["c"]
+    assert t2[0]["g"] == direct["g"]
+    assert t2[0]["h"]["request"][0] == direct["h"]["request"][0]
+    assert t2[0]["h"]["request"][1] == pytest.approx(
+        direct["h"]["request"][1])
+    assert (t2[0]["t"], t2[0]["dur_s"]) == (direct["t"], direct["dur_s"])
+
+
+def test_cluster_merge_sums_deltas_not_durations():
+    a = _window(10.0, dur=1.0, c={"serving.submitted": 10},
+                g={"router.occupancy": 0.2})
+    b = _window(10.4, dur=1.0, c={"serving.submitted": 30},
+                g={"router.occupancy": 0.9})
+    m = ts.merge_windows_across([a, b])
+    assert m["dur_s"] == 1.0          # same wall window, max not sum
+    assert m["c"]["serving.submitted"] == 40
+    assert ts.window_value(m, "rate", "serving.submitted") == 40.0
+    temporal = ts.merge_windows([a, b])
+    assert temporal["dur_s"] == 2.0   # consecutive windows DO sum
+
+
+# ---------------------------------------------------------------------------
+# property 2: fixed memory
+# ---------------------------------------------------------------------------
+
+
+def test_footprint_bounded_once_rings_full():
+    rng = random.Random(192)
+    store = ts.TimeseriesStore(tiers=TIERS, sample_s=1.0)
+    full = store.ring_limits()["max_windows"] * max(f for f, _ in TIERS)
+    for i in range(full):
+        store.add_window(_rand_window(rng, t=300.0 + i))
+    size_full = len(json.dumps(store.state()))
+    for i in range(full):
+        store.add_window(_rand_window(rng, t=300.0 + full + i))
+    size_2x = len(json.dumps(store.state()))
+    # window payloads are randomized, so allow small jitter — the point
+    # is no growth proportional to the second fill
+    assert size_2x <= size_full * 1.05
+    for tier in store.tier_layout():
+        assert tier["len"] <= tier["capacity"]
+
+
+def test_sampler_rebases_on_registry_reset():
+    store = ts.TimeseriesStore(tiers=((1, 8),), sample_s=1.0)
+    reg = get_registry()
+    assert store.sample(now=1.0) is None       # first sample = baseline
+    reg.incr("serving.submitted", 5)
+    w = store.sample(now=2.0)
+    assert w is not None and w["c"]["serving.submitted"] == 5
+    reg.reset()                                 # bumps the resets stamp
+    reg.incr("serving.submitted", 3)
+    assert store.sample(now=3.0) is None        # rebase, not garbage
+    reg.incr("serving.submitted", 2)
+    w = store.sample(now=4.0)
+    assert w is not None and w["c"]["serving.submitted"] == 2
+
+
+def test_sample_overhead_is_cheap():
+    """The acceptance bound is <=2% of a 10 s interval; pin an
+    absolute per-sample cost far inside it (200 ms would be 2%)."""
+    store = ts.TimeseriesStore(tiers=TIERS, sample_s=1.0)
+    reg = get_registry()
+    for i in range(40):
+        reg.incr("serving.submitted")
+        reg.observe("request", 0.004)
+    store.sample(now=1.0)
+    t0 = time.perf_counter()
+    n = 50
+    for i in range(n):
+        reg.incr("serving.submitted")
+        store.sample(now=2.0 + i)
+    per_sample = (time.perf_counter() - t0) / n
+    assert per_sample < 0.02, f"{per_sample * 1000:.2f} ms/sample"
+
+
+# ---------------------------------------------------------------------------
+# property 3: anomaly contract
+# ---------------------------------------------------------------------------
+
+
+def _steady_store(n=20, rate=10):
+    store = ts.TimeseriesStore(tiers=((1, 32),), sample_s=1.0)
+    for i in range(n):
+        store.add_window(_window(400.0 + i, c={"serving.submitted": rate},
+                                 g={"router.occupancy": 0.5}))
+    return store
+
+
+def test_anomaly_quiet_on_clean_history(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path))
+    store = _steady_store()
+    assert store.detect_anomalies() == []
+    assert list(tmp_path.iterdir()) == []
+    assert get_registry().counters().get("timeseries.anomaly", 0) == 0
+
+
+def test_anomaly_loud_exactly_once_under_fault(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path))
+    store = _steady_store()
+    # injected fault: submitted rate collapses AND occupancy spikes
+    store.add_window(_window(500.0, c={"serving.submitted": 500},
+                             g={"router.occupancy": 0.5}))
+    found = store.detect_anomalies()
+    assert [f["series"] for f in found] == ["submitted_per_s"]
+    assert abs(found[0]["z"]) >= 8.0
+    flights = [p for p in tmp_path.iterdir() if "anomaly" in p.name]
+    assert len(flights) == 1, "exactly one flight record"
+    # the artifact header carries the lead-up timeseries block
+    header = json.loads(flights[0].read_text().splitlines()[0])
+    assert header["reason"] == "anomaly"
+    assert header["extra"]["anomaly"]["series"] == "submitted_per_s"
+    # sustained fault: detection repeats, the flight dump does NOT
+    store.add_window(_window(501.0, c={"serving.submitted": 600},
+                             g={"router.occupancy": 0.5}))
+    again = store.detect_anomalies()
+    assert again and again[0]["series"] == "submitted_per_s"
+    flights = [p for p in tmp_path.iterdir() if "anomaly" in p.name]
+    assert len(flights) == 1, "rate limit absorbed the repeat"
+    assert get_registry().counters()["timeseries.anomaly"] == 2
+    assert store.recent_anomalies()[-1]["series"] == "submitted_per_s"
+
+
+def test_anomaly_floor_silences_flat_series(tmp_path, monkeypatch):
+    """A near-constant series (MAD ~ 0) must not alarm on jitter —
+    that is what the per-series floor is for."""
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path))
+    store = ts.TimeseriesStore(tiers=((1, 32),), sample_s=1.0)
+    for i in range(20):
+        store.add_window(_window(600.0 + i,
+                                 g={"router.occupancy": 0.500}))
+    store.add_window(_window(620.0, g={"router.occupancy": 0.52}))
+    assert store.detect_anomalies() == []
+    assert store.detect_anomalies(z_threshold=0) == []   # 0 disables
+
+
+# ---------------------------------------------------------------------------
+# the forecaster + the autoscaler's third signal
+# ---------------------------------------------------------------------------
+
+
+def test_fit_recovers_period_and_predicts_ahead():
+    pts = [(50.0 + i * 2.0,
+            0.5 + 0.3 * math.sin(2 * math.pi * (50.0 + i * 2.0) / 40.0))
+           for i in range(40)]
+    fit = ts.fit_sinusoid(pts)
+    assert fit is not None and fit["r2"] > 0.9
+    assert abs(fit["period_s"] - 40.0) < 4.0
+    t = pts[-1][0] + 10.0
+    truth = 0.5 + 0.3 * math.sin(2 * math.pi * t / 40.0)
+    assert abs(ts.predict(fit, t) - truth) < 0.08
+
+
+def test_fit_rejects_flat_and_noise():
+    flat = [(float(i), 0.5) for i in range(20)]
+    assert ts.fit_sinusoid(flat) is None
+    rng = random.Random(193)
+    noise = [(float(i), rng.random()) for i in range(20)]
+    fit = ts.fit_sinusoid(noise)
+    assert fit is None or fit["r2"] < 0.9
+
+
+def test_forecaster_publishes_gauge_and_degrades():
+    store = ts.TimeseriesStore(tiers=((1, 64),), sample_s=1.0)
+    fc = ts.Forecaster(store, lead_s=10.0, interval_s=0.0)
+    reg = get_registry()
+    # sinusoidal occupancy history -> a confident forecast
+    for i in range(30):
+        t = 700.0 + i * 2.0
+        store.add_window(_window(
+            t, g={"router.occupancy":
+                  0.5 + 0.3 * math.sin(2 * math.pi * t / 40.0)}))
+    fc._t0 = 700.0
+    now = 700.0 + 29 * 2.0
+    value = fc.poll(now=now)
+    assert value is not None
+    truth = 0.5 + 0.3 * math.sin(2 * math.pi * (now + 10.0) / 40.0)
+    assert abs(value - truth) < 0.12
+    assert reg.gauges()["forecast_occupancy"] == pytest.approx(value)
+    assert reg.counters()["forecast.fits"] >= 1
+    assert store.last_fit["lead_s"] == 10.0
+    # flat history -> gate fails -> gauge degrades to the current level
+    store.reset()
+    for i in range(20):
+        store.add_window(_window(800.0 + i,
+                                 g={"router.occupancy": 0.42}))
+    fc2 = ts.Forecaster(store, lead_s=10.0, interval_s=0.0)
+    fc2._t0 = 800.0
+    assert fc2.poll(now=820.0) is None
+    assert reg.gauges()["forecast_occupancy"] == pytest.approx(0.42)
+
+
+def test_autoscaler_forecast_is_third_up_signal():
+    from tests.test_autoscale import FakeFleet, FakeRouter, _cfg
+    from tpu_ir.serving.autoscale import Autoscaler
+
+    reg = get_registry()
+    fleet, router = FakeFleet(), FakeRouter()
+    scaler = Autoscaler(fleet, router, _cfg(sustain_up=2,
+                                            forecast_up=0.6))
+    router.admission.inflight = 3          # occupancy 0.3 < 0.8
+    # low occupancy, no forecast gauge: no arming
+    d = scaler.tick(now=10.0)
+    assert d["action"] is None and d["forecast"] == 0.0
+    assert reg.gauges()["router.occupancy"] == pytest.approx(0.3)
+    # forecast predicts a burst: arms and fires with reason "forecast"
+    reg.set_gauge("forecast_occupancy", 0.85)
+    scaler.tick(now=11.0)
+    d = scaler.tick(now=12.0)
+    assert d["action"] == "up" and d["reason"] == "forecast"
+    assert reg.counters()["forecast.scaleups"] == 1
+    assert fleet.active_replicas() == 2
+    # occupancy-driven scale-ups keep their own reason even when the
+    # forecast gauge is also high
+    fleet2, router2 = FakeFleet(), FakeRouter()
+    scaler2 = Autoscaler(fleet2, router2, _cfg(sustain_up=1,
+                                               forecast_up=0.6))
+    router2.admission.inflight = 9
+    d = scaler2.tick(now=20.0)
+    assert d["action"] == "up" and d["reason"] == "sustained_pressure"
+
+
+# ---------------------------------------------------------------------------
+# lifecycle + surfaces
+# ---------------------------------------------------------------------------
+
+
+def test_sampler_thread_starts_and_stops():
+    import threading
+
+    sampler = ts.TimeseriesSampler(
+        store=ts.TimeseriesStore(tiers=((1, 8),), sample_s=1.0),
+        interval_s=0.01)
+    sampler.start()
+    names = [t.name for t in threading.enumerate()]
+    assert "tpu-ir-obs-timeseries" in names
+    time.sleep(0.05)
+    sampler.stop()
+    names = [t.name for t in threading.enumerate()]
+    assert "tpu-ir-obs-timeseries" not in names
+    assert get_registry().counters().get("timeseries.samples", 0) >= 1
+
+
+def test_refcounted_sampler_survives_nested_servers():
+    import threading
+
+    s1 = ts.ensure_sampler()
+    s2 = ts.ensure_sampler()
+    assert s1 is s2 is not None
+    ts.release_sampler()
+    assert any(t.name == "tpu-ir-obs-timeseries"
+               for t in threading.enumerate())
+    ts.release_sampler()
+    assert not any(t.name == "tpu-ir-obs-timeseries"
+                   for t in threading.enumerate())
+
+
+def test_disabled_flag_turns_everything_off(monkeypatch):
+    monkeypatch.setenv("TPU_IR_TIMESERIES", "0")
+    assert not ts.enabled()
+    assert ts.ensure_sampler() is None
+    assert ts.payload() == {"enabled": False}
+    assert ts.header_window() is None
+
+
+def _get_json(url):
+    with urllib.request.urlopen(url, timeout=5) as resp:
+        return json.loads(resp.read().decode())
+
+
+def test_timeseries_endpoint_and_healthz(tmp_path, monkeypatch):
+    from tpu_ir.obs.server import MetricsServer
+
+    reg = get_registry()
+    store = ts.get_store()
+    store.sample(now=time.time() - 1.0)
+    reg.incr("serving.submitted", 7)
+    reg.set_gauge("router.occupancy", 0.4)
+    store.sample(now=time.time())
+    with MetricsServer(port=0) as srv:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = _get_json(f"{base}/timeseries")
+        assert body["enabled"] and body["sources"] == 1
+        assert body["tiers"][0]["len"] >= 1
+        sub = body["series"]["submitted_per_s"]
+        assert sub["kind"] == "rate"
+        assert sub["tiers"][0], "tier-0 points present"
+        occ = body["series"]["occupancy"]["tiers"][0]
+        assert occ and occ[-1][1] == pytest.approx(0.4)
+        html = urllib.request.urlopen(
+            f"{base}/timeseries?format=html", timeout=5).read().decode()
+        assert "<svg" in html and "/timeseries" in html
+        assert "submitted_per_s" in html
+        hz = _get_json(f"{base}/healthz")
+        assert hz["uptime_s"] > 0
+        assert hz["started_at"].count(":") == 2
+        assert isinstance(hz["build_sha"], str)
+        # the index page links the new route
+        index = _get_json(f"{base}/")
+        assert "/timeseries" in index["endpoints"]
+
+
+def test_flight_header_carries_leadup(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_IR_FLIGHT_DIR", str(tmp_path))
+    from tpu_ir.obs.recorder import flight_dump
+
+    store = ts.get_store()
+    store.sample(now=time.time() - 1.0)
+    get_registry().incr("serving.submitted", 3)
+    store.sample(now=time.time())
+    path = flight_dump("test_leadup", force=True)
+    header = json.loads(open(path).read().splitlines()[0])
+    assert "timeseries" in header
+    assert "submitted_per_s" in header["timeseries"]["series"]
+
+
+def test_cluster_spool_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setenv("TPU_IR_TELEMETRY_DIR", str(tmp_path))
+    store = ts.get_store()
+    reg = get_registry()
+    now = time.time()
+    store.sample(now=now - 1.0)
+    reg.incr("serving.submitted", 10)
+    store.sample(now=now)
+    assert ts.spool_write_store(str(tmp_path)) is not None
+    # forge a second process's spool file over the same wall window
+    docs = ts.read_spool_stores(str(tmp_path))
+    assert len(docs) == 1
+    foreign = json.loads(json.dumps(docs[0]))
+    foreign["run_id"] = "someone-else"
+    foreign["pid"] = 99999
+    with open(tmp_path / "timeseries-otherhost-99999.json", "w") as f:
+        json.dump(foreign, f)
+    body = ts.payload(cluster=True)
+    assert body["sources"] == 2
+    pts = body["series"]["submitted_per_s"]["tiers"][0]
+    local = ts.payload(cluster=False)["series"]["submitted_per_s"]["tiers"][0]
+    # cluster rate = sum of per-process rates over the same window
+    assert pts[-1][1] == pytest.approx(2 * local[-1][1], rel=1e-3)
+
+
+# ---------------------------------------------------------------------------
+# declared names
+# ---------------------------------------------------------------------------
+
+
+def test_timeseries_names_are_declared():
+    assert set(TIMESERIES_COUNTER_NAMES) <= set(DECLARED_COUNTERS)
+    assert {"timeseries.samples", "timeseries.rollups",
+            "timeseries.anomaly", "forecast.fits",
+            "forecast.scaleups"} == set(TIMESERIES_COUNTER_NAMES)
+    assert GAUGE_MERGE["router.occupancy"] == "last"
+    assert GAUGE_MERGE["forecast_occupancy"] == "last"
+
+
+def test_curated_sources_exist_in_registry_vocabulary():
+    """Every curated counter source must be a declared counter name; a
+    typo here would silently produce an all-zero series forever. The
+    serving.* family is declared bare in SERVING_COUNTER_NAMES."""
+    from tpu_ir.obs.registry import SERVING_COUNTER_NAMES
+
+    serving = {f"serving.{n}" for n in SERVING_COUNTER_NAMES}
+    for _, kind, source, _ in ts.CURATED:
+        if kind == "rate":
+            assert source in set(DECLARED_COUNTERS) | serving, source

@@ -15,6 +15,7 @@ import json
 import os
 import re
 import sys
+import time
 
 
 
@@ -1121,6 +1122,21 @@ def _serve_routed(args) -> int:
     cache_n = resolve_capacity(args.cache)
     reports = []
     ok = True
+    pacing_kw = ({} if args.pacing is None
+                 else {"pacing_s": args.pacing})
+    re_cfg = None
+    if args.autoscale:
+        # ONE explicit config shared by the reactive and forecast arms
+        # (the forecast arm adds only the third signal) — the A/B must
+        # differ in exactly one bit
+        from .serving.autoscale import AutoscaleConfig
+
+        re_cfg = AutoscaleConfig(
+            min_replicas=args.replicas,
+            max_replicas=args.replicas + 1,
+            cooldown_s=0.5, up_occupancy=0.45,
+            down_occupancy=0.1, sustain_up=5,
+            sustain_down=50, drain_timeout_s=15.0)
     with _MaybeTrack(args.metrics_port) as track:
         for label, spec in specs:
             report = run_distributed_soak(
@@ -1133,10 +1149,52 @@ def _serve_routed(args) -> int:
                                    else args.deadline),
                 timeout_s=args.timeout, flight_dir=args.flight_dir,
                 workload=spec, cache_entries=args.cache,
-                autoscale=bool(args.autoscale))
+                autoscale=re_cfg if args.autoscale else False,
+                **pacing_kw)
             if track.server is not None:
                 report["metrics_url"] = track.server.url
             static = None
+            forecast = None
+            if args.autoscale:
+                # the predictive A/B arm (ISSUE 19): same topology,
+                # same workload, same seed — but the autoscaler's
+                # THIRD signal armed. The soak's controller drives the
+                # telemetry time machine's diurnal fit over the
+                # occupancy series; forecast_occupancy (predicted
+                # occupancy --lead seconds ahead) arms scale-up, so
+                # growth starts before the burst crest instead of
+                # after the queue builds. The row records each arm's
+                # measured lead (first_peak - first_up, positive =
+                # fired early) and burst p99 side by side.
+                import dataclasses
+
+                fc_cfg = dataclasses.replace(
+                    re_cfg, forecast_up=re_cfg.up_occupancy,
+                    forecast_lead_s=args.lead)
+                forecast = run_distributed_soak(
+                    args.index_dir, shards=args.shards,
+                    replicas=args.replicas,
+                    threads=args.threads, queries=args.queries,
+                    seed=args.seed,
+                    layout=layout, chaos=args.chaos,
+                    worker_deadline_s=(1.0 if args.deadline is None
+                                       else args.deadline),
+                    timeout_s=args.timeout,
+                    flight_dir=args.flight_dir,
+                    workload=spec, cache_entries=args.cache,
+                    autoscale=fc_cfg, **pacing_kw)
+                report["forecast_arm"] = {
+                    "burst_p99_ms": forecast["burst_p99_ms"],
+                    "served": forecast["served"],
+                    "shed": forecast["shed"],
+                    "errors": forecast["errors"],
+                    "scale": {k: forecast["scale"].get(k)
+                              for k in ("events", "first_up_s",
+                                        "first_up_reason",
+                                        "first_up_frac",
+                                        "first_peak_s",
+                                        "forecast_lead_s")},
+                }
             if args.autoscale:
                 # the control arm: a STATIC fleet at the autoscaled
                 # run's mean active replica count — "equal capacity
@@ -1155,7 +1213,8 @@ def _serve_routed(args) -> int:
                                        else args.deadline),
                     timeout_s=args.timeout,
                     flight_dir=args.flight_dir,
-                    workload=spec, cache_entries=args.cache)
+                    workload=spec, cache_entries=args.cache,
+                    **pacing_kw)
                 report["static_control"] = {
                     "replicas": ctrl_replicas,
                     "burst_p99_ms": static["burst_p99_ms"],
@@ -1201,6 +1260,11 @@ def _serve_routed(args) -> int:
                 row["static_replicas"] = (
                     report["static_control"]["replicas"])
                 row["static_burst_p99_ms"] = static["burst_p99_ms"]
+                row["forecast_burst_p99_ms"] = forecast["burst_p99_ms"]
+                row["forecast_lead_s"] = forecast["scale"].get(
+                    "forecast_lead_s", -1.0)
+                row["reactive_lead_s"] = report["scale"].get(
+                    "forecast_lead_s", -1.0)
             report["history"] = append_history_row(row)
             report["history_row"] = row
             reports.append(report)
@@ -1224,6 +1288,18 @@ def _serve_routed(args) -> int:
                     # burst_p99_ms number across the history
                     ok = ok and (report["burst_p99_ms"]
                                  <= static["burst_p99_ms"] * 1.5 + 250.0)
+            if forecast is not None:
+                # the predictive arm must conserve too, and its burst
+                # p99 must not LOSE to the reactive arm (same generous
+                # smoke bound; bench-check trends the exact numbers)
+                ok = ok and (
+                    forecast["errors"] == 0
+                    and forecast["deadlocked"] == 0
+                    and forecast["served"] + forecast["shed"]
+                    == forecast["submitted"])
+                if report["burst_p99_ms"] > 0:
+                    ok = ok and (forecast["burst_p99_ms"]
+                                 <= report["burst_p99_ms"] * 1.5 + 250.0)
     out = reports[0] if len(reports) == 1 else {
         "runs": reports,
         "levels": [r["history_row"]["workload"] for r in reports]}
@@ -1376,6 +1452,98 @@ def cmd_scale(args) -> int:
         out["live"] = payload.get("autoscaler") or {
             "error": "no autoscaler registered in that process"}
     print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+_SPARK_BLOCKS = "▁▂▃▄▅▆▇█"
+
+
+def _sparkline(values, width: int = 48) -> str:
+    """Unicode block sparkline over the last `width` values."""
+    vs = list(values)[-width:]
+    if not vs:
+        return ""
+    lo, hi = min(vs), max(vs)
+    span = hi - lo
+    if span <= 0:
+        return _SPARK_BLOCKS[0] * len(vs)
+    return "".join(
+        _SPARK_BLOCKS[min(len(_SPARK_BLOCKS) - 1,
+                          int((v - lo) / span * len(_SPARK_BLOCKS)))]
+        for v in vs)
+
+
+def cmd_top(args) -> int:
+    """The telemetry time machine's terminal view (ISSUE 19;
+    obs/timeseries.py): one line per curated series — newest value,
+    min/max over the tier, and a unicode sparkline of the retained
+    window. Reads the local process store by default (useful inside a
+    soak), or a live server's /timeseries via --url. --watch N
+    redraws N times at --interval seconds; --json prints the raw
+    /timeseries payload instead (the scriptable form)."""
+
+    def _fetch() -> dict:
+        if args.url:
+            import urllib.request
+
+            url = args.url.rstrip("/") + "/timeseries"
+            with urllib.request.urlopen(url, timeout=10.0) as r:
+                return json.loads(r.read().decode("utf-8"))
+        from .obs import timeseries
+
+        return timeseries.payload()
+
+    try:
+        payload = _fetch()
+    except Exception as e:  # noqa: BLE001 — a dead server is the answer
+        print(f"error: cannot read /timeseries: {e!r}", file=sys.stderr)
+        return 1
+    if args.json:
+        print(json.dumps(payload, sort_keys=True))
+        return 0
+    tier = max(0, args.tier)
+    for it in range(max(1, args.watch)):
+        if it:
+            time.sleep(args.interval)
+            try:
+                payload = _fetch()
+            except Exception as e:  # noqa: BLE001
+                print(f"error: cannot read /timeseries: {e!r}",
+                      file=sys.stderr)
+                return 1
+            print("\x1b[2J\x1b[H", end="")  # clear + home between draws
+        if not payload.get("enabled"):
+            print("timeseries disabled (TPU_IR_TIMESERIES=0)")
+            return 0
+        tiers = payload.get("tiers", [])
+        if tier >= len(tiers):
+            print(f"error: tier {tier} out of range "
+                  f"(store has {len(tiers)})", file=sys.stderr)
+            return 2
+        t = tiers[tier]
+        print(f"tpu-ir top — tier {tier} "
+              f"({t['window_s']:g}s x {t['capacity']} windows, "
+              f"{t['len']} held)")
+        for label in sorted(payload.get("series", {})):
+            pts = payload["series"][label]["tiers"][tier]
+            vals = [v for _, v in pts]
+            if not vals:
+                print(f"  {label:<24} (no data)")
+                continue
+            print(f"  {label:<24} {vals[-1]:>10.3f}  "
+                  f"[{min(vals):.3f}..{max(vals):.3f}]  "
+                  f"{_sparkline(vals)}")
+        anomalies = payload.get("anomalies") or []
+        if anomalies:
+            a = anomalies[-1]
+            print(f"  last anomaly: {a['series']} z={a['z']} "
+                  f"value={a['value']} median={a['median']}")
+        fit = payload.get("forecast")
+        if fit:
+            print(f"  forecast: period={fit['period_s']:g}s "
+                  f"amplitude={fit['amplitude']:g} r2={fit['r2']:g} "
+                  f"-> occupancy {fit.get('forecast', 0.0):g} "
+                  f"in {fit.get('lead_s', 0.0):g}s")
     return 0
 
 
@@ -2037,7 +2205,19 @@ def main(argv: list[str] | None = None) -> int:
                          "static control at the same mean replica "
                          "count; scale_events / burst_p99_ms / "
                          "overprovision_fraction append to "
-                         "BENCH_HISTORY.jsonl")
+                         "BENCH_HISTORY.jsonl. Also runs the "
+                         "forecast-vs-reactive A/B arm (obs/"
+                         "timeseries.py): the diurnal-fit third "
+                         "scale-up signal vs plain occupancy, "
+                         "forecast_lead_s / forecast_burst_p99_ms "
+                         "recorded next to the reactive numbers")
+    pb.add_argument("--lead", type=float, default=1.0, metavar="S",
+                    help="forecast horizon (seconds) for the "
+                         "--autoscale predictive arm: the diurnal fit "
+                         "publishes occupancy predicted this far "
+                         "ahead, so scale-up leads the burst by about "
+                         "this much (live serving uses "
+                         "TPU_IR_SCALE_LEAD_S instead)")
     pb.add_argument("--layout",
                     choices=["auto", "dense", "sparse", "sharded"],
                     default="auto")
@@ -2057,6 +2237,13 @@ def main(argv: list[str] | None = None) -> int:
                     help="diurnal burst amplitude for the workload "
                          "arrival schedule (default: "
                          "TPU_IR_WORKLOAD_BURST)")
+    pb.add_argument("--pacing", type=float, default=None, metavar="S",
+                    help="mean inter-arrival pacing unit (seconds) for "
+                         "the routed soak's open-ish arrival schedule; "
+                         "raise it so arrivals (not service time) set "
+                         "the occupancy wave — the regime the "
+                         "--autoscale A/B needs (default: the soak's "
+                         "0.002)")
     pb.add_argument("--cache", type=int, default=None, metavar="N",
                     help="generation-keyed exact-hit result cache "
                          "capacity (entries) at the router / frontend "
@@ -2086,6 +2273,28 @@ def main(argv: list[str] | None = None) -> int:
                           "telemetry server; prints its /healthz "
                           "autoscaler section")
     psc.set_defaults(fn=cmd_scale)
+
+    ptp = sub.add_parser(
+        "top",
+        help="live terminal view of the telemetry time machine "
+             "(obs/timeseries.py): one sparkline per curated series "
+             "(rates, occupancy, per-window percentiles) off the "
+             "local store or a live server's /timeseries via --url")
+    ptp.add_argument("--url", default=None, metavar="URL",
+                     help="base URL of a running --metrics-port "
+                          "telemetry server; default reads this "
+                          "process's own store")
+    ptp.add_argument("--tier", type=int, default=0,
+                     help="ring tier to render (0 = finest)")
+    ptp.add_argument("--watch", type=int, default=1, metavar="N",
+                     help="redraw N times before exiting (1 = one "
+                          "shot)")
+    ptp.add_argument("--interval", type=float, default=2.0,
+                     help="seconds between --watch redraws")
+    ptp.add_argument("--json", action="store_true",
+                     help="print the raw /timeseries payload instead "
+                          "of the terminal view")
+    ptp.set_defaults(fn=cmd_top)
 
     pca = sub.add_parser(
         "cache",

@@ -71,6 +71,7 @@ from .profiling import (
 )
 from . import querylog  # noqa: E402 — needs recorder/registry bound above
 from . import disttrace  # noqa: E402 — registers the root-close hook
+from . import timeseries  # noqa: E402 — needs registry/recorder above
 
 
 def reset_all() -> None:
@@ -88,6 +89,7 @@ def reset_all() -> None:
     profiling.reset_profile()
     querylog.clear()
     disttrace.reset()
+    timeseries.reset()
 
 
 __all__ = [
@@ -105,4 +107,5 @@ __all__ = [
     "recompiles_last_60s",
     "querylog",
     "disttrace",
+    "timeseries",
 ]

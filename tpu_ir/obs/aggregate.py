@@ -345,6 +345,19 @@ class SpoolWriter:
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
             spool_write(self._dir)
+            self._spool_timeseries()
+
+    def _spool_timeseries(self) -> None:
+        # the history rides the same spool cadence (ISSUE 19): one
+        # timeseries-<host>-<pid>.json per process, newest state wins,
+        # merged by /timeseries?cluster=1 the way merge_spool folds the
+        # telemetry snapshots
+        try:
+            from . import timeseries
+
+            timeseries.spool_write_store(self._dir)
+        except Exception:  # noqa: BLE001 — spooling is best-effort
+            pass
 
     def stop(self) -> None:
         """Stop the thread and write one final snapshot (the authoritative
@@ -354,3 +367,4 @@ class SpoolWriter:
             self._thread.join(timeout=10.0)
             self._thread = None
         spool_write(self._dir)
+        self._spool_timeseries()

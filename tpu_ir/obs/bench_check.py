@@ -128,6 +128,14 @@ METRICS: dict[str, tuple[str, float]] = {
     "burst_p99_ms": ("lower", 50.0),
     "scale_events": ("lower", 2.0),
     "overprovision_fraction": ("lower", 0.05),
+    # predictive autoscaling (ISSUE 19; the forecast-vs-reactive A/B
+    # inside serve_routed -autoscale rows): forecast_lead_s is how far
+    # BEFORE the first diurnal crest the forecast-armed scaler fired
+    # its first scale-up (higher = more predictive; the 0.5 s floor is
+    # controller-tick + fit-refresh granularity), and the forecast
+    # arm's burst p99 rides the same weather floor as the reactive one
+    "forecast_lead_s": ("higher", 0.5),
+    "forecast_burst_p99_ms": ("lower", 50.0),
     # streaming-build phase walls (ISSUE 11: wiki/build_scale rows) —
     # the radix restructure's whole point is driving pass2_combine_s
     # down, so the sentry gates each pass plus the end-to-end build

@@ -126,6 +126,18 @@ def artifact_lines(reason: str, extra: dict | None = None,
                                        "attrs": dict(root.attrs)}
     except Exception:  # noqa: BLE001 — the header must always write
         pass
+    try:
+        # the lead-up (ISSUE 19): the last-N retained windows of every
+        # curated series, so a post-mortem carries the half-hour BEFORE
+        # the breach, not just the instant of it (lazy import —
+        # timeseries calls flight_dump for anomaly records)
+        from .timeseries import header_window
+
+        hw = header_window()
+        if hw is not None:
+            header["timeseries"] = hw
+    except Exception:  # noqa: BLE001 — the header must always write
+        pass
     if callable(extra):
         try:
             extra = extra()

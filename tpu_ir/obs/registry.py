@@ -257,6 +257,20 @@ DISTTRACE_COUNTER_NAMES = (
     "slo.good", "slo.bad", "slo.burn_breach",
 )
 
+# Telemetry time machine (ISSUE 19, obs/timeseries.py).
+# timeseries.samples counts base-rate windows taken off the registry,
+# timeseries.rollups exact fine->coarse tier merges, and
+# timeseries.anomaly MAD z-score detections on the curated series
+# (each detection also writes a rate-limited "anomaly" flight record).
+# forecast.fits counts sinusoid fits that PASSED the quality gate and
+# published the forecast_occupancy gauge; forecast.scaleups the
+# autoscaler scale-ups whose deciding signal was the forecast (reason
+# "forecast" — growth started before the burst, not after the queue).
+TIMESERIES_COUNTER_NAMES = (
+    "timeseries.samples", "timeseries.rollups", "timeseries.anomaly",
+    "forecast.fits", "forecast.scaleups",
+)
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
@@ -264,7 +278,7 @@ DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
 ) + (COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
      + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES + INGEST_COUNTER_NAMES
      + PRUNE_COUNTER_NAMES + CACHE_COUNTER_NAMES + SCALE_COUNTER_NAMES
-     + DISTTRACE_COUNTER_NAMES)
+     + DISTTRACE_COUNTER_NAMES + TIMESERIES_COUNTER_NAMES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
@@ -363,6 +377,13 @@ GAUGE_MERGE = {
     # threshold so a single spike can't page
     "slo.burn_fast": "last",
     "slo.burn_slow": "last",
+    # telemetry time machine (ISSUE 19): the admission occupancy the
+    # autoscaler computed on its last tick (the raw series the diurnal
+    # fit reads), and the fit's output — predicted occupancy
+    # TPU_IR_SCALE_LEAD_S in the future, the third scale-up signal.
+    # Both are per-process currents, so "last" merges.
+    "router.occupancy": "last",
+    "forecast_occupancy": "last",
 }
 DECLARED_GAUGES = tuple(sorted(GAUGE_MERGE))
 
@@ -608,17 +629,32 @@ class TelemetryRegistry:
 
     def prometheus_text(self, reset: bool = False) -> str:
         """Prometheus text exposition: counters as one labeled family,
-        histograms in the native cumulative-bucket format. `reset=True`
-        drains atomically, same as snapshot(reset=True)."""
+        histograms in the native cumulative-bucket format. Every family
+        carries its `# HELP`/`# TYPE` metadata pair (HELP first, the
+        order scrapers expect) so nothing is left to inference.
+        `reset=True` drains atomically, same as snapshot(reset=True)."""
         from .histogram import BOUNDS
 
         counters, gauges, _set, states, _ = self._collect(reset)
-        lines = ["# TYPE tpu_ir_events_total counter"]
+        lines = [
+            "# HELP tpu_ir_events_total Monotonic event counters; one "
+            "series per declared dotted name (label \"name\"), zeroed "
+            "only by an explicit reset.",
+            "# TYPE tpu_ir_events_total counter",
+        ]
         for name, v in sorted(counters.items()):
             lines.append(f'tpu_ir_events_total{{name="{name}"}} {v}')
+        lines.append(
+            "# HELP tpu_ir_gauge Point-in-time levels; one series per "
+            "declared dotted name (label \"name\"), merge policy per "
+            "GAUGE_MERGE.")
         lines.append("# TYPE tpu_ir_gauge gauge")
         for name, v in sorted(gauges.items()):
             lines.append(f'tpu_ir_gauge{{name="{name}"}} {v!r}')
+        lines.append(
+            "# HELP tpu_ir_stage_latency_seconds Stage wall time on "
+            "fixed log2 buckets; one series set per declared histogram "
+            "(label \"stage\"), cumulative le buckets.")
         lines.append("# TYPE tpu_ir_stage_latency_seconds histogram")
         for name in sorted(states):
             counts, sum_s = states[name]

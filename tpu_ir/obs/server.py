@@ -54,7 +54,9 @@ from __future__ import annotations
 import html
 import json
 import logging
+import os
 import threading
+import time
 import weakref
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
@@ -205,6 +207,33 @@ def _live_autoscalers() -> list:
         return [f for _, f in alive if f is not None]
 
 
+# process identity (ISSUE 19 satellite): a scraper comparing two
+# /healthz reads needs to tell "this process restarted" from "someone
+# reset the registry" without diffing seq/resets heuristics. started_at
+# + uptime_s pin the process lifetime; build_sha pins WHICH build is
+# running — stamped the same way BENCH_HISTORY rows are (git rev-parse
+# at first ask, cached: health scrapes must not fork per request).
+_PROCESS_START = time.time()
+_PROCESS_START_ISO = time.strftime("%Y-%m-%dT%H:%M:%S",
+                                   time.localtime(_PROCESS_START))
+_BUILD_SHA: list = []
+
+
+def _build_sha() -> str | None:
+    if not _BUILD_SHA:
+        try:
+            import subprocess
+
+            sha = subprocess.run(
+                ["git", "rev-parse", "--short", "HEAD"],
+                cwd=os.path.dirname(os.path.abspath(__file__)),
+                capture_output=True, text=True, timeout=10).stdout.strip()
+        except Exception:  # noqa: BLE001 — health must not 500
+            sha = ""
+        _BUILD_SHA.append(sha or None)
+    return _BUILD_SHA[0]
+
+
 def health_snapshot() -> dict:
     """The /healthz payload. The newest live frontend's control-plane
     state is lifted to the top-level `breaker`/`ladder`/`queue_depth`
@@ -220,6 +249,9 @@ def health_snapshot() -> dict:
         "frontends": [],
         "jobs_running": len(running),
         "registry_seq": get_registry().seq,
+        "uptime_s": round(time.time() - _PROCESS_START, 3),
+        "started_at": _PROCESS_START_ISO,
+        "build_sha": _build_sha(),
     }
     try:
         # a recompile storm in progress is a liveness problem (every
@@ -316,8 +348,8 @@ def health_snapshot() -> dict:
 # /jobs <-> /cluster <-> /profile <-> /querylog <-> /doctor drift fix)
 _NAV_ROUTES = ("/healthz", "/jobs?format=html", "/cluster?format=html",
                "/profile?format=html", "/querylog?format=html",
-               "/doctor?format=html", "/slo?format=html", "/flight",
-               "/metrics")
+               "/doctor?format=html", "/slo?format=html",
+               "/timeseries?format=html", "/flight", "/metrics")
 
 
 def _nav_html() -> str:
@@ -343,6 +375,66 @@ def _json_page_html(title: str, obj) -> str:
             f"<title>{html.escape(title)}</title>{_STYLE}</head><body>"
             f"<h1>{html.escape(title)}</h1>{_nav_html()}"
             f"<pre>{body}</pre></body></html>")
+
+
+def _timeseries_html(payload: dict) -> str:
+    """The /timeseries sparkline dashboard: one inline-SVG polyline
+    per curated series per tier — the retained history at a glance,
+    JobTracker-page idiom (static HTML, no scripts to serve)."""
+    parts = [
+        "<!DOCTYPE html><html><head><meta charset='utf-8'>",
+        f"<title>tpu-ir timeseries</title>{_STYLE}</head><body>",
+        "<h1>tpu-ir timeseries</h1>", _nav_html(),
+    ]
+    if not payload.get("enabled"):
+        parts.append("<p>timeseries disabled (TPU_IR_TIMESERIES=0)</p>"
+                     "</body></html>")
+        return "".join(parts)
+    tiers = payload.get("tiers", [])
+    parts.append("<p>" + " &middot; ".join(
+        f"tier {t['tier']}: {t['window_s']:g}s &times; "
+        f"{t['capacity']} ({t['len']} held)" for t in tiers) + "</p>")
+    w, h = 360, 48
+    for label in sorted(payload.get("series", {})):
+        ent = payload["series"][label]
+        cells = []
+        for tier_pts in ent["tiers"]:
+            vals = [v for _, v in tier_pts]
+            if not vals:
+                cells.append("<td>(no data)</td>")
+                continue
+            lo, hi = min(vals), max(vals)
+            span = (hi - lo) or 1.0
+            n = max(len(vals) - 1, 1)
+            pts = " ".join(
+                f"{i * w / n:.1f},{h - (v - lo) / span * h:.1f}"
+                for i, v in enumerate(vals))
+            cells.append(
+                f"<td><svg width='{w}' height='{h}' "
+                f"viewBox='0 0 {w} {h}'><polyline points='{pts}' "
+                "fill='none' stroke='#36c' stroke-width='1.5'/></svg>"
+                f"<br><small>last {vals[-1]:g} "
+                f"[{lo:g}..{hi:g}]</small></td>")
+        parts.append(f"<h3>{html.escape(label)}</h3>"
+                     f"<table><tr>{''.join(cells)}</tr></table>")
+    anomalies = payload.get("anomalies") or []
+    if anomalies:
+        rows = "".join(
+            f"<tr><td>{html.escape(str(a['series']))}</td>"
+            f"<td>{a['z']}</td><td>{a['value']}</td>"
+            f"<td>{a['median']}</td></tr>" for a in anomalies)
+        parts.append("<h3>anomalies</h3><table><tr><th>series</th>"
+                     "<th>z</th><th>value</th><th>median</th></tr>"
+                     f"{rows}</table>")
+    fit = payload.get("forecast")
+    if fit:
+        parts.append(
+            f"<p>forecast: period {fit['period_s']:g}s, amplitude "
+            f"{fit['amplitude']:g}, r&sup2; {fit['r2']:g} &rarr; "
+            f"occupancy {fit.get('forecast', 0.0):g} in "
+            f"{fit.get('lead_s', 0.0):g}s</p>")
+    parts.append("</body></html>")
+    return "".join(parts)
 
 
 def _jobs_html(job_dicts: list, title: str) -> str:
@@ -623,6 +715,17 @@ class _Handler(BaseHTTPRequestHandler):
                                "text/html; charset=utf-8")
                 else:
                     self._json(st)
+            elif route == "/timeseries":
+                from . import timeseries
+
+                cluster = q.get("cluster", ["0"])[0] not in ("", "0")
+                payload = timeseries.payload(cluster=cluster)
+                if q.get("format", [""])[0] == "html":
+                    self._send(200,
+                               _timeseries_html(payload).encode("utf-8"),
+                               "text/html; charset=utf-8")
+                else:
+                    self._json(payload)
             elif route == "/flight":
                 self._json({"flight_records": recent_headers()})
             elif route == "/cluster":
@@ -641,8 +744,8 @@ class _Handler(BaseHTTPRequestHandler):
                                           "/jobs/<id>", "/profile",
                                           "/querylog", "/doctor",
                                           "/slo", "/trace",
-                                          "/trace/<id>", "/flight",
-                                          "/cluster"]})
+                                          "/trace/<id>", "/timeseries",
+                                          "/flight", "/cluster"]})
             else:
                 self._json({"error": "unknown endpoint"}, code=404)
         except BrokenPipeError:
@@ -694,6 +797,12 @@ class MetricsServer:
             self._thread.start()
             if self._spool is not None:
                 self._spool.start()
+            # the telemetry time machine rides the server lifecycle:
+            # each running server holds one ref on the process-global
+            # sampler; the thread stops when the last server stops
+            from . import timeseries
+
+            self._ts_ref = timeseries.ensure_sampler() is not None
         return self
 
     def stop(self) -> None:
@@ -707,6 +816,11 @@ class MetricsServer:
         if self._spool is not None:
             self._spool.stop()
             self._spool = None
+        if getattr(self, "_ts_ref", False):
+            from . import timeseries
+
+            self._ts_ref = False
+            timeseries.release_sampler()
 
     def __enter__(self) -> "MetricsServer":
         return self.start()

@@ -68,6 +68,11 @@ class AutoscaleConfig:
     drain_timeout_s: float = 30.0      # retire's in-flight wait bound
     slo_burn_up: float = 2.0           # fast-window SLO burn to arm
     #                                    scale-up (0 disables the signal)
+    forecast_up: float = 0.0           # forecast_occupancy level to arm
+    #                                    scale-up (the THIRD signal,
+    #                                    ISSUE 19; 0 disables it)
+    forecast_lead_s: float | None = None  # forecast horizon; None =
+    #                                    TPU_IR_SCALE_LEAD_S
 
     def resolved(self) -> "AutoscaleConfig":
         from dataclasses import replace
@@ -82,7 +87,10 @@ class AutoscaleConfig:
                           envvars.get_int("TPU_IR_SCALE_MAX_REPLICAS")),
             cooldown_s=(self.cooldown_s
                         if self.cooldown_s is not None else
-                        envvars.get_float("TPU_IR_SCALE_COOLDOWN_S")))
+                        envvars.get_float("TPU_IR_SCALE_COOLDOWN_S")),
+            forecast_lead_s=(self.forecast_lead_s
+                             if self.forecast_lead_s is not None else
+                             envvars.get_float("TPU_IR_SCALE_LEAD_S")))
 
 
 def autoscale_enabled(flag: bool | None = None) -> bool:
@@ -149,13 +157,24 @@ class Autoscaler:
 
         burn = disttrace.slo_burn_signal()
         burning = cfg.slo_burn_up > 0 and burn >= cfg.slo_burn_up
+        # the THIRD input signal (ISSUE 19): the telemetry time
+        # machine's diurnal fit. forecast_occupancy is PREDICTED
+        # occupancy forecast_lead_s in the future — arming on it starts
+        # growth one lead window before the burst instead of after the
+        # queue builds. The gauge is published every-tick current level
+        # when the fit fails its quality gate, so a broken forecast
+        # degrades to exactly the reactive signal
+        reg = get_registry()
+        reg.set_gauge("router.occupancy", occ)
+        fc = reg.gauges().get("forecast_occupancy", 0.0)
+        forecasting = cfg.forecast_up > 0 and fc >= cfg.forecast_up
         active = self.shardset.active_replicas()
         with self._lock:
             self._ticks += 1
             if len(self._samples) < 200_000:
                 self._samples.append((active, self.router.admission
                                       .in_flight()))
-            if occ >= cfg.up_occupancy or burning:
+            if occ >= cfg.up_occupancy or burning or forecasting:
                 self._ticks_over += 1
                 self._ticks_under = 0
             elif occ <= cfg.down_occupancy:
@@ -172,7 +191,8 @@ class Autoscaler:
             in_cooldown = now < self._cooldown_until
         decision = {"action": None, "reason": "steady",
                     "occupancy": round(occ, 3), "active": active,
-                    "slo_burn": round(burn, 3), "tick": self._ticks}
+                    "slo_burn": round(burn, 3),
+                    "forecast": round(fc, 3), "tick": self._ticks}
         if want == "up":
             if active >= cfg.max_replicas:
                 decision["reason"] = "at_max_replicas"
@@ -181,9 +201,14 @@ class Autoscaler:
                 decision["reason"] = "cooldown"
             else:
                 decision.update(self._scale_up(now))
-                if (decision["action"] == "up" and burning
-                        and occ < cfg.up_occupancy):
-                    decision["reason"] = "slo_burn"
+                if decision["action"] == "up" and occ < cfg.up_occupancy:
+                    # occupancy alone did not arm this: credit the
+                    # predictive signal first, then the burn signal
+                    if forecasting and not burning:
+                        decision["reason"] = "forecast"
+                        get_registry().incr("forecast.scaleups")
+                    elif burning:
+                        decision["reason"] = "slo_burn"
         elif want == "down":
             if active <= cfg.min_replicas:
                 decision["reason"] = "at_min_replicas"
@@ -332,5 +357,7 @@ class Autoscaler:
                 "sustain_up": cfg.sustain_up,
                 "sustain_down": cfg.sustain_down,
                 "slo_burn_up": cfg.slo_burn_up,
+                "forecast_up": cfg.forecast_up,
+                "forecast_lead_s": cfg.forecast_lead_s,
             },
         }

@@ -44,6 +44,13 @@ logger = logging.getLogger(__name__)
 DEFAULT_CHAOS_PLAN = ("score.hang:p=0.12:sleep=0.6,"
                       "score.device_loss:p=0.08,seed=1")
 
+# completed-fraction before the closed-loop autoscaler (and its
+# forecaster) starts ticking in the routed soak: the opening requests'
+# JIT warmup pins occupancy at the cap for a second or two, and that
+# transient is not load — scaling on it turns every A/B run into
+# "grew at t=0" regardless of the arrival wave
+_SCALE_WARMUP_FRAC = 0.05
+
 
 def make_queries(scorer, n: int, seed: int = 0,
                  workload=None) -> list[dict]:
@@ -558,8 +565,8 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
     mid-drain (the worst membership race: the drain handshake must
     settle as killed_mid_drain and the router's failover must keep
     conservation). `autoscale=True` (or an AutoscaleConfig) runs the
-    closed-loop Autoscaler instead, ticked from the chaos controller
-    thread so decisions interleave with kills and swaps. Either way the
+    closed-loop Autoscaler instead, ticked on its own controller loop
+    (a blocking grow must not stall crest recording). Either way the
     report gains a `scale` section (membership epoch, events, drain
     handshakes, mean active replicas, overprovision_fraction) and a
     top-level `burst_p99_ms` — the p99 of served latency during the
@@ -699,18 +706,47 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                              up_occupancy=0.6, down_occupancy=0.15,
                              sustain_up=3, sustain_down=25,
                              drain_timeout_s=15.0))
-                # ticked from the chaos controller's own loop (no owned
-                # thread): scaling decisions interleave deterministically
-                # with the kill/respawn/swap schedule at the same 20ms
-                # cadence
+                # ticked from its own scaler_loop thread at the same
+                # 20ms cadence as the chaos controller — tick() blocks
+                # through grow() (a full worker spawn), and that block
+                # must not stall crest recording or forecaster refits
                 scaler = Autoscaler(shardset, router, a_cfg)
+            # the predictive arm (ISSUE 19): when the config arms the
+            # forecast signal, the controller also drives the telemetry
+            # time machine — sampling the occupancy gauge the scaler
+            # publishes and refitting the diurnal sinusoid, so
+            # forecast_occupancy leads the burst instead of following it
+            forecaster = None
+            if scaler is not None and scaler.config.forecast_up > 0:
+                from ..obs import timeseries
+
+                if timeseries.enabled():
+                    # refit at lead/8 (not the live-serving lead/4):
+                    # the scripted wave is minutes, not hours, and the
+                    # fit must lock inside the first rising edge
+                    forecaster = timeseries.Forecaster(
+                        timeseries.get_store(),
+                        lead_s=scaler.config.forecast_lead_s,
+                        sample=True)
+                    forecaster.interval_s = max(
+                        0.05, forecaster.lead_s / 8.0)
             try:
                 # -- chaos + upgrade controller -----------------------
                 killed: list = []
                 swap_state = {"done_at": None, "result": None}
                 swap_complete = threading.Event()
-                scale_state: dict = {"drains": [], "samples": []}
+                scale_state: dict = {"drains": [], "samples": [],
+                                     "peaks": {}}
                 drain_threads: list = []
+                # arrival-density crests of the diurnal pacing wave:
+                # pacing_scale is minimal (arrivals densest) where the
+                # trough-phased wave peaks, i.e. frac = (k + 1/2) / C
+                _crest_fracs: list = []
+                if wl is not None and getattr(wl, "burst", 0.0) > 0:
+                    from .workload import BURST_CYCLES
+
+                    _crest_fracs = [((k + 0.5) / BURST_CYCLES, k)
+                                    for k in range(int(BURST_CYCLES))]
 
                 def _retire(s_: int, r_: int) -> None:
                     try:
@@ -831,8 +867,21 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                                     swap_complete.set()
                             if scale_plan:
                                 _scripted_scale(frac, fired)
-                            if scaler is not None:
-                                scaler.tick()
+                            if forecaster is not None \
+                                    and frac >= _SCALE_WARMUP_FRAC:
+                                # fit over post-warmup windows only:
+                                # the first requests' JIT warmup spike
+                                # is not part of the diurnal wave
+                                forecaster.poll()
+                            # wall time of each diurnal crest (the
+                            # pacing sinusoid peaks at frac (k+1/4)/C)
+                            # — the reference the scale-up lead is
+                            # measured against
+                            for pf, _ in _crest_fracs:
+                                if frac >= pf and pf not in \
+                                        scale_state["peaks"]:
+                                    scale_state["peaks"][pf] = \
+                                        time.perf_counter()
                         except Exception:  # noqa: BLE001 — chaos must
                             logger.exception("chaos controller")  # not
                         # the provisioned-vs-demand series behind
@@ -848,9 +897,47 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
                         except Exception:  # noqa: BLE001
                             logger.exception("post-soak respawn")
 
+                def scaler_loop():
+                    # the autoscaler ticks on its OWN loop: a scale-up
+                    # blocks inside grow() for a full worker spawn (tens
+                    # of seconds), and that block must not starve the
+                    # chaos controller's crest recording or the
+                    # forecaster's refits. first_up is stamped at tick
+                    # START — the decision instant — not after the grow
+                    # returns
+                    while not completed.is_set():
+                        with progress_lock:
+                            frac = progress[0] / max(len(reqs), 1)
+                        if frac < _SCALE_WARMUP_FRAC:
+                            # the opening requests' JIT warmup inflates
+                            # occupancy for a second or two — real
+                            # pressure sustains past it, the transient
+                            # must not trigger a spurious grow
+                            completed.wait(0.02)
+                            continue
+                        t_dec = time.perf_counter()
+                        try:
+                            dec = scaler.tick()
+                            if dec["action"] == "up" \
+                                    and "first_up" not in scale_state:
+                                # the A/B's timing datum: when (and on
+                                # which signal) growth started
+                                scale_state["first_up"] = (
+                                    t_dec, dec["reason"], frac)
+                        except Exception:  # noqa: BLE001 — a failed
+                            logger.exception("scaler tick")  # spawn
+                            # must not kill the control loop
+                        completed.wait(0.02)
+
                 ctrl = threading.Thread(target=chaos_controller,
                                         name="soak-chaos", daemon=True)
                 ctrl.start()
+                if scaler is not None:
+                    sctl = threading.Thread(target=scaler_loop,
+                                            name="soak-scaler",
+                                            daemon=True)
+                    sctl.start()
+                    drain_threads.append(sctl)
 
                 def worker(i: int, r: dict) -> None:
                     if pacing_s:
@@ -1114,6 +1201,23 @@ def run_distributed_soak(index_dir: str, *, shards: int = 2,
             }
             if scaler is not None:
                 report["scale"]["autoscaler"] = scaler.snapshot()
+            # the A/B timing readout (ISSUE 19): when growth started,
+            # on which signal, and how far ahead of the first diurnal
+            # crest it landed. forecast_lead_s > 0 means the fleet was
+            # growing BEFORE the burst peak; a reactive control fires
+            # at/after onset, so its lead hugs zero or goes negative
+            if scale_state.get("first_up"):
+                t_up, up_reason, up_frac = scale_state["first_up"]
+                report["scale"]["first_up_s"] = round(t_up - t0, 3)
+                report["scale"]["first_up_reason"] = up_reason
+                report["scale"]["first_up_frac"] = round(up_frac, 4)
+                peaks = scale_state["peaks"]
+                if peaks:
+                    first_peak = min(peaks.values())
+                    report["scale"]["first_peak_s"] = round(
+                        first_peak - t0, 3)
+                    report["scale"]["forecast_lead_s"] = round(
+                        first_peak - t_up, 3)
         if upgrade_at is not None:
             report["upgrade"] = {
                 "generation_a": gen_a,

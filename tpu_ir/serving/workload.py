@@ -167,7 +167,11 @@ class Workload:
         burst run's wall clock is comparable to its flat twin."""
         if self.burst <= 0.0:
             return 1.0
-        wave = 0.5 + 0.5 * math.sin(2.0 * math.pi * BURST_CYCLES
+        # phased to START at the trough (wave(0) = 0): the run opens
+        # calm and ramps into its first crest at frac (k+1/2)/C, so an
+        # autoscaler A/B over this schedule measures reaction to the
+        # WAVE, not to the thread-pool cold-start transient
+        wave = 0.5 - 0.5 * math.cos(2.0 * math.pi * BURST_CYCLES
                                     * float(frac))
         # wave=1 (peak) -> 1/(1+b); wave=0 (trough) -> 1+b... normalized
         # around 1: peak arrivals are (1+b)x denser than trough arrivals

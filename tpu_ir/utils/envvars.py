@@ -323,6 +323,26 @@ _declare("TPU_IR_SLO_P99_MS", "float", 250.0,
          "request for the sliding-window burn-rate tracker (/slo) and "
          "its trace is tail-kept; also the disttrace slow-keep "
          "threshold", "§24", minimum=1.0)
+_declare("TPU_IR_TIMESERIES", "bool", True,
+         "0 disables the telemetry time machine wholesale — no history "
+         "store, no background sampler, /timeseries reports disabled, "
+         "the anomaly detector and the forecast signal go dark; the "
+         "one-switch rollback for ISSUE 19", "§25")
+_declare("TPU_IR_TS_SAMPLE_S", "float", 10.0,
+         "seconds between background registry samples: tier-0 window "
+         "width, and with the fixed tier factors (x1/x6/x60) the whole "
+         "retention ladder — 10 s gives 1 h / 4 h / 24 h", "§25",
+         minimum=0.05)
+_declare("TPU_IR_TS_ANOMALY_Z", "float", 8.0,
+         "robust MAD z-score above which a curated series' newest point "
+         "is an anomaly (timeseries.anomaly counter + rate-limited "
+         "'anomaly' flight record); 0 disables the detector", "§25",
+         minimum=0.0)
+_declare("TPU_IR_SCALE_LEAD_S", "float", 30.0,
+         "the forecast horizon: the diurnal fit publishes predicted "
+         "occupancy this many seconds ahead as forecast_occupancy, so "
+         "a forecast-armed autoscaler starts growing one lead window "
+         "before the predicted burst", "§25", minimum=0.0)
 
 
 def _raw(name: str) -> str | None:
