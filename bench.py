@@ -1140,6 +1140,185 @@ def run_scaling(args, backend: str) -> int:
     return 0
 
 
+def _postings_bytes(index_dir: str) -> tuple[int, int]:
+    """(postings part bytes, whole index-dir bytes). The part files are
+    the compressible payload the ratio is judged on; the dir total says
+    what a worker actually rsyncs."""
+    from tpu_ir.index import format as fmt
+
+    meta = fmt.IndexMetadata.load(index_dir)
+    parts = sum(os.path.getsize(fmt.part_path(index_dir, s))
+                for s in range(meta.num_shards))
+    total = 0
+    for root, _dirs, files in os.walk(index_dir):
+        total += sum(os.path.getsize(os.path.join(root, f)) for f in files)
+    return parts, total
+
+
+def _measure_compress_variant(index_dir: str, n_queries: int,
+                              cpu: bool) -> tuple[dict, tuple]:
+    """One side of the --compress A/B: cold load with the load.* stage
+    split (serving cache removed first — the point is the from-disk
+    path), a true-restart warm load, and the batched BM25 top-10 rate
+    with block-max pruning on and off. Returns (row fields, a 64-query
+    (scores, docnos) parity sample taken with pruning on)."""
+    import jax
+
+    from tpu_ir.index import format as fmt
+    from tpu_ir.obs import get_registry
+    from tpu_ir.search import Scorer
+
+    meta = fmt.IndexMetadata.load(index_dir)
+    parts, total = _postings_bytes(index_dir)
+    out = {
+        "compressed": bool(getattr(meta, "compressed", False)),
+        "tf_dtype": getattr(meta, "tf_dtype", "int32"),
+        "tf_lossy": bool(getattr(meta, "tf_lossy", False)),
+        "index_bytes": parts,
+        "index_dir_bytes": total,
+        "bytes_per_doc": round(parts / meta.num_docs, 2),
+    }
+    shutil.rmtree(os.path.join(index_dir, "serving-tiered"),
+                  ignore_errors=True)
+    get_registry().snapshot(reset=True)
+    # arm the format layer's streamed-bytes meter: on a page-cached CPU
+    # container load_read_s barely moves (decode replaces disk wait), so
+    # the "reads shrink with the payload" claim is made on BYTES — the
+    # quantity that survives to machines where reads cost real time
+    fmt.reset_read_bytes()
+    t0 = time.perf_counter()
+    scorer = Scorer.load(index_dir, layout="auto")
+    jax.block_until_ready(serving_arrays(scorer))
+    out["scorer_load_cold_s"] = round(time.perf_counter() - t0, 2)
+    out["cold_read_bytes"] = int(sum(
+        fmt.read_bytes_streamed().values()))
+    fmt.reset_read_bytes(arm=False)
+    out.update(load_stage_breakdown())
+    out.update(_warm_load_subprocess(index_dir, cpu=cpu, attempts=1))
+    out.pop("warm_runs", None)
+
+    rng = np.random.default_rng(1)
+    q_ids = rng.integers(0, meta.vocab_size, size=(n_queries, 2)).astype(
+        np.int32)
+    parity = None
+    for bm, tag in (("1", "topk_qps_blockmax_on"),
+                    ("0", "topk_qps_blockmax_off")):
+        os.environ["TPU_IR_BLOCKMAX"] = bm
+        try:
+            scorer.topk(q_ids, k=10, scoring="bm25")  # compile
+            t0 = time.perf_counter()
+            scores, docnos = scorer.topk(q_ids, k=10, scoring="bm25")
+            out[tag] = round(n_queries / (time.perf_counter() - t0), 1)
+            if bm == "1":
+                parity = (np.asarray(scores[:64]), np.asarray(docnos[:64]))
+        finally:
+            os.environ.pop("TPU_IR_BLOCKMAX", None)
+    out["query_batch"] = n_queries
+    out["layout"] = scorer.layout
+    # decode/compress telemetry for this variant's loads + dispatches
+    # (zero on the raw side — the counters existing at 0 is the signal
+    # that the fused path never engaged)
+    for name, v in get_registry().snapshot()["counters"].items():
+        if name.startswith(("decode.", "compress.")):
+            out[name.replace(".", "_")] = int(v)
+    return out, parity
+
+
+def run_compress_ab(args, backend: str, streaming: bool) -> int:
+    """`--compress`: the ISSUE 20 A/B. Build ONE index at the config's
+    scale, measure it raw, migrate a copy to the compressed arena
+    (tpu-ir migrate-index --compress equivalent), measure that, and
+    append BOTH rows to BENCH_HISTORY.jsonl under per-variant configs
+    (compress_ab-<docs>d-raw / -compressed) so the bench-check sentry
+    gates index_bytes / bytes_per_doc / load_read_s / load_h2d_s per
+    variant. In-process acceptance: the postings payload must shrink
+    >= 2.5x, and lossless modes must serve the same top-10 (scores
+    compared as float32 BITS) as the raw index."""
+    from tpu_ir.index import format as fmt
+    from tpu_ir.index.migrate import migrate_index
+
+    n_queries = min(args.queries or 2_000, 2_000)
+    with tempfile.TemporaryDirectory() as tmp:
+        corpus = os.path.join(tmp, "corpus.trec")
+        make_corpus(corpus)
+        raw_dir = os.path.join(tmp, "index-raw")
+        t0 = time.perf_counter()
+        if streaming:
+            from tpu_ir.index.streaming import build_index_streaming
+
+            radix = (args.radix_buckets if args.radix_buckets is not None
+                     else 16)
+            build_index_streaming([corpus], raw_dir, k=1, chargram_ks=[],
+                                  num_shards=10, radix_buckets=radix,
+                                  tokenize_procs=args.tokenize_procs)
+        else:
+            from tpu_ir.index import build_index
+
+            build_index([corpus], raw_dir, k=1, chargram_ks=[],
+                        num_shards=10, compute_chargrams=False)
+        build_s = time.perf_counter() - t0
+
+        raw_row, raw_parity = _measure_compress_variant(
+            raw_dir, n_queries, args.cpu)
+
+        comp_dir = os.path.join(tmp, "index-comp")
+        shutil.copytree(raw_dir, comp_dir)
+        shutil.rmtree(os.path.join(comp_dir, "serving-tiered"),
+                      ignore_errors=True)
+        t0 = time.perf_counter()
+        migrate_index(comp_dir, to_version=fmt.COMPRESSED_FORMAT_VERSION,
+                      tf_dtype=args.tf_dtype)
+        migrate_s = time.perf_counter() - t0
+        comp_row, comp_parity = _measure_compress_variant(
+            comp_dir, n_queries, args.cpu)
+
+        ratio = round(raw_row["index_bytes"]
+                      / max(comp_row["index_bytes"], 1), 2)
+        if comp_row["tf_lossy"]:
+            parity = "skipped (lossy int8)"
+        else:
+            s_r, d_r = raw_parity
+            s_c, d_c = comp_parity
+            bad = int((d_r != d_c).any(axis=1).sum()
+                      + (s_r.astype(np.float32).view(np.uint32)
+                         != s_c.astype(np.float32).view(np.uint32))
+                      .any(axis=1).sum())
+            parity = "ok" if bad == 0 else f"{bad} queries differ"
+        common = {
+            "metric": "compress_ab",
+            "backend": backend,
+            "num_docs": DOC_COUNT,
+            "build_s": round(build_s, 2),
+            "compress_ratio": ratio,
+            "serving_parity": parity,
+        }
+        raw_row = {**common,
+                   "config": f"compress_ab-{DOC_COUNT}d-raw", **raw_row}
+        comp_row = {**common,
+                    "config": f"compress_ab-{DOC_COUNT}d-compressed",
+                    "migrate_s": round(migrate_s, 2),
+                    "raw_index_bytes": raw_row["index_bytes"], **comp_row}
+        for row in (raw_row, comp_row):
+            _append_history(row)
+            print(json.dumps(row))
+    bad = []
+    if ratio < 2.5:
+        bad.append(f"compression ratio {ratio} below the 2.5x floor")
+    if (comp_row["cold_read_bytes"] * 2.0
+            > raw_row["cold_read_bytes"]):
+        bad.append(
+            f"cold-load bytes read did not drop with the payload: "
+            f"{comp_row['cold_read_bytes']} vs raw "
+            f"{raw_row['cold_read_bytes']}")
+    if parity not in ("ok", "skipped (lossy int8)"):
+        bad.append(f"raw-vs-compressed serving parity broke: {parity}")
+    if bad:
+        print("bench --compress FAILED: " + "; ".join(bad),
+              file=sys.stderr)
+        return 1
+    return 0
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--cpu", action="store_true",
@@ -1153,6 +1332,20 @@ def main() -> int:
     ap.add_argument("--no-controls", action="store_true",
                     help="skip the transport probe, device-only build "
                          "control, and CPU control subprocess")
+    ap.add_argument("--compress", action="store_true",
+                    help="compressed-arena A/B (ISSUE 20): build one "
+                         "index at the config's scale, measure raw, "
+                         "migrate a copy to the compressed arena, "
+                         "measure again, and append a raw/compressed "
+                         "row PAIR (index_bytes, bytes_per_doc, "
+                         "cold/warm load stage split, BM25 top-10 QPS "
+                         "with block-max on/off) to BENCH_HISTORY.jsonl; "
+                         "fails unless the postings shrink >= 2.5x and "
+                         "lossless modes serve bit-identical top-10")
+    ap.add_argument("--tf-dtype", choices=["int8", "bf16"], default=None,
+                    help="tf quantization for --compress (default: auto "
+                         "= int8 when lossless for this index, else "
+                         "bf16)")
     ap.add_argument("--scaling", default=None, metavar="DOCS[,DOCS...]",
                     help="per-phase build scaling sweep: for each docs "
                          "count, synthesize a proportional corpus, run "
@@ -1210,6 +1403,9 @@ def main() -> int:
 
     if args.scaling:
         return run_scaling(args, backend)
+
+    if args.compress:
+        return run_compress_ab(args, backend, streaming)
 
     if args.config == "msmarco":
         out = run_msmarco(args)

@@ -1,0 +1,542 @@
+"""Compressed quantized arena acceptance suite (ISSUE 20): codec fuzz
+round-trips, `migrate-index --compress/--decompress` (byte-identical
+rollback, idempotence, SIGKILL-mid-migrate), raw-vs-compressed serving
+bit-parity across scoring modes and block-max regimes, the memory-lean
+doc-range decode, the v7 serving-cache key (section-dtype signature),
+lossy-int8 loudness, and the doctor/verify compression readouts."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import tpu_ir.faults as faults
+from tpu_ir.cli import main
+from tpu_ir.index import build_index
+from tpu_ir.index import compress as comp
+from tpu_ir.index import format as fmt
+from tpu_ir.index.migrate import migrate_index
+from tpu_ir.index.verify import verify_index
+from tpu_ir.search import Scorer
+from tpu_ir.utils.report import recovery_counters
+
+WORDS = ("salmon fishing river bears honey quick brown fox lazy dog "
+         "market investor asset bond stock season rain forest".split())
+
+QUERIES = ("salmon fishing", "honey bears river", "stock market asset",
+           "quick brown fox", "rain")
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    faults.clear()
+    recovery_counters().reset()
+    fmt.reset_read_bytes()
+    yield
+    faults.clear()
+    recovery_counters().reset()
+    fmt.reset_read_bytes(arm=False)
+
+
+def write_corpus(path, n_docs=90):
+    body = []
+    for i in range(n_docs):
+        text = " ".join(WORDS[(i + j) % len(WORDS)]
+                        for j in range(3 + (i % 7)))
+        body.append(f"<DOC>\n<DOCNO> D-{i:04d} </DOCNO>\n<TEXT>\n"
+                    f"{text}\n</TEXT>\n</DOC>\n")
+    path.write_text("".join(body))
+    return str(path)
+
+
+def build(corpus, out):
+    build_index([corpus], out, k=1, num_shards=3,
+                compute_chargrams=False)
+
+
+def results(idx, layout="sparse", scoring="tfidf"):
+    s = Scorer.load(idx, layout=layout)
+    return [s.search(q, k=10, scoring=scoring) for q in QUERIES]
+
+
+def assert_bit_identical(a, b, ctx=""):
+    """Same docnos in the same order AND the same float32 score BITS."""
+    for qa, qb in zip(a, b):
+        assert [r[0] for r in qa] == [r[0] for r in qb], ctx
+        sa = np.array([r[1] for r in qa], np.float32)
+        sb = np.array([r[1] for r in qb], np.float32)
+        assert sa.tobytes() == sb.tobytes(), ctx
+
+
+def random_shard(rng, *, terms=30, num_docs=3000, max_tf=9):
+    """A raw shard dict in the builders' canonical impact order."""
+    term_ids, df_l, docs_l, tfs_l = [], [], [], []
+    for t in range(terms):
+        n = int(rng.integers(1, min(num_docs, 200)))
+        d = np.sort(rng.choice(np.arange(1, num_docs + 1), size=n,
+                               replace=False))
+        tf = rng.integers(1, max_tf + 1, size=n)
+        order = np.lexsort((d, -tf))
+        term_ids.append(t * 3)
+        df_l.append(n)
+        docs_l.append(d[order])
+        tfs_l.append(tf[order])
+    df = np.array(df_l, np.int64)
+    return {
+        "term_ids": np.array(term_ids, np.int32),
+        "df": df.astype(np.int32),
+        "indptr": np.concatenate([[0], np.cumsum(df)]).astype(np.int64),
+        "pair_doc": np.concatenate(docs_l).astype(np.int32),
+        "pair_tf": np.concatenate(tfs_l).astype(np.int32),
+    }
+
+
+# ---------------------------------------------------------------------------
+# codec unit behavior
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("tf_dtype", ["int8", "bf16"])
+def test_codec_fuzz_roundtrip(seed, tf_dtype):
+    """encode -> decode reproduces the raw arrays byte-for-byte (values
+    AND dtypes), across random shapes, both lossless tf modes, and
+    block widths that do / don't divide the doc axis."""
+    rng = np.random.default_rng(seed)
+    z = random_shard(rng, terms=10 + seed * 7,
+                     num_docs=500 + seed * 777)
+    enc = comp.encode_shard(z, num_docs=500 + seed * 777,
+                            tf_dtype=tf_dtype,
+                            block_width=64 if seed % 2 else None)
+    assert comp.is_compressed(enc)
+    dec = comp.decode_shard(enc)
+    for k in ("term_ids", "df", "indptr", "pair_doc", "pair_tf"):
+        assert np.asarray(dec[k]).dtype == z[k].dtype, k
+        assert np.array_equal(dec[k], z[k]), k
+    info = comp.shard_info(enc)
+    assert not info["tf_lossy"]
+
+
+def test_codec_refuses_noncanonical_order():
+    rng = np.random.default_rng(9)
+    z = random_shard(rng)
+    bad = dict(z, pair_doc=z["pair_doc"][::-1].copy())
+    with pytest.raises(comp.CompressError):
+        comp.encode_shard(bad, num_docs=3000)
+
+
+def test_lossy_int8_floor_quantizes_rank_safe():
+    """>256 distinct tfs under int8: served tf stays in (0, raw tf]
+    (block-max bounds remain upper bounds), per-term order stays
+    canonical wrt the QUANTIZED tfs, and the shard is stamped lossy."""
+    rng = np.random.default_rng(3)
+    z = random_shard(rng, terms=40, num_docs=5000, max_tf=2000)
+    enc = comp.encode_shard(z, num_docs=5000, tf_dtype="int8")
+    info = comp.shard_info(enc)
+    assert info["tf_lossy"]
+    dec = comp.decode_shard(enc)
+    assert np.array_equal(dec["df"], z["df"])
+    ip = z["indptr"]
+    qd, qt = dec["pair_doc"], dec["pair_tf"]
+    assert len(np.unique(qt)) <= 256
+    for i in range(len(z["df"])):
+        lo, hi = ip[i], ip[i + 1]
+        assert set(qd[lo:hi].tolist()) == set(
+            z["pair_doc"][lo:hi].tolist())
+        raw = dict(zip(z["pair_doc"][lo:hi].tolist(),
+                       z["pair_tf"][lo:hi].tolist()))
+        for d_, q_ in zip(qd[lo:hi].tolist(), qt[lo:hi].tolist()):
+            assert 0 < q_ <= raw[d_]
+        seg_tf, seg_doc = qt[lo:hi], qd[lo:hi]
+        assert (np.diff(seg_tf) <= 0).all()
+        ties = np.diff(seg_tf) == 0
+        assert (np.diff(seg_doc)[ties] > 0).all()
+
+
+def test_doc_range_decode_skips_payload():
+    """Lean decode: out-of-range grid groups come back as (0, 0) dead
+    slots WITHOUT their payload bytes being counted, and in-range
+    postings are byte-identical to the full decode."""
+    rng = np.random.default_rng(5)
+    num_docs = 4000
+    # DENSE terms: grid groups must win over flat runs for block
+    # skipping to exist at all (sparse random terms go flat)
+    term_ids, df_l, docs_l, tfs_l = [], [], [], []
+    for t in range(20):
+        n = int(rng.integers(1000, 3500))
+        d = np.sort(rng.choice(np.arange(1, num_docs + 1), size=n,
+                               replace=False))
+        tf = rng.integers(1, 10, size=n)
+        order = np.lexsort((d, -tf))
+        term_ids.append(t)
+        df_l.append(n)
+        docs_l.append(d[order])
+        tfs_l.append(tf[order])
+    dfa = np.array(df_l, np.int64)
+    z = {
+        "term_ids": np.array(term_ids, np.int32),
+        "df": dfa.astype(np.int32),
+        "indptr": np.concatenate([[0], np.cumsum(dfa)]).astype(np.int64),
+        "pair_doc": np.concatenate(docs_l).astype(np.int32),
+        "pair_tf": np.concatenate(tfs_l).astype(np.int32),
+    }
+    enc = comp.encode_shard(z, num_docs=num_docs, block_width=64)
+    full = comp.decode_shard(enc)
+    from tpu_ir.obs import get_registry
+
+    reg = get_registry()
+    before = reg.get("decode.bytes")
+    lo, hi = 1, 200  # half-open, ~5% of the doc axis
+    lean = comp.decode_shard(enc, doc_range=(lo, hi))
+    touched = reg.get("decode.bytes") - before
+    skipped = reg.get("decode.bytes_skipped")
+    assert skipped > touched  # most payload never read
+    # dead slots re-sort to their term runs' ends, so positions shift
+    # vs the full decode — the contract is on the (term, doc, tf)
+    # TRIPLES: every in-range triple survives exactly, out-of-range
+    # postings are dead (0, 0) slots or rode along exactly in a
+    # straddling/flat group
+    term_rep = np.repeat(np.arange(len(z["df"])), z["df"])
+
+    def triples(d):
+        m = (d["pair_doc"] >= lo) & (d["pair_doc"] < hi)
+        t = np.stack([term_rep[m], d["pair_doc"][m],
+                      d["pair_tf"][m]], axis=1)
+        return t[np.lexsort(t.T[::-1])]
+
+    assert np.array_equal(triples(lean), triples(full))
+    out = (lean["pair_doc"] < lo) | (lean["pair_doc"] >= hi)
+    dead = out & (lean["pair_tf"] == 0) & (lean["pair_doc"] == 0)
+    ride = out & ~dead
+    # ride-along postings carry their exact raw values (check against
+    # the full decode's triples for the same docs)
+    fmap = {(int(a), int(b)): int(c) for a, b, c in zip(
+        term_rep, full["pair_doc"], full["pair_tf"])}
+    for t_, d_, v_ in zip(term_rep[ride], lean["pair_doc"][ride],
+                          lean["pair_tf"][ride]):
+        assert fmap[(int(t_), int(d_))] == int(v_)
+    assert np.array_equal(lean["df"], full["df"])
+
+
+# ---------------------------------------------------------------------------
+# migrate: roundtrip, idempotence, crash, corruption
+# ---------------------------------------------------------------------------
+
+
+def part_bytes(idx, meta):
+    return {s: open(fmt.part_path(idx, s), "rb").read()
+            for s in range(meta.num_shards)}
+
+
+def test_migrate_compress_roundtrip_byte_identical(tmp_path):
+    idx = str(tmp_path / "idx")
+    build(write_corpus(tmp_path / "c.trec"), idx)
+    meta = fmt.IndexMetadata.load(idx)
+    raw = part_bytes(idx, meta)
+    raw_results = results(idx)
+
+    r = migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION)
+    assert r["ok"] and r["migrated"] == meta.num_shards
+    meta2 = fmt.IndexMetadata.load(idx)
+    assert meta2.format_version == fmt.COMPRESSED_FORMAT_VERSION
+    assert meta2.compressed and not meta2.tf_lossy
+    assert verify_index(idx)["ok"]
+    assert_bit_identical(results(idx), raw_results, "compressed serve")
+
+    # idempotent: a second run rewrites nothing
+    r2 = migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION)
+    assert r2["migrated"] == 0 and r2["skipped"] == meta.num_shards
+
+    # rollback restores the raw parts BYTE-identically
+    r3 = migrate_index(idx, to_version=fmt.ARENA_FORMAT_VERSION)
+    assert r3["ok"]
+    meta3 = fmt.IndexMetadata.load(idx)
+    assert meta3.format_version == fmt.ARENA_FORMAT_VERSION
+    assert part_bytes(idx, meta3) == raw
+    assert verify_index(idx)["ok"]
+
+
+def test_migrate_sigkill_mid_compress_leaves_verifiable_dir(
+        tmp_path, monkeypatch):
+    """A crash after shard 0's twin swap leaves a MIXED dir that still
+    loads, verifies, and serves; the doctor says 'mixed'; a re-run
+    completes the migration (skipping the finished shard)."""
+    idx = str(tmp_path / "idx")
+    build(write_corpus(tmp_path / "c.trec"), idx)
+    raw_results = results(idx)
+
+    real = fmt.save_shard
+    calls = {"n": 0}
+
+    def dying_save(*a, **kw):
+        out = real(*a, **kw)
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise KeyboardInterrupt  # the SIGKILL stand-in: post-rename
+        return out
+
+    monkeypatch.setattr(fmt, "save_shard", dying_save)
+    with pytest.raises(KeyboardInterrupt):
+        migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION)
+    monkeypatch.setattr(fmt, "save_shard", real)
+
+    # metadata was never rewritten: it still says v2, checksums still
+    # name the surviving raw parts; the swapped shard is a valid arena
+    meta = fmt.IndexMetadata.load(idx)
+    assert meta.format_version == fmt.ARENA_FORMAT_VERSION
+    from tpu_ir.index.doctor import doctor_report
+
+    rep = doctor_report(idx)
+    compn = rep["compression"]
+    assert compn["compressed_shards"] == 1
+    assert compn["raw_shards"] == meta.num_shards - 1
+    assert any("mixed shard formats" in w for w in rep["warnings"])
+    assert_bit_identical(results(idx), raw_results, "mixed dir serve")
+
+    r = migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION)
+    assert r["ok"] and r["skipped"] == 1
+    assert r["migrated"] == meta.num_shards - 1
+    assert verify_index(idx)["ok"]
+    assert_bit_identical(results(idx), raw_results, "completed migrate")
+
+
+def test_corrupt_compressed_part_raises_loud_integrity_error(tmp_path):
+    """Payload corruption in a compressed part surfaces as ONE
+    structured IntegrityError naming the file — on verify and on the
+    verified serving load (postings are DATA: no silent fallback)."""
+    idx = str(tmp_path / "idx")
+    build(write_corpus(tmp_path / "c.trec"), idx)
+    migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION)
+    path = fmt.part_path(idx, 0)
+    blob = bytearray(open(path, "rb").read())
+    blob[-64] ^= 0xFF  # deep in the last section's payload
+    open(path, "wb").write(bytes(blob))
+    with pytest.raises(faults.IntegrityError) as ei:
+        verify_index(idx)
+    assert os.path.basename(path) in str(ei.value)
+    with pytest.raises(faults.IntegrityError):
+        Scorer.load(idx, layout="sparse", verify_integrity=True)
+
+
+def test_corrupt_blockmax_on_compressed_quarantines_and_recomputes(
+        tmp_path):
+    """Derived data keeps the quarantine-and-recompute contract on a
+    compressed index: a corrupt bounds artifact is quarantined and the
+    bounds are recomputed from the DECODED postings — serving results
+    stay bit-identical to the raw index."""
+    idx = str(tmp_path / "idx")
+    build(write_corpus(tmp_path / "c.trec"), idx)
+    raw_results = results(idx)
+    migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION)
+    bpath = os.path.join(idx, "blockmax.arena")
+    blob = bytearray(open(bpath, "rb").read())
+    blob[len(blob) // 2] ^= 0xFF
+    open(bpath, "wb").write(bytes(blob))
+    # no serving cache in the way: force the eager path to see the rot
+    import shutil
+
+    shutil.rmtree(os.path.join(idx, "serving-tiered"),
+                  ignore_errors=True)
+    got = results(idx)
+    assert os.path.exists(os.path.join(idx, fmt.QUARANTINE_DIR,
+                                       "blockmax.arena"))
+    assert recovery_counters().snapshot()["integrity_failures"] >= 1
+    assert_bit_identical(got, raw_results, "recomputed bounds")
+
+
+# ---------------------------------------------------------------------------
+# serving parity matrix + the quantized strip
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("scoring", ["tfidf", "bm25"])
+@pytest.mark.parametrize("blockmax", ["0", "1"])
+def test_serving_parity_compress_on_off(tmp_path, monkeypatch, scoring,
+                                        blockmax):
+    """The dual-path contract: TPU_IR_COMPRESS on/off serves the same
+    docids and the same float32 score BITS, with block-max pruning on
+    and off (pruning composes with decode: blocks below tau are
+    skipped BEFORE decode)."""
+    corpus = write_corpus(tmp_path / "c.trec")
+    raw_idx = str(tmp_path / "raw")
+    cmp_idx = str(tmp_path / "cmp")
+    build(corpus, raw_idx)
+    monkeypatch.setenv("TPU_IR_COMPRESS", "1")
+    build(corpus, cmp_idx)
+    assert fmt.IndexMetadata.load(cmp_idx).compressed
+    monkeypatch.setenv("TPU_IR_BLOCKMAX", blockmax)
+    got = results(cmp_idx, scoring=scoring)
+    monkeypatch.setenv("TPU_IR_COMPRESS", "0")
+    want = results(raw_idx, scoring=scoring)
+    assert_bit_identical(got, want, f"{scoring}/blockmax={blockmax}")
+
+
+def test_bf16_strip_engages_and_stays_bit_exact(tmp_path, monkeypatch):
+    """On a compressed index the resident hot strip is bf16 (every tf
+    <= 256 round-trips exactly) and the pre-weighted strip cache is
+    built from the widened copy — fp32, bit-identical to raw's."""
+    import jax.numpy as jnp
+
+    corpus = write_corpus(tmp_path / "c.trec")
+    cmp_idx = str(tmp_path / "cmp")
+    monkeypatch.setenv("TPU_IR_COMPRESS", "1")
+    build(corpus, cmp_idx)
+    s = Scorer.load(cmp_idx, layout="sparse")
+    assert s.hot_tfs.dtype == jnp.bfloat16
+    ws = s._hot_wstrip("tfidf")
+    if ws is not None:  # budget-dependent; when cached it must be f32
+        assert ws.dtype == jnp.float32
+    monkeypatch.delenv("TPU_IR_COMPRESS")
+    raw_idx = str(tmp_path / "raw")
+    build(corpus, raw_idx)
+    s2 = Scorer.load(raw_idx, layout="sparse")
+    assert s2.hot_tfs.dtype == jnp.float32
+
+
+def test_doc_range_worker_lean_load_bit_parity(tmp_path, monkeypatch):
+    """A doc-range worker on a compressed index decodes only blocks
+    intersecting its range (decode.bytes shrinks) and scores in-range
+    docs bit-identically to the unrestricted scorer."""
+    corpus = write_corpus(tmp_path / "c.trec", n_docs=400)
+    idx = str(tmp_path / "idx")
+    monkeypatch.setenv("TPU_IR_COMPRESS", "1")
+    monkeypatch.setenv("TPU_IR_BLOCKMAX_WIDTH", "64")
+    build(corpus, idx)
+    from tpu_ir.obs import get_registry
+
+    reg = get_registry()
+    before_dec = reg.get("decode.bytes")
+    worker = Scorer.load(idx, layout="sparse", doc_range=(1, 80))
+    touched = reg.get("decode.bytes") - before_dec
+    assert reg.get("decode.blocks_skipped") > 0
+    full = Scorer.load(idx, layout="sparse")
+    for q in QUERIES:
+        w = {r[0]: r[1] for r in worker.search(q, k=50)}
+        f = {r[0]: r[1] for r in full.search(q, k=400)}
+        for docno, score in w.items():
+            assert np.float32(score).tobytes() == \
+                np.float32(f[docno]).tobytes(), (q, docno)
+    # the lean load really read less payload than the later full one
+    assert reg.get("decode.bytes_skipped") > 0
+    assert touched < reg.get("decode.bytes") - before_dec
+
+
+# ---------------------------------------------------------------------------
+# serving cache key v7: the revalidation blind spot (satellite 2)
+# ---------------------------------------------------------------------------
+
+
+def test_cache_misses_after_mtime_preserving_compress(tmp_path):
+    """A serving cache written on the RAW index must MISS after
+    `migrate-index --compress`, even when the migration preserves the
+    old part mtimes — the v6 blind spot this PR closes by folding the
+    section-dtype signature (and format/tf metadata) into the key."""
+    from tpu_ir.search.layout import load_serving_cache
+
+    idx = str(tmp_path / "idx")
+    build(write_corpus(tmp_path / "c.trec"), idx)
+    meta = fmt.IndexMetadata.load(idx)
+    Scorer.load(idx, layout="sparse")  # writes serving-tiered/
+    assert load_serving_cache(idx, meta=meta) is not None
+    old_stats = {s: os.stat(fmt.part_path(idx, s))
+                 for s in range(meta.num_shards)}
+    raw_results = results(idx)
+
+    migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION)
+    for s in range(meta.num_shards):
+        st = old_stats[s]
+        os.utime(fmt.part_path(idx, s),
+                 ns=(st.st_atime_ns, st.st_mtime_ns))
+    meta2 = fmt.IndexMetadata.load(idx)
+    assert load_serving_cache(idx, meta=meta2) is None
+    assert_bit_identical(results(idx), raw_results,
+                         "post-migrate serve")
+
+
+def test_cache_key_carries_section_dtype_signature(tmp_path):
+    """Unit pin for the v7 key: identical injected part digests still
+    yield DIFFERENT keys when the parts' section dtypes differ (int8
+    vs bf16 tf encodings) — the stat fast path rebuilds the key from
+    recorded digests, so only a fresh-from-disk field can catch an
+    interpretation flip."""
+    from tpu_ir.search.layout import _serving_cache_key
+
+    idx = str(tmp_path / "idx")
+    build(write_corpus(tmp_path / "c.trec"), idx)
+    meta = fmt.IndexMetadata.load(idx)
+    migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION,
+                  tf_dtype="int8")
+    crcs = {os.path.basename(fmt.part_path(idx, s)): "crc32:00000000"
+            for s in range(meta.num_shards)}
+    m1 = fmt.IndexMetadata.load(idx)
+    k1 = _serving_cache_key(idx, m1, 1, 1, 1, part_crcs=crcs)
+    migrate_index(idx, to_version=fmt.ARENA_FORMAT_VERSION)
+    migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION,
+                  tf_dtype="bf16")
+    m2 = fmt.IndexMetadata.load(idx)
+    k2 = _serving_cache_key(idx, m2, 1, 1, 1, part_crcs=crcs)
+    # digests injected equal: the CRC column alone cannot distinguish
+    # the two encodings on the stat fast path (it is rebuilt from the
+    # manifest's recorded digests) — only the fresh-from-disk fields can
+    assert [f[2] for f in k1["part_files"]] == \
+        [f[2] for f in k2["part_files"]]
+    assert k1["section_dtypes"] != k2["section_dtypes"]
+    assert k1 != k2
+
+
+# ---------------------------------------------------------------------------
+# CLI + doctor + verify loudness
+# ---------------------------------------------------------------------------
+
+
+def test_cli_migrate_compress_doctor_decompress(tmp_path, capsys):
+    idx = str(tmp_path / "idx")
+    build(write_corpus(tmp_path / "c.trec"), idx)
+
+    assert main(["migrate-index", idx, "--compress"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["format_version"] == \
+        fmt.COMPRESSED_FORMAT_VERSION
+    assert out["tf_dtype"] in ("int8", "bf16")
+
+    assert main(["doctor", idx]) == 0
+    rep = json.loads(capsys.readouterr().out.strip())
+    compn = rep["compression"]
+    assert compn["compressed_shards"] == rep["metadata"]["num_shards"]
+    assert compn["ratio"] is not None
+    assert compn["bytes_per_doc"] > 0
+    assert "projected_worker_hbm_bytes" in compn
+
+    assert main(["verify", idx]) == 0
+    v = json.loads(capsys.readouterr().out.strip())
+    assert v["ok"] and v["compressed"] and not v["tf_lossy"]
+
+    assert main(["migrate-index", idx, "--decompress"]) == 0
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["ok"] and out["format_version"] == \
+        fmt.ARENA_FORMAT_VERSION
+
+    # --compress and --decompress are mutually exclusive: exit 2
+    assert main(["migrate-index", idx, "--compress",
+                 "--decompress"]) == 2
+    capsys.readouterr()
+
+
+def test_verify_loud_on_lossy(tmp_path, monkeypatch):
+    """A hand-built lossy index verifies (structure intact) but the
+    report carries the lossy warning; tf-mass conservation is skipped,
+    not silently passed."""
+    idx = str(tmp_path / "idx")
+    build(write_corpus(tmp_path / "c.trec"), idx)
+    migrate_index(idx, to_version=fmt.COMPRESSED_FORMAT_VERSION)
+    meta = fmt.IndexMetadata.load(idx)
+    meta.tf_lossy = True  # the stamp a lossy int8 migration leaves
+    meta.save_with_checksums(idx, compress=False)
+    v = verify_index(idx)
+    assert v["ok"] and v["tf_lossy"]
+    assert "lossy" in v["tf_lossy_warning"]
+    from tpu_ir.index.doctor import doctor_report
+
+    rep = doctor_report(idx)
+    assert any("LOSSY" in w for w in rep["warnings"])

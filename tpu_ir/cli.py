@@ -694,14 +694,25 @@ def cmd_generations(args) -> int:
 
 def cmd_migrate_index(args) -> int:
     """Convert a built index's part shards between artifact formats in
-    place (v1 npz <-> v2 arenas; index/migrate.py): verify-while-read
-    from the old copies, atomic rename per shard, checksums re-recorded,
-    metadata.format_version stamped last. Idempotent — re-running
-    finishes an interrupted migration."""
+    place (v1 npz <-> v2 arenas <-> v3 compressed; index/migrate.py):
+    verify-while-read from the old copies, atomic rename per shard,
+    checksums re-recorded, metadata.format_version stamped last.
+    Idempotent — re-running finishes an interrupted migration.
+    `--compress` / `--decompress` are the v3 spellings (RUNBOOK §26)."""
     from .index.migrate import migrate_index
 
-    print(json.dumps(migrate_index(args.index_dir, to_version=args.to,
-                                   add_bounds=args.add_bounds)))
+    to = args.to
+    if args.compress and args.decompress:
+        print(json.dumps({"error": "--compress and --decompress are "
+                                   "mutually exclusive"}))
+        return 2
+    if args.compress:
+        to = 3
+    elif args.decompress:
+        to = 2
+    print(json.dumps(migrate_index(args.index_dir, to_version=to,
+                                   add_bounds=args.add_bounds,
+                                   tf_dtype=args.tf_dtype)))
     return 0
 
 
@@ -1926,12 +1937,25 @@ def main(argv: list[str] | None = None) -> int:
     pmi = sub.add_parser(
         "migrate-index",
         help="convert part shards between artifact formats in place "
-             "(npz v1 <-> arena v2; atomic per shard, checksums "
-             "re-recorded, idempotent)")
+             "(npz v1 <-> arena v2 <-> compressed v3; atomic per shard, "
+             "checksums re-recorded, idempotent)")
     pmi.add_argument("index_dir")
-    pmi.add_argument("--to", type=int, choices=[1, 2], default=2,
-                     help="target format_version (2 = zero-copy arenas, "
-                          "1 = npz rollback)")
+    pmi.add_argument("--to", type=int, choices=[1, 2, 3], default=2,
+                     help="target format_version (3 = compressed arenas, "
+                          "2 = zero-copy arenas, 1 = npz rollback)")
+    pmi.add_argument("--compress", action="store_true",
+                     help="shorthand for --to 3: bit-pack doc columns on "
+                          "the block-max grid and quantize tf "
+                          "(RUNBOOK §26)")
+    pmi.add_argument("--decompress", action="store_true",
+                     help="shorthand for --to 2: walk a compressed index "
+                          "back to raw arenas (byte-identical when the "
+                          "tf mode was lossless)")
+    pmi.add_argument("--tf-dtype", choices=["auto", "int8", "bf16"],
+                     default=None,
+                     help="tf quantization for --compress (default: "
+                          "TPU_IR_TF_DTYPE; auto = int8 when lossless "
+                          "everywhere, else bf16)")
     pmi.add_argument("--add-bounds", action="store_true",
                      help="backfill the block-max bounds artifact "
                           "(blockmax.arena) from the postings in place — "

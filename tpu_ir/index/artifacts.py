@@ -18,7 +18,7 @@ from typing import Iterator
 import numpy as np
 
 from .format import CORRUPT_NPZ as _CORRUPT_NPZ
-from .format import ARENA_SUFFIX, load_arena
+from .format import ARENA_SUFFIXES, load_arena
 
 _HEAD = 8  # values shown per array in the fallback listing
 
@@ -214,7 +214,7 @@ def inspect_path(path: str, n: int = 10) -> Iterator[str]:
         return
     if path.endswith(".npz"):
         yield from _inspect_npz(path, n)
-    elif path.endswith(ARENA_SUFFIX):
+    elif path.endswith(ARENA_SUFFIXES):
         yield from _inspect_arena(path, n)
     elif path.endswith(".npy"):
         a = np.load(path, mmap_mode="r")

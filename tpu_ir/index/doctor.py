@@ -45,7 +45,12 @@ def _pct(a, qs=(50, 90, 99)) -> dict:
 
 def _shard_scan(index_dir: str, meta) -> tuple[np.ndarray, list, dict]:
     """One pass over the part shards: the assembled global df column,
-    per-shard stats, and (v2) per-section byte totals."""
+    per-shard stats (codec facts included for compressed parts), and
+    (v2/v3) per-section byte totals. Compressed shards are decoded in
+    flight (load_shard's default), so `postings` and the df column mean
+    the same thing at every format version."""
+    from . import compress as comp
+
     df = np.zeros(meta.vocab_size, np.int64)
     shards = []
     sections: dict[str, int] = {}
@@ -53,18 +58,31 @@ def _shard_scan(index_dir: str, meta) -> tuple[np.ndarray, list, dict]:
         path = fmt.part_path(index_dir, s)
         z = fmt.load_shard(index_dir, s, mmap=True)
         df[z["term_ids"]] = z["df"]
-        shards.append({
+        entry = {
             "shard": s,
             "file": os.path.basename(path),
             "bytes": os.path.getsize(path),
             "terms": int(len(z["term_ids"])),
             "postings": int(z["indptr"][-1]) if len(z["indptr"]) else 0,
-        })
-        if path.endswith(fmt.ARENA_SUFFIX):
+            # what the SAME postings cost as decoded raw arrays — the
+            # numerator of the compression ratio (and the HBM a worker
+            # pays per shard when it assembles the full CSR)
+            "raw_equivalent_bytes": int(sum(
+                np.asarray(z[k]).nbytes
+                for k in ("term_ids", "indptr", "pair_doc",
+                          "pair_tf", "df"))),
+        }
+        if path.endswith(fmt.ARENA_SUFFIXES):
             header, _ = fmt.read_arena_header(path)
             for sec in header["sections"]:
                 sections[sec["name"]] = (sections.get(sec["name"], 0)
                                          + int(sec["nbytes"]))
+            names = {sec["name"] for sec in header["sections"]}
+            if comp.COMPRESS_INFO in names:
+                raw = fmt.load_shard(index_dir, s, mmap=True,
+                                     decode=False)
+                entry["codec"] = comp.shard_info(raw)
+        shards.append(entry)
     return df, shards, sections
 
 
@@ -197,6 +215,9 @@ def live_doctor_report(live_dir: str) -> dict:
             "docs": meta.num_docs,
             "num_pairs": meta.num_pairs,
             "bytes": _dir_bytes(p),
+            "format_version": meta.format_version,
+            "compressed": bool(getattr(meta, "compressed", False)),
+            "tf_lossy": bool(getattr(meta, "tf_lossy", False)),
             "tombstones": len(tombs.get(name, [])),
             # block-max bounds presence per segment (ISSUE 13): a
             # generation serves block-max only from segments that carry
@@ -301,6 +322,15 @@ def live_doctor_report(live_dir: str) -> dict:
             f"({len(segments)} segments, "
             f"{counts['tombstoned']} tombstones); serving follows the "
             "latest COMPACTED generation until the next compaction")
+    comp_segs = [s["segment"] for s in segments if s["compressed"]]
+    if comp_segs and len(comp_segs) < len(segments):
+        warnings.append(
+            f"mixed segment formats in generation {gen}: "
+            f"{len(comp_segs)} compressed, "
+            f"{len(segments) - len(comp_segs)} raw — per-worker HBM "
+            "projections are the raw segments' until every segment is "
+            "migrated (`tpu-ir migrate-index <segment> --compress`) or "
+            "the next compaction rewrites them uniformly")
     report["warnings"] = warnings
     return report
 
@@ -369,6 +399,7 @@ def doctor_report(index_dir: str, top_terms: int = 10) -> dict:
             "bytes_balance": _balance(s["bytes"] for s in shards),
         },
         "tiers": _tier_report(df, meta.num_docs),
+        "compression": _compression_report(meta, shards),
         "arena_sections": sections or None,
         "serving_caches": _serving_caches(index_dir),
         # block-max bound health (ISSUE 13): presence, staleness vs the
@@ -379,6 +410,40 @@ def doctor_report(index_dir: str, top_terms: int = 10) -> dict:
     }
     report["warnings"] = _warnings(report)
     return report
+
+
+def _compression_report(meta, shards: list) -> dict:
+    """The compressed-arena readout (ISSUE 20): how many shards carry
+    the codec, what the bytes shrank to, and what that buys a
+    scatter-gather worker. `projected_worker_hbm_bytes` maps worker
+    counts to the postings bytes ONE doc-range worker materializes:
+    raw workers assemble the full CSR whatever their range
+    (restrict_tiers zeroes tfs but keeps full geometry), while
+    compressed workers lean-decode only the blocks intersecting their
+    range (load_shard(doc_range=...)), so their share scales as 1/W —
+    the "one worker holds 10x the corpus" arithmetic, from this
+    container's real shard bytes."""
+    compressed = [s for s in shards if "codec" in s]
+    file_bytes = sum(s["bytes"] for s in shards)
+    raw_eq = sum(s["raw_equivalent_bytes"] for s in shards)
+    nd = max(meta.num_docs, 1)
+    out = {
+        "compressed_shards": len(compressed),
+        "raw_shards": len(shards) - len(compressed),
+        "tf_dtype": getattr(meta, "tf_dtype", "int32"),
+        "tf_lossy": bool(getattr(meta, "tf_lossy", False)),
+        "file_bytes": int(file_bytes),
+        "raw_equivalent_bytes": int(raw_eq),
+        "ratio": (round(raw_eq / file_bytes, 3) if file_bytes else None),
+        "bytes_per_doc": round(file_bytes / nd, 2),
+        "raw_bytes_per_doc": round(raw_eq / nd, 2),
+    }
+    if compressed:
+        out["projected_worker_hbm_bytes"] = {
+            str(w): {"raw": int(raw_eq),
+                     "compressed": int(raw_eq // w)}
+            for w in (1, 4, 16)}
+    return out
 
 
 def _bounds_report(index_dir: str, meta) -> dict:
@@ -419,4 +484,17 @@ def _warnings(report: dict) -> list[str]:
             f"term {top[0]['term']!r} appears in {top[0]['df_fraction']:.0%} "
             "of documents (stopword-grade; its idf contributes ~nothing "
             "while its postings dominate the hot strip)")
+    comp = report.get("compression") or {}
+    if comp.get("compressed_shards") and comp.get("raw_shards"):
+        out.append(
+            f"mixed shard formats: {comp['compressed_shards']} "
+            f"compressed, {comp['raw_shards']} raw — an interrupted "
+            "`migrate-index --compress`; finish it (re-run is "
+            "idempotent) or roll back with --decompress")
+    if comp.get("tf_lossy"):
+        out.append(
+            "term frequencies are LOSSY (int8 floor-quantized to 256 "
+            "anchors): rankings may differ from the raw index; "
+            "`--decompress` cannot restore the original tfs. Use "
+            "--tf-dtype bf16 where bit-exactness matters")
     return out

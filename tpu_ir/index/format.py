@@ -55,6 +55,13 @@ FORMAT_VERSION = 1
 # emit v2 unless pinned back via TPU_IR_FORMAT_VERSION=1 (RUNBOOK
 # migration note) or an explicit builder format_version=1.
 ARENA_FORMAT_VERSION = 2
+# artifact format v3: part files are COMPRESSED arenas (.carena) — same
+# container, but the five shard arrays are stored as bit-packed doc
+# groups + quantized tf sections (index/compress.py). Selected per
+# index by `tpu-ir migrate-index --compress` or the TPU_IR_COMPRESS
+# build hook, never by default: v3 is opt-in because decode pays CPU at
+# load that v2's zero-copy mmap does not.
+COMPRESSED_FORMAT_VERSION = 3
 DEFAULT_FORMAT_VERSION = ARENA_FORMAT_VERSION
 METADATA = "metadata.json"
 DOCNOS = "docnos.txt"
@@ -79,18 +86,24 @@ def resolve_format_version(format_version: int | None = None) -> int:
 
 def part_name(shard: int, format_version: int | None = None) -> str:
     # reference output shards are part-00000..part-0000N (Hadoop naming);
-    # the extension carries the artifact format (npz v1, arena v2)
-    if resolve_format_version(format_version) >= ARENA_FORMAT_VERSION:
+    # the extension carries the artifact format (npz v1, arena v2,
+    # compressed arena v3)
+    fv = resolve_format_version(format_version)
+    if fv >= COMPRESSED_FORMAT_VERSION:
+        return f"part-{shard:05d}.carena"
+    if fv >= ARENA_FORMAT_VERSION:
         return f"part-{shard:05d}.arena"
     return f"part-{shard:05d}.npz"
 
 
 def part_path(index_dir: str, shard: int) -> str:
-    """The shard's on-disk part file, whichever format is present (arena
-    preferred — a mid-migration dir holds both and the arenas are the
-    complete copies). Falls back to the resolved-default name when
-    neither exists (callers get a clean FileNotFoundError on open)."""
-    for fv in (ARENA_FORMAT_VERSION, FORMAT_VERSION):
+    """The shard's on-disk part file, whichever format is present
+    (newest format preferred — a mid-migration dir holds two copies and
+    the newer ones are the complete set). Falls back to the
+    resolved-default name when none exists (callers get a clean
+    FileNotFoundError on open)."""
+    for fv in (COMPRESSED_FORMAT_VERSION, ARENA_FORMAT_VERSION,
+               FORMAT_VERSION):
         p = os.path.join(index_dir, part_name(shard, fv))
         if os.path.exists(p):
             return p
@@ -119,15 +132,29 @@ class IndexMetadata:
     checksums: dict[str, str] = field(default_factory=dict)
     # artifact format of the part/serving-cache files: 1 = npz zips,
     # 2 = page-aligned raw-bytes arenas (zero-copy mmap loads, verify-
-    # while-read). Pre-v2 metadata lacks the key and defaults to 1.
+    # while-read), 3 = compressed arenas (bit-packed doc groups +
+    # quantized tf; index/compress.py). Pre-v2 metadata lacks the key
+    # and defaults to 1.
     format_version: int = FORMAT_VERSION
+    # v3 codec facts, stamped by migrate-index --compress / the build
+    # hook: tf_dtype is the stored tf encoding ("int8" | "bf16"; raw
+    # indexes keep "int32"), tf_lossy marks an int8 index whose tf
+    # values did NOT all fit the 256-entry LUT — scores are floor-
+    # quantized approximations and verify/doctor must say so loudly
+    tf_dtype: str = "int32"
+    tf_lossy: bool = False
+
+    @property
+    def compressed(self) -> bool:
+        return self.format_version >= COMPRESSED_FORMAT_VERSION
 
     def save(self, index_dir: str) -> None:
         with open(os.path.join(index_dir, METADATA), "w") as f:
             json.dump(self.__dict__, f, indent=2, sort_keys=True)
 
     def save_with_checksums(self, index_dir: str,
-                            block_bounds: bool = True) -> None:
+                            block_bounds: bool = True,
+                            compress: bool = True) -> None:
         """Checksum every integrity-covered artifact currently on disk,
         record the digests, then save. The single finalization call every
         builder (in-memory, streaming, multi-host, merge) ends with —
@@ -138,7 +165,18 @@ class IndexMetadata:
         and the merge/compaction paths live generations flow through —
         emits bounds before the checksum pass pins them, with no
         per-builder wiring to drift. `block_bounds=False` skips the pass
-        (migrate --add-bounds recomputes explicitly first)."""
+        (migrate --add-bounds recomputes explicitly first).
+
+        Compression rides the same choke point: with TPU_IR_COMPRESS=1
+        the parts just written are rewritten as v3 compressed arenas
+        (index/compress.py) BEFORE bounds, so bounds derive from the
+        postings serving will decode. `compress=False` opts out
+        (migrate has already converted explicitly — a rollback must not
+        be re-compressed by a lingering env var)."""
+        if compress:
+            from .compress import ensure_compressed
+
+            ensure_compressed(index_dir, self)
         if block_bounds:
             from .blockmax import ensure_block_bounds
 
@@ -283,7 +321,7 @@ def readable_npz(path: str) -> bool:
     / arena section CRCs verify on a full read), so True means the
     artifact's bytes are intact."""
     try:
-        if path.endswith(ARENA_SUFFIX):
+        if path.endswith(ARENA_SUFFIXES):
             load_arena(path)
             return True
         with np.load(path, allow_pickle=False) as z:
@@ -325,6 +363,13 @@ def file_checksum(path: str, chunk_bytes: int = 1 << 22) -> str:
 ARENA_MAGIC = b"TPUIRAR2"
 ARENA_ALIGN = 4096
 ARENA_SUFFIX = ".arena"
+# v3 compressed parts reuse the arena container byte-for-byte (same
+# magic, header, per-section CRCs); what makes them v3 is the section
+# set — index/compress.py's bit-packed doc groups + quantized tf
+# instead of the five raw arrays. Container-level read paths route on
+# ARENA_SUFFIXES so both spellings hit the arena reader.
+COMPRESSED_SUFFIX = ".carena"
+ARENA_SUFFIXES = (ARENA_SUFFIX, COMPRESSED_SUFFIX)
 
 
 def _align_up(n: int, align: int = ARENA_ALIGN) -> int:
@@ -494,11 +539,13 @@ def integrity_names(index_dir: str, meta: "IndexMetadata") -> list[str]:
     store is excluded — it may legitimately be (re)built AFTER metadata
     (cmd_index --store on an existing index) and carries its own idx/bin
     consistency check."""
-    # both format versions' part names are listed and existence-filtered:
-    # a mid-migration dir (arena written, npz not yet removed) keeps every
-    # on-disk copy covered instead of silently dropping one
+    # every format version's part names are listed and existence-
+    # filtered: a mid-migration dir (new copy written, source not yet
+    # removed) keeps every on-disk copy covered instead of silently
+    # dropping one
     names = [part_name(s, fv) for s in range(meta.num_shards)
-             for fv in (FORMAT_VERSION, ARENA_FORMAT_VERSION)]
+             for fv in (FORMAT_VERSION, ARENA_FORMAT_VERSION,
+                        COMPRESSED_FORMAT_VERSION)]
     if meta.has_positions:
         from .positions import positions_name
 
@@ -513,14 +560,18 @@ def integrity_names(index_dir: str, meta: "IndexMetadata") -> list[str]:
 
 
 def _part_twin(index_dir: str, name: str) -> str | None:
-    """The same shard's part file under the OTHER format's extension, if
-    it exists — what a migration leaves behind for a shard it has
+    """The same shard's part file under ANOTHER format's extension, if
+    one exists — what a migration leaves behind for a shard it has
     already converted (the source is unlinked, metadata stamped last)."""
-    for old, new in ((".npz", ARENA_SUFFIX), (ARENA_SUFFIX, ".npz")):
+    suffixes = (".npz", ARENA_SUFFIX, COMPRESSED_SUFFIX)
+    for old in suffixes:
         if name.startswith("part-") and name.endswith(old):
-            twin = os.path.join(index_dir, name[: -len(old)] + new)
-            if os.path.exists(twin):
-                return twin
+            for new in suffixes:
+                if new == old:
+                    continue
+                twin = os.path.join(index_dir, name[: -len(old)] + new)
+                if os.path.exists(twin):
+                    return twin
     return None
 
 
@@ -529,7 +580,7 @@ def _self_verify_part(path: str) -> None:
     table / npz zip entries) — full read, every byte checked — raising
     the structured IntegrityError surface on any corruption."""
     try:
-        if path.endswith(ARENA_SUFFIX):
+        if path.endswith(ARENA_SUFFIXES):
             load_arena(path)  # eager read checks every section CRC
         else:
             with np.load(path) as z:
@@ -627,7 +678,9 @@ def quarantine(index_dir: str, name: str, *, keep: int | None = None) -> str:
 def save_shard(index_dir: str, shard: int, *, term_ids: np.ndarray,
                indptr: np.ndarray, pair_doc: np.ndarray,
                pair_tf: np.ndarray, df: np.ndarray,
-               format_version: int | None = None) -> None:
+               format_version: int | None = None,
+               num_docs: int | None = None,
+               tf_dtype: str | None = None) -> None:
     fv = resolve_format_version(format_version)
     arrays = dict(
         term_ids=term_ids.astype(np.int32),
@@ -637,14 +690,32 @@ def save_shard(index_dir: str, shard: int, *, term_ids: np.ndarray,
         df=df.astype(np.int32),
     )
     path = os.path.join(index_dir, part_name(shard, fv))
-    if fv >= ARENA_FORMAT_VERSION:
+    if fv >= COMPRESSED_FORMAT_VERSION:
+        # v3: encode the five arrays into compressed sections, same
+        # atomic arena write. num_docs sizes the block-index column;
+        # when the caller does not know it, the shard's own max doc is
+        # an exact-enough bound (it only picks a metadata dtype).
+        from . import compress as _compress
+
+        if num_docs is None:
+            num_docs = int(arrays["pair_doc"].max()) + 1 \
+                if len(arrays["pair_doc"]) else 1
+        if tf_dtype is None:
+            from ..utils import envvars
+
+            tf_dtype = envvars.get_choice("TPU_IR_TF_DTYPE")
+        sections = _compress.encode_shard(arrays, num_docs=num_docs,
+                                          tf_dtype=tf_dtype)
+        write_arena_atomic(path, **sections)
+    elif fv >= ARENA_FORMAT_VERSION:
         write_arena_atomic(path, **arrays)
     else:
         savez_atomic(path, **arrays)
-    # drop the other-format twin so a rebuild over a migrated (or
+    # drop the other-format twins so a rebuild over a migrated (or
     # differently-pinned) dir can't leave a stale part both readers and
     # the checksum recorder would keep honoring
-    for other in (FORMAT_VERSION, ARENA_FORMAT_VERSION):
+    for other in (FORMAT_VERSION, ARENA_FORMAT_VERSION,
+                  COMPRESSED_FORMAT_VERSION):
         if other != fv:
             stale = os.path.join(index_dir, part_name(shard, other))
             if os.path.exists(stale):
@@ -672,23 +743,55 @@ def write_pair_shards(index_dir: str, df: np.ndarray, pair_doc: np.ndarray,
     return shard_of, offset_of
 
 
-def load_shard(index_dir: str, shard: int, *,
-               mmap: bool = False) -> dict[str, np.ndarray]:
+def _decode_sections(sections: dict[str, np.ndarray],
+                     doc_range: tuple[int, int] | None
+                     ) -> dict[str, np.ndarray]:
+    """Decode v3 compressed sections back to the raw shard dict, timing
+    the unpack into the decode.block histogram (NOT load.read: the read
+    span must keep tracking bytes-off-disk so compressed loads show the
+    byte win, and decode is a separate, attributable cost)."""
+    import time as _time
+
+    from ..obs import get_registry
+    from . import compress as _compress
+
+    t0 = _time.perf_counter()
+    out = _compress.decode_shard(sections, doc_range=doc_range)
+    get_registry().observe("decode.block", _time.perf_counter() - t0)
+    return out
+
+
+def load_shard(index_dir: str, shard: int, *, mmap: bool = False,
+               doc_range: tuple[int, int] | None = None,
+               decode: bool = True) -> dict[str, np.ndarray]:
     """Read one part shard, whichever format is on disk. A full (eager)
     read verifies content CRCs in both formats (zip entry CRCs / arena
     section CRCs), so corruption surfaces as a CORRUPT_NPZ member —
     the invariant the resume/quarantine paths trust. `mmap=True` maps
     arena sections zero-copy instead (no verification, no streamed
-    read); npz cannot mmap and ignores the flag."""
+    read); npz cannot mmap and ignores the flag.
+
+    v3 compressed shards are decoded transparently to the same five
+    arrays; with `doc_range`, doc blocks outside the range are skipped
+    before their payload bytes are touched (under mmap those pages are
+    never even faulted in — the memory-lean worker path).
+    `decode=False` returns the raw compressed sections instead (doctor
+    / migrate / inspect look at the codec itself)."""
     path = part_path(index_dir, shard)
-    if path.endswith(ARENA_SUFFIX):
-        return load_arena(path, mmap=mmap)
+    if path.endswith(ARENA_SUFFIXES):
+        z = load_arena(path, mmap=mmap)
+        from . import compress as _compress
+
+        if decode and _compress.is_compressed(z):
+            return _decode_sections(z, doc_range)
+        return z
     with np.load(path) as z:
         return {k: z[k] for k in z.files}
 
 
-def load_shard_verified(index_dir: str, shard: int,
-                        meta: "IndexMetadata") -> dict[str, np.ndarray]:
+def load_shard_verified(index_dir: str, shard: int, meta: "IndexMetadata",
+                        *, doc_range: tuple[int, int] | None = None,
+                        decode: bool = True) -> dict[str, np.ndarray]:
     """Verify-while-read shard load: ONE streamed pass over the part
     bytes folds the whole-file CRC32 and compares it against the
     metadata-recorded digest, then the arrays are viewed (arena) or
@@ -725,12 +828,17 @@ def load_shard_verified(index_dir: str, shard: int,
             path, f"checksum mismatch (recorded {want}, found {got}); "
             "the artifact is corrupt — quarantine it and rebuild the "
             "index (or restore from a good copy)")
-    if path.endswith(ARENA_SUFFIX):
+    if path.endswith(ARENA_SUFFIXES):
         header, data_start = read_arena_header(buf)
         # the whole-file digest matched, so section CRCs only need
         # re-checking when metadata recorded nothing to pin the bytes
-        return _arena_views(buf, header, data_start, path,
-                            verify=want is None)
+        z = _arena_views(buf, header, data_start, path,
+                         verify=want is None)
+        from . import compress as _compress
+
+        if decode and _compress.is_compressed(z):
+            return _decode_sections(z, doc_range)
+        return z
     with np.load(io.BytesIO(buf), allow_pickle=False) as z:
         return {k: z[k] for k in z.files}
 

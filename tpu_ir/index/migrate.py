@@ -1,17 +1,27 @@
-"""In-place artifact format migration: v1 npz parts <-> v2 arenas.
+"""In-place artifact format migration: v1 npz <-> v2 arena <-> v3 compressed.
 
 `tpu-ir migrate-index <dir>` rewrites every part shard of a built index
 into the target format (default: v2 page-aligned arenas, format.py) with
 the same atomic temp-file + rename discipline the builders use, then
 re-records the metadata integrity checksums and the format_version stamp
 in ONE final metadata write. Interrupted migrations leave a mixed dir
-that every reader already tolerates (part_path prefers the arena copy;
+that every reader already tolerates (part_path prefers the newest copy;
 integrity_names covers whichever files exist), and re-running the
 migration completes it — idempotent by construction.
 
 Rollback is the same operation with --to 1 (RUNBOOK: "Migration &
 rollback"): arenas re-serialize to npz and the metadata pin returns to
 format_version 1, so a fleet can be walked back without a rebuild.
+
+`--compress` (--to 3, ISSUE 20) rewrites parts as compressed arenas
+(index/compress.py: bit-packed doc groups on the block-max grid +
+int8-LUT/bf16 tf) and re-derives the block-max bounds from the postings
+serving will decode. `--decompress` walks back to raw v2 arenas — byte-
+identical to the pre-compression originals whenever the tf mode was
+lossless (the encoder proves restoration at compress time). A LOSSY
+int8 index decompresses to its floor-quantized values; metadata keeps
+`tf_lossy: true` sticky through the rollback so verify/doctor never
+stop saying so.
 """
 
 from __future__ import annotations
@@ -23,7 +33,8 @@ from . import format as fmt
 
 def migrate_index(index_dir: str,
                   to_version: int = fmt.ARENA_FORMAT_VERSION,
-                  add_bounds: bool = False) -> dict:
+                  add_bounds: bool = False,
+                  tf_dtype: str | None = None) -> dict:
     """Convert every part shard of the index at `index_dir` to
     `to_version` (1 = npz, 2 = arena), verify-while-read from the old
     copies, re-record checksums, and stamp metadata.format_version.
@@ -37,7 +48,8 @@ def migrate_index(index_dir: str,
     re-records checksums, so a pre-bounds index gains block-max pruning
     in place without a rebuild. Idempotent: identical postings produce
     byte-identical bounds."""
-    if to_version not in (fmt.FORMAT_VERSION, fmt.ARENA_FORMAT_VERSION):
+    if to_version not in (fmt.FORMAT_VERSION, fmt.ARENA_FORMAT_VERSION,
+                          fmt.COMPRESSED_FORMAT_VERSION):
         raise ValueError(f"unknown artifact format version: {to_version}")
     meta = fmt.IndexMetadata.load(index_dir)
     if add_bounds:
@@ -53,6 +65,24 @@ def migrate_index(index_dir: str,
             "index_dir": index_dir,
             "add_bounds": True,
             "bounds_artifact": BLOCKMAX_ARENA,
+            **info,
+            "checksums_recorded": len(meta.checksums),
+            "ok": True,
+        }
+    if to_version == fmt.COMPRESSED_FORMAT_VERSION:
+        from . import compress as comp
+
+        info = comp.compress_index(index_dir, meta, tf_dtype=tf_dtype)
+        # ONE final metadata write: compress=False (the conversion just
+        # happened, explicitly); block bounds are re-derived by the
+        # standing ensure_block_bounds hook from the postings serving
+        # will actually decode, so a lossy int8 index gets tight bounds
+        # over its floor-quantized tf values
+        meta.save_with_checksums(index_dir, compress=False)
+        return {
+            "index_dir": index_dir,
+            "format_version": to_version,
+            "num_shards": meta.num_shards,
             **info,
             "checksums_recorded": len(meta.checksums),
             "ok": True,
@@ -91,7 +121,13 @@ def migrate_index(index_dir: str,
     # on disk (the new parts included, the unlinked sources gone) plus
     # the format stamp readers key part names off
     meta.format_version = to_version
-    meta.save_with_checksums(index_dir)
+    # raw parts store exact int32 tf again — but tf_lossy stays STICKY:
+    # a lossy index's rollback restores the floor-QUANTIZED values (the
+    # exact originals are gone), and that fact must outlive the walk-back
+    meta.tf_dtype = "int32"
+    # compress=False: an explicit decompress must never be undone by a
+    # lingering TPU_IR_COMPRESS=1 in the environment
+    meta.save_with_checksums(index_dir, compress=False)
     return {
         "index_dir": index_dir,
         "format_version": to_version,

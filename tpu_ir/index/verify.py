@@ -139,7 +139,13 @@ def verify_index(index_dir: str) -> dict:
 
     assert seen_terms.all(), "terms missing from all shards"
     assert total_pairs == meta.num_pairs, "num_pairs != metadata"
-    assert total_tf == int(doc_len.sum()), "sum(tf) != sum(doc_len)"
+    tf_lossy = bool(getattr(meta, "tf_lossy", False))
+    if not tf_lossy:
+        assert total_tf == int(doc_len.sum()), "sum(tf) != sum(doc_len)"
+    # lossy int8 floor-quantizes tfs, so tf mass is NOT conserved — the
+    # conservation check is skipped and the report says so LOUDLY below
+    # (compress_index refuses lossy int8 on positional indexes, where
+    # the run-length invariant has no such escape hatch)
 
     # dictionary: sorted, complete, offsets point at real slices. The
     # whole expected file is regenerated from the vocab + the offsets
@@ -179,7 +185,7 @@ def verify_index(index_dir: str) -> dict:
             assert (np.diff(tids)[within] > 0).all(), \
                 f"chargram k={ck}: term lists not sorted-unique"
 
-    return {
+    out = {
         "checksums_verified": checksums_verified,
         "dictionary_terms_checked": dict_checked,
         "bucket_segmented_shards": segmented_shards,
@@ -189,8 +195,19 @@ def verify_index(index_dir: str) -> dict:
         "num_pairs": total_pairs,
         "num_shards": meta.num_shards,
         "total_tf": total_tf,
+        "format_version": meta.format_version,
         "ok": True,
     }
+    if getattr(meta, "compressed", False) or tf_lossy:
+        out["compressed"] = bool(getattr(meta, "compressed", False))
+        out["tf_dtype"] = getattr(meta, "tf_dtype", "int32")
+        out["tf_lossy"] = tf_lossy
+        if tf_lossy:
+            out["tf_lossy_warning"] = (
+                "term frequencies are floor-quantized (lossy int8): "
+                "tf-mass conservation was NOT checked and rankings may "
+                "differ from the raw index")
+    return out
 
 
 def verify_live(live_dir: str) -> dict:

@@ -167,6 +167,19 @@ METRICS: dict[str, tuple[str, float]] = {
     # generous like swap_staleness_ms — the sentry guards against an
     # order-of-magnitude staleness regression, not ms-level weather
     "freshness_lag_ms": ("lower", 2000.0),
+    # compressed arena (ISSUE 20; bench --compress A/B rows): on-disk
+    # part bytes and their per-doc normalization are the compression
+    # claim itself — creeping back UP means the codec (or a new
+    # section someone added) is leaking bytes. Floors absorb arena
+    # alignment padding when shard counts shift between runs.
+    "index_bytes": ("lower", 64 * 1024.0),
+    "bytes_per_doc": ("lower", 1.0),
+    # cold-load phase walls the compression directly buys: read_s
+    # scales with bytes mmap-faulted off disk, h2d_s with bytes
+    # shipped to the device (the bf16 strip halves its share).
+    # Second-scale container IO weather needs real floors.
+    "load_read_s": ("lower", 0.5),
+    "load_h2d_s": ("lower", 0.5),
 }
 
 

@@ -271,6 +271,20 @@ TIMESERIES_COUNTER_NAMES = (
     "forecast.fits", "forecast.scaleups",
 )
 
+# Compressed quantized arena (ISSUE 20, index/compress.py).
+# compress.shards / compress.bytes_in / compress.bytes_out account each
+# shard encode at migrate/build-hook time (the ratio doctor reports is
+# recomputed from disk, not from these). decode.blocks_decoded /
+# blocks_skipped count posting groups a shard decode unpacked vs skipped
+# (doc-range workers: skipped grows with what the range excludes);
+# decode.bytes / bytes_skipped the payload bytes behind each — the
+# memory-lean pin reads bytes_skipped directly.
+COMPRESS_COUNTER_NAMES = (
+    "compress.shards", "compress.bytes_in", "compress.bytes_out",
+    "decode.blocks_decoded", "decode.blocks_skipped",
+    "decode.bytes", "decode.bytes_skipped",
+)
+
 DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
     # bytes streamed host-to-device across all uploads (pairs with the
     # load.h2d histogram for an effective-MB/s readout)
@@ -278,7 +292,8 @@ DECLARED_COUNTERS = tuple(f"fault.{s}" for s in FAULT_SITES) + (
 ) + (COMPILE_COUNTER_NAMES + QUERYLOG_COUNTER_NAMES + BATCH_COUNTER_NAMES
      + ROUTER_COUNTER_NAMES + BUILD_COUNTER_NAMES + INGEST_COUNTER_NAMES
      + PRUNE_COUNTER_NAMES + CACHE_COUNTER_NAMES + SCALE_COUNTER_NAMES
-     + DISTTRACE_COUNTER_NAMES + TIMESERIES_COUNTER_NAMES)
+     + DISTTRACE_COUNTER_NAMES + TIMESERIES_COUNTER_NAMES
+     + COMPRESS_COUNTER_NAMES)
 # "request" (the root span, all levels pooled) rides alongside the
 # per-level request.<level> histograms — same observations, two cuts
 DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
@@ -290,6 +305,10 @@ DECLARED_HISTOGRAMS = ("request",) + REQUEST_STAGES + LOAD_STAGES + tuple(
     "explain",
     # one slow-query force-capture (span tree + explain + flight dump)
     "querylog.slow_capture",
+    # one compressed shard decode (ISSUE 20): unpack + canonical-order
+    # restore wall seconds — deliberately OUTSIDE the load.read span so
+    # load_read_s keeps measuring bytes-off-disk and drops with them
+    "decode.block",
     # coalescing scheduler (ISSUE 9): batch occupancy per dispatched
     # batch (a COUNT observed on the latency bucket scale — 1..64 lands
     # exactly; p50 occupancy > 1 is the "coalescing engaged" proof) and

@@ -102,3 +102,67 @@ def pallas_tfidf_topk(q_terms, doc_matrix, df, num_docs, *, k: int = 10,
     scores = pallas_tfidf_scores(q_terms, doc_matrix, df, num_docs,
                                  interpret=interpret)
     return _topk_from_scores(scores, k)
+
+
+def _dequant_score_kernel(q_ref, idf_ref, row_ref, out_ref):
+    """Fused dequantize + weight + score step for the COMPRESSED arena's
+    narrow tf strip (grid (B, L), same schedule as _score_kernel). The
+    row arrives as bf16 RAW tf — half the HBM->VMEM DMA bytes of the
+    fp32 path — and is widened and weighted (1 + ln tf) here in VMEM,
+    so the fp32 form of the strip never exists in HBM at all. The
+    widening is exact for the compressed index's integer tfs <= 256
+    (bf16's 8-bit mantissa), which is what keeps this path inside the
+    bit-parity contract the XLA twin pins."""
+    b = pl.program_id(0)
+    l = pl.program_id(1)
+
+    @pl.when(l == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    tf = row_ref[:].astype(jnp.float32)
+    wtf = jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
+    out_ref[:] = out_ref[:] + idf_ref[b, l] * wtf
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def pallas_tfidf_scores_quantized(
+    q_terms: jax.Array,     # int32 [B, L], -1 padding
+    tf_matrix: jax.Array,   # bf16 [V, D] RAW tf (quantized strip)
+    df: jax.Array,          # int32 [V]
+    num_docs: jax.Array,    # int32 scalar
+    *,
+    interpret: bool = False,
+) -> jax.Array:
+    """pallas_tfidf_scores over the quantized strip: same row-gather DMA
+    schedule, but the input rows are narrow RAW tfs and the (1 + ln tf)
+    weighting fuses into the accumulation step instead of being a
+    precomputed fp32 matrix. Exercised by tests/test_pallas.py in
+    interpret mode off-TPU (same guard as the fp32 kernel)."""
+    b, l = q_terms.shape
+    v, d = tf_matrix.shape
+
+    ratio = jnp.asarray(num_docs, jnp.float32) / jnp.maximum(
+        df.astype(jnp.float32), 1.0)
+    # lint: invariant-ok (O(V) elementwise idf, fused in-trace; caching
+    # would fork the expression the XLA-parity harness compares against)
+    idf = jnp.where(df > 0, jnp.log10(jnp.maximum(ratio, 1e-30)), 0.0)
+    q_valid = (q_terms >= 0) & (q_terms < v)
+    safe_q = jnp.where(q_valid, q_terms, 0).astype(jnp.int32)
+    q_idf = jnp.where(q_valid, idf[safe_q], 0.0)  # [B, L]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(b, l),
+        in_specs=[
+            pl.BlockSpec((1, 1, d), lambda i, j, q, w: (q[i, j], 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, d), lambda i, j, q, w: (i, 0, 0)),
+    )
+    out = pl.pallas_call(
+        _dequant_score_kernel,
+        out_shape=jax.ShapeDtypeStruct((b, 1, d), jnp.float32),
+        grid_spec=grid_spec,
+        interpret=interpret,
+    )(safe_q, q_idf, tf_matrix.reshape(v, 1, d))
+    return out.reshape(b, d)

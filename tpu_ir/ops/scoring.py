@@ -55,7 +55,15 @@ def _prune_applicable(k: int, num_docs: int, prune: bool) -> bool:
 
 
 def _lntf(tf):
-    """The (1 + ln tf) weight curve; 0 for empty slots."""
+    """The (1 + ln tf) weight curve; 0 for empty slots.
+
+    The entry cast makes the curve dtype-polymorphic over the hot strip:
+    a bf16 strip (compressed arena, integer tfs <= 256 so the narrow
+    mantissa is exact) must widen HERE, before jnp.maximum — JAX weak
+    typing would otherwise keep the whole expression in bf16 and the
+    log would round differently from the fp32 raw path. f32-in is an
+    identity cast, so the raw path's traced expression is unchanged."""
+    tf = tf.astype(jnp.float32)
     return jnp.where(tf > 0, 1.0 + jnp.log(jnp.maximum(tf, 1.0)), 0.0)
 
 
@@ -85,7 +93,10 @@ def bm25_idf_weights(df: jax.Array, n: jax.Array) -> jax.Array:
 def bm25_saturation(tf, dl_norm, *, k1: float):
     """tf*(k1+1)/(tf + k1*dl_norm), guarded: at b=1.0 an empty doc has
     dl_norm 0 and a tf=0 cell would divide 0/0 — the NaN then outranks
-    every real score in lax.top_k (and poisons the hot-strip matmul)."""
+    every real score in lax.top_k (and poisons the hot-strip matmul).
+    Entry cast for bf16 hot strips (see _lntf): saturation must be
+    computed in fp32 or weak typing narrows the whole ratio to bf16."""
+    tf = tf.astype(jnp.float32)
     return tf * (k1 + 1.0) / jnp.maximum(tf + k1 * dl_norm, 1e-9)
 
 

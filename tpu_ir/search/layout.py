@@ -75,27 +75,32 @@ class TieredPostings(NamedTuple):
             np.asarray(self.hot_docs, np.int64)] = self.hot_vals
         return out
 
-    def hot_device(self):
+    def hot_device(self, dtype: str = "float32"):
         """Densify the hot strip ON DEVICE: upload the COO columns (the
         postings, not the strip) via the chunked double-buffered streamer
         — when they arrive as serving-cache mmaps, disk page-ins overlap
-        the in-flight transfers — and scatter under jit."""
+        the in-flight transfers — and scatter under jit. `dtype` selects
+        the resident strip dtype: "bfloat16" halves the HBM footprint
+        for compressed indexes whose tfs round-trip bf16 exactly (the
+        scorer checks that before asking); the kernels widen to fp32 at
+        the weight-curve entry, so scores stay bit-identical."""
         from ..utils.transfer import stream_to_device
 
         return _densify_hot(
             stream_to_device(self.hot_rows),
             stream_to_device(self.hot_docs),
             stream_to_device(self.hot_vals),
-            num_hot=self.num_hot, width=self.hot_width)
+            num_hot=self.num_hot, width=self.hot_width, dtype=dtype)
 
 
-@partial(jax.jit, static_argnames=("num_hot", "width"))
-def _densify_hot(rows, docs, vals, *, num_hot: int, width: int):
-    """jit scatter: COO hot postings -> dense f32 [H, D+1] raw-tf strip.
+@partial(jax.jit, static_argnames=("num_hot", "width", "dtype"))
+def _densify_hot(rows, docs, vals, *, num_hot: int, width: int,
+                 dtype: str = "float32"):
+    """jit scatter: COO hot postings -> dense [H, D+1] raw-tf strip.
     Each (term, doc) pair appears at most once, so set == add semantics."""
-    strip = jnp.zeros((num_hot, width), jnp.float32)
+    strip = jnp.zeros((num_hot, width), dtype)
     return strip.at[rows.astype(jnp.int32), docs.astype(jnp.int32)].set(
-        vals.astype(jnp.float32))
+        vals.astype(dtype))
 
 
 def _slim(a: np.ndarray, hi: int) -> np.ndarray:
@@ -330,8 +335,17 @@ def restrict_tiers(tiers: TieredPostings, lo: int, hi: int) -> TieredPostings:
 #  an UNCHANGED index revalidates without re-streaming every part's CRC;
 #  v6: the hot strip's block-max bounds (hot_blk_max [H, nblk] +
 #  manifest blockmax_width) ride in the cache, so warm loads serve
-#  block-max pruning with zero postings IO)
-_CACHE_VERSION = 6
+#  block-max pruning with zero postings IO;
+#  v7: the key folds in the index's serving INTERPRETATION — format
+#  version, tf dtype/lossiness, and each part's arena section
+#  (name, dtype) signature. The part-CRC key certifies bytes, not
+#  meaning: a compressed-arena migration that lands byte-for-byte
+#  re-runs (or a raw<->compressed flip with preserved mtimes) changes
+#  how those bytes must be decoded without changing any stat the v6
+#  fast path compares, so v6's stat-first revalidation could serve a
+#  stale strip dtype. Dtype signatures are header-only reads (~1 page
+#  per part), so the fast path stays stat-cheap)
+_CACHE_VERSION = 7
 
 
 def _part_stat(index_dir: str, meta) -> list:
@@ -356,6 +370,30 @@ def _part_stat(index_dir: str, meta) -> list:
         path = fmt.part_path(index_dir, s)
         st = os.stat(path)
         out.append([os.path.basename(path), st.st_size, st.st_mtime_ns])
+    return out
+
+
+def _section_signature(index_dir: str, meta) -> list:
+    """Per-part serving-interpretation signature: the arena header's
+    (section name, dtype) pairs — "npz" for v1 parts, which have exactly
+    one interpretation. Header-only reads (no payload IO). This is what
+    lets the cache key distinguish raw from compressed parts that a
+    stat (or even a whole-file CRC of a byte-identical re-migration)
+    cannot: the section list IS the decode contract."""
+    import os
+
+    from ..index import format as fmt
+
+    out = []
+    for s in range(meta.num_shards):
+        path = fmt.part_path(index_dir, s)
+        if path.endswith(".npz"):
+            out.append([os.path.basename(path), "npz"])
+            continue
+        header, _ = fmt.read_arena_header(path)
+        out.append([os.path.basename(path),
+                    [[sec["name"], sec["dtype"]]
+                     for sec in header["sections"]]])
     return out
 
 
@@ -386,6 +424,11 @@ def _serving_cache_key(index_dir: str, meta, hot_budget, base_cap,
         "vocab_size": meta.vocab_size,
         "num_pairs": meta.num_pairs,
         "part_files": files,
+        # v7: the serving interpretation — see the version changelog.
+        "format_version": meta.format_version,
+        "tf_dtype": getattr(meta, "tf_dtype", "int32"),
+        "tf_lossy": bool(getattr(meta, "tf_lossy", False)),
+        "section_dtypes": _section_signature(index_dir, meta),
         "hot_budget": hot_budget,
         "base_cap": base_cap,
         "growth": growth,

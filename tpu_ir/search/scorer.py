@@ -380,7 +380,7 @@ class Scorer:
             # hot postings — at 1M docs that uploads a few hundred MB
             # instead of the ~2 GB dense matrix over the H2D link
             # (the serving cold-start bottleneck; layout.hot_device)
-            self.hot_tfs = tiers.hot_device()
+            self.hot_tfs = tiers.hot_device(dtype=self._strip_dtype(tiers))
             # (no hot_max_tf here: the runtime-bounded prune kernels
             # that take it are not the production path — the
             # scheduled static skip needs only hot_rank; tests
@@ -508,8 +508,16 @@ class Scorer:
         # digest pins CONTENT, not just well-formedness: a stale or
         # swapped-in part from another build parses perfectly and would
         # serve a silently wrong index.
+        # memory-lean worker (ISSUE 20): on a COMPRESSED index a
+        # doc-range worker forwards its range into shard decode, so
+        # posting blocks outside the range never have their payload
+        # bytes read — the per-worker footprint shrinks with the range
+        # instead of tf-zeroing a full-size assembly. Raw indexes keep
+        # the full read (restrict_tiers zeroes after layout build).
+        lean_range = doc_range if meta.compressed else None
         df, (pair_doc, pair_tf) = cls._assemble_csr(
-            index_dir, meta, verify=verify_integrity)
+            index_dir, meta, verify=verify_integrity,
+            doc_range=lean_range)
         pair_term = None  # derived lazily from df when something needs it
         tiers = norms = None
         sharded_layout = None
@@ -524,6 +532,11 @@ class Scorer:
         from .layout import serving_cache_writable
 
         save_cache = serving_cache_writable(index_dir)
+        if lean_range is not None:
+            # the assembly above holds dead slots for everything outside
+            # this worker's range — a cache written from it would poison
+            # every later full-index load
+            save_cache = False
         if resolved == "sharded":
             import jax
 
@@ -621,7 +634,8 @@ class Scorer:
                                           **kwargs)
 
     @staticmethod
-    def _assemble_csr(index_dir: str, meta, verify: bool = False):
+    def _assemble_csr(index_dir: str, meta, verify: bool = False,
+                      doc_range: tuple | None = None):
         """Shard files -> (df, (pair_doc, pair_tf)) in global CSR order:
         a shard holds contiguous per-term runs, so every run's
         destination is the global indptr slice of its TERM ID — no sort
@@ -642,17 +656,28 @@ class Scorer:
         `verify=True` folds each part's recorded CRC into its ONE
         streamed read (fmt.load_shard_verified) — the verify-then-read
         double scan is gone for v1 npz and v2 arenas alike; v2 arenas
-        additionally read zero-copy (np.frombuffer views / mmap)."""
+        additionally read zero-copy (np.frombuffer views / mmap).
+
+        `doc_range=(lo, hi)` (1-based inclusive, the shard-worker
+        restriction) is forwarded to compressed-shard decode: posting
+        blocks wholly outside the range come back as (doc=0, tf=0) dead
+        slots WITHOUT their payload bytes ever being read (memory-lean
+        worker, ISSUE 20) — raw shards ignore it (restrict_tiers zeroes
+        them after layout build, same as always)."""
         from concurrent.futures import ThreadPoolExecutor
 
         v = meta.vocab_size
         n_threads = max(1, min(fmt.load_threads(), meta.num_shards))
+        # decode's range is half-open over the 1-based docid space
+        dr = (int(doc_range[0]), int(doc_range[1]) + 1) \
+            if doc_range is not None else None
 
         def read_one(s: int):
             if verify:
-                return fmt.load_shard_verified(index_dir, s, meta)
+                return fmt.load_shard_verified(index_dir, s, meta,
+                                               doc_range=dr)
             # unverified eager load: arenas still map zero-copy
-            return fmt.load_shard(index_dir, s, mmap=True)
+            return fmt.load_shard(index_dir, s, mmap=True, doc_range=dr)
 
         with obs_trace("load.read", shards=meta.num_shards,
                        threads=n_threads, verify=verify):
@@ -1563,6 +1588,37 @@ class Scorer:
             return None
         return self._blockmax_bound_table(scoring), width, cand
 
+    def _strip_dtype(self, tiers) -> str:
+        """Device dtype for the dense hot strip — "bfloat16" when the
+        index is compressed (or TPU_IR_COMPRESS=1 opts serving in) AND
+        every hot tf round-trips bf16 exactly (integers <= 256 fit the
+        8-bit mantissa; quantized-int8 tfs satisfy this by
+        construction), so the strip holds half the HBM with scores
+        still bit-identical: the kernels widen to fp32 at the
+        weight-curve entry (ops/scoring._lntf, bm25_saturation) and an
+        exactly-representable tf widens to the exact same fp32 value
+        the raw path computed with. An index whose tfs do NOT
+        round-trip falls back to fp32 LOUDLY — silent narrowing would
+        be a ranking change, not a memory optimization."""
+        from ..utils import envvars
+
+        if not (getattr(self.meta, "compressed", False)
+                or envvars.get_choice("TPU_IR_COMPRESS") == "1"):
+            return "float32"
+        import ml_dtypes
+
+        f32 = np.asarray(tiers.hot_vals).astype(np.float32)
+        if np.array_equal(
+                f32, f32.astype(ml_dtypes.bfloat16).astype(np.float32)):
+            return "bfloat16"
+        logger.warning(
+            "compressed index requested a bf16 hot strip but %d hot tfs "
+            "do not round-trip bf16 exactly; serving the strip in fp32 "
+            "(bit-exact, no HBM saving)",
+            int((f32 != f32.astype(ml_dtypes.bfloat16)
+                 .astype(np.float32)).sum()))
+        return "float32"
+
     def _hot_wstrip(self, scoring: str):
         """The device-cached PRE-WEIGHTED hot strip for a scoring mode
         (ops/scoring.py lntf_strip / bm25_strip), or None when disabled
@@ -1597,7 +1653,19 @@ class Scorer:
         from ..ops.scoring import bm25_strip, lntf_strip
 
         # computed OUTSIDE the lazy lock (device dispatch — lint TPU202);
-        # a racing loser's copy is garbage-collected, never corruption
+        # a racing loser's copy is garbage-collected, never corruption.
+        # A bf16 resident strip (compressed arena, _strip_dtype) widens
+        # FIRST: its integer tfs are bf16-exact, so the widened strip is
+        # bit-identical to the raw path's fp32 strip and the cached
+        # weighted twin stays inside the compression parity contract
+        # (the eager standalone strip build has no FMA-contraction
+        # freedom; the in-kernel weighting does, so raw-with-wstrip vs
+        # compressed-without would drift one ulp on BM25). Engagement is
+        # dtype-independent (same h*d1 budget test), so raw and
+        # compressed always make the SAME wstrip decision.
+        hot = self.hot_tfs
+        if hot.dtype != jnp.float32:
+            hot = hot.astype(jnp.float32)
         if key == "bm25":
             from .phrase import B as _b, K1 as _k1
 
@@ -1606,12 +1674,12 @@ class Scorer:
             # lint: shape-universe-ok (one strip build per generation —
             # the shape is index state, not batch content; TPU501's
             # steady-state contract is about per-request dispatches)
-            strip = bm25_strip(self.hot_tfs, self.doc_len,
+            strip = bm25_strip(hot, self.doc_len,
                                jnp.int32(self.meta.num_docs),
                                k1=_k1, b=_b)
         else:
             # lint: shape-universe-ok (one strip build per generation)
-            strip = lntf_strip(self.hot_tfs)
+            strip = lntf_strip(hot)
         with self._lazy_lock:
             return cache.setdefault(key, strip)
 

@@ -101,6 +101,18 @@ _declare("TPU_IR_SPOOL_INTERVAL", "float", 5.0,
 _declare("TPU_IR_FORMAT_VERSION", "int", 2,
          "artifact format writers emit (1 = npz rollback pin, 2 = arenas)",
          "§12", choices=(1, 2))
+_declare("TPU_IR_COMPRESS", "choice", "0",
+         "compress part shards at build finalize (bit-packed docids + "
+         "quantized tf, format v3): 1 compresses through the "
+         "save_with_checksums hook, 0 leaves raw arenas (migrate-index "
+         "--compress converts in place either way)", "§26",
+         choices=("0", "1"))
+_declare("TPU_IR_TF_DTYPE", "choice", "auto",
+         "term-frequency quantization for compressed shards: auto "
+         "(int8 LUT when lossless, else bf16), int8 (LUT, lossy above "
+         "256 distinct values — floor-quantized so blockmax bounds "
+         "stay safe), bf16 (always lossless via exception list)", "§26",
+         choices=("auto", "int8", "bf16"))
 _declare("TPU_IR_LOAD_THREADS", "int", None,
          "concurrent verified shard loads (default min(8, cores))", "§12",
          minimum=1)
